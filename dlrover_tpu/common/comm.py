@@ -1,0 +1,437 @@
+"""Control-plane message schema (agent ⇄ master).
+
+Mirrors the message surface of the reference
+(``dlrover/python/common/comm.py:105-540``): a flat family of small typed
+dataclasses carried over a 2-verb RPC (``report`` fire-and-forget-ish writes,
+``get`` request/response reads).  All messages are msgpack-encoded through
+:mod:`dlrover_tpu.common.serialize` — no pickle on the wire.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .serialize import register_message
+
+
+@register_message
+@dataclass
+class BaseRequest:
+    node_id: int = -1
+    node_type: str = ""
+    data: bytes = b""
+
+
+@register_message
+@dataclass
+class BaseResponse:
+    success: bool = True
+    reason: str = ""
+    data: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# KV store (rendezvous store + barriers; also feeds jax.distributed bootstrap)
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class KeyValuePair:
+    key: str = ""
+    value: bytes = b""
+
+
+@register_message
+@dataclass
+class KeyValueQuery:
+    key: str = ""
+
+
+@register_message
+@dataclass
+class KeyValueAdd:
+    key: str = ""
+    amount: int = 0
+
+
+@register_message
+@dataclass
+class KeyValueMultiGet:
+    keys: List[str] = field(default_factory=list)
+
+
+@register_message
+@dataclass
+class KeyValueMultiPair:
+    kvs: Dict[str, bytes] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class NodeMeta:
+    """Topology metadata a host reports when joining a rendezvous."""
+
+    node_id: int = 0
+    node_rank: int = -1
+    process_unit: int = 1  # local device-group count (≙ local_world_size)
+    slice_id: int = 0  # TPU slice this host belongs to (multislice jobs)
+    hostname: str = ""
+    addr: str = ""
+    asw: str = ""  # access switch id, for topology-aware sorting
+    psw: str = ""
+
+
+@register_message
+@dataclass
+class JoinRendezvousRequest:
+    node_id: int = 0
+    node_rank: int = -1
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    round: int = 0
+    node_ip: str = ""
+    slice_id: int = 0
+
+
+@register_message
+@dataclass
+class JoinRendezvousResponse:
+    round: int = 0
+
+
+@register_message
+@dataclass
+class CommWorldRequest:
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@register_message
+@dataclass
+class CommWorldResponse:
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    # node_rank -> NodeMeta for every member of the completed world.
+    world: Dict[int, NodeMeta] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class WaitingNodeNumRequest:
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@register_message
+@dataclass
+class WaitingNodeNumResponse:
+    waiting_num: int = 0
+
+
+@register_message
+@dataclass
+class NetworkReadyRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class NetworkReadyResponse:
+    ready: bool = False
+    reason: str = ""
+
+
+@register_message
+@dataclass
+class NetworkCheckResult:
+    node_id: int = 0
+    normal: bool = True
+    elapsed_time: float = 0.0
+    round: int = 0
+
+
+@register_message
+@dataclass
+class FaultNodesRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class FaultNodesResponse:
+    fault_nodes: List[int] = field(default_factory=list)
+    reason: str = ""
+
+
+@register_message
+@dataclass
+class StragglersRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class StragglersResponse:
+    stragglers: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Node lifecycle / health
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class NodeStateRequest:
+    node_id: int = 0
+    node_type: str = ""
+    status: str = ""
+    exit_reason: str = ""
+    restart_count: int = 0
+    message: str = ""
+
+
+@register_message
+@dataclass
+class NodeFailureReport:
+    node_id: int = 0
+    node_rank: int = -1
+    error_data: str = ""
+    level: str = "error"
+    restart_count: int = 0
+
+
+@register_message
+@dataclass
+class HeartbeatRequest:
+    node_id: int = 0
+    node_rank: int = -1
+    timestamp: float = 0.0
+
+
+@register_message
+@dataclass
+class DiagnosisActionMsg:
+    action_cls: str = "NoAction"
+    instance: int = -2
+    timestamp: float = 0.0
+    expired_s: float = 300.0
+    config: Dict[str, str] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class HeartbeatResponse:
+    actions: List[DiagnosisActionMsg] = field(default_factory=list)
+
+
+@register_message
+@dataclass
+class ResourceUsageReport:
+    node_id: int = 0
+    node_type: str = ""
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    device_util: Dict[int, float] = field(default_factory=dict)
+    device_mem_mb: Dict[int, float] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class TrainingStepReport:
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = 0.0
+    elapsed_s: float = 0.0
+    tokens_per_s: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Dynamic data sharding
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class DatasetShardParams:
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    storage_type: str = ""
+    dataset_name: str = ""
+    task_type: str = "training"
+
+
+@register_message
+@dataclass
+class TaskRequest:
+    node_id: int = 0
+    dataset_name: str = ""
+
+
+@register_message
+@dataclass
+class ShardMsg:
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    indices: List[int] = field(default_factory=list)
+
+
+@register_message
+@dataclass
+class TaskMsg:
+    task_id: int = -1
+    task_type: str = ""
+    shard: Optional[ShardMsg] = None
+
+
+@register_message
+@dataclass
+class TaskResult:
+    node_id: int = 0
+    dataset_name: str = ""
+    task_id: int = -1
+    success: bool = True
+    reason: str = ""
+
+
+@register_message
+@dataclass
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@register_message
+@dataclass
+class ShardCheckpointMsg:
+    dataset_name: str = ""
+    content: str = ""  # JSON payload of DatasetShardCheckpoint
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint coordination
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class CheckpointStepSync:
+    node_id: int = 0
+    step: int = 0
+
+
+@register_message
+@dataclass
+class CheckpointStepSyncResponse:
+    success: bool = False
+    waiting: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Pre-check / job status
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class PreCheckRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class PreCheckResponse:
+    status: str = "checking"
+    reason: str = ""
+
+
+@register_message
+@dataclass
+class JobStatusRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class JobStatusResponse:
+    stage: str = ""
+    exit_reason: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Elastic run config / auto-tuning
+# ---------------------------------------------------------------------------
+
+
+@register_message
+@dataclass
+class ParallelConfig:
+    """Tunable knobs the master can push to running trainers.
+
+    Reference: ``paral_config_tuner.py`` + ``DataLoaderConfig``/
+    ``OptimizerConfig`` from ``hyperparams/simple_strategy_generator.py``.
+    """
+
+    dataloader_batch_size: int = 0
+    dataloader_workers: int = 0
+    grad_accum_steps: int = 0
+    learning_rate: float = 0.0
+    version: int = 0
+
+
+@register_message
+@dataclass
+class ParallelConfigRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class ElasticRunConfigRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class ElasticRunConfigResponse:
+    configs: Dict[str, str] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class EventReport:
+    event_type: str = ""
+    instance: str = ""
+    action: str = ""
+    msg: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    timestamp: float = 0.0
+
+
+@register_message
+@dataclass
+class SyncJoin:
+    sync_name: str = ""
+    node_id: int = 0
+    node_rank: int = -1
+
+
+@register_message
+@dataclass
+class SyncFinish:
+    sync_name: str = ""
+
+
+@register_message
+@dataclass
+class SyncQueryResponse:
+    success: bool = False

@@ -1,0 +1,105 @@
+"""Global runtime configuration singleton.
+
+Re-creates the reference's ``Context`` tunables singleton
+(``dlrover/python/common/global_context.py:87``): one process-wide object
+holding every knob, overridable from environment variables, so master, agent
+and trainer code share a single source of truth.
+"""
+
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List
+
+from .constants import CommsType, DefaultValues
+
+_ENV_PREFIX = "DLROVER_"
+
+
+@dataclass
+class Context:
+    master_service_type: str = DefaultValues.SERVICE_TYPE
+    master_port: int = DefaultValues.MASTER_PORT
+
+    # Rendezvous
+    rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
+    rdzv_lastcall_s: float = DefaultValues.RDZV_LASTCALL_S
+    node_check_timeout_s: float = DefaultValues.NODE_CHECK_TIMEOUT_S
+
+    # Fault tolerance
+    max_relaunch_count: int = DefaultValues.MAX_RELAUNCH_COUNT
+    relaunch_always: bool = False
+    restart_budget_per_node: int = 3
+    heartbeat_interval_s: float = DefaultValues.HEARTBEAT_INTERVAL_S
+    heartbeat_deadline_s: float = 600.0
+    monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
+    seconds_to_wait_pending_pod: float = DefaultValues.SEC_TO_WAIT_PENDING_POD
+    pending_fail_strategy: int = 1  # 0: ignore, 1: wait+abort, 2: wait+relaunch
+
+    # Hang detection
+    hang_downtime_s: float = DefaultValues.HANG_DOWNTIME_S
+    hang_detection_enabled: bool = True
+
+    # Checkpoint
+    save_at_breakpoint: bool = DefaultValues.SAVE_AT_BREAKPOINT
+    ckpt_replica_count: int = 0  # peer-memory replicas per shard
+
+    # Pre-check
+    precheck_enabled: bool = True
+    precheck_timeout_s: float = 600.0
+
+    # Network check / straggler
+    network_check_enabled: bool = False
+    straggler_median_ratio: float = 2.0
+    exclude_stragglers: bool = False
+
+    # Auto scaling / tuning
+    auto_tuning_enabled: bool = False
+    auto_scaling_interval_s: float = 30.0
+
+    # Misc
+    log_level: str = "INFO"
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def apply_env(self) -> None:
+        """Override fields from ``DLROVER_<UPPER_NAME>`` env vars."""
+        for f in fields(self):
+            env_key = _ENV_PREFIX + f.name.upper()
+            raw = os.getenv(env_key)
+            if raw is None:
+                continue
+            if f.type in (int, "int"):
+                setattr(self, f.name, int(raw))
+            elif f.type in (float, "float"):
+                setattr(self, f.name, float(raw))
+            elif f.type in (bool, "bool"):
+                setattr(self, f.name, raw.lower() in ("1", "true", "yes"))
+            elif f.type in (str, "str"):
+                setattr(self, f.name, raw)
+
+    def master_comms(self) -> str:
+        if self.master_service_type not in (CommsType.GRPC, CommsType.HTTP):
+            return CommsType.GRPC
+        return self.master_service_type
+
+    _singleton = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def singleton_instance(cls) -> "Context":
+        if cls._singleton is None:
+            with cls._lock:
+                if cls._singleton is None:
+                    ctx = cls()
+                    ctx.apply_env()
+                    cls._singleton = ctx
+        return cls._singleton
+
+
+def get_context() -> Context:
+    return Context.singleton_instance()
+
+
+# Registry of pre-check operator names enabled for the job (reference:
+# global_context.get_pre_check_operators). Filled by dlrover_tpu.master.
+PRE_CHECK_OPS: List[str] = ["scheduling", "connection"]

@@ -1,0 +1,164 @@
+"""Shared constants and enums for the runtime.
+
+Re-creates the vocabulary of the reference runtime
+(``dlrover/python/common/constants.py``) for a TPU/JAX world: nodes are TPU
+hosts, the data plane is ICI/DCN via XLA collectives, and elasticity operates
+at slice granularity (``node_unit``).
+"""
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"  # a TPU host (worker VM) running one JAX process
+    # Legacy role names kept so heterogeneous (CPU) role groups can reuse the
+    # same node management machinery (reference: PS/chief/evaluator managers).
+    PS = "ps"
+    CHIEF = "chief"
+    EVALUATOR = "evaluator"
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    BREAKDOWN = "breakdown"
+
+    @classmethod
+    def terminal(cls):
+        return {cls.SUCCEEDED, cls.FAILED, cls.DELETED}
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+    # Health reported by the agent itself.
+    NODE_HEALTHY = "node_healthy"
+    NODE_UNHEALTHY = "node_unhealthy"
+
+
+class NodeExitReason:
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"
+    PREEMPTED = "preempted"
+    UNKNOWN = "unknown"
+
+    RELAUNCHABLE = {KILLED, OOM, HARDWARE_ERROR, PREEMPTED}
+
+
+class JobStage:
+    INIT = "init"
+    PRE_CHECK = "pre_check"
+    RUNNING = "running"
+    SUSPENDED = "suspended"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+
+
+class JobExitReason:
+    SUCCEEDED = "succeeded"
+    FATAL_ERROR = "fatal_error"
+    MAX_RELAUNCH = "max_relaunch_exceeded"
+    PENDING_TIMEOUT = "pending_timeout"
+    NO_HEARTBEAT = "no_heartbeat"
+    HANG = "hang"
+    UNKNOWN = "unknown"
+
+
+class RendezvousName:
+    TRAINING = "training"
+    NETWORK_CHECK = "network-check"
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    GKE_TPU = "gke_tpu"
+    RAY = "ray"
+
+
+class Accelerators:
+    TPU = "tpu"
+    CPU = "cpu"  # CPU backend used for tests/virtual meshes
+
+
+class DistributionStrategy:
+    # Every TPU job is SPMD over a global mesh; LOCAL means single-host.
+    SPMD = "spmd"
+    LOCAL = "local"
+
+
+class TrainingExceptionLevel:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class CheckpointConstant:
+    TRACKER_FILE = "dlrover_latest.txt"
+    DONE_DIR = ".done"
+    STAGING_DIR = ".staging"
+    META_NAME = "ckpt_meta"
+    MODEL_STATE_NAME = "model_state"
+    COMMIT_FILE = "commit_success"
+
+
+class NodeEnv:
+    """Per-process environment contract (agent → JAX process)."""
+
+    MASTER_ADDR = "DLROVER_MASTER_ADDR"
+    MASTER_SERVICE_TYPE = "DLROVER_MASTER_SERVICE_TYPE"
+    JOB_NAME = "DLROVER_JOB_NAME"
+    NODE_ID = "DLROVER_NODE_ID"
+    NODE_RANK = "DLROVER_NODE_RANK"
+    NODE_NUM = "DLROVER_NODE_NUM"
+    NODE_UNIT = "DLROVER_NODE_UNIT"
+    # JAX distributed bootstrap (filled in by the rendezvous handler).
+    COORDINATOR_ADDRESS = "DLROVER_COORDINATOR_ADDRESS"
+    NUM_PROCESSES = "DLROVER_NUM_PROCESSES"
+    PROCESS_ID = "DLROVER_PROCESS_ID"
+    RESTART_COUNT = "DLROVER_RESTART_COUNT"
+    MONITOR_ENABLED = "DLROVER_MONITOR_ENABLED"
+
+
+class GRPC:
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class CommsType:
+    GRPC = "grpc"
+    HTTP = "http"
+
+
+class PreCheckStatus:
+    CHECKING = "checking"
+    PASSED = "passed"
+    FAILED = "failed"
+    DISABLED = "disabled"
+
+
+class DiagnosisConstants:
+    ACTION_EXPIRY_S = 60 * 5
+    MASTER_INSTANCE = -1
+    ANY_INSTANCE = -2
+
+
+class DefaultValues:
+    SERVICE_TYPE = CommsType.GRPC
+    MASTER_PORT = 0  # 0 → pick a free port
+    RDZV_TIMEOUT_S = 600
+    RDZV_LASTCALL_S = 30
+    NODE_CHECK_TIMEOUT_S = 300
+    HEARTBEAT_INTERVAL_S = 15
+    HANG_DOWNTIME_S = 300
+    MAX_RELAUNCH_COUNT = 3
+    MONITOR_INTERVAL_S = 5
+    SAVE_AT_BREAKPOINT = True
+    SEC_TO_WAIT_PENDING_POD = 900

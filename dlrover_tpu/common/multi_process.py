@@ -1,0 +1,555 @@
+"""Local inter-process primitives: shared memory + socket-served lock/queue/dict.
+
+Re-creates ``dlrover/python/common/multi_process.py:180-736`` for the TPU
+agent↔trainer split: the agent (per-host supervisor) owns the server side of
+each primitive over a unix domain socket; the JAX training process connects
+as a client.  Checkpoint bytes go through POSIX shared memory; control goes
+through these sockets.
+
+Design difference from the reference: one generic request/response socket
+protocol (msgpack frames) instead of pickled per-class request objects.
+"""
+
+import hashlib
+import os
+import socket
+import uuid
+import struct
+import threading
+import time
+import queue as _queue
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional
+
+import msgpack
+from multiprocessing import resource_tracker
+
+from .log import logger
+
+SOCKET_TMP_DIR = os.getenv(
+    "DLROVER_IPC_DIR", os.path.join("/tmp", "dlrover_tpu", "sockets")
+)
+
+_LEN = struct.Struct("!I")
+
+
+def _socket_path(name: str) -> str:
+    os.makedirs(SOCKET_TMP_DIR, exist_ok=True)
+    job = os.getenv("DLROVER_JOB_NAME", "local")
+    fname = f"{job}_{name}.sock"
+    path = os.path.join(SOCKET_TMP_DIR, fname)
+    # AF_UNIX sun_path is limited to ~108 bytes; hash long names down.
+    if len(path) > 100:
+        digest = hashlib.sha1(fname.encode()).hexdigest()[:16]
+        path = os.path.join(SOCKET_TMP_DIR, f"s_{digest}.sock")
+    return path
+
+
+def _send_frame(sock: socket.socket, payload: Dict[str, Any]) -> None:
+    data = msgpack.packb(payload, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return msgpack.unpackb(_recv_exact(sock, length), raw=False, strict_map_key=False)
+
+
+class LocalSocketServer:
+    """Threaded unix-socket server dispatching {"m": method, "a": args}."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.path = _socket_path(name)
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(64)
+        self._stopped = False
+        self._resp_cache: Dict[str, tuple] = {}
+        self._conn_local = threading.local()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"ipc-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn_id = id(conn)
+        # At-most-once cache: if a client retransmits a request whose
+        # response was lost in a connection drop, replay the cached
+        # response instead of re-executing a non-idempotent op.
+        try:
+            with conn:
+                while not self._stopped:
+                    try:
+                        req = _recv_frame(conn)
+                    except (ConnectionError, OSError):
+                        return
+                    cid, seq = req.get("cid"), req.get("seq")
+                    if cid is not None:
+                        cached = self._resp_cache.get(cid)
+                        if cached is not None and cached[0] == seq:
+                            try:
+                                _send_frame(conn, cached[1])
+                                continue
+                            except OSError:
+                                return
+                    try:
+                        result = self._dispatch(
+                            req["m"], req.get("a") or {}, conn_id
+                        )
+                        resp = {"ok": True, "r": result}
+                    except Exception as e:  # noqa: BLE001 — reported to client
+                        resp = {"ok": False, "err": repr(e)}
+                    if cid is not None:
+                        self._resp_cache[cid] = (seq, resp)
+                        if len(self._resp_cache) > 4096:
+                            self._resp_cache.pop(next(iter(self._resp_cache)))
+                    try:
+                        _send_frame(conn, resp)
+                    except OSError:
+                        return
+        finally:
+            self._on_conn_closed(conn_id)
+
+    def _on_conn_closed(self, conn_id: int) -> None:
+        """Hook: subclasses release per-connection resources (e.g. locks)."""
+
+    def _dispatch(self, method: str, args: Dict[str, Any], conn_id: int) -> Any:
+        fn = getattr(self, "op_" + method, None)
+        if fn is None:
+            raise ValueError(f"unknown method {method}")
+        self._conn_local.conn_id = conn_id
+        return fn(**args)
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._sock.close()
+        finally:
+            if os.path.exists(self.path):
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
+class LocalSocketClient:
+    """Client for :class:`LocalSocketServer`; reconnects lazily."""
+
+    def __init__(self, name: str, timeout: float = 60.0):
+        self.name = name
+        self.path = _socket_path(name)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._cid = uuid.uuid4().hex
+        self._seq = 0
+
+    def _connect(self) -> socket.socket:
+        deadline = time.time() + self._timeout
+        while True:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(self.path)
+                return s
+            except (FileNotFoundError, ConnectionRefusedError):
+                if time.time() > deadline:
+                    raise TimeoutError(f"IPC server {self.name} unavailable")
+                time.sleep(0.1)
+
+    def call(self, method: str, **args: Any) -> Any:
+        with self._lock:
+            self._seq += 1
+            req = {"m": method, "a": args, "cid": self._cid, "seq": self._seq}
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    _send_frame(self._sock, req)
+                    resp = _recv_frame(self._sock)
+                    break
+                except (ConnectionError, OSError):
+                    self._sock = None
+                    if attempt == 1:
+                        raise
+        if not resp["ok"]:
+            raise RuntimeError(f"IPC {self.name}.{method}: {resp['err']}")
+        return resp["r"]
+
+    def available(self) -> bool:
+        return os.path.exists(self.path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# SharedLock
+# ---------------------------------------------------------------------------
+
+
+class SharedLockServer(LocalSocketServer):
+    """Lock with reentrancy (hold count) and death-of-holder release.
+
+    The holding client's connection id is recorded at acquire time; if that
+    connection drops (client process crashed), the lock is force-released so
+    waiters — typically the agent draining a checkpoint after a trainer
+    crash — never deadlock.
+    """
+
+    def __init__(self, name: str):
+        super().__init__("lock_" + name)
+        self._locked_by: Optional[str] = None
+        self._holder_conn: Optional[int] = None
+        self._hold_count = 0
+        self._cond = threading.Condition()
+
+    def op_acquire(self, owner: str, blocking: bool = True, timeout: float = -1.0) -> bool:
+        conn_id = self._conn_local.conn_id
+        deadline = None if timeout < 0 else time.time() + timeout
+        with self._cond:
+            while self._locked_by is not None and self._locked_by != owner:
+                if not blocking:
+                    return False
+                wait = None if deadline is None else max(0.0, deadline - time.time())
+                if wait == 0.0 or not self._cond.wait(timeout=wait or 1.0):
+                    if deadline is not None and time.time() >= deadline:
+                        return False
+            self._locked_by = owner
+            self._holder_conn = conn_id
+            self._hold_count += 1
+            return True
+
+    def op_release(self, owner: str) -> bool:
+        with self._cond:
+            if self._locked_by == owner:
+                self._hold_count -= 1
+                if self._hold_count <= 0:
+                    self._locked_by = None
+                    self._holder_conn = None
+                    self._hold_count = 0
+                    self._cond.notify_all()
+                return True
+            return False
+
+    def op_locked(self) -> bool:
+        with self._cond:
+            return self._locked_by is not None
+
+    def _on_conn_closed(self, conn_id: int) -> None:
+        with self._cond:
+            if self._holder_conn == conn_id and self._locked_by is not None:
+                logger.warning(
+                    "lock %s force-released: holder %s connection dropped",
+                    self.name,
+                    self._locked_by,
+                )
+                self._locked_by = None
+                self._holder_conn = None
+                self._hold_count = 0
+                self._cond.notify_all()
+
+
+class SharedLock:
+    """Cross-process lock; ``name`` scopes it within the job."""
+
+    def __init__(self, name: str, create: bool = False):
+        self.name = name
+        self._server = SharedLockServer(name) if create else None
+        self._client = LocalSocketClient("lock_" + name)
+        self._owner = f"{os.getpid()}_{id(self)}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1.0) -> bool:
+        return self._client.call(
+            "acquire", owner=self._owner, blocking=blocking, timeout=timeout
+        )
+
+    def release(self) -> bool:
+        return self._client.call("release", owner=self._owner)
+
+    def locked(self) -> bool:
+        return self._client.call("locked")
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server:
+            self._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# SharedQueue
+# ---------------------------------------------------------------------------
+
+
+class SharedQueueServer(LocalSocketServer):
+    def __init__(self, name: str, maxsize: int = 0):
+        super().__init__("queue_" + name)
+        self._queue: "_queue.Queue[Any]" = _queue.Queue(maxsize)
+
+    def op_put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> bool:
+        try:
+            self._queue.put(item, block=block, timeout=timeout)
+            return True
+        except _queue.Full:
+            return False
+
+    def op_get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        try:
+            return {"found": True, "item": self._queue.get(block=block, timeout=timeout)}
+        except _queue.Empty:
+            return {"found": False, "item": None}
+
+    def op_qsize(self) -> int:
+        return self._queue.qsize()
+
+    def op_empty(self) -> bool:
+        return self._queue.empty()
+
+
+class SharedQueue:
+    def __init__(self, name: str, create: bool = False, maxsize: int = 0):
+        self.name = name
+        self._server = SharedQueueServer(name, maxsize) if create else None
+        self._client = LocalSocketClient("queue_" + name)
+
+    def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> bool:
+        return self._client.call("put", item=item, block=block, timeout=timeout)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        # Poll with short server-side timeouts so one slow get does not pin
+        # the connection; semantics match queue.Queue.get.
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            chunk = 1.0
+            if deadline is not None:
+                remaining = deadline - time.time()
+                if remaining <= 0 and block:
+                    raise _queue.Empty
+                chunk = min(chunk, max(0.0, remaining))
+            resp = self._client.call(
+                "get", block=block, timeout=chunk if block else None
+            )
+            if resp["found"]:
+                return resp["item"]
+            if not block:
+                raise _queue.Empty
+            if deadline is not None and time.time() >= deadline:
+                raise _queue.Empty
+
+    def qsize(self) -> int:
+        return self._client.call("qsize")
+
+    def empty(self) -> bool:
+        return self._client.call("empty")
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server:
+            self._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# SharedDict
+# ---------------------------------------------------------------------------
+
+
+class SharedDictServer(LocalSocketServer):
+    def __init__(self, name: str):
+        super().__init__("dict_" + name)
+        self._dict: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+
+    def op_set(self, key: Any, value: Any) -> None:
+        with self._lock:
+            self._dict[key] = value
+
+    def op_update(self, mapping: Dict[Any, Any]) -> None:
+        with self._lock:
+            self._dict.update(mapping)
+
+    def op_get(self, key: Any, default: Any = None) -> Any:
+        with self._lock:
+            return self._dict.get(key, default)
+
+    def op_get_all(self) -> Dict[Any, Any]:
+        with self._lock:
+            return dict(self._dict)
+
+    def op_delete(self, key: Any) -> None:
+        with self._lock:
+            self._dict.pop(key, None)
+
+    def op_clear(self) -> None:
+        with self._lock:
+            self._dict.clear()
+
+
+class SharedDict:
+    def __init__(self, name: str, create: bool = False):
+        self.name = name
+        self._server = SharedDictServer(name) if create else None
+        self._client = LocalSocketClient("dict_" + name)
+
+    def set(self, key: Any, value: Any) -> None:
+        self._client.call("set", key=key, value=value)
+
+    def update(self, mapping: Dict[Any, Any]) -> None:
+        self._client.call("update", mapping=mapping)
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        return self._client.call("get", key=key, default=default)
+
+    def get_all(self) -> Dict[Any, Any]:
+        return self._client.call("get_all")
+
+    def delete(self, key: Any) -> None:
+        self._client.call("delete", key=key)
+
+    def clear(self) -> None:
+        self._client.call("clear")
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server:
+            self._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shared memory
+# ---------------------------------------------------------------------------
+
+
+def _shm_name(name: str) -> str:
+    job = os.getenv("DLROVER_JOB_NAME", "local")
+    return f"dlrover_{job}_{name}"
+
+
+class SharedMemorySegment:
+    """POSIX shared-memory segment with create-or-attach-and-resize semantics.
+
+    Reference: ``SharedMemoryHandler`` (``ckpt_saver.py:234-397``) —
+    checkpoint bytes are staged here by the trainer and drained by the agent.
+    """
+
+    def __init__(self, name: str):
+        self.name = _shm_name(name)
+        self._shm: Optional[shared_memory.SharedMemory] = None
+
+    @staticmethod
+    def _untrack(shm: shared_memory.SharedMemory) -> None:
+        # CPython's resource tracker unlinks "leaked" segments when the
+        # creating process exits — which would destroy a staged checkpoint
+        # exactly when the trainer crashes. Lifetime is managed explicitly
+        # by the agent through unlink(), so always untrack.
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:
+            pass
+
+    @property
+    def size(self) -> int:
+        return self._shm.size if self._shm else 0
+
+    @property
+    def buf(self):
+        return self._shm.buf if self._shm else None
+
+    def exists(self) -> bool:
+        return os.path.exists(os.path.join("/dev/shm", self.name))
+
+    def ensure(self, size: int) -> None:
+        """Create the segment, growing (recreating) it if too small."""
+        if self._shm is not None and self._shm.size >= size:
+            return
+        if self._shm is not None:
+            self.unlink()
+        try:
+            self._shm = shared_memory.SharedMemory(name=self.name, create=True, size=size)
+        except FileExistsError:
+            existing = shared_memory.SharedMemory(name=self.name)
+            self._untrack(existing)
+            if existing.size >= size:
+                self._shm = existing
+            else:
+                existing.close()
+                existing.unlink()
+                self._shm = shared_memory.SharedMemory(
+                    name=self.name, create=True, size=size
+                )
+        self._untrack(self._shm)
+
+    def attach(self) -> bool:
+        if self._shm is not None:
+            return True
+        try:
+            self._shm = shared_memory.SharedMemory(name=self.name)
+            self._untrack(self._shm)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def write(self, data: bytes, offset: int = 0) -> None:
+        assert self._shm is not None
+        self._shm.buf[offset : offset + len(data)] = data
+
+    def read(self, offset: int, length: int) -> bytes:
+        assert self._shm is not None
+        return bytes(self._shm.buf[offset : offset + length])
+
+    def close(self) -> None:
+        if self._shm is not None:
+            try:
+                self._shm.close()
+            except Exception:
+                pass
+            self._shm = None
+
+    def unlink(self) -> None:
+        if self._shm is None and not self.attach():
+            return
+        shm, self._shm = self._shm, None
+        try:
+            shm.close()
+            # Balance the unregister() that SharedMemory.unlink() performs —
+            # we already untracked at create/attach time.
+            try:
+                resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
+            except Exception:
+                pass
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            logger.warning("failed to unlink shm %s", self.name, exc_info=True)

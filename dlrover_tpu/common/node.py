@@ -1,0 +1,172 @@
+"""Node model and lifecycle state machine.
+
+Re-creates ``dlrover/python/common/node.py`` (Node:162, NodeResource:44,
+NodeEvent:446) and the allowed-transition table of
+``master/node/status_flow.py`` for TPU hosts: a node is one worker VM hosting
+a JAX process and some number of TPU chips.
+"""
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .constants import NodeEventType, NodeExitReason, NodeStatus
+
+
+def _parse_memory_mb(value: str) -> float:
+    """Parse k8s-style memory quantities ("8192Mi", "8Gi", "2G", "512M")."""
+    value = value.strip().lower()
+    units = {"gi": 1024, "g": 1000, "mi": 1, "m": 1, "ki": 1 / 1024, "k": 1 / 1000}
+    for suffix, factor in units.items():
+        if value.endswith(suffix):
+            return float(value[: -len(suffix)]) * factor
+    return float(value)
+
+
+@dataclass
+class NodeResource:
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    device_type: str = ""  # e.g. "tpu-v5e"
+    device_count: int = 0  # chips attached to this host
+    priority: str = ""
+
+    @classmethod
+    def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":
+        """Parse "cpu=4,memory=8192Mi,tpu=8" style strings."""
+        kwargs: Dict[str, float] = {}
+        device_type = ""
+        for item in resource.split(","):
+            if not item.strip():
+                continue
+            k, _, v = item.partition("=")
+            k = k.strip().lower()
+            v = v.strip().lower()
+            if k == "cpu":
+                kwargs["cpu"] = float(v)
+            elif k == "memory":
+                kwargs["memory_mb"] = _parse_memory_mb(v)
+            elif k in ("tpu", "gpu", "device"):
+                kwargs["device_count"] = int(v)
+                device_type = k
+        res = cls(**kwargs)
+        res.device_type = device_type
+        return res
+
+
+# Allowed node status transitions (reference: status_flow.py). Anything not
+# listed is an out-of-order event and ignored.
+_ALLOWED_TRANSITIONS = {
+    NodeStatus.INITIAL: {
+        NodeStatus.PENDING,
+        NodeStatus.RUNNING,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+    },
+    NodeStatus.PENDING: {
+        NodeStatus.RUNNING,
+        NodeStatus.SUCCEEDED,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+    },
+    NodeStatus.RUNNING: {
+        NodeStatus.SUCCEEDED,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+        NodeStatus.BREAKDOWN,
+    },
+    NodeStatus.BREAKDOWN: {
+        NodeStatus.RUNNING,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+    },
+    NodeStatus.SUCCEEDED: {NodeStatus.DELETED},
+    NodeStatus.FAILED: {NodeStatus.DELETED},
+    NodeStatus.DELETED: set(),
+}
+
+
+def is_allowed_transition(from_status: str, to_status: str) -> bool:
+    if from_status == to_status:
+        return False
+    return to_status in _ALLOWED_TRANSITIONS.get(from_status, set())
+
+
+@dataclass
+class Node:
+    node_type: str = ""
+    node_id: int = 0
+    name: str = ""
+    rank_index: int = -1
+    status: str = NodeStatus.INITIAL
+    config_resource: NodeResource = field(default_factory=NodeResource)
+    used_resource: NodeResource = field(default_factory=NodeResource)
+    slice_id: int = 0
+    host_ip: str = ""
+    relaunch_count: int = 0
+    max_relaunch_count: int = 3
+    relaunchable: bool = True
+    is_released: bool = False
+    exit_reason: str = ""
+    create_time: float = field(default_factory=time.time)
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    heartbeat_time: float = 0.0
+    start_hang_time: float = 0.0
+    reported_unhealthy: bool = False
+    # Rendezvous bookkeeping
+    paral_config_version: int = 0
+
+    def inc_relaunch_count(self) -> None:
+        self.relaunch_count += 1
+
+    def update_status(self, status: str) -> bool:
+        """Apply a status transition; returns True if it was legal."""
+        if not is_allowed_transition(self.status, status):
+            return False
+        self.status = status
+        now = time.time()
+        if status == NodeStatus.RUNNING and self.start_time is None:
+            self.start_time = now
+        if status in NodeStatus.terminal():
+            self.finish_time = now
+        return True
+
+    def exited(self) -> bool:
+        return self.status in NodeStatus.terminal()
+
+    def should_relaunch(self) -> bool:
+        if self.is_released or not self.relaunchable:
+            return False
+        if self.relaunch_count >= self.max_relaunch_count:
+            return False
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return False
+        return True
+
+    def get_relaunch_node(self, new_id: int) -> "Node":
+        new_node = copy.deepcopy(self)
+        new_node.node_id = new_id
+        new_node.name = ""
+        new_node.status = NodeStatus.INITIAL
+        new_node.start_time = None
+        new_node.finish_time = None
+        new_node.is_released = False
+        new_node.exit_reason = ""
+        new_node.relaunch_count = self.relaunch_count + 1
+        new_node.heartbeat_time = 0
+        new_node.reported_unhealthy = False
+        return new_node
+
+
+@dataclass
+class NodeEvent:
+    event_type: str = NodeEventType.MODIFIED
+    node: Optional[Node] = None
+
+    def is_node_check_event(self) -> bool:
+        return self.event_type in (
+            NodeEventType.NODE_HEALTHY,
+            NodeEventType.NODE_UNHEALTHY,
+        )

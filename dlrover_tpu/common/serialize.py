@@ -1,0 +1,89 @@
+"""Typed message serialization for the control plane.
+
+The reference pickles dataclasses over a 2-RPC proto
+(``dlrover/python/common/comm.py``).  Pickle is unsafe across trust
+boundaries, so here every message type registers itself in a class registry
+and is encoded as ``msgpack({"_t": <registered name>, ...fields})``.
+Nested registered dataclasses, lists, dicts, tuples, bytes and scalars all
+round-trip; unknown types are rejected at encode time.
+"""
+
+import dataclasses
+from typing import Any, Dict, Type
+
+import msgpack
+
+_REGISTRY: Dict[str, Type] = {}
+_TYPE_KEY = "_t"
+_RAW_DICT = "__rawdict__"  # reserved: plain dict that contains _TYPE_KEY
+
+
+def register_message(cls):
+    """Class decorator: make a dataclass wire-serializable."""
+    name = cls.__name__
+    if name == _RAW_DICT:
+        raise ValueError(f"{_RAW_DICT} is reserved")
+    if name in _REGISTRY and _REGISTRY[name] is not cls:
+        raise ValueError(f"duplicate message type {name}")
+    _REGISTRY[name] = cls
+    return cls
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        name = type(obj).__name__
+        if name not in _REGISTRY:
+            raise TypeError(f"unregistered message type {name}")
+        out = {_TYPE_KEY: name}
+        for f in dataclasses.fields(obj):
+            out[f.name] = _encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        if _TYPE_KEY in obj:
+            # Escape plain dicts that collide with the reserved type key so
+            # user-controlled payloads cannot spoof or break decoding.
+            return {
+                _TYPE_KEY: _RAW_DICT,
+                "kv": [[_encode(k), _encode(v)] for k, v in obj.items()],
+            }
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool, bytes, type(None))):
+        return obj
+    raise TypeError(f"unserializable value of type {type(obj)!r}")
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if _TYPE_KEY in obj:
+            name = obj[_TYPE_KEY]
+            if name == _RAW_DICT:
+                return {_decode(k): _decode(v) for k, v in obj["kv"]}
+            cls = _REGISTRY.get(name)
+            if cls is None:
+                # The registry fills as modules import; pull in the standard
+                # message schema before giving up so bare consumers work.
+                from . import comm  # noqa: F401  (registers its dataclasses)
+
+                cls = _REGISTRY.get(name)
+            if cls is None:
+                raise TypeError(f"unknown message type {name}")
+            kwargs = {
+                k: _decode(v) for k, v in obj.items() if k != _TYPE_KEY
+            }
+            return cls(**kwargs)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def dumps(message: Any) -> bytes:
+    return msgpack.packb(_encode(message), use_bin_type=True)
+
+
+def loads(data: bytes) -> Any:
+    if not data:
+        return None
+    return _decode(msgpack.unpackb(data, raw=False, strict_map_key=False))

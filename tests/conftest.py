@@ -1,0 +1,26 @@
+"""Test bootstrap: force a virtual 8-device CPU platform before jax imports.
+
+Mirrors the reference's multi-node-without-cluster tricks (SURVEY.md §4):
+control-plane tests run N simulated agents against an in-process master;
+mesh/checkpoint tests run on 8 virtual CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DLROVER_JOB_NAME", f"test_{os.getpid()}")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_ipc_dir(tmp_path, monkeypatch):
+    import dlrover_tpu.common.multi_process as mp
+
+    monkeypatch.setattr(mp, "SOCKET_TMP_DIR", str(tmp_path / "sockets"))
+    return tmp_path

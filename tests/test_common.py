@@ -1,0 +1,170 @@
+"""Tests for dlrover_tpu.common: serialization, node model, config, events."""
+
+import dataclasses
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import Context
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.events import (
+    AsyncExporter,
+    EventEmitter,
+    Exporter,
+)
+from dlrover_tpu.common.node import Node, NodeResource, is_allowed_transition
+from dlrover_tpu.common.serialize import dumps, loads, register_message
+
+
+class TestSerialize:
+    def test_roundtrip_simple(self):
+        msg = comm.JoinRendezvousRequest(
+            node_id=3, node_rank=1, local_world_size=4, rdzv_name="training"
+        )
+        assert loads(dumps(msg)) == msg
+
+    def test_roundtrip_nested(self):
+        world = {
+            0: comm.NodeMeta(node_id=0, node_rank=0, addr="10.0.0.1"),
+            1: comm.NodeMeta(node_id=1, node_rank=1, addr="10.0.0.2"),
+        }
+        msg = comm.CommWorldResponse(rdzv_name="training", round=2, world=world)
+        out = loads(dumps(msg))
+        assert out.world[1].addr == "10.0.0.2"
+        assert isinstance(out.world[0], comm.NodeMeta)
+
+    def test_roundtrip_bytes_and_lists(self):
+        msg = comm.KeyValuePair(key="k", value=b"\x00\x01binary")
+        assert loads(dumps(msg)).value == b"\x00\x01binary"
+        msg2 = comm.FaultNodesResponse(fault_nodes=[1, 5, 9])
+        assert loads(dumps(msg2)).fault_nodes == [1, 5, 9]
+
+    def test_unknown_type_rejected(self):
+        class NotRegistered:
+            pass
+
+        with pytest.raises(TypeError):
+            dumps(NotRegistered())
+
+    def test_register_duplicate_rejected(self):
+        @register_message
+        @dataclasses.dataclass
+        class UniqueMsg1234:
+            x: int = 0
+
+        with pytest.raises(ValueError):
+
+            @register_message
+            @dataclasses.dataclass
+            class UniqueMsg1234:  # noqa: F811
+                y: int = 0
+
+    def test_empty_payload(self):
+        assert loads(b"") is None
+
+
+class TestNode:
+    def test_status_flow(self):
+        node = Node(node_type="worker", node_id=0)
+        assert node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.RUNNING)
+        assert node.start_time is not None
+        # Illegal transition back to pending
+        assert not node.update_status(NodeStatus.PENDING)
+        assert node.update_status(NodeStatus.FAILED)
+        assert node.exited()
+
+    def test_transition_table(self):
+        assert is_allowed_transition(NodeStatus.RUNNING, NodeStatus.SUCCEEDED)
+        assert not is_allowed_transition(NodeStatus.SUCCEEDED, NodeStatus.RUNNING)
+        assert not is_allowed_transition(NodeStatus.RUNNING, NodeStatus.RUNNING)
+
+    def test_should_relaunch_budget(self):
+        node = Node(node_type="worker", node_id=0, max_relaunch_count=2)
+        assert node.should_relaunch()
+        node.relaunch_count = 2
+        assert not node.should_relaunch()
+
+    def test_fatal_error_not_relaunched(self):
+        node = Node(node_type="worker", node_id=0)
+        node.exit_reason = NodeExitReason.FATAL_ERROR
+        assert not node.should_relaunch()
+
+    def test_get_relaunch_node(self):
+        node = Node(node_type="worker", node_id=0, rank_index=3)
+        node.update_status(NodeStatus.RUNNING)
+        new = node.get_relaunch_node(new_id=7)
+        assert new.node_id == 7
+        assert new.rank_index == 3
+        assert new.status == NodeStatus.INITIAL
+        assert new.relaunch_count == 1
+
+    def test_resource_parse(self):
+        res = NodeResource.resource_str_to_node_resource("cpu=4,memory=8192Mi,tpu=8")
+        assert res.cpu == 4
+        assert res.memory_mb == 8192
+        assert res.device_count == 8
+
+
+class TestConfig:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_MAX_RELAUNCH_COUNT", "7")
+        monkeypatch.setenv("DLROVER_HANG_DETECTION_ENABLED", "false")
+        ctx = Context()
+        ctx.apply_env()
+        assert ctx.max_relaunch_count == 7
+        assert ctx.hang_detection_enabled is False
+
+    def test_singleton(self):
+        assert Context.singleton_instance() is Context.singleton_instance()
+
+
+class _ListExporter(Exporter):
+    def __init__(self):
+        self.events = []
+
+    def export(self, event):
+        self.events.append(event)
+
+
+class TestEvents:
+    def test_instant_and_span(self):
+        exp = _ListExporter()
+        em = EventEmitter("test", exporter=exp)
+        em.instant("hello", a=1)
+        with em.duration("work", step=3):
+            pass
+        assert [e.name for e in exp.events] == ["hello", "work", "work"]
+        end = exp.events[-1]
+        assert end.event_type == "end"
+        assert "duration_s" in end.content
+
+    def test_span_failure(self):
+        exp = _ListExporter()
+        em = EventEmitter("test", exporter=exp)
+        with pytest.raises(RuntimeError):
+            with em.duration("work"):
+                raise RuntimeError("boom")
+        assert exp.events[-1].content["success"] is False
+
+    def test_async_exporter_drains(self):
+        exp = _ListExporter()
+        async_exp = AsyncExporter(exp)
+        em = EventEmitter("test", exporter=async_exp)
+        for i in range(100):
+            em.instant("e", i=i)
+        async_exp.close()
+        assert len(exp.events) == 100
+
+
+class TestSerializeEscaping:
+    def test_plain_dict_with_reserved_key(self):
+        msg = comm.ElasticRunConfigResponse(configs={"_t": "oops", "x": "1"})
+        out = loads(dumps(msg))
+        assert out.configs == {"_t": "oops", "x": "1"}
+
+    def test_memory_units(self):
+        res = NodeResource.resource_str_to_node_resource("memory=8Gi")
+        assert res.memory_mb == 8192
+        res = NodeResource.resource_str_to_node_resource("memory=2G")
+        assert res.memory_mb == 2000

@@ -1,0 +1,222 @@
+"""Tests for local IPC primitives (shm + unix-socket lock/queue/dict)."""
+
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemorySegment,
+    SharedQueue,
+)
+
+
+@pytest.fixture()
+def uniq(request, tmp_ipc_dir):
+    return request.node.name.replace("[", "_").replace("]", "_")
+
+
+class TestSharedLock:
+    def test_acquire_release(self, uniq):
+        server = SharedLock(uniq, create=True)
+        client = SharedLock(uniq)
+        try:
+            assert client.acquire()
+            assert client.locked()
+            # Second client cannot acquire non-blocking
+            other = SharedLock(uniq)
+            assert not other.acquire(blocking=False)
+            assert client.release()
+            assert other.acquire(blocking=False)
+            other.release()
+            other.close()
+        finally:
+            client.close()
+            server.close()
+
+    def test_timeout(self, uniq):
+        server = SharedLock(uniq, create=True)
+        a, b = SharedLock(uniq), SharedLock(uniq)
+        try:
+            assert a.acquire()
+            t0 = time.time()
+            assert not b.acquire(timeout=0.3)
+            assert time.time() - t0 < 3
+        finally:
+            a.close()
+            b.close()
+            server.close()
+
+    def test_blocking_handoff(self, uniq):
+        server = SharedLock(uniq, create=True)
+        a, b = SharedLock(uniq), SharedLock(uniq)
+        got = []
+        try:
+            a.acquire()
+
+            def taker():
+                got.append(b.acquire(timeout=5))
+
+            t = threading.Thread(target=taker)
+            t.start()
+            time.sleep(0.1)
+            a.release()
+            t.join(timeout=5)
+            assert got == [True]
+        finally:
+            a.close()
+            b.close()
+            server.close()
+
+
+class TestSharedQueue:
+    def test_fifo(self, uniq):
+        server = SharedQueue(uniq, create=True)
+        client = SharedQueue(uniq)
+        try:
+            for i in range(5):
+                client.put({"i": i})
+            assert server.qsize() == 5
+            assert [client.get(timeout=1)["i"] for _ in range(5)] == list(range(5))
+            assert client.empty()
+        finally:
+            client.close()
+            server.close()
+
+    def test_get_timeout(self, uniq):
+        server = SharedQueue(uniq, create=True)
+        try:
+            with pytest.raises(queue.Empty):
+                server.get(timeout=0.2)
+            with pytest.raises(queue.Empty):
+                server.get(block=False)
+        finally:
+            server.close()
+
+    def test_cross_thread_producer(self, uniq):
+        server = SharedQueue(uniq, create=True)
+        client = SharedQueue(uniq)
+        try:
+            def producer():
+                time.sleep(0.2)
+                client.put("payload")
+
+            threading.Thread(target=producer).start()
+            assert server.get(timeout=5) == "payload"
+        finally:
+            client.close()
+            server.close()
+
+
+class TestSharedDict:
+    def test_set_get_all(self, uniq):
+        server = SharedDict(uniq, create=True)
+        client = SharedDict(uniq)
+        try:
+            client.set("a", 1)
+            client.update({"b": [1, 2], "c": {"x": "y"}})
+            assert client.get("a") == 1
+            assert client.get("missing", "dflt") == "dflt"
+            snapshot = server.get_all()
+            assert snapshot == {"a": 1, "b": [1, 2], "c": {"x": "y"}}
+            client.delete("a")
+            assert client.get("a") is None
+        finally:
+            client.close()
+            server.close()
+
+
+class TestSharedMemorySegment:
+    def test_create_write_read(self, uniq):
+        seg = SharedMemorySegment(uniq)
+        try:
+            seg.ensure(1024)
+            seg.write(b"hello", offset=8)
+            assert seg.read(8, 5) == b"hello"
+            # Attach from a second handle (simulating the agent process)
+            other = SharedMemorySegment(uniq)
+            assert other.attach()
+            assert other.read(8, 5) == b"hello"
+            other.close()
+        finally:
+            seg.unlink()
+
+    def test_grow(self, uniq):
+        seg = SharedMemorySegment(uniq)
+        try:
+            seg.ensure(128)
+            seg.write(b"x" * 128)
+            seg.ensure(4096)
+            assert seg.size >= 4096
+            seg.write(b"y" * 4096)
+            assert seg.read(0, 1) == b"y"
+        finally:
+            seg.unlink()
+
+    def test_attach_missing(self, uniq):
+        seg = SharedMemorySegment(uniq + "_nope")
+        assert not seg.attach()
+
+
+class TestCrashSafety:
+    def test_lock_released_when_holder_connection_drops(self, uniq):
+        server = SharedLock(uniq, create=True)
+        holder = SharedLock(uniq)
+        waiter = SharedLock(uniq)
+        try:
+            assert holder.acquire()
+            # Simulate holder process death: drop its connection.
+            holder._client.close()
+            assert waiter.acquire(timeout=5), "lock leaked after holder died"
+            waiter.release()
+        finally:
+            holder.close()
+            waiter.close()
+            server.close()
+
+    def test_lock_reentrant_hold_count(self, uniq):
+        server = SharedLock(uniq, create=True)
+        a = SharedLock(uniq)
+        b = SharedLock(uniq)
+        try:
+            assert a.acquire()
+            assert a.acquire()  # reentrant
+            a.release()
+            # Still held: one release must not free a doubly-acquired lock.
+            assert not b.acquire(blocking=False)
+            a.release()
+            assert b.acquire(blocking=False)
+            b.release()
+        finally:
+            a.close()
+            b.close()
+            server.close()
+
+    def test_shm_survives_creator_exit(self, uniq):
+        import subprocess
+        import sys
+
+        import dlrover_tpu.common.multi_process as mp
+
+        name = uniq + "_crash"
+        code = (
+            "import os; os.environ['DLROVER_JOB_NAME']=%r;"
+            "from dlrover_tpu.common.multi_process import SharedMemorySegment;"
+            "s=SharedMemorySegment(%r); s.ensure(4096); s.write(b'precious')"
+        ) % (os.environ["DLROVER_JOB_NAME"], name)
+        subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "PYTHONPATH": os.getcwd()},
+            check=True,
+            capture_output=True,
+        )
+        seg = mp.SharedMemorySegment(name)
+        try:
+            assert seg.attach(), "shm destroyed by creator's resource tracker"
+            assert seg.read(0, 8) == b"precious"
+        finally:
+            seg.unlink()

@@ -10,6 +10,7 @@ dataclasses carried over a 2-verb RPC (``report`` fire-and-forget-ish writes,
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .constants import DiagnosisConstants
 from .serialize import register_message
 
 
@@ -219,9 +220,9 @@ class HeartbeatRequest:
 @dataclass
 class DiagnosisActionMsg:
     action_cls: str = "NoAction"
-    instance: int = -2
+    instance: int = DiagnosisConstants.ANY_INSTANCE
     timestamp: float = 0.0
-    expired_s: float = 300.0
+    expired_s: float = DiagnosisConstants.ACTION_EXPIRY_S
     config: Dict[str, str] = field(default_factory=dict)
 
 

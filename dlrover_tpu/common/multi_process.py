@@ -77,7 +77,8 @@ class LocalSocketServer:
         self._sock.bind(self.path)
         self._sock.listen(64)
         self._stopped = False
-        self._resp_cache: Dict[str, tuple] = {}
+        self._resp_cache: Dict[str, Dict[str, Any]] = {}
+        self._cache_lock = threading.Lock()
         self._conn_local = threading.local()
         self._thread = threading.Thread(
             target=self._accept_loop, name=f"ipc-{name}", daemon=True
@@ -94,11 +95,18 @@ class LocalSocketServer:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    # Methods whose semantics are bound to the *connection* (e.g. lock
+    # ownership) must re-execute on retransmit rather than replay a cached
+    # response — a reconnect means the old connection's effects (like a
+    # force-released lock) are gone.
+    UNCACHED_METHODS: frozenset = frozenset()
+
     def _serve_conn(self, conn: socket.socket) -> None:
         conn_id = id(conn)
-        # At-most-once cache: if a client retransmits a request whose
-        # response was lost in a connection drop, replay the cached
-        # response instead of re-executing a non-idempotent op.
+        # At-most-once execution: a cache entry is installed *before*
+        # dispatch, so a retransmit arriving while the original is still
+        # executing waits for that execution instead of running the op
+        # twice (which would e.g. silently drop a queue item).
         try:
             with conn:
                 while not self._stopped:
@@ -107,14 +115,41 @@ class LocalSocketServer:
                     except (ConnectionError, OSError):
                         return
                     cid, seq = req.get("cid"), req.get("seq")
-                    if cid is not None:
-                        cached = self._resp_cache.get(cid)
-                        if cached is not None and cached[0] == seq:
+                    cacheable = (
+                        cid is not None and req["m"] not in self.UNCACHED_METHODS
+                    )
+                    entry = None
+                    if cacheable:
+                        with self._cache_lock:
+                            cached = self._resp_cache.get(cid)
+                            if cached is not None and cached["seq"] == seq:
+                                entry = cached
+                            else:
+                                entry = {
+                                    "seq": seq,
+                                    "done": threading.Event(),
+                                    "resp": None,
+                                    "mine": True,
+                                }
+                                self._resp_cache[cid] = entry
+                                while len(self._resp_cache) > 4096:
+                                    oldest = next(iter(self._resp_cache))
+                                    if oldest == cid:
+                                        break
+                                    self._resp_cache.pop(oldest, None)
+                        if not entry.get("mine"):
+                            # Retransmit: wait for the original execution.
+                            entry["done"].wait(timeout=300)
+                            resp = entry["resp"] or {
+                                "ok": False,
+                                "err": "original request still in flight",
+                            }
                             try:
-                                _send_frame(conn, cached[1])
+                                _send_frame(conn, resp)
                                 continue
                             except OSError:
                                 return
+                        entry["mine"] = False
                     try:
                         result = self._dispatch(
                             req["m"], req.get("a") or {}, conn_id
@@ -122,10 +157,9 @@ class LocalSocketServer:
                         resp = {"ok": True, "r": result}
                     except Exception as e:  # noqa: BLE001 — reported to client
                         resp = {"ok": False, "err": repr(e)}
-                    if cid is not None:
-                        self._resp_cache[cid] = (seq, resp)
-                        if len(self._resp_cache) > 4096:
-                            self._resp_cache.pop(next(iter(self._resp_cache)))
+                    if entry is not None:
+                        entry["resp"] = resp
+                        entry["done"].set()
                     try:
                         _send_frame(conn, resp)
                     except OSError:
@@ -221,6 +255,8 @@ class SharedLockServer(LocalSocketServer):
     waiters — typically the agent draining a checkpoint after a trainer
     crash — never deadlock.
     """
+
+    UNCACHED_METHODS = frozenset({"acquire", "release", "locked"})
 
     def __init__(self, name: str):
         super().__init__("lock_" + name)

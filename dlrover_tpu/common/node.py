@@ -11,7 +11,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from .constants import NodeEventType, NodeExitReason, NodeStatus
+from .constants import DefaultValues, NodeEventType, NodeExitReason, NodeStatus
 
 
 def _parse_memory_mb(value: str) -> float:
@@ -105,7 +105,7 @@ class Node:
     slice_id: int = 0
     host_ip: str = ""
     relaunch_count: int = 0
-    max_relaunch_count: int = 3
+    max_relaunch_count: int = DefaultValues.MAX_RELAUNCH_COUNT
     relaunchable: bool = True
     is_released: bool = False
     exit_reason: str = ""
@@ -156,6 +156,7 @@ class Node:
         new_node.exit_reason = ""
         new_node.relaunch_count = self.relaunch_count + 1
         new_node.heartbeat_time = 0
+        new_node.start_hang_time = 0
         new_node.reported_unhealthy = False
         return new_node
 

@@ -4,8 +4,9 @@ The reference pickles dataclasses over a 2-RPC proto
 (``dlrover/python/common/comm.py``).  Pickle is unsafe across trust
 boundaries, so here every message type registers itself in a class registry
 and is encoded as ``msgpack({"_t": <registered name>, ...fields})``.
-Nested registered dataclasses, lists, dicts, tuples, bytes and scalars all
-round-trip; unknown types are rejected at encode time.
+Nested registered dataclasses, lists, dicts, bytes and scalars round-trip;
+tuples are accepted but decode as lists (msgpack has no tuple type), and
+plain-dict keys must be scalars. Unknown types are rejected at encode time.
 """
 
 import dataclasses
@@ -46,6 +47,9 @@ def _encode(obj: Any) -> Any:
                 _TYPE_KEY: _RAW_DICT,
                 "kv": [[_encode(k), _encode(v)] for k, v in obj.items()],
             }
+        for k in obj:
+            if not isinstance(k, (str, int, float, bool, bytes)):
+                raise TypeError(f"unserializable dict key of type {type(k)!r}")
         return {k: _encode(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_encode(v) for v in obj]

@@ -1,0 +1,94 @@
+"""Singleton job state shared across master components.
+
+Reference: ``master/node/job_context.py`` — node tables, job stage, and the
+diagnosis action queues live here so the servicer, job manager, and
+diagnosis master all see one consistent view.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..common.constants import JobStage, NodeType, PreCheckStatus
+from ..common.node import Node
+from .diagnosis.action import DiagnosisActionQueue
+
+
+class JobContext:
+    _instance: Optional["JobContext"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._nodes: Dict[str, Dict[int, Node]] = {}
+        self.job_stage = JobStage.INIT
+        self.job_exit_reason = ""
+        self.pre_check_status = PreCheckStatus.CHECKING
+        self.pre_check_reason = ""
+        self.master_actions = DiagnosisActionQueue()  # consumed by master loop
+        self.node_actions = DiagnosisActionQueue()  # delivered via heartbeat
+        self.start_time = time.time()
+        self.total_downtime_s = 0.0  # accumulated not-training time (goodput)
+        self.last_training_step = 0
+        self.last_step_time = 0.0
+
+    # -- nodes -------------------------------------------------------------
+
+    def update_node(self, node: Node) -> None:
+        with self._mu:
+            self._nodes.setdefault(node.node_type, {})[node.node_id] = node
+
+    def get_node(self, node_type: str, node_id: int) -> Optional[Node]:
+        with self._mu:
+            return self._nodes.get(node_type, {}).get(node_id)
+
+    def get_nodes(self, node_type: str = NodeType.WORKER) -> Dict[int, Node]:
+        with self._mu:
+            return dict(self._nodes.get(node_type, {}))
+
+    def remove_node(self, node_type: str, node_id: int) -> None:
+        with self._mu:
+            self._nodes.get(node_type, {}).pop(node_id, None)
+
+    def clear_nodes(self) -> None:
+        with self._mu:
+            self._nodes.clear()
+
+    # -- job stage ---------------------------------------------------------
+
+    def set_stage(self, stage: str, reason: str = "") -> None:
+        with self._mu:
+            self.job_stage = stage
+            if reason:
+                self.job_exit_reason = reason
+
+    def is_stopped(self) -> bool:
+        return self.job_stage in (JobStage.STOPPING, JobStage.STOPPED)
+
+    # -- training progress (perf/hang input) -------------------------------
+
+    def report_step(self, step: int, timestamp: float) -> None:
+        with self._mu:
+            if step >= self.last_training_step:
+                self.last_training_step = step
+                self.last_step_time = timestamp
+
+    # -- singleton ---------------------------------------------------------
+
+    @classmethod
+    def singleton(cls) -> "JobContext":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> "JobContext":
+        with cls._lock:
+            cls._instance = cls()
+        return cls._instance
+
+
+def get_job_context() -> JobContext:
+    return JobContext.singleton()

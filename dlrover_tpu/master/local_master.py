@@ -1,0 +1,158 @@
+"""In-process / standalone job master.
+
+Reference: ``master/local_master.py:130`` + the standalone-mode master that
+``dlrover-run`` spawns (``elastic_run.py:300-329``). Composes the managers,
+serves RPC, and runs the supervision loop. The distributed (cluster) master
+in :mod:`dlrover_tpu.master.dist_master` builds on the same composition with
+platform schedulers and watchers.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..common.config import get_context
+from ..common.constants import (
+    CommsType,
+    JobExitReason,
+    JobStage,
+    PreCheckStatus,
+    RendezvousName,
+)
+from ..common.events import MasterEvents
+from ..common.log import logger
+from ..rpc.server import create_master_server
+from .diagnosis.action import DiagnosisActionType, JobAbortionAction
+from .job_context import JobContext, get_job_context
+from .kv_store import KVStoreService
+from .monitor.perf_monitor import PerfMonitor
+from .node.job_manager import JobManager
+from .rdzv.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from .servicer import MasterServicer
+from .shard.task_manager import TaskManager
+from .sync_service import SyncService
+
+
+class LocalJobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        num_workers: int = 1,
+        node_unit: int = 1,
+        service_type: str = "",
+        fresh_context: bool = True,
+    ):
+        ctx = get_context()
+        if fresh_context:
+            JobContext.reset()
+        self._job_ctx = get_job_context()
+        self._events = MasterEvents()
+
+        self.job_manager = JobManager(num_workers=num_workers)
+        training_rdzv = ElasticTrainingRendezvousManager()
+        training_rdzv.update_rdzv_params(
+            min_nodes=1,
+            max_nodes=num_workers,
+            waiting_timeout=ctx.rdzv_timeout_s,
+            node_unit=node_unit,
+        )
+        check_rdzv = NetworkCheckRendezvousManager()
+        check_rdzv.update_rdzv_params(
+            min_nodes=1,
+            max_nodes=num_workers,
+            waiting_timeout=ctx.node_check_timeout_s,
+            node_unit=node_unit,
+        )
+        self.rdzv_managers: Dict[str, RendezvousManager] = {
+            RendezvousName.TRAINING: training_rdzv,
+            RendezvousName.NETWORK_CHECK: check_rdzv,
+        }
+        self.task_manager = TaskManager()
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.perf_monitor = PerfMonitor()
+        self.servicer = MasterServicer(
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            perf_monitor=self.perf_monitor,
+        )
+        service_type = service_type or ctx.master_comms()
+        self._server, self.port = create_master_server(
+            self.servicer, service_type, port
+        )
+        self._run_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.exit_reason = ""
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self) -> None:
+        self._server.start()
+        self.job_manager.start()
+        # Local mode runs no scheduling pre-check; mark passed so agents
+        # blocked on wait_pre_check proceed (reference: local_master.py).
+        self._job_ctx.pre_check_status = PreCheckStatus.PASSED
+        self._job_ctx.set_stage(JobStage.RUNNING)
+        self._events.start(port=self.port)
+
+    def run_in_background(self) -> None:
+        self._run_thread = threading.Thread(
+            target=self.run, name="master-run", daemon=True
+        )
+        self._run_thread.start()
+
+    def run(self) -> None:
+        """Supervision loop (reference dist_master.py:276-370)."""
+        while not self._stopped.is_set():
+            time.sleep(1.0)
+            try:
+                # Master-level diagnosis actions (e.g. job abortion)
+                action = self._job_ctx.master_actions.next_action(-1)
+                if action.action_type == DiagnosisActionType.JOB_ABORTION:
+                    self._exit(action.config.get("reason", JobExitReason.FATAL_ERROR))
+                    return
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        self._exit(JobExitReason.SUCCEEDED)
+                    else:
+                        self._exit(JobExitReason.FATAL_ERROR)
+                    return
+                if self.task_manager.finished():
+                    logger.info("all dataset tasks completed")
+            except Exception:
+                logger.exception("master run loop error")
+
+    def _exit(self, reason: str) -> None:
+        self.exit_reason = reason
+        self._job_ctx.set_stage(JobStage.STOPPED, reason)
+        self._events.job_stop(reason)
+        logger.info("job master exiting: %s", reason)
+        self._stopped.set()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.job_manager.stop()
+        self._server.stop()
+
+
+def run_local_master(
+    port: int = 0, num_workers: int = 1, node_unit: int = 1, service_type: str = ""
+) -> LocalJobMaster:
+    master = LocalJobMaster(
+        port=port,
+        num_workers=num_workers,
+        node_unit=node_unit,
+        service_type=service_type,
+    )
+    master.prepare()
+    master.run_in_background()
+    return master

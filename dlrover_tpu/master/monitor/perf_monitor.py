@@ -1,0 +1,46 @@
+"""Training performance monitor (reference: ``monitor/perf_monitor.py:45``).
+
+Tracks global-step progress and derives step speed; feeds hang detection
+(step watermark) and goodput accounting.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+
+class PerfMonitor:
+    def __init__(self, window: int = 64):
+        self._lock = threading.Lock()
+        self._samples: Deque[Tuple[int, float]] = deque(maxlen=window)
+        self._start_time = time.time()
+        self._total_steps = 0
+
+    def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
+        timestamp = timestamp or time.time()
+        with self._lock:
+            if self._samples and step <= self._samples[-1][0]:
+                return
+            self._samples.append((step, timestamp))
+            self._total_steps = step
+
+    def steps_per_second(self) -> float:
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            s0, t0 = self._samples[0]
+            s1, t1 = self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (s1 - s0) / (t1 - t0)
+
+    def last_step(self) -> Tuple[int, float]:
+        with self._lock:
+            return self._samples[-1] if self._samples else (0, 0.0)
+
+    def seconds_since_last_step(self) -> Optional[float]:
+        with self._lock:
+            if not self._samples:
+                return None
+            return time.time() - self._samples[-1][1]

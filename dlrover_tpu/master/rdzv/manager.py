@@ -1,0 +1,342 @@
+"""Master-side rendezvous managers.
+
+Re-creates ``dlrover/python/master/elastic_training/rdzv_manager.py`` for a
+JAX world: a completed rendezvous assigns each TPU host its
+``process_id`` (its rank in the sorted world) and designates rank 0's
+address as the ``jax.distributed`` coordinator.  Membership change =
+complete a new rendezvous round = rebuild the global device mesh.
+
+Key behaviors carried over (reference line cites in methods):
+- completion when waiting == max_nodes, or ≥ min_nodes after a last-call
+  timeout, truncated to a multiple of ``node_unit`` (≙ TPU slice size)
+- ``num_nodes_waiting`` only triggers a world restart when enough nodes
+  wait to form a unit, or a previous member re-joined (crash-restart)
+- network-check rendezvous pairs hosts (adjacent, then fastest-with-
+  slowest) to isolate faulty hosts; stragglers = elapsed > ratio × median
+"""
+
+import statistics
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...common import comm
+from ...common.config import get_context
+from ...common.constants import RendezvousName
+from ...common.log import logger
+
+
+class NodeTopologyMeta(comm.NodeMeta):
+    """Alias retained for reference-parity naming (net_topology.py:23)."""
+
+
+class TopologySorter:
+    """Orders a completed world. Hook for topology-aware placement
+    (reference: ``DpTopologySorter`` net_topology.py:53). The default
+    groups hosts by slice id then switch id then node rank, so
+    data-parallel neighbors land on the same ICI domain and collectives
+    cross DCN as little as possible."""
+
+    def sort(self, nodes: Dict[int, comm.NodeMeta]) -> List[comm.NodeMeta]:
+        return sorted(
+            nodes.values(), key=lambda n: (n.slice_id, n.asw, n.node_rank)
+        )
+
+
+class RendezvousManager:
+    def __init__(self, name: str):
+        self._name = name
+        self._lock = threading.Lock()
+        ctx = get_context()
+        self._waiting_nodes: Dict[int, comm.NodeMeta] = {}  # node_rank → meta
+        self._rdzv_nodes: Dict[int, comm.NodeMeta] = {}  # completed world
+        self._latest_members: Set[int] = set()  # node_ranks of last world
+        self._rdzv_round = 0
+        self._min_nodes = 1
+        self._max_nodes = 1
+        self._node_unit = 1
+        self._waiting_timeout = ctx.rdzv_timeout_s
+        self._lastcall_timeout = ctx.rdzv_lastcall_s
+        self._lastcall_time = 0.0
+        self._start_rdzv_time = 0.0
+        self._ckpt_sync_nodes: Dict[int, int] = {}  # node_id → step
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def update_rdzv_params(
+        self, min_nodes: int, max_nodes: int, waiting_timeout: float, node_unit: int
+    ) -> None:
+        with self._lock:
+            self._min_nodes = min_nodes
+            self._max_nodes = max_nodes
+            self._waiting_timeout = waiting_timeout
+            self._node_unit = max(1, node_unit)
+
+    def add_alive_node(self, node_rank: int) -> None:
+        pass  # membership is driven by joins; hook for the job manager
+
+    def remove_alive_node(self, node_rank: int) -> None:
+        """A node died: drop it from any pending rendezvous so completion
+        logic doesn't wait on a ghost (reference rdzv_manager.py:239)."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                del self._waiting_nodes[node_rank]
+                logger.info(
+                    "%s rdzv: removed dead node %s from waiting", self._name, node_rank
+                )
+
+    def join_rendezvous(self, meta: comm.NodeMeta) -> int:
+        """A host asks to join the next round (reference :280-337)."""
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_time = time.time()
+            self._waiting_nodes[meta.node_rank] = meta
+            self._lastcall_time = time.time()
+            logger.info(
+                "%s rdzv round %s: node %s joined (%s waiting)",
+                self._name,
+                self._rdzv_round,
+                meta.node_rank,
+                len(self._waiting_nodes),
+            )
+            return self._rdzv_round
+
+    def _check_rdzv_completed(self) -> bool:
+        """Caller holds the lock. Reference :156-217."""
+        waiting = len(self._waiting_nodes)
+        if waiting == self._max_nodes:
+            self._complete()
+            return True
+        if waiting >= self._min_nodes:
+            if (
+                self._lastcall_time > 0
+                and time.time() - self._lastcall_time > self._lastcall_timeout
+            ):
+                # Truncate to a multiple of node_unit (slice granularity);
+                # extra hosts stay waiting for the next round.
+                usable = (waiting // self._node_unit) * self._node_unit
+                if usable >= self._min_nodes and usable > 0:
+                    self._complete(limit=usable)
+                    return True
+        if (
+            self._start_rdzv_time > 0
+            and time.time() - self._start_rdzv_time > self._waiting_timeout
+        ):
+            logger.warning(
+                "%s rdzv round %s timed out with %s/%s nodes",
+                self._name,
+                self._rdzv_round,
+                waiting,
+                self._min_nodes,
+            )
+        return False
+
+    def _complete(self, limit: Optional[int] = None) -> None:
+        members = sorted(self._waiting_nodes)
+        if limit is not None:
+            members = members[:limit]
+        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in members}
+        for r in members:
+            del self._waiting_nodes[r]
+        self._latest_members = set(members)
+        self._rdzv_round += 1
+        self._lastcall_time = 0.0
+        self._start_rdzv_time = 0.0
+        logger.info(
+            "%s rdzv round %s completed with %s nodes",
+            self._name,
+            self._rdzv_round - 1,
+            len(self._rdzv_nodes),
+        )
+
+    def get_comm_world(
+        self, node_id: int
+    ) -> Tuple[int, int, Dict[int, comm.NodeMeta]]:
+        """Poll for the completed world. Returns (round, group, world);
+        world is empty until the rendezvous completes. Ranks (process ids)
+        are positions in the topology-sorted world (reference :423)."""
+        with self._lock:
+            if not self._rdzv_nodes:
+                self._check_rdzv_completed()
+            if not self._rdzv_nodes:
+                return self._rdzv_round, 0, {}
+            ordered = TopologySorter().sort(self._rdzv_nodes)
+            world = {}
+            for process_id, meta in enumerate(ordered):
+                world[process_id] = meta
+            return self._rdzv_round - 1, 0, world
+
+    def num_nodes_waiting(self) -> int:
+        """Reference :355-376: only report waiters (→ world restart) when a
+        full node_unit can join or a previous member is re-joining."""
+        with self._lock:
+            waiting = len(self._waiting_nodes)
+            if waiting == 0:
+                return 0
+            rejoin = any(r in self._latest_members for r in self._waiting_nodes)
+            if waiting >= self._node_unit or rejoin:
+                return waiting
+            return 0
+
+    def clear_waiting_nodes(self) -> None:
+        with self._lock:
+            self._waiting_nodes.clear()
+
+    def world_size(self) -> int:
+        with self._lock:
+            return len(self._rdzv_nodes)
+
+    def sync_ckpt_nodes(self, node_id: int, step: int) -> bool:
+        """All members report the same step → checkpoint sync done
+        (reference :378)."""
+        with self._lock:
+            self._ckpt_sync_nodes[node_id] = step
+            if any(s != step for s in self._ckpt_sync_nodes.values()):
+                self._ckpt_sync_nodes = {node_id: step}
+                return False
+            return len(self._ckpt_sync_nodes) >= len(self._rdzv_nodes) > 0
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    def __init__(self):
+        super().__init__(RendezvousName.TRAINING)
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pairwise node-check rendezvous (reference :510-799).
+
+    Round 0 pairs adjacent hosts; round 1 pairs the fastest with the
+    slowest, so a fault that shows up in both rounds pins the faulty host
+    (its two different partners were each otherwise healthy).
+    """
+
+    def __init__(self):
+        super().__init__(RendezvousName.NETWORK_CHECK)
+        self._node_times: Dict[int, Dict[int, float]] = {}  # round → {node: s}
+        self._node_status: Dict[int, Dict[int, bool]] = {}  # round → {node: ok}
+        self._check_round = 0
+        self._fault_nodes: Set[int] = set()
+        self._stragglers: Set[int] = set()
+        self._group_cache: Dict[int, List[List[int]]] = {}
+
+    def get_comm_world(
+        self, node_id: int
+    ) -> Tuple[int, int, Dict[int, comm.NodeMeta]]:
+        with self._lock:
+            if not self._rdzv_nodes:
+                self._check_rdzv_completed()
+                if self._rdzv_nodes:
+                    self._group_cache.clear()
+            if not self._rdzv_nodes:
+                return self._rdzv_round, 0, {}
+            groups = self._group_nodes(self._check_round)
+            for group_idx, group in enumerate(groups):
+                if node_id in group:
+                    world = {}
+                    for process_id, rank in enumerate(sorted(group)):
+                        world[process_id] = self._rdzv_nodes[rank]
+                    return self._rdzv_round - 1, group_idx, world
+            return self._rdzv_round - 1, 0, {}
+
+    def _group_nodes(self, round: int) -> List[List[int]]:
+        """Caller holds the lock. Round 0: adjacent pairs (:610-631);
+        round 1: fastest paired with slowest (:632-655)."""
+        round = round % 2
+        if round in self._group_cache:
+            return self._group_cache[round]
+        ranks = sorted(self._rdzv_nodes)
+        groups: List[List[int]] = []
+        if round == 0:
+            pair: List[int] = []
+            for r in ranks:
+                pair.append(r)
+                if len(pair) == 2:
+                    groups.append(pair)
+                    pair = []
+            if pair:
+                groups.append(pair)
+        else:
+            times = self._node_times.get(0, {})
+            ordered = sorted(ranks, key=lambda r: times.get(r, 0.0))
+            left, right = 0, len(ordered) - 1
+            while left < right:
+                groups.append([ordered[left], ordered[right]])
+                left += 1
+                right -= 1
+            if left == right:
+                groups.append([ordered[left]])
+        self._group_cache[round] = groups
+        return groups
+
+    def report_network_check_result(
+        self, node_id: int, normal: bool, elapsed: float
+    ) -> None:
+        with self._lock:
+            self._node_times.setdefault(self._check_round, {})[node_id] = elapsed
+            self._node_status.setdefault(self._check_round, {})[node_id] = normal
+
+    def join_rendezvous(self, meta: comm.NodeMeta) -> int:
+        with self._lock:
+            round_now = self._rdzv_round
+        result = super().join_rendezvous(meta)
+        with self._lock:
+            # A fresh join wave starts a new check round pair (0, 1, 0, ...)
+            if self._rdzv_nodes and meta.node_rank not in self._rdzv_nodes:
+                pass
+        return result
+
+    def next_check_round(self) -> int:
+        with self._lock:
+            self._check_round += 1
+            self._group_cache.clear()
+            return self._check_round
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        """Reference :732. A node is faulty if it reported not-normal in the
+        latest round; with two rounds of different pairings, both-round
+        failures isolate the true fault."""
+        with self._lock:
+            if not self._node_status:
+                return [], "no check results"
+            rounds = sorted(self._node_status)
+            latest = self._node_status[rounds[-1]]
+            expected = set(self._rdzv_nodes) or set(latest)
+            if len(rounds) >= 2:
+                first = self._node_status[rounds[-2]]
+                fault = {
+                    n
+                    for n in expected
+                    if not latest.get(n, True) and not first.get(n, True)
+                }
+            else:
+                fault = {n for n in expected if not latest.get(n, True)}
+            self._fault_nodes = fault
+            return sorted(fault), ""
+
+    def detect_stragglers(self) -> List[int]:
+        """Reference :784-799: elapsed > ratio × median of the round."""
+        with self._lock:
+            if not self._node_times:
+                return []
+            latest_round = max(self._node_times)
+            times = self._node_times[latest_round]
+            if len(times) < 2:
+                return []
+            med = statistics.median(times.values())
+            ratio = get_context().straggler_median_ratio
+            if med <= 0:
+                return []
+            stragglers = [n for n, t in times.items() if t > ratio * med]
+            self._stragglers = set(stragglers)
+            return sorted(stragglers)
+
+    def network_ready(self) -> Tuple[bool, str]:
+        """All members of the current round reported → ready."""
+        with self._lock:
+            status = self._node_status.get(self._check_round, {})
+            expected = len(self._rdzv_nodes)
+            if expected == 0 or len(status) < expected:
+                return False, "results pending"
+            return True, ""

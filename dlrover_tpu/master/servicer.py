@@ -1,0 +1,280 @@
+"""Master RPC servicer: typed-message dispatch for ``get``/``report``.
+
+Reference: ``dlrover/python/master/servicer.py`` (MasterServicer:84, get:147,
+report:412). Every agent RPC lands here; the servicer routes by message type
+to the owning component (kv store, rendezvous managers, task manager, job
+manager, diagnosis queues).
+"""
+
+import time
+from typing import Dict
+
+from ..common import comm
+from ..common.constants import JobStage, RendezvousName
+from ..common.log import logger
+from ..common.serialize import dumps, loads
+from .diagnosis.action import action_to_msg
+from .job_context import get_job_context
+from .kv_store import KVStoreService
+from .node.job_manager import JobManager
+from .rdzv.manager import RendezvousManager
+from .shard.task_manager import TaskManager
+from .sync_service import SyncService
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        job_manager: JobManager,
+        rdzv_managers: Dict[str, RendezvousManager],
+        task_manager: TaskManager,
+        kv_store: KVStoreService = None,
+        sync_service: SyncService = None,
+        perf_monitor=None,
+    ):
+        self._job_manager = job_manager
+        self._rdzv_managers = rdzv_managers
+        self._task_manager = task_manager
+        self._kv_store = kv_store or KVStoreService()
+        self._sync_service = sync_service or SyncService()
+        self._perf_monitor = perf_monitor
+        self._job_ctx = get_job_context()
+        self._start_time = time.time()
+
+    # -- transport entry points (bytes in/out) -----------------------------
+
+    def get(self, request_bytes: bytes) -> bytes:
+        req = loads(request_bytes)
+        message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
+        handler = self._GET_HANDLERS.get(type(message))
+        if handler is None:
+            logger.warning("no get handler for %s", type(message).__name__)
+            return dumps(comm.BaseResponse(success=False, reason="unknown message"))
+        result = handler(self, message)
+        return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+
+    def report(self, request_bytes: bytes) -> bytes:
+        req = loads(request_bytes)
+        message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
+        handler = self._REPORT_HANDLERS.get(type(message))
+        if handler is None:
+            logger.warning("no report handler for %s", type(message).__name__)
+            return dumps(comm.BaseResponse(success=False, reason="unknown message"))
+        try:
+            handler(self, message)
+            return dumps(comm.BaseResponse(success=True))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("report handler failed")
+            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+
+    # -- kv store ----------------------------------------------------------
+
+    def _kv_get(self, msg: comm.KeyValueQuery) -> comm.KeyValuePair:
+        return comm.KeyValuePair(key=msg.key, value=self._kv_store.get(msg.key))
+
+    def _kv_add(self, msg: comm.KeyValueAdd) -> comm.KeyValuePair:
+        value = self._kv_store.add(msg.key, msg.amount)
+        return comm.KeyValuePair(key=msg.key, value=str(value).encode())
+
+    def _kv_multi_get(self, msg: comm.KeyValueMultiGet) -> comm.KeyValueMultiPair:
+        return comm.KeyValueMultiPair(kvs=self._kv_store.multi_get(msg.keys))
+
+    def _kv_set(self, msg: comm.KeyValuePair) -> None:
+        self._kv_store.set(msg.key, msg.value)
+
+    def _kv_multi_set(self, msg: comm.KeyValueMultiPair) -> None:
+        self._kv_store.multi_set(msg.kvs)
+
+    # -- rendezvous --------------------------------------------------------
+
+    def _join_rdzv(self, msg: comm.JoinRendezvousRequest) -> comm.JoinRendezvousResponse:
+        manager = self._rdzv_managers[msg.rdzv_name or RendezvousName.TRAINING]
+        meta = comm.NodeMeta(
+            node_id=msg.node_id,
+            node_rank=msg.node_rank if msg.node_rank >= 0 else msg.node_id,
+            process_unit=msg.local_world_size,
+            addr=msg.node_ip,
+            slice_id=msg.slice_id,
+        )
+        round_ = manager.join_rendezvous(meta)
+        return comm.JoinRendezvousResponse(round=round_)
+
+    def _get_comm_world(self, msg: comm.CommWorldRequest) -> comm.CommWorldResponse:
+        manager = self._rdzv_managers[msg.rdzv_name or RendezvousName.TRAINING]
+        round_, group, world = manager.get_comm_world(msg.node_id)
+        return comm.CommWorldResponse(
+            rdzv_name=manager.name, round=round_, group=group, world=world
+        )
+
+    def _num_waiting(self, msg: comm.WaitingNodeNumRequest) -> comm.WaitingNodeNumResponse:
+        manager = self._rdzv_managers[msg.rdzv_name or RendezvousName.TRAINING]
+        return comm.WaitingNodeNumResponse(waiting_num=manager.num_nodes_waiting())
+
+    def _network_ready(self, msg: comm.NetworkReadyRequest) -> comm.NetworkReadyResponse:
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is None:
+            return comm.NetworkReadyResponse(ready=True)
+        ready, reason = manager.network_ready()
+        return comm.NetworkReadyResponse(ready=ready, reason=reason)
+
+    def _report_network_check(self, msg: comm.NetworkCheckResult) -> None:
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is not None:
+            manager.report_network_check_result(msg.node_id, msg.normal, msg.elapsed_time)
+
+    def _fault_nodes(self, msg: comm.FaultNodesRequest) -> comm.FaultNodesResponse:
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is None:
+            return comm.FaultNodesResponse()
+        nodes, reason = manager.check_fault_node()
+        return comm.FaultNodesResponse(fault_nodes=nodes, reason=reason)
+
+    def _stragglers(self, msg: comm.StragglersRequest) -> comm.StragglersResponse:
+        manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
+        if manager is None:
+            return comm.StragglersResponse()
+        return comm.StragglersResponse(stragglers=manager.detect_stragglers())
+
+    # -- node lifecycle ----------------------------------------------------
+
+    def _node_state(self, msg: comm.NodeStateRequest) -> None:
+        self._job_manager.update_node_status(
+            msg.node_id, msg.node_type or "worker", msg.status, msg.exit_reason
+        )
+        for manager in self._rdzv_managers.values():
+            if msg.status in ("failed", "succeeded", "deleted"):
+                manager.remove_alive_node(msg.node_id)
+
+    def _node_failure(self, msg: comm.NodeFailureReport) -> None:
+        self._job_manager.handle_failure_report(
+            msg.node_id, msg.error_data, msg.restart_count
+        )
+
+    def _heartbeat(self, msg: comm.HeartbeatRequest) -> comm.HeartbeatResponse:
+        self._job_manager.record_heartbeat(msg.node_id, msg.timestamp)
+        actions = self._job_ctx.node_actions.drain_actions(msg.node_id)
+        return comm.HeartbeatResponse(actions=[action_to_msg(a) for a in actions])
+
+    def _resource_usage(self, msg: comm.ResourceUsageReport) -> None:
+        node = self._job_ctx.get_node(msg.node_type or "worker", msg.node_id)
+        if node is not None:
+            node.used_resource.cpu = msg.cpu_percent
+            node.used_resource.memory_mb = msg.memory_mb
+            self._job_ctx.update_node(node)
+
+    def _training_step(self, msg: comm.TrainingStepReport) -> None:
+        self._job_ctx.report_step(msg.step, msg.timestamp)
+        if self._perf_monitor is not None:
+            self._perf_monitor.collect_global_step(msg.step, msg.timestamp)
+
+    # -- data shards -------------------------------------------------------
+
+    def _dataset_params(self, msg: comm.DatasetShardParams) -> None:
+        self._task_manager.new_dataset(msg)
+
+    def _get_task(self, msg: comm.TaskRequest) -> comm.TaskMsg:
+        task = self._task_manager.get_task(msg.node_id, msg.dataset_name)
+        shard = comm.ShardMsg(
+            name=task.shard.name,
+            start=task.shard.start,
+            end=task.shard.end,
+            indices=task.shard.record_indices,
+        )
+        return comm.TaskMsg(task_id=task.task_id, task_type=task.task_type, shard=shard)
+
+    def _task_result(self, msg: comm.TaskResult) -> None:
+        self._task_manager.report_task_result(msg.dataset_name, msg.task_id, msg.success)
+
+    def _shard_ckpt_get(self, msg: comm.ShardCheckpointRequest) -> comm.ShardCheckpointMsg:
+        return comm.ShardCheckpointMsg(
+            dataset_name=msg.dataset_name,
+            content=self._task_manager.checkpoint(msg.dataset_name),
+        )
+
+    def _shard_ckpt_restore(self, msg: comm.ShardCheckpointMsg) -> None:
+        self._task_manager.restore_checkpoint(msg.dataset_name, msg.content)
+
+    # -- checkpoint sync ---------------------------------------------------
+
+    def _ckpt_sync(self, msg: comm.CheckpointStepSync) -> comm.CheckpointStepSyncResponse:
+        manager = self._rdzv_managers.get(RendezvousName.TRAINING)
+        success = manager.sync_ckpt_nodes(msg.node_id, msg.step) if manager else True
+        return comm.CheckpointStepSyncResponse(success=success)
+
+    # -- pre-check / status / config ---------------------------------------
+
+    def _pre_check(self, msg: comm.PreCheckRequest) -> comm.PreCheckResponse:
+        return comm.PreCheckResponse(
+            status=self._job_ctx.pre_check_status,
+            reason=self._job_ctx.pre_check_reason,
+        )
+
+    def _job_status(self, msg: comm.JobStatusRequest) -> comm.JobStatusResponse:
+        return comm.JobStatusResponse(
+            stage=self._job_ctx.job_stage, exit_reason=self._job_ctx.job_exit_reason
+        )
+
+    def _paral_config(self, msg: comm.ParallelConfigRequest) -> comm.ParallelConfig:
+        return self._job_ctx.__dict__.setdefault(
+            "paral_config", comm.ParallelConfig()
+        )
+
+    def _run_config(self, msg: comm.ElasticRunConfigRequest) -> comm.ElasticRunConfigResponse:
+        configs = self._job_ctx.__dict__.get("elastic_run_config", {})
+        return comm.ElasticRunConfigResponse(configs=dict(configs))
+
+    def _event_report(self, msg: comm.EventReport) -> None:
+        logger.info(
+            "[event] type=%s instance=%s action=%s msg=%s",
+            msg.event_type,
+            msg.instance,
+            msg.action,
+            msg.msg,
+        )
+
+    # -- sync barriers -----------------------------------------------------
+
+    def _sync_join(self, msg: comm.SyncJoin) -> comm.SyncQueryResponse:
+        return comm.SyncQueryResponse(
+            success=self._sync_service.join(msg.sync_name, msg.node_id)
+        )
+
+    def _sync_finish(self, msg: comm.SyncFinish) -> comm.SyncQueryResponse:
+        self._sync_service.finish(msg.sync_name)
+        return comm.SyncQueryResponse(success=True)
+
+    _GET_HANDLERS = {
+        comm.KeyValueQuery: _kv_get,
+        comm.KeyValueAdd: _kv_add,
+        comm.KeyValueMultiGet: _kv_multi_get,
+        comm.JoinRendezvousRequest: _join_rdzv,
+        comm.CommWorldRequest: _get_comm_world,
+        comm.WaitingNodeNumRequest: _num_waiting,
+        comm.NetworkReadyRequest: _network_ready,
+        comm.FaultNodesRequest: _fault_nodes,
+        comm.StragglersRequest: _stragglers,
+        comm.HeartbeatRequest: _heartbeat,
+        comm.TaskRequest: _get_task,
+        comm.ShardCheckpointRequest: _shard_ckpt_get,
+        comm.CheckpointStepSync: _ckpt_sync,
+        comm.PreCheckRequest: _pre_check,
+        comm.JobStatusRequest: _job_status,
+        comm.ParallelConfigRequest: _paral_config,
+        comm.ElasticRunConfigRequest: _run_config,
+        comm.SyncJoin: _sync_join,
+        comm.SyncFinish: _sync_finish,
+    }
+
+    _REPORT_HANDLERS = {
+        comm.KeyValuePair: _kv_set,
+        comm.KeyValueMultiPair: _kv_multi_set,
+        comm.NetworkCheckResult: _report_network_check,
+        comm.NodeStateRequest: _node_state,
+        comm.NodeFailureReport: _node_failure,
+        comm.ResourceUsageReport: _resource_usage,
+        comm.TrainingStepReport: _training_step,
+        comm.DatasetShardParams: _dataset_params,
+        comm.TaskResult: _task_result,
+        comm.ShardCheckpointMsg: _shard_ckpt_restore,
+        comm.EventReport: _event_report,
+    }

@@ -1,0 +1,142 @@
+"""Dataset splitting into elastic shards.
+
+Reference: ``master/shard/dataset_splitter.py`` (Shard:26, DatasetSplitter:92,
+TableDatasetSplitter:146, TextDatasetSplitter:259,
+StreamingDatasetSplitter:361). A shard is a [start, end) sample-index range,
+optionally with shuffled per-sample indices; workers pull shards as tasks so
+data delivery stays correct under worker churn.
+"""
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class Shard:
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: List[int] = field(default_factory=list)
+
+    @property
+    def size(self) -> int:
+        return self.end - self.start
+
+
+class DatasetSplitter:
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+    ):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = max(1, shard_size)
+        self.num_epochs = max(1, num_epochs)
+        self.epoch = 0
+
+    def create_shards(self) -> List[Shard]:
+        raise NotImplementedError
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Contiguous range shards over an indexable dataset (reference :146)."""
+
+    def __init__(self, *args, shuffle: bool = False, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shuffle = shuffle
+        self._rng = random.Random(seed)
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        shards = []
+        starts = list(range(0, self.dataset_size, self.shard_size))
+        if self.shuffle:
+            self._rng.shuffle(starts)
+        for i, start in enumerate(starts):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(name=f"{self.dataset_name}_e{self.epoch}_s{i}", start=start, end=end)
+            )
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards with explicit per-sample indices, supporting intra-shard
+    shuffling (reference :259)."""
+
+    def __init__(self, *args, shuffle: bool = False, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shuffle = shuffle
+        self._rng = random.Random(seed)
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            self._rng.shuffle(indices)
+        shards = []
+        for i, start in enumerate(range(0, self.dataset_size, self.shard_size)):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}_e{self.epoch}_s{i}",
+                    start=start,
+                    end=end,
+                    record_indices=indices[start:end],
+                )
+            )
+        return shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Open-ended stream: shards are emitted as offsets advance
+    (reference :361)."""
+
+    def __init__(self, dataset_name: str, shard_size: int, start_offset: int = 0):
+        super().__init__(dataset_name, dataset_size=-1, shard_size=shard_size)
+        self._offset = start_offset
+        self._shard_idx = 0
+
+    def create_shards(self, count: int = 16) -> List[Shard]:
+        shards = []
+        for _ in range(count):
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}_s{self._shard_idx}",
+                    start=self._offset,
+                    end=self._offset + self.shard_size,
+                )
+            )
+            self._offset += self.shard_size
+            self._shard_idx += 1
+        return shards
+
+    def epoch_finished(self) -> bool:
+        return False
+
+
+def new_dataset_splitter(
+    splitter_type: str,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> DatasetSplitter:
+    if splitter_type == "text":
+        return TextDatasetSplitter(
+            dataset_name, dataset_size, shard_size, num_epochs, shuffle=shuffle, seed=seed
+        )
+    if splitter_type == "streaming":
+        return StreamingDatasetSplitter(dataset_name, shard_size)
+    return TableDatasetSplitter(
+        dataset_name, dataset_size, shard_size, num_epochs, shuffle=shuffle, seed=seed
+    )

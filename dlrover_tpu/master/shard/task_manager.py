@@ -1,0 +1,233 @@
+"""Master-side task queues for dynamic data sharding.
+
+Reference: ``master/shard/base_dataset_manager.py`` (Task:22, DoingTask:43,
+DatasetShardCheckpoint:60), ``batch_dataset_manager.py:29`` and
+``task_manager.py:35``: todo/doing queues with at-least-once redelivery —
+shards of dead or timed-out workers are re-queued, which is what makes
+worker-count elasticity safe for data order.
+"""
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...common import comm
+from ...common.log import logger
+from .dataset_splitter import DatasetSplitter, Shard
+
+
+@dataclass
+class Task:
+    task_id: int = -1
+    task_type: str = "training"
+    shard: Shard = field(default_factory=Shard)
+
+    @classmethod
+    def create_invalid_task(cls) -> "Task":
+        return cls(task_id=-1)
+
+
+@dataclass
+class DoingTask:
+    task: Task
+    node_id: int
+    start_time: float
+
+
+class DatasetManager:
+    """Per-dataset todo/doing bookkeeping (reference batch_dataset_manager)."""
+
+    def __init__(self, dataset_name: str, splitter: DatasetSplitter, task_type: str = "training"):
+        self.dataset_name = dataset_name
+        self._splitter = splitter
+        self._task_type = task_type
+        self.todo: List[Task] = []
+        self.doing: Dict[int, DoingTask] = {}
+        self._task_id = 0
+        self._completed = 0
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        if self.todo or self._splitter.epoch_finished():
+            return
+        for shard in self._splitter.create_shards():
+            self.todo.append(
+                Task(task_id=self._task_id, task_type=self._task_type, shard=shard)
+            )
+            self._task_id += 1
+
+    def get_task(self, node_id: int) -> Task:
+        with self._lock:
+            self._refill()
+            if not self.todo:
+                return Task.create_invalid_task()
+            task = self.todo.pop(0)
+            self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+            return task
+
+    def report_task_status(self, task_id: int, success: bool) -> Optional[Task]:
+        with self._lock:
+            doing = self.doing.pop(task_id, None)
+            if doing is None:
+                return None
+            if success:
+                self._completed += 1
+                return doing.task
+            self.todo.insert(0, doing.task)
+            return None
+
+    def recover_tasks_of_node(self, node_id: int) -> int:
+        """Requeue uncompleted shards of a dead worker (reference
+        task_manager recovery)."""
+        with self._lock:
+            recovered = [t for t in self.doing.values() if t.node_id == node_id]
+            for doing in recovered:
+                del self.doing[doing.task.task_id]
+                self.todo.insert(0, doing.task)
+            if recovered:
+                logger.info(
+                    "requeued %s tasks of dead node %s on dataset %s",
+                    len(recovered),
+                    node_id,
+                    self.dataset_name,
+                )
+            return len(recovered)
+
+    def recover_timeout_tasks(self, timeout_s: float) -> List[int]:
+        now = time.time()
+        with self._lock:
+            timed_out = [
+                tid
+                for tid, doing in self.doing.items()
+                if now - doing.start_time > timeout_s
+            ]
+            nodes = []
+            for tid in timed_out:
+                doing = self.doing.pop(tid)
+                self.todo.insert(0, doing.task)
+                nodes.append(doing.node_id)
+            return nodes
+
+    def completed(self) -> bool:
+        with self._lock:
+            return (
+                not self.todo
+                and not self.doing
+                and self._splitter.epoch_finished()
+            )
+
+    # -- shard checkpoint (data resume) -----------------------------------
+
+    def checkpoint(self) -> str:
+        """Serialize undelivered + in-flight shards (reference
+        DatasetShardCheckpoint base_dataset_manager.py:60)."""
+        with self._lock:
+            payload = {
+                "dataset_name": self.dataset_name,
+                "todo": [
+                    [t.shard.start, t.shard.end, t.shard.record_indices]
+                    for t in self.todo
+                ],
+                "doing": [
+                    [d.task.shard.start, d.task.shard.end, d.task.shard.record_indices]
+                    for d in self.doing.values()
+                ],
+                "epoch": self._splitter.epoch,
+            }
+            return json.dumps(payload)
+
+    def restore_checkpoint(self, content: str) -> None:
+        data = json.loads(content)
+        with self._lock:
+            self.todo = []
+            self.doing = {}
+            self._splitter.epoch = data.get("epoch", self._splitter.epoch)
+            for start, end, indices in data.get("doing", []) + data.get("todo", []):
+                shard = Shard(
+                    name=f"{self.dataset_name}_restored_{self._task_id}",
+                    start=start,
+                    end=end,
+                    record_indices=indices or [],
+                )
+                self.todo.append(
+                    Task(task_id=self._task_id, task_type=self._task_type, shard=shard)
+                )
+                self._task_id += 1
+
+
+class TaskManager:
+    """All datasets of the job (reference task_manager.py:35)."""
+
+    def __init__(self, task_timeout_s: float = 1800.0):
+        self._datasets: Dict[str, DatasetManager] = {}
+        self._lock = threading.Lock()
+        self._task_timeout_s = task_timeout_s
+        self._worker_restart_callbacks = []
+
+    def new_dataset(self, params: comm.DatasetShardParams) -> None:
+        from .dataset_splitter import new_dataset_splitter
+
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            shard_size = max(
+                1, params.batch_size * params.num_minibatches_per_shard
+            )
+            splitter = new_dataset_splitter(
+                params.storage_type or "table",
+                params.dataset_name,
+                params.dataset_size,
+                shard_size,
+                num_epochs=params.num_epochs,
+                shuffle=params.shuffle,
+            )
+            self._datasets[params.dataset_name] = DatasetManager(
+                params.dataset_name, splitter, params.task_type
+            )
+            logger.info("created dataset manager %s", params.dataset_name)
+
+    def get_dataset(self, name: str) -> Optional[DatasetManager]:
+        with self._lock:
+            return self._datasets.get(name)
+
+    def get_task(self, node_id: int, dataset_name: str) -> Task:
+        ds = self.get_dataset(dataset_name)
+        if ds is None:
+            return Task.create_invalid_task()
+        return ds.get_task(node_id)
+
+    def report_task_result(self, dataset_name: str, task_id: int, success: bool) -> None:
+        ds = self.get_dataset(dataset_name)
+        if ds is not None:
+            ds.report_task_status(task_id, success)
+
+    def recover_tasks(self, node_id: int) -> None:
+        with self._lock:
+            datasets = list(self._datasets.values())
+        for ds in datasets:
+            ds.recover_tasks_of_node(node_id)
+
+    def recover_timeout_tasks(self) -> List[int]:
+        slow_nodes: List[int] = []
+        with self._lock:
+            datasets = list(self._datasets.values())
+        for ds in datasets:
+            slow_nodes.extend(ds.recover_timeout_tasks(self._task_timeout_s))
+        return slow_nodes
+
+    def finished(self) -> bool:
+        with self._lock:
+            return bool(self._datasets) and all(
+                ds.completed() for ds in self._datasets.values()
+            )
+
+    def checkpoint(self, dataset_name: str) -> str:
+        ds = self.get_dataset(dataset_name)
+        return ds.checkpoint() if ds else ""
+
+    def restore_checkpoint(self, dataset_name: str, content: str) -> None:
+        ds = self.get_dataset(dataset_name)
+        if ds is not None:
+            ds.restore_checkpoint(content)

@@ -1,0 +1,33 @@
+"""Named barrier service for worker groups (reference: sync_service.py:25)."""
+
+import threading
+from typing import Dict, Set
+
+
+class SyncService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._syncs: Dict[str, Set[int]] = {}
+        self._finished: Set[str] = set()
+        self._expected = 0  # 0 → any positive count finishes on explicit finish
+
+    def set_expected(self, count: int) -> None:
+        with self._lock:
+            self._expected = count
+
+    def join(self, sync_name: str, node_id: int) -> bool:
+        with self._lock:
+            members = self._syncs.setdefault(sync_name, set())
+            members.add(node_id)
+            if self._expected and len(members) >= self._expected:
+                self._finished.add(sync_name)
+            return True
+
+    def finish(self, sync_name: str) -> bool:
+        with self._lock:
+            self._finished.add(sync_name)
+            return True
+
+    def is_finished(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished

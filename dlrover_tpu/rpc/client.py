@@ -1,0 +1,373 @@
+"""Master client: the agent/trainer side of the control plane.
+
+Re-creates ``dlrover/python/elastic_agent/master_client.py:45`` — a process
+singleton exposing the full RPC surface (kv-store, rendezvous, node events,
+tasks, checkpoint sync, heartbeat, pre-check) over either gRPC or HTTP.
+"""
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+from urllib import request as _urlreq
+
+import grpc
+
+from ..common import comm
+from ..common.config import get_context
+from ..common.constants import GRPC, CommsType, NodeEnv
+from ..common.log import logger
+from ..common.serialize import dumps, loads
+from .server import SERVICE_NAME, _identity
+
+
+class MasterTransport:
+    def get(self, payload: bytes) -> bytes:
+        raise NotImplementedError
+
+    def report(self, payload: bytes) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class GrpcTransport(MasterTransport):
+    def __init__(self, addr: str):
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[
+                ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+            ],
+        )
+        self._get = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/get",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        self._report = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/report",
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+
+    def get(self, payload: bytes) -> bytes:
+        return self._get(payload, timeout=30)
+
+    def report(self, payload: bytes) -> bytes:
+        return self._report(payload, timeout=30)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class HttpTransport(MasterTransport):
+    def __init__(self, addr: str):
+        self._base = f"http://{addr}"
+
+    def _post(self, path: str, payload: bytes) -> bytes:
+        req = _urlreq.Request(
+            self._base + path,
+            data=payload,
+            headers={"Content-Type": "application/msgpack"},
+        )
+        with _urlreq.urlopen(req, timeout=30) as resp:
+            return resp.read()
+
+    def get(self, payload: bytes) -> bytes:
+        return self._post("/get", payload)
+
+    def report(self, payload: bytes) -> bytes:
+        return self._post("/report", payload)
+
+
+class MasterClient:
+    """Typed control-plane client with retry. One per process (singleton)."""
+
+    _instance: Optional["MasterClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int = -1,
+        node_type: str = "worker",
+        service_type: str = "",
+        retries: int = 3,
+    ):
+        self.master_addr = master_addr
+        self.node_id = node_id
+        self.node_type = node_type
+        service_type = service_type or get_context().master_comms()
+        if service_type == CommsType.HTTP:
+            self._transport: MasterTransport = HttpTransport(master_addr)
+        else:
+            self._transport = GrpcTransport(master_addr)
+        self._retries = retries
+
+    # -- low-level verbs ---------------------------------------------------
+
+    def _wrap(self, message: Any) -> bytes:
+        req = comm.BaseRequest(
+            node_id=self.node_id, node_type=self.node_type, data=dumps(message)
+        )
+        return dumps(req)
+
+    def _call(self, verb: str, message: Any) -> Any:
+        payload = self._wrap(message)
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                fn = self._transport.get if verb == "get" else self._transport.report
+                raw = fn(payload)
+                resp = loads(raw)
+                if isinstance(resp, comm.BaseResponse):
+                    if not resp.success and resp.reason:
+                        logger.debug("master rejected %s: %s", verb, resp.reason)
+                    return loads(resp.data) if resp.data else resp
+                return resp
+            except Exception as e:  # noqa: BLE001 — transport errors retried
+                last_err = e
+                time.sleep(min(2**attempt, 5))
+        raise ConnectionError(
+            f"master {verb} failed after {self._retries} tries: {last_err!r}"
+        )
+
+    def get(self, message: Any) -> Any:
+        return self._call("get", message)
+
+    def report(self, message: Any) -> Any:
+        return self._call("report", message)
+
+    # -- kv store ----------------------------------------------------------
+
+    def kv_store_set(self, key: str, value: bytes) -> None:
+        self.report(comm.KeyValuePair(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> bytes:
+        resp = self.get(comm.KeyValueQuery(key=key))
+        return resp.value if isinstance(resp, comm.KeyValuePair) else b""
+
+    def kv_store_add(self, key: str, amount: int) -> int:
+        resp = self.get(comm.KeyValueAdd(key=key, amount=amount))
+        return int(resp.value.decode()) if resp.value else 0
+
+    def kv_store_multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        resp = self.get(comm.KeyValueMultiGet(keys=keys))
+        return resp.kvs if isinstance(resp, comm.KeyValueMultiPair) else {}
+
+    def kv_store_multi_set(self, kvs: Dict[str, bytes]) -> None:
+        self.report(comm.KeyValueMultiPair(kvs=kvs))
+
+    # -- rendezvous --------------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int,
+        rdzv_name: str,
+        node_ip: str = "",
+        slice_id: int = 0,
+    ) -> int:
+        resp = self.get(
+            comm.JoinRendezvousRequest(
+                node_id=self.node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_ip=node_ip,
+                slice_id=slice_id,
+            )
+        )
+        return resp.round if isinstance(resp, comm.JoinRendezvousResponse) else 0
+
+    def get_comm_world(self, rdzv_name: str) -> comm.CommWorldResponse:
+        return self.get(comm.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name))
+
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        resp = self.get(
+            comm.WaitingNodeNumRequest(node_id=self.node_id, rdzv_name=rdzv_name)
+        )
+        return resp.waiting_num if isinstance(resp, comm.WaitingNodeNumResponse) else 0
+
+    def network_ready(self) -> comm.NetworkReadyResponse:
+        return self.get(comm.NetworkReadyRequest(node_id=self.node_id))
+
+    def report_network_check_result(
+        self, normal: bool, elapsed_time: float, round: int = 0
+    ) -> None:
+        self.report(
+            comm.NetworkCheckResult(
+                node_id=self.node_id,
+                normal=normal,
+                elapsed_time=elapsed_time,
+                round=round,
+            )
+        )
+
+    def get_fault_nodes(self) -> List[int]:
+        resp = self.get(comm.FaultNodesRequest(node_id=self.node_id))
+        return resp.fault_nodes if isinstance(resp, comm.FaultNodesResponse) else []
+
+    def get_stragglers(self) -> List[int]:
+        resp = self.get(comm.StragglersRequest(node_id=self.node_id))
+        return resp.stragglers if isinstance(resp, comm.StragglersResponse) else []
+
+    # -- node lifecycle ----------------------------------------------------
+
+    def report_node_status(
+        self, status: str, exit_reason: str = "", restart_count: int = 0
+    ) -> None:
+        self.report(
+            comm.NodeStateRequest(
+                node_id=self.node_id,
+                node_type=self.node_type,
+                status=status,
+                exit_reason=exit_reason,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_failure(
+        self, error_data: str, level: str = "error", restart_count: int = 0
+    ) -> None:
+        self.report(
+            comm.NodeFailureReport(
+                node_id=self.node_id,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            )
+        )
+
+    def report_heartbeat(self) -> List[comm.DiagnosisActionMsg]:
+        resp = self.get(
+            comm.HeartbeatRequest(node_id=self.node_id, timestamp=time.time())
+        )
+        return resp.actions if isinstance(resp, comm.HeartbeatResponse) else []
+
+    def report_resource_usage(self, cpu_percent: float, memory_mb: float) -> None:
+        self.report(
+            comm.ResourceUsageReport(
+                node_id=self.node_id,
+                node_type=self.node_type,
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+            )
+        )
+
+    def report_training_step(
+        self, step: int, elapsed_s: float = 0.0, tokens_per_s: float = 0.0
+    ) -> None:
+        self.report(
+            comm.TrainingStepReport(
+                node_id=self.node_id,
+                step=step,
+                timestamp=time.time(),
+                elapsed_s=elapsed_s,
+                tokens_per_s=tokens_per_s,
+            )
+        )
+
+    # -- data shards -------------------------------------------------------
+
+    def report_dataset_params(self, params: comm.DatasetShardParams) -> None:
+        self.report(params)
+
+    def get_task(self, dataset_name: str) -> comm.TaskMsg:
+        return self.get(
+            comm.TaskRequest(node_id=self.node_id, dataset_name=dataset_name)
+        )
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, success: bool = True, reason: str = ""
+    ) -> None:
+        self.report(
+            comm.TaskResult(
+                node_id=self.node_id,
+                dataset_name=dataset_name,
+                task_id=task_id,
+                success=success,
+                reason=reason,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self.get(comm.ShardCheckpointRequest(dataset_name=dataset_name))
+        return resp.content if isinstance(resp, comm.ShardCheckpointMsg) else ""
+
+    def restore_shard_checkpoint(self, dataset_name: str, content: str) -> None:
+        self.report(
+            comm.ShardCheckpointMsg(dataset_name=dataset_name, content=content)
+        )
+
+    # -- checkpoint sync ---------------------------------------------------
+
+    def sync_checkpoint(self, step: int) -> bool:
+        resp = self.get(comm.CheckpointStepSync(node_id=self.node_id, step=step))
+        return resp.success if isinstance(resp, comm.CheckpointStepSyncResponse) else False
+
+    # -- pre-check / job status -------------------------------------------
+
+    def get_pre_check_result(self) -> comm.PreCheckResponse:
+        return self.get(comm.PreCheckRequest(node_id=self.node_id))
+
+    def get_job_status(self) -> comm.JobStatusResponse:
+        return self.get(comm.JobStatusRequest(node_id=self.node_id))
+
+    def get_paral_config(self) -> comm.ParallelConfig:
+        return self.get(comm.ParallelConfigRequest(node_id=self.node_id))
+
+    def get_elastic_run_config(self) -> Dict[str, str]:
+        resp = self.get(comm.ElasticRunConfigRequest(node_id=self.node_id))
+        return resp.configs if isinstance(resp, comm.ElasticRunConfigResponse) else {}
+
+    def report_event(self, event_type: str, instance: str, action: str, msg: str = "") -> None:
+        self.report(
+            comm.EventReport(
+                event_type=event_type,
+                instance=instance,
+                action=action,
+                msg=msg,
+                timestamp=time.time(),
+            )
+        )
+
+    # -- sync barriers -----------------------------------------------------
+
+    def join_sync(self, sync_name: str, node_rank: int = -1) -> bool:
+        resp = self.get(
+            comm.SyncJoin(sync_name=sync_name, node_id=self.node_id, node_rank=node_rank)
+        )
+        return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
+
+    def sync_finished(self, sync_name: str) -> bool:
+        resp = self.get(comm.SyncFinish(sync_name=sync_name))
+        return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
+
+    # -- singleton ---------------------------------------------------------
+
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    addr = os.getenv(NodeEnv.MASTER_ADDR, "")
+                    if not addr:
+                        raise RuntimeError(
+                            f"{NodeEnv.MASTER_ADDR} not set; no master to talk to"
+                        )
+                    cls._instance = cls(
+                        master_addr=addr,
+                        node_id=int(os.getenv(NodeEnv.NODE_ID, "0")),
+                        service_type=os.getenv(NodeEnv.MASTER_SERVICE_TYPE, ""),
+                    )
+        return cls._instance
+
+    @classmethod
+    def reset_singleton(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance._transport.close()
+            cls._instance = None

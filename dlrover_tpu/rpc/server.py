@@ -1,0 +1,131 @@
+"""Control-plane RPC servers: gRPC (default) and HTTP backends.
+
+Re-creates the reference's 2-verb transport
+(``dlrover/proto/elastic_training.proto:26-29`` — ``report`` and ``get``)
+without protoc: both verbs carry opaque msgpack bytes
+(:mod:`dlrover_tpu.common.serialize`), so the wire contract is one generic
+gRPC service registered via ``method_handlers_generic_handler`` plus an
+equivalent HTTP/1.1 POST surface (reference: ``servicer.py:846,926``).
+
+This channel is the *control plane* over DCN — entirely separate from the
+ICI/XLA-collective data plane.
+"""
+
+import threading
+from concurrent import futures
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import grpc
+
+from ..common.constants import GRPC, CommsType
+from ..common.log import logger
+
+SERVICE_NAME = "dlrover_tpu.MasterService"
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+class ServicerApi:
+    """What a master servicer must implement (see master/servicer.py)."""
+
+    def get(self, request_bytes: bytes) -> bytes:
+        raise NotImplementedError
+
+    def report(self, request_bytes: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class GrpcMasterServer:
+    def __init__(self, servicer: ServicerApi, port: int = 0, host: str = "0.0.0.0"):
+        self._servicer = servicer
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=64),
+            options=[
+                ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+            ],
+        )
+        handlers = {
+            "get": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._servicer.get(req),
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+            "report": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._servicer.report(req),
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("gRPC master server listening on :%s", self.port)
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+class _HttpHandler(BaseHTTPRequestHandler):
+    servicer: ServicerApi = None  # set per-server subclass
+
+    def do_POST(self):  # noqa: N802 — http.server API
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            if self.path == "/get":
+                out = self.servicer.get(body)
+            elif self.path == "/report":
+                out = self.servicer.report(body)
+            else:
+                self.send_error(404)
+                return
+        except Exception as e:  # noqa: BLE001
+            logger.warning("http servicer error: %r", e)
+            self.send_error(500, repr(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/msgpack")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class HttpMasterServer:
+    def __init__(self, servicer: ServicerApi, port: int = 0, host: str = "0.0.0.0"):
+        handler_cls = type("Handler", (_HttpHandler,), {"servicer": servicer})
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-master", daemon=True
+        )
+        self._thread.start()
+        logger.info("HTTP master server listening on :%s", self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def create_master_server(
+    servicer: ServicerApi, service_type: str = CommsType.GRPC, port: int = 0
+) -> Tuple[object, int]:
+    """Factory (reference: ``create_master_service``). Returns (server, port)."""
+    if service_type == CommsType.HTTP:
+        server = HttpMasterServer(servicer, port)
+    else:
+        server = GrpcMasterServer(servicer, port)
+    return server, server.port

@@ -1,0 +1,205 @@
+"""Master components + full RPC round-trips against a live LocalJobMaster."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    CommsType,
+    JobStage,
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.master.diagnosis.action import (
+    DiagnosisActionType,
+    NodeAction,
+)
+from dlrover_tpu.master.job_context import JobContext
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.master.shard.dataset_splitter import (
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+from dlrover_tpu.master.shard.task_manager import DatasetManager, TaskManager
+from dlrover_tpu.rpc.client import MasterClient
+
+
+class TestDatasetSplitting:
+    def test_table_splitter(self):
+        splitter = TableDatasetSplitter("ds", dataset_size=103, shard_size=10)
+        shards = splitter.create_shards()
+        assert len(shards) == 11
+        assert shards[-1].size == 3
+        assert sum(s.size for s in shards) == 103
+
+    def test_text_splitter_shuffle(self):
+        splitter = TextDatasetSplitter(
+            "ds", dataset_size=20, shard_size=5, shuffle=True, seed=42
+        )
+        shards = splitter.create_shards()
+        all_indices = [i for s in shards for i in s.record_indices]
+        assert sorted(all_indices) == list(range(20))
+        assert all_indices != list(range(20))  # actually shuffled
+
+    def test_task_redelivery_on_node_death(self):
+        splitter = TableDatasetSplitter("ds", dataset_size=40, shard_size=10)
+        mgr = DatasetManager("ds", splitter)
+        t1 = mgr.get_task(node_id=0)
+        t2 = mgr.get_task(node_id=1)
+        assert t1.task_id != t2.task_id
+        mgr.report_task_status(t1.task_id, success=True)
+        # node 1 dies with t2 in flight → t2 requeued first
+        assert mgr.recover_tasks_of_node(1) == 1
+        t3 = mgr.get_task(node_id=0)
+        assert t3.shard.start == t2.shard.start
+
+    def test_completion_after_epochs(self):
+        splitter = TableDatasetSplitter("ds", dataset_size=10, shard_size=10, num_epochs=2)
+        mgr = DatasetManager("ds", splitter)
+        for _ in range(2):
+            task = mgr.get_task(0)
+            mgr.report_task_status(task.task_id, success=True)
+        assert mgr.get_task(0).task_id == -1
+        assert mgr.completed()
+
+    def test_shard_checkpoint_roundtrip(self):
+        splitter = TableDatasetSplitter("ds", dataset_size=30, shard_size=10)
+        mgr = DatasetManager("ds", splitter)
+        t = mgr.get_task(0)  # in-flight
+        content = mgr.checkpoint()
+        # Fresh manager restores: the in-flight shard must come back
+        splitter2 = TableDatasetSplitter("ds", dataset_size=30, shard_size=10)
+        mgr2 = DatasetManager("ds", splitter2)
+        mgr2.restore_checkpoint(content)
+        restored_first = mgr2.get_task(0)
+        assert restored_first.shard.start == t.shard.start
+        starts = {restored_first.shard.start}
+        while True:
+            task = mgr2.get_task(0)
+            if task.task_id == -1:
+                break
+            starts.add(task.shard.start)
+        assert starts == {0, 10, 20}
+
+
+@pytest.fixture(params=[CommsType.GRPC, CommsType.HTTP])
+def live_master(request):
+    master = LocalJobMaster(
+        num_workers=2, service_type=request.param, fresh_context=True
+    )
+    master.prepare()
+    yield master
+    master.stop()
+    JobContext.reset()
+
+
+def _client(master, node_id):
+    return MasterClient(
+        master_addr=master.addr,
+        node_id=node_id,
+        service_type=(
+            CommsType.HTTP if "Http" in type(master._server).__name__ else CommsType.GRPC
+        ),
+    )
+
+
+class TestMasterRpcRoundtrip:
+    def test_kv_store(self, live_master):
+        c = _client(live_master, 0)
+        c.kv_store_set("k1", b"v1")
+        assert c.kv_store_get("k1") == b"v1"
+        assert c.kv_store_get("missing") == b""
+        assert c.kv_store_add("cnt", 3) == 3
+        assert c.kv_store_add("cnt", 2) == 5
+        c.kv_store_multi_set({"a": b"1", "b": b"2"})
+        assert c.kv_store_multi_get(["a", "b"]) == {"a": b"1", "b": b"2"}
+
+    def test_two_agents_complete_rendezvous(self, live_master):
+        c0, c1 = _client(live_master, 0), _client(live_master, 1)
+        c0.join_rendezvous(0, 4, RendezvousName.TRAINING, node_ip="10.0.0.1")
+        resp = c0.get_comm_world(RendezvousName.TRAINING)
+        assert resp.world == {}
+        c1.join_rendezvous(1, 4, RendezvousName.TRAINING, node_ip="10.0.0.2")
+        resp = c0.get_comm_world(RendezvousName.TRAINING)
+        assert len(resp.world) == 2
+        assert resp.world[0].addr == "10.0.0.1"
+        assert resp.world[1].addr == "10.0.0.2"
+
+    def test_node_status_and_heartbeat_actions(self, live_master):
+        c0 = _client(live_master, 0)
+        c0.report_node_status(NodeStatus.RUNNING)
+        # Master queues a restart action for this node
+        live_master.servicer._job_ctx.node_actions.add_action(
+            NodeAction(node_id=0, action_type=DiagnosisActionType.RESTART_WORKER)
+        )
+        actions = c0.report_heartbeat()
+        assert len(actions) == 1
+        assert actions[0].config["action_type"] == DiagnosisActionType.RESTART_WORKER
+        # Drained: next heartbeat is empty
+        assert c0.report_heartbeat() == []
+
+    def test_failed_worker_triggers_relaunch_action(self, live_master):
+        c0 = _client(live_master, 0)
+        c0.report_node_status(NodeStatus.RUNNING)
+        c0.report_node_status(NodeStatus.FAILED, exit_reason="killed")
+        actions = c0.report_heartbeat()
+        assert any(
+            a.config["action_type"] == DiagnosisActionType.RELAUNCH_WORKER
+            for a in actions
+        )
+
+    def test_task_flow_over_rpc(self, live_master):
+        c = _client(live_master, 0)
+        c.report_dataset_params(
+            comm.DatasetShardParams(
+                batch_size=5,
+                num_minibatches_per_shard=2,
+                dataset_size=30,
+                dataset_name="train",
+            )
+        )
+        task = c.get_task("train")
+        assert task.task_id >= 0
+        assert task.shard.end - task.shard.start == 10
+        c.report_task_result("train", task.task_id, success=True)
+        ckpt = c.get_shard_checkpoint("train")
+        assert "train" in ckpt
+
+    def test_pre_check_and_job_status(self, live_master):
+        c = _client(live_master, 0)
+        assert c.get_pre_check_result().status == "passed"
+        assert c.get_job_status().stage == JobStage.RUNNING
+
+    def test_sync_barrier(self, live_master):
+        c0, c1 = _client(live_master, 0), _client(live_master, 1)
+        assert c0.join_sync("mesh_build")
+        assert c1.join_sync("mesh_build")
+        assert c0.sync_finished("mesh_build")
+
+    def test_training_step_report_feeds_perf_monitor(self, live_master):
+        c = _client(live_master, 0)
+        c.report_training_step(step=10)
+        time.sleep(0.05)
+        c.report_training_step(step=20)
+        step, _ = live_master.perf_monitor.last_step()
+        assert step == 20
+        assert live_master.perf_monitor.steps_per_second() > 0
+
+
+class TestMasterSupervision:
+    def test_job_exits_when_all_workers_succeed(self):
+        master = LocalJobMaster(num_workers=1, fresh_context=True)
+        master.prepare()
+        master.run_in_background()
+        try:
+            c = _client(master, 0)
+            c.report_node_status(NodeStatus.RUNNING)
+            c.report_node_status(NodeStatus.SUCCEEDED)
+            deadline = time.time() + 10
+            while time.time() < deadline and not master.exit_reason:
+                time.sleep(0.2)
+            assert master.exit_reason == "succeeded"
+        finally:
+            master.stop()
+            JobContext.reset()

@@ -1,0 +1,203 @@
+"""Rendezvous manager matrices (reference test model: test_rdzv_manager.py)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.rdzv.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+
+
+def _meta(rank, addr="", slice_id=0):
+    return comm.NodeMeta(
+        node_id=rank, node_rank=rank, process_unit=1, addr=addr, slice_id=slice_id
+    )
+
+
+class TestElasticTrainingRendezvous:
+    def test_completes_at_max_nodes(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=3, waiting_timeout=60, node_unit=1)
+        for r in range(3):
+            m.join_rendezvous(_meta(r, addr=f"10.0.0.{r}"))
+        round_, group, world = m.get_comm_world(0)
+        assert len(world) == 3
+        assert group == 0
+        # process ids are dense 0..n-1 in sorted node order
+        assert sorted(world) == [0, 1, 2]
+        assert world[0].addr == "10.0.0.0"
+
+    def test_incomplete_below_min(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=4, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0))
+        _, _, world = m.get_comm_world(0)
+        assert world == {}
+
+    def test_lastcall_timeout_completes_at_min(self, monkeypatch):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=4, waiting_timeout=60, node_unit=1)
+        m._lastcall_timeout = 0.2
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.join_rendezvous(_meta(2))
+        _, _, world = m.get_comm_world(0)
+        assert world == {}  # still inside last-call window
+        time.sleep(0.3)
+        _, _, world = m.get_comm_world(0)
+        assert len(world) == 3
+
+    def test_node_unit_truncation(self):
+        """5 nodes with node_unit=2 → only 4 admitted (slice granularity)."""
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=8, waiting_timeout=60, node_unit=2)
+        m._lastcall_timeout = 0.1
+        for r in range(5):
+            m.join_rendezvous(_meta(r))
+        time.sleep(0.2)
+        _, _, world = m.get_comm_world(0)
+        assert len(world) == 4
+        # The 5th node is still waiting for the next round
+        assert m.num_nodes_waiting() == 0  # 1 < node_unit and not a member
+
+    def test_waiting_triggers_on_rejoin(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=1, max_nodes=2, waiting_timeout=60, node_unit=2)
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        _, _, world = m.get_comm_world(0)
+        assert len(world) == 2
+        # A member of the last world re-joins after crash → restart signal
+        m.join_rendezvous(_meta(1))
+        assert m.num_nodes_waiting() == 1
+
+    def test_waiting_requires_node_unit_for_new_nodes(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=1, max_nodes=8, waiting_timeout=60, node_unit=4)
+        m._lastcall_timeout = 0.1
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.join_rendezvous(_meta(2))
+        m.join_rendezvous(_meta(3))
+        time.sleep(0.2)
+        _, _, world = m.get_comm_world(0)
+        assert len(world) == 4
+        # 2 new nodes < node_unit → no restart yet
+        m.join_rendezvous(_meta(4))
+        m.join_rendezvous(_meta(5))
+        assert m.num_nodes_waiting() == 0
+        m.join_rendezvous(_meta(6))
+        m.join_rendezvous(_meta(7))
+        assert m.num_nodes_waiting() == 4
+
+    def test_dead_node_removed_from_waiting(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=3, max_nodes=3, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.remove_alive_node(1)
+        m.join_rendezvous(_meta(2))
+        _, _, world = m.get_comm_world(0)
+        assert world == {}  # only 2 waiting after removal
+
+    def test_topology_sort_groups_slices(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0, slice_id=1))
+        m.join_rendezvous(_meta(1, slice_id=0))
+        m.join_rendezvous(_meta(2, slice_id=1))
+        m.join_rendezvous(_meta(3, slice_id=0))
+        _, _, world = m.get_comm_world(0)
+        # slice 0 hosts get the lower process ids (contiguous ICI domains)
+        assert [world[i].slice_id for i in range(4)] == [0, 0, 1, 1]
+
+    def test_ckpt_sync(self):
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.get_comm_world(0)
+        assert not m.sync_ckpt_nodes(0, step=100)
+        assert m.sync_ckpt_nodes(1, step=100)
+        # Mismatched step resets
+        assert not m.sync_ckpt_nodes(0, step=200)
+        assert not m.sync_ckpt_nodes(1, step=100)
+
+
+class TestNetworkCheckRendezvous:
+    def _complete(self, m, n):
+        m.update_rdzv_params(min_nodes=n, max_nodes=n, waiting_timeout=60, node_unit=1)
+        for r in range(n):
+            m.join_rendezvous(_meta(r))
+
+    def test_adjacent_pairs_round0(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 4)
+        _, g0, w0 = m.get_comm_world(0)
+        _, g1, w1 = m.get_comm_world(1)
+        assert g0 == g1
+        assert {meta.node_rank for meta in w0.values()} == {0, 1}
+        _, g2, w2 = m.get_comm_world(2)
+        assert {meta.node_rank for meta in w2.values()} == {2, 3}
+
+    def test_fastest_slowest_pairing_round1(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 4)
+        m.get_comm_world(0)
+        times = {0: 1.0, 1: 8.0, 2: 2.0, 3: 3.0}
+        for n, t in times.items():
+            m.report_network_check_result(n, True, t)
+        m.next_check_round()
+        _, _, w = m.get_comm_world(0)
+        # Fastest (0) paired with slowest (1)
+        assert {meta.node_rank for meta in w.values()} == {0, 1}
+        _, _, w2 = m.get_comm_world(2)
+        assert {meta.node_rank for meta in w2.values()} == {2, 3}
+
+    def test_fault_isolation_two_rounds(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 4)
+        m.get_comm_world(0)
+        # Round 0: pair (0,1) both fail because node 1 is bad
+        m.report_network_check_result(0, False, 1.0)
+        m.report_network_check_result(1, False, 1.0)
+        m.report_network_check_result(2, True, 1.0)
+        m.report_network_check_result(3, True, 1.0)
+        fault, _ = m.check_fault_node()
+        assert set(fault) == {0, 1}
+        m.next_check_round()
+        # Round 1: different pairing exonerates node 0
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, False, 1.0)
+        m.report_network_check_result(2, True, 1.0)
+        m.report_network_check_result(3, False, 1.0)
+        fault, _ = m.check_fault_node()
+        assert fault == [1]
+
+    def test_straggler_detection(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 4)
+        m.get_comm_world(0)
+        for n, t in {0: 1.0, 1: 1.1, 2: 0.9, 3: 5.0}.items():
+            m.report_network_check_result(n, True, t)
+        assert m.detect_stragglers() == [3]
+
+    def test_network_ready_when_all_report(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 2)
+        m.get_comm_world(0)
+        ready, _ = m.network_ready()
+        assert not ready
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
+        ready, _ = m.network_ready()
+        assert ready
+
+    def test_odd_node_count(self):
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 3)
+        _, _, w = m.get_comm_world(2)
+        assert {meta.node_rank for meta in w.values()} == {2}

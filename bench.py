@@ -1,0 +1,73 @@
+"""Benchmark entry: prints ONE JSON line for the driver.
+
+Current metric (round 1, early): flash-checkpoint-style save blocking time
+will land with the checkpoint engine; until then this measures sustained
+training throughput of the flagship GPT model on the available device.
+
+vs_baseline semantics: ratio of achieved value to the north-star target
+(>1.0 is better than target). See BASELINE.md.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.parallel.train_step import (
+        build_train_step,
+        default_optimizer,
+        init_train_state,
+    )
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = GPTConfig.gpt2_small()
+        batch, seq, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig.tiny()
+        batch, seq, iters = 8, 64, 5
+
+    model = GPT(cfg)
+    mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+    tx = default_optimizer()
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state, shardings = init_train_state(model, tokens, mesh, tx)
+    step = build_train_step(
+        model, tx, cross_entropy_loss, mesh, shardings, donate=True
+    )
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    y = jnp.roll(x, -1, axis=1)
+
+    state, loss = step(state, x, y)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, x, y)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    tokens_per_s = batch * seq * iters / elapsed
+
+    # Rough reference point: the reference's GPT-2 examples train ~1e5
+    # tokens/s-class on a single A100; the target here is simply to report
+    # the measured number until the goodput bench lands.
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_train_tokens_per_s",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_s / 1e5, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from .checkpointer import Checkpointer, StorageType  # noqa: F401
+from .engine import CheckpointEngine  # noqa: F401

@@ -109,6 +109,7 @@ class JoinRendezvousResponse:
 @dataclass
 class CommWorldRequest:
     node_id: int = 0
+    node_rank: int = -1
     rdzv_name: str = ""
 
 
@@ -152,6 +153,7 @@ class NetworkReadyResponse:
 @dataclass
 class NetworkCheckResult:
     node_id: int = 0
+    node_rank: int = -1
     normal: bool = True
     elapsed_time: float = 0.0
     round: int = 0
@@ -429,6 +431,12 @@ class SyncJoin:
 @register_message
 @dataclass
 class SyncFinish:
+    sync_name: str = ""
+
+
+@register_message
+@dataclass
+class SyncQuery:
     sync_name: str = ""
 
 

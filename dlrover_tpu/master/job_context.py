@@ -31,6 +31,10 @@ class JobContext:
         self.total_downtime_s = 0.0  # accumulated not-training time (goodput)
         self.last_training_step = 0
         self.last_step_time = 0.0
+        # Tunables the master pushes to trainers (reference: paral config
+        # tuner + elastic run config merge).
+        self.paral_config = None  # comm.ParallelConfig, set by auto-tuner
+        self.elastic_run_config: Dict[str, str] = {}
 
     # -- nodes -------------------------------------------------------------
 

@@ -73,7 +73,7 @@ class LocalJobMaster:
         }
         self.task_manager = TaskManager()
         self.kv_store = KVStoreService()
-        self.sync_service = SyncService()
+        self.sync_service = SyncService(default_expected=num_workers)
         self.perf_monitor = PerfMonitor()
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
@@ -126,6 +126,9 @@ class LocalJobMaster:
                     else:
                         self._exit(JobExitReason.FATAL_ERROR)
                     return
+                slow = self.task_manager.recover_timeout_tasks()
+                if slow:
+                    logger.warning("recovered timed-out tasks from nodes %s", slow)
                 if self.task_manager.finished():
                     logger.info("all dataset tasks completed")
             except Exception:

@@ -77,6 +77,9 @@ class RendezvousManager:
     def add_alive_node(self, node_rank: int) -> None:
         pass  # membership is driven by joins; hook for the job manager
 
+    def _on_new_wave(self) -> None:
+        """Hook: called (lock held) when a join invalidates the old world."""
+
     def remove_alive_node(self, node_rank: int) -> None:
         """A node died: drop it from any pending rendezvous so completion
         logic doesn't wait on a ghost (reference rdzv_manager.py:239)."""
@@ -88,8 +91,17 @@ class RendezvousManager:
                 )
 
     def join_rendezvous(self, meta: comm.NodeMeta) -> int:
-        """A host asks to join the next round (reference :280-337)."""
+        """A host asks to join the next round (reference :280-337).
+
+        Joining invalidates the previously completed world: a new round is
+        forming, and get_comm_world must block (return empty) until it
+        completes — otherwise the elastic restart cycle would hand agents
+        the stale world forever after a fault.
+        """
         with self._lock:
+            if self._rdzv_nodes:
+                self._rdzv_nodes = {}
+                self._on_new_wave()
             if not self._waiting_nodes:
                 self._start_rdzv_time = time.time()
             self._waiting_nodes[meta.node_rank] = meta
@@ -152,7 +164,7 @@ class RendezvousManager:
         )
 
     def get_comm_world(
-        self, node_id: int
+        self, node_rank: int
     ) -> Tuple[int, int, Dict[int, comm.NodeMeta]]:
         """Poll for the completed world. Returns (round, group, world);
         world is empty until the rendezvous completes. Ranks (process ids)
@@ -222,7 +234,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._group_cache: Dict[int, List[List[int]]] = {}
 
     def get_comm_world(
-        self, node_id: int
+        self, node_rank: int
     ) -> Tuple[int, int, Dict[int, comm.NodeMeta]]:
         with self._lock:
             if not self._rdzv_nodes:
@@ -233,7 +245,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 return self._rdzv_round, 0, {}
             groups = self._group_nodes(self._check_round)
             for group_idx, group in enumerate(groups):
-                if node_id in group:
+                if node_rank in group:
                     world = {}
                     for process_id, rank in enumerate(sorted(group)):
                         world[process_id] = self._rdzv_nodes[rank]
@@ -277,15 +289,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._node_times.setdefault(self._check_round, {})[node_id] = elapsed
             self._node_status.setdefault(self._check_round, {})[node_id] = normal
 
-    def join_rendezvous(self, meta: comm.NodeMeta) -> int:
-        with self._lock:
-            round_now = self._rdzv_round
-        result = super().join_rendezvous(meta)
-        with self._lock:
-            # A fresh join wave starts a new check round pair (0, 1, 0, ...)
-            if self._rdzv_nodes and meta.node_rank not in self._rdzv_nodes:
-                pass
-        return result
+    def _on_new_wave(self) -> None:
+        """A fresh join wave restarts the check-round pair (0, 1) and drops
+        results that belong to the previous world."""
+        self._check_round = 0
+        self._group_cache.clear()
+        self._node_times.clear()
+        self._node_status.clear()
 
     def next_check_round(self) -> int:
         with self._lock:

@@ -50,7 +50,11 @@ class MasterServicer:
         if handler is None:
             logger.warning("no get handler for %s", type(message).__name__)
             return dumps(comm.BaseResponse(success=False, reason="unknown message"))
-        result = handler(self, message)
+        try:
+            result = handler(self, message)
+        except Exception as e:  # noqa: BLE001 — reported, not retried
+            logger.exception("get handler failed for %s", type(message).__name__)
+            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
         return dumps(comm.BaseResponse(success=True, data=dumps(result)))
 
     def report(self, request_bytes: bytes) -> bytes:
@@ -101,7 +105,8 @@ class MasterServicer:
 
     def _get_comm_world(self, msg: comm.CommWorldRequest) -> comm.CommWorldResponse:
         manager = self._rdzv_managers[msg.rdzv_name or RendezvousName.TRAINING]
-        round_, group, world = manager.get_comm_world(msg.node_id)
+        rank = msg.node_rank if msg.node_rank >= 0 else msg.node_id
+        round_, group, world = manager.get_comm_world(rank)
         return comm.CommWorldResponse(
             rdzv_name=manager.name, round=round_, group=group, world=world
         )
@@ -120,7 +125,8 @@ class MasterServicer:
     def _report_network_check(self, msg: comm.NetworkCheckResult) -> None:
         manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         if manager is not None:
-            manager.report_network_check_result(msg.node_id, msg.normal, msg.elapsed_time)
+            rank = msg.node_rank if msg.node_rank >= 0 else msg.node_id
+            manager.report_network_check_result(rank, msg.normal, msg.elapsed_time)
 
     def _fault_nodes(self, msg: comm.FaultNodesRequest) -> comm.FaultNodesResponse:
         manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
@@ -141,9 +147,14 @@ class MasterServicer:
         self._job_manager.update_node_status(
             msg.node_id, msg.node_type or "worker", msg.status, msg.exit_reason
         )
-        for manager in self._rdzv_managers.values():
-            if msg.status in ("failed", "succeeded", "deleted"):
-                manager.remove_alive_node(msg.node_id)
+        if msg.status in ("failed", "succeeded", "deleted"):
+            # Rendezvous structures are keyed by node_rank; a relaunched
+            # host keeps its rank even when the platform gives it a new id.
+            node = self._job_ctx.get_node(msg.node_type or "worker", msg.node_id)
+            rank = node.rank_index if node is not None and node.rank_index >= 0 else msg.node_id
+            for manager in self._rdzv_managers.values():
+                manager.remove_alive_node(rank)
+            self._task_manager.recover_tasks(msg.node_id)
 
     def _node_failure(self, msg: comm.NodeFailureReport) -> None:
         self._job_manager.handle_failure_report(
@@ -215,13 +226,12 @@ class MasterServicer:
         )
 
     def _paral_config(self, msg: comm.ParallelConfigRequest) -> comm.ParallelConfig:
-        return self._job_ctx.__dict__.setdefault(
-            "paral_config", comm.ParallelConfig()
-        )
+        return self._job_ctx.paral_config or comm.ParallelConfig()
 
     def _run_config(self, msg: comm.ElasticRunConfigRequest) -> comm.ElasticRunConfigResponse:
-        configs = self._job_ctx.__dict__.get("elastic_run_config", {})
-        return comm.ElasticRunConfigResponse(configs=dict(configs))
+        return comm.ElasticRunConfigResponse(
+            configs=dict(self._job_ctx.elastic_run_config)
+        )
 
     def _event_report(self, msg: comm.EventReport) -> None:
         logger.info(
@@ -237,6 +247,11 @@ class MasterServicer:
     def _sync_join(self, msg: comm.SyncJoin) -> comm.SyncQueryResponse:
         return comm.SyncQueryResponse(
             success=self._sync_service.join(msg.sync_name, msg.node_id)
+        )
+
+    def _sync_query(self, msg: comm.SyncQuery) -> comm.SyncQueryResponse:
+        return comm.SyncQueryResponse(
+            success=self._sync_service.is_finished(msg.sync_name)
         )
 
     def _sync_finish(self, msg: comm.SyncFinish) -> comm.SyncQueryResponse:
@@ -262,6 +277,7 @@ class MasterServicer:
         comm.ParallelConfigRequest: _paral_config,
         comm.ElasticRunConfigRequest: _run_config,
         comm.SyncJoin: _sync_join,
+        comm.SyncQuery: _sync_query,
         comm.SyncFinish: _sync_finish,
     }
 

@@ -1,27 +1,43 @@
-"""Named barrier service for worker groups (reference: sync_service.py:25)."""
+"""Named barrier service for worker groups (reference: sync_service.py:25).
+
+Protocol: every member calls ``join``; the barrier finishes when
+``expected`` members have joined (``expected`` defaults to the job's
+worker count, settable per barrier). Members then poll ``is_finished``.
+``finish`` force-completes a barrier (master/admin path).
+"""
 
 import threading
 from typing import Dict, Set
 
 
 class SyncService:
-    def __init__(self):
+    def __init__(self, default_expected: int = 0):
         self._lock = threading.Lock()
         self._syncs: Dict[str, Set[int]] = {}
+        self._expected: Dict[str, int] = {}
         self._finished: Set[str] = set()
-        self._expected = 0  # 0 → any positive count finishes on explicit finish
+        self._default_expected = default_expected
 
-    def set_expected(self, count: int) -> None:
+    def set_default_expected(self, count: int) -> None:
         with self._lock:
-            self._expected = count
+            self._default_expected = count
+
+    def set_expected(self, sync_name: str, count: int) -> None:
+        with self._lock:
+            self._expected[sync_name] = count
+            self._maybe_finish(sync_name)
 
     def join(self, sync_name: str, node_id: int) -> bool:
+        """Register a member; returns True if the barrier is now finished."""
         with self._lock:
-            members = self._syncs.setdefault(sync_name, set())
-            members.add(node_id)
-            if self._expected and len(members) >= self._expected:
-                self._finished.add(sync_name)
-            return True
+            self._syncs.setdefault(sync_name, set()).add(node_id)
+            self._maybe_finish(sync_name)
+            return sync_name in self._finished
+
+    def _maybe_finish(self, sync_name: str) -> None:
+        expected = self._expected.get(sync_name, self._default_expected)
+        if expected > 0 and len(self._syncs.get(sync_name, ())) >= expected:
+            self._finished.add(sync_name)
 
     def finish(self, sync_name: str) -> bool:
         with self._lock:

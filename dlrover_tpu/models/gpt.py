@@ -1,0 +1,238 @@
+"""GPT-style decoder-only transformer, TPU-first.
+
+Flagship model family for the runtime (the reference's headline workloads
+are GPT-2/GLM elastic jobs — e.g. ``examples/pytorch/gpt``). Written for
+the MXU: bf16 activations, fp32 params/optimizer, matmul-heavy blocks,
+logical-axis annotations everywhere so the same module runs 1-chip or
+pjit over any dp/fsdp/tp/sp mesh. No data-dependent Python control flow —
+everything traces once.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    embed_dim: int = 768
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_remat: bool = True  # jax.checkpoint each block: HBM for FLOPs
+    use_flash_attention: bool = False  # pallas kernel from dlrover_tpu.ops
+    tie_embeddings: bool = True
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.mlp_ratio * self.embed_dim
+
+    @staticmethod
+    def tiny() -> "GPTConfig":
+        return GPTConfig(
+            vocab_size=256,
+            max_seq_len=128,
+            num_layers=2,
+            num_heads=4,
+            head_dim=8,
+            embed_dim=32,
+            use_remat=False,
+        )
+
+    @staticmethod
+    def gpt2_small() -> "GPTConfig":
+        return GPTConfig(num_layers=12, num_heads=12, head_dim=64, embed_dim=768)
+
+    @staticmethod
+    def gpt2_xl() -> "GPTConfig":
+        return GPTConfig(num_layers=48, num_heads=25, head_dim=64, embed_dim=1600)
+
+
+def _constrain(x, *axes):
+    from ..parallel.sharding import with_logical_constraint
+
+    return with_logical_constraint(x, *axes)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        B, T, D = x.shape
+        H, Hd = cfg.num_heads, cfg.head_dim
+
+        wqkv = param_with_axes(
+            "wqkv",
+            nn.initializers.normal(0.02),
+            (D, 3, H, Hd),
+            cfg.param_dtype,
+            axes=("embed", None, "heads", "kv"),
+        )
+        wo = param_with_axes(
+            "wo",
+            nn.initializers.normal(0.02 / jnp.sqrt(2 * cfg.num_layers)),
+            (H, Hd, D),
+            cfg.param_dtype,
+            axes=("heads", "kv", "embed"),
+        )
+        qkv = jnp.einsum("btd,dchk->cbthk", x, wqkv.astype(cfg.dtype))
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        q = _constrain(q, "batch", "seq", "heads", "kv")
+        k = _constrain(k, "batch", "seq", "heads", "kv")
+        v = _constrain(v, "batch", "seq", "heads", "kv")
+
+        if cfg.use_flash_attention:
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / jnp.sqrt(Hd).astype(cfg.dtype)
+            logits = jnp.einsum("bqhk,bshk->bhqs", q, k) * scale
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            logits = jnp.where(mask[None, None, :, :], logits, -1e9)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqs,bshk->bqhk", probs, v)
+        out = _constrain(out, "batch", "seq", "heads", "kv")
+        y = jnp.einsum("bqhk,hkd->bqd", out, wo.astype(cfg.dtype))
+        return _constrain(y, "batch", "seq", "embed")
+
+
+class Mlp(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        D, F = cfg.embed_dim, cfg.mlp_dim
+        w1 = param_with_axes(
+            "w1",
+            nn.initializers.normal(0.02),
+            (D, F),
+            cfg.param_dtype,
+            axes=("embed", "mlp"),
+        )
+        b1 = param_with_axes(
+            "b1", nn.initializers.zeros, (F,), cfg.param_dtype, axes=("mlp",)
+        )
+        w2 = param_with_axes(
+            "w2",
+            nn.initializers.normal(0.02 / jnp.sqrt(2 * cfg.num_layers)),
+            (F, D),
+            cfg.param_dtype,
+            axes=("mlp", "embed"),
+        )
+        b2 = param_with_axes(
+            "b2", nn.initializers.zeros, (D,), cfg.param_dtype, axes=("embed",)
+        )
+        h = jnp.dot(x, w1.astype(cfg.dtype)) + b1.astype(cfg.dtype)
+        h = _constrain(h, "batch", "seq", "mlp")
+        h = jax.nn.gelu(h)
+        y = jnp.dot(h, w2.astype(cfg.dtype)) + b2.astype(cfg.dtype)
+        return _constrain(y, "batch", "seq", "embed")
+
+
+class LayerNorm(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        scale = param_with_axes(
+            "scale", nn.initializers.ones, (x.shape[-1],), cfg.param_dtype, axes=("norm",)
+        )
+        bias = param_with_axes(
+            "bias", nn.initializers.zeros, (x.shape[-1],), cfg.param_dtype, axes=("norm",)
+        )
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+        return (y * scale + bias).astype(cfg.dtype)
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        x = x + CausalSelfAttention(self.config)(
+            LayerNorm(self.config)(x), deterministic=deterministic
+        )
+        x = x + Mlp(self.config)(LayerNorm(self.config)(x))
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. ``__call__(tokens[B,T]) -> logits[B,T,V]``."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.config
+        B, T = tokens.shape
+        wte = param_with_axes(
+            "wte",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.embed_dim),
+            cfg.param_dtype,
+            axes=("vocab", "embed"),
+        )
+        wpe = param_with_axes(
+            "wpe",
+            nn.initializers.normal(0.01),
+            (cfg.max_seq_len, cfg.embed_dim),
+            cfg.param_dtype,
+            axes=(None, "embed"),
+        )
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[None, :T]
+        x = _constrain(x, "batch", "seq", "embed")
+
+        block = Block
+        if cfg.use_remat:
+            block = nn.remat(
+                Block,
+                prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x, deterministic=deterministic)
+        x = LayerNorm(cfg, name="ln_f")(x)
+
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
+        else:
+            w_lm = param_with_axes(
+                "lm_head",
+                nn.initializers.normal(0.02),
+                (cfg.embed_dim, cfg.vocab_size),
+                cfg.param_dtype,
+                axes=("embed", "vocab"),
+            )
+            logits = jnp.dot(x, w_lm.astype(cfg.dtype))
+        return _constrain(logits, "batch", "seq", "vocab")
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -1):
+    """Mean next-token CE in fp32 (MXU-friendly: one log_softmax fusion)."""
+    logits = logits.astype(jnp.float32)
+    mask = targets != ignore_index
+    safe_targets = jnp.where(mask, targets, 0)
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logps, safe_targets[..., None], axis=-1)[..., 0]
+    token_loss = jnp.where(mask, token_loss, 0.0)
+    return token_loss.sum() / jnp.maximum(mask.sum(), 1)

@@ -1,0 +1,60 @@
+"""Small MLP classifier — the elastic smoke-test workload.
+
+Reference parity: ``examples/pytorch/mnist`` is the reference's chaos-test
+job (fault_tolerance_exps.md). The same role here: a tiny model to drive
+end-to-end elastic runs and tests cheaply.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import partitioning as nn_partitioning
+
+param_with_axes = nn_partitioning.param_with_axes
+
+
+@dataclass(frozen=True)
+class MlpConfig:
+    input_dim: int = 784
+    hidden_dim: int = 512
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+class MnistMlp(nn.Module):
+    config: MlpConfig = MlpConfig()
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x.reshape((x.shape[0], -1)).astype(cfg.dtype)
+        w1 = param_with_axes(
+            "w1",
+            nn.initializers.lecun_normal(),
+            (cfg.input_dim, cfg.hidden_dim),
+            cfg.dtype,
+            axes=("embed", "mlp"),
+        )
+        b1 = param_with_axes(
+            "b1", nn.initializers.zeros, (cfg.hidden_dim,), cfg.dtype, axes=("mlp",)
+        )
+        w2 = param_with_axes(
+            "w2",
+            nn.initializers.lecun_normal(),
+            (cfg.hidden_dim, cfg.num_classes),
+            cfg.dtype,
+            axes=("mlp", None),
+        )
+        b2 = param_with_axes(
+            "b2", nn.initializers.zeros, (cfg.num_classes,), cfg.dtype, axes=(None,)
+        )
+        h = jax.nn.relu(jnp.dot(x, w1) + b1)
+        return jnp.dot(h, w2) + b2
+
+
+def classification_loss(logits, labels):
+    logps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logps, labels[:, None], axis=-1))

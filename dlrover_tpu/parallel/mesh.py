@@ -1,0 +1,125 @@
+"""Global device mesh construction and elastic re-meshing.
+
+TPU-first replacement for the reference's process-group world management
+(torch elastic re-creates NCCL groups on membership change; XLA worlds are
+static, so *every* membership change is a re-mesh). The mesh has five
+logical axes:
+
+  dp    pure data parallel (replicated params) — the elastic axis; on
+        multislice jobs this is the across-slice/DCN axis
+  fsdp  data parallel with sharded params/optimizer (ZeRO-style)
+  tp    tensor (model) parallel — ICI neighbors
+  sp    sequence/context parallel for long-context (ring attention)
+  pp    pipeline stages
+
+Axis sizes are chosen per elastic world size by :func:`choose_mesh_shape`,
+so a node join/leave maps to "rebuild mesh with new dp extent" while
+tp/sp/pp extents (ICI-bound) stay fixed.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Desired parallelism extents. -1 on dp/fsdp means "absorb remaining
+    devices" (at most one axis may be -1)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+
+    def axis_sizes(self) -> Tuple[int, int, int, int, int]:
+        return (self.dp, self.fsdp, self.tp, self.sp, self.pp)
+
+    def fixed_product(self) -> int:
+        return math.prod(s for s in self.axis_sizes() if s > 0)
+
+    def resolve(self, n_devices: int) -> "ResolvedMesh":
+        sizes = list(self.axis_sizes())
+        free = [i for i, s in enumerate(sizes) if s == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s > 0)
+        if free:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[free[0]] = n_devices // fixed
+        total = math.prod(sizes)
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(MESH_AXES, sizes))} needs {total} devices, "
+                f"have {n_devices}"
+            )
+        return ResolvedMesh(sizes=tuple(sizes))
+
+
+@dataclass(frozen=True)
+class ResolvedMesh:
+    sizes: Tuple[int, int, int, int, int]
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(zip(MESH_AXES, self.sizes))
+
+
+def build_mesh(
+    config: MeshConfig, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build the global mesh over all (or given) devices.
+
+    Device ordering: JAX returns devices grouped host-major on TPU, so
+    reshaping [dp, fsdp, tp, sp, pp] keeps tp/sp innermost → they land on
+    ICI neighbors within a host/slice, while dp spans hosts/slices (DCN
+    for multislice) — the layout the scaling recipe wants.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    resolved = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(resolved.sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def choose_mesh_shape(
+    n_devices: int,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    prefer_fsdp: bool = True,
+) -> MeshConfig:
+    """Pick dp/fsdp extents for an elastic world of ``n_devices``.
+
+    The ICI-bound extents (tp, sp, pp) are honored as given; the remaining
+    factor goes to fsdp (params sharded — memory-optimal) or dp.
+    Raises if n_devices is not divisible — the caller (master) must pick a
+    world size that is a multiple of the slice unit (= tp*sp*pp).
+    """
+    inner = tp * sp * pp
+    if n_devices % inner != 0:
+        raise ValueError(
+            f"world size {n_devices} not a multiple of tp*sp*pp={inner}"
+        )
+    outer = n_devices // inner
+    if prefer_fsdp:
+        return MeshConfig(dp=1, fsdp=outer, tp=tp, sp=sp, pp=pp)
+    return MeshConfig(dp=outer, fsdp=1, tp=tp, sp=sp, pp=pp)
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
+    """Per-data-shard batch size on the current mesh."""
+    data_extent = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % data_extent != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data extent {data_extent}"
+        )
+    return global_batch // data_extent

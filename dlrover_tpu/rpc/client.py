@@ -182,8 +182,14 @@ class MasterClient:
         )
         return resp.round if isinstance(resp, comm.JoinRendezvousResponse) else 0
 
-    def get_comm_world(self, rdzv_name: str) -> comm.CommWorldResponse:
-        return self.get(comm.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name))
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int = -1
+    ) -> comm.CommWorldResponse:
+        return self.get(
+            comm.CommWorldRequest(
+                node_id=self.node_id, node_rank=node_rank, rdzv_name=rdzv_name
+            )
+        )
 
     def num_nodes_waiting(self, rdzv_name: str) -> int:
         resp = self.get(
@@ -195,11 +201,12 @@ class MasterClient:
         return self.get(comm.NetworkReadyRequest(node_id=self.node_id))
 
     def report_network_check_result(
-        self, normal: bool, elapsed_time: float, round: int = 0
+        self, normal: bool, elapsed_time: float, round: int = 0, node_rank: int = -1
     ) -> None:
         self.report(
             comm.NetworkCheckResult(
                 node_id=self.node_id,
+                node_rank=node_rank,
                 normal=normal,
                 elapsed_time=elapsed_time,
                 round=round,
@@ -337,12 +344,18 @@ class MasterClient:
     # -- sync barriers -----------------------------------------------------
 
     def join_sync(self, sync_name: str, node_rank: int = -1) -> bool:
+        """Join a named barrier; True once the barrier is complete."""
         resp = self.get(
             comm.SyncJoin(sync_name=sync_name, node_id=self.node_id, node_rank=node_rank)
         )
         return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
 
     def sync_finished(self, sync_name: str) -> bool:
+        """Poll whether a named barrier has completed."""
+        resp = self.get(comm.SyncQuery(sync_name=sync_name))
+        return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
+
+    def force_finish_sync(self, sync_name: str) -> bool:
         resp = self.get(comm.SyncFinish(sync_name=sync_name))
         return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
 

@@ -173,7 +173,9 @@ class TestMasterRpcRoundtrip:
 
     def test_sync_barrier(self, live_master):
         c0, c1 = _client(live_master, 0), _client(live_master, 1)
-        assert c0.join_sync("mesh_build")
+        # Barrier of 2 (num_workers): incomplete until both join
+        assert not c0.join_sync("mesh_build")
+        assert not c0.sync_finished("mesh_build")
         assert c1.join_sync("mesh_build")
         assert c0.sync_finished("mesh_build")
 

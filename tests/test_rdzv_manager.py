@@ -201,3 +201,41 @@ class TestNetworkCheckRendezvous:
         self._complete(m, 3)
         _, _, w = m.get_comm_world(2)
         assert {meta.node_rank for meta in w.values()} == {2}
+
+
+class TestElasticCycle:
+    def test_second_round_completes_after_fault(self):
+        """Regression: the post-fault re-rendezvous must produce a NEW world
+        (the first implementation returned the stale round-0 world forever)."""
+        m = ElasticTrainingRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0, addr="a"))
+        m.join_rendezvous(_meta(1, addr="b"))
+        round0, _, world0 = m.get_comm_world(0)
+        assert len(world0) == 2 and round0 == 0
+        # Node 1 dies; both (replacement + survivor) re-join
+        m.join_rendezvous(_meta(1, addr="b2"))
+        # Old world invalidated immediately: agents must not get stale world
+        _, _, stale = m.get_comm_world(0)
+        assert stale == {}
+        m.join_rendezvous(_meta(0, addr="a"))
+        round1, _, world1 = m.get_comm_world(0)
+        assert round1 == 1
+        assert len(world1) == 2
+        assert world1[1].addr == "b2"
+
+    def test_network_check_state_reset_on_new_wave(self):
+        m = NetworkCheckRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.get_comm_world(0)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, False, 9.0)
+        m.next_check_round()
+        # New wave: previous world's results must not leak into the new one
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.get_comm_world(0)
+        assert m._check_round == 0
+        assert m._node_status == {}

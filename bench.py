@@ -1,72 +1,99 @@
 """Benchmark entry: prints ONE JSON line for the driver.
 
-Current metric (round 1, early): flash-checkpoint-style save blocking time
-will land with the checkpoint engine; until then this measures sustained
-training throughput of the flagship GPT model on the available device.
+Headline metric (BASELINE.md north star): flash-checkpoint save blocking
+time — the seconds training is stalled per checkpoint. The reference
+blocks 0.5 s for a GPT-2-1.5B on 2×A100 (megatron_flash_checkpoint.md:159)
+and the north-star target here is < 5 s. ``vs_baseline`` = target / actual
+(>1.0 beats the target).
 
-vs_baseline semantics: ratio of achieved value to the north-star target
-(>1.0 is better than target). See BASELINE.md.
+The bench builds the flagship GPT on the available device, stages a full
+train-state checkpoint into host shared memory (the blocking part), then
+verifies async persistence and memory restore complete.
 """
 
 import json
+import shutil
+import tempfile
 import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
+TARGET_SAVE_BLOCK_S = 5.0
 
 
 def main():
-    from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.models.gpt import GPT, GPTConfig
     from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
     from dlrover_tpu.parallel.train_step import (
-        build_train_step,
         default_optimizer,
         init_train_state,
     )
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
-        cfg = GPTConfig.gpt2_small()
-        batch, seq, iters = 8, 1024, 20
-    else:
-        cfg = GPTConfig.tiny()
-        batch, seq, iters = 8, 64, 5
-
+    # On the real chip use GPT-2 small (~124M params → ~1.5 GB of fp32
+    # param+adam state, a representative FCP payload); tiny on CPU.
+    cfg = GPTConfig.gpt2_small() if on_tpu else GPTConfig.tiny()
     model = GPT(cfg)
     mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
     tx = default_optimizer()
-    tokens = jnp.zeros((batch, seq), jnp.int32)
-    state, shardings = init_train_state(model, tokens, mesh, tx)
-    step = build_train_step(
-        model, tx, cross_entropy_loss, mesh, shardings, donate=True
-    )
-    r = np.random.default_rng(0)
-    x = jnp.asarray(r.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-    y = jnp.roll(x, -1, axis=1)
+    tokens = jnp.zeros((2, 128), jnp.int32)
+    state, _ = init_train_state(model, tokens, mesh, tx)
+    jax.block_until_ready(state.params)
 
-    state, loss = step(state, x, y)  # compile + warmup
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, x, y)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - t0
-    tokens_per_s = batch * seq * iters / elapsed
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        engine = CheckpointEngine(ckpt_dir, mesh=mesh, standalone=True)
+        # Warmup (allocates shm at full size). Explicit checks, not assert:
+        # the metric must never be fabricated under python -O.
+        if not engine.save_to_memory(0, state):
+            raise RuntimeError("warmup save_to_memory failed")
+        # Measure the blocking cost of a memory save (D2H + memcpy)
+        runs = []
+        for step in range(1, 4):
+            t0 = time.perf_counter()
+            if not engine.save_to_memory(step, state):
+                raise RuntimeError(f"save_to_memory failed at step {step}")
+            runs.append(time.perf_counter() - t0)
+        save_block_s = min(runs)
 
-    # Rough reference point: the reference's GPT-2 examples train ~1e5
-    # tokens/s-class on a single A100; the target here is simply to report
-    # the measured number until the goodput bench lands.
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_train_tokens_per_s",
-                "value": round(tokens_per_s, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(tokens_per_s / 1e5, 3),
-            }
+        # Async persist + restore must work end-to-end
+        if not engine.save_to_storage(4, state):
+            raise RuntimeError("save_to_storage failed")
+        if not engine.wait_saving(timeout=600):
+            raise RuntimeError("async persist did not complete")
+        t0 = time.perf_counter()
+        step, restored = engine.load(state)
+        restore_s = time.perf_counter() - t0
+        if step != 4 or restored is None:
+            raise RuntimeError(f"restore failed (step={step})")
+
+        nbytes = sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(state)
         )
-    )
+        print(
+            json.dumps(
+                {
+                    "metric": "flash_ckpt_save_block_s",
+                    "value": round(save_block_s, 4),
+                    "unit": "s",
+                    "vs_baseline": round(TARGET_SAVE_BLOCK_S / max(save_block_s, 1e-9), 2),
+                    "extra": {
+                        "ckpt_bytes": nbytes,
+                        "restore_s": round(restore_s, 4),
+                        "device": str(jax.devices()[0]),
+                    },
+                }
+            )
+        )
+    finally:
+        try:
+            engine.shm.unlink()
+            engine.close()
+        except Exception:
+            pass
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+"""User-facing checkpoint API.
+
+Reference: ``Checkpointer`` ABC + ``DdpCheckpointer`` etc.
+(``flash_checkpoint/checkpointer.py``, ``ddp.py``) with the
+``StorageType.MEMORY/DISK`` selector. One class suffices here — the engine
+already derives shard topology from jax shardings.
+"""
+
+from typing import Any, Optional, Tuple
+
+from .engine import CheckpointEngine
+
+
+class StorageType:
+    MEMORY = "memory"
+    DISK = "disk"
+
+
+class Checkpointer:
+    """``save_checkpoint(step, state, storage_type)`` / ``load_checkpoint``.
+
+    ``state`` is any jax pytree (e.g. a TrainState). Memory saves block
+    ~milliseconds; disk saves stage to memory and persist asynchronously
+    in the agent.
+    """
+
+    def __init__(self, checkpoint_dir: str, mesh=None, **engine_kwargs):
+        self.engine = CheckpointEngine(checkpoint_dir, mesh=mesh, **engine_kwargs)
+
+    def save_checkpoint(
+        self, step: int, state: Any, storage_type: str = StorageType.DISK
+    ) -> bool:
+        if storage_type == StorageType.MEMORY:
+            return self.engine.save_to_memory(step, state)
+        return self.engine.save_to_storage(step, state)
+
+    def load_checkpoint(self, template: Any) -> Tuple[int, Optional[Any]]:
+        """Restore into the template pytree; returns (step, state|None)."""
+        return self.engine.load(template)
+
+    def wait_latest_checkpoint(self, timeout: float = 300.0) -> bool:
+        return self.engine.wait_saving(timeout)
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,210 @@
+"""Trainer-side checkpoint engine for jax pytrees.
+
+Reference: ``CheckpointEngine`` (``flash_checkpoint/engine.py:154``) — the
+in-training-process half: ``save_to_memory`` (blocking sub-second),
+``save_to_storage`` (hand off to the agent saver), ``load`` (memory first,
+storage fallback). One engine covers DDP/FSDP/TP cases uniformly because
+the shard topology is derived from each leaf's jax sharding rather than
+from a framework-specific engine subclass (reference needed
+full/fsdp/megatron engines; SURVEY.md §2.4).
+"""
+
+import os
+import time
+import queue as _queue
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common.constants import NodeEnv
+from ..common.log import logger
+from ..common.multi_process import LocalSocketClient, SharedLock, SharedQueue
+from ..common.events import TrainerEvents
+from .meta import CheckpointMeta
+from .saver import (
+    EVENT_QUEUE,
+    FACTORY_QUEUE,
+    AsyncCheckpointSaver,
+    CheckpointEvent,
+    lock_name,
+)
+from .shm_handler import SharedMemoryHandler
+from .storage import PosixCheckpointStorage
+
+
+def _restore_into_template(template: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    """Map {path: global np array} back onto the template pytree, placing
+    each leaf with the template leaf's sharding (re-mesh happens here: the
+    saved mesh may differ from the template's — device_put reshards)."""
+    from .shm_handler import _path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        key = _path_str(path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint missing leaf {key}")
+        arr = arrays[key]
+        if isinstance(leaf, jax.Array):
+            target_dtype = leaf.dtype
+            arr = arr.astype(target_dtype) if str(arr.dtype) != str(target_dtype) else arr
+            leaves.append(jax.device_put(arr, leaf.sharding))
+        else:
+            leaves.append(np.asarray(arr, dtype=getattr(leaf, "dtype", arr.dtype)))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class CheckpointEngine:
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        mesh=None,
+        host_rank: Optional[int] = None,
+        num_hosts: Optional[int] = None,
+        master_client=None,
+        standalone: Optional[bool] = None,
+    ):
+        self.checkpoint_dir = checkpoint_dir
+        self.mesh = mesh
+        self.host_rank = (
+            host_rank
+            if host_rank is not None
+            else int(os.getenv(NodeEnv.PROCESS_ID, "0"))
+        )
+        self.num_hosts = (
+            num_hosts
+            if num_hosts is not None
+            else int(os.getenv(NodeEnv.NUM_PROCESSES, "1"))
+        )
+        self.master_client = master_client
+        self.storage = PosixCheckpointStorage(checkpoint_dir)
+        self.shm = SharedMemoryHandler(self.host_rank)
+        self._events = TrainerEvents()
+        self._latest_memory_step = -1
+
+        if standalone is None:
+            standalone = not LocalSocketClient("queue_" + FACTORY_QUEUE).available()
+        self._standalone = standalone
+        if standalone:
+            # No agent supervising us (reference start_saver_process
+            # fallback, engine.py:118): run the saver in-process.
+            self._saver_thread = AsyncCheckpointSaver.start_async_saving_ckpt()
+        self._factory_q = SharedQueue(FACTORY_QUEUE)
+        self._event_q = SharedQueue(EVENT_QUEUE)
+        self._factory_q.put(
+            {
+                "type": "create",
+                "storage_root": checkpoint_dir,
+                "host_rank": self.host_rank,
+                "num_hosts": self.num_hosts,
+            }
+        )
+        self._shard_lock = self._wait_lock()
+
+    def _wait_lock(self, timeout: float = 30.0) -> SharedLock:
+        deadline = time.time() + timeout
+        lock = SharedLock(lock_name(self.host_rank))
+        while not lock._client.available():
+            if time.time() > deadline:
+                raise TimeoutError("checkpoint saver did not come up")
+            time.sleep(0.05)
+        return lock
+
+    # -- save --------------------------------------------------------------
+
+    def save_to_memory(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
+        """Stage the pytree into host shm. Blocks only for D2H + memcpy.
+        Skips (returns False) if the persister still holds the shard lock
+        (reference non-blocking acquire, engine.py:351-365)."""
+        if not self._shard_lock.acquire(blocking=False):
+            logger.warning(
+                "skip save_to_memory step %s: persister busy with shard", step
+            )
+            return False
+        try:
+            with self._events.ckpt_save(step, storage="memory"):
+                self.shm.save_pytree(
+                    step,
+                    pytree,
+                    num_hosts=self.num_hosts,
+                    mesh=self.mesh,
+                    extra=extra,
+                )
+            self._latest_memory_step = step
+            return True
+        finally:
+            self._shard_lock.release()
+
+    def save_to_storage(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
+        """Stage to memory, then hand persistence to the agent saver."""
+        if not self.save_to_memory(step, pytree, extra):
+            return False
+        self._event_q.put({"type": CheckpointEvent.SAVE, "step": step})
+        return True
+
+    def wait_saving(self, timeout: float = 300.0) -> bool:
+        """Block until the queued saves are persisted (tracker catches up)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if (self.storage.latest_step() or -1) >= self._latest_memory_step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    # -- load --------------------------------------------------------------
+
+    def load(self, template: Any) -> Tuple[int, Optional[Any]]:
+        """Memory-first restore into ``template``'s structure/shardings.
+
+        Returns (step, restored_pytree) or (-1, None) if nothing to load.
+        """
+        with self._events.ckpt_load():
+            result = self._load_from_memory(template)
+            if result is not None:
+                return result
+            result = self._load_from_storage(template)
+            if result is not None:
+                return result
+        return -1, None
+
+    def _load_from_memory(self, template: Any):
+        if not self.shm.attach():
+            return None
+        got = self.shm.load_pytree_host()
+        if got is None:
+            return None
+        meta, arrays = got
+        try:
+            restored = _restore_into_template(template, arrays)
+        except (KeyError, ValueError) as e:
+            logger.warning("memory checkpoint unusable (%s); trying storage", e)
+            return None
+        logger.info("restored step %s from host memory", meta.step)
+        return meta.step, restored
+
+    def _load_from_storage(self, template: Any):
+        step = self.storage.latest_step()
+        if step is None:
+            return None
+        arrays = self.storage.load_step_host(step)
+        if arrays is None:
+            return None
+        restored = _restore_into_template(template, arrays)
+        logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
+        return step, restored
+
+    # -- shard topology (reference get_local/global_shard_num) -------------
+
+    def get_local_shard_num(self) -> int:
+        return 1  # one staged shard per host
+
+    def get_global_shard_num(self) -> int:
+        return self.num_hosts
+
+    def close(self) -> None:
+        try:
+            self._event_q.close()
+            self._factory_q.close()
+        except Exception:
+            pass

@@ -1,0 +1,113 @@
+"""Checkpoint metadata: self-describing, re-mesh-aware shard records.
+
+The reference stages torch tensors with ``TensorMeta{shape,dtype,offset}``
+(``ckpt_saver.py:89``). For jax the unit is a *device shard* of a pytree
+leaf: each record carries the leaf's GLOBAL shape, its PartitionSpec and
+the mesh shape it was saved under, plus the local index (slice bounds) of
+the shard — exactly the information needed to reassemble or re-shard the
+leaf onto a *different* mesh at load time (SURVEY.md §7 "re-mesh
+correctness" hard part).
+"""
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+HEADER_LEN_BYTES = 8  # u64 little-endian length of the JSON meta block
+
+
+@dataclass
+class ShardRecord:
+    """One device-shard of one pytree leaf staged at ``offset``."""
+
+    path: str  # "/"-joined pytree key path
+    global_shape: List[int]
+    local_shape: List[int]
+    dtype: str  # numpy dtype string
+    # [(start, stop) per dim] of this shard within the global array
+    index: List[Tuple[int, int]]
+    offset: int
+    nbytes: int
+    spec: List[Any] = field(default_factory=list)  # PartitionSpec as lists
+
+    def slices(self) -> Tuple[slice, ...]:
+        return tuple(slice(a, b) for a, b in self.index)
+
+
+@dataclass
+class CheckpointMeta:
+    step: int = 0
+    host_rank: int = 0
+    num_hosts: int = 1
+    mesh_axes: List[str] = field(default_factory=list)
+    mesh_shape: List[int] = field(default_factory=list)
+    records: List[ShardRecord] = field(default_factory=list)
+    total_bytes: int = 0
+    timestamp: float = 0.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, data: str) -> "CheckpointMeta":
+        raw = json.loads(data)
+        records = [
+            ShardRecord(**{**r, "index": [tuple(i) for i in r["index"]]})
+            for r in raw.pop("records", [])
+        ]
+        return cls(records=records, **{k: v for k, v in raw.items()})
+
+
+def spec_to_jsonable(spec) -> List[Any]:
+    """PartitionSpec → JSON-able nested lists (tuples → lists)."""
+    out: List[Any] = []
+    for entry in tuple(spec or ()):
+        if isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def jsonable_to_spec(data: List[Any]):
+    from jax.sharding import PartitionSpec
+
+    entries = []
+    for entry in data or []:
+        if isinstance(entry, list):
+            entries.append(tuple(entry))
+        else:
+            entries.append(entry)
+    return PartitionSpec(*entries)
+
+
+def assemble_global(records: List[ShardRecord], payload_read) -> np.ndarray:
+    """Reassemble one leaf's global array from (possibly partial) records.
+
+    ``payload_read(offset, nbytes) -> bytes``. Records must cover the full
+    global index space (validated).
+    """
+    assert records, "no records for leaf"
+    head = records[0]
+    out = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
+    covered = np.zeros(head.global_shape, dtype=bool) if head.global_shape else None
+    for rec in records:
+        block = np.frombuffer(
+            payload_read(rec.offset, rec.nbytes), dtype=np.dtype(rec.dtype)
+        ).reshape(rec.local_shape)
+        if rec.index:
+            out[rec.slices()] = block
+            if covered is not None:
+                covered[rec.slices()] = True
+        else:
+            out[...] = block
+            covered = None
+    if covered is not None and not covered.all():
+        raise ValueError(
+            f"incomplete shard coverage for leaf {head.path}: "
+            f"{covered.sum()}/{covered.size} elements"
+        )
+    return out

@@ -1,0 +1,179 @@
+"""Agent-side async checkpoint saver.
+
+Reference: ``AsyncCheckpointSaver`` (``ckpt_saver.py:399-1357``) — a
+singleton in the *agent* process whose thread drains save events from the
+trainer and persists the shm-staged bytes to storage, so the trainer's
+blocking cost is only D2H + memcpy. Key behaviors kept:
+
+- factory handshake: the trainer tells the agent what saver to build
+  (storage root, shard topology) via a queue (reference ``ClassMeta`` /
+  ``_notify_agent_to_create_saver``, engine.py:292-320)
+- per-shard lock serializing shm access between trainer and persister
+- done-file protocol + commit + ``dlrover_latest.txt`` tracker
+- ``save_shm_to_storage``: breakpoint save when workers fail, also wired
+  to SIGTERM (reference :533, :758)
+"""
+
+import signal
+import threading
+import queue as _queue
+from typing import Dict, Optional
+
+from ..common.log import logger
+from ..common.multi_process import SharedLock, SharedQueue
+from .shm_handler import SharedMemoryHandler
+from .storage import PosixCheckpointStorage
+
+FACTORY_QUEUE = "ckpt_factory"
+EVENT_QUEUE = "ckpt_events"
+
+
+def lock_name(host_rank: int) -> str:
+    return f"ckpt_shard_{host_rank}"
+
+
+class CheckpointEvent:
+    SAVE = "save"
+    UPDATE = "update"
+    EXIT = "exit"
+
+
+class AsyncCheckpointSaver:
+    """Singleton per agent process; one per-host checkpoint shard."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _cls_lock = threading.Lock()
+
+    def __init__(self, storage_root: str, host_rank: int = 0, num_hosts: int = 1):
+        self.storage = PosixCheckpointStorage(storage_root)
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self.shm = SharedMemoryHandler(host_rank)
+        self._shard_lock = SharedLock(lock_name(host_rank))
+        self._running = True
+        self._persisted_steps: Dict[int, bool] = {}
+        self.master_client = None  # optional: cross-host step sync
+
+    # -- factory / lifecycle ----------------------------------------------
+
+    @classmethod
+    def start_async_saving_ckpt(cls) -> threading.Thread:
+        """Agent entry: create the IPC servers and wait for the trainer's
+        factory message, then run the event loop (reference :474)."""
+        factory_q = SharedQueue(FACTORY_QUEUE, create=True)
+        event_q = SharedQueue(EVENT_QUEUE, create=True)
+
+        def runner():
+            while True:
+                msg = factory_q.get()
+                if msg is None or msg.get("type") == "exit":
+                    return
+                try:
+                    saver = cls.get_or_create(
+                        storage_root=msg["storage_root"],
+                        host_rank=msg.get("host_rank", 0),
+                        num_hosts=msg.get("num_hosts", 1),
+                    )
+                    # Lock server must exist before the trainer acquires it;
+                    # get_or_create made it. Ack by re-running the loop.
+                    saver._event_loop(event_q)
+                except Exception:
+                    logger.exception("checkpoint saver crashed; waiting again")
+
+        thread = threading.Thread(
+            target=runner, name="ckpt-saver", daemon=True
+        )
+        thread.start()
+        return thread
+
+    @classmethod
+    def get_or_create(
+        cls, storage_root: str, host_rank: int = 0, num_hosts: int = 1
+    ) -> "AsyncCheckpointSaver":
+        with cls._cls_lock:
+            if cls._instance is None:
+                # The saver owns the lock server side.
+                SharedLock(lock_name(host_rank), create=True)
+                cls._instance = cls(storage_root, host_rank, num_hosts)
+                cls._instance.register_signal_handler()
+            else:
+                cls._instance.storage = PosixCheckpointStorage(storage_root)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._cls_lock:
+            cls._instance = None
+
+    def register_signal_handler(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        orig_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            logger.info("SIGTERM: attempting breakpoint checkpoint persist")
+            try:
+                self.save_shm_to_storage()
+            finally:
+                if callable(orig_term):
+                    orig_term(signum, frame)
+
+        try:
+            signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            pass
+
+    # -- event loop --------------------------------------------------------
+
+    def _event_loop(self, event_q: SharedQueue) -> None:
+        logger.info(
+            "checkpoint saver running (host_rank=%s root=%s)",
+            self.host_rank,
+            self.storage.root,
+        )
+        while self._running:
+            try:
+                event = event_q.get(timeout=2.0)
+            except _queue.Empty:
+                continue
+            if event is None:
+                continue
+            etype = event.get("type")
+            if etype == CheckpointEvent.EXIT:
+                return
+            if etype == CheckpointEvent.SAVE:
+                self._persist_step(event.get("step", -1))
+
+    def _persist_step(self, step: int) -> None:
+        """Drain shm → storage under the shard lock (reference :925)."""
+        with self._shard_lock:
+            meta = self.shm.read_meta()
+            if meta is None:
+                logger.warning("save event for step %s but shm is empty", step)
+                return
+            if step >= 0 and meta.step != step:
+                logger.warning(
+                    "shm holds step %s, save event wanted %s; persisting shm step",
+                    meta.step,
+                    step,
+                )
+            reader = self.shm.payload_reader()
+            payload = reader(0, meta.total_bytes)
+        self.storage.write_shard(meta, payload)
+        self._persisted_steps[meta.step] = True
+        self.storage.commit(meta.step, self.num_hosts)
+
+    def save_shm_to_storage(self) -> bool:
+        """Breakpoint save: persist whatever step is staged in shm
+        (reference :758, called from the agent when workers fail)."""
+        meta = self.shm.read_meta()
+        if meta is None:
+            return False
+        if self._persisted_steps.get(meta.step):
+            return True  # already safe
+        logger.info("breakpoint-saving step %s from shm", meta.step)
+        self._persist_step(meta.step)
+        return True
+
+    def stop(self) -> None:
+        self._running = False

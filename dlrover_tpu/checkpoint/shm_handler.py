@@ -1,0 +1,210 @@
+"""Shared-memory staging of jax pytrees (the "flash" in flash checkpoint).
+
+Reference mechanism: ``SharedMemoryHandler`` (``ckpt_saver.py:234-397``) —
+trainer memcpys tensors into POSIX shm; the agent persists asynchronously.
+TPU version: the unit staged is each *addressable unique* device shard
+(replica_id 0) of each pytree leaf, after an async device→host copy, so
+the trainer blocks only for the D2H + memcpy, never for storage IO.
+
+Layout of the segment: [u64 meta_len][meta JSON][payload bytes...].
+"""
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common.log import logger
+from ..common.multi_process import SharedMemorySegment
+from .meta import (
+    HEADER_LEN_BYTES,
+    CheckpointMeta,
+    ShardRecord,
+    assemble_global,
+    jsonable_to_spec,
+    spec_to_jsonable,
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _leaf_records(path: str, leaf) -> List[Tuple[ShardRecord, Any]]:
+    """Plan the shard records for one leaf (no data copied yet)."""
+    records = []
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        spec = []
+        try:
+            spec = spec_to_jsonable(leaf.sharding.spec)
+        except Exception:
+            spec = []
+        seen_indices = set()
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue  # exact replica of a shard another device owns
+            key = tuple(
+                (s.start or 0, s.stop if s.stop is not None else dim)
+                for s, dim in zip(shard.index, leaf.shape)
+            )
+            if key in seen_indices:
+                continue
+            seen_indices.add(key)
+            local_shape = [b - a for a, b in key]
+            rec = ShardRecord(
+                path=path,
+                global_shape=list(leaf.shape),
+                local_shape=local_shape,
+                dtype=str(leaf.dtype),
+                index=list(key),
+                offset=0,
+                nbytes=int(np.dtype(leaf.dtype).itemsize * np.prod(local_shape or [1])),
+                spec=spec,
+            )
+            records.append((rec, shard))
+        return records
+    # Host array / scalar: one full record
+    arr = np.asarray(leaf)
+    rec = ShardRecord(
+        path=path,
+        global_shape=list(arr.shape),
+        local_shape=list(arr.shape),
+        dtype=str(arr.dtype),
+        index=[(0, d) for d in arr.shape],
+        offset=0,
+        nbytes=int(arr.nbytes),
+        spec=[],
+    )
+    return [(rec, arr)]
+
+
+class SharedMemoryHandler:
+    """One shm segment per host shard of the checkpoint."""
+
+    def __init__(self, host_rank: int = 0, name: str = ""):
+        self.host_rank = host_rank
+        self._segment = SharedMemorySegment(name or f"ckpt_shard_{host_rank}")
+
+    # -- trainer side ------------------------------------------------------
+
+    def save_pytree(
+        self,
+        step: int,
+        pytree: Any,
+        num_hosts: int = 1,
+        mesh=None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> CheckpointMeta:
+        flat, _ = jax.tree_util.tree_flatten_with_path(pytree)
+        plan: List[Tuple[ShardRecord, Any]] = []
+        for path, leaf in flat:
+            plan.extend(_leaf_records(_path_str(path), leaf))
+
+        # Start all D2H copies before any blocking read (overlap on TPU).
+        for _, shard in plan:
+            data = getattr(shard, "data", None)
+            if data is not None and hasattr(data, "copy_to_host_async"):
+                data.copy_to_host_async()
+
+        meta = CheckpointMeta(
+            step=step,
+            host_rank=self.host_rank,
+            num_hosts=num_hosts,
+            mesh_axes=list(getattr(mesh, "axis_names", []) or []),
+            mesh_shape=[int(s) for s in getattr(mesh, "devices", np.empty(0)).shape]
+            if mesh is not None
+            else [],
+            timestamp=time.time(),
+            extra=extra or {},
+        )
+        offset = 0
+        for rec, _ in plan:
+            rec.offset = offset
+            offset += rec.nbytes
+            meta.records.append(rec)
+        meta.total_bytes = offset
+
+        meta_bytes = meta.to_json().encode()
+        total = HEADER_LEN_BYTES + len(meta_bytes) + offset
+        self._segment.ensure(total)
+        buf = self._segment.buf
+        buf[:HEADER_LEN_BYTES] = len(meta_bytes).to_bytes(HEADER_LEN_BYTES, "little")
+        payload_base = HEADER_LEN_BYTES + len(meta_bytes)
+        buf[HEADER_LEN_BYTES:payload_base] = meta_bytes
+        for rec, shard in plan:
+            data = getattr(shard, "data", shard)
+            flat = np.ascontiguousarray(np.asarray(data)).reshape(-1)
+            start = payload_base + rec.offset
+            view = np.frombuffer(buf, dtype=np.uint8, count=rec.nbytes, offset=start)
+            view[:] = flat.view(np.uint8)
+            del view  # release the exported buffer pointer promptly
+        return meta
+
+    # -- agent / loader side ----------------------------------------------
+
+    def attach(self) -> bool:
+        return self._segment.attach()
+
+    def read_meta(self) -> Optional[CheckpointMeta]:
+        if not self._segment.attach():
+            return None
+        try:
+            meta_len = int.from_bytes(self._segment.read(0, HEADER_LEN_BYTES), "little")
+            if meta_len <= 0 or meta_len > self._segment.size:
+                return None
+            return CheckpointMeta.from_json(
+                self._segment.read(HEADER_LEN_BYTES, meta_len).decode()
+            )
+        except Exception:
+            logger.exception("unreadable checkpoint shm meta")
+            return None
+
+    def payload_reader(self) -> Optional[Callable[[int, int], bytes]]:
+        meta = self.read_meta()
+        if meta is None:
+            return None
+        meta_len = int.from_bytes(self._segment.read(0, HEADER_LEN_BYTES), "little")
+        base = HEADER_LEN_BYTES + meta_len
+
+        def read(offset: int, nbytes: int) -> bytes:
+            return self._segment.read(base + offset, nbytes)
+
+        return read
+
+    def load_pytree_host(self) -> Optional[Tuple[CheckpointMeta, Dict[str, np.ndarray]]]:
+        """Reassemble {leaf_path: global np array} from this host's shm.
+
+        Only complete when this host holds every shard (single-host case);
+        multi-host loads go through the storage/gather paths.
+        """
+        meta = self.read_meta()
+        reader = self.payload_reader()
+        if meta is None or reader is None:
+            return None
+        by_path: Dict[str, List[ShardRecord]] = {}
+        for rec in meta.records:
+            by_path.setdefault(rec.path, []).append(rec)
+        out = {}
+        for path, records in by_path.items():
+            out[path] = assemble_global(records, reader)
+        return meta, out
+
+    def exists(self) -> bool:
+        return self._segment.exists()
+
+    def close(self) -> None:
+        self._segment.close()
+
+    def unlink(self) -> None:
+        self._segment.unlink()

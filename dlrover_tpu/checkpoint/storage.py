@@ -1,0 +1,190 @@
+"""Filesystem storage backend with the done-dir commit protocol.
+
+Reference: ``ckpt_saver.py`` persistence half — per-shard files, a
+``.done`` marker per shard, a commit marker once every shard landed, and
+the ``dlrover_latest.txt`` tracker pointing at the newest complete step.
+Layout:
+
+    <dir>/<step>/shard_<rank>.meta.json
+    <dir>/<step>/shard_<rank>.bin
+    <dir>/<step>/.done/shard_<rank>.done
+    <dir>/<step>/commit_success
+    <dir>/dlrover_latest.txt
+"""
+
+import os
+import shutil
+import tempfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..common.constants import CheckpointConstant
+from ..common.log import logger
+from .meta import CheckpointMeta, ShardRecord, assemble_global
+
+
+class PosixCheckpointStorage:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, str(step))
+
+    def _done_dir(self, step: int) -> str:
+        return os.path.join(self.step_dir(step), CheckpointConstant.DONE_DIR)
+
+    def tracker_path(self) -> str:
+        return os.path.join(self.root, CheckpointConstant.TRACKER_FILE)
+
+    # -- writes ------------------------------------------------------------
+
+    def write_shard(self, meta: CheckpointMeta, payload: bytes) -> None:
+        step_dir = self.step_dir(meta.step)
+        os.makedirs(self._done_dir(meta.step), exist_ok=True)
+        rank = meta.host_rank
+        self._atomic_write(
+            os.path.join(step_dir, f"shard_{rank}.meta.json"),
+            meta.to_json().encode(),
+        )
+        self._atomic_write(os.path.join(step_dir, f"shard_{rank}.bin"), payload)
+        self._atomic_write(
+            os.path.join(self._done_dir(meta.step), f"shard_{rank}.done"), b"ok"
+        )
+
+    def commit(self, step: int, num_shards: int) -> bool:
+        """All shards done → write commit marker + update tracker."""
+        if not self.all_shards_done(step, num_shards):
+            return False
+        self._atomic_write(
+            os.path.join(self.step_dir(step), CheckpointConstant.COMMIT_FILE), b"ok"
+        )
+        self._atomic_write(self.tracker_path(), str(step).encode())
+        logger.info("checkpoint step %s committed (%s shards)", step, num_shards)
+        return True
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        d = os.path.dirname(path)
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    # -- queries -----------------------------------------------------------
+
+    def all_shards_done(self, step: int, num_shards: int) -> bool:
+        done = self._done_dir(step)
+        if not os.path.isdir(done):
+            return False
+        return all(
+            os.path.exists(os.path.join(done, f"shard_{r}.done"))
+            for r in range(num_shards)
+        )
+
+    def committed(self, step: int) -> bool:
+        return os.path.exists(
+            os.path.join(self.step_dir(step), CheckpointConstant.COMMIT_FILE)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        try:
+            with open(self.tracker_path()) as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def list_steps(self) -> List[int]:
+        steps = []
+        if not os.path.isdir(self.root):
+            return steps
+        for name in os.listdir(self.root):
+            if name.isdigit() and self.committed(int(name)):
+                steps.append(int(name))
+        return sorted(steps)
+
+    # -- reads -------------------------------------------------------------
+
+    def read_shard_meta(self, step: int, rank: int) -> Optional[CheckpointMeta]:
+        path = os.path.join(self.step_dir(step), f"shard_{rank}.meta.json")
+        try:
+            with open(path) as f:
+                return CheckpointMeta.from_json(f.read())
+        except FileNotFoundError:
+            return None
+
+    def shard_payload_reader(self, step: int, rank: int):
+        path = os.path.join(self.step_dir(step), f"shard_{rank}.bin")
+        if not os.path.exists(path):
+            return None
+        f = open(path, "rb")
+
+        def read(offset: int, nbytes: int) -> bytes:
+            f.seek(offset)
+            return f.read(nbytes)
+
+        return read
+
+    def load_step_host(self, step: int) -> Optional[Dict[str, np.ndarray]]:
+        """Assemble {leaf_path: global array} from all shards of a step."""
+        metas = []
+        rank = 0
+        while True:
+            meta = self.read_shard_meta(step, rank)
+            if meta is None:
+                break
+            metas.append(meta)
+            rank += 1
+        if not metas:
+            return None
+        by_path: Dict[str, List[ShardRecord]] = {}
+        readers = {}
+        rec_owner: Dict[int, int] = {}
+        for meta in metas:
+            readers[meta.host_rank] = self.shard_payload_reader(step, meta.host_rank)
+            for rec in meta.records:
+                by_path.setdefault(rec.path, []).append(rec)
+                rec_owner[id(rec)] = meta.host_rank
+        out = {}
+        for path, records in by_path.items():
+            # Deduplicate identical indices across hosts (dp replicas)
+            uniq = {}
+            for rec in records:
+                uniq.setdefault(tuple(map(tuple, rec.index)), rec)
+            records = list(uniq.values())
+
+            def reader(offset, nbytes, _recs=records):
+                raise RuntimeError("per-record reader required")
+
+            # assemble manually to route each record to its shard file
+            head = records[0]
+            arr = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
+            for rec in records:
+                r = readers[rec_owner[id(rec)]]
+                block = np.frombuffer(
+                    r(rec.offset, rec.nbytes), dtype=np.dtype(rec.dtype)
+                ).reshape(rec.local_shape)
+                if rec.index:
+                    arr[rec.slices()] = block
+                else:
+                    arr[...] = block
+            out[path] = arr
+        return out
+
+    def remove_step(self, step: int) -> None:
+        shutil.rmtree(self.step_dir(step), ignore_errors=True)
+
+    def keep_latest(self, count: int) -> None:
+        steps = self.list_steps()
+        for step in steps[:-count]:
+            self.remove_step(step)

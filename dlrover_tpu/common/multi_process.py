@@ -578,13 +578,13 @@ class SharedMemorySegment:
         shm, self._shm = self._shm, None
         try:
             shm.close()
-            # Balance the unregister() that SharedMemory.unlink() performs —
-            # we already untracked at create/attach time.
-            try:
-                resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
-            except Exception:
-                pass
-            shm.unlink()
+            # Unlink via the posix call directly: SharedMemory.unlink()
+            # would also unregister from the resource tracker, which we
+            # already did at create/attach time (double-unregister prints
+            # KeyErrors from the tracker daemon).
+            from multiprocessing import shared_memory as _sm
+
+            _sm._posixshmem.shm_unlink(shm._name)  # noqa: SLF001
         except FileNotFoundError:
             pass
         except Exception:

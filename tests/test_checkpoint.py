@@ -1,0 +1,172 @@
+"""Flash-checkpoint tests: shm staging, persist, memory/storage restore,
+and re-mesh load (save under one mesh topology, restore under another)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.checkpoint.meta import CheckpointMeta
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+from dlrover_tpu.checkpoint.storage import PosixCheckpointStorage
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.train_step import default_optimizer, init_train_state
+
+
+@pytest.fixture(autouse=True)
+def fresh_saver(tmp_ipc_dir, monkeypatch):
+    job = f"ckpt_{os.getpid()}_{id(tmp_ipc_dir)}"
+    monkeypatch.setenv("DLROVER_JOB_NAME", job)
+    AsyncCheckpointSaver.reset()
+    yield
+    AsyncCheckpointSaver.reset()
+    # Unlink any shm segments this test's job staged (they intentionally
+    # survive process exit, so tests must clean up explicitly).
+    for name in os.listdir("/dev/shm"):
+        if name.startswith(f"dlrover_{job}_"):
+            SharedMemoryHandler(0, name=name.split(f"dlrover_{job}_", 1)[1]).unlink()
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+class TestShmHandler:
+    def test_roundtrip_host_arrays(self):
+        shm = SharedMemoryHandler(0, name="t1")
+        try:
+            tree = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+                    "b": {"c": np.float64(3.5)}}
+            meta = shm.save_pytree(step=7, pytree=tree)
+            assert meta.step == 7
+            got_meta, arrays = shm.load_pytree_host()
+            assert got_meta.step == 7
+            np.testing.assert_array_equal(arrays["a"], tree["a"])
+            np.testing.assert_allclose(arrays["b/c"], 3.5)
+        finally:
+            shm.unlink()
+
+    def test_sharded_array_records(self):
+        mesh = build_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("fsdp", "tp")))
+        shm = SharedMemoryHandler(0, name="t2")
+        try:
+            meta = shm.save_pytree(step=1, pytree={"x": x}, mesh=mesh)
+            # 8 distinct shards (4x2), no replicas
+            assert len(meta.records) == 8
+            _, arrays = shm.load_pytree_host()
+            np.testing.assert_array_equal(arrays["x"], np.asarray(x))
+        finally:
+            shm.unlink()
+
+    def test_replicated_array_deduped(self):
+        mesh = build_mesh(MeshConfig(dp=8))
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        x = jax.device_put(
+            jnp.ones((4, 4)), NamedSharding(mesh, PartitionSpec())
+        )
+        shm = SharedMemoryHandler(0, name="t3")
+        try:
+            meta = shm.save_pytree(step=1, pytree={"x": x}, mesh=mesh)
+            assert len(meta.records) == 1  # replicas not staged 8x
+        finally:
+            shm.unlink()
+
+
+class TestStorage:
+    def test_done_protocol_and_tracker(self, tmp_path):
+        storage = PosixCheckpointStorage(str(tmp_path))
+        meta = CheckpointMeta(step=5, host_rank=0, num_hosts=2)
+        storage.write_shard(meta, b"payload0")
+        assert not storage.commit(5, num_shards=2)  # shard 1 missing
+        assert storage.latest_step() is None
+        meta1 = CheckpointMeta(step=5, host_rank=1, num_hosts=2)
+        storage.write_shard(meta1, b"payload1")
+        assert storage.commit(5, num_shards=2)
+        assert storage.latest_step() == 5
+        assert storage.committed(5)
+
+    def test_keep_latest(self, tmp_path):
+        storage = PosixCheckpointStorage(str(tmp_path))
+        for step in (1, 2, 3):
+            storage.write_shard(CheckpointMeta(step=step), b"x")
+            storage.commit(step, 1)
+        storage.keep_latest(2)
+        assert storage.list_steps() == [2, 3]
+
+
+class TestEngineEndToEnd:
+    def test_save_load_memory_and_storage(self, tmp_path):
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        tree = {
+            "w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+            "step": np.int64(3),
+        }
+        assert engine.save_to_storage(3, tree)
+        assert engine.wait_saving(timeout=30)
+        # Memory-first load
+        step, restored = engine.load(jax.tree.map(jnp.zeros_like, tree))
+        assert step == 3
+        _tree_equal(tree, restored)
+        # Wipe shm → storage fallback
+        engine.shm.unlink()
+        step, restored = engine.load(jax.tree.map(jnp.zeros_like, tree))
+        assert step == 3
+        _tree_equal(tree, restored)
+        engine.close()
+
+    def test_remesh_restore(self, tmp_path):
+        """Save a sharded train state under fsdp=4,tp=2 and restore it into
+        a dp=2,fsdp=2,tp=2 template — the elastic re-mesh path."""
+        cfg = GPTConfig.tiny()
+        model = GPT(cfg)
+        tx = default_optimizer()
+        tokens = jnp.zeros((8, 32), jnp.int32)
+
+        mesh_a = build_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+        state_a, _ = init_train_state(model, tokens, mesh_a, tx, rng=jax.random.PRNGKey(1))
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), mesh=mesh_a, standalone=True)
+        assert engine.save_to_storage(11, state_a)
+        assert engine.wait_saving(timeout=60)
+
+        mesh_b = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        state_b, _ = init_train_state(model, tokens, mesh_b, tx, rng=jax.random.PRNGKey(2))
+        step, restored = engine.load(state_b)
+        assert step == 11
+        # Values equal state_a, shardings equal state_b
+        _tree_equal(state_a.params, restored.params)
+        wqkv_b = restored.params["block_0"]["CausalSelfAttention_0"]["wqkv"]
+        assert wqkv_b.sharding.mesh.shape == mesh_b.shape
+        engine.close()
+
+    def test_breakpoint_save(self, tmp_path):
+        """Agent persists the staged step even though no SAVE event came
+        (trainer 'crashed' right after save_to_memory)."""
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        tree = {"w": jnp.ones((8, 8))}
+        assert engine.save_to_memory(21, tree)
+        saver = AsyncCheckpointSaver._instance
+        assert saver is not None
+        assert saver.save_shm_to_storage()
+        assert engine.storage.latest_step() == 21
+        engine.close()
+
+    def test_checkpointer_api(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path / "ckpt"))
+        tree = {"a": jnp.ones((2, 2))}
+        assert ckpt.save_checkpoint(1, tree, StorageType.MEMORY)
+        step, restored = ckpt.load_checkpoint(jax.tree.map(jnp.zeros_like, tree))
+        assert step == 1
+        _tree_equal(tree, restored)
+        ckpt.close()

@@ -1,0 +1,27 @@
+"""Per-host elastic agent: supervises the JAX training process.
+
+The agent is the TPU-native re-design of the reference's
+``dlrover/python/elastic_agent/`` (ElasticTrainingAgent,
+training.py:497). One agent runs per TPU host; it joins the
+master-coordinated rendezvous, derives the ``jax.distributed`` bootstrap
+parameters for its host, launches and monitors the single JAX process,
+and reacts to failures and membership changes by re-rendezvousing and
+rebuilding the world — because XLA worlds are static, every membership
+change is a full re-mesh, which maps exactly onto the reference's
+restart-the-worker-group model.
+"""
+
+from .config import ElasticLaunchConfig
+from .rendezvous import MasterRendezvousHandler, RendezvousTimeoutError
+from .training_agent import ElasticTrainingAgent
+from .worker import WorkerProcess, WorkerSpec, WorkerState
+
+__all__ = [
+    "ElasticLaunchConfig",
+    "MasterRendezvousHandler",
+    "RendezvousTimeoutError",
+    "ElasticTrainingAgent",
+    "WorkerSpec",
+    "WorkerProcess",
+    "WorkerState",
+]

@@ -1,0 +1,79 @@
+"""Launch configuration for the elastic agent.
+
+Reference: ``ElasticLaunchConfig`` (dlrover/python/elastic_agent/torch/
+training.py:180) which extends torch's LaunchConfig with network-check,
+node-unit and auto-config knobs. The TPU version drops torchrun
+inheritance and keeps the knobs that matter for a JAX-process-per-host
+world.
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..common.constants import Accelerators, DefaultValues, NodeEnv
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Everything the agent needs to launch and supervise one host."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    # Valid world sizes are multiples of node_unit (≙ TPU slice shape:
+    # hosts per slice). The rendezvous truncates to a multiple of it.
+    node_unit: int = 1
+    node_id: int = 0
+    node_rank: int = 0
+    # Devices supervised by this host's JAX process (local chip count).
+    local_world_size: int = 1
+
+    entrypoint: str = ""  # python script or module to run
+    entry_args: List[str] = field(default_factory=list)
+    run_module: bool = False  # entrypoint is a module (python -m style)
+
+    master_addr: str = ""
+    master_service_type: str = DefaultValues.SERVICE_TYPE
+    job_name: str = "local_job"
+
+    accelerator: str = Accelerators.TPU
+    network_check: bool = False
+    comm_perf_test: bool = False
+    auto_config: bool = False
+    max_restarts: int = DefaultValues.MAX_RELAUNCH_COUNT
+    monitor_interval: float = DefaultValues.MONITOR_INTERVAL_S
+    rdzv_timeout: float = DefaultValues.RDZV_TIMEOUT_S
+    save_at_breakpoint: bool = DefaultValues.SAVE_AT_BREAKPOINT
+    training_port: int = 0  # 0 → pick a free port for the jax coordinator
+    log_dir: Optional[str] = None
+    numa_affinity: bool = False
+    extra_env: Dict[str, str] = field(default_factory=dict)
+
+    def auto_configure_params(self) -> None:
+        """Fill node counts from the scheduler-provided env contract.
+
+        Reference: training.py:227 — nnodes comes from NODE_NUM, and the
+        network check is auto-enabled on jobs large enough (≥4 nodes)
+        that a single bad host is both likely and hard to find by hand.
+        """
+        node_num = int(os.environ.get(NodeEnv.NODE_NUM, "0"))
+        if node_num > 0:
+            self.min_nodes = node_num
+            self.max_nodes = node_num
+        unit = int(os.environ.get(NodeEnv.NODE_UNIT, "0"))
+        if unit > 0:
+            self.node_unit = unit
+        if self.auto_config and self.max_nodes >= 4:
+            self.network_check = True
+
+    def worker_env(self) -> Dict[str, str]:
+        """Static part of the env contract handed to the JAX process."""
+        env = dict(self.extra_env)
+        env[NodeEnv.MASTER_ADDR] = self.master_addr
+        env[NodeEnv.MASTER_SERVICE_TYPE] = self.master_service_type
+        env[NodeEnv.JOB_NAME] = self.job_name
+        env[NodeEnv.NODE_ID] = str(self.node_id)
+        env[NodeEnv.NODE_RANK] = str(self.node_rank)
+        env[NodeEnv.NODE_NUM] = str(self.max_nodes)
+        env[NodeEnv.NODE_UNIT] = str(self.node_unit)
+        return env

@@ -1,0 +1,152 @@
+"""Agent-side failure diagnosis.
+
+Reference: ``DiagnosisAgent`` (dlrover/python/elastic_agent/diagnosis/
+diagnosis_agent.py:55): collect worker logs, classify the failure, and
+decide between a soft restart (same node, re-rendezvous) and a node
+relaunch (agent exits nonzero so the master replaces the node). The
+heartbeat thread also delivers master-issued actions back to the agent
+(reference servicer.py:783).
+"""
+
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from ..common.constants import DefaultValues
+from ..common.log import logger
+from ..master.diagnosis.action import DiagnosisActionType
+from ..rpc.client import MasterClient
+
+
+@dataclass
+class WorkerFailure:
+    node_rank: int
+    restart_count: int
+    returncode: Optional[int]
+    signal: Optional[int]
+    log_tail: str = ""
+
+
+# Errors where retrying on the same host cannot help: the host (or its
+# chips) is the problem, so ask the master to replace the node.
+_NODE_FATAL_PATTERNS = [
+    r"device or resource busy",
+    r"failed to initialize tpu",
+    r"tpu platform.*not found",
+    r"pjrt.*internal",
+    r"out of memory.*hbm",
+    r"uncorrectable ecc",
+]
+
+# Errors that a re-rendezvous on the same host usually cures.
+_RETRYABLE_PATTERNS = [
+    r"rendezvousoutsyncerror",
+    r"coordination service.*unavailable",
+    r"deadline exceeded",
+    r"connection refused",
+    r"barrier timed out",
+]
+
+
+class DiagnosisAgent:
+    """Classify failures and run the heartbeat/action channel."""
+
+    def __init__(
+        self,
+        node_id: int,
+        client: Optional[MasterClient] = None,
+        max_restarts: int = DefaultValues.MAX_RELAUNCH_COUNT,
+        heartbeat_interval: float = DefaultValues.HEARTBEAT_INTERVAL_S,
+    ):
+        self._node_id = node_id
+        self._client = client or MasterClient.singleton()
+        self._max_restarts = max_restarts
+        self._heartbeat_interval = heartbeat_interval
+        self._stopped = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._action_handlers: List[Callable[[str, dict], None]] = []
+
+    # -- failure classification ------------------------------------------
+
+    def diagnose_training_failure(self, failure: WorkerFailure) -> str:
+        """Return a DiagnosisActionType for the observed failure."""
+        log = failure.log_tail.lower()
+        for pat in _NODE_FATAL_PATTERNS:
+            if re.search(pat, log):
+                logger.warning(
+                    "node-fatal error matched %r → relaunch node", pat
+                )
+                return DiagnosisActionType.RELAUNCH_WORKER
+        if failure.restart_count >= self._max_restarts:
+            logger.warning(
+                "restart budget exhausted (%s) → relaunch node",
+                failure.restart_count,
+            )
+            return DiagnosisActionType.RELAUNCH_WORKER
+        for pat in _RETRYABLE_PATTERNS:
+            if re.search(pat, log):
+                return DiagnosisActionType.RESTART_WORKER
+        # Unknown failure with budget left: soft restart is cheap on the
+        # same host, and the master's exit-code policy catches repeats.
+        return DiagnosisActionType.RESTART_WORKER
+
+    def report_failure(self, failure: WorkerFailure, level: str = "error") -> None:
+        try:
+            self._client.report_failure(
+                error_data=failure.log_tail[-4096:],
+                level=level,
+                restart_count=failure.restart_count,
+            )
+        except Exception as e:  # control plane must not kill supervision
+            logger.warning("failed to report failure to master: %s", e)
+
+    # -- heartbeat / master-action channel -------------------------------
+
+    def register_action_handler(
+        self, handler: Callable[[str, dict], None]
+    ) -> None:
+        """handler(action_type, config) invoked for master-issued actions."""
+        self._action_handlers.append(handler)
+
+    def start_heartbeat(self) -> None:
+        if self._hb_thread is not None:
+            return
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                actions = self._client.report_heartbeat()
+                for msg in actions:
+                    self._dispatch(msg)
+            except Exception as e:
+                logger.warning("heartbeat failed: %s", e)
+            self._stopped.wait(self._heartbeat_interval)
+
+    def _dispatch(self, msg) -> None:
+        action_type = {
+            "NoAction": DiagnosisActionType.NONE,
+            "EventAction": DiagnosisActionType.EVENT,
+            "JobAbortionAction": DiagnosisActionType.JOB_ABORTION,
+        }.get(msg.action_cls)
+        if action_type is None:
+            # NodeAction carries its concrete type in config.
+            action_type = msg.config.get(
+                "action_type", DiagnosisActionType.RESTART_WORKER
+            )
+        if action_type == DiagnosisActionType.NONE:
+            return
+        logger.info("master-issued diagnosis action: %s", action_type)
+        for handler in self._action_handlers:
+            try:
+                handler(action_type, dict(msg.config))
+            except Exception as e:
+                logger.error("action handler failed: %s", e)

@@ -1,0 +1,92 @@
+"""Agent-side resource monitor.
+
+Reference: ``dlrover/python/elastic_agent/monitor/resource.py`` — a
+thread sampling host CPU/memory and reporting to the master, which feeds
+the optimizer and the dead-node heuristics. Reads /proc directly so it
+has no third-party dependency.
+"""
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+_CLK_TCK = os.sysconf("SC_CLK_TCK") if hasattr(os, "sysconf") else 100
+
+
+def _read_proc_stat() -> Optional[tuple]:
+    """(busy_ticks, total_ticks) across all cpus, None off-Linux."""
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()[1:]
+        vals = [int(x) for x in parts]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0)
+        return sum(vals) - idle, sum(vals)
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _read_rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+class ResourceMonitor:
+    def __init__(
+        self,
+        node_id: int,
+        client: Optional[MasterClient] = None,
+        interval: float = 15.0,
+    ):
+        self._node_id = node_id
+        self._client = client or MasterClient.singleton()
+        self._interval = interval
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_stat = _read_proc_stat()
+        self._watched_pid: Optional[int] = None
+
+    def watch_pid(self, pid: Optional[int]) -> None:
+        self._watched_pid = pid
+
+    def sample(self) -> tuple:
+        """(cpu_percent, memory_mb) since last sample."""
+        cpu_percent = 0.0
+        cur = _read_proc_stat()
+        if cur and self._last_stat:
+            busy = cur[0] - self._last_stat[0]
+            total = cur[1] - self._last_stat[1]
+            if total > 0:
+                cpu_percent = 100.0 * busy / total
+        self._last_stat = cur
+        mem_mb = _read_rss_mb(self._watched_pid) if self._watched_pid else 0.0
+        return cpu_percent, mem_mb
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            self._stopped.wait(self._interval)
+            if self._stopped.is_set():
+                break
+            try:
+                cpu, mem = self.sample()
+                self._client.report_resource_usage(cpu, mem)
+            except Exception as e:
+                logger.warning("resource report failed: %s", e)

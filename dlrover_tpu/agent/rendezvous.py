@@ -1,0 +1,178 @@
+"""Master-coordinated rendezvous, agent side.
+
+Reference: ``MasterRendezvousHandler`` (dlrover/python/elastic_agent/
+torch/training.py:285-494): join via RPC, poll ``get_comm_world`` until
+this node's rank appears, derive ranks from the sorted world.
+
+The TPU difference is the *output*: instead of a torch c10d store this
+handler yields the ``jax.distributed.initialize`` bootstrap triple
+(coordinator_address, num_processes, process_id). The coordinator
+address is elected through the master KV store: the lowest-ranked member
+of the completed world publishes ``<rdzv>/coord/<round>`` and everyone
+else polls it — so the same mechanism works on one machine (tests,
+standalone) and across hosts over DCN.
+"""
+
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..common import comm
+from ..common.constants import RendezvousName
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+
+class RendezvousTimeoutError(RuntimeError):
+    """The world did not assemble within the configured timeout."""
+
+
+class RendezvousOutSyncError(RuntimeError):
+    """A concurrent rendezvous (node check) has waiters; caller must retry.
+
+    Reference: training.py:445-461 raises this when the network-check
+    rendezvous still has waiting nodes so training rendezvous does not
+    race ahead of an incomplete health check.
+    """
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class RendezvousWorld:
+    """A completed world plus this host's place in it."""
+
+    round: int = 0
+    group: int = 0
+    rank: int = -1  # this host's process_id in the world
+    world_size: int = 0  # number of hosts (JAX processes)
+    coordinator: str = ""  # jax.distributed coordinator "host:port"
+    # node_rank -> NodeMeta for every member, sorted order defines ranks.
+    world: Dict[int, comm.NodeMeta] = field(default_factory=dict)
+
+    @property
+    def global_device_count(self) -> int:
+        return sum(m.process_unit for m in self.world.values())
+
+
+class MasterRendezvousHandler:
+    def __init__(
+        self,
+        name: str,
+        node_rank: int,
+        client: Optional[MasterClient] = None,
+        node_id: Optional[int] = None,
+        local_world_size: int = 1,
+        rdzv_timeout: float = 600.0,
+        poll_interval: float = 0.2,
+        training_port: int = 0,
+        coordinator_host: str = "127.0.0.1",
+    ):
+        self._name = name
+        self._node_rank = node_rank
+        self._node_id = node_id if node_id is not None else node_rank
+        self._client = client or MasterClient.singleton()
+        self._local_world_size = local_world_size
+        self._timeout = rdzv_timeout
+        self._poll_interval = poll_interval
+        self._training_port = training_port
+        self._coordinator_host = coordinator_host
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _join(self) -> int:
+        return self._client.join_rendezvous(
+            node_rank=self._node_rank,
+            local_world_size=self._local_world_size,
+            rdzv_name=self._name,
+            node_ip=self._coordinator_host,
+        )
+
+    def next_rendezvous(self) -> RendezvousWorld:
+        """Join and block until the master completes a world containing us."""
+        start = time.time()
+        rdzv_round = self._join()
+        logger.info(
+            "node %s joined rendezvous %s round %s",
+            self._node_rank,
+            self._name,
+            rdzv_round,
+        )
+        while True:
+            resp = self._client.get_comm_world(
+                rdzv_name=self._name, node_rank=self._node_rank
+            )
+            # The world is keyed by process_id (topology-sorted position);
+            # find ourselves by the node_rank recorded in each meta.
+            my_rank = next(
+                (
+                    pid
+                    for pid, meta in resp.world.items()
+                    if meta.node_rank == self._node_rank
+                ),
+                None,
+            )
+            if my_rank is not None:
+                world = self._build_world(resp, my_rank)
+                if self._name == RendezvousName.TRAINING:
+                    world.coordinator = self._elect_coordinator(world)
+                return world
+            if resp.world:
+                # A world completed without us: the master truncated to a
+                # node_unit multiple, or we joined late. Re-join the next
+                # round rather than spinning on a world we are not in.
+                logger.warning(
+                    "node %s not in completed world %s, rejoining",
+                    self._node_rank,
+                    sorted(m.node_rank for m in resp.world.values()),
+                )
+                rdzv_round = self._join()
+            if time.time() - start > self._timeout:
+                raise RendezvousTimeoutError(
+                    f"rendezvous {self._name} timed out after "
+                    f"{self._timeout}s (node_rank={self._node_rank})"
+                )
+            time.sleep(self._poll_interval)
+
+    def _build_world(
+        self, resp: comm.CommWorldResponse, my_rank: int
+    ) -> RendezvousWorld:
+        # process_id = position in the topology-sorted world, assigned by
+        # the master's TopologySorter (reference training.py:423).
+        return RendezvousWorld(
+            round=resp.round,
+            group=resp.group,
+            rank=my_rank,
+            world_size=len(resp.world),
+            world=dict(resp.world),
+        )
+
+    def _elect_coordinator(self, world: RendezvousWorld) -> str:
+        """Publish (rank 0) or fetch the jax.distributed coordinator addr."""
+        key = f"rdzv/{self._name}/coord/{world.round}"
+        if world.rank == 0:
+            port = self._training_port or find_free_port()
+            addr = f"{self._coordinator_host}:{port}"
+            self._client.kv_store_set(key, addr.encode())
+            return addr
+        start = time.time()
+        while True:
+            raw = self._client.kv_store_get(key)
+            if raw:
+                return raw.decode()
+            if time.time() - start > self._timeout:
+                raise RendezvousTimeoutError(
+                    f"coordinator address for round {world.round} never "
+                    f"published (node_rank={self._node_rank})"
+                )
+            time.sleep(self._poll_interval)
+
+    def num_nodes_waiting(self) -> int:
+        return self._client.num_nodes_waiting(self._name)

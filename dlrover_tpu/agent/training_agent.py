@@ -1,0 +1,281 @@
+"""The per-host elastic training agent.
+
+Reference: ``ElasticTrainingAgent`` (dlrover/python/elastic_agent/torch/
+training.py:497) — rendezvous, worker start with retry, the monitor loop
+(:999-1139) reacting to FAILED (breakpoint-save, diagnose, restart vs
+relaunch) and to membership changes (restart the group to re-rendezvous),
+and the KV-store exit barrier (:1333).
+
+TPU-native shape: the "worker group" is one JAX process; a membership
+change means the global device mesh is stale, so the agent tears the
+process down and rebuilds the world — checkpoint-to-host-memory makes
+that cheap (flash checkpoint survives worker restarts because the shm
+segments live in the agent process).
+"""
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from ..checkpoint.saver import AsyncCheckpointSaver
+from ..common.constants import NodeEnv, NodeStatus, RendezvousName
+from ..common.events import EventEmitter
+from ..common.log import logger
+from ..master.diagnosis.action import DiagnosisActionType
+from ..rpc.client import MasterClient
+from .config import ElasticLaunchConfig
+from .diagnosis_agent import DiagnosisAgent, WorkerFailure
+from .monitor import ResourceMonitor
+from .rendezvous import MasterRendezvousHandler, RendezvousWorld
+from .worker import RunResult, WorkerProcess, WorkerSpec, WorkerState
+
+AGENT_EXIT_OK = 0
+# Nonzero exit asks the platform (master/k8s) to replace this node.
+AGENT_EXIT_RELAUNCH = 1
+AGENT_EXIT_FATAL = 2
+
+
+class ElasticTrainingAgent:
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        spec: Optional[WorkerSpec] = None,
+        client: Optional[MasterClient] = None,
+        start_ckpt_saver: bool = True,
+    ):
+        self._config = config
+        self._client = client or MasterClient.singleton()
+        self._spec = spec or WorkerSpec(
+            entrypoint=config.entrypoint,
+            args=config.entry_args,
+            run_module=config.run_module,
+            env=config.worker_env(),
+            log_dir=config.log_dir,
+        )
+        self._rdzv_handler = MasterRendezvousHandler(
+            RendezvousName.TRAINING,
+            node_rank=config.node_rank,
+            client=self._client,
+            node_id=config.node_id,
+            local_world_size=config.local_world_size,
+            rdzv_timeout=config.rdzv_timeout,
+            training_port=config.training_port,
+        )
+        self._diagnosis = DiagnosisAgent(
+            config.node_id, client=self._client, max_restarts=config.max_restarts
+        )
+        self._resource_monitor = ResourceMonitor(
+            config.node_id, client=self._client
+        )
+        self._worker: Optional[WorkerProcess] = None
+        self._world: Optional[RendezvousWorld] = None
+        self._remaining_restarts = config.max_restarts
+        self._restart_count = 0
+        self._start_ckpt_saver = start_ckpt_saver
+        self._stopped = threading.Event()
+        self._pending_action: Optional[str] = None
+        self._action_lock = threading.Lock()
+        self._evt = EventEmitter("agent")
+        self._diagnosis.register_action_handler(self._on_master_action)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def run(self) -> int:
+        if self._start_ckpt_saver:
+            AsyncCheckpointSaver.start_async_saving_ckpt()
+        self._diagnosis.start_heartbeat()
+        self._resource_monitor.start()
+        try:
+            self._initialize_workers()
+            return self._invoke_run()
+        finally:
+            self._diagnosis.stop()
+            self._resource_monitor.stop()
+            if self._worker is not None:
+                self._worker.stop()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- worker management ------------------------------------------------
+
+    def _initialize_workers(self) -> None:
+        """Rendezvous, then start the JAX process with the world's env.
+
+        Reference training.py:883 retries initialization; a failed
+        rendezvous here is fatal only after the rdzv timeout (the handler
+        retries internally).
+        """
+        with self._evt.duration(
+            "rendezvous", node_rank=self._config.node_rank
+        ) as span:
+            self._world = self._rdzv_handler.next_rendezvous()
+            span.end(
+                {
+                    "round": self._world.round,
+                    "rank": self._world.rank,
+                    "world_size": self._world.world_size,
+                }
+            )
+        logger.info(
+            "world ready: round=%s rank=%s/%s coordinator=%s",
+            self._world.round,
+            self._world.rank,
+            self._world.world_size,
+            self._world.coordinator,
+        )
+        self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
+        self._worker.start(dynamic_env=self._world_env(self._world))
+        self._resource_monitor.watch_pid(self._worker.pid)
+        self._report_status(NodeStatus.RUNNING)
+
+    def _world_env(self, world: RendezvousWorld) -> Dict[str, str]:
+        """The dynamic (per-rendezvous-round) part of the env contract."""
+        return {
+            NodeEnv.COORDINATOR_ADDRESS: world.coordinator,
+            NodeEnv.NUM_PROCESSES: str(world.world_size),
+            NodeEnv.PROCESS_ID: str(world.rank),
+            NodeEnv.NODE_RANK: str(self._config.node_rank),
+            NodeEnv.NODE_NUM: str(world.world_size),
+        }
+
+    def _restart_workers(self, reason: str) -> None:
+        logger.info("restarting worker (%s)", reason)
+        self._evt.instant("restart_worker", reason=reason)
+        if self._worker is not None:
+            self._worker.stop()
+        self._restart_count += 1
+        self._initialize_workers()
+
+    # -- monitor loop -----------------------------------------------------
+
+    def _invoke_run(self) -> int:
+        while not self._stopped.is_set():
+            time.sleep(self._config.monitor_interval)
+            action = self._take_pending_action()
+            if action is not None:
+                code = self._apply_master_action(action)
+                if code is not None:
+                    return code
+                continue
+            result = self._worker.poll()
+            if result.state == WorkerState.SUCCEEDED:
+                self._report_status(NodeStatus.SUCCEEDED)
+                self._exit_barrier()
+                return AGENT_EXIT_OK
+            if result.state == WorkerState.FAILED:
+                code = self._handle_worker_failure(result)
+                if code is not None:
+                    return code
+                continue
+            if self._membership_changed():
+                self._restart_workers("membership changed")
+        return AGENT_EXIT_OK
+
+    def _handle_worker_failure(self, result: RunResult) -> Optional[int]:
+        """Breakpoint-save, diagnose, restart or relaunch (training.py:1074)."""
+        logger.error(
+            "worker failed rc=%s signal=%s restart=%s",
+            result.returncode,
+            result.signal,
+            self._restart_count,
+        )
+        if self._config.save_at_breakpoint:
+            self._save_ckpt_at_breakpoint()
+        failure = WorkerFailure(
+            node_rank=self._config.node_rank,
+            restart_count=self._restart_count,
+            returncode=result.returncode,
+            signal=result.signal,
+            log_tail=self._worker.tail_log(),
+        )
+        self._diagnosis.report_failure(failure)
+        action = self._diagnosis.diagnose_training_failure(failure)
+        if (
+            action == DiagnosisActionType.RESTART_WORKER
+            and self._remaining_restarts > 0
+        ):
+            self._remaining_restarts -= 1
+            self._restart_workers("worker failure")
+            return None
+        self._report_status(NodeStatus.FAILED, exit_reason="fatal_error")
+        logger.error("worker failure unrecoverable on this node; relaunching")
+        return AGENT_EXIT_RELAUNCH
+
+    def _membership_changed(self) -> bool:
+        """True when the master has waiters that require a new world.
+
+        The master applies the node-unit rules (rdzv_manager: waiters
+        trigger a restart only when ≥ node_unit or a previous member
+        re-joined), so the agent only asks the count.
+        """
+        try:
+            return self._rdzv_handler.num_nodes_waiting() > 0
+        except Exception as e:
+            logger.warning("num_nodes_waiting failed: %s", e)
+            return False
+
+    # -- master-issued actions -------------------------------------------
+
+    def _on_master_action(self, action_type: str, config: dict) -> None:
+        with self._action_lock:
+            self._pending_action = action_type
+
+    def _take_pending_action(self) -> Optional[str]:
+        with self._action_lock:
+            action, self._pending_action = self._pending_action, None
+            return action
+
+    def _apply_master_action(self, action: str) -> Optional[int]:
+        if action == DiagnosisActionType.RESTART_WORKER:
+            self._restart_workers("master-issued restart")
+            return None
+        if action == DiagnosisActionType.RELAUNCH_WORKER:
+            self._worker.stop()
+            self._report_status(NodeStatus.FAILED, exit_reason="relaunched")
+            return AGENT_EXIT_RELAUNCH
+        if action == DiagnosisActionType.JOB_ABORTION:
+            self._worker.stop()
+            self._report_status(NodeStatus.FAILED, exit_reason="job_aborted")
+            return AGENT_EXIT_FATAL
+        return None
+
+    # -- helpers ----------------------------------------------------------
+
+    def _save_ckpt_at_breakpoint(self) -> None:
+        """Persist whatever step is staged in shm before teardown
+        (reference training.py:1216 → ckpt_saver.py:758)."""
+        saver = AsyncCheckpointSaver._instance
+        if saver is None:
+            return
+        try:
+            if saver.save_shm_to_storage():
+                logger.info("breakpoint checkpoint persisted")
+        except Exception as e:
+            logger.warning("breakpoint save failed: %s", e)
+
+    def _report_status(
+        self, status: str, exit_reason: str = ""
+    ) -> None:
+        try:
+            self._client.report_node_status(
+                status, exit_reason=exit_reason, restart_count=self._restart_count
+            )
+        except Exception as e:
+            logger.warning("status report failed: %s", e)
+
+    def _exit_barrier(self, timeout: float = 300.0) -> None:
+        """All agents wait here so stragglers can finish persisting
+        checkpoints before the job object is torn down (training.py:1333)."""
+        if self._world is None or self._world.world_size <= 1:
+            return
+        key = f"exit_barrier/{self._world.round}"
+        try:
+            count = self._client.kv_store_add(key, 1)
+            deadline = time.time() + timeout
+            while count < self._world.world_size and time.time() < deadline:
+                time.sleep(0.5)
+                count = self._client.kv_store_add(key, 0)
+        except Exception as e:
+            logger.warning("exit barrier failed: %s", e)

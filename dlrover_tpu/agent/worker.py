@@ -1,0 +1,196 @@
+"""Worker (JAX training process) supervision.
+
+The reference leans on torch-elastic's LocalElasticAgent for process
+supervision; here it is written fresh (SURVEY.md §7 "No torch-elastic to
+lean on") with the behaviors that matter lifted from the reference:
+signal-based teardown with a kill grace period, log capture for the
+diagnosis chain, restart counting, and orphan reaping
+(training.py:585-628, 883-935, 1228-1260).
+
+One host runs ONE JAX process (JAX is one-process-per-host on TPU); the
+"worker group" of torch-elastic collapses to a single supervised child.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..common.constants import NodeEnv
+from ..common.log import logger
+
+
+class WorkerState:
+    INIT = "init"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+@dataclass
+class WorkerSpec:
+    """What to run and how to restart it."""
+
+    entrypoint: str
+    args: List[str] = field(default_factory=list)
+    run_module: bool = False
+    env: Dict[str, str] = field(default_factory=dict)
+    log_dir: Optional[str] = None
+    kill_grace_s: float = 15.0
+    # TPU chips are held by a process until it fully exits; starting the
+    # next process before the old one released the devices deadlocks.
+    wait_release_s: float = 60.0
+
+
+@dataclass
+class RunResult:
+    state: str = WorkerState.INIT
+    returncode: Optional[int] = None
+    signal: Optional[int] = None
+
+
+class WorkerProcess:
+    """One supervised training process."""
+
+    def __init__(self, spec: WorkerSpec, restart_count: int = 0):
+        self.spec = spec
+        self.restart_count = restart_count
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_path: Optional[str] = None
+        self._log_file = None
+        self.start_time: float = 0.0
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc else None
+
+    @property
+    def log_path(self) -> Optional[str]:
+        return self._log_path
+
+    def start(self, dynamic_env: Optional[Dict[str, str]] = None) -> None:
+        env = dict(os.environ)
+        env.update(self.spec.env)
+        if dynamic_env:
+            env.update(dynamic_env)
+        env[NodeEnv.RESTART_COUNT] = str(self.restart_count)
+
+        if self.spec.run_module:
+            cmd = [sys.executable, "-m", self.spec.entrypoint]
+        else:
+            cmd = [sys.executable, self.spec.entrypoint]
+        cmd += list(self.spec.args)
+
+        stdout = None
+        if self.spec.log_dir:
+            os.makedirs(self.spec.log_dir, exist_ok=True)
+            self._log_path = os.path.join(
+                self.spec.log_dir, f"worker_{self.restart_count}.log"
+            )
+            self._log_file = open(self._log_path, "wb")
+            stdout = self._log_file
+
+        # New process group so teardown can kill the whole tree (grand-
+        # children like dataloader workers), mirroring orphan reaping in
+        # the reference (training.py:616).
+        self._proc = subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+            start_new_session=True,
+        )
+        self.start_time = time.time()
+        logger.info(
+            "started worker pid=%s restart=%s cmd=%s",
+            self._proc.pid,
+            self.restart_count,
+            " ".join(cmd),
+        )
+
+    def poll(self) -> RunResult:
+        if self._proc is None:
+            return RunResult(WorkerState.INIT)
+        rc = self._proc.poll()
+        if rc is None:
+            return RunResult(WorkerState.RUNNING)
+        self._close_log()
+        if rc == 0:
+            return RunResult(WorkerState.SUCCEEDED, returncode=0)
+        sig = -rc if rc < 0 else None
+        return RunResult(WorkerState.FAILED, returncode=rc, signal=sig)
+
+    def stop(self) -> None:
+        """SIGTERM the process group, escalate to SIGKILL after grace."""
+        if self._proc is None or self._proc.poll() is not None:
+            self._close_log()
+            return
+        pgid = None
+        try:
+            pgid = os.getpgid(self._proc.pid)
+            os.killpg(pgid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        deadline = time.time() + self.spec.kill_grace_s
+        while time.time() < deadline:
+            if self._proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        if self._proc.poll() is None:
+            logger.warning(
+                "worker pid=%s ignored SIGTERM, killing", self._proc.pid
+            )
+            try:
+                if pgid is not None:
+                    os.killpg(pgid, signal.SIGKILL)
+                else:
+                    self._proc.kill()
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._proc.wait()
+        self._reap_orphans(pgid)
+        self._close_log()
+
+    def wait(self, timeout: Optional[float] = None) -> RunResult:
+        if self._proc is not None:
+            try:
+                self._proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                pass
+        return self.poll()
+
+    def tail_log(self, max_bytes: int = 64 * 1024) -> str:
+        if not self._log_path or not os.path.exists(self._log_path):
+            return ""
+        with open(self._log_path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            return f.read().decode(errors="replace")
+
+    def _reap_orphans(self, pgid: Optional[int]) -> None:
+        if pgid is None:
+            return
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        # Collect any zombies reparented to us.
+        try:
+            while True:
+                pid, _ = os.waitpid(-1, os.WNOHANG)
+                if pid == 0:
+                    break
+        except ChildProcessError:
+            pass
+
+    def _close_log(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            finally:
+                self._log_file = None

@@ -11,7 +11,6 @@ full/fsdp/megatron engines; SURVEY.md §2.4).
 
 import os
 import time
-import queue as _queue
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -21,7 +20,6 @@ from ..common.constants import NodeEnv
 from ..common.log import logger
 from ..common.multi_process import LocalSocketClient, SharedLock, SharedQueue
 from ..common.events import TrainerEvents
-from .meta import CheckpointMeta
 from .saver import (
     EVENT_QUEUE,
     FACTORY_QUEUE,
@@ -81,7 +79,7 @@ class CheckpointEngine:
         self.storage = PosixCheckpointStorage(checkpoint_dir)
         self.shm = SharedMemoryHandler(self.host_rank)
         self._events = TrainerEvents()
-        self._latest_memory_step = -1
+        self._latest_storage_step = -1
 
         if standalone is None:
             standalone = not LocalSocketClient("queue_" + FACTORY_QUEUE).available()
@@ -131,7 +129,6 @@ class CheckpointEngine:
                     mesh=self.mesh,
                     extra=extra,
                 )
-            self._latest_memory_step = step
             return True
         finally:
             self._shard_lock.release()
@@ -141,13 +138,18 @@ class CheckpointEngine:
         if not self.save_to_memory(step, pytree, extra):
             return False
         self._event_q.put({"type": CheckpointEvent.SAVE, "step": step})
+        self._latest_storage_step = step
         return True
 
     def wait_saving(self, timeout: float = 300.0) -> bool:
-        """Block until the queued saves are persisted (tracker catches up)."""
+        """Block until the queued *storage* saves are persisted (tracker
+        catches up). Memory-only saves don't gate this — they have no
+        pending disk work."""
+        if self._latest_storage_step < 0:
+            return True
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if (self.storage.latest_step() or -1) >= self._latest_memory_step:
+            if (self.storage.latest_step() or -1) >= self._latest_storage_step:
                 return True
             time.sleep(0.1)
         return False
@@ -169,9 +171,18 @@ class CheckpointEngine:
         return -1, None
 
     def _load_from_memory(self, template: Any):
-        if not self.shm.attach():
+        # Read under the shard lock: the persister (or a dying trainer's
+        # last save) may be mid-write; an unlocked read could restore a
+        # torn payload with no error.
+        if not self._shard_lock.acquire(blocking=True, timeout=60.0):
+            logger.warning("shard lock busy; skipping memory restore")
             return None
-        got = self.shm.load_pytree_host()
+        try:
+            if not self.shm.attach():
+                return None
+            got = self.shm.load_pytree_host()
+        finally:
+            self._shard_lock.release()
         if got is None:
             return None
         meta, arrays = got
@@ -190,7 +201,15 @@ class CheckpointEngine:
         arrays = self.storage.load_step_host(step)
         if arrays is None:
             return None
-        restored = _restore_into_template(template, arrays)
+        try:
+            restored = _restore_into_template(template, arrays)
+        except (KeyError, ValueError) as e:
+            logger.warning(
+                "storage checkpoint step %s unusable (%s); starting fresh",
+                step,
+                e,
+            )
+            return None
         logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
         return step, restored
 
@@ -203,8 +222,14 @@ class CheckpointEngine:
         return self.num_hosts
 
     def close(self) -> None:
-        try:
-            self._event_q.close()
-            self._factory_q.close()
-        except Exception:
-            pass
+        """Release IPC clients and the shm mapping; in standalone mode
+        also tear down the in-process saver (thread + servers), so a
+        re-meshed world can build a fresh engine without leaking one
+        saver stack per topology round."""
+        for res in (self._event_q, self._factory_q, self._shard_lock, self.shm):
+            try:
+                res.close()
+            except Exception:
+                pass
+        if self._standalone:
+            AsyncCheckpointSaver.shutdown()

@@ -84,30 +84,34 @@ def jsonable_to_spec(data: List[Any]):
     return PartitionSpec(*entries)
 
 
-def assemble_global(records: List[ShardRecord], payload_read) -> np.ndarray:
+def assemble_global(records: List[ShardRecord], record_read) -> np.ndarray:
     """Reassemble one leaf's global array from (possibly partial) records.
 
-    ``payload_read(offset, nbytes) -> bytes``. Records must cover the full
-    global index space (validated).
+    ``record_read(rec) -> bytes`` returns one record's payload — records
+    may live in different shard files (multi-host) or one shm segment.
+    Records must cover the full global index space (validated).
     """
     assert records, "no records for leaf"
     head = records[0]
     out = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
-    covered = np.zeros(head.global_shape, dtype=bool) if head.global_shape else None
+    total = int(np.prod(head.global_shape)) if head.global_shape else 1
+    covered_elems = 0
+    full_write = False
     for rec in records:
         block = np.frombuffer(
-            payload_read(rec.offset, rec.nbytes), dtype=np.dtype(rec.dtype)
+            record_read(rec), dtype=np.dtype(rec.dtype)
         ).reshape(rec.local_shape)
         if rec.index:
             out[rec.slices()] = block
-            if covered is not None:
-                covered[rec.slices()] = True
+            covered_elems += int(np.prod(rec.local_shape)) if rec.local_shape else 1
         else:
             out[...] = block
-            covered = None
-    if covered is not None and not covered.all():
+            full_write = True
+    # Records are disjoint (JAX shard indices after replica dedup), so a
+    # volume sum equals full coverage — no per-element mask needed.
+    if not full_write and covered_elems != total:
         raise ValueError(
             f"incomplete shard coverage for leaf {head.path}: "
-            f"{covered.sum()}/{covered.size} elements"
+            f"{covered_elems}/{total} elements"
         )
     return out

@@ -14,6 +14,7 @@ blocking cost is only D2H + memcpy. Key behaviors kept:
   to SIGTERM (reference :533, :758)
 """
 
+import os
 import signal
 import threading
 import queue as _queue
@@ -43,13 +44,18 @@ class AsyncCheckpointSaver:
 
     _instance: Optional["AsyncCheckpointSaver"] = None
     _cls_lock = threading.Lock()
+    _factory_q: Optional[SharedQueue] = None
+    _event_q: Optional[SharedQueue] = None
+    _runner_thread: Optional[threading.Thread] = None
+    _signals_installed = False
 
     def __init__(self, storage_root: str, host_rank: int = 0, num_hosts: int = 1):
         self.storage = PosixCheckpointStorage(storage_root)
         self.host_rank = host_rank
         self.num_hosts = num_hosts
         self.shm = SharedMemoryHandler(host_rank)
-        self._shard_lock = SharedLock(lock_name(host_rank))
+        # The saver owns the lock server side; trainers connect as clients.
+        self._shard_lock = SharedLock(lock_name(host_rank), create=True)
         self._running = True
         self._persisted_steps: Dict[int, bool] = {}
         self.master_client = None  # optional: cross-host step sync
@@ -59,9 +65,19 @@ class AsyncCheckpointSaver:
     @classmethod
     def start_async_saving_ckpt(cls) -> threading.Thread:
         """Agent entry: create the IPC servers and wait for the trainer's
-        factory message, then run the event loop (reference :474)."""
-        factory_q = SharedQueue(FACTORY_QUEUE, create=True)
-        event_q = SharedQueue(EVENT_QUEUE, create=True)
+        factory message, then run the event loop (reference :474).
+
+        Must be called from the agent's main thread so the SIGTERM
+        breakpoint-save hook (reference :533) can actually be installed —
+        Python only allows signal registration on the main thread.
+        """
+        with cls._cls_lock:
+            if cls._runner_thread is not None and cls._runner_thread.is_alive():
+                return cls._runner_thread
+            cls._factory_q = SharedQueue(FACTORY_QUEUE, create=True)
+            cls._event_q = SharedQueue(EVENT_QUEUE, create=True)
+        cls._install_signal_handlers()
+        factory_q, event_q = cls._factory_q, cls._event_q
 
         def runner():
             while True:
@@ -84,6 +100,7 @@ class AsyncCheckpointSaver:
             target=runner, name="ckpt-saver", daemon=True
         )
         thread.start()
+        cls._runner_thread = thread
         return thread
 
     @classmethod
@@ -92,12 +109,33 @@ class AsyncCheckpointSaver:
     ) -> "AsyncCheckpointSaver":
         with cls._cls_lock:
             if cls._instance is None:
-                # The saver owns the lock server side.
-                SharedLock(lock_name(host_rank), create=True)
                 cls._instance = cls(storage_root, host_rank, num_hosts)
-                cls._instance.register_signal_handler()
             else:
-                cls._instance.storage = PosixCheckpointStorage(storage_root)
+                inst = cls._instance
+                inst.storage = PosixCheckpointStorage(storage_root)
+                if (
+                    host_rank != inst.host_rank
+                    or num_hosts != inst.num_hosts
+                ):
+                    # Elastic re-mesh changed the shard topology: the old
+                    # shm/lock/step bookkeeping belongs to the old world.
+                    logger.info(
+                        "saver topology change: rank %s/%s -> %s/%s",
+                        inst.host_rank,
+                        inst.num_hosts,
+                        host_rank,
+                        num_hosts,
+                    )
+                    if host_rank != inst.host_rank:
+                        inst._shard_lock.close()
+                        inst._shard_lock = SharedLock(
+                            lock_name(host_rank), create=True
+                        )
+                        inst.shm.close()
+                        inst.shm = SharedMemoryHandler(host_rank)
+                    inst.host_rank = host_rank
+                    inst.num_hosts = num_hosts
+                    inst._persisted_steps.clear()
             return cls._instance
 
     @classmethod
@@ -105,21 +143,76 @@ class AsyncCheckpointSaver:
         with cls._cls_lock:
             cls._instance = None
 
-    def register_signal_handler(self) -> None:
+    @classmethod
+    def shutdown(cls, timeout: float = 10.0) -> None:
+        """Stop the runner thread, IPC servers, and the instance's
+        shm/lock resources. Safe to call repeatedly."""
+        with cls._cls_lock:
+            factory_q, event_q = cls._factory_q, cls._event_q
+            thread, inst = cls._runner_thread, cls._instance
+            cls._factory_q = cls._event_q = None
+            cls._runner_thread = None
+            cls._instance = None
+        if inst is not None:
+            inst.stop()
+        if event_q is not None and thread is not None and thread.is_alive():
+            try:
+                event_q.put({"type": CheckpointEvent.EXIT}, timeout=2.0)
+            except Exception:
+                pass
+        if factory_q is not None and thread is not None and thread.is_alive():
+            try:
+                factory_q.put({"type": "exit"}, timeout=2.0)
+            except Exception:
+                pass
+        if thread is not None:
+            thread.join(timeout)
+        for q in (factory_q, event_q):
+            if q is not None:
+                try:
+                    q.close()
+                except Exception:
+                    pass
+        if inst is not None:
+            inst.shm.close()
+            try:
+                inst._shard_lock.close()
+            except Exception:
+                pass
+
+    @classmethod
+    def _install_signal_handlers(cls) -> None:
+        """Breakpoint-save on SIGTERM (pod eviction / preemption): persist
+        whatever step is staged in shm, then resume default termination."""
+        if cls._signals_installed:
+            return
         if threading.current_thread() is not threading.main_thread():
+            logger.warning(
+                "saver started off the main thread; SIGTERM breakpoint "
+                "save disabled"
+            )
             return
         orig_term = signal.getsignal(signal.SIGTERM)
 
         def on_term(signum, frame):
             logger.info("SIGTERM: attempting breakpoint checkpoint persist")
-            try:
-                self.save_shm_to_storage()
-            finally:
-                if callable(orig_term):
-                    orig_term(signum, frame)
+            inst = cls._instance
+            if inst is not None:
+                try:
+                    inst.save_shm_to_storage()
+                except Exception:
+                    logger.exception("breakpoint save on SIGTERM failed")
+            if callable(orig_term):
+                orig_term(signum, frame)
+            else:
+                # SIG_DFL/SIG_IGN aren't callable: restore and re-deliver
+                # so the process still dies from the signal.
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
 
         try:
             signal.signal(signal.SIGTERM, on_term)
+            cls._signals_installed = True
         except ValueError:
             pass
 
@@ -145,7 +238,14 @@ class AsyncCheckpointSaver:
                 self._persist_step(event.get("step", -1))
 
     def _persist_step(self, step: int) -> None:
-        """Drain shm → storage under the shard lock (reference :925)."""
+        """Drain shm → storage under the shard lock (reference :925).
+
+        The write streams straight from the shm buffer in chunks — no
+        full-payload copy in agent RAM (matters at multi-GB checkpoints).
+        The lock is held for the whole persist; the trainer's
+        save_to_memory uses a non-blocking acquire and skips the step if
+        we're still writing (reference engine.py:351-365).
+        """
         with self._shard_lock:
             meta = self.shm.read_meta()
             if meta is None:
@@ -158,8 +258,7 @@ class AsyncCheckpointSaver:
                     step,
                 )
             reader = self.shm.payload_reader()
-            payload = reader(0, meta.total_bytes)
-        self.storage.write_shard(meta, payload)
+            self.storage.write_shard(meta, reader)
         self._persisted_steps[meta.step] = True
         self.storage.commit(meta.step, self.num_hosts)
 

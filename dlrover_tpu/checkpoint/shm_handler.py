@@ -197,7 +197,9 @@ class SharedMemoryHandler:
             by_path.setdefault(rec.path, []).append(rec)
         out = {}
         for path, records in by_path.items():
-            out[path] = assemble_global(records, reader)
+            out[path] = assemble_global(
+                records, lambda rec: reader(rec.offset, rec.nbytes)
+            )
         return meta, out
 
     def exists(self) -> bool:

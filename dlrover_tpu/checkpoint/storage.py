@@ -42,7 +42,11 @@ class PosixCheckpointStorage:
 
     # -- writes ------------------------------------------------------------
 
-    def write_shard(self, meta: CheckpointMeta, payload: bytes) -> None:
+    WRITE_CHUNK = 64 * 1024 * 1024
+
+    def write_shard(self, meta: CheckpointMeta, payload) -> None:
+        """``payload`` is either the raw bytes or a reader
+        ``(offset, nbytes) -> bytes`` streamed in chunks (no full copy)."""
         step_dir = self.step_dir(meta.step)
         os.makedirs(self._done_dir(meta.step), exist_ok=True)
         rank = meta.host_rank
@@ -50,7 +54,11 @@ class PosixCheckpointStorage:
             os.path.join(step_dir, f"shard_{rank}.meta.json"),
             meta.to_json().encode(),
         )
-        self._atomic_write(os.path.join(step_dir, f"shard_{rank}.bin"), payload)
+        bin_path = os.path.join(step_dir, f"shard_{rank}.bin")
+        if callable(payload):
+            self._atomic_write_stream(bin_path, payload, meta.total_bytes)
+        else:
+            self._atomic_write(bin_path, payload)
         self._atomic_write(
             os.path.join(self._done_dir(meta.step), f"shard_{rank}.done"), b"ok"
         )
@@ -65,6 +73,25 @@ class PosixCheckpointStorage:
         self._atomic_write(self.tracker_path(), str(step).encode())
         logger.info("checkpoint step %s committed (%s shards)", step, num_shards)
         return True
+
+    def _atomic_write_stream(self, path: str, reader, total_bytes: int) -> None:
+        d = os.path.dirname(path)
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                offset = 0
+                while offset < total_bytes:
+                    n = min(self.WRITE_CHUNK, total_bytes - offset)
+                    f.write(reader(offset, n))
+                    offset += n
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
 
     def _atomic_write(self, path: str, data: bytes) -> None:
         d = os.path.dirname(path)
@@ -155,30 +182,16 @@ class PosixCheckpointStorage:
             for rec in meta.records:
                 by_path.setdefault(rec.path, []).append(rec)
                 rec_owner[id(rec)] = meta.host_rank
+        def record_read(rec: ShardRecord) -> bytes:
+            return readers[rec_owner[id(rec)]](rec.offset, rec.nbytes)
+
         out = {}
         for path, records in by_path.items():
             # Deduplicate identical indices across hosts (dp replicas)
             uniq = {}
             for rec in records:
                 uniq.setdefault(tuple(map(tuple, rec.index)), rec)
-            records = list(uniq.values())
-
-            def reader(offset, nbytes, _recs=records):
-                raise RuntimeError("per-record reader required")
-
-            # assemble manually to route each record to its shard file
-            head = records[0]
-            arr = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
-            for rec in records:
-                r = readers[rec_owner[id(rec)]]
-                block = np.frombuffer(
-                    r(rec.offset, rec.nbytes), dtype=np.dtype(rec.dtype)
-                ).reshape(rec.local_shape)
-                if rec.index:
-                    arr[rec.slices()] = block
-                else:
-                    arr[...] = block
-            out[path] = arr
+            out[path] = assemble_global(list(uniq.values()), record_read)
         return out
 
     def remove_step(self, step: int) -> None:

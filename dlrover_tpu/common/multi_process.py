@@ -233,7 +233,23 @@ class LocalSocketClient:
         return resp["r"]
 
     def available(self) -> bool:
-        return os.path.exists(self.path)
+        """True only if a server is actually accepting on the socket.
+
+        A bare path-exists check reports a socket file left behind by a
+        SIGKILLed server as alive, which makes callers (e.g. the
+        checkpoint engine's standalone auto-detection) neither start
+        their own server nor reach one.
+        """
+        if not os.path.exists(self.path):
+            return False
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(2.0)
+            s.connect(self.path)
+            s.close()
+            return True
+        except OSError:
+            return False
 
     def close(self) -> None:
         with self._lock:
@@ -502,6 +518,7 @@ class SharedMemorySegment:
     def __init__(self, name: str):
         self.name = _shm_name(name)
         self._shm: Optional[shared_memory.SharedMemory] = None
+        self._ino: Optional[int] = None
 
     @staticmethod
     def _untrack(shm: shared_memory.SharedMemory) -> None:
@@ -514,6 +531,33 @@ class SharedMemorySegment:
         except Exception:
             pass
 
+    @staticmethod
+    def _posix_unlink(shm: shared_memory.SharedMemory) -> None:
+        # Unlink via the posix call directly: SharedMemory.unlink() would
+        # also unregister from the resource tracker, which _untrack already
+        # did (double-unregister prints KeyErrors from the tracker daemon).
+        try:
+            shared_memory._posixshmem.shm_unlink(shm._name)  # noqa: SLF001
+        except FileNotFoundError:
+            pass
+
+    def _path(self) -> str:
+        return os.path.join("/dev/shm", self.name)
+
+    def _file_ino(self) -> Optional[int]:
+        try:
+            return os.stat(self._path()).st_ino
+        except OSError:
+            return None
+
+    def _record_ino(self) -> None:
+        # Prefer the mapped fd's inode (no race with concurrent recreate).
+        fd = getattr(self._shm, "_fd", -1)
+        try:
+            self._ino = os.fstat(fd).st_ino if fd >= 0 else self._file_ino()
+        except OSError:
+            self._ino = self._file_ino()
+
     @property
     def size(self) -> int:
         return self._shm.size if self._shm else 0
@@ -523,7 +567,7 @@ class SharedMemorySegment:
         return self._shm.buf if self._shm else None
 
     def exists(self) -> bool:
-        return os.path.exists(os.path.join("/dev/shm", self.name))
+        return os.path.exists(self._path())
 
     def ensure(self, size: int) -> None:
         """Create the segment, growing (recreating) it if too small."""
@@ -540,18 +584,25 @@ class SharedMemorySegment:
                 self._shm = existing
             else:
                 existing.close()
-                existing.unlink()
+                self._posix_unlink(existing)
                 self._shm = shared_memory.SharedMemory(
                     name=self.name, create=True, size=size
                 )
         self._untrack(self._shm)
+        self._record_ino()
 
     def attach(self) -> bool:
         if self._shm is not None:
-            return True
+            # The creator may have grown the segment (unlink + recreate
+            # under the same name); a cached mapping would then silently
+            # read the orphaned old segment. Detect via inode change.
+            if self._file_ino() == self._ino and self._ino is not None:
+                return True
+            self.close()
         try:
             self._shm = shared_memory.SharedMemory(name=self.name)
             self._untrack(self._shm)
+            self._record_ino()
             return True
         except FileNotFoundError:
             return False
@@ -576,16 +627,9 @@ class SharedMemorySegment:
         if self._shm is None and not self.attach():
             return
         shm, self._shm = self._shm, None
+        self._ino = None
         try:
             shm.close()
-            # Unlink via the posix call directly: SharedMemory.unlink()
-            # would also unregister from the resource tracker, which we
-            # already did at create/attach time (double-unregister prints
-            # KeyErrors from the tracker daemon).
-            from multiprocessing import shared_memory as _sm
-
-            _sm._posixshmem.shm_unlink(shm._name)  # noqa: SLF001
-        except FileNotFoundError:
-            pass
         except Exception:
-            logger.warning("failed to unlink shm %s", self.name, exc_info=True)
+            pass
+        self._posix_unlink(shm)

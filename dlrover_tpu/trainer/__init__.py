@@ -1,0 +1,11 @@
+"""Training-process-side API (runs inside the supervised JAX process).
+
+TPU re-design of ``dlrover/trainer/``: the elastic bootstrap reads the
+agent's env contract and initializes ``jax.distributed``; the trainer
+utilities (elastic context, step reporting, data sharding) talk to the
+master over the same control plane as the agent.
+"""
+
+from .elastic import ElasticContext, elastic_context
+
+__all__ = ["ElasticContext", "elastic_context"]

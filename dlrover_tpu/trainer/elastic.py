@@ -1,0 +1,117 @@
+"""Elastic bootstrap for the JAX training process.
+
+The agent hands this process its place in the world via the
+``NodeEnv`` contract (reference: per-node env in
+dlrover/python/common/constants.py NodeEnv, consumed by torchrun in the
+reference; consumed by ``jax.distributed.initialize`` here). Every
+restart of the process is a fresh world: process_id / num_processes may
+differ from the previous incarnation, and the training script is
+expected to rebuild its Mesh from ``jax.devices()`` after ``initialize``.
+"""
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..common.constants import NodeEnv
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+
+@dataclass
+class ElasticContext:
+    """This process's coordinates in the elastic world."""
+
+    node_id: int = 0
+    node_rank: int = 0
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator: str = ""
+    restart_count: int = 0
+    master_addr: str = ""
+    job_name: str = "local_job"
+
+    _client: Optional[MasterClient] = None
+    _step_t0: float = 0.0
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @classmethod
+    def from_env(cls) -> "ElasticContext":
+        env = os.environ
+        return cls(
+            node_id=int(env.get(NodeEnv.NODE_ID, "0")),
+            node_rank=int(env.get(NodeEnv.NODE_RANK, "0")),
+            num_processes=int(env.get(NodeEnv.NUM_PROCESSES, "1")),
+            process_id=int(env.get(NodeEnv.PROCESS_ID, "0")),
+            coordinator=env.get(NodeEnv.COORDINATOR_ADDRESS, ""),
+            restart_count=int(env.get(NodeEnv.RESTART_COUNT, "0")),
+            master_addr=env.get(NodeEnv.MASTER_ADDR, ""),
+            job_name=env.get(NodeEnv.JOB_NAME, "local_job"),
+        )
+
+    def initialize_jax(self) -> None:
+        """Bring up the multi-host JAX runtime for this world.
+
+        Single-process worlds skip ``jax.distributed`` entirely — that is
+        also the standalone/test path where the process uses the local
+        (or virtual CPU) devices directly.
+        """
+        if self.num_processes <= 1 or not self.coordinator:
+            logger.info("single-process world; skipping jax.distributed")
+            return
+        import jax
+
+        logger.info(
+            "jax.distributed.initialize(coordinator=%s, num_processes=%s, "
+            "process_id=%s)",
+            self.coordinator,
+            self.num_processes,
+            self.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+
+    # -- master control-plane helpers ------------------------------------
+
+    @property
+    def client(self) -> Optional[MasterClient]:
+        if self._client is None and self.master_addr:
+            self._client = MasterClient.singleton()
+        return self._client
+
+    def report_step(
+        self, step: int, elapsed_s: float = 0.0, tokens_per_s: float = 0.0
+    ) -> None:
+        """Feed the master's PerfMonitor / hang detector."""
+        if self.client is None:
+            return
+        try:
+            self.client.report_training_step(
+                step=step, elapsed_s=elapsed_s, tokens_per_s=tokens_per_s
+            )
+        except Exception as e:
+            logger.debug("step report failed: %s", e)
+
+    def start_step_timer(self) -> None:
+        self._step_t0 = time.time()
+
+
+_context: Optional[ElasticContext] = None
+
+
+def elastic_context(initialize: bool = True) -> ElasticContext:
+    """Process-wide singleton; builds from env and (optionally) brings up
+    the JAX distributed runtime on first call."""
+    global _context
+    if _context is None:
+        _context = ElasticContext.from_env()
+        if initialize:
+            _context.initialize_jax()
+    return _context

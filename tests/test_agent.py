@@ -1,0 +1,285 @@
+"""Elastic agent: rendezvous handler, worker supervision, failure handling.
+
+Mirrors the reference's agent test approach (SURVEY.md §4): real
+rendezvous against an in-process LocalJobMaster, real subprocess workers
+(tiny scripts written to tmp_path), no cluster.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent, WorkerFailure
+from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
+from dlrover_tpu.agent.training_agent import (
+    AGENT_EXIT_OK,
+    AGENT_EXIT_RELAUNCH,
+    ElasticTrainingAgent,
+)
+from dlrover_tpu.agent.worker import WorkerProcess, WorkerSpec, WorkerState
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.master.diagnosis.action import DiagnosisActionType
+from dlrover_tpu.master.local_master import LocalJobMaster
+from dlrover_tpu.rpc.client import MasterClient
+
+
+@pytest.fixture()
+def master2():
+    m = LocalJobMaster(num_workers=2, fresh_context=True)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def master1():
+    m = LocalJobMaster(num_workers=1, fresh_context=True)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _client(master, node_id):
+    return MasterClient(
+        master_addr=master.addr, node_id=node_id, service_type="grpc"
+    )
+
+
+class TestRendezvousHandler:
+    def test_two_nodes_assemble_world(self, master2):
+        results = {}
+
+        def join(rank):
+            handler = MasterRendezvousHandler(
+                RendezvousName.TRAINING,
+                node_rank=rank,
+                client=_client(master2, rank),
+                rdzv_timeout=30,
+            )
+            results[rank] = handler.next_rendezvous()
+
+        threads = [threading.Thread(target=join, args=(r,)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(results) == [0, 1]
+        w0, w1 = results[0], results[1]
+        assert w0.world_size == w1.world_size == 2
+        assert {w0.rank, w1.rank} == {0, 1}
+        assert w0.coordinator == w1.coordinator
+        assert ":" in w0.coordinator
+
+    def test_rank_is_topology_position(self, master1):
+        handler = MasterRendezvousHandler(
+            RendezvousName.TRAINING,
+            node_rank=7,
+            client=_client(master1, 7),
+            rdzv_timeout=30,
+        )
+        world = handler.next_rendezvous()
+        # Single node: process_id 0 regardless of its node_rank.
+        assert world.rank == 0
+        assert world.world_size == 1
+        assert world.world[0].node_rank == 7
+
+
+def _write_script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(body)
+    return str(path)
+
+
+class TestWorkerProcess:
+    def test_success_lifecycle(self, tmp_path):
+        script = _write_script(tmp_path, "ok.py", "print('hello')\n")
+        w = WorkerProcess(WorkerSpec(entrypoint=script, log_dir=str(tmp_path)))
+        w.start()
+        result = w.wait(timeout=30)
+        assert result.state == WorkerState.SUCCEEDED
+        assert "hello" in w.tail_log()
+
+    def test_failure_captures_log(self, tmp_path):
+        script = _write_script(
+            tmp_path, "bad.py", "raise RuntimeError('boom-xyz')\n"
+        )
+        w = WorkerProcess(WorkerSpec(entrypoint=script, log_dir=str(tmp_path)))
+        w.start()
+        result = w.wait(timeout=30)
+        assert result.state == WorkerState.FAILED
+        assert result.returncode == 1
+        assert "boom-xyz" in w.tail_log()
+
+    def test_stop_kills_process_group(self, tmp_path):
+        script = _write_script(
+            tmp_path,
+            "sleep.py",
+            "import time\nprint('up', flush=True)\ntime.sleep(600)\n",
+        )
+        spec = WorkerSpec(entrypoint=script, log_dir=str(tmp_path), kill_grace_s=2)
+        w = WorkerProcess(spec)
+        w.start()
+        deadline = time.time() + 20
+        while "up" not in w.tail_log() and time.time() < deadline:
+            time.sleep(0.1)
+        assert w.poll().state == WorkerState.RUNNING
+        pid = w.pid
+        w.stop()
+        assert w.poll().state in (WorkerState.FAILED, WorkerState.SUCCEEDED)
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+    def test_env_contract_passed(self, tmp_path):
+        script = _write_script(
+            tmp_path,
+            "env.py",
+            "import os\nprint('PID=' + os.environ['DLROVER_PROCESS_ID'])\n",
+        )
+        w = WorkerProcess(WorkerSpec(entrypoint=script, log_dir=str(tmp_path)))
+        w.start(dynamic_env={"DLROVER_PROCESS_ID": "3"})
+        w.wait(timeout=30)
+        assert "PID=3" in w.tail_log()
+
+
+class TestDiagnosisClassification:
+    def _agent(self, master, max_restarts=3):
+        return DiagnosisAgent(
+            0, client=_client(master, 0), max_restarts=max_restarts
+        )
+
+    def test_retryable_restarts(self, master1):
+        d = self._agent(master1)
+        f = WorkerFailure(0, 0, 1, None, log_tail="Connection refused by peer")
+        assert (
+            d.diagnose_training_failure(f) == DiagnosisActionType.RESTART_WORKER
+        )
+
+    def test_node_fatal_relaunches(self, master1):
+        d = self._agent(master1)
+        f = WorkerFailure(0, 0, 1, None, log_tail="Failed to initialize TPU system")
+        assert (
+            d.diagnose_training_failure(f) == DiagnosisActionType.RELAUNCH_WORKER
+        )
+
+    def test_budget_exhaustion_relaunches(self, master1):
+        d = self._agent(master1, max_restarts=2)
+        f = WorkerFailure(0, 2, 1, None, log_tail="whatever")
+        assert (
+            d.diagnose_training_failure(f) == DiagnosisActionType.RELAUNCH_WORKER
+        )
+
+
+def _make_agent(master, tmp_path, script, node_rank=0, **cfg_kw):
+    cfg = ElasticLaunchConfig(
+        min_nodes=1,
+        max_nodes=cfg_kw.pop("max_nodes", 1),
+        node_id=node_rank,
+        node_rank=node_rank,
+        entrypoint=script,
+        master_addr=master.addr,
+        monitor_interval=0.2,
+        rdzv_timeout=30,
+        save_at_breakpoint=False,
+        log_dir=str(tmp_path / f"logs{node_rank}"),
+        **cfg_kw,
+    )
+    return ElasticTrainingAgent(
+        cfg, client=_client(master, node_rank), start_ckpt_saver=False
+    )
+
+
+class TestElasticTrainingAgent:
+    def test_successful_run(self, master1, tmp_path):
+        script = _write_script(tmp_path, "ok.py", "print('done')\n")
+        agent = _make_agent(master1, tmp_path, script)
+        assert agent.run() == AGENT_EXIT_OK
+
+    def test_restart_then_success(self, master1, tmp_path):
+        # Fails on first run, succeeds once the marker file exists.
+        marker = tmp_path / "marker"
+        script = _write_script(
+            tmp_path,
+            "flaky.py",
+            f"""
+import os, sys
+marker = {str(marker)!r}
+if not os.path.exists(marker):
+    open(marker, 'w').close()
+    sys.exit(3)
+print('recovered')
+""",
+        )
+        agent = _make_agent(master1, tmp_path, script, max_restarts=2)
+        assert agent.run() == AGENT_EXIT_OK
+        assert agent._restart_count == 1
+
+    def test_relaunch_when_budget_exhausted(self, master1, tmp_path):
+        script = _write_script(tmp_path, "bad.py", "import sys\nsys.exit(5)\n")
+        agent = _make_agent(master1, tmp_path, script, max_restarts=0)
+        assert agent.run() == AGENT_EXIT_RELAUNCH
+
+    def test_membership_change_triggers_re_rendezvous(self, master2, tmp_path):
+        """Two agents; kill one worker → both re-rendezvous into round 1.
+
+        This is the core elastic scenario (reference training.py:1262):
+        a healthy agent notices waiters and restarts its worker group so
+        the whole world re-meshes.
+        """
+        script = _write_script(
+            tmp_path,
+            "sleep.py",
+            "import time\nprint('up', flush=True)\ntime.sleep(120)\n",
+        )
+        agents = [
+            _make_agent(master2, tmp_path, script, node_rank=r, max_nodes=2)
+            for r in (0, 1)
+        ]
+        codes = {}
+        threads = [
+            threading.Thread(target=lambda r=r: codes.update({r: agents[r].run()}))
+            for r in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+
+        def wait_for(cond, timeout=30):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.1)
+            return False
+
+        # Both workers up in round 0.
+        assert wait_for(
+            lambda: all(
+                a._worker is not None
+                and a._worker.poll().state == WorkerState.RUNNING
+                for a in agents
+            )
+        )
+        assert agents[0]._world.round == agents[1]._world.round == 0
+        victim_pid = agents[1]._worker.pid
+
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # Both agents must land in a new world (round 1) with live workers.
+        assert wait_for(
+            lambda: all(
+                a._world is not None
+                and a._world.round == 1
+                and a._worker.poll().state == WorkerState.RUNNING
+                for a in agents
+            ),
+            timeout=60,
+        ), f"worlds: {[a._world and a._world.round for a in agents]}"
+        assert agents[0]._world.world_size == 2
+
+        for a in agents:
+            a.stop()
+        for t in threads:
+            t.join(timeout=30)

@@ -39,6 +39,7 @@ class ElasticLaunchConfig:
     accelerator: str = Accelerators.TPU
     network_check: bool = False
     comm_perf_test: bool = False
+    exclude_straggler: bool = False
     auto_config: bool = False
     max_restarts: int = DefaultValues.MAX_RELAUNCH_COUNT
     monitor_interval: float = DefaultValues.MONITOR_INTERVAL_S

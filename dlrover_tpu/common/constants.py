@@ -76,6 +76,12 @@ class RendezvousName:
     NETWORK_CHECK = "network-check"
 
 
+class NodeCheckConstants:
+    # Rounds per check sequence: adjacent pairs, then fastest-with-slowest.
+    # The agent's check loop and the master's round state machine must agree.
+    CHECK_ROUNDS = 2
+
+
 class PlatformType:
     LOCAL = "local"
     KUBERNETES = "k8s"

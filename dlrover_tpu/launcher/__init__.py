@@ -1,0 +1,1 @@
+"""tpurun launcher: CLI, elastic launch, pre-flight node checks."""

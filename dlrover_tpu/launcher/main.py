@@ -1,0 +1,8 @@
+"""Console entry for ``tpurun`` (reference dlrover/trainer/torch/main.py)."""
+
+import sys
+
+from .elastic_run import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Pre-flight node health check (agent side).
+
+Reference: ``NodeCheckElasticAgent.run`` (dlrover/python/elastic_agent/
+torch/training.py:1584) spawning matmul+allreduce subprocesses
+(``trainer/torch/node_check/nvidia_gpu.py:52-84``), with the master's
+``NetworkCheckRendezvousManager`` pairing hosts (adjacent pairs, then
+fastest-with-slowest) so a both-round failure pins the faulty host, and
+stragglers flagged at elapsed > ratio × median (rdzv_manager.py:610-799).
+
+TPU-native check per host:
+  1. device check — enumerate local chips, time a bf16 matmul sized to
+     land on the MXU (device FLOPs sanity);
+  2. intra-host collective — ``psum`` over the local device mesh (ICI on
+     a real host, XLA CPU ring in tests);
+  3. pair exchange — a KV-store payload round-trip with the pair peer
+     assigned by the master (DCN control-plane reachability + latency).
+
+Each round reports (normal, elapsed) to the master; the launcher then
+reads fault/straggler verdicts. Runs inline in the agent process — JAX
+is initialized local-only (no global mesh yet), which is exactly the
+pre-rendezvous state tpurun is in.
+"""
+
+import time
+from typing import Optional, Tuple
+
+from ..common.constants import NodeCheckConstants, RendezvousName
+from ..common.log import logger
+from ..rpc.client import MasterClient
+from ..agent.config import ElasticLaunchConfig
+from ..agent.rendezvous import MasterRendezvousHandler
+
+CHECK_ROUNDS = NodeCheckConstants.CHECK_ROUNDS
+_MATMUL_DIM = 1024
+
+
+def _device_matmul_seconds() -> Tuple[bool, float]:
+    """Time a bf16 matmul on every local device; False on any failure."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        devices = jax.local_devices()
+        if not devices:
+            return False, 0.0
+        x = jnp.ones((_MATMUL_DIM, _MATMUL_DIM), jnp.bfloat16)
+        started = time.monotonic()
+        for dev in devices:
+            xd = jax.device_put(x, dev)
+            (xd @ xd).block_until_ready()
+        return True, time.monotonic() - started
+    except Exception as e:  # device enumeration/compile failure = faulty
+        logger.error("device matmul check failed: %s", e)
+        return False, 0.0
+
+
+def _local_collective_seconds() -> Tuple[bool, float]:
+    """Time a psum across the local devices (single-host mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        devices = jax.local_devices()
+        if len(devices) < 2:
+            return True, 0.0
+        n = len(devices)
+        started = time.monotonic()
+        out = jax.pmap(
+            lambda x: jax.lax.psum(x, "d"), axis_name="d", devices=devices
+        )(jnp.ones((n, 128)))
+        out.block_until_ready()
+        return True, time.monotonic() - started
+    except Exception as e:
+        logger.error("local collective check failed: %s", e)
+        return False, 0.0
+
+
+def _pair_exchange_seconds(
+    client: MasterClient,
+    node_rank: int,
+    peer_rank: Optional[int],
+    wave: int,
+    payload_bytes: int = 1 << 16,
+    timeout: float = 60.0,
+) -> Tuple[bool, float]:
+    """KV-store payload round-trip with the pair peer.
+
+    Both members write ``netcheck/<wave>/<rank>`` then poll for the
+    peer's key; elapsed covers write + peer visibility, a control-plane
+    proxy for DCN reachability (the data-plane equivalent needs a formed
+    world, which is what this check gates). Keys are namespaced by the
+    rendezvous wave round — unique per join wave across the whole job —
+    so a re-run after a node relaunch never reads a stale payload from a
+    previous check sequence.
+    """
+    if peer_rank is None:
+        return True, 0.0
+    payload = bytes(payload_bytes)
+    try:
+        started = time.monotonic()
+        client.kv_store_set(f"netcheck/{wave}/{node_rank}", payload)
+        deadline = started + timeout
+        peer_key = f"netcheck/{wave}/{peer_rank}"
+        while time.monotonic() < deadline:
+            value = client.kv_store_get(peer_key)
+            if value:
+                return len(value) == payload_bytes, time.monotonic() - started
+            time.sleep(0.2)
+        logger.error("pair exchange with rank %s timed out", peer_rank)
+        return False, time.monotonic() - started
+    except Exception as e:
+        logger.error("pair exchange failed: %s", e)
+        return False, 0.0
+
+
+def run_node_check(
+    config: ElasticLaunchConfig, client: Optional[MasterClient] = None
+) -> bool:
+    """Run CHECK_ROUNDS rounds of the pre-flight check.
+
+    Returns True when this node may proceed to the training rendezvous;
+    False when the master marked it faulty (the launcher exits nonzero so
+    the platform replaces the node — reference training.py:1787).
+    """
+    client = client or MasterClient.singleton()
+    for round_idx in range(CHECK_ROUNDS):
+        handler = MasterRendezvousHandler(
+            RendezvousName.NETWORK_CHECK,
+            node_rank=config.node_rank,
+            client=client,
+            node_id=config.node_id,
+            local_world_size=config.local_world_size,
+            rdzv_timeout=config.rdzv_timeout,
+        )
+        world = handler.next_rendezvous()
+        peer = None
+        member_ranks = sorted(m.node_rank for m in world.world.values())
+        if len(member_ranks) == 2:
+            peer = (
+                member_ranks[1]
+                if member_ranks[0] == config.node_rank
+                else member_ranks[0]
+            )
+        ok_m, t_m = _device_matmul_seconds()
+        ok_c, t_c = _local_collective_seconds()
+        ok_p, t_p = _pair_exchange_seconds(
+            client, config.node_rank, peer, world.round
+        )
+        normal = ok_m and ok_c and ok_p
+        elapsed = t_m + t_c + t_p
+        client.report_network_check_result(
+            normal, elapsed, round=round_idx, node_rank=config.node_rank
+        )
+        logger.info(
+            "node check round %s: normal=%s elapsed=%.3fs (matmul=%.3f "
+            "collective=%.3f pair=%.3f)",
+            round_idx,
+            normal,
+            elapsed,
+            t_m,
+            t_c,
+            t_p,
+        )
+        _wait_round_results(client)
+    fault_nodes = client.get_fault_nodes()
+    stragglers = client.get_stragglers()
+    if stragglers:
+        logger.warning("straggler nodes detected: %s", stragglers)
+    if config.node_rank in fault_nodes:
+        logger.error("this node failed the health check; asking for relaunch")
+        return False
+    if config.node_rank in stragglers and config.exclude_straggler:
+        logger.error("this node is a straggler and exclusion is on")
+        return False
+    return True
+
+
+def _wait_round_results(
+    client: MasterClient, timeout: float = 120.0
+) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        resp = client.network_ready()
+        if resp.ready:
+            return
+        time.sleep(0.5)
+    logger.warning("node check round results incomplete after %.0fs", timeout)

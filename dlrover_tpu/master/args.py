@@ -1,0 +1,70 @@
+"""Master CLI argument parsing.
+
+Reference: ``dlrover/python/master/args.py:22-110`` — job name, platform,
+port, node counts and timeouts. The TPU master keeps the same surface but
+speaks host/slice instead of pod/PS.
+"""
+
+import argparse
+
+from ..common.constants import DefaultValues, PlatformType
+
+
+def _pos_int(value: str) -> int:
+    res = int(value)
+    if res <= 0:
+        raise argparse.ArgumentTypeError(f"must be positive: {value}")
+    return res
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="dlrover-tpu job master")
+    parser.add_argument("--job_name", default="local_job", help="job name")
+    parser.add_argument(
+        "--platform",
+        default=PlatformType.LOCAL,
+        choices=[
+            PlatformType.LOCAL,
+            PlatformType.KUBERNETES,
+            PlatformType.GKE_TPU,
+            PlatformType.RAY,
+        ],
+        help="scheduling platform backing the job",
+    )
+    parser.add_argument(
+        "--port", type=int, default=0, help="RPC port (0 picks a free one)"
+    )
+    parser.add_argument(
+        "--num_workers",
+        type=_pos_int,
+        default=1,
+        help="number of TPU hosts (JAX processes) in the job",
+    )
+    parser.add_argument(
+        "--node_unit",
+        type=_pos_int,
+        default=1,
+        help="world sizes must be multiples of this (hosts per slice)",
+    )
+    parser.add_argument(
+        "--service_type",
+        default=DefaultValues.SERVICE_TYPE,
+        help="master RPC transport: grpc | http | local",
+    )
+    parser.add_argument(
+        "--pending_timeout",
+        type=int,
+        default=DefaultValues.SEC_TO_WAIT_PENDING_POD,
+        help="seconds a node may stay pending before early stop",
+    )
+    parser.add_argument(
+        "--port_file",
+        default="",
+        help="if set, write the bound RPC port to this file once serving "
+        "(lets a parent process discover a port picked with --port 0)",
+    )
+    return parser
+
+
+def parse_master_args(args=None) -> argparse.Namespace:
+    return build_master_parser().parse_args(args)

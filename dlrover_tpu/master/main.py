@@ -1,0 +1,59 @@
+"""Master process entry: ``python -m dlrover_tpu.master.main``.
+
+Reference: ``dlrover/python/master/main.py:46,91`` — parse args, build the
+platform job args, compose the master, serve until the job finishes.
+The standalone launcher (`tpurun --standalone`) spawns exactly this module
+as a subprocess (reference elastic_run.py:300-329).
+"""
+
+import sys
+
+from ..common.log import logger
+from .args import parse_master_args
+from .local_master import LocalJobMaster
+
+
+def run(namespace) -> int:
+    from ..common.constants import PlatformType
+
+    if namespace.platform in (PlatformType.KUBERNETES, PlatformType.GKE_TPU):
+        try:
+            from .dist_master import DistributedJobMaster
+        except ImportError as e:
+            raise SystemExit(
+                f"platform {namespace.platform!r} needs the distributed "
+                f"master, which failed to import: {e}"
+            )
+        master = DistributedJobMaster.from_args(namespace)
+    else:
+        master = LocalJobMaster(
+            port=namespace.port,
+            num_workers=namespace.num_workers,
+            node_unit=namespace.node_unit,
+            service_type=namespace.service_type,
+        )
+    master.prepare()
+    if namespace.port_file:
+        with open(namespace.port_file, "w") as f:
+            f.write(str(master.port))
+    logger.info(
+        "job master serving job=%s addr=%s workers=%s",
+        namespace.job_name,
+        master.addr,
+        namespace.num_workers,
+    )
+    try:
+        master.run()
+    finally:
+        master.stop()
+    from ..common.constants import JobExitReason
+
+    return 0 if master.exit_reason == JobExitReason.SUCCEEDED else 1
+
+
+def main(args=None) -> int:
+    return run(parse_master_args(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

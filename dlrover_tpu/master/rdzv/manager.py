@@ -22,8 +22,11 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ...common import comm
 from ...common.config import get_context
-from ...common.constants import RendezvousName
+from ...common.constants import NodeCheckConstants, RendezvousName
 from ...common.log import logger
+
+# Check rounds per sequence: adjacent pairs, then fastest-with-slowest.
+CHECK_ROUNDS = NodeCheckConstants.CHECK_ROUNDS
 
 
 class NodeTopologyMeta(comm.NodeMeta):
@@ -255,7 +258,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def _group_nodes(self, round: int) -> List[List[int]]:
         """Caller holds the lock. Round 0: adjacent pairs (:610-631);
         round 1: fastest paired with slowest (:632-655)."""
-        round = round % 2
+        round = round % CHECK_ROUNDS
         if round in self._group_cache:
             return self._group_cache[round]
         ranks = sorted(self._rdzv_nodes)
@@ -283,25 +286,38 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         return groups
 
     def report_network_check_result(
-        self, node_id: int, normal: bool, elapsed: float
+        self, node_id: int, normal: bool, elapsed: float, round_idx: int = -1
     ) -> None:
         with self._lock:
-            self._node_times.setdefault(self._check_round, {})[node_id] = elapsed
-            self._node_status.setdefault(self._check_round, {})[node_id] = normal
+            r = self._check_round if round_idx < 0 else round_idx
+            self._node_times.setdefault(r, {})[node_id] = elapsed
+            self._node_status.setdefault(r, {})[node_id] = normal
 
-    def _on_new_wave(self) -> None:
-        """A fresh join wave restarts the check-round pair (0, 1) and drops
-        results that belong to the previous world."""
-        self._check_round = 0
+    def _complete(self, limit: Optional[int] = None) -> None:
+        """A completed join wave transitions the check-round state machine.
+
+        If the current round has a full result set, the new wave begins
+        the next round (round 1 keeps round-0 times for its fastest-with-
+        slowest grouping); after the last round it starts a fresh check
+        sequence (a node was replaced) and drops stale results. A wave
+        completing with the current round only partially reported means
+        membership changed mid-round (late elastic joiner): stay on the
+        same round and drop the partial results, which belong to the old
+        membership.
+        """
+        prev_members = set(self._latest_members)
+        super()._complete(limit)
         self._group_cache.clear()
-        self._node_times.clear()
-        self._node_status.clear()
-
-    def next_check_round(self) -> int:
-        with self._lock:
+        reported = self._node_status.get(self._check_round, {})
+        if prev_members and len(reported) >= len(prev_members):
             self._check_round += 1
-            self._group_cache.clear()
-            return self._check_round
+            if self._check_round >= CHECK_ROUNDS:
+                self._check_round = 0
+                self._node_times.clear()
+                self._node_status.clear()
+        elif reported:
+            self._node_status.pop(self._check_round, None)
+            self._node_times.pop(self._check_round, None)
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         """Reference :732. A node is faulty if it reported not-normal in the
@@ -343,10 +359,18 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             return sorted(stragglers)
 
     def network_ready(self) -> Tuple[bool, str]:
-        """All members of the current round reported → ready."""
+        """All members of the latest reported round are in → ready.
+
+        Uses ``_latest_members`` (survives the join wave that opens the
+        next check round) so late pollers of a finished round are not
+        stranded when a fast peer has already re-joined.
+        """
         with self._lock:
-            status = self._node_status.get(self._check_round, {})
-            expected = len(self._rdzv_nodes)
-            if expected == 0 or len(status) < expected:
+            if not self._node_status:
+                return False, "no results yet"
+            latest = max(self._node_status)
+            status = self._node_status[latest]
+            expected = len(self._latest_members) or len(status)
+            if len(status) < expected:
                 return False, "results pending"
             return True, ""

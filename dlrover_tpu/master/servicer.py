@@ -126,7 +126,9 @@ class MasterServicer:
         manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         if manager is not None:
             rank = msg.node_rank if msg.node_rank >= 0 else msg.node_id
-            manager.report_network_check_result(rank, msg.normal, msg.elapsed_time)
+            manager.report_network_check_result(
+                rank, msg.normal, msg.elapsed_time, round_idx=msg.round
+            )
 
     def _fault_nodes(self, msg: comm.FaultNodesRequest) -> comm.FaultNodesResponse:
         manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)

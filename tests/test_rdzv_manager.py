@@ -133,6 +133,13 @@ class TestNetworkCheckRendezvous:
         for r in range(n):
             m.join_rendezvous(_meta(r))
 
+    def _next_round(self, m, n):
+        """Advance the check round the way production does: all members
+        re-join (a new wave) after fully reporting the current round."""
+        for r in range(n):
+            m.join_rendezvous(_meta(r))
+        m.get_comm_world(0)
+
     def test_adjacent_pairs_round0(self):
         m = NetworkCheckRendezvousManager()
         self._complete(m, 4)
@@ -150,7 +157,7 @@ class TestNetworkCheckRendezvous:
         times = {0: 1.0, 1: 8.0, 2: 2.0, 3: 3.0}
         for n, t in times.items():
             m.report_network_check_result(n, True, t)
-        m.next_check_round()
+        self._next_round(m, 4)
         _, _, w = m.get_comm_world(0)
         # Fastest (0) paired with slowest (1)
         assert {meta.node_rank for meta in w.values()} == {0, 1}
@@ -168,7 +175,7 @@ class TestNetworkCheckRendezvous:
         m.report_network_check_result(3, True, 1.0)
         fault, _ = m.check_fault_node()
         assert set(fault) == {0, 1}
-        m.next_check_round()
+        self._next_round(m, 4)
         # Round 1: different pairing exonerates node 0
         m.report_network_check_result(0, True, 1.0)
         m.report_network_check_result(1, False, 1.0)
@@ -203,6 +210,40 @@ class TestNetworkCheckRendezvous:
         assert {meta.node_rank for meta in w.values()} == {2}
 
 
+    def test_network_check_state_reset_on_new_wave(self):
+        m = NetworkCheckRendezvousManager()
+        m.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60, node_unit=1)
+        m.join_rendezvous(_meta(0))
+        m.join_rendezvous(_meta(1))
+        m.get_comm_world(0)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, False, 9.0)
+        # Wave 2 begins check round 1 and keeps round-0 results
+        self._next_round(m, 2)
+        assert m._check_round == 1
+        assert 0 in m._node_status
+        m.report_network_check_result(0, True, 1.0, round_idx=1)
+        m.report_network_check_result(1, False, 9.0, round_idx=1)
+        # Wave 3 after a full sequence: fresh sequence, results dropped
+        self._next_round(m, 2)
+        assert m._check_round == 0
+        assert m._node_status == {}
+
+    def test_mid_round_membership_change_drops_partials(self):
+        """A wave completing while the current round is only partially
+        reported (late elastic joiner) stays on the same round and drops
+        the partial results of the old membership."""
+        m = NetworkCheckRendezvousManager()
+        self._complete(m, 4)
+        m.get_comm_world(0)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
+        # Only 2/4 reported; all re-join (e.g. a membership change)
+        self._next_round(m, 4)
+        assert m._check_round == 0
+        assert m._node_status.get(0, {}) == {}
+
+
 class TestElasticCycle:
     def test_second_round_completes_after_fault(self):
         """Regression: the post-fault re-rendezvous must produce a NEW world
@@ -224,18 +265,3 @@ class TestElasticCycle:
         assert len(world1) == 2
         assert world1[1].addr == "b2"
 
-    def test_network_check_state_reset_on_new_wave(self):
-        m = NetworkCheckRendezvousManager()
-        m.update_rdzv_params(min_nodes=2, max_nodes=2, waiting_timeout=60, node_unit=1)
-        m.join_rendezvous(_meta(0))
-        m.join_rendezvous(_meta(1))
-        m.get_comm_world(0)
-        m.report_network_check_result(0, True, 1.0)
-        m.report_network_check_result(1, False, 9.0)
-        m.next_check_round()
-        # New wave: previous world's results must not leak into the new one
-        m.join_rendezvous(_meta(0))
-        m.join_rendezvous(_meta(1))
-        m.get_comm_world(0)
-        assert m._check_round == 0
-        assert m._node_status == {}

@@ -1,0 +1,161 @@
+"""Dynamic data sharding, worker side.
+
+Reference: ``ShardingClient`` (dlrover/python/elastic_agent/sharding/
+client.py:29) and ``IndexShardingClient`` (:232): workers pull shard
+tasks from the master's TaskManager, report completion, and the master
+re-queues uncompleted shards of dead workers — fault-tolerant,
+at-least-once data delivery decoupled from the worker count, which is
+what makes elasticity safe for data order (SURVEY §2.8).
+
+TPU shape: one client per host (JAX process). The task's shard is a
+sample-index range [start, end); the host feeds those indices to its
+input pipeline (grain/tf.data-style) and reports when consumed. Because
+shards are pulled, a re-meshed world with a different host count keeps
+exactly-once-or-requeued semantics without any rank arithmetic.
+"""
+
+import threading
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+from ..common import comm
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+
+class ShardingClient:
+    """Pull shard tasks for one dataset; report completion (at-least-once)."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        client: Optional[MasterClient] = None,
+        batch_size: int = 1,
+        num_epochs: int = 1,
+        dataset_size: int = 0,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        storage_type: str = "text",
+        task_type: str = "training",
+    ):
+        self._client = client or MasterClient.singleton()
+        self.dataset_name = dataset_name
+        self._params = comm.DatasetShardParams(
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            dataset_size=dataset_size,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            storage_type=storage_type,
+            dataset_name=dataset_name,
+            task_type=task_type,
+        )
+        self._registered = False
+        self._current_task: Optional[comm.TaskMsg] = None
+        self._lock = threading.Lock()
+
+    def register_dataset(self) -> None:
+        """Idempotent on the master side; every host calls it so any host
+        (including a replacement) can bootstrap the dataset."""
+        if not self._registered:
+            self._client.report_dataset_params(self._params)
+            self._registered = True
+
+    def fetch_task(self) -> Optional[comm.TaskMsg]:
+        """Next shard task, or None when the dataset is exhausted."""
+        self.register_dataset()
+        task = self._client.get_task(self.dataset_name)
+        if task is None or task.task_id < 0 or task.shard is None:
+            return None
+        with self._lock:
+            self._current_task = task
+        return task
+
+    def report_task_done(self, task: comm.TaskMsg, success: bool = True) -> None:
+        self._client.report_task_result(self.dataset_name, task.task_id, success)
+        with self._lock:
+            if self._current_task is task:
+                self._current_task = None
+
+    def current_task(self) -> Optional[comm.TaskMsg]:
+        with self._lock:
+            return self._current_task
+
+    # -- data-state checkpoint (resume exactly where data delivery was) ----
+
+    def get_shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore_shard_checkpoint(self, content: str) -> None:
+        self._client.restore_shard_checkpoint(self.dataset_name, content)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream on top of shard tasks (reference :232).
+
+    ``fetch_sample_index`` refills an index queue from the next shard and
+    auto-reports a shard done once every index in it has been consumed.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._indices: Deque[int] = deque()
+        self._pending_task: Optional[comm.TaskMsg] = None
+        self._consumed_of_task = 0
+
+    def fetch_sample_index(self) -> Optional[int]:
+        if not self._indices and not self._refill():
+            return None
+        index = self._indices.popleft()
+        self._consumed_of_task += 1
+        if not self._indices and self._pending_task is not None:
+            self.report_task_done(self._pending_task)
+            self._pending_task = None
+        return index
+
+    def _refill(self) -> bool:
+        task = self.fetch_task()
+        if task is None or task.shard is None:
+            return False
+        shard = task.shard
+        if shard.indices:
+            self._indices.extend(shard.indices)
+        else:
+            self._indices.extend(range(shard.start, shard.end))
+        self._pending_task = task
+        self._consumed_of_task = 0
+        return bool(self._indices)
+
+    def report_batch_done(self, batch_size: int) -> None:
+        """Compatibility hook for pipelines that count samples themselves."""
+        # Index-mode auto-reports per shard; nothing to do here.
+
+
+def iter_dataset_shards(
+    sharding_client: ShardingClient,
+) -> "ShardIterator":
+    return ShardIterator(sharding_client)
+
+
+class ShardIterator:
+    """Iterate (task, index_list) pairs, reporting each shard on advance."""
+
+    def __init__(self, client: ShardingClient):
+        self._client = client
+        self._prev: Optional[comm.TaskMsg] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> List[int]:
+        if self._prev is not None:
+            self._client.report_task_done(self._prev)
+            self._prev = None
+        task = self._client.fetch_task()
+        if task is None:
+            raise StopIteration
+        self._prev = task
+        shard = task.shard
+        if shard.indices:
+            return list(shard.indices)
+        return list(range(shard.start, shard.end))

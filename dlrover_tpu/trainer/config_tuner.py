@@ -1,0 +1,72 @@
+"""Auto-tuning config poller (trainer side).
+
+Reference: ``ParalConfigTuner`` (dlrover/python/elastic_agent/config/
+paral_config_tuner.py:30) polls the master's ``get_paral_config`` and
+hands new versions to the data pipeline (``ElasticDataLoader.
+update_batch_size``, dataloader.py:133). The reference relays through a
+JSON file agent→trainer; here the trainer process polls the control
+plane directly — same DCN channel, one fewer hop.
+"""
+
+import threading
+from typing import Callable, List, Optional
+
+from ..common import comm
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+
+class ParalConfigTuner:
+    def __init__(
+        self,
+        client: Optional[MasterClient] = None,
+        poll_interval_s: float = 30.0,
+    ):
+        self._client = client or MasterClient.singleton()
+        self._interval = poll_interval_s
+        self._callbacks: List[Callable[[comm.ParallelConfig], None]] = []
+        self._last_version = -1
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def on_update(self, fn: Callable[[comm.ParallelConfig], None]) -> None:
+        self._callbacks.append(fn)
+
+    def attach_dataloader(self, loader) -> None:
+        self.on_update(
+            lambda cfg: cfg.dataloader_batch_size
+            and loader.update_batch_size(cfg.dataloader_batch_size)
+        )
+
+    def poll_once(self) -> Optional[comm.ParallelConfig]:
+        try:
+            config = self._client.get_paral_config()
+        except Exception as e:
+            logger.debug("paral config poll failed: %s", e)
+            return None
+        if config is None or config.version <= self._last_version:
+            return None
+        self._last_version = config.version
+        for fn in self._callbacks:
+            try:
+                fn(config)
+            except Exception:
+                logger.exception("paral config callback failed")
+        return config
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="paral-config-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None

@@ -1,0 +1,189 @@
+"""Elastic data pipeline for JAX hosts.
+
+Reference: ``ElasticDataLoader`` (dlrover/trainer/torch/elastic/
+dataloader.py:133, master-tuned batch size) and
+``ElasticDistributedSampler`` (dlrover/trainer/torch/elastic/
+sampler.py:25, state_dict/load_state_dict for exact data resume).
+
+Two modes, both TPU-first (per-host pipelines feeding a global batch):
+
+- **ElasticShardLoader** — dynamic sharding: the host pulls whole shard
+  tasks from the master ([start,end) index ranges) and batches them.
+  Worker-count changes need no rank arithmetic; unfinished shards of
+  dead hosts are re-queued by the master. Resume = master-side shard
+  checkpoint (get/restore via the sharding client).
+- **ElasticDistributedSampler** — static striding: classic
+  rank-strided sampling with `set_epoch`, whose `state_dict` /
+  `load_state_dict` lets a re-meshed world (different num_replicas)
+  resume mid-epoch at the same sample position.
+
+Both produce *per-host* batches; the training step assembles the global
+batch via `jax.make_array_from_process_local_data` (the data axis of the
+mesh spans processes).
+"""
+
+import math
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..agent.sharding import ShardingClient
+from ..common.log import logger
+
+
+class ElasticDistributedSampler:
+    """Rank-strided sampler with exact-resume state (reference sampler.py:25).
+
+    ``state_dict()`` records the epoch and the number of samples already
+    consumed globally; ``load_state_dict`` replays into any new
+    (num_replicas, rank) layout — the completed fraction is skipped in
+    the new stride pattern, so no sample is double-trained after an
+    elastic re-mesh.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int = 1,
+        rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(f"rank {rank} out of range for {num_replicas}")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.consumed_samples = 0  # global, across replicas
+        if drop_last:
+            self.num_samples = dataset_size // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_size / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.consumed_samples = 0
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if not self.drop_last and len(indices) < self.total_size:
+            pad = self.total_size - len(indices)
+            indices = np.concatenate([indices, indices[:pad]])
+        return indices[: self.total_size]
+
+    def __iter__(self) -> Iterator[int]:
+        indices = self._global_indices()
+        start = self.consumed_samples
+        for i in range(start + self.rank, self.total_size, self.num_replicas):
+            self.consumed_samples += self.num_replicas
+            yield int(indices[i])
+
+    def __len__(self) -> int:
+        remaining = self.total_size - self.consumed_samples
+        return max(0, remaining // self.num_replicas)
+
+    def state_dict(self) -> Dict[str, int]:
+        """Reference sampler.py:116-135."""
+        return {"epoch": self.epoch, "completed_num": self.consumed_samples}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        completed = int(state.get("completed_num", 0))
+        # Round down to a multiple of the new replica count so every new
+        # rank resumes at the same stride offset.
+        self.consumed_samples = (completed // self.num_replicas) * self.num_replicas
+
+
+class ElasticShardLoader:
+    """Batches from master-assigned shards (dynamic sharding mode).
+
+    ``fetch_fn(indices) -> batch`` turns a list of sample indices into a
+    host-local batch (numpy arrays / pytrees); the loader pulls shard
+    tasks, slices them into batches, and reports each shard consumed.
+    ``update_batch_size`` applies master auto-tuning (reference
+    dataloader.py:133).
+    """
+
+    def __init__(
+        self,
+        sharding_client: ShardingClient,
+        fetch_fn: Callable[[List[int]], Any],
+        batch_size: int,
+        drop_remainder: bool = True,
+    ):
+        self._client = sharding_client
+        self._fetch = fetch_fn
+        self.batch_size = batch_size
+        self._drop_remainder = drop_remainder
+        self._leftover: List[int] = []
+        # FIFO of (task, samples of it still unconsumed): a shard is
+        # reported done only after its last sample was *yielded*, so a
+        # host dying mid-shard gets the whole shard re-queued
+        # (at-least-once delivery, reference client.py:29).
+        self._open_tasks: List[List[Any]] = []
+
+    def update_batch_size(self, batch_size: int) -> None:
+        if batch_size > 0 and batch_size != self.batch_size:
+            logger.info(
+                "batch size %s -> %s (master tuning)", self.batch_size, batch_size
+            )
+            self.batch_size = batch_size
+
+    def _consume(self, count: int) -> None:
+        while count > 0 and self._open_tasks:
+            entry = self._open_tasks[0]
+            take = min(count, entry[1])
+            entry[1] -= take
+            count -= take
+            if entry[1] == 0:
+                self._client.report_task_done(entry[0])
+                self._open_tasks.pop(0)
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            while len(self._leftover) < self.batch_size:
+                task = self._client.fetch_task()
+                if task is None:
+                    if self._leftover and not self._drop_remainder:
+                        batch, self._leftover = self._leftover, []
+                        self._consume(len(batch))
+                        yield self._fetch(batch)
+                    return
+                shard = task.shard
+                indices = (
+                    list(shard.indices)
+                    if shard.indices
+                    else list(range(shard.start, shard.end))
+                )
+                self._leftover.extend(indices)
+                self._open_tasks.append([task, len(indices)])
+            batch = self._leftover[: self.batch_size]
+            self._leftover = self._leftover[self.batch_size :]
+            self._consume(self.batch_size)
+            yield self._fetch(batch)
+
+
+def make_global_array(local_batch, mesh, pspec):
+    """Assemble a globally-sharded jax.Array from per-host batches.
+
+    The data axes of ``pspec`` span processes; each host contributes the
+    rows it read. This is the host-pipeline → device-mesh handoff.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(
+            jax.sharding.NamedSharding(mesh, pspec), np.asarray(x)
+        ),
+        local_batch,
+    )

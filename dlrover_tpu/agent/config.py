@@ -41,6 +41,8 @@ class ElasticLaunchConfig:
     comm_perf_test: bool = False
     exclude_straggler: bool = False
     auto_config: bool = False
+    # Worker-side ParalConfigTuner polls master tuning configs when set.
+    auto_tunning: bool = False
     max_restarts: int = DefaultValues.MAX_RELAUNCH_COUNT
     monitor_interval: float = DefaultValues.MONITOR_INTERVAL_S
     rdzv_timeout: float = DefaultValues.RDZV_TIMEOUT_S
@@ -77,4 +79,6 @@ class ElasticLaunchConfig:
         env[NodeEnv.NODE_RANK] = str(self.node_rank)
         env[NodeEnv.NODE_NUM] = str(self.max_nodes)
         env[NodeEnv.NODE_UNIT] = str(self.node_unit)
+        if self.auto_tunning:
+            env[NodeEnv.AUTO_TUNNING] = "1"
         return env

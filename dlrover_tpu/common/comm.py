@@ -140,6 +140,8 @@ class WaitingNodeNumResponse:
 @dataclass
 class NetworkReadyRequest:
     node_id: int = 0
+    # Rendezvous wave whose check-round results are awaited (-1 = latest).
+    round: int = -1
 
 
 @register_message

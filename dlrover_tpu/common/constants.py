@@ -131,6 +131,7 @@ class NodeEnv:
     PROCESS_ID = "DLROVER_PROCESS_ID"
     RESTART_COUNT = "DLROVER_RESTART_COUNT"
     MONITOR_ENABLED = "DLROVER_MONITOR_ENABLED"
+    AUTO_TUNNING = "DLROVER_AUTO_TUNNING"
 
 
 class GRPC:

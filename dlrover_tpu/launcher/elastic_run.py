@@ -100,7 +100,7 @@ def parse_args(args: Optional[List[str]] = None) -> argparse.Namespace:
     )
     parser.add_argument(
         "--save_at_breakpoint",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
         default=DefaultValues.SAVE_AT_BREAKPOINT,
         help="persist the staged shm checkpoint when workers fail",
     )
@@ -169,6 +169,7 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         comm_perf_test=ns.comm_perf_test,
         exclude_straggler=ns.exclude_straggler,
         auto_config=ns.auto_config,
+        auto_tunning=ns.auto_tunning,
         max_restarts=ns.max_restarts,
         save_at_breakpoint=ns.save_at_breakpoint,
         training_port=ns.training_port,
@@ -264,9 +265,15 @@ def wait_pre_check(
             continue
         if resp.status == PreCheckStatus.PASSED:
             return True
-        if resp.status == PreCheckStatus.FAILED and level >= 2:
-            logger.error("master pre-check failed: %s", resp.reason)
-            return False
+        if resp.status == PreCheckStatus.FAILED:
+            if level >= 2:
+                logger.error("master pre-check failed: %s", resp.reason)
+                return False
+            logger.warning(
+                "master pre-check failed (%s); proceeding at level 1",
+                resp.reason,
+            )
+            return True
         time.sleep(2)
     logger.error("pre-check did not pass within %.0fs", timeout)
     return level < 2

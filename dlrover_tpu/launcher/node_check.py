@@ -146,22 +146,27 @@ def run_node_check(
         ok_p, t_p = _pair_exchange_seconds(
             client, config.node_rank, peer, world.round
         )
+        if config.comm_perf_test:
+            _comm_perf_report(config)
         normal = ok_m and ok_c and ok_p
         elapsed = t_m + t_c + t_p
+        # Echo the wave number back: the master owns the wave→check-round
+        # mapping, so a restarted check loop cannot desync the rounds.
         client.report_network_check_result(
-            normal, elapsed, round=round_idx, node_rank=config.node_rank
+            normal, elapsed, round=world.round, node_rank=config.node_rank
         )
         logger.info(
-            "node check round %s: normal=%s elapsed=%.3fs (matmul=%.3f "
-            "collective=%.3f pair=%.3f)",
+            "node check round %s (wave %s): normal=%s elapsed=%.3fs "
+            "(matmul=%.3f collective=%.3f pair=%.3f)",
             round_idx,
+            world.round,
             normal,
             elapsed,
             t_m,
             t_c,
             t_p,
         )
-        _wait_round_results(client)
+        _wait_round_results(client, wave=world.round)
     fault_nodes = client.get_fault_nodes()
     stragglers = client.get_stragglers()
     if stragglers:
@@ -176,12 +181,49 @@ def run_node_check(
 
 
 def _wait_round_results(
-    client: MasterClient, timeout: float = 120.0
+    client: MasterClient, wave: int = -1, timeout: float = 120.0
 ) -> None:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        resp = client.network_ready()
+        resp = client.network_ready(round=wave)
         if resp.ready:
             return
         time.sleep(0.5)
     logger.warning("node check round results incomplete after %.0fs", timeout)
+
+
+def _comm_perf_report(config: ElasticLaunchConfig) -> None:
+    """--comm-perf-test: measure local-mesh allreduce bus bandwidth.
+
+    Reference: comm-perf subprocess in trainer/torch/node_check. On a
+    real TPU host this exercises ICI; in tests, the XLA CPU ring. The
+    result is logged (and lands in the straggler statistics through the
+    overall elapsed time on repeat runs).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        devices = jax.local_devices()
+        n = len(devices)
+        if n < 2:
+            return
+        mb = 8
+        x = jnp.ones((n, mb * 1024 * 1024 // 4), jnp.float32)
+        psum = jax.pmap(lambda v: jax.lax.psum(v, "d"), axis_name="d")
+        psum(x).block_until_ready()  # compile
+        started = time.monotonic()
+        psum(x).block_until_ready()
+        dt = time.monotonic() - started
+        # ring allreduce moves 2(n-1)/n of the payload per device
+        bus_gb = (mb / 1024) * 2 * (n - 1) / n * n
+        logger.info(
+            "comm perf: %d devices, %.1f MB/device allreduce in %.4fs "
+            "(~%.2f GB/s bus)",
+            n,
+            float(mb),
+            dt,
+            bus_gb / dt if dt > 0 else 0.0,
+        )
+    except Exception as e:
+        logger.warning("comm perf test failed: %s", e)

@@ -235,6 +235,12 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._fault_nodes: Set[int] = set()
         self._stragglers: Set[int] = set()
         self._group_cache: Dict[int, List[List[int]]] = {}
+        # The master owns the wave→check-round mapping: agents report and
+        # poll by the globally-unique rendezvous wave number, so an agent
+        # restarting its check loop can never desync the round state
+        # machine (it simply echoes back the wave it was handed).
+        self._wave_check_round: Dict[int, int] = {}
+        self._round_members: Dict[int, Set[int]] = {}  # round → expected
 
     def get_comm_world(
         self, node_rank: int
@@ -288,36 +294,55 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     def report_network_check_result(
         self, node_id: int, normal: bool, elapsed: float, round_idx: int = -1
     ) -> None:
+        """``round_idx`` is the *wave* number the agent was handed by
+        ``get_comm_world`` (echoed back); the master maps it to its check
+        round. Unknown/absent wave falls back to the current round."""
         with self._lock:
-            r = self._check_round if round_idx < 0 else round_idx
+            r = self._wave_check_round.get(round_idx, self._check_round)
             self._node_times.setdefault(r, {})[node_id] = elapsed
             self._node_status.setdefault(r, {})[node_id] = normal
 
     def _complete(self, limit: Optional[int] = None) -> None:
         """A completed join wave transitions the check-round state machine.
 
-        If the current round has a full result set, the new wave begins
-        the next round (round 1 keeps round-0 times for its fastest-with-
-        slowest grouping); after the last round it starts a fresh check
-        sequence (a node was replaced) and drops stale results. A wave
-        completing with the current round only partially reported means
-        membership changed mid-round (late elastic joiner): stay on the
-        same round and drop the partial results, which belong to the old
-        membership.
+        Same membership with a full result set for the current round →
+        the wave begins the next round (round 1 keeps round-0 times for
+        its fastest-with-slowest grouping), wrapping to a fresh sequence
+        after the last round. Changed membership (replacement host, late
+        elastic joiner, shrink) → fresh sequence: all previous results
+        belong to a different world and are dropped. Same membership but
+        only partial results → a wave fired mid-round (e.g. agents
+        relaunched after an aborted sequence): stay on the round, drop
+        the partials.
         """
         prev_members = set(self._latest_members)
         super()._complete(limit)
         self._group_cache.clear()
+        new_members = set(self._latest_members)
         reported = self._node_status.get(self._check_round, {})
-        if prev_members and len(reported) >= len(prev_members):
+        if prev_members == new_members and len(reported) >= len(new_members):
             self._check_round += 1
             if self._check_round >= CHECK_ROUNDS:
                 self._check_round = 0
                 self._node_times.clear()
                 self._node_status.clear()
-        elif reported:
+            else:
+                # leftovers for the newly-opened round can't be trusted
+                self._node_status.pop(self._check_round, None)
+                self._node_times.pop(self._check_round, None)
+        elif prev_members != new_members:
+            self._check_round = 0
+            self._node_times.clear()
+            self._node_status.clear()
+        else:
             self._node_status.pop(self._check_round, None)
             self._node_times.pop(self._check_round, None)
+        wave = self._rdzv_round - 1
+        self._wave_check_round[wave] = self._check_round
+        self._round_members[self._check_round] = new_members
+        # keep the wave map bounded (only recent waves are ever echoed)
+        for old in [w for w in self._wave_check_round if w < wave - 8]:
+            del self._wave_check_round[old]
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         """Reference :732. A node is faulty if it reported not-normal in the
@@ -358,19 +383,26 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._stragglers = set(stragglers)
             return sorted(stragglers)
 
-    def network_ready(self) -> Tuple[bool, str]:
-        """All members of the latest reported round are in → ready.
+    def network_ready(self, wave: int = -1) -> Tuple[bool, str]:
+        """All members of the given wave's check round reported → ready.
 
-        Uses ``_latest_members`` (survives the join wave that opens the
-        next check round) so late pollers of a finished round are not
-        stranded when a fast peer has already re-joined.
+        ``wave`` is what the agent was handed by ``get_comm_world``;
+        membership is the set recorded when that wave completed (it
+        survives the next join wave, so late pollers of a finished round
+        are not stranded when a fast peer has already re-joined). Without
+        a wave, falls back to the latest reported round.
         """
         with self._lock:
             if not self._node_status:
                 return False, "no results yet"
-            latest = max(self._node_status)
-            status = self._node_status[latest]
-            expected = len(self._latest_members) or len(status)
-            if len(status) < expected:
+            if wave >= 0 and wave in self._wave_check_round:
+                r = self._wave_check_round[wave]
+            else:
+                r = max(self._node_status)
+            status = self._node_status.get(r, {})
+            expected = len(self._round_members.get(r, set())) or len(
+                self._latest_members
+            )
+            if expected == 0 or len(status) < expected:
                 return False, "results pending"
             return True, ""

@@ -119,7 +119,7 @@ class MasterServicer:
         manager = self._rdzv_managers.get(RendezvousName.NETWORK_CHECK)
         if manager is None:
             return comm.NetworkReadyResponse(ready=True)
-        ready, reason = manager.network_ready()
+        ready, reason = manager.network_ready(wave=msg.round)
         return comm.NetworkReadyResponse(ready=ready, reason=reason)
 
     def _report_network_check(self, msg: comm.NetworkCheckResult) -> None:

@@ -197,8 +197,8 @@ class MasterClient:
         )
         return resp.waiting_num if isinstance(resp, comm.WaitingNodeNumResponse) else 0
 
-    def network_ready(self) -> comm.NetworkReadyResponse:
-        return self.get(comm.NetworkReadyRequest(node_id=self.node_id))
+    def network_ready(self, round: int = -1) -> comm.NetworkReadyResponse:
+        return self.get(comm.NetworkReadyRequest(node_id=self.node_id, round=round))
 
     def report_network_check_result(
         self, normal: bool, elapsed_time: float, round: int = 0, node_rank: int = -1

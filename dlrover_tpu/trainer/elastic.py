@@ -31,6 +31,7 @@ class ElasticContext:
     restart_count: int = 0
     master_addr: str = ""
     job_name: str = "local_job"
+    auto_tunning: bool = False
 
     _client: Optional[MasterClient] = None
     _step_t0: float = 0.0
@@ -51,6 +52,7 @@ class ElasticContext:
             restart_count=int(env.get(NodeEnv.RESTART_COUNT, "0")),
             master_addr=env.get(NodeEnv.MASTER_ADDR, ""),
             job_name=env.get(NodeEnv.JOB_NAME, "local_job"),
+            auto_tunning=env.get(NodeEnv.AUTO_TUNNING, "") == "1",
         )
 
     def initialize_jax(self) -> None:
@@ -101,6 +103,19 @@ class ElasticContext:
 
     def start_step_timer(self) -> None:
         self._step_t0 = time.time()
+
+    def start_config_tuner(self, dataloader=None):
+        """Start the auto-tuning poller when the launcher enabled it
+        (``tpurun --auto_tunning``); returns the tuner or None."""
+        if not self.auto_tunning or self.client is None:
+            return None
+        from .config_tuner import ParalConfigTuner
+
+        tuner = ParalConfigTuner(client=self.client)
+        if dataloader is not None:
+            tuner.attach_dataloader(dataloader)
+        tuner.start()
+        return tuner
 
 
 _context: Optional[ElasticContext] = None

@@ -1,0 +1,216 @@
+"""Master-side diagnosis: pre-check chain, hang detection, action loop.
+
+Reference: ``DiagnosisMaster`` (dlrover/python/master/diagnosis/
+diagnosis_master.py:73): ``pre_check`` (:100) running an operator chain
+(``precheck_operator.py:63`` — SchedulingPreCheckOperator gang-wait
+:91, ConnectionPreCheckOperator :352), metric monitors (:272), hang
+check (:359 — "tensor-util zero for hang_downtime AND step events
+stalled") and the ``_diagnose`` loop (:465) feeding the action queues.
+
+TPU hang signal: no kernel-level NCCL hooks exist for XLA, so the hang
+check watermarks *step events* reported by trainers (ElasticContext.
+report_step) — a stalled watermark across all hosts for longer than
+``hang_downtime_s`` while workers are RUNNING means the job is wedged
+(usually a collective stall after a silent host loss); the action is a
+job-level restart of the worker group.
+"""
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ...common.config import get_context
+from ...common.constants import (
+    JobExitReason,
+    NodeStatus,
+    NodeType,
+    PreCheckStatus,
+)
+from ...common.log import logger
+from ..job_context import get_job_context
+from .action import (
+    DiagnosisActionType,
+    EventAction,
+    JobAbortionAction,
+    NodeAction,
+)
+
+
+@dataclass
+class PreCheckResult:
+    passed: bool = True
+    reason: str = ""
+    # nodes to relaunch before retrying the check
+    abnormal_nodes: List[int] = field(default_factory=list)
+
+
+class PreCheckOperator(ABC):
+    """Reference precheck_operator.py:63."""
+
+    retry_interval_s: float = 2.0
+    max_retries: int = 150
+
+    @abstractmethod
+    def check(self) -> PreCheckResult:
+        ...
+
+    def recover(self, result: PreCheckResult) -> None:
+        """Optional recovery between retries (e.g. relaunch bad nodes)."""
+
+
+class SchedulingPreCheckOperator(PreCheckOperator):
+    """Gang-wait: every expected worker is scheduled (RUNNING) before
+    training rendezvous proceeds (reference :91)."""
+
+    def __init__(self, expected_workers: int):
+        self._expected = expected_workers
+        self._job_ctx = get_job_context()
+
+    def check(self) -> PreCheckResult:
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        running = [
+            n for n in workers.values() if n.status == NodeStatus.RUNNING
+        ]
+        if len(running) >= self._expected:
+            return PreCheckResult(passed=True)
+        return PreCheckResult(
+            passed=False,
+            reason=f"{len(running)}/{self._expected} workers scheduled",
+        )
+
+
+class ConnectionPreCheckOperator(PreCheckOperator):
+    """All expected agents have opened a control-plane connection
+    (heartbeat seen) — reference :352."""
+
+    def __init__(self, expected_workers: int, window_s: float = 120.0):
+        self._expected = expected_workers
+        self._window = window_s
+        self._job_ctx = get_job_context()
+
+    def check(self) -> PreCheckResult:
+        now = time.time()
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        connected = [
+            n
+            for n in workers.values()
+            if n.heartbeat_time > 0 and now - n.heartbeat_time < self._window
+        ]
+        if len(connected) >= self._expected:
+            return PreCheckResult(passed=True)
+        return PreCheckResult(
+            passed=False,
+            reason=f"{len(connected)}/{self._expected} agents connected",
+        )
+
+
+class DiagnosisMaster:
+    def __init__(self, operators: Optional[List[PreCheckOperator]] = None):
+        self._ctx = get_context()
+        self._job_ctx = get_job_context()
+        self._operators = operators or []
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._hang_since: Optional[float] = None
+        self._hang_reported = False
+
+    # -- pre-check chain ---------------------------------------------------
+
+    def pre_check(self) -> bool:
+        """Run the operator chain; sets job-context pre-check status
+        (reference diagnosis_master.py:100). Blocking."""
+        if not self._ctx.precheck_enabled or not self._operators:
+            self._job_ctx.pre_check_status = PreCheckStatus.PASSED
+            return True
+        self._job_ctx.pre_check_status = PreCheckStatus.CHECKING
+        for op in self._operators:
+            attempts = 0
+            while True:
+                result = op.check()
+                if result.passed:
+                    break
+                attempts += 1
+                if attempts > op.max_retries:
+                    self._job_ctx.pre_check_status = PreCheckStatus.FAILED
+                    self._job_ctx.pre_check_reason = result.reason
+                    logger.error(
+                        "pre-check %s failed: %s",
+                        type(op).__name__,
+                        result.reason,
+                    )
+                    return False
+                op.recover(result)
+                time.sleep(op.retry_interval_s)
+        self._job_ctx.pre_check_status = PreCheckStatus.PASSED
+        return True
+
+    # -- periodic diagnosis ------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._diagnose_loop, name="diagnosis-master", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None
+
+    def _diagnose_loop(self) -> None:
+        interval = max(1.0, self._ctx.monitor_interval_s)
+        while not self._stopped.wait(interval):
+            try:
+                self.observe_once()
+            except Exception:
+                logger.exception("diagnosis loop error")
+
+    def observe_once(self) -> None:
+        if self._ctx.hang_detection_enabled:
+            self._check_hang()
+
+    def _check_hang(self) -> None:
+        """Step-watermark hang detection (reference :359 adapted)."""
+        last_step_time = self._job_ctx.last_step_time
+        if last_step_time <= 0:
+            return  # training has not produced a step yet
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        running = [
+            n for n in workers.values() if n.status == NodeStatus.RUNNING
+        ]
+        if not running:
+            self._hang_since = None
+            self._hang_reported = False
+            return
+        stalled_for = time.time() - last_step_time
+        if stalled_for < self._ctx.hang_downtime_s:
+            self._hang_since = None
+            self._hang_reported = False
+            return
+        if self._hang_reported:
+            return
+        self._hang_reported = True
+        logger.error(
+            "hang detected: no training step for %.0fs (> %.0fs) with %s "
+            "running workers; restarting worker group",
+            stalled_for,
+            self._ctx.hang_downtime_s,
+            len(running),
+        )
+        self._job_ctx.master_actions.add_action(
+            EventAction(event_type="hang", msg=f"stalled {stalled_for:.0f}s")
+        )
+        # Ask every agent to restart its worker: the re-rendezvous clears
+        # wedged collectives and excludes silently-dead hosts.
+        for node in running:
+            self._job_ctx.node_actions.add_action(
+                NodeAction(
+                    node_id=node.node_id,
+                    action_type=DiagnosisActionType.RESTART_WORKER,
+                    reason="hang",
+                )
+            )

@@ -1,0 +1,252 @@
+"""Distributed job master: full composition for cluster platforms.
+
+Reference: ``DistributedJobMaster`` (dlrover/python/master/
+dist_master.py:98): composes JobManager + TaskManager + rendezvous
+managers + DiagnosisMaster + PerfMonitor + servicer (:132-166),
+``prepare`` starts server & managers (:194), ``run`` is the 30s
+supervision loop checking early-stop / all-exited / hang / completion
+(:276-370) with the diagnosis action thread (:223).
+
+Platform wiring:
+- ``local-proc``: ProcessScaler/ProcessWatcher — worker "hosts" are
+  local agent processes (production standalone + chaos harness).
+- ``k8s``/``gke_tpu``: PodScaler/PodWatcher (requires the kubernetes
+  client in the image).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.config import get_context
+from ..common.constants import (
+    JobExitReason,
+    JobStage,
+    PlatformType,
+    PreCheckStatus,
+    RendezvousName,
+)
+from ..common.events import MasterEvents
+from ..common.log import logger
+from ..rpc.server import create_master_server
+from .diagnosis.action import DiagnosisActionType, JobAbortionAction
+from .diagnosis.diagnosis_master import (
+    ConnectionPreCheckOperator,
+    DiagnosisMaster,
+    PreCheckOperator,
+    SchedulingPreCheckOperator,
+)
+from .job_context import JobContext, get_job_context
+from .kv_store import KVStoreService
+from .monitor.perf_monitor import PerfMonitor
+from .node.dist_job_manager import DistributedJobManager
+from .node.job_auto_scaler import JobAutoScaler
+from .rdzv.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from .resource.optimizer import (
+    FixedResourceOptimizer,
+    ThroughputScalingOptimizer,
+)
+from .scaler.base_scaler import NoopScaler, Scaler
+from .servicer import MasterServicer
+from .shard.task_manager import TaskManager
+from .sync_service import SyncService
+
+
+class DistributedJobMaster:
+    def __init__(
+        self,
+        scaler: Scaler,
+        watcher=None,
+        port: int = 0,
+        num_workers: int = 1,
+        max_workers: int = 0,
+        node_unit: int = 1,
+        service_type: str = "",
+        job_name: str = "job",
+        pre_check_ops: Optional[List[PreCheckOperator]] = None,
+        fresh_context: bool = True,
+    ):
+        ctx = get_context()
+        if fresh_context:
+            JobContext.reset()
+        self._job_ctx = get_job_context()
+        self._events = MasterEvents()
+        self.job_name = job_name
+        self.num_workers = num_workers
+        self.max_workers = max_workers or num_workers
+
+        self.job_manager = DistributedJobManager(
+            num_workers=num_workers,
+            scaler=scaler,
+            watcher=watcher,
+            node_unit=node_unit,
+        )
+        training_rdzv = ElasticTrainingRendezvousManager()
+        training_rdzv.update_rdzv_params(
+            min_nodes=min(num_workers, self.max_workers),
+            max_nodes=self.max_workers,
+            waiting_timeout=ctx.rdzv_timeout_s,
+            node_unit=node_unit,
+        )
+        check_rdzv = NetworkCheckRendezvousManager()
+        check_rdzv.update_rdzv_params(
+            min_nodes=min(num_workers, self.max_workers),
+            max_nodes=self.max_workers,
+            waiting_timeout=ctx.node_check_timeout_s,
+            node_unit=node_unit,
+        )
+        self.rdzv_managers: Dict[str, RendezvousManager] = {
+            RendezvousName.TRAINING: training_rdzv,
+            RendezvousName.NETWORK_CHECK: check_rdzv,
+        }
+        self.task_manager = TaskManager()
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService(default_expected=num_workers)
+        self.perf_monitor = PerfMonitor()
+        self.diagnosis_master = DiagnosisMaster(
+            operators=pre_check_ops
+            if pre_check_ops is not None
+            else [
+                SchedulingPreCheckOperator(expected_workers=num_workers),
+                ConnectionPreCheckOperator(expected_workers=num_workers),
+            ]
+        )
+        optimizer = (
+            ThroughputScalingOptimizer(
+                self.perf_monitor,
+                max_workers=self.max_workers,
+                node_unit=node_unit,
+            )
+            if self.max_workers > num_workers
+            else FixedResourceOptimizer()
+        )
+        self.auto_scaler = JobAutoScaler(
+            optimizer=optimizer,
+            scaler=scaler,
+            node_unit=node_unit,
+            max_workers=self.max_workers,
+            world_size_fn=training_rdzv.world_size,
+        )
+        self.servicer = MasterServicer(
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            task_manager=self.task_manager,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            perf_monitor=self.perf_monitor,
+        )
+        service_type = service_type or ctx.master_comms()
+        self._server, self.port = create_master_server(
+            self.servicer, service_type, port
+        )
+        self._stopped = threading.Event()
+        self.exit_reason = ""
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def prepare(self) -> None:
+        """Reference dist_master.py:194 — server, managers, pre-check."""
+        self._server.start()
+        self.job_manager.start()
+        self._job_ctx.set_stage(JobStage.PRE_CHECK)
+        self._events.start(port=self.port)
+        # Pre-check runs in the background so prepare() doesn't block the
+        # servicer; agents poll get_pre_check_result.
+        threading.Thread(
+            target=self._run_pre_check, name="pre-check", daemon=True
+        ).start()
+
+    def _run_pre_check(self) -> None:
+        passed = self.diagnosis_master.pre_check()
+        if passed:
+            self._job_ctx.set_stage(JobStage.RUNNING)
+            self.diagnosis_master.start()
+            self.auto_scaler.start()
+        else:
+            self._job_ctx.master_actions.add_action(
+                JobAbortionAction(reason=JobExitReason.FATAL_ERROR)
+            )
+
+    def run_in_background(self) -> None:
+        threading.Thread(target=self.run, name="master-run", daemon=True).start()
+
+    def run(self) -> None:
+        """Supervision loop (reference dist_master.py:276-370)."""
+        while not self._stopped.is_set():
+            time.sleep(1.0)
+            try:
+                action = self._job_ctx.master_actions.next_action(-1)
+                if action.action_type == DiagnosisActionType.JOB_ABORTION:
+                    self._exit(
+                        action.config.get("reason", JobExitReason.FATAL_ERROR)
+                    )
+                    return
+                early = self.job_manager.should_early_stop()
+                if early:
+                    self._exit(early)
+                    return
+                if self.job_manager.all_workers_exited():
+                    if self.job_manager.all_workers_succeeded():
+                        self._exit(JobExitReason.SUCCEEDED)
+                    else:
+                        self._exit(JobExitReason.FATAL_ERROR)
+                    return
+                slow = self.task_manager.recover_timeout_tasks()
+                if slow:
+                    logger.warning("recovered tasks from slow nodes %s", slow)
+            except Exception:
+                logger.exception("master run loop error")
+
+    def _exit(self, reason: str) -> None:
+        self.exit_reason = reason
+        self._job_ctx.set_stage(JobStage.STOPPED, reason)
+        self._events.job_stop(reason)
+        logger.info("distributed master exiting: %s", reason)
+        self._stopped.set()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.diagnosis_master.stop()
+        self.auto_scaler.stop()
+        self.job_manager.stop()
+        self._server.stop()
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_args(cls, namespace) -> "DistributedJobMaster":
+        """Build from master CLI args (k8s/GKE platforms)."""
+        from .scaler.pod_scaler import PodScaler
+        from .watcher.k8s_watcher import PodWatcher
+        import os
+
+        job_name = namespace.job_name
+        namespace_name = os.environ.get("POD_NAMESPACE", "default")
+        master_addr = os.environ.get("DLROVER_MASTER_SERVICE_ADDR", "")
+        image = os.environ.get("DLROVER_WORKER_IMAGE", "")
+        command = os.environ.get("DLROVER_WORKER_COMMAND", "").split()
+        scaler = PodScaler(
+            job_name=job_name,
+            image=image,
+            command=command,
+            master_addr=master_addr,
+            namespace=namespace_name,
+        )
+        watcher = PodWatcher(job_name, namespace_name)
+        return cls(
+            scaler=scaler,
+            watcher=watcher,
+            port=namespace.port,
+            num_workers=namespace.num_workers,
+            node_unit=namespace.node_unit,
+            service_type=namespace.service_type,
+            job_name=job_name,
+        )

@@ -1,0 +1,184 @@
+"""Distributed job manager: watcher-driven node lifecycle + relaunch.
+
+Reference: ``DistributedJobManager`` (dlrover/python/master/node/
+dist_job_manager.py:102): node watcher thread (:459), heartbeat monitor
+(:475), event processing through the status flow (:733), relaunch policy
+(:922) issuing ScalePlans (:1010), group relaunch (:1046) and early-stop
+conditions (:257).
+
+TPU shape: a node is a TPU host; group relaunch moves in slice
+granularity (node_unit hosts at a time) because a slice with a dead
+host cannot run its ICI collectives at all.
+"""
+
+import threading
+import time
+from typing import List, Optional
+
+from ...common.config import get_context
+from ...common.constants import (
+    JobExitReason,
+    NodeEventType,
+    NodeStatus,
+    NodeType,
+)
+from ...common.log import logger
+from ...common.node import Node, NodeEvent
+from ..diagnosis.action import JobAbortionAction
+from ..scaler.base_scaler import ScalePlan, Scaler
+from ..watcher.base import NodeWatcher
+from .job_manager import JobManager
+
+
+class DistributedJobManager(JobManager):
+    def __init__(
+        self,
+        num_workers: int,
+        scaler: Scaler,
+        watcher: Optional[NodeWatcher] = None,
+        node_unit: int = 1,
+    ):
+        super().__init__(num_workers=num_workers)
+        self._scaler = scaler
+        self._watcher = watcher
+        self._node_unit = max(1, node_unit)
+        self._watch_thread: Optional[threading.Thread] = None
+        self._pending_since: Optional[float] = None
+
+    def start(self) -> None:
+        super().start()
+        self._scaler.start()
+        # Materialize the initial world.
+        self._scaler.scale(ScalePlan(worker_num=self.num_workers))
+        if self._watcher is not None:
+            self._watch_thread = threading.Thread(
+                target=self._watch_nodes, name="node-watcher", daemon=True
+            )
+            self._watch_thread.start()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._watcher is not None:
+            self._watcher.stop()
+        self._scaler.stop()
+
+    # -- platform event loop ----------------------------------------------
+
+    def _watch_nodes(self) -> None:
+        """Reference dist_job_manager.py:459 — consume watcher events."""
+        while not self._stopped:
+            try:
+                for event in self._watcher.watch():
+                    if self._stopped:
+                        return
+                    self.process_event(event)
+            except Exception:
+                logger.exception("node watcher error; retrying")
+                time.sleep(1)
+
+    def process_event(self, event: NodeEvent) -> None:
+        node = event.node
+        if node is None:
+            return
+        if event.event_type == NodeEventType.DELETED:
+            current = self._job_ctx.get_node(node.node_type, node.node_id)
+            if current is not None:
+                current.exit_reason = node.exit_reason or current.exit_reason
+                if not current.exited():
+                    current.update_status(
+                        NodeStatus.FAILED
+                        if node.status == NodeStatus.FAILED
+                        else node.status
+                    )
+                node = current
+            # Decide relaunch BEFORE marking released: a released node is
+            # never relaunchable, but this deletion IS the failure we are
+            # reacting to.
+            relaunch = (
+                node.status == NodeStatus.FAILED and node.should_relaunch()
+            )
+            node.is_released = True
+            self._job_ctx.update_node(node)
+            if node.status == NodeStatus.FAILED:
+                self._relaunch_node(node, allowed=relaunch)
+        else:
+            current = self._job_ctx.get_node(node.node_type, node.node_id)
+            if current is not None:
+                current.update_status(node.status)
+                self._job_ctx.update_node(current)
+            else:
+                self._job_ctx.update_node(node)
+
+    # -- relaunch (platform path) -----------------------------------------
+
+    def _relaunch_node(self, node: Node, allowed: Optional[bool] = None) -> None:
+        """Replace a dead node via the scaler (reference :1010)."""
+        if allowed is None:
+            allowed = node.should_relaunch()
+        if not allowed:
+            if not self._fault_tolerance_left():
+                self._job_ctx.master_actions.add_action(
+                    JobAbortionAction(reason=JobExitReason.MAX_RELAUNCH)
+                )
+            return
+        node.inc_relaunch_count()
+        self._job_ctx.update_node(node)
+        replacement = node.get_relaunch_node(node.node_id)
+        replacement.relaunch_count = node.relaunch_count
+        self._job_ctx.update_node(replacement)
+        logger.info(
+            "relaunching node %s via scaler (count %s/%s)",
+            node.node_id,
+            node.relaunch_count,
+            node.max_relaunch_count,
+        )
+        self._scaler.scale(ScalePlan(launch_nodes=[replacement]))
+
+    def relaunch_slice(self, slice_id: int) -> None:
+        """Group relaunch (reference :1046): replace every host of a
+        slice together — a slice is the unit of ICI connectivity."""
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        members = [n for n in workers.values() if n.slice_id == slice_id]
+        if not members:
+            return
+        logger.info(
+            "slice %s group relaunch: nodes %s",
+            slice_id,
+            sorted(n.node_id for n in members),
+        )
+        plan = ScalePlan(
+            remove_nodes=[n.node_id for n in members],
+            launch_nodes=[n.get_relaunch_node(n.node_id) for n in members],
+        )
+        for node in members:
+            node.inc_relaunch_count()
+            self._job_ctx.update_node(node)
+        self._scaler.scale(plan)
+
+    # -- early stop (reference should_early_stop :257) ---------------------
+
+    def should_early_stop(self) -> Optional[str]:
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        if not workers:
+            return None
+        pending = [
+            n
+            for n in workers.values()
+            if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+            and not n.is_released
+        ]
+        if pending and len(pending) == len(workers):
+            if self._pending_since is None:
+                self._pending_since = time.time()
+            elif (
+                time.time() - self._pending_since
+                > self._ctx.seconds_to_wait_pending_pod
+            ):
+                return JobExitReason.PENDING_TIMEOUT
+        else:
+            self._pending_since = None
+        if not self._fault_tolerance_left() and any(
+            n.status == NodeStatus.FAILED for n in workers.values()
+        ):
+            return JobExitReason.MAX_RELAUNCH
+        return None

@@ -1,0 +1,98 @@
+"""Job auto-scaler: execute resource plans as scale operations.
+
+Reference: ``JobAutoScaler``/``AllreduceTrainingAutoScaler``
+(dlrover/python/master/node/job_auto_scaler.py:71,276) — the allreduce
+path periodically grows workers toward the max (:315); plan execution
+flows optimizer → ResourcePlan → ScalePlan → Scaler.
+
+TPU constraint: world sizes move in node_unit (slice) steps, and a grown
+world only takes effect at the next rendezvous wave — the rendezvous
+manager admits the new hosts and the agents restart the worker group
+(num_nodes_waiting ≥ node_unit), rebuilding the mesh.
+"""
+
+import threading
+from typing import Optional
+
+from ...common import comm
+from ...common.config import get_context
+from ...common.log import logger
+from ..job_context import get_job_context
+from ..scaler.base_scaler import ScalePlan, Scaler
+from ..resource.optimizer import ResourceOptimizer, ResourcePlan
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        optimizer: ResourceOptimizer,
+        scaler: Scaler,
+        node_unit: int = 1,
+        max_workers: int = 1,
+        world_size_fn=None,
+    ):
+        self._ctx = get_context()
+        self._job_ctx = get_job_context()
+        self._optimizer = optimizer
+        self._scaler = scaler
+        self._unit = max(1, node_unit)
+        self._max = max_workers
+        # Supplies the current rendezvous world size to size-aware
+        # optimizers (ThroughputScalingOptimizer.record_world_size).
+        self._world_size_fn = world_size_fn
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def execute_job_optimization_plan(self, plan: ResourcePlan) -> None:
+        """Reference job_auto_scaler.py:71 — plan → scale + tuning push."""
+        if plan.empty():
+            return
+        if plan.dataloader_batch_size > 0 or plan.grad_accum_steps > 0:
+            prev = self._job_ctx.paral_config
+            version = (prev.version if prev else 0) + 1
+            self._job_ctx.paral_config = comm.ParallelConfig(
+                dataloader_batch_size=plan.dataloader_batch_size,
+                grad_accum_steps=plan.grad_accum_steps,
+                version=version,
+            )
+            logger.info(
+                "pushed tuning config v%s (batch=%s accum=%s)",
+                version,
+                plan.dataloader_batch_size,
+                plan.grad_accum_steps,
+            )
+        if plan.worker_num > 0:
+            target = (plan.worker_num // self._unit) * self._unit
+            target = min(target, self._max)
+            if target > 0:
+                logger.info("auto-scale to %s workers", target)
+                self._scaler.scale(ScalePlan(worker_num=target))
+
+    # -- periodic loop (allreduce auto-scale, reference :315) --------------
+
+    def start(self) -> None:
+        if self._thread is not None or not self._ctx.auto_tuning_enabled:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        interval = max(5.0, self._ctx.auto_scaling_interval_s)
+        while not self._stopped.wait(interval):
+            try:
+                if self._world_size_fn is not None and hasattr(
+                    self._optimizer, "record_world_size"
+                ):
+                    self._optimizer.record_world_size(self._world_size_fn())
+                self.execute_job_optimization_plan(
+                    self._optimizer.generate_plan()
+                )
+            except Exception:
+                logger.exception("auto-scaler loop error")
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None

@@ -326,6 +326,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 self._check_round = 0
                 self._node_times.clear()
                 self._node_status.clear()
+                self._round_members.clear()
             else:
                 # leftovers for the newly-opened round can't be trusted
                 self._node_status.pop(self._check_round, None)
@@ -334,6 +335,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             self._check_round = 0
             self._node_times.clear()
             self._node_status.clear()
+            self._round_members.clear()
         else:
             self._node_status.pop(self._check_round, None)
             self._node_times.pop(self._check_round, None)

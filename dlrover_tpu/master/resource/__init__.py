@@ -1,0 +1,1 @@
+"""Resource plans & optimizers (reference master/resource/)."""

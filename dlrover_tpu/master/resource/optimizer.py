@@ -1,0 +1,105 @@
+"""Resource plans and optimizers.
+
+Reference: ``ResourcePlan``/``ResourceOptimizer`` (dlrover/python/
+master/resource/optimizer.py:48,134) + the stats-driven single-job
+``PSLocalOptimizer`` (local_optimizer.py:66). The PS-specific parts
+(hot-PS migration) don't exist on TPU; what carries over is the split:
+an optimizer produces a platform-neutral plan from observed stats, the
+auto-scaler executes it.
+
+TPU specifics: the scaling unit is a slice (node_unit hosts); valid
+worker counts are multiples of it. Throughput modelling is per-host
+step speed from the PerfMonitor.
+"""
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ...common.log import logger
+from ...common.node import NodeResource
+
+
+@dataclass
+class ResourcePlan:
+    """A desired adjustment (reference optimizer.py:48)."""
+
+    # target worker (host) count; 0 = no opinion
+    worker_num: int = 0
+    node_resources: Dict[str, NodeResource] = field(default_factory=dict)
+    # tuning suggestions delivered to trainers via ParallelConfig
+    dataloader_batch_size: int = 0
+    grad_accum_steps: int = 0
+
+    def empty(self) -> bool:
+        return (
+            self.worker_num <= 0
+            and not self.node_resources
+            and self.dataloader_batch_size <= 0
+            and self.grad_accum_steps <= 0
+        )
+
+
+class ResourceOptimizer(ABC):
+    @abstractmethod
+    def generate_plan(self) -> ResourcePlan:
+        ...
+
+
+class FixedResourceOptimizer(ResourceOptimizer):
+    """No-op optimizer for fixed-size jobs."""
+
+    def generate_plan(self) -> ResourcePlan:
+        return ResourcePlan()
+
+
+class ThroughputScalingOptimizer(ResourceOptimizer):
+    """Grow the job while throughput scales, stop when it saturates.
+
+    The allreduce-path analogue of the reference's stats-driven local
+    optimizer: track steps/s at each world size; propose +node_unit
+    hosts while marginal speedup per host stays above ``min_gain``.
+    """
+
+    def __init__(
+        self,
+        perf_monitor,
+        max_workers: int,
+        node_unit: int = 1,
+        min_gain_per_host: float = 0.4,
+    ):
+        self._perf = perf_monitor
+        self._max = max_workers
+        self._unit = max(1, node_unit)
+        self._min_gain = min_gain_per_host
+        self._speed_at_size: Dict[int, float] = {}
+        self._current_size = 0
+
+    def record_world_size(self, size: int) -> None:
+        self._current_size = size
+
+    def generate_plan(self) -> ResourcePlan:
+        speed = self._perf.steps_per_second()
+        size = self._current_size
+        if size <= 0 or speed <= 0:
+            return ResourcePlan()
+        self._speed_at_size[size] = speed
+        target = size + self._unit
+        if target > self._max:
+            return ResourcePlan()
+        prev_sizes = [s for s in self._speed_at_size if s < size]
+        if prev_sizes:
+            prev = max(prev_sizes)
+            gained = self._speed_at_size[size] - self._speed_at_size[prev]
+            per_host = gained / max(1, size - prev)
+            expected_per_host = self._speed_at_size[prev] / prev
+            if per_host < self._min_gain * expected_per_host:
+                logger.info(
+                    "scaling saturated: +%.3f steps/s per host < %.0f%% of "
+                    "linear; holding at %s hosts",
+                    per_host,
+                    self._min_gain * 100,
+                    size,
+                )
+                return ResourcePlan()
+        return ResourcePlan(worker_num=target)

@@ -1,0 +1,1 @@
+"""Scalers turn ScalePlans into platform actions (reference master/scaler/)."""

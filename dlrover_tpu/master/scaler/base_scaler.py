@@ -1,0 +1,67 @@
+"""ScalePlan + Scaler ABC.
+
+Reference: ``ScalePlan`` (dlrover/python/master/scaler/base_scaler.py:21)
+and the scaler split: the plan is platform-neutral (how many hosts of
+which resource, which nodes to remove/relaunch); the scaler executes it
+against the platform (pods, processes, TPU slice VMs).
+"""
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ...common.log import logger
+from ...common.node import Node, NodeResource
+
+
+@dataclass
+class ScalePlan:
+    # target worker count (−1 = unchanged)
+    worker_num: int = -1
+    # nodes to remove (ids)
+    remove_nodes: List[int] = field(default_factory=list)
+    # failed nodes to replace: old node → replacement node object
+    launch_nodes: List[Node] = field(default_factory=list)
+    # resource change for new nodes
+    node_resource: NodeResource = field(default_factory=NodeResource)
+    created_at: float = field(default_factory=time.time)
+
+    def empty(self) -> bool:
+        return (
+            self.worker_num < 0
+            and not self.remove_nodes
+            and not self.launch_nodes
+        )
+
+
+class Scaler(ABC):
+    """Executes ScalePlans; one per job (reference base_scaler.py)."""
+
+    def __init__(self, job_name: str = "job"):
+        self._job_name = job_name
+        self._lock = threading.Lock()
+
+    @abstractmethod
+    def scale(self, plan: ScalePlan) -> None:
+        ...
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class NoopScaler(Scaler):
+    """Local/standalone: agents self-restart; nothing to scale."""
+
+    def scale(self, plan: ScalePlan) -> None:
+        if not plan.empty():
+            logger.info(
+                "noop scaler ignoring plan: worker_num=%s remove=%s launch=%s",
+                plan.worker_num,
+                plan.remove_nodes,
+                [n.node_id for n in plan.launch_nodes],
+            )

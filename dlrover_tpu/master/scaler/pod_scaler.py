@@ -1,0 +1,79 @@
+"""Pod scaler: ScalePlan → k8s pod create/delete.
+
+Reference: ``PodScaler`` (dlrover/python/master/scaler/pod_scaler.py:84)
+— the master creates/deletes worker pods directly (the Go operator only
+launches the master pod). TPU shape: a pod per host; slice granularity
+is enforced upstream by the plan builder (node_unit truncation).
+"""
+
+from typing import Dict, List, Optional
+
+from ...common.log import logger
+from ...common.node import Node
+from ...scheduler.kubernetes import (
+    ELASTIC_JOB_LABEL,
+    build_worker_pod,
+    k8sClient,
+)
+from .base_scaler import ScalePlan, Scaler
+
+
+class PodScaler(Scaler):
+    def __init__(
+        self,
+        job_name: str,
+        image: str,
+        command: List[str],
+        master_addr: str,
+        namespace: str = "default",
+        tpu_chips_per_host: int = 0,
+        tpu_topology: str = "",
+        hosts_per_slice: int = 1,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        super().__init__(job_name)
+        self._client = k8sClient.singleton(namespace)
+        self._image = image
+        self._command = command
+        self._master_addr = master_addr
+        self._namespace = namespace
+        self._tpu_chips = tpu_chips_per_host
+        self._tpu_topology = tpu_topology
+        self._hosts_per_slice = max(1, hosts_per_slice)
+        self._env = env or {}
+        self._target = 0
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            if plan.worker_num >= 0:
+                self._target = plan.worker_num
+            for node_id in plan.remove_nodes:
+                self._client.delete_pod(f"{self._job_name}-worker-{node_id}")
+            for node in plan.launch_nodes:
+                self._create_worker(node.node_id, node.rank_index)
+            self._reconcile()
+
+    def _reconcile(self) -> None:
+        pods = self._client.list_pods(f"{ELASTIC_JOB_LABEL}={self._job_name}")
+        existing = {p.metadata.name for p in pods}
+        for node_id in range(self._target):
+            name = f"{self._job_name}-worker-{node_id}"
+            if name not in existing:
+                self._create_worker(node_id, node_id)
+
+    def _create_worker(self, node_id: int, node_rank: int) -> None:
+        pod = build_worker_pod(
+            job_name=self._job_name,
+            node_id=node_id,
+            node_rank=node_rank,
+            image=self._image,
+            command=self._command,
+            master_addr=self._master_addr,
+            namespace=self._namespace,
+            tpu_chips=self._tpu_chips,
+            tpu_topology=self._tpu_topology,
+            slice_index=node_rank // self._hosts_per_slice,
+            env=self._env,
+        )
+        if self._client.create_pod(pod):
+            logger.info("created worker pod %s", pod.metadata.name)

@@ -1,0 +1,149 @@
+"""Process scaler: "nodes" are local agent processes.
+
+The local analogue of the reference's ``PodScaler`` (pod_scaler.py:84) —
+and the production standalone/chaos-test backend: each worker node is a
+``tpurun``-agent subprocess with the proper ``NodeEnv`` contract. Multi-
+host elasticity (kill a node → master relaunches it; scale up → new
+nodes join the rendezvous) runs for real on one machine, which is also
+how the reference validates fault tolerance without a cluster
+(SURVEY §4, trick #1).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...common.constants import NodeEnv, NodeStatus
+from ...common.log import logger
+from ...common.node import Node
+from .base_scaler import ScalePlan, Scaler
+
+
+@dataclass
+class ProcessNodeSpec:
+    """How to start one worker-node process."""
+
+    command: List[str] = field(default_factory=list)  # argv of the agent
+    env: Dict[str, str] = field(default_factory=dict)
+    cwd: Optional[str] = None
+
+
+class ProcessHandle:
+    def __init__(self, node_id: int, proc: subprocess.Popen):
+        self.node_id = node_id
+        self.proc = proc
+        self.started_at = time.time()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def returncode(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self) -> None:
+        if self.alive():
+            try:
+                os.killpg(self.proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(self.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    self.proc.kill()
+
+
+class ProcessScaler(Scaler):
+    def __init__(
+        self,
+        spec: ProcessNodeSpec,
+        master_addr: str,
+        job_name: str = "job",
+        num_workers: int = 1,
+    ):
+        super().__init__(job_name)
+        self._spec = spec
+        self._master_addr = master_addr
+        self._target = num_workers
+        self._procs: Dict[int, ProcessHandle] = {}
+        self._next_node_id = num_workers
+
+    # -- plan execution ----------------------------------------------------
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            if plan.worker_num >= 0:
+                self._target = plan.worker_num
+            for node_id in plan.remove_nodes:
+                self._kill_node(node_id)
+            for node in plan.launch_nodes:
+                self._launch_node(node.node_id, node.rank_index)
+            self._reconcile()
+
+    def _reconcile(self) -> None:
+        """Launch missing node ids / trim beyond-target ones (caller holds
+        the lock). Dead entries are deliberately NOT resurrected here: the
+        watcher must report their DELETED and the job manager decide the
+        relaunch (budget accounting) — reconcile only materializes nodes
+        that have never existed (initial world, scale-up)."""
+        known = set(self._procs)
+        for rank in range(self._target):
+            if rank not in known:
+                self._launch_node(rank, rank)
+        alive = sorted(
+            nid for nid, h in self._procs.items() if h.alive()
+        )
+        for node_id in [n for n in alive if n >= self._target]:
+            self._kill_node(node_id)
+
+    def _launch_node(
+        self, node_id: int, node_rank: int
+    ) -> Optional[ProcessHandle]:
+        old = self._procs.get(node_id)
+        if old is not None and old.alive():
+            old.kill()
+        env = dict(os.environ)
+        env.update(self._spec.env)
+        env[NodeEnv.MASTER_ADDR] = self._master_addr
+        env[NodeEnv.JOB_NAME] = self._job_name
+        env[NodeEnv.NODE_ID] = str(node_id)
+        env[NodeEnv.NODE_RANK] = str(node_rank)
+        try:
+            proc = subprocess.Popen(
+                self._spec.command,
+                env=env,
+                cwd=self._spec.cwd,
+                start_new_session=True,
+            )
+        except OSError as e:
+            logger.error("failed to launch node %s: %s", node_id, e)
+            return None
+        handle = ProcessHandle(node_id, proc)
+        self._procs[node_id] = handle
+        logger.info("launched node %s pid=%s", node_id, proc.pid)
+        return handle
+
+    def _kill_node(self, node_id: int) -> None:
+        handle = self._procs.pop(node_id, None)
+        if handle is not None:
+            logger.info("killing node %s pid=%s", node_id, handle.proc.pid)
+            handle.kill()
+
+    # -- introspection (used by the local watcher) -------------------------
+
+    def snapshot(self) -> Dict[int, Optional[int]]:
+        """node_id → returncode (None while running)."""
+        with self._lock:
+            return {nid: h.returncode() for nid, h in self._procs.items()}
+
+    def stop(self) -> None:
+        with self._lock:
+            for node_id in list(self._procs):
+                self._kill_node(node_id)

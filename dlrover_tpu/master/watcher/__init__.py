@@ -1,0 +1,1 @@
+"""Node watchers: platform events → NodeEvents (reference master/watcher/)."""

@@ -1,0 +1,19 @@
+"""NodeWatcher ABC (reference master/watcher/k8s_watcher.py shape)."""
+
+from abc import ABC, abstractmethod
+from typing import Iterator, List
+
+from ...common.node import Node, NodeEvent
+
+
+class NodeWatcher(ABC):
+    @abstractmethod
+    def watch(self) -> Iterator[NodeEvent]:
+        """Block, yielding node events as the platform reports them."""
+
+    @abstractmethod
+    def list(self) -> List[Node]:
+        """Snapshot of the platform's current nodes."""
+
+    def stop(self) -> None:
+        pass

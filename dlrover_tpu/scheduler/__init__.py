@@ -1,0 +1,1 @@
+"""Platform schedulers: job args + elastic-job backends (local, k8s/GKE)."""

@@ -1,0 +1,66 @@
+"""Platform-neutral job description.
+
+Reference: ``ElasticJob``/``JobArgs`` ABCs (dlrover/python/scheduler/
+job.py:26,75) — what the master needs to know about the job regardless
+of whether hosts are local processes, k8s pods, or GKE TPU slices.
+"""
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..common.constants import (
+    DefaultValues,
+    DistributionStrategy,
+    NodeType,
+    PlatformType,
+)
+from ..common.node import NodeResource
+
+
+@dataclass
+class NodeGroupArgs:
+    """One replica group (TPU build: the worker group = TPU hosts)."""
+
+    count: int = 1
+    resource: NodeResource = field(default_factory=NodeResource)
+    restart_count: int = DefaultValues.MAX_RELAUNCH_COUNT
+    # Hosts per slice: relaunch/scale decisions move in this granularity.
+    node_unit: int = 1
+    # TPU topology hint, e.g. "v5e-16" or "2x4" (opaque to the master).
+    accelerator_topology: str = ""
+
+
+@dataclass
+class JobArgs:
+    """Everything the master needs about the job (reference job.py:75)."""
+
+    platform: str = PlatformType.LOCAL
+    namespace: str = "default"
+    job_name: str = "local_job"
+    distribution_strategy: str = DistributionStrategy.SPMD
+    node_args: Dict[str, NodeGroupArgs] = field(default_factory=dict)
+    job_uuid: str = ""
+    relaunch_always: bool = False
+
+    @property
+    def workers(self) -> NodeGroupArgs:
+        return self.node_args.setdefault(NodeType.WORKER, NodeGroupArgs())
+
+
+class ElasticJob(ABC):
+    """Platform hooks the master calls to materialize nodes."""
+
+    @abstractmethod
+    def get_node_name(self, node_type: str, node_id: int) -> str:
+        """Stable platform name for a node (pod name / process tag)."""
+
+    @abstractmethod
+    def get_node_service_addr(self, node_type: str, node_id: int) -> str:
+        """Address agents use to reach the node, '' if not applicable."""
+
+
+def new_job_args(platform: str, job_name: str, num_workers: int) -> JobArgs:
+    args = JobArgs(platform=platform, job_name=job_name)
+    args.node_args[NodeType.WORKER] = NodeGroupArgs(count=num_workers)
+    return args

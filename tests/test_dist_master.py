@@ -1,0 +1,231 @@
+"""Distributed master stack: job manager relaunch, auto-scaler,
+diagnosis/hang detection, pre-check operators, and the full
+multi-process elastic chaos e2e (reference test model: test_job_manager,
+test_job_auto_scaler, chaos experiments in fault_tolerance_exps.md).
+"""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    JobExitReason,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    PreCheckStatus,
+)
+from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.master.diagnosis.diagnosis_master import (
+    ConnectionPreCheckOperator,
+    DiagnosisMaster,
+    SchedulingPreCheckOperator,
+)
+from dlrover_tpu.master.job_context import JobContext, get_job_context
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.resource.optimizer import (
+    ResourcePlan,
+    ThroughputScalingOptimizer,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("test")
+        self.plans = []
+
+    def scale(self, plan: ScalePlan) -> None:
+        self.plans.append(plan)
+
+
+@pytest.fixture(autouse=True)
+def fresh_ctx():
+    JobContext.reset()
+    yield
+    JobContext.reset()
+
+
+def _worker(node_id, status=NodeStatus.RUNNING, **kw):
+    node = Node(
+        node_type=NodeType.WORKER, node_id=node_id, rank_index=node_id, **kw
+    )
+    node.status = status
+    return node
+
+
+class TestDistributedJobManager:
+    def _manager(self, n=2):
+        scaler = RecordingScaler()
+        m = DistributedJobManager(num_workers=n, scaler=scaler)
+        return m, scaler
+
+    def test_start_materializes_world(self):
+        m, scaler = self._manager(3)
+        m.start()
+        m.stop()
+        assert scaler.plans[0].worker_num == 3
+
+    def test_deleted_failed_node_relaunched(self):
+        m, scaler = self._manager(2)
+        m.start()
+        dead = _worker(0, NodeStatus.FAILED)
+        dead.exit_reason = NodeExitReason.KILLED
+        m.process_event(NodeEvent(event_type=NodeEventType.DELETED, node=dead))
+        m.stop()
+        launch_plans = [p for p in scaler.plans if p.launch_nodes]
+        assert len(launch_plans) == 1
+        assert launch_plans[0].launch_nodes[0].node_id == 0
+        # table now holds the INITIAL replacement with bumped count
+        node = get_job_context().get_node(NodeType.WORKER, 0)
+        assert node.status == NodeStatus.INITIAL
+        assert node.relaunch_count == 1
+
+    def test_fatal_error_not_relaunched(self):
+        m, scaler = self._manager(1)
+        m.start()
+        dead = _worker(0, NodeStatus.FAILED)
+        dead.exit_reason = NodeExitReason.FATAL_ERROR
+        m.process_event(NodeEvent(event_type=NodeEventType.DELETED, node=dead))
+        m.stop()
+        assert not any(p.launch_nodes for p in scaler.plans)
+
+    def test_relaunch_budget_exhausted_aborts(self):
+        m, scaler = self._manager(1)
+        m.start()
+        ctx = get_job_context()
+        for i in range(10):
+            node = ctx.get_node(NodeType.WORKER, 0)
+            if not node.should_relaunch():
+                break
+            dead = _worker(0, NodeStatus.FAILED)
+            dead.relaunch_count = node.relaunch_count
+            dead.exit_reason = NodeExitReason.KILLED
+            m.process_event(
+                NodeEvent(event_type=NodeEventType.DELETED, node=dead)
+            )
+            # replacement goes RUNNING then dies again
+            ctx.get_node(NodeType.WORKER, 0).update_status(NodeStatus.RUNNING)
+        final = _worker(0, NodeStatus.FAILED)
+        final.relaunch_count = get_context().max_relaunch_count
+        final.exit_reason = NodeExitReason.KILLED
+        m.process_event(NodeEvent(event_type=NodeEventType.DELETED, node=final))
+        m.stop()
+        action = ctx.master_actions.next_action(-1)
+        assert action.config.get("reason") == JobExitReason.MAX_RELAUNCH
+
+    def test_slice_group_relaunch(self):
+        m, scaler = self._manager(4)
+        m.start()
+        ctx = get_job_context()
+        for node_id in range(4):
+            node = ctx.get_node(NodeType.WORKER, node_id)
+            node.slice_id = node_id // 2
+            ctx.update_node(node)
+        m.relaunch_slice(1)
+        m.stop()
+        plan = scaler.plans[-1]
+        assert sorted(plan.remove_nodes) == [2, 3]
+        assert sorted(n.node_id for n in plan.launch_nodes) == [2, 3]
+
+
+class TestAutoScaler:
+    def test_plan_execution_scales_in_units(self):
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=None, scaler=scaler, node_unit=4, max_workers=16
+        )
+        auto.execute_job_optimization_plan(ResourcePlan(worker_num=7))
+        assert scaler.plans[-1].worker_num == 4  # truncated to slice unit
+
+    def test_plan_pushes_tuning_config(self):
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=None, scaler=scaler, node_unit=1, max_workers=2
+        )
+        auto.execute_job_optimization_plan(
+            ResourcePlan(dataloader_batch_size=64)
+        )
+        cfg = get_job_context().paral_config
+        assert cfg.dataloader_batch_size == 64
+        assert cfg.version == 1
+
+    def test_throughput_optimizer_grows_until_saturation(self):
+        perf = PerfMonitor()
+        opt = ThroughputScalingOptimizer(
+            perf, max_workers=8, node_unit=2, min_gain_per_host=0.5
+        )
+        now = time.time()
+        # 2 hosts: 1.0 steps/s → proposes 4
+        for i in range(8):
+            perf.collect_global_step(i, now + i)
+        opt.record_world_size(2)
+        plan = opt.generate_plan()
+        assert plan.worker_num == 4
+        # 4 hosts: 1.05 steps/s (barely better) → saturated, no growth
+        perf2 = PerfMonitor()
+        for i in range(8):
+            perf2.collect_global_step(i, now + i / 1.05)
+        opt._perf = perf2
+        opt.record_world_size(4)
+        assert opt.generate_plan().empty()
+
+
+class TestDiagnosisMaster:
+    def test_precheck_operators(self):
+        ctx = get_job_context()
+        op_sched = SchedulingPreCheckOperator(expected_workers=1)
+        assert not op_sched.check().passed
+        ctx.update_node(_worker(0, NodeStatus.RUNNING))
+        assert op_sched.check().passed
+        op_conn = ConnectionPreCheckOperator(expected_workers=1)
+        assert not op_conn.check().passed
+        node = ctx.get_node(NodeType.WORKER, 0)
+        node.heartbeat_time = time.time()
+        ctx.update_node(node)
+        assert op_conn.check().passed
+
+    def test_precheck_chain_sets_status(self):
+        ctx = get_job_context()
+        ctx.update_node(_worker(0, NodeStatus.RUNNING))
+        node = ctx.get_node(NodeType.WORKER, 0)
+        node.heartbeat_time = time.time()
+        ctx.update_node(node)
+        dm = DiagnosisMaster(
+            operators=[
+                SchedulingPreCheckOperator(1),
+                ConnectionPreCheckOperator(1),
+            ]
+        )
+        assert dm.pre_check()
+        assert ctx.pre_check_status == PreCheckStatus.PASSED
+
+    def test_hang_detection_issues_restart(self, monkeypatch):
+        ctx = get_job_context()
+        ctx.update_node(_worker(0, NodeStatus.RUNNING))
+        monkeypatch.setattr(get_context(), "hang_downtime_s", 0.1)
+        dm = DiagnosisMaster()
+        ctx.report_step(10, time.time() - 1.0)  # stalled > downtime
+        dm.observe_once()
+        action = ctx.node_actions.next_action(0)
+        assert action.action_type == "restart_worker"
+        # reported once, not repeatedly
+        dm.observe_once()
+        assert ctx.node_actions.next_action(0).action_type == "no_action"
+
+    def test_no_hang_while_steps_flow(self, monkeypatch):
+        ctx = get_job_context()
+        ctx.update_node(_worker(0, NodeStatus.RUNNING))
+        monkeypatch.setattr(get_context(), "hang_downtime_s", 60.0)
+        dm = DiagnosisMaster()
+        ctx.report_step(10, time.time())
+        dm.observe_once()
+        assert ctx.node_actions.next_action(0).action_type == "no_action"

@@ -1,0 +1,117 @@
+"""Elastic chaos e2e: DistributedJobMaster + ProcessScaler node processes.
+
+The TPU build's equivalent of the reference's chaosblade experiments
+(docs/tech_report/fault_tolerance_exps.md): a 2-"host" job where each
+host is a real agent process supervising a real worker process; SIGKILL
+one host mid-run and assert the master replaces it, the survivor
+re-rendezvouses, and the job runs to completion.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import JobExitReason, NodeEnv
+from dlrover_tpu.master.dist_master import DistributedJobMaster
+from dlrover_tpu.master.scaler.process_scaler import (
+    ProcessNodeSpec,
+    ProcessScaler,
+)
+from dlrover_tpu.master.watcher.process_watcher import ProcessWatcher
+
+
+def _worker_script(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, time, pathlib\n"
+        "md = pathlib.Path(os.environ['MARKER_DIR'])\n"
+        "rank = os.environ['DLROVER_NODE_RANK']\n"
+        "runs = len(list(md.glob(f'run_{rank}_*')))\n"
+        "(md / f'run_{rank}_{os.getpid()}').write_text(\n"
+        "    os.environ['DLROVER_NUM_PROCESSES'])\n"
+        "time.sleep(25 if runs == 0 else 6)\n"
+        "print('worker', rank, 'done after', runs + 1, 'runs')\n"
+    )
+    return script
+
+
+@pytest.mark.slow
+def test_kill_node_master_relaunches(tmp_path):
+    markers = tmp_path / "markers"
+    markers.mkdir()
+    script = _worker_script(tmp_path)
+    # Build master first with a NoopScaler placeholder, then swap in the
+    # real ProcessScaler once the RPC port is known.
+    from dlrover_tpu.master.scaler.base_scaler import NoopScaler
+
+    master = DistributedJobMaster(
+        scaler=NoopScaler(),
+        watcher=None,
+        num_workers=2,
+        node_unit=1,
+        job_name="chaos_e2e",
+        pre_check_ops=[],
+        fresh_context=True,
+    )
+    spec = ProcessNodeSpec(
+        command=[
+            sys.executable,
+            "-m",
+            "dlrover_tpu.launcher.elastic_run",
+            "--nnodes",
+            "2",
+            "--max_restarts",
+            "3",
+            str(script),
+        ],
+        env={
+            "MARKER_DIR": str(markers),
+            "DLROVER_LOCAL_DEVICES": "1",
+            "PYTHONPATH": os.pathsep.join(sys.path),
+        },
+    )
+    scaler = ProcessScaler(
+        spec, master_addr=master.addr, job_name="chaos_e2e", num_workers=2
+    )
+    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
+    master.job_manager._scaler = scaler
+    master.job_manager._watcher = watcher
+    master.auto_scaler._scaler = scaler
+    try:
+        master.prepare()
+        master.run_in_background()
+        # wait until both first-incarnation workers are running
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if len(list(markers.glob("run_*"))) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(list(markers.glob("run_*"))) >= 2, "workers never started"
+
+        # chaos: SIGKILL node 0's agent process (kills its process group)
+        handle = scaler._procs[0]
+        os.killpg(handle.proc.pid, signal.SIGKILL)
+
+        # master must replace it: a second run marker for rank 0 appears
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if len(list(markers.glob("run_0_*"))) >= 2:
+                break
+            time.sleep(0.5)
+        assert len(list(markers.glob("run_0_*"))) >= 2, "node 0 not relaunched"
+
+        # and the whole job completes successfully
+        deadline = time.time() + 120
+        while time.time() < deadline and not master._stopped.is_set():
+            time.sleep(0.5)
+        assert master.exit_reason == JobExitReason.SUCCEEDED
+        # the re-rendezvoused world was full-size again
+        final_runs = sorted(markers.glob("run_0_*"))
+        assert final_runs[-1].read_text() == "2"
+    finally:
+        master.stop()
+        scaler.stop()

@@ -131,35 +131,12 @@ def test_node_check_pair_isolates_fault(monkeypatch):
         config = ElasticLaunchConfig(
             min_nodes=2, max_nodes=2, node_rank=rank, node_id=rank
         )
-        if not healthy:
-            # per-thread failure injection: run the check loop with a
-            # matmul stub instead of monkeypatching the module globally
-            ok = _run_check_with_matmul(
-                config, client, lambda: (False, 0.0)
-            )
-        else:
-            ok = node_check.run_node_check(config, client)
-        results[rank] = ok
-
-    def _run_check_with_matmul(config, client, matmul_fn):
-        from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
-
-        for round_idx in range(node_check.CHECK_ROUNDS):
-            handler = MasterRendezvousHandler(
-                RendezvousName.NETWORK_CHECK,
-                node_rank=config.node_rank,
-                client=client,
-                node_id=config.node_id,
-                local_world_size=1,
-                rdzv_timeout=30,
-            )
-            world = handler.next_rendezvous()
-            ok, t = matmul_fn()
-            client.report_network_check_result(
-                ok, t, round=round_idx, node_rank=config.node_rank
-            )
-            node_check._wait_round_results(client, timeout=30)
-        return config.node_rank not in client.get_fault_nodes()
+        # Both hosts run the FULL protocol (including the pair exchange);
+        # the faulty one only has its device matmul stubbed to fail.
+        matmul_fn = None if healthy else (lambda: (False, 0.0))
+        results[rank] = node_check.run_node_check(
+            config, client, matmul_fn=matmul_fn
+        )
 
     threads = [
         threading.Thread(target=run_host, args=(0, True)),

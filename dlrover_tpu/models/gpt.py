@@ -33,7 +33,16 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     use_remat: bool = True  # jax.checkpoint each block: HBM for FLOPs
     use_flash_attention: bool = False  # pallas kernel from dlrover_tpu.ops
+    # "dense" | "flash" (pallas kernel, single-device/data-parallel) |
+    # "ring" (sp-sharded exact attention via shard_map; needs
+    # parallel.mesh.current_mesh to be active)
+    attention_impl: str = ""
     tie_embeddings: bool = True
+
+    def resolved_attention_impl(self) -> str:
+        if self.attention_impl:
+            return self.attention_impl
+        return "flash" if self.use_flash_attention else "dense"
 
     @property
     def mlp_dim(self) -> int:
@@ -95,7 +104,23 @@ class CausalSelfAttention(nn.Module):
         k = _constrain(k, "batch", "seq", "heads", "kv")
         v = _constrain(v, "batch", "seq", "heads", "kv")
 
-        if cfg.use_flash_attention:
+        impl = cfg.resolved_attention_impl()
+        if impl not in ("dense", "flash", "ring"):
+            raise ValueError(
+                f"unknown attention_impl {impl!r}; expected dense|flash|ring"
+            )
+        if impl == "ring":
+            from ..ops.ring_attention import ring_attention_sharded
+            from ..parallel.mesh import get_current_mesh
+
+            mesh = get_current_mesh()
+            if mesh is None:
+                raise ValueError(
+                    "attention_impl='ring' needs parallel.mesh.current_mesh "
+                    "active around model application"
+                )
+            out = ring_attention_sharded(q, k, v, mesh, causal=True)
+        elif impl == "flash":
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)

@@ -1,0 +1,1 @@
+"""TPU-native ops: Pallas kernels + sequence-parallel collectives."""

@@ -1,0 +1,503 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The hot op of the GPT compute path (SURVEY §2.17: the reference has no
+attention kernels at all — its parallelism is integrated, not
+implemented — so this is TPU-native net-new work, built to the Pallas
+guide's flash-attention/online-softmax pattern).
+
+Algorithm: FlashAttention-2. Forward streams K/V blocks through VMEM
+with an online softmax (running max ``m``, normalizer ``l``, f32
+accumulator); saves per-row logsumexp for the backward. Backward runs
+two passes (dk/dv with q as the streamed axis, dq with k streamed),
+recomputing probabilities from the saved logsumexp.
+
+Layout: inputs are ``[batch, seq, heads, head_dim]`` (the model's
+``bqhk``); kernels operate on ``[batch*heads, seq, head_dim]``. Blocks
+default to 128×128 (MXU tile), fp32 softmax, inputs in bf16 on TPU.
+
+On non-TPU backends the same kernels run in Pallas interpret mode, so
+CPU tests cover the kernel logic bit-for-bit.
+"""
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+# Tuned on v5e: larger K blocks amortize the online-softmax rescale; a
+# 512×1024 f32 probability tile (2 MB) still fits VMEM comfortably.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+# Trailing lanes used to materialize per-row scalars (lse/delta) in HBM.
+_LSE_LANES = 8
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(block_shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(block_shape, index_map)
+
+
+def _scratch(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemorySpace.ANY  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_len: int,
+    q_len: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+    # End-aligned causal offset (standard KV-cache convention): query row
+    # i attends keys [0, i + kv_len - q_len].
+    causal_off = kv_len - q_len
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: a K block strictly right of the Q block's last row is fully
+    # masked — skip its FLOPs (the grid still visits it).
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1 + causal_off
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]  # (block_q, d) — keep input dtype: bf16 rides the MXU
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= sm_scale
+        q_idx = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_idx <= q_idx + causal_off)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)  # (block_q, block_k)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:, :1] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l_safe)
+        # lse carries a trailing dim of 8 — the smallest the Mosaic block
+        # rules allow (equal to the overall array dim), 16x leaner than a
+        # full 128-lane tile.
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
+
+
+def _pad_to(x, size, axis):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd(
+    q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int
+) -> Tuple[jax.Array, jax.Array]:
+    """q,k,v: (BH, T, D) → (out (BH,T,D), lse (BH,T))."""
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_kv, 8))
+    tq_pad = math.ceil(t_q / block_q) * block_q
+    tk_pad = math.ceil(t_kv / block_k) * block_k
+    qp = _pad_to(q, tq_pad, 1)
+    kp = _pad_to(k, tk_pad, 1)
+    vp = _pad_to(v, tk_pad, 1)
+    grid = (bh, tq_pad // block_q, tk_pad // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        kv_len=t_kv,
+        q_len=t_q,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq_pad, _LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, d), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+            _scratch((block_q, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qp, kp, vp)
+    return out[:, :t_q], lse[:, :t_q, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkdv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc,
+    dv_acc,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_len: int,
+    q_len: int,
+):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1 + (kv_len - q_len)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]  # (block_q, 1)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= sm_scale
+        q_idx = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = jnp.logical_and(k_idx < kv_len, q_idx < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, k_idx <= q_idx + (kv_len - q_len))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (block_q, block_k)
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dp = do @ v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_acc,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    kv_len: int,
+    q_len: int,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1 + (kv_len - q_len)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s *= sm_scale
+        q_idx = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = jnp.logical_and(k_idx < kv_len, q_idx < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, k_idx <= q_idx + (kv_len - q_len))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(
+    q, k, v, out, lse, do, sm_scale, causal, block_q, block_k
+):
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    block_q = min(block_q, max(t_q, 8))
+    block_k = min(block_k, max(t_kv, 8))
+    tq_pad = math.ceil(t_q / block_q) * block_q
+    tk_pad = math.ceil(t_kv / block_k) * block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qp = _pad_to(q, tq_pad, 1)
+    kp = _pad_to(k, tk_pad, 1)
+    vp = _pad_to(v, tk_pad, 1)
+    dop = _pad_to(do, tq_pad, 1)
+    # lse/delta carry a small trailing lane dim (Mosaic block rules)
+    lsep = jnp.broadcast_to(
+        _pad_to(lse, tq_pad, 1)[..., None], (bh, tq_pad, _LSE_LANES)
+    )
+    deltap = jnp.broadcast_to(
+        _pad_to(delta, tq_pad, 1)[..., None], (bh, tq_pad, _LSE_LANES)
+    )
+
+    common = dict(
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        kv_len=t_kv,
+        q_len=t_q,
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **common),
+        grid=(bh, tk_pad // block_k, tq_pad // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, _LSE_LANES), lambda b, j, i: (b, i, 0)),
+            _vmem_spec((1, block_q, _LSE_LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            _scratch((block_k, d), jnp.float32),
+            _scratch((block_k, d), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, tq_pad // block_q, tk_pad // block_k),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            _vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+            _vmem_spec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[_vmem_spec((1, block_q, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype)],
+        scratch_shapes=[_scratch((block_q, d), jnp.float32)],
+        interpret=_use_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)[0]
+    return dq[:, :t_q], dk[:, :t_kv], dv[:, :t_kv]
+
+
+# ---------------------------------------------------------------------------
+# public API (custom VJP over the [B, T, H, D] layout)
+# ---------------------------------------------------------------------------
+
+
+def _to_bht(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bht(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+):
+    """Flash attention over ``[batch, seq, heads, head_dim]`` tensors."""
+    out, _ = _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    out3, lse = _flash_fwd(
+        _to_bht(q), _to_bht(k), _to_bht(v), scale, causal, block_q, block_k
+    )
+    out = _from_bht(out3, b, h)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    q, k, v, out, lse = residuals
+    b, t, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    dq3, dk3, dv3 = _flash_bwd(
+        _to_bht(q),
+        _to_bht(k),
+        _to_bht(v),
+        _to_bht(out),
+        lse,
+        _to_bht(g),
+        scale,
+        causal,
+        block_q,
+        block_k,
+    )
+    return _from_bht(dq3, b, h), _from_bht(dk3, b, h), _from_bht(dv3, b, h)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def reference_attention(q, k, v, causal: bool = True, sm_scale=None):
+    """Naive einsum attention — the correctness oracle for kernel tests."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool), k=t_k - t_q)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(probs.dtype)).astype(
+        q.dtype
+    )

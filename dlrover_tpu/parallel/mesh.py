@@ -115,6 +115,31 @@ def choose_mesh_shape(
     return MeshConfig(dp=outer, fsdp=1, tp=tp, sp=sp, pp=pp)
 
 
+_CURRENT_MESH: List[Optional[Mesh]] = [None]
+
+
+class current_mesh:
+    """Context manager publishing the active mesh to modules that need
+    the concrete object (e.g. shard_map-wrapped ring attention); plain
+    pjit sharding constraints don't need it."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = _CURRENT_MESH[0]
+        _CURRENT_MESH[0] = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _CURRENT_MESH[0] = self._prev
+        return False
+
+
+def get_current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH[0]
+
+
 def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
     """Per-data-shard batch size on the current mesh."""
     data_extent = mesh.shape["dp"] * mesh.shape["fsdp"]

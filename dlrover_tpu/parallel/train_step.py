@@ -17,6 +17,7 @@ from flax.core import unfreeze
 from flax.linen import partitioning as nn_partitioning
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .mesh import current_mesh
 from .sharding import DEFAULT_RULES, apply_rules, data_sharding_for
 
 
@@ -59,7 +60,7 @@ def state_shardings(
 ) -> Tuple[TrainState, TrainState]:
     """Return (abstract_state, sharding-tree) for the full TrainState."""
     rules = rules or DEFAULT_RULES
-    with mesh, apply_rules(rules):
+    with mesh, apply_rules(rules), current_mesh(mesh):
         abstract_vars = jax.eval_shape(
             lambda: model.init(jax.random.PRNGKey(0), example_input)
         )
@@ -140,7 +141,7 @@ def init_train_state(
             step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
         )
 
-    with mesh, apply_rules(rules or DEFAULT_RULES):
+    with mesh, apply_rules(rules or DEFAULT_RULES), current_mesh(mesh):
         state = jax.jit(_init, out_shardings=sharding_tree)(rng)
     return state, sharding_tree
 
@@ -183,13 +184,20 @@ def build_train_step(
         )
         return new_state, loss
 
-    with mesh, apply_rules(rules):
-        return jax.jit(
-            step_fn,
-            in_shardings=(sharding_tree, in_sharding, tgt_sharding),
-            out_shardings=(sharding_tree, replicated),
-            donate_argnums=(0,) if donate else (),
-        )
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(sharding_tree, in_sharding, tgt_sharding),
+        out_shardings=(sharding_tree, replicated),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def run_step(state, inputs, targets):
+        # Tracing happens on first call: keep the logical rules and the
+        # concrete mesh (ring attention's shard_map needs it) active.
+        with mesh, apply_rules(rules), current_mesh(mesh):
+            return jitted(state, inputs, targets)
+
+    return run_step
 
 
 def build_eval_step(
@@ -207,9 +215,14 @@ def build_eval_step(
         logits = model.apply({"params": params}, inputs)
         return loss_fn(logits, targets)
 
-    with mesh, apply_rules(rules):
-        return jax.jit(
-            eval_fn,
-            in_shardings=(sharding_tree.params, in_sharding, tgt_sharding),
-            out_shardings=replicated,
-        )
+    jitted = jax.jit(
+        eval_fn,
+        in_shardings=(sharding_tree.params, in_sharding, tgt_sharding),
+        out_shardings=replicated,
+    )
+
+    def run_eval(params, inputs, targets):
+        with mesh, apply_rules(rules), current_mesh(mesh):
+            return jitted(params, inputs, targets)
+
+    return run_eval

@@ -1,0 +1,85 @@
+"""Agent-side profiler metric collector.
+
+Reference: ``xpu_timer_metric_collector.py:28`` — the agent scrapes the
+worker's xpu_timer Prometheus endpoint and forwards gauges to the
+master's metric context. Here the endpoint is the native tpu_timer HTTP
+server inside the JAX process (port published via the ``TPU_TIMER_PORT``
+env the trainer sets, or discovered from the worker env contract).
+"""
+
+import re
+import threading
+import urllib.request
+from typing import Dict, Optional
+
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+_LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([-0-9.eE+]+)$")
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """metric{labels} value → {"metric[labels]": value} flat map."""
+    gauges: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if not m:
+            continue
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            gauges[name + labels] = float(value)
+        except ValueError:
+            continue
+        # convenience: bare name keeps the last seen value
+        gauges.setdefault(name, 0.0)
+        gauges[name] = float(value)
+    return gauges
+
+
+class ProfilerMetricCollector:
+    def __init__(
+        self,
+        port: int,
+        client: Optional[MasterClient] = None,
+        interval_s: float = 30.0,
+    ):
+        self._url = f"http://127.0.0.1:{port}/metrics"
+        self._client = client or MasterClient.singleton()
+        self._interval = interval_s
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def collect_once(self) -> Optional[Dict[str, float]]:
+        try:
+            with urllib.request.urlopen(self._url, timeout=5) as resp:
+                text = resp.read().decode()
+        except Exception as e:
+            logger.debug("profiler scrape failed: %s", e)
+            return None
+        gauges = parse_prometheus(text)
+        if gauges:
+            try:
+                self._client.report_node_metrics(gauges)
+            except Exception as e:
+                logger.debug("metric report failed: %s", e)
+        return gauges
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="profiler-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            self.collect_once()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None

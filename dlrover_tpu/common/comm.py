@@ -238,6 +238,15 @@ class HeartbeatResponse:
 
 @register_message
 @dataclass
+class NodeMetricsReport:
+    """Profiler gauges scraped from the node's tpu_timer endpoint."""
+
+    node_id: int = 0
+    gauges: Dict[str, float] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
 class ResourceUsageReport:
     node_id: int = 0
     node_type: str = ""

@@ -172,6 +172,38 @@ class DiagnosisMaster:
     def observe_once(self) -> None:
         if self._ctx.hang_detection_enabled:
             self._check_hang()
+            self._check_profiler_hang()
+
+    def _check_profiler_hang(self) -> None:
+        """Second hang signal: the native tpu_timer watchdog on each node
+        exports ``tpu_timer_hang`` (scraped by the agent, reference
+        xpu_timer doHang → :18889 → collector). A node-local hang fires
+        faster than the global step watermark and names the node."""
+        from ..monitor.metric_context import get_metric_context
+
+        hung = get_metric_context().hung_nodes()
+        if not hung:
+            return
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        for node_id in hung:
+            node = workers.get(node_id)
+            if node is None or node.status != NodeStatus.RUNNING:
+                continue
+            if node.reported_unhealthy:
+                continue  # already acted on
+            node.reported_unhealthy = True
+            self._job_ctx.update_node(node)
+            logger.error(
+                "node %s profiler reports a hang; restarting its worker",
+                node_id,
+            )
+            self._job_ctx.node_actions.add_action(
+                NodeAction(
+                    node_id=node_id,
+                    action_type=DiagnosisActionType.RESTART_WORKER,
+                    reason="profiler_hang",
+                )
+            )
 
     def _check_hang(self) -> None:
         """Step-watermark hang detection (reference :359 adapted)."""

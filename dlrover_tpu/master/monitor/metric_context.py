@@ -1,0 +1,73 @@
+"""Job-level metric context: per-node profiler gauges on the master.
+
+Reference: ``JobMetricContext`` (dlrover/python/common/metric/
+context.py:26) filled by the agents' xpu_timer scrapes
+(xpu_timer_metric_collector.py:28) and consumed by hang/straggler
+diagnosis (diagnosis_master.py:359).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NodeMetrics:
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+        self.gauges: Dict[str, float] = {}
+        self.updated_at: float = 0.0
+
+
+class JobMetricContext:
+    _instance: Optional["JobMetricContext"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._nodes: Dict[int, NodeMetrics] = {}
+
+    @classmethod
+    def singleton(cls) -> "JobMetricContext":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    def report(self, node_id: int, gauges: Dict[str, float]) -> None:
+        with self._mu:
+            node = self._nodes.setdefault(node_id, NodeMetrics(node_id))
+            node.gauges.update(gauges)
+            node.updated_at = time.time()
+
+    def gauge(self, node_id: int, name: str, default: float = 0.0) -> float:
+        with self._mu:
+            node = self._nodes.get(node_id)
+            return node.gauges.get(name, default) if node else default
+
+    def nodes_with(self, name: str) -> Dict[int, float]:
+        with self._mu:
+            return {
+                nid: n.gauges[name]
+                for nid, n in self._nodes.items()
+                if name in n.gauges
+            }
+
+    def hung_nodes(self, stale_after_s: float = 120.0) -> List[int]:
+        """Nodes whose profiler reports a hang (fresh gauges only)."""
+        now = time.time()
+        with self._mu:
+            return sorted(
+                nid
+                for nid, n in self._nodes.items()
+                if n.gauges.get("tpu_timer_hang", 0) > 0
+                and now - n.updated_at < stale_after_s
+            )
+
+
+def get_metric_context() -> JobMetricContext:
+    return JobMetricContext.singleton()

@@ -168,6 +168,11 @@ class MasterServicer:
         actions = self._job_ctx.node_actions.drain_actions(msg.node_id)
         return comm.HeartbeatResponse(actions=[action_to_msg(a) for a in actions])
 
+    def _node_metrics(self, msg: comm.NodeMetricsReport) -> None:
+        from .monitor.metric_context import get_metric_context
+
+        get_metric_context().report(msg.node_id, msg.gauges)
+
     def _resource_usage(self, msg: comm.ResourceUsageReport) -> None:
         node = self._job_ctx.get_node(msg.node_type or "worker", msg.node_id)
         if node is not None:
@@ -289,6 +294,7 @@ class MasterServicer:
         comm.NetworkCheckResult: _report_network_check,
         comm.NodeStateRequest: _node_state,
         comm.NodeFailureReport: _node_failure,
+        comm.NodeMetricsReport: _node_metrics,
         comm.ResourceUsageReport: _resource_usage,
         comm.TrainingStepReport: _training_step,
         comm.DatasetShardParams: _dataset_params,

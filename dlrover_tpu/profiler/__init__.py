@@ -1,0 +1,12 @@
+"""Native profiling: tpu_timer bindings, step hooks, timeline tools.
+
+TPU counterpart of the reference's xpu_timer stack (SURVEY §2.15): the
+C++ core (native/tpu_timer) aggregates metrics, watches for hangs, and
+serves Prometheus; this package feeds it events from the JAX runtime
+and gives the agent a scraper.
+"""
+
+from .native import TpuTimer, load_native
+from .hooks import StepProfiler, profile_op
+
+__all__ = ["TpuTimer", "load_native", "StepProfiler", "profile_op"]

@@ -1,0 +1,141 @@
+"""ctypes bindings for the native tpu_timer core.
+
+The shared library is built from ``native/tpu_timer`` (plain g++, no
+deps); :func:`load_native` builds it on demand when the .so is missing —
+the runtime equivalent of the reference shipping prebuilt xpu_timer
+wheels (xpu_timer/build.sh).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from ..common.log import logger
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "tpu_timer",
+)
+_LIB_NAME = "libtpu_timer.so"
+
+KIND_MATMUL = 0
+KIND_COLLECTIVE = 1
+KIND_STEP = 2
+KIND_H2D = 3
+KIND_D2H = 4
+KIND_OTHER = 5
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> str:
+    lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    if os.path.exists(lib_path):
+        return lib_path
+    logger.info("building native tpu_timer in %s", _NATIVE_DIR)
+    subprocess.run(
+        ["make", _LIB_NAME], cwd=_NATIVE_DIR, check=True, capture_output=True
+    )
+    return lib_path
+
+
+def load_native() -> ctypes.CDLL:
+    """Load (building if needed) the native core. Raises on failure."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_build_library())
+        lib.tt_init.restype = ctypes.c_int
+        lib.tt_init.argtypes = [ctypes.c_int]
+        lib.tt_http_port.restype = ctypes.c_int
+        lib.tt_intern_name.restype = ctypes.c_int32
+        lib.tt_intern_name.argtypes = [ctypes.c_char_p]
+        lib.tt_record.argtypes = [
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_double,
+        ]
+        lib.tt_step_begin.argtypes = [ctypes.c_int64]
+        lib.tt_step_end.argtypes = [ctypes.c_int64]
+        lib.tt_config_hang.argtypes = [ctypes.c_double, ctypes.c_int64]
+        lib.tt_hang_status.restype = ctypes.c_int
+        lib.tt_current_step_open_s.restype = ctypes.c_double
+        lib.tt_dump_timeline.restype = ctypes.c_int64
+        lib.tt_dump_timeline.argtypes = [ctypes.c_char_p]
+        lib.tt_metrics_text.restype = ctypes.c_int64
+        lib.tt_metrics_text.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        _lib = lib
+        return lib
+
+
+class TpuTimer:
+    """Process-wide profiler handle (singleton, like GpuTimerManager)."""
+
+    _instance: Optional["TpuTimer"] = None
+    _singleton_lock = threading.Lock()
+
+    def __init__(self, port: int = 0):
+        self._lib = load_native()
+        self.port = self._lib.tt_init(port)
+        if self.port < 0:
+            raise RuntimeError("tpu_timer native init failed")
+        self._name_cache: Dict[str, int] = {}
+
+    @classmethod
+    def singleton(cls, port: int = 0) -> "TpuTimer":
+        with cls._singleton_lock:
+            if cls._instance is None:
+                cls._instance = cls(port)
+            return cls._instance
+
+    def intern(self, name: str) -> int:
+        nid = self._name_cache.get(name)
+        if nid is None:
+            nid = self._lib.tt_intern_name(name.encode())
+            self._name_cache[name] = nid
+        return nid
+
+    def record(
+        self,
+        name: str,
+        kind: int,
+        start_us: int,
+        dur_us: int,
+        flops: float = 0.0,
+        bytes_moved: float = 0.0,
+    ) -> None:
+        self._lib.tt_record(
+            self.intern(name), kind, start_us, dur_us, flops, bytes_moved
+        )
+
+    def step_begin(self, step: int) -> None:
+        self._lib.tt_step_begin(step)
+
+    def step_end(self, step: int) -> None:
+        self._lib.tt_step_end(step)
+
+    def config_hang(self, factor: float, min_timeout_ms: int) -> None:
+        self._lib.tt_config_hang(factor, min_timeout_ms)
+
+    @property
+    def hang(self) -> bool:
+        return bool(self._lib.tt_hang_status())
+
+    def step_open_seconds(self) -> float:
+        return float(self._lib.tt_current_step_open_s())
+
+    def dump_timeline(self, path: str) -> int:
+        return int(self._lib.tt_dump_timeline(path.encode()))
+
+    def metrics_text(self) -> str:
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.tt_metrics_text(buf, len(buf))
+        return buf.raw[:n].decode()

@@ -1,0 +1,92 @@
+"""Compact timeline reader + perfetto (chrome trace) export.
+
+Tool counterpart of ``xpu_timer_gen_trace_timeline`` (reference
+py_xpu_timer/bin): the native core dumps 24-byte records; this converts
+them to the Trace Event JSON that ui.perfetto.dev loads directly.
+
+Format (native/tpu_timer/tpu_timer.cc): 8-byte magic "TPUTL001", then
+records of (name_id u32, kind u32, start_us i64, dur_us u32, step u32).
+"""
+
+import json
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+_MAGIC = b"TPUTL001"
+_RECORD = struct.Struct("<IIqII")
+
+KIND_NAMES = ["matmul", "collective", "step", "h2d", "d2h", "other"]
+
+
+@dataclass
+class TimelineEvent:
+    name_id: int
+    kind: int
+    start_us: int
+    dur_us: int
+    step: int
+
+
+def read_timeline(path: str) -> List[TimelineEvent]:
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: bad magic {magic!r}")
+        events = []
+        while True:
+            raw = f.read(_RECORD.size)
+            if len(raw) < _RECORD.size:
+                break
+            events.append(TimelineEvent(*_RECORD.unpack(raw)))
+    return events
+
+
+def to_perfetto(
+    events: List[TimelineEvent],
+    names: Optional[Dict[int, str]] = None,
+    pid: int = 0,
+) -> dict:
+    """Trace Event format: one track (tid) per event kind."""
+    trace = []
+    for ev in events:
+        kind = KIND_NAMES[ev.kind] if ev.kind < len(KIND_NAMES) else "other"
+        name = (names or {}).get(ev.name_id, f"{kind}_{ev.name_id}")
+        trace.append(
+            {
+                "name": name,
+                "cat": kind,
+                "ph": "X",
+                "ts": ev.start_us,
+                "dur": ev.dur_us,
+                "pid": pid,
+                "tid": ev.kind,
+                "args": {"step": ev.step},
+            }
+        )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def convert(timeline_path: str, json_path: str) -> int:
+    events = read_timeline(timeline_path)
+    with open(json_path, "w") as f:
+        json.dump(to_perfetto(events), f)
+    return len(events)
+
+
+def main(argv=None) -> int:  # console tool
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="convert a tpu_timer .timeline to perfetto JSON"
+    )
+    parser.add_argument("timeline")
+    parser.add_argument("output")
+    ns = parser.parse_args(argv)
+    n = convert(ns.timeline, ns.output)
+    print(f"wrote {n} events to {ns.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -254,6 +254,11 @@ class MasterClient:
         )
         return resp.actions if isinstance(resp, comm.HeartbeatResponse) else []
 
+    def report_node_metrics(self, gauges: Dict[str, float]) -> None:
+        self.report(
+            comm.NodeMetricsReport(node_id=self.node_id, gauges=dict(gauges))
+        )
+
     def report_resource_usage(self, cpu_percent: float, memory_mb: float) -> None:
         self.report(
             comm.ResourceUsageReport(

@@ -1,0 +1,74 @@
+// Native smoke test for tpu_timer (reference model: xpu_timer/test/
+// common_test.cc). Exercises ingestion from multiple threads, metrics
+// text, the step watchdog, and the timeline dump format.
+
+#include "tpu_timer.h"
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+int main() {
+  int port = tt_init(0);
+  assert(port > 0);
+  assert(tt_http_port() == port);
+
+  int32_t mm = tt_intern_name("matmul_fwd");
+  int32_t cc = tt_intern_name("psum_grads");
+  assert(mm == tt_intern_name("matmul_fwd"));  // stable interning
+
+  // concurrent ingestion
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back([&, t]() {
+      for (int i = 0; i < 1000; i++) {
+        tt_record(mm, TT_KIND_MATMUL, i * 100, 50, 1e9, 0);
+        tt_record(cc, TT_KIND_COLLECTIVE, i * 100, 20, 0, 1e6);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+
+  // steps + hang watchdog
+  tt_config_hang(3.0, 50);  // 50ms min timeout for the test
+  for (int64_t s = 0; s < 5; s++) {
+    tt_step_begin(s);
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    tt_step_end(s);
+  }
+  assert(tt_hang_status() == 0);
+  tt_step_begin(5);
+  std::this_thread::sleep_for(std::chrono::milliseconds(700));
+  assert(tt_hang_status() == 1);  // stuck step flagged
+  tt_step_end(5);
+  assert(tt_hang_status() == 0);
+
+  char buf[16384];
+  int64_t n = tt_metrics_text(buf, sizeof(buf));
+  assert(n > 0);
+  std::string text(buf);
+  assert(text.find("tpu_timer_tflops{kind=\"matmul\"}") != std::string::npos);
+  assert(text.find("tpu_timer_gbps{kind=\"collective\"}") != std::string::npos);
+  assert(text.find("tpu_timer_count{kind=\"matmul\"} 4000") !=
+         std::string::npos);
+  assert(text.find("tpu_timer_last_step 5") != std::string::npos);
+
+  int64_t written = tt_dump_timeline("/tmp/tt_test.timeline");
+  assert(written >= 8000);
+  FILE* f = fopen("/tmp/tt_test.timeline", "rb");
+  char magic[9] = {0};
+  fread(magic, 1, 8, f);
+  assert(strcmp(magic, "TPUTL001") == 0);
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  assert((size - 8) % 24 == 0);  // 24B records
+  fclose(f);
+
+  tt_shutdown();
+  printf("tpu_timer native tests OK (%lld trace records)\n",
+         static_cast<long long>(written));
+  return 0;
+}

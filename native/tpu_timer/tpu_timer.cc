@@ -1,0 +1,375 @@
+// tpu_timer core implementation. See tpu_timer.h for the design notes
+// and the reference mapping (xpu_timer manager/metrics/server).
+
+#include "tpu_timer.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <array>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// -- per-kind aggregation (reference metrics.h bucketed families) -----------
+
+struct KindStats {
+  int64_t count = 0;
+  double sum_us = 0, min_us = 0, max_us = 0;
+  double sum_flops = 0, sum_bytes = 0;
+  // reservoir of recent durations for p99 (fixed window)
+  std::deque<double> window;
+  static constexpr size_t kWindow = 512;
+
+  void Add(double dur_us, double flops, double bytes) {
+    if (count == 0 || dur_us < min_us) min_us = dur_us;
+    if (count == 0 || dur_us > max_us) max_us = dur_us;
+    count++;
+    sum_us += dur_us;
+    sum_flops += flops;
+    sum_bytes += bytes;
+    window.push_back(dur_us);
+    if (window.size() > kWindow) window.pop_front();
+  }
+
+  double P99() const {
+    if (window.empty()) return 0;
+    std::vector<double> v(window.begin(), window.end());
+    size_t idx = static_cast<size_t>(v.size() * 0.99);
+    if (idx >= v.size()) idx = v.size() - 1;
+    std::nth_element(v.begin(), v.begin() + idx, v.end());
+    return v[idx];
+  }
+};
+
+// -- compact trace ring (reference KernelTraceManager, 24B/event) -----------
+
+#pragma pack(push, 1)
+struct TraceRecord {
+  uint32_t name_id;
+  uint32_t kind;
+  int64_t start_us;
+  uint32_t dur_us;
+  uint32_t step;
+};
+#pragma pack(pop)
+static_assert(sizeof(TraceRecord) == 24, "trace record must be 24 bytes");
+
+constexpr size_t kTraceCapacity = 1 << 18;  // 256k events, 6 MB
+
+struct Core {
+  std::mutex mu;
+  std::array<KindStats, TT_KIND_COUNT> stats;
+  std::vector<TraceRecord> trace = std::vector<TraceRecord>(kTraceCapacity);
+  std::atomic<uint64_t> trace_head{0};  // total records ever written
+
+  std::vector<std::string> names;
+  std::unordered_map<std::string, int32_t> name_ids;
+
+  // step / hang state
+  std::atomic<int64_t> current_step{-1};
+  std::atomic<int64_t> step_open_since_us{0};
+  std::atomic<int64_t> last_step_done{-1};
+  std::deque<double> step_durs_ms;
+  std::atomic<int> hang{0};
+  double hang_factor = 5.0;
+  int64_t hang_min_timeout_ms = 120000;
+
+  // server
+  std::atomic<bool> running{false};
+  int listen_fd = -1;
+  int port = 0;
+  std::thread server_thread;
+  std::thread watchdog_thread;
+};
+
+Core* g_core = nullptr;
+std::mutex g_init_mu;
+
+double StepMedianMs(Core& c) {
+  std::lock_guard<std::mutex> lock(c.mu);
+  if (c.step_durs_ms.empty()) return 0;
+  std::vector<double> v(c.step_durs_ms.begin(), c.step_durs_ms.end());
+  size_t mid = v.size() / 2;
+  std::nth_element(v.begin(), v.begin() + mid, v.end());
+  return v[mid];
+}
+
+std::string MetricsText(Core& c) {
+  static const char* kKindNames[TT_KIND_COUNT] = {
+      "matmul", "collective", "step", "h2d", "d2h", "other"};
+  std::string out;
+  out.reserve(4096);
+  char buf[512];
+  std::lock_guard<std::mutex> lock(c.mu);
+  for (int k = 0; k < TT_KIND_COUNT; k++) {
+    const KindStats& s = c.stats[k];
+    if (s.count == 0) continue;
+    const char* kn = kKindNames[k];
+    double avg = s.sum_us / s.count;
+    snprintf(buf, sizeof(buf),
+             "tpu_timer_latency_us{kind=\"%s\",agg=\"avg\"} %.3f\n"
+             "tpu_timer_latency_us{kind=\"%s\",agg=\"min\"} %.3f\n"
+             "tpu_timer_latency_us{kind=\"%s\",agg=\"max\"} %.3f\n"
+             "tpu_timer_latency_us{kind=\"%s\",agg=\"p99\"} %.3f\n"
+             "tpu_timer_count{kind=\"%s\"} %lld\n",
+             kn, avg, kn, s.min_us, kn, s.max_us, kn, s.P99(), kn,
+             static_cast<long long>(s.count));
+    out += buf;
+    if (s.sum_flops > 0 && s.sum_us > 0) {
+      snprintf(buf, sizeof(buf),
+               "tpu_timer_tflops{kind=\"%s\"} %.3f\n", kn,
+               s.sum_flops / (s.sum_us * 1e6));  // flops/us -> TF/s
+      out += buf;
+    }
+    if (s.sum_bytes > 0 && s.sum_us > 0) {
+      snprintf(buf, sizeof(buf),
+               "tpu_timer_gbps{kind=\"%s\"} %.3f\n", kn,
+               s.sum_bytes / (s.sum_us * 1e3));  // bytes/us -> GB/s
+      out += buf;
+    }
+  }
+  snprintf(buf, sizeof(buf), "tpu_timer_hang %d\n", c.hang.load());
+  out += buf;
+  snprintf(buf, sizeof(buf), "tpu_timer_last_step %lld\n",
+           static_cast<long long>(c.last_step_done.load()));
+  out += buf;
+  int64_t open_since = c.step_open_since_us.load();
+  double open_s = open_since > 0 ? (NowUs() - open_since) / 1e6 : 0.0;
+  snprintf(buf, sizeof(buf), "tpu_timer_step_open_seconds %.3f\n", open_s);
+  out += buf;
+  return out;
+}
+
+// -- minimal HTTP server (GET /metrics, /status, /healthz) ------------------
+
+void ServeClient(Core& c, int fd) {
+  char req[1024];
+  ssize_t n = recv(fd, req, sizeof(req) - 1, 0);
+  if (n <= 0) {
+    close(fd);
+    return;
+  }
+  req[n] = 0;
+  std::string body;
+  if (strstr(req, "GET /metrics")) {
+    body = MetricsText(c);
+  } else if (strstr(req, "GET /status")) {
+    char buf[256];
+    snprintf(buf, sizeof(buf),
+             "{\"hang\": %d, \"last_step\": %lld, \"median_step_ms\": %.1f}\n",
+             c.hang.load(), static_cast<long long>(c.last_step_done.load()),
+             StepMedianMs(c));
+    body = buf;
+  } else {
+    body = "ok\n";
+  }
+  char header[256];
+  snprintf(header, sizeof(header),
+           "HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
+           "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+           body.size());
+  send(fd, header, strlen(header), MSG_NOSIGNAL);
+  send(fd, body.data(), body.size(), MSG_NOSIGNAL);
+  close(fd);
+}
+
+void ServerLoop(Core* c) {
+  while (c->running.load()) {
+    sockaddr_in addr;
+    socklen_t len = sizeof(addr);
+    int fd = accept(c->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    if (fd < 0) {
+      if (!c->running.load()) break;
+      continue;
+    }
+    ServeClient(*c, fd);
+  }
+}
+
+// -- hang watchdog (reference manager.cc:393 doHang) ------------------------
+
+void WatchdogLoop(Core* c) {
+  while (c->running.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    int64_t open_since = c->step_open_since_us.load();
+    if (open_since <= 0) {
+      c->hang.store(0);
+      continue;
+    }
+    double open_ms = (NowUs() - open_since) / 1e3;
+    double median = StepMedianMs(*c);
+    double threshold =
+        std::max(static_cast<double>(c->hang_min_timeout_ms),
+                 median > 0 ? c->hang_factor * median : 1e18);
+    c->hang.store(open_ms > threshold ? 1 : 0);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int tt_init(int port) {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g_core != nullptr) return g_core->port;
+  auto* c = new Core();
+  c->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (c->listen_fd < 0) {
+    delete c;
+    return -1;
+  }
+  int one = 1;
+  setsockopt(c->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(c->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      listen(c->listen_fd, 16) < 0) {
+    close(c->listen_fd);
+    delete c;
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(c->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  c->port = ntohs(addr.sin_port);
+  c->running.store(true);
+  c->server_thread = std::thread(ServerLoop, c);
+  c->watchdog_thread = std::thread(WatchdogLoop, c);
+  g_core = c;
+  return c->port;
+}
+
+void tt_shutdown() {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g_core == nullptr) return;
+  Core* c = g_core;
+  g_core = nullptr;
+  c->running.store(false);
+  shutdown(c->listen_fd, SHUT_RDWR);
+  close(c->listen_fd);
+  if (c->server_thread.joinable()) c->server_thread.join();
+  if (c->watchdog_thread.joinable()) c->watchdog_thread.join();
+  delete c;
+}
+
+int tt_http_port() { return g_core ? g_core->port : -1; }
+
+int32_t tt_intern_name(const char* name) {
+  if (g_core == nullptr) return -1;
+  Core& c = *g_core;
+  std::lock_guard<std::mutex> lock(c.mu);
+  auto it = c.name_ids.find(name);
+  if (it != c.name_ids.end()) return it->second;
+  int32_t id = static_cast<int32_t>(c.names.size());
+  c.names.emplace_back(name);
+  c.name_ids.emplace(name, id);
+  return id;
+}
+
+void tt_record(int32_t name_id, int32_t kind, int64_t start_us,
+               int64_t dur_us, double flops, double bytes) {
+  if (g_core == nullptr) return;
+  Core& c = *g_core;
+  if (kind < 0 || kind >= TT_KIND_COUNT) kind = TT_KIND_OTHER;
+  {
+    std::lock_guard<std::mutex> lock(c.mu);
+    c.stats[kind].Add(static_cast<double>(dur_us), flops, bytes);
+  }
+  uint64_t slot = c.trace_head.fetch_add(1);
+  TraceRecord& r = c.trace[slot % kTraceCapacity];
+  r.name_id = static_cast<uint32_t>(name_id < 0 ? 0 : name_id);
+  r.kind = static_cast<uint32_t>(kind);
+  r.start_us = start_us;
+  r.dur_us = static_cast<uint32_t>(
+      dur_us < 0 ? 0 : std::min<int64_t>(dur_us, UINT32_MAX));
+  int64_t step = c.current_step.load();
+  r.step = static_cast<uint32_t>(step < 0 ? 0 : step);
+}
+
+void tt_step_begin(int64_t step) {
+  if (g_core == nullptr) return;
+  g_core->current_step.store(step);
+  g_core->step_open_since_us.store(NowUs());
+}
+
+void tt_step_end(int64_t step) {
+  if (g_core == nullptr) return;
+  Core& c = *g_core;
+  int64_t open_since = c.step_open_since_us.exchange(0);
+  c.last_step_done.store(step);
+  if (open_since > 0) {
+    // Only the watchdog's median window; step *stats* come from the
+    // caller's tt_record (avoids double counting with the step hook).
+    double dur_ms = (NowUs() - open_since) / 1e3;
+    std::lock_guard<std::mutex> lock(c.mu);
+    c.step_durs_ms.push_back(dur_ms);
+    if (c.step_durs_ms.size() > 256) c.step_durs_ms.pop_front();
+  }
+  c.hang.store(0);
+}
+
+void tt_config_hang(double factor, int64_t min_timeout_ms) {
+  if (g_core == nullptr) return;
+  g_core->hang_factor = factor;
+  g_core->hang_min_timeout_ms = min_timeout_ms;
+}
+
+int tt_hang_status() { return g_core ? g_core->hang.load() : 0; }
+
+double tt_current_step_open_s() {
+  if (g_core == nullptr) return 0;
+  int64_t since = g_core->step_open_since_us.load();
+  return since > 0 ? (NowUs() - since) / 1e6 : 0.0;
+}
+
+int64_t tt_dump_timeline(const char* path) {
+  if (g_core == nullptr) return -1;
+  Core& c = *g_core;
+  FILE* f = fopen(path, "wb");
+  if (f == nullptr) return -1;
+  fwrite("TPUTL001", 1, 8, f);
+  uint64_t head = c.trace_head.load();
+  uint64_t count = std::min<uint64_t>(head, kTraceCapacity);
+  uint64_t first = head - count;
+  int64_t written = 0;
+  for (uint64_t i = first; i < head; i++) {
+    const TraceRecord& r = c.trace[i % kTraceCapacity];
+    fwrite(&r, sizeof(TraceRecord), 1, f);
+    written++;
+  }
+  fclose(f);
+  return written;
+}
+
+int64_t tt_metrics_text(char* out, int64_t cap) {
+  if (g_core == nullptr || cap <= 0) return 0;
+  std::string text = MetricsText(*g_core);
+  int64_t n = std::min<int64_t>(cap - 1, text.size());
+  memcpy(out, text.data(), n);
+  out[n] = 0;
+  return n;
+}
+
+}  // extern "C"

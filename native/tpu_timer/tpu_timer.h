@@ -1,0 +1,80 @@
+// tpu_timer — native profiling / hang-detection core for TPU training.
+//
+// TPU-native counterpart of the reference's xpu_timer C++ library
+// (xpu_timer/xpu_timer/common/manager.h:106 GpuTimerManager,
+// metrics.{h,cc} bucketed TFLOPS/latency, manager.cc:393 doHang,
+// manager.h:50 KernelTraceManager 24B-trace ring buffer, server/
+// hosting_service_server_client.cc Prometheus :18889).
+//
+// Where xpu_timer intercepts CUDA/NCCL symbols via LD_PRELOAD, XLA has
+// no stable per-collective C ABI to hook, so events are *pushed* from
+// the runtime layer (Python ctypes around jitted steps / PJRT events)
+// and everything downstream of ingestion — aggregation, percentile
+// buckets, the hang watchdog, compact timeline file, Prometheus text
+// endpoint — is native, off the trainer's critical path.
+//
+// Threading model: lock-free-ish ingestion (per-call mutex on a small
+// struct; events are O(μs) apart at training granularity), background
+// poller thread computes aggregates and serves HTTP.
+
+#ifndef DLROVER_TPU_TIMER_H_
+#define DLROVER_TPU_TIMER_H_
+
+#include <cstdint>
+
+extern "C" {
+
+// Lifecycle -----------------------------------------------------------------
+// Start the core: aggregation thread + HTTP server on `port` (0 = pick a
+// free port; returns the bound port, or -1 on failure).
+int tt_init(int port);
+void tt_shutdown();
+int tt_http_port();
+
+// Event ingestion -----------------------------------------------------------
+// Kinds mirror the reference's metric families.
+enum TTKind : int32_t {
+  TT_KIND_MATMUL = 0,     // flops metric -> TFLOPS
+  TT_KIND_COLLECTIVE = 1, // bytes metric -> bus GB/s
+  TT_KIND_STEP = 2,       // training step
+  TT_KIND_H2D = 3,
+  TT_KIND_D2H = 4,
+  TT_KIND_OTHER = 5,
+  TT_KIND_COUNT = 6
+};
+
+// Record one completed event. name_id: interned via tt_intern_name.
+// dur_us: duration; flops/bytes: work for rate metrics (0 if n/a).
+void tt_record(int32_t name_id, int32_t kind, int64_t start_us,
+               int64_t dur_us, double flops, double bytes);
+
+// Intern an event name, returning a dense id (stable for process life).
+int32_t tt_intern_name(const char* name);
+
+// Step watermarks (hang detection input).
+void tt_step_begin(int64_t step);
+void tt_step_end(int64_t step);
+
+// Hang detection ------------------------------------------------------------
+// A hang is flagged when a step stays open longer than
+// max(min_timeout_ms, factor * rolling-median step time).
+void tt_config_hang(double factor, int64_t min_timeout_ms);
+// 1 if currently hung, else 0.
+int tt_hang_status();
+// Seconds the current step has been open (0 if none open).
+double tt_current_step_open_s();
+
+// Timeline ------------------------------------------------------------------
+// Dump the trace ring buffer to `path` in the compact binary format
+// (header "TPUTL001", then 24-byte records: name_id u32, kind u32,
+// start_us i64, dur_us u32, step u32). Returns records written.
+int64_t tt_dump_timeline(const char* path);
+
+// Metrics (pull; also served as Prometheus text over HTTP /metrics) ---------
+// Fill `out` with the Prometheus exposition text; returns bytes written
+// (truncated to cap). Thread-safe snapshot.
+int64_t tt_metrics_text(char* out, int64_t cap);
+
+}  // extern "C"
+
+#endif  // DLROVER_TPU_TIMER_H_

@@ -229,3 +229,22 @@ class TestDiagnosisMaster:
         ctx.report_step(10, time.time())
         dm.observe_once()
         assert ctx.node_actions.next_action(0).action_type == "no_action"
+
+    def test_profiler_hang_gauge_triggers_restart(self, monkeypatch):
+        from dlrover_tpu.master.monitor.metric_context import (
+            JobMetricContext,
+            get_metric_context,
+        )
+
+        JobMetricContext.reset()
+        ctx = get_job_context()
+        ctx.update_node(_worker(0, NodeStatus.RUNNING))
+        get_metric_context().report(0, {"tpu_timer_hang": 1.0})
+        dm = DiagnosisMaster()
+        dm.observe_once()
+        action = ctx.node_actions.next_action(0)
+        assert action.action_type == "restart_worker"
+        # acted once; a second observe doesn't re-issue
+        dm.observe_once()
+        assert ctx.node_actions.next_action(0).action_type == "no_action"
+        JobMetricContext.reset()

@@ -1,0 +1,134 @@
+"""Native tpu_timer bindings: metrics, hang watchdog, timeline, scraper.
+
+The native library is built on demand by load_native() (plain make); the
+reference's test model is xpu_timer/test/common_test.cc plus the
+collector parser tests in dlrover/python/tests.
+"""
+
+import os
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlrover_tpu.agent.metric_collector import (
+    ProfilerMetricCollector,
+    parse_prometheus,
+)
+from dlrover_tpu.master.monitor.metric_context import (
+    JobMetricContext,
+    get_metric_context,
+)
+from dlrover_tpu.profiler import StepProfiler, TpuTimer, profile_op
+from dlrover_tpu.profiler.native import KIND_COLLECTIVE, KIND_MATMUL
+from dlrover_tpu.profiler.timeline import read_timeline, to_perfetto
+
+
+@pytest.fixture(scope="module")
+def timer():
+    t = TpuTimer.singleton()
+    t.config_hang(3.0, 100)  # 100ms min timeout for tests
+    return t
+
+
+class TestNativeCore:
+    def test_record_and_metrics(self, timer):
+        timer.record("mm", KIND_MATMUL, 0, 100, flops=1e9)
+        timer.record("ar", KIND_COLLECTIVE, 0, 50, bytes_moved=1e6)
+        text = timer.metrics_text()
+        assert 'tpu_timer_tflops{kind="matmul"}' in text
+        assert 'tpu_timer_gbps{kind="collective"}' in text
+
+    def test_http_endpoint(self, timer):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{timer.port}/metrics", timeout=5
+        ) as resp:
+            text = resp.read().decode()
+        assert "tpu_timer_hang" in text
+
+    def test_step_watchdog(self, timer):
+        for s in range(5):
+            timer.step_begin(s)
+            time.sleep(0.002)
+            timer.step_end(s)
+        assert not timer.hang
+        timer.step_begin(100)
+        time.sleep(1.2)  # > max(100ms, 3x median)
+        assert timer.hang
+        timer.step_end(100)
+        assert not timer.hang
+
+    def test_timeline_roundtrip(self, timer, tmp_path):
+        timer.record("mm", KIND_MATMUL, 123, 45, flops=1.0)
+        path = str(tmp_path / "t.timeline")
+        n = timer.dump_timeline(path)
+        assert n > 0
+        events = read_timeline(path)
+        assert len(events) == n
+        perfetto = to_perfetto(events)
+        assert len(perfetto["traceEvents"]) == n
+        assert perfetto["traceEvents"][0]["ph"] == "X"
+
+
+class TestHooks:
+    def test_step_profiler_wraps_jitted_fn(self, timer):
+        @jax.jit
+        def step_fn(x):
+            return x * 2
+
+
+        prof = StepProfiler(timer=timer)
+        out = prof.step(step_fn, jnp.ones((4,)), step=7)
+        assert out.shape == (4,)
+        assert "tpu_timer_last_step 7" in timer.metrics_text()
+
+    def test_profile_op_records(self, timer):
+        @profile_op("op_mm", KIND_MATMUL, flops=2 * 8 * 8 * 8, timer=timer)
+        def mm(a, b):
+            return a @ b
+
+        out = mm(jnp.ones((8, 8)), jnp.ones((8, 8)))
+        assert out.shape == (8, 8)
+
+
+class TestCollector:
+    def test_parse_prometheus(self):
+        text = (
+            "# comment\n"
+            'tpu_timer_latency_us{kind="step",agg="avg"} 1234.5\n'
+            "tpu_timer_hang 1\n"
+        )
+        gauges = parse_prometheus(text)
+        assert gauges['tpu_timer_latency_us{kind="step",agg="avg"}'] == 1234.5
+        assert gauges["tpu_timer_hang"] == 1.0
+
+    def test_scrape_to_master_context(self, timer):
+        """End-to-end: scrape the real native endpoint, report into the
+        master metric context through a stub client."""
+
+        class StubClient:
+            node_id = 3
+
+            def __init__(self):
+                self.reported = None
+
+            def report_node_metrics(self, gauges):
+                self.reported = gauges
+                get_metric_context().report(self.node_id, gauges)
+
+        JobMetricContext.reset()
+        client = StubClient()
+        collector = ProfilerMetricCollector(timer.port, client=client)
+        gauges = collector.collect_once()
+        assert gauges and client.reported
+        ctx = get_metric_context()
+        assert ctx.gauge(3, "tpu_timer_hang") in (0.0, 1.0)
+
+    def test_hung_nodes_feed_diagnosis(self):
+        JobMetricContext.reset()
+        ctx = get_metric_context()
+        ctx.report(0, {"tpu_timer_hang": 0.0})
+        ctx.report(1, {"tpu_timer_hang": 1.0})
+        assert ctx.hung_nodes() == [1]

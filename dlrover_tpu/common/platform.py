@@ -1,0 +1,45 @@
+"""Platform pinning helpers for virtual-device runs.
+
+Sharding logic (tests, dry runs) is validated on the host backend with N
+virtual CPU devices (``--xla_force_host_platform_device_count``), mirroring
+the reference's multi-node-without-cluster trick (SURVEY.md §4). Two traps
+make this worth a shared helper:
+
+- this environment's sitecustomize registers a hardware PJRT plugin and
+  overrides ``jax_platforms`` *after* env-var resolution, so setting the
+  env var alone is not enough — ``jax.config.update`` must run too; and
+- initializing an unreachable hardware plugin blocks indefinitely, so the
+  pinning must happen before any backend initialization.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu(n_devices: int, platform: str = "cpu") -> None:
+    """Pin JAX to ``platform`` with >= ``n_devices`` host devices.
+
+    Must be called before the first JAX backend initialization; afterwards
+    it is a best-effort no-op (jax refuses platform changes post-init).
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if "cpu" in platform:
+        flags = os.environ.get("XLA_FLAGS", "")
+        match = re.search(rf"--{_COUNT_FLAG}=(\d+)", flags)
+        if match is None:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --{_COUNT_FLAG}={n_devices}"
+            ).strip()
+        elif int(match.group(1)) < n_devices:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                match.group(0), f"--{_COUNT_FLAG}={n_devices}"
+            )
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except RuntimeError:
+        pass  # backend already initialized; caller's device assert decides

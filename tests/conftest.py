@@ -7,19 +7,10 @@ mesh/checkpoint tests run on 8 virtual CPU devices.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from dlrover_tpu.common.platform import force_virtual_cpu
+
+force_virtual_cpu(8)
 os.environ.setdefault("DLROVER_JOB_NAME", f"test_{os.getpid()}")
-
-# The environment's sitecustomize registers a TPU backend and overrides
-# jax_platforms after env-var resolution; force CPU back explicitly.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

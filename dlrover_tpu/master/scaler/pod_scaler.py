@@ -6,7 +6,8 @@ launches the master pod). TPU shape: a pod per host; slice granularity
 is enforced upstream by the plan builder (node_unit truncation).
 """
 
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Set
 
 from ...common.log import logger
 from ...common.node import Node
@@ -14,6 +15,7 @@ from ...scheduler.kubernetes import (
     ELASTIC_JOB_LABEL,
     build_worker_pod,
     k8sClient,
+    pod_name,
 )
 from .base_scaler import ScalePlan, Scaler
 
@@ -30,6 +32,7 @@ class PodScaler(Scaler):
         tpu_topology: str = "",
         hosts_per_slice: int = 1,
         env: Optional[Dict[str, str]] = None,
+        reconcile_interval: float = 15.0,
     ):
         super().__init__(job_name)
         self._client = k8sClient.singleton(namespace)
@@ -42,6 +45,30 @@ class PodScaler(Scaler):
         self._hosts_per_slice = max(1, hosts_per_slice)
         self._env = env or {}
         self._target = 0
+        # Ids deleted by a plan and not re-launched since: _reconcile must
+        # not resurrect them (a remove-only plan keeps worker_num
+        # unchanged, so the bare target count would immediately recreate
+        # the pod we just deleted).
+        self._removed: Set[int] = set()
+        # (node_id, rank) creates that failed (e.g. 409 against a
+        # still-Terminating pod) — retried by the periodic reconcile loop.
+        self._retry: Dict[int, int] = {}
+        self._reconcile_interval = reconcile_interval
+        self._reconcile_thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        """Start the periodic reconcile loop (retry failed creates and
+        converge the pod set to the target)."""
+        if self._reconcile_thread is not None:
+            return
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="pod-reconcile"
+        )
+        self._reconcile_thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
 
     def scale(self, plan: ScalePlan) -> None:
         with self._lock:
@@ -49,17 +76,33 @@ class PodScaler(Scaler):
                 self._target = plan.worker_num
             for node_id in plan.remove_nodes:
                 self._client.delete_pod(f"{self._job_name}-worker-{node_id}")
+                self._removed.add(node_id)
+                self._retry.pop(node_id, None)
             for node in plan.launch_nodes:
+                self._removed.discard(node.node_id)
                 self._create_worker(node.node_id, node.rank_index)
             self._reconcile()
 
     def _reconcile(self) -> None:
         pods = self._client.list_pods(f"{ELASTIC_JOB_LABEL}={self._job_name}")
-        existing = {p.metadata.name for p in pods}
+        existing = {pod_name(p) for p in pods}
         for node_id in range(self._target):
             name = f"{self._job_name}-worker-{node_id}"
-            if name not in existing:
+            if name not in existing and node_id not in self._removed:
                 self._create_worker(node_id, node_id)
+        for node_id, rank in list(self._retry.items()):
+            if f"{self._job_name}-worker-{node_id}" in existing:
+                self._retry.pop(node_id, None)
+            else:
+                self._create_worker(node_id, rank)
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopped.wait(self._reconcile_interval):
+            try:
+                with self._lock:
+                    self._reconcile()
+            except Exception:
+                logger.exception("pod reconcile failed")
 
     def _create_worker(self, node_id: int, node_rank: int) -> None:
         pod = build_worker_pod(
@@ -76,4 +119,12 @@ class PodScaler(Scaler):
             env=self._env,
         )
         if self._client.create_pod(pod):
-            logger.info("created worker pod %s", pod.metadata.name)
+            logger.info("created worker pod %s", pod_name(pod))
+            self._retry.pop(node_id, None)
+        else:
+            # Likely a 409 against a still-Terminating pod — leave it for
+            # the periodic reconcile to retry.
+            logger.warning(
+                "create of %s failed; queued for retry", pod_name(pod)
+            )
+            self._retry[node_id] = node_rank

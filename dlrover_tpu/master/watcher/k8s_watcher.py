@@ -20,6 +20,9 @@ from ...scheduler.kubernetes import (
     ELASTIC_JOB_LABEL,
     REPLICA_INDEX_LABEL,
     k8sClient,
+    pod_labels,
+    pod_name,
+    pod_phase,
 )
 from .base import NodeWatcher
 
@@ -33,9 +36,10 @@ _PHASE_TO_STATUS = {
 
 
 def _pod_to_node(pod) -> Optional[Node]:
-    labels = pod.metadata.labels or {}
+    labels = pod_labels(pod)
+    name = pod_name(pod)
     try:
-        node_id = int(pod.metadata.name.rsplit("-", 1)[-1])
+        node_id = int(name.rsplit("-", 1)[-1])
     except ValueError:
         return None
     rank = int(labels.get(REPLICA_INDEX_LABEL, node_id))
@@ -43,25 +47,46 @@ def _pod_to_node(pod) -> Optional[Node]:
         node_type=NodeType.WORKER,
         node_id=node_id,
         rank_index=rank,
-        status=_PHASE_TO_STATUS.get(pod.status.phase, NodeStatus.INITIAL),
-        name=pod.metadata.name,
+        status=_PHASE_TO_STATUS.get(pod_phase(pod), NodeStatus.INITIAL),
+        name=name,
     )
     if node.status == NodeStatus.FAILED:
         node.exit_reason = _exit_reason(pod)
     return node
 
 
-def _exit_reason(pod) -> str:
+def _container_terminations(pod):
+    """Yield terminated-state dicts {reason, exit_code, signal} from
+    either pod representation."""
+    if isinstance(pod, dict):
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        for cs in statuses:
+            term = (cs.get("state") or {}).get("terminated")
+            if term:
+                yield {
+                    "reason": term.get("reason"),
+                    "exit_code": term.get("exitCode") or 0,
+                    "signal": term.get("signal") or 0,
+                }
+        return
     statuses = pod.status.container_statuses or []
     for cs in statuses:
         term = cs.state.terminated if cs.state else None
-        if term is None:
-            continue
-        if term.reason == "OOMKilled":
+        if term is not None:
+            yield {
+                "reason": term.reason,
+                "exit_code": term.exit_code or 0,
+                "signal": term.signal or 0,
+            }
+
+
+def _exit_reason(pod) -> str:
+    for term in _container_terminations(pod):
+        if term["reason"] == "OOMKilled":
             return NodeExitReason.OOM
-        if term.exit_code in (137, 143) or (term.signal or 0) in (9, 15):
+        if term["exit_code"] in (137, 143) or term["signal"] in (9, 15):
             return NodeExitReason.KILLED
-        if term.exit_code:
+        if term["exit_code"]:
             return NodeExitReason.FATAL_ERROR
     return NodeExitReason.UNKNOWN
 

@@ -146,6 +146,21 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
 
 
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _clamp_blocks(dtype, t_q, t_kv, block_q, block_k):
+    """Clamp block sizes to the sequence length while keeping them a
+    multiple of the TPU sublane tile (8 for f32, 16 for bf16/f16) —
+    Mosaic rejects ragged second-minor block dims on real hardware even
+    though interpret-mode CPU runs accept them."""
+    sublane = 16 if dtype.itemsize <= 2 else 8
+    block_q = min(block_q, _round_up(max(t_q, sublane), sublane))
+    block_k = min(block_k, _round_up(max(t_kv, sublane), sublane))
+    return block_q, block_k
+
+
 def _pad_to(x, size, axis):
     pad = size - x.shape[axis]
     if pad <= 0:
@@ -161,8 +176,7 @@ def _flash_fwd(
     """q,k,v: (BH, T, D) → (out (BH,T,D), lse (BH,T))."""
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
-    block_q = min(block_q, max(t_q, 8))
-    block_k = min(block_k, max(t_kv, 8))
+    block_q, block_k = _clamp_blocks(q.dtype, t_q, t_kv, block_q, block_k)
     tq_pad = math.ceil(t_q / block_q) * block_q
     tk_pad = math.ceil(t_kv / block_k) * block_k
     qp = _pad_to(q, tq_pad, 1)
@@ -356,8 +370,7 @@ def _flash_bwd(
 ):
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
-    block_q = min(block_q, max(t_q, 8))
-    block_k = min(block_k, max(t_kv, 8))
+    block_q, block_k = _clamp_blocks(q.dtype, t_q, t_kv, block_q, block_k)
     tq_pad = math.ceil(t_q / block_q) * block_q
     tk_pad = math.ceil(t_kv / block_k) * block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)

@@ -34,12 +34,21 @@ _lib_lock = threading.Lock()
 
 def _build_library() -> str:
     lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
-    if os.path.exists(lib_path):
-        return lib_path
-    logger.info("building native tpu_timer in %s", _NATIVE_DIR)
-    subprocess.run(
-        ["make", _LIB_NAME], cwd=_NATIVE_DIR, check=True, capture_output=True
+    sources = [
+        os.path.join(_NATIVE_DIR, n) for n in ("tpu_timer.cc", "tpu_timer.h")
+    ]
+    stale = not os.path.exists(lib_path) or any(
+        os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(lib_path)
+        for s in sources
     )
+    if stale:
+        logger.info("building native tpu_timer in %s", _NATIVE_DIR)
+        subprocess.run(
+            ["make", _LIB_NAME],
+            cwd=_NATIVE_DIR,
+            check=True,
+            capture_output=True,
+        )
     return lib_path
 
 

@@ -109,6 +109,27 @@ class k8sClient:
         )
 
 
+def pod_name(pod: Any) -> str:
+    """Name of a pod in either representation (dict manifest or k8s
+    client object) — the transport layer may hand back either."""
+    if isinstance(pod, dict):
+        return pod.get("metadata", {}).get("name", "")
+    return pod.metadata.name
+
+
+def pod_labels(pod: Any) -> Dict[str, str]:
+    if isinstance(pod, dict):
+        return pod.get("metadata", {}).get("labels", {}) or {}
+    return pod.metadata.labels or {}
+
+
+def pod_phase(pod: Any) -> str:
+    if isinstance(pod, dict):
+        return (pod.get("status") or {}).get("phase", "")
+    status = getattr(pod, "status", None)
+    return getattr(status, "phase", "") or ""
+
+
 def build_worker_pod(
     job_name: str,
     node_id: int,
@@ -121,53 +142,54 @@ def build_worker_pod(
     tpu_topology: str = "",
     slice_index: int = 0,
     env: Optional[Dict[str, str]] = None,
-) -> Any:
-    """Pod template for one TPU host (reference pod construction in
-    go/elasticjob/pkg/common/resource.go + pod_scaler.py:84)."""
-    require_k8s()
+) -> Dict[str, Any]:
+    """Pod manifest (plain dict, accepted verbatim by the k8s API) for
+    one TPU host (reference pod construction in
+    go/elasticjob/pkg/common/resource.go + pod_scaler.py:84). Dict form
+    keeps the whole construction path testable without the kubernetes
+    client package."""
     env_vars = [
-        k8s_api.V1EnvVar(name=NodeEnv.MASTER_ADDR, value=master_addr),
-        k8s_api.V1EnvVar(name=NodeEnv.JOB_NAME, value=job_name),
-        k8s_api.V1EnvVar(name=NodeEnv.NODE_ID, value=str(node_id)),
-        k8s_api.V1EnvVar(name=NodeEnv.NODE_RANK, value=str(node_rank)),
+        {"name": NodeEnv.MASTER_ADDR, "value": master_addr},
+        {"name": NodeEnv.JOB_NAME, "value": job_name},
+        {"name": NodeEnv.NODE_ID, "value": str(node_id)},
+        {"name": NodeEnv.NODE_RANK, "value": str(node_rank)},
     ]
     for key, value in (env or {}).items():
-        env_vars.append(k8s_api.V1EnvVar(name=key, value=value))
-    resources = None
-    node_selector = None
+        env_vars.append({"name": key, "value": str(value)})
+    container: Dict[str, Any] = {
+        "name": "worker",
+        "image": image,
+        "command": list(command),
+        "env": env_vars,
+    }
+    spec: Dict[str, Any] = {
+        "containers": [container],
+        "restartPolicy": "Never",
+    }
     if tpu_chips > 0:
-        resources = k8s_api.V1ResourceRequirements(
-            limits={TPU_RESOURCE: str(tpu_chips)},
-            requests={TPU_RESOURCE: str(tpu_chips)},
-        )
+        container["resources"] = {
+            "limits": {TPU_RESOURCE: str(tpu_chips)},
+            "requests": {TPU_RESOURCE: str(tpu_chips)},
+        }
         if tpu_topology:
-            node_selector = {
+            spec["nodeSelector"] = {
                 "cloud.google.com/gke-tpu-topology": tpu_topology,
             }
-    container = k8s_api.V1Container(
-        name="worker",
-        image=image,
-        command=command,
-        env=env_vars,
-        resources=resources,
-    )
-    return k8s_api.V1Pod(
-        metadata=k8s_api.V1ObjectMeta(
-            name=f"{job_name}-worker-{node_id}",
-            namespace=namespace,
-            labels={
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job_name}-worker-{node_id}",
+            "namespace": namespace,
+            "labels": {
                 ELASTIC_JOB_LABEL: job_name,
                 REPLICA_TYPE_LABEL: NodeType.WORKER,
                 REPLICA_INDEX_LABEL: str(node_rank),
                 SLICE_INDEX_LABEL: str(slice_index),
             },
-        ),
-        spec=k8s_api.V1PodSpec(
-            containers=[container],
-            restart_policy="Never",
-            node_selector=node_selector,
-        ),
-    )
+        },
+        "spec": spec,
+    }
 
 
 class K8sElasticJob(ElasticJob):

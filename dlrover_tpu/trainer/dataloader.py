@@ -36,9 +36,10 @@ class ElasticDistributedSampler:
 
     ``state_dict()`` records the epoch and the number of samples already
     consumed globally; ``load_state_dict`` replays into any new
-    (num_replicas, rank) layout — the completed fraction is skipped in
-    the new stride pattern, so no sample is double-trained after an
-    elastic re-mesh.
+    (num_replicas, rank) layout — the completed count is rounded down to
+    a whole stride of the new replica count so every rank resumes at the
+    same offset, which means at most ``num_replicas - 1`` samples may be
+    seen twice after an elastic re-mesh (and none are skipped).
     """
 
     def __init__(

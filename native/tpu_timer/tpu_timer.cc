@@ -91,8 +91,8 @@ struct Core {
   std::atomic<int64_t> last_step_done{-1};
   std::deque<double> step_durs_ms;
   std::atomic<int> hang{0};
-  double hang_factor = 5.0;
-  int64_t hang_min_timeout_ms = 120000;
+  std::atomic<double> hang_factor{5.0};
+  std::atomic<int64_t> hang_min_timeout_ms{120000};
 
   // server
   std::atomic<bool> running{false};
@@ -218,9 +218,10 @@ void WatchdogLoop(Core* c) {
     }
     double open_ms = (NowUs() - open_since) / 1e3;
     double median = StepMedianMs(*c);
+    double factor = c->hang_factor.load();
     double threshold =
-        std::max(static_cast<double>(c->hang_min_timeout_ms),
-                 median > 0 ? c->hang_factor * median : 1e18);
+        std::max(static_cast<double>(c->hang_min_timeout_ms.load()),
+                 median > 0 ? factor * median : 1e18);
     c->hang.store(open_ms > threshold ? 1 : 0);
   }
 }
@@ -293,19 +294,23 @@ void tt_record(int32_t name_id, int32_t kind, int64_t start_us,
   if (g_core == nullptr) return;
   Core& c = *g_core;
   if (kind < 0 || kind >= TT_KIND_COUNT) kind = TT_KIND_OTHER;
-  {
-    std::lock_guard<std::mutex> lock(c.mu);
-    c.stats[kind].Add(static_cast<double>(dur_us), flops, bytes);
-  }
-  uint64_t slot = c.trace_head.fetch_add(1);
-  TraceRecord& r = c.trace[slot % kTraceCapacity];
-  r.name_id = static_cast<uint32_t>(name_id < 0 ? 0 : name_id);
-  r.kind = static_cast<uint32_t>(kind);
-  r.start_us = start_us;
-  r.dur_us = static_cast<uint32_t>(
+  TraceRecord rec;
+  rec.name_id = static_cast<uint32_t>(name_id < 0 ? 0 : name_id);
+  rec.kind = static_cast<uint32_t>(kind);
+  rec.start_us = start_us;
+  rec.dur_us = static_cast<uint32_t>(
       dur_us < 0 ? 0 : std::min<int64_t>(dur_us, UINT32_MAX));
   int64_t step = c.current_step.load();
-  r.step = static_cast<uint32_t>(step < 0 ? 0 : step);
+  rec.step = static_cast<uint32_t>(step < 0 ? 0 : step);
+  {
+    // Single mutex covers stats and the trace ring slot, so a concurrent
+    // tt_dump_timeline (which snapshots under the same lock) never reads
+    // a torn record.
+    std::lock_guard<std::mutex> lock(c.mu);
+    c.stats[kind].Add(static_cast<double>(dur_us), flops, bytes);
+    uint64_t slot = c.trace_head.fetch_add(1);
+    c.trace[slot % kTraceCapacity] = rec;
+  }
 }
 
 void tt_step_begin(int64_t step) {
@@ -332,8 +337,8 @@ void tt_step_end(int64_t step) {
 
 void tt_config_hang(double factor, int64_t min_timeout_ms) {
   if (g_core == nullptr) return;
-  g_core->hang_factor = factor;
-  g_core->hang_min_timeout_ms = min_timeout_ms;
+  g_core->hang_factor.store(factor);
+  g_core->hang_min_timeout_ms.store(min_timeout_ms);
 }
 
 int tt_hang_status() { return g_core ? g_core->hang.load() : 0; }
@@ -349,18 +354,22 @@ int64_t tt_dump_timeline(const char* path) {
   Core& c = *g_core;
   FILE* f = fopen(path, "wb");
   if (f == nullptr) return -1;
-  fwrite("TPUTL001", 1, 8, f);
-  uint64_t head = c.trace_head.load();
-  uint64_t count = std::min<uint64_t>(head, kTraceCapacity);
-  uint64_t first = head - count;
-  int64_t written = 0;
-  for (uint64_t i = first; i < head; i++) {
-    const TraceRecord& r = c.trace[i % kTraceCapacity];
-    fwrite(&r, sizeof(TraceRecord), 1, f);
-    written++;
+  // Snapshot the ring under the lock (see tt_record), then write the
+  // copy outside it so slow IO never blocks recording.
+  std::vector<TraceRecord> snapshot;
+  {
+    std::lock_guard<std::mutex> lock(c.mu);
+    uint64_t head = c.trace_head.load();
+    uint64_t count = std::min<uint64_t>(head, kTraceCapacity);
+    snapshot.reserve(count);
+    for (uint64_t i = head - count; i < head; i++) {
+      snapshot.push_back(c.trace[i % kTraceCapacity]);
+    }
   }
+  fwrite("TPUTL001", 1, 8, f);
+  fwrite(snapshot.data(), sizeof(TraceRecord), snapshot.size(), f);
   fclose(f);
-  return written;
+  return static_cast<int64_t>(snapshot.size());
 }
 
 int64_t tt_metrics_text(char* out, int64_t cap) {

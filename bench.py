@@ -1,14 +1,19 @@
 """Benchmark entry: prints ONE JSON line for the driver.
 
-Headline metric (BASELINE.md north star): flash-checkpoint save blocking
-time — the seconds training is stalled per checkpoint. The reference
-blocks 0.5 s for a GPT-2-1.5B on 2×A100 (megatron_flash_checkpoint.md:159)
-and the north-star target here is < 5 s. ``vs_baseline`` = target / actual
-(>1.0 beats the target).
+Round-2 headline (VERDICT.md #2): real training throughput of the
+flagship GPT-2-small on the TPU chip — tokens/s and MFU vs v5e peak
+(197 bf16 TFLOP/s) — with the Pallas flash-attention kernel exercised
+on hardware and compared against the XLA dense-attention path.
+``vs_baseline`` = flash-path tokens/s over the best dense-path
+tokens/s (>1.0 means the kernel pays for itself).
 
-The bench builds the flagship GPT on the available device, stages a full
-train-state checkpoint into host shared memory (the blocking part), then
-verifies async persistence and memory restore complete.
+Also carried in ``extra`` (BASELINE.md metric family): flash-checkpoint
+save blocking seconds, async persist, memory-restore seconds for the
+full ~1.5 GB train state, and the implied goodput of checkpointing
+every 10 steps (reference GLM-65B cadence, flash_checkpoint.md:403).
+
+On CPU (no TPU chip) the bench degrades to tiny shapes so CI smoke
+runs still complete; the JSON line then reports device=cpu.
 """
 
 import json
@@ -18,38 +23,123 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-TARGET_SAVE_BLOCK_S = 5.0
+V5E_PEAK_FLOPS = 197e12  # bf16 per chip
+TARGET_SAVE_BLOCK_S = 5.0  # BASELINE.json north star
 
 
-def main():
-    from dlrover_tpu.checkpoint.engine import CheckpointEngine
-    from dlrover_tpu.models.gpt import GPT, GPTConfig
-    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+def _build(cfg_kwargs, batch, seq, mesh):
+    from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
     from dlrover_tpu.parallel.train_step import (
+        build_train_step,
         default_optimizer,
         init_train_state,
     )
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    # On the real chip use GPT-2 small (~124M params → ~1.5 GB of fp32
-    # param+adam state, a representative FCP payload); tiny on CPU.
-    cfg = GPTConfig.gpt2_small() if on_tpu else GPTConfig.tiny()
+    cfg = GPTConfig(max_seq_len=seq, **cfg_kwargs)
     model = GPT(cfg)
-    mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
     tx = default_optimizer()
-    tokens = jnp.zeros((2, 128), jnp.int32)
-    state, _ = init_train_state(model, tokens, mesh, tx)
-    jax.block_until_ready(state.params)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state, shardings = init_train_state(model, tokens, mesh, tx)
+    step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, shardings)
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    y = jnp.roll(x, -1, axis=1)
+    return cfg, state, step_fn, x, y
 
+
+def _time_steps(state, step_fn, x, y, iters=6):
+    state, loss = step_fn(state, x, y)  # compile + warmup
+    # Hard sync via a scalar fetch: over the tunneled chip the very first
+    # block_until_ready after compilation can return before the step has
+    # actually executed, which would poison the fastest sample.
+    if not np.isfinite(float(loss)):
+        raise RuntimeError(f"non-finite warmup loss {float(loss)}")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, loss = step_fn(state, x, y)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    if not np.isfinite(float(loss)):
+        raise RuntimeError(f"non-finite loss {float(loss)}")
+    return float(np.median(times)), state
+
+
+def _mfu(cfg, n_params, batch, seq, step_s):
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.embed_dim * seq
+    return flops_per_token * batch * seq / step_s / V5E_PEAK_FLOPS
+
+
+def main():
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # CI smoke: this environment's sitecustomize re-registers the
+        # hardware plugin after env-var resolution, so pin explicitly.
+        from dlrover_tpu.common.platform import force_virtual_cpu
+
+        force_virtual_cpu(1)
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+    extra = {"device": str(jax.devices()[0])}
+
+    if on_tpu:
+        # Flash path: bs=32 fits only because the Pallas kernel never
+        # materializes the s^2 probability tensor (dense OOMs at bs=32:
+        # 17.4G > 15.75G hbm); dense's best single-chip config is bs=16.
+        flash_bs, dense_bs, seq = 32, 16, 1024
+    else:
+        flash_bs, dense_bs, seq = 2, 2, 128
+
+    tiny = {} if on_tpu else dict(
+        vocab_size=256, num_layers=2, num_heads=4, head_dim=8, embed_dim=32,
+        use_remat=False,
+    )
+
+    cfg, state, step_fn, x, y = _build(
+        dict(attention_impl="flash", **tiny), flash_bs, seq, mesh
+    )
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    flash_s, state = _time_steps(state, step_fn, x, y)
+    flash_tps = flash_bs * seq / flash_s
+    extra.update(
+        {
+            "model": f"gpt2-small-{n_params/1e6:.0f}M" if on_tpu else "tiny",
+            "flash_step_s": round(flash_s, 4),
+            "flash_batch": flash_bs,
+            "seq_len": seq,
+            "mfu": round(_mfu(cfg, n_params, flash_bs, seq, flash_s), 4),
+        }
+    )
+
+    _, dstate, dstep_fn, dx, dy = _build(
+        dict(attention_impl="dense", **tiny), dense_bs, seq, mesh
+    )
+    dense_s, _ = _time_steps(dstate, dstep_fn, dx, dy)
+    del dstate, dstep_fn, dx, dy
+    dense_tps = dense_bs * seq / dense_s
+    extra.update(
+        {
+            "dense_step_s": round(dense_s, 4),
+            "dense_batch": dense_bs,
+            "dense_tokens_per_s": round(dense_tps, 1),
+            "flash_vs_dense": round(flash_tps / dense_tps, 3),
+        }
+    )
+
+    # -- flash checkpoint on the real train state (~1.5 GB on TPU) --------
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    engine = None
     try:
         engine = CheckpointEngine(ckpt_dir, mesh=mesh, standalone=True)
-        # Warmup (allocates shm at full size). Explicit checks, not assert:
-        # the metric must never be fabricated under python -O.
         if not engine.save_to_memory(0, state):
             raise RuntimeError("warmup save_to_memory failed")
-        # Measure the blocking cost of a memory save (D2H + memcpy)
         runs = []
         for step in range(1, 4):
             t0 = time.perf_counter()
@@ -58,7 +148,6 @@ def main():
             runs.append(time.perf_counter() - t0)
         save_block_s = min(runs)
 
-        # Async persist + restore must work end-to-end
         if not engine.save_to_storage(4, state):
             raise RuntimeError("save_to_storage failed")
         if not engine.wait_saving(timeout=600):
@@ -68,32 +157,64 @@ def main():
         restore_s = time.perf_counter() - t0
         if step != 4 or restored is None:
             raise RuntimeError(f"restore failed (step={step})")
+        del restored
 
         nbytes = sum(
             leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(state)
         )
-        print(
-            json.dumps(
-                {
-                    "metric": "flash_ckpt_save_block_s",
-                    "value": round(save_block_s, 4),
-                    "unit": "s",
-                    "vs_baseline": round(TARGET_SAVE_BLOCK_S / max(save_block_s, 1e-9), 2),
-                    "extra": {
-                        "ckpt_bytes": nbytes,
-                        "restore_s": round(restore_s, 4),
-                        "device": str(jax.devices()[0]),
-                    },
-                }
-            )
+        # Reference H2D transfer of the same byte count as ONE contiguous
+        # buffer, measured right now: the tunneled chip's host->device
+        # bandwidth swings more than 10x between runs, so the honest
+        # restore figure is the overhead over this floor, not wall time.
+        ref_frac = 4
+        # Incompressible payload: the transport may compress, and zeros
+        # would overstate the floor by an order of magnitude.
+        ref_buf = np.random.default_rng(0).standard_normal(
+            max(1, int(nbytes // (4 * ref_frac))), dtype=np.float32
+        )
+        ref_sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        t0 = time.perf_counter()
+        ref_arr = jax.device_put(ref_buf, ref_sh)
+        jax.block_until_ready(ref_arr)
+        h2d_ref_s = (time.perf_counter() - t0) * ref_frac
+        del ref_arr, ref_buf
+
+        goodput_10 = 10 * flash_s / (10 * flash_s + save_block_s)
+        extra.update(
+            {
+                "ckpt_bytes": int(nbytes),
+                "ckpt_save_block_s": round(save_block_s, 4),
+                "ckpt_save_vs_target": round(
+                    TARGET_SAVE_BLOCK_S / max(save_block_s, 1e-9), 2
+                ),
+                "restore_s": round(restore_s, 4),
+                "h2d_floor_s": round(h2d_ref_s, 4),
+                "restore_overhead_x": round(
+                    restore_s / max(h2d_ref_s, 1e-9), 2
+                ),
+                "goodput_ckpt_every_10_steps": round(goodput_10, 4),
+            }
         )
     finally:
-        try:
-            engine.shm.unlink()
-            engine.close()
-        except Exception:
-            pass
+        if engine is not None:
+            try:
+                engine.shm.unlink()
+                engine.close()
+            except Exception:
+                pass
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_s",
+                "value": round(flash_tps, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(flash_tps / dense_tps, 3),
+                "extra": extra,
+            }
+        )
+    )
 
 
 if __name__ == "__main__":

@@ -87,14 +87,29 @@ def jsonable_to_spec(data: List[Any]):
 def assemble_global(records: List[ShardRecord], record_read) -> np.ndarray:
     """Reassemble one leaf's global array from (possibly partial) records.
 
-    ``record_read(rec) -> bytes`` returns one record's payload — records
-    may live in different shard files (multi-host) or one shm segment.
-    Records must cover the full global index space (validated).
+    ``record_read(rec) -> buffer`` returns one record's payload (bytes or
+    a zero-copy view) — records may live in different shard files
+    (multi-host) or one shm segment. Records must cover the full global
+    index space (validated).
+
+    When a single record covers the whole leaf, its buffer is wrapped
+    without copying — the caller owns keeping the backing storage alive
+    until it is done with the result (the engine holds the shard lock
+    through the device transfer for exactly this reason).
     """
     assert records, "no records for leaf"
     head = records[0]
-    out = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
     total = int(np.prod(head.global_shape)) if head.global_shape else 1
+    if len(records) == 1:
+        covers = (not head.index) or all(
+            a == 0 and b == dim
+            for (a, b), dim in zip(head.index, head.global_shape)
+        )
+        if covers:
+            return np.frombuffer(
+                record_read(head), dtype=np.dtype(head.dtype)
+            ).reshape(head.global_shape)
+    out = np.empty(head.global_shape, dtype=np.dtype(head.dtype))
     covered_elems = 0
     full_write = False
     for rec in records:

@@ -170,26 +170,43 @@ class SharedMemoryHandler:
             logger.exception("unreadable checkpoint shm meta")
             return None
 
-    def payload_reader(self) -> Optional[Callable[[int, int], bytes]]:
+    def payload_reader(
+        self, copy: bool = True
+    ) -> Optional[Callable[[int, int], Any]]:
+        """Reader over the payload region. With ``copy=False`` the reader
+        returns zero-copy memoryviews into the segment — valid only while
+        the segment stays mapped and unmodified (hold the shard lock)."""
         meta = self.read_meta()
         if meta is None:
             return None
         meta_len = int.from_bytes(self._segment.read(0, HEADER_LEN_BYTES), "little")
         base = HEADER_LEN_BYTES + meta_len
 
-        def read(offset: int, nbytes: int) -> bytes:
-            return self._segment.read(base + offset, nbytes)
+        if copy:
+
+            def read(offset: int, nbytes: int) -> bytes:
+                return self._segment.read(base + offset, nbytes)
+
+        else:
+            buf = self._segment.buf
+
+            def read(offset: int, nbytes: int):
+                return buf[base + offset : base + offset + nbytes]
 
         return read
 
-    def load_pytree_host(self) -> Optional[Tuple[CheckpointMeta, Dict[str, np.ndarray]]]:
+    def load_pytree_host(
+        self, copy: bool = True
+    ) -> Optional[Tuple[CheckpointMeta, Dict[str, np.ndarray]]]:
         """Reassemble {leaf_path: global np array} from this host's shm.
 
         Only complete when this host holds every shard (single-host case);
-        multi-host loads go through the storage/gather paths.
+        multi-host loads go through the storage/gather paths. With
+        ``copy=False``, unsharded leaves are zero-copy views into the
+        segment (see :meth:`payload_reader`).
         """
         meta = self.read_meta()
-        reader = self.payload_reader()
+        reader = self.payload_reader(copy=copy)
         if meta is None or reader is None:
             return None
         by_path: Dict[str, List[ShardRecord]] = {}

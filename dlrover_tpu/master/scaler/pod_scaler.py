@@ -16,6 +16,7 @@ from ...scheduler.kubernetes import (
     build_worker_pod,
     k8sClient,
     pod_name,
+    pod_terminating,
 )
 from .base_scaler import ScalePlan, Scaler
 
@@ -53,6 +54,9 @@ class PodScaler(Scaler):
         # (node_id, rank) creates that failed (e.g. 409 against a
         # still-Terminating pod) — retried by the periodic reconcile loop.
         self._retry: Dict[int, int] = {}
+        # Planned rank per node id (from launch_nodes): the bare target
+        # loop must not silently reset a replacement's rank to its id.
+        self._ranks: Dict[int, int] = {}
         self._reconcile_interval = reconcile_interval
         self._reconcile_thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -80,16 +84,23 @@ class PodScaler(Scaler):
                 self._retry.pop(node_id, None)
             for node in plan.launch_nodes:
                 self._removed.discard(node.node_id)
+                self._ranks[node.node_id] = node.rank_index
                 self._create_worker(node.node_id, node.rank_index)
             self._reconcile()
 
     def _reconcile(self) -> None:
         pods = self._client.list_pods(f"{ELASTIC_JOB_LABEL}={self._job_name}")
-        existing = {pod_name(p) for p in pods}
+        # A Terminating pod still occupies its name (creates 409) but is
+        # going away — treat it as absent so its replacement stays queued.
+        existing = {pod_name(p) for p in pods if not pod_terminating(p)}
         for node_id in range(self._target):
             name = f"{self._job_name}-worker-{node_id}"
-            if name not in existing and node_id not in self._removed:
-                self._create_worker(node_id, node_id)
+            if (
+                name not in existing
+                and node_id not in self._removed
+                and node_id not in self._retry
+            ):
+                self._create_worker(node_id, self._ranks.get(node_id, node_id))
         for node_id, rank in list(self._retry.items()):
             if f"{self._job_name}-worker-{node_id}" in existing:
                 self._retry.pop(node_id, None)

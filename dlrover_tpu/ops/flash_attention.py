@@ -177,8 +177,8 @@ def _flash_fwd(
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
     block_q, block_k = _clamp_blocks(q.dtype, t_q, t_kv, block_q, block_k)
-    tq_pad = math.ceil(t_q / block_q) * block_q
-    tk_pad = math.ceil(t_kv / block_k) * block_k
+    tq_pad = _round_up(t_q, block_q)
+    tk_pad = _round_up(t_kv, block_k)
     qp = _pad_to(q, tq_pad, 1)
     kp = _pad_to(k, tk_pad, 1)
     vp = _pad_to(v, tk_pad, 1)
@@ -371,8 +371,8 @@ def _flash_bwd(
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
     block_q, block_k = _clamp_blocks(q.dtype, t_q, t_kv, block_q, block_k)
-    tq_pad = math.ceil(t_q / block_q) * block_q
-    tk_pad = math.ceil(t_kv / block_k) * block_k
+    tq_pad = _round_up(t_q, block_q)
+    tk_pad = _round_up(t_kv, block_k)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qp = _pad_to(q, tq_pad, 1)
     kp = _pad_to(k, tk_pad, 1)

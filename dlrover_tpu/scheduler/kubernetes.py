@@ -130,6 +130,15 @@ def pod_phase(pod: Any) -> str:
     return getattr(status, "phase", "") or ""
 
 
+def pod_terminating(pod: Any) -> bool:
+    """True when the pod has a deletionTimestamp (graceful delete in
+    progress — its name is still taken but it is going away)."""
+    if isinstance(pod, dict):
+        return bool(pod.get("metadata", {}).get("deletionTimestamp"))
+    meta = getattr(pod, "metadata", None)
+    return bool(getattr(meta, "deletion_timestamp", None))
+
+
 def build_worker_pod(
     job_name: str,
     node_id: int,

@@ -78,6 +78,8 @@ class CheckpointEngine:
         num_hosts: Optional[int] = None,
         master_client=None,
         standalone: Optional[bool] = None,
+        replicate: Optional[bool] = None,
+        replica_peers: Optional[Dict[int, str]] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.mesh = mesh
@@ -96,6 +98,14 @@ class CheckpointEngine:
         self.shm = SharedMemoryHandler(self.host_rank)
         self._events = TrainerEvents()
         self._latest_storage_step = -1
+        # Peer-memory replication (reference replica.py): on by default
+        # for multi-host jobs; each memory save is mirrored into a backup
+        # host's memory by the agent saver, and load() can recover this
+        # host's shard from a peer when the node was replaced.
+        self._replicate = (
+            replicate if replicate is not None else self.num_hosts > 1
+        )
+        self._replica_peers = replica_peers
 
         if standalone is None:
             standalone = not LocalSocketClient("queue_" + FACTORY_QUEUE).available()
@@ -112,6 +122,8 @@ class CheckpointEngine:
                 "storage_root": checkpoint_dir,
                 "host_rank": self.host_rank,
                 "num_hosts": self.num_hosts,
+                "replicate": self._replicate,
+                "replica_peers": self._replica_peers,
             }
         )
         self._shard_lock = self._wait_lock()
@@ -145,9 +157,13 @@ class CheckpointEngine:
                     mesh=self.mesh,
                     extra=extra,
                 )
-            return True
         finally:
             self._shard_lock.release()
+        if self._replicate:
+            # Mirror to the backup peer — handled by the agent saver so
+            # the trainer never blocks on a DCN transfer.
+            self._event_q.put({"type": CheckpointEvent.REPLICATE, "step": step})
+        return True
 
     def save_to_storage(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
         """Stage to memory, then hand persistence to the agent saver."""
@@ -173,7 +189,10 @@ class CheckpointEngine:
     # -- load --------------------------------------------------------------
 
     def load(self, template: Any) -> Tuple[int, Optional[Any]]:
-        """Memory-first restore into ``template``'s structure/shardings.
+        """Restore into ``template``'s structure/shardings: own host
+        memory first, then a peer's replica of this host's shard
+        (node-replacement recovery without touching storage — reference
+        engine.py:375,392-409), then storage.
 
         Returns (step, restored_pytree) or (-1, None) if nothing to load.
         """
@@ -181,10 +200,56 @@ class CheckpointEngine:
             result = self._load_from_memory(template)
             if result is not None:
                 return result
+            result = self._load_from_peer(template)
+            if result is not None:
+                return result
             result = self._load_from_storage(template)
             if result is not None:
                 return result
         return -1, None
+
+    def _load_from_peer(self, template: Any):
+        """Refill this host's shm from the peer that replicated it, then
+        load through the normal memory path. A replica can be stale
+        (push failures are log-and-drop), so if storage holds a NEWER
+        step the peer result is discarded and load() falls through."""
+        if not self._replicate:
+            return None
+        from .replica import ReplicaManager, default_master_client
+
+        client = self.master_client
+        if client is None and self._replica_peers is None:
+            client = default_master_client()
+            if client is None:
+                return None
+        manager = ReplicaManager(
+            self.host_rank,
+            self.num_hosts,
+            master_client=client,
+            peers=self._replica_peers,
+        )
+        if not self._shard_lock.acquire(blocking=True, timeout=60.0):
+            return None
+        try:
+            fetched = manager.fetch_own_shard(self.shm.write_image_stream)
+        finally:
+            self._shard_lock.release()
+            manager.stop()
+        if not fetched:
+            return None
+        result = self._load_from_memory(template)
+        if result is None:
+            return None
+        storage_step = self.storage.latest_step() or -1
+        if storage_step > result[0]:
+            logger.info(
+                "peer replica holds step %s but storage has %s; "
+                "preferring storage",
+                result[0],
+                storage_step,
+            )
+            return None
+        return result
 
     def _load_from_memory(self, template: Any):
         # Everything happens under the shard lock: the persister (or a

@@ -1,0 +1,447 @@
+"""Peer-memory checkpoint replicas over the DCN control plane.
+
+Reference: ``CkptReplicaManger`` / ``ShardCkptReplicaManager``
+(``dlrover/trainer/torch/flash_checkpoint/replica.py:28,73-245``) back up
+each node's shm checkpoint shard into a peer node's memory via an
+allgather over backup ranks, and ``engine.py:392-409`` gathers a lost
+shard back from peers on restart — recovery without touching storage
+even when a whole node (and its shm) is replaced.
+
+TPU-native shape: replication is a *host-level* concern, so it lives in
+the agent's saver process, not the training loop. The staged shm bytes
+are pushed asynchronously to a peer host's :class:`ReplicaServer` over
+DCN (plain HTTP, streamed in chunks), never riding the ICI data plane
+and never blocking the train step — the reference's in-training
+allgather would serialize a multi-GB transfer into the step time on a
+TPU, and a host-level push is also what survives when the training
+process is already dead. Peer discovery goes through the master KV
+store (``ckpt_replica/{rank}`` -> ``host:port``).
+
+The stored unit is the raw shm segment image
+(``[8B meta_len][meta JSON][payload]``), so a fetched replica can be
+written verbatim into the replacement host's segment and loaded through
+the normal memory-restore path.
+"""
+
+import hashlib
+import os
+import socket
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from ..common.log import logger
+from ..common.multi_process import SharedMemorySegment
+from .meta import HEADER_LEN_BYTES, CheckpointMeta
+from .shm_handler import segment_image_size, stream_into_segment
+
+KV_PREFIX = "ckpt_replica/"
+_CHUNK = 8 << 20
+_TOKEN_HEADER = "X-Replica-Token"
+
+
+def _job_token() -> str:
+    """Shared-secret for the replica endpoints. Prefer an operator-set
+    secret (DLROVER_REPLICA_TOKEN); otherwise derive from the job name so
+    at least cross-job and drive-by requests are rejected. Proper network
+    isolation (k8s NetworkPolicy scoping the job's pods) is still the
+    primary control; this closes the unauthenticated-write hole."""
+    secret = os.getenv("DLROVER_REPLICA_TOKEN")
+    if secret:
+        return secret
+    job = os.getenv("DLROVER_JOB_NAME", "default")
+    return hashlib.sha256(f"dlrover-replica:{job}".encode()).hexdigest()
+
+
+def default_master_client():
+    """MasterClient from env if a master address is configured."""
+    try:
+        from ..rpc.client import MasterClient
+
+        return MasterClient.singleton()
+    except Exception:
+        return None
+
+
+def replica_segment_name(owner_rank: int) -> str:
+    return f"ckpt_replica_{owner_rank}"
+
+
+def backup_rank(host_rank: int, num_hosts: int) -> int:
+    """Peer that stores this host's replica.
+
+    Pairs of adjacent ranks back each other up (reference
+    ``ShardCkptReplicaManager`` builds 2-rank backup groups,
+    replica.py:99-116); a trailing odd rank wraps to rank 0.
+    """
+    if num_hosts <= 1:
+        return host_rank
+    partner = host_rank ^ 1
+    if partner >= num_hosts:
+        partner = 0
+    return partner
+
+
+class ReplicaStore:
+    """Holds peers' segment images in this host's memory (shm-backed, so
+    a replica survives agent restarts just like the local shard)."""
+
+    def __init__(self):
+        self._segments: Dict[int, SharedMemorySegment] = {}
+        self._sizes: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _segment(self, owner_rank: int) -> SharedMemorySegment:
+        seg = self._segments.get(owner_rank)
+        if seg is None:
+            seg = SharedMemorySegment(replica_segment_name(owner_rank))
+            self._segments[owner_rank] = seg
+        return seg
+
+    def put_stream(
+        self, owner_rank: int, total: int, read: Callable[[int], bytes]
+    ) -> None:
+        """Stream ``total`` bytes from ``read(n)`` into the owner's
+        replica segment (no full-payload copy in RAM). Torn-write safe:
+        the advertised size is dropped before the overwrite and the
+        segment header lands last (:func:`stream_into_segment`), so an
+        interrupted PUT leaves an image readers treat as absent — never
+        a new meta over an old payload."""
+        with self._lock:
+            self._sizes.pop(owner_rank, None)
+            seg = self._segment(owner_rank)
+            stream_into_segment(seg, total, read)
+            self._sizes[owner_rank] = total
+
+    def image_size(self, owner_rank: int) -> int:
+        with self._lock:
+            size = self._sizes.get(owner_rank, 0)
+            if size:
+                return size
+            # After an agent restart the segment may pre-exist in shm:
+            # recover its logical size from the embedded meta.
+            size = segment_image_size(self._segment(owner_rank))
+            if size:
+                self._sizes[owner_rank] = size
+            return size
+
+    def read(self, owner_rank: int, offset: int, nbytes: int) -> bytes:
+        with self._lock:
+            seg = self._segment(owner_rank)
+            if not seg.attach():
+                return b""
+            return seg.read(offset, nbytes)
+
+    def step_of(self, owner_rank: int) -> Optional[int]:
+        if not self.image_size(owner_rank):
+            return None
+        with self._lock:
+            seg = self._segment(owner_rank)
+            try:
+                meta_len = int.from_bytes(
+                    seg.read(0, HEADER_LEN_BYTES), "little"
+                )
+                meta = CheckpointMeta.from_json(
+                    seg.read(HEADER_LEN_BYTES, meta_len).decode()
+                )
+                return meta.step
+            except Exception:
+                return None
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._segments.values():
+                try:
+                    seg.close()
+                except Exception:
+                    pass
+            self._segments.clear()
+
+    def unlink(self) -> None:
+        with self._lock:
+            for seg in self._segments.values():
+                try:
+                    seg.unlink()
+                except Exception:
+                    pass
+            self._segments.clear()
+            self._sizes.clear()
+
+
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    store: ReplicaStore = None  # set on the server subclass
+    protocol_version = "HTTP/1.1"
+
+    def _rank(self) -> Optional[int]:
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 2 and parts[0] == "shard":
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
+    def _authorized(self) -> bool:
+        if self.headers.get(_TOKEN_HEADER, "") == _job_token():
+            return True
+        self.send_error(403)
+        return False
+
+    def do_PUT(self):  # noqa: N802 — http.server API
+        if not self._authorized():
+            return
+        rank = self._rank()
+        length = int(self.headers.get("Content-Length", 0))
+        if rank is None or length <= 0:
+            self.send_error(400)
+            return
+        try:
+            self.store.put_stream(rank, length, self.rfile.read)
+        except Exception as e:
+            logger.exception("replica PUT failed")
+            self.send_error(500, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):  # noqa: N802
+        if not self._authorized():
+            return
+        rank = self._rank()
+        if rank is None:
+            self.send_error(404)
+            return
+        total = self.store.image_size(rank)
+        if not total:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(total))
+        self.end_headers()
+        off = 0
+        while off < total:
+            chunk = self.store.read(rank, off, min(_CHUNK, total - off))
+            if not chunk:
+                break
+            self.wfile.write(chunk)
+            off += len(chunk)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class ReplicaServer:
+    """Per-host replica endpoint (runs in the agent/saver process)."""
+
+    def __init__(self, store: ReplicaStore, port: int = 0):
+        handler = type("BoundReplicaHandler", (_ReplicaHandler,), {"store": store})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="ckpt-replica"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+        logger.info("checkpoint replica server on :%s", self.port)
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+
+class ReplicaClient:
+    """Push/fetch segment images to/from a peer's ReplicaServer."""
+
+    @staticmethod
+    def push(
+        addr: str,
+        owner_rank: int,
+        total: int,
+        read: Callable[[int, int], bytes],
+        timeout: float = 120.0,
+    ) -> bool:
+        """PUT ``total`` bytes (``read(offset, n)``) as rank's shard."""
+
+        class _Reader:
+            def __init__(self):
+                self.off = 0
+
+            def read(self, n: int = -1) -> bytes:
+                if self.off >= total:
+                    return b""
+                n = total - self.off if n is None or n < 0 else min(n, total - self.off)
+                chunk = read(self.off, n)
+                self.off += len(chunk)
+                return chunk
+
+        req = urllib.request.Request(
+            f"http://{addr}/shard/{owner_rank}", data=_Reader(), method="PUT"
+        )
+        req.add_header("Content-Length", str(total))
+        req.add_header(_TOKEN_HEADER, _job_token())
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status == 200
+        except Exception as e:
+            logger.warning("replica push to %s failed: %s", addr, e)
+            return False
+
+    @staticmethod
+    def fetch_stream(
+        addr: str,
+        owner_rank: int,
+        sink: Callable[[int, Callable[[int], bytes]], None],
+        timeout: float = 30.0,
+    ) -> bool:
+        """GET rank's shard from ``addr``; call ``sink(total, read)``."""
+        req = urllib.request.Request(
+            f"http://{addr}/shard/{owner_rank}",
+            headers={_TOKEN_HEADER: _job_token()},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                total = int(resp.headers.get("Content-Length", 0))
+                if resp.status != 200 or total <= 0:
+                    return False
+                sink(total, resp.read)
+                return True
+        except Exception as e:
+            logger.debug("replica fetch from %s: %s", addr, e)
+            return False
+
+
+class ReplicaManager:
+    """Agent-side replication driver.
+
+    ``replicate()`` pushes the local staged shard to the backup peer;
+    ``fetch_own_shard(sink)`` recovers this host's shard from whichever
+    peer holds it (reference engine.py:392-409 ``gather``).
+    """
+
+    def __init__(
+        self,
+        host_rank: int,
+        num_hosts: int,
+        master_client=None,
+        peers: Optional[Dict[int, str]] = None,
+        advertise_host: Optional[str] = None,
+    ):
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self.master_client = master_client
+        self._static_peers = peers
+        self.store = ReplicaStore()
+        # Server is created in start(): fetch-only users (the trainer
+        # engine restoring from a peer) must not bind a port.
+        self.server: Optional[ReplicaServer] = None
+        self._advertise_host = advertise_host or _local_host()
+
+    def start(self) -> None:
+        if self.server is None:
+            self.server = ReplicaServer(self.store)
+        self.server.start()
+        self._register()
+
+    def _register(self) -> None:
+        if self.master_client is None or self.server is None:
+            return
+        addr = f"{self._advertise_host}:{self.server.port}"
+        try:
+            self.master_client.kv_store_set(
+                f"{KV_PREFIX}{self.host_rank}", addr.encode()
+            )
+        except Exception:
+            logger.exception("replica address registration failed")
+
+    def peer_addrs(self) -> Dict[int, str]:
+        if self._static_peers is not None:
+            return dict(self._static_peers)
+        if self.master_client is None:
+            return {}
+        keys = [f"{KV_PREFIX}{r}" for r in range(self.num_hosts)]
+        try:
+            kvs = self.master_client.kv_store_multi_get(keys)
+        except Exception:
+            logger.exception("replica peer lookup failed")
+            return {}
+        out = {}
+        for key, val in (kvs or {}).items():
+            if val:
+                out[int(key.rsplit("/", 1)[-1])] = val.decode()
+        return out
+
+    def replicate(
+        self, total: int, read: Callable[[int, int], bytes]
+    ) -> bool:
+        """Push this host's staged segment image to its backup peer."""
+        peer = backup_rank(self.host_rank, self.num_hosts)
+        if peer == self.host_rank:
+            return True  # single host: nothing to protect against
+        addr = self.peer_addrs().get(peer)
+        if not addr:
+            logger.warning("no replica address for peer %s", peer)
+            return False
+        ok = ReplicaClient.push(addr, self.host_rank, total, read)
+        if ok:
+            logger.info(
+                "replicated shard of rank %s (%d bytes) to rank %s",
+                self.host_rank,
+                total,
+                peer,
+            )
+        return ok
+
+    def fetch_own_shard(
+        self, sink: Callable[[int, Callable[[int], bytes]], None]
+    ) -> bool:
+        """Recover this host's shard from the peer that holds it.
+
+        Only ``backup_rank(self)`` can have the replica (the mapping is
+        deterministic), so no full-fleet probe — each dead peer would
+        otherwise cost a connect timeout during recovery."""
+        holder = backup_rank(self.host_rank, self.num_hosts)
+        if holder == self.host_rank:
+            return False
+        addrs = self.peer_addrs()
+        addr = addrs.get(holder)
+        if not addr:
+            logger.warning("no replica address for holder %s", holder)
+            return False
+        if ReplicaClient.fetch_stream(addr, self.host_rank, sink):
+            logger.info(
+                "recovered shard of rank %s from peer %s",
+                self.host_rank,
+                holder,
+            )
+            return True
+        return False
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+        self.store.close()
+
+
+def _local_host() -> str:
+    """Advertised host for the replica endpoint. Hostname resolution is
+    authoritative on k8s (pod DNS); fall back to the outbound IP."""
+    host = socket.gethostname()
+    try:
+        socket.getaddrinfo(host, None)
+        return host
+    except OSError:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

@@ -36,6 +36,7 @@ def lock_name(host_rank: int) -> str:
 class CheckpointEvent:
     SAVE = "save"
     UPDATE = "update"
+    REPLICATE = "replicate"
     EXIT = "exit"
 
 
@@ -49,7 +50,14 @@ class AsyncCheckpointSaver:
     _runner_thread: Optional[threading.Thread] = None
     _signals_installed = False
 
-    def __init__(self, storage_root: str, host_rank: int = 0, num_hosts: int = 1):
+    def __init__(
+        self,
+        storage_root: str,
+        host_rank: int = 0,
+        num_hosts: int = 1,
+        replicate: bool = False,
+        replica_peers=None,
+    ):
         self.storage = PosixCheckpointStorage(storage_root)
         self.host_rank = host_rank
         self.num_hosts = num_hosts
@@ -59,6 +67,47 @@ class AsyncCheckpointSaver:
         self._running = True
         self._persisted_steps: Dict[int, bool] = {}
         self.master_client = None  # optional: cross-host step sync
+        self.replica_manager = None
+        self._replica_peers = replica_peers
+        self._replicate_q: Optional[_queue.Queue] = None
+        self._replicate_thread: Optional[threading.Thread] = None
+        if replicate and num_hosts > 1:
+            self._start_replication()
+
+    def _start_replication(self) -> None:
+        """Serve this host's replica store and register its address
+        (reference replica.py:73 backup groups; TPU shape: host-level
+        push over DCN, see checkpoint/replica.py)."""
+        from .replica import ReplicaManager
+
+        client = self.master_client
+        if client is None and self._replica_peers is None:
+            try:
+                from ..rpc.client import MasterClient
+
+                client = MasterClient.singleton()
+            except Exception:
+                client = None
+        try:
+            self.replica_manager = ReplicaManager(
+                self.host_rank,
+                self.num_hosts,
+                master_client=client,
+                peers=self._replica_peers,
+            )
+            self.replica_manager.start()
+        except Exception:
+            logger.exception("replica manager failed to start")
+            self.replica_manager = None
+            return
+        if self._replicate_thread is None or not self._replicate_thread.is_alive():
+            self._replicate_q = _queue.Queue(maxsize=64)
+            self._replicate_thread = threading.Thread(
+                target=self._replicate_worker,
+                name="ckpt-replicate",
+                daemon=True,
+            )
+            self._replicate_thread.start()
 
     # -- factory / lifecycle ----------------------------------------------
 
@@ -89,6 +138,8 @@ class AsyncCheckpointSaver:
                         storage_root=msg["storage_root"],
                         host_rank=msg.get("host_rank", 0),
                         num_hosts=msg.get("num_hosts", 1),
+                        replicate=msg.get("replicate", False),
+                        replica_peers=msg.get("replica_peers"),
                     )
                     # Lock server must exist before the trainer acquires it;
                     # get_or_create made it. Ack by re-running the loop.
@@ -105,11 +156,22 @@ class AsyncCheckpointSaver:
 
     @classmethod
     def get_or_create(
-        cls, storage_root: str, host_rank: int = 0, num_hosts: int = 1
+        cls,
+        storage_root: str,
+        host_rank: int = 0,
+        num_hosts: int = 1,
+        replicate: bool = False,
+        replica_peers=None,
     ) -> "AsyncCheckpointSaver":
         with cls._cls_lock:
             if cls._instance is None:
-                cls._instance = cls(storage_root, host_rank, num_hosts)
+                cls._instance = cls(
+                    storage_root,
+                    host_rank,
+                    num_hosts,
+                    replicate=replicate,
+                    replica_peers=replica_peers,
+                )
             else:
                 inst = cls._instance
                 inst.storage = PosixCheckpointStorage(storage_root)
@@ -136,6 +198,18 @@ class AsyncCheckpointSaver:
                     inst.host_rank = host_rank
                     inst.num_hosts = num_hosts
                     inst._persisted_steps.clear()
+                    if inst.replica_manager is not None:
+                        inst.replica_manager.stop()
+                        inst.replica_manager = None
+                if replicate and num_hosts > 1:
+                    inst._replica_peers = replica_peers
+                    if inst.replica_manager is None:
+                        inst._start_replication()
+                elif inst.replica_manager is not None:
+                    # replication turned off with unchanged topology:
+                    # stop serving and unregister the stale endpoint
+                    inst.replica_manager.stop()
+                    inst.replica_manager = None
             return cls._instance
 
     @classmethod
@@ -236,6 +310,8 @@ class AsyncCheckpointSaver:
                 return
             if etype == CheckpointEvent.SAVE:
                 self._persist_step(event.get("step", -1))
+            elif etype == CheckpointEvent.REPLICATE:
+                self._replicate_step(event.get("step", -1))
 
     def _persist_step(self, step: int) -> None:
         """Drain shm → storage under the shard lock (reference :925).
@@ -262,6 +338,52 @@ class AsyncCheckpointSaver:
         self._persisted_steps[meta.step] = True
         self.storage.commit(meta.step, self.num_hosts)
 
+    def _replicate_step(self, step: int) -> None:
+        """Hand the push to the replication worker: a multi-GB DCN
+        transfer must not stall the persist loop behind it (the SAVE for
+        the same step sits on the same serial event queue)."""
+        if self.replica_manager is None or self._replicate_q is None:
+            return
+        try:
+            self._replicate_q.put_nowait(step)
+        except _queue.Full:
+            logger.warning("replication backlog full; dropping step %s", step)
+
+    def _replicate_worker(self) -> None:
+        """Optimistic lock-free push with verify-after: the shard lock is
+        NOT held during the transfer (a 2-minute push would make the
+        trainer skip its memory saves), so the trainer may restage while
+        we stream. The staged step is compared before and after; a
+        mismatch means the bytes were torn mid-push and the new image is
+        pushed again — the receiver's torn copy is overwritten, and its
+        header-last protocol keeps even the torn copy unreadable rather
+        than silently wrong."""
+        while self._running:
+            try:
+                self._replicate_q.get(timeout=1.0)
+            except _queue.Empty:
+                continue
+            # collapse the backlog: only the newest staged image matters
+            try:
+                while True:
+                    self._replicate_q.get_nowait()
+            except _queue.Empty:
+                pass
+            manager = self.replica_manager
+            if manager is None:
+                continue
+            for _ in range(3):
+                meta = self.shm.read_meta()
+                total = self.shm.image_size()
+                if meta is None or not total:
+                    break
+                before = meta.step
+                if not manager.replicate(total, self.shm.read_image):
+                    break
+                after = self.shm.read_meta()
+                if after is not None and after.step == before:
+                    break  # clean push
+
     def save_shm_to_storage(self) -> bool:
         """Breakpoint save: persist whatever step is staged in shm
         (reference :758, called from the agent when workers fail)."""
@@ -276,3 +398,5 @@ class AsyncCheckpointSaver:
 
     def stop(self) -> None:
         self._running = False
+        if self.replica_manager is not None:
+            self.replica_manager.stop()

@@ -27,6 +27,56 @@ from .meta import (
 )
 
 
+_IMAGE_CHUNK = 8 << 20
+
+
+def segment_image_size(segment: SharedMemorySegment) -> int:
+    """Logical byte length of a segment image
+    (``[8B meta_len][meta JSON][payload]``), 0 when absent/invalid."""
+    if not segment.attach():
+        return 0
+    try:
+        meta_len = int.from_bytes(segment.read(0, HEADER_LEN_BYTES), "little")
+        if meta_len <= 0 or meta_len > segment.size:
+            return 0
+        meta = CheckpointMeta.from_json(
+            segment.read(HEADER_LEN_BYTES, meta_len).decode()
+        )
+        return HEADER_LEN_BYTES + meta_len + meta.total_bytes
+    except Exception:
+        return 0
+
+
+def stream_into_segment(
+    segment: SharedMemorySegment, total: int, read
+) -> None:
+    """Overwrite ``segment`` with a ``total``-byte image from ``read(n)``.
+
+    Torn-write safe: the 8-byte header is zeroed first and written LAST,
+    so a stream that dies mid-transfer leaves a segment whose meta never
+    parses (readers see "empty") instead of a valid-looking image over a
+    truncated payload. Raises on truncation; the header stays invalid.
+    """
+    segment.ensure(total)
+    buf = segment.buf
+    buf[:HEADER_LEN_BYTES] = b"\x00" * HEADER_LEN_BYTES
+    header = b""
+    off = 0
+    while off < total:
+        chunk = read(min(_IMAGE_CHUNK, total - off))
+        if not chunk:
+            raise IOError(f"segment image truncated at {off}/{total}")
+        if off < HEADER_LEN_BYTES:
+            take = min(len(chunk), HEADER_LEN_BYTES - off)
+            header += chunk[:take]
+            if len(chunk) > take:
+                buf[off + take : off + len(chunk)] = chunk[take:]
+        else:
+            buf[off : off + len(chunk)] = chunk
+        off += len(chunk)
+    buf[:HEADER_LEN_BYTES] = header
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:
@@ -218,6 +268,21 @@ class SharedMemoryHandler:
                 records, lambda rec: reader(rec.offset, rec.nbytes)
             )
         return meta, out
+
+    # -- raw segment image (peer replication) ------------------------------
+
+    def image_size(self) -> int:
+        """Total bytes of the current segment image, 0 when empty."""
+        return segment_image_size(self._segment)
+
+    def read_image(self, offset: int, nbytes: int) -> bytes:
+        return self._segment.read(offset, nbytes)
+
+    def write_image_stream(self, total: int, read) -> None:
+        """Overwrite this segment with a ``total``-byte image streamed
+        from ``read(n)`` (restore-from-peer path). Torn-write safe —
+        see :func:`stream_into_segment`."""
+        stream_into_segment(self._segment, total, read)
 
     def exists(self) -> bool:
         return self._segment.exists()

@@ -82,6 +82,11 @@ class ElasticTrainingAgent:
     # -- lifecycle --------------------------------------------------------
 
     def run(self) -> int:
+        # A hard-killed predecessor agent may have left its worker
+        # orphaned (own session) — reap before touching shm or devices.
+        from .worker import reap_stale_workers
+
+        reap_stale_workers()
         if self._start_ckpt_saver:
             AsyncCheckpointSaver.start_async_saving_ckpt()
         self._diagnosis.start_heartbeat()

@@ -31,6 +31,130 @@ class WorkerState:
     STOPPED = "stopped"
 
 
+_PIDFILE_DIR = os.getenv(
+    "DLROVER_PIDFILE_DIR", os.path.join("/tmp", "dlrover_tpu", "workers")
+)
+
+
+def _worker_pidfile() -> str:
+    from ..common.multi_process import _ipc_namespace
+
+    os.makedirs(_PIDFILE_DIR, exist_ok=True)
+    return os.path.join(_PIDFILE_DIR, f"{_ipc_namespace()}.pid")
+
+
+def _proc_stat(pid: int):
+    """(state, start_ticks) of ``pid`` from /proc, or None when gone.
+    (pid, start time) uniquely identifies a process incarnation — the
+    pid-reuse guard the reaper needs."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+    except OSError:
+        return None
+    # fields counted after the parenthesized comm (which may itself
+    # contain spaces/parens): state is field 3, starttime field 22
+    try:
+        rest = stat[stat.rindex(b")") + 2 :].split()
+        return rest[0].decode(), int(rest[19])
+    except (ValueError, IndexError):
+        return None
+
+
+def _proc_starttime(pid: int) -> Optional[int]:
+    info = _proc_stat(pid)
+    return info[1] if info else None
+
+
+def kill_worker_by_pidfile(namespace: str) -> None:
+    """Kill the worker recorded for ``namespace`` (platform teardown:
+    a pod's death takes every process in it, so a process-scaler "pod"
+    kill must take the worker even though it runs in its own session)."""
+    pidfile = os.path.join(_PIDFILE_DIR, f"{namespace}.pid")
+    try:
+        parts = open(pidfile).read().split()
+        pid = int(parts[0])
+        recorded_start = int(parts[1]) if len(parts) > 1 else 0
+    except (OSError, ValueError):
+        return
+    info = _proc_stat(pid)
+    if info is None or (recorded_start and info[1] != recorded_start):
+        return
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    try:
+        os.unlink(pidfile)
+    except OSError:
+        pass
+
+
+class OrphanWorkerError(RuntimeError):
+    """A previous incarnation's worker could not be killed; starting a
+    second trainer would race on the devices and the checkpoint shard."""
+
+
+def reap_stale_workers() -> None:
+    """Kill a previous agent incarnation's worker before starting ours.
+
+    When an agent dies hard (SIGKILL, OOM) its worker — which runs in
+    its own session so the agent can killpg the whole tree — survives as
+    an orphan still holding the TPU chips and the staged shm. The
+    replacement agent must reap it first (reference orphan reaping,
+    training.py:585-628), or two trainers race on the same devices and
+    checkpoint shard.
+
+    Identity is (pid, kernel start time) recorded by the agent that
+    spawned the worker, so pid reuse can never kill an innocent process.
+    Raises :class:`OrphanWorkerError` (keeping the pidfile) if the
+    orphan refuses to die — failing fast beats double-training.
+    """
+    pidfile = _worker_pidfile()
+    try:
+        parts = open(pidfile).read().split()
+        pid = int(parts[0])
+        recorded_start = int(parts[1]) if len(parts) > 1 else None
+    except (OSError, ValueError):
+        return
+
+    def alive() -> bool:
+        info = _proc_stat(pid)
+        if info is None:
+            return False
+        state, start = info
+        if state == "Z":
+            return False  # zombie: dead, just unreaped (orphaned to init)
+        # 0/None = start time unknown at spawn; fall back to pid-only
+        return not recorded_start or start == recorded_start
+
+    if alive():
+        logger.warning("reaping orphan worker pid=%s from dead agent", pid)
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        # wait for the process to actually vanish (device release)
+        deadline = time.time() + 30
+        while time.time() < deadline and alive():
+            time.sleep(0.2)
+        if alive():
+            raise OrphanWorkerError(
+                f"orphan worker pid={pid} survived SIGKILL; refusing to "
+                "start a second trainer against the same devices/shm"
+            )
+    try:
+        os.unlink(pidfile)
+    except OSError:
+        pass
+
+
 @dataclass
 class WorkerSpec:
     """What to run and how to restart it."""
@@ -105,6 +229,12 @@ class WorkerProcess:
             start_new_session=True,
         )
         self.start_time = time.time()
+        try:
+            start_ticks = _proc_starttime(self._proc.pid)
+            with open(_worker_pidfile(), "w") as f:
+                f.write(f"{self._proc.pid} {start_ticks or 0}")
+        except OSError:
+            logger.warning("could not write worker pidfile")
         logger.info(
             "started worker pid=%s restart=%s cmd=%s",
             self._proc.pid,
@@ -154,6 +284,10 @@ class WorkerProcess:
         self._proc.wait()
         self._reap_orphans(pgid)
         self._close_log()
+        try:
+            os.unlink(_worker_pidfile())
+        except OSError:
+            pass
 
     def wait(self, timeout: Optional[float] = None) -> RunResult:
         if self._proc is not None:

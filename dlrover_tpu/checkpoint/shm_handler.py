@@ -189,7 +189,10 @@ class SharedMemoryHandler:
         total = HEADER_LEN_BYTES + len(meta_bytes) + offset
         self._segment.ensure(total)
         buf = self._segment.buf
-        buf[:HEADER_LEN_BYTES] = len(meta_bytes).to_bytes(HEADER_LEN_BYTES, "little")
+        # Header lands LAST: a trainer killed mid-stage must leave an
+        # image that parses as absent, not a fresh meta over a torn
+        # payload (the agent's breakpoint save would persist it).
+        buf[:HEADER_LEN_BYTES] = b"\x00" * HEADER_LEN_BYTES
         payload_base = HEADER_LEN_BYTES + len(meta_bytes)
         buf[HEADER_LEN_BYTES:payload_base] = meta_bytes
         for rec, shard in plan:
@@ -199,6 +202,9 @@ class SharedMemoryHandler:
             view = np.frombuffer(buf, dtype=np.uint8, count=rec.nbytes, offset=start)
             view[:] = flat.view(np.uint8)
             del view  # release the exported buffer pointer promptly
+        buf[:HEADER_LEN_BYTES] = len(meta_bytes).to_bytes(
+            HEADER_LEN_BYTES, "little"
+        )
         return meta
 
     # -- agent / loader side ----------------------------------------------
@@ -283,6 +289,14 @@ class SharedMemoryHandler:
         from ``read(n)`` (restore-from-peer path). Torn-write safe —
         see :func:`stream_into_segment`."""
         stream_into_segment(self._segment, total, read)
+
+    def invalidate(self) -> None:
+        """Zero the header so the staged image reads as absent (e.g. a
+        stale peer image that must not be breakpoint-persisted)."""
+        if self._segment.attach():
+            buf = self._segment.buf
+            if buf is not None and len(buf) >= HEADER_LEN_BYTES:
+                buf[:HEADER_LEN_BYTES] = b"\x00" * HEADER_LEN_BYTES
 
     def exists(self) -> bool:
         return self._segment.exists()

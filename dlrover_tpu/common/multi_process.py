@@ -33,9 +33,19 @@ SOCKET_TMP_DIR = os.getenv(
 _LEN = struct.Struct("!I")
 
 
+def _ipc_namespace() -> str:
+    """Machine-local IPC namespace. Normally the job name; when several
+    simulated "hosts" of one job share a real machine (chaos/e2e tests,
+    standalone multi-agent runs), DLROVER_IPC_NAMESPACE gives each its
+    own namespace — matching production, where shm/sockets are per-host."""
+    return os.getenv("DLROVER_IPC_NAMESPACE") or os.getenv(
+        "DLROVER_JOB_NAME", "local"
+    )
+
+
 def _socket_path(name: str) -> str:
     os.makedirs(SOCKET_TMP_DIR, exist_ok=True)
-    job = os.getenv("DLROVER_JOB_NAME", "local")
+    job = _ipc_namespace()
     fname = f"{job}_{name}.sock"
     path = os.path.join(SOCKET_TMP_DIR, fname)
     # AF_UNIX sun_path is limited to ~108 bytes; hash long names down.
@@ -504,8 +514,7 @@ class SharedDict:
 
 
 def _shm_name(name: str) -> str:
-    job = os.getenv("DLROVER_JOB_NAME", "local")
-    return f"dlrover_{job}_{name}"
+    return f"dlrover_{_ipc_namespace()}_{name}"
 
 
 class SharedMemorySegment:

@@ -115,6 +115,11 @@ class ProcessScaler(Scaler):
         env[NodeEnv.JOB_NAME] = self._job_name
         env[NodeEnv.NODE_ID] = str(node_id)
         env[NodeEnv.NODE_RANK] = str(node_rank)
+        # Each simulated host gets its own machine-local IPC namespace
+        # (keyed by node id, which relaunch preserves — so a replacement
+        # agent reattaches the dead incarnation's staged shm checkpoint,
+        # like a pod rescheduled onto the same host).
+        env["DLROVER_IPC_NAMESPACE"] = f"{self._job_name}_n{node_id}"
         try:
             proc = subprocess.Popen(
                 self._spec.command,
@@ -135,6 +140,11 @@ class ProcessScaler(Scaler):
         if handle is not None:
             logger.info("killing node %s pid=%s", node_id, handle.proc.pid)
             handle.kill()
+        # A "node" death takes the whole pod: the agent's worker runs in
+        # its own session, so killing the agent's group misses it.
+        from ...agent.worker import kill_worker_by_pidfile
+
+        kill_worker_by_pidfile(f"{self._job_name}_n{node_id}")
 
     # -- introspection (used by the local watcher) -------------------------
 

@@ -189,6 +189,8 @@ class DistributedJobMaster:
                         action.config.get("reason", JobExitReason.FATAL_ERROR)
                     )
                     return
+                if getattr(self.job_manager, "is_suspended", False):
+                    continue  # suspended: no workers is not completion
                 early = self.job_manager.should_early_stop()
                 if early:
                     self._exit(early)
@@ -241,7 +243,7 @@ class DistributedJobMaster:
             namespace=namespace_name,
         )
         watcher = PodWatcher(job_name, namespace_name)
-        return cls(
+        master = cls(
             scaler=scaler,
             watcher=watcher,
             port=namespace.port,
@@ -250,3 +252,16 @@ class DistributedJobMaster:
             service_type=namespace.service_type,
             job_name=job_name,
         )
+        # CR-driven control: operator/user-posted ScalePlans and the
+        # ElasticJob suspend flag (reference k8s_watcher.py:331,427).
+        from .watcher.k8s_watcher import ElasticJobWatcher, ScalePlanWatcher
+
+        master.scaleplan_watcher = ScalePlanWatcher(
+            job_name, scaler.scale, namespace_name
+        )
+        master.elasticjob_watcher = ElasticJobWatcher(
+            job_name, master.job_manager, namespace_name
+        )
+        master.scaleplan_watcher.start()
+        master.elasticjob_watcher.start()
+        return master

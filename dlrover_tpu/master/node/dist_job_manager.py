@@ -44,6 +44,7 @@ class DistributedJobManager(JobManager):
         self._node_unit = max(1, node_unit)
         self._watch_thread: Optional[threading.Thread] = None
         self._pending_since: Optional[float] = None
+        self._suspended = False
 
     def start(self) -> None:
         super().start()
@@ -80,6 +81,14 @@ class DistributedJobManager(JobManager):
         node = event.node
         if node is None:
             return
+        if self._suspended and event.event_type == NodeEventType.DELETED:
+            # Suspension removes the pods on purpose; their deletions are
+            # not failures and must not consume the relaunch budget.
+            current = self._job_ctx.get_node(node.node_type, node.node_id)
+            if current is not None:
+                current.is_released = True
+                self._job_ctx.update_node(current)
+            return
         if event.event_type == NodeEventType.DELETED:
             current = self._job_ctx.get_node(node.node_type, node.node_id)
             if current is not None:
@@ -113,6 +122,8 @@ class DistributedJobManager(JobManager):
 
     def _relaunch_node(self, node: Node, allowed: Optional[bool] = None) -> None:
         """Replace a dead node via the scaler (reference :1010)."""
+        if self._suspended:
+            return
         if allowed is None:
             allowed = node.should_relaunch()
         if not allowed:
@@ -154,6 +165,42 @@ class DistributedJobManager(JobManager):
             node.inc_relaunch_count()
             self._job_ctx.update_node(node)
         self._scaler.scale(plan)
+
+    # -- suspend / resume (reference K8sElasticJobWatcher, k8s_watcher.py:427)
+
+    @property
+    def is_suspended(self) -> bool:
+        return self._suspended
+
+    def suspend(self) -> None:
+        """Tear the worker pods down without failing the job (ElasticJob
+        ``spec.suspend`` — reference elasticjob_types.go:29-130)."""
+        if self._suspended:
+            return
+        self._suspended = True
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        ids = []
+        for node in workers.values():
+            if not node.exited():
+                ids.append(node.node_id)
+            node.is_released = True
+            self._job_ctx.update_node(node)
+        logger.info("suspending job: removing workers %s", sorted(ids))
+        self._scaler.scale(ScalePlan(worker_num=0, remove_nodes=ids))
+
+    def resume(self) -> None:
+        if not self._suspended:
+            return
+        self._suspended = False
+        # Reset node bookkeeping: suspension marked every node released,
+        # and a released node is never relaunchable — without this, a
+        # post-resume crash would leave the job permanently short.
+        for node in self._job_ctx.get_nodes(NodeType.WORKER).values():
+            node.is_released = False
+            node.update_status(NodeStatus.PENDING)
+            self._job_ctx.update_node(node)
+        logger.info("resuming job: scaling back to %s workers", self.num_workers)
+        self._scaler.scale(ScalePlan(worker_num=self.num_workers))
 
     # -- early stop (reference should_early_stop :257) ---------------------
 

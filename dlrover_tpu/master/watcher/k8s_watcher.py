@@ -91,6 +91,161 @@ def _exit_reason(pod) -> str:
     return NodeExitReason.UNKNOWN
 
 
+class ScalePlanWatcher:
+    """Watch ScalePlan CRs targeting this job and feed them to a callback
+    as :class:`ScalePlan`s (reference ``K8sScalePlanWatcher``,
+    dlrover/python/master/watcher/k8s_watcher.py:331 — the manual /
+    operator-driven scaling path: users or the Brain post a ScalePlan CR,
+    the master executes it)."""
+
+    def __init__(self, job_name: str, on_plan, namespace: str = "default"):
+        from ...scheduler.kubernetes import (
+            CRD_GROUP,
+            CRD_VERSION,
+            SCALEPLAN_PLURAL,
+        )
+
+        self._job_name = job_name
+        self._on_plan = on_plan
+        self._selector = f"{ELASTIC_JOB_LABEL}={job_name}"
+        self._client = k8sClient.singleton(namespace)
+        self._stopped = threading.Event()
+        self._coords = (CRD_GROUP, CRD_VERSION, SCALEPLAN_PLURAL)
+        self._thread: Optional[threading.Thread] = None
+        self._seen: set = set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="scaleplan-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        group, version, plural = self._coords
+        while not self._stopped.is_set():
+            try:
+                for raw in self._client.watch_custom_objects(
+                    group, version, plural, self._selector
+                ):
+                    if self._stopped.is_set():
+                        return
+                    if raw.get("type") not in ("ADDED", "MODIFIED"):
+                        continue
+                    self._handle(raw.get("object") or {})
+            except Exception as e:
+                logger.warning("scaleplan watch error (retrying): %s", e)
+                self._stopped.wait(2.0)
+
+    def _handle(self, obj) -> None:
+        meta = obj.get("metadata", {})
+        key = (meta.get("name"), meta.get("resourceVersion"))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        plan = scale_plan_from_cr(obj)
+        if plan is None:
+            return
+        logger.info(
+            "executing ScalePlan CR %s: worker_num=%s remove=%s",
+            meta.get("name"),
+            plan.worker_num,
+            plan.remove_nodes,
+        )
+        try:
+            self._on_plan(plan)
+        except Exception:
+            logger.exception("ScalePlan CR execution failed")
+            return
+        # A ScalePlan CR is a one-shot command: delete it once executed,
+        # or a master restart would replay stale plans against a job
+        # that has long since scaled elsewhere (the watch re-lists
+        # existing objects as ADDED, and _seen starts empty).
+        group, version, plural = self._coords
+        if meta.get("name") and not self._client.delete_custom_object(
+            group, version, plural, meta["name"]
+        ):
+            logger.warning(
+                "executed ScalePlan CR %s could not be deleted; it may "
+                "replay on master restart",
+                meta.get("name"),
+            )
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+
+def scale_plan_from_cr(obj) -> Optional["ScalePlan"]:
+    """Parse a ScalePlan CR into a ScalePlan. Spec shape:
+
+    ``spec.replicaResourceSpecs.worker.replicas`` (target count) and/or
+    ``spec.removeNodes`` (explicit evictions) — mirroring the reference
+    ScalePlan CRD handled in go/elasticjob/pkg/controllers."""
+    from ..scaler.base_scaler import ScalePlan
+
+    spec = obj.get("spec") or {}
+    worker = (spec.get("replicaResourceSpecs") or {}).get("worker") or {}
+    worker_num = int(worker.get("replicas", -1))
+    remove = [int(n) for n in spec.get("removeNodes") or []]
+    if worker_num < 0 and not remove:
+        return None
+    return ScalePlan(worker_num=worker_num, remove_nodes=remove)
+
+
+class ElasticJobWatcher:
+    """Watch this job's ElasticJob CR for ``spec.suspend`` flips and
+    drive job_manager.suspend()/resume() (reference
+    ``K8sElasticJobWatcher``, k8s_watcher.py:427)."""
+
+    def __init__(self, job_name: str, job_manager, namespace: str = "default"):
+        from ...scheduler.kubernetes import (
+            CRD_GROUP,
+            CRD_VERSION,
+            ELASTICJOB_PLURAL,
+        )
+
+        self._job_name = job_name
+        self._job_manager = job_manager
+        self._client = k8sClient.singleton(namespace)
+        self._stopped = threading.Event()
+        self._coords = (CRD_GROUP, CRD_VERSION, ELASTICJOB_PLURAL)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="elasticjob-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        group, version, plural = self._coords
+        while not self._stopped.is_set():
+            try:
+                for raw in self._client.watch_custom_objects(
+                    group, version, plural
+                ):
+                    if self._stopped.is_set():
+                        return
+                    obj = raw.get("object") or {}
+                    if obj.get("metadata", {}).get("name") != self._job_name:
+                        continue
+                    self._apply(obj)
+            except Exception as e:
+                logger.warning("elasticjob watch error (retrying): %s", e)
+                self._stopped.wait(2.0)
+
+    def _apply(self, obj) -> None:
+        suspend = bool((obj.get("spec") or {}).get("suspend", False))
+        if suspend and not self._job_manager.is_suspended:
+            logger.info("ElasticJob CR suspended; tearing down workers")
+            self._job_manager.suspend()
+        elif not suspend and self._job_manager.is_suspended:
+            logger.info("ElasticJob CR resumed; restoring workers")
+            self._job_manager.resume()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+
 class PodWatcher(NodeWatcher):
     _EVENT_TYPES = {
         "ADDED": NodeEventType.ADDED,

@@ -35,6 +35,13 @@ REPLICA_INDEX_LABEL = "dlrover-tpu/replica-index"
 SLICE_INDEX_LABEL = "dlrover-tpu/slice-index"
 TPU_RESOURCE = "google.com/tpu"
 
+# CRD coordinates (reference: go/elasticjob/api/v1alpha1, group
+# elastic.iml.github.io; ours is a TPU-native group)
+CRD_GROUP = "tpu.dlrover.org"
+CRD_VERSION = "v1alpha1"
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
 
 def require_k8s() -> None:
     if not _HAS_K8S:
@@ -104,6 +111,65 @@ class k8sClient:
         return w.stream(
             self.core.list_namespaced_pod,
             self.namespace,
+            label_selector=label_selector,
+            timeout_seconds=timeout_s,
+        )
+
+    # -- custom resources (ElasticJob / ScalePlan CRs) ---------------------
+
+    def get_custom_object(
+        self, group: str, version: str, plural: str, name: str
+    ) -> Optional[Dict[str, Any]]:
+        try:
+            return self.custom.get_namespaced_custom_object(
+                group, version, self.namespace, plural, name
+            )
+        except Exception:
+            return None
+
+    def list_custom_objects(
+        self, group: str, version: str, plural: str, label_selector: str = ""
+    ) -> List[Dict[str, Any]]:
+        try:
+            out = self.custom.list_namespaced_custom_object(
+                group,
+                version,
+                self.namespace,
+                plural,
+                label_selector=label_selector,
+            )
+            return out.get("items", [])
+        except Exception as e:
+            logger.error("list %s failed: %s", plural, e)
+            return []
+
+    def delete_custom_object(
+        self, group: str, version: str, plural: str, name: str
+    ) -> bool:
+        try:
+            self.custom.delete_namespaced_custom_object(
+                group, version, self.namespace, plural, name
+            )
+            return True
+        except Exception as e:
+            logger.warning("delete %s/%s failed: %s", plural, name, e)
+            return False
+
+    def watch_custom_objects(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        label_selector: str = "",
+        timeout_s: int = 60,
+    ):
+        w = k8s_watch.Watch()
+        return w.stream(
+            self.custom.list_namespaced_custom_object,
+            group,
+            version,
+            self.namespace,
+            plural,
             label_selector=label_selector,
             timeout_seconds=timeout_s,
         )

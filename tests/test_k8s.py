@@ -1,0 +1,370 @@
+"""K8s path tests without a cluster (reference style: mock_k8s_client,
+dlrover/python/tests/test_utils.py:321-341 — every k8s verb faked,
+watch → NodeEvent → relaunch → scaler CRUD exercised in-process)."""
+
+import time
+from unittest import mock
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.watcher.k8s_watcher import (
+    ElasticJobWatcher,
+    PodWatcher,
+    ScalePlanWatcher,
+    _pod_to_node,
+    scale_plan_from_cr,
+)
+from dlrover_tpu.scheduler.kubernetes import (
+    ELASTIC_JOB_LABEL,
+    REPLICA_INDEX_LABEL,
+    build_worker_pod,
+    job_args_from_crd,
+    pod_name,
+    pod_terminating,
+)
+
+
+class FakeK8sClient:
+    """In-memory stand-in for k8sClient (reference mock_k8s_client)."""
+
+    def __init__(self):
+        self.pods = {}
+        self.fail_names = set()
+        self.custom_objects = {}
+        self.watch_events = []
+
+    # pods
+    def create_pod(self, pod):
+        name = pod_name(pod)
+        if name in self.fail_names:
+            return False
+        self.pods[name] = pod
+        return True
+
+    def delete_pod(self, name):
+        self.pods.pop(name, None)
+        return True
+
+    def get_pod(self, name):
+        return self.pods.get(name)
+
+    def list_pods(self, label_selector):
+        key, _, val = label_selector.partition("=")
+        return [
+            p
+            for p in self.pods.values()
+            if p["metadata"]["labels"].get(key) == val
+        ]
+
+    def watch_pods(self, label_selector, timeout_s=60):
+        yield from self.watch_events
+
+    # custom objects
+    def list_custom_objects(self, group, version, plural, label_selector=""):
+        return list(self.custom_objects.get(plural, {}).values())
+
+    def watch_custom_objects(
+        self, group, version, plural, label_selector="", timeout_s=60
+    ):
+        yield from self.watch_events
+
+    def delete_custom_object(self, group, version, plural, name):
+        self.custom_objects.get(plural, {}).pop(name, None)
+        self.deleted_crs = getattr(self, "deleted_crs", [])
+        self.deleted_crs.append((plural, name))
+        return True
+
+
+@pytest.fixture()
+def fake_client(monkeypatch):
+    client = FakeK8sClient()
+    import dlrover_tpu.master.scaler.pod_scaler as ps_mod
+    import dlrover_tpu.master.watcher.k8s_watcher as kw_mod
+
+    for mod in (ps_mod, kw_mod):
+        monkeypatch.setattr(
+            mod.k8sClient, "singleton", staticmethod(lambda ns="default": client)
+        )
+    return client
+
+
+def _make_scaler(client, **kwargs):
+    from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+
+    return PodScaler(
+        "job", "img:v1", ["python", "train.py"], "master:50001", **kwargs
+    )
+
+
+class TestPodManifest:
+    def test_worker_pod_shape(self):
+        pod = build_worker_pod(
+            job_name="gpt",
+            node_id=3,
+            node_rank=5,
+            image="img",
+            command=["run"],
+            master_addr="m:1",
+            tpu_chips=4,
+            tpu_topology="4x4",
+            slice_index=1,
+            env={"EXTRA": "1"},
+        )
+        assert pod["metadata"]["name"] == "gpt-worker-3"
+        labels = pod["metadata"]["labels"]
+        assert labels[ELASTIC_JOB_LABEL] == "gpt"
+        assert labels[REPLICA_INDEX_LABEL] == "5"
+        container = pod["spec"]["containers"][0]
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        assert (
+            pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+            == "4x4"
+        )
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["DLROVER_MASTER_ADDR"] == "m:1"
+        assert env["DLROVER_NODE_RANK"] == "5"
+        assert env["EXTRA"] == "1"
+
+    def test_pod_terminating(self):
+        pod = build_worker_pod("j", 0, 0, "i", ["c"], "m:1")
+        assert not pod_terminating(pod)
+        pod["metadata"]["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+        assert pod_terminating(pod)
+
+
+class TestPodToNode:
+    def _pod(self, name="j-worker-2", phase="Running", **status):
+        return {
+            "metadata": {"name": name, "labels": {REPLICA_INDEX_LABEL: "2"}},
+            "status": {"phase": phase, **status},
+        }
+
+    def test_phases(self):
+        assert _pod_to_node(self._pod()).status == NodeStatus.RUNNING
+        assert (
+            _pod_to_node(self._pod(phase="Pending")).status
+            == NodeStatus.PENDING
+        )
+        assert (
+            _pod_to_node(self._pod(phase="Succeeded")).status
+            == NodeStatus.SUCCEEDED
+        )
+
+    def test_exit_reasons(self):
+        oom = self._pod(
+            phase="Failed",
+            containerStatuses=[
+                {"state": {"terminated": {"reason": "OOMKilled", "exitCode": 137}}}
+            ],
+        )
+        assert _pod_to_node(oom).exit_reason == NodeExitReason.OOM
+        killed = self._pod(
+            phase="Failed",
+            containerStatuses=[
+                {"state": {"terminated": {"exitCode": 137}}}
+            ],
+        )
+        assert _pod_to_node(killed).exit_reason == NodeExitReason.KILLED
+        fatal = self._pod(
+            phase="Failed",
+            containerStatuses=[{"state": {"terminated": {"exitCode": 1}}}],
+        )
+        assert _pod_to_node(fatal).exit_reason == NodeExitReason.FATAL_ERROR
+
+    def test_non_worker_name_skipped(self):
+        assert _pod_to_node({"metadata": {"name": "whatever"}}) is None
+
+
+class TestPodWatcher:
+    def test_list_and_watch_events(self, fake_client):
+        scaler = _make_scaler(fake_client)
+        scaler.scale(ScalePlan(worker_num=2))
+        watcher = PodWatcher("job")
+        nodes = watcher.list()
+        assert sorted(n.node_id for n in nodes) == [0, 1]
+
+        dead = dict(fake_client.pods["job-worker-1"])
+        dead["status"] = {
+            "phase": "Failed",
+            "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 137}}}
+            ],
+        }
+        fake_client.watch_events = [{"type": "DELETED", "object": dead}]
+        events = []
+        for ev in fake_client.watch_pods(""):
+            node = _pod_to_node(ev["object"])
+            events.append(
+                NodeEvent(event_type=NodeEventType.DELETED, node=node)
+            )
+        assert events[0].node.node_id == 1
+        assert events[0].node.exit_reason == NodeExitReason.KILLED
+
+
+class TestPodScaler:
+    def test_scale_up_and_reconcile(self, fake_client):
+        scaler = _make_scaler(fake_client)
+        scaler.scale(ScalePlan(worker_num=3))
+        assert len(fake_client.pods) == 3
+        # a pod vanishes outside a plan -> reconcile recreates it
+        fake_client.pods.pop("job-worker-1")
+        with scaler._lock:
+            scaler._reconcile()
+        assert "job-worker-1" in fake_client.pods
+
+    def test_remove_only_plan_not_resurrected(self, fake_client):
+        scaler = _make_scaler(fake_client)
+        scaler.scale(ScalePlan(worker_num=3))
+        scaler.scale(ScalePlan(worker_num=-1, remove_nodes=[1]))
+        assert "job-worker-1" not in fake_client.pods
+        with scaler._lock:
+            scaler._reconcile()
+        assert "job-worker-1" not in fake_client.pods
+
+    def test_terminating_409_retry_keeps_rank(self, fake_client):
+        scaler = _make_scaler(fake_client, reconcile_interval=0.1)
+        scaler.scale(ScalePlan(worker_num=3))
+        old = fake_client.pods["job-worker-2"]
+        old["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        fake_client.fail_names.add("job-worker-2")
+        scaler.scale(ScalePlan(worker_num=-1, remove_nodes=[2]))
+        fake_client.pods["job-worker-2"] = old  # graceful delete: lingers
+        scaler.scale(
+            ScalePlan(
+                worker_num=-1,
+                launch_nodes=[Node(node_id=2, rank_index=7)],
+            )
+        )
+        with scaler._lock:
+            scaler._reconcile()
+        assert 2 in scaler._retry, "Terminating pod cancelled the retry"
+        # old pod finally goes; retry loop heals with the planned rank
+        del fake_client.pods["job-worker-2"]
+        fake_client.fail_names.clear()
+        scaler.start()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            pod = fake_client.pods.get("job-worker-2")
+            if pod is not None:
+                break
+            time.sleep(0.05)
+        scaler.stop()
+        assert pod is not None, "retry loop never healed the 409"
+        assert pod["metadata"]["labels"][REPLICA_INDEX_LABEL] == "7"
+
+
+class TestCrdParsing:
+    def test_job_args_from_crd(self):
+        crd = {
+            "metadata": {"name": "gpt-job", "uid": "u1"},
+            "spec": {
+                "distributionStrategy": "spmd",
+                "nodeUnit": 4,
+                "tpuTopology": "4x4",
+                "replicaSpecs": {
+                    "worker": {"replicas": 16, "restartCount": 5}
+                },
+            },
+        }
+        args = job_args_from_crd(crd, "ns1")
+        assert args.job_name == "gpt-job"
+        group = args.node_args["worker"]
+        assert group.count == 16
+        assert group.restart_count == 5
+        assert group.node_unit == 4
+        assert group.accelerator_topology == "4x4"
+
+    def test_scale_plan_from_cr(self):
+        obj = {
+            "metadata": {"name": "sp1"},
+            "spec": {
+                "replicaResourceSpecs": {"worker": {"replicas": 8}},
+                "removeNodes": [3, 5],
+            },
+        }
+        plan = scale_plan_from_cr(obj)
+        assert plan.worker_num == 8
+        assert plan.remove_nodes == [3, 5]
+        assert scale_plan_from_cr({"spec": {}}) is None
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("job")
+        self.plans = []
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+
+class TestScalePlanWatcher:
+    def test_plan_cr_dispatch_and_dedup(self, fake_client):
+        scaler = RecordingScaler()
+        watcher = ScalePlanWatcher("job", scaler.scale)
+        cr = {
+            "metadata": {"name": "sp1", "resourceVersion": "1"},
+            "spec": {"replicaResourceSpecs": {"worker": {"replicas": 5}}},
+        }
+        watcher._handle(cr)
+        watcher._handle(cr)  # same resourceVersion: no double-execute
+        assert len(scaler.plans) == 1
+        assert scaler.plans[0].worker_num == 5
+        # executed CRs are deleted so they can't replay on master restart
+        assert ("scaleplans", "sp1") in fake_client.deleted_crs
+        cr2 = dict(cr, metadata={"name": "sp1", "resourceVersion": "2"})
+        watcher._handle(cr2)
+        assert len(scaler.plans) == 2
+
+
+class TestSuspendResume:
+    def _manager(self):
+        from dlrover_tpu.master.node.dist_job_manager import (
+            DistributedJobManager,
+        )
+
+        scaler = RecordingScaler()
+        mgr = DistributedJobManager(num_workers=2, scaler=scaler)
+        from dlrover_tpu.common.constants import NodeType
+
+        for node_id in range(2):
+            node = Node(
+                node_type=NodeType.WORKER, node_id=node_id, rank_index=node_id
+            )
+            node.update_status(NodeStatus.RUNNING)
+            mgr._job_ctx.update_node(node)
+        return mgr, scaler
+
+    def test_suspend_removes_and_suppresses_relaunch(self):
+        mgr, scaler = self._manager()
+        mgr.suspend()
+        assert mgr.is_suspended
+        assert scaler.plans[-1].worker_num == 0
+        assert sorted(scaler.plans[-1].remove_nodes) == [0, 1]
+        # deletions while suspended are not failures
+        from dlrover_tpu.common.constants import NodeType
+
+        dead = Node(node_type=NodeType.WORKER, node_id=0, rank_index=0)
+        dead.update_status(NodeStatus.FAILED)
+        mgr.process_event(
+            NodeEvent(event_type=NodeEventType.DELETED, node=dead)
+        )
+        assert len(scaler.plans) == 1, "suspended deletion triggered relaunch"
+
+        mgr.resume()
+        assert not mgr.is_suspended
+        assert scaler.plans[-1].worker_num == 2
+
+    def test_elasticjob_watcher_apply(self, fake_client):
+        mgr, scaler = self._manager()
+        watcher = ElasticJobWatcher("job", mgr)
+        watcher._apply({"metadata": {"name": "job"}, "spec": {"suspend": True}})
+        assert mgr.is_suspended
+        watcher._apply({"metadata": {"name": "job"}, "spec": {"suspend": False}})
+        assert not mgr.is_suspended

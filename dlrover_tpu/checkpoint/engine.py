@@ -232,24 +232,30 @@ class CheckpointEngine:
             return None
         try:
             fetched = manager.fetch_own_shard(self.shm.write_image_stream)
+            if not fetched:
+                return None
+            # Staleness check BEFORE the expensive host->device restore:
+            # a replica can lag behind storage (push failures are
+            # log-and-drop), and restoring a multi-GB pytree only to
+            # throw it away wastes minutes on the recovery path.
+            meta = self.shm.read_meta()
+            storage_step = self.storage.latest_step()
+            storage_step = -1 if storage_step is None else storage_step
+            if meta is not None and storage_step > meta.step:
+                logger.info(
+                    "peer replica holds step %s but storage has %s; "
+                    "preferring storage",
+                    meta.step,
+                    storage_step,
+                )
+                # Drop the stale image: a later breakpoint save would
+                # otherwise persist it and regress the tracker.
+                self.shm.invalidate()
+                return None
         finally:
             self._shard_lock.release()
             manager.stop()
-        if not fetched:
-            return None
-        result = self._load_from_memory(template)
-        if result is None:
-            return None
-        storage_step = self.storage.latest_step() or -1
-        if storage_step > result[0]:
-            logger.info(
-                "peer replica holds step %s but storage has %s; "
-                "preferring storage",
-                result[0],
-                storage_step,
-            )
-            return None
-        return result
+        return self._load_from_memory(template)
 
     def _load_from_memory(self, template: Any):
         # Everything happens under the shard lock: the persister (or a

@@ -90,7 +90,20 @@ class ReplicaStore:
     def __init__(self):
         self._segments: Dict[int, SharedMemorySegment] = {}
         self._sizes: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._gens: Dict[int, int] = {}  # bumped on every overwrite
+        # Per-rank locks: a PUT body is paced by the remote sender, so a
+        # stalled peer must only wedge its own slot, never the endpoint
+        # (rank 0 can hold two ranks' replicas under the odd-N wrap).
+        self._meta_lock = threading.Lock()
+        self._rank_locks: Dict[int, threading.Lock] = {}
+
+    def _lock_for(self, owner_rank: int) -> threading.Lock:
+        with self._meta_lock:
+            lock = self._rank_locks.get(owner_rank)
+            if lock is None:
+                lock = threading.Lock()
+                self._rank_locks[owner_rank] = lock
+            return lock
 
     def _segment(self, owner_rank: int) -> SharedMemorySegment:
         seg = self._segments.get(owner_rank)
@@ -108,14 +121,15 @@ class ReplicaStore:
         segment header lands last (:func:`stream_into_segment`), so an
         interrupted PUT leaves an image readers treat as absent — never
         a new meta over an old payload."""
-        with self._lock:
+        with self._lock_for(owner_rank):
             self._sizes.pop(owner_rank, None)
+            self._gens[owner_rank] = self._gens.get(owner_rank, 0) + 1
             seg = self._segment(owner_rank)
             stream_into_segment(seg, total, read)
             self._sizes[owner_rank] = total
 
     def image_size(self, owner_rank: int) -> int:
-        with self._lock:
+        with self._lock_for(owner_rank):
             size = self._sizes.get(owner_rank, 0)
             if size:
                 return size
@@ -126,8 +140,26 @@ class ReplicaStore:
                 self._sizes[owner_rank] = size
             return size
 
+    def generation(self, owner_rank: int) -> int:
+        with self._lock_for(owner_rank):
+            return self._gens.get(owner_rank, 0)
+
+    def read_checked(
+        self, owner_rank: int, offset: int, nbytes: int, gen: int
+    ):
+        """Read bytes IF the slot still holds generation ``gen``; None
+        when it was overwritten (the gen check and the read share one
+        lock acquisition, so a PUT can never interleave between them)."""
+        with self._lock_for(owner_rank):
+            if self._gens.get(owner_rank, 0) != gen:
+                return None
+            seg = self._segment(owner_rank)
+            if not seg.attach():
+                return None
+            return seg.read(offset, nbytes)
+
     def read(self, owner_rank: int, offset: int, nbytes: int) -> bytes:
-        with self._lock:
+        with self._lock_for(owner_rank):
             seg = self._segment(owner_rank)
             if not seg.attach():
                 return b""
@@ -136,7 +168,7 @@ class ReplicaStore:
     def step_of(self, owner_rank: int) -> Optional[int]:
         if not self.image_size(owner_rank):
             return None
-        with self._lock:
+        with self._lock_for(owner_rank):
             seg = self._segment(owner_rank)
             try:
                 meta_len = int.from_bytes(
@@ -150,7 +182,7 @@ class ReplicaStore:
                 return None
 
     def close(self) -> None:
-        with self._lock:
+        with self._meta_lock:
             for seg in self._segments.values():
                 try:
                     seg.close()
@@ -159,7 +191,7 @@ class ReplicaStore:
             self._segments.clear()
 
     def unlink(self) -> None:
-        with self._lock:
+        with self._meta_lock:
             for seg in self._segments.values():
                 try:
                     seg.unlink()
@@ -172,6 +204,9 @@ class ReplicaStore:
 class _ReplicaHandler(BaseHTTPRequestHandler):
     store: ReplicaStore = None  # set on the server subclass
     protocol_version = "HTTP/1.1"
+    # Socket timeout: a peer that dies mid-PUT without RST must not pin
+    # this handler thread (and its rank lock) forever.
+    timeout = 60
 
     def _rank(self) -> Optional[int]:
         parts = self.path.strip("/").split("/")
@@ -217,12 +252,24 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         if not total:
             self.send_error(404)
             return
+        # Chunked reads interleaving with a concurrent PUT would serve a
+        # mixed-generation image; abort (short body -> client discards)
+        # if the slot is overwritten mid-stream.
+        gen = self.store.generation(rank)
         self.send_response(200)
         self.send_header("Content-Length", str(total))
         self.end_headers()
         off = 0
         while off < total:
-            chunk = self.store.read(rank, off, min(_CHUNK, total - off))
+            chunk = self.store.read_checked(
+                rank, off, min(_CHUNK, total - off), gen
+            )
+            if chunk is None:
+                logger.warning(
+                    "replica GET for rank %s aborted: slot overwritten", rank
+                )
+                self.close_connection = True
+                return
             if not chunk:
                 break
             self.wfile.write(chunk)
@@ -425,6 +472,15 @@ class ReplicaManager:
     def stop(self) -> None:
         if self.server is not None:
             self.server.stop()
+            # Unregister: peers must not keep pushing to a dead endpoint
+            # (peer_addrs skips empty values).
+            if self.master_client is not None:
+                try:
+                    self.master_client.kv_store_set(
+                        f"{KV_PREFIX}{self.host_rank}", b""
+                    )
+                except Exception:
+                    logger.warning("replica address unregister failed")
         self.store.close()
 
 

@@ -78,16 +78,11 @@ class AsyncCheckpointSaver:
         """Serve this host's replica store and register its address
         (reference replica.py:73 backup groups; TPU shape: host-level
         push over DCN, see checkpoint/replica.py)."""
-        from .replica import ReplicaManager
+        from .replica import ReplicaManager, default_master_client
 
         client = self.master_client
         if client is None and self._replica_peers is None:
-            try:
-                from ..rpc.client import MasterClient
-
-                client = MasterClient.singleton()
-            except Exception:
-                client = None
+            client = default_master_client()
         try:
             self.replica_manager = ReplicaManager(
                 self.host_rank,

@@ -56,6 +56,11 @@ class Context:
     # Auto scaling / tuning
     auto_tuning_enabled: bool = False
     auto_scaling_interval_s: float = 30.0
+    # Host RAM capacity and the job's starting per-host dataloader batch
+    # size — inputs to the hyperparam strategy generator (0 = unknown,
+    # generator disabled).
+    host_memory_mb: float = 0.0
+    initial_batch_size: int = 0
 
     # Misc
     log_level: str = "INFO"

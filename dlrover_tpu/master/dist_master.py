@@ -56,6 +56,13 @@ from .shard.task_manager import TaskManager
 from .sync_service import SyncService
 
 
+def ctx_enables_stats() -> bool:
+    """The stats sampler only runs when something consumes it (tuning
+    or straggler exclusion) — no 10s sampling thread on idle masters."""
+    ctx = get_context()
+    return ctx.auto_tuning_enabled or ctx.exclude_stragglers
+
+
 class DistributedJobMaster:
     def __init__(
         self,
@@ -124,12 +131,35 @@ class DistributedJobMaster:
             if self.max_workers > num_workers
             else FixedResourceOptimizer()
         )
+        # Real-metrics pipeline: per-node runtime series feeding the
+        # strategy generator and straggler exclusion (reference
+        # master/stats/ + simple_strategy_generator.py:40).
+        from .hyperparams import SimpleStrategyGenerator
+        from .stats import JobStatsCollector
+
+        self.stats_collector = JobStatsCollector(self._job_ctx)
+        strategy = (
+            SimpleStrategyGenerator(
+                self.stats_collector,
+                host_memory_mb=ctx.host_memory_mb,
+                current_batch_size=ctx.initial_batch_size,
+            )
+            if ctx.auto_tuning_enabled and ctx.initial_batch_size > 0
+            else None
+        )
+
+        def _exclude_straggler(node_id: int) -> None:
+            self.job_manager.migrate_straggler(node_id)
+
         self.auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=scaler,
             node_unit=node_unit,
             max_workers=self.max_workers,
             world_size_fn=training_rdzv.world_size,
+            stats=self.stats_collector,
+            strategy_generator=strategy,
+            straggler_handler=_exclude_straggler,
         )
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
@@ -169,6 +199,8 @@ class DistributedJobMaster:
         if passed:
             self._job_ctx.set_stage(JobStage.RUNNING)
             self.diagnosis_master.start()
+            if ctx_enables_stats():
+                self.stats_collector.start()
             self.auto_scaler.start()
         else:
             self._job_ctx.master_actions.add_action(
@@ -217,6 +249,7 @@ class DistributedJobMaster:
     def stop(self) -> None:
         self._stopped.set()
         self.diagnosis_master.stop()
+        self.stats_collector.stop()
         self.auto_scaler.stop()
         self.job_manager.stop()
         self._server.stop()

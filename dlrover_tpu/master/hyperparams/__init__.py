@@ -1,0 +1,1 @@
+from .strategy_generator import SimpleStrategyGenerator  # noqa: F401

@@ -1,0 +1,100 @@
+"""Hyperparameter strategy suggestions from runtime stats.
+
+Reference: ``SimpleStrategyGenerator``
+(dlrover/python/master/hyperparams/simple_strategy_generator.py:40) —
+emits DataLoaderConfig/OptimizerConfig suggestions that the agent-side
+ParalConfigTuner delivers to trainers. TPU shape: the knobs that matter
+per-host are the input-pipeline batch size (HBM- and host-RAM-bound) and
+gradient accumulation (keeps the global batch constant when the
+per-host batch moves); both ride the existing ParallelConfig push.
+"""
+
+from typing import Optional
+
+from ...common.log import logger
+from ..resource.optimizer import ResourcePlan
+from ..stats.job_stats import JobStatsCollector
+
+
+class SimpleStrategyGenerator:
+    """Heuristic tuner (reference :40, :82 data-loader version rules):
+
+    - host memory nearly exhausted → halve the dataloader batch and
+      double grad accumulation (same global batch, half the peak RAM)
+    - host memory + CPU both far below capacity while training is
+      input-bound → double the dataloader batch (fewer, larger host
+      transfers; the MXU prefers bigger batches)
+    """
+
+    def __init__(
+        self,
+        stats: JobStatsCollector,
+        host_memory_mb: float,
+        current_batch_size: int,
+        max_batch_size: int = 0,
+        high_mem_frac: float = 0.92,
+        low_mem_frac: float = 0.45,
+        low_cpu_percent: float = 50.0,
+        settle_s: float = 180.0,
+    ):
+        self._stats = stats
+        self._host_mem = host_memory_mb
+        self._batch = current_batch_size
+        self._max_batch = max_batch_size or current_batch_size * 8
+        self._high = high_mem_frac
+        self._low = low_mem_frac
+        self._low_cpu = low_cpu_percent
+        # Settle period: a pushed plan needs time to reach the trainers
+        # (config poll) and show up in fresh samples; reacting to
+        # pre-push memory readings every round would collapse the batch
+        # to 1 in a handful of rounds.
+        self._settle_s = settle_s
+        self._last_push = 0.0
+        self._accum = 1
+
+    def generate_plan(self) -> ResourcePlan:
+        import time
+
+        if self._batch <= 0 or self._host_mem <= 0:
+            return ResourcePlan()
+        if time.time() - self._last_push < self._settle_s:
+            return ResourcePlan()
+        mem = self._stats.mean_memory_mb()
+        cpu = self._stats.mean_cpu_percent()
+        if mem <= 0:
+            return ResourcePlan()
+        frac = mem / self._host_mem
+        if frac > self._high and self._batch > 1:
+            self._batch = max(1, self._batch // 2)
+            self._accum *= 2
+            logger.info(
+                "memory %.0f%%: halving dataloader batch to %s "
+                "(grad accum x%s keeps the global batch)",
+                frac * 100,
+                self._batch,
+                self._accum,
+            )
+            self._last_push = time.time()
+            return ResourcePlan(
+                dataloader_batch_size=self._batch,
+                grad_accum_steps=self._accum,
+            )
+        if (
+            frac < self._low
+            and cpu < self._low_cpu
+            and self._batch * 2 <= self._max_batch
+        ):
+            self._batch *= 2
+            self._accum = max(1, self._accum // 2)
+            logger.info(
+                "memory %.0f%% cpu %.0f%%: doubling dataloader batch to %s",
+                frac * 100,
+                cpu,
+                self._batch,
+            )
+            self._last_push = time.time()
+            return ResourcePlan(
+                dataloader_batch_size=self._batch,
+                grad_accum_steps=self._accum,
+            )
+        return ResourcePlan()

@@ -49,6 +49,18 @@ class JobMetricContext:
             node = self._nodes.get(node_id)
             return node.gauges.get(name, default) if node else default
 
+    def fresh_gauge(
+        self, node_id: int, name: str, max_age_s: float, default: float = 0.0
+    ) -> float:
+        """Gauge value only if the node reported within ``max_age_s`` —
+        a stale scrape re-read is not a new observation."""
+        now = time.time()
+        with self._mu:
+            node = self._nodes.get(node_id)
+            if node is None or now - node.updated_at > max_age_s:
+                return default
+            return node.gauges.get(name, default)
+
     def nodes_with(self, name: str) -> Dict[int, float]:
         with self._mu:
             return {

@@ -145,6 +145,31 @@ class DistributedJobManager(JobManager):
         )
         self._scaler.scale(ScalePlan(launch_nodes=[replacement]))
 
+    def migrate_straggler(self, node_id: int) -> None:
+        """Replace a live-but-slow node: remove its pod AND launch a
+        replacement in one plan (the dead-node path only launches, which
+        against a still-running pod is a 409 no-op). Budget rules apply —
+        a straggler that exhausted its relaunch count stays."""
+        node = self._job_ctx.get_node(NodeType.WORKER, node_id)
+        if node is None or node.exited() or node.is_released:
+            return
+        if not node.should_relaunch():
+            logger.warning(
+                "straggler node %s has no relaunch budget left; keeping it",
+                node_id,
+            )
+            return
+        node.inc_relaunch_count()
+        node.is_released = True
+        self._job_ctx.update_node(node)
+        replacement = node.get_relaunch_node(node.node_id)
+        replacement.relaunch_count = node.relaunch_count
+        self._job_ctx.update_node(replacement)
+        logger.info("migrating straggler node %s", node_id)
+        self._scaler.scale(
+            ScalePlan(remove_nodes=[node_id], launch_nodes=[replacement])
+        )
+
     def relaunch_slice(self, slice_id: int) -> None:
         """Group relaunch (reference :1046): replace every host of a
         slice together — a slice is the unit of ICI connectivity."""

@@ -30,6 +30,9 @@ class JobAutoScaler:
         node_unit: int = 1,
         max_workers: int = 1,
         world_size_fn=None,
+        stats=None,
+        strategy_generator=None,
+        straggler_handler=None,
     ):
         self._ctx = get_context()
         self._job_ctx = get_job_context()
@@ -40,6 +43,13 @@ class JobAutoScaler:
         # Supplies the current rendezvous world size to size-aware
         # optimizers (ThroughputScalingOptimizer.record_world_size).
         self._world_size_fn = world_size_fn
+        # Real-metrics pipeline (reference master/stats/): collector of
+        # per-node runtime series, the hyperparam strategy generator fed
+        # by it, and the straggler exclusion callback (node_id -> None).
+        self._stats = stats
+        self._strategy = strategy_generator
+        self._straggler_handler = straggler_handler
+        self._excluded_stragglers: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
 
@@ -83,15 +93,45 @@ class JobAutoScaler:
         interval = max(5.0, self._ctx.auto_scaling_interval_s)
         while not self._stopped.wait(interval):
             try:
-                if self._world_size_fn is not None and hasattr(
-                    self._optimizer, "record_world_size"
-                ):
-                    self._optimizer.record_world_size(self._world_size_fn())
-                self.execute_job_optimization_plan(
-                    self._optimizer.generate_plan()
-                )
+                self.run_once()
             except Exception:
                 logger.exception("auto-scaler loop error")
+
+    def run_once(self) -> None:
+        """One supervision round: scale decision from throughput, then
+        hyperparam suggestions, then straggler exclusion — each driven by
+        the stats pipeline rather than static configuration."""
+        if self._world_size_fn is not None and hasattr(
+            self._optimizer, "record_world_size"
+        ):
+            self._optimizer.record_world_size(self._world_size_fn())
+        self.execute_job_optimization_plan(self._optimizer.generate_plan())
+        if self._strategy is not None:
+            self.execute_job_optimization_plan(self._strategy.generate_plan())
+        self._check_stragglers()
+
+    def _check_stragglers(self) -> None:
+        """Runtime straggler exclusion (reference job_auto_scaler.py:241
+        PS migration + the rdzv median rule applied to live step times):
+        a consistently slow host drags every ICI collective, so it is
+        handed to the straggler handler (relaunch/exclude) once."""
+        if self._stats is None or self._straggler_handler is None:
+            return
+        if not self._ctx.exclude_stragglers:
+            return  # destructive exclusion is its own opt-in flag
+        for node_id in self._stats.detect_stragglers():
+            if node_id in self._excluded_stragglers:
+                continue
+            self._excluded_stragglers.add(node_id)
+            logger.warning(
+                "straggler node %s (step time > %.1fx median); excluding",
+                node_id,
+                self._ctx.straggler_median_ratio,
+            )
+            try:
+                self._straggler_handler(node_id)
+            except Exception:
+                logger.exception("straggler handler failed for %s", node_id)
 
     def stop(self) -> None:
         self._stopped.set()

@@ -1,0 +1,1 @@
+from .job_stats import JobStatsCollector, NodeSample  # noqa: F401

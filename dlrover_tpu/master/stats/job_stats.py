@@ -1,0 +1,187 @@
+"""Master-side job runtime statistics.
+
+Reference: ``dlrover/python/master/stats/`` (job stats collectors feeding
+the local optimizer, ``local_optimizer.py:66``) and the runtime metric
+path ``xpu_timer_metric_collector.py:28`` → ``JobMetricContext`` →
+diagnosis. The TPU shape: agents report (a) resource usage and (b)
+profiler gauges (tpu_timer Prometheus names); this collector samples both
+into bounded per-node time series that the auto-scaling optimizer, the
+straggler policy, and the hyperparameter strategy generator consume —
+the "real metrics pipeline" behind scaling decisions.
+"""
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from ...common.constants import NodeType
+from ...common.log import logger
+from ..monitor.metric_context import get_metric_context
+
+# tpu_timer gauge names (native/tpu_timer MetricsText)
+STEP_AVG_US = 'tpu_timer_latency_us{kind="step",agg="avg"}'
+MATMUL_TFLOPS = 'tpu_timer_tflops{kind="matmul"}'
+
+
+@dataclass
+class NodeSample:
+    """One sampling instant of one node's runtime signals."""
+
+    timestamp: float
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    step_time_us: float = 0.0
+    matmul_tflops: float = 0.0
+
+
+@dataclass
+class NodeSeries:
+    node_id: int
+    samples: Deque[NodeSample] = field(default_factory=lambda: deque(maxlen=128))
+
+    def latest(self) -> Optional[NodeSample]:
+        return self.samples[-1] if self.samples else None
+
+    def mean_step_time_us(self, last_n: int = 8) -> float:
+        vals = [
+            s.step_time_us
+            for s in list(self.samples)[-last_n:]
+            if s.step_time_us > 0
+        ]
+        return sum(vals) / len(vals) if vals else 0.0
+
+
+class JobStatsCollector:
+    """Samples per-node signals into series; answers optimizer queries.
+
+    Sources (both already flow through the master RPC surface):
+    - ``ResourceUsageReport`` → node.used_resource (job context)
+    - ``NodeMetricsReport`` → JobMetricContext gauges (tpu_timer scrape)
+    """
+
+    def __init__(self, job_context, interval_s: float = 10.0):
+        self._job_ctx = job_context
+        self._interval = interval_s
+        self._series: Dict[int, NodeSeries] = {}
+        self._mu = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_once(self) -> None:
+        now = time.time()
+        metric_ctx = get_metric_context()
+        # Gauges older than this are re-reports of one stale scrape, not
+        # new observations — recording them would let a single metric
+        # report satisfy min_samples.
+        max_age = 3 * self._interval
+        with self._mu:
+            nodes = self._job_ctx.get_nodes(NodeType.WORKER)
+            # Evict series of exited/removed nodes: frozen samples of
+            # dead nodes must not feed straggler medians or memory means.
+            for node_id in list(self._series):
+                node = nodes.get(node_id)
+                if node is None or node.exited():
+                    del self._series[node_id]
+            for node in nodes.values():
+                if node.exited():
+                    continue
+                series = self._series.setdefault(
+                    node.node_id, NodeSeries(node.node_id)
+                )
+                series.samples.append(
+                    NodeSample(
+                        timestamp=now,
+                        cpu_percent=node.used_resource.cpu,
+                        memory_mb=node.used_resource.memory_mb,
+                        step_time_us=metric_ctx.fresh_gauge(
+                            node.node_id, STEP_AVG_US, max_age
+                        ),
+                        matmul_tflops=metric_ctx.fresh_gauge(
+                            node.node_id, MATMUL_TFLOPS, max_age
+                        ),
+                    )
+                )
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="job-stats", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            try:
+                self.sample_once()
+            except Exception:
+                logger.exception("job stats sampling error")
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None
+
+    # -- queries -----------------------------------------------------------
+
+    def series(self, node_id: int) -> Optional[NodeSeries]:
+        with self._mu:
+            return self._series.get(node_id)
+
+    def detect_stragglers(
+        self,
+        factor: Optional[float] = None,
+        min_nodes: int = 3,
+        min_samples: int = 4,
+    ) -> List[int]:
+        """Nodes whose mean step time exceeds ``factor`` x the median of
+        peers (reference straggler rule, rdzv_manager.py:784 — applied
+        here to *runtime* profiler data rather than the one-shot network
+        check).
+
+        Requires ``min_nodes`` reporting nodes (a median of one or two is
+        meaningless) and ``min_samples`` samples per accused node so a
+        single slow step (GC pause, ckpt stall) can't evict a host.
+        ``factor`` defaults to the configured straggler_median_ratio so
+        runtime exclusion and the rendezvous check share one knob.
+        """
+        if factor is None:
+            from ...common.config import get_context
+
+            factor = get_context().straggler_median_ratio
+        with self._mu:
+            means = {}
+            for nid, series in self._series.items():
+                count = sum(1 for s in series.samples if s.step_time_us > 0)
+                if count >= min_samples:
+                    means[nid] = series.mean_step_time_us()
+        if len(means) < min_nodes:
+            return []
+        import statistics
+
+        median = statistics.median(means.values())
+        if median <= 0:
+            return []
+        return sorted(n for n, v in means.items() if v > factor * median)
+
+    def mean_cpu_percent(self) -> float:
+        with self._mu:
+            vals = [
+                s.latest().cpu_percent
+                for s in self._series.values()
+                if s.latest() is not None
+            ]
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def mean_memory_mb(self) -> float:
+        with self._mu:
+            vals = [
+                s.latest().memory_mb
+                for s in self._series.values()
+                if s.latest() is not None
+            ]
+        return sum(vals) / len(vals) if vals else 0.0

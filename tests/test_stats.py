@@ -1,0 +1,234 @@
+"""Stats pipeline tests: per-node series → optimizer / straggler /
+hyperparam decisions (reference master/stats/ + local_optimizer.py:66 +
+simple_strategy_generator.py:40)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+from dlrover_tpu.master.job_context import JobContext, get_job_context
+from dlrover_tpu.master.monitor.metric_context import (
+    JobMetricContext,
+    get_metric_context,
+)
+from dlrover_tpu.master.monitor.perf_monitor import PerfMonitor
+from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.resource.optimizer import (
+    ResourcePlan,
+    ThroughputScalingOptimizer,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+from dlrover_tpu.master.stats.job_stats import (
+    STEP_AVG_US,
+    JobStatsCollector,
+)
+
+
+class TestStragglerGate:
+    def test_exclusion_requires_config_flag(self):
+        """exclude_stragglers=False (default): detection runs nowhere."""
+        job_ctx = _populate(4, [100e3, 105e3, 98e3, 330e3])
+        stats = JobStatsCollector(job_ctx)
+        for _ in range(4):
+            stats.sample_once()
+        excluded = []
+        auto = JobAutoScaler(
+            optimizer=ThroughputScalingOptimizer(PerfMonitor(), max_workers=4),
+            scaler=RecordingScaler(),
+            stats=stats,
+            straggler_handler=excluded.append,
+        )
+        auto.run_once()
+        assert excluded == []
+
+
+@pytest.fixture(autouse=True)
+def fresh_contexts():
+    JobContext.reset()
+    JobMetricContext.reset()
+    yield
+    JobContext.reset()
+    JobMetricContext.reset()
+
+
+def _populate(num_nodes, step_times_us, cpu=30.0, mem=2000.0):
+    job_ctx = get_job_context()
+    metric_ctx = get_metric_context()
+    for node_id in range(num_nodes):
+        node = Node(
+            node_type=NodeType.WORKER, node_id=node_id, rank_index=node_id
+        )
+        node.update_status(NodeStatus.RUNNING)
+        node.used_resource.cpu = cpu
+        node.used_resource.memory_mb = mem
+        job_ctx.update_node(node)
+        metric_ctx.report(node_id, {STEP_AVG_US: step_times_us[node_id]})
+    return job_ctx
+
+
+class TestJobStatsCollector:
+    def test_series_built_from_both_sources(self):
+        job_ctx = _populate(2, [100_000.0, 110_000.0])
+        stats = JobStatsCollector(job_ctx)
+        stats.sample_once()
+        series = stats.series(0)
+        assert series is not None
+        sample = series.latest()
+        assert sample.step_time_us == 100_000.0
+        assert sample.cpu_percent == 30.0
+        assert sample.memory_mb == 2000.0
+
+    def test_straggler_detected(self):
+        job_ctx = _populate(4, [100e3, 105e3, 98e3, 330e3])
+        stats = JobStatsCollector(job_ctx)
+        for _ in range(4):
+            stats.sample_once()
+        assert stats.detect_stragglers() == [3]
+
+    def test_straggler_needs_enough_nodes_and_samples(self):
+        job_ctx = _populate(2, [100e3, 400e3])
+        stats = JobStatsCollector(job_ctx)
+        for _ in range(4):
+            stats.sample_once()
+        assert stats.detect_stragglers() == []  # 2 nodes: median meaningless
+
+        JobContext.reset()
+        JobMetricContext.reset()
+        job_ctx = _populate(4, [100e3, 105e3, 98e3, 330e3])
+        stats = JobStatsCollector(job_ctx)
+        stats.sample_once()  # one sample < min_samples
+        assert stats.detect_stragglers() == []
+
+
+class RecordingScaler(Scaler):
+    def __init__(self):
+        super().__init__("job")
+        self.plans = []
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+
+class TestThroughputScaling:
+    def _perf(self, speed):
+        perf = PerfMonitor()
+        now = time.time()
+        perf.collect_global_step(0, now - 10)
+        perf.collect_global_step(int(speed * 10), now)
+        return perf
+
+    def test_grows_while_linear_then_stops_at_saturation(self):
+        perf = PerfMonitor()
+        opt = ThroughputScalingOptimizer(perf, max_workers=8, node_unit=2)
+        now = time.time()
+
+        perf.collect_global_step(0, now - 10)
+        perf.collect_global_step(20, now)  # 2 steps/s at size 2
+        opt.record_world_size(2)
+        assert opt.generate_plan().worker_num == 4
+
+        # near-linear gain: keep growing
+        perf2 = self._perf(3.8)
+        opt._perf = perf2
+        opt.record_world_size(4)
+        assert opt.generate_plan().worker_num == 6
+
+        # saturated: +2 hosts bought alsmost nothing
+        perf3 = self._perf(3.9)
+        opt._perf = perf3
+        opt.record_world_size(6)
+        assert opt.generate_plan().empty()
+
+
+class TestAutoScalerIntegration:
+    def test_run_once_straggler_exclusion_fires_once(self, monkeypatch):
+        from dlrover_tpu.common.config import get_context
+
+        monkeypatch.setattr(get_context(), "exclude_stragglers", True)
+        job_ctx = _populate(4, [100e3, 105e3, 98e3, 330e3])
+        stats = JobStatsCollector(job_ctx)
+        for _ in range(4):
+            stats.sample_once()
+        excluded = []
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=ThroughputScalingOptimizer(
+                PerfMonitor(), max_workers=4
+            ),
+            scaler=scaler,
+            stats=stats,
+            straggler_handler=excluded.append,
+        )
+        auto.run_once()
+        auto.run_once()
+        assert excluded == [3], "straggler must be handed over exactly once"
+
+    def test_run_once_pushes_strategy_plan(self):
+        job_ctx = _populate(2, [100e3, 100e3], cpu=20.0, mem=1000.0)
+        stats = JobStatsCollector(job_ctx)
+        stats.sample_once()
+        strategy = SimpleStrategyGenerator(
+            stats, host_memory_mb=16_000.0, current_batch_size=8
+        )
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=ThroughputScalingOptimizer(
+                PerfMonitor(), max_workers=2
+            ),
+            scaler=scaler,
+            stats=stats,
+            strategy_generator=strategy,
+        )
+        auto.run_once()
+        cfg = get_job_context().paral_config
+        assert cfg is not None
+        assert cfg.dataloader_batch_size == 16  # low mem+cpu: doubled
+
+
+class TestStrategyGenerator:
+    def test_high_memory_halves_batch_and_raises_accum(self):
+        job_ctx = _populate(2, [0, 0], mem=15_500.0)
+        stats = JobStatsCollector(job_ctx)
+        stats.sample_once()
+        gen = SimpleStrategyGenerator(
+            stats, host_memory_mb=16_000.0, current_batch_size=8
+        )
+        plan = gen.generate_plan()
+        assert plan.dataloader_batch_size == 4
+        assert plan.grad_accum_steps == 2
+
+    def test_comfortable_memory_no_plan(self):
+        job_ctx = _populate(2, [0, 0], mem=10_000.0, cpu=80.0)
+        stats = JobStatsCollector(job_ctx)
+        stats.sample_once()
+        gen = SimpleStrategyGenerator(
+            stats, host_memory_mb=16_000.0, current_batch_size=8
+        )
+        assert gen.generate_plan().empty()
+
+
+class TestMigrateStraggler:
+    def test_remove_and_launch_in_one_plan(self):
+        from dlrover_tpu.master.node.dist_job_manager import (
+            DistributedJobManager,
+        )
+
+        scaler = RecordingScaler()
+        mgr = DistributedJobManager(num_workers=3, scaler=scaler)
+        _populate(3, [0, 0, 0])
+        mgr.migrate_straggler(2)
+        assert scaler.plans, "no plan issued"
+        plan = scaler.plans[-1]
+        assert plan.remove_nodes == [2]
+        replacement = plan.launch_nodes[0]
+        assert replacement.node_id == 2
+        assert replacement.relaunch_count == 1  # budget consumed
+        # budget rules apply: once exhausted, the straggler stays
+        node = get_job_context().get_node(NodeType.WORKER, 2)
+        node.relaunch_count = node.max_relaunch_count
+        get_job_context().update_node(node)
+        mgr.migrate_straggler(2)
+        assert len(scaler.plans) == 1

@@ -224,8 +224,38 @@ class ElasticTrainingAgent:
     # -- master-issued actions -------------------------------------------
 
     def _on_master_action(self, action_type: str, config: dict) -> None:
+        if action_type == DiagnosisActionType.STACK_DUMP:
+            # Executed inline (not queued): the whole point is capturing
+            # the wedged state BEFORE any restart action tears it down.
+            self._dump_worker_stacks(config.get("reason", ""))
+            return
         with self._action_lock:
             self._pending_action = action_type
+
+    def _dump_worker_stacks(self, reason: str) -> None:
+        """Signal the worker for a faulthandler traceback and ship it to
+        the master (reference all-rank stack dump, manager.cc:393-414)."""
+        from ..profiler.stack_dump import trigger_and_read
+
+        pid = self._worker.pid if self._worker is not None else None
+        if not pid:
+            return
+        text = trigger_and_read(pid)
+        if not text:
+            logger.warning("worker %s produced no stack dump", pid)
+            return
+        logger.info(
+            "worker stack dump (%s):\n%s", reason or "requested", text
+        )
+        try:
+            self._client.report_event(
+                event_type="stack_dump",
+                instance=f"node-{self._config.node_id}",
+                action=reason or "requested",
+                msg=text[-8000:],
+            )
+        except Exception:
+            logger.warning("stack dump report to master failed")
 
     def _take_pending_action(self) -> Optional[str]:
         with self._action_lock:

@@ -20,6 +20,7 @@ class DiagnosisActionType:
     EVENT = "event"
     RESTART_WORKER = "restart_worker"  # soft: restart the JAX process
     RELAUNCH_WORKER = "relaunch_worker"  # hard: replace the node
+    STACK_DUMP = "stack_dump"  # collect the worker's Python stacks
     JOB_ABORTION = "job_abortion"
 
 

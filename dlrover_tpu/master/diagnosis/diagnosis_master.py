@@ -236,8 +236,19 @@ class DiagnosisMaster:
         self._job_ctx.master_actions.add_action(
             EventAction(event_type="hang", msg=f"stalled {stalled_for:.0f}s")
         )
-        # Ask every agent to restart its worker: the re-rendezvous clears
-        # wedged collectives and excludes silently-dead hosts.
+        # First collect every host's Python stacks (the post-mortem the
+        # restart would destroy — reference manager.cc:393 all-rank
+        # dump), then ask every agent to restart its worker: the
+        # re-rendezvous clears wedged collectives and excludes
+        # silently-dead hosts. Queue order is delivery order.
+        for node in running:
+            self._job_ctx.node_actions.add_action(
+                NodeAction(
+                    node_id=node.node_id,
+                    action_type=DiagnosisActionType.STACK_DUMP,
+                    reason="hang",
+                )
+            )
         for node in running:
             self._job_ctx.node_actions.add_action(
                 NodeAction(

@@ -132,11 +132,7 @@ class DistributedJobManager(JobManager):
                     JobAbortionAction(reason=JobExitReason.MAX_RELAUNCH)
                 )
             return
-        node.inc_relaunch_count()
-        self._job_ctx.update_node(node)
-        replacement = node.get_relaunch_node(node.node_id)
-        replacement.relaunch_count = node.relaunch_count
-        self._job_ctx.update_node(replacement)
+        replacement = self._consume_budget(node)
         logger.info(
             "relaunching node %s via scaler (count %s/%s)",
             node.node_id,
@@ -144,6 +140,16 @@ class DistributedJobManager(JobManager):
             node.max_relaunch_count,
         )
         self._scaler.scale(ScalePlan(launch_nodes=[replacement]))
+
+    def _consume_budget(self, node: Node) -> Node:
+        """Burn one relaunch and register the replacement node (shared
+        by the dead-node and straggler-migration paths)."""
+        node.inc_relaunch_count()
+        self._job_ctx.update_node(node)
+        replacement = node.get_relaunch_node(node.node_id)
+        replacement.relaunch_count = node.relaunch_count
+        self._job_ctx.update_node(replacement)
+        return replacement
 
     def migrate_straggler(self, node_id: int) -> None:
         """Replace a live-but-slow node: remove its pod AND launch a
@@ -159,12 +165,8 @@ class DistributedJobManager(JobManager):
                 node_id,
             )
             return
-        node.inc_relaunch_count()
         node.is_released = True
-        self._job_ctx.update_node(node)
-        replacement = node.get_relaunch_node(node.node_id)
-        replacement.relaunch_count = node.relaunch_count
-        self._job_ctx.update_node(replacement)
+        replacement = self._consume_budget(node)
         logger.info("migrating straggler node %s", node_id)
         self._scaler.scale(
             ScalePlan(remove_nodes=[node_id], launch_nodes=[replacement])

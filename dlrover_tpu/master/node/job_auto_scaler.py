@@ -81,7 +81,10 @@ class JobAutoScaler:
     # -- periodic loop (allreduce auto-scale, reference :315) --------------
 
     def start(self) -> None:
-        if self._thread is not None or not self._ctx.auto_tuning_enabled:
+        enabled = (
+            self._ctx.auto_tuning_enabled or self._ctx.exclude_stragglers
+        )
+        if self._thread is not None or not enabled:
             return
         self._stopped.clear()
         self._thread = threading.Thread(
@@ -119,10 +122,17 @@ class JobAutoScaler:
             return
         if not self._ctx.exclude_stragglers:
             return  # destructive exclusion is its own opt-in flag
+        from ...common.constants import NodeType
+
         for node_id in self._stats.detect_stragglers():
-            if node_id in self._excluded_stragglers:
+            node = self._job_ctx.get_node(NodeType.WORKER, node_id)
+            # Key by incarnation: migration reuses the node id, and the
+            # replacement (higher relaunch_count) must stay detectable.
+            key = (node_id, node.relaunch_count if node else 0)
+            if key in self._excluded_stragglers:
                 continue
-            self._excluded_stragglers.add(node_id)
+            self._excluded_stragglers.add(key)
+            self._stats.evict(node_id)  # old samples must not skew peers
             logger.warning(
                 "straggler node %s (step time > %.1fx median); excluding",
                 node_id,

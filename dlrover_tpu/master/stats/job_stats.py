@@ -20,9 +20,11 @@ from ...common.constants import NodeType
 from ...common.log import logger
 from ..monitor.metric_context import get_metric_context
 
-# tpu_timer gauge names (native/tpu_timer MetricsText)
-STEP_AVG_US = 'tpu_timer_latency_us{kind="step",agg="avg"}'
-MATMUL_TFLOPS = 'tpu_timer_tflops{kind="matmul"}'
+# tpu_timer gauge names (native/tpu_timer MetricsText). win_avg is the
+# recent-window average — the run-lifetime avg would take hours to
+# reflect a degradation and is useless for straggler detection.
+STEP_AVG_US = 'tpu_timer_latency_us{kind="step",agg="win_avg"}'
+MATMUL_TFLOPS = 'tpu_timer_tflops{kind="hlo_flops"}'
 
 
 @dataclass
@@ -127,6 +129,12 @@ class JobStatsCollector:
         self._thread = None
 
     # -- queries -----------------------------------------------------------
+
+    def evict(self, node_id: int) -> None:
+        """Drop a node's series (e.g. straggler migrated: the old
+        incarnation's samples must not skew the peer median)."""
+        with self._mu:
+            self._series.pop(node_id, None)
 
     def series(self, node_id: int) -> Optional[NodeSeries]:
         with self._mu:

@@ -1,0 +1,125 @@
+"""Automatic FLOP / collective-byte derivation from compiled HLO.
+
+Reference: xpu_timer derives matmul TFLOPS from intercepted GEMM dims
+and bus GB/s from NCCL call sizes (``hook.cc:126-441``,
+``intercepted.cc``). XLA has no per-op call sites to intercept — a jit
+step is one compiled program — so the equivalent signals come from the
+compiler itself:
+
+- total FLOPs per step from ``Compiled.cost_analysis()`` (exact, the
+  compiler's own count), and
+- per-collective payload bytes parsed from the optimized HLO text
+  (``all-reduce``/``all-gather``/``reduce-scatter``/``all-to-all``/
+  ``collective-permute`` instruction shapes).
+
+With the step duration measured by :class:`~.hooks.StepProfiler`, these
+feed the native core's TFLOPS and bus-GB/s gauges with no manual
+flops/bytes arguments anywhere.
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..common.log import logger
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# One shaped buffer: f32[128,256]{...} — dims optional (scalars: f32[])
+_SHAPE = re.compile(r"\b([a-z]\d*[a-z0-9]*)\[([0-9,]*)\]")
+# An HLO instruction line: %name = <shapes...> <opcode>(...)
+_INSTR = re.compile(
+    r"=\s*(?:\()?\s*(.*?)\s*(?:\))?\s*"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(?:-start|-done)?\("
+)
+
+
+def _shape_bytes(shapes_text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE.findall(shapes_text):
+        itemsize = _DTYPE_BYTES.get(dtype)
+        if itemsize is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * itemsize
+    return total
+
+
+@dataclass
+class HloCosts:
+    """Per-execution cost summary of one compiled program."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    # opcode -> total payload bytes per execution
+    collective_bytes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total_collective_bytes(self) -> int:
+        return sum(self.collective_bytes.values())
+
+
+def parse_collectives(hlo_text: str) -> Dict[str, int]:
+    """Sum payload bytes per collective opcode from optimized HLO text.
+
+    ``-start`` forms are counted, ``-done`` forms skipped (same
+    transfer). Variadic collectives (tuple results) sum every operand
+    shape on the left-hand side.
+    """
+    out: Dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        if "-done(" in line:
+            continue
+        m = _INSTR.search(line)
+        if m is None:
+            continue
+        shapes_text, opcode = m.group(1), m.group(2)
+        nbytes = _shape_bytes(shapes_text)
+        if nbytes:
+            out[opcode] = out.get(opcode, 0) + nbytes
+    return out
+
+
+def analyze_compiled(compiled) -> HloCosts:
+    """Cost summary of a ``jax.stages.Compiled`` (or anything exposing
+    ``cost_analysis()`` and ``as_text()``)."""
+    costs = HloCosts()
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        costs.flops = float(analysis.get("flops", 0.0))
+        costs.bytes_accessed = float(analysis.get("bytes accessed", 0.0))
+    except Exception as e:
+        logger.debug("cost_analysis unavailable: %s", e)
+    try:
+        costs.collective_bytes = parse_collectives(compiled.as_text())
+    except Exception as e:
+        logger.debug("HLO text unavailable: %s", e)
+    return costs
+
+
+def analyze_jitted(jitted_fn, *args, **kwargs) -> HloCosts:
+    """Lower+compile a jitted function for the given arguments and
+    analyze it. The compilation hits jax's cache, so pairing this with
+    the first real call costs (almost) nothing extra."""
+    return analyze_compiled(jitted_fn.lower(*args, **kwargs).compile())

@@ -23,6 +23,8 @@ import jax
 
 from .native import (
     KIND_COLLECTIVE,
+    KIND_HLO_COMM,
+    KIND_HLO_FLOPS,
     KIND_MATMUL,
     KIND_OTHER,
     KIND_STEP,
@@ -35,17 +37,46 @@ def _now_us() -> int:
 
 
 class StepProfiler:
-    """Wraps a train step; feeds step watermarks + durations.
+    """Wraps a train step; feeds step watermarks + durations, and (with
+    ``auto_costs``) FLOP/collective-byte gauges derived from the
+    compiled HLO — no manual flops/bytes anywhere.
 
     >>> prof = StepProfiler()
     >>> state, loss = prof.step(step_fn, state, x, y, step=int(state.step))
     """
 
-    def __init__(self, timer: Optional[TpuTimer] = None, port: int = 0):
+    def __init__(
+        self,
+        timer: Optional[TpuTimer] = None,
+        port: int = 0,
+        auto_costs: bool = True,
+    ):
         self.timer = timer or TpuTimer.singleton(port)
         self._auto_step = 0
+        self._auto_costs = auto_costs
+        self._costs = None
+        self._costs_probed = False
+
+    def _probe_costs(self, fn: Callable, args, kwargs) -> None:
+        """Derive per-step FLOPs and collective bytes from the jitted
+        fn's compiled HLO (first call only; compilation is cached so the
+        real call right after reuses it)."""
+        self._costs_probed = True
+        if not hasattr(fn, "lower"):
+            return
+        try:
+            from .hlo import analyze_jitted
+
+            self._costs = analyze_jitted(fn, *args, **kwargs)
+        except Exception as e:
+            # never let profiling break training
+            import logging
+
+            logging.getLogger(__name__).debug("HLO cost probe failed: %s", e)
 
     def step(self, fn: Callable, *args, step: Optional[int] = None, **kwargs):
+        if self._auto_costs and not self._costs_probed:
+            self._probe_costs(fn, args, kwargs)
         step_no = self._auto_step if step is None else step
         self._auto_step = step_no + 1
         self.timer.step_begin(step_no)
@@ -55,9 +86,28 @@ class StepProfiler:
             result = jax.block_until_ready(result)
             return result
         finally:
-            self.timer.record(
-                "train_step", KIND_STEP, started, _now_us() - started
-            )
+            dur = _now_us() - started
+            self.timer.record("train_step", KIND_STEP, started, dur)
+            if self._costs is not None:
+                # Effective per-step rates: compiler-counted work over
+                # the measured wall time (how xpu_timer's TFLOPS and
+                # bus-GB/s gauges read, with XLA as the "interceptor").
+                if self._costs.flops > 0:
+                    self.timer.record(
+                        "hlo_step_flops",
+                        KIND_HLO_FLOPS,
+                        started,
+                        dur,
+                        flops=self._costs.flops,
+                    )
+                for opcode, nbytes in self._costs.collective_bytes.items():
+                    self.timer.record(
+                        f"hlo_{opcode}",
+                        KIND_HLO_COMM,
+                        started,
+                        dur,
+                        bytes_moved=float(nbytes),
+                    )
             self.timer.step_end(step_no)
 
     def wrap(self, fn: Callable) -> Callable:

@@ -27,6 +27,10 @@ KIND_STEP = 2
 KIND_H2D = 3
 KIND_D2H = 4
 KIND_OTHER = 5
+# Whole-step compiler-derived work (HLO cost analysis) — separate
+# families so step durations don't pollute op-granular latency gauges.
+KIND_HLO_FLOPS = 6
+KIND_HLO_COMM = 7
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
@@ -79,6 +83,8 @@ def load_native() -> ctypes.CDLL:
         lib.tt_current_step_open_s.restype = ctypes.c_double
         lib.tt_dump_timeline.restype = ctypes.c_int64
         lib.tt_dump_timeline.argtypes = [ctypes.c_char_p]
+        lib.tt_dump_names.restype = ctypes.c_int64
+        lib.tt_dump_names.argtypes = [ctypes.c_char_p]
         lib.tt_metrics_text.restype = ctypes.c_int64
         lib.tt_metrics_text.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         _lib = lib
@@ -142,7 +148,12 @@ class TpuTimer:
         return float(self._lib.tt_current_step_open_s())
 
     def dump_timeline(self, path: str) -> int:
-        return int(self._lib.tt_dump_timeline(path.encode()))
+        """Dump the trace ring plus its name table (sidecar
+        ``<path>.names``) so the perfetto converter can symbolize."""
+        n = int(self._lib.tt_dump_timeline(path.encode()))
+        if n >= 0:
+            self._lib.tt_dump_names((path + ".names").encode())
+        return n
 
     def metrics_text(self) -> str:
         buf = ctypes.create_string_buffer(1 << 16)

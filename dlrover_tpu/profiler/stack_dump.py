@@ -1,0 +1,89 @@
+"""All-host Python stack dumps on hang.
+
+Reference: xpu_timer's hang path (``common/manager.cc:393-414`` doHang →
+daemon-coordinated all-rank pstack via
+``server/hosting_service_server_client.cc`` and
+``py_xpu_timer/bin/xpu_timer_stacktrace_viewer``). TPU shape: the master's
+hang detector broadcasts a STACK_DUMP diagnosis action; each agent
+signals its worker with SIGUSR2, which a ``faulthandler`` hook the
+trainer installed turns into an all-thread Python traceback written to a
+well-known per-host file; the agent ships the text back to the master as
+an event, giving one artifact with every host's stacks.
+"""
+
+import faulthandler
+import os
+import signal
+import time
+from typing import Optional
+
+from ..common.log import logger
+
+_DUMP_DIR = os.getenv(
+    "DLROVER_STACK_DUMP_DIR", os.path.join("/tmp", "dlrover_tpu", "stacks")
+)
+_handle = None  # keep the dump file object alive (faulthandler holds the fd)
+
+
+def stack_dump_path() -> str:
+    from ..common.multi_process import _ipc_namespace
+
+    os.makedirs(_DUMP_DIR, exist_ok=True)
+    return os.path.join(_DUMP_DIR, f"{_ipc_namespace()}.stacks")
+
+
+def install_stack_dump_handler() -> Optional[str]:
+    """Trainer side: SIGUSR2 → all-thread traceback into the host's dump
+    file. Async-signal-safe (faulthandler writes directly to the fd), so
+    it works even when the process is wedged inside a blocked collective.
+    Returns the dump path, or None when installation failed."""
+    global _handle
+    path = stack_dump_path()
+    try:
+        _handle = open(path, "w")
+        faulthandler.register(
+            signal.SIGUSR2, file=_handle, all_threads=True, chain=False
+        )
+        return path
+    except (OSError, AttributeError, ValueError) as e:
+        # ValueError: not in main thread / unsupported platform
+        logger.warning("stack dump handler not installed: %s", e)
+        return None
+
+
+def trigger_and_read(pid: int, timeout_s: float = 5.0) -> str:
+    """Agent side: signal the worker, wait for the dump to land, return
+    the traceback text ('' when nothing arrived)."""
+    path = stack_dump_path()
+    if not os.path.exists(path):
+        # The trainer never installed the faulthandler hook (the install
+        # creates this file): SIGUSR2 would TERMINATE it (default
+        # disposition), turning a diagnostic into a kill.
+        logger.warning(
+            "no stack dump hook installed for this host; skipping signal"
+        )
+        return ""
+    try:
+        before = os.path.getsize(path)
+    except OSError:
+        before = 0
+    try:
+        os.kill(pid, signal.SIGUSR2)
+    except (ProcessLookupError, PermissionError) as e:
+        logger.warning("cannot signal worker %s for stack dump: %s", pid, e)
+        return ""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if os.path.getsize(path) > before:
+                time.sleep(0.2)  # let the write finish
+                break
+        except OSError:
+            pass
+        time.sleep(0.1)
+    try:
+        with open(path) as f:
+            f.seek(before)
+            return f.read()
+    except OSError:
+        return ""

@@ -16,7 +16,10 @@ from typing import Dict, List, Optional
 _MAGIC = b"TPUTL001"
 _RECORD = struct.Struct("<IIqII")
 
-KIND_NAMES = ["matmul", "collective", "step", "h2d", "d2h", "other"]
+KIND_NAMES = [
+    "matmul", "collective", "step", "h2d", "d2h", "other",
+    "hlo_flops", "hlo_comm",
+]
 
 
 @dataclass
@@ -67,10 +70,25 @@ def to_perfetto(
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
+def read_names(path: str) -> Dict[int, str]:
+    """Read a ``tt_dump_names`` sidecar ("id\tname" lines)."""
+    names: Dict[int, str] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                ident, _, name = line.rstrip("\n").partition("\t")
+                if name:
+                    names[int(ident)] = name
+    except OSError:
+        pass
+    return names
+
+
 def convert(timeline_path: str, json_path: str) -> int:
     events = read_timeline(timeline_path)
+    names = read_names(timeline_path + ".names")
     with open(json_path, "w") as f:
-        json.dump(to_perfetto(events), f)
+        json.dump(to_perfetto(events, names=names), f)
     return len(events)
 
 

@@ -62,6 +62,11 @@ class ElasticContext:
         also the standalone/test path where the process uses the local
         (or virtual CPU) devices directly.
         """
+        from ..profiler.stack_dump import install_stack_dump_handler
+
+        # Hang post-mortems: the agent's SIGUSR2 lands here even when the
+        # process is wedged inside a blocked collective.
+        install_stack_dump_handler()
         if self.num_processes <= 1 or not self.coordinator:
             logger.info("single-process world; skipping jax.distributed")
             return

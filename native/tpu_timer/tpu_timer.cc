@@ -51,6 +51,13 @@ struct KindStats {
     if (window.size() > kWindow) window.pop_front();
   }
 
+  double WindowAvg() const {
+    if (window.empty()) return 0;
+    double sum = 0;
+    for (double v : window) sum += v;
+    return sum / window.size();
+  }
+
   double P99() const {
     if (window.empty()) return 0;
     std::vector<double> v(window.begin(), window.end());
@@ -116,7 +123,8 @@ double StepMedianMs(Core& c) {
 
 std::string MetricsText(Core& c) {
   static const char* kKindNames[TT_KIND_COUNT] = {
-      "matmul", "collective", "step", "h2d", "d2h", "other"};
+      "matmul", "collective", "step", "h2d", "d2h", "other",
+      "hlo_flops", "hlo_comm"};
   std::string out;
   out.reserve(4096);
   char buf[512];
@@ -128,12 +136,13 @@ std::string MetricsText(Core& c) {
     double avg = s.sum_us / s.count;
     snprintf(buf, sizeof(buf),
              "tpu_timer_latency_us{kind=\"%s\",agg=\"avg\"} %.3f\n"
+             "tpu_timer_latency_us{kind=\"%s\",agg=\"win_avg\"} %.3f\n"
              "tpu_timer_latency_us{kind=\"%s\",agg=\"min\"} %.3f\n"
              "tpu_timer_latency_us{kind=\"%s\",agg=\"max\"} %.3f\n"
              "tpu_timer_latency_us{kind=\"%s\",agg=\"p99\"} %.3f\n"
              "tpu_timer_count{kind=\"%s\"} %lld\n",
-             kn, avg, kn, s.min_us, kn, s.max_us, kn, s.P99(), kn,
-             static_cast<long long>(s.count));
+             kn, avg, kn, s.WindowAvg(), kn, s.min_us, kn, s.max_us, kn,
+             s.P99(), kn, static_cast<long long>(s.count));
     out += buf;
     if (s.sum_flops > 0 && s.sum_us > 0) {
       snprintf(buf, sizeof(buf),
@@ -370,6 +379,23 @@ int64_t tt_dump_timeline(const char* path) {
   fwrite(snapshot.data(), sizeof(TraceRecord), snapshot.size(), f);
   fclose(f);
   return static_cast<int64_t>(snapshot.size());
+}
+
+int64_t tt_dump_names(const char* path) {
+  if (g_core == nullptr) return -1;
+  Core& c = *g_core;
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> lock(c.mu);
+    names = c.names;
+  }
+  FILE* f = fopen(path, "w");
+  if (f == nullptr) return -1;
+  for (size_t i = 0; i < names.size(); i++) {
+    fprintf(f, "%zu\t%s\n", i, names[i].c_str());
+  }
+  fclose(f);
+  return static_cast<int64_t>(names.size());
 }
 
 int64_t tt_metrics_text(char* out, int64_t cap) {

@@ -34,13 +34,18 @@ int tt_http_port();
 // Event ingestion -----------------------------------------------------------
 // Kinds mirror the reference's metric families.
 enum TTKind : int32_t {
-  TT_KIND_MATMUL = 0,     // flops metric -> TFLOPS
-  TT_KIND_COLLECTIVE = 1, // bytes metric -> bus GB/s
+  TT_KIND_MATMUL = 0,     // flops metric -> TFLOPS (op-granular)
+  TT_KIND_COLLECTIVE = 1, // bytes metric -> bus GB/s (op-granular)
   TT_KIND_STEP = 2,       // training step
   TT_KIND_H2D = 3,
   TT_KIND_D2H = 4,
   TT_KIND_OTHER = 5,
-  TT_KIND_COUNT = 6
+  // Whole-step compiler-derived work (HLO cost analysis): separate
+  // families so step-length durations never pollute the op-granular
+  // matmul/collective latency gauges.
+  TT_KIND_HLO_FLOPS = 6,
+  TT_KIND_HLO_COMM = 7,
+  TT_KIND_COUNT = 8
 };
 
 // Record one completed event. name_id: interned via tt_intern_name.
@@ -69,6 +74,10 @@ double tt_current_step_open_s();
 // (header "TPUTL001", then 24-byte records: name_id u32, kind u32,
 // start_us i64, dur_us u32, step u32). Returns records written.
 int64_t tt_dump_timeline(const char* path);
+
+// Dump the interned-name table to `path` as "id\tname\n" lines, so a
+// timeline file can be symbolized offline. Returns names written.
+int64_t tt_dump_names(const char* path);
 
 // Metrics (pull; also served as Prometheus text over HTTP /metrics) ---------
 // Fill `out` with the Prometheus exposition text; returns bytes written

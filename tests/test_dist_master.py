@@ -215,6 +215,10 @@ class TestDiagnosisMaster:
         dm = DiagnosisMaster()
         ctx.report_step(10, time.time() - 1.0)  # stalled > downtime
         dm.observe_once()
+        # post-mortem first (stack dump), then the restart that would
+        # destroy the wedged state
+        action = ctx.node_actions.next_action(0)
+        assert action.action_type == "stack_dump"
         action = ctx.node_actions.next_action(0)
         assert action.action_type == "restart_worker"
         # reported once, not repeatedly

@@ -132,3 +132,105 @@ class TestCollector:
         ctx.report(0, {"tpu_timer_hang": 0.0})
         ctx.report(1, {"tpu_timer_hang": 1.0})
         assert ctx.hung_nodes() == [1]
+
+
+class TestHloCosts:
+    def test_parse_collectives_shapes(self):
+        from dlrover_tpu.profiler.hlo import parse_collectives
+
+        hlo = """
+  %ar = f32[128,256]{1,0} all-reduce(%p0), replica_groups={}
+  %ag.1 = bf16[64]{0} all-gather(%p1), dimensions={0}
+  %done = f32[8]{0} all-reduce-done(%start)
+  %rs = (f32[32]{0}, f32[16]{0}) reduce-scatter(%a, %b), dimensions={0}
+  %cp = u32[4,4]{1,0} collective-permute(%x), source_target_pairs={{0,1}}
+"""
+        by_op = parse_collectives(hlo)
+        assert by_op["all-reduce"] == 128 * 256 * 4
+        assert by_op["all-gather"] == 64 * 2
+        assert by_op["reduce-scatter"] == 32 * 4 + 16 * 4
+        assert by_op["collective-permute"] == 4 * 4 * 4
+        assert "all-reduce-done" not in by_op
+
+    def test_analyze_jitted_reports_flops(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.profiler.hlo import analyze_jitted
+
+        @jax.jit
+        def f(a, b):
+            return (a @ b).sum()
+
+        a = jnp.zeros((64, 128), jnp.float32)
+        b = jnp.zeros((128, 32), jnp.float32)
+        costs = analyze_jitted(f, a, b)
+        # compiler counts at least the dot flops (2*M*N*K)
+        assert costs.flops >= 2 * 64 * 128 * 32
+
+    def test_step_profiler_auto_costs(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.profiler.hooks import StepProfiler
+        from dlrover_tpu.profiler.native import TpuTimer
+
+        timer = TpuTimer.singleton()
+        prof = StepProfiler(timer=timer, auto_costs=True)
+
+        @jax.jit
+        def step_fn(a, b):
+            return (a @ b).sum()
+
+        a = jnp.ones((32, 64), jnp.float32)
+        b = jnp.ones((64, 16), jnp.float32)
+        for _ in range(3):
+            prof.step(step_fn, a, b)
+        text = timer.metrics_text()
+        # HLO-derived flops light up the TFLOPS gauge with no manual args
+        assert 'tpu_timer_tflops{kind="hlo_flops"}' in text
+
+
+class TestTimelineNames:
+    def test_dump_and_symbolize(self, tmp_path):
+        from dlrover_tpu.profiler.native import KIND_MATMUL, TpuTimer
+        from dlrover_tpu.profiler.timeline import convert, read_names
+
+        timer = TpuTimer.singleton()
+        timer.record("my_special_op", KIND_MATMUL, 1000, 50, flops=1e6)
+        tl = tmp_path / "t.timeline"
+        out = tmp_path / "t.json"
+        assert timer.dump_timeline(str(tl)) > 0
+        names = read_names(str(tl) + ".names")
+        assert "my_special_op" in names.values()
+        convert(str(tl), str(out))
+        import json
+
+        trace = json.loads(out.read_text())
+        assert any(
+            ev["name"] == "my_special_op" for ev in trace["traceEvents"]
+        )
+
+
+class TestStackDump:
+    def test_install_trigger_read_roundtrip(self, tmp_path, monkeypatch):
+        import os
+        import threading
+        import time
+
+        monkeypatch.setenv("DLROVER_JOB_NAME", f"sd_{os.getpid()}")
+        import dlrover_tpu.profiler.stack_dump as sd
+
+        monkeypatch.setattr(sd, "_DUMP_DIR", str(tmp_path))
+        path = sd.install_stack_dump_handler()
+        assert path is not None
+
+        def waiter():
+            time.sleep(3)
+
+        t = threading.Thread(target=waiter, name="wedged-collective")
+        t.start()
+        text = sd.trigger_and_read(os.getpid())
+        t.join()
+        assert "wedged-collective" in text or "Thread" in text
+        assert "test_install_trigger_read_roundtrip" in text

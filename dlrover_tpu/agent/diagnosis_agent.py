@@ -8,7 +8,6 @@ heartbeat thread also delivers master-issued actions back to the agent
 (reference servicer.py:783).
 """
 
-import re
 import threading
 import time
 from dataclasses import dataclass
@@ -16,6 +15,7 @@ from typing import Callable, List, Optional
 
 from ..common.constants import DefaultValues
 from ..common.log import logger
+from ..diagnosis.diagnostician import FailureNodeDiagnostician
 from ..master.diagnosis.action import DiagnosisActionType
 from ..rpc.client import MasterClient
 
@@ -27,27 +27,6 @@ class WorkerFailure:
     returncode: Optional[int]
     signal: Optional[int]
     log_tail: str = ""
-
-
-# Errors where retrying on the same host cannot help: the host (or its
-# chips) is the problem, so ask the master to replace the node.
-_NODE_FATAL_PATTERNS = [
-    r"device or resource busy",
-    r"failed to initialize tpu",
-    r"tpu platform.*not found",
-    r"pjrt.*internal",
-    r"out of memory.*hbm",
-    r"uncorrectable ecc",
-]
-
-# Errors that a re-rendezvous on the same host usually cures.
-_RETRYABLE_PATTERNS = [
-    r"rendezvousoutsyncerror",
-    r"coordination service.*unavailable",
-    r"deadline exceeded",
-    r"connection refused",
-    r"barrier timed out",
-]
 
 
 class DiagnosisAgent:
@@ -67,30 +46,25 @@ class DiagnosisAgent:
         self._stopped = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self._action_handlers: List[Callable[[str, dict], None]] = []
+        self._diagnostician = FailureNodeDiagnostician(
+            max_restarts=max_restarts
+        )
 
     # -- failure classification ------------------------------------------
 
     def diagnose_training_failure(self, failure: WorkerFailure) -> str:
-        """Return a DiagnosisActionType for the observed failure."""
-        log = failure.log_tail.lower()
-        for pat in _NODE_FATAL_PATTERNS:
-            if re.search(pat, log):
-                logger.warning(
-                    "node-fatal error matched %r → relaunch node", pat
-                )
-                return DiagnosisActionType.RELAUNCH_WORKER
-        if failure.restart_count >= self._max_restarts:
-            logger.warning(
-                "restart budget exhausted (%s) → relaunch node",
-                failure.restart_count,
-            )
-            return DiagnosisActionType.RELAUNCH_WORKER
-        for pat in _RETRYABLE_PATTERNS:
-            if re.search(pat, log):
-                return DiagnosisActionType.RESTART_WORKER
-        # Unknown failure with budget left: soft restart is cheap on the
-        # same host, and the master's exit-code policy catches repeats.
-        return DiagnosisActionType.RESTART_WORKER
+        """Return a DiagnosisActionType for the observed failure (log
+        collector + inference chain; reference diagnosis_agent.py:137 →
+        failure_node_diagnostician.py:25)."""
+        action = self._diagnostician.decide(
+            log_tail=failure.log_tail,
+            restart_count=failure.restart_count,
+            returncode=failure.returncode,
+            signal=failure.signal,
+        )
+        if action == DiagnosisActionType.RELAUNCH_WORKER:
+            logger.warning("failure diagnosis → relaunch node")
+        return action
 
     def report_failure(self, failure: WorkerFailure, level: str = "error") -> None:
         try:

@@ -1,0 +1,17 @@
+from .inference_chain import (  # noqa: F401
+    Inference,
+    InferenceAttribution,
+    InferenceChain,
+    InferenceName,
+    InferenceOperator,
+)
+from .collectors import (  # noqa: F401
+    DataCollector,
+    ResourceCollector,
+    TrainingLogCollector,
+)
+from .diagnostician import (  # noqa: F401
+    Diagnostician,
+    FailureNodeDiagnostician,
+    TrainingHangDiagnostician,
+)

@@ -1,0 +1,118 @@
+"""Diagnosis data collectors.
+
+Reference: ``dlrover/python/diagnosis/datacollector`` —
+``training_log_collector.py:19`` (worker log tail + error-line
+extraction) and ``resource_collector.py:18``. The profiler metric
+collector lives in :mod:`dlrover_tpu.agent.metric_collector` (the agent
+scrapes the native tpu_timer endpoint); these two complete the family.
+"""
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..common.log import logger
+
+# Lines worth surfacing to the failure diagnostician: python tracebacks,
+# XLA/PJRT errors, OOM reports, fatal runtime logs.
+_ERROR_LINE = re.compile(
+    r"(error|exception|traceback|fatal|abort|out of memory|oom|"
+    r"killed|sigsegv|sigbus|core dump)",
+    re.IGNORECASE,
+)
+
+
+@dataclass
+class TrainingLog:
+    """Reference diagnosis_data.py:140."""
+
+    path: str = ""
+    tail: str = ""
+    error_lines: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ResourceUsage:
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    host_memory_total_mb: float = 0.0
+
+
+class DataCollector:
+    """Reference datacollector/data_collector.py ABC."""
+
+    def is_enabled(self) -> bool:
+        return True
+
+    def collect(self):
+        raise NotImplementedError
+
+
+class TrainingLogCollector(DataCollector):
+    """Tail a worker log and extract the error-ish lines (reference
+    training_log_collector.py:19)."""
+
+    def __init__(self, log_path: str = "", max_bytes: int = 64 * 1024):
+        self._path = log_path
+        self._max_bytes = max_bytes
+
+    def is_enabled(self) -> bool:
+        return bool(self._path) and os.path.exists(self._path)
+
+    def collect(self) -> TrainingLog:
+        log = TrainingLog(path=self._path)
+        if not self.is_enabled():
+            return log
+        try:
+            with open(self._path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - self._max_bytes))
+                log.tail = f.read().decode(errors="replace")
+        except OSError as e:
+            logger.warning("log collect failed for %s: %s", self._path, e)
+            return log
+        log.error_lines = [
+            line for line in log.tail.splitlines() if _ERROR_LINE.search(line)
+        ][-200:]
+        return log
+
+
+class ResourceCollector(DataCollector):
+    """Point-in-time host/worker resource usage from /proc (reference
+    resource_collector.py:18; no psutil dependency)."""
+
+    def __init__(self, pid: Optional[int] = None):
+        self._pid = pid
+
+    def collect(self) -> ResourceUsage:
+        usage = ResourceUsage()
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        usage.host_memory_total_mb = (
+                            float(line.split()[1]) / 1024.0
+                        )
+                    elif line.startswith("MemAvailable:"):
+                        available_mb = float(line.split()[1]) / 1024.0
+                        usage.memory_mb = (
+                            usage.host_memory_total_mb - available_mb
+                        )
+        except OSError:
+            pass
+        if self._pid:
+            try:
+                with open(f"/proc/{self._pid}/statm") as f:
+                    pages = int(f.read().split()[1])
+                usage.memory_mb = pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+            except (OSError, ValueError, IndexError):
+                pass
+        try:
+            load1, _, _ = os.getloadavg()
+            ncpu = os.cpu_count() or 1
+            usage.cpu_percent = 100.0 * load1 / ncpu
+        except OSError:
+            pass
+        return usage

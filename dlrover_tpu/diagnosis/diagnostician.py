@@ -1,0 +1,143 @@
+"""Diagnosticians: observe → diagnose → resolve bundles.
+
+Reference: ``diagnosis/common/diagnostician.py`` (Diagnostician base)
+and ``diagnostician/failure_node_diagnostician.py:25``. A diagnostician
+owns its collectors and its slice of the inference chain, exposing one
+``diagnose`` call for the agent/master to use.
+"""
+
+from typing import List, Optional
+
+from ..common.log import logger
+from ..master.diagnosis.action import DiagnosisActionType
+from .collectors import TrainingLogCollector
+from .inference_chain import (
+    Inference,
+    InferenceChain,
+    InferenceName,
+)
+from .operators import (
+    CheckFailureNodeOperator,
+    CheckTrainingHangOperator,
+    ResolveFailureNodeOperator,
+    ResolveTrainingHangOperator,
+)
+
+
+class Diagnostician:
+    """observe (collect) → diagnose (infer) → resolve (actions)."""
+
+    def observe(self, **kwargs) -> List[Inference]:
+        raise NotImplementedError
+
+    def resolve(self, inferences: List[Inference]) -> List[str]:
+        raise NotImplementedError
+
+    def diagnose(self, **kwargs) -> List[str]:
+        return self.resolve(self.observe(**kwargs))
+
+
+class FailureNodeDiagnostician(Diagnostician):
+    """Worker-failure classification (reference
+    failure_node_diagnostician.py:25): collect the worker log, attribute
+    the failure, decide restart vs relaunch."""
+
+    def __init__(self, max_restarts: int = 3):
+        self._max_restarts = max_restarts
+        self._chain = InferenceChain(
+            [CheckFailureNodeOperator(), ResolveFailureNodeOperator()]
+        )
+
+    def observe(
+        self,
+        log_path: str = "",
+        log_tail: str = "",
+        restart_count: int = 0,
+        returncode: Optional[int] = None,
+        signal: Optional[int] = None,
+        **_,
+    ) -> List[Inference]:
+        if not log_tail and log_path:
+            log_tail = TrainingLogCollector(log_path).collect().tail
+        return [
+            Inference(
+                name=InferenceName.WORKER_FAILURE,
+                data={
+                    "log_tail": log_tail,
+                    "restart_count": restart_count,
+                    "max_restarts": self._max_restarts,
+                    "returncode": returncode,
+                    "signal": signal,
+                },
+            )
+        ]
+
+    def resolve(self, inferences: List[Inference]) -> List[str]:
+        return self._chain.resolved_actions(inferences)
+
+    def decide(self, **kwargs) -> str:
+        """Single restart-vs-relaunch decision (what the agent needs),
+        logging the attribution/pattern behind it (on-call debugging
+        needs "matched 'uncorrectable ecc'", not a generic verdict)."""
+        facts = self._chain.infer(self.observe(**kwargs))
+        actions = [
+            f
+            for f in facts
+            if f.name == InferenceName.RESOLVED_ACTION
+        ]
+        # any relaunch verdict wins (it subsumes restart)
+        chosen = None
+        for f in actions:
+            if (
+                f.data.get("action_type")
+                == DiagnosisActionType.RELAUNCH_WORKER
+            ):
+                chosen = f
+                break
+        if chosen is None and actions:
+            chosen = actions[0]
+        if chosen is None:
+            return DiagnosisActionType.RESTART_WORKER
+        logger.info(
+            "failure diagnosis: %s (%s) → %s",
+            chosen.attribution,
+            chosen.description,
+            chosen.data.get("action_type"),
+        )
+        return chosen.data.get(
+            "action_type", DiagnosisActionType.RESTART_WORKER
+        )
+
+
+class TrainingHangDiagnostician(Diagnostician):
+    """Hang confirmation + resolution (reference
+    check/resolve_training_hang_operator): the master feeds the raw
+    stall numbers; the resolved actions come back ordered — stack dump
+    first, then the worker-group restart."""
+
+    def __init__(self, hang_downtime_s: float):
+        self._chain = InferenceChain(
+            [
+                CheckTrainingHangOperator(hang_downtime_s),
+                ResolveTrainingHangOperator(),
+            ]
+        )
+
+    def observe(
+        self,
+        stalled_for_s: float = 0.0,
+        profiler_hung_nodes=None,
+        **_,
+    ) -> List[Inference]:
+        return [
+            Inference(
+                name=InferenceName.TRAINING_HANG,
+                data={
+                    "stalled_for_s": stalled_for_s,
+                    "profiler_hung_nodes": profiler_hung_nodes or [],
+                },
+            )
+        ]
+
+    def resolve(self, inferences: List[Inference]) -> List[str]:
+        return self._chain.resolved_actions(inferences)

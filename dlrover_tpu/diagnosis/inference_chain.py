@@ -1,0 +1,81 @@
+"""Inference chain: symptoms → attributed causes → actions.
+
+Reference: ``dlrover/python/diagnosis/inferencechain`` —
+``Inference``/``InferenceOperator`` (common/inference_chain.py:47,58)
+plus the check/resolve operator pairs (check_training_hang_operator.py,
+resolve_training_hang_operator.py). An Inference is a (name,
+attribution, description[, data]) fact; operators consume the facts
+they are compatible with and emit refined ones; the chain runs until no
+operator advances the state, leaving resolved facts (usually carrying a
+DiagnosisActionType) for the caller to act on.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+
+class InferenceName:
+    WORKER_FAILURE = "worker_failure"
+    TRAINING_HANG = "training_hang"
+    NODE_FAULT = "node_fault"
+    RESOLVED_ACTION = "resolved_action"
+
+
+class InferenceAttribution:
+    """Why (cause class) an observed symptom happened."""
+
+    UNKNOWN = "unknown"
+    NODE_FATAL = "node_fatal"  # host/chips are the problem
+    RETRYABLE = "retryable"  # re-rendezvous on the same host cures it
+    OOM = "oom"
+    BUDGET_EXHAUSTED = "budget_exhausted"
+    COLLECTIVE_STALL = "collective_stall"
+
+
+@dataclass
+class Inference:
+    name: str
+    attribution: str = InferenceAttribution.UNKNOWN
+    description: str = ""
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+class InferenceOperator:
+    """One reasoning step (reference inference_chain.py:58)."""
+
+    def is_compatible(self, inferences: List[Inference]) -> bool:
+        raise NotImplementedError
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        raise NotImplementedError
+
+
+class InferenceChain:
+    """Run operators over the fact set until it stops changing
+    (reference common/inference_chain.py InferenceChain.infer)."""
+
+    def __init__(self, operators: List[InferenceOperator]):
+        self._operators = operators
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        facts = list(inferences)
+        for _ in range(len(self._operators) + 1):  # bounded: no cycles
+            progressed = False
+            for op in self._operators:
+                if not op.is_compatible(facts):
+                    continue
+                new_facts = op.infer(facts)
+                if new_facts != facts:
+                    facts = new_facts
+                    progressed = True
+            if not progressed:
+                break
+        return facts
+
+    def resolved_actions(self, inferences: List[Inference]) -> List[str]:
+        facts = self.infer(inferences)
+        return [
+            f.data.get("action_type", "")
+            for f in facts
+            if f.name == InferenceName.RESOLVED_ACTION
+        ]

@@ -1,0 +1,219 @@
+"""Check/resolve operator pairs for the inference chain.
+
+Reference: ``inferencechain/check_training_hang_operator.py`` /
+``resolve_training_hang_operator.py`` and
+``diagnostician/failure_node_diagnostician.py:25``. Check operators turn
+raw symptoms into attributed causes; resolve operators turn causes into
+DiagnosisActionType decisions.
+"""
+
+import re
+from typing import List
+
+from ..master.diagnosis.action import DiagnosisActionType
+from .inference_chain import (
+    Inference,
+    InferenceAttribution,
+    InferenceName,
+    InferenceOperator,
+)
+
+# Errors where retrying on the same host cannot help: the host (or its
+# chips) is the problem, so ask the master to replace the node.
+NODE_FATAL_PATTERNS = [
+    r"device or resource busy",
+    r"failed to initialize tpu",
+    r"tpu platform.*not found",
+    r"pjrt.*internal",
+    r"uncorrectable ecc",
+    r"sigbus",
+]
+
+# HBM exhaustion: same host retry CAN help after a restart (fragmenta-
+# tion) but repeated OOMs mean the config doesn't fit — attributed
+# separately so resolvers can special-case it.
+OOM_PATTERNS = [
+    r"out of memory",
+    r"resource_exhausted",
+    r"exceeded hbm capacity",
+    r"oom-?kill",
+]
+
+# Errors that a re-rendezvous on the same host usually cures.
+RETRYABLE_PATTERNS = [
+    r"rendezvousoutsyncerror",
+    r"coordination service.*unavailable",
+    r"deadline exceeded",
+    r"connection refused",
+    r"barrier timed out",
+]
+
+
+def _match_any(patterns: List[str], text: str):
+    for pat in patterns:
+        if re.search(pat, text):
+            return pat
+    return None
+
+
+class CheckFailureNodeOperator(InferenceOperator):
+    """worker_failure(+log) → attributed cause (reference
+    failure_node_diagnostician.py:25 log-based classification)."""
+
+    def is_compatible(self, inferences) -> bool:
+        return any(
+            i.name == InferenceName.WORKER_FAILURE
+            and i.attribution == InferenceAttribution.UNKNOWN
+            for i in inferences
+        )
+
+    def infer(self, inferences):
+        out = []
+        for inf in inferences:
+            if (
+                inf.name != InferenceName.WORKER_FAILURE
+                or inf.attribution != InferenceAttribution.UNKNOWN
+            ):
+                out.append(inf)
+                continue
+            log = (inf.data.get("log_tail") or "").lower()
+            if pat := _match_any(NODE_FATAL_PATTERNS, log):
+                attribution = InferenceAttribution.NODE_FATAL
+            elif pat := _match_any(OOM_PATTERNS, log):
+                attribution = InferenceAttribution.OOM
+            elif pat := _match_any(RETRYABLE_PATTERNS, log):
+                attribution = InferenceAttribution.RETRYABLE
+            else:
+                attribution = InferenceAttribution.UNKNOWN
+            restart_count = int(inf.data.get("restart_count", 0))
+            max_restarts = int(inf.data.get("max_restarts", 3))
+            if (
+                attribution
+                in (InferenceAttribution.RETRYABLE, InferenceAttribution.UNKNOWN)
+                and restart_count >= max_restarts
+            ):
+                attribution = InferenceAttribution.BUDGET_EXHAUSTED
+            out.append(
+                Inference(
+                    name=InferenceName.WORKER_FAILURE,
+                    attribution=attribution,
+                    description=f"matched {pat!r}" if pat else "no known pattern",
+                    data=dict(inf.data),
+                )
+            )
+        return out
+
+
+class ResolveFailureNodeOperator(InferenceOperator):
+    """Attributed failure → restart vs relaunch decision."""
+
+    _DECISION = {
+        InferenceAttribution.NODE_FATAL: DiagnosisActionType.RELAUNCH_WORKER,
+        InferenceAttribution.BUDGET_EXHAUSTED: DiagnosisActionType.RELAUNCH_WORKER,
+        InferenceAttribution.OOM: DiagnosisActionType.RESTART_WORKER,
+        InferenceAttribution.RETRYABLE: DiagnosisActionType.RESTART_WORKER,
+        # Unknown with budget left: a soft restart is cheap on the same
+        # host, and the master's exit-code policy catches repeats.
+        InferenceAttribution.UNKNOWN: DiagnosisActionType.RESTART_WORKER,
+    }
+
+    def is_compatible(self, inferences) -> bool:
+        return any(
+            i.name == InferenceName.WORKER_FAILURE for i in inferences
+        ) and not any(
+            i.name == InferenceName.RESOLVED_ACTION for i in inferences
+        )
+
+    def infer(self, inferences):
+        out = list(inferences)
+        for inf in inferences:
+            if inf.name != InferenceName.WORKER_FAILURE:
+                continue
+            if inf.attribution == InferenceAttribution.UNKNOWN and not inf.data:
+                continue  # unchecked fact: let the check operator run
+            action = self._DECISION.get(
+                inf.attribution, DiagnosisActionType.RESTART_WORKER
+            )
+            out.append(
+                Inference(
+                    name=InferenceName.RESOLVED_ACTION,
+                    attribution=inf.attribution,
+                    description=inf.description,
+                    data={"action_type": action},
+                )
+            )
+        return out
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Step-watermark + profiler signals → training_hang fact (reference
+    check_training_hang_operator.py; the master's hang detector feeds
+    the raw numbers)."""
+
+    def __init__(self, hang_downtime_s: float):
+        self._downtime = hang_downtime_s
+
+    def is_compatible(self, inferences) -> bool:
+        return any(
+            i.name == InferenceName.TRAINING_HANG
+            and i.attribution == InferenceAttribution.UNKNOWN
+            for i in inferences
+        )
+
+    def infer(self, inferences):
+        out = []
+        for inf in inferences:
+            if (
+                inf.name != InferenceName.TRAINING_HANG
+                or inf.attribution != InferenceAttribution.UNKNOWN
+            ):
+                out.append(inf)
+                continue
+            stalled = float(inf.data.get("stalled_for_s", 0.0))
+            hung_nodes = inf.data.get("profiler_hung_nodes", [])
+            if stalled >= self._downtime or hung_nodes:
+                out.append(
+                    Inference(
+                        name=InferenceName.TRAINING_HANG,
+                        attribution=InferenceAttribution.COLLECTIVE_STALL,
+                        description=(
+                            f"stalled {stalled:.0f}s, profiler-hung "
+                            f"nodes {hung_nodes}"
+                        ),
+                        data=dict(inf.data),
+                    )
+                )
+            # below threshold: the symptom dissolves (no fact emitted)
+        return out
+
+
+class ResolveTrainingHangOperator(InferenceOperator):
+    """Confirmed hang → stack dump then worker-group restart (reference
+    resolve_training_hang_operator.py)."""
+
+    def is_compatible(self, inferences) -> bool:
+        return any(
+            i.name == InferenceName.TRAINING_HANG
+            and i.attribution == InferenceAttribution.COLLECTIVE_STALL
+            for i in inferences
+        ) and not any(
+            i.name == InferenceName.RESOLVED_ACTION for i in inferences
+        )
+
+    def infer(self, inferences):
+        out = list(inferences)
+        out.append(
+            Inference(
+                name=InferenceName.RESOLVED_ACTION,
+                attribution=InferenceAttribution.COLLECTIVE_STALL,
+                data={"action_type": DiagnosisActionType.STACK_DUMP},
+            )
+        )
+        out.append(
+            Inference(
+                name=InferenceName.RESOLVED_ACTION,
+                attribution=InferenceAttribution.COLLECTIVE_STALL,
+                data={"action_type": DiagnosisActionType.RESTART_WORKER},
+            )
+        )
+        return out

@@ -108,6 +108,8 @@ class ConnectionPreCheckOperator(PreCheckOperator):
 
 class DiagnosisMaster:
     def __init__(self, operators: Optional[List[PreCheckOperator]] = None):
+        from ...diagnosis.diagnostician import TrainingHangDiagnostician
+
         self._ctx = get_context()
         self._job_ctx = get_job_context()
         self._operators = operators or []
@@ -115,6 +117,9 @@ class DiagnosisMaster:
         self._stopped = threading.Event()
         self._hang_since: Optional[float] = None
         self._hang_reported = False
+        self._hang_diagnostician = TrainingHangDiagnostician(
+            self._ctx.hang_downtime_s
+        )
 
     # -- pre-check chain ---------------------------------------------------
 
@@ -236,24 +241,23 @@ class DiagnosisMaster:
         self._job_ctx.master_actions.add_action(
             EventAction(event_type="hang", msg=f"stalled {stalled_for:.0f}s")
         )
-        # First collect every host's Python stacks (the post-mortem the
-        # restart would destroy — reference manager.cc:393 all-rank
-        # dump), then ask every agent to restart its worker: the
-        # re-rendezvous clears wedged collectives and excludes
-        # silently-dead hosts. Queue order is delivery order.
-        for node in running:
-            self._job_ctx.node_actions.add_action(
-                NodeAction(
-                    node_id=node.node_id,
-                    action_type=DiagnosisActionType.STACK_DUMP,
-                    reason="hang",
+        # Route the symptom through the hang diagnostician (reference
+        # inferencechain/check+resolve_training_hang_operator): the
+        # resolved actions come back ordered — stack dumps first (the
+        # post-mortem a restart would destroy), then the group restart
+        # whose re-rendezvous clears wedged collectives.
+        from ..monitor.metric_context import get_metric_context
+
+        actions = self._hang_diagnostician.diagnose(
+            stalled_for_s=stalled_for,
+            profiler_hung_nodes=get_metric_context().hung_nodes(),
+        )
+        for action_type in actions:
+            for node in running:
+                self._job_ctx.node_actions.add_action(
+                    NodeAction(
+                        node_id=node.node_id,
+                        action_type=action_type,
+                        reason="hang",
+                    )
                 )
-            )
-        for node in running:
-            self._job_ctx.node_actions.add_action(
-                NodeAction(
-                    node_id=node.node_id,
-                    action_type=DiagnosisActionType.RESTART_WORKER,
-                    reason="hang",
-                )
-            )

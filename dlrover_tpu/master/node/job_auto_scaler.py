@@ -102,15 +102,21 @@ class JobAutoScaler:
 
     def run_once(self) -> None:
         """One supervision round: scale decision from throughput, then
-        hyperparam suggestions, then straggler exclusion — each driven by
-        the stats pipeline rather than static configuration."""
-        if self._world_size_fn is not None and hasattr(
-            self._optimizer, "record_world_size"
-        ):
-            self._optimizer.record_world_size(self._world_size_fn())
-        self.execute_job_optimization_plan(self._optimizer.generate_plan())
-        if self._strategy is not None:
-            self.execute_job_optimization_plan(self._strategy.generate_plan())
+        hyperparam suggestions, then straggler exclusion — each gated on
+        its own opt-in (a user enabling only straggler exclusion must
+        not get auto scale-ups)."""
+        if self._ctx.auto_tuning_enabled:
+            if self._world_size_fn is not None and hasattr(
+                self._optimizer, "record_world_size"
+            ):
+                self._optimizer.record_world_size(self._world_size_fn())
+            self.execute_job_optimization_plan(
+                self._optimizer.generate_plan()
+            )
+            if self._strategy is not None:
+                self.execute_job_optimization_plan(
+                    self._strategy.generate_plan()
+                )
         self._check_stragglers()
 
     def _check_stragglers(self) -> None:

@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from ..common.log import logger
 from .native import (
     KIND_COLLECTIVE,
     KIND_HLO_COMM,
@@ -55,13 +56,18 @@ class StepProfiler:
         self._auto_step = 0
         self._auto_costs = auto_costs
         self._costs = None
-        self._costs_probed = False
+        # Costs are keyed by function identity: a rebuilt jitted step
+        # (new shapes after re-tuning, or an eval fn sharing the
+        # profiler) must be re-probed, or its gauges report the old
+        # program's flops/bytes.
+        self._costs_fn_id: Optional[int] = None
 
     def _probe_costs(self, fn: Callable, args, kwargs) -> None:
         """Derive per-step FLOPs and collective bytes from the jitted
-        fn's compiled HLO (first call only; compilation is cached so the
+        fn's compiled HLO (once per fn; compilation is cached so the
         real call right after reuses it)."""
-        self._costs_probed = True
+        self._costs_fn_id = id(fn)
+        self._costs = None
         if not hasattr(fn, "lower"):
             return
         try:
@@ -70,12 +76,10 @@ class StepProfiler:
             self._costs = analyze_jitted(fn, *args, **kwargs)
         except Exception as e:
             # never let profiling break training
-            import logging
-
-            logging.getLogger(__name__).debug("HLO cost probe failed: %s", e)
+            logger.debug("HLO cost probe failed: %s", e)
 
     def step(self, fn: Callable, *args, step: Optional[int] = None, **kwargs):
-        if self._auto_costs and not self._costs_probed:
+        if self._auto_costs and self._costs_fn_id != id(fn):
             self._probe_costs(fn, args, kwargs)
         step_no = self._auto_step if step is None else step
         self._auto_step = step_no + 1

@@ -1,0 +1,155 @@
+"""Diagnosis subsystem tests: collectors, inference chain, operators,
+diagnosticians (reference dlrover/python/diagnosis family)."""
+
+import os
+
+import pytest
+
+from dlrover_tpu.diagnosis import (
+    Inference,
+    InferenceAttribution,
+    InferenceChain,
+    InferenceName,
+    FailureNodeDiagnostician,
+    ResourceCollector,
+    TrainingLogCollector,
+)
+from dlrover_tpu.diagnosis.operators import (
+    CheckFailureNodeOperator,
+    CheckTrainingHangOperator,
+    ResolveFailureNodeOperator,
+    ResolveTrainingHangOperator,
+)
+from dlrover_tpu.master.diagnosis.action import DiagnosisActionType
+
+
+class TestCollectors:
+    def test_training_log_collector_extracts_errors(self, tmp_path):
+        log = tmp_path / "worker.log"
+        log.write_text(
+            "step 1 loss 3.2\n"
+            "step 2 loss 3.1\n"
+            "E0730 something RESOURCE_EXHAUSTED: out of memory\n"
+            "Traceback (most recent call last):\n"
+            "  File train.py line 10\n"
+            "ValueError: bad value\n"
+        )
+        got = TrainingLogCollector(str(log)).collect()
+        assert "loss 3.2" in got.tail
+        assert any("out of memory" in line for line in got.error_lines)
+        assert any("Traceback" in line for line in got.error_lines)
+        assert not any("loss" in line for line in got.error_lines)
+
+    def test_training_log_collector_missing_file(self):
+        collector = TrainingLogCollector("/nonexistent/x.log")
+        assert not collector.is_enabled()
+        assert collector.collect().tail == ""
+
+    def test_resource_collector_reads_proc(self):
+        usage = ResourceCollector(pid=os.getpid()).collect()
+        assert usage.host_memory_total_mb > 0
+        assert usage.memory_mb > 0
+
+
+class TestFailureChain:
+    def _decide(self, log, restart_count=0, max_restarts=3):
+        return FailureNodeDiagnostician(max_restarts=max_restarts).decide(
+            log_tail=log, restart_count=restart_count
+        )
+
+    def test_node_fatal_relaunches(self):
+        assert (
+            self._decide("E: failed to initialize TPU system")
+            == DiagnosisActionType.RELAUNCH_WORKER
+        )
+        assert (
+            self._decide("uncorrectable ECC error encountered")
+            == DiagnosisActionType.RELAUNCH_WORKER
+        )
+
+    def test_retryable_restarts(self):
+        assert (
+            self._decide("grpc: connection refused while dialing master")
+            == DiagnosisActionType.RESTART_WORKER
+        )
+
+    def test_oom_restarts_with_budget(self):
+        assert (
+            self._decide("RESOURCE_EXHAUSTED: out of memory on device")
+            == DiagnosisActionType.RESTART_WORKER
+        )
+
+    def test_budget_exhausted_relaunches(self):
+        assert (
+            self._decide("connection refused", restart_count=3)
+            == DiagnosisActionType.RELAUNCH_WORKER
+        )
+        # node-fatal wins regardless of budget
+        assert (
+            self._decide("pjrt internal error", restart_count=0)
+            == DiagnosisActionType.RELAUNCH_WORKER
+        )
+
+    def test_unknown_restarts(self):
+        assert self._decide("") == DiagnosisActionType.RESTART_WORKER
+
+    def test_attribution_surfaces(self):
+        diag = FailureNodeDiagnostician()
+        facts = diag.observe(log_tail="out of memory on chip 0")
+        resolved = InferenceChain(
+            [CheckFailureNodeOperator(), ResolveFailureNodeOperator()]
+        ).infer(facts)
+        attributed = [
+            f for f in resolved if f.name == InferenceName.WORKER_FAILURE
+        ]
+        assert attributed[0].attribution == InferenceAttribution.OOM
+
+
+class TestHangChain:
+    def _chain(self, downtime=10.0):
+        return InferenceChain(
+            [CheckTrainingHangOperator(downtime), ResolveTrainingHangOperator()]
+        )
+
+    def test_confirmed_hang_dumps_then_restarts(self):
+        actions = self._chain().resolved_actions(
+            [
+                Inference(
+                    name=InferenceName.TRAINING_HANG,
+                    data={"stalled_for_s": 60.0, "profiler_hung_nodes": []},
+                )
+            ]
+        )
+        assert actions == [
+            DiagnosisActionType.STACK_DUMP,
+            DiagnosisActionType.RESTART_WORKER,
+        ]
+
+    def test_profiler_hang_alone_confirms(self):
+        actions = self._chain().resolved_actions(
+            [
+                Inference(
+                    name=InferenceName.TRAINING_HANG,
+                    data={"stalled_for_s": 0.0, "profiler_hung_nodes": [2]},
+                )
+            ]
+        )
+        assert DiagnosisActionType.STACK_DUMP in actions
+
+    def test_below_threshold_no_actions(self):
+        actions = self._chain().resolved_actions(
+            [
+                Inference(
+                    name=InferenceName.TRAINING_HANG,
+                    data={"stalled_for_s": 2.0, "profiler_hung_nodes": []},
+                )
+            ]
+        )
+        assert actions == []
+
+
+class TestChainMechanics:
+    def test_chain_terminates_without_compatible_operator(self):
+        chain = InferenceChain([CheckFailureNodeOperator()])
+        facts = [Inference(name="unrelated")]
+        assert chain.infer(facts) == facts

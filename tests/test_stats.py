@@ -166,7 +166,10 @@ class TestAutoScalerIntegration:
         auto.run_once()
         assert excluded == [3], "straggler must be handed over exactly once"
 
-    def test_run_once_pushes_strategy_plan(self):
+    def test_run_once_pushes_strategy_plan(self, monkeypatch):
+        from dlrover_tpu.common.config import get_context
+
+        monkeypatch.setattr(get_context(), "auto_tuning_enabled", True)
         job_ctx = _populate(2, [100e3, 100e3], cpu=20.0, mem=1000.0)
         stats = JobStatsCollector(job_ctx)
         stats.sample_once()

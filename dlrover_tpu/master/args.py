@@ -41,6 +41,12 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="number of TPU hosts (JAX processes) in the job",
     )
     parser.add_argument(
+        "--max_workers",
+        type=int,
+        default=0,
+        help="auto-scale ceiling (0 = fixed at --num_workers)",
+    )
+    parser.add_argument(
         "--node_unit",
         type=_pos_int,
         default=1,

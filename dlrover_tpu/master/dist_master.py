@@ -274,6 +274,7 @@ class DistributedJobMaster:
             command=command,
             master_addr=master_addr,
             namespace=namespace_name,
+            owner_uid=os.environ.get("DLROVER_JOB_UID", ""),
         )
         watcher = PodWatcher(job_name, namespace_name)
         master = cls(
@@ -281,6 +282,7 @@ class DistributedJobMaster:
             watcher=watcher,
             port=namespace.port,
             num_workers=namespace.num_workers,
+            max_workers=getattr(namespace, "max_workers", 0),
             node_unit=namespace.node_unit,
             service_type=namespace.service_type,
             job_name=job_name,

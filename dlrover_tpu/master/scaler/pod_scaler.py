@@ -34,6 +34,7 @@ class PodScaler(Scaler):
         hosts_per_slice: int = 1,
         env: Optional[Dict[str, str]] = None,
         reconcile_interval: float = 15.0,
+        owner_uid: str = "",
     ):
         super().__init__(job_name)
         self._client = k8sClient.singleton(namespace)
@@ -45,6 +46,7 @@ class PodScaler(Scaler):
         self._tpu_topology = tpu_topology
         self._hosts_per_slice = max(1, hosts_per_slice)
         self._env = env or {}
+        self._owner_uid = owner_uid
         self._target = 0
         # Ids deleted by a plan and not re-launched since: _reconcile must
         # not resurrect them (a remove-only plan keeps worker_num
@@ -128,6 +130,7 @@ class PodScaler(Scaler):
             tpu_topology=self._tpu_topology,
             slice_index=node_rank // self._hosts_per_slice,
             env=self._env,
+            owner_uid=self._owner_uid,
         )
         if self._client.create_pod(pod):
             logger.info("created worker pod %s", pod_name(pod))

@@ -1,0 +1,1 @@
+from .controller import ElasticJobController, build_master_pod  # noqa: F401

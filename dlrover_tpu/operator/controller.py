@@ -1,0 +1,286 @@
+"""ElasticJob operator: CR → master pod.
+
+Reference: the Go operator (``go/elasticjob/pkg/controllers/
+elasticjob_controller.go`` + ``master.go``) reconciles ElasticJob CRs by
+launching ONLY the job-master pod; the master then creates and scales
+the worker pods itself (the L1 split in SURVEY §2.14). This is the same
+controller written in Python (no Go toolchain in this build), running
+against the dict-manifest k8s layer so the reconcile logic is fully
+testable with a fake client (the reference tests the Go version with
+controller-runtime envtest; see tests/test_operator.py).
+
+Run in-cluster: ``python -m dlrover_tpu.operator.main``.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..common.constants import NodeEnv
+from ..common.log import logger
+from ..scheduler.kubernetes import (
+    CRD_GROUP,
+    CRD_VERSION,
+    ELASTIC_JOB_LABEL,
+    ELASTICJOB_PLURAL,
+    REPLICA_TYPE_LABEL,
+    k8sClient,
+    pod_name,
+    pod_phase,
+)
+
+MASTER_SERVICE_PORT = 50001
+
+
+class JobPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUSPENDED = "Suspended"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+def master_pod_name(job_name: str) -> str:
+    return f"{job_name}-master"
+
+
+def build_master_pod(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
+    """Master pod manifest from an ElasticJob CR (reference
+    pkg/controllers/master.go + pkg/common/resource.go)."""
+    meta = cr.get("metadata", {})
+    spec = cr.get("spec", {})
+    job_name = meta.get("name", "job")
+    worker_spec = (spec.get("replicaSpecs") or {}).get("worker") or {}
+    replicas = int(worker_spec.get("replicas", 1))
+    max_replicas = int(worker_spec.get("maxReplicas", replicas))
+    command = [
+        "python",
+        "-m",
+        "dlrover_tpu.master.main",
+        "--platform",
+        "k8s",
+        "--job_name",
+        job_name,
+        "--num_workers",
+        str(replicas),
+        "--max_workers",
+        str(max_replicas),
+        "--node_unit",
+        str(spec.get("nodeUnit", 1)),
+        "--port",
+        str(MASTER_SERVICE_PORT),
+    ]
+    env = [
+        {"name": "POD_NAMESPACE", "value": namespace},
+        {"name": NodeEnv.JOB_NAME, "value": job_name},
+        {"name": "DLROVER_JOB_UID", "value": meta.get("uid", "")},
+        {
+            "name": "DLROVER_MASTER_SERVICE_ADDR",
+            "value": f"{master_pod_name(job_name)}.{namespace}.svc:"
+            f"{MASTER_SERVICE_PORT}",
+        },
+        {"name": "DLROVER_WORKER_IMAGE", "value": spec.get("workerImage", "")},
+        {
+            "name": "DLROVER_WORKER_COMMAND",
+            "value": " ".join(spec.get("workerCommand") or []),
+        },
+    ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": master_pod_name(job_name),
+            "namespace": namespace,
+            "labels": {
+                ELASTIC_JOB_LABEL: job_name,
+                REPLICA_TYPE_LABEL: "master",
+            },
+            "ownerReferences": [
+                {
+                    "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+                    "kind": "ElasticJob",
+                    "name": job_name,
+                    "uid": meta.get("uid", ""),
+                    "controller": True,
+                    "blockOwnerDeletion": True,
+                }
+            ],
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "master",
+                    "image": spec.get("masterImage")
+                    or spec.get("workerImage", ""),
+                    "command": command,
+                    "env": env,
+                    "ports": [{"containerPort": MASTER_SERVICE_PORT}],
+                }
+            ],
+            # Never: a master that exits nonzero means the JOB failed —
+            # kubelet restarts under OnFailure would keep the pod phase
+            # Running forever and re-run a fatally failed job. Transient
+            # master crashes are covered by the operator recreating the
+            # pod on the next reconcile when the CR is still live.
+            "restartPolicy": "Never",
+        },
+    }
+
+
+def build_master_service(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
+    """Stable DNS for the master (reference: the Go operator creates the
+    master Service alongside the pod, pkg/controllers/master.go) — the
+    '<name>.<ns>.svc' address handed to workers only resolves for a
+    Service object, never for a bare pod."""
+    meta = cr.get("metadata", {})
+    job_name = meta.get("name", "job")
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": master_pod_name(job_name),
+            "namespace": namespace,
+            "labels": {ELASTIC_JOB_LABEL: job_name},
+            "ownerReferences": [
+                {
+                    "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+                    "kind": "ElasticJob",
+                    "name": job_name,
+                    "uid": meta.get("uid", ""),
+                    "controller": True,
+                    "blockOwnerDeletion": True,
+                }
+            ],
+        },
+        "spec": {
+            "selector": {
+                ELASTIC_JOB_LABEL: job_name,
+                REPLICA_TYPE_LABEL: "master",
+            },
+            "ports": [
+                {"port": MASTER_SERVICE_PORT, "targetPort": MASTER_SERVICE_PORT}
+            ],
+        },
+    }
+
+
+class ElasticJobController:
+    """Level-triggered reconciler over ElasticJob CRs."""
+
+    def __init__(self, namespace: str = "default", resync_s: float = 30.0):
+        self._client = k8sClient.singleton(namespace)
+        self._namespace = namespace
+        self._resync = resync_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- reconcile ---------------------------------------------------------
+
+    def reconcile(self, cr: Dict[str, Any]) -> None:
+        """Converge one CR: ensure/remove the master pod, mirror status.
+
+        The operator's only child is the MASTER pod (reference L1
+        split); workers belong to the master. Suspension is the
+        master's job too (it watches spec.suspend via ElasticJobWatcher)
+        — the operator keeps the master alive so it can orchestrate the
+        teardown and later resume.
+        """
+        meta = cr.get("metadata", {})
+        job_name = meta.get("name")
+        if not job_name:
+            return
+        if meta.get("deletionTimestamp"):
+            self._delete_children(job_name)
+            return
+        if self._client.get_service(master_pod_name(job_name)) is None:
+            self._client.create_service(
+                build_master_service(cr, self._namespace)
+            )
+        pod = self._client.get_pod(master_pod_name(job_name))
+        if pod is None:
+            manifest = build_master_pod(cr, self._namespace)
+            if self._client.create_pod(manifest):
+                logger.info("created master pod for job %s", job_name)
+            self._set_status(
+                cr,
+                phase=JobPhase.PENDING,
+                master_pod=master_pod_name(job_name),
+            )
+            return
+        phase = pod_phase(pod)
+        suspend = bool((cr.get("spec") or {}).get("suspend", False))
+        if phase == "Succeeded":
+            status_phase = JobPhase.SUCCEEDED
+        elif phase == "Failed":
+            status_phase = JobPhase.FAILED
+        elif suspend:
+            status_phase = JobPhase.SUSPENDED
+        elif phase == "Running":
+            status_phase = JobPhase.RUNNING
+        else:
+            status_phase = JobPhase.PENDING
+        self._set_status(cr, phase=status_phase, master_pod=pod_name(pod))
+
+    def reconcile_all(self) -> None:
+        for cr in self._client.list_custom_objects(
+            CRD_GROUP, CRD_VERSION, ELASTICJOB_PLURAL
+        ):
+            try:
+                self.reconcile(cr)
+            except Exception:
+                logger.exception(
+                    "reconcile failed for %s",
+                    cr.get("metadata", {}).get("name"),
+                )
+
+    def _delete_children(self, job_name: str) -> None:
+        self._client.delete_service(master_pod_name(job_name))
+        self._client.delete_pod(master_pod_name(job_name))
+        for pod in self._client.list_pods(f"{ELASTIC_JOB_LABEL}={job_name}"):
+            self._client.delete_pod(pod_name(pod))
+        logger.info("deleted pods of job %s", job_name)
+
+    def _set_status(self, cr: Dict[str, Any], phase: str, master_pod: str) -> None:
+        # Compare against the CR we already hold (watch/list items carry
+        # .status) — no extra apiserver GET per reconcile.
+        if (cr.get("status") or {}).get("phase") == phase:
+            return  # no-op updates keep resourceVersion churn down
+        self._client.update_custom_object_status(
+            CRD_GROUP,
+            CRD_VERSION,
+            ELASTICJOB_PLURAL,
+            cr.get("metadata", {}).get("name", ""),
+            {"phase": phase, "masterPod": master_pod},
+        )
+
+    # -- watch loop --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="elasticjob-operator", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                self.reconcile_all()
+                for raw in self._client.watch_custom_objects(
+                    CRD_GROUP,
+                    CRD_VERSION,
+                    ELASTICJOB_PLURAL,
+                    timeout_s=int(self._resync),
+                ):
+                    if self._stopped.is_set():
+                        return
+                    obj = raw.get("object") or {}
+                    if raw.get("type") == "DELETED":
+                        meta = dict(obj.get("metadata", {}))
+                        meta.setdefault("deletionTimestamp", "now")
+                        obj = dict(obj, metadata=meta)
+                    self.reconcile(obj)
+            except Exception as e:
+                logger.warning("operator watch error (retrying): %s", e)
+                self._stopped.wait(2.0)
+
+    def stop(self) -> None:
+        self._stopped.set()

@@ -1,0 +1,39 @@
+"""Operator entry: ``python -m dlrover_tpu.operator.main``.
+
+Runs the ElasticJobController reconcile/watch loop in-cluster
+(reference: the Go operator binary, go/elasticjob/main.go)."""
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..common.log import logger
+from .controller import ElasticJobController
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="dlrover-tpu operator")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--resync_s", type=float, default=30.0)
+    ns = parser.parse_args(argv)
+    controller = ElasticJobController(
+        namespace=ns.namespace, resync_s=ns.resync_s
+    )
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        logger.info("operator stopping (signal %s)", signum)
+        controller.stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    controller.start()
+    logger.info("elasticjob operator running (namespace=%s)", ns.namespace)
+    stop.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,8 +94,36 @@ class k8sClient:
     def get_pod(self, name: str) -> Optional[Any]:
         try:
             return self.core.read_namespaced_pod(name, self.namespace)
-        except Exception:
-            return None
+        except Exception as e:
+            if getattr(e, "status", None) == 404:
+                return None
+            # Transient apiserver error: surface it — callers treating
+            # it as "missing" would spuriously recreate/downgrade.
+            raise
+
+    def create_service(self, service: Any) -> bool:
+        try:
+            self.core.create_namespaced_service(self.namespace, service)
+            return True
+        except Exception as e:
+            logger.error("create service failed: %s", e)
+            return False
+
+    def get_service(self, name: str) -> Optional[Any]:
+        try:
+            return self.core.read_namespaced_service(name, self.namespace)
+        except Exception as e:
+            if getattr(e, "status", None) == 404:
+                return None
+            raise
+
+    def delete_service(self, name: str) -> bool:
+        try:
+            self.core.delete_namespaced_service(name, self.namespace)
+            return True
+        except Exception as e:
+            logger.warning("delete service %s failed: %s", name, e)
+            return False
 
     def list_pods(self, label_selector: str) -> List[Any]:
         try:
@@ -142,6 +170,28 @@ class k8sClient:
         except Exception as e:
             logger.error("list %s failed: %s", plural, e)
             return []
+
+    def update_custom_object_status(
+        self,
+        group: str,
+        version: str,
+        plural: str,
+        name: str,
+        status: Dict[str, Any],
+    ) -> bool:
+        try:
+            self.custom.patch_namespaced_custom_object_status(
+                group,
+                version,
+                self.namespace,
+                plural,
+                name,
+                {"status": status},
+            )
+            return True
+        except Exception as e:
+            logger.warning("status update %s/%s failed: %s", plural, name, e)
+            return False
 
     def delete_custom_object(
         self, group: str, version: str, plural: str, name: str
@@ -217,6 +267,7 @@ def build_worker_pod(
     tpu_topology: str = "",
     slice_index: int = 0,
     env: Optional[Dict[str, str]] = None,
+    owner_uid: str = "",
 ) -> Dict[str, Any]:
     """Pod manifest (plain dict, accepted verbatim by the k8s API) for
     one TPU host (reference pod construction in
@@ -250,19 +301,34 @@ def build_worker_pod(
             spec["nodeSelector"] = {
                 "cloud.google.com/gke-tpu-topology": tpu_topology,
             }
+    metadata: Dict[str, Any] = {
+        "name": f"{job_name}-worker-{node_id}",
+        "namespace": namespace,
+        "labels": {
+            ELASTIC_JOB_LABEL: job_name,
+            REPLICA_TYPE_LABEL: NodeType.WORKER,
+            REPLICA_INDEX_LABEL: str(node_rank),
+            SLICE_INDEX_LABEL: str(slice_index),
+        },
+    }
+    if owner_uid:
+        # Garbage collection: deleting the ElasticJob CR must take the
+        # workers down even if the master/operator never observes it
+        # (TPU chips must not leak behind a missed watch event).
+        metadata["ownerReferences"] = [
+            {
+                "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+                "kind": "ElasticJob",
+                "name": job_name,
+                "uid": owner_uid,
+                "controller": False,
+                "blockOwnerDeletion": False,
+            }
+        ]
     return {
         "apiVersion": "v1",
         "kind": "Pod",
-        "metadata": {
-            "name": f"{job_name}-worker-{node_id}",
-            "namespace": namespace,
-            "labels": {
-                ELASTIC_JOB_LABEL: job_name,
-                REPLICA_TYPE_LABEL: NodeType.WORKER,
-                REPLICA_INDEX_LABEL: str(node_rank),
-                SLICE_INDEX_LABEL: str(slice_index),
-            },
-        },
+        "metadata": metadata,
         "spec": spec,
     }
 

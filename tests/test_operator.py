@@ -1,0 +1,189 @@
+"""ElasticJob operator reconcile tests with a fake client (reference:
+controller-runtime envtest suites, go/elasticjob/pkg/controllers/
+elasticjob_controller_test.go — here the reconciler is Python, so a
+fake client covers the same create-master-pod-from-CR behavior)."""
+
+import pytest
+
+from dlrover_tpu.operator.controller import (
+    ElasticJobController,
+    JobPhase,
+    build_master_pod,
+    build_master_service,
+    master_pod_name,
+)
+from dlrover_tpu.scheduler.kubernetes import (
+    CRD_GROUP,
+    ELASTIC_JOB_LABEL,
+    ELASTICJOB_PLURAL,
+    pod_name,
+)
+
+
+def _cr(name="gpt", replicas=4, **spec_overrides):
+    spec = {
+        "distributionStrategy": "spmd",
+        "nodeUnit": 2,
+        "masterImage": "dlrover-tpu:latest",
+        "workerImage": "dlrover-tpu:latest",
+        "workerCommand": ["python", "-m", "train"],
+        "replicaSpecs": {
+            "worker": {"replicas": replicas, "maxReplicas": 8, "tpuChips": 4}
+        },
+    }
+    spec.update(spec_overrides)
+    return {
+        "metadata": {"name": name, "uid": "uid-1"},
+        "spec": spec,
+    }
+
+
+class FakeClient:
+    def __init__(self):
+        self.pods = {}
+        self.services = {}
+        self.custom = {ELASTICJOB_PLURAL: {}}
+        self.statuses = {}
+
+    def create_service(self, svc):
+        self.services[svc["metadata"]["name"]] = svc
+        return True
+
+    def get_service(self, name):
+        return self.services.get(name)
+
+    def delete_service(self, name):
+        self.services.pop(name, None)
+        return True
+
+    def create_pod(self, pod):
+        self.pods[pod_name(pod)] = pod
+        return True
+
+    def delete_pod(self, name):
+        self.pods.pop(name, None)
+        return True
+
+    def get_pod(self, name):
+        return self.pods.get(name)
+
+    def list_pods(self, label_selector):
+        key, _, val = label_selector.partition("=")
+        return [
+            p
+            for p in self.pods.values()
+            if p["metadata"]["labels"].get(key) == val
+        ]
+
+    def list_custom_objects(self, group, version, plural, label_selector=""):
+        return list(self.custom.get(plural, {}).values())
+
+    def get_custom_object(self, group, version, plural, name):
+        obj = self.custom.get(plural, {}).get(name)
+        if obj is not None and name in self.statuses:
+            obj = dict(obj, status=self.statuses[name])
+        return obj
+
+    def update_custom_object_status(self, group, version, plural, name, status):
+        self.statuses[name] = status
+        return True
+
+    def watch_custom_objects(self, *a, **k):
+        return iter(())
+
+
+@pytest.fixture()
+def controller(monkeypatch):
+    client = FakeClient()
+    import dlrover_tpu.operator.controller as mod
+
+    monkeypatch.setattr(
+        mod.k8sClient, "singleton", staticmethod(lambda ns="default": client)
+    )
+    ctl = ElasticJobController(namespace="ns1")
+    return ctl, client
+
+
+class TestMasterPodManifest:
+    def test_shape(self):
+        pod = build_master_pod(_cr(), "ns1")
+        assert pod["metadata"]["name"] == "gpt-master"
+        assert pod["metadata"]["labels"][ELASTIC_JOB_LABEL] == "gpt"
+        owner = pod["metadata"]["ownerReferences"][0]
+        assert owner["kind"] == "ElasticJob"
+        assert owner["name"] == "gpt"
+        assert CRD_GROUP in owner["apiVersion"]
+        container = pod["spec"]["containers"][0]
+        assert "--num_workers" in container["command"]
+        idx = container["command"].index("--num_workers")
+        assert container["command"][idx + 1] == "4"
+        idx = container["command"].index("--max_workers")
+        assert container["command"][idx + 1] == "8"
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["DLROVER_WORKER_IMAGE"] == "dlrover-tpu:latest"
+        assert env["DLROVER_WORKER_COMMAND"] == "python -m train"
+
+
+class TestReconcile:
+    def test_creates_master_pod_from_cr(self, controller):
+        ctl, client = controller
+        cr = _cr()
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile_all()
+        assert master_pod_name("gpt") in client.pods
+        # workers resolve the master through a Service, not a bare pod
+        assert master_pod_name("gpt") in client.services
+        assert client.statuses["gpt"]["phase"] == JobPhase.PENDING
+        assert client.pods["gpt-master"]["spec"]["restartPolicy"] == "Never"
+
+    def test_idempotent_and_status_follows_pod(self, controller):
+        ctl, client = controller
+        cr = _cr()
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile(cr)
+        ctl.reconcile(cr)
+        assert len(client.pods) == 1
+        client.pods["gpt-master"]["status"] = {"phase": "Running"}
+        ctl.reconcile(dict(cr, status=client.statuses.get("gpt", {})))
+        assert client.statuses["gpt"]["phase"] == JobPhase.RUNNING
+        client.pods["gpt-master"]["status"] = {"phase": "Succeeded"}
+        ctl.reconcile(dict(cr, status=client.statuses.get("gpt", {})))
+        assert client.statuses["gpt"]["phase"] == JobPhase.SUCCEEDED
+
+    def test_suspend_keeps_master_and_reports(self, controller):
+        ctl, client = controller
+        cr = _cr(suspend=True)
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile(cr)
+        client.pods["gpt-master"]["status"] = {"phase": "Running"}
+        ctl.reconcile(cr)
+        # the master stays (it orchestrates worker teardown + resume)
+        assert "gpt-master" in client.pods
+        assert client.statuses["gpt"]["phase"] == JobPhase.SUSPENDED
+
+    def test_deletion_removes_master_and_workers(self, controller):
+        ctl, client = controller
+        cr = _cr()
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile(cr)
+        # master created a worker pod meanwhile
+        client.pods["gpt-worker-0"] = {
+            "metadata": {
+                "name": "gpt-worker-0",
+                "labels": {ELASTIC_JOB_LABEL: "gpt"},
+            }
+        }
+        deleted = dict(cr, metadata=dict(cr["metadata"], deletionTimestamp="t"))
+        ctl.reconcile(deleted)
+        assert "gpt-master" not in client.pods
+        assert "gpt-worker-0" not in client.pods
+        assert "gpt-master" not in client.services
+
+    def test_failed_master_reported(self, controller):
+        ctl, client = controller
+        cr = _cr()
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile(cr)
+        client.pods["gpt-master"]["status"] = {"phase": "Failed"}
+        ctl.reconcile(cr)
+        assert client.statuses["gpt"]["phase"] == JobPhase.FAILED

@@ -267,7 +267,9 @@ class DistributedJobMaster:
         namespace_name = os.environ.get("POD_NAMESPACE", "default")
         master_addr = os.environ.get("DLROVER_MASTER_SERVICE_ADDR", "")
         image = os.environ.get("DLROVER_WORKER_IMAGE", "")
-        command = os.environ.get("DLROVER_WORKER_COMMAND", "").split()
+        import shlex
+
+        command = shlex.split(os.environ.get("DLROVER_WORKER_COMMAND", ""))
         scaler = PodScaler(
             job_name=job_name,
             image=image,

@@ -12,8 +12,9 @@ controller-runtime envtest; see tests/test_operator.py).
 Run in-cluster: ``python -m dlrover_tpu.operator.main``.
 """
 
+import shlex
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from ..common.constants import NodeEnv
 from ..common.log import logger
@@ -24,6 +25,7 @@ from ..scheduler.kubernetes import (
     ELASTICJOB_PLURAL,
     REPLICA_TYPE_LABEL,
     k8sClient,
+    owner_reference,
     pod_name,
     pod_phase,
 )
@@ -80,8 +82,9 @@ def build_master_pod(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
         },
         {"name": "DLROVER_WORKER_IMAGE", "value": spec.get("workerImage", "")},
         {
+            # shlex round-trip: argv elements may contain spaces
             "name": "DLROVER_WORKER_COMMAND",
-            "value": " ".join(spec.get("workerCommand") or []),
+            "value": shlex.join(spec.get("workerCommand") or []),
         },
     ]
     return {
@@ -95,14 +98,7 @@ def build_master_pod(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
                 REPLICA_TYPE_LABEL: "master",
             },
             "ownerReferences": [
-                {
-                    "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
-                    "kind": "ElasticJob",
-                    "name": job_name,
-                    "uid": meta.get("uid", ""),
-                    "controller": True,
-                    "blockOwnerDeletion": True,
-                }
+                owner_reference(job_name, meta.get("uid", ""), controller=True)
             ],
         },
         "spec": {
@@ -119,8 +115,8 @@ def build_master_pod(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
             # Never: a master that exits nonzero means the JOB failed —
             # kubelet restarts under OnFailure would keep the pod phase
             # Running forever and re-run a fatally failed job. Transient
-            # master crashes are covered by the operator recreating the
-            # pod on the next reconcile when the CR is still live.
+            # master crashes (eviction, OOM) are retried by the
+            # operator's master-restart budget in reconcile().
             "restartPolicy": "Never",
         },
     }
@@ -141,14 +137,7 @@ def build_master_service(cr: Dict[str, Any], namespace: str) -> Dict[str, Any]:
             "namespace": namespace,
             "labels": {ELASTIC_JOB_LABEL: job_name},
             "ownerReferences": [
-                {
-                    "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
-                    "kind": "ElasticJob",
-                    "name": job_name,
-                    "uid": meta.get("uid", ""),
-                    "controller": True,
-                    "blockOwnerDeletion": True,
-                }
+                owner_reference(job_name, meta.get("uid", ""), controller=True)
             ],
         },
         "spec": {
@@ -191,12 +180,18 @@ class ElasticJobController:
         if meta.get("deletionTimestamp"):
             self._delete_children(job_name)
             return
-        if self._client.get_service(master_pod_name(job_name)) is None:
-            self._client.create_service(
-                build_master_service(cr, self._namespace)
-            )
+        status = cr.get("status") or {}
+        if status.get("phase") in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+            # Terminal: a GC'd master pod must NOT resurrect the job.
+            return
         pod = self._client.get_pod(master_pod_name(job_name))
         if pod is None:
+            # Service creation only needs checking alongside pod
+            # creation — steady state skips both apiserver calls.
+            if self._client.get_service(master_pod_name(job_name)) is None:
+                self._client.create_service(
+                    build_master_service(cr, self._namespace)
+                )
             manifest = build_master_pod(cr, self._namespace)
             if self._client.create_pod(manifest):
                 logger.info("created master pod for job %s", job_name)
@@ -208,10 +203,38 @@ class ElasticJobController:
             return
         phase = pod_phase(pod)
         suspend = bool((cr.get("spec") or {}).get("suspend", False))
-        if phase == "Succeeded":
-            status_phase = JobPhase.SUCCEEDED
-        elif phase == "Failed":
+        if phase == "Failed":
+            # Transient master crash (eviction/OOM): retry under the
+            # budget before declaring the job failed. A master that
+            # exits nonzero because the JOB failed usually patched its
+            # own terminal state first; this path covers kills.
+            restarts = int(status.get("masterRestarts", 0))
+            budget = int(
+                (cr.get("spec") or {}).get("masterRestartCount", 3)
+            )
+            if restarts < budget:
+                logger.warning(
+                    "master pod of %s failed; restart %s/%s",
+                    job_name,
+                    restarts + 1,
+                    budget,
+                )
+                self._client.delete_pod(master_pod_name(job_name))
+                self._client.update_custom_object_status(
+                    CRD_GROUP,
+                    CRD_VERSION,
+                    ELASTICJOB_PLURAL,
+                    job_name,
+                    {
+                        "phase": JobPhase.PENDING,
+                        "masterPod": master_pod_name(job_name),
+                        "masterRestarts": restarts + 1,
+                    },
+                )
+                return
             status_phase = JobPhase.FAILED
+        elif phase == "Succeeded":
+            status_phase = JobPhase.SUCCEEDED
         elif suspend:
             status_phase = JobPhase.SUSPENDED
         elif phase == "Running":

@@ -225,6 +225,21 @@ class k8sClient:
         )
 
 
+def owner_reference(
+    job_name: str, uid: str, controller: bool = False
+) -> Dict[str, Any]:
+    """ownerReference block pointing at the ElasticJob CR (one shared
+    definition for master/service/worker builders)."""
+    return {
+        "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
+        "kind": "ElasticJob",
+        "name": job_name,
+        "uid": uid,
+        "controller": controller,
+        "blockOwnerDeletion": controller,
+    }
+
+
 def pod_name(pod: Any) -> str:
     """Name of a pod in either representation (dict manifest or k8s
     client object) — the transport layer may hand back either."""
@@ -315,16 +330,7 @@ def build_worker_pod(
         # Garbage collection: deleting the ElasticJob CR must take the
         # workers down even if the master/operator never observes it
         # (TPU chips must not leak behind a missed watch event).
-        metadata["ownerReferences"] = [
-            {
-                "apiVersion": f"{CRD_GROUP}/{CRD_VERSION}",
-                "kind": "ElasticJob",
-                "name": job_name,
-                "uid": owner_uid,
-                "controller": False,
-                "blockOwnerDeletion": False,
-            }
-        ]
+        metadata["ownerReferences"] = [owner_reference(job_name, owner_uid)]
     return {
         "apiVersion": "v1",
         "kind": "Pod",

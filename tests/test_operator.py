@@ -179,11 +179,47 @@ class TestReconcile:
         assert "gpt-worker-0" not in client.pods
         assert "gpt-master" not in client.services
 
-    def test_failed_master_reported(self, controller):
+    def test_failed_master_retried_then_reported(self, controller):
+        ctl, client = controller
+        cr = _cr(masterRestartCount=1)
+        client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
+        ctl.reconcile(cr)
+        # transient crash: pod deleted + budget consumed, job stays live
+        client.pods["gpt-master"]["status"] = {"phase": "Failed"}
+        ctl.reconcile(cr)
+        assert "gpt-master" not in client.pods
+        assert client.statuses["gpt"]["masterRestarts"] == 1
+        # operator recreates it on the next pass
+        cr_live = dict(cr, status=client.statuses["gpt"])
+        ctl.reconcile(cr_live)
+        assert "gpt-master" in client.pods
+        # second crash exhausts the budget -> FAILED
+        client.pods["gpt-master"]["status"] = {"phase": "Failed"}
+        ctl.reconcile(dict(cr, status=client.statuses["gpt"]))
+        assert client.statuses["gpt"]["phase"] == JobPhase.FAILED
+
+    def test_terminal_job_not_resurrected(self, controller):
         ctl, client = controller
         cr = _cr()
         client.custom[ELASTICJOB_PLURAL]["gpt"] = cr
         ctl.reconcile(cr)
-        client.pods["gpt-master"]["status"] = {"phase": "Failed"}
+        client.pods["gpt-master"]["status"] = {"phase": "Succeeded"}
         ctl.reconcile(cr)
-        assert client.statuses["gpt"]["phase"] == JobPhase.FAILED
+        assert client.statuses["gpt"]["phase"] == JobPhase.SUCCEEDED
+        # kubelet GC removes the terminated pod later
+        del client.pods["gpt-master"]
+        ctl.reconcile(dict(cr, status=client.statuses["gpt"]))
+        assert "gpt-master" not in client.pods, "finished job re-ran!"
+
+    def test_worker_command_shlex_roundtrip(self):
+        import shlex
+
+        cr = _cr(workerCommand=["python", "train.py", "--name", "my run"])
+        pod = build_master_pod(cr, "ns1")
+        env = {
+            e["name"]: e["value"]
+            for e in pod["spec"]["containers"][0]["env"]
+        }
+        assert shlex.split(env["DLROVER_WORKER_COMMAND"]) == [
+            "python", "train.py", "--name", "my run",
+        ]

@@ -259,29 +259,13 @@ class WorkerProcess:
         if self._proc is None or self._proc.poll() is not None:
             self._close_log()
             return
-        pgid = None
         try:
             pgid = os.getpgid(self._proc.pid)
-            os.killpg(pgid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            pass
-        deadline = time.time() + self.spec.kill_grace_s
-        while time.time() < deadline:
-            if self._proc.poll() is not None:
-                break
-            time.sleep(0.1)
-        if self._proc.poll() is None:
-            logger.warning(
-                "worker pid=%s ignored SIGTERM, killing", self._proc.pid
-            )
-            try:
-                if pgid is not None:
-                    os.killpg(pgid, signal.SIGKILL)
-                else:
-                    self._proc.kill()
-            except (ProcessLookupError, PermissionError):
-                pass
-        self._proc.wait()
+            pgid = None
+        from ..common.proc import kill_process_group
+
+        kill_process_group(self._proc, self.spec.kill_grace_s)
         self._reap_orphans(pgid)
         self._close_log()
         try:

@@ -1,0 +1,73 @@
+"""Shared process-group helpers (one SIGTERM→SIGKILL shutdown for the
+agent worker, the process scaler, and unified role workers)."""
+
+import os
+import signal
+import subprocess
+import time
+from typing import Optional
+
+from .log import logger
+
+
+def kill_process_group(
+    proc: subprocess.Popen, grace_s: float = 5.0
+) -> None:
+    """SIGTERM the process group, escalate to SIGKILL after ``grace_s``,
+    and reap. Safe on already-dead processes."""
+    if proc.poll() is not None:
+        return
+    pgid: Optional[int] = None
+    try:
+        pgid = os.getpgid(proc.pid)
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+    try:
+        proc.wait(timeout=grace_s)
+    except subprocess.TimeoutExpired:
+        logger.warning("pid=%s ignored SIGTERM; killing group", proc.pid)
+        try:
+            if pgid is not None:
+                os.killpg(pgid, signal.SIGKILL)
+            else:
+                proc.kill()
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+
+
+def proc_start_ticks(pid: int) -> Optional[int]:
+    """Kernel start time of ``pid`` (pid-reuse guard); None when gone
+    or when the process is a zombie (dead, awaiting reaping)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        rest = stat[stat.rindex(b")") + 2 :].split()
+        if rest[0] == b"Z":
+            return None
+        return int(rest[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def kill_pid_if_same_incarnation(pid: int, start_ticks: int) -> bool:
+    """SIGKILL the group of ``pid`` only when its kernel start time
+    still matches (never kills a recycled pid). True if signaled."""
+    current = proc_start_ticks(pid)
+    if current is None or (start_ticks and current != start_ticks):
+        return False
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return False
+    deadline = time.time() + 10
+    while time.time() < deadline and proc_start_ticks(pid) == start_ticks:
+        time.sleep(0.1)
+    return True

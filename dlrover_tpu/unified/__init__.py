@@ -1,0 +1,18 @@
+"""Unified multi-role control plane (RL orchestration).
+
+TPU-native counterpart of ``dlrover/python/unified`` (~9.3k LoC): a
+second-generation control plane that places and supervises MULTIPLE
+roles (trainer / rollout / reward / ...) of one job, with failover
+lineage and master self-recovery. The reference builds on Ray actors;
+this build has no Ray, so roles are supervised OS processes placed on
+host slots — the same control-plane semantics (PrimeMaster → manager →
+role workers) over the process/scheduler substrate the elastic runtime
+already uses.
+"""
+
+from .api import DLJob, DLJobBuilder, RLJobBuilder  # noqa: F401
+from .graph import DLExecutionGraph, RoleVertex  # noqa: F401
+from .manager import PrimeManager  # noqa: F401
+from .master import PrimeMaster  # noqa: F401
+from .scheduler import Placement, place  # noqa: F401
+from .state import FileStateBackend, MemoryStateBackend  # noqa: F401

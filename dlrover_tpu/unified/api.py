@@ -1,0 +1,167 @@
+"""Job builder API for multi-role (RL) jobs.
+
+Reference: ``unified/api/builder/base.py`` (``DLJob:53``,
+``DLJobBuilder``, collocation groups :55-79) and ``rl.py``
+(``RLJobBuilder:43`` with the trainer/actor/rollout/reference/reward/
+critic role methods :66-137). Declarative: the builder validates the
+role topology; ``submit()`` hands the job to a PrimeMaster.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclass
+class RoleSpec:
+    """One workload role (reference workload_desc.py)."""
+
+    name: str
+    command: List[str] = field(default_factory=list)
+    num_instances: int = 1
+    # Fraction of one host's accelerator a single instance needs;
+    # instances of collocated roles share a host when fractions fit.
+    device_per_instance: float = 1.0
+    env: Dict[str, str] = field(default_factory=dict)
+    # Restarting this role forces a restart of these dependents (e.g. a
+    # rollout restart invalidates in-flight trajectories for the
+    # trainer): failover lineage, reference manager.py:222.
+    restart_dependents: List[str] = field(default_factory=list)
+    max_restarts: int = 3
+    # Backed by the full elastic runtime (own job master + agents)
+    # instead of a bare supervised process.
+    elastic: bool = False
+
+
+@dataclass
+class DLJob:
+    """Validated multi-role job description (reference base.py:53)."""
+
+    name: str = "unified_job"
+    roles: Dict[str, RoleSpec] = field(default_factory=dict)
+    # Each group's roles are packed onto the same hosts (reference
+    # collocation, base.py:55-79 — e.g. actor+rollout share chips).
+    collocations: List[List[str]] = field(default_factory=list)
+    num_nodes: int = 1
+    devices_per_node: float = 1.0
+
+    def submit(self, **master_kwargs):
+        from .master import PrimeMaster
+
+        master = PrimeMaster(self, **master_kwargs)
+        master.start()
+        return master
+
+
+class DLJobBuilder:
+    """Fluent builder (reference DLJobBuilder)."""
+
+    def __init__(self, name: str = "unified_job"):
+        self._job = DLJob(name=name)
+
+    def node_num(self, n: int) -> "DLJobBuilder":
+        self._job.num_nodes = int(n)
+        return self
+
+    def device_per_node(self, n: float) -> "DLJobBuilder":
+        self._job.devices_per_node = float(n)
+        return self
+
+    def role(
+        self,
+        name: str,
+        command: Sequence[str],
+        num: int = 1,
+        device: float = 1.0,
+        env: Optional[Dict[str, str]] = None,
+        restart_dependents: Optional[Sequence[str]] = None,
+        max_restarts: int = 3,
+        elastic: bool = False,
+    ) -> "DLJobBuilder":
+        if name in self._job.roles:
+            raise ValueError(f"role {name!r} declared twice")
+        if int(num) < 1:
+            raise ValueError(f"role {name!r} needs num >= 1, got {num}")
+        if float(device) < 0:
+            raise ValueError(f"role {name!r} has negative device fraction")
+        self._job.roles[name] = RoleSpec(
+            name=name,
+            command=list(command),
+            num_instances=int(num),
+            device_per_instance=float(device),
+            env=dict(env or {}),
+            restart_dependents=list(restart_dependents or []),
+            max_restarts=max_restarts,
+            elastic=elastic,
+        )
+        return self
+
+    def with_collocation(self, *role_names: str) -> "DLJobBuilder":
+        if len(role_names) < 2:
+            raise ValueError("collocation needs at least two roles")
+        self._job.collocations.append(list(role_names))
+        return self
+
+    def build(self) -> DLJob:
+        if not self._job.roles:
+            raise ValueError("a job needs at least one role")
+        grouped = set()
+        for group in self._job.collocations:
+            for name in group:
+                if name not in self._job.roles:
+                    raise ValueError(
+                        f"collocation references unknown role {name!r}"
+                    )
+                if name in grouped:
+                    raise ValueError(
+                        f"role {name!r} appears in more than one "
+                        "collocation group"
+                    )
+                grouped.add(name)
+        for spec in self._job.roles.values():
+            for dep in spec.restart_dependents:
+                if dep not in self._job.roles:
+                    raise ValueError(
+                        f"role {spec.name!r} lists unknown dependent {dep!r}"
+                    )
+            if not spec.command and not spec.elastic:
+                raise ValueError(f"role {spec.name!r} has no command")
+        return self._job
+
+
+class RLJobBuilder(DLJobBuilder):
+    """RL role vocabulary (reference rl.py:43,66-137): trainer, actor,
+    rollout, reference, reward, critic — each a role with its own
+    instance count and device fraction."""
+
+    TRAINER = "trainer"
+    ACTOR = "actor"
+    ROLLOUT = "rollout"
+    REFERENCE = "reference"
+    REWARD = "reward"
+    CRITIC = "critic"
+
+    def trainer(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        return self.role(self.TRAINER, command, num=num, device=device, **kw)
+
+    def actor(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        return self.role(self.ACTOR, command, num=num, device=device, **kw)
+
+    def rollout(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        # fresh rollouts are useless to a dead trainer and vice versa:
+        # default lineage couples them (overridable via kw)
+        kw.setdefault("restart_dependents", [self.TRAINER])
+        return self.role(self.ROLLOUT, command, num=num, device=device, **kw)
+
+    def reference(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        return self.role(self.REFERENCE, command, num=num, device=device, **kw)
+
+    def reward(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        return self.role(self.REWARD, command, num=num, device=device, **kw)
+
+    def critic(self, command, num=1, device=1.0, **kw) -> "RLJobBuilder":
+        return self.role(self.CRITIC, command, num=num, device=device, **kw)
+
+    def build(self) -> DLJob:
+        if self.TRAINER not in self._job.roles:
+            raise ValueError("an RL job needs a trainer role")
+        return super().build()

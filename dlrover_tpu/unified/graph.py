@@ -1,0 +1,74 @@
+"""Execution graph: role specs → placed vertices.
+
+Reference: ``unified/controller/schedule/graph.py`` (``DLExecutionGraph``
+with one vertex per role instance). A vertex is the unit of placement,
+supervision, and failover.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .api import DLJob, RoleSpec
+
+
+class VertexState:
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+@dataclass
+class RoleVertex:
+    role: str
+    index: int  # instance index within the role
+    device: float = 1.0
+    node: Optional[int] = None  # host slot assigned by the scheduler
+    state: str = VertexState.PENDING
+    restart_count: int = 0
+
+    @property
+    def vertex_id(self) -> str:
+        return f"{self.role}-{self.index}"
+
+
+@dataclass
+class DLExecutionGraph:
+    job: DLJob
+    vertices: Dict[str, RoleVertex] = field(default_factory=dict)
+
+    @classmethod
+    def from_job(cls, job: DLJob) -> "DLExecutionGraph":
+        graph = cls(job=job)
+        for spec in job.roles.values():
+            for index in range(spec.num_instances):
+                vertex = RoleVertex(
+                    role=spec.name,
+                    index=index,
+                    device=spec.device_per_instance,
+                )
+                graph.vertices[vertex.vertex_id] = vertex
+        return graph
+
+    def role_vertices(self, role: str) -> List[RoleVertex]:
+        return sorted(
+            (v for v in self.vertices.values() if v.role == role),
+            key=lambda v: v.index,
+        )
+
+    def spec_of(self, vertex: RoleVertex) -> RoleSpec:
+        return self.job.roles[vertex.role]
+
+    def dependents_of(self, role: str) -> List[str]:
+        """Transitive restart lineage of ``role`` (reference
+        deal_with_actor_restarting, manager.py:222)."""
+        seen: List[str] = []
+        frontier = list(self.job.roles[role].restart_dependents)
+        while frontier:
+            name = frontier.pop()
+            if name in seen or name == role:
+                continue
+            seen.append(name)
+            frontier.extend(self.job.roles[name].restart_dependents)
+        return seen

@@ -1,0 +1,271 @@
+"""PrimeManager: the unified control plane's brain.
+
+Reference: ``unified/controller/manager.py`` (``PrimeManager:63``) —
+``prepare`` builds placement + workers (:113), ``_nodes_check`` (:143),
+``_main_loop`` monitors and fails over (:175), role-restart lineage
+(``deal_with_actor_restarting`` :222), whole-job ``restart_job``
+(:330), and state save/self-recovery (:389-430).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.log import logger
+from .api import DLJob
+from .graph import DLExecutionGraph, RoleVertex, VertexState
+from .runtime import RoleWorker
+from .scheduler import Placement, place
+from .state import MemoryStateBackend, StateBackend
+
+
+class JobStatus:
+    INIT = "init"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+class PrimeManager:
+    def __init__(
+        self,
+        job: DLJob,
+        state_backend: Optional[StateBackend] = None,
+        log_dir: Optional[str] = None,
+        monitor_interval: float = 0.5,
+        max_job_restarts: int = 1,
+    ):
+        self.job = job
+        self.graph = DLExecutionGraph.from_job(job)
+        self.placement: Optional[Placement] = None
+        self.status = JobStatus.INIT
+        self._state = state_backend or MemoryStateBackend()
+        self._log_dir = log_dir
+        self._interval = monitor_interval
+        self._workers: Dict[str, RoleWorker] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._job_restarts = 0
+        self._max_job_restarts = max_job_restarts
+        self._self_recover()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def prepare(self) -> None:
+        """Placement + node check (reference :113,:143)."""
+        self.placement = place(self.graph)
+        self._nodes_check()
+        self._save_state()
+
+    def _nodes_check(self) -> None:
+        """Per-node sanity before spending role startup time (reference
+        _nodes_check runs a probe workload per node; locally the check
+        is that every slot got schedulable capacity)."""
+        used: Dict[int, float] = {}
+        for vertex in self.graph.vertices.values():
+            if vertex.node is None:
+                raise RuntimeError(f"{vertex.vertex_id} was not placed")
+            used[vertex.node] = used.get(vertex.node, 0.0) + vertex.device
+        for node, need in used.items():
+            if need > self.job.devices_per_node + 1e-9:
+                raise RuntimeError(
+                    f"node {node} oversubscribed: {need} > "
+                    f"{self.job.devices_per_node}"
+                )
+
+    def start(self) -> None:
+        if self.placement is None:
+            self.prepare()
+        for vertex in self.graph.vertices.values():
+            self._start_vertex(vertex)
+        self.status = JobStatus.RUNNING
+        self._save_state()
+        self._thread = threading.Thread(
+            target=self._main_loop, name="prime-manager", daemon=True
+        )
+        self._thread.start()
+
+    def _start_vertex(self, vertex: RoleVertex) -> None:
+        spec = self.graph.spec_of(vertex)
+        worker = RoleWorker(
+            vertex,
+            spec.command,
+            env=spec.env,
+            job_name=self.job.name,
+            role_world=spec.num_instances,
+            log_dir=self._log_dir,
+        )
+        worker.start()
+        self._workers[vertex.vertex_id] = worker
+
+    # -- supervision -------------------------------------------------------
+
+    def _main_loop(self) -> None:
+        """Reference :175 — poll vertices, drive failover/completion."""
+        while not self._stopped.wait(self._interval):
+            try:
+                self._observe()
+            except Exception:
+                logger.exception("prime manager loop error")
+            if self.status in (JobStatus.SUCCEEDED, JobStatus.FAILED):
+                return
+
+    def _observe(self) -> None:
+        if self._stopped.is_set():
+            return  # stop() is tearing workers down; don't revive them
+        for vertex_id, worker in list(self._workers.items()):
+            state = worker.poll()
+            vertex = self.graph.vertices[vertex_id]
+            if state != vertex.state:
+                vertex.state = state
+                self._save_state()
+            if state == VertexState.FAILED:
+                # One failure per poll: handling it may restart other
+                # vertices (lineage, whole-job restart), and reacting to
+                # a now-stale snapshot would double-restart fresh
+                # processes or mis-charge budgets. The next poll sees
+                # the refreshed states.
+                self._handle_vertex_failure(vertex)
+                return
+        if all(
+            v.state == VertexState.SUCCEEDED
+            for v in self.graph.vertices.values()
+        ):
+            logger.info("all roles succeeded; job complete")
+            self.status = JobStatus.SUCCEEDED
+            self._save_state()
+
+    def _handle_vertex_failure(self, vertex: RoleVertex) -> None:
+        """Reference deal_with_actor_restarting (:222): restart the
+        failed instance plus its lineage dependents; exhausted budget
+        escalates to a whole-job restart (:330), then job failure."""
+        spec = self.graph.spec_of(vertex)
+        if vertex.restart_count >= spec.max_restarts:
+            logger.error(
+                "%s exhausted its restart budget (%s)",
+                vertex.vertex_id,
+                spec.max_restarts,
+            )
+            self.restart_job()
+            return
+        vertex.restart_count += 1
+        logger.warning(
+            "restarting %s (count %s/%s) and lineage %s",
+            vertex.vertex_id,
+            vertex.restart_count,
+            spec.max_restarts,
+            self.graph.dependents_of(vertex.role),
+        )
+        self._restart_vertex(vertex)
+        for role in self.graph.dependents_of(vertex.role):
+            for dependent in self.graph.role_vertices(role):
+                if dependent.state in (
+                    VertexState.RUNNING,
+                    VertexState.FAILED,
+                ):
+                    self._restart_vertex(dependent)
+        self._save_state()
+
+    def _restart_vertex(self, vertex: RoleVertex) -> None:
+        """Relaunch one vertex (budget accounting is the caller's)."""
+        worker = self._workers.get(vertex.vertex_id)
+        if worker is not None:
+            worker.stop()
+        self._start_vertex(vertex)
+
+    def restart_job(self) -> None:
+        """Whole-job restart (reference :330): tear every role down and
+        bring the graph back up once; beyond the budget the job fails."""
+        if self._job_restarts >= self._max_job_restarts:
+            logger.error("job restart budget exhausted; job failed")
+            self.stop(status=JobStatus.FAILED)
+            return
+        self._job_restarts += 1
+        logger.warning(
+            "restarting the whole job (%s/%s)",
+            self._job_restarts,
+            self._max_job_restarts,
+        )
+        for worker in self._workers.values():
+            worker.stop()
+        self._workers.clear()
+        for vertex in self.graph.vertices.values():
+            vertex.state = VertexState.PENDING
+            vertex.restart_count = 0
+            self._start_vertex(vertex)
+        self._save_state()
+
+    def stop(self, status: str = JobStatus.STOPPED) -> None:
+        self._stopped.set()
+        for worker in self._workers.values():
+            worker.stop()
+        self.status = status
+        self._save_state()
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.status == JobStatus.RUNNING and (
+            deadline is None or time.time() < deadline
+        ):
+            time.sleep(0.1)
+        return self.status
+
+    # -- state persistence (reference :389-430) ----------------------------
+
+    def _save_state(self) -> None:
+        try:
+            self._state.save(
+                {
+                    "job_name": self.job.name,
+                    "status": self.status,
+                    "job_restarts": self._job_restarts,
+                    "vertices": {
+                        vid: {
+                            "state": v.state,
+                            "restart_count": v.restart_count,
+                            "node": v.node,
+                            "pid": (
+                                self._workers[vid].pid
+                                if vid in self._workers
+                                else None
+                            ),
+                            "start_ticks": (
+                                self._workers[vid].start_ticks
+                                if vid in self._workers
+                                else None
+                            ),
+                        }
+                        for vid, v in self.graph.vertices.items()
+                    },
+                }
+            )
+        except Exception:
+            logger.exception("state save failed")
+
+    def _self_recover(self) -> None:
+        """A restarted master resumes bookkeeping instead of forgetting
+        restart budgets (process supervision itself cannot survive the
+        master process, so orphaned role processes are restarted)."""
+        state = self._state.load()
+        if not state or state.get("job_name") != self.job.name:
+            return
+        self._job_restarts = int(state.get("job_restarts", 0))
+        from ..common.proc import kill_pid_if_same_incarnation
+
+        for vid, saved in (state.get("vertices") or {}).items():
+            vertex = self.graph.vertices.get(vid)
+            if vertex is not None:
+                vertex.restart_count = int(saved.get("restart_count", 0))
+            # The dead master's role processes (own sessions) are
+            # orphans now — a fresh start() would otherwise run two
+            # copies of every role against the same devices/state.
+            pid = saved.get("pid")
+            ticks = saved.get("start_ticks")
+            if pid and kill_pid_if_same_incarnation(int(pid), int(ticks or 0)):
+                logger.warning(
+                    "reaped orphaned role process %s (pid %s)", vid, pid
+                )
+        logger.info(
+            "recovered manager state: job_restarts=%s", self._job_restarts
+        )

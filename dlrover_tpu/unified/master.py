@@ -1,0 +1,53 @@
+"""PrimeMaster: the unified job's top-level supervisor.
+
+Reference: ``unified/controller/master.py`` (PrimeMaster Ray actor) —
+here a thin process-local wrapper over :class:`PrimeManager`, giving
+the builder API one object to start/wait/stop.
+"""
+
+from typing import Optional
+
+from ..common.log import logger
+from .api import DLJob
+from .manager import JobStatus, PrimeManager
+from .state import StateBackend
+
+
+class PrimeMaster:
+    def __init__(
+        self,
+        job: DLJob,
+        state_backend: Optional[StateBackend] = None,
+        log_dir: Optional[str] = None,
+        monitor_interval: float = 0.5,
+    ):
+        self.manager = PrimeManager(
+            job,
+            state_backend=state_backend,
+            log_dir=log_dir,
+            monitor_interval=monitor_interval,
+        )
+
+    def start(self) -> None:
+        logger.info(
+            "unified job %s starting: roles=%s",
+            self.manager.job.name,
+            {
+                name: spec.num_instances
+                for name, spec in self.manager.job.roles.items()
+            },
+        )
+        self.manager.start()
+
+    @property
+    def status(self) -> str:
+        return self.manager.status
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        return self.manager.wait(timeout)
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def succeeded(self) -> bool:
+        return self.manager.status == JobStatus.SUCCEEDED

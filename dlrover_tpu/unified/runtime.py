@@ -1,0 +1,127 @@
+"""Role worker runtime: supervised processes for graph vertices.
+
+Reference: ``unified/backend/elastic/worker/worker.py`` runs the torch
+agent inside a Ray actor; here a vertex is a supervised OS process.
+An ``elastic=True`` role wraps the full elastic runtime — its command
+is the ``tpurun`` launcher, so the role gets a job master + agent tree
+of its own (reference ElasticMaster sub-master actor).
+"""
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+from ..common.log import logger
+from ..common.proc import kill_process_group, proc_start_ticks
+from .graph import RoleVertex, VertexState
+
+
+class RoleEnv:
+    """Env contract a role process receives (reference worker env)."""
+
+    ROLE = "DLROVER_ROLE"
+    ROLE_INDEX = "DLROVER_ROLE_INDEX"
+    ROLE_WORLD = "DLROVER_ROLE_WORLD"
+    NODE_SLOT = "DLROVER_NODE_SLOT"
+    JOB_NAME = "DLROVER_UNIFIED_JOB"
+
+
+class RoleWorker:
+    """One supervised role-instance process."""
+
+    def __init__(
+        self,
+        vertex: RoleVertex,
+        command,
+        env: Optional[Dict[str, str]] = None,
+        job_name: str = "unified",
+        role_world: int = 1,
+        log_dir: Optional[str] = None,
+    ):
+        self.vertex = vertex
+        self._command = list(command)
+        self._env = dict(env or {})
+        self._job_name = job_name
+        self._role_world = role_world
+        self._log_dir = log_dir
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_file = None
+        self.start_ticks: Optional[int] = None
+        self._launches = 0
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc else None
+
+    def start(self) -> None:
+        env = dict(os.environ)
+        env.update(self._env)
+        env.update(
+            {
+                RoleEnv.ROLE: self.vertex.role,
+                RoleEnv.ROLE_INDEX: str(self.vertex.index),
+                RoleEnv.ROLE_WORLD: str(self._role_world),
+                RoleEnv.NODE_SLOT: str(self.vertex.node or 0),
+                RoleEnv.JOB_NAME: self._job_name,
+            }
+        )
+        stdout = None
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            # per-launch files: restart_count resets on whole-job
+            # restarts, and overwriting the previous incarnation's log
+            # destroys exactly the evidence a failover investigation
+            # needs
+            path = os.path.join(
+                self._log_dir,
+                f"{self.vertex.vertex_id}_{self._launches}.log",
+            )
+            self._launches += 1
+            self._log_file = open(path, "wb")
+            stdout = self._log_file
+        self._proc = subprocess.Popen(
+            self._command,
+            env=env,
+            stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+            start_new_session=True,
+        )
+        self.start_ticks = proc_start_ticks(self._proc.pid)
+        self.vertex.state = VertexState.RUNNING
+        logger.info(
+            "started %s pid=%s (restart %s)",
+            self.vertex.vertex_id,
+            self._proc.pid,
+            self.vertex.restart_count,
+        )
+
+    def poll(self) -> str:
+        if self._proc is None:
+            return VertexState.PENDING
+        rc = self._proc.poll()
+        if rc is None:
+            return VertexState.RUNNING
+        self._close_log()
+        return VertexState.SUCCEEDED if rc == 0 else VertexState.FAILED
+
+    def returncode(self) -> Optional[int]:
+        return self._proc.poll() if self._proc else None
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        if self._proc is not None:
+            kill_process_group(self._proc, grace_s)
+        self._close_log()
+
+    def _close_log(self) -> None:
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            finally:
+                self._log_file = None
+
+
+def python_role_command(script: str) -> list:
+    """Convenience: a role command running ``script`` with this
+    interpreter (tests and local runs)."""
+    return [sys.executable, script]

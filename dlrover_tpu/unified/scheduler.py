@@ -1,0 +1,84 @@
+"""Placement: vertices → host slots.
+
+Reference: ``unified/controller/schedule/scheduler.py`` (placement
+groups). TPU shape: a "node" is a host (or slice) with a device
+capacity; collocated roles pack onto the same hosts (their device
+fractions must fit together), everything else first-fits.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..common.log import logger
+from .graph import DLExecutionGraph
+
+
+@dataclass
+class Placement:
+    # node -> vertex_ids
+    by_node: Dict[int, List[str]] = field(default_factory=dict)
+
+    def node_of(self, vertex_id: str) -> int:
+        for node, ids in self.by_node.items():
+            if vertex_id in ids:
+                return node
+        raise KeyError(vertex_id)
+
+
+def place(graph: DLExecutionGraph) -> Placement:
+    """Assign every vertex a node slot; raises when capacity is short.
+
+    Collocation groups are packed first: instance i of every role in a
+    group lands on the same node (the reference's placement-group
+    STRICT_PACK), consuming the sum of their fractions. Remaining roles
+    first-fit by descending device need.
+    """
+    job = graph.job
+    capacity = [job.devices_per_node] * job.num_nodes
+    placement = Placement(by_node={n: [] for n in range(job.num_nodes)})
+
+    def assign(vertex, node: int) -> None:
+        capacity[node] -= vertex.device
+        placement.by_node[node].append(vertex.vertex_id)
+        vertex.node = node
+
+    collocated_roles = set()
+    for group in job.collocations:
+        collocated_roles.update(group)
+        counts = {job.roles[name].num_instances for name in group}
+        if len(counts) != 1:
+            raise ValueError(
+                f"collocated roles {group} need equal instance counts"
+            )
+        group_need = sum(
+            job.roles[name].device_per_instance for name in group
+        )
+        for index in range(counts.pop()):
+            node = _first_fit(capacity, group_need)
+            for name in group:
+                assign(graph.vertices[f"{name}-{index}"], node)
+
+    rest = [
+        v
+        for v in graph.vertices.values()
+        if v.role not in collocated_roles
+    ]
+    for vertex in sorted(rest, key=lambda v: -v.device):
+        node = _first_fit(capacity, vertex.device)
+        assign(vertex, node)
+
+    logger.info(
+        "placement: %s",
+        {n: ids for n, ids in placement.by_node.items() if ids},
+    )
+    return placement
+
+
+def _first_fit(capacity: List[float], need: float) -> int:
+    for node, free in enumerate(capacity):
+        if free + 1e-9 >= need:
+            return node
+    raise ValueError(
+        f"insufficient capacity: need {need} devices on one node, "
+        f"free={capacity}"
+    )

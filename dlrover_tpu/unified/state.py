@@ -1,0 +1,72 @@
+"""Master state backends for self-recovery.
+
+Reference: ``unified/controller/state_backend.py`` — the PrimeMaster
+persists its job state so a restarted master resumes supervision
+instead of restarting the job (manager.py:389-430).
+"""
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+
+class StateBackend:
+    def save(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class MemoryStateBackend(StateBackend):
+    def __init__(self):
+        self._state: Optional[Dict[str, Any]] = None
+
+    def save(self, state):
+        self._state = json.loads(json.dumps(state))  # deep copy + validate
+
+    def load(self):
+        return self._state
+
+    def clear(self):
+        self._state = None
+
+
+class FileStateBackend(StateBackend):
+    """Atomic JSON file (a k8s deployment would mount this on a PV or
+    swap in a KV/configmap backend with the same three verbs)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def save(self, state):
+        directory = os.path.dirname(self._path) or "."
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self._path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load(self):
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear(self):
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass

@@ -1,0 +1,41 @@
+"""Minimal multi-role RL job on the unified control plane.
+
+The TPU-native analogue of the reference's builder examples
+(examples/unified/rl/openrlhf/ppo/main.py:26-60): declare the roles,
+their instance counts and per-host device fractions, collocate the
+actor with its rollout engine, and submit. Role processes read their
+identity from the DLROVER_ROLE* env contract.
+
+Run:  python examples/unified/rl_ppo.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+from dlrover_tpu.unified import RLJobBuilder
+
+HERE = pathlib.Path(__file__).parent
+
+
+def main() -> int:
+    role_script = str(HERE / "rl_role.py")
+    job = (
+        RLJobBuilder("ppo-demo")
+        .node_num(2)
+        .device_per_node(4)
+        .trainer([sys.executable, role_script], num=2, device=2.0)
+        .rollout([sys.executable, role_script], num=2, device=1.0)
+        .reward([sys.executable, role_script], num=1, device=0.5)
+        .with_collocation("trainer", "rollout")
+        .build()
+    )
+    master = job.submit(log_dir="/tmp/ppo-demo-logs")
+    status = master.wait(timeout=60)
+    print("job finished:", status)
+    return 0 if master.succeeded() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

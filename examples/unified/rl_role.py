@@ -1,0 +1,10 @@
+"""One role process of the demo RL job: real code would run the JAX
+trainer / inference rollout here; the demo just proves the contract."""
+
+import os
+
+role = os.environ["DLROVER_ROLE"]
+index = os.environ["DLROVER_ROLE_INDEX"]
+world = os.environ["DLROVER_ROLE_WORLD"]
+slot = os.environ["DLROVER_NODE_SLOT"]
+print(f"{role}[{index}/{world}] on node slot {slot}: step done", flush=True)

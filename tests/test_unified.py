@@ -1,0 +1,273 @@
+"""Unified multi-role control plane tests (reference:
+dlrover/python/unified/tests — builder validation, placement,
+supervision, failover lineage, state recovery — run with real local
+processes like the reference's local-Ray integration tests)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.unified import (
+    DLExecutionGraph,
+    DLJobBuilder,
+    FileStateBackend,
+    MemoryStateBackend,
+    PrimeManager,
+    RLJobBuilder,
+    place,
+)
+from dlrover_tpu.unified.graph import VertexState
+from dlrover_tpu.unified.manager import JobStatus
+
+
+class TestBuilder:
+    def test_rl_roles_and_validation(self):
+        job = (
+            RLJobBuilder("ppo")
+            .node_num(2)
+            .device_per_node(4)
+            .trainer(["python", "t.py"], num=2, device=2.0)
+            .rollout(["python", "r.py"], num=2, device=1.0)
+            .reward(["python", "w.py"], num=1, device=0.5)
+            .with_collocation("trainer", "rollout")
+            .build()
+        )
+        assert set(job.roles) == {"trainer", "rollout", "reward"}
+        # rollout failure lineage defaults to the trainer
+        assert job.roles["rollout"].restart_dependents == ["trainer"]
+
+    def test_rl_requires_trainer(self):
+        with pytest.raises(ValueError, match="trainer"):
+            RLJobBuilder("x").rollout(["python", "r.py"]).build()
+
+    def test_duplicate_and_unknown_roles_rejected(self):
+        builder = DLJobBuilder("j").role("a", ["cmd"])
+        with pytest.raises(ValueError, match="twice"):
+            builder.role("a", ["cmd"])
+        with pytest.raises(ValueError, match="unknown role"):
+            DLJobBuilder("j").role("a", ["cmd"]).with_collocation(
+                "a", "ghost"
+            ).build()
+        with pytest.raises(ValueError, match="unknown dependent"):
+            DLJobBuilder("j").role(
+                "a", ["cmd"], restart_dependents=["ghost"]
+            ).build()
+
+
+class TestPlacement:
+    def _job(self, **kw):
+        builder = (
+            DLJobBuilder("place")
+            .node_num(kw.get("nodes", 2))
+            .device_per_node(kw.get("devices", 4))
+        )
+        return builder
+
+    def test_collocated_roles_share_nodes(self):
+        job = (
+            self._job()
+            .role("actor", ["c"], num=2, device=2.0)
+            .role("rollout", ["c"], num=2, device=2.0)
+            .with_collocation("actor", "rollout")
+            .build()
+        )
+        graph = DLExecutionGraph.from_job(job)
+        placement = place(graph)
+        for index in range(2):
+            assert placement.node_of(f"actor-{index}") == placement.node_of(
+                f"rollout-{index}"
+            )
+
+    def test_capacity_enforced(self):
+        job = (
+            self._job(nodes=1, devices=2)
+            .role("big", ["c"], num=3, device=1.0)
+            .build()
+        )
+        with pytest.raises(ValueError, match="insufficient capacity"):
+            place(DLExecutionGraph.from_job(job))
+
+    def test_collocation_requires_equal_counts(self):
+        job = (
+            self._job()
+            .role("a", ["c"], num=2, device=1.0)
+            .role("b", ["c"], num=1, device=1.0)
+            .with_collocation("a", "b")
+            .build()
+        )
+        with pytest.raises(ValueError, match="equal instance counts"):
+            place(DLExecutionGraph.from_job(job))
+
+
+def _script(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(body)
+    return [sys.executable, str(path)]
+
+
+class TestSupervision:
+    def test_job_runs_to_success(self, tmp_path):
+        marker = tmp_path / "out"
+        marker.mkdir()
+        cmd = _script(
+            tmp_path,
+            "ok.py",
+            "import os, pathlib\n"
+            "role = os.environ['DLROVER_ROLE']\n"
+            "idx = os.environ['DLROVER_ROLE_INDEX']\n"
+            f"pathlib.Path(r'{marker}', f'{{role}}_{{idx}}').write_text(\n"
+            "    os.environ['DLROVER_ROLE_WORLD'])\n",
+        )
+        job = (
+            DLJobBuilder("ok")
+            .node_num(1)
+            .device_per_node(4)
+            .role("trainer", cmd, num=2, device=1.0)
+            .role("reward", cmd, num=1, device=1.0)
+            .build()
+        )
+        manager = PrimeManager(job, log_dir=str(tmp_path / "logs"))
+        manager.start()
+        assert manager.wait(timeout=30) == JobStatus.SUCCEEDED
+        assert sorted(p.name for p in marker.iterdir()) == [
+            "reward_0",
+            "trainer_0",
+            "trainer_1",
+        ]
+        assert (marker / "trainer_0").read_text() == "2"
+
+    def test_failed_role_restarts_with_lineage(self, tmp_path):
+        marker = tmp_path / "runs"
+        marker.mkdir()
+        # rollout fails once, then succeeds; each start drops a marker
+        rollout_cmd = _script(
+            tmp_path,
+            "rollout.py",
+            "import os, pathlib, sys, time\n"
+            f"d = pathlib.Path(r'{marker}')\n"
+            "n = len(list(d.glob('rollout_*')))\n"
+            "(d / f'rollout_{n}').write_text('')\n"
+            "time.sleep(0.3)\n"
+            "sys.exit(1 if n == 0 else 0)\n",
+        )
+        trainer_cmd = _script(
+            tmp_path,
+            "trainer.py",
+            "import pathlib, time\n"
+            f"d = pathlib.Path(r'{marker}')\n"
+            "n = len(list(d.glob('trainer_*')))\n"
+            "(d / f'trainer_{n}').write_text('')\n"
+            "time.sleep(1.2)\n",
+        )
+        job = (
+            RLJobBuilder("lineage")
+            .node_num(1)
+            .device_per_node(4)
+            .trainer(trainer_cmd, num=1, device=1.0)
+            .rollout(rollout_cmd, num=1, device=1.0)
+            .build()
+        )
+        manager = PrimeManager(
+            job, log_dir=str(tmp_path / "logs"), monitor_interval=0.1
+        )
+        manager.start()
+        status = manager.wait(timeout=30)
+        assert status == JobStatus.SUCCEEDED, status
+        # rollout ran twice (failure + retry); the trainer was restarted
+        # by lineage even though it never failed itself
+        assert len(list(marker.glob("rollout_*"))) == 2
+        assert len(list(marker.glob("trainer_*"))) >= 2
+
+    def test_budget_exhaustion_fails_job(self, tmp_path):
+        cmd = _script(tmp_path, "bad.py", "import sys; sys.exit(1)\n")
+        job = (
+            DLJobBuilder("doomed")
+            .node_num(1)
+            .device_per_node(2)
+            .role("trainer", cmd, num=1, device=1.0, max_restarts=1)
+            .build()
+        )
+        manager = PrimeManager(
+            job,
+            log_dir=str(tmp_path / "logs"),
+            monitor_interval=0.1,
+            max_job_restarts=0,
+        )
+        manager.start()
+        assert manager.wait(timeout=30) == JobStatus.FAILED
+
+
+class TestStateRecovery:
+    def test_file_backend_roundtrip(self, tmp_path):
+        backend = FileStateBackend(str(tmp_path / "state.json"))
+        backend.save({"a": 1})
+        assert backend.load() == {"a": 1}
+        backend.clear()
+        assert backend.load() is None
+
+    def test_manager_recovers_budgets(self, tmp_path):
+        backend = FileStateBackend(str(tmp_path / "state.json"))
+        cmd = [sys.executable, "-c", "pass"]
+        job = (
+            DLJobBuilder("recover")
+            .node_num(1)
+            .device_per_node(1)
+            .role("trainer", cmd, num=1, device=1.0)
+            .build()
+        )
+        first = PrimeManager(job, state_backend=backend)
+        first.graph.vertices["trainer-0"].restart_count = 2
+        first._job_restarts = 1
+        first._save_state()
+
+        # a NEW master process resumes the budgets instead of resetting
+        second = PrimeManager(job, state_backend=backend)
+        assert second.graph.vertices["trainer-0"].restart_count == 2
+        assert second._job_restarts == 1
+
+
+class TestOrphanReaping:
+    def test_recovered_master_reaps_orphan_roles(self, tmp_path):
+        backend = FileStateBackend(str(tmp_path / "state.json"))
+        cmd = _script(tmp_path, "sleepy.py", "import time; time.sleep(60)\n")
+        job = (
+            DLJobBuilder("orphans")
+            .node_num(1)
+            .device_per_node(1)
+            .role("trainer", cmd, num=1, device=1.0)
+            .build()
+        )
+        first = PrimeManager(job, state_backend=backend, monitor_interval=0.1)
+        first.start()
+        pid = first._workers["trainer-0"].pid
+        assert pid is not None
+        # simulate the master process dying: supervision stops, the role
+        # process (own session) survives as an orphan
+        first._stopped.set()
+        time.sleep(0.3)
+        assert os.path.exists(f"/proc/{pid}")
+
+        def alive(p):
+            # in THIS test the orphan stays our child, so a killed orphan
+            # lingers as a zombie (state Z) until reaped — dead either way
+            try:
+                with open(f"/proc/{p}/stat", "rb") as f:
+                    stat = f.read()
+                return stat[stat.rindex(b")") + 2 :].split()[0] != b"Z"
+            except OSError:
+                return False
+
+        second = PrimeManager(job, state_backend=backend)
+        deadline = time.time() + 10
+        while time.time() < deadline and alive(pid):
+            time.sleep(0.1)
+        try:
+            assert not alive(pid), "orphan role survived master recovery"
+        finally:
+            second.stop()
+            try:
+                os.kill(pid, 9)
+            except OSError:
+                pass

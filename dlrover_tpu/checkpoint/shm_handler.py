@@ -163,6 +163,11 @@ class SharedMemoryHandler:
 
         # Start all D2H copies before any blocking read (overlap on TPU).
         for _, shard in plan:
+            if isinstance(shard, np.ndarray):
+                # ndarray.data raises ValueError for non-buffer dtypes
+                # (ml_dtypes bfloat16), and a host array has no D2H copy
+                # to start anyway.
+                continue
             data = getattr(shard, "data", None)
             if data is not None and hasattr(data, "copy_to_host_async"):
                 data.copy_to_host_async()
@@ -196,7 +201,10 @@ class SharedMemoryHandler:
         payload_base = HEADER_LEN_BYTES + len(meta_bytes)
         buf[HEADER_LEN_BYTES:payload_base] = meta_bytes
         for rec, shard in plan:
-            data = getattr(shard, "data", shard)
+            if isinstance(shard, np.ndarray):
+                data = shard
+            else:
+                data = getattr(shard, "data", shard)
             flat = np.ascontiguousarray(np.asarray(data)).reshape(-1)
             start = payload_base + rec.offset
             view = np.frombuffer(buf, dtype=np.uint8, count=rec.nbytes, offset=start)

@@ -1,0 +1,227 @@
+"""Second workload family: torch.distributed training on the same runtime.
+
+The reference proves its runtime is framework-agnostic by running a whole
+TF/PS stack next to torch (SURVEY.md §2.12, ``dlrover/trainer/tensorflow/``).
+The TPU build's equivalent proof: the elastic runtime — master, rendezvous,
+agent supervision, dynamic data sharding, flash checkpoint — drives a
+**torch** (CPU/gloo) workload with zero framework-specific changes to the
+control plane.  Everything rides the same ``NodeEnv`` contract the agent
+already exports for JAX workers:
+
+- ``TorchElasticContext`` maps the rendezvous output (coordinator address,
+  num_processes, process_id) onto ``torch.distributed.init_process_group``
+  the way :class:`dlrover_tpu.trainer.elastic.ElasticContext` maps it onto
+  ``jax.distributed.initialize`` (reference: ``MasterRendezvousHandler``
+  feeding torchrun, ``training.py:285-494``).
+- ``TorchCheckpointEngine`` stages ``state_dict`` trees through the exact
+  same shm engine/saver the JAX path uses (reference: ``DdpCheckpointer``,
+  ``flash_checkpoint/ddp.py``), converting tensors losslessly — including
+  bfloat16, which numpy cannot represent natively — at the boundary.
+- ``ElasticDistributedSampler`` (already framework-neutral) plugs into
+  ``torch.utils.data.DataLoader`` as-is.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import torch
+
+from ..common.log import logger
+from .elastic import ElasticContext
+
+
+def _torch_to_numpy(t: torch.Tensor) -> np.ndarray:
+    """Lossless tensor→ndarray, routing bfloat16 through its bit pattern
+    (torch refuses ``.numpy()`` on bf16; ml_dtypes — registered by jax —
+    gives numpy a real bfloat16 dtype so the staged bytes keep the truth)."""
+    t = t.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _numpy_to_torch(arr: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    if like.dtype == torch.bfloat16:
+        raw = np.ascontiguousarray(arr).view(np.uint16)
+        return (
+            torch.from_numpy(raw.copy())
+            .view(torch.bfloat16)
+            .reshape(like.shape)
+            .to(like.device)
+        )
+    out = torch.from_numpy(np.ascontiguousarray(arr).copy())
+    return out.to(dtype=like.dtype, device=like.device).reshape(like.shape)
+
+
+def _map_tree(tree: Any, fn) -> Any:
+    """Structure-preserving map over the containers torch state_dicts use
+    (dict/list/tuple), applying ``fn`` to tensor leaves only."""
+    if isinstance(tree, dict):
+        return {k: _map_tree(v, fn) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_tree(v, fn) for v in tree)
+    if isinstance(tree, torch.Tensor):
+        return fn(tree)
+    return tree
+
+
+def _map_tree_like(tree: Any, template: Any, fn, coerce_plain: bool = False) -> Any:
+    """Zip-map ``tree`` against ``template``; ``fn(leaf, template_leaf)``
+    runs where the template holds a tensor.  With ``coerce_plain``, plain
+    Python leaves (int/float/bool/str — e.g. optimizer ``param_groups``
+    hyperparams and the ``params`` id lists) that came back from the shm
+    engine as 0-d ndarrays are cast back to the template's Python type:
+    ``Optimizer.load_state_dict`` hashes the param ids, and an ndarray id
+    would blow up with 'unhashable type'."""
+    if isinstance(template, dict):
+        return {
+            k: _map_tree_like(tree[k], template[k], fn, coerce_plain)
+            for k in template
+        }
+    if isinstance(template, (list, tuple)):
+        return type(template)(
+            _map_tree_like(a, b, fn, coerce_plain) for a, b in zip(tree, template)
+        )
+    if isinstance(template, torch.Tensor):
+        return fn(tree, template) if fn is not None else tree
+    if (
+        coerce_plain
+        and isinstance(template, (bool, int, float, str))
+        and isinstance(tree, (np.ndarray, np.generic))
+    ):
+        return type(template)(np.asarray(tree).item())
+    return tree
+
+
+@dataclass
+class TorchElasticContext(ElasticContext):
+    """:class:`ElasticContext` for torch workers: same env contract, same
+    master control-plane helpers (step reports, config tuner), but the
+    world bring-up targets ``torch.distributed`` instead of
+    ``jax.distributed``."""
+
+    backend: str = "gloo"
+
+    def initialize_torch(
+        self, backend: Optional[str] = None, timeout_s: float = 300.0
+    ) -> bool:
+        """``init_process_group`` from the rendezvous coordinator triple.
+
+        The elected coordinator address doubles as the TCPStore endpoint:
+        rank 0 binds it (nothing else does in a torch job — there is no
+        jax coordinator here), everyone else connects.  Returns False for
+        single-process worlds, where DDP is pointless and user code can
+        run un-initialized (mirrors ``initialize_jax`` skipping
+        ``jax.distributed`` for world size 1).
+        """
+        import datetime
+
+        from ..profiler.stack_dump import install_stack_dump_handler
+
+        install_stack_dump_handler()
+        if self.num_processes <= 1 or not self.coordinator:
+            logger.info("single-process world; skipping torch.distributed")
+            return False
+        backend = backend or self.backend
+        logger.info(
+            "torch init_process_group(backend=%s, init=tcp://%s, rank=%s/%s)",
+            backend,
+            self.coordinator,
+            self.process_id,
+            self.num_processes,
+        )
+        torch.distributed.init_process_group(
+            backend=backend,
+            init_method=f"tcp://{self.coordinator}",
+            rank=self.process_id,
+            world_size=self.num_processes,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+        return True
+
+    def shutdown(self) -> None:
+        if torch.distributed.is_initialized():
+            torch.distributed.destroy_process_group()
+
+
+def torch_elastic_context() -> TorchElasticContext:
+    """Build the torch context from the agent's env (no singleton caching:
+    a restarted incarnation re-reads its new coordinates)."""
+    ctx = TorchElasticContext.from_env()
+    return ctx
+
+
+class TorchCheckpointEngine:
+    """Flash checkpoint for torch ``state_dict`` trees.
+
+    Same engine/saver/shm stack as the JAX path (reference engine split,
+    ``flash_checkpoint/engine.py:154`` + ``ddp.py``): tensors are staged
+    as host ndarrays, the agent persists asynchronously, and restore
+    prefers memory over storage.  DDP semantics: every host stages a full
+    replica of its (identical) state, so any surviving incarnation can
+    restore locally after a re-mesh — the same property the reference's
+    ``DdpCheckpointer`` provides.
+    """
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        host_rank: Optional[int] = None,
+        num_hosts: Optional[int] = None,
+        **engine_kwargs,
+    ):
+        from ..checkpoint.engine import CheckpointEngine
+
+        self._engine = CheckpointEngine(
+            checkpoint_dir,
+            mesh=None,
+            host_rank=host_rank,
+            num_hosts=num_hosts,
+            **engine_kwargs,
+        )
+
+    # -- save --------------------------------------------------------------
+
+    def save_to_memory(
+        self, step: int, state_dict: Dict, extra: Optional[Dict] = None
+    ) -> bool:
+        host_tree = _map_tree(state_dict, _torch_to_numpy)
+        return self._engine.save_to_memory(step, host_tree, extra=extra)
+
+    def save_to_storage(
+        self, step: int, state_dict: Dict, extra: Optional[Dict] = None
+    ) -> bool:
+        host_tree = _map_tree(state_dict, _torch_to_numpy)
+        return self._engine.save_to_storage(step, host_tree, extra=extra)
+
+    def wait_saving(self, timeout: float = 300.0) -> bool:
+        return self._engine.wait_saving(timeout)
+
+    # -- load --------------------------------------------------------------
+
+    def load(self, template: Dict) -> Tuple[int, Optional[Dict]]:
+        """Restore into ``template``'s structure/dtypes/devices.
+        Returns ``(step, state_dict)`` or ``(-1, None)``."""
+        host_template = _map_tree(template, _torch_to_numpy)
+        step, restored = self._engine.load(host_template)
+        if restored is None:
+            return -1, None
+        out = _map_tree_like(restored, template, _numpy_to_torch)
+        out = _map_tree_like(out, template, None, coerce_plain=True)
+        return step, out
+
+    def get_local_shard_num(self) -> int:
+        return self._engine.get_local_shard_num()
+
+    def get_global_shard_num(self) -> int:
+        return self._engine.get_global_shard_num()
+
+    @property
+    def shm(self):
+        return self._engine.shm
+
+    def close(self) -> None:
+        self._engine.close()

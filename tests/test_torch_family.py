@@ -1,0 +1,377 @@
+"""Second workload family: the elastic runtime driving torch workloads.
+
+The framework-agnostic proof the reference carries via its TF/PS stack
+(SURVEY.md §2.12): the SAME master / rendezvous / agent / flash-ckpt
+machinery runs a torch.distributed (gloo) job with no control-plane
+changes — the NodeEnv contract plus the shm checkpoint engine are the
+whole integration surface.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+import torch
+
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+from dlrover_tpu.common.constants import JobExitReason, NodeEnv
+from dlrover_tpu.trainer.torch_elastic import (
+    TorchCheckpointEngine,
+    TorchElasticContext,
+    _map_tree,
+    _numpy_to_torch,
+    _torch_to_numpy,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_saver(tmp_ipc_dir, monkeypatch):
+    job = f"torch_{os.getpid()}_{id(tmp_ipc_dir)}"
+    monkeypatch.setenv("DLROVER_JOB_NAME", job)
+    AsyncCheckpointSaver.reset()
+    yield
+    AsyncCheckpointSaver.reset()
+    for name in os.listdir("/dev/shm"):
+        if name.startswith(f"dlrover_{job}_"):
+            SharedMemoryHandler(0, name=name.split(f"dlrover_{job}_", 1)[1]).unlink()
+
+
+class TestTensorConversion:
+    def test_float_and_int_roundtrip(self):
+        for dtype in (torch.float32, torch.float64, torch.int64, torch.int32):
+            t = torch.arange(12, dtype=dtype).reshape(3, 4)
+            arr = _torch_to_numpy(t)
+            back = _numpy_to_torch(arr, t)
+            assert back.dtype == t.dtype
+            assert torch.equal(back, t)
+
+    def test_bfloat16_lossless(self):
+        # bf16 has no native numpy dtype in torch's eyes; the bit-pattern
+        # route must preserve every value exactly.
+        t = torch.randn(64, dtype=torch.float32).to(torch.bfloat16)
+        arr = _torch_to_numpy(t)
+        assert str(arr.dtype) == "bfloat16"
+        back = _numpy_to_torch(arr, t)
+        assert back.dtype == torch.bfloat16
+        assert torch.equal(back.view(torch.uint16), t.view(torch.uint16))
+
+    def test_map_tree_structures(self):
+        tree = {"a": torch.ones(2), "b": [torch.zeros(3), {"c": 5}], "d": "x"}
+        out = _map_tree(tree, _torch_to_numpy)
+        assert isinstance(out["a"], np.ndarray)
+        assert isinstance(out["b"][0], np.ndarray)
+        assert out["b"][1]["c"] == 5 and out["d"] == "x"
+
+
+def _model_and_opt(seed=0):
+    torch.manual_seed(seed)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1)
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=1e-2)
+    # take one step so optimizer state (exp_avg etc.) exists
+    loss = model(torch.randn(4, 8)).sum()
+    loss.backward()
+    opt.step()
+    opt.zero_grad()
+    return model, opt
+
+
+class TestTorchCheckpointEngine:
+    def test_memory_roundtrip_full_train_state(self, tmp_path):
+        model, opt = _model_and_opt()
+        state = {
+            "model": model.state_dict(),
+            "opt": opt.state_dict(),
+            "step": torch.tensor(3),
+        }
+        engine = TorchCheckpointEngine(
+            str(tmp_path / "ckpt"), host_rank=0, num_hosts=1,
+            standalone=True, replicate=False,
+        )
+        try:
+            assert engine.save_to_memory(3, state)
+            # fresh template with different values
+            m2, o2 = _model_and_opt(seed=1)
+            template = {
+                "model": m2.state_dict(),
+                "opt": o2.state_dict(),
+                "step": torch.tensor(0),
+            }
+            step, restored = engine.load(template)
+            assert step == 3
+            for k, v in state["model"].items():
+                assert torch.equal(restored["model"][k], v)
+            assert int(restored["step"]) == 3
+            # optimizer state tensors restored exactly
+            sd, rd = state["opt"]["state"], restored["opt"]["state"]
+            for idx in sd:
+                for k in sd[idx]:
+                    a, b = sd[idx][k], rd[idx][k]
+                    if isinstance(a, torch.Tensor):
+                        assert torch.equal(a, b)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_storage_roundtrip_and_bf16(self, tmp_path):
+        state = {
+            "w": torch.randn(32, 8).to(torch.bfloat16),
+            "b": torch.randn(8, dtype=torch.float64),
+        }
+        engine = TorchCheckpointEngine(
+            str(tmp_path / "ckpt"), host_rank=0, num_hosts=1,
+            standalone=True, replicate=False,
+        )
+        try:
+            assert engine.save_to_storage(5, state)
+            assert engine.wait_saving(timeout=60)
+            # wipe memory so load must come from storage
+            engine.shm.invalidate()
+            template = {
+                "w": torch.zeros(32, 8, dtype=torch.bfloat16),
+                "b": torch.zeros(8, dtype=torch.float64),
+            }
+            step, restored = engine.load(template)
+            assert step == 5
+            assert torch.equal(
+                restored["w"].view(torch.uint16), state["w"].view(torch.uint16)
+            )
+            assert torch.equal(restored["b"], state["b"])
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+
+class TestTorchElasticContext:
+    def test_from_env_contract(self, monkeypatch):
+        monkeypatch.setenv(NodeEnv.NODE_RANK, "2")
+        monkeypatch.setenv(NodeEnv.NUM_PROCESSES, "4")
+        monkeypatch.setenv(NodeEnv.PROCESS_ID, "2")
+        monkeypatch.setenv(NodeEnv.COORDINATOR_ADDRESS, "10.0.0.1:1234")
+        ctx = TorchElasticContext.from_env()
+        assert ctx.process_id == 2
+        assert ctx.num_processes == 4
+        assert ctx.coordinator == "10.0.0.1:1234"
+
+    def test_single_process_skips_init(self):
+        ctx = TorchElasticContext(num_processes=1)
+        assert ctx.initialize_torch() is False
+        assert not torch.distributed.is_initialized()
+
+    def test_sampler_feeds_torch_dataloader(self):
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from dlrover_tpu.trainer.dataloader import ElasticDistributedSampler
+
+        data = TensorDataset(torch.arange(20, dtype=torch.float32))
+        sampler = ElasticDistributedSampler(
+            dataset_size=20, num_replicas=2, rank=0, shuffle=False
+        )
+        loader = DataLoader(data, batch_size=5, sampler=sampler)
+        seen = torch.cat([b[0] for b in loader])
+        assert len(seen) == 10  # this rank's half
+        # resume replays only the unconsumed tail
+        sampler.consumed_samples = 10  # 5 per rank already done globally
+        loader2 = DataLoader(data, batch_size=5, sampler=sampler)
+        seen2 = torch.cat([b[0] for b in loader2])
+        assert len(seen2) == 5
+
+
+# --------------------------------------------------------------------------
+# Chaos e2e: a real torch DDP (gloo) job through master + agents, one node
+# SIGKILLed, replacement rejoins, training resumes from the shm checkpoint.
+# Mirrors tests/test_elastic_train_e2e.py for the JAX family.
+# --------------------------------------------------------------------------
+
+TORCH_TRAINER = r'''
+import os, pathlib, time
+import numpy as np
+import torch
+
+from dlrover_tpu.trainer.torch_elastic import (
+    TorchCheckpointEngine, TorchElasticContext,
+)
+
+TOTAL_STEPS = 400
+ctx = TorchElasticContext.from_env()
+rank = ctx.node_rank
+out_dir = pathlib.Path(os.environ["PROGRESS_DIR"])
+ckpt_dir = pathlib.Path(os.environ["CKPT_DIR"]) / f"rank{rank}"
+ckpt_dir.mkdir(parents=True, exist_ok=True)
+progress = out_dir / f"progress_{rank}.txt"
+
+initialized = ctx.initialize_torch(timeout_s=120)
+assert initialized, "expected a multi-process world"
+assert torch.distributed.get_world_size() == ctx.num_processes
+
+torch.manual_seed(0)  # identical init on every rank (DDP invariant)
+model = torch.nn.Linear(4, 1)
+opt = torch.optim.SGD(model.parameters(), lr=0.05)
+
+engine = TorchCheckpointEngine(
+    str(ckpt_dir), host_rank=rank, num_hosts=1, replicate=False
+)
+start = 0
+step0, restored = engine.load(
+    {"model": model.state_dict(), "opt": opt.state_dict()}
+)
+if step0 >= 0 and restored is not None:
+    model.load_state_dict(restored["model"])
+    opt.load_state_dict(restored["opt"])
+    start = step0 + 1
+    (out_dir / f"resumed_{rank}_{step0}").write_text(str(os.getpid()))
+
+rng = np.random.default_rng(rank)
+w_true = torch.tensor([[1.0, -2.0, 3.0, 0.5]]).T
+for step in range(start, TOTAL_STEPS):
+    x = torch.tensor(rng.standard_normal((8, 4)), dtype=torch.float32)
+    y = x @ w_true
+    loss = torch.nn.functional.mse_loss(model(x), y)
+    opt.zero_grad()
+    loss.backward()
+    # hand-rolled DDP: average grads across the world (gloo allreduce)
+    for p in model.parameters():
+        torch.distributed.all_reduce(p.grad, op=torch.distributed.ReduceOp.AVG)
+    opt.step()
+    assert np.isfinite(loss.item())
+    engine.save_to_memory(
+        step, {"model": model.state_dict(), "opt": opt.state_dict()}
+    )
+    with open(progress, "a") as f:
+        f.write(f"{step} {loss.item():.6f}\n")
+    time.sleep(0.25)
+
+print(f"rank {rank} finished at step {TOTAL_STEPS-1}", flush=True)
+'''
+
+
+def _read_progress(path):
+    rows = []
+    if not path.exists():
+        return rows
+    for line in path.read_text().splitlines():
+        step, loss = line.split()
+        rows.append((int(step), float(loss)))
+    return rows
+
+
+def _cleanup_namespaces():
+    from dlrover_tpu.agent.worker import kill_worker_by_pidfile
+
+    for job in ("torch_e2e_n0", "torch_e2e_n1"):
+        kill_worker_by_pidfile(job)
+        for name in os.listdir("/dev/shm"):
+            if name.startswith(f"dlrover_{job}_"):
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+
+
+@pytest.mark.slow
+def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+    from dlrover_tpu.master.scaler.base_scaler import NoopScaler
+    from dlrover_tpu.master.scaler.process_scaler import (
+        ProcessNodeSpec,
+        ProcessScaler,
+    )
+    from dlrover_tpu.master.watcher.process_watcher import ProcessWatcher
+
+    _cleanup_namespaces()
+    progress_dir = tmp_path / "progress"
+    ckpt_dir = tmp_path / "ckpt"
+    progress_dir.mkdir()
+    ckpt_dir.mkdir()
+    script = tmp_path / "train_torch.py"
+    script.write_text(TORCH_TRAINER)
+
+    master = DistributedJobMaster(
+        scaler=NoopScaler(),
+        watcher=None,
+        num_workers=2,
+        node_unit=1,
+        job_name="torch_e2e",
+        pre_check_ops=[],
+        fresh_context=True,
+    )
+    spec = ProcessNodeSpec(
+        command=[
+            sys.executable,
+            "-m",
+            "dlrover_tpu.launcher.elastic_run",
+            "--nnodes",
+            "2",
+            "--max_restarts",
+            "3",
+            str(script),
+        ],
+        env={
+            "PROGRESS_DIR": str(progress_dir),
+            "CKPT_DIR": str(ckpt_dir),
+            "DLROVER_LOCAL_DEVICES": "1",
+            "PYTHONPATH": os.pathsep.join(sys.path),
+        },
+    )
+    scaler = ProcessScaler(
+        spec, master_addr=master.addr, job_name="torch_e2e", num_workers=2
+    )
+    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
+    master.job_manager._scaler = scaler
+    master.job_manager._watcher = watcher
+    master.auto_scaler._scaler = scaler
+    try:
+        master.prepare()
+        master.run_in_background()
+
+        # both ranks training (progress past a few steps)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            p0 = _read_progress(progress_dir / "progress_0.txt")
+            p1 = _read_progress(progress_dir / "progress_1.txt")
+            if len(p0) >= 4 and len(p1) >= 4:
+                break
+            time.sleep(0.5)
+        assert len(p0) >= 4 and len(p1) >= 4, "torch workers never trained"
+
+        # chaos: SIGKILL node 0's agent tree mid-training
+        handle = scaler._procs[0]
+        os.killpg(handle.proc.pid, signal.SIGKILL)
+
+        # the replacement must RESUME from its staged shm step
+        deadline = time.time() + 180
+        resumed = []
+        while time.time() < deadline:
+            resumed = list(progress_dir.glob("resumed_0_*"))
+            if resumed:
+                break
+            time.sleep(0.5)
+        assert resumed, "replacement node 0 never resumed from memory"
+        resumed_step = int(resumed[0].name.split("_")[-1])
+        assert resumed_step >= 3, "resume step lost the staged progress"
+
+        # after resume, rank 0's steps continue past the kill point with
+        # no regression (strictly increasing across the whole file)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            p0 = _read_progress(progress_dir / "progress_0.txt")
+            if p0 and p0[-1][0] > resumed_step + 3:
+                break
+            time.sleep(0.5)
+        steps0 = [s for s, _ in _read_progress(progress_dir / "progress_0.txt")]
+        assert steps0 == sorted(steps0), "steps regressed after resume"
+        assert steps0[-1] > resumed_step + 3, "training did not continue"
+
+        # both ranks re-entered a world of size 2 (allreduce would hang
+        # otherwise and progress files would stall)
+        p1_after = _read_progress(progress_dir / "progress_1.txt")
+        assert p1_after[-1][0] > resumed_step, "survivor stalled"
+    finally:
+        master.stop()
+        scaler.stop()
+        _cleanup_namespaces()

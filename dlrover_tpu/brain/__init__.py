@@ -1,0 +1,32 @@
+"""Brain: cluster-level resource optimization service.
+
+TPU-native counterpart of the reference's Go Brain
+(``dlrover/go/brain/``, ~15.2k LoC; SURVEY.md §2.14): a standalone
+service that persists runtime metrics from every job into a datastore
+and answers stage-based optimization queries (job creation, running
+adjustment, OOM recovery) from that cross-job history.  Masters consume
+it through :class:`dlrover_tpu.master.resource.brain_optimizer.
+BrainResourceOptimizer` the way the reference master consumes Brain via
+``master/resource/brain_optimizer.py:64`` — and degrade gracefully to
+local optimization when the service is unreachable.
+"""
+
+from .algorithms import (
+    JobCreateResourceAlgorithm,
+    JobRunningResourceAlgorithm,
+    OomRecoveryAlgorithm,
+)
+from .client import BrainClient
+from .datastore import BrainDataStore, JobMetricSample, JobRecord
+from .service import BrainService
+
+__all__ = [
+    "BrainClient",
+    "BrainDataStore",
+    "BrainService",
+    "JobCreateResourceAlgorithm",
+    "JobMetricSample",
+    "JobRecord",
+    "JobRunningResourceAlgorithm",
+    "OomRecoveryAlgorithm",
+]

@@ -1,0 +1,185 @@
+"""Brain optimization algorithms.
+
+Reference: ``dlrover/go/brain/pkg/optimizer/implementation/optalgorithm/``
+— stage-specific algorithms (job create, init adjust, running, OOM
+recovery) mining the datastore.  The PS-specific ones (hot-PS) have no
+TPU counterpart; what carries over is the *stage* structure and the
+history-driven decision style, re-targeted at slice-count selection:
+
+- create stage: pick the initial worker (host) count and per-host memory
+  from similar completed jobs' scaling curves (marginal-gain knee).
+- running stage: compare this job's observed curve against history; grow
+  while history says the next size still pays, shrink advice when past
+  the knee.
+- OOM recovery: bump memory by a factor with a cluster-wide cap
+  (reference ``optimize_job_worker_create_oom_resource.go``).
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..common.log import logger
+from .datastore import BrainDataStore
+
+DEFAULT_MEMORY_SAFETY = 1.2  # headroom over historical peak
+OOM_MEMORY_FACTOR = 1.5  # reference OOM algorithms use 1.5x-2x bumps
+
+
+@dataclass
+class OptimizePlan:
+    """Brain's answer to one optimize query (wire-friendly)."""
+
+    worker_num: int = 0  # 0 = no opinion
+    memory_mb_per_host: float = 0.0
+    reason: str = ""
+    # steps/s the history predicts at worker_num (0 = unknown)
+    predicted_speed: float = 0.0
+    extra: Dict = field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return self.worker_num <= 0 and self.memory_mb_per_host <= 0
+
+
+def _knee_of_curve(
+    curve: Dict[int, float], node_unit: int, max_workers: int, min_gain: float
+) -> int:
+    """Largest world size on ``curve`` whose marginal speedup per host is
+    still ≥ ``min_gain`` of linear; the classic scaling-knee rule the
+    local ThroughputScalingOptimizer applies online, applied offline to
+    history here."""
+    sizes = sorted(s for s in curve if s <= max_workers)
+    if not sizes:
+        return 0
+    best = sizes[0]
+    for prev, cur in zip(sizes, sizes[1:]):
+        gained = curve[cur] - curve[prev]
+        per_host = gained / max(1, cur - prev)
+        linear_per_host = curve[prev] / prev if prev else 0.0
+        if linear_per_host <= 0 or per_host >= min_gain * linear_per_host:
+            best = cur
+        else:
+            break
+    # snap to slice granularity
+    if node_unit > 1:
+        best = (best // node_unit) * node_unit
+    return best
+
+
+class JobCreateResourceAlgorithm:
+    """Initial resources for a brand-new job (reference
+    ``optimize_job_worker_create_resource.go``): warm-start from similar
+    completed jobs; cold-start returns no opinion so the master falls
+    back to its configured defaults."""
+
+    def __init__(self, store: BrainDataStore, min_gain: float = 0.4):
+        self._store = store
+        self._min_gain = min_gain
+
+    def optimize(
+        self,
+        model_signature: str,
+        workload: str = "",
+        node_unit: int = 1,
+        max_workers: int = 0,
+    ) -> OptimizePlan:
+        history = self._store.similar_jobs(model_signature, workload)
+        if not history:
+            return OptimizePlan(reason="cold start: no similar job history")
+        uuids = [j.job_uuid for j in history]
+        curve = self._store.speed_by_world_size(uuids)
+        limit = max_workers or max((j.worker_num for j in history), default=0)
+        worker_num = _knee_of_curve(curve, node_unit, limit, self._min_gain)
+        if worker_num <= 0:
+            # history exists but carries no usable speed curve; recommend
+            # the most common successful size
+            sizes = sorted(j.worker_num for j in history if j.worker_num > 0)
+            worker_num = sizes[len(sizes) // 2] if sizes else 0
+        peak_mem = self._store.peak_memory(uuids)
+        return OptimizePlan(
+            worker_num=worker_num,
+            memory_mb_per_host=peak_mem * DEFAULT_MEMORY_SAFETY,
+            predicted_speed=curve.get(worker_num, 0.0),
+            reason=f"warm start from {len(history)} similar jobs",
+            extra={"speed_curve": {str(k): v for k, v in curve.items()}},
+        )
+
+
+class JobRunningResourceAlgorithm:
+    """Adjust a running job (reference
+    ``optimize_job_worker_resource.go``): combine the job's own observed
+    scaling points with history from similar jobs, and recommend the
+    knee. A recommendation equal to the current size means hold."""
+
+    def __init__(self, store: BrainDataStore, min_gain: float = 0.4):
+        self._store = store
+        self._min_gain = min_gain
+
+    def optimize(
+        self,
+        job_uuid: str,
+        current_workers: int,
+        node_unit: int = 1,
+        max_workers: int = 0,
+    ) -> OptimizePlan:
+        job = self._store.get_job(job_uuid)
+        if job is None:
+            return OptimizePlan(reason=f"unknown job {job_uuid}")
+        own_curve = self._store.speed_by_world_size([job_uuid])
+        similar = self._store.similar_jobs(job.model_signature, job.workload)
+        hist_curve = self._store.speed_by_world_size(
+            [j.job_uuid for j in similar]
+        )
+        # Own observations dominate; history fills in sizes not yet tried.
+        curve = dict(hist_curve)
+        curve.update(own_curve)
+        if not curve:
+            return OptimizePlan(reason="no scaling observations yet")
+        limit = max_workers or max(max(curve), current_workers)
+        target = _knee_of_curve(curve, node_unit, limit, self._min_gain)
+        if target <= 0 or target == current_workers:
+            return OptimizePlan(
+                reason=f"hold at {current_workers} (knee={target or 'n/a'})"
+            )
+        return OptimizePlan(
+            worker_num=target,
+            predicted_speed=curve.get(target, 0.0),
+            reason=(
+                f"scaling knee at {target} hosts "
+                f"(observed {sorted(own_curve)}, history {sorted(hist_curve)})"
+            ),
+        )
+
+
+class OomRecoveryAlgorithm:
+    """Memory bump after an OOM (reference
+    ``optimize_job_worker_create_oom_resource.go``): factor increase over
+    the observed peak, capped by the per-host limit."""
+
+    def __init__(self, store: BrainDataStore, memory_limit_mb: float = 0.0):
+        self._store = store
+        self._limit = memory_limit_mb
+
+    def optimize(self, job_uuid: str) -> OptimizePlan:
+        peak = self._store.peak_memory([job_uuid])
+        if peak <= 0:
+            # no usage data: nothing principled to say
+            return OptimizePlan(reason="no memory observations for job")
+        target = peak * OOM_MEMORY_FACTOR
+        if self._limit and target > self._limit:
+            if peak >= self._limit:
+                logger.warning(
+                    "job %s OOM at peak %.0f MB already at limit %.0f MB",
+                    job_uuid,
+                    peak,
+                    self._limit,
+                )
+                return OptimizePlan(
+                    reason="peak memory already at cluster limit",
+                    extra={"at_limit": True},
+                )
+            target = self._limit
+        self._store.add_event(job_uuid, "oom_recovery_plan", detail=f"{target:.0f}MB")
+        return OptimizePlan(
+            memory_mb_per_host=target,
+            reason=f"OOM recovery: {peak:.0f} MB peak -> {target:.0f} MB",
+        )

@@ -1,0 +1,138 @@
+"""Brain client: the master-side consumer of the Brain service.
+
+Reference: ``dlrover/python/brain/client.py`` (``GlobalBrainClient``) —
+a thin typed wrapper; every call degrades to None on transport failure
+so the master never depends on Brain availability.
+"""
+
+from typing import Optional
+
+from ..common.log import logger
+from ..rpc.client import MasterClient
+from . import messages as bm
+
+
+class BrainClient:
+    def __init__(self, brain_addr: str, service_type: str = "", retries: int = 2):
+        self._client = MasterClient(
+            brain_addr,
+            node_id=-1,
+            node_type="master",
+            service_type=service_type,
+            retries=retries,
+        )
+
+    # -- writes ------------------------------------------------------------
+
+    def report_job(
+        self,
+        job_uuid: str,
+        job_name: str = "",
+        model_signature: str = "",
+        workload: str = "jax",
+        worker_num: int = 0,
+        node_unit: int = 1,
+        status: str = "running",
+    ) -> bool:
+        try:
+            self._client.report(
+                bm.BrainJobReport(
+                    job_uuid=job_uuid,
+                    job_name=job_name,
+                    model_signature=model_signature,
+                    workload=workload,
+                    worker_num=worker_num,
+                    node_unit=node_unit,
+                    status=status,
+                )
+            )
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain report_job failed: %r", e)
+            return False
+
+    def report_metrics(
+        self,
+        job_uuid: str,
+        world_size: int = 0,
+        steps_per_second: float = 0.0,
+        tokens_per_second: float = 0.0,
+        peak_memory_mb: float = 0.0,
+        cpu_percent: float = 0.0,
+    ) -> bool:
+        try:
+            self._client.report(
+                bm.BrainMetricReport(
+                    job_uuid=job_uuid,
+                    world_size=world_size,
+                    steps_per_second=steps_per_second,
+                    tokens_per_second=tokens_per_second,
+                    peak_memory_mb=peak_memory_mb,
+                    cpu_percent=cpu_percent,
+                )
+            )
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain report_metrics failed: %r", e)
+            return False
+
+    def report_event(
+        self, job_uuid: str, event_type: str, node_id: int = -1, detail: str = ""
+    ) -> bool:
+        try:
+            self._client.report(
+                bm.BrainEventReport(
+                    job_uuid=job_uuid,
+                    event_type=event_type,
+                    node_id=node_id,
+                    detail=detail,
+                )
+            )
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain report_event failed: %r", e)
+            return False
+
+    # -- reads -------------------------------------------------------------
+
+    def get_optimization_plan(
+        self,
+        stage: str,
+        job_uuid: str = "",
+        model_signature: str = "",
+        workload: str = "",
+        current_workers: int = 0,
+        node_unit: int = 1,
+        max_workers: int = 0,
+    ) -> Optional[bm.BrainOptimizeResponse]:
+        try:
+            resp = self._client.get(
+                bm.BrainOptimizeRequest(
+                    stage=stage,
+                    job_uuid=job_uuid,
+                    model_signature=model_signature,
+                    workload=workload,
+                    current_workers=current_workers,
+                    node_unit=node_unit,
+                    max_workers=max_workers,
+                )
+            )
+            if isinstance(resp, bm.BrainOptimizeResponse):
+                return resp
+            return None
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain optimize(%s) unreachable: %r", stage, e)
+            return None
+
+    def get_job_info(self, job_uuid: str) -> Optional[bm.BrainJobInfo]:
+        try:
+            resp = self._client.get(bm.BrainJobQuery(job_uuid=job_uuid))
+            if isinstance(resp, bm.BrainJobInfo) and resp.job_name:
+                return resp
+            return None
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain job query unreachable: %r", e)
+            return None
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,282 @@
+"""Brain datastore: persistent cross-job metric history.
+
+Reference: ``dlrover/go/brain/pkg/datastore/`` — a MySQL-backed store of
+job metadata + runtime metrics that the optimizer algorithms mine.  The
+TPU build uses sqlite (single file, zero-dependency, transactional),
+which matches the deployment shape: one Brain per cluster, modest write
+rates (one sample per job per ~30 s), read-mostly optimization queries.
+"""
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class JobRecord:
+    """One job's identity + outcome (reference datastore job table)."""
+
+    job_uuid: str
+    job_name: str = ""
+    # Signature fields drive similarity matching across jobs: same model
+    # scale + workload type ⇒ history is transferable.
+    model_signature: str = ""  # e.g. "gpt2-small-124M"
+    workload: str = "jax"  # jax | torch | custom
+    worker_num: int = 0
+    node_unit: int = 1
+    status: str = "running"  # running | completed | failed | oom
+    created_at: float = field(default_factory=time.time)
+    finished_at: float = 0.0
+    extra: Dict = field(default_factory=dict)
+
+
+@dataclass
+class JobMetricSample:
+    """One runtime observation of a running job."""
+
+    job_uuid: str
+    timestamp: float = field(default_factory=time.time)
+    world_size: int = 0
+    steps_per_second: float = 0.0
+    tokens_per_second: float = 0.0
+    peak_memory_mb: float = 0.0
+    cpu_percent: float = 0.0
+
+
+class BrainDataStore:
+    """Thread-safe sqlite store. ``path=':memory:'`` for tests."""
+
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._mu = threading.Lock()
+        with self._mu:
+            self._conn.executescript(
+                """
+                CREATE TABLE IF NOT EXISTS jobs (
+                    job_uuid TEXT PRIMARY KEY,
+                    job_name TEXT,
+                    model_signature TEXT,
+                    workload TEXT,
+                    worker_num INTEGER,
+                    node_unit INTEGER,
+                    status TEXT,
+                    created_at REAL,
+                    finished_at REAL,
+                    extra TEXT
+                );
+                CREATE TABLE IF NOT EXISTS metrics (
+                    job_uuid TEXT,
+                    timestamp REAL,
+                    world_size INTEGER,
+                    steps_per_second REAL,
+                    tokens_per_second REAL,
+                    peak_memory_mb REAL,
+                    cpu_percent REAL
+                );
+                CREATE INDEX IF NOT EXISTS idx_metrics_job
+                    ON metrics (job_uuid, timestamp);
+                CREATE TABLE IF NOT EXISTS events (
+                    job_uuid TEXT,
+                    timestamp REAL,
+                    event_type TEXT,
+                    node_id INTEGER,
+                    detail TEXT
+                );
+                """
+            )
+            self._conn.commit()
+
+    # -- jobs --------------------------------------------------------------
+
+    def upsert_job(self, job: JobRecord) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT INTO jobs VALUES (?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(job_uuid) DO UPDATE SET "
+                "job_name=excluded.job_name, "
+                "model_signature=excluded.model_signature, "
+                "workload=excluded.workload, "
+                "worker_num=excluded.worker_num, "
+                "node_unit=excluded.node_unit, "
+                "status=excluded.status, "
+                "finished_at=excluded.finished_at, "
+                "extra=excluded.extra",
+                (
+                    job.job_uuid,
+                    job.job_name,
+                    job.model_signature,
+                    job.workload,
+                    job.worker_num,
+                    job.node_unit,
+                    job.status,
+                    job.created_at,
+                    job.finished_at,
+                    json.dumps(job.extra),
+                ),
+            )
+            self._conn.commit()
+
+    def update_job_status(self, job_uuid: str, status: str) -> None:
+        finished = (
+            time.time() if status in ("completed", "failed", "oom") else 0.0
+        )
+        with self._mu:
+            self._conn.execute(
+                "UPDATE jobs SET status=?, finished_at=? WHERE job_uuid=?",
+                (status, finished, job_uuid),
+            )
+            self._conn.commit()
+
+    def get_job(self, job_uuid: str) -> Optional[JobRecord]:
+        with self._mu:
+            row = self._conn.execute(
+                "SELECT * FROM jobs WHERE job_uuid=?", (job_uuid,)
+            ).fetchone()
+        return self._row_to_job(row) if row else None
+
+    def similar_jobs(
+        self,
+        model_signature: str,
+        workload: str = "",
+        status: str = "completed",
+        limit: int = 50,
+    ) -> List[JobRecord]:
+        """History transferable to a new job: same model signature (and
+        workload, when given), most recent first."""
+        q = "SELECT * FROM jobs WHERE model_signature=? AND status=?"
+        args: List = [model_signature, status]
+        if workload:
+            q += " AND workload=?"
+            args.append(workload)
+        q += " ORDER BY created_at DESC LIMIT ?"
+        args.append(limit)
+        with self._mu:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self._row_to_job(r) for r in rows]
+
+    # -- metrics -----------------------------------------------------------
+
+    def add_metric(self, sample: JobMetricSample) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT INTO metrics VALUES (?,?,?,?,?,?,?)",
+                (
+                    sample.job_uuid,
+                    sample.timestamp,
+                    sample.world_size,
+                    sample.steps_per_second,
+                    sample.tokens_per_second,
+                    sample.peak_memory_mb,
+                    sample.cpu_percent,
+                ),
+            )
+            self._conn.commit()
+
+    def job_metrics(
+        self, job_uuid: str, since: float = 0.0, limit: int = 1000
+    ) -> List[JobMetricSample]:
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT * FROM metrics WHERE job_uuid=? AND timestamp>=? "
+                "ORDER BY timestamp ASC LIMIT ?",
+                (job_uuid, since, limit),
+            ).fetchall()
+        return [
+            JobMetricSample(
+                job_uuid=r[0],
+                timestamp=r[1],
+                world_size=r[2],
+                steps_per_second=r[3],
+                tokens_per_second=r[4],
+                peak_memory_mb=r[5],
+                cpu_percent=r[6],
+            )
+            for r in rows
+        ]
+
+    def speed_by_world_size(self, job_uuids: List[str]) -> Dict[int, float]:
+        """Best observed steps/s per world size across the given jobs —
+        the scaling curve the create-stage optimizer mines."""
+        if not job_uuids:
+            return {}
+        marks = ",".join("?" * len(job_uuids))
+        with self._mu:
+            rows = self._conn.execute(
+                f"SELECT world_size, MAX(steps_per_second) FROM metrics "
+                f"WHERE job_uuid IN ({marks}) AND world_size>0 "
+                f"GROUP BY world_size",
+                job_uuids,
+            ).fetchall()
+        return {int(w): float(s) for w, s in rows if s}
+
+    def peak_memory(self, job_uuids: List[str]) -> float:
+        if not job_uuids:
+            return 0.0
+        marks = ",".join("?" * len(job_uuids))
+        with self._mu:
+            row = self._conn.execute(
+                f"SELECT MAX(peak_memory_mb) FROM metrics "
+                f"WHERE job_uuid IN ({marks})",
+                job_uuids,
+            ).fetchone()
+        return float(row[0] or 0.0)
+
+    # -- events ------------------------------------------------------------
+
+    def add_event(
+        self, job_uuid: str, event_type: str, node_id: int = -1, detail: str = ""
+    ) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT INTO events VALUES (?,?,?,?,?)",
+                (job_uuid, time.time(), event_type, node_id, detail),
+            )
+            self._conn.commit()
+
+    def job_events(self, job_uuid: str, event_type: str = "") -> List[Dict]:
+        q = "SELECT * FROM events WHERE job_uuid=?"
+        args: List = [job_uuid]
+        if event_type:
+            q += " AND event_type=?"
+            args.append(event_type)
+        with self._mu:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            {
+                "job_uuid": r[0],
+                "timestamp": r[1],
+                "event_type": r[2],
+                "node_id": r[3],
+                "detail": r[4],
+            }
+            for r in rows
+        ]
+
+    def close(self) -> None:
+        with self._mu:
+            self._conn.close()
+
+    @staticmethod
+    def _row_to_job(row) -> JobRecord:
+        return JobRecord(
+            job_uuid=row[0],
+            job_name=row[1],
+            model_signature=row[2],
+            workload=row[3],
+            worker_num=row[4],
+            node_unit=row[5],
+            status=row[6],
+            created_at=row[7],
+            finished_at=row[8],
+            extra=json.loads(row[9] or "{}"),
+        )
+
+
+def job_record_to_dict(job: JobRecord) -> Dict:
+    return asdict(job)

@@ -1,0 +1,89 @@
+"""Brain wire messages (msgpack dataclasses over the 2-verb transport).
+
+Reference: ``dlrover/proto/brain.proto`` — the Brain has its own message
+surface separate from the master⇄agent one.  Same serialization registry
+as :mod:`dlrover_tpu.common.comm`.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from ..common.serialize import register_message
+
+
+@register_message
+@dataclass
+class BrainJobReport:
+    """Create/update one job's identity + outcome."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    model_signature: str = ""
+    workload: str = "jax"
+    worker_num: int = 0
+    node_unit: int = 1
+    status: str = "running"
+
+
+@register_message
+@dataclass
+class BrainMetricReport:
+    """One runtime metrics sample from a running job's master."""
+
+    job_uuid: str = ""
+    world_size: int = 0
+    steps_per_second: float = 0.0
+    tokens_per_second: float = 0.0
+    peak_memory_mb: float = 0.0
+    cpu_percent: float = 0.0
+
+
+@register_message
+@dataclass
+class BrainEventReport:
+    job_uuid: str = ""
+    event_type: str = ""
+    node_id: int = -1
+    detail: str = ""
+
+
+@register_message
+@dataclass
+class BrainOptimizeRequest:
+    """Stage-based optimize query (reference brain_pb2 optimize RPC)."""
+
+    stage: str = "create"  # create | running | oom
+    job_uuid: str = ""
+    model_signature: str = ""
+    workload: str = ""
+    current_workers: int = 0
+    node_unit: int = 1
+    max_workers: int = 0
+
+
+@register_message
+@dataclass
+class BrainOptimizeResponse:
+    worker_num: int = 0
+    memory_mb_per_host: float = 0.0
+    predicted_speed: float = 0.0
+    reason: str = ""
+    extra: Dict = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class BrainJobQuery:
+    job_uuid: str = ""
+
+
+@register_message
+@dataclass
+class BrainJobInfo:
+    job_uuid: str = ""
+    job_name: str = ""
+    model_signature: str = ""
+    workload: str = ""
+    worker_num: int = 0
+    status: str = ""
+    metric_count: int = 0

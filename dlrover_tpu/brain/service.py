@@ -1,0 +1,195 @@
+"""Brain service: the RPC surface over datastore + algorithms.
+
+Reference: ``dlrover/go/brain/pkg/server/`` (gRPC optimize/persist
+service).  Rides the same 2-verb msgpack transport as the master
+(:mod:`dlrover_tpu.rpc.server`), so one server stack serves both roles:
+``report`` persists job/metric/event writes, ``get`` answers optimize
+and history queries.
+"""
+
+from typing import Optional, Tuple
+
+from ..common import comm
+from ..common.log import logger
+from ..common.serialize import dumps, loads
+from ..rpc.server import ServicerApi, create_master_server
+from . import messages as bm
+from .algorithms import (
+    JobCreateResourceAlgorithm,
+    JobRunningResourceAlgorithm,
+    OomRecoveryAlgorithm,
+    OptimizePlan,
+)
+from .datastore import BrainDataStore, JobMetricSample, JobRecord
+
+
+class BrainServicer(ServicerApi):
+    def __init__(
+        self,
+        store: BrainDataStore,
+        memory_limit_mb: float = 0.0,
+        min_gain: float = 0.4,
+    ):
+        self._store = store
+        self._create_algo = JobCreateResourceAlgorithm(store, min_gain)
+        self._running_algo = JobRunningResourceAlgorithm(store, min_gain)
+        self._oom_algo = OomRecoveryAlgorithm(store, memory_limit_mb)
+
+    # -- transport entry points -------------------------------------------
+
+    def report(self, request_bytes: bytes) -> bytes:
+        req = loads(request_bytes)
+        msg = loads(req.data) if isinstance(req, comm.BaseRequest) else req
+        try:
+            if isinstance(msg, bm.BrainJobReport):
+                self._store.upsert_job(
+                    JobRecord(
+                        job_uuid=msg.job_uuid,
+                        job_name=msg.job_name,
+                        model_signature=msg.model_signature,
+                        workload=msg.workload,
+                        worker_num=msg.worker_num,
+                        node_unit=msg.node_unit,
+                        status=msg.status,
+                    )
+                )
+            elif isinstance(msg, bm.BrainMetricReport):
+                self._store.add_metric(
+                    JobMetricSample(
+                        job_uuid=msg.job_uuid,
+                        world_size=msg.world_size,
+                        steps_per_second=msg.steps_per_second,
+                        tokens_per_second=msg.tokens_per_second,
+                        peak_memory_mb=msg.peak_memory_mb,
+                        cpu_percent=msg.cpu_percent,
+                    )
+                )
+            elif isinstance(msg, bm.BrainEventReport):
+                self._store.add_event(
+                    msg.job_uuid, msg.event_type, msg.node_id, msg.detail
+                )
+            else:
+                return dumps(
+                    comm.BaseResponse(success=False, reason="unknown message")
+                )
+            return dumps(comm.BaseResponse(success=True))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("brain report failed")
+            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+
+    def get(self, request_bytes: bytes) -> bytes:
+        req = loads(request_bytes)
+        msg = loads(req.data) if isinstance(req, comm.BaseRequest) else req
+        try:
+            if isinstance(msg, bm.BrainOptimizeRequest):
+                result = self._optimize(msg)
+            elif isinstance(msg, bm.BrainJobQuery):
+                result = self._job_info(msg)
+            else:
+                return dumps(
+                    comm.BaseResponse(success=False, reason="unknown message")
+                )
+            return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("brain get failed")
+            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+
+    # -- handlers ----------------------------------------------------------
+
+    def _optimize(self, msg: bm.BrainOptimizeRequest) -> bm.BrainOptimizeResponse:
+        if msg.stage == "create":
+            plan = self._create_algo.optimize(
+                msg.model_signature,
+                workload=msg.workload,
+                node_unit=msg.node_unit,
+                max_workers=msg.max_workers,
+            )
+        elif msg.stage == "running":
+            plan = self._running_algo.optimize(
+                msg.job_uuid,
+                current_workers=msg.current_workers,
+                node_unit=msg.node_unit,
+                max_workers=msg.max_workers,
+            )
+        elif msg.stage == "oom":
+            plan = self._oom_algo.optimize(msg.job_uuid)
+        else:
+            plan = OptimizePlan(reason=f"unknown stage {msg.stage!r}")
+        return bm.BrainOptimizeResponse(
+            worker_num=plan.worker_num,
+            memory_mb_per_host=plan.memory_mb_per_host,
+            predicted_speed=plan.predicted_speed,
+            reason=plan.reason,
+            extra=plan.extra,
+        )
+
+    def _job_info(self, msg: bm.BrainJobQuery) -> bm.BrainJobInfo:
+        job = self._store.get_job(msg.job_uuid)
+        if job is None:
+            return bm.BrainJobInfo(job_uuid=msg.job_uuid)
+        return bm.BrainJobInfo(
+            job_uuid=job.job_uuid,
+            job_name=job.job_name,
+            model_signature=job.model_signature,
+            workload=job.workload,
+            worker_num=job.worker_num,
+            status=job.status,
+            metric_count=len(self._store.job_metrics(job.job_uuid)),
+        )
+
+
+class BrainService:
+    """The deployable unit: datastore + servicer + server."""
+
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        port: int = 0,
+        service_type: str = "",
+        memory_limit_mb: float = 0.0,
+    ):
+        from ..common.config import get_context
+        from ..common.constants import CommsType
+
+        self.store = BrainDataStore(db_path)
+        self.servicer = BrainServicer(self.store, memory_limit_mb)
+        service_type = service_type or get_context().master_comms()
+        self._server, self.port = create_master_server(
+            self.servicer, service_type, port
+        )
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("brain service on :%s", self.port)
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.store.close()
+
+
+def main(argv: Optional[Tuple[str, ...]] = None) -> None:
+    """``python -m dlrover_tpu.brain.service --port 8500 --db brain.db``"""
+    import argparse
+    import threading
+
+    parser = argparse.ArgumentParser("dlrover-tpu brain")
+    parser.add_argument("--port", type=int, default=8500)
+    parser.add_argument("--db", default="brain.db")
+    parser.add_argument("--memory_limit_mb", type=float, default=0.0)
+    args = parser.parse_args(argv)
+    service = BrainService(
+        db_path=args.db, port=args.port, memory_limit_mb=args.memory_limit_mb
+    )
+    service.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        service.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,9 @@ class Context:
     # Auto scaling / tuning
     auto_tuning_enabled: bool = False
     auto_scaling_interval_s: float = 30.0
+    # Brain service (cluster-level resource optimizer); empty = disabled.
+    brain_addr: str = ""
+    brain_report_interval_s: float = 30.0
     # Host RAM capacity and the job's starting per-host dataloader batch
     # size — inputs to the hyperparam strategy generator (0 = unknown,
     # generator disabled).

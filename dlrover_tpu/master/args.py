@@ -69,6 +69,12 @@ def build_master_parser() -> argparse.ArgumentParser:
         help="if set, write the bound RPC port to this file once serving "
         "(lets a parent process discover a port picked with --port 0)",
     )
+    parser.add_argument(
+        "--brain_addr",
+        default="",
+        help="cluster Brain service address host:port (empty = disabled); "
+        "enables cross-job history-driven resource optimization",
+    )
     return parser
 
 

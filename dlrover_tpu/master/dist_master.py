@@ -148,6 +148,39 @@ class DistributedJobMaster:
             else None
         )
 
+        # Cluster Brain (reference brain_optimizer.py:64): when configured,
+        # the running-stage optimizer consults cross-job history first and
+        # falls back to the local throughput optimizer; a reporter thread
+        # persists this job's record + metric samples into the Brain.
+        self.brain_reporter = None
+        if ctx.brain_addr:
+            from ..brain.client import BrainClient
+            from .resource.brain_optimizer import (
+                BrainReporter,
+                BrainResourceOptimizer,
+            )
+
+            brain_client = BrainClient(ctx.brain_addr)
+            self.brain_reporter = BrainReporter(
+                brain_client,
+                job_name=job_name,
+                model_signature=ctx.extra.get("model_signature", job_name),
+                worker_num=num_workers,
+                node_unit=node_unit,
+                perf_monitor=self.perf_monitor,
+                stats_collector=self.stats_collector,
+                world_size_fn=training_rdzv.world_size,
+                interval_s=ctx.brain_report_interval_s,
+            )
+            optimizer = BrainResourceOptimizer(
+                brain_client,
+                job_uuid=self.brain_reporter.job_uuid,
+                node_unit=node_unit,
+                max_workers=self.max_workers,
+                world_size_fn=training_rdzv.world_size,
+                fallback=optimizer,
+            )
+
         def _exclude_straggler(node_id: int) -> None:
             self.job_manager.migrate_straggler(node_id)
 
@@ -186,6 +219,8 @@ class DistributedJobMaster:
         """Reference dist_master.py:194 — server, managers, pre-check."""
         self._server.start()
         self.job_manager.start()
+        if self.brain_reporter is not None:
+            self.brain_reporter.start()
         self._job_ctx.set_stage(JobStage.PRE_CHECK)
         self._events.start(port=self.port)
         # Pre-check runs in the background so prepare() doesn't block the
@@ -241,6 +276,10 @@ class DistributedJobMaster:
 
     def _exit(self, reason: str) -> None:
         self.exit_reason = reason
+        if self.brain_reporter is not None:
+            self.brain_reporter.finish(
+                "completed" if reason == JobExitReason.SUCCEEDED else "failed"
+            )
         self._job_ctx.set_stage(JobStage.STOPPED, reason)
         self._events.job_stop(reason)
         logger.info("distributed master exiting: %s", reason)
@@ -248,6 +287,8 @@ class DistributedJobMaster:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.brain_reporter is not None:
+            self.brain_reporter.stop()
         self.diagnosis_master.stop()
         self.stats_collector.stop()
         self.auto_scaler.stop()

@@ -14,7 +14,11 @@ from .local_master import LocalJobMaster
 
 
 def run(namespace) -> int:
+    from ..common.config import get_context
     from ..common.constants import PlatformType
+
+    if getattr(namespace, "brain_addr", ""):
+        get_context().brain_addr = namespace.brain_addr
 
     if namespace.platform in (PlatformType.KUBERNETES, PlatformType.GKE_TPU):
         try:

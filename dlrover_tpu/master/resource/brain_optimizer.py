@@ -1,0 +1,155 @@
+"""Brain-backed resource optimizer + the master→Brain reporter.
+
+Reference: ``dlrover/python/master/resource/brain_optimizer.py:64``
+(``BrainResoureOptimizer`` querying the Brain gRPC service per stage,
+with every call degrading to an empty plan on RPC failure) and the
+``JobMetricCollector`` → Brain persistence path (``master/stats/``).
+"""
+
+import threading
+import uuid
+from typing import Optional
+
+from ...brain.client import BrainClient
+from ...common.log import logger
+from .optimizer import ResourceOptimizer, ResourcePlan
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    """Running-stage optimizer consulting the cluster Brain, falling back
+    to a local optimizer when Brain has no opinion or is unreachable."""
+
+    def __init__(
+        self,
+        brain_client: BrainClient,
+        job_uuid: str,
+        node_unit: int = 1,
+        max_workers: int = 0,
+        world_size_fn=None,
+        fallback: Optional[ResourceOptimizer] = None,
+    ):
+        self._brain = brain_client
+        self._job_uuid = job_uuid
+        self._node_unit = node_unit
+        self._max_workers = max_workers
+        self._world_size_fn = world_size_fn or (lambda: 0)
+        self._fallback = fallback
+
+    def generate_plan(self) -> ResourcePlan:
+        current = self._world_size_fn()
+        resp = self._brain.get_optimization_plan(
+            "running",
+            job_uuid=self._job_uuid,
+            current_workers=current,
+            node_unit=self._node_unit,
+            max_workers=self._max_workers,
+        )
+        if resp is not None and resp.worker_num > 0:
+            logger.info(
+                "brain plan: %s workers (%s)", resp.worker_num, resp.reason
+            )
+            return ResourcePlan(worker_num=resp.worker_num)
+        if self._fallback is not None:
+            return self._fallback.generate_plan()
+        return ResourcePlan()
+
+    # Delegate the signals the local fallback needs.
+    def record_world_size(self, size: int) -> None:
+        if self._fallback is not None and hasattr(
+            self._fallback, "record_world_size"
+        ):
+            self._fallback.record_world_size(size)
+
+
+class BrainReporter:
+    """Periodic job→Brain persistence thread (reference JobMetricCollector
+    feeding Brain). Registers the job, then streams metric samples from
+    the PerfMonitor + stats collector; marks final status on stop."""
+
+    def __init__(
+        self,
+        brain_client: BrainClient,
+        job_name: str,
+        model_signature: str = "",
+        workload: str = "jax",
+        worker_num: int = 0,
+        node_unit: int = 1,
+        perf_monitor=None,
+        stats_collector=None,
+        world_size_fn=None,
+        interval_s: float = 30.0,
+        job_uuid: str = "",
+    ):
+        self.job_uuid = job_uuid or f"{job_name}-{uuid.uuid4().hex[:8]}"
+        self._brain = brain_client
+        self._job_name = job_name
+        self._signature = model_signature
+        self._workload = workload
+        self._worker_num = worker_num
+        self._node_unit = node_unit
+        self._perf = perf_monitor
+        self._stats = stats_collector
+        self._world_size_fn = world_size_fn or (lambda: worker_num)
+        self._interval = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._brain.report_job(
+            self.job_uuid,
+            job_name=self._job_name,
+            model_signature=self._signature,
+            workload=self._workload,
+            worker_num=self._worker_num,
+            node_unit=self._node_unit,
+            status="running",
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name="brain-reporter", daemon=True
+        )
+        self._thread.start()
+
+    def sample_once(self) -> None:
+        steps_per_s = (
+            self._perf.steps_per_second() if self._perf is not None else 0.0
+        )
+        peak_mem = cpu = 0.0
+        if self._stats is not None:
+            peak_mem = self._stats.mean_memory_mb()
+            cpu = self._stats.mean_cpu_percent()
+        self._brain.report_metrics(
+            self.job_uuid,
+            world_size=self._world_size_fn(),
+            steps_per_second=steps_per_s,
+            peak_memory_mb=peak_mem,
+            cpu_percent=cpu,
+        )
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001
+                logger.debug("brain reporting failed", exc_info=True)
+
+    def stop(self) -> None:
+        """Stop sampling without recording a final status (master torn
+        down externally, e.g. tests); ``finish`` records the outcome."""
+        self._stopped.set()
+        self._thread = None
+
+    def finish(self, status: str) -> None:
+        self._stopped.set()
+        try:
+            self._brain.report_job(
+                self.job_uuid,
+                job_name=self._job_name,
+                model_signature=self._signature,
+                workload=self._workload,
+                worker_num=self._world_size_fn() or self._worker_num,
+                node_unit=self._node_unit,
+                status=status,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        self._thread = None

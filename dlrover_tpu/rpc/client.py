@@ -364,6 +364,9 @@ class MasterClient:
         resp = self.get(comm.SyncFinish(sync_name=sync_name))
         return resp.success if isinstance(resp, comm.SyncQueryResponse) else False
 
+    def close(self) -> None:
+        self._transport.close()
+
     # -- singleton ---------------------------------------------------------
 
     @classmethod

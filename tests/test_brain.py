@@ -1,0 +1,309 @@
+"""Brain service tests: datastore, algorithms, RPC service round-trips,
+and the master-side optimizer with graceful degradation.
+
+Reference behaviors: ``dlrover/go/brain`` optimizer algorithms + the
+master consuming Brain via ``master/resource/brain_optimizer.py:64``
+(every failure degrades to an empty/local plan).
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.brain import (
+    BrainClient,
+    BrainDataStore,
+    BrainService,
+    JobCreateResourceAlgorithm,
+    JobMetricSample,
+    JobRecord,
+    JobRunningResourceAlgorithm,
+)
+from dlrover_tpu.brain.algorithms import OomRecoveryAlgorithm
+from dlrover_tpu.master.resource.brain_optimizer import (
+    BrainReporter,
+    BrainResourceOptimizer,
+)
+from dlrover_tpu.master.resource.optimizer import ResourcePlan
+
+
+def _seed_history(store, signature="gpt2s", n_jobs=3):
+    """Completed jobs whose scaling curve saturates past 8 hosts."""
+    curve = {2: 1.8, 4: 3.5, 8: 6.4, 16: 7.0}  # knee at 8
+    for i in range(n_jobs):
+        uid = f"hist-{i}"
+        store.upsert_job(
+            JobRecord(
+                job_uuid=uid,
+                job_name=f"job{i}",
+                model_signature=signature,
+                workload="jax",
+                worker_num=8,
+                status="completed",
+            )
+        )
+        for size, speed in curve.items():
+            store.add_metric(
+                JobMetricSample(
+                    job_uuid=uid,
+                    world_size=size,
+                    steps_per_second=speed + 0.02 * i,
+                    peak_memory_mb=10_000 + 500 * i,
+                )
+            )
+
+
+class TestDataStore:
+    def test_job_upsert_and_status(self):
+        store = BrainDataStore()
+        store.upsert_job(JobRecord(job_uuid="j1", job_name="a", worker_num=4))
+        store.update_job_status("j1", "completed")
+        job = store.get_job("j1")
+        assert job.status == "completed" and job.finished_at > 0
+
+    def test_similar_jobs_filters(self):
+        store = BrainDataStore()
+        _seed_history(store, "sig-a")
+        store.upsert_job(
+            JobRecord(job_uuid="other", model_signature="sig-b", status="completed")
+        )
+        store.upsert_job(
+            JobRecord(job_uuid="failed", model_signature="sig-a", status="failed")
+        )
+        similar = store.similar_jobs("sig-a")
+        assert {j.job_uuid for j in similar} == {"hist-0", "hist-1", "hist-2"}
+
+    def test_speed_curve_and_peak_memory(self):
+        store = BrainDataStore()
+        _seed_history(store)
+        uuids = ["hist-0", "hist-1", "hist-2"]
+        curve = store.speed_by_world_size(uuids)
+        assert set(curve) == {2, 4, 8, 16}
+        assert curve[8] == pytest.approx(6.44, abs=0.01)  # max across jobs
+        assert store.peak_memory(uuids) == pytest.approx(11_000)
+
+    def test_events(self):
+        store = BrainDataStore()
+        store.add_event("j1", "oom", node_id=3, detail="16GB")
+        evts = store.job_events("j1", "oom")
+        assert len(evts) == 1 and evts[0]["node_id"] == 3
+
+    def test_persistence_across_reopen(self, tmp_path):
+        db = str(tmp_path / "brain.db")
+        store = BrainDataStore(db)
+        store.upsert_job(JobRecord(job_uuid="p1", job_name="persisted"))
+        store.close()
+        store2 = BrainDataStore(db)
+        assert store2.get_job("p1").job_name == "persisted"
+        store2.close()
+
+
+class TestAlgorithms:
+    def test_create_cold_start_has_no_opinion(self):
+        store = BrainDataStore()
+        plan = JobCreateResourceAlgorithm(store).optimize("unknown-model")
+        assert plan.empty() and "cold start" in plan.reason
+
+    def test_create_warm_start_picks_knee(self):
+        store = BrainDataStore()
+        _seed_history(store)
+        plan = JobCreateResourceAlgorithm(store).optimize("gpt2s")
+        # 8 -> 16 doubles hosts for +0.6 steps/s: past the knee
+        assert plan.worker_num == 8
+        assert plan.memory_mb_per_host > 11_000  # peak + safety margin
+        assert plan.predicted_speed > 6
+
+    def test_create_respects_node_unit(self):
+        store = BrainDataStore()
+        _seed_history(store)
+        plan = JobCreateResourceAlgorithm(store).optimize("gpt2s", node_unit=4)
+        assert plan.worker_num % 4 == 0
+
+    def test_running_holds_at_knee(self):
+        store = BrainDataStore()
+        _seed_history(store)
+        store.upsert_job(
+            JobRecord(job_uuid="live", model_signature="gpt2s", status="running")
+        )
+        algo = JobRunningResourceAlgorithm(store)
+        plan = algo.optimize("live", current_workers=8)
+        assert plan.worker_num == 0  # hold
+
+    def test_running_grows_toward_knee(self):
+        store = BrainDataStore()
+        _seed_history(store)
+        store.upsert_job(
+            JobRecord(job_uuid="live", model_signature="gpt2s", status="running")
+        )
+        store.add_metric(
+            JobMetricSample(job_uuid="live", world_size=2, steps_per_second=1.7)
+        )
+        plan = JobRunningResourceAlgorithm(store).optimize(
+            "live", current_workers=2
+        )
+        assert plan.worker_num == 8  # history says 8 still pays
+
+    def test_oom_recovery_bumps_memory(self):
+        store = BrainDataStore()
+        store.upsert_job(JobRecord(job_uuid="o1"))
+        store.add_metric(
+            JobMetricSample(job_uuid="o1", world_size=2, peak_memory_mb=10_000)
+        )
+        plan = OomRecoveryAlgorithm(store).optimize("o1")
+        assert plan.memory_mb_per_host == pytest.approx(15_000)
+
+    def test_oom_recovery_caps_at_limit(self):
+        store = BrainDataStore()
+        store.upsert_job(JobRecord(job_uuid="o2"))
+        store.add_metric(
+            JobMetricSample(job_uuid="o2", world_size=2, peak_memory_mb=10_000)
+        )
+        plan = OomRecoveryAlgorithm(store, memory_limit_mb=12_000).optimize("o2")
+        assert plan.memory_mb_per_host == pytest.approx(12_000)
+        at_limit = OomRecoveryAlgorithm(store, memory_limit_mb=9_000).optimize(
+            "o2"
+        )
+        assert at_limit.empty() and at_limit.extra.get("at_limit")
+
+
+class TestBrainServiceRpc:
+    @pytest.fixture()
+    def service(self):
+        svc = BrainService(db_path=":memory:", service_type="grpc")
+        svc.start()
+        yield svc
+        svc.stop()
+
+    def test_report_and_optimize_round_trip(self, service):
+        client = BrainClient(service.addr)
+        try:
+            assert client.report_job(
+                "rpc-1", job_name="j", model_signature="m1", worker_num=4,
+                status="completed",
+            )
+            for size, speed in {2: 1.0, 4: 1.9, 8: 2.1}.items():
+                assert client.report_metrics(
+                    "rpc-1", world_size=size, steps_per_second=speed,
+                    peak_memory_mb=8_000,
+                )
+            plan = client.get_optimization_plan(
+                "create", model_signature="m1"
+            )
+            assert plan is not None
+            assert plan.worker_num == 4  # 4->8 gains 0.2: past the knee
+            assert plan.memory_mb_per_host > 8_000
+            info = client.get_job_info("rpc-1")
+            assert info.metric_count == 3
+        finally:
+            client.close()
+
+    def test_event_report(self, service):
+        client = BrainClient(service.addr)
+        try:
+            assert client.report_event("rpc-2", "oom", node_id=1)
+            assert service.store.job_events("rpc-2", "oom")
+        finally:
+            client.close()
+
+
+class TestMasterIntegration:
+    def test_brain_optimizer_prefers_brain_plan(self):
+        svc = BrainService(db_path=":memory:")
+        svc.start()
+        try:
+            _seed_history(svc.store)
+            svc.store.upsert_job(
+                JobRecord(
+                    job_uuid="live", model_signature="gpt2s", status="running"
+                )
+            )
+            client = BrainClient(svc.addr)
+            opt = BrainResourceOptimizer(
+                client,
+                job_uuid="live",
+                world_size_fn=lambda: 2,
+                max_workers=16,
+            )
+            plan = opt.generate_plan()
+            assert plan.worker_num == 8
+        finally:
+            svc.stop()
+
+    def test_degrades_to_fallback_when_unreachable(self):
+        class LocalFallback:
+            def generate_plan(self):
+                return ResourcePlan(worker_num=3)
+
+        client = BrainClient("127.0.0.1:1", retries=1)  # nothing listens
+        opt = BrainResourceOptimizer(
+            client, job_uuid="x", fallback=LocalFallback()
+        )
+        plan = opt.generate_plan()
+        assert plan.worker_num == 3
+
+    def test_reporter_lifecycle(self):
+        svc = BrainService(db_path=":memory:")
+        svc.start()
+        try:
+            client = BrainClient(svc.addr)
+
+            class Perf:
+                def steps_per_second(self):
+                    return 2.5
+
+            reporter = BrainReporter(
+                client,
+                job_name="repjob",
+                model_signature="sig",
+                worker_num=2,
+                perf_monitor=Perf(),
+                world_size_fn=lambda: 2,
+                interval_s=3600,  # sample manually
+            )
+            reporter.start()
+            reporter.sample_once()
+            reporter.finish("completed")
+            job = svc.store.get_job(reporter.job_uuid)
+            assert job.status == "completed"
+            metrics = svc.store.job_metrics(reporter.job_uuid)
+            assert metrics and metrics[0].steps_per_second == 2.5
+        finally:
+            svc.stop()
+
+    def test_dist_master_wires_brain(self, tmp_ipc_dir, monkeypatch):
+        """brain_addr in context → master registers job + final status."""
+        from dlrover_tpu.common.config import get_context
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.scaler.base_scaler import NoopScaler
+
+        svc = BrainService(db_path=":memory:")
+        svc.start()
+        ctx = get_context()
+        old = ctx.brain_addr
+        ctx.brain_addr = svc.addr
+        try:
+            master = DistributedJobMaster(
+                scaler=NoopScaler(),
+                num_workers=1,
+                job_name="brainy",
+                pre_check_ops=[],
+                fresh_context=True,
+            )
+            assert master.brain_reporter is not None
+            master.prepare()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if svc.store.get_job(master.brain_reporter.job_uuid):
+                    break
+                time.sleep(0.1)
+            job = svc.store.get_job(master.brain_reporter.job_uuid)
+            assert job is not None and job.status == "running"
+            from dlrover_tpu.common.constants import JobExitReason
+
+            master._exit(JobExitReason.SUCCEEDED)
+            job = svc.store.get_job(master.brain_reporter.job_uuid)
+            assert job.status == "completed"
+            master.stop()
+        finally:
+            ctx.brain_addr = old
+            svc.stop()

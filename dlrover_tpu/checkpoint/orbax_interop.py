@@ -1,0 +1,141 @@
+"""Orbax interop: move checkpoints between flash-ckpt storage and Orbax.
+
+The reference's persistence formats are framework-native on purpose —
+``MegatronCheckpointSaver`` writes Megatron's tracker files,
+``FsdpDcpSaver`` writes torch-DCP ``.metadata`` (``ckpt_saver.py:1276,
+1314``) — so users can point their existing tooling at the output.  The
+JAX ecosystem's lingua franca is Orbax; this module is the equivalent
+bridge:
+
+- :func:`export_to_orbax` — a committed flash-ckpt step (done-dir
+  protocol, ``storage.py``) → a standard Orbax checkpoint any Orbax
+  user/tool can restore.
+- :func:`import_from_orbax` — an Orbax checkpoint → a committed
+  flash-ckpt step, so a job migrating onto this runtime resumes straight
+  through ``CheckpointEngine.load`` (memory-first path intact).
+
+Arrays travel as host numpy; leaf addressing uses the engine's
+``a/b/c`` path-string convention (``shm_handler._path_str``), which maps
+1:1 onto nested dicts — the shape Orbax's ``StandardCheckpointer``
+saves/restores natively.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..common.log import logger
+from .meta import CheckpointMeta, ShardRecord
+from .storage import PosixCheckpointStorage
+
+
+def paths_to_nested(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """{'a/b': x, 'a/c': y} → {'a': {'b': x, 'c': y}}."""
+    root: Dict[str, Any] = {}
+    for path, arr in arrays.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            nxt = node.setdefault(p, {})
+            if not isinstance(nxt, dict):
+                raise ValueError(
+                    f"leaf path {path!r} collides with an inner node"
+                )
+            node = nxt
+        node[parts[-1]] = arr
+    return root
+
+
+def nested_to_paths(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Inverse of :func:`paths_to_nested` (arbitrary nested dicts)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}/{k}" if prefix else str(k)
+            out.update(nested_to_paths(v, key))
+        return out
+    if prefix == "":
+        raise ValueError("checkpoint root must be a mapping")
+    out[prefix] = np.asarray(tree)
+    return out
+
+
+def export_to_orbax(
+    storage_root: str, orbax_dir: str, step: Optional[int] = None
+) -> int:
+    """Export a committed flash-ckpt step into ``orbax_dir`` (a fresh
+    directory; Orbax refuses to overwrite).  Returns the exported step.
+    Multi-host checkpoints are assembled to global arrays first
+    (``storage.load_step_host`` re-applies each record's index), so the
+    Orbax artifact is topology-free — restorable onto any mesh.
+    """
+    import orbax.checkpoint as ocp
+
+    storage = PosixCheckpointStorage(storage_root)
+    if step is None:
+        step = storage.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no committed checkpoint under {storage_root}")
+    arrays = storage.load_step_host(step)
+    if arrays is None:
+        raise FileNotFoundError(f"step {step} has no readable shards")
+    tree = paths_to_nested(arrays)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(orbax_dir), tree)
+    ckptr.wait_until_finished()
+    logger.info(
+        "exported flash-ckpt step %s (%s leaves) to orbax at %s",
+        step,
+        len(arrays),
+        orbax_dir,
+    )
+    return step
+
+
+def import_from_orbax(
+    orbax_dir: str, storage_root: str, step: int = 0
+) -> Dict[str, np.ndarray]:
+    """Import an Orbax checkpoint as committed flash-ckpt ``step`` (one
+    full shard, host_rank 0 — the topology-free layout every engine can
+    reshard from on load).  Returns the flat {path: array} map.
+    """
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    tree = ckptr.restore(os.path.abspath(orbax_dir))
+    arrays = nested_to_paths(tree)
+    if not arrays:
+        raise ValueError(f"orbax checkpoint at {orbax_dir} holds no arrays")
+
+    meta = CheckpointMeta(step=step, host_rank=0, num_hosts=1)
+    payload = bytearray()
+    for path in sorted(arrays):
+        # NOT ascontiguousarray: it promotes 0-d scalars to shape (1,),
+        # which would resurrect every scalar leaf as a 1-element vector.
+        arr = np.asarray(arrays[path], order="C")
+        rec = ShardRecord(
+            path=path,
+            global_shape=list(arr.shape),
+            local_shape=list(arr.shape),
+            dtype=str(arr.dtype),
+            index=[(0, d) for d in arr.shape],
+            offset=len(payload),
+            nbytes=int(arr.nbytes),
+            spec=[],
+        )
+        meta.records.append(rec)
+        payload += arr.tobytes()
+    meta.total_bytes = len(payload)
+
+    storage = PosixCheckpointStorage(storage_root)
+    storage.write_shard(meta, bytes(payload))
+    if not storage.commit(step, num_shards=1):
+        raise RuntimeError(f"commit failed for imported step {step}")
+    logger.info(
+        "imported orbax checkpoint %s as flash-ckpt step %s (%s leaves)",
+        orbax_dir,
+        step,
+        len(arrays),
+    )
+    return arrays

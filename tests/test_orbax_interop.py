@@ -1,0 +1,151 @@
+"""Orbax interop: flash-ckpt storage ⇄ Orbax round trips.
+
+The JAX-ecosystem analogue of the reference's framework-native
+persistence formats (Megatron tracker / torch-DCP metadata,
+``ckpt_saver.py:1276,1314``): our committed steps must be consumable by
+plain Orbax, and Orbax checkpoints must resume through the engine.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.orbax_interop import (
+    export_to_orbax,
+    import_from_orbax,
+    nested_to_paths,
+    paths_to_nested,
+)
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+from dlrover_tpu.checkpoint.storage import PosixCheckpointStorage
+
+
+@pytest.fixture(autouse=True)
+def fresh_saver(tmp_ipc_dir, monkeypatch):
+    job = f"orbax_{os.getpid()}_{id(tmp_ipc_dir)}"
+    monkeypatch.setenv("DLROVER_JOB_NAME", job)
+    AsyncCheckpointSaver.reset()
+    yield
+    AsyncCheckpointSaver.reset()
+    for name in os.listdir("/dev/shm"):
+        if name.startswith(f"dlrover_{job}_"):
+            SharedMemoryHandler(0, name=name.split(f"dlrover_{job}_", 1)[1]).unlink()
+
+
+class TestPathMapping:
+    def test_round_trip(self):
+        flat = {
+            "params/dense/kernel": np.ones((2, 3)),
+            "params/dense/bias": np.zeros(3),
+            "opt_state/0/count": np.int32(7),
+        }
+        nested = paths_to_nested(flat)
+        assert set(nested) == {"params", "opt_state"}
+        back = nested_to_paths(nested)
+        assert set(back) == set(flat)
+        np.testing.assert_array_equal(back["params/dense/kernel"], np.ones((2, 3)))
+
+    def test_collision_detected(self):
+        with pytest.raises(ValueError):
+            paths_to_nested({"a": np.ones(1), "a/b": np.ones(1)})
+
+
+class TestExportImport:
+    def _stage_step(self, root, step=3):
+        """Commit a step through the real engine (storage path)."""
+        state = {
+            "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "opt": {"mu": jnp.ones(4, jnp.bfloat16), "count": jnp.int32(9)},
+        }
+        engine = CheckpointEngine(
+            root, host_rank=0, num_hosts=1, standalone=True, replicate=False
+        )
+        try:
+            assert engine.save_to_storage(step, state)
+            assert engine.wait_saving(timeout=60)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+        return state
+
+    def test_export_then_plain_orbax_restore(self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        root = str(tmp_path / "flash")
+        state = self._stage_step(root)
+        odir = str(tmp_path / "orbax_out")
+        step = export_to_orbax(root, odir)
+        assert step == 3
+        # a plain Orbax user restores without any dlrover_tpu code
+        restored = ocp.StandardCheckpointer().restore(odir)
+        np.testing.assert_array_equal(restored["w"], np.asarray(state["w"]))
+        np.testing.assert_array_equal(
+            restored["opt"]["mu"].astype(np.float32),
+            np.asarray(state["opt"]["mu"]).astype(np.float32),
+        )
+        assert int(restored["opt"]["count"]) == 9
+
+    def test_import_then_engine_load(self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        # an Orbax user's existing checkpoint...
+        tree = {
+            "w": np.arange(8, dtype=np.float32).reshape(2, 4),
+            "opt": {"count": np.int32(5)},
+        }
+        odir = str(tmp_path / "orbax_in")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(odir, tree)
+        ckptr.wait_until_finished()
+
+        # ...imported, then resumed through the normal engine path
+        root = str(tmp_path / "flash")
+        import_from_orbax(odir, root, step=11)
+        assert PosixCheckpointStorage(root).latest_step() == 11
+
+        template = {
+            "w": jnp.zeros((2, 4), jnp.float32),
+            "opt": {"count": jnp.int32(0)},
+        }
+        engine = CheckpointEngine(
+            root, host_rank=0, num_hosts=1, standalone=True, replicate=False
+        )
+        try:
+            step, restored = engine.load(template)
+            assert step == 11
+            np.testing.assert_array_equal(np.asarray(restored["w"]), tree["w"])
+            assert int(restored["opt"]["count"]) == 5
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_export_sharded_checkpoint_assembles_global(self, tmp_path):
+        """A multi-device-sharded step exports as full global arrays."""
+        import orbax.checkpoint as ocp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+        x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("fsdp", "tp")))
+        root = str(tmp_path / "flash")
+        engine = CheckpointEngine(
+            root, mesh=mesh, host_rank=0, num_hosts=1,
+            standalone=True, replicate=False,
+        )
+        try:
+            assert engine.save_to_storage(1, {"x": x})
+            assert engine.wait_saving(timeout=60)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+        odir = str(tmp_path / "orbax_out")
+        export_to_orbax(root, odir, step=1)
+        restored = ocp.StandardCheckpointer().restore(odir)
+        np.testing.assert_array_equal(restored["x"], np.asarray(x))

@@ -8,6 +8,8 @@ logical axes:
   dp    pure data parallel (replicated params) — the elastic axis; on
         multislice jobs this is the across-slice/DCN axis
   fsdp  data parallel with sharded params/optimizer (ZeRO-style)
+  ep    expert parallel (MoE experts distributed; gshard-style a2a
+        dispatch rides this axis)
   tp    tensor (model) parallel — ICI neighbors
   sp    sequence/context parallel for long-context (ring attention)
   pp    pipeline stages
@@ -25,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp")
+MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp", "pp")
 
 
 @dataclass(frozen=True)
@@ -35,12 +37,13 @@ class MeshConfig:
 
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
     pp: int = 1
 
-    def axis_sizes(self) -> Tuple[int, int, int, int, int]:
-        return (self.dp, self.fsdp, self.tp, self.sp, self.pp)
+    def axis_sizes(self) -> Tuple[int, int, int, int, int, int]:
+        return (self.dp, self.fsdp, self.ep, self.tp, self.sp, self.pp)
 
     def fixed_product(self) -> int:
         return math.prod(s for s in self.axis_sizes() if s > 0)
@@ -68,7 +71,7 @@ class MeshConfig:
 
 @dataclass(frozen=True)
 class ResolvedMesh:
-    sizes: Tuple[int, int, int, int, int]
+    sizes: Tuple[int, int, int, int, int, int]
 
     def as_dict(self) -> Dict[str, int]:
         return dict(zip(MESH_AXES, self.sizes))
@@ -92,6 +95,7 @@ def build_mesh(
 
 def choose_mesh_shape(
     n_devices: int,
+    ep: int = 1,
     tp: int = 1,
     sp: int = 1,
     pp: int = 1,
@@ -99,20 +103,20 @@ def choose_mesh_shape(
 ) -> MeshConfig:
     """Pick dp/fsdp extents for an elastic world of ``n_devices``.
 
-    The ICI-bound extents (tp, sp, pp) are honored as given; the remaining
-    factor goes to fsdp (params sharded — memory-optimal) or dp.
+    The ICI-bound extents (ep, tp, sp, pp) are honored as given; the
+    remaining factor goes to fsdp (params sharded — memory-optimal) or dp.
     Raises if n_devices is not divisible — the caller (master) must pick a
-    world size that is a multiple of the slice unit (= tp*sp*pp).
+    world size that is a multiple of the slice unit (= ep*tp*sp*pp).
     """
-    inner = tp * sp * pp
+    inner = ep * tp * sp * pp
     if n_devices % inner != 0:
         raise ValueError(
-            f"world size {n_devices} not a multiple of tp*sp*pp={inner}"
+            f"world size {n_devices} not a multiple of ep*tp*sp*pp={inner}"
         )
     outer = n_devices // inner
     if prefer_fsdp:
-        return MeshConfig(dp=1, fsdp=outer, tp=tp, sp=sp, pp=pp)
-    return MeshConfig(dp=outer, fsdp=1, tp=tp, sp=sp, pp=pp)
+        return MeshConfig(dp=1, fsdp=outer, ep=ep, tp=tp, sp=sp, pp=pp)
+    return MeshConfig(dp=outer, fsdp=1, ep=ep, tp=tp, sp=sp, pp=pp)
 
 
 _CURRENT_MESH: List[Optional[Mesh]] = [None]

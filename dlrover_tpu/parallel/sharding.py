@@ -27,8 +27,11 @@ DEFAULT_RULES: LogicalRules = [
     ("embed", "fsdp"),
     ("heads", "tp"),
     ("kv", None),
+    ("kv_heads", None),  # GQA kv-head groups: few of them; keep local
     ("mlp", "tp"),
     ("vocab", "tp"),
+    ("expert", "ep"),  # MoE experts distributed over the ep axis
+    ("expert_mlp", "tp"),  # per-expert hidden dim still tensor-parallel
     ("stage", "pp"),
     ("norm", None),
 ]

@@ -24,11 +24,13 @@ from dlrover_tpu.parallel.train_step import (
 class TestMeshConfig:
     def test_resolve_free_axis(self):
         cfg = MeshConfig(dp=-1, fsdp=1, tp=2)
-        assert cfg.resolve(8).as_dict() == {"dp": 4, "fsdp": 1, "tp": 2, "sp": 1, "pp": 1}
+        assert cfg.resolve(8).as_dict() == {
+            "dp": 4, "fsdp": 1, "ep": 1, "tp": 2, "sp": 1, "pp": 1,
+        }
 
     def test_resolve_exact(self):
         cfg = MeshConfig(dp=2, fsdp=2, tp=2)
-        assert cfg.resolve(8).sizes == (2, 2, 2, 1, 1)
+        assert cfg.resolve(8).sizes == (2, 2, 1, 2, 1, 1)
 
     def test_resolve_mismatch_raises(self):
         with pytest.raises(ValueError):

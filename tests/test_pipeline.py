@@ -1,0 +1,153 @@
+"""Pipeline parallelism: SPMD GPipe schedule over the pp mesh axis.
+
+Correctness bar: the pipelined forward AND backward must match the plain
+sequential application of the same stages bit-for-bit (fp32 tolerance) —
+the schedule is an execution reordering, not an approximation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.pipeline import (
+    init_pipelined_blocks,
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+    stage_sharding,
+    transformer_stage_fn,
+)
+
+
+def _sequential(stage_params, microbatches, stage_fn):
+    """Ground truth: apply every stage in order to every microbatch."""
+    S = jax.tree.leaves(stage_params)[0].shape[0]
+    outs = []
+    for m in range(microbatches.shape[0]):
+        x = microbatches[m]
+        for s in range(S):
+            params_s = jax.tree.map(lambda p: p[s], stage_params)
+            x = stage_fn(params_s, x)
+        outs.append(x)
+    return jnp.stack(outs)
+
+
+class TestPipelineForward:
+    @pytest.mark.parametrize("stages,micro", [(2, 4), (4, 4), (4, 8)])
+    def test_matches_sequential(self, stages, micro):
+        mesh = build_mesh(MeshConfig(dp=8 // stages, fsdp=1, pp=stages))
+        params = init_pipelined_blocks(
+            jax.random.PRNGKey(0), stages, layers_per_stage=2,
+            embed_dim=16, mlp_dim=32,
+        )
+        params = jax.device_put(params, stage_sharding(params, mesh))
+        x = jax.random.normal(jax.random.PRNGKey(1), (micro, 2, 8, 16))
+        with mesh:
+            got = pipeline_apply(
+                transformer_stage_fn, params, x, mesh
+            )
+        want = _sequential(params, x, transformer_stage_fn)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+    def test_single_stage_degenerates(self):
+        mesh = build_mesh(MeshConfig(dp=8, fsdp=1, pp=1))
+        params = init_pipelined_blocks(
+            jax.random.PRNGKey(0), 1, 2, embed_dim=16, mlp_dim=32
+        )
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8, 16))
+        got = pipeline_apply(transformer_stage_fn, params, x, mesh)
+        want = _sequential(params, x, transformer_stage_fn)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5)
+
+
+class TestPipelineBackward:
+    def test_grads_match_sequential(self):
+        stages, micro = 4, 4
+        mesh = build_mesh(MeshConfig(dp=2, fsdp=1, pp=stages))
+        params = init_pipelined_blocks(
+            jax.random.PRNGKey(0), stages, 1, embed_dim=16, mlp_dim=32
+        )
+        params = jax.device_put(params, stage_sharding(params, mesh))
+        x = jax.random.normal(jax.random.PRNGKey(1), (micro, 2, 8, 16))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (micro, 2, 8, 16))
+
+        def piped_loss(p):
+            with mesh:
+                y = pipeline_apply(transformer_stage_fn, p, x, mesh)
+            return jnp.mean((y - tgt) ** 2)
+
+        def seq_loss(p):
+            return jnp.mean((_sequential(p, x, transformer_stage_fn) - tgt) ** 2)
+
+        g_pipe = jax.grad(piped_loss)(params)
+        g_seq = jax.grad(seq_loss)(params)
+        for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+            )
+
+    def test_pipelined_lm_trains(self):
+        """End-to-end: embedding outside, pipelined blocks inside, loss
+        decreases — pp is a usable training axis, not a demo."""
+        import optax
+
+        stages, micro = 2, 4
+        mesh = build_mesh(MeshConfig(dp=4, fsdp=1, pp=stages))
+        V, D = 64, 16
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = {
+            "embed": jax.random.normal(k1, (V, D)) * 0.02,
+            "stages": init_pipelined_blocks(k2, stages, 1, D, 32),
+            "unembed": jax.random.normal(k3, (D, V)) * 0.02,
+        }
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        tokens = jax.random.randint(k3, (8, 16), 0, V)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        def loss_fn(p):
+            x = p["embed"][tokens]
+            mb = split_microbatches(x, micro)
+            with mesh:
+                y = pipeline_apply(transformer_stage_fn, p["stages"], mb, mesh)
+            y = merge_microbatches(y)
+            logits = y @ p["unembed"]
+            logps = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(
+                jnp.take_along_axis(logps, targets[..., None], axis=-1)
+            )
+
+        @jax.jit
+        def step(p, o):
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            up, o = tx.update(g, o)
+            return optax.apply_updates(p, up), o, loss
+
+        losses = []
+        for _ in range(10):
+            params, opt, loss = step(params, opt)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
+class TestHelpers:
+    def test_split_merge_roundtrip(self):
+        x = jnp.arange(24).reshape(8, 3)
+        mb = split_microbatches(x, 4)
+        assert mb.shape == (4, 2, 3)
+        np.testing.assert_array_equal(np.asarray(merge_microbatches(mb)), np.asarray(x))
+        with pytest.raises(ValueError):
+            split_microbatches(x, 5)
+
+    def test_stack_stage_params(self):
+        a = {"w": jnp.ones((2, 3))}
+        b = {"w": jnp.zeros((2, 3))}
+        stacked = stack_stage_params([a, b])
+        assert stacked["w"].shape == (2, 2, 3)

@@ -114,6 +114,11 @@ class CheckpointEngine:
             # No agent supervising us (reference start_saver_process
             # fallback, engine.py:118): run the saver in-process.
             self._saver_thread = AsyncCheckpointSaver.start_async_saving_ckpt()
+        # A persist-error marker surviving from a PREVIOUS incarnation is
+        # stale history (e.g. disk-full fixed, job resumed at a lower
+        # step): left in place it would fail-fast every wait_saving of
+        # the new run whose steps sit below the old failed step.
+        self.storage.clear_persist_error(self.host_rank)
         self._factory_q = SharedQueue(FACTORY_QUEUE)
         self._event_q = SharedQueue(EVENT_QUEUE)
         self._factory_q.put(
@@ -176,13 +181,40 @@ class CheckpointEngine:
     def wait_saving(self, timeout: float = 300.0) -> bool:
         """Block until the queued *storage* saves are persisted (tracker
         catches up). Memory-only saves don't gate this — they have no
-        pending disk work."""
+        pending disk work.
+
+        Fails fast (no full-timeout stall) when the saver reported a
+        persist error for this shard or its event-queue server vanished
+        (saver process crashed)."""
         if self._latest_storage_step < 0:
             return True
         deadline = time.time() + timeout
         while time.time() < deadline:
             if (self.storage.latest_step() or -1) >= self._latest_storage_step:
                 return True
+            err = self.storage.persist_error(self.host_rank)
+            if err is not None and err[0] >= self._latest_storage_step:
+                # Markers from OLDER steps are stale history — a newer
+                # save is in flight and may well succeed.
+                logger.error(
+                    "saver reported persist failure at step %s: %s",
+                    err[0],
+                    err[1],
+                )
+                return False
+            if not self._event_q.available():
+                # Re-check the tracker once: the saver may have committed
+                # and exited between our two probes.
+                if (
+                    self.storage.latest_step() or -1
+                ) >= self._latest_storage_step:
+                    return True
+                logger.error(
+                    "checkpoint saver is gone (event queue unreachable); "
+                    "step %s will not be persisted",
+                    self._latest_storage_step,
+                )
+                return False
             time.sleep(0.1)
         return False
 

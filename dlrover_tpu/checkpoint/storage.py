@@ -108,6 +108,31 @@ class PosixCheckpointStorage:
                 os.unlink(tmp)
             raise
 
+    # -- persist error channel (saver → blocked trainer) -------------------
+
+    def _error_path(self, rank: int) -> str:
+        return os.path.join(self.root, f".persist_error_{rank}")
+
+    def record_persist_error(self, rank: int, step: int, reason: str) -> None:
+        self._atomic_write(
+            self._error_path(rank), f"{step}\n{reason}".encode()
+        )
+
+    def clear_persist_error(self, rank: int) -> None:
+        try:
+            os.unlink(self._error_path(rank))
+        except OSError:
+            pass
+
+    def persist_error(self, rank: int):
+        """(step, reason) of the rank's last failed persist, or None."""
+        try:
+            with open(self._error_path(rank)) as f:
+                step_line, _, reason = f.read().partition("\n")
+                return int(step_line), reason
+        except (FileNotFoundError, ValueError):
+            return None
+
     # -- queries -----------------------------------------------------------
 
     def all_shards_done(self, step: int, num_shards: int) -> bool:

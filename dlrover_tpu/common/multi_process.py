@@ -436,6 +436,12 @@ class SharedQueue:
     def empty(self) -> bool:
         return self._client.call("empty")
 
+    def available(self) -> bool:
+        """True while a server is accepting on this queue's socket —
+        i.e. the owning process is alive (liveness probe for callers
+        blocked on work the server should be doing)."""
+        return self._client.available()
+
     def close(self) -> None:
         self._client.close()
         if self._server:

@@ -56,9 +56,16 @@ def proc_start_ticks(pid: int) -> Optional[int]:
 
 def kill_pid_if_same_incarnation(pid: int, start_ticks: int) -> bool:
     """SIGKILL the group of ``pid`` only when its kernel start time
-    still matches (never kills a recycled pid). True if signaled."""
+    still matches (never kills a recycled pid). True if signaled.
+
+    Unknown ``start_ticks`` (0/None) means the caller could not record
+    the incarnation — refuse rather than kill: by recovery time the pid
+    may belong to an unrelated process, and killing its whole group on a
+    guess is worse than leaking one orphan."""
+    if not start_ticks:
+        return False
     current = proc_start_ticks(pid)
-    if current is None or (start_ticks and current != start_ticks):
+    if current is None or current != start_ticks:
         return False
     try:
         os.killpg(os.getpgid(pid), signal.SIGKILL)

@@ -46,18 +46,9 @@ class ProcessHandle:
         return self.proc.poll()
 
     def kill(self) -> None:
-        if self.alive():
-            try:
-                os.killpg(self.proc.pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                self.proc.terminate()
-            try:
-                self.proc.wait(10)
-            except subprocess.TimeoutExpired:
-                try:
-                    os.killpg(self.proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    self.proc.kill()
+        from ...common.proc import kill_process_group
+
+        kill_process_group(self.proc, grace_s=10)
 
 
 class ProcessScaler(Scaler):

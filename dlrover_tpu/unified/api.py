@@ -123,7 +123,10 @@ class DLJobBuilder:
                     raise ValueError(
                         f"role {spec.name!r} lists unknown dependent {dep!r}"
                     )
-            if not spec.command and not spec.elastic:
+            if not spec.command:
+                # elastic roles too: their command is the training script
+                # the synthesized tpurun launcher will run (runtime.py
+                # wraps it); an empty command has nothing to launch.
                 raise ValueError(f"role {spec.name!r} has no command")
         return self._job
 

@@ -44,7 +44,18 @@ class PrimeManager:
         self._log_dir = log_dir
         self._interval = monitor_interval
         self._workers: Dict[str, RoleWorker] = {}
+        # Per-role sub-masters for elastic=True roles (reference
+        # ElasticMaster sub-master actor): one standalone master process
+        # per role; instances run under tpurun against it.
+        self._sub_masters: Dict[str, object] = {}
         self._stopped = threading.Event()
+        # Serializes the monitor's observe/failover step against stop():
+        # without it stop() can SIGKILL a worker while the monitor is
+        # mid-_observe, which would read FAILED and restart the worker
+        # AFTER stop() finished — a leaked role process. RLock because a
+        # failover inside _observe may escalate to stop() on the same
+        # thread (restart budget exhausted).
+        self._mu = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._job_restarts = 0
         self._max_job_restarts = max_job_restarts
@@ -88,10 +99,44 @@ class PrimeManager:
 
     def _start_vertex(self, vertex: RoleVertex) -> None:
         spec = self.graph.spec_of(vertex)
+        command = list(spec.command)
+        env = dict(spec.env)
+        if spec.elastic:
+            # Wrap the role's script in the tpurun launcher against a
+            # role-scoped sub-master (reference ElasticMaster sub-master
+            # actor driving agents inside worker actors): the role's
+            # instances form one elastic world with rendezvous, flash
+            # checkpoint and agent supervision of their own.
+            import sys
+
+            from ..common.constants import NodeEnv
+
+            master = self._ensure_sub_master(spec)
+            command = [
+                sys.executable,
+                "-m",
+                "dlrover_tpu.launcher.elastic_run",
+                "--nnodes",
+                str(spec.num_instances),
+                "--node_rank",
+                str(vertex.index),
+                "--max_restarts",
+                str(spec.max_restarts),
+            ] + command
+            role_job = f"{self.job.name}_{vertex.role}"
+            env.update(
+                {
+                    NodeEnv.MASTER_ADDR: master.addr,
+                    NodeEnv.JOB_NAME: role_job,
+                    NodeEnv.NODE_ID: str(vertex.index),
+                    NodeEnv.NODE_RANK: str(vertex.index),
+                    "DLROVER_IPC_NAMESPACE": f"{role_job}_n{vertex.index}",
+                }
+            )
         worker = RoleWorker(
             vertex,
-            spec.command,
-            env=spec.env,
+            command,
+            env=env,
             job_name=self.job.name,
             role_world=spec.num_instances,
             log_dir=self._log_dir,
@@ -99,13 +144,27 @@ class PrimeManager:
         worker.start()
         self._workers[vertex.vertex_id] = worker
 
+    def _ensure_sub_master(self, spec):
+        handle = self._sub_masters.get(spec.name)
+        if handle is not None and handle.proc.poll() is None:
+            return handle
+        from ..launcher.elastic_run import launch_local_master
+
+        handle = launch_local_master(
+            num_workers=spec.num_instances,
+            job_name=f"{self.job.name}_{spec.name}",
+        )
+        self._sub_masters[spec.name] = handle
+        return handle
+
     # -- supervision -------------------------------------------------------
 
     def _main_loop(self) -> None:
         """Reference :175 — poll vertices, drive failover/completion."""
         while not self._stopped.wait(self._interval):
             try:
-                self._observe()
+                with self._mu:
+                    self._observe()
             except Exception:
                 logger.exception("prime manager loop error")
             if self.status in (JobStatus.SUCCEEDED, JobStatus.FAILED):
@@ -197,11 +256,22 @@ class PrimeManager:
         self._save_state()
 
     def stop(self, status: str = JobStatus.STOPPED) -> None:
-        self._stopped.set()
-        for worker in self._workers.values():
-            worker.stop()
-        self.status = status
-        self._save_state()
+        # Take the monitor lock BEFORE killing anything: an in-flight
+        # _observe must finish (any worker it restarted lands in
+        # self._workers and gets stopped below); after _stopped is set
+        # under the lock, no later observe can revive a role.
+        with self._mu:
+            self._stopped.set()
+            for worker in self._workers.values():
+                worker.stop()
+            for handle in self._sub_masters.values():
+                try:
+                    handle.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._sub_masters.clear()
+            self.status = status
+            self._save_state()
 
     def wait(self, timeout: Optional[float] = None) -> str:
         deadline = None if timeout is None else time.time() + timeout

@@ -20,12 +20,14 @@ class PrimeMaster:
         state_backend: Optional[StateBackend] = None,
         log_dir: Optional[str] = None,
         monitor_interval: float = 0.5,
+        max_job_restarts: int = 1,
     ):
         self.manager = PrimeManager(
             job,
             state_backend=state_backend,
             log_dir=log_dir,
             monitor_interval=monitor_interval,
+            max_job_restarts=max_job_restarts,
         )
 
     def start(self) -> None:

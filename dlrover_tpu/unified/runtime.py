@@ -69,15 +69,23 @@ class RoleWorker:
         stdout = None
         if self._log_dir:
             os.makedirs(self._log_dir, exist_ok=True)
-            # per-launch files: restart_count resets on whole-job
-            # restarts, and overwriting the previous incarnation's log
-            # destroys exactly the evidence a failover investigation
-            # needs
+            # per-launch files: a restart builds a NEW RoleWorker (so an
+            # in-object counter would reset to 0) and restart_count
+            # resets on whole-job restarts — probe the directory for the
+            # first unused suffix instead; overwriting the previous
+            # incarnation's log destroys exactly the evidence a failover
+            # investigation needs
+            n = self._launches
+            while os.path.exists(
+                os.path.join(
+                    self._log_dir, f"{self.vertex.vertex_id}_{n}.log"
+                )
+            ):
+                n += 1
             path = os.path.join(
-                self._log_dir,
-                f"{self.vertex.vertex_id}_{self._launches}.log",
+                self._log_dir, f"{self.vertex.vertex_id}_{n}.log"
             )
-            self._launches += 1
+            self._launches = n + 1
             self._log_file = open(path, "wb")
             stdout = self._log_file
         self._proc = subprocess.Popen(

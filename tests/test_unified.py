@@ -138,6 +138,53 @@ class TestSupervision:
         ]
         assert (marker / "trainer_0").read_text() == "2"
 
+    @pytest.mark.slow
+    def test_elastic_role_runs_under_tpurun(self, tmp_path):
+        """elastic=True wraps the role in the tpurun launcher against a
+        role-scoped sub-master (reference ElasticMaster sub-master):
+        both instances must rendezvous into ONE world of size 2."""
+        marker = tmp_path / "world"
+        marker.mkdir()
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, pathlib\n"
+            "rank = os.environ['DLROVER_NODE_RANK']\n"
+            f"pathlib.Path(r'{marker}', f'r{{rank}}').write_text(\n"
+            "    os.environ['DLROVER_NUM_PROCESSES'])\n"
+        )
+        job = (
+            DLJobBuilder("eljob")
+            .node_num(1)
+            .device_per_node(2)
+            .role("trainer", [str(script)], num=2, device=1.0, elastic=True)
+            .build()
+        )
+        manager = PrimeManager(job, log_dir=str(tmp_path / "logs"))
+        env_backup = dict(os.environ)
+        os.environ["PYTHONPATH"] = os.pathsep.join(sys.path)
+        try:
+            manager.start()
+            assert manager._sub_masters  # sub-master actually spawned
+            assert manager.wait(timeout=90) == JobStatus.SUCCEEDED
+        finally:
+            manager.stop(manager.status)
+            os.environ.clear()
+            os.environ.update(env_backup)
+        assert sorted(p.name for p in marker.iterdir()) == ["r0", "r1"]
+        # one elastic world of both instances, not two worlds of one
+        assert (marker / "r0").read_text() == "2"
+        assert (marker / "r1").read_text() == "2"
+
+    def test_elastic_role_requires_command(self):
+        with pytest.raises(ValueError, match="no command"):
+            (
+                DLJobBuilder("bad")
+                .node_num(1)
+                .device_per_node(1)
+                .role("t", [], elastic=True)
+                .build()
+            )
+
     def test_failed_role_restarts_with_lineage(self, tmp_path):
         marker = tmp_path / "runs"
         marker.mkdir()

@@ -190,7 +190,10 @@ class CheckpointEngine:
             return True
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if (self.storage.latest_step() or -1) >= self._latest_storage_step:
+            latest = self.storage.latest_step()
+            # NOT `latest or -1`: a committed step 0 is falsy and the
+            # idiom would spin out the whole timeout on the first save.
+            if latest is not None and latest >= self._latest_storage_step:
                 return True
             err = self.storage.persist_error(self.host_rank)
             if err is not None and err[0] >= self._latest_storage_step:
@@ -205,9 +208,8 @@ class CheckpointEngine:
             if not self._event_q.available():
                 # Re-check the tracker once: the saver may have committed
                 # and exited between our two probes.
-                if (
-                    self.storage.latest_step() or -1
-                ) >= self._latest_storage_step:
+                latest = self.storage.latest_step()
+                if latest is not None and latest >= self._latest_storage_step:
                     return True
                 logger.error(
                     "checkpoint saver is gone (event queue unreachable); "

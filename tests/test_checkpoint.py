@@ -166,6 +166,21 @@ class TestEngineEndToEnd:
             engine2.shm.unlink()
             engine2.close()
 
+    def test_wait_saving_step_zero(self, tmp_path):
+        """Step 0 is falsy; `latest or -1` would spin the full timeout
+        on the very first persisted checkpoint of a job."""
+        import time as _time
+
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        try:
+            assert engine.save_to_storage(0, {"w": jnp.ones(4)})
+            t0 = _time.time()
+            assert engine.wait_saving(timeout=30)
+            assert _time.time() - t0 < 20
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
     def test_stale_persist_error_cleared_on_new_engine(self, tmp_path):
         """A marker left by a dead incarnation (step 100) must not
         fail-fast a resumed run saving lower steps."""

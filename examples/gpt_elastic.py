@@ -1,0 +1,81 @@
+"""Elastic GPT training with flash checkpointing — the flagship workflow.
+
+Run on one host (spawns a local master automatically):
+
+    tpurun --standalone --nnodes 1 examples/gpt_elastic.py
+
+Or against a running master on a multi-host slice:
+
+    DLROVER_MASTER_ADDR=<master:port> tpurun --nnodes 4 examples/gpt_elastic.py
+
+Kill the worker (or the whole host) mid-run: the agent re-rendezvouses,
+the script rebuilds the mesh from whatever world it lands in, and
+``engine.load`` resumes from the shm-staged step — storage only if the
+memory copy is gone. (Reference workflow: examples/pytorch/gpt elastic
+jobs + flash_checkpoint.)
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.parallel.mesh import build_mesh, choose_mesh_shape
+from dlrover_tpu.parallel.train_step import (
+    build_train_step,
+    default_optimizer,
+    init_train_state,
+)
+from dlrover_tpu.trainer.elastic import elastic_context
+
+TOTAL_STEPS = int(os.environ.get("TOTAL_STEPS", "200"))
+CKPT_DIR = os.environ.get("CKPT_DIR", "/tmp/gpt_elastic_ckpt")
+BATCH_PER_DEVICE = 2
+
+
+def main():
+    ctx = elastic_context()  # jax.distributed bootstrap from the agent env
+
+    n = len(jax.devices())
+    mesh = build_mesh(choose_mesh_shape(n, tp=1))
+    cfg = GPTConfig.tiny() if n <= 8 else GPTConfig.gpt2_small()
+    model = GPT(cfg)
+    tx = default_optimizer()
+    batch = BATCH_PER_DEVICE * n
+
+    tokens = jnp.zeros((batch, cfg.max_seq_len), jnp.int32)
+    state, shardings = init_train_state(model, tokens, mesh, tx)
+    step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, shardings)
+
+    engine = CheckpointEngine(CKPT_DIR, mesh=mesh)
+    start = 0
+    loaded, restored = engine.load(state)
+    if loaded >= 0 and restored is not None:
+        state, start = restored, loaded + 1
+        print(f"resumed from step {loaded}")
+
+    rng = np.random.default_rng(ctx.process_id)
+    for step in range(start, TOTAL_STEPS):
+        x = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq_len)),
+            jnp.int32,
+        )
+        y = jnp.roll(x, -1, axis=1)
+        ctx.start_step_timer()
+        state, loss = step_fn(state, x, y)
+        loss_val = float(loss)
+        engine.save_to_memory(step, state)  # sub-second stage to shm
+        if step % 50 == 0:
+            engine.save_to_storage(step, state)  # async persist
+        ctx.report_step(step)  # feeds master PerfMonitor + hang detector
+        if step % 10 == 0:
+            print(f"step {step}: loss {loss_val:.4f}")
+    engine.wait_saving()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

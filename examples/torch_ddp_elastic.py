@@ -1,0 +1,76 @@
+"""Elastic torch DDP (gloo) training on the same runtime — the
+framework-agnostic workflow (reference parity: the TF/PS stack role,
+SURVEY.md §2.12).
+
+    tpurun --standalone --nnodes 2 examples/torch_ddp_elastic.py
+
+The SAME master/rendezvous/agent/flash-ckpt stack supervises torch
+workers: the rendezvous coordinator address becomes the TCPStore
+endpoint, and state_dicts stage through the shm checkpoint engine.
+"""
+
+import os
+
+import numpy as np
+import torch
+
+from dlrover_tpu.trainer.torch_elastic import (
+    TorchCheckpointEngine,
+    TorchElasticContext,
+)
+
+TOTAL_STEPS = int(os.environ.get("TOTAL_STEPS", "200"))
+CKPT_DIR = os.environ.get("CKPT_DIR", "/tmp/torch_ddp_ckpt")
+
+
+def main():
+    ctx = TorchElasticContext.from_env()
+    distributed = ctx.initialize_torch()
+
+    torch.manual_seed(0)  # identical init everywhere (DDP invariant)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(16, 64), torch.nn.ReLU(), torch.nn.Linear(64, 1)
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+
+    engine = TorchCheckpointEngine(
+        os.path.join(CKPT_DIR, f"rank{ctx.node_rank}"),
+        host_rank=ctx.node_rank,
+        num_hosts=1,
+    )
+    start = 0
+    step0, restored = engine.load(
+        {"model": model.state_dict(), "opt": opt.state_dict()}
+    )
+    if step0 >= 0 and restored is not None:
+        model.load_state_dict(restored["model"])
+        opt.load_state_dict(restored["opt"])
+        start = step0 + 1
+        print(f"rank {ctx.process_id} resumed from step {step0}")
+
+    rng = np.random.default_rng(ctx.process_id)
+    w_true = torch.randn(16, 1)
+    for step in range(start, TOTAL_STEPS):
+        x = torch.tensor(rng.standard_normal((32, 16)), dtype=torch.float32)
+        y = x @ w_true
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        opt.zero_grad()
+        loss.backward()
+        if distributed:
+            for p in model.parameters():  # hand-rolled DDP allreduce
+                torch.distributed.all_reduce(
+                    p.grad, op=torch.distributed.ReduceOp.AVG
+                )
+        opt.step()
+        engine.save_to_memory(
+            step, {"model": model.state_dict(), "opt": opt.state_dict()}
+        )
+        if step % 20 == 0:
+            print(f"rank {ctx.process_id} step {step}: loss {loss.item():.5f}")
+    if distributed:
+        ctx.shutdown()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,7 @@ full/fsdp/megatron engines; SURVEY.md §2.4).
 
 import os
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -319,8 +319,9 @@ class CheckpointEngine:
         logger.info("restored step %s from host memory", meta.step)
         return meta.step, restored
 
-    def _load_from_storage(self, template: Any):
-        step = self.storage.latest_step()
+    def _load_from_storage(self, template: Any, step: Optional[int] = None):
+        if step is None:
+            step = self.storage.latest_step()
         if step is None:
             return None
         arrays = self.storage.load_step_host(step)
@@ -337,6 +338,63 @@ class CheckpointEngine:
             return None
         logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
         return step, restored
+
+    def _gather_steps(self, step: int) -> List[int]:
+        """Every host's restored step (single-process: just ours)."""
+        if jax.process_count() <= 1:
+            return [step]
+        from jax.experimental import multihost_utils
+
+        return [
+            int(s) for s in multihost_utils.process_allgather(np.int64(step))
+        ]
+
+    def load_consistent(self, template: Any) -> Tuple[int, Optional[Any]]:
+        """``load`` + cross-host consistency (reference
+        ``verify_all_rank_step_consistent`` allgather,
+        flash_checkpoint/engine.py:74-95).
+
+        ``load`` is per-host (own shm → peer → storage), so after a node
+        replacement hosts can legally restore DIFFERENT steps — and a
+        step-count fix alone would train a model whose shards mix two
+        checkpoints. When the allgathered steps disagree, every host
+        discards its restore and reloads the newest step available to
+        ALL of them: the smallest committed-storage step across hosts
+        (storage is the shared tier; commit markers make it complete).
+        No common storage step → everyone starts fresh, consistently.
+        """
+        step, restored = self.load(template)
+        steps = self._gather_steps(step)
+        if len(set(steps)) == 1:
+            return step, restored
+        storage_latest = self.storage.latest_step()
+        target = min(
+            self._gather_steps(
+                -1 if storage_latest is None else storage_latest
+            )
+        )
+        logger.warning(
+            "hosts restored different steps %s; reloading common storage "
+            "step %s",
+            steps,
+            target,
+        )
+        if target < 0:
+            return -1, None
+        if step == target and restored is not None:
+            # our restore already holds exactly this step's data (memory
+            # stages and storage commits of a step are the same bytes)
+            return step, restored
+        del restored
+        return target, self._reload(template, target)
+
+    def _reload(self, template: Any, step: int):
+        result = self._load_from_storage(template, step=step)
+        if result is None:
+            raise RuntimeError(
+                f"agreed checkpoint step {step} unreadable from storage"
+            )
+        return result[1]
 
     # -- shard topology (reference get_local/global_shard_num) -------------
 

@@ -96,7 +96,15 @@ class ElasticContext:
     def report_step(
         self, step: int, elapsed_s: float = 0.0, tokens_per_s: float = 0.0
     ) -> None:
-        """Feed the master's PerfMonitor / hang detector."""
+        """Feed the master's PerfMonitor / hang detector. When
+        ``start_step_timer`` was called for this step, the elapsed time
+        is filled in automatically."""
+        if elapsed_s == 0.0 and self._step_t0 > 0.0:
+            elapsed_s = time.monotonic() - self._step_t0
+        # Always drop the timer: a stale t0 surviving an explicit
+        # elapsed_s report would span multiple steps at the next
+        # auto-timed report and skew the PerfMonitor.
+        self._step_t0 = 0.0
         if self.client is None:
             return
         try:
@@ -107,7 +115,9 @@ class ElasticContext:
             logger.debug("step report failed: %s", e)
 
     def start_step_timer(self) -> None:
-        self._step_t0 = time.time()
+        # monotonic: an NTP step between here and report_step must not
+        # produce negative/inflated durations for the PerfMonitor
+        self._step_t0 = time.monotonic()
 
     def start_config_tuner(self, dataloader=None):
         """Start the auto-tuning poller when the launcher enabled it

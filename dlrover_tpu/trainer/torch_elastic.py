@@ -96,6 +96,27 @@ def _map_tree_like(tree: Any, template: Any, fn, coerce_plain: bool = False) -> 
     return tree
 
 
+# marks tensor positions in the broadcast plain-value skeleton (a bare
+# None would collide with legitimately-None plain leaves)
+_TENSOR_POS = "__dlrover_tensor_pos__"
+
+
+def _merge_plain(skeleton: Any, tensors: Any) -> Any:
+    """Overlay a broadcast plain-value skeleton (tensor positions marked
+    with a sentinel) onto the broadcast tensor tree: tensor positions
+    keep the tensor, every other position takes the source rank's plain
+    value."""
+    if isinstance(skeleton, dict):
+        return {k: _merge_plain(skeleton[k], tensors[k]) for k in skeleton}
+    if isinstance(skeleton, (list, tuple)):
+        return type(skeleton)(
+            _merge_plain(a, b) for a, b in zip(skeleton, tensors)
+        )
+    if isinstance(skeleton, str) and skeleton == _TENSOR_POS:
+        return tensors
+    return skeleton
+
+
 @dataclass
 class TorchElasticContext(ElasticContext):
     """:class:`ElasticContext` for torch workers: same env contract, same
@@ -212,6 +233,59 @@ class TorchCheckpointEngine:
         out = _map_tree_like(restored, template, _numpy_to_torch)
         out = _map_tree_like(out, template, None, coerce_plain=True)
         return step, out
+
+    def load_consistent(self, template: Dict) -> Tuple[int, Optional[Dict]]:
+        """``load`` + cross-rank consistency (reference
+        ``verify_all_rank_step_consistent``).
+
+        DDP state is a full replica per rank, so when ranks restore
+        different steps (a replaced rank found nothing; a survivor held
+        a newer shm step) the BEST rank's whole state is broadcast to
+        everyone — no progress is lost and every rank enters the loop
+        with identical parameters, optimizer slots, and step count.
+        Aligning only the step counter would leave the replaced rank on
+        fresh-init weights that gradient averaging never reconciles."""
+        step, restored = self.load(template)
+        if not torch.distributed.is_initialized():
+            return step, restored
+        world = torch.distributed.get_world_size()
+        steps = [torch.zeros(1, dtype=torch.int64) for _ in range(world)]
+        torch.distributed.all_gather(
+            steps, torch.tensor([step], dtype=torch.int64)
+        )
+        steps = [int(t.item()) for t in steps]
+        best = max(steps)
+        if all(s == best for s in steps):
+            return step, restored
+        src = steps.index(best)
+        logger.warning(
+            "ranks restored different steps %s; broadcasting rank %s's "
+            "step-%s state to all",
+            steps,
+            src,
+            best,
+        )
+        if best < 0:
+            return -1, None
+        # Broadcast tensor-by-tensor over the template's structure; the
+        # source rank sends its restored values, everyone else receives
+        # into (a copy of) the template.
+        base = restored if step == best and restored is not None else template
+
+        def bcast(leaf: torch.Tensor) -> torch.Tensor:
+            t = leaf.detach().clone()
+            torch.distributed.broadcast(t, src=src)
+            return t
+
+        out = _map_tree(base, bcast)
+        # Plain-Python leaves (scheduler-decayed lr in param_groups,
+        # older-torch Adam int step counts) must ALSO come from the
+        # source — a replaced rank's template holds fresh-init values
+        # that DDP's gradient sync would never reconcile.
+        skeleton = [_map_tree(base, lambda t: _TENSOR_POS)]
+        torch.distributed.broadcast_object_list(skeleton, src=src)
+        out = _merge_plain(skeleton[0], out)
+        return best, out
 
     def get_local_shard_num(self) -> int:
         return self._engine.get_local_shard_num()

@@ -52,7 +52,10 @@ def main():
 
     engine = CheckpointEngine(CKPT_DIR, mesh=mesh)
     start = 0
-    loaded, restored = engine.load(state)
+    # load_consistent: hosts restore independently (shm/peer/storage) and
+    # can land on different steps after a replacement — on disagreement
+    # every host reloads the common storage step so shards never mix.
+    loaded, restored = engine.load_consistent(state)
     if loaded >= 0 and restored is not None:
         state, start = restored, loaded + 1
         print(f"resumed from step {loaded}")
@@ -66,13 +69,15 @@ def main():
         y = jnp.roll(x, -1, axis=1)
         ctx.start_step_timer()
         state, loss = step_fn(state, x, y)
-        loss_val = float(loss)
-        engine.save_to_memory(step, state)  # sub-second stage to shm
         if step % 50 == 0:
-            engine.save_to_storage(step, state)  # async persist
+            engine.save_to_storage(step, state)  # stages + async persist
+        else:
+            engine.save_to_memory(step, state)  # sub-second stage to shm
         ctx.report_step(step)  # feeds master PerfMonitor + hang detector
         if step % 10 == 0:
-            print(f"step {step}: loss {loss_val:.4f}")
+            # fetch the scalar only when printing: a per-step float()
+            # would force a host-device sync and defeat async dispatch
+            print(f"step {step}: loss {float(loss):.4f}")
     engine.wait_saving()
     print("done")
 

@@ -32,6 +32,12 @@ def main():
         torch.nn.Linear(16, 64), torch.nn.ReLU(), torch.nn.Linear(64, 1)
     )
     opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    # Materialize Adam's slot state before building the load template:
+    # engine.load restores only leaves present in the template, and a
+    # never-stepped Adam has an empty state dict.
+    model(torch.zeros(1, 16)).sum().backward()
+    opt.step()
+    opt.zero_grad()
 
     engine = TorchCheckpointEngine(
         os.path.join(CKPT_DIR, f"rank{ctx.node_rank}"),
@@ -39,7 +45,10 @@ def main():
         num_hosts=1,
     )
     start = 0
-    step0, restored = engine.load(
+    # load_consistent: a replaced rank with no local checkpoint receives
+    # the best surviving rank's full state by broadcast, so every rank
+    # enters the loop with identical weights AND the same step count.
+    step0, restored = engine.load_consistent(
         {"model": model.state_dict(), "opt": opt.state_dict()}
     )
     if step0 >= 0 and restored is not None:
@@ -57,10 +66,14 @@ def main():
         opt.zero_grad()
         loss.backward()
         if distributed:
-            for p in model.parameters():  # hand-rolled DDP allreduce
+            # hand-rolled DDP allreduce (SUM/world: AVG is NCCL-only on
+            # older torch builds; SUM+divide is portable across backends)
+            world = torch.distributed.get_world_size()
+            for p in model.parameters():
                 torch.distributed.all_reduce(
-                    p.grad, op=torch.distributed.ReduceOp.AVG
+                    p.grad, op=torch.distributed.ReduceOp.SUM
                 )
+                p.grad /= world
         opt.step()
         engine.save_to_memory(
             step, {"model": model.state_dict(), "opt": opt.state_dict()}

@@ -195,6 +195,49 @@ class TestEngineEndToEnd:
             engine.shm.unlink()
             engine.close()
 
+    def test_load_consistent_reloads_common_storage_step(
+        self, tmp_path, monkeypatch
+    ):
+        """Simulated host disagreement: this host restored memory step 5
+        but 'another host' only reached step 3 — everyone must fall back
+        to the common storage step, never mixing shards of two steps."""
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        try:
+            assert engine.save_to_storage(3, {"w": jnp.full((4,), 3.0)})
+            assert engine.wait_saving(timeout=30)
+            assert engine.save_to_memory(5, {"w": jnp.full((4,), 5.0)})
+
+            calls = {"n": 0}
+
+            def fake_gather(step):
+                calls["n"] += 1
+                # first gather: restored steps disagree (peer got 3);
+                # second gather: storage latest (both see 3)
+                return [step, 3]
+
+            monkeypatch.setattr(engine, "_gather_steps", fake_gather)
+            step, restored = engine.load_consistent(
+                {"w": jnp.zeros(4, jnp.float32)}
+            )
+            assert step == 3
+            np.testing.assert_array_equal(np.asarray(restored["w"]), 3.0)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_load_consistent_agreement_keeps_memory_restore(self, tmp_path):
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        try:
+            assert engine.save_to_memory(8, {"w": jnp.full((4,), 8.0)})
+            step, restored = engine.load_consistent(
+                {"w": jnp.zeros(4, jnp.float32)}
+            )
+            assert step == 8
+            np.testing.assert_array_equal(np.asarray(restored["w"]), 8.0)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
     def test_remesh_restore(self, tmp_path):
         """Save a sharded train state under fsdp=4,tp=2 and restore it into
         a dp=2,fsdp=2,tp=2 template — the elastic re-mesh path."""

@@ -94,6 +94,38 @@ class TestHooks:
 
 
 class TestCollector:
+    def test_step_profiler_overhead_bounded(self, timer):
+        """The reference claims ≤0.5% overhead enabled (xpu_timer
+        README). CI-grade bound: the wrapper must add only a small
+        constant per step — we assert < 1 ms absolute overhead on a
+        median step, which at the flagship's 0.36 s/step is < 0.3%."""
+        import time as _time
+
+        fn = jax.jit(lambda x: (jnp.sin(x) @ x).sum())
+        x = jnp.ones((512, 512))
+        float(fn(x))  # compile
+
+        def min_time(call, iters=30):
+            # MIN of interleaved-ish samples: robust to noisy-neighbor
+            # descheduling, which shifts medians on loaded CI runners
+            best = float("inf")
+            for _ in range(iters):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(call())
+                best = min(best, _time.perf_counter() - t0)
+            return best
+
+        prof = StepProfiler(timer=timer, auto_costs=True)
+        # warm the profiler's one-time HLO probe out of the measurement
+        prof.step(fn, x, step=0)
+        bare = min_time(lambda: fn(x))
+        wrapped = min_time(lambda: prof.step(fn, x, step=1))
+        overhead = wrapped - bare
+        bound = max(1e-3, 0.05 * bare)
+        assert overhead < bound, (
+            f"profiler adds {overhead*1e3:.2f} ms/step (bare {bare*1e3:.2f})"
+        )
+
     def test_parse_prometheus(self):
         text = (
             "# comment\n"

@@ -147,6 +147,87 @@ class TestTorchCheckpointEngine:
             engine.close()
 
 
+class TestLoadConsistent:
+    def test_identity_without_process_group(self, tmp_path):
+        engine = TorchCheckpointEngine(
+            str(tmp_path / "c"), host_rank=0, num_hosts=1,
+            standalone=True, replicate=False,
+        )
+        try:
+            state = {"w": torch.arange(4, dtype=torch.float32)}
+            assert engine.save_to_memory(7, state)
+            step, restored = engine.load_consistent(
+                {"w": torch.zeros(4)}
+            )
+            assert step == 7
+            assert torch.equal(restored["w"], state["w"])
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_replaced_rank_receives_broadcast(self, tmp_path):
+        """Two real gloo ranks: rank 1 restores nothing, rank 0 holds a
+        trained step — both must come out with rank 0's exact state and
+        step (the replaced-node recovery path of the torch family)."""
+        import pathlib
+        import subprocess
+        import sys as _sys
+
+        import dlrover_tpu
+        from dlrover_tpu.agent.rendezvous import find_free_port
+
+        repo_root = str(pathlib.Path(dlrover_tpu.__file__).parents[1])
+        port = find_free_port("127.0.0.1")
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys, json, pathlib\n"
+            "sys.path.insert(0, %r)\n"
+            "import torch\n"
+            "from dlrover_tpu.trainer.torch_elastic import TorchCheckpointEngine\n"
+            "rank = int(os.environ['RANK'])\n"
+            "torch.distributed.init_process_group(\n"
+            "    'gloo', init_method='tcp://127.0.0.1:%d',\n"
+            "    rank=rank, world_size=2)\n"
+            "base = pathlib.Path(%r)\n"
+            "engine = TorchCheckpointEngine(\n"
+            "    str(base / f'rank{rank}'), host_rank=rank, num_hosts=1,\n"
+            "    standalone=True, replicate=False)\n"
+            "if rank == 0:\n"
+            "    engine.save_to_memory(\n"
+            "        9, {'w': torch.full((4,), 3.5), 'lr': 0.5})\n"
+            "torch.distributed.barrier()\n"
+            "step, got = engine.load_consistent(\n"
+            "    {'w': torch.zeros(4), 'lr': 0.1})\n"
+            "out = {'step': step, 'w': got['w'].tolist() if got else None,\n"
+            "       'lr': got['lr'] if got else None}\n"
+            "(base / f'out{rank}.json').write_text(json.dumps(out))\n"
+            "engine.shm.unlink(); engine.close()\n"
+            % (repo_root, port, str(tmp_path))
+        )
+        procs = [
+            subprocess.Popen(
+                [_sys.executable, str(script)],
+                env={
+                    **os.environ,
+                    "RANK": str(r),
+                    "DLROVER_JOB_NAME": f"bc_{os.getpid()}_{r}",
+                },
+            )
+            for r in range(2)
+        ]
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        import json
+
+        for r in range(2):
+            out = json.loads((tmp_path / f"out{r}.json").read_text())
+            assert out["step"] == 9, (r, out)
+            assert out["w"] == [3.5] * 4, (r, out)
+            # plain-Python leaves (e.g. scheduler-decayed lr) must also
+            # come from the source rank, not the local template
+            assert out["lr"] == 0.5, (r, out)
+
+
 class TestTorchElasticContext:
     def test_from_env_contract(self, monkeypatch):
         monkeypatch.setenv(NodeEnv.NODE_RANK, "2")
@@ -217,7 +298,10 @@ engine = TorchCheckpointEngine(
     str(ckpt_dir), host_rank=rank, num_hosts=1, replicate=False
 )
 start = 0
-step0, restored = engine.load(
+# consistency across ranks: a rank restoring a different step receives
+# the best rank's full state by broadcast (tested for real below by the
+# shm wipe after the kill)
+step0, restored = engine.load_consistent(
     {"model": model.state_dict(), "opt": opt.state_dict()}
 )
 if step0 >= 0 and restored is not None:

@@ -121,6 +121,39 @@ def pipeline_apply(
     )(stage_params, microbatches)
 
 
+def refold_stages(stage_params: Any, new_num_stages: int) -> Any:
+    """Re-stage params for a different pipeline depth (elastic re-mesh
+    of the pp axis): ``[S, L, ...]`` per-layer stacks become
+    ``[S', (S·L)/S', ...]`` — consecutive stages concatenate in order,
+    so the composed function is unchanged (stage fns scan their layer
+    axis). The new stage count must divide the total layer count S·L.
+
+    Contract: every leaf is layer-stacked ``[stages, layers, ...]`` (the
+    shape :func:`init_pipelined_blocks` produces and a scanning stage fn
+    consumes). Per-stage leaves WITHOUT a layer axis cannot be refolded
+    — their second dim would be misread as layers — and are rejected by
+    the rank check below only when rank < 2; keep all stage params
+    layer-stacked."""
+
+    def refold(leaf):
+        if leaf.ndim < 2:
+            raise ValueError(
+                f"refold_stages needs [stages, layers, ...] leaves; got "
+                f"shape {leaf.shape}"
+            )
+        s, l = leaf.shape[0], leaf.shape[1]
+        total = s * l
+        if total % new_num_stages:
+            raise ValueError(
+                f"{total} layers not divisible into {new_num_stages} stages"
+            )
+        return leaf.reshape(
+            (new_num_stages, total // new_num_stages) + leaf.shape[2:]
+        )
+
+    return jax.tree.map(refold, stage_params)
+
+
 def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
     """[B, ...] → [M, B/M, ...]."""
     B = x.shape[0]

@@ -15,6 +15,7 @@ from dlrover_tpu.parallel.pipeline import (
     init_pipelined_blocks,
     merge_microbatches,
     pipeline_apply,
+    refold_stages,
     split_microbatches,
     stack_stage_params,
     stage_sharding,
@@ -135,6 +136,73 @@ class TestPipelineBackward:
             losses.append(float(loss))
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
+
+
+class TestPipelineCheckpointRemesh:
+    def test_stage_params_survive_pp_remesh(self, tmp_path, monkeypatch):
+        """Flash-ckpt the stacked stage params under pp=4, restore onto a
+        pp=2 mesh: the engine's shard records re-shard the leading stage
+        axis, and the pipelined forward stays bit-identical — elastic
+        re-meshing covers the pipeline axis too."""
+        import os
+
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+        from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+        from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+
+        job = f"ppremesh_{os.getpid()}"
+        monkeypatch.setenv("DLROVER_JOB_NAME", job)
+        AsyncCheckpointSaver.reset()
+        try:
+            mesh4 = build_mesh(MeshConfig(dp=2, fsdp=1, pp=4))
+            params = init_pipelined_blocks(
+                jax.random.PRNGKey(0), 4, 1, embed_dim=16, mlp_dim=32
+            )
+            params = jax.device_put(params, stage_sharding(params, mesh4))
+            x = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8, 16))
+            with mesh4:
+                want = pipeline_apply(transformer_stage_fn, params, x, mesh4)
+
+            engine = CheckpointEngine(
+                str(tmp_path / "ckpt"), mesh=mesh4, standalone=True,
+                replicate=False,
+            )
+            try:
+                assert engine.save_to_storage(1, {"stages": params})
+                assert engine.wait_saving(timeout=60)
+                engine.shm.invalidate()  # force the storage re-shard path
+
+                mesh2 = build_mesh(MeshConfig(dp=4, fsdp=1, pp=2))
+                template = jax.tree.map(
+                    lambda p: jnp.zeros_like(p), params
+                )
+                template = jax.device_put(
+                    template, stage_sharding(template, mesh2)
+                )
+                step, restored = engine.load({"stages": template})
+                assert step == 1
+                # 4 saved stages fold into 2 deeper stages (1 per rank)
+                folded = refold_stages(restored["stages"], 2)
+                folded = jax.device_put(
+                    folded, stage_sharding(folded, mesh2)
+                )
+                with mesh2:
+                    got = pipeline_apply(
+                        transformer_stage_fn, folded, x, mesh2
+                    )
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+                )
+            finally:
+                engine.shm.unlink()
+                engine.close()
+        finally:
+            AsyncCheckpointSaver.reset()
+            for name in os.listdir("/dev/shm"):
+                if name.startswith(f"dlrover_{job}_"):
+                    SharedMemoryHandler(
+                        0, name=name.split(f"dlrover_{job}_", 1)[1]
+                    ).unlink()
 
 
 class TestHelpers:

@@ -379,6 +379,10 @@ class JobStatusRequest:
 class JobStatusResponse:
     stage: str = ""
     exit_reason: str = ""
+    # live training health (reference headline metric: goodput %)
+    goodput: float = 0.0
+    steps_per_second: float = 0.0
+    last_step: int = 0
 
 
 # ---------------------------------------------------------------------------

@@ -28,7 +28,6 @@ class JobContext:
         self.master_actions = DiagnosisActionQueue()  # consumed by master loop
         self.node_actions = DiagnosisActionQueue()  # delivered via heartbeat
         self.start_time = time.time()
-        self.total_downtime_s = 0.0  # accumulated not-training time (goodput)
         self.last_training_step = 0
         self.last_step_time = 0.0
         # Tunables the master pushes to trainers (reference: paral config

@@ -1,13 +1,29 @@
 """Training performance monitor (reference: ``monitor/perf_monitor.py:45``).
 
 Tracks global-step progress and derives step speed; feeds hang detection
-(step watermark) and goodput accounting.
+(step watermark) and goodput accounting — the reference's headline metric
+(README.md:55-56: fault tolerance lifted goodput 69% → 95%). Goodput here
+is measured, not assumed: productive seconds are credited per observed
+step interval, capped at a tolerance over the running median step time,
+so rendezvous rounds, restarts, and hangs show up as the gap between
+productive and wall-clock time.
 """
 
+import statistics
 import threading
 import time
 from collections import deque
 from typing import Deque, Optional, Tuple
+
+# A step interval beyond this multiple of the median step time is
+# downtime (re-rendezvous, restart, hang) — only one median's worth of
+# it was actual training.
+_STALL_TOLERANCE = 3.0
+# The FIRST interval has no median to judge against (and legitimately
+# includes the jit compile, 20-40 s on TPU); credit at most this much of
+# it so an early crash-recovery hour can neither count as productive nor
+# poison the median baseline.
+_FIRST_INTERVAL_CAP_S = 120.0
 
 
 class PerfMonitor:
@@ -16,14 +32,53 @@ class PerfMonitor:
         self._samples: Deque[Tuple[int, float]] = deque(maxlen=window)
         self._start_time = time.time()
         self._total_steps = 0
+        self._productive_s = 0.0
+        self._step_dts: Deque[float] = deque(maxlen=window)
 
     def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
         timestamp = timestamp or time.time()
         with self._lock:
             if self._samples and step <= self._samples[-1][0]:
                 return
+            if self._samples:
+                # Clamp to monotonic: a report from a host with a
+                # lagging clock must not rewind the baseline, or the
+                # next interval double-counts the rewound seconds (and
+                # seconds_since_last_step would inflate).
+                timestamp = max(timestamp, self._samples[-1][1])
+                dt = timestamp - self._samples[-1][1]
+                if dt > 0:
+                    if self._step_dts:
+                        median = statistics.median(self._step_dts)
+                        if dt <= _STALL_TOLERANCE * median:
+                            self._step_dts.append(dt)
+                            self._productive_s += dt
+                        else:
+                            # stall: the step itself cost ~median; the
+                            # rest of the gap was downtime
+                            self._productive_s += median
+                    else:
+                        # first interval: no baseline to judge a stall
+                        # by; credit it capped (includes jit compile)
+                        credited = min(dt, _FIRST_INTERVAL_CAP_S)
+                        self._step_dts.append(credited)
+                        self._productive_s += credited
             self._samples.append((step, timestamp))
             self._total_steps = step
+
+    def goodput(self) -> float:
+        """Productive fraction of wall time since the monitor (≈ the
+        job) started; 0.0 until the first step interval lands. Elapsed
+        extends to the newest report timestamp so reporter-side clocks
+        slightly ahead of ours can't inflate the ratio."""
+        with self._lock:
+            now = time.time()
+            if self._samples:
+                now = max(now, self._samples[-1][1])
+            elapsed = now - self._start_time
+            if elapsed <= 0 or self._productive_s <= 0:
+                return 0.0
+            return min(1.0, self._productive_s / elapsed)
 
     def steps_per_second(self) -> float:
         with self._lock:

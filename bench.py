@@ -51,19 +51,20 @@ def _build(cfg_kwargs, batch, seq, mesh):
 
 def _time_steps(state, step_fn, x, y, iters=6):
     state, loss = step_fn(state, x, y)  # compile + warmup
-    # Hard sync via a scalar fetch: over the tunneled chip the very first
-    # block_until_ready after compilation can return before the step has
-    # actually executed, which would poison the fastest sample.
+    # Hard sync via a scalar fetch: over the tunneled chip
+    # block_until_ready can return before the step actually executed
+    # (observed: 1.4 ms "steps" for a 0.36 s program), so every timed
+    # iteration syncs on the loss value itself.
     if not np.isfinite(float(loss)):
         raise RuntimeError(f"non-finite warmup loss {float(loss)}")
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         state, loss = step_fn(state, x, y)
-        jax.block_until_ready(loss)
+        loss_val = float(loss)
         times.append(time.perf_counter() - t0)
-    if not np.isfinite(float(loss)):
-        raise RuntimeError(f"non-finite loss {float(loss)}")
+        if not np.isfinite(loss_val):
+            raise RuntimeError(f"non-finite loss {loss_val}")
     return float(np.median(times)), state
 
 
@@ -132,6 +133,41 @@ def main():
             "flash_vs_dense": round(flash_tps / dense_tps, 3),
         }
     )
+
+    # -- long context: flash-attention kernel at 4x the training seq ------
+    # Guarded: a long-seq compile failure must not take down the headline
+    # numbers; on success the extras carry kernel TFLOP/s at seq 4096.
+    if on_tpu:
+        try:
+            from dlrover_tpu.ops.flash_attention import flash_attention
+
+            B, H, T, Dh = 4, 12, 4096, 64
+            r2 = np.random.default_rng(1)
+            mk = lambda: jnp.asarray(  # noqa: E731
+                r2.standard_normal((B, T, H, Dh)), jnp.bfloat16
+            )
+            q, k, v = mk(), mk(), mk()
+            att = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+            out = att(q, k, v)
+            if not np.isfinite(float(out.sum())):
+                raise RuntimeError("non-finite flash output")
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                out = att(q, k, v)
+                _ = float(out[0, 0, 0, 0])  # hard sync
+                ts.append(time.perf_counter() - t0)
+            att_s = float(np.median(ts))
+            # causal fwd flops: 2 matmuls over the lower triangle
+            flops = 2 * 2 * B * H * T * T * Dh / 2
+            extra.update(
+                {
+                    "flash_seq4096_ms": round(att_s * 1e3, 2),
+                    "flash_seq4096_tflops": round(flops / att_s / 1e12, 1),
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            extra["flash_seq4096_error"] = repr(e)[:120]
 
     # -- flash checkpoint on the real train state (~1.5 GB on TPU) --------
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")

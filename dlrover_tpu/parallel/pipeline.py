@@ -50,6 +50,7 @@ def pipeline_apply(
     microbatches: jax.Array,
     mesh: Mesh,
     axis: str = "pp",
+    data_spec: P = P(),
 ):
     """Run ``stage_fn`` as a GPipe pipeline over the ``axis`` mesh axis.
 
@@ -63,9 +64,15 @@ def pipeline_apply(
       microbatches: ``[M, mb, ...]`` — the batch pre-split into M
         microbatches.
       mesh: the global mesh; ``mesh.shape[axis]`` = number of stages.
+      data_spec: PartitionSpec of the microbatch tensor over the OTHER
+        mesh axes (e.g. ``P(None, ("dp", "fsdp"))`` to keep the batch
+        dim data-parallel through the pipeline — the default replicates,
+        which makes dp ranks compute redundantly). Must not mention
+        ``axis``; shard_map's transpose inserts the grad psum over the
+        data axes automatically.
 
     Returns ``[M, mb, ...]`` — last stage's output per microbatch,
-    replicated across the ``axis`` ranks.
+    replicated across the ``axis`` ranks, sharded per ``data_spec``.
     """
     S = mesh.shape[axis]
     M = microbatches.shape[0]
@@ -109,14 +116,19 @@ def pipeline_apply(
         outputs = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
         return jax.lax.psum(outputs, axis)
 
-    # Everything except the pp axis is handled by the caller's outer
-    # sharding (dp/tp constraints inside stage_fn still apply); within
-    # shard_map we only split the stage axis.
+    flat_axes = []
+    for entry in tuple(data_spec or ()):
+        if isinstance(entry, (tuple, list)):
+            flat_axes.extend(entry)
+        elif entry is not None:
+            flat_axes.append(entry)
+    if axis in flat_axes:
+        raise ValueError(f"data_spec {data_spec} must not mention {axis!r}")
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), data_spec),
+        out_specs=data_spec,
         check_rep=False,
     )(stage_params, microbatches)
 

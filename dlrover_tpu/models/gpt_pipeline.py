@@ -203,9 +203,12 @@ def build_gpt_pipeline_train_step(
     tx,
     num_microbatches: int,
     shardings: Dict[str, Any],
+    donate: bool = True,
 ):
     """Jitted (params, opt_state, tokens, targets) -> (params', opt', loss)
-    — embed → pipeline → unembed → CE → grads → optimizer, one program."""
+    — embed → pipeline → unembed → CE → grads → optimizer, one program.
+    ``donate=False`` keeps the input params/opt_state buffers alive
+    (e.g. to diff before/after or retry a step)."""
     import optax
 
     replicated = NamedSharding(mesh, P())
@@ -234,6 +237,6 @@ def build_gpt_pipeline_train_step(
         step,
         in_shardings=(shardings, None, batch_sharded, batch_sharded),
         out_shardings=(shardings, None, replicated),
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1) if donate else (),
     )
     return run

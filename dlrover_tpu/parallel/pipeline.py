@@ -76,8 +76,19 @@ def pipeline_apply(
     """
     S = mesh.shape[axis]
     M = microbatches.shape[0]
+    # Validate data_spec regardless of S: an invalid spec must not turn
+    # into silent acceptance when an elastic re-mesh lands on pp=1.
+    flat_axes = []
+    for entry in tuple(data_spec or ()):
+        if isinstance(entry, (tuple, list)):
+            flat_axes.extend(entry)
+        elif entry is not None:
+            flat_axes.append(entry)
+    if axis in flat_axes:
+        raise ValueError(f"data_spec {data_spec} must not mention {axis!r}")
     if S == 1:
-        # degenerate pipeline: plain scan over microbatches
+        # degenerate pipeline: plain scan over microbatches (data_spec
+        # sharding rides the caller's jit/constraints)
         params = jax.tree.map(lambda p: p[0], stage_params)
         return jax.lax.map(lambda mb: stage_fn(params, mb), microbatches)
 
@@ -116,14 +127,6 @@ def pipeline_apply(
         outputs = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
         return jax.lax.psum(outputs, axis)
 
-    flat_axes = []
-    for entry in tuple(data_spec or ()):
-        if isinstance(entry, (tuple, list)):
-            flat_axes.extend(entry)
-        elif entry is not None:
-            flat_axes.append(entry)
-    if axis in flat_axes:
-        raise ValueError(f"data_spec {data_spec} must not mention {axis!r}")
     return shard_map(
         per_device,
         mesh=mesh,

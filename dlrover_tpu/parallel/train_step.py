@@ -155,11 +155,25 @@ def build_train_step(
     rules=None,
     donate: bool = True,
     example_data: Optional[Tuple[Any, Any]] = None,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, jax.Array]]:
     """Jitted (state, inputs, targets) -> (state', metrics) over the mesh.
 
     ``example_data`` (inputs, targets) fixes the data sharding ranks; by
     default both are assumed [batch, seq].
+
+    ``grad_accum_steps`` > 1 keeps the GLOBAL batch fixed when the
+    elastic world shrinks (reference ElasticTrainer semantics,
+    elastic/trainer.py:196-202): inputs of shape [accum*B, ...] are
+    scanned in ``accum`` slices, gradients averaged in fp32, ONE
+    optimizer update — at 1/accum the activation memory.
+
+    Caveat: slices are weighted EQUALLY, so this matches the full-batch
+    step exactly only when ``loss_fn``'s per-slice mean covers the same
+    effective token count per slice (true for packed/unpadded data). A
+    pad-heavy batch with very uneven ``ignore_index`` counts per slice
+    would over-weight sparse slices; pack sequences or shuffle padding
+    uniformly before relying on accumulation equivalence.
     """
     rules = rules or DEFAULT_RULES
     if example_data is not None:
@@ -170,13 +184,52 @@ def build_train_step(
             jnp.zeros((1, 1)), mesh, rules
         )
     replicated = NamedSharding(mesh, PartitionSpec())
+    accum = max(1, int(grad_accum_steps))
 
-    def step_fn(state: TrainState, inputs, targets):
-        def compute_loss(params):
-            logits = model.apply({"params": params}, inputs)
+    def grads_of(params, inputs, targets):
+        def compute_loss(p):
+            logits = model.apply({"params": p}, inputs)
             return loss_fn(logits, targets)
 
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        return jax.value_and_grad(compute_loss)(params)
+
+    def step_fn(state: TrainState, inputs, targets):
+        if accum == 1:
+            loss, grads = grads_of(state.params, inputs, targets)
+        else:
+            def slice_micro(x):
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch {x.shape[0]} not divisible by "
+                        f"grad_accum_steps {accum}"
+                    )
+                return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+            micro_in = slice_micro(inputs)
+            micro_tgt = slice_micro(targets)
+
+            def one(carry, xs):
+                loss_acc, grads_acc = carry
+                mi, mt = xs
+                loss, grads = grads_of(state.params, mi, mt)
+                grads = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                )
+                return (loss_acc + loss, grads), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (loss, grads), _ = jax.lax.scan(
+                one, (jnp.zeros((), jnp.float32), zero_grads),
+                (micro_in, micro_tgt),
+            )
+            loss = loss / accum
+            grads = jax.tree.map(
+                lambda g, p: (g / accum).astype(p.dtype),
+                grads,
+                state.params,
+            )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

@@ -1,0 +1,122 @@
+"""ElasticTrainLoop: the convenience training loop for elastic jobs.
+
+Reference: ``ElasticTrainer`` (``dlrover/trainer/torch/elastic/
+trainer.py:181``) — the L7 wrapper users reach for: fixed global batch
+via world-size-aware gradient accumulation, checkpoint cadence, resume,
+and step reporting, so a training script is the model + data and nothing
+else. The TPU shape: consistent resume through
+``CheckpointEngine.load_consistent``, staged-memory saves every step,
+async storage saves on a cadence, and master step reports feeding the
+PerfMonitor/goodput/hang machinery.
+"""
+
+import time
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+from ..common.log import logger
+
+
+def gradient_accumulation_steps(max_workers: int, current_workers: int) -> int:
+    """Accumulation factor keeping the global batch fixed as the world
+    shrinks (reference trainer.py:196-202): with max 8 workers and 2
+    alive, each does 4 accumulation slices per optimizer step."""
+    if current_workers <= 0 or max_workers <= current_workers:
+        return 1
+    if max_workers % current_workers:
+        # non-divisible worlds round UP: global batch grows slightly
+        # rather than silently shrinking
+        return -(-max_workers // current_workers)
+    return max_workers // current_workers
+
+
+class ElasticTrainLoop:
+    """Drives ``step_fn`` with elastic resume + checkpoint cadence.
+
+    >>> loop = ElasticTrainLoop(engine, step_fn, ctx=elastic_context(),
+    ...                         max_steps=10_000, storage_every=200)
+    >>> state = loop.run(state, data_iter)
+
+    ``step_fn(state, *batch) -> (state, loss)``; ``data_iter`` yields
+    batch tuples. The loop:
+    - restores via ``load_consistent`` (cross-host step agreement),
+    - stages every step to shm, persists every ``storage_every`` steps,
+    - reports steps to the master (PerfMonitor / goodput / hang check),
+    - stops at ``max_steps`` and waits for pending persists.
+    """
+
+    def __init__(
+        self,
+        engine,
+        step_fn: Callable,
+        ctx=None,
+        max_steps: int = 0,
+        memory_every: int = 1,
+        storage_every: int = 100,
+        log_every: int = 10,
+        on_step: Optional[Callable[[int, float], None]] = None,
+    ):
+        self.engine = engine
+        self.step_fn = step_fn
+        self.ctx = ctx
+        self.max_steps = max_steps
+        self.memory_every = max(1, memory_every)
+        self.storage_every = max(1, storage_every)
+        self.log_every = max(1, log_every)
+        self.on_step = on_step
+        self.start_step = 0
+
+    def restore(self, state: Any) -> Tuple[int, Any]:
+        """(start_step, state) — consistent across hosts."""
+        loaded, restored = self.engine.load_consistent(state)
+        if loaded >= 0 and restored is not None:
+            logger.info("resuming from step %s", loaded)
+            self.start_step = loaded + 1
+            return self.start_step, restored
+        self.start_step = 0
+        return 0, state
+
+    def run(self, state: Any, data_iter: Iterable[Tuple]) -> Any:
+        start, state = self.restore(state)
+        step = start
+        it = iter(data_iter)
+        while True:
+            # bound check BEFORE drawing: a resume at/past max_steps
+            # must not consume (and discard) an element of a finite or
+            # replayable dataset
+            if self.max_steps and step >= self.max_steps:
+                break
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            if self.ctx is not None:
+                self.ctx.start_step_timer()
+            state, loss = self.step_fn(state, *batch)
+            if step % self.storage_every == 0:
+                self.engine.save_to_storage(step, state)
+            elif step % self.memory_every == 0:
+                self.engine.save_to_memory(step, state)
+            if self.ctx is not None:
+                self.ctx.report_step(step)
+            if self.on_step is not None:
+                self.on_step(step, loss)
+            if step % self.log_every == 0:
+                # scalar fetch only when logging: a per-step float()
+                # would serialize host and device
+                logger.info("step %s: loss %.4f", step, float(loss))
+            step += 1
+        if step > start:
+            # In-loop saves skip while the persister holds the shard
+            # lock (non-blocking by design); stage the FINAL state with
+            # retries so resume continues exactly where training
+            # stopped instead of at the last uncontended save.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if self.engine.save_to_memory(step - 1, state):
+                    break
+                time.sleep(0.1)
+            else:
+                logger.warning("could not stage the final step %s", step - 1)
+        if not self.engine.wait_saving():
+            logger.warning("pending checkpoint persists did not complete")
+        return state

@@ -51,34 +51,25 @@ def main():
     step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, shardings)
 
     engine = CheckpointEngine(CKPT_DIR, mesh=mesh)
-    start = 0
-    # load_consistent: hosts restore independently (shm/peer/storage) and
-    # can land on different steps after a replacement — on disagreement
-    # every host reloads the common storage step so shards never mix.
-    loaded, restored = engine.load_consistent(state)
-    if loaded >= 0 and restored is not None:
-        state, start = restored, loaded + 1
-        print(f"resumed from step {loaded}")
+    # ElasticTrainLoop handles consistent resume (hosts agree on ONE
+    # step after a replacement), the shm/storage save cadence, and step
+    # reports feeding the master's PerfMonitor/goodput/hang machinery.
+    from dlrover_tpu.trainer.loop import ElasticTrainLoop
 
     rng = np.random.default_rng(ctx.process_id)
-    for step in range(start, TOTAL_STEPS):
-        x = jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq_len)),
-            jnp.int32,
-        )
-        y = jnp.roll(x, -1, axis=1)
-        ctx.start_step_timer()
-        state, loss = step_fn(state, x, y)
-        if step % 50 == 0:
-            engine.save_to_storage(step, state)  # stages + async persist
-        else:
-            engine.save_to_memory(step, state)  # sub-second stage to shm
-        ctx.report_step(step)  # feeds master PerfMonitor + hang detector
-        if step % 10 == 0:
-            # fetch the scalar only when printing: a per-step float()
-            # would force a host-device sync and defeat async dispatch
-            print(f"step {step}: loss {float(loss):.4f}")
-    engine.wait_saving()
+
+    def data():
+        while True:
+            x = jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq_len)),
+                jnp.int32,
+            )
+            yield x, jnp.roll(x, -1, axis=1)
+
+    loop = ElasticTrainLoop(
+        engine, step_fn, ctx=ctx, max_steps=TOTAL_STEPS, storage_every=50
+    )
+    loop.run(state, data())
     print("done")
 
 

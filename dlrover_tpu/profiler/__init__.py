@@ -8,5 +8,13 @@ and gives the agent a scraper.
 
 from .native import TpuTimer, load_native
 from .hooks import StepProfiler, profile_op
+from .host_stalls import GcStallTracer, host_section
 
-__all__ = ["TpuTimer", "load_native", "StepProfiler", "profile_op"]
+__all__ = [
+    "GcStallTracer",
+    "StepProfiler",
+    "TpuTimer",
+    "host_section",
+    "load_native",
+    "profile_op",
+]

@@ -1,0 +1,76 @@
+"""Host-side stall tracing: GC pauses and marked host sections.
+
+Reference: ``xpu_timer/python/py_tracing.c`` — a CPython-level tracer
+whose main catch in production is host stalls (garbage collection,
+dataloader hiccups) that show up as inexplicable step-time spikes and
+straggler flags. The TPU build hooks CPython's ``gc.callbacks`` (GC
+events are rare, so a Python-level hook costs nothing between
+collections) and offers a context manager for arbitrary host sections
+(data loading, tokenization); both feed the native tpu_timer ring and
+gauges, so GC pauses appear in the SAME timeline/metrics as steps and
+collectives — a straggler whose cause is gen-2 GC is visible at a
+glance.
+"""
+
+import gc
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from .native import KIND_OTHER, TpuTimer
+
+_GC_NAME = "host_gc"
+
+
+class GcStallTracer:
+    """Records every GC collection's duration into the tpu_timer core."""
+
+    def __init__(self, timer: Optional[TpuTimer] = None):
+        self.timer = timer or TpuTimer.singleton()
+        self._start_us = 0
+        self._installed = False
+        self.collections = 0
+        self.total_pause_us = 0
+
+    def _cb(self, phase: str, info: dict) -> None:
+        if phase == "start":
+            self._start_us = time.monotonic_ns() // 1000
+        elif phase == "stop" and self._start_us:
+            now = time.monotonic_ns() // 1000
+            dur = now - self._start_us
+            self._start_us = 0
+            self.collections += 1
+            self.total_pause_us += dur
+            self.timer.record(
+                f"{_GC_NAME}_gen{info.get('generation', '?')}",
+                KIND_OTHER,
+                now - dur,
+                dur,
+            )
+
+    def install(self) -> "GcStallTracer":
+        if not self._installed:
+            gc.callbacks.append(self._cb)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            try:
+                gc.callbacks.remove(self._cb)
+            except ValueError:
+                pass
+            self._installed = False
+
+
+@contextmanager
+def host_section(name: str, timer: Optional[TpuTimer] = None):
+    """Time an arbitrary host-side section into the profiler timeline
+    (``with host_section("dataloader"): batch = next(it)``)."""
+    timer = timer or TpuTimer.singleton()
+    start = time.monotonic_ns() // 1000
+    try:
+        yield
+    finally:
+        end = time.monotonic_ns() // 1000
+        timer.record(f"host_{name}", KIND_OTHER, start, end - start)

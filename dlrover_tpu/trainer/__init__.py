@@ -7,5 +7,11 @@ master over the same control plane as the agent.
 """
 
 from .elastic import ElasticContext, elastic_context
+from .loop import ElasticTrainLoop, gradient_accumulation_steps
 
-__all__ = ["ElasticContext", "elastic_context"]
+__all__ = [
+    "ElasticContext",
+    "ElasticTrainLoop",
+    "elastic_context",
+    "gradient_accumulation_steps",
+]

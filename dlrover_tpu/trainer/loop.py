@@ -75,9 +75,29 @@ class ElasticTrainLoop:
         self.start_step = 0
         return 0, state
 
-    def run(self, state: Any, data_iter: Iterable[Tuple]) -> Any:
+    def run(
+        self,
+        state: Any,
+        data_iter: Optional[Iterable[Tuple]] = None,
+        data_factory: Optional[Callable[[int], Iterable[Tuple]]] = None,
+    ) -> Any:
+        """Train until ``max_steps`` (or data exhaustion).
+
+        Data resume: pass ``data_factory`` — called with the resumed
+        start step AFTER the checkpoint restore — to build an iterator
+        positioned at the right sample (e.g. an
+        ``ElasticDistributedSampler`` with ``consumed_samples`` set). A
+        plain ``data_iter`` is only correct for stateless/randomized
+        sources: a sequential dataset would replay its FIRST batches
+        after a resume.
+        """
         start, state = self.restore(state)
+        if data_factory is not None:
+            data_iter = data_factory(start)
+        if data_iter is None:
+            raise ValueError("run() needs data_iter or data_factory")
         step = start
+        last_save_ok = False
         it = iter(data_iter)
         while True:
             # bound check BEFORE drawing: a resume at/past max_steps
@@ -93,9 +113,11 @@ class ElasticTrainLoop:
                 self.ctx.start_step_timer()
             state, loss = self.step_fn(state, *batch)
             if step % self.storage_every == 0:
-                self.engine.save_to_storage(step, state)
+                last_save_ok = self.engine.save_to_storage(step, state)
             elif step % self.memory_every == 0:
-                self.engine.save_to_memory(step, state)
+                last_save_ok = self.engine.save_to_memory(step, state)
+            else:
+                last_save_ok = False
             if self.ctx is not None:
                 self.ctx.report_step(step)
             if self.on_step is not None:
@@ -105,11 +127,13 @@ class ElasticTrainLoop:
                 # would serialize host and device
                 logger.info("step %s: loss %.4f", step, float(loss))
             step += 1
-        if step > start:
+        if step > start and not last_save_ok:
             # In-loop saves skip while the persister holds the shard
             # lock (non-blocking by design); stage the FINAL state with
             # retries so resume continues exactly where training
-            # stopped instead of at the last uncontended save.
+            # stopped. Skipped when the last in-loop save already
+            # landed — re-staging the identical step would cost a
+            # redundant full-model D2H + memcpy (+ replica push).
             deadline = time.monotonic() + 30
             while time.monotonic() < deadline:
                 if self.engine.save_to_memory(step - 1, state):

@@ -126,6 +126,53 @@ class TestCollector:
             f"profiler adds {overhead*1e3:.2f} ms/step (bare {bare*1e3:.2f})"
         )
 
+    def test_gc_stall_tracer(self, timer, tmp_path):
+        import gc as _gc
+
+        from dlrover_tpu.profiler import GcStallTracer
+
+        tracer = GcStallTracer(timer).install()
+        try:
+            _gc.collect()
+            assert tracer.collections >= 1
+            assert tracer.total_pause_us >= 0
+            # the pause landed in the kind-aggregated gauges...
+            assert 'kind="other"' in timer.metrics_text()
+            # ...and, named, in the trace ring/timeline
+            path = str(tmp_path / "gc.timeline")
+            assert timer.dump_timeline(path) > 0
+            from dlrover_tpu.profiler.timeline import read_names
+
+            names = read_names(path + ".names")
+            events = read_timeline(path)
+            assert any(
+                "host_gc" in names.get(e.name_id, "") for e in events
+            )
+        finally:
+            tracer.uninstall()
+        before = tracer.collections
+        _gc.collect()
+        assert tracer.collections == before  # uninstalled → no hook
+
+    def test_host_section_records(self, timer, tmp_path):
+        import time as _time
+
+        from dlrover_tpu.profiler import host_section
+
+        with host_section("dataloader", timer):
+            _time.sleep(0.01)
+        path = str(tmp_path / "host.timeline")
+        assert timer.dump_timeline(path) > 0
+        from dlrover_tpu.profiler.timeline import read_names
+
+        names = read_names(path + ".names")
+        events = read_timeline(path)
+        ours = [
+            e for e in events
+            if names.get(e.name_id, "") == "host_dataloader"
+        ]
+        assert ours and ours[0].dur_us >= 9_000
+
     def test_parse_prometheus(self):
         text = (
             "# comment\n"

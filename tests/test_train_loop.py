@@ -130,6 +130,28 @@ class TestElasticTrainLoop:
 
         return engine, step, state, data
 
+    def test_data_factory_gets_resume_step(self, tmp_path):
+        engine, step_fn, state, data = self._setup(tmp_path)
+        got_starts = []
+
+        def factory(start):
+            got_starts.append(start)
+            return data()
+
+        try:
+            loop = ElasticTrainLoop(engine, step_fn, max_steps=2)
+            state = loop.run(state, data_factory=factory)
+            assert got_starts == [0]
+            loop2 = ElasticTrainLoop(engine, step_fn, max_steps=4)
+            _, _, fresh_state, _ = self._setup(tmp_path)
+            loop2.run(fresh_state, data_factory=factory)
+            assert got_starts[-1] == 2  # factory told where to seek
+            with pytest.raises(ValueError, match="data_iter or data_factory"):
+                ElasticTrainLoop(engine, step_fn).run(state)
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
     def test_run_resume_continues_step_sequence(self, tmp_path):
         engine, step_fn, state, data = self._setup(tmp_path)
         seen = []

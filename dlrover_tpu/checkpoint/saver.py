@@ -48,6 +48,8 @@ class AsyncCheckpointSaver:
     _factory_q: Optional[SharedQueue] = None
     _event_q: Optional[SharedQueue] = None
     _runner_thread: Optional[threading.Thread] = None
+    _runner_namespace: Optional[str] = None
+    _start_lock = threading.Lock()
     _signals_installed = False
 
     def __init__(
@@ -115,39 +117,69 @@ class AsyncCheckpointSaver:
         breakpoint-save hook (reference :533) can actually be installed —
         Python only allows signal registration on the main thread.
         """
-        with cls._cls_lock:
-            if cls._runner_thread is not None and cls._runner_thread.is_alive():
-                return cls._runner_thread
-            cls._factory_q = SharedQueue(FACTORY_QUEUE, create=True)
-            cls._event_q = SharedQueue(EVENT_QUEUE, create=True)
-        cls._install_signal_handlers()
-        factory_q, event_q = cls._factory_q, cls._event_q
+        from ..common.multi_process import _ipc_namespace
 
-        def runner():
-            while True:
-                msg = factory_q.get()
-                if msg is None or msg.get("type") == "exit":
-                    return
-                try:
-                    saver = cls.get_or_create(
-                        storage_root=msg["storage_root"],
-                        host_rank=msg.get("host_rank", 0),
-                        num_hosts=msg.get("num_hosts", 1),
-                        replicate=msg.get("replicate", False),
-                        replica_peers=msg.get("replica_peers"),
-                    )
-                    # Lock server must exist before the trainer acquires it;
-                    # get_or_create made it. Ack by re-running the loop.
-                    saver._event_loop(event_q)
-                except Exception:
-                    logger.exception("checkpoint saver crashed; waiting again")
+        namespace = _ipc_namespace()
+        # _start_lock serializes concurrent starters so a restart (old
+        # namespace torn down, new servers coming up) can never be
+        # interleaved with — and destroyed by — a second starter acting
+        # on a stale snapshot. Separate from _cls_lock because
+        # shutdown() takes _cls_lock itself.
+        with cls._start_lock:
+            with cls._cls_lock:
+                alive = (
+                    cls._runner_thread is not None
+                    and cls._runner_thread.is_alive()
+                )
+                if alive and cls._runner_namespace == namespace:
+                    return cls._runner_thread
+            if alive:
+                # A live runner serving a DIFFERENT job namespace (the
+                # process was reused across jobs, or tests switched
+                # DLROVER_JOB_NAME): its queue servers answer on the OLD
+                # sockets, so a new-namespace engine would time out
+                # waiting for servers that never come up.
+                logger.info(
+                    "saver namespace changed (%s -> %s); restarting",
+                    cls._runner_namespace,
+                    namespace,
+                )
+                cls.shutdown()
+            with cls._cls_lock:
+                cls._factory_q = SharedQueue(FACTORY_QUEUE, create=True)
+                cls._event_q = SharedQueue(EVENT_QUEUE, create=True)
+                cls._runner_namespace = namespace
+            cls._install_signal_handlers()
+            factory_q, event_q = cls._factory_q, cls._event_q
 
-        thread = threading.Thread(
-            target=runner, name="ckpt-saver", daemon=True
-        )
-        thread.start()
-        cls._runner_thread = thread
-        return thread
+            def runner():
+                while True:
+                    msg = factory_q.get()
+                    if msg is None or msg.get("type") == "exit":
+                        return
+                    try:
+                        saver = cls.get_or_create(
+                            storage_root=msg["storage_root"],
+                            host_rank=msg.get("host_rank", 0),
+                            num_hosts=msg.get("num_hosts", 1),
+                            replicate=msg.get("replicate", False),
+                            replica_peers=msg.get("replica_peers"),
+                        )
+                        # Lock server must exist before the trainer
+                        # acquires it; get_or_create made it. Ack by
+                        # re-running the loop.
+                        saver._event_loop(event_q)
+                    except Exception:
+                        logger.exception(
+                            "checkpoint saver crashed; waiting again"
+                        )
+
+            thread = threading.Thread(
+                target=runner, name="ckpt-saver", daemon=True
+            )
+            thread.start()
+            cls._runner_thread = thread
+            return thread
 
     @classmethod
     def get_or_create(

@@ -13,10 +13,10 @@ glance.
 """
 
 import gc
-import time
 from contextlib import contextmanager
 from typing import Optional
 
+from .hooks import _now_us
 from .native import KIND_OTHER, TpuTimer
 
 _GC_NAME = "host_gc"
@@ -34,9 +34,9 @@ class GcStallTracer:
 
     def _cb(self, phase: str, info: dict) -> None:
         if phase == "start":
-            self._start_us = time.monotonic_ns() // 1000
+            self._start_us = _now_us()
         elif phase == "stop" and self._start_us:
-            now = time.monotonic_ns() // 1000
+            now = _now_us()
             dur = now - self._start_us
             self._start_us = 0
             self.collections += 1
@@ -68,9 +68,9 @@ def host_section(name: str, timer: Optional[TpuTimer] = None):
     """Time an arbitrary host-side section into the profiler timeline
     (``with host_section("dataloader"): batch = next(it)``)."""
     timer = timer or TpuTimer.singleton()
-    start = time.monotonic_ns() // 1000
+    start = _now_us()
     try:
         yield
     finally:
-        end = time.monotonic_ns() // 1000
+        end = _now_us()
         timer.record(f"host_{name}", KIND_OTHER, start, end - start)

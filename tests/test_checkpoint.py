@@ -166,6 +166,27 @@ class TestEngineEndToEnd:
             engine2.shm.unlink()
             engine2.close()
 
+    def test_saver_restarts_on_namespace_change(self, tmp_path, monkeypatch):
+        """A live runner serving an OLD job namespace must be torn down
+        when the namespace changes — otherwise a new engine times out
+        waiting for queue servers that answer on the old sockets (the
+        exact full-suite flake this reproduces: reset() between tests
+        leaves the thread alive)."""
+        monkeypatch.setenv("DLROVER_JOB_NAME", f"nsA_{os.getpid()}")
+        t1 = AsyncCheckpointSaver.start_async_saving_ckpt()
+        assert t1.is_alive()
+        monkeypatch.setenv("DLROVER_JOB_NAME", f"nsB_{os.getpid()}")
+        engine = CheckpointEngine(
+            str(tmp_path / "c"), standalone=True, replicate=False
+        )
+        try:
+            assert engine.save_to_memory(1, {"w": jnp.ones(2)})
+            step, restored = engine.load({"w": jnp.zeros(2)})
+            assert step == 1
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
     def test_wait_saving_step_zero(self, tmp_path):
         """Step 0 is falsy; `latest or -1` would spin the full timeout
         on the very first persisted checkpoint of a job."""

@@ -104,7 +104,9 @@ class TestGradAccumulation:
 
 
 class TestElasticTrainLoop:
-    def _setup(self, tmp_path):
+    def _model(self):
+        """(step_fn, fresh_state, data_factory) — no engine involved, so
+        a test can mint fresh states without touching the saver stack."""
         import optax
 
         cfg = GPTConfig.tiny()
@@ -114,10 +116,6 @@ class TestElasticTrainLoop:
         tokens = jnp.zeros((2, cfg.max_seq_len), jnp.int32)
         state, sh = init_train_state(model, tokens, mesh, tx)
         step = build_train_step(model, tx, cross_entropy_loss, mesh, sh)
-        engine = CheckpointEngine(
-            str(tmp_path / "ckpt"), mesh=mesh, standalone=True,
-            replicate=False,
-        )
         r = np.random.default_rng(0)
 
         def data():
@@ -128,6 +126,15 @@ class TestElasticTrainLoop:
                 )
                 yield x, jnp.roll(x, -1, axis=1)
 
+        self._mesh = mesh
+        return step, state, data
+
+    def _setup(self, tmp_path):
+        step, state, data = self._model()
+        engine = CheckpointEngine(
+            str(tmp_path / "ckpt"), mesh=self._mesh, standalone=True,
+            replicate=False,
+        )
         return engine, step, state, data
 
     def test_data_factory_gets_resume_step(self, tmp_path):
@@ -143,7 +150,7 @@ class TestElasticTrainLoop:
             state = loop.run(state, data_factory=factory)
             assert got_starts == [0]
             loop2 = ElasticTrainLoop(engine, step_fn, max_steps=4)
-            _, _, fresh_state, _ = self._setup(tmp_path)
+            _, fresh_state, _ = self._model()
             loop2.run(fresh_state, data_factory=factory)
             assert got_starts[-1] == 2  # factory told where to seek
             with pytest.raises(ValueError, match="data_iter or data_factory"):
@@ -166,7 +173,7 @@ class TestElasticTrainLoop:
 
             # a "restarted" incarnation resumes where it stopped
             seen2 = []
-            _, _, fresh_state, _ = self._setup(tmp_path)
+            _, fresh_state, _ = self._model()
             loop2 = ElasticTrainLoop(
                 engine, step_fn, max_steps=8,
                 on_step=lambda s, loss: seen2.append(s),

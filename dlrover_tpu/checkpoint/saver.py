@@ -132,15 +132,26 @@ class AsyncCheckpointSaver:
                     and cls._runner_thread.is_alive()
                 )
                 if alive and cls._runner_namespace == namespace:
-                    return cls._runner_thread
+                    # Same namespace is necessary but not sufficient: the
+                    # socket DIRECTORY may have moved (tests repoint
+                    # SOCKET_TMP_DIR per test), leaving a live runner
+                    # whose servers listen where no new client looks.
+                    # Probe with a FRESH client (current path rules).
+                    from ..common.multi_process import LocalSocketClient
+
+                    if LocalSocketClient(
+                        "queue_" + FACTORY_QUEUE
+                    ).available():
+                        return cls._runner_thread
             if alive:
-                # A live runner serving a DIFFERENT job namespace (the
-                # process was reused across jobs, or tests switched
-                # DLROVER_JOB_NAME): its queue servers answer on the OLD
-                # sockets, so a new-namespace engine would time out
-                # waiting for servers that never come up.
+                # A live runner serving a DIFFERENT job namespace or a
+                # moved socket dir (the process was reused across jobs,
+                # or tests switched DLROVER_JOB_NAME/SOCKET_TMP_DIR):
+                # its queue servers answer on the OLD sockets, so a
+                # new engine would time out waiting for servers that
+                # never come up.
                 logger.info(
-                    "saver namespace changed (%s -> %s); restarting",
+                    "saver endpoints stale (%s -> %s); restarting",
                     cls._runner_namespace,
                     namespace,
                 )

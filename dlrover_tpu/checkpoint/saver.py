@@ -131,6 +131,7 @@ class AsyncCheckpointSaver:
                     cls._runner_thread is not None
                     and cls._runner_thread.is_alive()
                 )
+                stale_reason = None
                 if alive and cls._runner_namespace == namespace:
                     # Same namespace is necessary but not sufficient: the
                     # socket DIRECTORY may have moved (tests repoint
@@ -143,18 +144,19 @@ class AsyncCheckpointSaver:
                         "queue_" + FACTORY_QUEUE
                     ).available():
                         return cls._runner_thread
+                    stale_reason = "factory socket unreachable"
+                elif alive:
+                    stale_reason = (
+                        f"namespace changed {cls._runner_namespace} -> "
+                        f"{namespace}"
+                    )
             if alive:
-                # A live runner serving a DIFFERENT job namespace or a
-                # moved socket dir (the process was reused across jobs,
-                # or tests switched DLROVER_JOB_NAME/SOCKET_TMP_DIR):
-                # its queue servers answer on the OLD sockets, so a
-                # new engine would time out waiting for servers that
-                # never come up.
-                logger.info(
-                    "saver endpoints stale (%s -> %s); restarting",
-                    cls._runner_namespace,
-                    namespace,
-                )
+                # A live runner serving stale endpoints (the process was
+                # reused across jobs, or the socket dir moved): its
+                # queue servers answer on the OLD sockets, so a new
+                # engine would time out waiting for servers that never
+                # come up.
+                logger.info("saver endpoints stale (%s); restarting", stale_reason)
                 cls.shutdown()
             with cls._cls_lock:
                 cls._factory_q = SharedQueue(FACTORY_QUEUE, create=True)

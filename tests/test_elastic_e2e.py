@@ -16,12 +16,6 @@ import time
 import pytest
 
 from dlrover_tpu.common.constants import JobExitReason, NodeEnv
-from dlrover_tpu.master.dist_master import DistributedJobMaster
-from dlrover_tpu.master.scaler.process_scaler import (
-    ProcessNodeSpec,
-    ProcessScaler,
-)
-from dlrover_tpu.master.watcher.process_watcher import ProcessWatcher
 
 
 def _worker_script(tmp_path):
@@ -44,20 +38,10 @@ def test_kill_node_master_relaunches(tmp_path):
     markers = tmp_path / "markers"
     markers.mkdir()
     script = _worker_script(tmp_path)
-    # Build master first with a NoopScaler placeholder, then swap in the
-    # real ProcessScaler once the RPC port is known.
-    from dlrover_tpu.master.scaler.base_scaler import NoopScaler
+    from e2e_utils import make_process_master
 
-    master = DistributedJobMaster(
-        scaler=NoopScaler(),
-        watcher=None,
-        num_workers=2,
-        node_unit=1,
-        job_name="chaos_e2e",
-        pre_check_ops=[],
-        fresh_context=True,
-    )
-    spec = ProcessNodeSpec(
+    master, scaler, watcher = make_process_master(
+        "chaos_e2e",
         command=[
             sys.executable,
             "-m",
@@ -73,14 +57,8 @@ def test_kill_node_master_relaunches(tmp_path):
             "DLROVER_LOCAL_DEVICES": "1",
             "PYTHONPATH": os.pathsep.join(sys.path),
         },
+        num_workers=2,
     )
-    scaler = ProcessScaler(
-        spec, master_addr=master.addr, job_name="chaos_e2e", num_workers=2
-    )
-    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
-    master.job_manager._scaler = scaler
-    master.job_manager._watcher = watcher
-    master.auto_scaler._scaler = scaler
     try:
         master.prepare()
         master.run_in_background()

@@ -24,13 +24,6 @@ import time
 import pytest
 
 from dlrover_tpu.common.constants import JobExitReason
-from dlrover_tpu.master.dist_master import DistributedJobMaster
-from dlrover_tpu.master.scaler.base_scaler import NoopScaler
-from dlrover_tpu.master.scaler.process_scaler import (
-    ProcessNodeSpec,
-    ProcessScaler,
-)
-from dlrover_tpu.master.watcher.process_watcher import ProcessWatcher
 
 TRAINER = r'''
 import os, sys, time, pathlib
@@ -125,16 +118,10 @@ def test_kill_node_resumes_training_from_memory(tmp_path):
     script = tmp_path / "train_gpt.py"
     script.write_text(TRAINER)
 
-    master = DistributedJobMaster(
-        scaler=NoopScaler(),
-        watcher=None,
-        num_workers=2,
-        node_unit=1,
-        job_name="chaos_train_e2e",
-        pre_check_ops=[],
-        fresh_context=True,
-    )
-    spec = ProcessNodeSpec(
+    from e2e_utils import make_process_master
+
+    master, scaler, watcher = make_process_master(
+        "chaos_train_e2e",
         command=[
             sys.executable,
             "-m",
@@ -151,17 +138,8 @@ def test_kill_node_resumes_training_from_memory(tmp_path):
             "DLROVER_LOCAL_DEVICES": "1",
             "PYTHONPATH": os.pathsep.join(sys.path),
         },
-    )
-    scaler = ProcessScaler(
-        spec,
-        master_addr=master.addr,
-        job_name="chaos_train_e2e",
         num_workers=2,
     )
-    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
-    master.job_manager._scaler = scaler
-    master.job_manager._watcher = watcher
-    master.auto_scaler._scaler = scaler
     p0 = progress_dir / "progress_0.txt"
     p1 = progress_dir / "progress_1.txt"
     try:

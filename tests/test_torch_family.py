@@ -359,13 +359,7 @@ def _cleanup_namespaces():
 
 @pytest.mark.slow
 def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
-    from dlrover_tpu.master.dist_master import DistributedJobMaster
-    from dlrover_tpu.master.scaler.base_scaler import NoopScaler
-    from dlrover_tpu.master.scaler.process_scaler import (
-        ProcessNodeSpec,
-        ProcessScaler,
-    )
-    from dlrover_tpu.master.watcher.process_watcher import ProcessWatcher
+    from e2e_utils import make_process_master
 
     _cleanup_namespaces()
     progress_dir = tmp_path / "progress"
@@ -375,16 +369,8 @@ def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
     script = tmp_path / "train_torch.py"
     script.write_text(TORCH_TRAINER)
 
-    master = DistributedJobMaster(
-        scaler=NoopScaler(),
-        watcher=None,
-        num_workers=2,
-        node_unit=1,
-        job_name="torch_e2e",
-        pre_check_ops=[],
-        fresh_context=True,
-    )
-    spec = ProcessNodeSpec(
+    master, scaler, watcher = make_process_master(
+        "torch_e2e",
         command=[
             sys.executable,
             "-m",
@@ -401,14 +387,8 @@ def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
             "DLROVER_LOCAL_DEVICES": "1",
             "PYTHONPATH": os.pathsep.join(sys.path),
         },
+        num_workers=2,
     )
-    scaler = ProcessScaler(
-        spec, master_addr=master.addr, job_name="torch_e2e", num_workers=2
-    )
-    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
-    master.job_manager._scaler = scaler
-    master.job_manager._watcher = watcher
-    master.auto_scaler._scaler = scaler
     try:
         master.prepare()
         master.run_in_background()

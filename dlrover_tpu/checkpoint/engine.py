@@ -69,6 +69,21 @@ def _restore_into_template(template: Any, arrays: Dict[str, np.ndarray]) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _process_count() -> int:
+    """World size WITHOUT initializing a jax backend: the engine also
+    runs inside non-JAX workers (torch family), where jax.process_count()
+    would boot a hardware plugin just to answer "1" — and hang if the
+    accelerator is unreachable. jax.distributed.initialize records the
+    world in the distributed global state; absent that, we are single
+    process by definition."""
+    try:
+        from jax._src import distributed
+
+        return int(getattr(distributed.global_state, "num_processes", None) or 1)
+    except Exception:  # noqa: BLE001 — private-module drift
+        return 1
+
+
 class CheckpointEngine:
     def __init__(
         self,
@@ -144,13 +159,42 @@ class CheckpointEngine:
 
     # -- save --------------------------------------------------------------
 
+    def _all_hosts_ready(self, ready: bool) -> bool:
+        """All-or-none gate for a multi-process save (reference
+        ``check_all_rank_ready`` allreduce, engine.py:57-71): if ANY
+        host's persister holds its shard lock, every host skips this
+        step. Without it hosts stage DIFFERENT steps over time and a
+        re-meshed world has no common memory step to resume from."""
+        if _process_count() <= 1:
+            return ready
+        from jax.experimental import multihost_utils
+
+        all_ready = multihost_utils.process_allgather(
+            np.int64(1 if ready else 0)
+        )
+        return bool(np.all(all_ready))
+
     def save_to_memory(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
         """Stage the pytree into host shm. Blocks only for D2H + memcpy.
-        Skips (returns False) if the persister still holds the shard lock
-        (reference non-blocking acquire, engine.py:351-365)."""
-        if not self._shard_lock.acquire(blocking=False):
+        Skips (returns False) if ANY host's persister still holds its
+        shard lock (reference non-blocking acquire + all-rank-ready
+        allreduce, engine.py:57-71,351-365) — all-or-none, so every
+        host's shm always stages the SAME step."""
+        acquired = self._shard_lock.acquire(blocking=False)
+        try:
+            ready = self._all_hosts_ready(acquired)
+        except Exception:
+            # a peer died mid-allgather: surface it, but NEVER while
+            # holding the shard lock — a leaked lock starves the agent
+            # persister forever
+            if acquired:
+                self._shard_lock.release()
+            raise
+        if not ready:
+            if acquired:
+                self._shard_lock.release()
             logger.warning(
-                "skip save_to_memory step %s: persister busy with shard", step
+                "skip save_to_memory step %s: a persister is busy", step
             )
             return False
         try:
@@ -242,20 +286,20 @@ class CheckpointEngine:
                 return result
         return -1, None
 
-    def _load_from_peer(self, template: Any):
-        """Refill this host's shm from the peer that replicated it, then
-        load through the normal memory path. A replica can be stale
-        (push failures are log-and-drop), so if storage holds a NEWER
-        step the peer result is discarded and load() falls through."""
+    def _refill_from_peer(self) -> bool:
+        """Pull this host's replicated shard from its backup peer into
+        local shm (control-plane transfer only — NO device collectives,
+        so it is safe before a multi-process restore agreement). True
+        when shm now holds a usable image."""
         if not self._replicate:
-            return None
+            return False
         from .replica import ReplicaManager, default_master_client
 
         client = self.master_client
         if client is None and self._replica_peers is None:
             client = default_master_client()
             if client is None:
-                return None
+                return False
         manager = ReplicaManager(
             self.host_rank,
             self.num_hosts,
@@ -263,11 +307,12 @@ class CheckpointEngine:
             peers=self._replica_peers,
         )
         if not self._shard_lock.acquire(blocking=True, timeout=60.0):
-            return None
+            manager.stop()
+            return False
         try:
             fetched = manager.fetch_own_shard(self.shm.write_image_stream)
             if not fetched:
-                return None
+                return False
             # Staleness check BEFORE the expensive host->device restore:
             # a replica can lag behind storage (push failures are
             # log-and-drop), and restoring a multi-GB pytree only to
@@ -285,10 +330,17 @@ class CheckpointEngine:
                 # Drop the stale image: a later breakpoint save would
                 # otherwise persist it and regress the tracker.
                 self.shm.invalidate()
-                return None
+                return False
+            return meta is not None
         finally:
             self._shard_lock.release()
             manager.stop()
+
+    def _load_from_peer(self, template: Any):
+        """Refill this host's shm from the peer that replicated it, then
+        load through the normal memory path."""
+        if not self._refill_from_peer():
+            return None
         return self._load_from_memory(template)
 
     def _load_from_memory(self, template: Any):
@@ -339,15 +391,22 @@ class CheckpointEngine:
         logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
         return step, restored
 
-    def _gather_steps(self, step: int) -> List[int]:
-        """Every host's restored step (single-process: just ours)."""
-        if jax.process_count() <= 1:
-            return [step]
+    def _gather_restore_meta(
+        self, mem_step: int, st_step: int
+    ) -> Tuple[List[int], List[int]]:
+        """Every host's (staged shm step, storage tracker step) —
+        host-only metadata, gathered before any collective restore."""
+        if _process_count() <= 1:
+            return [mem_step], [st_step]
         from jax.experimental import multihost_utils
 
-        return [
-            int(s) for s in multihost_utils.process_allgather(np.int64(step))
-        ]
+        gathered = multihost_utils.process_allgather(
+            np.array([mem_step, st_step], np.int64)
+        )
+        return (
+            [int(v) for v in gathered[:, 0]],
+            [int(v) for v in gathered[:, 1]],
+        )
 
     def load_consistent(self, template: Any) -> Tuple[int, Optional[Any]]:
         """``load`` + cross-host consistency (reference
@@ -357,35 +416,54 @@ class CheckpointEngine:
         ``load`` is per-host (own shm → peer → storage), so after a node
         replacement hosts can legally restore DIFFERENT steps — and a
         step-count fix alone would train a model whose shards mix two
-        checkpoints. When the allgathered steps disagree, every host
-        discards its restore and reloads the newest step available to
-        ALL of them: the smallest committed-storage step across hosts
-        (storage is the shared tier; commit markers make it complete).
-        No common storage step → everyone starts fresh, consistently.
+        checkpoints.
+
+        On a MULTI-PROCESS world the restore itself is collective: when
+        the template leaves live on a global (multi-process) mesh, each
+        ``device_put`` participates in cross-host transfers, so hosts
+        must agree on the restore SOURCE before moving a single byte —
+        a host restoring from memory while another reads storage would
+        interleave mismatched collectives and deadlock/abort the world.
+        The agreement therefore happens on cheap host-only metadata
+        (shm meta step, storage tracker) gathered FIRST; then every
+        host executes the SAME restore path:
+
+        - all hosts stage the same memory step → memory restore
+          everywhere;
+        - otherwise the newest storage step committed on EVERY host;
+        - no common storage step → everyone starts fresh, consistently.
         """
-        step, restored = self.load(template)
-        steps = self._gather_steps(step)
-        if len(set(steps)) == 1:
-            return step, restored
+        meta = self.shm.read_meta() if self.shm.attach() else None
+        if meta is None and self._refill_from_peer():
+            meta = self.shm.read_meta()
+        mem_step = -1 if meta is None else meta.step
         storage_latest = self.storage.latest_step()
-        target = min(
-            self._gather_steps(
-                -1 if storage_latest is None else storage_latest
+        st_step = -1 if storage_latest is None else storage_latest
+        mem_steps, st_steps = self._gather_restore_meta(mem_step, st_step)
+        if mem_steps[0] >= 0 and len(set(mem_steps)) == 1:
+            result = self._load_from_memory(template)
+            if result is not None:
+                return result
+            if _process_count() > 1:
+                # our shm image turned out unreadable AFTER agreement —
+                # the other hosts are already inside the memory
+                # restore's collectives; no safe divergence from here.
+                raise RuntimeError(
+                    f"agreed memory step {mem_steps[0]} unreadable "
+                    "locally; restart the worker to re-rendezvous"
+                )
+            # single process: nothing collective at risk — storage next
+        target = min(st_steps)
+        if len(set(mem_steps)) != 1 or mem_steps[0] < 0:
+            logger.info(
+                "staged steps %s not uniformly restorable (storage %s); "
+                "restoring common storage step %s",
+                mem_steps,
+                st_steps,
+                target,
             )
-        )
-        logger.warning(
-            "hosts restored different steps %s; reloading common storage "
-            "step %s",
-            steps,
-            target,
-        )
         if target < 0:
             return -1, None
-        if step == target and restored is not None:
-            # our restore already holds exactly this step's data (memory
-            # stages and storage commits of a step are the same bytes)
-            return step, restored
-        del restored
         return target, self._reload(template, target)
 
     def _reload(self, template: Any, step: int):

@@ -102,8 +102,11 @@ def _leaf_records(path: str, leaf) -> List[Tuple[ShardRecord, Any]]:
             spec = []
         seen_indices = set()
         for shard in leaf.addressable_shards:
-            if shard.replica_id != 0:
-                continue  # exact replica of a shard another device owns
+            # Dedupe by index among THIS HOST's shards only (NOT by
+            # replica_id): on a multi-process mesh a replicated leaf's
+            # replica_id-0 copy lives on ONE host — filtering on it
+            # would leave every other host's shm empty for that leaf,
+            # making its staged checkpoint unrestorable after a re-mesh.
             key = tuple(
                 (s.start or 0, s.stop if s.stop is not None else dim)
                 for s, dim in zip(shard.index, leaf.shape)

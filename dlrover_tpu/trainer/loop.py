@@ -134,8 +134,12 @@ class ElasticTrainLoop:
             # stopped. Skipped when the last in-loop save already
             # landed — re-staging the identical step would cost a
             # redundant full-model D2H + memcpy (+ replica push).
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
+            # Bounded by ATTEMPT COUNT, not wall clock: each attempt is
+            # a cross-host collective whose outcome is identical on
+            # every host, so a count keeps all hosts in lockstep where
+            # per-host deadlines would desynchronize the collective
+            # sequence and wedge the world.
+            for _ in range(300):
                 if self.engine.save_to_memory(step - 1, state):
                     break
                 time.sleep(0.1)

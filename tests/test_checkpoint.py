@@ -228,15 +228,14 @@ class TestEngineEndToEnd:
             assert engine.wait_saving(timeout=30)
             assert engine.save_to_memory(5, {"w": jnp.full((4,), 5.0)})
 
-            calls = {"n": 0}
+            def fake_gather(mem_step, st_step):
+                # "another host" only staged step 3 in memory; both have
+                # storage step 3 committed
+                return [mem_step, 3], [st_step, 3]
 
-            def fake_gather(step):
-                calls["n"] += 1
-                # first gather: restored steps disagree (peer got 3);
-                # second gather: storage latest (both see 3)
-                return [step, 3]
-
-            monkeypatch.setattr(engine, "_gather_steps", fake_gather)
+            monkeypatch.setattr(
+                engine, "_gather_restore_meta", fake_gather
+            )
             step, restored = engine.load_consistent(
                 {"w": jnp.zeros(4, jnp.float32)}
             )

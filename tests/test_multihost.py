@@ -42,9 +42,12 @@ engine = CheckpointEngine(
 # both ranks commit step 3 to (their) storage
 assert engine.save_to_storage(3, {"w": jnp.full((4,), 3.0)})
 assert engine.wait_saving(60)
-# rank 0 then stages a NEWER memory step the other rank never saw
+# rank 0 then stages a NEWER memory step the other rank never saw —
+# via the shm handler directly: save_to_memory itself is collective
+# (all-or-none allreduce), which is exactly why live worlds cannot
+# diverge; this simulates a stage left behind by a DEAD world
 if rank == 0:
-    assert engine.save_to_memory(5, {"w": jnp.full((4,), 5.0)})
+    engine.shm.save_pytree(5, {"w": jnp.full((4,), 5.0)}, num_hosts=1)
 
 from jax.experimental import multihost_utils
 multihost_utils.sync_global_devices("staged")
@@ -258,6 +261,176 @@ def test_full_stack_two_host_jax_world(tmp_path):
         assert l0[-1] < l0[0]  # and it learns
         # the master's PerfMonitor saw the step reports -> goodput live
         assert master.perf_monitor.last_step()[0] >= 2
+    finally:
+        master.stop()
+        scaler.stop()
+        cleanup_namespaces(job, 2)
+
+
+CHAOS_TRAINER = r'''
+import os, json, pathlib
+from dlrover_tpu.common.platform import force_virtual_cpu
+force_virtual_cpu(1)
+import jax
+from dlrover_tpu.trainer.elastic import elastic_context
+
+ctx = elastic_context()
+assert jax.process_count() == 2
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.train_step import (
+    build_train_step, default_optimizer, init_train_state,
+)
+from dlrover_tpu.trainer.loop import ElasticTrainLoop
+
+rank = ctx.process_id
+out = pathlib.Path(os.environ["OUT_DIR"])
+progress = out / f"progress_{rank}.txt"
+
+cfg = GPTConfig.tiny()
+model = GPT(cfg)
+mesh = build_mesh(MeshConfig(dp=2, fsdp=1))
+tx = default_optimizer(learning_rate=1e-2, warmup_steps=2)
+tokens = jnp.zeros((4, cfg.max_seq_len), jnp.int32)
+state, sh = init_train_state(model, tokens, mesh, tx)
+step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, sh)
+
+engine = CheckpointEngine(
+    os.path.join(os.environ["CKPT_DIR"], f"rank{rank}"),
+    mesh=mesh, host_rank=rank, num_hosts=1, replicate=False,
+)
+spec = jax.sharding.PartitionSpec(("dp", "fsdp"))
+r = np.random.default_rng(0)
+xg = r.integers(0, cfg.vocab_size, (4, cfg.max_seq_len)).astype("int32")
+yg = np.roll(xg, -1, axis=1)
+
+def data():
+    while True:
+        x = multihost_utils.host_local_array_to_global_array(
+            xg[rank*2:(rank+1)*2], mesh, spec)
+        y = multihost_utils.host_local_array_to_global_array(
+            yg[rank*2:(rank+1)*2], mesh, spec)
+        yield x, y
+
+import time
+def on_step(step, loss):
+    with open(progress, "a") as f:
+        f.write(f"{step}\n")
+    time.sleep(0.3)
+
+def factory(start):
+    # called AFTER the (cross-host-consistent) restore with the agreed
+    # start step — the resume marker the test watches for
+    if start > 0:
+        (out / f"resumed_{rank}_{start - 1}").write_text(str(os.getpid()))
+    return data()
+
+loop = ElasticTrainLoop(
+    engine, step_fn, ctx=ctx, max_steps=400,
+    storage_every=1,  # every step commits: resume agreement always has
+                      # a common storage step after a replacement
+    on_step=on_step,
+)
+state = loop.run(state, data_factory=factory)
+print(f"rank {rank} finished", flush=True)
+'''
+
+
+@pytest.mark.slow
+def test_chaos_kill_on_real_two_host_world(tmp_path):
+    """THE production scenario at full depth: a genuine 2-process
+    jax.distributed world trains under tpurun agents; one host is
+    SIGKILLed; the master replaces it; BOTH fresh worker incarnations
+    re-rendezvous into a NEW 2-process world (new coordinator), agree on
+    a consistent resume step, and training continues past the kill."""
+    from e2e_utils import cleanup_namespaces, make_process_master
+
+    out_dir = tmp_path / "out"
+    ckpt_dir = tmp_path / "ckpt"
+    out_dir.mkdir()
+    ckpt_dir.mkdir()
+    script = tmp_path / "train.py"
+    script.write_text(CHAOS_TRAINER)
+    job = f"mh_chaos_{os.getpid()}"
+    wlogs = tmp_path / "wlogs"
+    master, scaler, watcher = make_process_master(
+        job,
+        command=[
+            sys.executable,
+            "-m",
+            "dlrover_tpu.launcher.elastic_run",
+            "--nnodes",
+            "2",
+            "--max_restarts",
+            "3",
+            "--log_dir",
+            str(wlogs),
+            str(script),
+        ],
+        env={
+            "OUT_DIR": str(out_dir),
+            "CKPT_DIR": str(ckpt_dir),
+            "DLROVER_LOCAL_DEVICES": "1",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": os.pathsep.join(sys.path),
+        },
+        num_workers=2,
+    )
+    import signal
+    import time as _time
+
+    def steps(rank):
+        p = out_dir / f"progress_{rank}.txt"
+        if not p.exists():
+            return []
+        return [int(l) for l in p.read_text().splitlines()]
+
+    try:
+        master.prepare()
+        master.run_in_background()
+        deadline = _time.time() + 180
+        while _time.time() < deadline:
+            if len(steps(0)) >= 4 and len(steps(1)) >= 4:
+                break
+            _time.sleep(0.5)
+        assert len(steps(0)) >= 4 and len(steps(1)) >= 4, "never trained"
+
+        killed_at = max(steps(0) or [0])
+        os.killpg(scaler._procs[0].proc.pid, signal.SIGKILL)
+
+        # both fresh incarnations must resume into a NEW 2-host world
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            if list(out_dir.glob("resumed_0_*")) and list(
+                out_dir.glob("resumed_1_*")
+            ):
+                break
+            _time.sleep(0.5)
+        assert list(out_dir.glob("resumed_0_*")), "rank 0 never resumed"
+        assert list(out_dir.glob("resumed_1_*")), "rank 1 never resumed"
+        r0 = int(
+            list(out_dir.glob("resumed_0_*"))[0].name.rsplit("_", 1)[-1]
+        )
+        r1 = int(
+            list(out_dir.glob("resumed_1_*"))[0].name.rsplit("_", 1)[-1]
+        )
+        assert r0 == r1, f"ranks resumed from different steps: {r0} vs {r1}"
+        assert r0 >= killed_at - 3, (r0, killed_at)
+
+        # and the new world actually trains past the kill point
+        deadline = _time.time() + 180
+        while _time.time() < deadline:
+            s0 = steps(0)
+            if s0 and s0[-1] > killed_at + 3:
+                break
+            _time.sleep(0.5)
+        assert steps(0)[-1] > killed_at + 3, "no progress after re-mesh"
+        assert steps(1)[-1] > killed_at, "survivor stalled after re-mesh"
     finally:
         master.stop()
         scaler.stop()

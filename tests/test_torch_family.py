@@ -344,24 +344,10 @@ def _read_progress(path):
     return rows
 
 
-def _cleanup_namespaces():
-    from dlrover_tpu.agent.worker import kill_worker_by_pidfile
-
-    for job in ("torch_e2e_n0", "torch_e2e_n1"):
-        kill_worker_by_pidfile(job)
-        for name in os.listdir("/dev/shm"):
-            if name.startswith(f"dlrover_{job}_"):
-                try:
-                    os.unlink(os.path.join("/dev/shm", name))
-                except OSError:
-                    pass
-
-
 @pytest.mark.slow
 def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
     from e2e_utils import make_process_master
 
-    _cleanup_namespaces()
     progress_dir = tmp_path / "progress"
     ckpt_dir = tmp_path / "ckpt"
     progress_dir.mkdir()
@@ -438,4 +424,6 @@ def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
     finally:
         master.stop()
         scaler.stop()
-        _cleanup_namespaces()
+        from e2e_utils import cleanup_namespaces
+
+        cleanup_namespaces("torch_e2e", 2)

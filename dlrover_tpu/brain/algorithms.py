@@ -16,7 +16,7 @@ history-driven decision style, re-targeted at slice-count selection:
 """
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict
 
 from ..common.log import logger
 from .datastore import BrainDataStore

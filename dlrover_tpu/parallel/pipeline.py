@@ -18,7 +18,6 @@ bubble — ``(S-1)/(M+S-1)`` of the schedule, amortized by more
 microbatches).
 """
 
-from functools import partial
 from typing import Any, Callable
 
 import jax

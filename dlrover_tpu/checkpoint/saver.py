@@ -385,8 +385,16 @@ class AsyncCheckpointSaver:
                 reader = self.shm.payload_reader()
                 self.storage.write_shard(meta, reader)
             self._persisted_steps[meta.step] = True
-            self.storage.commit(meta.step, self.num_hosts)
+            committed = self.storage.commit(meta.step, self.num_hosts)
             self.storage.clear_persist_error(self.host_rank)
+            if committed:
+                from ..common.config import get_context
+
+                keep = get_context().ckpt_keep_latest
+                if keep > 0:
+                    # bounded retention (reference keeps a rolling set;
+                    # unbounded step dirs eventually fill the volume)
+                    self.storage.keep_latest(keep)
         except Exception as e:  # noqa: BLE001 — reported via marker
             logger.exception("persist failed for step %s", step)
             try:

@@ -222,7 +222,49 @@ class PosixCheckpointStorage:
     def remove_step(self, step: int) -> None:
         shutil.rmtree(self.step_dir(step), ignore_errors=True)
 
+    # Uncommitted step dirs older than this are crash leftovers (a host
+    # died mid-persist); anything younger may be an in-flight write.
+    STALE_PARTIAL_GRACE_S = 3600.0
+
     def keep_latest(self, count: int) -> None:
-        steps = self.list_steps()
-        for step in steps[:-count]:
-            self.remove_step(step)
+        """Retain the ``count`` most RECENTLY COMMITTED steps (by commit
+        marker mtime, NOT step number: a fresh run reusing a root that
+        still holds a stale higher-numbered history must not have its
+        new low-numbered commits deleted out from under the tracker).
+        Also sweeps uncommitted step dirs past the staleness grace —
+        crashed partial persists would otherwise accumulate forever."""
+        import time as _time
+
+        committed = []
+        partial = []
+        if not os.path.isdir(self.root):
+            return
+        for name in os.listdir(self.root):
+            if not name.isdigit():
+                continue
+            step = int(name)
+            marker = os.path.join(
+                self.step_dir(step), CheckpointConstant.COMMIT_FILE
+            )
+            try:
+                committed.append((os.path.getmtime(marker), step))
+            except OSError:
+                try:
+                    partial.append(
+                        (os.path.getmtime(self.step_dir(step)), step)
+                    )
+                except OSError:
+                    pass
+        committed.sort()
+        keep = {step for _, step in committed[-count:]}
+        tracked = self.latest_step()
+        if tracked is not None:
+            keep.add(tracked)  # never delete what the tracker points at
+        for _, step in committed[:-count]:
+            if step not in keep:
+                self.remove_step(step)
+        now = _time.time()
+        for mtime, step in partial:
+            if now - mtime > self.STALE_PARTIAL_GRACE_S and step not in keep:
+                logger.info("removing stale partial checkpoint step %s", step)
+                self.remove_step(step)

@@ -43,6 +43,9 @@ class Context:
     # Checkpoint
     save_at_breakpoint: bool = DefaultValues.SAVE_AT_BREAKPOINT
     ckpt_replica_count: int = 0  # peer-memory replicas per shard
+    # committed steps kept on storage (0 = unlimited); pruned by the
+    # saver after each successful commit
+    ckpt_keep_latest: int = 3
 
     # Pre-check
     precheck_enabled: bool = True

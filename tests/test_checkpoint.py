@@ -166,6 +166,64 @@ class TestEngineEndToEnd:
             engine2.shm.unlink()
             engine2.close()
 
+    def test_storage_retention_prunes_old_steps(self, tmp_path, monkeypatch):
+        """The saver keeps only ckpt_keep_latest committed steps —
+        unbounded step dirs would eventually fill the volume."""
+        from dlrover_tpu.common.config import get_context
+
+        import time as _time
+
+        monkeypatch.setattr(get_context(), "ckpt_keep_latest", 2)
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        try:
+            for step in (1, 2, 3, 4):
+                assert engine.save_to_storage(step, {"w": jnp.full(4, float(step))})
+                assert engine.wait_saving(timeout=30)
+            # wait_saving returns at tracker update; the saver prunes
+            # right after — poll briefly
+            deadline = _time.time() + 15
+            while _time.time() < deadline:
+                if engine.storage.list_steps() == [3, 4]:
+                    break
+                _time.sleep(0.1)
+            assert engine.storage.list_steps() == [3, 4]
+            assert engine.storage.latest_step() == 4
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_retention_by_commit_recency_and_stale_partials(self, tmp_path):
+        """A fresh run reusing a root with stale HIGHER-numbered history
+        must keep its new low commits; crashed partial dirs past the
+        grace window are swept."""
+        import time as _time
+
+        storage = PosixCheckpointStorage(str(tmp_path / "ckpt"))
+        from dlrover_tpu.checkpoint.meta import CheckpointMeta
+
+        def commit(step):
+            meta = CheckpointMeta(step=step, host_rank=0, num_hosts=1)
+            storage.write_shard(meta, b"x")
+            assert storage.commit(step, 1)
+
+        for old in (500, 501):
+            commit(old)
+        _time.sleep(0.05)
+        commit(1)  # new run, low step, committed most recently
+        storage.keep_latest(2)
+        steps = storage.list_steps()
+        assert 1 in steps, steps  # newest COMMIT survives despite low number
+        assert 500 not in steps, steps
+        # stale partial: uncommitted dir older than the grace window
+        os.makedirs(storage.step_dir(77), exist_ok=True)
+        old_time = _time.time() - storage.STALE_PARTIAL_GRACE_S - 10
+        os.utime(storage.step_dir(77), (old_time, old_time))
+        # a FRESH partial must survive (may be an in-flight persist)
+        os.makedirs(storage.step_dir(78), exist_ok=True)
+        storage.keep_latest(2)
+        assert not os.path.isdir(storage.step_dir(77))
+        assert os.path.isdir(storage.step_dir(78))
+
     def test_saver_restarts_on_namespace_change(self, tmp_path, monkeypatch):
         """A live runner serving an OLD job namespace must be torn down
         when the namespace changes — otherwise a new engine times out

@@ -1,35 +1,192 @@
 """Benchmark entry: prints ONE JSON line for the driver.
 
-Round-2 headline (VERDICT.md #2): real training throughput of the
-flagship GPT-2-small on the TPU chip — tokens/s and MFU vs v5e peak
-(197 bf16 TFLOP/s) — with the Pallas flash-attention kernel exercised
-on hardware and compared against the XLA dense-attention path.
-``vs_baseline`` = flash-path tokens/s over the best dense-path
-tokens/s (>1.0 means the kernel pays for itself).
+Headline (stable from r02 on): real training throughput of the flagship
+GPT-2-small on the TPU chip — tokens/s and MFU vs v5e peak (197 bf16
+TFLOP/s) — with the Pallas flash-attention kernel exercised on hardware
+and compared against the XLA dense-attention path. ``vs_baseline`` =
+flash-path tokens/s over the best dense-path tokens/s (>1.0 means the
+kernel pays for itself).
 
-Also carried in ``extra`` (BASELINE.md metric family): flash-checkpoint
-save blocking seconds, async persist, memory-restore seconds for the
-full ~1.5 GB train state, and the implied goodput of checkpointing
-every 10 steps (reference GLM-65B cadence, flash_checkpoint.md:403).
+Also carried in ``extra`` (BASELINE.md metric family, stable since r01):
+``flash_ckpt_save_block_s`` blocking-save seconds, async persist,
+memory-restore seconds for the full ~1.5 GB train state, and the implied
+goodput of checkpointing every 10 steps (reference GLM-65B cadence,
+flash_checkpoint.md:403).
 
-On CPU (no TPU chip) the bench degrades to tiny shapes so CI smoke
+Failure discipline (VERDICT r2 #1 — BENCH_r02 died with rc=1 and no
+JSON): this file is an orchestrator/worker pair.
+
+- Orchestrator (default): imports NO jax. Probes the TPU with a tiny
+  matmul in a throwaway subprocess, retrying with backoff for up to
+  ~5 minutes (a failed PJRT init can poison a process, hence one fresh
+  re-exec per attempt). Then runs the worker in its own process with a
+  hard timeout. On terminal TPU failure it re-runs the worker
+  CPU-degraded and attaches ``extra.tpu_error``. A JSON line is printed
+  on EVERY path, exit code 0.
+- Worker (``--worker``): the actual measurement. Every non-headline
+  section is individually guarded so a long-seq compile failure or a
+  checkpoint hiccup downgrades to an ``extra.*_error`` field instead of
+  killing the run; even a headline failure prints a JSON line with
+  whatever was measured.
+
+On CPU (no TPU chip) the worker degrades to tiny shapes so CI smoke
 runs still complete; the JSON line then reports device=cpu.
 """
 
 import json
+import os
 import shutil
+import subprocess
+import sys
 import tempfile
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 V5E_PEAK_FLOPS = 197e12  # bf16 per chip
 TARGET_SAVE_BLOCK_S = 5.0  # BASELINE.json north star
 
+METRIC = "gpt2s_train_tokens_per_s"
+
+# ---------------------------------------------------------------------------
+# Orchestrator — no jax imports in this half.
+# ---------------------------------------------------------------------------
+
+# Fetch the scalar: over the tunneled chip block_until_ready can return
+# before execution, so sync on the value itself.
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp, numpy as np;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "v = float(jnp.dot(x, x).sum());"
+    "assert np.isfinite(v), v;"
+    "print('PROBE_OK', jax.devices()[0].platform)"
+)
+
+PROBE_WINDOW_S = 300.0  # total backoff budget for TPU init
+PROBE_TIMEOUT_S = 180.0  # one probe attempt (first compile can be slow)
+WORKER_TIMEOUT_S = 1800.0  # full TPU bench attempt
+CPU_WORKER_TIMEOUT_S = 900.0
+
+
+def _run(cmd, env, timeout):
+    try:
+        p = subprocess.run(
+            cmd, env=env, timeout=timeout, capture_output=True, text=True
+        )
+        return p.returncode, p.stdout or "", p.stderr or ""
+    except subprocess.TimeoutExpired as e:
+
+        def _s(v):
+            if v is None:
+                return ""
+            return v.decode(errors="replace") if isinstance(v, bytes) else v
+
+        return -9, _s(e.stdout), _s(e.stderr) + f"\nTIMEOUT after {timeout}s"
+    except Exception as e:  # noqa: BLE001 — orchestrator must not die
+        return -1, "", repr(e)
+
+
+def _last_json_line(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return None
+
+
+def _emit(result):
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
+def _fallback_json(error, extra=None):
+    out = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": dict(extra or {}),
+    }
+    out["extra"]["fatal_error"] = str(error)[-500:]
+    return out
+
+
+def orchestrate():
+    env = dict(os.environ)
+    worker_cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+
+    if env.get("JAX_PLATFORMS", "") == "cpu":
+        # CI smoke: no TPU expected, run the worker directly.
+        rc, out, err = _run(worker_cmd, env, CPU_WORKER_TIMEOUT_S)
+        parsed = _last_json_line(out)
+        _emit(parsed or _fallback_json(f"cpu worker rc={rc}: {err[-400:]}"))
+        return
+
+    # -- phase 1: bring the TPU backend up (retry, fresh process each try)
+    deadline = time.time() + PROBE_WINDOW_S
+    tpu_error = None
+    delay = 5.0
+    while True:
+        rc, out, err = _run(
+            [sys.executable, "-c", _PROBE_SRC], env, PROBE_TIMEOUT_S
+        )
+        if rc == 0 and "PROBE_OK" in out:
+            platform = out.split("PROBE_OK", 1)[1].strip().split()[0]
+            if platform != "cpu":
+                tpu_error = None
+                break
+            # jax silently fell back to CPU — treat as TPU-unavailable
+            tpu_error = f"probe landed on platform={platform}"
+        else:
+            tpu_error = f"probe rc={rc}: {(err or out)[-400:]}"
+        if time.time() + delay > deadline:
+            break
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
+
+    # -- phase 2: the real bench on TPU (two attempts — a transient
+    # mid-bench Unavailable should not forfeit the round's numbers)
+    if tpu_error is None:
+        for _attempt in range(2):
+            rc, out, err = _run(worker_cmd, env, WORKER_TIMEOUT_S)
+            parsed = _last_json_line(out)
+            if parsed is not None:
+                # A JSON line is a finished measurement even if the
+                # process then died in cleanup (e.g. a runtime at-exit
+                # hang over the tunneled chip) — keep the numbers.
+                if rc != 0:
+                    parsed.setdefault("extra", {})["worker_rc"] = rc
+                _emit(parsed)
+                return
+            tpu_error = f"worker rc={rc}: {(err or out)[-400:]}"
+
+    # -- phase 3: degraded CPU numbers, never rc!=0 / no JSON
+    env_cpu = dict(env)
+    env_cpu["JAX_PLATFORMS"] = "cpu"
+    rc, out, err = _run(worker_cmd, env_cpu, CPU_WORKER_TIMEOUT_S)
+    parsed = _last_json_line(out)
+    if parsed is None:
+        parsed = _fallback_json(f"cpu worker rc={rc}: {(err or out)[-400:]}")
+    parsed.setdefault("extra", {})["tpu_error"] = (tpu_error or "unknown")[
+        -500:
+    ]
+    _emit(parsed)
+
+
+# ---------------------------------------------------------------------------
+# Worker — the measurement itself (runs in its own process).
+# ---------------------------------------------------------------------------
+
 
 def _build(cfg_kwargs, batch, seq, mesh):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
     from dlrover_tpu.parallel.train_step import (
         build_train_step,
@@ -50,6 +207,8 @@ def _build(cfg_kwargs, batch, seq, mesh):
 
 
 def _time_steps(state, step_fn, x, y, iters=6):
+    import numpy as np
+
     state, loss = step_fn(state, x, y)  # compile + warmup
     # Hard sync via a scalar fetch: over the tunneled chip
     # block_until_ready can return before the step actually executed
@@ -73,103 +232,48 @@ def _mfu(cfg, n_params, batch, seq, step_s):
     return flops_per_token * batch * seq / step_s / V5E_PEAK_FLOPS
 
 
-def main():
-    import os
+def _bench_long_context(extra):
+    """Flash-attention kernel at 4x the training seq (TPU only)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # CI smoke: this environment's sitecustomize re-registers the
-        # hardware plugin after env-var resolution, so pin explicitly.
-        from dlrover_tpu.common.platform import force_virtual_cpu
+    from dlrover_tpu.ops.flash_attention import flash_attention
 
-        force_virtual_cpu(1)
+    B, H, T, Dh = 4, 12, 4096, 64
+    r2 = np.random.default_rng(1)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        r2.standard_normal((B, T, H, Dh)), jnp.bfloat16
+    )
+    q, k, v = mk(), mk(), mk()
+    att = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    out = att(q, k, v)
+    if not np.isfinite(float(out.sum())):
+        raise RuntimeError("non-finite flash output")
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = att(q, k, v)
+        _ = float(out[0, 0, 0, 0])  # hard sync
+        ts.append(time.perf_counter() - t0)
+    att_s = float(np.median(ts))
+    # causal fwd flops: 2 matmuls over the lower triangle
+    flops = 2 * 2 * B * H * T * T * Dh / 2
+    extra.update(
+        {
+            "flash_seq4096_ms": round(att_s * 1e3, 2),
+            "flash_seq4096_tflops": round(flops / att_s / 1e12, 1),
+        }
+    )
+
+
+def _bench_checkpoint(extra, state, mesh, flash_s):
+    """Flash checkpoint on the real train state (~1.5 GB on TPU)."""
+    import jax
+    import numpy as np
 
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
-    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
-    extra = {"device": str(jax.devices()[0])}
-
-    if on_tpu:
-        # Flash path: bs=32 fits only because the Pallas kernel never
-        # materializes the s^2 probability tensor (dense OOMs at bs=32:
-        # 17.4G > 15.75G hbm); dense's best single-chip config is bs=16.
-        flash_bs, dense_bs, seq = 32, 16, 1024
-    else:
-        flash_bs, dense_bs, seq = 2, 2, 128
-
-    tiny = {} if on_tpu else dict(
-        vocab_size=256, num_layers=2, num_heads=4, head_dim=8, embed_dim=32,
-        use_remat=False,
-    )
-
-    cfg, state, step_fn, x, y = _build(
-        dict(attention_impl="flash", **tiny), flash_bs, seq, mesh
-    )
-    n_params = sum(l.size for l in jax.tree.leaves(state.params))
-    flash_s, state = _time_steps(state, step_fn, x, y)
-    flash_tps = flash_bs * seq / flash_s
-    extra.update(
-        {
-            "model": f"gpt2-small-{n_params/1e6:.0f}M" if on_tpu else "tiny",
-            "flash_step_s": round(flash_s, 4),
-            "flash_batch": flash_bs,
-            "seq_len": seq,
-            "mfu": round(_mfu(cfg, n_params, flash_bs, seq, flash_s), 4),
-        }
-    )
-
-    _, dstate, dstep_fn, dx, dy = _build(
-        dict(attention_impl="dense", **tiny), dense_bs, seq, mesh
-    )
-    dense_s, _ = _time_steps(dstate, dstep_fn, dx, dy)
-    del dstate, dstep_fn, dx, dy
-    dense_tps = dense_bs * seq / dense_s
-    extra.update(
-        {
-            "dense_step_s": round(dense_s, 4),
-            "dense_batch": dense_bs,
-            "dense_tokens_per_s": round(dense_tps, 1),
-            "flash_vs_dense": round(flash_tps / dense_tps, 3),
-        }
-    )
-
-    # -- long context: flash-attention kernel at 4x the training seq ------
-    # Guarded: a long-seq compile failure must not take down the headline
-    # numbers; on success the extras carry kernel TFLOP/s at seq 4096.
-    if on_tpu:
-        try:
-            from dlrover_tpu.ops.flash_attention import flash_attention
-
-            B, H, T, Dh = 4, 12, 4096, 64
-            r2 = np.random.default_rng(1)
-            mk = lambda: jnp.asarray(  # noqa: E731
-                r2.standard_normal((B, T, H, Dh)), jnp.bfloat16
-            )
-            q, k, v = mk(), mk(), mk()
-            att = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-            out = att(q, k, v)
-            if not np.isfinite(float(out.sum())):
-                raise RuntimeError("non-finite flash output")
-            ts = []
-            for _ in range(5):
-                t0 = time.perf_counter()
-                out = att(q, k, v)
-                _ = float(out[0, 0, 0, 0])  # hard sync
-                ts.append(time.perf_counter() - t0)
-            att_s = float(np.median(ts))
-            # causal fwd flops: 2 matmuls over the lower triangle
-            flops = 2 * 2 * B * H * T * T * Dh / 2
-            extra.update(
-                {
-                    "flash_seq4096_ms": round(att_s * 1e3, 2),
-                    "flash_seq4096_tflops": round(flops / att_s / 1e12, 1),
-                }
-            )
-        except Exception as e:  # noqa: BLE001
-            extra["flash_seq4096_error"] = repr(e)[:120]
-
-    # -- flash checkpoint on the real train state (~1.5 GB on TPU) --------
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
     engine = None
     try:
@@ -219,6 +323,8 @@ def main():
         extra.update(
             {
                 "ckpt_bytes": int(nbytes),
+                # r01 family name, kept stable alongside the short alias
+                "flash_ckpt_save_block_s": round(save_block_s, 4),
                 "ckpt_save_block_s": round(save_block_s, 4),
                 "ckpt_save_vs_target": round(
                     TARGET_SAVE_BLOCK_S / max(save_block_s, 1e-9), 2
@@ -240,18 +346,103 @@ def main():
                 pass
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
-    print(
-        json.dumps(
+
+def worker():
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # This environment's sitecustomize re-registers the hardware
+        # plugin after env-var resolution, so pin explicitly.
+        from dlrover_tpu.common.platform import force_virtual_cpu
+
+        force_virtual_cpu(1)
+
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    extra = {}
+    flash_tps = 0.0
+    vs_baseline = 0.0
+    try:
+        on_tpu = jax.devices()[0].platform != "cpu"
+        mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+        extra["device"] = str(jax.devices()[0])
+
+        if on_tpu:
+            # Flash path: bs=32 fits only because the Pallas kernel never
+            # materializes the s^2 probability tensor (dense OOMs at
+            # bs=32: 17.4G > 15.75G hbm); dense's best single-chip config
+            # is bs=16.
+            flash_bs, dense_bs, seq = 32, 16, 1024
+        else:
+            flash_bs, dense_bs, seq = 2, 2, 128
+
+        tiny = {} if on_tpu else dict(
+            vocab_size=256, num_layers=2, num_heads=4, head_dim=8,
+            embed_dim=32, use_remat=False,
+        )
+
+        cfg, state, step_fn, x, y = _build(
+            dict(attention_impl="flash", **tiny), flash_bs, seq, mesh
+        )
+        n_params = sum(l.size for l in jax.tree.leaves(state.params))
+        flash_s, state = _time_steps(state, step_fn, x, y)
+        flash_tps = flash_bs * seq / flash_s
+        extra.update(
             {
-                "metric": "gpt2s_train_tokens_per_s",
-                "value": round(flash_tps, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(flash_tps / dense_tps, 3),
-                "extra": extra,
+                "model": f"gpt2-small-{n_params/1e6:.0f}M" if on_tpu else "tiny",
+                "flash_step_s": round(flash_s, 4),
+                "flash_batch": flash_bs,
+                "seq_len": seq,
+                "mfu": round(_mfu(cfg, n_params, flash_bs, seq, flash_s), 4),
             }
         )
+
+        try:
+            _, dstate, dstep_fn, dx, dy = _build(
+                dict(attention_impl="dense", **tiny), dense_bs, seq, mesh
+            )
+            dense_s, _ = _time_steps(dstate, dstep_fn, dx, dy)
+            del dstate, dstep_fn, dx, dy
+            dense_tps = dense_bs * seq / dense_s
+            vs_baseline = flash_tps / dense_tps
+            extra.update(
+                {
+                    "dense_step_s": round(dense_s, 4),
+                    "dense_batch": dense_bs,
+                    "dense_tokens_per_s": round(dense_tps, 1),
+                    "flash_vs_dense": round(vs_baseline, 3),
+                }
+            )
+        except Exception as e:  # noqa: BLE001 — keep the flash headline
+            extra["dense_error"] = repr(e)[:200]
+
+        if on_tpu:
+            try:
+                _bench_long_context(extra)
+            except Exception as e:  # noqa: BLE001
+                extra["flash_seq4096_error"] = repr(e)[:200]
+
+        try:
+            _bench_checkpoint(extra, state, mesh, flash_s)
+        except Exception as e:  # noqa: BLE001
+            extra["ckpt_error"] = repr(e)[:200]
+    except Exception as e:  # noqa: BLE001 — JSON line on every path
+        extra["fatal_error"] = repr(e)[:500]
+
+    _emit(
+        {
+            "metric": METRIC,
+            "value": round(flash_tps, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(vs_baseline, 3),
+            "extra": extra,
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv[1:]:
+        worker()
+    else:
+        orchestrate()

@@ -391,21 +391,47 @@ class CheckpointEngine:
         logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
         return step, restored
 
+    # Floor for how many of each host's newest committed steps enter the
+    # cross-host agreement; the effective count always exceeds the
+    # configured ckpt_keep_latest (see _restore_candidate_steps) so
+    # pruning can't hide a still-on-disk common step from the
+    # intersection.
+    RESTORE_CANDIDATE_STEPS = 8
+
+    def _restore_candidate_steps(self) -> int:
+        # Job config is uniform across hosts, so every host computes the
+        # same K — required: the allgather row length depends on it.
+        from ..common.config import get_context
+
+        return max(self.RESTORE_CANDIDATE_STEPS, get_context().ckpt_keep_latest + 2)
+
     def _gather_restore_meta(
-        self, mem_step: int, st_step: int
-    ) -> Tuple[List[int], List[int]]:
-        """Every host's (staged shm step, storage tracker step) —
-        host-only metadata, gathered before any collective restore."""
+        self, mem_step: int, tracker_step: int, committed: List[int]
+    ) -> Tuple[List[int], List[int], List[set]]:
+        """Every host's (staged shm step, storage tracker step, committed
+        step set) — host-only metadata, gathered before any collective
+        restore. The committed set (top-K of ``storage.list_steps()``)
+        rather than just the tracker: with per-host storage roots plus
+        ``ckpt_keep_latest`` pruning, a host may have already deleted
+        another host's tracker step while a common older step still
+        exists on every host."""
+        K = self._restore_candidate_steps()
+        own = sorted(committed)[-K:]
         if _process_count() <= 1:
-            return [mem_step], [st_step]
+            return [mem_step], [tracker_step], [set(own)]
         from jax.experimental import multihost_utils
 
-        gathered = multihost_utils.process_allgather(
-            np.array([mem_step, st_step], np.int64)
-        )
+        row = np.full(2 + K, -1, np.int64)
+        row[0], row[1] = mem_step, tracker_step
+        row[2 : 2 + len(own)] = own
+        gathered = multihost_utils.process_allgather(row)
         return (
             [int(v) for v in gathered[:, 0]],
             [int(v) for v in gathered[:, 1]],
+            [
+                {int(s) for s in host_row[2:] if s >= 0}
+                for host_row in gathered
+            ],
         )
 
     def load_consistent(self, template: Any) -> Tuple[int, Optional[Any]]:
@@ -430,7 +456,10 @@ class CheckpointEngine:
 
         - all hosts stage the same memory step → memory restore
           everywhere;
-        - otherwise the newest storage step committed on EVERY host;
+        - otherwise the NEWEST step committed on EVERY host (max of the
+          intersection of per-host committed sets, capped at the newest
+          tracker so a stale high-numbered step left in a reused root
+          can't shadow the live history);
         - no common storage step → everyone starts fresh, consistently.
         """
         meta = self.shm.read_meta() if self.shm.attach() else None
@@ -439,7 +468,9 @@ class CheckpointEngine:
         mem_step = -1 if meta is None else meta.step
         storage_latest = self.storage.latest_step()
         st_step = -1 if storage_latest is None else storage_latest
-        mem_steps, st_steps = self._gather_restore_meta(mem_step, st_step)
+        mem_steps, st_steps, committed_sets = self._gather_restore_meta(
+            mem_step, st_step, self.storage.list_steps()
+        )
         if mem_steps[0] >= 0 and len(set(mem_steps)) == 1:
             result = self._load_from_memory(template)
             if result is not None:
@@ -453,13 +484,17 @@ class CheckpointEngine:
                     "locally; restart the worker to re-rendezvous"
                 )
             # single process: nothing collective at risk — storage next
-        target = min(st_steps)
+        common = set.intersection(*committed_sets) if committed_sets else set()
+        cap = max(st_steps)
+        candidates = {s for s in common if cap < 0 or s <= cap}
+        target = max(candidates) if candidates else -1
         if len(set(mem_steps)) != 1 or mem_steps[0] < 0:
             logger.info(
-                "staged steps %s not uniformly restorable (storage %s); "
-                "restoring common storage step %s",
+                "staged steps %s not uniformly restorable (trackers %s, "
+                "common committed %s); restoring step %s",
                 mem_steps,
                 st_steps,
+                sorted(common),
                 target,
             )
         if target < 0:

@@ -94,13 +94,32 @@ def export_to_orbax(
 
 
 def import_from_orbax(
-    orbax_dir: str, storage_root: str, step: int = 0
+    orbax_dir: str, storage_root: str, step: int = 0, force: bool = False
 ) -> Dict[str, np.ndarray]:
     """Import an Orbax checkpoint as committed flash-ckpt ``step`` (one
     full shard, host_rank 0 — the topology-free layout every engine can
     reshard from on load).  Returns the flat {path: array} map.
+
+    Refuses a ``storage_root`` whose committed history is already ahead
+    of ``step``: committing would rewind the latest-step tracker, so
+    subsequent loads and retention would operate against the stale low
+    step. Pass ``force=True`` (or a larger ``step``) to override.
     """
     import orbax.checkpoint as ocp
+
+    pre = PosixCheckpointStorage(storage_root)
+    # max over tracker AND committed dirs: a missing/corrupt tracker must
+    # not let the import slip a rewound tracker under committed history.
+    existing = max(
+        [s for s in (pre.latest_step(),) if s is not None] + pre.list_steps(),
+        default=None,
+    )
+    if existing is not None and existing > step and not force:
+        raise ValueError(
+            f"storage root {storage_root} already tracks committed step "
+            f"{existing} > import step {step}; importing would rewind the "
+            "tracker. Use a fresh root, a larger step, or force=True."
+        )
 
     ckptr = ocp.StandardCheckpointer()
     tree = ckptr.restore(os.path.abspath(orbax_dir))

@@ -285,11 +285,16 @@ class SharedLockServer(LocalSocketServer):
     UNCACHED_METHODS = frozenset({"acquire", "release", "locked"})
 
     def __init__(self, name: str):
-        super().__init__("lock_" + name)
+        # Subclass state BEFORE super().__init__: the base constructor
+        # starts the accept thread, and a client connecting (and
+        # dropping — which runs _on_conn_closed) in that window must
+        # find _cond et al. already present, or the handler thread dies
+        # and the server silently mis-tracks the disconnect.
         self._locked_by: Optional[str] = None
         self._holder_conn: Optional[int] = None
         self._hold_count = 0
         self._cond = threading.Condition()
+        super().__init__("lock_" + name)
 
     def op_acquire(self, owner: str, blocking: bool = True, timeout: float = -1.0) -> bool:
         conn_id = self._conn_local.conn_id
@@ -377,8 +382,9 @@ class SharedLock:
 
 class SharedQueueServer(LocalSocketServer):
     def __init__(self, name: str, maxsize: int = 0):
-        super().__init__("queue_" + name)
+        # state before super(): see SharedLockServer.__init__
         self._queue: "_queue.Queue[Any]" = _queue.Queue(maxsize)
+        super().__init__("queue_" + name)
 
     def op_put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> bool:
         try:
@@ -455,9 +461,10 @@ class SharedQueue:
 
 class SharedDictServer(LocalSocketServer):
     def __init__(self, name: str):
-        super().__init__("dict_" + name)
+        # state before super(): see SharedLockServer.__init__
         self._dict: Dict[Any, Any] = {}
         self._lock = threading.Lock()
+        super().__init__("dict_" + name)
 
     def op_set(self, key: Any, value: Any) -> None:
         with self._lock:

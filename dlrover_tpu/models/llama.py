@@ -54,7 +54,11 @@ class LlamaConfig:
         return self.expert_mlp_dim or self.mlp_dim
 
     def is_moe_block(self, layer_idx: int) -> bool:
-        return self.num_experts > 0 and (layer_idx % self.moe_every == 1)
+        # Every `moe_every`-th block, LAST of each group: moe_every=1
+        # means every block, moe_every=2 means layers 1, 3, 5, ...
+        return self.num_experts > 0 and (
+            layer_idx % self.moe_every == self.moe_every - 1
+        )
 
     @staticmethod
     def tiny(**overrides) -> "LlamaConfig":

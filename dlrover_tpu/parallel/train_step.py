@@ -156,6 +156,7 @@ def build_train_step(
     donate: bool = True,
     example_data: Optional[Tuple[Any, Any]] = None,
     grad_accum_steps: int = 1,
+    aux_loss_weight: float = 0.01,
 ) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, jax.Array]]:
     """Jitted (state, inputs, targets) -> (state', metrics) over the mesh.
 
@@ -174,6 +175,9 @@ def build_train_step(
     pad-heavy batch with very uneven ``ignore_index`` counts per slice
     would over-weight sparse slices; pack sequences or shuffle padding
     uniformly before relying on accumulation equivalence.
+
+    ``aux_loss_weight`` scales any ``("losses", ...)`` terms the model
+    sows (MoE load-balance); 0 disables them.
     """
     rules = rules or DEFAULT_RULES
     if example_data is not None:
@@ -188,8 +192,20 @@ def build_train_step(
 
     def grads_of(params, inputs, targets):
         def compute_loss(p):
-            logits = model.apply({"params": p}, inputs)
-            return loss_fn(logits, targets)
+            # mutable=("losses",) collects ``self.sow("losses", ...)``
+            # auxiliary terms (MoE load-balance, GShard eq.4 — see
+            # models/llama.py MoeMlp); without it flax silently drops
+            # them and top-k routing trains with no balance pressure.
+            logits, mutated = model.apply(
+                {"params": p}, inputs, mutable=("losses",)
+            )
+            loss = loss_fn(logits, targets)
+            aux_leaves = jax.tree.leaves(mutated.get("losses", {}))
+            if aux_leaves and aux_loss_weight:
+                loss = loss + aux_loss_weight * sum(
+                    jnp.sum(a) for a in aux_leaves
+                )
+            return loss
 
         return jax.value_and_grad(compute_loss)(params)
 

@@ -137,6 +137,44 @@ class TestMoE:
         w = state.params["block_1"]["MoeMlp_0"]["w_gate"]
         assert w.addressable_shards[0].data.shape[0] == 1  # 4 experts / ep=4
 
+    def test_moe_every_one_means_every_block(self):
+        cfg = self._moe_cfg(moe_every=1)
+        assert all(cfg.is_moe_block(i) for i in range(cfg.num_layers))
+        cfg2 = self._moe_cfg(moe_every=2)
+        assert [cfg2.is_moe_block(i) for i in range(4)] == [
+            False, True, False, True,
+        ]
+
+    def test_aux_loss_reaches_gradients(self):
+        """ADVICE r2: build_train_step must collect the sowed balance
+        term — the same batch from the same init must step to different
+        params when aux_loss_weight changes, and the reported loss must
+        include the aux term."""
+        cfg = self._moe_cfg()
+        model = Llama(cfg)
+        mesh = build_mesh(MeshConfig(dp=2, fsdp=1, ep=4, tp=1))
+        tx = default_optimizer(warmup_steps=1)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        r = np.random.default_rng(3)
+        x = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+        y = jnp.roll(x, -1, axis=1)
+        losses = {}
+        gates = {}
+        for w in (0.0, 1.0):
+            state, shardings = init_train_state(model, tokens, mesh, tx)
+            step = build_train_step(
+                model, tx, cross_entropy_loss, mesh, shardings,
+                aux_loss_weight=w,
+            )
+            state, loss = step(state, x, y)  # lr still 0 (warmup)
+            losses[w] = float(loss)
+            state, _ = step(state, x, y)  # lr > 0: grads reach params
+            gates[w] = np.asarray(
+                state.params["block_1"]["MoeMlp_0"]["w_gate"], np.float32
+            )
+        assert losses[1.0] > losses[0.0]  # aux term counted in the loss
+        assert not np.allclose(gates[0.0], gates[1.0])  # ...and in grads
+
     def test_capacity_drops_overflow_tokens(self):
         """With capacity_factor tiny, overflowed tokens contribute zero
         output (combine mask empty) — the layer still runs, no NaNs."""

@@ -124,6 +124,27 @@ class TestExportImport:
             engine.shm.unlink()
             engine.close()
 
+    def test_import_refuses_tracker_rewind(self, tmp_path):
+        """ADVICE r2: importing step 0 into a root with newer committed
+        history must not rewind the latest-step tracker."""
+        import orbax.checkpoint as ocp
+        import pytest
+
+        odir = str(tmp_path / "orbax_in")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(odir, {"w": np.ones((2,), np.float32)})
+        ckptr.wait_until_finished()
+
+        root = str(tmp_path / "flash")
+        import_from_orbax(odir, root, step=20)
+        assert PosixCheckpointStorage(root).latest_step() == 20
+        with pytest.raises(ValueError, match="rewind"):
+            import_from_orbax(odir, root, step=0)
+        assert PosixCheckpointStorage(root).latest_step() == 20
+        # explicit override still possible
+        import_from_orbax(odir, root, step=0, force=True)
+        assert PosixCheckpointStorage(root).latest_step() == 0
+
     def test_export_sharded_checkpoint_assembles_global(self, tmp_path):
         """A multi-device-sharded step exports as full global arrays."""
         import orbax.checkpoint as ocp

@@ -36,24 +36,37 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
 
 
-def _build_library() -> str:
-    lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
-    sources = [
-        os.path.join(_NATIVE_DIR, n) for n in ("tpu_timer.cc", "tpu_timer.h")
-    ]
+def build_native_lib(native_dir: str, lib_name: str, sources) -> str:
+    """Build ``lib_name`` via the directory's Makefile when the .so is
+    missing or older than any of ``sources``; returns the lib path.
+    Shared by every native component (tpu_timer, pjrt_interposer)."""
+    lib_path = os.path.join(native_dir, lib_name)
     stale = not os.path.exists(lib_path) or any(
         os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(lib_path)
         for s in sources
     )
     if stale:
-        logger.info("building native tpu_timer in %s", _NATIVE_DIR)
-        subprocess.run(
-            ["make", _LIB_NAME],
-            cwd=_NATIVE_DIR,
-            check=True,
-            capture_output=True,
-        )
+        logger.info("building %s in %s", lib_name, native_dir)
+        try:
+            subprocess.run(
+                ["make", lib_name],
+                cwd=native_dir,
+                check=True,
+                capture_output=True,
+            )
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build of {lib_name} failed:\n"
+                f"{(e.stderr or b'').decode(errors='replace')[-2000:]}"
+            ) from e
     return lib_path
+
+
+def _build_library() -> str:
+    sources = [
+        os.path.join(_NATIVE_DIR, n) for n in ("tpu_timer.cc", "tpu_timer.h")
+    ]
+    return build_native_lib(_NATIVE_DIR, _LIB_NAME, sources)
 
 
 def load_native() -> ctypes.CDLL:

@@ -1,0 +1,228 @@
+// Minimal fake PJRT plugin — a test double for the interposer.
+//
+// Implements just enough of the PJRT C API for test_driver.cc to push a
+// compile / execute / H2D / D2H through the interposed table without
+// hardware: events with deferred readiness (a background thread fires
+// them after FAKE_EXEC_MS milliseconds), multiple OnReady callbacks per
+// event (matching XLA's future semantics the interposer relies on), and
+// a FAKE_EXEC_HANG=1 mode where execute events never fire — simulating
+// a wedged device program for the stall-verdict test.
+
+#include "pjrt_c_api.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct FakeError {
+  std::string message;
+};
+
+struct FakeEvent {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool ready = false;
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> callbacks;
+  // creator thread + owner each hold a ref; freed when both release
+  std::atomic<int> refs{1};
+
+  void Fire() {
+    std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      if (ready) return;
+      ready = true;
+      cbs.swap(callbacks);
+    }
+    cv.notify_all();
+    for (auto& cb : cbs) cb.first(nullptr, cb.second);
+  }
+
+  void Unref() {
+    if (refs.fetch_sub(1) == 1) delete this;
+  }
+};
+
+PJRT_Event* MakeDeferredEvent(int delay_ms) {
+  auto* ev = new FakeEvent();
+  if (delay_ms < 0) {
+    // hang mode: never fires; the extra creator ref is leaked on
+    // purpose (the test process is short-lived)
+    return reinterpret_cast<PJRT_Event*>(ev);
+  }
+  if (delay_ms == 0) {
+    ev->Fire();
+    return reinterpret_cast<PJRT_Event*>(ev);
+  }
+  ev->refs.fetch_add(1);
+  std::thread([ev, delay_ms]() {
+    std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+    ev->Fire();
+    ev->Unref();
+  }).detach();
+  return reinterpret_cast<PJRT_Event*>(ev);
+}
+
+int ExecDelayMs() {
+  if (getenv("FAKE_EXEC_HANG") != nullptr) return -1;
+  const char* ms = getenv("FAKE_EXEC_MS");
+  return ms != nullptr ? atoi(ms) : 5;
+}
+
+// -- API impls --------------------------------------------------------------
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<FakeError*>(args->error);
+}
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  auto* e = reinterpret_cast<const FakeError*>(args->error);
+  args->message = e->message.c_str();
+  args->message_size = e->message.size();
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  args->code = PJRT_Error_Code_UNKNOWN;
+  return nullptr;
+}
+
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args* args) {
+  if (args->event != nullptr) {
+    reinterpret_cast<FakeEvent*>(args->event)->Unref();
+  }
+  return nullptr;
+}
+
+PJRT_Error* EventIsReady(PJRT_Event_IsReady_Args* args) {
+  auto* ev = reinterpret_cast<FakeEvent*>(args->event);
+  std::lock_guard<std::mutex> lock(ev->mu);
+  args->is_ready = ev->ready;
+  return nullptr;
+}
+
+PJRT_Error* EventError(PJRT_Event_Error_Args*) { return nullptr; }
+
+PJRT_Error* EventAwait(PJRT_Event_Await_Args* args) {
+  auto* ev = reinterpret_cast<FakeEvent*>(args->event);
+  std::unique_lock<std::mutex> lock(ev->mu);
+  ev->cv.wait(lock, [ev] { return ev->ready; });
+  return nullptr;
+}
+
+PJRT_Error* EventOnReady(PJRT_Event_OnReady_Args* args) {
+  auto* ev = reinterpret_cast<FakeEvent*>(args->event);
+  bool fire_now = false;
+  {
+    std::lock_guard<std::mutex> lock(ev->mu);
+    if (ev->ready) {
+      fire_now = true;
+    } else {
+      ev->callbacks.emplace_back(args->callback, args->user_arg);
+    }
+  }
+  if (fire_now) args->callback(nullptr, args->user_arg);
+  return nullptr;
+}
+
+int g_client_token, g_executable_token, g_buffer_token;
+const char kProgramName[] = "fake_program";
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  args->client = reinterpret_cast<PJRT_Client*>(&g_client_token);
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args*) { return nullptr; }
+
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  args->executable =
+      reinterpret_cast<PJRT_LoadedExecutable*>(&g_executable_token);
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableGetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = reinterpret_cast<PJRT_Executable*>(&g_executable_token);
+  return nullptr;
+}
+
+PJRT_Error* ExecutableName(PJRT_Executable_Name_Args* args) {
+  args->executable_name = kProgramName;
+  args->executable_name_size = sizeof(kProgramName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->device_complete_events != nullptr) {
+    int delay = ExecDelayMs();
+    for (size_t i = 0; i < args->num_devices; i++) {
+      args->device_complete_events[i] = MakeDeferredEvent(delay);
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  std::this_thread::sleep_for(std::chrono::microseconds(200));
+  args->done_with_host_buffer = MakeDeferredEvent(0);
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(&g_buffer_token);
+  return nullptr;
+}
+
+PJRT_Error* ToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  if (args->dst == nullptr) {
+    args->dst_size = 64;
+    return nullptr;
+  }
+  memset(args->dst, 0, args->dst_size);
+  args->event = MakeDeferredEvent(2);
+  return nullptr;
+}
+
+PJRT_Api g_api;
+std::once_flag g_once;
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() {
+  std::call_once(g_once, [] {
+    memset(&g_api, 0, sizeof(g_api));
+    g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+    g_api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+    g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    g_api.PJRT_Error_Destroy = ErrorDestroy;
+    g_api.PJRT_Error_Message = ErrorMessage;
+    g_api.PJRT_Error_GetCode = ErrorGetCode;
+    g_api.PJRT_Plugin_Initialize = PluginInitialize;
+    g_api.PJRT_Event_Destroy = EventDestroy;
+    g_api.PJRT_Event_IsReady = EventIsReady;
+    g_api.PJRT_Event_Error = EventError;
+    g_api.PJRT_Event_Await = EventAwait;
+    g_api.PJRT_Event_OnReady = EventOnReady;
+    g_api.PJRT_Client_Create = ClientCreate;
+    g_api.PJRT_Client_Destroy = ClientDestroy;
+    g_api.PJRT_Client_Compile = ClientCompile;
+    g_api.PJRT_LoadedExecutable_GetExecutable = LoadedExecutableGetExecutable;
+    g_api.PJRT_Executable_Name = ExecutableName;
+    g_api.PJRT_LoadedExecutable_Execute = Execute;
+    g_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+    g_api.PJRT_Buffer_ToHostBuffer = ToHostBuffer;
+  });
+  return &g_api;
+}
+
+}  // extern "C"

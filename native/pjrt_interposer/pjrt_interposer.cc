@@ -1,0 +1,360 @@
+// PJRT C-API interposer — ground-truth device activity for tpu_timer.
+//
+// TPU-native counterpart of the reference's driver-boundary hooks
+// (xpu_timer/xpu_timer/nvidia/hook.cc:54 intercepted cudaLaunchKernel,
+// :323 NCCL collectives; completion timing via CUDA event pools,
+// xpu_timer/common/manager.h:106). On TPU the driver boundary is the
+// PJRT C API: jax loads a plugin shared object and calls through its
+// PJRT_Api function table. This library IS a plugin — GetPjrtApi()
+// loads the real one, copies its table, and patches the entries where
+// device work is born:
+//
+//   PJRT_LoadedExecutable_Execute    -> launch + device-completion time
+//   PJRT_Client_BufferFromHostBuffer -> H2D bytes + latency
+//   PJRT_Buffer_ToHostBuffer         -> D2H bytes + event-completion time
+//   PJRT_Client_Compile              -> compile wall time
+//
+// Everything lands in the tpu_timer core (bucketed stats, trace ring,
+// Prometheus /metrics, hang watchdog) with NO Python cooperation: what
+// the process actually executed is what gets recorded.
+//
+// ABI notes: the PJRT C ABI is append-only and struct_size-negotiated.
+// The real table is copied at its full struct_size (heap buffer), so
+// entries newer than this header pass through untouched; the patched
+// entries live at offsets fixed since long before v0.72. Execute
+// completion uses the per-device `device_complete_events`: when the
+// caller passed none we request our own (and destroy them); when the
+// caller did, we piggyback an extra OnReady — XLA's event is a future
+// supporting multiple waiters.
+
+#include "pjrt_c_api.h"
+
+#include <dlfcn.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "../tpu_timer/tpu_timer.h"
+
+namespace {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+const PJRT_Api* g_real = nullptr;
+PJRT_Api* g_wrapped = nullptr;
+std::mutex g_mu;
+
+int32_t g_name_execute = -1;
+int32_t g_name_h2d = -1;
+int32_t g_name_d2h = -1;
+int32_t g_name_compile = -1;
+
+// LoadedExecutable -> interned program name (one lookup per program).
+std::mutex g_exe_mu;
+std::unordered_map<PJRT_LoadedExecutable*, int32_t> g_exe_names;
+
+void DestroyError(PJRT_Error* err) {
+  if (err == nullptr || g_real == nullptr) return;
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_real->PJRT_Error_Destroy(&d);
+}
+
+int32_t ExecutableNameId(PJRT_LoadedExecutable* exe) {
+  {
+    std::lock_guard<std::mutex> lock(g_exe_mu);
+    auto it = g_exe_names.find(exe);
+    if (it != g_exe_names.end()) return it->second;
+  }
+  int32_t id = g_name_execute;
+  if (g_real->PJRT_LoadedExecutable_GetExecutable != nullptr &&
+      g_real->PJRT_Executable_Name != nullptr) {
+    PJRT_LoadedExecutable_GetExecutable_Args ga;
+    memset(&ga, 0, sizeof(ga));
+    ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ga.loaded_executable = exe;
+    PJRT_Error* err = g_real->PJRT_LoadedExecutable_GetExecutable(&ga);
+    if (err == nullptr && ga.executable != nullptr) {
+      PJRT_Executable_Name_Args na;
+      memset(&na, 0, sizeof(na));
+      na.struct_size = PJRT_Executable_Name_Args_STRUCT_SIZE;
+      na.executable = ga.executable;
+      PJRT_Error* nerr = g_real->PJRT_Executable_Name(&na);
+      if (nerr == nullptr && na.executable_name != nullptr) {
+        std::string name(na.executable_name, na.executable_name_size);
+        id = tt_intern_name(("exec:" + name).c_str());
+      } else {
+        DestroyError(nerr);
+      }
+      // NOTE: deliberately not destroying ga.executable — some plugins
+      // hand back an owned reference; leaking one small handle per
+      // distinct program is bounded by the number of compiled programs.
+    } else {
+      DestroyError(err);
+    }
+  }
+  std::lock_guard<std::mutex> lock(g_exe_mu);
+  g_exe_names.emplace(exe, id);
+  return id;
+}
+
+// -- Execute ----------------------------------------------------------------
+
+struct ExecCompletionCtx {
+  int64_t start_us;
+  int32_t name_id;
+  PJRT_Event* event;  // owned iff we substituted our own events
+  bool owns_event;
+};
+
+void OnExecReady(PJRT_Error* error, void* user_arg) {
+  auto* ctx = static_cast<ExecCompletionCtx*>(user_arg);
+  int64_t now = NowUs();
+  tt_record(ctx->name_id, TT_KIND_EXECUTE, ctx->start_us,
+            now - ctx->start_us, 0, 0);
+  tt_device_complete(now - ctx->start_us);
+  DestroyError(error);
+  if (ctx->owns_event && ctx->event != nullptr &&
+      g_real->PJRT_Event_Destroy != nullptr) {
+    PJRT_Event_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = ctx->event;
+    DestroyError(g_real->PJRT_Event_Destroy(&d));
+  }
+  delete ctx;
+}
+
+PJRT_Error* WrapExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  int64_t t0 = NowUs();
+  bool substituted = false;
+  std::vector<PJRT_Event*> our_events;
+  if (args->device_complete_events == nullptr && args->num_devices > 0 &&
+      g_real->PJRT_Event_OnReady != nullptr) {
+    our_events.assign(args->num_devices, nullptr);
+    args->device_complete_events = our_events.data();
+    substituted = true;
+  }
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  if (err != nullptr) {
+    if (substituted) args->device_complete_events = nullptr;
+    return err;
+  }
+  int32_t name_id = ExecutableNameId(args->executable);
+  PJRT_Event** events = args->device_complete_events;
+  size_t n = events != nullptr ? args->num_devices : 0;
+  bool any_event = false;
+  for (size_t i = 0; i < n; i++) {
+    if (events[i] == nullptr) continue;
+    auto* ctx = new ExecCompletionCtx{t0, name_id, events[i], substituted};
+    // Launch is counted BEFORE OnReady: an already-ready event invokes
+    // the callback inline, and completion-before-launch would send
+    // inflight negative (misreading a concurrent wedge as host-stall).
+    tt_device_launch();
+    PJRT_Event_OnReady_Args oa;
+    memset(&oa, 0, sizeof(oa));
+    oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    oa.event = events[i];
+    oa.callback = OnExecReady;
+    oa.user_arg = ctx;
+    PJRT_Error* oerr = g_real->PJRT_Event_OnReady(&oa);
+    if (oerr != nullptr) {
+      DestroyError(oerr);
+      delete ctx;
+      tt_device_complete(0);  // never tracked; rebalance the watermark
+      if (substituted && g_real->PJRT_Event_Destroy != nullptr) {
+        PJRT_Event_Destroy_Args d;
+        memset(&d, 0, sizeof(d));
+        d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        d.event = events[i];
+        DestroyError(g_real->PJRT_Event_Destroy(&d));
+      }
+      continue;
+    }
+    any_event = true;
+  }
+  if (!any_event) {
+    // No completion events available: record the host-side call as the
+    // best evidence we have (still marks real device activity).
+    tt_record(name_id, TT_KIND_EXECUTE, t0, NowUs() - t0, 0, 0);
+  }
+  if (substituted) args->device_complete_events = nullptr;
+  return nullptr;
+}
+
+// -- Transfers --------------------------------------------------------------
+
+int64_t BufferTypeBytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+    case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+    case PJRT_Buffer_Type_F8E5M2FNUZ:
+    case PJRT_Buffer_Type_F8E4M3FNUZ:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_C128:
+      return 16;
+    default:
+      return 1;
+  }
+}
+
+PJRT_Error* WrapBufferFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  int64_t t0 = NowUs();
+  double bytes = static_cast<double>(BufferTypeBytes(args->type));
+  for (size_t i = 0; i < args->num_dims; i++) {
+    bytes *= static_cast<double>(args->dims[i]);
+  }
+  PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err == nullptr) {
+    // Host-call latency (the staging copy); the async device write is
+    // covered by the buffer-ready event the runtime consumes.
+    tt_record(g_name_h2d, TT_KIND_H2D, t0, NowUs() - t0, 0, bytes);
+  }
+  return err;
+}
+
+struct D2HCtx {
+  int64_t start_us;
+  double bytes;
+};
+
+void OnD2HReady(PJRT_Error* error, void* user_arg) {
+  auto* ctx = static_cast<D2HCtx*>(user_arg);
+  int64_t now = NowUs();
+  tt_record(g_name_d2h, TT_KIND_D2H, ctx->start_us, now - ctx->start_us, 0,
+            ctx->bytes);
+  DestroyError(error);
+  delete ctx;
+}
+
+PJRT_Error* WrapToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
+  if (args->dst == nullptr) {
+    // size query, not a transfer
+    return g_real->PJRT_Buffer_ToHostBuffer(args);
+  }
+  int64_t t0 = NowUs();
+  double bytes = static_cast<double>(args->dst_size);
+  PJRT_Error* err = g_real->PJRT_Buffer_ToHostBuffer(args);
+  if (err != nullptr) return err;
+  bool recorded = false;
+  if (args->event != nullptr && g_real->PJRT_Event_OnReady != nullptr) {
+    auto* ctx = new D2HCtx{t0, bytes};
+    PJRT_Event_OnReady_Args oa;
+    memset(&oa, 0, sizeof(oa));
+    oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    oa.event = args->event;
+    oa.callback = OnD2HReady;
+    oa.user_arg = ctx;
+    PJRT_Error* oerr = g_real->PJRT_Event_OnReady(&oa);
+    if (oerr != nullptr) {
+      DestroyError(oerr);
+      delete ctx;
+    } else {
+      recorded = true;
+    }
+  }
+  if (!recorded) {
+    tt_record(g_name_d2h, TT_KIND_D2H, t0, NowUs() - t0, 0, bytes);
+  }
+  return nullptr;
+}
+
+// -- Compile ----------------------------------------------------------------
+
+PJRT_Error* WrapCompile(PJRT_Client_Compile_Args* args) {
+  int64_t t0 = NowUs();
+  PJRT_Error* err = g_real->PJRT_Client_Compile(args);
+  if (err == nullptr) {
+    tt_record(g_name_compile, TT_KIND_COMPILE, t0, NowUs() - t0, 0, 0);
+  }
+  return err;
+}
+
+const char* RealPluginPath() {
+  const char* p = getenv("DLROVER_PJRT_REAL_PLUGIN");
+  if (p != nullptr && p[0] != 0) return p;
+  return "libtpu.so";
+}
+
+}  // namespace
+
+extern "C" {
+
+// The PJRT plugin entry point. jax (or any PJRT client) dlopens this
+// library and calls GetPjrtApi(); we hand back the real plugin's table
+// with four entries replaced.
+const PJRT_Api* GetPjrtApi() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_wrapped != nullptr) return g_wrapped;
+
+  void* handle = dlopen(RealPluginPath(), RTLD_NOW | RTLD_GLOBAL);
+  if (handle == nullptr) {
+    fprintf(stderr, "pjrt_interposer: cannot dlopen real plugin %s: %s\n",
+            RealPluginPath(), dlerror());
+    return nullptr;
+  }
+  auto get_api =
+      reinterpret_cast<const PJRT_Api* (*)()>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    fprintf(stderr, "pjrt_interposer: %s has no GetPjrtApi\n",
+            RealPluginPath());
+    return nullptr;
+  }
+  g_real = get_api();
+  if (g_real == nullptr) return nullptr;
+
+  // Metrics core: port from env (0 -> auto-pick; the Python side reads
+  // tt_http_port through this same library).
+  const char* port_env = getenv("DLROVER_TT_PORT");
+  int port = port_env != nullptr ? atoi(port_env) : 0;
+  tt_init(port);
+  g_name_execute = tt_intern_name("pjrt_execute");
+  g_name_h2d = tt_intern_name("pjrt_h2d");
+  g_name_d2h = tt_intern_name("pjrt_d2h");
+  g_name_compile = tt_intern_name("pjrt_compile");
+
+  // Full-size copy: fields beyond this header's knowledge pass through.
+  size_t size = g_real->struct_size;
+  if (size < sizeof(PJRT_Api)) size = sizeof(PJRT_Api);
+  void* buf = calloc(1, size);
+  memcpy(buf, g_real, g_real->struct_size);
+  g_wrapped = static_cast<PJRT_Api*>(buf);
+  g_wrapped->PJRT_LoadedExecutable_Execute = WrapExecute;
+  g_wrapped->PJRT_Client_BufferFromHostBuffer = WrapBufferFromHost;
+  g_wrapped->PJRT_Buffer_ToHostBuffer = WrapToHost;
+  g_wrapped->PJRT_Client_Compile = WrapCompile;
+  return g_wrapped;
+}
+
+}  // extern "C"

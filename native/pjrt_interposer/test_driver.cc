@@ -1,0 +1,175 @@
+// Drives the interposer against the fake plugin — no Python, no jax.
+//
+// Usage: test_driver <libpjrt_interposer.so> <mode>
+//   mode "basic":     compile + execute + H2D + D2H, then print metrics
+//   mode "devstall":  open a step, launch an execute whose completion
+//                     never fires (FAKE_EXEC_HANG=1 set by the caller),
+//                     then print the stall verdict (expect 1)
+//   mode "hoststall": open a step and launch nothing (expect 2)
+//
+// The tt_* symbols are linked INTO the interposer library, so the same
+// dlopen handle serves both the PJRT table and the metrics accessors —
+// exactly how the Python side reads them in production.
+
+#include <dlfcn.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include "pjrt_c_api.h"
+
+typedef const PJRT_Api* (*GetApiFn)();
+typedef void (*ConfigHangFn)(double, long long);
+typedef void (*StepBeginFn)(long long);
+typedef void (*StepEndFn)(long long);
+typedef long long (*MetricsFn)(char*, long long);
+typedef int (*VerdictFn)();
+typedef long long (*InflightFn)();
+
+#define CHECK(cond)                                               \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      fprintf(stderr, "CHECK failed at %d: %s\n", __LINE__, #cond); \
+      exit(1);                                                    \
+    }                                                             \
+  } while (0)
+
+int main(int argc, char** argv) {
+  CHECK(argc >= 3);
+  void* handle = dlopen(argv[1], RTLD_NOW);
+  if (handle == nullptr) {
+    fprintf(stderr, "dlopen %s: %s\n", argv[1], dlerror());
+    return 1;
+  }
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
+  auto config_hang =
+      reinterpret_cast<ConfigHangFn>(dlsym(handle, "tt_config_hang"));
+  auto step_begin =
+      reinterpret_cast<StepBeginFn>(dlsym(handle, "tt_step_begin"));
+  auto step_end = reinterpret_cast<StepEndFn>(dlsym(handle, "tt_step_end"));
+  auto metrics = reinterpret_cast<MetricsFn>(dlsym(handle, "tt_metrics_text"));
+  auto verdict = reinterpret_cast<VerdictFn>(dlsym(handle, "tt_stall_verdict"));
+  auto inflight =
+      reinterpret_cast<InflightFn>(dlsym(handle, "tt_device_inflight"));
+  CHECK(get_api && config_hang && step_begin && step_end && metrics &&
+        verdict && inflight);
+
+  const PJRT_Api* api = get_api();
+  CHECK(api != nullptr);
+  // Entries the interposer does not wrap pass through to the fake.
+  PJRT_Plugin_Initialize_Args init_args;
+  memset(&init_args, 0, sizeof(init_args));
+  init_args.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Plugin_Initialize(&init_args) == nullptr);
+
+  PJRT_Client_Create_Args cc;
+  memset(&cc, 0, sizeof(cc));
+  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&cc) == nullptr);
+  CHECK(cc.client != nullptr);
+
+  const char* mode = argv[2];
+
+  // The hang threshold stays infinite until a step-duration median
+  // exists (no false hang during the first long compile), so the stall
+  // modes record two quick steps first.
+  if (strcmp(mode, "hoststall") == 0 || strcmp(mode, "devstall") == 0) {
+    for (long long s = 0; s < 2; s++) {
+      step_begin(s);
+      usleep(20 * 1000);
+      step_end(s);
+    }
+    config_hang(5.0, 150);
+  }
+
+  if (strcmp(mode, "hoststall") == 0) {
+    step_begin(2);
+    usleep(400 * 1000);
+    printf("verdict=%d inflight=%lld\n", verdict(), inflight());
+    return 0;
+  }
+
+  // compile
+  char code[] = "dummy";
+  PJRT_Program prog;
+  memset(&prog, 0, sizeof(prog));
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = code;
+  prog.code_size = sizeof(code) - 1;
+  prog.format = "mlir";
+  prog.format_size = 4;
+  PJRT_Client_Compile_Args comp;
+  memset(&comp, 0, sizeof(comp));
+  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  comp.client = cc.client;
+  comp.program = &prog;
+  CHECK(api->PJRT_Client_Compile(&comp) == nullptr);
+  CHECK(comp.executable != nullptr);
+
+  if (strcmp(mode, "devstall") == 0) {
+    step_begin(2);
+    PJRT_LoadedExecutable_Execute_Args ex;
+    memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = comp.executable;
+    ex.num_devices = 1;
+    ex.num_args = 0;
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ex) == nullptr);
+    usleep(400 * 1000);
+    printf("verdict=%d inflight=%lld\n", verdict(), inflight());
+    return 0;
+  }
+
+  // basic: execute (interposer substitutes completion events)
+  for (int i = 0; i < 3; i++) {
+    PJRT_LoadedExecutable_Execute_Args ex;
+    memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = comp.executable;
+    ex.num_devices = 1;
+    ex.num_args = 0;
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ex) == nullptr);
+    CHECK(ex.device_complete_events == nullptr);  // interposer reset it
+  }
+
+  // H2D: 128x128 f32 = 65536 bytes
+  int64_t dims[2] = {128, 128};
+  float host_data[4] = {0, 1, 2, 3};  // fake never reads past the pointer
+  PJRT_Client_BufferFromHostBuffer_Args h2d;
+  memset(&h2d, 0, sizeof(h2d));
+  h2d.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  h2d.client = cc.client;
+  h2d.data = host_data;
+  h2d.type = PJRT_Buffer_Type_F32;
+  h2d.dims = dims;
+  h2d.num_dims = 2;
+  h2d.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  CHECK(api->PJRT_Client_BufferFromHostBuffer(&h2d) == nullptr);
+  CHECK(h2d.buffer != nullptr);
+
+  // D2H
+  char dst[64];
+  PJRT_Buffer_ToHostBuffer_Args d2h;
+  memset(&d2h, 0, sizeof(d2h));
+  d2h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  d2h.src = h2d.buffer;
+  d2h.dst = dst;
+  d2h.dst_size = sizeof(dst);
+  CHECK(api->PJRT_Buffer_ToHostBuffer(&d2h) == nullptr);
+
+  usleep(100 * 1000);  // let deferred completion events fire
+
+  char buf[16384];
+  long long n = metrics(buf, sizeof(buf));
+  CHECK(n > 0);
+  fwrite(buf, 1, static_cast<size_t>(n), stdout);
+  printf("inflight=%lld\n", inflight());
+  fflush(stdout);
+  // Hold the process (and its /metrics server) open on request so an
+  // external scraper can poll without racing process exit.
+  const char* linger = getenv("DRIVER_LINGER_MS");
+  if (linger != nullptr) usleep(atoi(linger) * 1000);
+  return 0;
+}

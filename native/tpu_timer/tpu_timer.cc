@@ -101,6 +101,11 @@ struct Core {
   std::atomic<double> hang_factor{5.0};
   std::atomic<int64_t> hang_min_timeout_ms{120000};
 
+  // device launch/completion watermarks (PJRT interposer)
+  std::atomic<int64_t> device_launches{0};
+  std::atomic<int64_t> device_completes{0};
+  std::atomic<int64_t> last_device_complete_us{0};
+
   // server
   std::atomic<bool> running{false};
   int listen_fd = -1;
@@ -121,10 +126,18 @@ double StepMedianMs(Core& c) {
   return v[mid];
 }
 
+// Hang threshold (ms): the watchdog and the stall verdict must agree.
+double HangThresholdMs(Core& c) {
+  double median = StepMedianMs(c);
+  double factor = c.hang_factor.load();
+  return std::max(static_cast<double>(c.hang_min_timeout_ms.load()),
+                  median > 0 ? factor * median : 1e18);
+}
+
 std::string MetricsText(Core& c) {
   static const char* kKindNames[TT_KIND_COUNT] = {
       "matmul", "collective", "step", "h2d", "d2h", "other",
-      "hlo_flops", "hlo_comm"};
+      "hlo_flops", "hlo_comm", "execute", "compile"};
   std::string out;
   out.reserve(4096);
   char buf[512];
@@ -165,6 +178,16 @@ std::string MetricsText(Core& c) {
   int64_t open_since = c.step_open_since_us.load();
   double open_s = open_since > 0 ? (NowUs() - open_since) / 1e6 : 0.0;
   snprintf(buf, sizeof(buf), "tpu_timer_step_open_seconds %.3f\n", open_s);
+  out += buf;
+  int64_t launches = c.device_launches.load();
+  int64_t completes = c.device_completes.load();
+  snprintf(buf, sizeof(buf),
+           "tpu_timer_device_launches_total %lld\n"
+           "tpu_timer_device_completes_total %lld\n"
+           "tpu_timer_device_inflight %lld\n",
+           static_cast<long long>(launches),
+           static_cast<long long>(completes),
+           static_cast<long long>(launches - completes));
   out += buf;
   return out;
 }
@@ -226,12 +249,7 @@ void WatchdogLoop(Core* c) {
       continue;
     }
     double open_ms = (NowUs() - open_since) / 1e3;
-    double median = StepMedianMs(*c);
-    double factor = c->hang_factor.load();
-    double threshold =
-        std::max(static_cast<double>(c->hang_min_timeout_ms.load()),
-                 median > 0 ? factor * median : 1e18);
-    c->hang.store(open_ms > threshold ? 1 : 0);
+    c->hang.store(open_ms > HangThresholdMs(*c) ? 1 : 0);
   }
 }
 
@@ -356,6 +374,54 @@ double tt_current_step_open_s() {
   if (g_core == nullptr) return 0;
   int64_t since = g_core->step_open_since_us.load();
   return since > 0 ? (NowUs() - since) / 1e6 : 0.0;
+}
+
+void tt_device_launch() {
+  if (g_core == nullptr) return;
+  g_core->device_launches.fetch_add(1);
+}
+
+void tt_device_complete(int64_t dur_us) {
+  (void)dur_us;  // duration lands in stats via tt_record; this is the clock
+  if (g_core == nullptr) return;
+  g_core->device_completes.fetch_add(1);
+  g_core->last_device_complete_us.store(NowUs());
+}
+
+int64_t tt_device_inflight() {
+  if (g_core == nullptr) return 0;
+  return g_core->device_launches.load() - g_core->device_completes.load();
+}
+
+double tt_last_device_complete_age_s() {
+  if (g_core == nullptr) return -1;
+  int64_t last = g_core->last_device_complete_us.load();
+  return last > 0 ? (NowUs() - last) / 1e6 : -1;
+}
+
+int tt_stall_verdict() {
+  if (g_core == nullptr) return 0;
+  Core& c = *g_core;
+  int64_t open_since = c.step_open_since_us.load();
+  if (open_since <= 0) return 0;
+  double open_ms = (NowUs() - open_since) / 1e3;
+  double threshold_ms = HangThresholdMs(c);
+  if (open_ms <= threshold_ms) return 0;
+  // A completion newer than the threshold means the device is making
+  // progress (or a synchronous launch/await loop is between launches) —
+  // the step is just long; keep watching. This recency gate applies to
+  // BOTH branches so the verdict can't flap 1<->2 with sample timing.
+  int64_t last = c.last_device_complete_us.load();
+  double since_complete_ms = last > 0 ? (NowUs() - last) / 1e3 : open_ms;
+  if (since_complete_ms <= threshold_ms) return 0;
+  int64_t inflight = c.device_launches.load() - c.device_completes.load();
+  // Work was handed to the device and the completion stream went quiet
+  // for at least the threshold: the device (or its program) is wedged.
+  if (inflight > 0) return 1;
+  // Step open past threshold, completions quiet, nothing in flight:
+  // the host loop stopped feeding the device (dataloader stall, GC,
+  // deadlock).
+  return 2;
 }
 
 int64_t tt_dump_timeline(const char* path) {

@@ -45,7 +45,11 @@ enum TTKind : int32_t {
   // matmul/collective latency gauges.
   TT_KIND_HLO_FLOPS = 6,
   TT_KIND_HLO_COMM = 7,
-  TT_KIND_COUNT = 8
+  // PJRT-interposer ground truth (pjrt_interposer.cc): device program
+  // executions and compilations observed at the driver boundary.
+  TT_KIND_EXECUTE = 8,
+  TT_KIND_COMPILE = 9,
+  TT_KIND_COUNT = 10
 };
 
 // Record one completed event. name_id: interned via tt_intern_name.
@@ -68,6 +72,23 @@ void tt_config_hang(double factor, int64_t min_timeout_ms);
 int tt_hang_status();
 // Seconds the current step has been open (0 if none open).
 double tt_current_step_open_s();
+
+// Device launch/completion watermarks (fed by the PJRT interposer; the
+// reference separates launch vs completion at the driver —
+// xpu_timer/common/manager.cc:393-414). A launch marks device work
+// enqueued; the matching completion fires when the device-side event
+// resolves. The split lets the watchdog tell a wedged device program
+// (work in flight, completions stopped) from a stalled host loop
+// (step open, nothing in flight).
+void tt_device_launch();
+void tt_device_complete(int64_t dur_us);
+int64_t tt_device_inflight();
+// Seconds since the last device completion (-1 if none ever).
+double tt_last_device_complete_age_s();
+// 0 = no stall; 1 = DEVICE stall: the open step exceeded the hang
+// threshold with work in flight and no recent completion; 2 = HOST
+// stall: the open step exceeded the threshold with nothing in flight.
+int tt_stall_verdict();
 
 // Timeline ------------------------------------------------------------------
 // Dump the trace ring buffer to `path` in the compact binary format

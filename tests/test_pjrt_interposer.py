@@ -1,0 +1,173 @@
+"""PJRT C-API interposer tests (VERDICT r2 #2).
+
+The interposer is exercised exactly the way jax would use it — through
+the PJRT plugin entry point ``GetPjrtApi`` — against the fake plugin
+(``native/pjrt_interposer/fake_pjrt_plugin.cc``), with NO Python
+annotations anywhere: the C test driver compiles, executes, and
+transfers through the interposed table and the metrics must show up on
+their own. Reference parity:
+``xpu_timer/xpu_timer/nvidia/hook.cc:54,323`` (driver-boundary
+interception), ``common/manager.cc:393-414`` (launch-vs-completion hang
+split).
+"""
+
+import os
+import subprocess
+import urllib.request
+
+import pytest
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+    "pjrt_interposer",
+)
+
+
+@pytest.fixture(scope="module")
+def built():
+    r = subprocess.run(
+        ["make", "-s"], cwd=NATIVE_DIR, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    return NATIVE_DIR
+
+
+def _run_driver(built, mode, extra_env=None, port="0"):
+    env = dict(
+        os.environ,
+        DLROVER_PJRT_REAL_PLUGIN=os.path.join(built, "libfake_pjrt_plugin.so"),
+        DLROVER_TT_PORT=port,
+    )
+    env.update(extra_env or {})
+    r = subprocess.run(
+        ["./test_driver", "./libpjrt_interposer.so", mode],
+        cwd=built, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+class TestInterposition:
+    def test_execute_and_transfers_recorded_without_annotations(self, built):
+        """compile + 3 executes + H2D + D2H through the PJRT table only;
+        every family must appear in the metrics text."""
+        out = _run_driver(built, "basic")
+        assert 'tpu_timer_count{kind="execute"} 3' in out
+        assert 'tpu_timer_count{kind="compile"} 1' in out
+        assert 'tpu_timer_count{kind="h2d"} 1' in out
+        assert 'tpu_timer_count{kind="d2h"} 1' in out
+        # completion events resolved: nothing left in flight
+        assert "tpu_timer_device_launches_total 3" in out
+        assert "tpu_timer_device_completes_total 3" in out
+        assert out.strip().endswith("inflight=0")
+        # the fake device delay (~5 ms) must be visible in the measured
+        # execute latency — proof we timed the completion event, not
+        # just the host-side call
+        for line in out.splitlines():
+            if line.startswith('tpu_timer_latency_us{kind="execute",agg="min"'):
+                assert float(line.rsplit(" ", 1)[1]) >= 4000, line
+                break
+        else:
+            pytest.fail("no execute latency line")
+
+    def test_h2d_bytes_from_dims(self, built):
+        """128x128 f32 = 64 KiB must yield a nonzero GB/s gauge."""
+        out = _run_driver(built, "basic")
+        assert 'tpu_timer_gbps{kind="h2d"}' in out
+
+    def test_device_stall_verdict(self, built):
+        """Execution launched, completion never fires -> DEVICE stall."""
+        out = _run_driver(built, "devstall", {"FAKE_EXEC_HANG": "1"})
+        assert "verdict=1" in out and "inflight=1" in out
+
+    def test_host_stall_verdict(self, built):
+        """Step open, nothing in flight -> HOST stall (dataloader/GC)."""
+        out = _run_driver(built, "hoststall")
+        assert "verdict=2" in out and "inflight=0" in out
+
+    def test_metrics_served_over_http(self, built):
+        """The interposer's tt core serves /metrics on the configured
+        port inside the driven process; spot-check via a fixed port."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        # DRIVER_LINGER_MS holds the driver (and its HTTP server) open
+        # after the measurements so polling can't race process exit.
+        env = dict(
+            os.environ,
+            DLROVER_PJRT_REAL_PLUGIN=os.path.join(
+                built, "libfake_pjrt_plugin.so"
+            ),
+            DLROVER_TT_PORT=str(port),
+            DRIVER_LINGER_MS="5000",
+        )
+        proc = subprocess.Popen(
+            ["./test_driver", "./libpjrt_interposer.so", "basic"],
+            cwd=built, env=env, stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            body = None
+            for _ in range(50):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=1
+                    ) as resp:
+                        body = resp.read().decode()
+                    if "tpu_timer_device_launches_total" in body:
+                        break
+                except OSError:
+                    import time
+
+                    time.sleep(0.05)
+            assert body and "tpu_timer_device_launches_total" in body
+        finally:
+            proc.wait(timeout=60)
+
+
+class TestPythonBindings:
+    def test_parse_metrics(self):
+        from dlrover_tpu.profiler.pjrt import parse_metrics
+
+        text = 'tpu_timer_count{kind="execute"} 3\ntpu_timer_hang 0\nbad\n'
+        m = parse_metrics(text)
+        assert m['tpu_timer_count{kind="execute"}'] == 3.0
+        assert m["tpu_timer_hang"] == 0.0
+
+    def test_build_and_bind(self, built):
+        """The ctypes bindings load the library and read live state."""
+        from dlrover_tpu.profiler import pjrt
+
+        # Fresh-process check: binding works without a prior GetPjrtApi
+        # (tt core not initialized -> safe defaults, no crash).
+        code = (
+            "from dlrover_tpu.profiler import pjrt;"
+            "assert pjrt.stall_verdict() == pjrt.STALL_NONE;"
+            "assert pjrt.device_inflight() == 0;"
+            "print('BIND_OK')"
+        )
+        r = subprocess.run(
+            ["python", "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert r.returncode == 0 and "BIND_OK" in r.stdout, r.stderr
+
+    def test_enable_sets_env(self, built, monkeypatch, tmp_path):
+        from dlrover_tpu.profiler import pjrt
+
+        fake_real = tmp_path / "libtpu.so"
+        fake_real.write_bytes(b"not really")
+        for var in ("TPU_LIBRARY_PATH", "DLROVER_PJRT_REAL_PLUGIN"):
+            monkeypatch.delenv(var, raising=False)
+        lib = pjrt.enable_tpu_interposition(real_plugin=str(fake_real))
+        assert os.environ["TPU_LIBRARY_PATH"] == lib
+        assert os.environ["DLROVER_PJRT_REAL_PLUGIN"] == str(fake_real)
+        monkeypatch.delenv("TPU_LIBRARY_PATH")
+        monkeypatch.delenv("PJRT_TPU_LIBRARY_PATH")
+        monkeypatch.delenv("DLROVER_PJRT_REAL_PLUGIN")
+        monkeypatch.delenv("DLROVER_TT_PORT")

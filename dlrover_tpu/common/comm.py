@@ -252,8 +252,14 @@ class ResourceUsageReport:
     node_type: str = ""
     cpu_percent: float = 0.0
     memory_mb: float = 0.0
+    # Per-local-device gauges, reported by the TRAINER (the process that
+    # owns the chips — TPU memory stats are only visible to the owning
+    # PJRT client, unlike the reference's out-of-process nvidia-smi,
+    # common/metric/monitor.py:351). util is duty-cycle 0..1 (-1 when
+    # the profiler has no device activity signal yet).
     device_util: Dict[int, float] = field(default_factory=dict)
     device_mem_mb: Dict[int, float] = field(default_factory=dict)
+    device_mem_limit_mb: Dict[int, float] = field(default_factory=dict)
 
 
 @register_message

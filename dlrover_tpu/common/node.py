@@ -31,6 +31,11 @@ class NodeResource:
     device_type: str = ""  # e.g. "tpu-v5e"
     device_count: int = 0  # chips attached to this host
     priority: str = ""
+    # Live per-device gauges from the trainer's ResourceUsageReport
+    # (duty-cycle 0..1, HBM used/limit MB), keyed by local device index.
+    device_util: Dict[int, float] = field(default_factory=dict)
+    device_mem_mb: Dict[int, float] = field(default_factory=dict)
+    device_mem_limit_mb: Dict[int, float] = field(default_factory=dict)
 
     @classmethod
     def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":

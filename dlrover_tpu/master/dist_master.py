@@ -114,13 +114,21 @@ class DistributedJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(default_expected=num_workers)
         self.perf_monitor = PerfMonitor()
+        # Real-metrics pipeline: per-node runtime series feeding the
+        # strategy generator, straggler exclusion, and the diagnosis
+        # device-pressure check (reference master/stats/ +
+        # simple_strategy_generator.py:40).
+        from .stats import JobStatsCollector
+
+        self.stats_collector = JobStatsCollector(self._job_ctx)
         self.diagnosis_master = DiagnosisMaster(
             operators=pre_check_ops
             if pre_check_ops is not None
             else [
                 SchedulingPreCheckOperator(expected_workers=num_workers),
                 ConnectionPreCheckOperator(expected_workers=num_workers),
-            ]
+            ],
+            stats=self.stats_collector,
         )
         optimizer = (
             ThroughputScalingOptimizer(
@@ -131,13 +139,8 @@ class DistributedJobMaster:
             if self.max_workers > num_workers
             else FixedResourceOptimizer()
         )
-        # Real-metrics pipeline: per-node runtime series feeding the
-        # strategy generator and straggler exclusion (reference
-        # master/stats/ + simple_strategy_generator.py:40).
         from .hyperparams import SimpleStrategyGenerator
-        from .stats import JobStatsCollector
 
-        self.stats_collector = JobStatsCollector(self._job_ctx)
         strategy = (
             SimpleStrategyGenerator(
                 self.stats_collector,

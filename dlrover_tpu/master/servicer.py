@@ -176,8 +176,22 @@ class MasterServicer:
     def _resource_usage(self, msg: comm.ResourceUsageReport) -> None:
         node = self._job_ctx.get_node(msg.node_type or "worker", msg.node_id)
         if node is not None:
-            node.used_resource.cpu = msg.cpu_percent
-            node.used_resource.memory_mb = msg.memory_mb
+            # Two reporters share this node: the agent's ResourceMonitor
+            # (host cpu/mem) and the trainer's DeviceMonitor (device
+            # gauges, host fields zero). Merge per-field — a device-only
+            # report must not zero the host gauges between agent samples.
+            if msg.cpu_percent > 0:
+                node.used_resource.cpu = msg.cpu_percent
+            if msg.memory_mb > 0:
+                node.used_resource.memory_mb = msg.memory_mb
+            if msg.device_util:
+                node.used_resource.device_util = dict(msg.device_util)
+            if msg.device_mem_mb:
+                node.used_resource.device_mem_mb = dict(msg.device_mem_mb)
+            if msg.device_mem_limit_mb:
+                node.used_resource.device_mem_limit_mb = dict(
+                    msg.device_mem_limit_mb
+                )
             self._job_ctx.update_node(node)
 
     def _training_step(self, msg: comm.TrainingStepReport) -> None:

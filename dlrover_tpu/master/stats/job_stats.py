@@ -36,6 +36,11 @@ class NodeSample:
     memory_mb: float = 0.0
     step_time_us: float = 0.0
     matmul_tflops: float = 0.0
+    # Device-side signals (trainer-reported, see trainer/device_monitor):
+    # mean duty-cycle across the host's local devices (-1 = no signal)
+    # and the worst HBM occupancy fraction (used/limit; 0 = unknown).
+    device_util: float = -1.0
+    device_mem_frac: float = 0.0
 
 
 @dataclass
@@ -94,17 +99,28 @@ class JobStatsCollector:
                 series = self._series.setdefault(
                     node.node_id, NodeSeries(node.node_id)
                 )
+                used = node.used_resource
+                utils = [u for u in used.device_util.values() if u >= 0]
+                mem_fracs = [
+                    used.device_mem_mb.get(i, 0.0) / limit
+                    for i, limit in used.device_mem_limit_mb.items()
+                    if limit > 0
+                ]
                 series.samples.append(
                     NodeSample(
                         timestamp=now,
-                        cpu_percent=node.used_resource.cpu,
-                        memory_mb=node.used_resource.memory_mb,
+                        cpu_percent=used.cpu,
+                        memory_mb=used.memory_mb,
                         step_time_us=metric_ctx.fresh_gauge(
                             node.node_id, STEP_AVG_US, max_age
                         ),
                         matmul_tflops=metric_ctx.fresh_gauge(
                             node.node_id, MATMUL_TFLOPS, max_age
                         ),
+                        device_util=(
+                            sum(utils) / len(utils) if utils else -1.0
+                        ),
+                        device_mem_frac=max(mem_fracs, default=0.0),
                     )
                 )
 
@@ -175,6 +191,63 @@ class JobStatsCollector:
         if median <= 0:
             return []
         return sorted(n for n, v in means.items() if v > factor * median)
+
+    def detect_device_pressure(
+        self,
+        util_floor_ratio: float = 0.6,
+        mem_frac_ceiling: float = 0.92,
+        min_nodes: int = 3,
+        min_samples: int = 3,
+    ) -> Dict[int, str]:
+        """Hosts whose DEVICE metrics degraded — before step times
+        diverge (reference GpuMetricMonitor feeds the same early-warning
+        role, common/metric/monitor.py:351). Two signals:
+
+        - duty-cycle collapse: a node's mean device utilization below
+          ``util_floor_ratio`` x the peer median while peers are busy —
+          its chip is starving (input stall, desharded collective)
+          though its step reports may still look on-pace;
+        - HBM saturation: worst device memory above ``mem_frac_ceiling``
+          of its limit — the next rematerialization spike OOMs it.
+
+        Returns {node_id: reason}. Median gating mirrors
+        detect_stragglers: no verdicts from tiny worlds or thin series.
+        """
+        with self._mu:
+            utils: Dict[int, float] = {}
+            mem_fracs: Dict[int, float] = {}
+            for nid, series in self._series.items():
+                samples = [
+                    s for s in list(series.samples)[-8:] if s.device_util >= 0
+                ]
+                if len(samples) >= min_samples:
+                    utils[nid] = sum(s.device_util for s in samples) / len(
+                        samples
+                    )
+                mems = [
+                    s.device_mem_frac
+                    for s in list(series.samples)[-min_samples:]
+                    if s.device_mem_frac > 0
+                ]
+                if len(mems) >= min_samples:
+                    mem_fracs[nid] = min(mems)  # sustained, not a spike
+        out: Dict[int, str] = {}
+        if len(utils) >= min_nodes:
+            import statistics
+
+            median = statistics.median(utils.values())
+            if median > 0.05:  # peers genuinely busy
+                for nid, u in utils.items():
+                    if u < util_floor_ratio * median:
+                        # "<kind>: <detail>" — consumers dedup on kind
+                        out[nid] = (
+                            f"duty-cycle: {u:.2f} vs peer median "
+                            f"{median:.2f}"
+                        )
+        for nid, frac in mem_fracs.items():
+            if frac > mem_frac_ceiling and nid not in out:
+                out[nid] = f"hbm: {frac:.0%} of limit"
+        return out
 
     def mean_cpu_percent(self) -> float:
         with self._mu:

@@ -259,13 +259,23 @@ class MasterClient:
             comm.NodeMetricsReport(node_id=self.node_id, gauges=dict(gauges))
         )
 
-    def report_resource_usage(self, cpu_percent: float, memory_mb: float) -> None:
+    def report_resource_usage(
+        self,
+        cpu_percent: float,
+        memory_mb: float,
+        device_util: Optional[Dict[int, float]] = None,
+        device_mem_mb: Optional[Dict[int, float]] = None,
+        device_mem_limit_mb: Optional[Dict[int, float]] = None,
+    ) -> None:
         self.report(
             comm.ResourceUsageReport(
                 node_id=self.node_id,
                 node_type=self.node_type,
                 cpu_percent=cpu_percent,
                 memory_mb=memory_mb,
+                device_util=dict(device_util or {}),
+                device_mem_mb=dict(device_mem_mb or {}),
+                device_mem_limit_mb=dict(device_mem_limit_mb or {}),
             )
         )
 

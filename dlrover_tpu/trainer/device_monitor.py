@@ -1,0 +1,164 @@
+"""Trainer-side per-device TPU metrics (VERDICT r2 #5).
+
+Reference parity: ``dlrover/python/common/metric/monitor.py:351``
+(GpuMetricMonitor polls nvidia-smi per accelerator). On TPU the
+equivalent gauges are only visible to the process that owns the chips:
+HBM occupancy comes from the PJRT client (``device.memory_stats()``)
+and duty-cycle from the profiler's device-activity stream — so this
+monitor runs in the TRAINER, not the agent, and ships its samples to
+the master through ``report_resource_usage`` where the stats collector
+and the device-pressure detector consume them.
+
+Duty-cycle derivation: the tpu_timer core accumulates device-execute
+busy-microseconds (PJRT interposer ``kind="execute"``; falls back to
+the step family when no interposer is loaded). The monitor diffs the
+busy sum between samples and divides by wall time — a 0..1 fraction of
+the interval the device spent executing. -1 means "no signal yet"
+(profiler inactive), which consumers must treat as unknown, not idle.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..common.log import logger
+from ..rpc.client import MasterClient
+
+DeviceStats = Dict[int, Dict[str, float]]  # idx -> {used_mb, limit_mb}
+
+
+def jax_device_stats() -> DeviceStats:
+    """HBM gauges for every local device via the live PJRT client.
+
+    Only call from the process that initialized jax — creating a client
+    here in an agent would grab (and can hang on) the hardware plugin.
+    """
+    import jax
+
+    out: DeviceStats = {}
+    for idx, dev in enumerate(jax.local_devices()):
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — per-device, best effort
+            stats = {}
+        used = float(stats.get("bytes_in_use", 0)) / 1e6
+        limit = float(stats.get("bytes_limit", 0)) / 1e6
+        out[idx] = {"used_mb": used, "limit_mb": limit}
+    return out
+
+
+class _BusyCounter:
+    """Device busy-microseconds from the native profiler core."""
+
+    # Prometheus names from tpu_timer MetricsText: busy sum = avg * count
+    _FAMILIES = ("execute", "step")
+
+    def read_busy_us(self) -> Optional[float]:
+        try:
+            from ..profiler.pjrt import metrics_text, parse_metrics
+
+            gauges = parse_metrics(metrics_text())
+        except Exception:  # noqa: BLE001 — profiler optional
+            return None
+        for fam in self._FAMILIES:
+            count = gauges.get(f'tpu_timer_count{{kind="{fam}"}}')
+            avg = gauges.get(f'tpu_timer_latency_us{{kind="{fam}",agg="avg"}}')
+            if count and avg:
+                return count * avg
+        return None
+
+
+class DeviceMonitor:
+    """Samples device memory + duty-cycle on an interval and reports.
+
+    ``stats_provider`` / ``busy_provider`` are injectable for tests and
+    for runtimes without jax in-process.
+    """
+
+    def __init__(
+        self,
+        client: Optional[MasterClient] = None,
+        interval: float = 15.0,
+        stats_provider: Callable[[], DeviceStats] = jax_device_stats,
+        busy_provider: Optional[Callable[[], Optional[float]]] = None,
+        host_usage: Optional[Callable[[], Tuple[float, float]]] = None,
+    ):
+        self._client = client
+        self._interval = interval
+        self._stats_provider = stats_provider
+        self._busy_provider = busy_provider or _BusyCounter().read_busy_us
+        self._host_usage = host_usage
+        self._last_busy: Optional[float] = None
+        self._last_wall = 0.0
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> Tuple[Dict[int, float], Dict[int, float], Dict[int, float]]:
+        """(device_util, device_mem_mb, device_mem_limit_mb)."""
+        now = time.monotonic()
+        busy = self._busy_provider()
+        util = -1.0
+        if busy is not None and self._last_busy is not None and now > self._last_wall:
+            delta_busy = max(0.0, busy - self._last_busy)
+            wall_us = (now - self._last_wall) * 1e6
+            util = min(1.0, delta_busy / wall_us)
+        if busy is not None:
+            self._last_busy = busy
+            self._last_wall = now
+        stats = {}
+        try:
+            stats = self._stats_provider()
+        except Exception as e:  # noqa: BLE001 — never kill the trainer
+            logger.debug("device stats unavailable: %s", e)
+        mem = {i: s.get("used_mb", 0.0) for i, s in stats.items()}
+        limit = {i: s.get("limit_mb", 0.0) for i, s in stats.items()}
+        # The busy counter is process-wide; attribute it uniformly (one
+        # chip per host in the common TPU pod slice layout). No device
+        # stats -> report NO util rather than fabricating a device 0
+        # whose gauge would pollute the master's peer median.
+        utils = {i: util for i in stats}
+        return utils, mem, limit
+
+    def report_once(self) -> None:
+        client = self._client or MasterClient.singleton()
+        if client is None:
+            return
+        utils, mem, limit = self.sample()
+        cpu, host_mem = (0.0, 0.0)
+        if self._host_usage is not None:
+            try:
+                cpu, host_mem = self._host_usage()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            client.report_resource_usage(
+                cpu,
+                host_mem,
+                device_util=utils,
+                device_mem_mb=mem,
+                device_mem_limit_mb=limit,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("device usage report failed: %s", e)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopped.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="device-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread = None
+
+    def _run(self) -> None:
+        # Prime the busy counter so the first report has a real delta.
+        try:
+            self.sample()
+        except Exception:  # noqa: BLE001
+            pass
+        while not self._stopped.wait(self._interval):
+            self.report_once()

@@ -54,6 +54,7 @@ class ElasticTrainLoop:
         storage_every: int = 100,
         log_every: int = 10,
         on_step: Optional[Callable[[int, float], None]] = None,
+        device_monitor: bool = True,
     ):
         self.engine = engine
         self.step_fn = step_fn
@@ -64,6 +65,14 @@ class ElasticTrainLoop:
         self.log_every = max(1, log_every)
         self.on_step = on_step
         self.start_step = 0
+        # Per-device HBM/duty-cycle reporter — runs HERE because only
+        # the trainer's PJRT client can see TPU memory stats (see
+        # trainer/device_monitor.py). Needs a master to report to.
+        self._device_monitor = None
+        if device_monitor and ctx is not None and ctx.client is not None:
+            from .device_monitor import DeviceMonitor
+
+            self._device_monitor = DeviceMonitor(client=ctx.client)
 
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
@@ -96,6 +105,18 @@ class ElasticTrainLoop:
             data_iter = data_factory(start)
         if data_iter is None:
             raise ValueError("run() needs data_iter or data_factory")
+        if self._device_monitor is not None:
+            self._device_monitor.start()
+        try:
+            return self._run_inner(state, data_iter, start)
+        finally:
+            # stop() even when step_fn raises: a leaked daemon reporter
+            # would keep shipping stale gauges for the process life and
+            # block a retried run() from restarting it cleanly.
+            if self._device_monitor is not None:
+                self._device_monitor.stop()
+
+    def _run_inner(self, state, data_iter, start):
         step = start
         last_save_ok = False
         it = iter(data_iter)

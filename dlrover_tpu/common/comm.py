@@ -250,8 +250,10 @@ class NodeMetricsReport:
 class ResourceUsageReport:
     node_id: int = 0
     node_type: str = ""
-    cpu_percent: float = 0.0
-    memory_mb: float = 0.0
+    # None = "not reported" (a device-only report from the trainer) —
+    # distinct from a genuine 0.0 gauge on an idle host.
+    cpu_percent: Optional[float] = None
+    memory_mb: Optional[float] = None
     # Per-local-device gauges, reported by the TRAINER (the process that
     # owns the chips — TPU memory stats are only visible to the owning
     # PJRT client, unlike the reference's out-of-process nvidia-smi,

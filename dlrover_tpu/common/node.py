@@ -33,9 +33,12 @@ class NodeResource:
     priority: str = ""
     # Live per-device gauges from the trainer's ResourceUsageReport
     # (duty-cycle 0..1, HBM used/limit MB), keyed by local device index.
+    # device_reported_at stamps the last device report so consumers can
+    # drop stale gauges from a reporter that died (job_stats freshness).
     device_util: Dict[int, float] = field(default_factory=dict)
     device_mem_mb: Dict[int, float] = field(default_factory=dict)
     device_mem_limit_mb: Dict[int, float] = field(default_factory=dict)
+    device_reported_at: float = 0.0
 
     @classmethod
     def resource_str_to_node_resource(cls, resource: str) -> "NodeResource":

@@ -178,11 +178,12 @@ class MasterServicer:
         if node is not None:
             # Two reporters share this node: the agent's ResourceMonitor
             # (host cpu/mem) and the trainer's DeviceMonitor (device
-            # gauges, host fields zero). Merge per-field — a device-only
-            # report must not zero the host gauges between agent samples.
-            if msg.cpu_percent > 0:
+            # gauges, host fields None). None = "not reported", so a
+            # device-only report can't clobber host gauges and a genuine
+            # 0.0 host gauge still lands.
+            if msg.cpu_percent is not None:
                 node.used_resource.cpu = msg.cpu_percent
-            if msg.memory_mb > 0:
+            if msg.memory_mb is not None:
                 node.used_resource.memory_mb = msg.memory_mb
             if msg.device_util:
                 node.used_resource.device_util = dict(msg.device_util)
@@ -192,6 +193,10 @@ class MasterServicer:
                 node.used_resource.device_mem_limit_mb = dict(
                     msg.device_mem_limit_mb
                 )
+            if msg.device_util or msg.device_mem_mb:
+                import time as _time
+
+                node.used_resource.device_reported_at = _time.time()
             self._job_ctx.update_node(node)
 
     def _training_step(self, msg: comm.TrainingStepReport) -> None:

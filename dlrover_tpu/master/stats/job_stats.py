@@ -100,12 +100,28 @@ class JobStatsCollector:
                     node.node_id, NodeSeries(node.node_id)
                 )
                 used = node.used_resource
-                utils = [u for u in used.device_util.values() if u >= 0]
-                mem_fracs = [
-                    used.device_mem_mb.get(i, 0.0) / limit
-                    for i, limit in used.device_mem_limit_mb.items()
-                    if limit > 0
-                ]
+                # Freshness gate (same rationale as fresh_gauge above):
+                # a dead reporter's last device gauges must not be
+                # replayed into new samples — they would prop up or drag
+                # the peer median in detect_device_pressure forever.
+                device_fresh = (
+                    used.device_reported_at > 0
+                    and now - used.device_reported_at <= max_age
+                )
+                utils = (
+                    [u for u in used.device_util.values() if u >= 0]
+                    if device_fresh
+                    else []
+                )
+                mem_fracs = (
+                    [
+                        used.device_mem_mb.get(i, 0.0) / limit
+                        for i, limit in used.device_mem_limit_mb.items()
+                        if limit > 0
+                    ]
+                    if device_fresh
+                    else []
+                )
                 series.samples.append(
                     NodeSample(
                         timestamp=now,

@@ -261,8 +261,8 @@ class MasterClient:
 
     def report_resource_usage(
         self,
-        cpu_percent: float,
-        memory_mb: float,
+        cpu_percent: Optional[float],
+        memory_mb: Optional[float],
         device_util: Optional[Dict[int, float]] = None,
         device_mem_mb: Optional[Dict[int, float]] = None,
         device_mem_limit_mb: Optional[Dict[int, float]] = None,

@@ -124,12 +124,14 @@ class DeviceMonitor:
         if client is None:
             return
         utils, mem, limit = self.sample()
-        cpu, host_mem = (0.0, 0.0)
+        # None = "host gauges not reported here" — the agent's
+        # ResourceMonitor owns those; the master merges per-field.
+        cpu, host_mem = (None, None)
         if self._host_usage is not None:
             try:
                 cpu, host_mem = self._host_usage()
             except Exception:  # noqa: BLE001
-                pass
+                cpu, host_mem = (None, None)
         try:
             client.report_resource_usage(
                 cpu,
@@ -152,7 +154,13 @@ class DeviceMonitor:
 
     def stop(self) -> None:
         self._stopped.set()
+        thread = self._thread
         self._thread = None
+        # Join before allowing a restart: an immediate start() clearing
+        # the event could otherwise leave two threads reporting over the
+        # same busy-delta state.
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
 
     def _run(self) -> None:
         # Prime the busy counter so the first report has a real delta.

@@ -11,6 +11,20 @@ already uses.
 """
 
 from .api import DLJob, DLJobBuilder, RLJobBuilder  # noqa: F401
+from .comm import (  # noqa: F401
+    DataQueue,
+    RoleActor,
+    RoleGroup,
+    call_role,
+    current_role,
+    current_role_index,
+    export_rpc_instance,
+    export_rpc_method,
+    pack_array,
+    queue_batches,
+    rpc,
+    unpack_array,
+)
 from .graph import DLExecutionGraph, RoleVertex  # noqa: F401
 from .manager import PrimeManager  # noqa: F401
 from .master import PrimeMaster  # noqa: F401

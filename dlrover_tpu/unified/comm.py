@@ -1,0 +1,352 @@
+"""Role-to-role runtime helpers: RPC, data queues, weight sync.
+
+Reference: ``unified/api/runtime/`` — ``rpc_helper.py`` (the ``@rpc``
+decorator, ``export_rpc_method/instance``, ``create_rpc_proxy``,
+``RoleActor.call``, ``RoleGroup``), ``queue.py`` (``DataQueue`` with an
+owner-side impl and name-addressed clients), and
+``ray_dataloader_iter.py``. There these ride Ray actor calls; here the
+TPU-native unified runtime runs roles as supervised processes, so the
+same API rides the job's msgpack unix-socket IPC layer
+(``common/multi_process.py``) — no pickle, no Ray dependency. A role
+process finds a peer purely by (role, index) name; restarts re-bind the
+same address, so an in-flight consumer survives a producer failover by
+retrying (see ``call_role(..., retry_for=...)``).
+
+Addressing requires the roles to share one IPC namespace — the
+PrimeManager sets ``DLROVER_IPC_NAMESPACE=unified_<job>`` for plain
+roles. ``elastic=True`` roles live in per-instance namespaces (their
+agent/saver stacks must not collide) and are reachable over the master
+RPC transport instead; the process-local helpers raise a clear error
+there.
+
+Arrays cross the wire as (dtype, shape, bytes) — msgpack carries no
+numpy; ``pack_array``/``unpack_array`` are the 3-line codecs.
+"""
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..common.log import logger
+from ..common.multi_process import (
+    LocalSocketClient,
+    LocalSocketServer,
+    SharedQueue,
+)
+from .runtime import RoleEnv
+
+# ---------------------------------------------------------------------------
+# identity
+# ---------------------------------------------------------------------------
+
+
+def current_role() -> str:
+    return os.environ.get(RoleEnv.ROLE, "")
+
+
+def current_role_index() -> int:
+    return int(os.environ.get(RoleEnv.ROLE_INDEX, "0"))
+
+
+def current_role_world() -> int:
+    return int(os.environ.get(RoleEnv.ROLE_WORLD, "1"))
+
+
+def role_world(role: str) -> int:
+    """Instance count of ANY role in the job — the PrimeManager ships
+    the full {role: world} map in DLROVER_ROLE_WORLDS so a peer group
+    can be addressed without re-declaring its size."""
+    import json
+
+    worlds = os.environ.get("DLROVER_ROLE_WORLDS", "")
+    if worlds:
+        try:
+            parsed = json.loads(worlds)
+            if role in parsed:
+                return int(parsed[role])
+        except (ValueError, TypeError):
+            pass
+    if role == current_role():
+        return current_role_world()
+    return 1
+
+
+def _check_addressable() -> None:
+    """Process-local role comm needs the job-shared IPC namespace; an
+    elastic=True role lives in its per-instance namespace (agent/saver
+    isolation) where peer sockets do not resolve — fail fast with the
+    reason instead of timing out on a socket that will never bind."""
+    ns = os.environ.get("DLROVER_IPC_NAMESPACE", "")
+    if current_role() and ns and not ns.startswith("unified_"):
+        raise RuntimeError(
+            "role-to-role IPC helpers are not available inside "
+            "elastic=True roles (per-instance IPC namespace "
+            f"{ns!r}); use the master RPC transport instead"
+        )
+
+
+def _rpc_sock_name(role: str, index: int) -> str:
+    return f"urpc_{role}_{index}"
+
+
+# ---------------------------------------------------------------------------
+# RPC: export methods, call peers (reference rpc_helper.py)
+# ---------------------------------------------------------------------------
+
+
+class RoleRpcServer(LocalSocketServer):
+    """This role-instance's method registry, served over the job IPC."""
+
+    def __init__(self, name: str):
+        self._methods: Dict[str, Callable] = {}
+        super().__init__(name)
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    def op_call(self, name: str = "", args: Optional[list] = None,
+                kwargs: Optional[dict] = None) -> Any:
+        fn = self._methods.get(name)
+        if fn is None:
+            raise ValueError(
+                f"role {current_role()!r} exports no rpc {name!r} "
+                f"(has: {sorted(self._methods)})"
+            )
+        return fn(*(args or []), **(kwargs or {}))
+
+    def op_methods(self) -> List[str]:
+        return sorted(self._methods)
+
+
+_rpc_server: Optional[RoleRpcServer] = None
+
+
+def _server() -> RoleRpcServer:
+    global _rpc_server
+    if _rpc_server is None:
+        role, index = current_role(), current_role_index()
+        if not role:
+            raise RuntimeError(
+                "not inside a unified role process (DLROVER_ROLE unset)"
+            )
+        _check_addressable()
+        _rpc_server = RoleRpcServer(_rpc_sock_name(role, index))
+    return _rpc_server
+
+
+def export_rpc_method(name: str, fn: Callable) -> None:
+    """Make ``fn`` callable by peers as ``call_role(role, name, ...)``
+    (reference rpc_helper.py:86)."""
+    _server().register(name, fn)
+
+
+def rpc(name: Optional[str] = None):
+    """Decorator marking a method for export (reference :61); apply
+    ``export_rpc_instance`` to the object afterwards."""
+
+    def wrap(fn):
+        fn.__rpc_name__ = name or fn.__name__
+        return fn
+
+    return wrap
+
+
+def export_rpc_instance(ns: Optional[str], instance: Any) -> None:
+    """Export every ``@rpc``-decorated method of ``instance``, names
+    prefixed with ``ns.`` when given (reference :117)."""
+    for attr in dir(instance):
+        fn = getattr(instance, attr, None)
+        rpc_name = getattr(fn, "__rpc_name__", None)
+        if rpc_name is None or not callable(fn):
+            continue
+        full = f"{ns}.{rpc_name}" if ns else rpc_name
+        export_rpc_method(full, fn)
+
+
+def call_role(
+    role: str,
+    method: str,
+    *args: Any,
+    index: int = 0,
+    timeout: float = 60.0,
+    retry_for: float = 0.0,
+    **kwargs: Any,
+) -> Any:
+    """Invoke ``method`` on a peer role instance.
+
+    ``retry_for`` > 0 keeps retrying connection-level failures for that
+    many seconds — the peer may still be starting, or mid-failover
+    (its restart re-binds the same socket name). Application errors
+    (the method raised) propagate immediately.
+    """
+    _check_addressable()
+    deadline = time.time() + max(retry_for, 0.0)
+    while True:
+        # Per-attempt connect budget: the client's own timeout loop
+        # already waits for a not-yet-bound socket, so give it the
+        # remaining retry window (or the plain call timeout when the
+        # caller asked for no retries).
+        if retry_for > 0:
+            attempt_timeout = max(0.5, min(timeout, deadline - time.time()))
+        else:
+            attempt_timeout = timeout
+        client = LocalSocketClient(
+            _rpc_sock_name(role, index), timeout=attempt_timeout
+        )
+        try:
+            return client.call("call", name=method, args=list(args),
+                               kwargs=kwargs)
+        except RuntimeError:
+            raise  # remote method raised: not retryable
+        except (ConnectionError, OSError, TimeoutError) as e:
+            if time.time() >= deadline:
+                raise ConnectionError(
+                    f"role {role}[{index}] unreachable for rpc {method!r}: {e}"
+                ) from e
+            time.sleep(0.2)
+        finally:
+            client.close()
+
+
+class RoleActor:
+    """Handle on one peer instance (reference rpc_helper.py:159)."""
+
+    def __init__(self, role: str, index: int):
+        self.role = role
+        self.index = index
+
+    def call(self, method: str, *args, retry_for: float = 0.0, **kwargs):
+        return call_role(
+            self.role, method, *args, index=self.index,
+            retry_for=retry_for, **kwargs,
+        )
+
+
+class RoleGroup(Sequence):
+    """All instances of a peer role (reference rpc_helper.py:177)."""
+
+    def __init__(self, role: str, world: Optional[int] = None):
+        self.role = role
+        if world is None:
+            world = role_world(role)
+        self._actors = [RoleActor(role, i) for i in range(world)]
+
+    def __len__(self) -> int:
+        return len(self._actors)
+
+    def __getitem__(self, i):
+        return self._actors[i]
+
+    def call(self, method: str, *args, retry_for: float = 0.0, **kwargs):
+        """Fan the call to every instance; list of results in index
+        order."""
+        return [
+            a.call(method, *args, retry_for=retry_for, **kwargs)
+            for a in self._actors
+        ]
+
+
+# ---------------------------------------------------------------------------
+# DataQueue (reference queue.py DataQueue/DataQueueImpl)
+# ---------------------------------------------------------------------------
+
+
+class DataQueue:
+    """Name-addressed sample queue between roles.
+
+    The ``is_master=True`` side owns the queue server (reference: the
+    impl lives on the owner actor); any role in the job gets the same
+    queue by name. Bounded: ``put`` blocks when ``size`` samples are
+    pending, back-pressuring a rollout that outruns its trainer.
+    """
+
+    def __init__(self, name: str, is_master: bool = False, size: int = 1000):
+        self.name = name
+        self._q = SharedQueue(
+            f"udq_{name}", create=is_master, maxsize=size
+        )
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def put(self, *items: Any, timeout: Optional[float] = None) -> None:
+        for item in items:
+            if not self._q.put(item, timeout=timeout):
+                raise TimeoutError(
+                    f"queue {self.name!r} full for {timeout}s"
+                )
+
+    def get(
+        self,
+        batch_size: int = 1,
+        timeout: Optional[float] = None,
+        retry_for: float = 0.0,
+    ) -> List[Any]:
+        """Up to ``batch_size`` items (at least one unless timed out).
+        ``retry_for`` tolerates the owner restarting mid-wait."""
+        import queue as _pyqueue
+
+        out: List[Any] = []
+        deadline = None if retry_for <= 0 else time.time() + retry_for
+        while len(out) < batch_size:
+            try:
+                item = self._q.get(
+                    timeout=timeout if not out else 0.01
+                )
+            except _pyqueue.Empty:
+                break  # timed out (first) or drained the burst (rest)
+            except (ConnectionError, OSError) as e:
+                if deadline is not None and time.time() < deadline:
+                    time.sleep(0.2)
+                    continue
+                raise ConnectionError(
+                    f"queue {self.name!r} owner unreachable: {e}"
+                ) from e
+            out.append(item)
+        return out
+
+    def close(self) -> None:
+        self._q.close()
+
+
+# ---------------------------------------------------------------------------
+# array codec + sample iterator
+# ---------------------------------------------------------------------------
+
+
+def pack_array(arr) -> Dict[str, Any]:
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    return {"dtype": str(a.dtype), "shape": list(a.shape), "data": a.tobytes()}
+
+
+def unpack_array(obj: Dict[str, Any]):
+    import numpy as np
+
+    return np.frombuffer(
+        obj["data"], dtype=np.dtype(obj["dtype"])
+    ).reshape(obj["shape"])
+
+
+def queue_batches(
+    queue: DataQueue,
+    batch_size: int,
+    max_batches: Optional[int] = None,
+    timeout: float = 60.0,
+    retry_for: float = 0.0,
+):
+    """Iterator of sample batches off a DataQueue (reference
+    ray_dataloader_iter.py): the trainer-side dataloader for a
+    rollout-fed pipeline. Stops after ``max_batches`` or a timed-out
+    empty read."""
+    produced = 0
+    while max_batches is None or produced < max_batches:
+        batch = queue.get(
+            batch_size, timeout=timeout, retry_for=retry_for
+        )
+        if not batch:
+            logger.info("queue %s drained; iterator ends", queue.name)
+            return
+        yield batch
+        produced += 1

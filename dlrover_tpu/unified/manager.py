@@ -101,6 +101,28 @@ class PrimeManager:
         spec = self.graph.spec_of(vertex)
         command = list(spec.command)
         env = dict(spec.env)
+        if not spec.elastic:
+            # One shared IPC namespace per unified job: role-to-role
+            # RPC/queues (unified/comm.py) address peers by socket name,
+            # so every plain role must resolve the same socket dir keys.
+            # Elastic roles keep their per-instance namespaces (agent +
+            # saver isolation) — see comm.py docstring.
+            env.setdefault(
+                "DLROVER_IPC_NAMESPACE", f"unified_{self.job.name}"
+            )
+            # Full role->world map so RoleGroup("peer") can address every
+            # instance without the script re-declaring the topology.
+            import json
+
+            env.setdefault(
+                "DLROVER_ROLE_WORLDS",
+                json.dumps(
+                    {
+                        name: s.num_instances
+                        for name, s in self.job.roles.items()
+                    }
+                ),
+            )
         if spec.elastic:
             # Wrap the role's script in the tpurun launcher against a
             # role-scoped sub-master (reference ElasticMaster sub-master

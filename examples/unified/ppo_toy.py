@@ -1,0 +1,131 @@
+"""Toy PPO-shaped loop proving the unified role data path end-to-end.
+
+The TPU-native analogue of the reference PPO example
+(examples/unified/rl/openrlhf/ppo/main.py:26-60 — rollout generates,
+trainer consumes, weights sync back) shrunk to a scalar policy so the
+whole loop runs in milliseconds in tests:
+
+- rollout[i]: samples x ~ U(-1,1), acts y = w_rollout * x + noise, puts
+  (x, y) experience batches on the shared ``DataQueue("experience")``;
+  exports ``set_weights`` (trainer pushes fresh w) and ``shutdown``.
+- trainer: owns the queue; SGD-fits w_train so y ≈ TARGET * x from the
+  experience stream, pushes w_train to every rollout each SYNC_EVERY
+  updates (``RoleGroup("rollout").call(...)``), records progress, and
+  shuts the rollouts down when done.
+
+Every arrow rides framework primitives (unified/comm.py): the queue is
+the rollout→trainer data path, ``call_role``/``RoleGroup`` the
+trainer→rollout weight path, and ``retry_for`` carries both across a
+mid-loop rollout kill + restart (the failover e2e in test_unified.py).
+
+Run standalone:  python examples/unified/ppo_toy.py
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+TARGET = 3.0
+UPDATES = int(os.environ.get("PPO_UPDATES", "40"))
+BATCH = int(os.environ.get("PPO_BATCH", "8"))
+SYNC_EVERY = int(os.environ.get("PPO_SYNC_EVERY", "5"))
+OUT_DIR = os.environ.get("PPO_OUT_DIR", "/tmp/ppo_toy")
+
+
+def run_rollout() -> int:
+    import random
+
+    from dlrover_tpu.unified.comm import (
+        DataQueue,
+        current_role_index,
+        export_rpc_method,
+    )
+
+    state = {"w": 0.0, "version": -1, "stop": False}
+
+    def set_weights(w: float, version: int) -> int:
+        state["w"], state["version"] = float(w), int(version)
+        return state["version"]
+
+    def shutdown() -> bool:
+        state["stop"] = True
+        return True
+
+    export_rpc_method("set_weights", set_weights)
+    export_rpc_method("shutdown", shutdown)
+
+    queue = DataQueue("experience")  # trainer owns it; connect by name
+    rng = random.Random(1234 + current_role_index())
+    sent = 0
+    while not state["stop"]:
+        batch = []
+        for _ in range(BATCH):
+            x = rng.uniform(-1.0, 1.0)
+            noise = rng.gauss(0.0, 0.05)
+            batch.append({"x": x, "y": state["w"] * x + noise})
+        try:
+            queue.put(batch, timeout=10.0)
+            sent += 1
+        except (TimeoutError, ConnectionError, OSError):
+            # trainer busy or mid-restart: drop the batch, stay alive
+            time.sleep(0.1)
+        time.sleep(0.005)
+    print(f"rollout exiting cleanly after {sent} batches", flush=True)
+    return 0
+
+
+def run_trainer() -> int:
+    from dlrover_tpu.unified.comm import DataQueue, RoleGroup
+
+    queue = DataQueue("experience", is_master=True, size=64)
+    rollouts = RoleGroup("rollout")  # world from DLROVER_ROLE_WORLDS
+    w = 0.0
+    lr = 0.4
+    history = []
+    for update in range(UPDATES):
+        samples = []
+        while not samples:
+            batch = queue.get(1, timeout=30.0, retry_for=60.0)
+            samples = batch[0] if batch else []
+        # Policy-improvement step on the OBSERVED actions: advantage of
+        # the target action over the taken one, (TARGET*x - y) * x. The
+        # taken action y came from the rollout's (lagging) weights, so
+        # the fixed point w = TARGET is only reached if the queue
+        # payloads AND the weight sync-back both carry real data — a
+        # corrupted y breaks convergence, which the e2e asserts on.
+        g = 0.0
+        for s in samples:
+            g += (TARGET * s["x"] - s["y"]) * s["x"]
+        w += lr * g / len(samples)
+        history.append(w)
+        if (update + 1) % SYNC_EVERY == 0:
+            # Weight sync back: every rollout instance, with retries
+            # riding over a mid-loop rollout restart.
+            versions = rollouts.call(
+                "set_weights", w, update, retry_for=60.0
+            )
+            print(f"update {update}: w={w:.3f} synced v{versions}", flush=True)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "trainer_result.json"), "w") as f:
+        json.dump({"w": w, "updates": len(history)}, f)
+    rollouts.call("shutdown", retry_for=60.0)
+    print(f"trainer done: w={w:.4f} (target {TARGET})", flush=True)
+    return 0
+
+
+def main() -> int:
+    role = os.environ.get("DLROVER_ROLE", "")
+    if role == "trainer":
+        return run_trainer()
+    if role == "rollout":
+        return run_rollout()
+    print(f"unknown role {role!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,6 +112,7 @@ class TestDevicePressure:
         for node_id, u in enumerate(utils):
             node = job_ctx.get_node(NodeType.WORKER, node_id)
             node.used_resource.device_util = {0: u}
+            node.used_resource.device_reported_at = time.time()
             if mem_fracs:
                 node.used_resource.device_mem_mb = {
                     0: mem_fracs[node_id] * 16000.0
@@ -119,6 +120,19 @@ class TestDevicePressure:
                 node.used_resource.device_mem_limit_mb = {0: 16000.0}
             job_ctx.update_node(node)
         return job_ctx
+
+    def test_stale_device_gauges_are_ignored(self):
+        """A dead reporter's last gauges must not keep feeding the
+        detector (freshness gate, mirrors fresh_gauge)."""
+        job_ctx = self._populate_devices([0.8, 0.82, 0.78, 0.2])
+        node = job_ctx.get_node(NodeType.WORKER, 3)
+        node.used_resource.device_reported_at = time.time() - 3600
+        job_ctx.update_node(node)
+        stats = JobStatsCollector(job_ctx)
+        for _ in range(4):
+            stats.sample_once()
+        # node 3's stale gauge never enters a sample -> no verdict on it
+        assert stats.detect_device_pressure() == {}
 
     def test_duty_cycle_collapse_flagged_with_uniform_step_times(self):
         job_ctx = self._populate_devices([0.8, 0.82, 0.78, 0.2])
@@ -194,14 +208,16 @@ class TestDeviceMonitor:
             },
             busy_provider=lambda: busy["v"],
         )
+        t0 = time.monotonic()
         utils, mem, limit = mon.sample()
         assert utils[0] == -1.0  # first sample: no delta yet
         assert mem[0] == 1200.0 and limit[0] == 16000.0
-        # simulate 50% busy over the next interval
+        # inject busy proportional to REAL elapsed time (~50% duty) so
+        # CI scheduling delays can't push the ratio out of bounds
         time.sleep(0.05)
-        busy["v"] += 0.05 * 1e6 * 0.5
+        busy["v"] = (time.monotonic() - t0) * 1e6 * 0.5
         utils, _, _ = mon.sample()
-        assert 0.2 < utils[0] <= 1.0
+        assert 0.05 < utils[0] <= 1.0
 
     def test_report_once_ships_device_dicts(self):
         from dlrover_tpu.trainer.device_monitor import DeviceMonitor

@@ -318,3 +318,193 @@ class TestOrphanReaping:
                 os.kill(pid, 9)
             except OSError:
                 pass
+
+
+class TestRoleComm:
+    """Role-to-role RPC + queue helpers (VERDICT r2 #4; reference
+    unified/api/runtime/ rpc_helper.py + queue.py)."""
+
+    def _role_env(self, role, index=0, world=1, job="commjob"):
+        return {
+            "DLROVER_ROLE": role,
+            "DLROVER_ROLE_INDEX": str(index),
+            "DLROVER_ROLE_WORLD": str(world),
+            "DLROVER_UNIFIED_JOB": job,
+        }
+
+    def test_rpc_export_and_call(self, tmp_ipc_dir, monkeypatch):
+        import dlrover_tpu.unified.comm as comm
+
+        for k, v in self._role_env("rollout").items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(comm, "_rpc_server", None)
+        calls = []
+        comm.export_rpc_method("ping", lambda x: calls.append(x) or x + 1)
+        try:
+            # a "peer" (same process, different client) calls by name
+            assert comm.call_role("rollout", "ping", 41) == 42
+            assert calls == [41]
+            with pytest.raises(RuntimeError, match="exports no rpc"):
+                comm.call_role("rollout", "nope")
+        finally:
+            comm._server().stop()
+            monkeypatch.setattr(comm, "_rpc_server", None)
+
+    def test_rpc_instance_export_and_group(self, tmp_ipc_dir, monkeypatch):
+        import dlrover_tpu.unified.comm as comm
+
+        for k, v in self._role_env("actor").items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(comm, "_rpc_server", None)
+
+        class Policy:
+            @comm.rpc()
+            def version(self):
+                return 7
+
+            @comm.rpc("rename")
+            def other(self):
+                return "renamed"
+
+        comm.export_rpc_instance("policy", Policy())
+        try:
+            assert comm.call_role("actor", "policy.version") == 7
+            assert comm.call_role("actor", "policy.rename") == "renamed"
+            group = comm.RoleGroup("actor", world=1)
+            assert group.call("policy.version") == [7]
+        finally:
+            comm._server().stop()
+            monkeypatch.setattr(comm, "_rpc_server", None)
+
+    def test_data_queue_batches_and_array_codec(self, tmp_ipc_dir):
+        import numpy as np
+
+        from dlrover_tpu.unified.comm import (
+            DataQueue,
+            pack_array,
+            queue_batches,
+            unpack_array,
+        )
+
+        owner = DataQueue("exp_test", is_master=True, size=8)
+        client = DataQueue("exp_test")
+        try:
+            arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+            client.put({"a": pack_array(arr)}, {"a": pack_array(arr * 2)})
+            batches = list(
+                queue_batches(owner, batch_size=2, max_batches=1, timeout=5)
+            )
+            assert len(batches) == 1 and len(batches[0]) == 2
+            np.testing.assert_array_equal(
+                unpack_array(batches[0][0]["a"]), arr
+            )
+            np.testing.assert_array_equal(
+                unpack_array(batches[0][1]["a"]), arr * 2
+            )
+            assert owner.qsize() == 0
+        finally:
+            client.close()
+            owner.close()
+
+    def test_queue_backpressure(self, tmp_ipc_dir):
+        from dlrover_tpu.unified.comm import DataQueue
+
+        owner = DataQueue("bp_test", is_master=True, size=2)
+        try:
+            owner.put(1, 2)
+            with pytest.raises(TimeoutError):
+                owner.put(3, timeout=0.2)
+            assert owner.get(2, timeout=1) == [1, 2]
+        finally:
+            owner.close()
+
+
+PPO_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples",
+    "unified",
+    "ppo_toy.py",
+)
+
+
+class TestPpoE2E:
+    """The toy PPO loop: rollout -> queue -> trainer, weights -> rollout
+    (reference examples/unified/rl/openrlhf/ppo/main.py:26-60)."""
+
+    def _job(self, tmp_path, name, updates=30):
+        out = tmp_path / "out"
+        env = {
+            "PPO_OUT_DIR": str(out),
+            "PPO_UPDATES": str(updates),
+            "PPO_ROLLOUTS": "1",
+            "PPO_SYNC_EVERY": "5",
+        }
+        job = (
+            RLJobBuilder(name)
+            .node_num(1)
+            .device_per_node(4)
+            .trainer([sys.executable, PPO_SCRIPT], num=1, device=1.0, env=env)
+            .rollout(
+                [sys.executable, PPO_SCRIPT],
+                num=1,
+                device=1.0,
+                env=env,
+                restart_dependents=[],  # trainer survives rollout kills
+            )
+            .build()
+        )
+        return job, out
+
+    def test_data_flows_and_weights_sync(self, tmp_path):
+        import json
+        import uuid
+
+        job, out = self._job(tmp_path, f"ppo_{uuid.uuid4().hex[:8]}")
+        manager = PrimeManager(
+            job, log_dir=str(tmp_path / "logs"), monitor_interval=0.2
+        )
+        manager.start()
+        try:
+            assert manager.wait(timeout=120) == JobStatus.SUCCEEDED
+        finally:
+            manager.stop(status=manager.status)
+        result = json.loads((out / "trainer_result.json").read_text())
+        assert result["updates"] == 30
+        # trainer learned the target through the experience stream
+        assert abs(result["w"] - 3.0) < 0.5, result
+
+    def test_mid_loop_rollout_kill_recovers(self, tmp_path):
+        """SIGKILL the rollout mid-loop: the manager restarts it, the
+        re-bound RPC/queue endpoints pick the flow back up, and the job
+        still completes with the trainer uninterrupted."""
+        import json
+        import signal
+        import uuid
+
+        job, out = self._job(
+            tmp_path, f"ppo_{uuid.uuid4().hex[:8]}", updates=60
+        )
+        manager = PrimeManager(
+            job, log_dir=str(tmp_path / "logs"), monitor_interval=0.2
+        )
+        manager.start()
+        try:
+            # let the pipeline flow, then kill the rollout process
+            deadline = time.time() + 30
+            rollout = manager._workers.get("rollout-0")
+            while time.time() < deadline and (
+                rollout is None or rollout.pid is None
+            ):
+                time.sleep(0.1)
+                rollout = manager._workers.get("rollout-0")
+            assert rollout is not None and rollout.pid is not None
+            time.sleep(1.0)  # mid-loop
+            os.kill(rollout.pid, signal.SIGKILL)
+            assert manager.wait(timeout=180) == JobStatus.SUCCEEDED
+        finally:
+            manager.stop(status=manager.status)
+        restarted = manager.graph.vertices["rollout-0"].restart_count
+        assert restarted >= 1, "rollout was never restarted"
+        result = json.loads((out / "trainer_result.json").read_text())
+        assert result["updates"] == 60
+        assert abs(result["w"] - 3.0) < 0.5, result

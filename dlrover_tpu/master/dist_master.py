@@ -187,6 +187,44 @@ class DistributedJobMaster:
         def _exclude_straggler(node_id: int) -> None:
             self.job_manager.migrate_straggler(node_id)
 
+        def _scale_down(target: int) -> None:
+            # Drain path: mark the released nodes intentional (no
+            # relaunch-budget burn), kill through the scaler, and drop
+            # the rendezvous floor so the survivors re-form a world of
+            # `target` hosts at the next wave (re-mesh at lower dp).
+            removed = self.job_manager.scale_down(target)
+            if not removed:
+                return
+            training_rdzv.update_rdzv_params(
+                min_nodes=target,
+                max_nodes=self.max_workers,
+                waiting_timeout=ctx.rdzv_timeout_s,
+                node_unit=node_unit,
+            )
+            # Named barriers must also expect the smaller world, or two
+            # survivors wait forever on a third that no longer exists.
+            self.sync_service.set_default_expected(target)
+            # Unlike a relaunch (where the REPLACEMENT's rendezvous join
+            # announces the new world), a shrink adds no joiner — the
+            # survivors would keep running in the old world with dead
+            # members wedging every collective. Actively restart their
+            # worker groups; the re-joins form the smaller world.
+            from .diagnosis.action import DiagnosisActionType, NodeAction
+
+            from ..common.constants import NodeStatus, NodeType
+
+            for node in self._job_ctx.get_nodes(NodeType.WORKER).values():
+                # released covers the just-removed nodes too
+                if node.is_released or node.status != NodeStatus.RUNNING:
+                    continue
+                self._job_ctx.node_actions.add_action(
+                    NodeAction(
+                        node_id=node.node_id,
+                        action_type=DiagnosisActionType.RESTART_WORKER,
+                        reason="scale_down_remesh",
+                    )
+                )
+
         self.auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=scaler,
@@ -196,6 +234,7 @@ class DistributedJobMaster:
             stats=self.stats_collector,
             strategy_generator=strategy,
             straggler_handler=_exclude_straggler,
+            shrink_handler=_scale_down,
         )
         self.servicer = MasterServicer(
             job_manager=self.job_manager,

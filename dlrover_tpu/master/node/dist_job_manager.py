@@ -127,6 +127,8 @@ class DistributedJobManager(JobManager):
         if allowed is None:
             allowed = node.should_relaunch()
         if not allowed:
+            if self._scaled_out(node):
+                return  # intentional shrink removal: never abort-worthy
             if not self._fault_tolerance_left():
                 self._job_ctx.master_actions.add_action(
                     JobAbortionAction(reason=JobExitReason.MAX_RELAUNCH)
@@ -193,6 +195,38 @@ class DistributedJobManager(JobManager):
             self._job_ctx.update_node(node)
         self._scaler.scale(plan)
 
+    # -- scale down (reference job_auto_scaler.py:276-345 shrink path) -----
+
+    def scale_down(self, target: int):
+        """Release the highest-ranked workers so the job continues at
+        ``target`` hosts. The released nodes are marked BEFORE the
+        scaler kills them: their DELETED events must read as intentional
+        removals, not failures — otherwise the relaunch budget would
+        resurrect every host the optimizer just released. Returns the
+        removed node ids.
+        """
+        target = max(0, int(target))
+        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        active = sorted(
+            (n for n in workers.values() if not n.exited() and not n.is_released),
+            key=lambda n: n.rank_index,
+        )
+        if target >= len(active):
+            return []
+        removed = active[target:]  # keep the lowest ranks: dp shrinks
+        ids = []
+        for node in removed:
+            node.is_released = True
+            node.relaunchable = False
+            self._job_ctx.update_node(node)
+            ids.append(node.node_id)
+        self.num_workers = target
+        logger.info(
+            "scaling down to %s workers: releasing nodes %s", target, ids
+        )
+        self._scaler.scale(ScalePlan(worker_num=target, remove_nodes=ids))
+        return ids
+
     # -- suspend / resume (reference K8sElasticJobWatcher, k8s_watcher.py:427)
 
     @property
@@ -252,7 +286,11 @@ class DistributedJobManager(JobManager):
         else:
             self._pending_since = None
         if not self._fault_tolerance_left() and any(
-            n.status == NodeStatus.FAILED for n in workers.values()
+            n.status == NodeStatus.FAILED and not self._scaled_out(n)
+            for n in workers.values()
         ):
+            # scaled-out nodes end FAILED (killed on purpose) and stay in
+            # the context; counting them would abort a healthy shrunken
+            # job once the survivors' budgets are spent.
             return JobExitReason.MAX_RELAUNCH
         return None

@@ -33,6 +33,7 @@ class JobAutoScaler:
         stats=None,
         strategy_generator=None,
         straggler_handler=None,
+        shrink_handler=None,
     ):
         self._ctx = get_context()
         self._job_ctx = get_job_context()
@@ -49,6 +50,11 @@ class JobAutoScaler:
         self._stats = stats
         self._strategy = strategy_generator
         self._straggler_handler = straggler_handler
+        # Executes a shrink (target_workers -> None) with drain
+        # semantics: released nodes must be marked intentional before
+        # the kill, and the rendezvous bounds must drop, so the shrink
+        # routes through the job manager instead of the raw scaler.
+        self._shrink_handler = shrink_handler
         self._excluded_stragglers: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -74,9 +80,21 @@ class JobAutoScaler:
         if plan.worker_num > 0:
             target = (plan.worker_num // self._unit) * self._unit
             target = min(target, self._max)
-            if target > 0:
-                logger.info("auto-scale to %s workers", target)
-                self._scaler.scale(ScalePlan(worker_num=target))
+            if target <= 0:
+                return
+            current = (
+                self._world_size_fn() if self._world_size_fn else 0
+            )
+            if 0 < target < current and self._shrink_handler is not None:
+                # Shrink (optimizer saturation / Brain running-stage
+                # advice): drain path, not a bare kill.
+                logger.info(
+                    "auto-scale DOWN %s -> %s workers", current, target
+                )
+                self._shrink_handler(target)
+                return
+            logger.info("auto-scale to %s workers", target)
+            self._scaler.scale(ScalePlan(worker_num=target))
 
     # -- periodic loop (allreduce auto-scale, reference :315) --------------
 

@@ -174,14 +174,31 @@ class JobManager:
 
     # -- queries -----------------------------------------------------------
 
+    @staticmethod
+    def _scaled_out(n) -> bool:
+        # Intentionally removed by scale_down (is_released +
+        # relaunchable=False set BEFORE the kill): its FAILED/KILLED end
+        # state is the shrink working, not an error, so completion
+        # accounting skips it. Ordinary deletions only set is_released
+        # (relaunchable stays True) and still count.
+        return n.is_released and not n.relaunchable
+
     def all_workers_exited(self) -> bool:
-        workers = self._job_ctx.get_nodes(NodeType.WORKER)
-        return bool(workers) and all(n.exited() for n in workers.values())
+        workers = [
+            n
+            for n in self._job_ctx.get_nodes(NodeType.WORKER).values()
+            if not self._scaled_out(n)
+        ]
+        return bool(workers) and all(n.exited() for n in workers)
 
     def all_workers_succeeded(self) -> bool:
-        workers = self._job_ctx.get_nodes(NodeType.WORKER)
+        workers = [
+            n
+            for n in self._job_ctx.get_nodes(NodeType.WORKER).values()
+            if not self._scaled_out(n)
+        ]
         return bool(workers) and all(
-            n.status == NodeStatus.SUCCEEDED for n in workers.values()
+            n.status == NodeStatus.SUCCEEDED for n in workers
         )
 
     def alive_workers(self) -> List[Node]:

@@ -54,11 +54,17 @@ class FixedResourceOptimizer(ResourceOptimizer):
 
 
 class ThroughputScalingOptimizer(ResourceOptimizer):
-    """Grow the job while throughput scales, stop when it saturates.
+    """Grow the job while throughput scales, shrink past saturation.
 
     The allreduce-path analogue of the reference's stats-driven local
-    optimizer: track steps/s at each world size; propose +node_unit
-    hosts while marginal speedup per host stays above ``min_gain``.
+    optimizer (reference handles both directions,
+    job_auto_scaler.py:276-345): track steps/s at each world size;
+    propose +node_unit hosts while marginal speedup per host stays
+    above ``min_gain``. When a grow turns out NOT to pay, propose
+    shrinking back to the last efficient size and remember the
+    saturation frontier so the job doesn't oscillate grow/shrink
+    around it — hosts past the knee cost quota while barely moving
+    throughput.
     """
 
     def __init__(
@@ -74,6 +80,9 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
         self._min_gain = min_gain_per_host
         self._speed_at_size: Dict[int, float] = {}
         self._current_size = 0
+        # Largest size observed to still scale efficiently; sizes above
+        # it are known-saturated. None until a saturation is seen.
+        self._efficient_frontier: Optional[int] = None
 
     def record_world_size(self, size: int) -> None:
         self._current_size = size
@@ -84,9 +93,6 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
         if size <= 0 or speed <= 0:
             return ResourcePlan()
         self._speed_at_size[size] = speed
-        target = size + self._unit
-        if target > self._max:
-            return ResourcePlan()
         prev_sizes = [s for s in self._speed_at_size if s < size]
         if prev_sizes:
             prev = max(prev_sizes)
@@ -94,12 +100,28 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
             per_host = gained / max(1, size - prev)
             expected_per_host = self._speed_at_size[prev] / prev
             if per_host < self._min_gain * expected_per_host:
+                self._efficient_frontier = prev
                 logger.info(
                     "scaling saturated: +%.3f steps/s per host < %.0f%% of "
-                    "linear; holding at %s hosts",
+                    "linear; releasing back to %s hosts",
                     per_host,
                     self._min_gain * 100,
-                    size,
+                    prev,
                 )
-                return ResourcePlan()
+                return ResourcePlan(worker_num=prev)
+        if (
+            self._efficient_frontier is not None
+            and size > self._efficient_frontier
+        ):
+            # Still above the known knee (e.g. the earlier shrink plan
+            # was not executed): keep asking for the efficient size.
+            return ResourcePlan(worker_num=self._efficient_frontier)
+        target = size + self._unit
+        if target > self._max:
+            return ResourcePlan()
+        if (
+            self._efficient_frontier is not None
+            and target > self._efficient_frontier
+        ):
+            return ResourcePlan()  # growing past the knee is known waste
         return ResourcePlan(worker_num=target)

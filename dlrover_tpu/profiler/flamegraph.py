@@ -1,0 +1,87 @@
+"""Flamegraph from collected stack dumps.
+
+Tool counterpart of the reference's stack tooling
+(``py_xpu_timer/bin`` flamegraph path over py-spy/pstack output): the
+agent collects faulthandler dumps from every worker
+(``profiler/stack_dump.py`` — SIGUSR2 → all-thread tracebacks), and
+this folds them into the standard collapsed-stack format
+(``frame;frame;frame count`` lines) that flamegraph.pl, speedscope, or
+any flamegraph viewer renders directly. Repeated dumps of a wedged
+worker act as a poor-man's sampling profile: the hot (stuck) stack
+dominates the counts.
+
+CLI::
+
+    python -m dlrover_tpu.profiler.flamegraph dump1.stacks [dump2 ...] \
+        -o collapsed.txt
+"""
+
+import re
+from typing import Dict, Iterable, List
+
+# faulthandler frame line: '  File "x.py", line 12 in fn'
+_FRAME = re.compile(r'^\s+File "(?P<file>[^"]+)", line (?P<line>\d+) in (?P<fn>.+)$')
+# thread header: 'Thread 0x00007f... (most recent call first):'
+_THREAD = re.compile(r"^(Current thread|Thread) 0x[0-9a-fA-F]+")
+
+
+def parse_faulthandler(text: str) -> List[List[str]]:
+    """Split a faulthandler dump into per-thread stacks, ROOT-FIRST
+    (faulthandler prints most-recent-call-first; flamegraphs want the
+    root at the base)."""
+    stacks: List[List[str]] = []
+    current: List[str] = []
+    for line in text.splitlines():
+        if _THREAD.match(line):
+            if current:
+                stacks.append(list(reversed(current)))
+            current = []
+            continue
+        m = _FRAME.match(line)
+        if m:
+            short = m.group("file").rsplit("/", 1)[-1]
+            current.append(f"{m.group('fn')} ({short}:{m.group('line')})")
+    if current:
+        stacks.append(list(reversed(current)))
+    return stacks
+
+
+def fold(dumps: Iterable[str]) -> Dict[str, int]:
+    """{collapsed_stack: count} over every thread stack in every dump."""
+    counts: Dict[str, int] = {}
+    for text in dumps:
+        for stack in parse_faulthandler(text):
+            key = ";".join(stack)
+            if key:
+                counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_collapsed(counts: Dict[str, int], path: str) -> int:
+    with open(path, "w") as f:
+        for stack, count in sorted(counts.items()):
+            f.write(f"{stack} {count}\n")
+    return len(counts)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="fold faulthandler stack dumps into collapsed "
+        "flamegraph format"
+    )
+    parser.add_argument("dumps", nargs="+", help="stack dump files")
+    parser.add_argument("-o", "--output", required=True)
+    ns = parser.parse_args(argv)
+    texts = []
+    for path in ns.dumps:
+        with open(path) as f:
+            texts.append(f.read())
+    n = write_collapsed(fold(texts), ns.output)
+    print(f"wrote {n} unique stacks to {ns.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,24 +1,32 @@
-"""Compact timeline reader + perfetto (chrome trace) export.
+"""Compact timeline reader + perfetto export + merge/diff cluster tools.
 
-Tool counterpart of ``xpu_timer_gen_trace_timeline`` (reference
-py_xpu_timer/bin): the native core dumps 24-byte records; this converts
-them to the Trace Event JSON that ui.perfetto.dev loads directly.
+Tool counterpart of the reference's ``py_xpu_timer/bin`` suite:
+``xpu_timer_gen_trace_timeline`` (convert), the cluster timeline merge
+(one perfetto trace with a lane per host), and ``xpu_timer_diff``
+(per-kind/name latency deltas between two runs). The native core dumps
+24-byte records; perfetto JSON loads directly in ui.perfetto.dev.
 
 Format (native/tpu_timer/tpu_timer.cc): 8-byte magic "TPUTL001", then
 records of (name_id u32, kind u32, start_us i64, dur_us u32, step u32).
+
+CLI::
+
+    python -m dlrover_tpu.profiler.timeline convert RING OUT.json
+    python -m dlrover_tpu.profiler.timeline merge HOST=RING... -o OUT.json
+    python -m dlrover_tpu.profiler.timeline diff BASE RING
 """
 
 import json
 import struct
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _MAGIC = b"TPUTL001"
 _RECORD = struct.Struct("<IIqII")
 
 KIND_NAMES = [
     "matmul", "collective", "step", "h2d", "d2h", "other",
-    "hlo_flops", "hlo_comm",
+    "hlo_flops", "hlo_comm", "execute", "compile",
 ]
 
 
@@ -92,17 +100,143 @@ def convert(timeline_path: str, json_path: str) -> int:
     return len(events)
 
 
+def merge(
+    host_timelines: Sequence[Tuple[str, str]], json_path: str
+) -> int:
+    """Merge per-host rings into ONE perfetto trace, a process lane per
+    host (reference: the cluster-wide timeline the rank-0 xpu_timer
+    service assembles). ``host_timelines`` is [(host_label, ring_path)].
+    Events keep their host-local clocks; lanes are labeled so a
+    straggling collective on one host lines up visually against peers.
+    """
+    trace: List[dict] = []
+    total = 0
+    for pid, (host, path) in enumerate(host_timelines):
+        events = read_timeline(path)
+        names = read_names(path + ".names")
+        part = to_perfetto(events, names=names, pid=pid)["traceEvents"]
+        trace.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": host},
+            }
+        )
+        trace.extend(part)
+        total += len(events)
+    with open(json_path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return total
+
+
+def _stats_by_key(
+    events: List[TimelineEvent], names: Dict[int, str]
+) -> Dict[str, Tuple[int, float]]:
+    """{key: (count, total_us)} keyed "kind:name"."""
+    out: Dict[str, Tuple[int, float]] = {}
+    for ev in events:
+        kind = KIND_NAMES[ev.kind] if ev.kind < len(KIND_NAMES) else "other"
+        name = names.get(ev.name_id, f"{kind}_{ev.name_id}")
+        key = f"{kind}:{name}"
+        count, total = out.get(key, (0, 0.0))
+        out[key] = (count + 1, total + ev.dur_us)
+    return out
+
+
+def diff(base_path: str, new_path: str) -> List[dict]:
+    """Per-(kind, name) latency deltas between two runs (reference
+    ``xpu_timer_diff``): rows sorted by |mean delta|, so the op family
+    that regressed most tops the report."""
+    base = _stats_by_key(
+        read_timeline(base_path), read_names(base_path + ".names")
+    )
+    new = _stats_by_key(
+        read_timeline(new_path), read_names(new_path + ".names")
+    )
+    rows = []
+    for key in sorted(set(base) | set(new)):
+        b = base.get(key)
+        n = new.get(key)
+        b_mean = b[1] / b[0] if b else 0.0
+        n_mean = n[1] / n[0] if n else 0.0
+        rows.append(
+            {
+                "key": key,
+                "base_count": b[0] if b else 0,
+                "new_count": n[0] if n else 0,
+                "base_mean_us": round(b_mean, 1),
+                "new_mean_us": round(n_mean, 1),
+                "delta_us": round(n_mean - b_mean, 1),
+                "delta_pct": round(
+                    100.0 * (n_mean - b_mean) / b_mean, 1
+                )
+                if b_mean > 0
+                else None,
+            }
+        )
+    rows.sort(key=lambda r: -abs(r["delta_us"]))
+    return rows
+
+
+def format_diff(rows: List[dict]) -> str:
+    lines = [
+        f"{'kind:name':40} {'base_n':>7} {'new_n':>7} "
+        f"{'base_us':>10} {'new_us':>10} {'delta_us':>10} {'pct':>7}"
+    ]
+    for r in rows:
+        pct = f"{r['delta_pct']:+.1f}%" if r["delta_pct"] is not None else "n/a"
+        lines.append(
+            f"{r['key'][:40]:40} {r['base_count']:>7} {r['new_count']:>7} "
+            f"{r['base_mean_us']:>10.1f} {r['new_mean_us']:>10.1f} "
+            f"{r['delta_us']:>+10.1f} {pct:>7}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:  # console tool
     import argparse
 
     parser = argparse.ArgumentParser(
-        description="convert a tpu_timer .timeline to perfetto JSON"
+        description="tpu_timer timeline tools (convert / merge / diff)"
     )
-    parser.add_argument("timeline")
-    parser.add_argument("output")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_convert = sub.add_parser("convert", help="one ring -> perfetto JSON")
+    p_convert.add_argument("timeline")
+    p_convert.add_argument("output")
+
+    p_merge = sub.add_parser(
+        "merge", help="per-host rings -> ONE perfetto trace with host lanes"
+    )
+    p_merge.add_argument(
+        "inputs",
+        nargs="+",
+        help="HOST=path.timeline (or bare paths, labeled host<i>)",
+    )
+    p_merge.add_argument("-o", "--output", required=True)
+
+    p_diff = sub.add_parser(
+        "diff", help="latency deltas between two runs' rings"
+    )
+    p_diff.add_argument("base")
+    p_diff.add_argument("new")
+    p_diff.add_argument("--json", action="store_true", help="JSON rows")
+
     ns = parser.parse_args(argv)
-    n = convert(ns.timeline, ns.output)
-    print(f"wrote {n} events to {ns.output}")
+    if ns.cmd == "convert":
+        n = convert(ns.timeline, ns.output)
+        print(f"wrote {n} events to {ns.output}")
+    elif ns.cmd == "merge":
+        pairs = []
+        for i, item in enumerate(ns.inputs):
+            host, sep, path = item.partition("=")
+            pairs.append((host, path) if sep else (f"host{i}", item))
+        n = merge(pairs, ns.output)
+        print(f"merged {n} events from {len(pairs)} hosts to {ns.output}")
+    elif ns.cmd == "diff":
+        rows = diff(ns.base, ns.new)
+        print(json.dumps(rows) if ns.json else format_diff(rows))
     return 0
 
 

@@ -89,6 +89,74 @@ class TestDistributedJobManager:
         assert node.status == NodeStatus.INITIAL
         assert node.relaunch_count == 1
 
+    def test_scale_down_releases_highest_ranks_without_relaunch(self):
+        """VERDICT r2 #6: a shrink kills hosts on purpose — their
+        DELETED events must not burn relaunch budget or resurrect
+        them, and the lowest ranks survive (dp shrinks in place)."""
+        m, scaler = self._manager(4)
+        m.start()
+        for nid in range(4):
+            node = get_job_context().get_node(NodeType.WORKER, nid)
+            node.update_status(NodeStatus.RUNNING)
+            get_job_context().update_node(node)
+
+        removed = m.scale_down(2)
+        assert removed == [2, 3]
+        assert m.num_workers == 2
+        shrink_plans = [p for p in scaler.plans if p.remove_nodes]
+        assert shrink_plans[-1].worker_num == 2
+        assert shrink_plans[-1].remove_nodes == [2, 3]
+
+        # the scaler's kill surfaces as DELETED/FAILED — intentional,
+        # so NO launch plan and NO budget burn
+        before = len(scaler.plans)
+        for nid in (2, 3):
+            dead = _worker(nid, NodeStatus.FAILED)
+            dead.exit_reason = NodeExitReason.KILLED
+            m.process_event(
+                NodeEvent(event_type=NodeEventType.DELETED, node=dead)
+            )
+        m.stop()
+        assert not any(p.launch_nodes for p in scaler.plans[before:])
+        node = get_job_context().get_node(NodeType.WORKER, 3)
+        assert node.relaunch_count == 0 and node.is_released
+
+    def test_scale_down_does_not_trip_max_relaunch_abort(self):
+        """Released nodes end FAILED on purpose; with survivor budgets
+        spent they must not read as an abort-worthy failure."""
+        m, scaler = self._manager(3)
+        m.start()
+        for nid in range(3):
+            node = get_job_context().get_node(NodeType.WORKER, nid)
+            node.update_status(NodeStatus.RUNNING)
+            node.relaunch_count = node.max_relaunch_count  # budget spent
+            get_job_context().update_node(node)
+        m.scale_down(2)
+        dead = _worker(2, NodeStatus.FAILED)
+        dead.exit_reason = NodeExitReason.KILLED
+        m.process_event(NodeEvent(event_type=NodeEventType.DELETED, node=dead))
+        assert m.should_early_stop() is None
+        # and no abort action was enqueued while digesting the deletion
+        from dlrover_tpu.master.diagnosis.action import NoAction
+
+        assert isinstance(
+            get_job_context().master_actions.next_action(-1), NoAction
+        )
+        m.stop()
+
+    def test_scale_down_noop_when_target_not_smaller(self):
+        m, scaler = self._manager(2)
+        m.start()
+        for nid in range(2):
+            node = get_job_context().get_node(NodeType.WORKER, nid)
+            node.update_status(NodeStatus.RUNNING)
+            get_job_context().update_node(node)
+        before = len(scaler.plans)
+        assert m.scale_down(2) == []
+        assert m.scale_down(5) == []
+        m.stop()
+        assert len(scaler.plans) == before
+
     def test_fatal_error_not_relaunched(self):
         m, scaler = self._manager(1)
         m.start()
@@ -170,13 +238,14 @@ class TestAutoScaler:
         opt.record_world_size(2)
         plan = opt.generate_plan()
         assert plan.worker_num == 4
-        # 4 hosts: 1.05 steps/s (barely better) → saturated, no growth
+        # 4 hosts: 1.05 steps/s (barely better) → saturated: release
+        # the wasted hosts back to the efficient size (r3 shrink path)
         perf2 = PerfMonitor()
         for i in range(8):
             perf2.collect_global_step(i, now + i / 1.05)
         opt._perf = perf2
         opt.record_world_size(4)
-        assert opt.generate_plan().empty()
+        assert opt.generate_plan().worker_num == 2
 
 
 class TestDiagnosisMaster:

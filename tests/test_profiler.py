@@ -313,3 +313,127 @@ class TestStackDump:
         t.join()
         assert "wedged-collective" in text or "Thread" in text
         assert "test_install_trigger_read_roundtrip" in text
+
+
+def _write_ring(path, records, names=None):
+    """Hand-author a .timeline ring (+.names sidecar) fixture."""
+    import struct
+
+    with open(path, "wb") as f:
+        f.write(b"TPUTL001")
+        for name_id, kind, start, dur, step in records:
+            f.write(struct.Struct("<IIqII").pack(name_id, kind, start, dur, step))
+    if names:
+        with open(str(path) + ".names", "w") as f:
+            for ident, name in names.items():
+                f.write(f"{ident}\t{name}\n")
+
+
+class TestTimelineClusterTools:
+    """VERDICT r2 #8: merge / diff / flamegraph CLI (reference
+    py_xpu_timer/bin xpu_timer_diff + gen_trace_timeline)."""
+
+    def test_merge_gives_one_lane_per_host(self, tmp_path):
+        import json
+
+        from dlrover_tpu.profiler import timeline as tl
+
+        a = tmp_path / "a.timeline"
+        b = tmp_path / "b.timeline"
+        _write_ring(a, [(0, 8, 100, 50, 1)], {0: "exec:step_fn"})
+        _write_ring(b, [(0, 8, 120, 300, 1)], {0: "exec:step_fn"})
+        out = tmp_path / "merged.json"
+        rc = tl.main(
+            ["merge", f"hostA={a}", f"hostB={b}", "-o", str(out)]
+        )
+        assert rc == 0
+        trace = json.loads(out.read_text())["traceEvents"]
+        meta = {e["args"]["name"] for e in trace if e.get("ph") == "M"}
+        assert meta == {"hostA", "hostB"}
+        pids = {e["pid"] for e in trace if e.get("ph") == "X"}
+        assert pids == {0, 1}  # one lane per host
+        # the straggler host's 300us execute is attributable to hostB
+        slow = [e for e in trace if e.get("dur") == 300]
+        assert slow and slow[0]["pid"] == 1
+
+    def test_diff_ranks_regressed_family_first(self, tmp_path, capsys):
+        from dlrover_tpu.profiler import timeline as tl
+
+        base = tmp_path / "base.timeline"
+        new = tmp_path / "new.timeline"
+        names = {0: "exec:train_step", 1: "pjrt_h2d"}
+        _write_ring(
+            base,
+            [(0, 8, 0, 100, 1), (0, 8, 200, 100, 2), (1, 3, 0, 20, 1)],
+            names,
+        )
+        _write_ring(
+            new,
+            [(0, 8, 0, 400, 1), (0, 8, 500, 400, 2), (1, 3, 0, 22, 1)],
+            names,
+        )
+        rows = tl.diff(str(base), str(new))
+        assert rows[0]["key"] == "execute:exec:train_step"
+        assert rows[0]["delta_us"] == 300.0
+        assert rows[0]["delta_pct"] == 300.0
+        # text report prints the regressed family on the first data row
+        assert tl.main(["diff", str(base), str(new)]) == 0
+        out = capsys.readouterr().out
+        assert "execute:exec:train_step" in out.splitlines()[1]
+
+    def test_diff_handles_new_and_vanished_keys(self, tmp_path):
+        from dlrover_tpu.profiler import timeline as tl
+
+        base = tmp_path / "base.timeline"
+        new = tmp_path / "new.timeline"
+        _write_ring(base, [(0, 9, 0, 500, 0)], {0: "pjrt_compile"})
+        _write_ring(new, [(1, 3, 0, 30, 0)], {1: "pjrt_h2d"})
+        rows = tl.diff(str(base), str(new))
+        keys = {r["key"]: r for r in rows}
+        assert keys["compile:pjrt_compile"]["new_count"] == 0
+        assert keys["h2d:pjrt_h2d"]["base_count"] == 0
+        assert keys["h2d:pjrt_h2d"]["delta_pct"] is None
+
+
+FAULTHANDLER_DUMP = '''Thread 0x00007f1122334455 (most recent call first):
+  File "/opt/venv/lib/queue.py", line 171 in get
+  File "/app/loader.py", line 40 in next_batch
+  File "/app/train.py", line 12 in main
+
+Current thread 0x00007f0000000001 (most recent call first):
+  File "/app/util.py", line 5 in spin
+  File "/app/train.py", line 20 in worker
+'''
+
+
+class TestFlamegraph:
+    def test_fold_and_collapsed_output(self, tmp_path):
+        from dlrover_tpu.profiler.flamegraph import (
+            fold,
+            parse_faulthandler,
+            write_collapsed,
+        )
+
+        stacks = parse_faulthandler(FAULTHANDLER_DUMP)
+        assert len(stacks) == 2
+        # root-first: main at the base, the blocking get at the leaf
+        assert stacks[0][0].startswith("main (train.py:12)")
+        assert stacks[0][-1].startswith("get (queue.py:171)")
+
+        # two dumps of the same wedged worker: the stuck stack counts 2
+        counts = fold([FAULTHANDLER_DUMP, FAULTHANDLER_DUMP])
+        stuck = "main (train.py:12);next_batch (loader.py:40);get (queue.py:171)"
+        assert counts[stuck] == 2
+        out = tmp_path / "collapsed.txt"
+        assert write_collapsed(counts, str(out)) == 2
+        lines = out.read_text().splitlines()
+        assert f"{stuck} 2" in lines
+
+    def test_cli(self, tmp_path, capsys):
+        from dlrover_tpu.profiler.flamegraph import main
+
+        d = tmp_path / "w.stacks"
+        d.write_text(FAULTHANDLER_DUMP)
+        out = tmp_path / "c.txt"
+        assert main([str(d), "-o", str(out)]) == 0
+        assert "2 unique stacks" in capsys.readouterr().out

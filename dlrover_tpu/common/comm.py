@@ -378,6 +378,35 @@ class PreCheckResponse:
 
 @register_message
 @dataclass
+class ClusterMetricsRequest:
+    """Every node's last-scraped profiler gauges (profiler daemon)."""
+
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class ClusterMetricsResponse:
+    # {node_id: {gauge_name: value}}
+    node_gauges: Dict[int, Dict[str, float]] = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class ClusterDumpRequest:
+    """Queue a stack dump on every running worker (profiler daemon)."""
+
+    node_id: int = 0
+
+
+@register_message
+@dataclass
+class ClusterDumpResponse:
+    node_ids: List[int] = field(default_factory=list)
+
+
+@register_message
+@dataclass
 class JobStatusRequest:
     node_id: int = 0
 

@@ -1,0 +1,170 @@
+"""Crash-safe event flushing (reference
+``training_event/error_handler.py:26``).
+
+The span/event SDK buffers through ``AsyncExporter`` whose ``atexit``
+close covers clean exits — but a process dying on an unhandled
+exception loses the crash itself (nobody records WHY), and a fatal
+signal (SIGTERM from the scheduler, SIGABRT from a native library)
+skips atexit entirely. The ErrorHandler closes both gaps:
+
+- ``sys.excepthook``: emit one final ``crash`` event with the traceback
+  summary, flush every registered flushable, then chain the original
+  hook (the traceback still prints).
+- fatal signals: flush, then re-deliver to the original handler so
+  existing semantics (the agent's SIGTERM breakpoint save, default
+  kill) are preserved — this handler only FRONT-RUNS the teardown with
+  a flush, it never swallows the signal.
+
+Flushables are (name, fn) pairs — exporter closes, timeline dumps,
+anything that must hit disk before the interpreter dies.
+"""
+
+import signal
+import sys
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from .log import logger
+
+_FATAL_SIGNALS = (signal.SIGTERM, signal.SIGQUIT, signal.SIGABRT)
+
+
+class ErrorHandler:
+    _instance: Optional["ErrorHandler"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._flushables: Dict[str, Callable[[], None]] = {}
+        self._orig_excepthook = None
+        self._orig_signal_handlers: Dict[int, object] = {}
+        self._registered = False
+        self._flushed = False
+
+    @classmethod
+    def singleton(cls) -> "ErrorHandler":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # -- flushables --------------------------------------------------------
+
+    def register_flushable(self, name: str, fn: Callable[[], None]) -> None:
+        self._flushables[name] = fn
+
+    def unregister_flushable(self, name: str) -> None:
+        self._flushables.pop(name, None)
+
+    def flush_all(self) -> List[str]:
+        """Run every flushable once (idempotent per crash); returns the
+        names that ran."""
+        ran = []
+        for name, fn in list(self._flushables.items()):
+            try:
+                fn()
+                ran.append(name)
+            except Exception:  # noqa: BLE001 — flushing must not re-crash
+                logger.exception("crash flush %s failed", name)
+        return ran
+
+    # -- hooks -------------------------------------------------------------
+
+    def _handle_exception(self, exc_type, exc_value, exc_tb) -> None:
+        try:
+            if not self._flushed:
+                self._flushed = True
+                summary = "".join(
+                    traceback.format_exception_only(exc_type, exc_value)
+                ).strip()
+                try:
+                    from .events import global_emitter
+
+                    global_emitter().instant(
+                        "crash",
+                        error=summary[:500],
+                        frame=_last_app_frame(exc_tb),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+                self.flush_all()
+        finally:
+            (self._orig_excepthook or sys.__excepthook__)(
+                exc_type, exc_value, exc_tb
+            )
+
+    def _handle_signal(self, signum, frame) -> None:
+        if not self._flushed:
+            self._flushed = True
+            try:
+                from .events import global_emitter
+
+                global_emitter().instant(
+                    "fatal_signal", signum=int(signum)
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            self.flush_all()
+        self._call_original_handler(signum, frame)
+
+    def _call_original_handler(self, signum, frame) -> None:
+        original = self._orig_signal_handlers.get(signum)
+        if callable(original):
+            original(signum, frame)
+            return
+        if original == signal.SIG_IGN:
+            return
+        # SIG_DFL (or unknown): restore and re-deliver so the process
+        # dies with the true signal disposition/exit status.
+        signal.signal(signum, signal.SIG_DFL)
+        import os
+
+        os.kill(os.getpid(), signum)
+
+    def register(self) -> None:
+        if self._registered:
+            return
+        self._registered = True
+        self._orig_excepthook = sys.excepthook
+        sys.excepthook = self._handle_exception
+        for signum in _FATAL_SIGNALS:
+            try:
+                self._orig_signal_handlers[signum] = signal.signal(
+                    signum, self._handle_signal
+                )
+            except (ValueError, OSError):
+                # not the main thread / unsupported signal
+                self._orig_signal_handlers.pop(signum, None)
+
+    def unregister(self) -> None:
+        if not self._registered:
+            return
+        self._registered = False
+        if self._orig_excepthook is not None:
+            sys.excepthook = self._orig_excepthook
+        for signum, original in self._orig_signal_handlers.items():
+            try:
+                signal.signal(signum, original)
+            except (ValueError, OSError, TypeError):
+                pass
+        self._orig_signal_handlers.clear()
+        self._flushed = False
+
+
+def _last_app_frame(tb) -> str:
+    last = ""
+    for frame, lineno in traceback.walk_tb(tb):
+        last = f"{frame.f_code.co_filename}:{lineno}:{frame.f_code.co_name}"
+    return last
+
+
+def init_error_handler() -> ErrorHandler:
+    """Install the hooks and return the singleton (reference
+    error_handler.py:142). The span SDK's shared exporter is always a
+    flushable; callers add their own (timeline dumps, checkpoints)."""
+    handler = ErrorHandler.singleton()
+    from .events import flush_default_exporter
+
+    handler.register_flushable("events", flush_default_exporter)
+    handler.register()
+    return handler

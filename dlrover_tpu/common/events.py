@@ -202,6 +202,32 @@ class _NullExporter(Exporter):
         pass
 
 
+_global_emitter: Optional[EventEmitter] = None
+
+
+def global_emitter() -> EventEmitter:
+    """Process-scoped emitter for cross-cutting events (crash reports,
+    fatal signals) that belong to no specific subsystem."""
+    global _global_emitter
+    if _global_emitter is None:
+        _global_emitter = EventEmitter("process")
+    return _global_emitter
+
+
+def flush_default_exporter() -> None:
+    """Drain + close the shared async exporter NOW (crash path: the
+    ErrorHandler calls this before the interpreter dies; a fresh
+    exporter is rebuilt lazily if anything emits afterwards)."""
+    global _default
+    with _default_lock:
+        exporter, _default = _default, None
+    if exporter is not None:
+        try:
+            exporter.close()
+        except Exception:  # noqa: BLE001 — crash path
+            logger.debug("default exporter close failed", exc_info=True)
+
+
 # Predefined emitters (reference: training_event/predefined/)
 class AgentEvents:
     def __init__(self):

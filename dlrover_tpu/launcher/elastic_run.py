@@ -320,6 +320,11 @@ class ElasticLaunch:
 
 
 def run(ns: argparse.Namespace) -> int:
+    # Crash-safe span flushing: an agent dying on SIGTERM/exception must
+    # land its buffered events first (reference error_handler.py:26).
+    from ..common.error_handler import init_error_handler
+
+    init_error_handler()
     config = config_from_args(ns)
     master_handle: Optional[LocalMasterHandle] = None
     if ns.standalone and not config.master_addr:

@@ -1,0 +1,6 @@
+from .quota import (  # noqa: F401
+    K8sQuotaChecker,
+    QuotaChecker,
+    StaticQuotaChecker,
+    UnlimitedQuotaChecker,
+)

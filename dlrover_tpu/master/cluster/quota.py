@@ -1,0 +1,112 @@
+"""Cluster quota awareness (reference ``master/cluster/quota.py:18``).
+
+Scale-ups must not ask for hosts the cluster cannot give: a grow plan
+beyond the free quota leaves pending pods that trip the
+pending-timeout abort. The checker answers "how many MORE hosts can
+this job get right now"; the auto-scaler caps grow targets with it.
+"""
+
+from abc import ABC, abstractmethod
+
+from ...common.log import logger
+
+
+class QuotaChecker(ABC):
+    @abstractmethod
+    def get_free_node_num(self) -> int:
+        """Hosts the cluster could schedule for this job right now."""
+
+
+class UnlimitedQuotaChecker(QuotaChecker):
+    """Default: the platform will make room (autoscaling node pools)."""
+
+    def get_free_node_num(self) -> int:
+        return 1 << 30
+
+
+class StaticQuotaChecker(QuotaChecker):
+    """Fixed reservation (on-prem slice pools, test rigs)."""
+
+    def __init__(self, free_nodes: int):
+        self._free = max(0, int(free_nodes))
+
+    def set_free_node_num(self, free_nodes: int) -> None:
+        self._free = max(0, int(free_nodes))
+
+    def get_free_node_num(self) -> int:
+        return self._free
+
+
+class K8sQuotaChecker(QuotaChecker):
+    """Free TPU hosts = schedulable nodes carrying the TPU resource
+    minus nodes already running a TPU-requesting pod. Coarse (node
+    granularity — TPU hosts are not fractionally shared), which matches
+    how slices schedule."""
+
+    TPU_RESOURCE = "google.com/tpu"
+
+    def __init__(self, client=None, namespace: str = "default"):
+        if client is None:
+            from ...scheduler.kubernetes import k8sClient
+
+            client = k8sClient.singleton(namespace)
+        self._client = client
+
+    def get_free_node_num(self) -> int:
+        try:
+            nodes = self._client.list_nodes()
+            pods = self._client.list_all_pods()
+        except Exception:  # noqa: BLE001 — degrade to "don't block"
+            logger.exception("quota query failed; assuming unlimited")
+            return 1 << 30
+        tpu_nodes = set()
+        for node in nodes or []:
+            alloc = (
+                getattr(node.status, "allocatable", None) or {}
+                if hasattr(node, "status")
+                else node.get("status", {}).get("allocatable", {})
+            )
+            name = (
+                node.metadata.name
+                if hasattr(node, "metadata")
+                else node.get("metadata", {}).get("name", "")
+            )
+            unschedulable = (
+                getattr(node.spec, "unschedulable", False)
+                if hasattr(node, "spec")
+                else node.get("spec", {}).get("unschedulable", False)
+            )
+            if not unschedulable and self.TPU_RESOURCE in (alloc or {}):
+                tpu_nodes.add(name)
+        busy = set()
+        for pod in pods or []:
+            phase = (
+                getattr(getattr(pod, "status", None), "phase", "")
+                if hasattr(pod, "status")
+                else pod.get("status", {}).get("phase", "")
+            )
+            if phase in ("Succeeded", "Failed"):
+                continue  # terminated pods no longer hold the device
+            spec = (
+                pod.spec if hasattr(pod, "spec") else pod.get("spec", {})
+            )
+            node_name = (
+                getattr(spec, "node_name", "")
+                if hasattr(pod, "spec")
+                else spec.get("nodeName", "")
+            )
+            containers = (
+                getattr(spec, "containers", [])
+                if hasattr(pod, "spec")
+                else spec.get("containers", [])
+            )
+            for c in containers or []:
+                limits = (
+                    (getattr(c, "resources", None) and c.resources.limits)
+                    if hasattr(c, "resources")
+                    else c.get("resources", {}).get("limits", {})
+                ) or {}
+                if self.TPU_RESOURCE in limits and node_name:
+                    busy.add(node_name)
+                    break
+        return max(0, len(tpu_nodes - busy))

@@ -76,6 +76,7 @@ class DistributedJobMaster:
         job_name: str = "job",
         pre_check_ops: Optional[List[PreCheckOperator]] = None,
         fresh_context: bool = True,
+        quota=None,
     ):
         ctx = get_context()
         if fresh_context:
@@ -187,6 +188,9 @@ class DistributedJobMaster:
         def _exclude_straggler(node_id: int) -> None:
             self.job_manager.migrate_straggler(node_id)
 
+        self._training_rdzv = training_rdzv
+        self._node_unit = node_unit
+
         def _scale_down(target: int) -> None:
             # Drain path: mark the released nodes intentional (no
             # relaunch-budget burn), kill through the scaler, and drop
@@ -225,6 +229,7 @@ class DistributedJobMaster:
                     )
                 )
 
+        self.scale_down = _scale_down
         self.auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=scaler,
@@ -235,6 +240,7 @@ class DistributedJobMaster:
             strategy_generator=strategy,
             straggler_handler=_exclude_straggler,
             shrink_handler=_scale_down,
+            quota=quota,
         )
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
@@ -362,9 +368,12 @@ class DistributedJobMaster:
             owner_uid=os.environ.get("DLROVER_JOB_UID", ""),
         )
         watcher = PodWatcher(job_name, namespace_name)
+        from .cluster import K8sQuotaChecker
+
         master = cls(
             scaler=scaler,
             watcher=watcher,
+            quota=K8sQuotaChecker(namespace=namespace_name),
             port=namespace.port,
             num_workers=namespace.num_workers,
             max_workers=getattr(namespace, "max_workers", 0),
@@ -377,7 +386,7 @@ class DistributedJobMaster:
         from .watcher.k8s_watcher import ElasticJobWatcher, ScalePlanWatcher
 
         master.scaleplan_watcher = ScalePlanWatcher(
-            job_name, scaler.scale, namespace_name
+            job_name, master.execute_scale_plan, namespace_name
         )
         master.elasticjob_watcher = ElasticJobWatcher(
             job_name, master.job_manager, namespace_name
@@ -385,3 +394,80 @@ class DistributedJobMaster:
         master.scaleplan_watcher.start()
         master.elasticjob_watcher.start()
         return master
+
+    def execute_scale_plan(self, plan) -> None:
+        """Manual/operator scaling entry (ScalePlan CRs): a shrink must
+        take the SAME drain path the auto-scaler uses — a raw
+        scaler.scale would kill pods that still read as failures,
+        burning relaunch budget and resurrecting the removed nodes.
+
+        ``replicas: 0`` means suspend (tear down without failing, keep
+        the job resumable) — releasing EVERY worker through scale_down
+        would leave a zombie with no completion path. Plans carrying
+        explicit removals/launches keep the operator's node choices and
+        go to the scaler directly."""
+        if plan.worker_num == 0 and not plan.remove_nodes:
+            self.job_manager.suspend()
+            return
+        current = self._training_rdzv.world_size()
+        if (
+            0 < plan.worker_num < current
+            and not plan.launch_nodes
+            and not plan.remove_nodes
+        ):
+            self.scale_down(plan.worker_num)
+            return
+        self.job_manager._scaler.scale(plan)
+
+    @classmethod
+    def from_ray_args(cls, namespace, ray_module=None) -> "DistributedJobMaster":
+        """Build for the Ray platform (reference servicer.py:800
+        RayMasterServicer + ray_scaler.py:39): nodes are detached
+        AgentActors; the agent command inside each actor is the same
+        tpurun entrypoint every other platform runs."""
+        import os
+        import shlex
+
+        from ..scheduler.ray import RayClient
+        from .scaler.ray_scaler import ActorScaler
+        from .watcher.ray_watcher import ActorWatcher
+
+        job_name = namespace.job_name
+        client = RayClient(
+            namespace=os.environ.get("RAY_JOB_NAMESPACE", job_name),
+            job_name=job_name,
+            ray_module=ray_module,
+            address=os.environ.get("RAY_ADDRESS", "auto"),
+        )
+        command = shlex.split(os.environ.get("DLROVER_WORKER_COMMAND", ""))
+        if not command:
+            # Unlike k8s (empty command -> image CMD), an actor's argv
+            # can never be empty; failing fast beats a relaunch storm of
+            # actors dying on Popen([]).
+            raise SystemExit(
+                "the Ray platform needs DLROVER_WORKER_COMMAND set to "
+                "the per-host agent command (e.g. 'tpurun ... train.py')"
+            )
+        resources = {}
+        tpu_per_host = os.environ.get("DLROVER_TPU_PER_HOST", "")
+        if tpu_per_host:
+            resources["TPU"] = float(tpu_per_host)
+        scaler = ActorScaler(
+            client,
+            command=command,
+            master_addr=os.environ.get("DLROVER_MASTER_SERVICE_ADDR", ""),
+            job_name=job_name,
+            num_workers=namespace.num_workers,
+            resources_per_node=resources,
+        )
+        watcher = ActorWatcher(scaler)
+        return cls(
+            scaler=scaler,
+            watcher=watcher,
+            port=namespace.port,
+            num_workers=namespace.num_workers,
+            max_workers=getattr(namespace, "max_workers", 0),
+            node_unit=namespace.node_unit,
+            service_type=namespace.service_type,
+            job_name=job_name,
+        )

@@ -16,11 +16,18 @@ from .local_master import LocalJobMaster
 def run(namespace) -> int:
     from ..common.config import get_context
     from ..common.constants import PlatformType
+    from ..common.error_handler import init_error_handler
+
+    init_error_handler()
 
     if getattr(namespace, "brain_addr", ""):
         get_context().brain_addr = namespace.brain_addr
 
-    if namespace.platform in (PlatformType.KUBERNETES, PlatformType.GKE_TPU):
+    if namespace.platform in (
+        PlatformType.KUBERNETES,
+        PlatformType.GKE_TPU,
+        PlatformType.RAY,
+    ):
         try:
             from .dist_master import DistributedJobMaster
         except ImportError as e:
@@ -28,7 +35,10 @@ def run(namespace) -> int:
                 f"platform {namespace.platform!r} needs the distributed "
                 f"master, which failed to import: {e}"
             )
-        master = DistributedJobMaster.from_args(namespace)
+        if namespace.platform == PlatformType.RAY:
+            master = DistributedJobMaster.from_ray_args(namespace)
+        else:
+            master = DistributedJobMaster.from_args(namespace)
     else:
         master = LocalJobMaster(
             port=namespace.port,

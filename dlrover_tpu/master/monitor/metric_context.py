@@ -44,6 +44,13 @@ class JobMetricContext:
             node.gauges.update(gauges)
             node.updated_at = time.time()
 
+    def all_gauges(self) -> Dict[int, Dict[str, float]]:
+        """{node_id: gauges} snapshot (profiler daemon aggregation)."""
+        with self._mu:
+            return {
+                nid: dict(node.gauges) for nid, node in self._nodes.items()
+            }
+
     def gauge(self, node_id: int, name: str, default: float = 0.0) -> float:
         with self._mu:
             node = self._nodes.get(node_id)

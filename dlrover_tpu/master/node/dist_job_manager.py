@@ -112,7 +112,17 @@ class DistributedJobManager(JobManager):
                 self._relaunch_node(node, allowed=relaunch)
         else:
             current = self._job_ctx.get_node(node.node_type, node.node_id)
-            if current is not None:
+            if (
+                current is not None
+                and self._scaled_out(current)
+                and current.exited()
+            ):
+                # A released id re-materialized (grow after a shrink):
+                # the stale terminal record would make the fresh worker
+                # a ghost (excluded from completion, never relaunched).
+                # Adopt the event node as a brand-new incarnation.
+                self._job_ctx.update_node(node)
+            elif current is not None:
                 current.update_status(node.status)
                 self._job_ctx.update_node(current)
             else:
@@ -218,6 +228,11 @@ class DistributedJobManager(JobManager):
         for node in removed:
             node.is_released = True
             node.relaunchable = False
+            # Terminal NOW: the process/Ray scalers drop the handle
+            # synchronously, so no DELETED event ever arrives for these
+            # — a record stuck in RUNNING would defeat the
+            # grow-after-shrink adoption (which requires exited()).
+            node.update_status(NodeStatus.DELETED)
             self._job_ctx.update_node(node)
             ids.append(node.node_id)
         self.num_workers = target
@@ -256,7 +271,11 @@ class DistributedJobManager(JobManager):
         # Reset node bookkeeping: suspension marked every node released,
         # and a released node is never relaunchable — without this, a
         # post-resume crash would leave the job permanently short.
+        # Scale-down casualties keep their marker: resume must not turn
+        # an intentional removal back into an abort-worthy FAILED.
         for node in self._job_ctx.get_nodes(NodeType.WORKER).values():
+            if self._scaled_out(node):
+                continue
             node.is_released = False
             node.update_status(NodeStatus.PENDING)
             self._job_ctx.update_node(node)

@@ -34,6 +34,7 @@ class JobAutoScaler:
         strategy_generator=None,
         straggler_handler=None,
         shrink_handler=None,
+        quota=None,
     ):
         self._ctx = get_context()
         self._job_ctx = get_job_context()
@@ -55,6 +56,10 @@ class JobAutoScaler:
         # the kill, and the rendezvous bounds must drop, so the shrink
         # routes through the job manager instead of the raw scaler.
         self._shrink_handler = shrink_handler
+        # Cluster quota (reference master/cluster/quota.py): grow plans
+        # are capped at what the cluster can actually schedule, so the
+        # job never parks pending pods into the pending-timeout abort.
+        self._quota = quota
         self._excluded_stragglers: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -93,6 +98,19 @@ class JobAutoScaler:
                 )
                 self._shrink_handler(target)
                 return
+            if target > current > 0 and self._quota is not None:
+                free = self._quota.get_free_node_num()
+                capped = current + (free // self._unit) * self._unit
+                if capped < target:
+                    logger.info(
+                        "quota caps grow %s -> %s (free hosts: %s)",
+                        target,
+                        capped,
+                        free,
+                    )
+                    target = capped
+                if target <= current:
+                    return
             logger.info("auto-scale to %s workers", target)
             self._scaler.scale(ScalePlan(worker_num=target))
 

@@ -1,0 +1,108 @@
+"""ActorScaler: execute ScalePlans as Ray actor create/kill.
+
+Reference: ``dlrover/python/master/scaler/ray_scaler.py:39``
+(ActorScaler). Same reconcile discipline as the ProcessScaler — the
+plan's ``worker_num`` is the target, ``remove_nodes`` kill by id,
+``launch_nodes`` materialize replacements; dead actors are NOT
+resurrected here (the watcher reports DELETED and the job manager
+decides the relaunch, keeping budget accounting in one place).
+"""
+
+from typing import Dict, List, Optional
+
+from ...common.constants import NodeEnv
+from ...common.log import logger
+from ...scheduler.ray import RayClient, RayElasticJob
+from .base_scaler import ScalePlan, Scaler
+
+
+class ActorScaler(Scaler):
+    def __init__(
+        self,
+        client: RayClient,
+        command: List[str],
+        env: Optional[Dict[str, str]] = None,
+        master_addr: str = "",
+        job_name: str = "job",
+        num_workers: int = 1,
+        num_cpus_per_node: float = 1.0,
+        resources_per_node: Optional[Dict[str, float]] = None,
+    ):
+        super().__init__(job_name)
+        self._client = client
+        self._job = RayElasticJob(job_name)
+        self._command = list(command)
+        self._env = dict(env or {})
+        self._master_addr = master_addr
+        self._target = num_workers
+        self._num_cpus = num_cpus_per_node
+        self._resources = dict(resources_per_node or {})
+        # node_id -> actor name for every node this scaler materialized
+        self._actors: Dict[int, str] = {}
+
+    def actor_name(self, node_id: int) -> str:
+        return self._job.get_node_name("worker", node_id)
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            if plan.worker_num >= 0:
+                self._target = plan.worker_num
+            for node_id in plan.remove_nodes:
+                self._kill_node(node_id)
+            for node in plan.launch_nodes:
+                self._launch_node(node.node_id, node.rank_index)
+            self._reconcile()
+
+    def _reconcile(self) -> None:
+        for rank in range(self._target):
+            if rank not in self._actors:
+                self._launch_node(rank, rank)
+        for node_id in [n for n in sorted(self._actors) if n >= self._target]:
+            self._kill_node(node_id)
+
+    def _launch_node(self, node_id: int, node_rank: int) -> None:
+        name = self.actor_name(node_id)
+        if self._client.get_actor(name) is not None:
+            # replacement of a live/stale incarnation: clear it first
+            self._client.kill_actor(name)
+        env = dict(self._env)
+        env[NodeEnv.MASTER_ADDR] = self._master_addr
+        env[NodeEnv.JOB_NAME] = self._job_name
+        env[NodeEnv.NODE_ID] = str(node_id)
+        env[NodeEnv.NODE_RANK] = str(node_rank)
+        try:
+            self._client.create_actor(
+                name,
+                self._command,
+                env,
+                num_cpus=self._num_cpus,
+                resources=self._resources or None,
+            )
+            self._actors[node_id] = name
+        except Exception:  # noqa: BLE001 — surfaced via watcher absence
+            logger.exception("failed to create ray actor %s", name)
+
+    def _kill_node(self, node_id: int) -> None:
+        name = self._actors.pop(node_id, None) or self.actor_name(node_id)
+        self._client.kill_actor(name)
+
+    def snapshot(self) -> Dict[int, Optional[int]]:
+        """{node_id: None while alive, exit code after} — the watcher's
+        poll source (absent actors report rc -1)."""
+        with self._lock:
+            items = dict(self._actors)
+        out: Dict[int, Optional[int]] = {}
+        for node_id, name in items.items():
+            state, rc = self._client.actor_poll(name)
+            if state == "alive":
+                out[node_id] = None
+            elif state == "exited":
+                out[node_id] = int(rc)
+            else:  # absent: the actor died or was externally removed
+                out[node_id] = -1
+        return out
+
+    def stop(self) -> None:
+        with self._lock:
+            for node_id in list(self._actors):
+                self._kill_node(node_id)

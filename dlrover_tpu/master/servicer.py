@@ -246,6 +246,38 @@ class MasterServicer:
             reason=self._job_ctx.pre_check_reason,
         )
 
+    def _cluster_metrics(
+        self, msg: comm.ClusterMetricsRequest
+    ) -> comm.ClusterMetricsResponse:
+        from .monitor.metric_context import get_metric_context
+
+        return comm.ClusterMetricsResponse(
+            node_gauges=get_metric_context().all_gauges()
+        )
+
+    def _cluster_dump(
+        self, msg: comm.ClusterDumpRequest
+    ) -> comm.ClusterDumpResponse:
+        """Cluster-wide stack dumps (reference hosting service dump
+        coordination): one STACK_DUMP action per running worker; the
+        agents signal their trainers and report the tracebacks back."""
+        from ..common.constants import NodeStatus, NodeType
+        from .diagnosis.action import DiagnosisActionType, NodeAction
+
+        dumped = []
+        for node in self._job_ctx.get_nodes(NodeType.WORKER).values():
+            if node.status != NodeStatus.RUNNING:
+                continue
+            self._job_ctx.node_actions.add_action(
+                NodeAction(
+                    node_id=node.node_id,
+                    action_type=DiagnosisActionType.STACK_DUMP,
+                    reason="cluster_dump",
+                )
+            )
+            dumped.append(node.node_id)
+        return comm.ClusterDumpResponse(node_ids=sorted(dumped))
+
     def _job_status(self, msg: comm.JobStatusRequest) -> comm.JobStatusResponse:
         goodput = sps = 0.0
         last_step = 0
@@ -310,6 +342,8 @@ class MasterServicer:
         comm.CheckpointStepSync: _ckpt_sync,
         comm.PreCheckRequest: _pre_check,
         comm.JobStatusRequest: _job_status,
+        comm.ClusterMetricsRequest: _cluster_metrics,
+        comm.ClusterDumpRequest: _cluster_dump,
         comm.ParallelConfigRequest: _paral_config,
         comm.ElasticRunConfigRequest: _run_config,
         comm.SyncJoin: _sync_join,

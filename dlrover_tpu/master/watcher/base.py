@@ -1,8 +1,16 @@
 """NodeWatcher ABC (reference master/watcher/k8s_watcher.py shape)."""
 
+import threading
+import time
 from abc import ABC, abstractmethod
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional
 
+from ...common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
 from ...common.node import Node, NodeEvent
 
 
@@ -17,3 +25,66 @@ class NodeWatcher(ABC):
 
     def stop(self) -> None:
         pass
+
+
+class SnapshotWatcher(NodeWatcher):
+    """Shared poll-based watcher over any scaler exposing
+    ``snapshot() -> {node_id: None | exit_code}`` (ProcessScaler,
+    ActorScaler). Emits ADDED when an id appears alive and DELETED when
+    it exits, mapping exit codes to node status/exit-reason — so the
+    job manager's event path is identical across platforms."""
+
+    def __init__(self, scaler, poll_interval_s: float = 1.0):
+        self._scaler = scaler
+        self._interval = poll_interval_s
+        self._stopped = threading.Event()
+        self._known: Dict[int, Optional[int]] = {}
+
+    def watch(self) -> Iterator[NodeEvent]:
+        while not self._stopped.is_set():
+            snapshot = self._scaler.snapshot()
+            for node_id, rc in snapshot.items():
+                prev = self._known.get(node_id, "absent")
+                if prev == "absent" and rc is None:
+                    yield self._event(node_id, NodeEventType.ADDED, rc)
+                elif (prev == "absent" or prev is None) and rc is not None:
+                    yield self._event(node_id, NodeEventType.DELETED, rc)
+                self._known[node_id] = rc
+            for gone in set(self._known) - set(snapshot):
+                del self._known[gone]
+            time.sleep(self._interval)
+
+    def _event(
+        self, node_id: int, event_type: str, returncode: Optional[int]
+    ) -> NodeEvent:
+        if event_type == NodeEventType.DELETED:
+            status = NodeStatus.FAILED if returncode else NodeStatus.SUCCEEDED
+        else:
+            status = NodeStatus.RUNNING
+        node = Node(
+            node_type=NodeType.WORKER,
+            node_id=node_id,
+            rank_index=node_id,
+            status=status,
+        )
+        if event_type == NodeEventType.DELETED and returncode:
+            node.exit_reason = (
+                NodeExitReason.KILLED
+                if returncode < 0
+                else NodeExitReason.FATAL_ERROR
+            )
+        return NodeEvent(event_type=event_type, node=node)
+
+    def list(self) -> List[Node]:
+        return [
+            Node(
+                node_type=NodeType.WORKER,
+                node_id=nid,
+                rank_index=nid,
+                status=NodeStatus.RUNNING if rc is None else NodeStatus.FAILED,
+            )
+            for nid, rc in self._scaler.snapshot().items()
+        ]
+
+    def stop(self) -> None:
+        self._stopped.set()

@@ -1,71 +1,16 @@
 """Watcher over the ProcessScaler's local node processes.
 
-Local analogue of ``PodWatcher`` (reference k8s_watcher.py:251): polls
-the process table and emits DELETED events when a node process dies, so
-the job manager's event path (watch → _should_relaunch → ScalePlan) is
-identical across platforms.
+Local analogue of ``PodWatcher`` (reference k8s_watcher.py:251): the
+shared :class:`SnapshotWatcher` polls the process table and emits
+DELETED events when a node process dies, so the job manager's event
+path (watch → _should_relaunch → ScalePlan) is identical across
+platforms.
 """
 
-import threading
-import time
-from typing import Dict, Iterator, List, Optional
-
-from ...common.constants import NodeEventType, NodeExitReason, NodeStatus, NodeType
-from ...common.node import Node, NodeEvent
 from ..scaler.process_scaler import ProcessScaler
-from .base import NodeWatcher
+from .base import SnapshotWatcher
 
 
-class ProcessWatcher(NodeWatcher):
+class ProcessWatcher(SnapshotWatcher):
     def __init__(self, scaler: ProcessScaler, poll_interval_s: float = 1.0):
-        self._scaler = scaler
-        self._interval = poll_interval_s
-        self._stopped = threading.Event()
-        self._known: Dict[int, Optional[int]] = {}
-
-    def watch(self) -> Iterator[NodeEvent]:
-        while not self._stopped.is_set():
-            snapshot = self._scaler.snapshot()
-            for node_id, rc in snapshot.items():
-                prev = self._known.get(node_id, "absent")
-                if prev == "absent" and rc is None:
-                    yield self._event(node_id, NodeEventType.ADDED, rc)
-                elif (prev == "absent" or prev is None) and rc is not None:
-                    yield self._event(node_id, NodeEventType.DELETED, rc)
-                self._known[node_id] = rc
-            for gone in set(self._known) - set(snapshot):
-                del self._known[gone]
-            time.sleep(self._interval)
-
-    def _event(
-        self, node_id: int, event_type: str, returncode: Optional[int]
-    ) -> NodeEvent:
-        if event_type == NodeEventType.DELETED:
-            status = NodeStatus.FAILED if returncode else NodeStatus.SUCCEEDED
-        else:
-            status = NodeStatus.RUNNING
-        node = Node(
-            node_type=NodeType.WORKER,
-            node_id=node_id,
-            rank_index=node_id,
-            status=status,
-        )
-        if event_type == NodeEventType.DELETED and returncode:
-            node.exit_reason = (
-                NodeExitReason.KILLED if returncode < 0 else NodeExitReason.FATAL_ERROR
-            )
-        return NodeEvent(event_type=event_type, node=node)
-
-    def list(self) -> List[Node]:
-        return [
-            Node(
-                node_type=NodeType.WORKER,
-                node_id=nid,
-                rank_index=nid,
-                status=NodeStatus.RUNNING if rc is None else NodeStatus.FAILED,
-            )
-            for nid, rc in self._scaler.snapshot().items()
-        ]
-
-    def stop(self) -> None:
-        self._stopped.set()
+        super().__init__(scaler, poll_interval_s)

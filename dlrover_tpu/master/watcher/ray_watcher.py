@@ -1,0 +1,16 @@
+"""Watcher over the ActorScaler's Ray actors.
+
+Reference: ``dlrover/python/master/watcher/ray_watcher.py``
+(ActorWatcher). The actual state machine is the shared
+:class:`SnapshotWatcher` (same contract as the ProcessWatcher), so the
+job manager's event path (watch → relaunch decision → ScalePlan) is
+identical across the process, k8s, and Ray platforms.
+"""
+
+from ..scaler.ray_scaler import ActorScaler
+from .base import SnapshotWatcher
+
+
+class ActorWatcher(SnapshotWatcher):
+    def __init__(self, scaler: ActorScaler, poll_interval_s: float = 1.0):
+        super().__init__(scaler, poll_interval_s)

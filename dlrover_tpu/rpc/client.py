@@ -338,6 +338,12 @@ class MasterClient:
     def get_job_status(self) -> comm.JobStatusResponse:
         return self.get(comm.JobStatusRequest(node_id=self.node_id))
 
+    def get_cluster_metrics(self) -> comm.ClusterMetricsResponse:
+        return self.get(comm.ClusterMetricsRequest(node_id=self.node_id))
+
+    def trigger_cluster_dump(self) -> comm.ClusterDumpResponse:
+        return self.get(comm.ClusterDumpRequest(node_id=self.node_id))
+
     def get_paral_config(self) -> comm.ParallelConfig:
         return self.get(comm.ParallelConfigRequest(node_id=self.node_id))
 

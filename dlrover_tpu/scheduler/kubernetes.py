@@ -134,6 +134,19 @@ class k8sClient:
             logger.error("list pods failed: %s", e)
             return []
 
+    def list_nodes(self) -> List[Any]:
+        """Cluster nodes (quota checker input)."""
+        return self.core.list_node().items
+
+    def list_all_pods(self) -> List[Any]:
+        """Live pods across namespaces (quota checker input: TPU hosts
+        busy with ANY job's pods are not free). Terminated pods are
+        filtered server-side — they no longer hold devices, and on a
+        big cluster the unfiltered list is megabytes per call."""
+        return self.core.list_pod_for_all_namespaces(
+            field_selector="status.phase!=Succeeded,status.phase!=Failed"
+        ).items
+
     def watch_pods(self, label_selector: str, timeout_s: int = 60):
         w = k8s_watch.Watch()
         return w.stream(

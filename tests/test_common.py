@@ -1,6 +1,7 @@
 """Tests for dlrover_tpu.common: serialization, node model, config, events."""
 
 import dataclasses
+import os
 
 import pytest
 
@@ -168,3 +169,107 @@ class TestSerializeEscaping:
         assert res.memory_mb == 8192
         res = NodeResource.resource_str_to_node_resource("memory=2G")
         assert res.memory_mb == 2000
+
+
+class TestErrorHandler:
+    """Crash-safe event flushing (reference error_handler.py:26)."""
+
+    def test_excepthook_flushes_and_chains(self):
+        import sys
+
+        from dlrover_tpu.common.error_handler import ErrorHandler
+
+        handler = ErrorHandler()
+        flushed = []
+        chained = []
+        handler.register_flushable("x", lambda: flushed.append(1))
+        orig = sys.excepthook
+        sys.excepthook = lambda *a: chained.append(a)
+        try:
+            handler.register()
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+            assert flushed == [1]
+            assert chained and chained[0][0] is ValueError
+        finally:
+            handler.unregister()
+            sys.excepthook = orig
+
+    def test_flush_failure_does_not_block_others(self):
+        from dlrover_tpu.common.error_handler import ErrorHandler
+
+        handler = ErrorHandler()
+        ran = []
+        handler.register_flushable("bad", lambda: 1 / 0)
+        handler.register_flushable("good", lambda: ran.append(1))
+        assert "good" in handler.flush_all()
+        assert ran == [1]
+
+    def test_fatal_signal_flushes_then_dies_with_signal(self, tmp_path):
+        """SIGTERM: the flushable lands on disk, then the ORIGINAL
+        disposition kills the process (exit -15), in a real child."""
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        marker = tmp_path / "flushed"
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                (
+                    "import sys, time, pathlib\n"
+                    "sys.path.insert(0, %r)\n"
+                    "from dlrover_tpu.common.error_handler import "
+                    "init_error_handler\n"
+                    "h = init_error_handler()\n"
+                    "h.register_flushable('m', lambda: pathlib.Path(%r)"
+                    ".write_text('flushed'))\n"
+                    "print('READY', flush=True)\n"
+                    "time.sleep(60)\n"
+                )
+                % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   str(marker)),
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            assert child.stdout.readline().strip() == "READY"
+            child.send_signal(signal.SIGTERM)
+            rc = child.wait(timeout=15)
+            assert rc == -signal.SIGTERM  # true disposition preserved
+            deadline = time.time() + 5
+            while time.time() < deadline and not marker.exists():
+                time.sleep(0.1)
+            assert marker.read_text() == "flushed"
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    def test_crash_event_written_to_event_dir(self, tmp_path):
+        """An unhandled exception leaves a 'crash' event on disk."""
+        import subprocess
+        import sys
+
+        env = dict(os.environ, DLROVER_EVENT_DIR=str(tmp_path))
+        code = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from dlrover_tpu.common.error_handler import init_error_handler\n"
+            "init_error_handler()\n"
+            "raise RuntimeError('the crash reason')\n"
+        ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode != 0
+        assert "the crash reason" in r.stderr  # original hook chained
+        contents = "".join(
+            p.read_text() for p in tmp_path.glob("events*")
+        )
+        assert '"crash"' in contents and "the crash reason" in contents

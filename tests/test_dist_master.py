@@ -321,3 +321,101 @@ class TestDiagnosisMaster:
         dm.observe_once()
         assert ctx.node_actions.next_action(0).action_type == "no_action"
         JobMetricContext.reset()
+
+
+class TestQuotaAwareScaling:
+    """Cluster quota caps grow plans (reference master/cluster/quota.py)."""
+
+    def test_grow_capped_by_free_nodes(self):
+        from dlrover_tpu.master.cluster import StaticQuotaChecker
+
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=ThroughputScalingOptimizer(PerfMonitor(), max_workers=8),
+            scaler=scaler,
+            max_workers=8,
+            world_size_fn=lambda: 2,
+            quota=StaticQuotaChecker(1),
+        )
+        auto.execute_job_optimization_plan(ResourcePlan(worker_num=6))
+        # wanted +4, cluster has 1 free -> grow to 3, not 6
+        assert scaler.plans[-1].worker_num == 3
+
+    def test_no_free_quota_suppresses_grow(self):
+        from dlrover_tpu.master.cluster import StaticQuotaChecker
+
+        scaler = RecordingScaler()
+        auto = JobAutoScaler(
+            optimizer=ThroughputScalingOptimizer(PerfMonitor(), max_workers=8),
+            scaler=scaler,
+            max_workers=8,
+            world_size_fn=lambda: 2,
+            quota=StaticQuotaChecker(0),
+        )
+        auto.execute_job_optimization_plan(ResourcePlan(worker_num=4))
+        assert scaler.plans == []
+
+    def test_k8s_checker_counts_idle_tpu_hosts(self):
+        from dlrover_tpu.master.cluster import K8sQuotaChecker
+
+        class FakeClient:
+            def list_nodes(self):
+                return [
+                    {  # schedulable TPU host, idle
+                        "metadata": {"name": "tpu-a"},
+                        "spec": {},
+                        "status": {"allocatable": {"google.com/tpu": "4"}},
+                    },
+                    {  # TPU host already running a TPU pod
+                        "metadata": {"name": "tpu-b"},
+                        "spec": {},
+                        "status": {"allocatable": {"google.com/tpu": "4"}},
+                    },
+                    {  # cordoned TPU host
+                        "metadata": {"name": "tpu-c"},
+                        "spec": {"unschedulable": True},
+                        "status": {"allocatable": {"google.com/tpu": "4"}},
+                    },
+                    {  # CPU-only node
+                        "metadata": {"name": "cpu-a"},
+                        "spec": {},
+                        "status": {"allocatable": {"cpu": "8"}},
+                    },
+                ]
+
+            def list_all_pods(self):
+                return [
+                    {
+                        "spec": {
+                            "nodeName": "tpu-b",
+                            "containers": [
+                                {
+                                    "resources": {
+                                        "limits": {"google.com/tpu": "4"}
+                                    }
+                                }
+                            ],
+                        }
+                    },
+                    {  # CPU pod on the idle TPU host does not occupy it
+                        "spec": {
+                            "nodeName": "tpu-a",
+                            "containers": [{"resources": {"limits": {}}}],
+                        }
+                    },
+                ]
+
+        checker = K8sQuotaChecker(client=FakeClient())
+        assert checker.get_free_node_num() == 1
+
+    def test_k8s_checker_degrades_open_on_api_error(self):
+        from dlrover_tpu.master.cluster import K8sQuotaChecker
+
+        class BrokenClient:
+            def list_nodes(self):
+                raise RuntimeError("apiserver down")
+
+            def list_all_pods(self):
+                return []
+
+        assert K8sQuotaChecker(client=BrokenClient()).get_free_node_num() > 1e6

@@ -82,6 +82,17 @@ class FakeK8sClient:
         return True
 
 
+@pytest.fixture(autouse=True)
+def fresh_job_context():
+    """Tests here build managers on the GLOBAL job context; stale nodes
+    from earlier (e2e) tests must not leak into suspend/scale plans."""
+    from dlrover_tpu.master.job_context import JobContext
+
+    JobContext.reset()
+    yield
+    JobContext.reset()
+
+
 @pytest.fixture()
 def fake_client(monkeypatch):
     client = FakeK8sClient()

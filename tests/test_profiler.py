@@ -5,6 +5,7 @@ reference's test model is xpu_timer/test/common_test.cc plus the
 collector parser tests in dlrover/python/tests.
 """
 
+import json
 import os
 import time
 import urllib.request
@@ -437,3 +438,98 @@ class TestFlamegraph:
         out = tmp_path / "c.txt"
         assert main([str(d), "-o", str(out)]) == 0
         assert "2 unique stacks" in capsys.readouterr().out
+
+
+class TestProfilerDaemon:
+    """Rank-0 cluster helper service (reference
+    hosting_service_server_client.cc): one Prometheus target for the
+    whole job + cluster-wide dump coordination, against a LIVE master."""
+
+    @pytest.fixture()
+    def live(self):
+        from dlrover_tpu.master.job_context import JobContext
+        from dlrover_tpu.master.local_master import LocalJobMaster
+        from dlrover_tpu.master.monitor.metric_context import (
+            JobMetricContext,
+        )
+        from dlrover_tpu.rpc.client import MasterClient
+
+        JobContext.reset()
+        JobMetricContext.reset()
+        master = LocalJobMaster(num_workers=2, fresh_context=True)
+        master.prepare()
+        client = MasterClient(master_addr=master.addr, node_id=-1)
+        yield master, client
+        master.stop()
+        JobContext.reset()
+        JobMetricContext.reset()
+
+    def test_metrics_aggregated_with_node_labels(self, live):
+        import urllib.request
+
+        from dlrover_tpu.master.monitor.metric_context import (
+            get_metric_context,
+        )
+        from dlrover_tpu.profiler.daemon import ProfilerDaemon
+
+        master, client = live
+        get_metric_context().report(
+            0, {'tpu_timer_latency_us{kind="step",agg="win_avg"}': 120.0}
+        )
+        get_metric_context().report(1, {"tpu_timer_hang": 1.0})
+        daemon = ProfilerDaemon(client=client, port=0)
+        daemon.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/metrics", timeout=10
+            ) as resp:
+                body = resp.read().decode()
+            assert (
+                'tpu_timer_latency_us{node="0",kind="step",agg="win_avg"} 120.0'
+                in body
+            )
+            assert 'tpu_timer_hang{node="1"} 1.0' in body
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/job", timeout=10
+            ) as resp:
+                job = json.loads(resp.read().decode())
+            assert "goodput" in job
+        finally:
+            daemon.stop()
+
+    def test_dump_queues_stack_dump_for_running_workers(self, live):
+        import urllib.request
+
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+        from dlrover_tpu.common.node import Node
+        from dlrover_tpu.master.diagnosis.action import (
+            DiagnosisActionType,
+            NoAction,
+        )
+        from dlrover_tpu.master.job_context import get_job_context
+        from dlrover_tpu.profiler.daemon import ProfilerDaemon
+
+        master, client = live
+        job_ctx = get_job_context()
+        for nid, status in ((0, NodeStatus.RUNNING), (1, NodeStatus.FAILED)):
+            node = Node(
+                node_type=NodeType.WORKER, node_id=nid, rank_index=nid
+            )
+            node.update_status(status)
+            job_ctx.update_node(node)
+        daemon = ProfilerDaemon(client=client, port=0)
+        daemon.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.port}/dump", timeout=10
+            ) as resp:
+                out = json.loads(resp.read().decode())
+            assert out["dumped"] == [0]  # only the RUNNING worker
+            action = job_ctx.node_actions.next_action(0)
+            assert not isinstance(action, NoAction)
+            assert action.action_type == DiagnosisActionType.STACK_DUMP
+            assert isinstance(
+                job_ctx.node_actions.next_action(1), NoAction
+            )
+        finally:
+            daemon.stop()

@@ -1,0 +1,264 @@
+"""Ray platform backend tests (VERDICT r2 #9; reference scheduler/ray.py:51,
+master/scaler/ray_scaler.py:39, watcher/ray_watcher.py).
+
+`ray` is not installed in this image, so a faithful in-process fake
+implements the slice of the ray API the backend uses (remote/options/
+named detached actors/get/kill). The AgentActor itself is REAL — it
+spawns genuine agent subprocesses — so everything below the actor layer
+(process groups, exit codes, env contract) is exercised for real; only
+cluster placement is faked.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.master.scaler.base_scaler import ScalePlan
+from dlrover_tpu.master.scaler.ray_scaler import ActorScaler
+from dlrover_tpu.master.watcher.ray_watcher import ActorWatcher
+from dlrover_tpu.scheduler.ray import AgentActor, RayClient, RayElasticJob
+
+
+class FakeRef:
+    def __init__(self, fn, args, kwargs):
+        self.fn, self.args, self.kwargs = fn, args, kwargs
+
+
+class FakeMethod:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *args, **kwargs):
+        return FakeRef(self._fn, args, kwargs)
+
+
+class FakeHandle:
+    def __init__(self, instance):
+        self._instance = instance
+
+    def __getattr__(self, name):
+        return FakeMethod(getattr(self._instance, name))
+
+
+class FakeRemoteClass:
+    def __init__(self, ray, cls):
+        self._ray = ray
+        self._cls = cls
+        self._options = {}
+
+    def options(self, **opts):
+        out = FakeRemoteClass(self._ray, self._cls)
+        out._options = opts
+        return out
+
+    def remote(self, *args, **kwargs):
+        instance = self._cls(*args, **kwargs)
+        handle = FakeHandle(instance)
+        name = self._options.get("name")
+        if name:
+            self._ray.actors[name] = handle
+            self._ray.created_options[name] = dict(self._options)
+        return handle
+
+
+class FakeRay:
+    """The slice of the ray module surface RayClient touches."""
+
+    def __init__(self):
+        self.actors = {}
+        self.created_options = {}
+        self.inited_with = None
+
+    def is_initialized(self):
+        return self.inited_with is not None
+
+    def init(self, **kwargs):
+        self.inited_with = kwargs
+
+    def remote(self, cls):
+        return FakeRemoteClass(self, cls)
+
+    def get_actor(self, name, namespace=None):
+        if name not in self.actors:
+            raise ValueError(f"no actor {name}")
+        return self.actors[name]
+
+    def get(self, ref, timeout=None):
+        return ref.fn(*ref.args, **ref.kwargs)
+
+    def kill(self, handle):
+        for name, h in list(self.actors.items()):
+            if h is handle:
+                del self.actors[name]
+                # the actor process dies with the actor
+                h._instance.stop(grace_s=0.2)
+
+
+SLEEPER = [sys.executable, "-c", "import time; time.sleep(300)"]
+
+
+def _scaler(fake, n=2, command=None):
+    client = RayClient("ns", "rayjob", ray_module=fake)
+    return ActorScaler(
+        client,
+        command=command or SLEEPER,
+        master_addr="127.0.0.1:0",
+        job_name="rayjob",
+        num_workers=n,
+        resources_per_node={"TPU": 4},
+    )
+
+
+class TestActorScaler:
+    def test_scale_materializes_named_detached_actors(self, tmp_path):
+        fake = FakeRay()
+        scaler = _scaler(fake, n=2)
+        try:
+            scaler.scale(ScalePlan(worker_num=2))
+            assert sorted(fake.actors) == ["rayjob-worker-0", "rayjob-worker-1"]
+            opts = fake.created_options["rayjob-worker-0"]
+            assert opts["lifetime"] == "detached"
+            assert opts["resources"] == {"TPU": 4}
+            assert opts["max_restarts"] == 0  # our control plane restarts
+            # the env contract reached the real agent subprocess
+            inst = fake.actors["rayjob-worker-1"]._instance
+            assert inst.poll() is None  # really running
+            snapshot = scaler.snapshot()
+            assert snapshot == {0: None, 1: None}
+        finally:
+            scaler.stop()
+        assert fake.actors == {}  # stop() killed everything
+
+    def test_scale_down_trims_highest_ids(self):
+        fake = FakeRay()
+        scaler = _scaler(fake, n=3)
+        try:
+            scaler.scale(ScalePlan(worker_num=3))
+            assert len(fake.actors) == 3
+            scaler.scale(ScalePlan(worker_num=1))
+            assert sorted(fake.actors) == ["rayjob-worker-0"]
+        finally:
+            scaler.stop()
+
+    def test_dead_actor_not_resurrected_by_reconcile(self):
+        """Watcher/job-manager own relaunch; reconcile only materializes
+        never-existed ids (same contract as ProcessScaler)."""
+        fake = FakeRay()
+        scaler = _scaler(fake, n=2)
+        try:
+            scaler.scale(ScalePlan(worker_num=2))
+            inst = fake.actors["rayjob-worker-0"]._instance
+            os.killpg(inst.pid(), signal.SIGKILL)
+            deadline = time.time() + 10
+            while time.time() < deadline and scaler.snapshot()[0] is None:
+                time.sleep(0.1)
+            assert scaler.snapshot()[0] == -signal.SIGKILL
+            scaler.scale(ScalePlan())  # a no-op plan reconciles
+            assert scaler.snapshot()[0] == -signal.SIGKILL  # still dead
+            # explicit relaunch (the job manager's decision) replaces it
+            from dlrover_tpu.common.node import Node
+
+            scaler.scale(
+                ScalePlan(launch_nodes=[Node("worker", 0, rank_index=0)])
+            )
+            assert scaler.snapshot()[0] is None
+        finally:
+            scaler.stop()
+
+
+class TestActorWatcher:
+    def test_events_mirror_process_watcher_contract(self):
+        fake = FakeRay()
+        scaler = _scaler(fake, n=1)
+        try:
+            scaler.scale(ScalePlan(worker_num=1))
+            watcher = ActorWatcher(scaler, poll_interval_s=0.1)
+            events = watcher.watch()
+            first = next(events)
+            assert first.event_type == NodeEventType.ADDED
+            assert first.node.status == NodeStatus.RUNNING
+            inst = fake.actors["rayjob-worker-0"]._instance
+            os.killpg(inst.pid(), signal.SIGKILL)
+            second = next(events)
+            assert second.event_type == NodeEventType.DELETED
+            assert second.node.status == NodeStatus.FAILED
+            assert second.node.exit_reason == NodeExitReason.KILLED
+            watcher.stop()
+        finally:
+            scaler.stop()
+
+    def test_clean_exit_reports_succeeded(self):
+        fake = FakeRay()
+        scaler = _scaler(
+            fake, n=1, command=[sys.executable, "-c", "print('ok')"]
+        )
+        try:
+            scaler.scale(ScalePlan(worker_num=1))
+            deadline = time.time() + 15
+            while time.time() < deadline and scaler.snapshot()[0] is None:
+                time.sleep(0.1)
+            watcher = ActorWatcher(scaler, poll_interval_s=0.1)
+            event = next(watcher.watch())
+            assert event.event_type == NodeEventType.DELETED
+            assert event.node.status == NodeStatus.SUCCEEDED
+            watcher.stop()
+        finally:
+            scaler.stop()
+
+
+class TestRayMasterFactory:
+    def test_from_ray_args_builds_backend(self, monkeypatch):
+        from types import SimpleNamespace
+
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.job_context import JobContext
+
+        monkeypatch.setenv(
+            "DLROVER_WORKER_COMMAND", f"{sys.executable} -c pass"
+        )
+        monkeypatch.setenv("DLROVER_TPU_PER_HOST", "8")
+        fake = FakeRay()
+        JobContext.reset()
+        ns = SimpleNamespace(
+            job_name="rayjob",
+            port=0,
+            num_workers=2,
+            node_unit=1,
+            service_type="grpc",
+        )
+        master = DistributedJobMaster.from_ray_args(ns, ray_module=fake)
+        try:
+            assert isinstance(master.job_manager._scaler, ActorScaler)
+            assert isinstance(master.job_manager._watcher, ActorWatcher)
+            assert master.job_manager._scaler._resources == {"TPU": 8.0}
+        finally:
+            master.stop()
+            JobContext.reset()
+
+    def test_missing_ray_module_gives_clear_error(self):
+        with pytest.raises(RuntimeError, match="ray"):
+            RayClient("ns", "j").connect()
+
+
+class TestElasticJobNaming:
+    def test_names(self):
+        job = RayElasticJob("j1")
+        assert job.get_node_name("worker", 3) == "j1-worker-3"
+        assert job.get_node_service_addr("worker", 3) == ""
+
+
+class TestAgentActorDirect:
+    def test_stop_kills_process_group(self):
+        actor = AgentActor(SLEEPER, {})
+        assert actor.poll() is None
+        rc = actor.stop(grace_s=0.5)
+        assert rc is not None and rc != 0
+        assert actor.poll() is not None

@@ -481,3 +481,88 @@ def test_load_consistent_over_real_jax_distributed(tmp_path):
         # rank may keep the newer step-5 state the other never had
         assert got["step"] == 3, (rank, got, outs)
         assert got["w"] == [3.0] * 4, (rank, got)
+
+
+PRUNED_WORKER = r'''
+import os, sys, json, pathlib
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+rank = int(os.environ["RANK"])
+jax.distributed.initialize(
+    coordinator_address=os.environ["COORD"], num_processes=2, process_id=rank
+)
+
+import numpy as np
+import jax.numpy as jnp
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+base = pathlib.Path(os.environ["BASE"])
+engine = CheckpointEngine(
+    str(base / f"ckpt{rank}"), host_rank=0, num_hosts=1,
+    standalone=True, replicate=False,
+)
+# Divergent per-host histories after retention pruning: the newest
+# tracker steps (10 vs 6) exist only on ONE host each; the single step
+# committed on BOTH is 4. min-of-trackers (the r2 rule) would name
+# step 6, which rank 0 does not have -> permanent crash loop.
+steps = [4, 10] if rank == 0 else [4, 6]
+for s in steps:
+    assert engine.save_to_storage(s, {"w": jnp.full((4,), float(s))}), s
+    assert engine.wait_saving(60), s
+
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("committed")
+
+step, restored = engine.load_consistent({"w": jnp.zeros(4, jnp.float32)})
+out = {"rank": rank, "step": step,
+       "w": np.asarray(restored["w"]).tolist() if restored is not None else None}
+(base / f"out{rank}.json").write_text(json.dumps(out))
+engine.shm.unlink()
+engine.close()
+'''
+
+
+def test_pruned_history_agreement_over_real_jax_distributed(tmp_path):
+    """ADVICE r2 engine fix, proven on a genuine 2-process allgather:
+    hosts with divergent pruned histories restore the newest step
+    committed on EVERY host (the intersection), not min-of-trackers."""
+    port = find_free_port("127.0.0.1")
+    script = tmp_path / "worker.py"
+    script.write_text(PRUNED_WORKER)
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            RANK=str(rank),
+            COORD=f"127.0.0.1:{port}",
+            BASE=str(tmp_path),
+            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            DLROVER_JOB_NAME=f"mhp_{os.getpid()}_{rank}",
+            JAX_PLATFORMS="cpu",
+        )
+        env.pop("XLA_FLAGS", None)
+        env.pop("DLROVER_IPC_NAMESPACE", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, out.decode(errors="replace")[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank in range(2):
+        got = json.loads((tmp_path / f"out{rank}.json").read_text())
+        assert got["step"] == 4, (rank, got, outs)
+        assert got["w"] == [4.0] * 4, (rank, got)

@@ -25,6 +25,11 @@ from .comm import (  # noqa: F401
     rpc,
     unpack_array,
 )
+from .comm_service import (  # noqa: F401
+    MasterDataQueue,
+    MasterKV,
+    UnifiedCommService,
+)
 from .graph import DLExecutionGraph, RoleVertex  # noqa: F401
 from .manager import PrimeManager  # noqa: F401
 from .master import PrimeMaster  # noqa: F401

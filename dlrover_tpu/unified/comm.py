@@ -79,9 +79,10 @@ def _check_addressable() -> None:
     ns = os.environ.get("DLROVER_IPC_NAMESPACE", "")
     if current_role() and ns and not ns.startswith("unified_"):
         raise RuntimeError(
-            "role-to-role IPC helpers are not available inside "
+            "process-local role IPC is not available inside "
             "elastic=True roles (per-instance IPC namespace "
-            f"{ns!r}); use the master RPC transport instead"
+            f"{ns!r}); use the cluster-wide comm_service helpers "
+            "(MasterDataQueue / MasterKV) instead"
         )
 
 

@@ -1,0 +1,363 @@
+"""Cluster-wide role comm: master-hosted queues + KV over the DCN RPC.
+
+The process-local helpers in :mod:`unified.comm` ride unix sockets —
+same-host only. The reference's queues are Ray actors reachable from
+anywhere in the cluster; the TPU-native equivalent is this service: the
+PrimeMaster hosts named bounded queues and a small KV (weight
+broadcast) behind the SAME 2-verb msgpack transport the elastic control
+plane uses (:mod:`rpc.server`), and every role — including
+``elastic=True`` roles living in isolated IPC namespaces, and roles on
+OTHER hosts — reaches it through the address in
+``DLROVER_UNIFIED_COMM_ADDR``.
+
+Server-side waits are capped (LONG_POLL_CAP_S) so one slow get can't
+pin an HTTP worker; clients loop until their own deadline.
+"""
+
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..common.log import logger
+from ..common.serialize import dumps, loads, register_message
+from ..rpc.server import create_master_server
+
+ADDR_ENV = "DLROVER_UNIFIED_COMM_ADDR"
+LONG_POLL_CAP_S = 5.0
+
+
+# -- wire messages ----------------------------------------------------------
+
+
+@register_message
+@dataclass
+class UQueuePut:
+    name: str = ""
+    items: List[Any] = field(default_factory=list)
+    timeout: float = 0.0  # server-side wait for space, capped
+
+
+@register_message
+@dataclass
+class UQueueGet:
+    name: str = ""
+    batch: int = 1
+    timeout: float = 0.0  # server-side wait for the FIRST item, capped
+
+
+@register_message
+@dataclass
+class UQueueStat:
+    name: str = ""
+
+
+@register_message
+@dataclass
+class UQueueReply:
+    ok: bool = True
+    items: List[Any] = field(default_factory=list)
+    size: int = 0
+    reason: str = ""
+
+
+@register_message
+@dataclass
+class UKvSet:
+    key: str = ""
+    value: Any = None
+
+
+@register_message
+@dataclass
+class UKvGet:
+    key: str = ""
+
+
+@register_message
+@dataclass
+class UKvReply:
+    found: bool = False
+    value: Any = None
+
+
+# -- servicer ---------------------------------------------------------------
+
+
+class UnifiedCommServicer:
+    """Named queues + KV behind the generic get/report verbs."""
+
+    def __init__(self, default_queue_size: int = 1000):
+        self._default_size = default_queue_size
+        self._queues: Dict[str, "_queue.Queue[Any]"] = {}
+        self._kv: Dict[str, Any] = {}
+        self._mu = threading.Lock()
+
+    def _q(self, name: str) -> "_queue.Queue[Any]":
+        with self._mu:
+            q = self._queues.get(name)
+            if q is None:
+                q = _queue.Queue(self._default_size)
+                self._queues[name] = q
+            return q
+
+    # handlers
+
+    def _put(self, msg: UQueuePut) -> UQueueReply:
+        q = self._q(msg.name)
+        # One deadline for the WHOLE request (not per item): the cap
+        # bounds the server worker, stays under the client's transport
+        # timeout, and a partial put reports how far it got so the
+        # client can resume without re-enqueueing duplicates.
+        deadline = time.time() + min(max(msg.timeout, 0.0), LONG_POLL_CAP_S)
+        accepted = 0
+        for item in msg.items:
+            remaining = deadline - time.time()
+            try:
+                if remaining > 0:
+                    q.put(item, timeout=remaining)
+                else:
+                    q.put_nowait(item)
+                accepted += 1
+            except _queue.Full:
+                return UQueueReply(
+                    ok=False,
+                    size=accepted,
+                    reason=f"queue {msg.name!r} full",
+                )
+        return UQueueReply(ok=True, size=accepted)
+
+    def _get(self, msg: UQueueGet) -> UQueueReply:
+        q = self._q(msg.name)
+        wait = min(max(msg.timeout, 0.0), LONG_POLL_CAP_S)
+        items: List[Any] = []
+        deadline = time.time() + wait
+        while len(items) < max(1, msg.batch):
+            try:
+                remaining = deadline - time.time()
+                if items:
+                    # burst drain: don't wait once something arrived
+                    items.append(q.get_nowait())
+                elif remaining > 0:
+                    items.append(q.get(timeout=remaining))
+                else:
+                    items.append(q.get_nowait())
+            except _queue.Empty:
+                break
+        return UQueueReply(ok=True, items=items, size=q.qsize())
+
+    def _stat(self, msg: UQueueStat) -> UQueueReply:
+        return UQueueReply(ok=True, size=self._q(msg.name).qsize())
+
+    def _kv_set(self, msg: UKvSet) -> UKvReply:
+        with self._mu:
+            self._kv[msg.key] = msg.value
+        return UKvReply(found=True)
+
+    def _kv_get(self, msg: UKvGet) -> UKvReply:
+        with self._mu:
+            if msg.key in self._kv:
+                return UKvReply(found=True, value=self._kv[msg.key])
+        return UKvReply(found=False)
+
+    _HANDLERS = {
+        UQueuePut: _put,
+        UQueueGet: _get,
+        UQueueStat: _stat,
+        UKvSet: _kv_set,
+        UKvGet: _kv_get,
+    }
+
+    # ServicerApi surface (both verbs dispatch the same way here)
+
+    def _dispatch(self, request_bytes: bytes) -> bytes:
+        from ..common import comm
+
+        req = loads(request_bytes)
+        message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
+        handler = self._HANDLERS.get(type(message))
+        if handler is None:
+            return dumps(
+                comm.BaseResponse(success=False, reason="unknown message")
+            )
+        try:
+            result = handler(self, message)
+        except Exception as e:  # noqa: BLE001 — reported to caller
+            logger.exception("unified comm handler failed")
+            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+        return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+
+    def get(self, request_bytes: bytes) -> bytes:
+        return self._dispatch(request_bytes)
+
+    def report(self, request_bytes: bytes) -> bytes:
+        return self._dispatch(request_bytes)
+
+
+class UnifiedCommService:
+    """The PrimeMaster-side server; addr goes to roles via env."""
+
+    def __init__(self, port: int = 0, service_type: str = ""):
+        from ..common.config import get_context
+
+        self._servicer = UnifiedCommServicer()
+        # Same transport default as every other master (and as the
+        # clients' MasterClient): a job configured for HTTP comms must
+        # not get an HTTP client talking to a gRPC server.
+        self._server, self.port = create_master_server(
+            self._servicer, service_type or get_context().master_comms(), port
+        )
+        self._server.start()
+
+    @property
+    def addr(self) -> str:
+        """Routable address for the env export: cross-host roles must
+        not be handed a loopback. Falls back to loopback when the host
+        has no resolvable address (isolated test machines)."""
+        import socket
+
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
+        return f"{host}:{self.port}"
+
+    @property
+    def local_addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+# -- client-side API --------------------------------------------------------
+
+
+def _comm_addr(addr: Optional[str]) -> str:
+    import os
+
+    resolved = addr or os.environ.get(ADDR_ENV, "")
+    if not resolved:
+        raise RuntimeError(
+            f"no unified comm service address: set {ADDR_ENV} (the "
+            "PrimeManager exports it to every role) or pass addr="
+        )
+    return resolved
+
+
+class MasterDataQueue:
+    """Cluster-wide DataQueue: same surface as the host-local one, but
+    every operation is an RPC to the PrimeMaster's comm service — usable
+    from any host and from elastic=True roles."""
+
+    def __init__(self, name: str, addr: Optional[str] = None):
+        from ..rpc.client import MasterClient
+
+        self.name = name
+        self._client = MasterClient(
+            master_addr=_comm_addr(addr), node_id=-1
+        )
+
+    def put(
+        self,
+        *items: Any,
+        timeout: Optional[float] = None,
+        retry_for: float = 0.0,
+    ) -> None:
+        """``retry_for`` rides over a master restart (same failover
+        contract as ``get``) — the rollout side of a pipeline must
+        survive the PrimeMaster's self-recovery window too."""
+        deadline = None if timeout is None else time.time() + timeout
+        retry_deadline = time.time() + max(retry_for, 0.0)
+        pending = list(items)
+        while pending:
+            chunk_wait = LONG_POLL_CAP_S
+            if deadline is not None:
+                chunk_wait = min(chunk_wait, max(0.0, deadline - time.time()))
+            try:
+                reply = self._client.get(
+                    UQueuePut(
+                        name=self.name, items=pending, timeout=chunk_wait
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — master failover window
+                if time.time() < retry_deadline:
+                    time.sleep(0.2)
+                    continue
+                raise ConnectionError(
+                    f"queue {self.name!r} service unreachable: {e}"
+                ) from e
+            if not isinstance(reply, UQueueReply):
+                raise RuntimeError(f"queue put rejected: {reply!r}")
+            if reply.ok:
+                return
+            pending = pending[reply.size :]
+            if deadline is not None and time.time() >= deadline:
+                raise TimeoutError(
+                    f"queue {self.name!r} full for {timeout}s"
+                )
+
+    def get(
+        self,
+        batch_size: int = 1,
+        timeout: Optional[float] = None,
+        retry_for: float = 0.0,
+    ) -> List[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        retry_deadline = time.time() + max(retry_for, 0.0)
+        while True:
+            chunk_wait = LONG_POLL_CAP_S
+            if deadline is not None:
+                chunk_wait = min(chunk_wait, max(0.0, deadline - time.time()))
+            try:
+                reply = self._client.get(
+                    UQueueGet(
+                        name=self.name, batch=batch_size, timeout=chunk_wait
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — master failover window
+                if time.time() < retry_deadline:
+                    time.sleep(0.2)
+                    continue
+                raise ConnectionError(
+                    f"queue {self.name!r} service unreachable: {e}"
+                ) from e
+            if not isinstance(reply, UQueueReply):
+                raise RuntimeError(f"queue get rejected: {reply!r}")
+            if reply.items:
+                return list(reply.items)
+            if deadline is not None and time.time() >= deadline:
+                return []
+
+    def qsize(self) -> int:
+        reply = self._client.get(UQueueStat(name=self.name))
+        if not isinstance(reply, UQueueReply):
+            raise RuntimeError(f"queue stat rejected: {reply!r}")
+        return int(reply.size)
+
+    def close(self) -> None:
+        close = getattr(self._client, "close", None)
+        if close:
+            close()
+
+
+class MasterKV:
+    """Tiny cluster KV on the comm service (weight versions, configs)."""
+
+    def __init__(self, addr: Optional[str] = None):
+        from ..rpc.client import MasterClient
+
+        self._client = MasterClient(master_addr=_comm_addr(addr), node_id=-1)
+
+    def set(self, key: str, value: Any) -> None:
+        reply = self._client.get(UKvSet(key=key, value=value))
+        if not isinstance(reply, UKvReply):
+            # a silently dropped weight publish is a stalled learner
+            raise RuntimeError(f"kv set rejected: {reply!r}")
+
+    def get(self, key: str, default: Any = None) -> Any:
+        reply = self._client.get(UKvGet(key=key))
+        if not isinstance(reply, UKvReply):
+            raise RuntimeError(f"kv get rejected: {reply!r}")
+        return reply.value if reply.found else default

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 
 from ..common.log import logger
 from .api import DLJob
+from .comm_service import ADDR_ENV, UnifiedCommService
 from .graph import DLExecutionGraph, RoleVertex, VertexState
 from .runtime import RoleWorker
 from .scheduler import Placement, place
@@ -59,6 +60,12 @@ class PrimeManager:
         self._thread: Optional[threading.Thread] = None
         self._job_restarts = 0
         self._max_job_restarts = max_job_restarts
+        # Cluster-wide role comm: master-hosted queues/KV over the DCN
+        # RPC, reachable from every role (elastic ones too) via
+        # DLROVER_UNIFIED_COMM_ADDR (reference: Ray queues are
+        # cluster-wide; the host-local unix-socket path in comm.py is
+        # the low-latency same-host fast path).
+        self.comm_service = UnifiedCommService()
         self._self_recover()
 
     # -- lifecycle ---------------------------------------------------------
@@ -101,6 +108,8 @@ class PrimeManager:
         spec = self.graph.spec_of(vertex)
         command = list(spec.command)
         env = dict(spec.env)
+        # Routable, not loopback: roles placed on other hosts dial this.
+        env.setdefault(ADDR_ENV, self.comm_service.addr)
         if not spec.elastic:
             # One shared IPC namespace per unified job: role-to-role
             # RPC/queues (unified/comm.py) address peers by socket name,
@@ -292,6 +301,10 @@ class PrimeManager:
                 except Exception:  # noqa: BLE001
                     pass
             self._sub_masters.clear()
+            try:
+                self.comm_service.stop()
+            except Exception:  # noqa: BLE001
+                pass
             self.status = status
             self._save_state()
 

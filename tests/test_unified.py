@@ -508,3 +508,90 @@ class TestPpoE2E:
         result = json.loads((out / "trainer_result.json").read_text())
         assert result["updates"] == 60
         assert abs(result["w"] - 3.0) < 0.5, result
+
+
+class TestMasterCommService:
+    """Cluster-wide role comm over the DCN RPC (reference: Ray queues
+    reach any actor in the cluster; the unix-socket DataQueue is the
+    same-host fast path only)."""
+
+    @pytest.fixture()
+    def service(self):
+        from dlrover_tpu.unified.comm_service import UnifiedCommService
+
+        svc = UnifiedCommService()
+        yield svc
+        svc.stop()
+
+    def test_queue_roundtrip_across_clients(self, service):
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        producer = MasterDataQueue("exp", addr=service.local_addr)
+        consumer = MasterDataQueue("exp", addr=service.local_addr)
+        producer.put({"x": 1.0}, {"x": 2.0}, [1, 2, 3])
+        assert consumer.qsize() == 3
+        batch = consumer.get(batch_size=3, timeout=10)
+        assert batch == [{"x": 1.0}, {"x": 2.0}, [1, 2, 3]]
+        assert consumer.get(batch_size=1, timeout=0.2) == []
+
+    def test_queue_backpressure_and_timeout(self, service):
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        service._servicer._default_size = 2
+        q = MasterDataQueue("small", addr=service.local_addr)
+        q.put(1, 2)
+        import pytest as _pytest
+
+        with _pytest.raises(TimeoutError):
+            q.put(3, timeout=0.5)
+        assert q.get(2, timeout=5) == [1, 2]
+
+    def test_kv_roundtrip(self, service):
+        from dlrover_tpu.unified.comm_service import MasterKV
+
+        kv = MasterKV(addr=service.local_addr)
+        assert kv.get("w", default="none") == "none"
+        kv.set("w", {"version": 3, "data": [0.5, 0.25]})
+        assert kv.get("w")["version"] == 3
+
+    def test_missing_addr_raises_clearly(self, monkeypatch):
+        from dlrover_tpu.unified.comm_service import (
+            ADDR_ENV,
+            MasterDataQueue,
+        )
+
+        monkeypatch.delenv(ADDR_ENV, raising=False)
+        with pytest.raises(RuntimeError, match="DLROVER_UNIFIED_COMM_ADDR"):
+            MasterDataQueue("q")
+
+    def test_roles_receive_comm_addr(self, tmp_path):
+        """Every role process (plain AND elastic) gets the service
+        address in its env contract."""
+        from dlrover_tpu.unified.comm_service import ADDR_ENV
+
+        marker = tmp_path / "out"
+        marker.mkdir()
+        cmd = _script(
+            tmp_path,
+            "addr.py",
+            "import os, pathlib\n"
+            f"pathlib.Path(r'{marker}', os.environ['DLROVER_ROLE'])"
+            ".write_text(os.environ.get('DLROVER_UNIFIED_COMM_ADDR', ''))\n",
+        )
+        job = (
+            DLJobBuilder("commaddr")
+            .node_num(1)
+            .device_per_node(2)
+            .role("trainer", cmd, num=1, device=1.0)
+            .build()
+        )
+        manager = PrimeManager(job, log_dir=str(tmp_path / "logs"))
+        manager.start()
+        try:
+            assert manager.wait(timeout=30) == JobStatus.SUCCEEDED
+        finally:
+            manager.stop(status=manager.status)
+        addr = (marker / "trainer").read_text()
+        # routable export (loopback only as a resolution fallback)
+        assert addr == manager.comm_service.addr
+        assert addr.endswith(f":{manager.comm_service.port}")

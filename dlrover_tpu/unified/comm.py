@@ -262,6 +262,7 @@ class DataQueue:
     """
 
     def __init__(self, name: str, is_master: bool = False, size: int = 1000):
+        _check_addressable()  # elastic roles: use MasterDataQueue
         self.name = name
         self._q = SharedQueue(
             f"udq_{name}", create=is_master, maxsize=size

@@ -595,3 +595,67 @@ class TestMasterCommService:
         # routable export (loopback only as a resolution fallback)
         assert addr == manager.comm_service.addr
         assert addr.endswith(f":{manager.comm_service.port}")
+
+
+@pytest.mark.slow
+def test_elastic_role_consumes_master_queue(tmp_path):
+    """The cluster comm path closes the elastic-role gap: a plain
+    producer role feeds MasterDataQueue; the consumer is an elastic=True
+    role (own tpurun world + isolated IPC namespace, where the
+    unix-socket helpers refuse) reading the SAME queue through
+    DLROVER_UNIFIED_COMM_ADDR."""
+    out = tmp_path / "out"
+    out.mkdir()
+    producer = _script(
+        tmp_path,
+        "producer.py",
+        "import os, sys\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "from dlrover_tpu.unified.comm_service import MasterDataQueue\n"
+        "q = MasterDataQueue('eq')\n"
+        "for v in range(1, 11):\n"
+        "    q.put(v, timeout=30)\n"
+        "print('produced 10')\n",
+    )
+    trainer = tmp_path / "train.py"
+    trainer.write_text(
+        "import os, sys, pathlib\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "from dlrover_tpu.unified.comm_service import MasterDataQueue\n"
+        "from dlrover_tpu.unified.comm import DataQueue\n"
+        "# the process-local helper must refuse inside an elastic role\n"
+        "try:\n"
+        "    DataQueue('eq')\n"
+        "    refused = False\n"
+        "except RuntimeError as e:\n"
+        "    refused = 'MasterDataQueue' in str(e)\n"
+        "q = MasterDataQueue('eq')\n"
+        "total, got = 0, 0\n"
+        "while got < 10:\n"
+        "    for v in q.get(batch_size=10, timeout=30, retry_for=30):\n"
+        "        total += v; got += 1\n"
+        f"pathlib.Path(r'{out}', 'sum').write_text(f'{{total}},{{refused}}')\n",
+    )
+    job = (
+        DLJobBuilder("elq")
+        .node_num(1)
+        .device_per_node(2)
+        .role("rollout", producer, num=1, device=0.5)
+        .role(
+            "trainer", [str(trainer)], num=1, device=1.0, elastic=True
+        )
+        .build()
+    )
+    manager = PrimeManager(job, log_dir=str(tmp_path / "logs"))
+    env_backup = dict(os.environ)
+    os.environ["PYTHONPATH"] = os.pathsep.join(sys.path)
+    try:
+        manager.start()
+        assert manager.wait(timeout=120) == JobStatus.SUCCEEDED
+    finally:
+        manager.stop(manager.status)
+        os.environ.clear()
+        os.environ.update(env_backup)
+    total, refused = (out / "sum").read_text().split(",")
+    assert int(total) == sum(range(1, 11))
+    assert refused == "True", "local DataQueue did not refuse in elastic role"

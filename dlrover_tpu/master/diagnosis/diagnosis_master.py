@@ -228,7 +228,8 @@ class DiagnosisMaster:
         faster than the global step watermark and names the node."""
         from ..monitor.metric_context import get_metric_context
 
-        hung = get_metric_context().hung_nodes()
+        metric_ctx = get_metric_context()
+        hung = metric_ctx.hung_nodes()
         if not hung:
             return
         workers = self._job_ctx.get_nodes(NodeType.WORKER)
@@ -240,15 +241,27 @@ class DiagnosisMaster:
                 continue  # already acted on
             node.reported_unhealthy = True
             self._job_ctx.update_node(node)
+            # Launch-vs-completion evidence (PJRT interposer): name the
+            # side that stalled so operators (and the RELAUNCH-vs-
+            # RESTART policy) see device-wedge vs host-loop-stall
+            # instead of one undifferentiated "hang".
+            verdict = int(
+                metric_ctx.gauge(node_id, "tpu_timer_stall_verdict", 0.0)
+            )
+            cause = {1: "device_stall", 2: "host_stall"}.get(
+                verdict, "unknown"
+            )
             logger.error(
-                "node %s profiler reports a hang; restarting its worker",
+                "node %s profiler reports a hang (%s); restarting its "
+                "worker",
                 node_id,
+                cause,
             )
             self._job_ctx.node_actions.add_action(
                 NodeAction(
                     node_id=node_id,
                     action_type=DiagnosisActionType.RESTART_WORKER,
-                    reason="profiler_hang",
+                    reason=f"profiler_hang:{cause}",
                 )
             )
 

@@ -43,8 +43,20 @@ int main() {
   tt_step_begin(5);
   std::this_thread::sleep_for(std::chrono::milliseconds(700));
   assert(tt_hang_status() == 1);  // stuck step flagged
+  {
+    // Regression: metrics rendered WITH a step open past the hang
+    // threshold (the stall-verdict path once re-locked the core mutex
+    // from inside the locked section — a self-deadlock only this state
+    // reaches). Host-stall expected: nothing was device-launched.
+    char sbuf[16384];
+    assert(tt_metrics_text(sbuf, sizeof(sbuf)) > 0);
+    assert(std::string(sbuf).find("tpu_timer_stall_verdict 2") !=
+           std::string::npos);
+    assert(tt_stall_verdict() == 2);
+  }
   tt_step_end(5);
   assert(tt_hang_status() == 0);
+  assert(tt_stall_verdict() == 0);
 
   char buf[16384];
   int64_t n = tt_metrics_text(buf, sizeof(buf));

@@ -134,6 +134,20 @@ double HangThresholdMs(Core& c) {
                   median > 0 ? factor * median : 1e18);
 }
 
+// 0 none, 1 device-stall, 2 host-stall (see tt_stall_verdict).
+int StallVerdict(Core& c) {
+  int64_t open_since = c.step_open_since_us.load();
+  if (open_since <= 0) return 0;
+  double open_ms = (NowUs() - open_since) / 1e3;
+  double threshold_ms = HangThresholdMs(c);
+  if (open_ms <= threshold_ms) return 0;
+  int64_t last = c.last_device_complete_us.load();
+  double since_complete_ms = last > 0 ? (NowUs() - last) / 1e3 : open_ms;
+  if (since_complete_ms <= threshold_ms) return 0;
+  int64_t inflight = c.device_launches.load() - c.device_completes.load();
+  return inflight > 0 ? 1 : 2;
+}
+
 std::string MetricsText(Core& c) {
   static const char* kKindNames[TT_KIND_COUNT] = {
       "matmul", "collective", "step", "h2d", "d2h", "other",
@@ -141,6 +155,9 @@ std::string MetricsText(Core& c) {
   std::string out;
   out.reserve(4096);
   char buf[512];
+  // BEFORE taking c.mu: StallVerdict -> HangThresholdMs -> StepMedianMs
+  // re-locks the same non-recursive mutex (self-deadlock under lock).
+  int stall_verdict = StallVerdict(c);
   std::lock_guard<std::mutex> lock(c.mu);
   for (int k = 0; k < TT_KIND_COUNT; k++) {
     const KindStats& s = c.stats[k];
@@ -184,10 +201,12 @@ std::string MetricsText(Core& c) {
   snprintf(buf, sizeof(buf),
            "tpu_timer_device_launches_total %lld\n"
            "tpu_timer_device_completes_total %lld\n"
-           "tpu_timer_device_inflight %lld\n",
+           "tpu_timer_device_inflight %lld\n"
+           "tpu_timer_stall_verdict %d\n",
            static_cast<long long>(launches),
            static_cast<long long>(completes),
-           static_cast<long long>(launches - completes));
+           static_cast<long long>(launches - completes),
+           stall_verdict);
   out += buf;
   return out;
 }
@@ -400,28 +419,16 @@ double tt_last_device_complete_age_s() {
 }
 
 int tt_stall_verdict() {
-  if (g_core == nullptr) return 0;
-  Core& c = *g_core;
-  int64_t open_since = c.step_open_since_us.load();
-  if (open_since <= 0) return 0;
-  double open_ms = (NowUs() - open_since) / 1e3;
-  double threshold_ms = HangThresholdMs(c);
-  if (open_ms <= threshold_ms) return 0;
   // A completion newer than the threshold means the device is making
   // progress (or a synchronous launch/await loop is between launches) —
-  // the step is just long; keep watching. This recency gate applies to
+  // the step is just long; keep watching. The recency gate applies to
   // BOTH branches so the verdict can't flap 1<->2 with sample timing.
-  int64_t last = c.last_device_complete_us.load();
-  double since_complete_ms = last > 0 ? (NowUs() - last) / 1e3 : open_ms;
-  if (since_complete_ms <= threshold_ms) return 0;
-  int64_t inflight = c.device_launches.load() - c.device_completes.load();
-  // Work was handed to the device and the completion stream went quiet
-  // for at least the threshold: the device (or its program) is wedged.
-  if (inflight > 0) return 1;
-  // Step open past threshold, completions quiet, nothing in flight:
-  // the host loop stopped feeding the device (dataloader stall, GC,
-  // deadlock).
-  return 2;
+  // 1 = work handed to the device, completion stream quiet: the device
+  // (or its program) is wedged. 2 = step open past threshold with
+  // nothing in flight: the host stopped feeding the device
+  // (dataloader stall, GC, deadlock).
+  if (g_core == nullptr) return 0;
+  return StallVerdict(*g_core);
 }
 
 int64_t tt_dump_timeline(const char* path) {

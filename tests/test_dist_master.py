@@ -312,11 +312,15 @@ class TestDiagnosisMaster:
         JobMetricContext.reset()
         ctx = get_job_context()
         ctx.update_node(_worker(0, NodeStatus.RUNNING))
-        get_metric_context().report(0, {"tpu_timer_hang": 1.0})
+        get_metric_context().report(
+            0, {"tpu_timer_hang": 1.0, "tpu_timer_stall_verdict": 1.0}
+        )
         dm = DiagnosisMaster()
         dm.observe_once()
         action = ctx.node_actions.next_action(0)
         assert action.action_type == "restart_worker"
+        # the interposer's launch-vs-completion evidence names the side
+        assert action.config.get("reason") == "profiler_hang:device_stall"
         # acted once; a second observe doesn't re-issue
         dm.observe_once()
         assert ctx.node_actions.next_action(0).action_type == "no_action"

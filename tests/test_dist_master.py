@@ -423,3 +423,70 @@ class TestQuotaAwareScaling:
                 return []
 
         assert K8sQuotaChecker(client=BrokenClient()).get_free_node_num() > 1e6
+
+
+class TestExecuteScalePlanRouting:
+    """Manual ScalePlan CR routing on the live master: shrink -> drain,
+    zero -> suspend, explicit node choices -> scaler verbatim."""
+
+    @pytest.fixture()
+    def master(self):
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+        scaler = RecordingScaler()
+        m = DistributedJobMaster(
+            scaler=scaler,
+            num_workers=3,
+            max_workers=6,
+            pre_check_ops=[],
+            fresh_context=True,
+        )
+        yield m, scaler
+        m.stop()
+        JobContext.reset()
+
+    def _run_world(self, m, n=3):
+        for nid in range(n):
+            node = _worker(nid, NodeStatus.RUNNING)
+            get_job_context().update_node(node)
+        # a completed rendezvous round of n members (joining alone only
+        # completes at max_nodes or after the lastcall window)
+        m._training_rdzv.world_size = lambda: n
+
+    def test_zero_replicas_suspends_not_zombie(self, master):
+        m, scaler = master
+        self._run_world(m)
+        plan = ScalePlan(worker_num=0)
+        m.execute_scale_plan(plan)
+        assert m.job_manager.is_suspended
+        # suspend path: removal plan issued, nodes resumable (released
+        # but NOT scaled-out: resume() clears them)
+        node = get_job_context().get_node(NodeType.WORKER, 0)
+        assert node.is_released and node.relaunchable
+
+    def test_shrink_takes_drain_path(self, master):
+        m, scaler = master
+        self._run_world(m)
+        m.execute_scale_plan(ScalePlan(worker_num=2))
+        node = get_job_context().get_node(NodeType.WORKER, 2)
+        assert node.is_released and not node.relaunchable
+        assert m.job_manager.num_workers == 2
+        # barrier expectation dropped with the world
+        assert m.sync_service._default_expected == 2
+
+    def test_explicit_remove_nodes_bypasses_drain(self, master):
+        """The operator picked WHICH node dies; honor it verbatim."""
+        m, scaler = master
+        self._run_world(m)
+        plan = ScalePlan(worker_num=2, remove_nodes=[0])
+        m.execute_scale_plan(plan)
+        assert scaler.plans[-1].remove_nodes == [0]
+        # drain path not taken: node 2 untouched
+        node2 = get_job_context().get_node(NodeType.WORKER, 2)
+        assert not node2.is_released
+
+    def test_grow_goes_straight_to_scaler(self, master):
+        m, scaler = master
+        self._run_world(m)
+        m.execute_scale_plan(ScalePlan(worker_num=5))
+        assert scaler.plans[-1].worker_num == 5

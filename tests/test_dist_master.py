@@ -412,6 +412,60 @@ class TestQuotaAwareScaling:
         checker = K8sQuotaChecker(client=FakeClient())
         assert checker.get_free_node_num() == 1
 
+    def test_k8s_checker_handles_attr_style_objects(self):
+        """The real kubernetes client returns attribute-style models,
+        not dicts — both shapes must count identically."""
+        from types import SimpleNamespace as NS
+
+        from dlrover_tpu.master.cluster import K8sQuotaChecker
+
+        class AttrClient:
+            def list_nodes(self):
+                return [
+                    NS(
+                        metadata=NS(name="tpu-a"),
+                        spec=NS(unschedulable=False),
+                        status=NS(allocatable={"google.com/tpu": "4"}),
+                    ),
+                    NS(
+                        metadata=NS(name="tpu-b"),
+                        spec=NS(unschedulable=False),
+                        status=NS(allocatable={"google.com/tpu": "4"}),
+                    ),
+                ]
+
+            def list_all_pods(self):
+                return [
+                    NS(
+                        status=NS(phase="Running"),
+                        spec=NS(
+                            node_name="tpu-b",
+                            containers=[
+                                NS(
+                                    resources=NS(
+                                        limits={"google.com/tpu": "4"}
+                                    )
+                                )
+                            ],
+                        ),
+                    ),
+                    NS(  # terminated pod frees its host
+                        status=NS(phase="Succeeded"),
+                        spec=NS(
+                            node_name="tpu-a",
+                            containers=[
+                                NS(
+                                    resources=NS(
+                                        limits={"google.com/tpu": "4"}
+                                    )
+                                )
+                            ],
+                        ),
+                    ),
+                ]
+
+        assert K8sQuotaChecker(client=AttrClient()).get_free_node_num() == 1
+
     def test_k8s_checker_degrades_open_on_api_error(self):
         from dlrover_tpu.master.cluster import K8sQuotaChecker
 

@@ -345,17 +345,20 @@ class CheckpointEngine:
 
     def _load_from_memory(self, template: Any):
         # Everything happens under the shard lock: the persister (or a
-        # dying trainer's last save) may be mid-write, and the restore
-        # uses zero-copy views into the segment, which must not be
-        # overwritten until the device transfer completes
-        # (_restore_into_template blocks on it before returning).
+        # dying trainer's last save) may be mid-write. The load COPIES
+        # out of the segment (copy=True): zero-copy views were tried and
+        # leak — on the CPU backend jax.device_put aliases the host
+        # buffer, so a view into the mmap outlives the lock scope and
+        # the segment can never be closed (BufferError: cannot close
+        # exported pointers exist). One memcpy at memory bandwidth is
+        # cheap next to the device transfer it feeds.
         if not self._shard_lock.acquire(blocking=True, timeout=60.0):
             logger.warning("shard lock busy; skipping memory restore")
             return None
         try:
             if not self.shm.attach():
                 return None
-            got = self.shm.load_pytree_host(copy=False)
+            got = self.shm.load_pytree_host(copy=True)
             if got is None:
                 return None
             meta, arrays = got

@@ -485,19 +485,11 @@ class ReplicaManager:
 
 
 def _local_host() -> str:
-    """Advertised host for the replica endpoint. Hostname resolution is
-    authoritative on k8s (pod DNS); fall back to the outbound IP."""
-    host = socket.gethostname()
-    try:
-        socket.getaddrinfo(host, None)
-        return host
-    except OSError:
-        pass
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+    """Advertised host for THIS node's replica endpoint: never a
+    loopback (see platform.routable_host) and never an env override —
+    DLROVER_MASTER_HOST is typically set job-uniformly via the pod
+    template, and honoring it here would make every node advertise the
+    master's address as its own shard endpoint."""
+    from ..common.platform import routable_host
+
+    return routable_host()

@@ -19,7 +19,7 @@ import threading
 import time
 import queue as _queue
 from multiprocessing import shared_memory
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import msgpack
 from multiprocessing import resource_tracker
@@ -530,6 +530,30 @@ def _shm_name(name: str) -> str:
     return f"dlrover_{_ipc_namespace()}_{name}"
 
 
+# Mappings whose close() hit "BufferError: cannot close exported pointers
+# exist" — something (a numpy view, a CPU-backend jax.Array aliasing host
+# memory) still references the mmap. Quarantined with a strong reference so
+# SharedMemory.__del__ never runs on them (an unraisable BufferError in a
+# finalizer is uncatchable by callers); retried opportunistically once the
+# exporting views die. Guarded: concurrent close() calls (persister thread
+# vs trainer) must not lose a quarantined entry in the sweep's rewrite.
+_UNCLOSEABLE: List[shared_memory.SharedMemory] = []
+_UNCLOSEABLE_LOCK = threading.Lock()
+
+
+def _sweep_uncloseable() -> None:
+    with _UNCLOSEABLE_LOCK:
+        still = []
+        for shm in _UNCLOSEABLE:
+            try:
+                shm.close()
+            except BufferError:
+                still.append(shm)
+            except Exception:
+                pass
+        _UNCLOSEABLE[:] = still
+
+
 class SharedMemorySegment:
     """POSIX shared-memory segment with create-or-attach-and-resize semantics.
 
@@ -637,21 +661,29 @@ class SharedMemorySegment:
         assert self._shm is not None
         return bytes(self._shm.buf[offset : offset + length])
 
+    @staticmethod
+    def _close_or_quarantine(shm: shared_memory.SharedMemory) -> None:
+        """Close a mapping; never raise. A mapping with live exported
+        views goes to the quarantine list (strong ref) so its __del__
+        can't fire an unraisable BufferError at GC time."""
+        _sweep_uncloseable()
+        try:
+            shm.close()
+        except BufferError:
+            with _UNCLOSEABLE_LOCK:
+                _UNCLOSEABLE.append(shm)
+        except Exception:
+            pass
+
     def close(self) -> None:
         if self._shm is not None:
-            try:
-                self._shm.close()
-            except Exception:
-                pass
-            self._shm = None
+            shm, self._shm = self._shm, None
+            self._close_or_quarantine(shm)
 
     def unlink(self) -> None:
         if self._shm is None and not self.attach():
             return
         shm, self._shm = self._shm, None
         self._ino = None
-        try:
-            shm.close()
-        except Exception:
-            pass
+        self._close_or_quarantine(shm)
         self._posix_unlink(shm)

@@ -43,3 +43,47 @@ def force_virtual_cpu(n_devices: int, platform: str = "cpu") -> None:
         jax.config.update("jax_platforms", platform)
     except RuntimeError:
         pass  # backend already initialized; caller's device assert decides
+
+
+def routable_host(override_env: str = "") -> str:
+    """Best non-loopback IP for cross-host env exports.
+
+    ``gethostbyname(gethostname())`` resolves to 127.0.1.1 on stock
+    Debian/Ubuntu hosts files, which silently breaks any service whose
+    address is handed to OTHER hosts (they dial their own loopback).
+    Resolution order: the ``override_env`` env var when the caller
+    names one (only for addresses that genuinely are per-deployment,
+    e.g. the master's — a per-node endpoint must NOT honor a
+    job-uniform override or every node advertises the same address) →
+    the hostname's first A record when non-loopback (the resolved IP
+    is returned, not the name: peers on bare-metal clusters without
+    shared DNS can route an IP but not resolve a foreign hostname) →
+    outbound-interface IP via the UDP-connect trick (no packet is
+    sent) → loopback as a last resort (isolated test machines).
+    """
+    import socket
+
+    if override_env:
+        override = os.getenv(override_env, "")
+        if override:
+            return override
+    try:
+        infos = socket.getaddrinfo(socket.gethostname(), None, socket.AF_INET)
+        if infos and not infos[0][4][0].startswith("127."):
+            return infos[0][4][0]
+    except OSError:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # connect() on a datagram socket sends nothing; it only
+            # resolves the outbound interface for the default route.
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"

@@ -175,6 +175,13 @@ class JobAutoScaler:
                 continue
             self._excluded_stragglers.add(key)
             self._stats.evict(node_id)  # old samples must not skew peers
+            # A straggler was dragging every collective; any saturation
+            # knee measured while it ran is evidence about the old
+            # fleet, not the post-exclusion one.
+            if hasattr(self._optimizer, "invalidate_frontier"):
+                self._optimizer.invalidate_frontier(
+                    f"straggler {node_id} excluded"
+                )
             logger.warning(
                 "straggler node %s (step time > %.1fx median); excluding",
                 node_id,

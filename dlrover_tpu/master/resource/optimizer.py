@@ -83,6 +83,48 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
         # Largest size observed to still scale efficiently; sizes above
         # it are known-saturated. None until a saturation is seen.
         self._efficient_frontier: Optional[int] = None
+        # Speed measured at the frontier when it was set, and how many
+        # plans have been pinned at it — both feed invalidation below.
+        self._frontier_speed = 0.0
+        self._plans_at_frontier = 0
+
+    # A saturation verdict is evidence about conditions at the time it
+    # was taken (a straggler since excluded, transient network
+    # degradation), not a permanent property of the job. Re-probe past
+    # the knee when the measured speed at the frontier size drifts
+    # materially, or after enough pinned plans go by.
+    FRONTIER_DRIFT = 0.15
+    FRONTIER_REPROBE_PLANS = 30
+
+    def invalidate_frontier(self, reason: str = "") -> None:
+        """Forget the saturation knee (e.g. after straggler exclusion
+        or node migration changed the fleet's character)."""
+        if self._efficient_frontier is not None:
+            logger.info(
+                "re-opening scaling frontier (was %s hosts)%s",
+                self._efficient_frontier,
+                f": {reason}" if reason else "",
+            )
+        self._efficient_frontier = None
+        self._frontier_speed = 0.0
+        self._plans_at_frontier = 0
+        # Stale per-size speeds above the old knee would immediately
+        # re-trigger saturation against fresh measurements.
+        self._speed_at_size.clear()
+
+    def _maybe_invalidate(self, size: int, speed: float) -> None:
+        if self._efficient_frontier is None:
+            return
+        if size == self._efficient_frontier and self._frontier_speed > 0:
+            drift = abs(speed - self._frontier_speed) / self._frontier_speed
+            if drift > self.FRONTIER_DRIFT:
+                self.invalidate_frontier(
+                    f"speed at {size} hosts moved {drift:.0%}"
+                )
+                return
+        self._plans_at_frontier += 1
+        if self._plans_at_frontier >= self.FRONTIER_REPROBE_PLANS:
+            self.invalidate_frontier("periodic re-probe window elapsed")
 
     def record_world_size(self, size: int) -> None:
         self._current_size = size
@@ -92,6 +134,7 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
         size = self._current_size
         if size <= 0 or speed <= 0:
             return ResourcePlan()
+        self._maybe_invalidate(size, speed)
         self._speed_at_size[size] = speed
         prev_sizes = [s for s in self._speed_at_size if s < size]
         if prev_sizes:
@@ -101,6 +144,8 @@ class ThroughputScalingOptimizer(ResourceOptimizer):
             expected_per_host = self._speed_at_size[prev] / prev
             if per_host < self._min_gain * expected_per_host:
                 self._efficient_frontier = prev
+                self._frontier_speed = self._speed_at_size[prev]
+                self._plans_at_frontier = 0
                 logger.info(
                     "scaling saturated: +%.3f steps/s per host < %.0f%% of "
                     "linear; releasing back to %s hosts",

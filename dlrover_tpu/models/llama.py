@@ -56,6 +56,13 @@ class LlamaConfig:
     def is_moe_block(self, layer_idx: int) -> bool:
         # Every `moe_every`-th block, LAST of each group: moe_every=1
         # means every block, moe_every=2 means layers 1, 3, 5, ...
+        # NOTE: this rule changed from `% moe_every == 1` (which placed
+        # no MoE blocks at all for moe_every=1 and layers 1,4,7 for
+        # moe_every=3). Checkpoints trained under the old rule with
+        # moe_every>2 have MoE params at different layer indices; a
+        # restore fails loudly with "checkpoint missing leaf
+        # layers_<i>/moe/..." (engine._restore_into_template) rather
+        # than mis-restoring, because leaf paths encode the layer index.
         return self.num_experts > 0 and (
             layer_idx % self.moe_every == self.moe_every - 1
         )

@@ -48,9 +48,15 @@ def render_cluster_metrics(node_gauges) -> str:
 
 
 class ProfilerDaemon:
-    def __init__(self, client: Optional[MasterClient] = None, port: int = 0):
+    def __init__(
+        self,
+        client: Optional[MasterClient] = None,
+        port: int = 0,
+        bind: str = "0.0.0.0",
+    ):
         self._client = client or MasterClient.singleton()
         self._port = port
+        self._bind = bind
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -74,6 +80,9 @@ class ProfilerDaemon:
                 self.wfile.write(data)
 
             def do_GET(self):
+                # Read-only verbs only: /dump is side-effectful (queues
+                # SIGUSR2 stack dumps on every trainer) and scrapers /
+                # health probers / browser prefetchers issue GETs freely.
                 try:
                     if self.path.startswith("/metrics"):
                         resp = daemon._client.get_cluster_metrics()
@@ -95,23 +104,30 @@ class ProfilerDaemon:
                             ctype="application/json",
                         )
                     elif self.path.startswith("/dump"):
+                        self._send(405, "POST /dump to trigger a dump\n")
+                    else:
+                        self._send(200, "ok\n")
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    self._send(502, f"master unreachable: {e}\n")
+
+            def do_POST(self):
+                try:
+                    if self.path.startswith("/dump"):
                         resp = daemon._client.trigger_cluster_dump()
                         self._send(
                             200, json.dumps({"dumped": resp.node_ids}),
                             ctype="application/json",
                         )
                     else:
-                        self._send(200, "ok\n")
+                        self._send(404, "unknown endpoint\n")
                 except Exception as e:  # noqa: BLE001 — keep serving
                     self._send(502, f"master unreachable: {e}\n")
-
-            do_POST = do_GET
 
         return Handler
 
     def start(self) -> int:
         self._httpd = ThreadingHTTPServer(
-            ("0.0.0.0", self._port), self._handler()
+            (self._bind, self._port), self._handler()
         )
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="profiler-daemon",
@@ -132,9 +148,16 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="cluster profiler daemon")
     parser.add_argument("--master", required=True, help="master HOST:PORT")
     parser.add_argument("--port", type=int, default=18889)
+    parser.add_argument(
+        "--bind",
+        default="0.0.0.0",
+        help="listen address (use 127.0.0.1 to restrict to local scrapers)",
+    )
     ns = parser.parse_args(argv)
     daemon = ProfilerDaemon(
-        client=MasterClient(master_addr=ns.master, node_id=-1), port=ns.port
+        client=MasterClient(master_addr=ns.master, node_id=-1),
+        port=ns.port,
+        bind=ns.bind,
     )
     daemon.start()
     try:

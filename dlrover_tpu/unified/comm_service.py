@@ -213,15 +213,13 @@ class UnifiedCommService:
     @property
     def addr(self) -> str:
         """Routable address for the env export: cross-host roles must
-        not be handed a loopback. Falls back to loopback when the host
-        has no resolvable address (isolated test machines)."""
-        import socket
+        not be handed a loopback (gethostbyname(gethostname()) returns
+        127.0.1.1 on stock Debian hosts files). Honors
+        DLROVER_MASTER_HOST, else resolves the outbound interface; only
+        isolated test machines fall back to loopback."""
+        from ..common.platform import routable_host
 
-        try:
-            host = socket.gethostbyname(socket.gethostname())
-        except OSError:
-            host = "127.0.0.1"
-        return f"{host}:{self.port}"
+        return f"{routable_host(override_env='DLROVER_MASTER_HOST')}:{self.port}"
 
     @property
     def local_addr(self) -> str:

@@ -520,8 +520,18 @@ class TestProfilerDaemon:
         daemon = ProfilerDaemon(client=client, port=0)
         daemon.start()
         try:
+            # GET /dump must be side-effect free (scrapers/prefetchers
+            # issue GETs freely); the trigger verb is POST.
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{daemon.port}/dump", timeout=10
+                )
+            assert exc_info.value.code == 405
+            assert isinstance(
+                job_ctx.node_actions.next_action(0), NoAction
+            )
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{daemon.port}/dump", timeout=10
+                f"http://127.0.0.1:{daemon.port}/dump", data=b"", timeout=10
             ) as resp:
                 out = json.loads(resp.read().decode())
             assert out["dumped"] == [0]  # only the RUNNING worker

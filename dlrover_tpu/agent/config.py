@@ -50,7 +50,20 @@ class ElasticLaunchConfig:
     training_port: int = 0  # 0 → pick a free port for the jax coordinator
     log_dir: Optional[str] = None
     numa_affinity: bool = False
+    # Native PJRT profiling: "auto" enables it on TPU (the reference's
+    # xpu_timer is passive and always-on); "on"/"off" force it.
+    profile: str = "auto"
+    profiler_port: int = 0  # worker tt /metrics port (0 → agent picks)
+    profiler_daemon_port: int = 0  # rank-0 cluster daemon port (0 → any)
+    profiler_scrape_interval_s: float = 30.0
     extra_env: Dict[str, str] = field(default_factory=dict)
+
+    def profile_enabled(self) -> bool:
+        if self.profile == "on":
+            return True
+        if self.profile == "off":
+            return False
+        return self.accelerator == Accelerators.TPU
 
     def auto_configure_params(self) -> None:
         """Fill node counts from the scheduler-provided env contract.

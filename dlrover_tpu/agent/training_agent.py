@@ -77,6 +77,8 @@ class ElasticTrainingAgent:
         self._pending_action: Optional[str] = None
         self._action_lock = threading.Lock()
         self._evt = EventEmitter("agent")
+        self._metric_collector = None
+        self._profiler_daemon = None
         self._diagnosis.register_action_handler(self._on_master_action)
 
     # -- lifecycle --------------------------------------------------------
@@ -92,11 +94,13 @@ class ElasticTrainingAgent:
         self._diagnosis.start_heartbeat()
         self._resource_monitor.start()
         try:
+            self._setup_profiling()
             self._initialize_workers()
             return self._invoke_run()
         finally:
             self._diagnosis.stop()
             self._resource_monitor.stop()
+            self._teardown_profiling()
             if self._worker is not None:
                 self._worker.stop()
 
@@ -220,6 +224,65 @@ class ElasticTrainingAgent:
         except Exception as e:
             logger.warning("num_nodes_waiting failed: %s", e)
             return False
+
+    # -- native profiling (default-on product path) ------------------------
+
+    def _setup_profiling(self) -> None:
+        """Make profiling passive and automatic (reference: xpu_timer is
+        preloaded into every trainer by ``xpu_timer_launch`` and the
+        agent auto-registers the collector, diagnosis_agent.py:85).
+
+        Worker side: the interposer env goes into the worker spec so the
+        trainer's jax loads it at backend init — zero user code. Agent
+        side: the metric collector scrapes the worker's native /metrics
+        (incl. the stall verdict the master's hang check consumes) and
+        rank 0 serves the cluster-wide profiler daemon.
+        """
+        if not self._config.profile_enabled():
+            return
+        try:
+            from ..profiler.pjrt import prepare_worker_profiling_env
+
+            env = prepare_worker_profiling_env(
+                port=self._config.profiler_port
+            )
+            if env is None:
+                return  # reason already logged; never blocks training
+            self._spec.env.update(env)
+            port = int(env["DLROVER_TT_PORT"])
+            from .metric_collector import ProfilerMetricCollector
+
+            self._metric_collector = ProfilerMetricCollector(
+                port,
+                client=self._client,
+                interval_s=self._config.profiler_scrape_interval_s,
+            )
+            self._metric_collector.start()
+            logger.info("native profiling on: worker tt port %s", port)
+        except Exception as e:  # noqa: BLE001 — never blocks training
+            logger.warning("profiling setup failed: %s", e)
+            self._metric_collector = None
+            return
+        if self._config.node_rank == 0:
+            try:
+                from ..profiler.daemon import ProfilerDaemon
+
+                self._profiler_daemon = ProfilerDaemon(
+                    client=self._client,
+                    port=self._config.profiler_daemon_port,
+                )
+                self._profiler_daemon.start()
+            except Exception as e:  # noqa: BLE001 — aux service only
+                logger.warning("profiler daemon failed to start: %s", e)
+                self._profiler_daemon = None
+
+    def _teardown_profiling(self) -> None:
+        if self._metric_collector is not None:
+            self._metric_collector.stop()
+            self._metric_collector = None
+        if self._profiler_daemon is not None:
+            self._profiler_daemon.stop()
+            self._profiler_daemon = None
 
     # -- master-issued actions -------------------------------------------
 

@@ -110,6 +110,15 @@ def parse_args(args: Optional[List[str]] = None) -> argparse.Namespace:
         choices=[Accelerators.TPU, Accelerators.CPU],
     )
     parser.add_argument(
+        "--profile",
+        default="auto",
+        choices=["auto", "on", "off"],
+        help="native PJRT profiling of the worker (auto = on for TPU): "
+        "the agent loads the interposer into the worker via the env "
+        "contract, scrapes its /metrics, and rank 0 runs the cluster "
+        "profiler daemon",
+    )
+    parser.add_argument(
         "--max_restarts",
         type=int,
         default=DefaultValues.MAX_RELAUNCH_COUNT,
@@ -174,6 +183,7 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         save_at_breakpoint=ns.save_at_breakpoint,
         training_port=ns.training_port,
         log_dir=ns.log_dir,
+        profile=ns.profile,
     )
     config.auto_configure_params()
     return config

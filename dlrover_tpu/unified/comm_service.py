@@ -64,6 +64,20 @@ class UQueueReply:
 
 @register_message
 @dataclass
+class UCommStats:
+    """Ask the service for its transport byte counters (the proof that
+    payload bytes do NOT transit the master in p2p mode)."""
+
+
+@register_message
+@dataclass
+class UCommStatsReply:
+    bytes_in: int = 0
+    bytes_out: int = 0
+
+
+@register_message
+@dataclass
 class UKvSet:
     key: str = ""
     value: Any = None
@@ -93,6 +107,12 @@ class UnifiedCommServicer:
         self._queues: Dict[str, "_queue.Queue[Any]"] = {}
         self._kv: Dict[str, Any] = {}
         self._mu = threading.Lock()
+        # Transport byte counters (monotonic; read via UCommStats).
+        # Guarded: concurrent server workers would lose increments on a
+        # bare +=, and these counters are the p2p-flatness proof metric.
+        self._stats_mu = threading.Lock()
+        self.bytes_in = 0
+        self.bytes_out = 0
 
     def _q(self, name: str) -> "_queue.Queue[Any]":
         with self._mu:
@@ -161,12 +181,18 @@ class UnifiedCommServicer:
                 return UKvReply(found=True, value=self._kv[msg.key])
         return UKvReply(found=False)
 
+    def _comm_stats(self, msg: UCommStats) -> UCommStatsReply:
+        return UCommStatsReply(
+            bytes_in=self.bytes_in, bytes_out=self.bytes_out
+        )
+
     _HANDLERS = {
         UQueuePut: _put,
         UQueueGet: _get,
         UQueueStat: _stat,
         UKvSet: _kv_set,
         UKvGet: _kv_get,
+        UCommStats: _comm_stats,
     }
 
     # ServicerApi surface (both verbs dispatch the same way here)
@@ -174,19 +200,27 @@ class UnifiedCommServicer:
     def _dispatch(self, request_bytes: bytes) -> bytes:
         from ..common import comm
 
+        with self._stats_mu:
+            self.bytes_in += len(request_bytes)
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._HANDLERS.get(type(message))
         if handler is None:
-            return dumps(
+            out = dumps(
                 comm.BaseResponse(success=False, reason="unknown message")
             )
-        try:
-            result = handler(self, message)
-        except Exception as e:  # noqa: BLE001 — reported to caller
-            logger.exception("unified comm handler failed")
-            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
-        return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+        else:
+            try:
+                result = handler(self, message)
+                out = dumps(
+                    comm.BaseResponse(success=True, data=dumps(result))
+                )
+            except Exception as e:  # noqa: BLE001 — reported to caller
+                logger.exception("unified comm handler failed")
+                out = dumps(comm.BaseResponse(success=False, reason=repr(e)))
+        with self._stats_mu:
+            self.bytes_out += len(out)
+        return out
 
     def get(self, request_bytes: bytes) -> bytes:
         return self._dispatch(request_bytes)
@@ -245,17 +279,93 @@ def _comm_addr(addr: Optional[str]) -> str:
 
 
 class MasterDataQueue:
-    """Cluster-wide DataQueue: same surface as the host-local one, but
-    every operation is an RPC to the PrimeMaster's comm service — usable
-    from any host and from elastic=True roles."""
+    """Cluster-wide DataQueue: the master brokers ORDER and NAMES; the
+    payload BYTES go peer-to-peer. Large items are stored in the
+    producer's ticketed payload server and only a tiny envelope
+    ``{addr, ticket, nbytes}`` transits the master RPC; the consumer
+    fetches from the producer directly and acks. Small items (and any
+    producer-side serving failure) stay inline — the master-hosted
+    queue remains the always-works fallback. Reference shape: queue
+    actor moves references, Ray object store moves bytes
+    (unified/api/runtime/queue.py:123). Disable with
+    ``DLROVER_UNIFIED_P2P=0``."""
 
-    def __init__(self, name: str, addr: Optional[str] = None):
+    def __init__(
+        self,
+        name: str,
+        addr: Optional[str] = None,
+        p2p: Optional[bool] = None,
+    ):
         from ..rpc.client import MasterClient
+        from .payload import p2p_enabled
 
         self.name = name
         self._client = MasterClient(
             master_addr=_comm_addr(addr), node_id=-1
         )
+        self._p2p = p2p_enabled() if p2p is None else p2p
+
+    def _encode_items(self, items) -> List[Any]:
+        """Large payloads → producer-served envelopes (see class doc)."""
+        from . import payload as _p
+        from ..common.serialize import dumps as _dumps
+
+        out: List[Any] = []
+        for item in items:
+            try:
+                data = _dumps(item)
+                if len(data) < _p.INLINE_MAX:
+                    out.append(item)
+                    continue
+                server = _p.PayloadServer.singleton()
+                ticket = server.store.put(data)
+                if ticket is None:
+                    # Store full of un-fetched tickets: fall back to
+                    # inline so the master queue's back-pressure
+                    # applies instead of silently losing data.
+                    out.append(item)
+                    continue
+                out.append(
+                    {
+                        _p.ENVELOPE_KEY: 1,
+                        "addr": server.addr,
+                        "ticket": ticket,
+                        "nbytes": len(data),
+                    }
+                )
+            except Exception as e:  # noqa: BLE001 — inline always works
+                logger.warning(
+                    "p2p payload staging failed (%s); sending inline", e
+                )
+                out.append(item)
+        return out
+
+    def _decode_items(self, items) -> List[Any]:
+        """Resolve envelopes; a dead producer's ticket is unrecoverable
+        (Ray-object-owner semantics) — drop it with a warning rather
+        than wedge the consumer."""
+        from . import payload as _p
+        from ..common.serialize import loads as _loads
+
+        out: List[Any] = []
+        for item in items:
+            if not (isinstance(item, dict) and _p.ENVELOPE_KEY in item):
+                out.append(item)
+                continue
+            addr, ticket = item.get("addr", ""), item.get("ticket", "")
+            data = _p.fetch(addr, ticket)
+            if data is None:
+                logger.warning(
+                    "dropping queue item: producer %s no longer serves "
+                    "ticket %s (%s bytes)",
+                    addr,
+                    ticket,
+                    item.get("nbytes"),
+                )
+                continue
+            out.append(_loads(data))
+            _p.ack(addr, ticket)
+        return out
 
     def put(
         self,
@@ -268,7 +378,7 @@ class MasterDataQueue:
         survive the PrimeMaster's self-recovery window too."""
         deadline = None if timeout is None else time.time() + timeout
         retry_deadline = time.time() + max(retry_for, 0.0)
-        pending = list(items)
+        pending = self._encode_items(items) if self._p2p else list(items)
         while pending:
             chunk_wait = LONG_POLL_CAP_S
             if deadline is not None:
@@ -324,7 +434,15 @@ class MasterDataQueue:
             if not isinstance(reply, UQueueReply):
                 raise RuntimeError(f"queue get rejected: {reply!r}")
             if reply.items:
-                return list(reply.items)
+                # Decode is UNCONDITIONAL: envelopes are
+                # self-identifying, and a producer with p2p on may feed
+                # a consumer whose flag is off — raw envelopes must
+                # never leak out as queue items.
+                resolved = self._decode_items(reply.items)
+                if resolved:
+                    return resolved
+                # Every item was an unrecoverable envelope (producer
+                # gone) — keep polling within the deadline.
             if deadline is not None and time.time() >= deadline:
                 return []
 
@@ -333,6 +451,14 @@ class MasterDataQueue:
         if not isinstance(reply, UQueueReply):
             raise RuntimeError(f"queue stat rejected: {reply!r}")
         return int(reply.size)
+
+    def comm_stats(self) -> Dict[str, int]:
+        """The service's transport byte counters — the observable proof
+        that p2p payload bytes do not transit the master."""
+        reply = self._client.get(UCommStats())
+        if not isinstance(reply, UCommStatsReply):
+            raise RuntimeError(f"comm stats rejected: {reply!r}")
+        return {"bytes_in": reply.bytes_in, "bytes_out": reply.bytes_out}
 
     def close(self) -> None:
         close = getattr(self._client, "close", None)

@@ -1,0 +1,271 @@
+"""Peer-to-peer payload path for unified queues (VERDICT r3 #6).
+
+The master-hosted queue (:mod:`unified.comm_service`) is the broker of
+RECORD — but routing every sample batch's bytes through the master's
+2-verb RPC makes the control plane the data bottleneck and a single
+point of back-pressure for real RL jobs. The reference hands payloads
+off through Ray's object store while its queue actor only moves
+references (``dlrover/python/unified/api/runtime/queue.py:123``).
+
+TPU-native equivalent: each producer process runs ONE ticketed payload
+server (HTTP, same shared-token scheme as the checkpoint replica
+channel); ``MasterDataQueue.put`` stores the serialized item locally,
+enqueues only a tiny envelope ``{addr, ticket, nbytes}`` through the
+master, and the consumer fetches the bytes straight from the producer,
+then acks so the producer can free the ticket. Small items stay inline
+(an RPC round trip beats an extra TCP connection under ~32 KB), and any
+failure to serve locally falls back to inline — the master queue always
+works, it's just slower.
+
+Like a Ray object whose owner died, a ticket is unrecoverable once its
+producer is gone; consumers drop such envelopes with a warning instead
+of wedging forever.
+"""
+
+import hashlib
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from ..common.log import logger
+
+# Items below this serialize-size ride the master queue inline.
+INLINE_MAX = int(os.getenv("DLROVER_UNIFIED_P2P_INLINE_MAX", 32 * 1024))
+# Producer-side store cap; oldest tickets are evicted (with a warning
+# when unacked) so a consumerless queue can't OOM the producer.
+STORE_CAP_BYTES = int(
+    os.getenv("DLROVER_UNIFIED_P2P_STORE_CAP", 2 * 1024 * 1024 * 1024)
+)
+TICKET_TTL_S = float(os.getenv("DLROVER_UNIFIED_P2P_TTL_S", 600.0))
+
+ENVELOPE_KEY = "__dlrover_p2p__"
+
+
+def _token() -> str:
+    secret = os.getenv("DLROVER_UNIFIED_COMM_TOKEN")
+    if secret:
+        return secret
+    job = os.getenv("DLROVER_JOB_NAME", "default")
+    return hashlib.sha256(f"dlrover-unified-payload:{job}".encode()).hexdigest()
+
+
+class PayloadStore:
+    """Ticketed byte store with TTL + size-cap eviction."""
+
+    def __init__(
+        self, cap_bytes: int = STORE_CAP_BYTES, ttl_s: float = TICKET_TTL_S
+    ):
+        self._cap = cap_bytes
+        self._ttl = ttl_s
+        self._mu = threading.Lock()
+        # ticket -> (data, created_ts); OrderedDict gives FIFO eviction
+        self._items: "OrderedDict[str, Tuple[bytes, float]]" = OrderedDict()
+        self._bytes = 0
+        self._seq = 0
+
+    def put(self, data: bytes) -> Optional[str]:
+        """Store ``data``; None when there is no room.
+
+        Refusal, not eviction, is the overflow behavior: an enqueued
+        ticket that gets silently evicted is guaranteed data loss (the
+        master queue already accepted its envelope, every fetch 404s),
+        whereas a refusal makes the caller fall back to inline, where
+        the master queue's own back-pressure applies. Only EXPIRED
+        tickets (consumer never came; TTL) are reclaimed to make room.
+        """
+        with self._mu:
+            self._expire_locked()
+            if self._bytes + len(data) > self._cap:
+                return None
+            self._seq += 1
+            ticket = f"t{self._seq}_{os.getpid()}"
+            self._items[ticket] = (data, time.time())
+            self._bytes += len(data)
+            return ticket
+
+    def get(self, ticket: str) -> Optional[bytes]:
+        with self._mu:
+            entry = self._items.get(ticket)
+            return entry[0] if entry else None
+
+    def ack(self, ticket: str) -> None:
+        with self._mu:
+            entry = self._items.pop(ticket, None)
+            if entry:
+                self._bytes -= len(entry[0])
+
+    def _expire_locked(self) -> None:
+        now = time.time()
+        while self._items:
+            ticket, (data, ts) = next(iter(self._items.items()))
+            if now - ts <= self._ttl:
+                break
+            logger.warning(
+                "evicting expired unacked payload %s (%d bytes)",
+                ticket,
+                len(data),
+            )
+            self._items.popitem(last=False)
+            self._bytes -= len(data)
+
+    @property
+    def nbytes(self) -> int:
+        with self._mu:
+            return self._bytes
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: PayloadStore = None  # type: ignore[assignment]
+
+    def _authorized(self) -> bool:
+        return self.headers.get("X-DLRover-Token", "") == _token()
+
+    def _ticket(self) -> Optional[str]:
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 2 and parts[0] == "payload":
+            return parts[1]
+        return None
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if not self._authorized():
+            self.send_error(403)
+            return
+        ticket = self._ticket()
+        data = self.store.get(ticket) if ticket else None
+        if data is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Content-Type", "application/octet-stream")
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_DELETE(self):  # noqa: N802 — the consumer's ack
+        if not self._authorized():
+            self.send_error(403)
+            return
+        ticket = self._ticket()
+        if ticket:
+            self.store.ack(ticket)
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # quiet per-request stderr
+        pass
+
+
+class PayloadServer:
+    """One per producer process, shared by all its queues."""
+
+    _instance: Optional["PayloadServer"] = None
+    _instance_mu = threading.Lock()
+
+    def __init__(self, port: int = 0):
+        self.store = PayloadStore()
+        handler = type("Handler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="payload-server",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def addr(self) -> str:
+        from ..common.platform import routable_host
+
+        return f"{routable_host()}:{self._httpd.server_address[1]}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @classmethod
+    def singleton(cls) -> "PayloadServer":
+        with cls._instance_mu:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_singleton(cls) -> None:
+        with cls._instance_mu:
+            if cls._instance is not None:
+                cls._instance.stop()
+                cls._instance = None
+
+
+class TicketGone(Exception):
+    """The producer answered authoritatively: this ticket no longer
+    exists (404/403). Retrying is pointless."""
+
+
+def fetch_once(addr: str, ticket: str, timeout: float = 30.0) -> bytes:
+    """GET the payload from its producer. Raises :class:`TicketGone` on
+    an authoritative miss, other OSError subclasses on transient
+    failures (connection refused/reset, timeout) — the caller decides
+    whether to retry."""
+    req = urllib.request.Request(
+        f"http://{addr}/payload/{ticket}",
+        headers={"X-DLRover-Token": _token()},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise TicketGone(f"{addr}/{ticket}: HTTP {e.code}") from e
+
+
+def fetch(
+    addr: str,
+    ticket: str,
+    timeout: float = 30.0,
+    retries: int = 3,
+    retry_delay_s: float = 1.0,
+) -> Optional[bytes]:
+    """Fetch with bounded retries on TRANSIENT failures only. A
+    transient blip (producer GC pause, connection reset) must not drop
+    an item the master queue already handed out — the bytes still live
+    in the producer's store. None only when the ticket is
+    authoritatively gone or retries are exhausted."""
+    for attempt in range(max(1, retries)):
+        try:
+            return fetch_once(addr, ticket, timeout=timeout)
+        except TicketGone as e:
+            logger.warning("payload gone: %s", e)
+            return None
+        except OSError as e:
+            if attempt + 1 >= retries:
+                logger.warning(
+                    "payload fetch %s from %s failed after %d tries: %s",
+                    ticket,
+                    addr,
+                    retries,
+                    e,
+                )
+                return None
+            time.sleep(retry_delay_s)
+    return None
+
+
+def ack(addr: str, ticket: str, timeout: float = 10.0) -> None:
+    req = urllib.request.Request(
+        f"http://{addr}/payload/{ticket}",
+        method="DELETE",
+        headers={"X-DLRover-Token": _token()},
+    )
+    try:
+        urllib.request.urlopen(req, timeout=timeout).close()
+    except (urllib.error.URLError, urllib.error.HTTPError, OSError):
+        pass  # ack is best-effort; TTL eviction reclaims the ticket
+
+
+def p2p_enabled() -> bool:
+    return os.getenv("DLROVER_UNIFIED_P2P", "1") not in ("0", "false")

@@ -659,3 +659,134 @@ def test_elastic_role_consumes_master_queue(tmp_path):
     total, refused = (out / "sum").read_text().split(",")
     assert int(total) == sum(range(1, 11))
     assert refused == "True", "local DataQueue did not refuse in elastic role"
+
+
+class TestP2PPayloadPath:
+    """VERDICT r3 #6: payload bytes go producer→consumer directly; the
+    master brokers only tiny envelopes (Ray-object-store shape,
+    reference unified/api/runtime/queue.py:123)."""
+
+    @pytest.fixture()
+    def service(self):
+        from dlrover_tpu.unified.comm_service import UnifiedCommService
+        from dlrover_tpu.unified.payload import PayloadServer
+
+        svc = UnifiedCommService()
+        yield svc
+        svc.stop()
+        PayloadServer.reset_singleton()
+
+    def _big_item(self, nbytes, seed=0):
+        import numpy as np
+
+        from dlrover_tpu.unified.comm import pack_array
+
+        return {
+            "obs": pack_array(
+                np.full(nbytes // 4, seed, dtype=np.float32)
+            ),
+            "seed": seed,
+        }
+
+    def test_payload_bytes_bypass_master(self, service):
+        from dlrover_tpu.unified.comm import unpack_array
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        producer = MasterDataQueue("p2p", addr=service.local_addr)
+        consumer = MasterDataQueue("p2p", addr=service.local_addr)
+        payload = 512 * 1024  # 512 KB, far above INLINE_MAX
+        before = producer.comm_stats()["bytes_in"]
+        producer.put(*[self._big_item(payload, i) for i in range(4)])
+        master_bytes = producer.comm_stats()["bytes_in"] - before
+        assert master_bytes < payload, (
+            f"puts moved {master_bytes} bytes through the master for "
+            f"4x{payload}B items — payloads are transiting the master"
+        )
+        batch = consumer.get(batch_size=4, timeout=20)
+        assert len(batch) == 4
+        for item in batch:
+            arr = unpack_array(item["obs"])
+            assert arr.shape == (payload // 4,)
+            assert float(arr[0]) == item["seed"]
+
+    def test_master_load_flat_in_payload_size(self, service):
+        """10x the payload must not 10x the master's byte load."""
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        q = MasterDataQueue("flat", addr=service.local_addr)
+        c = MasterDataQueue("flat", addr=service.local_addr)
+
+        def master_cost(nbytes):
+            s0 = q.comm_stats()
+            q.put(self._big_item(nbytes))
+            assert len(c.get(1, timeout=20)) == 1
+            s1 = q.comm_stats()
+            return (s1["bytes_in"] - s0["bytes_in"]) + (
+                s1["bytes_out"] - s0["bytes_out"]
+            )
+
+        small = master_cost(128 * 1024)
+        big = master_cost(1280 * 1024)
+        assert big < small * 3, (small, big)
+
+    def test_small_items_stay_inline(self, service):
+        from dlrover_tpu.unified import payload as p
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        q = MasterDataQueue("inline", addr=service.local_addr)
+        c = MasterDataQueue("inline", addr=service.local_addr)
+        q.put({"tiny": 1})
+        # no payload server should have been spun up for a tiny item
+        assert p.PayloadServer._instance is None
+        assert c.get(1, timeout=10) == [{"tiny": 1}]
+
+    def test_dead_producer_item_dropped_not_wedged(self, service):
+        from dlrover_tpu.unified import payload as p
+        from dlrover_tpu.unified.comm_service import MasterDataQueue
+
+        q = MasterDataQueue("dead", addr=service.local_addr)
+        c = MasterDataQueue("dead", addr=service.local_addr)
+        q.put(self._big_item(256 * 1024))
+        p.PayloadServer.reset_singleton()  # producer dies
+        assert c.get(1, timeout=1.5) == []  # dropped, no hang
+        # queue stays usable for inline traffic afterwards
+        q.put({"ok": True})
+        assert c.get(1, timeout=10) == [{"ok": True}]
+
+    def test_store_cap_refuses_and_ttl_expires(self):
+        """Overflow REFUSES (caller falls back to inline, master queue
+        back-pressures) — never evicts a live enqueued ticket, which
+        would be guaranteed data loss. Only TTL-expired tickets are
+        reclaimed."""
+        from dlrover_tpu.unified.payload import PayloadStore
+
+        store = PayloadStore(cap_bytes=100, ttl_s=1000)
+        t1 = store.put(b"x" * 60)
+        assert store.put(b"y" * 60) is None  # no room: refused
+        assert store.get(t1) == b"x" * 60  # t1 untouched
+        store.ack(t1)
+        assert store.get(t1) is None and store.nbytes == 0
+        assert store.put(b"y" * 60) is not None  # room again
+
+        store = PayloadStore(cap_bytes=10_000, ttl_s=0.05)
+        t3 = store.put(b"z" * 10)
+        time.sleep(0.1)
+        assert store.put(b"w") is not None  # triggers the TTL sweep
+        assert store.get(t3) is None
+
+    def test_fetch_requires_token(self, service):
+        import urllib.error
+        import urllib.request
+
+        from dlrover_tpu.unified.payload import PayloadServer, fetch
+
+        server = PayloadServer.singleton()
+        ticket = server.store.put(b"secret" * 100)
+        addr = f"127.0.0.1:{server._httpd.server_address[1]}"
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://{addr}/payload/{ticket}", timeout=5
+            )
+        assert exc_info.value.code == 403
+        assert fetch(addr, ticket) == b"secret" * 100
+        PayloadServer.reset_singleton()

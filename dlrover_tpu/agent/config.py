@@ -56,6 +56,9 @@ class ElasticLaunchConfig:
     profiler_port: int = 0  # worker tt /metrics port (0 → agent picks)
     profiler_daemon_port: int = 0  # rank-0 cluster daemon port (0 → any)
     profiler_scrape_interval_s: float = 30.0
+    # Keep a pre-imported spare interpreter per agent so worker
+    # restarts skip the CPython + jax/flax import tax (elastic MTTR).
+    warm_spare: bool = True
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def profile_enabled(self) -> bool:

@@ -79,6 +79,7 @@ class ElasticTrainingAgent:
         self._evt = EventEmitter("agent")
         self._metric_collector = None
         self._profiler_daemon = None
+        self._spare = None
         self._diagnosis.register_action_handler(self._on_master_action)
 
     # -- lifecycle --------------------------------------------------------
@@ -98,9 +99,16 @@ class ElasticTrainingAgent:
             self._initialize_workers()
             return self._invoke_run()
         finally:
+            # run() returning IS the agent stopping: the deferred
+            # spare-spawn timer checks this flag, so without it a spare
+            # could be spawned (and leaked) after this cleanup ran.
+            self._stopped.set()
             self._diagnosis.stop()
             self._resource_monitor.stop()
             self._teardown_profiling()
+            if self._spare is not None:
+                self._spare.kill()
+                self._spare = None
             if self._worker is not None:
                 self._worker.stop()
 
@@ -135,7 +143,17 @@ class ElasticTrainingAgent:
             self._world.coordinator,
         )
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
-        self._worker.start(dynamic_env=self._world_env(self._world))
+        spare = self._take_spare()
+        how = self._worker.start(
+            dynamic_env=self._world_env(self._world), spare=spare
+        )
+        if how != "warm" and spare is not None:
+            if spare.proc.poll() is None:
+                # not adopted (imports still racing): keep for next time
+                self._spare = spare
+            else:
+                spare.kill()  # died during imports: release log fd/marker
+        self._replenish_spare()
         self._resource_monitor.watch_pid(self._worker.pid)
         self._report_status(NodeStatus.RUNNING)
 
@@ -148,6 +166,37 @@ class ElasticTrainingAgent:
             NodeEnv.NODE_RANK: str(self._config.node_rank),
             NodeEnv.NODE_NUM: str(world.world_size),
         }
+
+    # -- warm-spare pool (one pre-imported interpreter per agent) ---------
+
+    def _take_spare(self):
+        spare, self._spare = self._spare, None
+        return spare
+
+    # Spare spawn is DEFERRED off the recovery critical path: paying
+    # the spare's import tax while the fresh worker is itself booting
+    # doubles the CPU demand at exactly the moment MTTR is measured.
+    SPARE_SPAWN_DELAY_S = 8.0
+
+    def _replenish_spare(self) -> None:
+        """Keep exactly one warm spare on deck (spawned after a delay)."""
+        if not self._config.warm_spare or self._spare is not None:
+            return
+
+        def spawn():
+            if self._spare is not None or self._stopped.is_set():
+                return
+            from .worker import WarmSpare
+
+            try:
+                self._spare = WarmSpare(self._spec)
+            except Exception as e:  # noqa: BLE001 — an optimization only
+                logger.warning("warm spare spawn failed: %s", e)
+                self._spare = None
+
+        timer = threading.Timer(self.SPARE_SPAWN_DELAY_S, spawn)
+        timer.daemon = True
+        timer.start()
 
     def _restart_workers(self, reason: str) -> None:
         logger.info("restarting worker (%s)", reason)

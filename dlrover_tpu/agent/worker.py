@@ -177,6 +177,95 @@ class RunResult:
     signal: Optional[int] = None
 
 
+class WarmSpare:
+    """A pre-imported interpreter waiting to become the next worker.
+
+    Elastic MTTR is boot-dominated: every restart pays CPython start +
+    the jax/flax import tax before product code runs. The spare pays it
+    AHEAD of need (while the current worker trains) and turns into the
+    trainer the moment the agent writes the rendezvous env contract to
+    its stdin (see :mod:`dlrover_tpu.agent.warm_worker`).
+    """
+
+    def __init__(self, spec: "WorkerSpec", tag: str = "spare"):
+        import tempfile
+
+        self.spec = spec
+        self._ready_file = os.path.join(
+            tempfile.gettempdir(),
+            f"dlrover_warm_{os.getpid()}_{tag}_{time.time_ns()}",
+        )
+        env = dict(os.environ)
+        env.update(spec.env)
+        env["DLROVER_WARM_READY_FILE"] = self._ready_file
+        self.log_path: Optional[str] = None
+        self._log_file = None
+        if spec.log_dir:
+            os.makedirs(spec.log_dir, exist_ok=True)
+            self.log_path = os.path.join(
+                spec.log_dir, f"worker_{tag}_{time.time_ns()}.log"
+            )
+            self._log_file = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.agent.warm_worker"],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=self._log_file,
+            stderr=subprocess.STDOUT if self._log_file else None,
+            start_new_session=True,
+        )
+
+    def ready(self) -> bool:
+        return os.path.exists(self._ready_file) and self.proc.poll() is None
+
+    def wait_ready(self, timeout: float = 0.0) -> bool:
+        deadline = time.time() + timeout
+        while not self.ready():
+            if self.proc.poll() is not None or time.time() >= deadline:
+                return self.ready()
+            time.sleep(0.05)
+        return True
+
+    def hand_off(self, dynamic_env: Dict[str, str]) -> None:
+        """Turn the spare into the worker (irreversible)."""
+        import json
+
+        contract = {
+            "env": dynamic_env,
+            "entrypoint": self.spec.entrypoint,
+            "args": list(self.spec.args),
+            "run_module": self.spec.run_module,
+        }
+        self.proc.stdin.write((json.dumps(contract) + "\n").encode())
+        self.proc.stdin.flush()
+        self.proc.stdin.close()
+        self._cleanup_marker()
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if self._log_file is not None:
+            try:
+                self._log_file.close()
+            finally:
+                self._log_file = None
+        self._cleanup_marker()
+
+    def detach_log(self):
+        """(path, file) handed to the adopting WorkerProcess."""
+        log_file, self._log_file = self._log_file, None
+        return self.log_path, log_file
+
+    def _cleanup_marker(self) -> None:
+        try:
+            os.unlink(self._ready_file)
+        except OSError:
+            pass
+
+
 class WorkerProcess:
     """One supervised training process."""
 
@@ -196,38 +285,68 @@ class WorkerProcess:
     def log_path(self) -> Optional[str]:
         return self._log_path
 
-    def start(self, dynamic_env: Optional[Dict[str, str]] = None) -> None:
-        env = dict(os.environ)
-        env.update(self.spec.env)
-        if dynamic_env:
-            env.update(dynamic_env)
-        env[NodeEnv.RESTART_COUNT] = str(self.restart_count)
+    def start(
+        self,
+        dynamic_env: Optional[Dict[str, str]] = None,
+        spare: Optional[WarmSpare] = None,
+    ) -> str:
+        """Start (or adopt the warm ``spare`` as) the worker; returns
+        "warm" or "cold"."""
+        contract_env = dict(dynamic_env or {})
+        contract_env[NodeEnv.RESTART_COUNT] = str(self.restart_count)
 
-        if self.spec.run_module:
-            cmd = [sys.executable, "-m", self.spec.entrypoint]
-        else:
-            cmd = [sys.executable, self.spec.entrypoint]
-        cmd += list(self.spec.args)
+        adopted = False
+        if spare is not None and not spare.wait_ready(timeout=2.0):
+            logger.warning("warm spare not ready; cold-starting")
+        elif spare is not None:
+            # Adopt the warm spare: imports already paid, process
+            # becomes the trainer on the contract line. A spare dying
+            # between the ready check and the handoff write must fall
+            # back to cold start, not abort the recovery.
+            try:
+                self._log_path, self._log_file = spare.detach_log()
+                spare.hand_off(contract_env)
+                self._proc = spare.proc
+                adopted = True
+                how = "warm"
+            except OSError as e:
+                logger.warning(
+                    "warm spare died during handoff (%s); cold-starting", e
+                )
+                spare.kill()
+                self._log_path = None
+                self._close_log()
+        if not adopted:
+            env = dict(os.environ)
+            env.update(self.spec.env)
+            env.update(contract_env)
 
-        stdout = None
-        if self.spec.log_dir:
-            os.makedirs(self.spec.log_dir, exist_ok=True)
-            self._log_path = os.path.join(
-                self.spec.log_dir, f"worker_{self.restart_count}.log"
+            if self.spec.run_module:
+                cmd = [sys.executable, "-m", self.spec.entrypoint]
+            else:
+                cmd = [sys.executable, self.spec.entrypoint]
+            cmd += list(self.spec.args)
+
+            stdout = None
+            if self.spec.log_dir:
+                os.makedirs(self.spec.log_dir, exist_ok=True)
+                self._log_path = os.path.join(
+                    self.spec.log_dir, f"worker_{self.restart_count}.log"
+                )
+                self._log_file = open(self._log_path, "wb")
+                stdout = self._log_file
+
+            # New process group so teardown can kill the whole tree
+            # (grand-children like dataloader workers), mirroring orphan
+            # reaping in the reference (training.py:616).
+            self._proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None,
+                start_new_session=True,
             )
-            self._log_file = open(self._log_path, "wb")
-            stdout = self._log_file
-
-        # New process group so teardown can kill the whole tree (grand-
-        # children like dataloader workers), mirroring orphan reaping in
-        # the reference (training.py:616).
-        self._proc = subprocess.Popen(
-            cmd,
-            env=env,
-            stdout=stdout,
-            stderr=subprocess.STDOUT if stdout else None,
-            start_new_session=True,
-        )
+            how = "cold"
         self.start_time = time.time()
         try:
             start_ticks = _proc_starttime(self._proc.pid)
@@ -236,11 +355,13 @@ class WorkerProcess:
         except OSError:
             logger.warning("could not write worker pidfile")
         logger.info(
-            "started worker pid=%s restart=%s cmd=%s",
+            "started worker pid=%s restart=%s (%s) entry=%s",
             self._proc.pid,
             self.restart_count,
-            " ".join(cmd),
+            how,
+            self.spec.entrypoint,
         )
+        return how
 
     def poll(self) -> RunResult:
         if self._proc is None:

@@ -150,6 +150,262 @@ class JobRunningResourceAlgorithm:
         )
 
 
+class JobInitAdjustAlgorithm:
+    """Early-stage sanity adjust (reference
+    ``optimize_job_worker_init_adjust_resource.go``): once the first
+    real samples land, compare the job's observed speed against what
+    history PREDICTS for its size. A job running far below its cohort
+    is misconfigured (bad host, wrong batch size, thermal throttle) —
+    flag it and recommend the cohort's knee rather than letting the
+    online optimizer slow-walk the discovery."""
+
+    # below this fraction of the cohort's speed at the same size, the
+    # job is anomalous, not just noisy
+    UNDERPERF_FRACTION = 0.6
+
+    def __init__(self, store: BrainDataStore, min_gain: float = 0.4):
+        self._store = store
+        self._min_gain = min_gain
+
+    def optimize(
+        self,
+        job_uuid: str,
+        node_unit: int = 1,
+        max_workers: int = 0,
+    ) -> OptimizePlan:
+        job = self._store.get_job(job_uuid)
+        if job is None:
+            return OptimizePlan(reason=f"unknown job {job_uuid}")
+        own = self._store.speed_by_world_size([job_uuid])
+        if not own:
+            return OptimizePlan(reason="no samples yet")
+        similar = [
+            j
+            for j in self._store.similar_jobs(
+                job.model_signature, job.workload
+            )
+            if j.job_uuid != job_uuid
+        ]
+        if not similar:
+            return OptimizePlan(reason="no cohort to compare against")
+        cohort = self._store.speed_by_world_size(
+            [j.job_uuid for j in similar]
+        )
+        size, speed = max(own.items())  # newest/largest observed size
+        expected = cohort.get(size)
+        if expected is None:
+            # Interpolate between BRACKETING cohort sizes only. Linear
+            # extrapolation through the origin past the cohort's
+            # largest observation assumes linear scaling — the exact
+            # assumption saturating curves violate — and would flag
+            # healthy large jobs as anomalous.
+            smaller = [s for s in cohort if s < size]
+            larger = [s for s in cohort if s > size]
+            if smaller and larger:
+                s0, s1 = max(smaller), min(larger)
+                frac = (size - s0) / (s1 - s0)
+                expected = cohort[s0] + frac * (cohort[s1] - cohort[s0])
+        if not expected or expected <= 0:
+            return OptimizePlan(reason="cohort has no comparable size")
+        ratio = speed / expected
+        if ratio >= self.UNDERPERF_FRACTION:
+            return OptimizePlan(
+                reason=f"healthy: {ratio:.0%} of cohort speed at {size} hosts",
+                extra={"cohort_ratio": round(ratio, 3)},
+            )
+        limit = max_workers or max(cohort)
+        knee = _knee_of_curve(cohort, node_unit, limit, self._min_gain)
+        self._store.add_event(
+            job_uuid,
+            "init_underperformance",
+            detail=f"{ratio:.2f} of cohort at {size} hosts",
+        )
+        return OptimizePlan(
+            worker_num=knee,
+            predicted_speed=cohort.get(knee, 0.0),
+            reason=(
+                f"underperforming cohort ({ratio:.0%} of expected "
+                f"{expected:.2f} steps/s at {size} hosts) — check for a "
+                f"slow host; cohort knee is {knee}"
+            ),
+            extra={"cohort_ratio": round(ratio, 3), "anomaly": True},
+        )
+
+
+class CompletionTimePredictor:
+    """Deadline-aware sizing: predict remaining wall time at candidate
+    world sizes from the speed curve (own + cohort) and pick the
+    SMALLEST size that meets the deadline — the reference Brain's
+    training-speed estimators serve the same 'what do I need to finish
+    by X' question; hosts beyond that size are quota other jobs could
+    use."""
+
+    def __init__(self, store: BrainDataStore, min_gain: float = 0.4):
+        self._store = store
+        self._min_gain = min_gain
+
+    def optimize(
+        self,
+        job_uuid: str,
+        remaining_steps: int,
+        deadline_s: float,
+        node_unit: int = 1,
+        max_workers: int = 0,
+    ) -> OptimizePlan:
+        job = self._store.get_job(job_uuid)
+        if job is None:
+            return OptimizePlan(reason=f"unknown job {job_uuid}")
+        own = self._store.speed_by_world_size([job_uuid])
+        cohort = self._store.speed_by_world_size(
+            [
+                j.job_uuid
+                for j in self._store.similar_jobs(
+                    job.model_signature, job.workload
+                )
+            ]
+        )
+        curve = dict(cohort)
+        curve.update(own)
+        if not curve or remaining_steps <= 0 or deadline_s <= 0:
+            return OptimizePlan(reason="insufficient data for prediction")
+        limit = max_workers or max(curve)
+        # Candidates are the OBSERVED sizes (snapping first would index
+        # the curve at keys that were never measured and silently drop
+        # cohorts run at off-granularity sizes); the final pick is
+        # rounded UP to slice granularity — a bigger slice only
+        # finishes sooner.
+        etas = {
+            s: remaining_steps / speed
+            for s, speed in curve.items()
+            if 0 < s <= limit and speed > 0
+        }
+        feasible = [s for s, eta in etas.items() if eta <= deadline_s]
+        if feasible:
+            observed = min(feasible)
+            pick = -(-observed // node_unit) * node_unit
+            if pick > limit:
+                # rounding up crossed the caller's cap: stay at the
+                # observed (in-quota) size even if off-granularity
+                pick = observed
+            return OptimizePlan(
+                worker_num=pick,
+                predicted_speed=curve[observed],
+                reason=(
+                    f"{remaining_steps} steps in {etas[observed]:.0f}s at "
+                    f"{observed} hosts meets the {deadline_s:.0f}s deadline"
+                    + (
+                        f" (rounded to slice multiple {pick})"
+                        if pick != observed
+                        else ""
+                    )
+                ),
+                extra={"eta_s": {str(s): round(e, 1) for s, e in etas.items()}},
+            )
+        # nothing meets it: recommend the knee (fastest EFFICIENT size)
+        # and say so — burning hosts past the knee won't save the
+        # deadline either.
+        knee = _knee_of_curve(curve, node_unit, limit, self._min_gain)
+        best_eta = min(etas.values()) if etas else 0.0
+        return OptimizePlan(
+            worker_num=knee,
+            predicted_speed=curve.get(knee, 0.0),
+            reason=(
+                f"deadline unreachable (best ETA {best_eta:.0f}s > "
+                f"{deadline_s:.0f}s); recommending the efficiency knee {knee}"
+            ),
+            extra={"deadline_unreachable": True},
+        )
+
+
+class ClusterResourceArbiter:
+    """Cross-JOB host allocation — the genuinely cluster-level piece of
+    the reference Brain (its optimizers mine a cross-job datastore to
+    size every job against shared quota). Given the running jobs and a
+    host pool, allocate hosts greedily by MARGINAL throughput gain per
+    host (each job's gain read off its own/cohort speed curve), so a
+    saturated job never holds hosts a scaling job could convert into
+    cluster throughput."""
+
+    def __init__(self, store: BrainDataStore):
+        self._store = store
+
+    def _curve(self, job) -> Dict[int, float]:
+        curve = self._store.speed_by_world_size(
+            [
+                j.job_uuid
+                for j in self._store.similar_jobs(
+                    job.model_signature, job.workload
+                )
+            ]
+        )
+        curve.update(self._store.speed_by_world_size([job.job_uuid]))
+        return curve
+
+    @staticmethod
+    def _marginal(curve: Dict[int, float], size: int, unit: int) -> float:
+        """Estimated steps/s gained by growing ``size`` -> ``size+unit``,
+        interpolated/extrapolated from the observed points."""
+        if not curve:
+            return 0.0
+        nxt = size + unit
+        if size in curve and nxt in curve:
+            return curve[nxt] - curve[size]
+        sizes = sorted(curve)
+        below = [s for s in sizes if s <= size]
+        above = [s for s in sizes if s > size]
+        if below and above:
+            # interpolate: linear fit through the bracketing points
+            s0, s1 = below[-1], above[0]
+        elif len(sizes) >= 2:
+            # extrapolate with the TAIL slope (the two largest
+            # observed sizes). Average throughput (curve[s]/s) here
+            # would report a large "marginal" gain for a SATURATED
+            # curve — e.g. {1: 10, 8: 11} averages 1.4/host while the
+            # real tail marginal is 0.14 — and the greedy allocator
+            # would feed the whole pool to exactly the job that can't
+            # use it.
+            s0, s1 = sizes[-2], sizes[-1]
+        else:
+            s0 = s1 = sizes[0]
+        if s0 == s1:
+            # single observed point: no slope is knowable; claim
+            # nothing rather than inventing linear scaling
+            return 0.0
+        slope = (curve[s1] - curve[s0]) / (s1 - s0)
+        return max(0.0, slope * unit)
+
+    def allocate(
+        self,
+        job_uuids,
+        total_hosts: int,
+        node_unit: int = 1,
+    ) -> Dict[str, int]:
+        """{job_uuid: host_count} summing to ≤ total_hosts. Every known
+        job gets at least one slice (starvation-free); remaining slices
+        go to the highest marginal gain."""
+        jobs = [
+            j
+            for j in (self._store.get_job(u) for u in job_uuids)
+            if j is not None
+        ]
+        if not jobs or total_hosts < node_unit * len(jobs):
+            return {}
+        alloc = {j.job_uuid: node_unit for j in jobs}
+        curves = {j.job_uuid: self._curve(j) for j in jobs}
+        spare = total_hosts - node_unit * len(jobs)
+        while spare >= node_unit:
+            gains = {
+                u: self._marginal(curves[u], alloc[u], node_unit)
+                for u in alloc
+            }
+            u_best = max(gains, key=lambda u: gains[u])
+            if gains[u_best] <= 0:
+                break  # everyone saturated; leave the rest in the pool
+            alloc[u_best] += node_unit
+            spare -= node_unit
+        return alloc
+
+
 class OomRecoveryAlgorithm:
     """Memory bump after an OOM (reference
     ``optimize_job_worker_create_oom_resource.go``): factor increase over

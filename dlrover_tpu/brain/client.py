@@ -104,6 +104,7 @@ class BrainClient:
         current_workers: int = 0,
         node_unit: int = 1,
         max_workers: int = 0,
+        extra: Optional[dict] = None,
     ) -> Optional[bm.BrainOptimizeResponse]:
         try:
             resp = self._client.get(
@@ -115,6 +116,7 @@ class BrainClient:
                     current_workers=current_workers,
                     node_unit=node_unit,
                     max_workers=max_workers,
+                    extra=dict(extra or {}),
                 )
             )
             if isinstance(resp, bm.BrainOptimizeResponse):
@@ -122,6 +124,25 @@ class BrainClient:
             return None
         except Exception as e:  # noqa: BLE001
             logger.debug("brain optimize(%s) unreachable: %r", stage, e)
+            return None
+
+    def get_cluster_allocation(
+        self, job_uuids, total_hosts: int, node_unit: int = 1
+    ) -> Optional[dict]:
+        """{job_uuid: hosts} from the Brain's cross-job arbiter."""
+        try:
+            resp = self._client.get(
+                bm.BrainAllocateRequest(
+                    job_uuids=list(job_uuids),
+                    total_hosts=total_hosts,
+                    node_unit=node_unit,
+                )
+            )
+            if isinstance(resp, bm.BrainAllocateResponse):
+                return dict(resp.allocation)
+            return None
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain allocate unreachable: %r", e)
             return None
 
     def get_job_info(self, job_uuid: str) -> Optional[bm.BrainJobInfo]:

@@ -52,13 +52,33 @@ class BrainEventReport:
 class BrainOptimizeRequest:
     """Stage-based optimize query (reference brain_pb2 optimize RPC)."""
 
-    stage: str = "create"  # create | running | oom
+    # create | running | init_adjust | deadline | oom
+    stage: str = "create"
     job_uuid: str = ""
     model_signature: str = ""
     workload: str = ""
     current_workers: int = 0
     node_unit: int = 1
     max_workers: int = 0
+    # stage-specific knobs (deadline: remaining_steps, deadline_s)
+    extra: Dict = field(default_factory=dict)
+
+
+@register_message
+@dataclass
+class BrainAllocateRequest:
+    """Cross-job host arbitration: split ``total_hosts`` across the
+    running jobs by marginal-throughput gain."""
+
+    job_uuids: list = field(default_factory=list)
+    total_hosts: int = 0
+    node_unit: int = 1
+
+
+@register_message
+@dataclass
+class BrainAllocateResponse:
+    allocation: Dict = field(default_factory=dict)  # job_uuid -> hosts
 
 
 @register_message

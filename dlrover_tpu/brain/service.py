@@ -34,6 +34,13 @@ class BrainServicer(ServicerApi):
         self._create_algo = JobCreateResourceAlgorithm(store, min_gain)
         self._running_algo = JobRunningResourceAlgorithm(store, min_gain)
         self._oom_algo = OomRecoveryAlgorithm(store, memory_limit_mb)
+        from .algorithms import (
+            CompletionTimePredictor,
+            JobInitAdjustAlgorithm,
+        )
+
+        self._init_adjust_algo = JobInitAdjustAlgorithm(store, min_gain)
+        self._deadline_algo = CompletionTimePredictor(store, min_gain)
 
     # -- transport entry points -------------------------------------------
 
@@ -85,6 +92,16 @@ class BrainServicer(ServicerApi):
                 result = self._optimize(msg)
             elif isinstance(msg, bm.BrainJobQuery):
                 result = self._job_info(msg)
+            elif isinstance(msg, bm.BrainAllocateRequest):
+                from .algorithms import ClusterResourceArbiter
+
+                result = bm.BrainAllocateResponse(
+                    allocation=ClusterResourceArbiter(self._store).allocate(
+                        msg.job_uuids,
+                        msg.total_hosts,
+                        node_unit=msg.node_unit,
+                    )
+                )
             else:
                 return dumps(
                     comm.BaseResponse(success=False, reason="unknown message")
@@ -108,6 +125,20 @@ class BrainServicer(ServicerApi):
             plan = self._running_algo.optimize(
                 msg.job_uuid,
                 current_workers=msg.current_workers,
+                node_unit=msg.node_unit,
+                max_workers=msg.max_workers,
+            )
+        elif msg.stage == "init_adjust":
+            plan = self._init_adjust_algo.optimize(
+                msg.job_uuid,
+                node_unit=msg.node_unit,
+                max_workers=msg.max_workers,
+            )
+        elif msg.stage == "deadline":
+            plan = self._deadline_algo.optimize(
+                msg.job_uuid,
+                remaining_steps=int(msg.extra.get("remaining_steps", 0)),
+                deadline_s=float(msg.extra.get("deadline_s", 0.0)),
                 node_unit=msg.node_unit,
                 max_workers=msg.max_workers,
             )

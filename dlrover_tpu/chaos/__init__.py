@@ -1,0 +1,14 @@
+"""Chaos/fault-injection harnesses.
+
+The reference validates its fault-tolerance story with chaosblade
+experiments against live clusters (docs/tech_report/
+fault_tolerance_exps.md: preempt pod, fault node, process kill). The
+TPU build's equivalent is programmatic: these harnesses run a real
+master + real agent processes + real trainers on one machine and
+inject failures, returning the measured outcome (e.g. goodput under a
+preemption storm) so both the test suite and the benchmark can assert
+on it.
+"""
+
+from .harness import cleanup_namespaces, make_process_master  # noqa: F401
+from .goodput_storm import run_goodput_storm  # noqa: F401

@@ -1,0 +1,65 @@
+"""Process-backed multi-"node" harness: one in-process master + N real
+agent processes, each supervising a real trainer — the reference's
+multi-node-without-cluster trick (SURVEY.md §4) packaged for chaos
+experiments and e2e tests. Moved here from tests/e2e_utils.py so the
+benchmark can drive the same harness."""
+
+import os
+from typing import Dict, List
+
+from ..master.dist_master import DistributedJobMaster
+from ..master.scaler.base_scaler import NoopScaler
+from ..master.scaler.process_scaler import ProcessNodeSpec, ProcessScaler
+from ..master.watcher.process_watcher import ProcessWatcher
+
+
+def cleanup_namespaces(job_name: str, num_workers: int) -> None:
+    """Kill stale workers and unlink shm left by an aborted prior run."""
+    from ..agent.worker import kill_worker_by_pidfile
+
+    for node in range(num_workers):
+        ns = f"{job_name}_n{node}"
+        kill_worker_by_pidfile(ns)
+        for name in os.listdir("/dev/shm"):
+            if name.startswith(f"dlrover_{ns}_"):
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+
+
+def make_process_master(
+    job_name: str,
+    command: List[str],
+    env: Dict[str, str],
+    num_workers: int = 2,
+    node_unit: int = 1,
+    max_workers: int = 0,
+):
+    """(master, scaler, watcher) wired together: the master is built with
+    a placeholder scaler (its RPC port must exist before the real scaler
+    can point agents at it), then the ProcessScaler/Watcher are swapped
+    in. Callers own master.stop() + scaler.stop()."""
+    cleanup_namespaces(job_name, max(num_workers, max_workers or 0))
+    master = DistributedJobMaster(
+        scaler=NoopScaler(),
+        watcher=None,
+        num_workers=num_workers,
+        max_workers=max_workers,
+        node_unit=node_unit,
+        job_name=job_name,
+        pre_check_ops=[],
+        fresh_context=True,
+    )
+    spec = ProcessNodeSpec(command=list(command), env=dict(env))
+    scaler = ProcessScaler(
+        spec,
+        master_addr=master.addr,
+        job_name=job_name,
+        num_workers=num_workers,
+    )
+    watcher = ProcessWatcher(scaler, poll_interval_s=0.5)
+    master.job_manager._scaler = scaler
+    master.job_manager._watcher = watcher
+    master.auto_scaler._scaler = scaler
+    return master, scaler, watcher

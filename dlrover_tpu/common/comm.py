@@ -418,6 +418,8 @@ class JobStatusResponse:
     exit_reason: str = ""
     # live training health (reference headline metric: goodput %)
     goodput: float = 0.0
+    # productive fraction once training began (excludes provisioning)
+    training_goodput: float = 0.0
     steps_per_second: float = 0.0
     last_step: int = 0
 

@@ -125,6 +125,13 @@ def parse_args(args: Optional[List[str]] = None) -> argparse.Namespace:
         help="in-place worker restart budget before asking for relaunch",
     )
     parser.add_argument(
+        "--monitor_interval",
+        type=float,
+        default=DefaultValues.MONITOR_INTERVAL_S,
+        help="agent supervision poll seconds (worker health + membership "
+        "changes); lower = faster elastic reaction, more master RPCs",
+    )
+    parser.add_argument(
         "--training_port",
         type=int,
         default=0,
@@ -184,6 +191,7 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         training_port=ns.training_port,
         log_dir=ns.log_dir,
         profile=ns.profile,
+        monitor_interval=ns.monitor_interval,
     )
     config.auto_configure_params()
     return config

@@ -34,6 +34,9 @@ class PerfMonitor:
         self._total_steps = 0
         self._productive_s = 0.0
         self._step_dts: Deque[float] = deque(maxlen=window)
+        # timestamp of the FIRST step report ever (the samples deque is
+        # a sliding window, so its head is not the first)
+        self._first_sample_ts = 0.0
 
     def collect_global_step(self, step: int, timestamp: float = 0.0) -> None:
         timestamp = timestamp or time.time()
@@ -63,6 +66,8 @@ class PerfMonitor:
                         credited = min(dt, _FIRST_INTERVAL_CAP_S)
                         self._step_dts.append(credited)
                         self._productive_s += credited
+            if not self._first_sample_ts:
+                self._first_sample_ts = timestamp
             self._samples.append((step, timestamp))
             self._total_steps = step
 
@@ -71,11 +76,27 @@ class PerfMonitor:
         job) started; 0.0 until the first step interval lands. Elapsed
         extends to the newest report timestamp so reporter-side clocks
         slightly ahead of ours can't inflate the ratio."""
+        return self._goodput(since_first_step=False)
+
+    def training_goodput(self) -> float:
+        """Productive fraction of wall time since TRAINING began (the
+        first step report). The strict :meth:`goodput` charges
+        provisioning (pod scheduling, rendezvous, first worker boot) to
+        the job; this one isolates the fault-tolerance machinery's own
+        efficiency — the number flash checkpointing and fast recovery
+        actually control. Both are reported; neither replaces the
+        other."""
+        return self._goodput(since_first_step=True)
+
+    def _goodput(self, since_first_step: bool) -> float:
         with self._lock:
             now = time.time()
             if self._samples:
                 now = max(now, self._samples[-1][1])
-            elapsed = now - self._start_time
+            start = self._start_time
+            if since_first_step and self._first_sample_ts:
+                start = max(start, self._first_sample_ts)
+            elapsed = now - start
             if elapsed <= 0 or self._productive_s <= 0:
                 return 0.0
             return min(1.0, self._productive_s / elapsed)

@@ -144,6 +144,16 @@ class ProcessScaler(Scaler):
         with self._lock:
             return {nid: h.returncode() for nid, h in self._procs.items()}
 
+    def node_pid(self, node_id: int) -> Optional[int]:
+        """PID of a live node's agent process (None when absent/exited).
+        Public contract for fault injection (chaos harnesses SIGKILL the
+        process group) — callers must not reach into ``_procs``."""
+        with self._lock:
+            handle = self._procs.get(node_id)
+            if handle is None or handle.proc.poll() is not None:
+                return None
+            return handle.proc.pid
+
     def stop(self) -> None:
         with self._lock:
             for node_id in list(self._procs):

@@ -279,16 +279,18 @@ class MasterServicer:
         return comm.ClusterDumpResponse(node_ids=sorted(dumped))
 
     def _job_status(self, msg: comm.JobStatusRequest) -> comm.JobStatusResponse:
-        goodput = sps = 0.0
+        goodput = training_goodput = sps = 0.0
         last_step = 0
         if self._perf_monitor is not None:
             goodput = self._perf_monitor.goodput()
+            training_goodput = self._perf_monitor.training_goodput()
             sps = self._perf_monitor.steps_per_second()
             last_step, _ = self._perf_monitor.last_step()
         return comm.JobStatusResponse(
             stage=self._job_ctx.job_stage,
             exit_reason=self._job_ctx.job_exit_reason,
             goodput=goodput,
+            training_goodput=training_goodput,
             steps_per_second=sps,
             last_step=last_step,
         )

@@ -1,0 +1,240 @@
+"""Arbitrary-function host tracer on ``sys.monitoring`` (PEP 669).
+
+Reference: ``xpu_timer/python/py_tracing.c`` (501 LoC) times arbitrary
+Python functions — above all the dataloader's ``__next__`` — and
+``py_syshook.c`` captures crash exceptions, both at the C level so the
+cost is paid only on the traced functions. CPython 3.12's
+``sys.monitoring`` gives the same property natively: events are enabled
+*per code object* (``set_local_events``), so untraced code runs with
+ZERO instrumentation — no global trace function, no per-call Python
+dispatch anywhere except on the targets.
+
+Every traced call lands in the native tpu_timer core
+(``host_py_<name>`` records), i.e. the SAME ring/metrics/timeline as
+device executes and GC pauses — a straggler whose cause is a slow
+dataloader is attributable at a glance, with no user annotations
+(:class:`ElasticTrainLoop` auto-targets its data iterator; extra
+targets come from ``DLROVER_PY_TRACE_TARGETS=module:qualname,...``).
+
+Generators are first-class: a generator-based dataloader's per-item
+cost is the PY_RESUME→PY_YIELD span, which is exactly what gets
+recorded (a plain PY_START→PY_RETURN would count the whole generator
+lifetime once).
+"""
+
+import importlib
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..common.log import logger
+from .native import KIND_OTHER, TpuTimer
+
+TARGETS_ENV = "DLROVER_PY_TRACE_TARGETS"
+
+_mon = sys.monitoring
+# PROFILER_ID is the conventional slot for profiling tools; only one
+# tool per slot, so a co-resident profiler (cProfile) would conflict —
+# install() degrades gracefully in that case.
+_TOOL_ID = _mon.PROFILER_ID
+
+
+def _now_us() -> int:
+    return int(time.perf_counter_ns() // 1000)
+
+
+def _code_of(target: Any):
+    """Best-effort code object of a callable/iterator."""
+    fn = target
+    if hasattr(fn, "__func__"):  # bound method
+        fn = fn.__func__
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        return code
+    # generator / coroutine instance
+    return getattr(target, "gi_code", None)
+
+
+class FunctionTracer:
+    """Times configured target functions into the tpu_timer core."""
+
+    _instance: Optional["FunctionTracer"] = None
+    _instance_mu = threading.Lock()
+
+    def __init__(self, timer: Optional[TpuTimer] = None):
+        self.timer = timer or TpuTimer.singleton()
+        self._names: Dict[Any, str] = {}  # code -> display name
+        self._installed = False
+        self._tls = threading.local()
+        self.calls = 0
+
+    @classmethod
+    def singleton(cls) -> "FunctionTracer":
+        with cls._instance_mu:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # -- target configuration ---------------------------------------------
+
+    def add_target(self, target: Any, name: str = "") -> bool:
+        """Trace ``target`` (callable, bound method, generator instance,
+        or an already-resolved code object). Returns False when no code
+        object can be found (C-implemented callables can't be traced
+        here — the reference has the same limit for builtins)."""
+        code = target if hasattr(target, "co_code") else _code_of(target)
+        if code is None:
+            return False
+        self._names[code] = name or getattr(code, "co_qualname", code.co_name)
+        if self._installed:
+            self._enable_code(code)
+        return True
+
+    def add_iterator(self, it: Any, name: str = "data_iter") -> bool:
+        """Auto-target a data iterator: its generator frame, or the
+        Python-level ``__next__`` of its type."""
+        code = getattr(it, "gi_code", None)
+        if code is not None:
+            return self.add_target(code, name)
+        nxt = getattr(type(it), "__next__", None)
+        if nxt is not None and self.add_target(nxt, name):
+            return True
+        return False
+
+    def add_spec(self, spec: str) -> bool:
+        """``module:qualname`` (e.g. ``my_data:Loader.__next__``)."""
+        mod_name, _, qual = spec.partition(":")
+        try:
+            obj: Any = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+        except (ImportError, AttributeError) as e:
+            logger.warning("untraceable target %r: %s", spec, e)
+            return False
+        return self.add_target(obj, name=qual)
+
+    def add_env_targets(self) -> int:
+        n = 0
+        for spec in filter(None, os.getenv(TARGETS_ENV, "").split(",")):
+            n += bool(self.add_spec(spec.strip()))
+        return n
+
+    # -- sys.monitoring plumbing ------------------------------------------
+
+    _EVENTS = 0  # filled at class definition end
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _on_enter(self, code, offset) -> Any:
+        if code in self._names:
+            self._stack().append(_now_us())
+            return None
+        return _mon.DISABLE  # never fire again for this code object
+
+    def _on_exit(self, code, offset, retval) -> Any:
+        name = self._names.get(code)
+        if name is None:
+            return _mon.DISABLE
+        stack = self._stack()
+        if stack:
+            t0 = stack.pop()
+            now = _now_us()
+            self.calls += 1
+            self.timer.record(f"host_py_{name}", KIND_OTHER, t0, now - t0)
+        return None
+
+    def _on_unwind(self, code, offset, exc) -> Any:
+        # PY_UNWIND has no DISABLE; just keep stacks balanced when a
+        # traced function raises.
+        if code in self._names:
+            stack = self._stack()
+            if stack:
+                stack.pop()
+        return None
+
+    def _enable_code(self, code) -> None:
+        _mon.set_local_events(_TOOL_ID, code, self._EVENTS)
+
+    def install(self) -> bool:
+        if self._installed:
+            return True
+        try:
+            _mon.use_tool_id(_TOOL_ID, "dlrover_tpu")
+        except ValueError:
+            logger.warning(
+                "sys.monitoring profiler slot taken; host tracer disabled"
+            )
+            return False
+        E = _mon.events
+        _mon.register_callback(_TOOL_ID, E.PY_START, self._on_enter)
+        _mon.register_callback(_TOOL_ID, E.PY_RESUME, self._on_enter)
+        _mon.register_callback(_TOOL_ID, E.PY_RETURN, self._on_exit)
+        _mon.register_callback(_TOOL_ID, E.PY_YIELD, self._on_exit)
+        _mon.register_callback(_TOOL_ID, E.PY_UNWIND, self._on_unwind)
+        # PY_UNWIND is global-only (set_local_events rejects it); it
+        # fires when an exception propagates OUT of a frame — e.g. the
+        # traced dataloader's StopIteration — and the callback is a dict
+        # miss for everything untraced.
+        _mon.set_events(_TOOL_ID, E.PY_UNWIND)
+        self._installed = True
+        for code in self._names:
+            self._enable_code(code)
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for code in self._names:
+            try:
+                _mon.set_local_events(_TOOL_ID, code, 0)
+            except ValueError:
+                pass
+        _mon.set_events(_TOOL_ID, 0)
+        _mon.free_tool_id(_TOOL_ID)
+        self._installed = False
+
+
+FunctionTracer._EVENTS = (
+    _mon.events.PY_START
+    | _mon.events.PY_RESUME
+    | _mon.events.PY_RETURN
+    | _mon.events.PY_YIELD
+)
+
+
+# -- crash exception hook ----------------------------------------------------
+
+
+def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
+    """Record uncaught exceptions (main thread AND worker threads) into
+    the profiler stream before the process dies, so a post-mortem
+    timeline shows WHAT killed the trainer next to what it was doing
+    (reference: py_syshook.c). Chains to the previous hooks — the
+    events-SDK crash flush (common/error_handler.py) still runs."""
+    t = timer or TpuTimer.singleton()
+    prev_except = sys.excepthook
+    prev_thread = threading.excepthook
+
+    def _record(exc_type, exc) -> None:
+        try:
+            now = _now_us()
+            t.record(f"host_crash_{exc_type.__name__}", KIND_OTHER, now, 1)
+        except Exception:  # noqa: BLE001 — never mask the real crash
+            pass
+
+    def hook(exc_type, exc, tb):
+        _record(exc_type, exc)
+        prev_except(exc_type, exc, tb)
+
+    def thread_hook(args):
+        _record(args.exc_type, args.exc_value)
+        prev_thread(args)
+
+    sys.excepthook = hook
+    threading.excepthook = thread_hook

@@ -55,6 +55,7 @@ class ElasticTrainLoop:
         log_every: int = 10,
         on_step: Optional[Callable[[int, float], None]] = None,
         device_monitor: bool = True,
+        trace_host: bool = True,
     ):
         self.engine = engine
         self.step_fn = step_fn
@@ -73,6 +74,7 @@ class ElasticTrainLoop:
             from .device_monitor import DeviceMonitor
 
             self._device_monitor = DeviceMonitor(client=ctx.client)
+        self._trace_host = trace_host
 
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
@@ -105,6 +107,8 @@ class ElasticTrainLoop:
             data_iter = data_factory(start)
         if data_iter is None:
             raise ValueError("run() needs data_iter or data_factory")
+        if self._trace_host:
+            self._install_host_tracer(data_iter)
         if self._device_monitor is not None:
             self._device_monitor.start()
         try:
@@ -115,6 +119,26 @@ class ElasticTrainLoop:
             # block a retried run() from restarting it cleanly.
             if self._device_monitor is not None:
                 self._device_monitor.stop()
+
+    def _install_host_tracer(self, data_iter) -> None:
+        """Slow-dataloader visibility with zero user annotations: the
+        data iterator (and any DLROVER_PY_TRACE_TARGETS functions) get
+        per-call timings in the native profiler stream — the reference's
+        py_tracing.c capability (SURVEY §2.15), via sys.monitoring so
+        untraced code carries no instrumentation at all."""
+        try:
+            from ..profiler.py_tracer import (
+                FunctionTracer,
+                install_crash_hook,
+            )
+
+            tracer = FunctionTracer.singleton()
+            tracer.add_iterator(data_iter)
+            tracer.add_env_targets()
+            tracer.install()
+            install_crash_hook(tracer.timer)
+        except Exception as e:  # noqa: BLE001 — aux, never blocks training
+            logger.warning("host tracer unavailable: %s", e)
 
     def _run_inner(self, state, data_iter, start):
         step = start

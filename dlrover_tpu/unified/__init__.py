@@ -30,6 +30,12 @@ from .comm_service import (  # noqa: F401
     MasterKV,
     UnifiedCommService,
 )
+from .dataloader_iter import RemoteBatchIterator  # noqa: F401
+from .rpc_helper import (  # noqa: F401
+    FutureGroup,
+    call_role_async,
+    create_rpc_proxy,
+)
 from .graph import DLExecutionGraph, RoleVertex  # noqa: F401
 from .manager import PrimeManager  # noqa: F401
 from .master import PrimeMaster  # noqa: F401

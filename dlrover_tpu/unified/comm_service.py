@@ -305,6 +305,30 @@ class MasterDataQueue:
         )
         self._p2p = p2p_enabled() if p2p is None else p2p
 
+    @staticmethod
+    def _rough_size(item, depth: int = 0) -> int:
+        """Cheap lower bound on the serialized size — bulk payloads are
+        bytes blobs (pack_array) or strings, and summing those catches
+        them without paying a full msgpack pass per item (which would
+        DOUBLE serialization work for the common small-item case: the
+        RPC layer serializes again for the wire)."""
+        if isinstance(item, (bytes, bytearray, memoryview)):
+            return len(item)
+        if isinstance(item, str):
+            return len(item)
+        if depth >= 3:
+            return 64
+        if isinstance(item, dict):
+            return sum(
+                MasterDataQueue._rough_size(v, depth + 1)
+                for v in item.values()
+            ) + 8 * len(item)
+        if isinstance(item, (list, tuple)):
+            return sum(
+                MasterDataQueue._rough_size(v, depth + 1) for v in item
+            )
+        return 16
+
     def _encode_items(self, items) -> List[Any]:
         """Large payloads → producer-served envelopes (see class doc)."""
         from . import payload as _p
@@ -313,6 +337,9 @@ class MasterDataQueue:
         out: List[Any] = []
         for item in items:
             try:
+                if self._rough_size(item) < _p.INLINE_MAX // 2:
+                    out.append(item)  # clearly small: no dumps() pass
+                    continue
                 data = _dumps(item)
                 if len(data) < _p.INLINE_MAX:
                     out.append(item)

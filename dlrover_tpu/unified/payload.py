@@ -220,7 +220,9 @@ def fetch_once(addr: str, ticket: str, timeout: float = 30.0) -> bytes:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
-        raise TicketGone(f"{addr}/{ticket}: HTTP {e.code}") from e
+        if e.code in (403, 404, 410):
+            raise TicketGone(f"{addr}/{ticket}: HTTP {e.code}") from e
+        raise  # 5xx etc: server hiccup, bytes may still exist — retry
 
 
 def fetch(

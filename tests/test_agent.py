@@ -283,3 +283,99 @@ print('recovered')
             a.stop()
         for t in threads:
             t.join(timeout=30)
+
+
+class TestWarmSpare:
+    """Warm-spare workers (round 4): restarts skip the interpreter +
+    jax/flax import tax — the dominant term in elastic MTTR."""
+
+    def test_spare_adopted_and_env_contract_applied(self, tmp_path):
+        from dlrover_tpu.agent.worker import WarmSpare, WorkerProcess
+
+        out = tmp_path / "out.txt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, pathlib, sys\n"
+            f"pathlib.Path(r'{out}').write_text(\n"
+            "    os.environ['DLROVER_COORDINATOR_ADDRESS'] + ' '\n"
+            "    + os.environ['DLROVER_RESTART_COUNT']\n"
+            "    + ' ' + (sys.argv[1] if len(sys.argv) > 1 else ''))\n"
+        )
+        spec = WorkerSpec(
+            entrypoint=str(script),
+            args=["argA"],
+            log_dir=str(tmp_path / "logs"),
+        )
+        spare = WarmSpare(spec, tag="t")
+        assert spare.wait_ready(timeout=30), "spare never became ready"
+        worker = WorkerProcess(spec, restart_count=3)
+        t0 = time.time()
+        how = worker.start(
+            dynamic_env={"DLROVER_COORDINATOR_ADDRESS": "1.2.3.4:5"},
+            spare=spare,
+        )
+        assert how == "warm"
+        result = worker.wait(timeout=30)
+        warm_latency = time.time() - t0
+        assert result.state == WorkerState.SUCCEEDED, worker.tail_log()
+        assert out.read_text() == "1.2.3.4:5 3 argA"
+        # the whole point: handoff->exit must beat a cold python start
+        assert warm_latency < 5.0, warm_latency
+
+    def test_unready_spare_falls_back_cold(self, tmp_path):
+        from dlrover_tpu.agent.worker import WarmSpare, WorkerProcess
+
+        script = tmp_path / "ok.py"
+        script.write_text("print('ran')\n")
+        spec = WorkerSpec(entrypoint=str(script))
+
+        class NeverReady(WarmSpare):
+            def wait_ready(self, timeout=0.0):
+                return False
+
+        spare = NeverReady(spec, tag="n")
+        try:
+            worker = WorkerProcess(spec)
+            how = worker.start(spare=spare)
+            assert how == "cold"
+            assert worker.wait(timeout=30).state == WorkerState.SUCCEEDED
+            assert spare.proc.poll() is None  # untouched, still warm-ing
+        finally:
+            spare.kill()
+
+    def test_agent_keeps_one_spare_and_cleans_up(self, master1, tmp_path):
+        script = tmp_path / "train.py"
+        # outlives the (shortened) spare-spawn delay: the timer only
+        # fires while the agent is still running
+        script.write_text("import time\ntime.sleep(4.0)\n")
+        config = ElasticLaunchConfig(
+            min_nodes=1,
+            max_nodes=1,
+            entrypoint=str(script),
+            master_addr=master1.addr,
+            monitor_interval=0.3,
+            warm_spare=True,
+        )
+        agent = ElasticTrainingAgent(
+            config,
+            client=_client(master1, 0),
+            start_ckpt_saver=False,
+        )
+        agent.SPARE_SPAWN_DELAY_S = 0.5
+        rc = {}
+        t = threading.Thread(target=lambda: rc.update(v=agent.run()))
+        t.start()
+        deadline = time.time() + 30
+        saw_spare = False
+        while time.time() < deadline and not saw_spare:
+            saw_spare = agent._spare is not None
+            time.sleep(0.1)
+        assert saw_spare, "agent never spawned a warm spare"
+        spare_proc = agent._spare.proc
+        t.join(timeout=60)
+        assert rc.get("v") == AGENT_EXIT_OK
+        assert agent._spare is None
+        deadline = time.time() + 10
+        while time.time() < deadline and spare_proc.poll() is None:
+            time.sleep(0.1)
+        assert spare_proc.poll() is not None, "spare leaked after agent exit"

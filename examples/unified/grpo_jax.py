@@ -1,0 +1,327 @@
+"""GRPO on the unified control plane — a real-array RL pipeline.
+
+The step up from ``ppo_toy.py`` (scalar weights, unix-socket queue):
+this example moves REAL jax/numpy tensors through the cluster-wide
+runtime the way an LLM RLHF job would (reference shape:
+examples/unified/rl/openrlhf/ppo — rollout engines generate, a reward
+model scores, the learner updates, weights flow back):
+
+- ``rollout`` (N instances): holds the policy table, samples G
+  completions per prompt (group sampling), scores them through the
+  REWARD role via a **typed RPC proxy** (``create_rpc_proxy`` —
+  same signatures as the server class, ``async_call`` overlaps scoring
+  with generation), computes per-group GRPO advantages, and ships
+  (prompts, completions, advantages, behavior logits) as packed arrays
+  on the cluster-wide ``MasterDataQueue`` — batches above the inline
+  threshold ride the **peer-to-peer payload path** (bytes go
+  producer→learner; the master brokers envelopes).
+- ``reward`` (1 instance): exports a ``RewardService`` instance
+  (``@rpc`` methods) — completions earn one point per TARGET_TOKEN.
+- ``learner`` (trainer): drains the queue, does REAL jax grads (group
+  advantage-weighted policy gradient with an importance-ratio clip —
+  the GRPO objective), and publishes fresh weights to ``MasterKV``
+  every update; rollouts refresh between batches.
+
+Convergence is the end-to-end proof: the learned policy emits
+TARGET_TOKEN with high probability ONLY if queue payloads, reward RPCs,
+and KV weight syncs all carry faithful data.
+
+Run standalone:  python examples/unified/grpo_jax.py
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+VOCAB = 8
+TARGET_TOKEN = 5
+GROUP = 4  # completions per prompt (the G in GRPO)
+GEN_LEN = 4
+PROMPTS_PER_BATCH = int(os.environ.get("GRPO_PROMPTS", "64"))
+UPDATES = int(os.environ.get("GRPO_UPDATES", "40"))
+OUT_DIR = os.environ.get("GRPO_OUT_DIR", "/tmp/grpo_jax")
+CLIP = 0.2
+
+
+# -- reward role -------------------------------------------------------------
+
+
+class RewardService:
+    """Typed protocol both sides share: the reward role exports an
+    instance; rollouts talk to it through ``create_rpc_proxy`` with
+    these exact signatures. Methods are ``@rpc``-decorated here, on the
+    shared class, so the proxy side resolves the same wire names."""
+
+    def score_batch(self, completions):
+        """completions: [B][GEN_LEN] token ids -> [B] float scores."""
+        return [
+            float(sum(1.0 for t in row if t == TARGET_TOKEN))
+            for row in completions
+        ]
+
+    def target_token(self) -> int:
+        return TARGET_TOKEN
+
+
+def _decorate_reward_protocol():
+    from dlrover_tpu.unified.comm import rpc
+
+    RewardService.score_batch = rpc()(RewardService.score_batch)
+    RewardService.target_token = rpc()(RewardService.target_token)
+
+
+_decorate_reward_protocol()
+
+
+def run_reward() -> int:
+    from dlrover_tpu.unified import MasterKV
+    from dlrover_tpu.unified.comm import export_rpc_instance
+
+    export_rpc_instance("reward", RewardService())
+    print("reward service up", flush=True)
+    kv = MasterKV()
+    while not kv.get("stop"):
+        time.sleep(0.5)
+    print("reward done", flush=True)
+    return 0
+
+
+# -- rollout role ------------------------------------------------------------
+
+
+def _softmax(x, axis=-1):
+    import numpy as np
+
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def run_rollout() -> int:
+    import numpy as np
+
+    from dlrover_tpu.unified import MasterDataQueue, MasterKV, create_rpc_proxy
+    from dlrover_tpu.unified.comm import current_role_index, pack_array
+
+    rng = np.random.default_rng(7 + current_role_index())
+    queue = MasterDataQueue("grpo_experience")
+    kv = MasterKV()
+    reward = create_rpc_proxy(
+        "reward", RewardService, ns="reward", retry_for=30.0
+    )
+    try:
+        assert reward.target_token() == TARGET_TOKEN  # typed round-trip
+    except (ConnectionError, OSError):
+        # reward already gone: the job is shutting down (stop persists
+        # in KV) — exit cleanly instead of burning restarts
+        if kv.get("stop"):
+            return 0
+        raise
+
+    theta = np.zeros((VOCAB, VOCAB), dtype=np.float32)
+    version = -1
+    while True:
+        blob = kv.get("policy")
+        if blob is not None and blob["version"] != version:
+            from dlrover_tpu.unified.comm import unpack_array
+
+            theta = unpack_array(blob["theta"])
+            version = int(blob["version"])
+        if kv.get("stop"):
+            break
+
+        prompts = rng.integers(0, VOCAB, PROMPTS_PER_BATCH).astype(np.int32)
+        # group sampling: G completions per prompt under the CURRENT
+        # policy (token distribution conditioned on the previous token)
+        comps = np.zeros(
+            (PROMPTS_PER_BATCH, GROUP, GEN_LEN), dtype=np.int32
+        )
+        prev = np.repeat(prompts[:, None], GROUP, axis=1)
+        for t in range(GEN_LEN):
+            probs = _softmax(theta[prev])  # [B, G, V]
+            flat = probs.reshape(-1, VOCAB)
+            choice = np.array(
+                [rng.choice(VOCAB, p=p) for p in flat], dtype=np.int32
+            ).reshape(prev.shape)
+            comps[:, :, t] = choice
+            prev = choice
+
+        # reward via the typed proxy, async so the next block of numpy
+        # work overlaps the RPC
+        fut = reward.score_batch.async_call(
+            comps.reshape(-1, GEN_LEN).tolist()
+        )
+        try:
+            scores = np.asarray(fut.result(timeout=60), dtype=np.float32)
+        except (ConnectionError, OSError):
+            # reward exiting under us: the learner just declared stop
+            if kv.get("stop"):
+                break
+            raise
+        scores = scores.reshape(PROMPTS_PER_BATCH, GROUP)
+        # GRPO: advantage is the group-normalized score
+        adv = (scores - scores.mean(axis=1, keepdims=True)) / (
+            scores.std(axis=1, keepdims=True) + 1e-6
+        )
+        try:
+            queue.put(
+                {
+                    "prompts": pack_array(prompts),
+                    "completions": pack_array(comps),
+                    "advantages": pack_array(adv.astype(np.float32)),
+                    # behavior policy weights for the importance ratio
+                    "theta_version": version,
+                    "theta": pack_array(theta),
+                },
+                timeout=10.0,
+                retry_for=30.0,
+            )
+        except (TimeoutError, ConnectionError, OSError):
+            # learner finished or mid-failover: re-check stop, stay up
+            continue
+    print("rollout done", flush=True)
+    return 0
+
+
+# -- learner role ------------------------------------------------------------
+
+
+def run_learner() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.common.platform import force_virtual_cpu
+
+    force_virtual_cpu(1)
+
+    from dlrover_tpu.unified import MasterDataQueue, MasterKV
+    from dlrover_tpu.unified.comm import pack_array, unpack_array
+
+    queue = MasterDataQueue("grpo_experience")
+    kv = MasterKV()
+    # a whole-job restart must not inherit the previous run's stop flag
+    kv.set("stop", False)
+
+    def loss_fn(theta, prompts, comps, adv, behavior_theta):
+        # [B, G, T] token ids; logp under current + behavior policies
+        prev = jnp.concatenate(
+            [
+                jnp.repeat(prompts[:, None, None], GROUP, axis=1),
+                comps[:, :, :-1],
+            ],
+            axis=2,
+        )
+        def logp_under(th):
+            logits = th[prev]  # [B, G, T, V]
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            tok = jnp.take_along_axis(
+                logits, comps[..., None], axis=-1
+            )[..., 0]
+            return (tok - logz).sum(axis=-1)  # [B, G]
+
+        logp = logp_under(theta)
+        logp_b = jax.lax.stop_gradient(logp_under(behavior_theta))
+        ratio = jnp.exp(logp - logp_b)
+        clipped = jnp.clip(ratio, 1.0 - CLIP, 1.0 + CLIP)
+        # GRPO objective: clipped importance-weighted group advantages
+        obj = jnp.minimum(ratio * adv, clipped * adv)
+        return -obj.mean()
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+
+    theta = jnp.zeros((VOCAB, VOCAB), dtype=jnp.float32)
+    kv.set(
+        "policy",
+        {"version": 0, "theta": pack_array(np.asarray(theta))},
+    )
+    lr = 2.5
+    mean_rewards = []
+    update = 0
+    while update < UPDATES:
+        # Staleness control: the clip nullifies gradients from batches
+        # whose behavior policy lags far behind (that is its JOB), so
+        # an off-policy learner that blindly consumes the backlog
+        # crawls. Drain what's queued, train on the FRESHEST batch,
+        # drop the rest — the sample-reuse limit every real RLHF
+        # system applies.
+        items = queue.get(8, timeout=60.0, retry_for=60.0)
+        if not items:
+            continue
+        item = max(items, key=lambda i: i["theta_version"])
+        if item["theta_version"] < update - 2:
+            continue  # entire backlog stale; wait for a fresh rollout
+        prompts = jnp.asarray(unpack_array(item["prompts"]))
+        comps = jnp.asarray(unpack_array(item["completions"]))
+        adv = jnp.asarray(unpack_array(item["advantages"]))
+        behavior = jnp.asarray(unpack_array(item["theta"]))
+        g = grad_fn(theta, prompts, comps, adv, behavior)
+        theta = theta - lr * g
+        kv.set(
+            "policy",
+            {
+                "version": update + 1,
+                "theta": pack_array(np.asarray(theta)),
+            },
+        )
+        update += 1
+        # bookkeeping: how often does the current policy emit TARGET?
+        p_target = float(
+            np.mean(_softmax(np.asarray(theta))[:, TARGET_TOKEN])
+        )
+        mean_rewards.append(p_target)
+        if update % 5 == 0:
+            print(f"update {update}: p(target)={p_target:.3f}", flush=True)
+    kv.set("stop", True)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "learner_result.json"), "w") as f:
+        json.dump(
+            {"p_target": mean_rewards[-1], "updates": len(mean_rewards)}, f
+        )
+    print(f"learner done: p(target)={mean_rewards[-1]:.3f}", flush=True)
+    return 0
+
+
+def submit() -> int:
+    """Self-submitting driver (reference main.py:26-60 builder shape)."""
+    from dlrover_tpu.unified import RLJobBuilder
+
+    me = [sys.executable, str(pathlib.Path(__file__).resolve())]
+    # batches here are a few KB; lower the inline threshold so they
+    # genuinely ride the peer-to-peer payload path (the claim above)
+    os.environ.setdefault("DLROVER_UNIFIED_P2P_INLINE_MAX", "2048")
+    job = (
+        RLJobBuilder("grpo-jax")
+        .node_num(1)
+        .device_per_node(4)
+        .trainer(me, num=1, device=2.0)
+        .rollout(me, num=2, device=0.5)
+        .reward(me, num=1, device=0.5)
+        .build()
+    )
+    master = job.submit(log_dir=os.path.join(OUT_DIR, "logs"))
+    status = master.wait(timeout=600)
+    print("job finished:", status)
+    return 0 if master.succeeded() else 1
+
+
+def main() -> int:
+    role = os.environ.get("DLROVER_ROLE", "")
+    if role == "trainer":
+        return run_learner()
+    if role == "rollout":
+        return run_rollout()
+    if role == "reward":
+        return run_reward()
+    if not role:
+        return submit()
+    print(f"unknown role {role!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -207,17 +207,28 @@ def run_goodput_storm(
             if step > last_advance[0]:
                 gap = now - last_advance[1]
                 if gap > 2.0 and last_advance[0] > 0:
-                    # attribute: a stall whose window contains a kill is
-                    # recovery; others are jit/ckpt/scheduler pauses and
-                    # must not pollute the MTTR figure
+                    # attribute: a stall is kill-recovery when a kill
+                    # landed in (or a few seconds before) its window —
+                    # the victim may have been a step behind the
+                    # watermark holder, so the freeze starts slightly
+                    # after the SIGKILL. Each kill is CONSUMED by the
+                    # first stall it matches, so a later jit/ckpt pause
+                    # can never double-claim it and pollute the MTTR.
+                    matched = next(
+                        (
+                            kt
+                            for kt in kill_times
+                            if last_advance[1] - 5.0 <= kt <= now
+                        ),
+                        None,
+                    )
+                    if matched is not None:
+                        kill_times.remove(matched)
                     stalls.append(
                         {
                             "at_step": last_advance[0],
                             "gap_s": round(gap, 1),
-                            "kill": any(
-                                last_advance[1] <= kt <= now
-                                for kt in kill_times
-                            ),
+                            "kill": matched is not None,
                         }
                     )
                 if last_advance[0] == 0:

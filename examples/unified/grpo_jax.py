@@ -37,6 +37,8 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 
+from dlrover_tpu.unified.comm import rpc  # noqa: E402
+
 VOCAB = 8
 TARGET_TOKEN = 5
 GROUP = 4  # completions per prompt (the G in GRPO)
@@ -56,6 +58,7 @@ class RewardService:
     these exact signatures. Methods are ``@rpc``-decorated here, on the
     shared class, so the proxy side resolves the same wire names."""
 
+    @rpc()
     def score_batch(self, completions):
         """completions: [B][GEN_LEN] token ids -> [B] float scores."""
         return [
@@ -63,18 +66,9 @@ class RewardService:
             for row in completions
         ]
 
+    @rpc()
     def target_token(self) -> int:
         return TARGET_TOKEN
-
-
-def _decorate_reward_protocol():
-    from dlrover_tpu.unified.comm import rpc
-
-    RewardService.score_batch = rpc()(RewardService.score_batch)
-    RewardService.target_token = rpc()(RewardService.target_token)
-
-
-_decorate_reward_protocol()
 
 
 def run_reward() -> int:
@@ -84,7 +78,17 @@ def run_reward() -> int:
     export_rpc_instance("reward", RewardService())
     print("reward service up", flush=True)
     kv = MasterKV()
-    while not kv.get("stop"):
+    # A stop flag present BEFORE we ever saw the job running is stale
+    # state from a previous incarnation (whole-job restart; the KV
+    # lives in the master and survives) — wait for the restarted
+    # learner to clear it rather than exiting instantly.
+    saw_running = False
+    while True:
+        stopped = bool(kv.get("stop"))
+        if not stopped:
+            saw_running = True
+        elif saw_running:
+            break
         time.sleep(0.5)
     print("reward done", flush=True)
     return 0
@@ -124,6 +128,7 @@ def run_rollout() -> int:
 
     theta = np.zeros((VOCAB, VOCAB), dtype=np.float32)
     version = -1
+    saw_running = False  # see run_reward: pre-seen stop flags are stale
     while True:
         blob = kv.get("policy")
         if blob is not None and blob["version"] != version:
@@ -131,8 +136,14 @@ def run_rollout() -> int:
 
             theta = unpack_array(blob["theta"])
             version = int(blob["version"])
-        if kv.get("stop"):
+        stopped = bool(kv.get("stop"))
+        if not stopped:
+            saw_running = True
+        elif saw_running:
             break
+        elif stopped:
+            time.sleep(0.2)
+            continue
 
         prompts = rng.integers(0, VOCAB, PROMPTS_PER_BATCH).astype(np.int32)
         # group sampling: G completions per prompt under the CURRENT
@@ -143,10 +154,12 @@ def run_rollout() -> int:
         prev = np.repeat(prompts[:, None], GROUP, axis=1)
         for t in range(GEN_LEN):
             probs = _softmax(theta[prev])  # [B, G, V]
-            flat = probs.reshape(-1, VOCAB)
-            choice = np.array(
-                [rng.choice(VOCAB, p=p) for p in flat], dtype=np.int32
-            ).reshape(prev.shape)
+            # vectorized inverse-CDF draw: one rng call per step
+            cdf = probs.reshape(-1, VOCAB).cumsum(axis=1)
+            u = rng.random((cdf.shape[0], 1)) * cdf[:, -1:]
+            choice = (
+                (u < cdf).argmax(axis=1).astype(np.int32).reshape(prev.shape)
+            )
             comps[:, :, t] = choice
             prev = choice
 

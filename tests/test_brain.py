@@ -456,3 +456,54 @@ class TestHistoryDepthAlgorithms:
             client.close()
         finally:
             service.stop()
+
+
+class TestArbiterProperties:
+    """Allocation invariants that must hold for ANY curve shapes."""
+
+    def test_never_overallocates_and_never_starves(self):
+        import random
+
+        from dlrover_tpu.brain.algorithms import ClusterResourceArbiter
+
+        rng = random.Random(0)
+        for trial in range(20):
+            store = BrainDataStore()
+            n_jobs = rng.randint(1, 5)
+            uuids = []
+            for j in range(n_jobs):
+                uid = f"job{trial}_{j}"
+                uuids.append(uid)
+                store.upsert_job(
+                    JobRecord(
+                        job_uuid=uid,
+                        job_name=uid,
+                        model_signature=f"sig{j}",
+                        workload="jax",
+                        worker_num=2,
+                        status="running",
+                    )
+                )
+                size = 1
+                speed = 0.0
+                for _ in range(rng.randint(0, 5)):
+                    size += rng.randint(1, 4)
+                    speed += rng.uniform(0.0, 4.0)
+                    store.add_metric(
+                        JobMetricSample(
+                            job_uuid=uid,
+                            world_size=size,
+                            steps_per_second=speed,
+                        )
+                    )
+            unit = rng.choice([1, 2, 4])
+            total = rng.randint(0, 40)
+            alloc = ClusterResourceArbiter(store).allocate(
+                uuids, total, node_unit=unit
+            )
+            if total < unit * n_jobs:
+                assert alloc == {}
+                continue
+            assert set(alloc) == set(uuids)
+            assert sum(alloc.values()) <= total
+            assert all(v >= unit and v % unit == 0 for v in alloc.values())

@@ -952,3 +952,88 @@ class TestGrpoE2E:
         # uniform policy emits the target 12.5% of the time; a learned
         # one must be far beyond noise
         assert result["p_target"] >= 0.5, result
+
+
+class TestPayloadServerConcurrency:
+    """The producer's payload server under concurrent consumers — the
+    load pattern a real RL job creates (many learner threads fetching
+    tickets from one rollout)."""
+
+    def test_parallel_fetches_and_acks(self):
+        import concurrent.futures
+
+        from dlrover_tpu.unified.payload import PayloadServer, fetch
+
+        server = PayloadServer.singleton()
+        try:
+            addr = f"127.0.0.1:{server._httpd.server_address[1]}"
+            blobs = {
+                server.store.put(bytes([i]) * 50_000): bytes([i]) * 50_000
+                for i in range(8)
+            }
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                results = list(
+                    pool.map(
+                        lambda t: (t, fetch(addr, t)), list(blobs)
+                    )
+                )
+            for ticket, data in results:
+                assert data == blobs[ticket]
+            # store drains fully once every consumer acks
+            from dlrover_tpu.unified.payload import ack
+
+            for ticket in blobs:
+                ack(addr, ticket)
+            assert server.store.nbytes == 0
+        finally:
+            PayloadServer.reset_singleton()
+
+
+class TestTracerThreadSafety:
+    def test_traced_function_from_multiple_threads(self):
+        """Per-thread timing stacks: concurrent traced calls must not
+        cross-pollinate durations."""
+        import threading as _threading
+        import time as _time
+
+        from dlrover_tpu.profiler.py_tracer import FunctionTracer
+
+        tracer = FunctionTracer()
+
+        def work(ms):
+            _time.sleep(ms / 1000.0)
+            return ms
+
+        assert tracer.add_target(work, name="work")
+        assert tracer.install()
+        try:
+            threads = [
+                _threading.Thread(target=work, args=(d,))
+                for d in (30, 60, 30, 60)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert tracer.calls == 4
+            import tempfile
+
+            from dlrover_tpu.profiler.timeline import (
+                read_names,
+                read_timeline,
+            )
+
+            path = tempfile.mktemp(suffix=".timeline")
+            assert tracer.timer.dump_timeline(path) > 0
+            names = read_names(path + ".names")
+            durs = sorted(
+                e.dur_us
+                for e in read_timeline(path)
+                if names.get(e.name_id) == "host_py_work"
+            )
+            assert len(durs) == 4
+            # two ~30ms and two ~60ms, none smeared across threads
+            assert durs[0] >= 25_000 and durs[1] < 55_000
+            assert durs[2] >= 50_000 and durs[3] < 120_000
+        finally:
+            tracer.uninstall()

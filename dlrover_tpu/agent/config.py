@@ -59,6 +59,10 @@ class ElasticLaunchConfig:
     # Keep a pre-imported spare interpreter per agent so worker
     # restarts skip the CPython + jax/flax import tax (elastic MTTR).
     warm_spare: bool = True
+    # Offer shape-compatible new worlds to a live worker at a step
+    # boundary (trainer/remesh.py) before falling back to a restart.
+    soft_remesh: bool = True
+    soft_remesh_timeout_s: float = 15.0
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def profile_enabled(self) -> bool:

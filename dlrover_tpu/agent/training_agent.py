@@ -14,6 +14,7 @@ segments live in the agent process).
 """
 
 import os
+import signal
 import threading
 import time
 from typing import Dict, Optional
@@ -80,6 +81,24 @@ class ElasticTrainingAgent:
         self._metric_collector = None
         self._profiler_daemon = None
         self._spare = None
+        # Soft-remesh handshake dir, exported to the worker (unique per
+        # agent incarnation so two agents on one host never collide).
+        import tempfile
+
+        from ..trainer.remesh import REMESH_DIR_ENV
+
+        self._remesh_dir = os.path.join(
+            tempfile.gettempdir(),
+            "dlrover_tpu",
+            "remesh",
+            f"{config.job_name}_{config.node_rank}_{os.getpid()}",
+        )
+        if config.soft_remesh:
+            # setdefault honors a user-supplied dir (extra_env), but
+            # the agent must then USE that same dir — a divergent pair
+            # would silently disable the protocol.
+            self._spec.env.setdefault(REMESH_DIR_ENV, self._remesh_dir)
+            self._remesh_dir = self._spec.env[REMESH_DIR_ENV]
         self._diagnosis.register_action_handler(self._on_master_action)
 
     # -- lifecycle --------------------------------------------------------
@@ -142,6 +161,12 @@ class ElasticTrainingAgent:
             self._world.world_size,
             self._world.coordinator,
         )
+        # A predecessor incarnation's remesh handshake files must never
+        # be mistaken for the new worker's (files are pid-keyed, but a
+        # recycled pid across restarts is cheap to rule out entirely).
+        import shutil
+
+        shutil.rmtree(self._remesh_dir, ignore_errors=True)
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
         spare = self._take_spare()
         how = self._worker.start(
@@ -198,6 +223,80 @@ class ElasticTrainingAgent:
         timer.daemon = True
         timer.start()
 
+    # -- soft re-mesh (survivors keep their process) ----------------------
+
+    def _try_soft_remesh(self) -> bool:
+        """Offer the new world to the live worker (trainer/remesh.py).
+
+        The rendezvous for the NEW round runs while the worker keeps
+        training — the restart-path ordering (stop, then rendezvous)
+        inverted, which is the whole win: a node replacement costs
+        survivors zero downtime. True = the worker adopted the world;
+        False = take the classic restart path.
+        """
+        import json as _json
+
+        if not self._config.soft_remesh or self._worker is None:
+            return False
+        pid = self._worker.pid
+        ready = os.path.join(self._remesh_dir, f"ready_{pid}")
+        if not pid or not os.path.exists(ready):
+            return False  # worker doesn't speak the protocol
+        with self._evt.duration(
+            "soft_remesh", node_rank=self._config.node_rank
+        ) as span:
+            world = self._rdzv_handler.next_rendezvous()
+            ack_path = os.path.join(self._remesh_dir, f"ack_{pid}")
+            try:
+                os.unlink(ack_path)
+            except OSError:
+                pass
+            contract = {
+                "coordinator": world.coordinator,
+                "num_processes": world.world_size,
+                "process_id": world.rank,
+                "node_rank": self._config.node_rank,
+                "round": world.round,
+            }
+            with open(
+                os.path.join(self._remesh_dir, f"world_{pid}"), "w"
+            ) as f:
+                _json.dump(contract, f)
+            try:
+                os.kill(pid, signal.SIGUSR1)
+            except (ProcessLookupError, PermissionError):
+                return False
+            deadline = time.time() + self._config.soft_remesh_timeout_s
+            while time.time() < deadline:
+                if self._worker.poll().state != WorkerState.RUNNING:
+                    return False  # died mid-offer: failure path handles it
+                try:
+                    with open(ack_path) as f:
+                        accepted = bool(_json.load(f).get("accepted"))
+                    break
+                except (OSError, ValueError):
+                    time.sleep(0.2)
+            else:
+                logger.warning(
+                    "soft remesh: worker %s never acked; restarting", pid
+                )
+                span.end({"outcome": "timeout"})
+                return False
+            span.end({"outcome": "accepted" if accepted else "refused"})
+        if not accepted:
+            return False
+        self._world = world
+        logger.info(
+            "soft remesh: round=%s adopted by live worker %s "
+            "(rank %s/%s, zero survivor downtime)",
+            world.round,
+            pid,
+            world.rank,
+            world.world_size,
+        )
+        self._report_status(NodeStatus.RUNNING)
+        return True
+
     def _restart_workers(self, reason: str) -> None:
         logger.info("restarting worker (%s)", reason)
         self._evt.instant("restart_worker", reason=reason)
@@ -228,7 +327,8 @@ class ElasticTrainingAgent:
                     return code
                 continue
             if self._membership_changed():
-                self._restart_workers("membership changed")
+                if not self._try_soft_remesh():
+                    self._restart_workers("membership changed")
         return AGENT_EXIT_OK
 
     def _handle_worker_failure(self, result: RunResult) -> Optional[int]:

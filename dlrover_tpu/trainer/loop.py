@@ -56,6 +56,8 @@ class ElasticTrainLoop:
         on_step: Optional[Callable[[int, float], None]] = None,
         device_monitor: bool = True,
         trace_host: bool = True,
+        soft_remesh: bool = True,
+        on_remesh: Optional[Callable] = None,
     ):
         self.engine = engine
         self.step_fn = step_fn
@@ -75,6 +77,16 @@ class ElasticTrainLoop:
 
             self._device_monitor = DeviceMonitor(client=ctx.client)
         self._trace_host = trace_host
+        # Soft re-mesh: adopt a shape-compatible new world at a step
+        # boundary instead of dying (see trainer/remesh.py). Survivors
+        # of a node replacement keep training THROUGH the rendezvous.
+        self._remesh = None
+        if soft_remesh and ctx is not None:
+            from .remesh import SoftRemesh
+
+            candidate = SoftRemesh(ctx, on_remesh=on_remesh)
+            if candidate.available:
+                self._remesh = candidate
 
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
@@ -109,11 +121,15 @@ class ElasticTrainLoop:
             raise ValueError("run() needs data_iter or data_factory")
         if self._trace_host:
             self._install_host_tracer(data_iter)
+        if self._remesh is not None:
+            self._remesh.install()
         if self._device_monitor is not None:
             self._device_monitor.start()
         try:
             return self._run_inner(state, data_iter, start)
         finally:
+            if self._remesh is not None:
+                self._remesh.uninstall()
             # stop() even when step_fn raises: a leaked daemon reporter
             # would keep shipping stale gauges for the process life and
             # block a retried run() from restarting it cleanly.
@@ -150,6 +166,21 @@ class ElasticTrainLoop:
             # replayable dataset
             if self.max_steps and step >= self.max_steps:
                 break
+            if self._remesh is not None and self._remesh.requested:
+                # Stage BEFORE deciding: an accepted world continues
+                # from live state; a refusal means the agent restarts
+                # us and the staged step is what the successor resumes.
+                # Skipped when nothing completed yet (staging the
+                # INITIAL state as "step 0 done" would make the
+                # successor skip step 0), and when the previous
+                # iteration's save of this exact step already landed
+                # (a redundant full-model D2H inside the ack budget).
+                if step > start and not last_save_ok:
+                    for _ in range(50):
+                        if self.engine.save_to_memory(step - 1, state):
+                            break
+                        time.sleep(0.1)
+                self._remesh.apply()
             try:
                 batch = next(it)
             except StopIteration:

@@ -78,23 +78,26 @@ def run_reward() -> int:
     export_rpc_instance("reward", RewardService())
     print("reward service up", flush=True)
     kv = MasterKV()
-    # A stop flag present BEFORE we ever saw the job running is stale
-    # state from a previous incarnation (whole-job restart; the KV
-    # lives in the master and survives) — wait for the restarted
-    # learner to clear it rather than exiting instantly.
-    saw_running = False
-    while True:
-        stopped = bool(kv.get("stop"))
-        if not stopped:
-            saw_running = True
-        elif saw_running:
-            break
+    stop_state = {"saw_running": False}
+    while not _stop_requested(kv, stop_state):
         time.sleep(0.5)
     print("reward done", flush=True)
     return 0
 
 
 # -- rollout role ------------------------------------------------------------
+
+
+def _stop_requested(kv, state) -> bool:
+    """Stale-stop-aware check shared by reward and rollout: a stop flag
+    seen BEFORE the job was ever observed running is residue of a prior
+    incarnation (the KV survives whole-job restarts) and is ignored
+    until the restarted learner clears it."""
+    stopped = bool(kv.get("stop"))
+    if not stopped:
+        state["saw_running"] = True
+        return False
+    return state["saw_running"]
 
 
 def _softmax(x, axis=-1):
@@ -128,7 +131,7 @@ def run_rollout() -> int:
 
     theta = np.zeros((VOCAB, VOCAB), dtype=np.float32)
     version = -1
-    saw_running = False  # see run_reward: pre-seen stop flags are stale
+    stop_state = {"saw_running": False}
     while True:
         blob = kv.get("policy")
         if blob is not None and blob["version"] != version:
@@ -136,12 +139,9 @@ def run_rollout() -> int:
 
             theta = unpack_array(blob["theta"])
             version = int(blob["version"])
-        stopped = bool(kv.get("stop"))
-        if not stopped:
-            saw_running = True
-        elif saw_running:
+        if _stop_requested(kv, stop_state):
             break
-        elif stopped:
+        if kv.get("stop"):  # stale flag: wait for the learner to clear
             time.sleep(0.2)
             continue
 

@@ -379,3 +379,227 @@ class TestWarmSpare:
         while time.time() < deadline and spare_proc.poll() is None:
             time.sleep(0.1)
         assert spare_proc.poll() is not None, "spare leaked after agent exit"
+
+
+class TestSoftRemesh:
+    """Soft re-mesh (round 4): survivors of a membership change keep
+    their PROCESS — the agent runs the new rendezvous while the worker
+    trains, offers the world at a step boundary, and only restarts on
+    refusal/timeout. The reference restarts worker processes on every
+    membership change (training.py:1262)."""
+
+    def test_worker_side_accept_and_refuse(self, tmp_path, monkeypatch):
+        import json
+
+        from dlrover_tpu.trainer.elastic import ElasticContext
+        from dlrover_tpu.trainer.remesh import REMESH_DIR_ENV, SoftRemesh
+
+        monkeypatch.setenv(REMESH_DIR_ENV, str(tmp_path))
+        ctx = ElasticContext(num_processes=2, process_id=1, coordinator="a:1")
+        sr = SoftRemesh(ctx)
+        assert sr.install()
+        try:
+            pid = os.getpid()
+            assert (tmp_path / f"ready_{pid}").exists()
+
+            def offer(world):
+                (tmp_path / f"world_{pid}").write_text(json.dumps(world))
+                os.kill(pid, signal.SIGUSR1)
+                deadline = time.time() + 5
+                while not sr.requested and time.time() < deadline:
+                    time.sleep(0.01)
+                assert sr.requested
+                ok = sr.apply()
+                ack = json.loads((tmp_path / f"ack_{pid}").read_text())
+                assert ack["accepted"] == ok
+                return ok
+
+            # same shape, new coordinator, no live jax.distributed: ride
+            assert offer(
+                {"coordinator": "b:2", "num_processes": 2, "process_id": 1,
+                 "round": 3}
+            )
+            assert ctx.coordinator == "b:2" and sr.applied == 1
+            # shape change: refuse (agent will hard-restart)
+            assert not offer(
+                {"coordinator": "b:2", "num_processes": 3, "process_id": 1,
+                 "round": 4}
+            )
+            # live distributed runtime + coordinator change: refuse
+            import dlrover_tpu.trainer.remesh as remesh_mod
+
+            monkeypatch.setattr(
+                remesh_mod, "_jax_distributed_initialized", lambda: True
+            )
+            assert not offer(
+                {"coordinator": "c:3", "num_processes": 2, "process_id": 1,
+                 "round": 5}
+            )
+        finally:
+            sr.uninstall()
+
+    def test_agent_offers_world_to_live_worker(self, master2, tmp_path):
+        """Two agents; when a waiter appears, the protocol-speaking
+        worker adopts the new world and its PID never changes."""
+        import json
+
+        script = tmp_path / "protocol_worker.py"
+        script.write_text(
+            "import json, os, signal, sys, time\n"
+            "d = os.environ['DLROVER_REMESH_DIR']\n"
+            "os.makedirs(d, exist_ok=True)\n"
+            "pid = os.getpid()\n"
+            "flag = []\n"
+            "signal.signal(signal.SIGUSR1, lambda *a: flag.append(1))\n"
+            "open(f'{d}/ready_{pid}', 'w').write(str(pid))\n"
+            "t0 = time.time()\n"
+            "while time.time() - t0 < 60:\n"
+            "    if flag:\n"
+            "        flag.clear()\n"
+            "        world = json.load(open(f'{d}/world_{pid}'))\n"
+            "        json.dump({'accepted': True},\n"
+            "                  open(f'{d}/ack_{pid}', 'w'))\n"
+            "        open(os.environ['ADOPTED_FILE'], 'w').write(\n"
+            "            str(world['round']))\n"
+            "    time.sleep(0.05)\n"
+            "sys.exit(0)\n"
+        )
+        adopted = tmp_path / "adopted"
+        config = ElasticLaunchConfig(
+            min_nodes=2,
+            max_nodes=2,
+            node_rank=0,
+            entrypoint=str(script),
+            master_addr=master2.addr,
+            monitor_interval=0.3,
+            warm_spare=False,
+            extra_env={"ADOPTED_FILE": str(adopted)},
+        )
+        agent = ElasticTrainingAgent(
+            config,
+            client=_client(master2, 0),
+            start_ckpt_saver=False,
+        )
+
+        def peer_join():
+            handler = MasterRendezvousHandler(
+                RendezvousName.TRAINING,
+                node_rank=1,
+                client=_client(master2, 1),
+                rdzv_timeout=60,
+            )
+            return handler.next_rendezvous()
+
+        rc = {}
+        t = threading.Thread(target=lambda: rc.update(v=agent.run()))
+        t.start()
+        # node 1 joins round 0 alongside the agent so it forms instantly
+        t_first = threading.Thread(target=peer_join)
+        t_first.start()
+        try:
+            t_first.join(timeout=60)
+            # wait for the worker to come up and publish its ready file
+            deadline = time.time() + 60
+            while time.time() < deadline and (
+                agent._worker is None
+                or agent._worker.pid is None
+                or not os.path.exists(
+                    os.path.join(
+                        agent._remesh_dir, f"ready_{agent._worker.pid}"
+                    )
+                )
+            ):
+                time.sleep(0.1)
+            pid_before = agent._worker.pid
+            assert pid_before and os.path.exists(
+                os.path.join(agent._remesh_dir, f"ready_{pid_before}")
+            ), "worker never published its soft-remesh ready file"
+
+            # node 1 re-joins (its own restart): membership change
+            joiner = {}
+            t2 = threading.Thread(
+                target=lambda: joiner.update(w=peer_join())
+            )
+            t2.start()
+            deadline = time.time() + 60
+            while time.time() < deadline and not adopted.exists():
+                time.sleep(0.2)
+            assert adopted.exists(), "worker never adopted the new world"
+            assert agent._worker.pid == pid_before, (
+                "survivor was restarted despite accepting the soft remesh"
+            )
+            t2.join(timeout=30)
+            assert joiner["w"].world_size == 2
+        finally:
+            agent.stop()
+            t.join(timeout=30)
+
+    def test_loop_rides_membership_change_in_process(
+        self, tmp_path, monkeypatch
+    ):
+        """ElasticTrainLoop + SoftRemesh end-to-end in one process: the
+        loop keeps stepping across an adopted world."""
+        import json
+
+        import jax.numpy as jnp
+
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+        from dlrover_tpu.trainer.elastic import ElasticContext
+        from dlrover_tpu.trainer.loop import ElasticTrainLoop
+        from dlrover_tpu.trainer.remesh import REMESH_DIR_ENV
+
+        monkeypatch.setenv(REMESH_DIR_ENV, str(tmp_path / "remesh"))
+        ctx = ElasticContext(num_processes=1, process_id=0)
+        steps_done = []
+
+        def step_fn(state, x):
+            return state + jnp.sum(x), jnp.sum(x)
+
+        def data():
+            while True:
+                time.sleep(0.03)
+                yield (jnp.ones(()),)
+
+        engine = CheckpointEngine(
+            str(tmp_path / "ckpt"), standalone=True, replicate=False
+        )
+        loop = ElasticTrainLoop(
+            engine,
+            step_fn,
+            ctx=ctx,
+            max_steps=40,
+            storage_every=1000,
+            device_monitor=False,
+            trace_host=False,
+            on_step=lambda s, l: steps_done.append(s),
+        )
+
+        # The loop must run on the MAIN thread (signal handlers); the
+        # agent-side offer comes from a helper thread, as in production
+        # (where it is a different PROCESS).
+        def offer():
+            deadline = time.time() + 30
+            while time.time() < deadline and len(steps_done) < 5:
+                time.sleep(0.05)
+            pid = os.getpid()
+            d = tmp_path / "remesh"
+            (d / f"world_{pid}").write_text(
+                json.dumps(
+                    {"coordinator": "new:1", "num_processes": 1,
+                     "process_id": 0, "round": 9}
+                )
+            )
+            os.kill(pid, signal.SIGUSR1)
+
+        t = threading.Thread(target=offer)
+        t.start()
+        try:
+            final = loop.run(jnp.zeros(()), data())
+            t.join(timeout=30)
+            assert loop._remesh is not None
+            assert loop._remesh.applied == 1
+            assert ctx.coordinator == "new:1"
+            assert float(final) == 40.0  # no step lost or repeated
+        finally:
+            engine.shm.unlink()
+            engine.close()

@@ -32,8 +32,12 @@ def test_goodput_storm_meets_north_star(tmp_path):
     # bounded below 0.90 by arithmetic: ~25 s of one-core cold boot
     # amortized over ~8 min instead of days — assert it is in the
     # production-extrapolable band and record both in the bench.
+    # With soft re-mesh, survivors ride through kills without
+    # restarting (measured: strict 0.948 / training 0.982 — most kills
+    # cause NO watermark stall at all); the bounds keep headroom for
+    # the victim-held-watermark case and noisy-neighbor CI boxes.
     assert result["training_goodput"] >= 0.90, result
-    assert result["goodput"] >= 0.80, result
+    assert result["goodput"] >= 0.85, result
     # MTTR itself is the product claim: recovery (detect -> relaunch ->
     # re-rendezvous -> shm restore -> stepping) in seconds, not minutes.
     assert result["mttr_s"] <= 25.0, result

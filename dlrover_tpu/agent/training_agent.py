@@ -96,9 +96,16 @@ class ElasticTrainingAgent:
         if config.soft_remesh:
             # setdefault honors a user-supplied dir (extra_env), but
             # the agent must then USE that same dir — a divergent pair
-            # would silently disable the protocol.
+            # would silently disable the protocol. Only the
+            # agent-generated default is OURS to delete wholesale; a
+            # user dir may be shared (pid keying handles collisions).
             self._spec.env.setdefault(REMESH_DIR_ENV, self._remesh_dir)
+            self._remesh_dir_owned = (
+                self._spec.env[REMESH_DIR_ENV] == self._remesh_dir
+            )
             self._remesh_dir = self._spec.env[REMESH_DIR_ENV]
+        else:
+            self._remesh_dir_owned = True
         self._diagnosis.register_action_handler(self._on_master_action)
 
     # -- lifecycle --------------------------------------------------------
@@ -136,24 +143,30 @@ class ElasticTrainingAgent:
 
     # -- worker management ------------------------------------------------
 
-    def _initialize_workers(self) -> None:
-        """Rendezvous, then start the JAX process with the world's env.
+    def _initialize_workers(self, world=None) -> None:
+        """Rendezvous (unless an already-formed ``world`` is handed in —
+        a refused soft remesh consumed a round every peer is in; joining
+        again would force the whole fleet through one more), then start
+        the JAX process with the world's env.
 
         Reference training.py:883 retries initialization; a failed
         rendezvous here is fatal only after the rdzv timeout (the handler
         retries internally).
         """
-        with self._evt.duration(
-            "rendezvous", node_rank=self._config.node_rank
-        ) as span:
-            self._world = self._rdzv_handler.next_rendezvous()
-            span.end(
-                {
-                    "round": self._world.round,
-                    "rank": self._world.rank,
-                    "world_size": self._world.world_size,
-                }
-            )
+        if world is not None:
+            self._world = world
+        else:
+            with self._evt.duration(
+                "rendezvous", node_rank=self._config.node_rank
+            ) as span:
+                self._world = self._rdzv_handler.next_rendezvous()
+                span.end(
+                    {
+                        "round": self._world.round,
+                        "rank": self._world.rank,
+                        "world_size": self._world.world_size,
+                    }
+                )
         logger.info(
             "world ready: round=%s rank=%s/%s coordinator=%s",
             self._world.round,
@@ -164,9 +177,12 @@ class ElasticTrainingAgent:
         # A predecessor incarnation's remesh handshake files must never
         # be mistaken for the new worker's (files are pid-keyed, but a
         # recycled pid across restarts is cheap to rule out entirely).
-        import shutil
+        # Only wholesale-delete the agent-generated dir; a user-supplied
+        # one may be shared with other agents' live workers.
+        if self._remesh_dir_owned:
+            import shutil
 
-        shutil.rmtree(self._remesh_dir, ignore_errors=True)
+            shutil.rmtree(self._remesh_dir, ignore_errors=True)
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
         spare = self._take_spare()
         how = self._worker.start(
@@ -225,23 +241,29 @@ class ElasticTrainingAgent:
 
     # -- soft re-mesh (survivors keep their process) ----------------------
 
-    def _try_soft_remesh(self) -> bool:
+    def _try_soft_remesh(self):
         """Offer the new world to the live worker (trainer/remesh.py).
 
         The rendezvous for the NEW round runs while the worker keeps
         training — the restart-path ordering (stop, then rendezvous)
         inverted, which is the whole win: a node replacement costs
-        survivors zero downtime. True = the worker adopted the world;
-        False = take the classic restart path.
+        survivors zero downtime.
+
+        Returns ``(outcome, world)``: "adopted" (nobody died),
+        "worker_exited" (let the monitor loop's normal poll handling
+        run — a crash must go through diagnosis/budgets, a success
+        through the exit barrier), or "restart" with the
+        already-formed world (when one exists) so the hard path can
+        reuse the round instead of forcing every peer through another.
         """
         import json as _json
 
         if not self._config.soft_remesh or self._worker is None:
-            return False
+            return "restart", None
         pid = self._worker.pid
         ready = os.path.join(self._remesh_dir, f"ready_{pid}")
         if not pid or not os.path.exists(ready):
-            return False  # worker doesn't speak the protocol
+            return "restart", None  # worker doesn't speak the protocol
         with self._evt.duration(
             "soft_remesh", node_rank=self._config.node_rank
         ) as span:
@@ -265,11 +287,12 @@ class ElasticTrainingAgent:
             try:
                 os.kill(pid, signal.SIGUSR1)
             except (ProcessLookupError, PermissionError):
-                return False
+                return "worker_exited", world
             deadline = time.time() + self._config.soft_remesh_timeout_s
             while time.time() < deadline:
                 if self._worker.poll().state != WorkerState.RUNNING:
-                    return False  # died mid-offer: failure path handles it
+                    span.end({"outcome": "worker_exited"})
+                    return "worker_exited", world
                 try:
                     with open(ack_path) as f:
                         accepted = bool(_json.load(f).get("accepted"))
@@ -281,10 +304,10 @@ class ElasticTrainingAgent:
                     "soft remesh: worker %s never acked; restarting", pid
                 )
                 span.end({"outcome": "timeout"})
-                return False
+                return "restart", world
             span.end({"outcome": "accepted" if accepted else "refused"})
         if not accepted:
-            return False
+            return "restart", world
         self._world = world
         logger.info(
             "soft remesh: round=%s adopted by live worker %s "
@@ -295,15 +318,15 @@ class ElasticTrainingAgent:
             world.world_size,
         )
         self._report_status(NodeStatus.RUNNING)
-        return True
+        return "adopted", world
 
-    def _restart_workers(self, reason: str) -> None:
+    def _restart_workers(self, reason: str, world=None) -> None:
         logger.info("restarting worker (%s)", reason)
         self._evt.instant("restart_worker", reason=reason)
         if self._worker is not None:
             self._worker.stop()
         self._restart_count += 1
-        self._initialize_workers()
+        self._initialize_workers(world=world)
 
     # -- monitor loop -----------------------------------------------------
 
@@ -327,8 +350,14 @@ class ElasticTrainingAgent:
                     return code
                 continue
             if self._membership_changed():
-                if not self._try_soft_remesh():
-                    self._restart_workers("membership changed")
+                outcome, world = self._try_soft_remesh()
+                if outcome == "worker_exited":
+                    continue  # normal poll handling owns exits/failures
+                if outcome != "adopted":
+                    # reuse an already-formed round (refusal/timeout
+                    # happened AFTER the rendezvous): restarting into it
+                    # spares every peer a second global round
+                    self._restart_workers("membership changed", world=world)
         return AGENT_EXIT_OK
 
     def _handle_worker_failure(self, result: RunResult) -> Optional[int]:

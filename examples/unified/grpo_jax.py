@@ -92,8 +92,10 @@ def _stop_requested(kv, state) -> bool:
     """Stale-stop-aware check shared by reward and rollout: a stop flag
     seen BEFORE the job was ever observed running is residue of a prior
     incarnation (the KV survives whole-job restarts) and is ignored
-    until the restarted learner clears it."""
+    until the restarted learner clears it. The raw flag is stashed in
+    ``state["stopped"]`` so callers branch without a second KV read."""
     stopped = bool(kv.get("stop"))
+    state["stopped"] = stopped
     if not stopped:
         state["saw_running"] = True
         return False
@@ -141,7 +143,7 @@ def run_rollout() -> int:
             version = int(blob["version"])
         if _stop_requested(kv, stop_state):
             break
-        if kv.get("stop"):  # stale flag: wait for the learner to clear
+        if stop_state["stopped"]:  # stale flag: wait for it to clear
             time.sleep(0.2)
             continue
 

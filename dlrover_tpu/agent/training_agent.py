@@ -177,12 +177,23 @@ class ElasticTrainingAgent:
         # A predecessor incarnation's remesh handshake files must never
         # be mistaken for the new worker's (files are pid-keyed, but a
         # recycled pid across restarts is cheap to rule out entirely).
-        # Only wholesale-delete the agent-generated dir; a user-supplied
-        # one may be shared with other agents' live workers.
+        # The agent-generated dir is wholesale-deleted; in a
+        # user-supplied (possibly shared) dir only OUR previous
+        # worker's pid-keyed files are removed.
         if self._remesh_dir_owned:
             import shutil
 
             shutil.rmtree(self._remesh_dir, ignore_errors=True)
+        elif self._worker is not None and self._worker.pid:
+            for kind in ("ready", "world", "ack"):
+                try:
+                    os.unlink(
+                        os.path.join(
+                            self._remesh_dir, f"{kind}_{self._worker.pid}"
+                        )
+                    )
+                except OSError:
+                    pass
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
         spare = self._take_spare()
         how = self._worker.start(
@@ -286,8 +297,12 @@ class ElasticTrainingAgent:
                 _json.dump(contract, f)
             try:
                 os.kill(pid, signal.SIGUSR1)
-            except (ProcessLookupError, PermissionError):
+            except ProcessLookupError:
                 return "worker_exited", world
+            except PermissionError:
+                # worker ALIVE but unsignalable (privilege boundary):
+                # only a restart can deliver the new world
+                return "restart", world
             deadline = time.time() + self._config.soft_remesh_timeout_s
             while time.time() < deadline:
                 if self._worker.poll().state != WorkerState.RUNNING:

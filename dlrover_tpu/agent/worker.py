@@ -210,8 +210,10 @@ class WarmSpare:
             [sys.executable, "-m", "dlrover_tpu.agent.warm_worker"],
             env=env,
             stdin=subprocess.PIPE,
-            stdout=self._log_file,
-            stderr=subprocess.STDOUT if self._log_file else None,
+            # without a log dir, the spare's chatter (READY marker,
+            # import warnings) must not leak into the agent's stdout
+            stdout=self._log_file or subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if self._log_file else subprocess.DEVNULL,
             start_new_session=True,
         )
 

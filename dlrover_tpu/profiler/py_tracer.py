@@ -57,6 +57,17 @@ def _code_of(target: Any):
     return getattr(target, "gi_code", None)
 
 
+# The sys.monitoring tool slot is PROCESS-global: all FunctionTracer
+# instances (the training loop's singleton, test-local tracers, user
+# ones) share it through this module-level registry. Callbacks are
+# registered once; each instance owns its targets and uninstall only
+# frees the slot when the registry empties — so one instance tearing
+# down can never strand another's events.
+_REGISTRY: Dict[Any, "FunctionTracer"] = {}  # code -> owning tracer
+_REGISTRY_MU = threading.Lock()
+_SLOT_HELD = False
+
+
 class FunctionTracer:
     """Times configured target functions into the tpu_timer core."""
 
@@ -87,7 +98,25 @@ class FunctionTracer:
         code = target if hasattr(target, "co_code") else _code_of(target)
         if code is None:
             return False
-        self._names[code] = name or getattr(code, "co_qualname", code.co_name)
+        with _REGISTRY_MU:
+            owner = _REGISTRY.get(code)
+            if owner is not None and owner is not self:
+                # first owner wins: silently re-owning would strand the
+                # other tracer's timings (and its uninstall would strand
+                # ours) — exactly what the registry exists to prevent
+                logger.warning(
+                    "code object %s already traced by another tracer",
+                    getattr(code, "co_qualname", code),
+                )
+                return False
+            self._names[code] = name or getattr(
+                code, "co_qualname", code.co_name
+            )
+            if self._installed:
+                # registry entries exist only for INSTALLED tracers —
+                # a never-installed tracer must leave no residue that
+                # pins the tool slot
+                _REGISTRY[code] = self
         if self._installed:
             self._enable_code(code)
         return True
@@ -158,46 +187,104 @@ class FunctionTracer:
                 stack.pop()
         return None
 
+    # module-level dispatch: events route to the instance that owns the
+    # code object, regardless of which instance registered callbacks
+    @staticmethod
+    def _dispatch_enter(code, offset):
+        owner = _REGISTRY.get(code)
+        if owner is None:
+            return _mon.DISABLE
+        return owner._on_enter(code, offset)
+
+    @staticmethod
+    def _dispatch_exit(code, offset, retval):
+        owner = _REGISTRY.get(code)
+        if owner is None:
+            return _mon.DISABLE
+        return owner._on_exit(code, offset, retval)
+
+    @staticmethod
+    def _dispatch_unwind(code, offset, exc):
+        owner = _REGISTRY.get(code)
+        if owner is not None:
+            owner._on_unwind(code, offset, exc)
+
     def _enable_code(self, code) -> None:
         _mon.set_local_events(_TOOL_ID, code, self._EVENTS)
 
     def install(self) -> bool:
+        global _SLOT_HELD
         if self._installed:
             return True
-        try:
-            _mon.use_tool_id(_TOOL_ID, "dlrover_tpu")
-        except ValueError:
-            logger.warning(
-                "sys.monitoring profiler slot taken; host tracer disabled"
-            )
-            return False
-        E = _mon.events
-        _mon.register_callback(_TOOL_ID, E.PY_START, self._on_enter)
-        _mon.register_callback(_TOOL_ID, E.PY_RESUME, self._on_enter)
-        _mon.register_callback(_TOOL_ID, E.PY_RETURN, self._on_exit)
-        _mon.register_callback(_TOOL_ID, E.PY_YIELD, self._on_exit)
-        _mon.register_callback(_TOOL_ID, E.PY_UNWIND, self._on_unwind)
-        # PY_UNWIND is global-only (set_local_events rejects it); it
-        # fires when an exception propagates OUT of a frame — e.g. the
-        # traced dataloader's StopIteration — and the callback is a dict
-        # miss for everything untraced.
-        _mon.set_events(_TOOL_ID, E.PY_UNWIND)
+        with _REGISTRY_MU:
+            if not _SLOT_HELD:
+                try:
+                    _mon.use_tool_id(_TOOL_ID, "dlrover_tpu")
+                except ValueError:
+                    logger.warning(
+                        "sys.monitoring profiler slot taken; "
+                        "host tracer disabled"
+                    )
+                    return False
+                E = _mon.events
+                _mon.register_callback(
+                    _TOOL_ID, E.PY_START, FunctionTracer._dispatch_enter
+                )
+                _mon.register_callback(
+                    _TOOL_ID, E.PY_RESUME, FunctionTracer._dispatch_enter
+                )
+                _mon.register_callback(
+                    _TOOL_ID, E.PY_RETURN, FunctionTracer._dispatch_exit
+                )
+                _mon.register_callback(
+                    _TOOL_ID, E.PY_YIELD, FunctionTracer._dispatch_exit
+                )
+                _mon.register_callback(
+                    _TOOL_ID, E.PY_UNWIND, FunctionTracer._dispatch_unwind
+                )
+                # PY_UNWIND is global-only (set_local_events rejects
+                # it); it fires when an exception propagates OUT of a
+                # frame — e.g. the traced dataloader's StopIteration —
+                # and the dispatch is a dict miss for everything
+                # untraced.
+                _mon.set_events(_TOOL_ID, _mon.events.PY_UNWIND)
+                _SLOT_HELD = True
         self._installed = True
+        with _REGISTRY_MU:
+            # (re-)claim our targets: uninstall popped them, and
+            # add_target only registers while installed. A code another
+            # installed tracer claimed in the meantime is dropped from
+            # OUR set — enabling/disabling it would strand theirs.
+            for code in list(self._names):
+                if _REGISTRY.setdefault(code, self) is not self:
+                    logger.warning(
+                        "dropping %s: now traced by another tracer",
+                        self._names.pop(code),
+                    )
         for code in self._names:
             self._enable_code(code)
         return True
 
     def uninstall(self) -> None:
+        global _SLOT_HELD
         if not self._installed:
             return
-        for code in self._names:
-            try:
-                _mon.set_local_events(_TOOL_ID, code, 0)
-            except ValueError:
-                pass
-        _mon.set_events(_TOOL_ID, 0)
-        _mon.free_tool_id(_TOOL_ID)
-        self._installed = False
+        with _REGISTRY_MU:
+            for code in self._names:
+                if _REGISTRY.get(code) is self:
+                    _REGISTRY.pop(code)
+                try:
+                    _mon.set_local_events(_TOOL_ID, code, 0)
+                except ValueError:
+                    pass
+            self._installed = False
+            # free the slot only when NO tracer's targets remain — the
+            # training loop's singleton must survive a test-local
+            # tracer's teardown
+            if _SLOT_HELD and not _REGISTRY:
+                _mon.set_events(_TOOL_ID, 0)
+                _mon.free_tool_id(_TOOL_ID)
+                _SLOT_HELD = False
 
 
 FunctionTracer._EVENTS = (

@@ -15,6 +15,10 @@ from typing import Any, Callable, Iterable, Optional, Tuple
 
 from ..common.log import logger
 
+# Process-wide GC tracer installed by the first loop run (gc.callbacks
+# hooks must not stack when run() is called repeatedly).
+_gc_tracer = None
+
 
 def gradient_accumulation_steps(max_workers: int, current_workers: int) -> int:
     """Accumulation factor keeping the global batch fixed as the world
@@ -143,6 +147,7 @@ class ElasticTrainLoop:
         py_tracing.c capability (SURVEY §2.15), via sys.monitoring so
         untraced code carries no instrumentation at all."""
         try:
+            from ..profiler.host_stalls import GcStallTracer
             from ..profiler.py_tracer import (
                 FunctionTracer,
                 install_crash_hook,
@@ -153,6 +158,13 @@ class ElasticTrainLoop:
             tracer.add_env_targets()
             tracer.install()
             install_crash_hook(tracer.timer)
+            # GC pauses in the same stream (a straggler whose cause is
+            # gen-2 GC is attributable at a glance) — hooks fire only
+            # at collections, so always-on costs nothing between them.
+            # One per PROCESS: repeated loop runs must not stack hooks.
+            global _gc_tracer
+            if _gc_tracer is None:
+                _gc_tracer = GcStallTracer(tracer.timer).install()
         except Exception as e:  # noqa: BLE001 — aux, never blocks training
             logger.warning("host tracer unavailable: %s", e)
 

@@ -716,3 +716,63 @@ class TestPyTracer:
             engine.shm.unlink()
             engine.close()
             FunctionTracer.singleton().uninstall()
+
+
+class TestTracerSlotSharing:
+    """The sys.monitoring slot is process-global; instances share it
+    through the module registry. Reinstall and cross-instance teardown
+    must never strand another tracer's events."""
+
+    def test_uninstall_reinstall_records_again(self):
+        from dlrover_tpu.profiler.py_tracer import FunctionTracer
+
+        t = FunctionTracer()
+
+        def fn():
+            time.sleep(0.01)
+
+        assert t.add_target(fn, name="reinstall_fn")
+        assert t.install()
+        fn()
+        assert t.calls == 1
+        t.uninstall()
+        assert t.install()  # must re-claim the registry entries
+        fn()
+        assert t.calls == 2
+        t.uninstall()
+
+    def test_teardown_of_one_tracer_keeps_the_other_live(self):
+        from dlrover_tpu.profiler.py_tracer import FunctionTracer
+
+        a, b = FunctionTracer(), FunctionTracer()
+
+        def fa():
+            return 1
+
+        def fb():
+            return 2
+
+        assert a.add_target(fa, name="fa") and a.install()
+        assert b.add_target(fb, name="fb") and b.install()
+        fa(), fb()
+        assert a.calls == 1 and b.calls == 1
+        b.uninstall()  # must NOT free the slot (a still has targets)
+        fa()
+        assert a.calls == 2, "surviving tracer was stranded"
+        a.uninstall()
+
+    def test_same_code_object_not_double_owned(self):
+        from dlrover_tpu.profiler.py_tracer import FunctionTracer
+
+        a, b = FunctionTracer(), FunctionTracer()
+
+        def shared():
+            return 0
+
+        assert a.add_target(shared, name="mine") and a.install()
+        b.install()
+        assert not b.add_target(shared, name="theirs")
+        shared()
+        assert a.calls == 1 and b.calls == 0
+        a.uninstall()
+        b.uninstall()

@@ -122,6 +122,12 @@ class ElasticTrainingAgent:
         self._resource_monitor.start()
         try:
             self._setup_profiling()
+            # Spawn the first spare NOW, concurrently with the
+            # rendezvous: its imports race the world formation, so even
+            # the FIRST worker start — including a replacement node's,
+            # which is on the recovery critical path — can adopt a
+            # warm interpreter.
+            self._replenish_spare(delay_s=0.0)
             self._initialize_workers()
             return self._invoke_run()
         finally:
@@ -184,16 +190,28 @@ class ElasticTrainingAgent:
             import shutil
 
             shutil.rmtree(self._remesh_dir, ignore_errors=True)
-        elif self._worker is not None and self._worker.pid:
-            for kind in ("ready", "world", "ack"):
+        else:
+            # Shared dir: purge pid-keyed files whose process is GONE —
+            # covers both our previous worker and a dead predecessor
+            # AGENT's leftovers (a recycled pid meeting a stale ready_
+            # file would get a fatal default-disposition SIGUSR1).
+            try:
+                entries = os.listdir(self._remesh_dir)
+            except OSError:
+                entries = []
+            for name in entries:
+                kind, _, pid_s = name.partition("_")
+                if kind not in ("ready", "world", "ack") or not pid_s.isdigit():
+                    continue
                 try:
-                    os.unlink(
-                        os.path.join(
-                            self._remesh_dir, f"{kind}_{self._worker.pid}"
-                        )
-                    )
-                except OSError:
-                    pass
+                    os.kill(int(pid_s), 0)
+                except ProcessLookupError:
+                    try:
+                        os.unlink(os.path.join(self._remesh_dir, name))
+                    except OSError:
+                        pass
+                except PermissionError:
+                    pass  # alive under another uid: not ours to judge
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
         spare = self._take_spare()
         how = self._worker.start(
@@ -230,8 +248,10 @@ class ElasticTrainingAgent:
     # doubles the CPU demand at exactly the moment MTTR is measured.
     SPARE_SPAWN_DELAY_S = 8.0
 
-    def _replenish_spare(self) -> None:
-        """Keep exactly one warm spare on deck (spawned after a delay)."""
+    def _replenish_spare(self, delay_s: Optional[float] = None) -> None:
+        """Keep exactly one warm spare on deck (spawned after a delay,
+        except at agent startup where the spare's imports race the
+        rendezvous instead of a live worker's recovery)."""
         if not self._config.warm_spare or self._spare is not None:
             return
 
@@ -246,7 +266,12 @@ class ElasticTrainingAgent:
                 logger.warning("warm spare spawn failed: %s", e)
                 self._spare = None
 
-        timer = threading.Timer(self.SPARE_SPAWN_DELAY_S, spawn)
+        if delay_s is None:
+            delay_s = self.SPARE_SPAWN_DELAY_S
+        if delay_s <= 0:
+            spawn()
+            return
+        timer = threading.Timer(delay_s, spawn)
         timer.daemon = True
         timer.start()
 

@@ -66,6 +66,10 @@ def _code_of(target: Any):
 _REGISTRY: Dict[Any, "FunctionTracer"] = {}  # code -> owning tracer
 _REGISTRY_MU = threading.Lock()
 _SLOT_HELD = False
+# ids of tracers currently installed: the slot must outlive EVERY
+# installed instance, not merely the registry (an installed tracer may
+# be momentarily target-less and add targets later).
+_INSTALLED_IDS: set = set()
 
 
 class FunctionTracer:
@@ -249,6 +253,7 @@ class FunctionTracer:
                 # untraced.
                 _mon.set_events(_TOOL_ID, _mon.events.PY_UNWIND)
                 _SLOT_HELD = True
+            _INSTALLED_IDS.add(id(self))
         self._installed = True
         with _REGISTRY_MU:
             # (re-)claim our targets: uninstall popped them, and
@@ -278,10 +283,11 @@ class FunctionTracer:
                 except ValueError:
                     pass
             self._installed = False
-            # free the slot only when NO tracer's targets remain — the
-            # training loop's singleton must survive a test-local
-            # tracer's teardown
-            if _SLOT_HELD and not _REGISTRY:
+            _INSTALLED_IDS.discard(id(self))
+            # free the slot only when no targets AND no installed
+            # tracers remain — an installed-but-momentarily-target-less
+            # tracer must not be stranded with a freed tool id
+            if _SLOT_HELD and not _REGISTRY and not _INSTALLED_IDS:
                 _mon.set_events(_TOOL_ID, 0)
                 _mon.free_tool_id(_TOOL_ID)
                 _SLOT_HELD = False
@@ -298,12 +304,21 @@ FunctionTracer._EVENTS = (
 # -- crash exception hook ----------------------------------------------------
 
 
+_CRASH_HOOK_INSTALLED = False
+
+
 def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
     """Record uncaught exceptions (main thread AND worker threads) into
     the profiler stream before the process dies, so a post-mortem
     timeline shows WHAT killed the trainer next to what it was doing
     (reference: py_syshook.c). Chains to the previous hooks — the
-    events-SDK crash flush (common/error_handler.py) still runs."""
+    events-SDK crash flush (common/error_handler.py) still runs.
+    Idempotent per process: repeated calls (e.g. every loop run) must
+    not stack N-deep hook chains emitting duplicate crash records."""
+    global _CRASH_HOOK_INSTALLED
+    if _CRASH_HOOK_INSTALLED:
+        return
+    _CRASH_HOOK_INSTALLED = True
     t = timer or TpuTimer.singleton()
     prev_except = sys.excepthook
     prev_thread = threading.excepthook

@@ -34,9 +34,43 @@ class BrainResourceOptimizer(ResourceOptimizer):
         self._max_workers = max_workers
         self._world_size_fn = world_size_fn or (lambda: 0)
         self._fallback = fallback
+        self._init_checks_left = self.INIT_ADJUST_CHECKS
+        self._init_attempts_left = self.INIT_ADJUST_MAX_ATTEMPTS
+
+    # The first few rounds consult the Brain's init-adjust stage: a job
+    # running far below its cohort at the same size is misconfigured (a
+    # slow host, wrong batch) and should be flagged/corrected NOW, not
+    # slow-walked by the running-stage knee search. CHECKS counts
+    # conclusive verdicts; MAX_ATTEMPTS bounds total RPCs so a job with
+    # no cohort (unique model) stops asking after ~2 reporter periods.
+    INIT_ADJUST_CHECKS = 3
+    INIT_ADJUST_MAX_ATTEMPTS = 20
 
     def generate_plan(self) -> ResourcePlan:
         current = self._world_size_fn()
+        if self._init_checks_left > 0 and self._init_attempts_left > 0:
+            self._init_attempts_left -= 1
+            resp = self._brain.get_optimization_plan(
+                "init_adjust",
+                job_uuid=self._job_uuid,
+                node_unit=self._node_unit,
+                max_workers=self._max_workers,
+            )
+            # Only a CONCLUSIVE verdict (a cohort comparison actually
+            # ran — cohort_ratio present) consumes the window, in
+            # either direction: healthy closes it, anomaly closes it
+            # and corrects. Inconclusive rounds (no samples yet — the
+            # reporter streams every ~30 s while plans run every ~5 s —
+            # no cohort, Brain unreachable) keep the check alive so the
+            # anomaly scan happens on REAL data, not on startup air.
+            if resp is not None and "cohort_ratio" in resp.extra:
+                self._init_checks_left = 0
+                if resp.extra.get("anomaly"):
+                    logger.warning(
+                        "brain init-adjust flags this job: %s", resp.reason
+                    )
+                    if resp.worker_num > 0:
+                        return ResourcePlan(worker_num=resp.worker_num)
         resp = self._brain.get_optimization_plan(
             "running",
             job_uuid=self._job_uuid,

@@ -507,3 +507,78 @@ class TestArbiterProperties:
             assert set(alloc) == set(uuids)
             assert sum(alloc.values()) <= total
             assert all(v >= unit and v % unit == 0 for v in alloc.values())
+
+
+class TestMasterInitAdjustIntegration:
+    """The master's Brain-backed optimizer consults the init-adjust
+    stage in its first rounds, so a cohort-anomalous job is corrected
+    immediately instead of slow-walked by the knee search."""
+
+    def test_anomalous_job_corrected_in_first_rounds(self):
+        svc = BrainService(db_path=":memory:", service_type="grpc")
+        store = svc.store
+        _seed_history(store)
+        store.upsert_job(
+            JobRecord(
+                job_uuid="anom",
+                job_name="anom",
+                model_signature="gpt2s",
+                workload="jax",
+                worker_num=4,
+                status="running",
+            )
+        )
+        store.add_metric(
+            JobMetricSample(
+                job_uuid="anom", world_size=4, steps_per_second=1.0
+            )
+        )
+        svc.start()
+        try:
+            client = BrainClient(svc.addr, service_type="grpc")
+            opt = BrainResourceOptimizer(
+                client, "anom", world_size_fn=lambda: 4
+            )
+            plan = opt.generate_plan()
+            # init-adjust fired: cohort knee recommended right away
+            assert plan.worker_num == 8
+            # verdict reached; subsequent rounds use the running stage
+            assert opt._init_checks_left == 0
+            client.close()
+        finally:
+            svc.stop()
+
+    def test_healthy_job_falls_through_to_running_stage(self):
+        svc = BrainService(db_path=":memory:", service_type="grpc")
+        store = svc.store
+        _seed_history(store)
+        store.upsert_job(
+            JobRecord(
+                job_uuid="ok",
+                job_name="ok",
+                model_signature="gpt2s",
+                workload="jax",
+                worker_num=2,
+                status="running",
+            )
+        )
+        store.add_metric(
+            JobMetricSample(
+                job_uuid="ok", world_size=2, steps_per_second=1.7
+            )
+        )
+        svc.start()
+        try:
+            client = BrainClient(svc.addr, service_type="grpc")
+            opt = BrainResourceOptimizer(
+                client, "ok", world_size_fn=lambda: 2
+            )
+            plan = opt.generate_plan()
+            # healthy at 2 hosts; the RUNNING stage still says grow to 8
+            assert plan.worker_num == 8
+            # healthy IS a conclusive verdict: the window closes and no
+            # further init_adjust RPCs are issued
+            assert opt._init_checks_left == 0
+            client.close()
+        finally:
+            svc.stop()

@@ -85,6 +85,34 @@ def run_reward() -> int:
     return 0
 
 
+# -- dataset role ------------------------------------------------------------
+
+
+def run_dataset() -> int:
+    """Index-addressed prompt server (reference ray_dataloader_iter
+    shape: the dataset lives in ONE role, consumers iterate it remotely
+    with prefetch). Deterministic by index: the same index always
+    yields the same batch, so consumers control replay/resume purely by
+    the indices they issue."""
+    import numpy as np
+
+    from dlrover_tpu.unified import MasterKV
+    from dlrover_tpu.unified.comm import export_rpc_method
+
+    def fetch_prompts(index: int):
+        rng = np.random.default_rng(1000 + int(index))
+        return rng.integers(0, VOCAB, PROMPTS_PER_BATCH).tolist()
+
+    export_rpc_method("fetch_prompts", fetch_prompts)
+    print("dataset role up", flush=True)
+    kv = MasterKV()
+    stop_state = {"saw_running": False}
+    while not _stop_requested(kv, stop_state):
+        time.sleep(0.5)
+    print("dataset done", flush=True)
+    return 0
+
+
 # -- rollout role ------------------------------------------------------------
 
 
@@ -113,12 +141,36 @@ def _softmax(x, axis=-1):
 def run_rollout() -> int:
     import numpy as np
 
-    from dlrover_tpu.unified import MasterDataQueue, MasterKV, create_rpc_proxy
-    from dlrover_tpu.unified.comm import current_role_index, pack_array
+    from dlrover_tpu.unified import (
+        MasterDataQueue,
+        MasterKV,
+        RemoteBatchIterator,
+        create_rpc_proxy,
+    )
+    from dlrover_tpu.unified.comm import (
+        current_role_index,
+        current_role_world,
+        pack_array,
+    )
 
     rng = np.random.default_rng(7 + current_role_index())
     queue = MasterDataQueue("grpo_experience")
     kv = MasterKV()
+    # Prompts come from the DATASET role through the prefetching remote
+    # iterator (2 fetches in flight, so generation overlaps the RPC);
+    # each rollout instance reads a disjoint index stride derived from
+    # the role world, so streams never overlap at any instance count.
+    # (A restarted rollout REPLAYS its stride from the top — fine for
+    # this i.i.d. toy; true resume would persist a start offset.)
+    my_index = current_role_index()
+    stride = max(1, current_role_world())
+    prompt_iter = RemoteBatchIterator(
+        "dataset",
+        "fetch_prompts",
+        prefetch=2,
+        index_fn=lambda i: i * stride + my_index,
+        retry_for=60.0,
+    )
     reward = create_rpc_proxy(
         "reward", RewardService, ns="reward", retry_for=30.0
     )
@@ -147,7 +199,12 @@ def run_rollout() -> int:
             time.sleep(0.2)
             continue
 
-        prompts = rng.integers(0, VOCAB, PROMPTS_PER_BATCH).astype(np.int32)
+        try:
+            prompts = np.asarray(next(prompt_iter), dtype=np.int32)
+        except (StopIteration, ConnectionError, OSError):
+            if kv.get("stop"):
+                break
+            raise
         # group sampling: G completions per prompt under the CURRENT
         # policy (token distribution conditioned on the previous token)
         comps = np.zeros(
@@ -313,9 +370,10 @@ def submit() -> int:
         RLJobBuilder("grpo-jax")
         .node_num(1)
         .device_per_node(4)
-        .trainer(me, num=1, device=2.0)
+        .trainer(me, num=1, device=1.5)
         .rollout(me, num=2, device=0.5)
         .reward(me, num=1, device=0.5)
+        .role("dataset", me, num=1, device=0.5)
         .build()
     )
     master = job.submit(log_dir=os.path.join(OUT_DIR, "logs"))
@@ -332,6 +390,8 @@ def main() -> int:
         return run_rollout()
     if role == "reward":
         return run_reward()
+    if role == "dataset":
+        return run_dataset()
     if not role:
         return submit()
     print(f"unknown role {role!r}", file=sys.stderr)

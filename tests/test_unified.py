@@ -936,9 +936,11 @@ class TestGrpoE2E:
             RLJobBuilder("grpo-e2e")
             .node_num(1)
             .device_per_node(4)
-            .trainer([sys.executable, script], num=1, device=2.0, env=env)
+            .trainer([sys.executable, script], num=1, device=1.5, env=env)
             .rollout([sys.executable, script], num=2, device=0.5, env=env)
             .reward([sys.executable, script], num=1, device=0.5, env=env)
+            .role("dataset", [sys.executable, script], num=1, device=0.5,
+                  env=env)
             .build()
         )
         manager = PrimeManager(job, log_dir=str(tmp_path / "logs"))

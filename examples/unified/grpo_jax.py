@@ -76,13 +76,9 @@ def run_reward() -> int:
     from dlrover_tpu.unified.comm import export_rpc_instance
 
     export_rpc_instance("reward", RewardService())
-    print("reward service up", flush=True)
-    kv = MasterKV()
-    stop_state = {"saw_running": False}
-    while not _stop_requested(kv, stop_state):
-        time.sleep(0.5)
+    rc = _serve_until_stop(MasterKV(), "reward service up")
     print("reward done", flush=True)
-    return 0
+    return rc
 
 
 # -- dataset role ------------------------------------------------------------
@@ -104,13 +100,9 @@ def run_dataset() -> int:
         return rng.integers(0, VOCAB, PROMPTS_PER_BATCH).tolist()
 
     export_rpc_method("fetch_prompts", fetch_prompts)
-    print("dataset role up", flush=True)
-    kv = MasterKV()
-    stop_state = {"saw_running": False}
-    while not _stop_requested(kv, stop_state):
-        time.sleep(0.5)
+    rc = _serve_until_stop(MasterKV(), "dataset role up")
     print("dataset done", flush=True)
-    return 0
+    return rc
 
 
 # -- rollout role ------------------------------------------------------------
@@ -128,6 +120,16 @@ def _stop_requested(kv, state) -> bool:
         state["saw_running"] = True
         return False
     return state["saw_running"]
+
+
+def _serve_until_stop(kv, banner: str) -> int:
+    """Passive server roles (reward, dataset) park here until the
+    learner's stop flag — stale-stop aware via _stop_requested."""
+    print(banner, flush=True)
+    stop_state = {"saw_running": False}
+    while not _stop_requested(kv, stop_state):
+        time.sleep(0.5)
+    return 0
 
 
 def _softmax(x, axis=-1):
@@ -164,12 +166,15 @@ def run_rollout() -> int:
     # this i.i.d. toy; true resume would persist a start offset.)
     my_index = current_role_index()
     stride = max(1, current_role_world())
+    # retry_for bounds BOTH startup tolerance (dataset role still
+    # booting) and the worst-case shutdown stall (in-flight fetches
+    # retrying against an exited dataset before the stop flag is seen)
     prompt_iter = RemoteBatchIterator(
         "dataset",
         "fetch_prompts",
         prefetch=2,
         index_fn=lambda i: i * stride + my_index,
-        retry_for=60.0,
+        retry_for=15.0,
     )
     reward = create_rpc_proxy(
         "reward", RewardService, ns="reward", retry_for=30.0

@@ -603,3 +603,103 @@ class TestSoftRemesh:
         finally:
             engine.shm.unlink()
             engine.close()
+
+    def test_refused_offer_restarts_into_same_round(self, master2, tmp_path):
+        """A worker that refuses the offered world is restarted INTO
+        that world — no second global rendezvous round is formed."""
+        script = tmp_path / "refusing_worker.py"
+        script.write_text(
+            "import json, os, signal, sys, time\n"
+            "d = os.environ['DLROVER_REMESH_DIR']\n"
+            "os.makedirs(d, exist_ok=True)\n"
+            "pid = os.getpid()\n"
+            "flag = []\n"
+            "signal.signal(signal.SIGUSR1, lambda *a: flag.append(1))\n"
+            "open(f'{d}/ready_{pid}', 'w').write(str(pid))\n"
+            "# record every incarnation so the test sees the restart\n"
+            "runs = os.environ['RUNS_DIR']\n"
+            "open(f'{runs}/run_{pid}', 'w').write(\n"
+            "    os.environ.get('DLROVER_NUM_PROCESSES', '?'))\n"
+            "t0 = time.time()\n"
+            "while time.time() - t0 < 60:\n"
+            "    if flag:\n"
+            "        flag.clear()\n"
+            "        json.dump({'accepted': False},\n"
+            "                  open(f'{d}/ack_{pid}', 'w'))\n"
+            "    time.sleep(0.05)\n"
+            "sys.exit(0)\n"
+        )
+        runs = tmp_path / "runs"
+        runs.mkdir()
+        config = ElasticLaunchConfig(
+            min_nodes=2,
+            max_nodes=2,
+            node_rank=0,
+            entrypoint=str(script),
+            master_addr=master2.addr,
+            monitor_interval=0.3,
+            warm_spare=False,
+            extra_env={"RUNS_DIR": str(runs)},
+        )
+        agent = ElasticTrainingAgent(
+            config,
+            client=_client(master2, 0),
+            start_ckpt_saver=False,
+        )
+
+        def peer_join():
+            handler = MasterRendezvousHandler(
+                RendezvousName.TRAINING,
+                node_rank=1,
+                client=_client(master2, 1),
+                rdzv_timeout=60,
+            )
+            return handler.next_rendezvous()
+
+        rc = {}
+        t = threading.Thread(target=lambda: rc.update(v=agent.run()))
+        t.start()
+        t_first = threading.Thread(target=peer_join)
+        t_first.start()
+        try:
+            t_first.join(timeout=60)
+            deadline = time.time() + 60
+            while time.time() < deadline and (
+                agent._worker is None
+                or agent._worker.pid is None
+                or not os.path.exists(
+                    os.path.join(
+                        agent._remesh_dir, f"ready_{agent._worker.pid}"
+                    )
+                )
+            ):
+                time.sleep(0.1)
+            pid_before = agent._worker.pid
+            assert pid_before
+
+            joiner = {}
+            t2 = threading.Thread(
+                target=lambda: joiner.update(w=peer_join())
+            )
+            t2.start()
+            t2.join(timeout=60)
+            new_round = joiner["w"].round
+
+            # refusal must RESTART the worker (new pid) into the SAME
+            # round the refusal consumed
+            deadline = time.time() + 60
+            while time.time() < deadline and (
+                agent._worker.pid == pid_before
+                or len(list(runs.iterdir())) < 2
+            ):
+                time.sleep(0.2)
+            assert agent._worker.pid != pid_before, (
+                "refusing worker was never restarted"
+            )
+            assert agent._world.round == new_round, (
+                "restart formed an extra rendezvous round instead of "
+                "reusing the refused offer's"
+            )
+        finally:
+            agent.stop()
+            t.join(timeout=30)

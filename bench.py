@@ -409,15 +409,20 @@ def _build(cfg_kwargs, batch, seq, mesh):
 
 
 def _time_steps(state, step_fn, x, y, iters=6):
+    import jax
     import numpy as np
 
     state, loss = step_fn(state, x, y)  # compile + warmup
     # Hard sync via a scalar fetch: over the tunneled chip
     # block_until_ready can return before the step actually executed
     # (observed: 1.4 ms "steps" for a 0.36 s program), so every timed
-    # iteration syncs on the loss value itself.
+    # iteration syncs on the loss value itself. The scalar fetch costs a
+    # network round-trip on a tunneled chip (~31 ms measured); subtract
+    # the measured dispatch+fetch floor so step time reflects the device,
+    # not the tunnel (r4 methodology fix — r3 under-reported ~9%).
     if not np.isfinite(float(loss)):
         raise RuntimeError(f"non-finite warmup loss {float(loss)}")
+    floor_s = _dispatch_floor(loss)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -426,7 +431,20 @@ def _time_steps(state, step_fn, x, y, iters=6):
         times.append(time.perf_counter() - t0)
         if not np.isfinite(loss_val):
             raise RuntimeError(f"non-finite loss {loss_val}")
-    return float(np.median(times)), state
+    return max(float(np.median(times)) - floor_s, 1e-9), state
+
+
+def _dispatch_floor(val):
+    """Seconds for one tiny dispatch + scalar fetch — the tunnel/host
+    overhead every synced timing pays; subtracted by both the step and
+    kernel benches so device time is measured, not the transport."""
+    import jax
+
+    sync = jax.jit(lambda v: (v * 0.0).sum())
+    _ = float(sync(val))  # compile
+    t0 = time.perf_counter()
+    _ = float(sync(val))
+    return time.perf_counter() - t0
 
 
 def _mfu(cfg, n_params, batch, seq, step_s):
@@ -435,7 +453,14 @@ def _mfu(cfg, n_params, batch, seq, step_s):
 
 
 def _bench_long_context(extra):
-    """Flash-attention kernel at 4x the training seq (TPU only)."""
+    """Flash-attention kernel at 4x the training seq (TPU only).
+
+    Timing methodology (r4): the r3 bench synced device→host after every
+    kernel call, so on a tunneled TPU the 'kernel time' was ~95% network
+    round-trip (83.8 ms/call reported vs ~2.8 ms real). Chain N kernel
+    calls inside ONE jitted scan (single dispatch), sync once through a
+    scalar fetch, and subtract the measured dispatch+fetch floor.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -443,28 +468,41 @@ def _bench_long_context(extra):
     from dlrover_tpu.ops.flash_attention import flash_attention
 
     B, H, T, Dh = 4, 12, 4096, 64
+    N = 50
     r2 = np.random.default_rng(1)
     mk = lambda: jnp.asarray(  # noqa: E731
         r2.standard_normal((B, T, H, Dh)), jnp.bfloat16
     )
     q, k, v = mk(), mk(), mk()
-    att = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    out = att(q, k, v)
-    if not np.isfinite(float(out.sum())):
+
+    att1 = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    if not np.isfinite(float(att1(q, k, v).sum())):
         raise RuntimeError("non-finite flash output")
+
+    def many(q, k, v):
+        def body(o, _):
+            return flash_attention(o, k, v, causal=True), None
+
+        o, _ = jax.lax.scan(body, q, None, length=N)
+        return o.sum()
+
+    floor_s = _dispatch_floor(q)
+
+    att = jax.jit(many)
+    _ = float(att(q, k, v))  # compile
     ts = []
-    for _ in range(5):
+    for _ in range(3):
         t0 = time.perf_counter()
-        out = att(q, k, v)
-        _ = float(out[0, 0, 0, 0])  # hard sync
-        ts.append(time.perf_counter() - t0)
-    att_s = float(np.median(ts))
+        _ = float(att(q, k, v))
+        ts.append((time.perf_counter() - t0 - floor_s) / N)
+    att_s = max(float(np.median(ts)), 1e-6)
     # causal fwd flops: 2 matmuls over the lower triangle
     flops = 2 * 2 * B * H * T * T * Dh / 2
     extra.update(
         {
             "flash_seq4096_ms": round(att_s * 1e3, 2),
             "flash_seq4096_tflops": round(flops / att_s / 1e12, 1),
+            "flash_seq4096_dispatch_floor_ms": round(floor_s * 1e3, 1),
         }
     )
 
@@ -490,14 +528,32 @@ def _bench_checkpoint(extra, state, mesh, flash_s):
             runs.append(time.perf_counter() - t0)
         save_block_s = min(runs)
 
-        if not engine.save_to_storage(4, state):
+        # Async staging (r4): trainer-visible block is one device-side
+        # snapshot dispatch; D2H + memcpy happen behind the shard lock.
+        # Pre-compile the snapshot executable so the timed saves measure
+        # dispatch, not remote_compile. Each drain pays the tunnel's
+        # TRUE d2h (the blocking saves above ride jax's cached host
+        # values — same `state` object re-saved — which real training
+        # never does), so keep the timed async saves to two.
+        jax.block_until_ready(engine._snapshot(state))
+        async_runs = []
+        for step in range(4, 6):
+            t0 = time.perf_counter()
+            if not engine.save_to_memory(step, state, block=False):
+                raise RuntimeError(f"async save failed at step {step}")
+            async_runs.append(time.perf_counter() - t0)
+            if not engine.wait_staged(timeout=600):
+                raise RuntimeError(f"async staging failed at step {step}")
+        async_block_s = min(async_runs)
+
+        if not engine.save_to_storage(7, state):
             raise RuntimeError("save_to_storage failed")
         if not engine.wait_saving(timeout=600):
             raise RuntimeError("async persist did not complete")
         t0 = time.perf_counter()
         step, restored = engine.load(state)
         restore_s = time.perf_counter() - t0
-        if step != 4 or restored is None:
+        if step != 7 or restored is None:
             raise RuntimeError(f"restore failed (step={step})")
         del restored
 
@@ -521,15 +577,18 @@ def _bench_checkpoint(extra, state, mesh, flash_s):
         h2d_ref_s = (time.perf_counter() - t0) * ref_frac
         del ref_arr, ref_buf
 
-        goodput_10 = 10 * flash_s / (10 * flash_s + save_block_s)
+        # Goodput at a 10-step cadence uses the ASYNC block (what the
+        # train loop actually pays per cadence save since r4).
+        goodput_10 = 10 * flash_s / (10 * flash_s + async_block_s)
         extra.update(
             {
                 "ckpt_bytes": int(nbytes),
                 # r01 family name, kept stable alongside the short alias
                 "flash_ckpt_save_block_s": round(save_block_s, 4),
                 "ckpt_save_block_s": round(save_block_s, 4),
+                "ckpt_async_stage_block_s": round(async_block_s, 4),
                 "ckpt_save_vs_target": round(
-                    TARGET_SAVE_BLOCK_S / max(save_block_s, 1e-9), 2
+                    TARGET_SAVE_BLOCK_S / max(async_block_s, 1e-9), 2
                 ),
                 "restore_s": round(restore_s, 4),
                 "h2d_floor_s": round(h2d_ref_s, 4),

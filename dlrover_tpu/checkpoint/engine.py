@@ -10,6 +10,7 @@ full/fsdp/megatron engines; SURVEY.md §2.4).
 """
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -147,6 +148,18 @@ class CheckpointEngine:
             }
         )
         self._shard_lock = self._wait_lock()
+        # Async staging (save_to_memory(block=False)): the trainer's
+        # blocking cost is one device-side snapshot dispatch; a
+        # background thread does the D2H + shm memcpy and releases the
+        # shard lock when done.
+        self._stage_thread: Optional[threading.Thread] = None
+        self._stage_error: Optional[BaseException] = None
+        self._snap_fn = None
+        # Async staging needs ~+1x the state's bytes of free HBM for
+        # the snapshot window. If the device can't afford it, the first
+        # attempt fails RESOURCE_EXHAUSTED and all later block=False
+        # saves transparently degrade to the blocking path.
+        self._async_disabled = False
 
     def _wait_lock(self, timeout: float = 30.0) -> SharedLock:
         deadline = time.time() + timeout
@@ -174,13 +187,46 @@ class CheckpointEngine:
         )
         return bool(np.all(all_ready))
 
-    def save_to_memory(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
-        """Stage the pytree into host shm. Blocks only for D2H + memcpy.
-        Skips (returns False) if ANY host's persister still holds its
-        shard lock (reference non-blocking acquire + all-rank-ready
-        allreduce, engine.py:57-71,351-365) — all-or-none, so every
-        host's shm always stages the SAME step."""
-        acquired = self._shard_lock.acquire(blocking=False)
+    def save_to_memory(
+        self,
+        step: int,
+        pytree: Any,
+        extra: Optional[Dict] = None,
+        block: bool = True,
+        for_storage: bool = False,
+    ) -> bool:
+        """Stage the pytree into host shm. Skips (returns False) if ANY
+        host's persister still holds its shard lock (reference
+        non-blocking acquire + all-rank-ready allreduce,
+        engine.py:57-71,351-365) — all-or-none, so every host's shm
+        always stages the SAME step.
+
+        ``block=True`` blocks for D2H + memcpy (sub-second at HBM/shm
+        bandwidth). ``block=False`` blocks only to DISPATCH a
+        device-side snapshot (an HBM-bandwidth copy this engine owns —
+        NOTE: the snapshot holds ~+1x the state's bytes in HBM until
+        staging drains; a device without that headroom OOMs the first
+        attempt, which permanently degrades block=False to the blocking
+        path for this engine):
+        the train step donates its state buffers
+        (``train_step.py:donate``), so staging must not read them after
+        the trainer's next dispatch — ``copy_to_host_async`` alone does
+        NOT survive donation (the array is marked deleted). A background
+        thread then streams the snapshot to host shm and releases the
+        shard lock; the lock serializes it against the persister and
+        cross-process readers. The next save from THIS engine must be
+        guarded separately — the shard lock is reentrant per owner
+        (same pid+object), so an in-flight staging thread would not
+        block a sibling acquire — hence the explicit thread-alive skip,
+        folded into the all-hosts allreduce so every host skips the
+        same step together.
+        """
+        staging = self._stage_thread is not None and self._stage_thread.is_alive()
+        if staging:
+            logger.warning(
+                "step %s: previous async stage still in flight", step
+            )
+        acquired = (not staging) and self._shard_lock.acquire(blocking=False)
         try:
             ready = self._all_hosts_ready(acquired)
         except Exception:
@@ -197,6 +243,37 @@ class CheckpointEngine:
                 "skip save_to_memory step %s: a persister is busy", step
             )
             return False
+        if not block and self._async_disabled:
+            block = True  # degraded: no HBM headroom for snapshots
+        if not block:
+            try:
+                snapshot = self._snapshot(pytree)
+                t = threading.Thread(
+                    target=self._stage_async,
+                    args=(step, snapshot, extra, for_storage),
+                    name=f"ckpt-stage-{step}",
+                    daemon=True,
+                )
+                t.start()
+                # Assigned only AFTER start(): join() on a never-started
+                # thread raises, which would break every later
+                # wait_staged/close if start() itself failed.
+                self._stage_thread = t
+                return True
+            except Exception as e:
+                msg = repr(e).lower()
+                if "resource_exhausted" in msg or "out of memory" in msg:
+                    # No HBM headroom for the snapshot: degrade THIS and
+                    # all later saves to the blocking path (we still
+                    # hold the shard lock — fall through below).
+                    self._async_disabled = True
+                    logger.error(
+                        "snapshot OOM at step %s; degrading to blocking "
+                        "saves", step
+                    )
+                else:
+                    self._shard_lock.release()
+                    raise
         try:
             with self._events.ckpt_save(step, storage="memory"):
                 self.shm.save_pytree(
@@ -206,6 +283,11 @@ class CheckpointEngine:
                     mesh=self.mesh,
                     extra=extra,
                 )
+            # A successful blocking save supersedes any stale async
+            # failure: without this, a degraded (async-disabled) engine
+            # would keep failing wait_staged_all and force redundant
+            # re-saves of steps that already landed.
+            self._stage_error = None
         finally:
             self._shard_lock.release()
         if self._replicate:
@@ -214,9 +296,139 @@ class CheckpointEngine:
             self._event_q.put({"type": CheckpointEvent.REPLICATE, "step": step})
         return True
 
-    def save_to_storage(self, step: int, pytree: Any, extra: Optional[Dict] = None) -> bool:
-        """Stage to memory, then hand persistence to the agent saver."""
-        if not self.save_to_memory(step, pytree, extra):
+    def _snapshot(self, pytree: Any) -> Any:
+        """Device-side copy of every jax leaf in ONE jitted dispatch
+        (fresh buffers — ``jnp.copy`` lowers to an explicit copy that
+        cannot alias its input), host leaves copied on host. The result
+        is immune to the caller donating/overwriting the originals."""
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree_util.tree_flatten(pytree)
+        is_dev = [isinstance(leaf, jax.Array) for leaf in flat]
+        dev_leaves = [l for l, d in zip(flat, is_dev) if d]
+        if dev_leaves:
+            if self._snap_fn is None:
+                self._snap_fn = jax.jit(
+                    lambda leaves: [jnp.copy(l) for l in leaves]
+                )
+            dev_copies = iter(self._snap_fn(dev_leaves))
+        else:
+            dev_copies = iter(())
+        out = [
+            next(dev_copies)
+            if d
+            else (np.array(l, copy=True) if isinstance(l, np.ndarray) else l)
+            for l, d in zip(flat, is_dev)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _stage_async(self, step: int, snapshot: Any, extra, for_storage: bool) -> None:
+        """Background half of save_to_memory(block=False). Owns the
+        already-acquired shard lock; ALWAYS releases it. ``_stage_error``
+        is sticky across saves until a stage SUCCEEDS (or wait_staged
+        consumes it): the loop's boundary checks turn it into a blocking
+        re-save, where the silent alternative loses the step."""
+        ok = False
+        try:
+            with self._events.ckpt_save(step, storage="memory"):
+                self.shm.save_pytree(
+                    step,
+                    snapshot,
+                    num_hosts=self.num_hosts,
+                    mesh=self.mesh,
+                    extra=extra,
+                )
+            ok = True
+            self._stage_error = None
+        except BaseException as e:  # noqa: BLE001 — recorded, surfaced by wait_staged
+            self._stage_error = e
+            logger.error("async checkpoint staging failed at step %s: %s", step, e)
+            msg = repr(e).lower()
+            if "resource_exhausted" in msg or "out of memory" in msg:
+                self._async_disabled = True
+                logger.error(
+                    "no HBM headroom for snapshot staging; later saves "
+                    "fall back to blocking D2H"
+                )
+            if for_storage:
+                # The SAVE event is already queued; the persister will
+                # find an absent image and skip. Leave a persist-error
+                # marker so wait_saving fails FAST instead of burning
+                # its whole timeout on a step that will never commit.
+                try:
+                    self.storage.record_persist_error(
+                        self.host_rank, step, f"async stage failed: {e!r}"
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            self._shard_lock.release()
+        if ok and self._replicate:
+            self._event_q.put({"type": CheckpointEvent.REPLICATE, "step": step})
+
+    def wait_staged_all(self, timeout: float = 300.0) -> bool:
+        """Collective wait_staged: ANDs every host's local outcome via
+        the same allgather as ``_all_hosts_ready``. The train loop gates
+        COLLECTIVE decisions (blocking re-save before a re-mesh, final
+        re-save) on the staging verdict — a per-host verdict would send
+        hosts down different code paths and wedge the world's collective
+        sequence (one host in save_to_memory's allgather, another in
+        remesh). Call points must themselves be collective-aligned."""
+        ok = self.wait_staged(timeout)
+        if _process_count() <= 1:
+            return ok
+        from jax.experimental import multihost_utils
+
+        all_ok = multihost_utils.process_allgather(np.int64(1 if ok else 0))
+        return bool(np.all(all_ok))
+
+    def _drain_stage_for_read(self) -> None:
+        """Gate every restore path on the staging thread being DEAD —
+        not merely timed out. A wedged stage thread still writes through
+        the reentrant shard lock; proceeding would let a second writer
+        (peer refill) interleave on the same segment, which the
+        header-last protocol cannot protect against. A dead thread with
+        a recorded failure is fine: the zeroed/absent header parses as
+        no-image and load falls through to peer/storage."""
+        t = self._stage_thread
+        if t is not None and t.is_alive():
+            t.join(300.0)
+            if t.is_alive():
+                raise RuntimeError(
+                    "async checkpoint staging is wedged (>300s); refusing "
+                    "to restore over a live writer on the shm segment"
+                )
+        self.wait_staged(timeout=0.1)
+
+    def wait_staged(self, timeout: float = 300.0) -> bool:
+        """Join the outstanding async staging, if any. Returns False if
+        it failed or is still running at the deadline. A recorded
+        failure is CONSUMED here: the caller reacts (the loop re-saves
+        blocking), so a later wait must not keep reporting it."""
+        t = self._stage_thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                return False
+            self._stage_thread = None
+        err, self._stage_error = self._stage_error, None
+        return err is None
+
+    def save_to_storage(
+        self,
+        step: int,
+        pytree: Any,
+        extra: Optional[Dict] = None,
+        block: bool = True,
+    ) -> bool:
+        """Stage to memory, then hand persistence to the agent saver.
+        With ``block=False`` the SAVE event is enqueued while staging
+        still runs — safe because the persister must take the shard
+        lock, which the staging thread holds until the image is
+        complete."""
+        if not self.save_to_memory(
+            step, pytree, extra, block=block, for_storage=True
+        ):
             return False
         self._event_q.put({"type": CheckpointEvent.SAVE, "step": step})
         self._latest_storage_step = step
@@ -274,6 +486,11 @@ class CheckpointEngine:
 
         Returns (step, restored_pytree) or (-1, None) if nothing to load.
         """
+        # Drain any in-flight async stage first: the shard lock is
+        # reentrant for this engine, so _load_from_memory would NOT
+        # block on the staging thread and could read a half-written
+        # image.
+        self._drain_stage_for_read()
         with self._events.ckpt_load():
             result = self._load_from_memory(template)
             if result is not None:
@@ -457,6 +674,9 @@ class CheckpointEngine:
         (shm meta step, storage tracker) gathered FIRST; then every
         host executes the SAME restore path:
 
+        Drains any in-flight async stage up front (same reentrancy
+        hazard as ``load``).
+
         - all hosts stage the same memory step → memory restore
           everywhere;
         - otherwise the NEWEST step committed on EVERY host (max of the
@@ -465,6 +685,7 @@ class CheckpointEngine:
           can't shadow the live history);
         - no common storage step → everyone starts fresh, consistently.
         """
+        self._drain_stage_for_read()
         meta = self.shm.read_meta() if self.shm.attach() else None
         if meta is None and self._refill_from_peer():
             meta = self.shm.read_meta()
@@ -525,6 +746,21 @@ class CheckpointEngine:
         also tear down the in-process saver (thread + servers), so a
         re-meshed world can build a fresh engine without leaking one
         saver stack per topology round."""
+        t = self._stage_thread
+        if t is not None and t.is_alive():
+            t.join(60.0)
+            if t.is_alive():
+                # A wedged staging thread still writes through self.shm
+                # and releases through self._shard_lock: closing them
+                # under it trades a leak for corruption (and the lock
+                # server's death-of-holder handling will free the lock
+                # when this process exits anyway). Leak loudly instead.
+                logger.error(
+                    "async stage still running after 60s; leaving shm/"
+                    "lock open (leaked until process exit)"
+                )
+                return
+        self.wait_staged(timeout=0.1)
         for res in (self._event_q, self._factory_q, self._shard_lock, self.shm):
             try:
                 res.close()

@@ -386,7 +386,15 @@ class AsyncCheckpointSaver:
                 self.storage.write_shard(meta, reader)
             self._persisted_steps[meta.step] = True
             committed = self.storage.commit(meta.step, self.num_hosts)
-            self.storage.clear_persist_error(self.host_rank)
+            if meta.step >= step:
+                # Only a persist covering the REQUESTED step clears the
+                # fail-fast marker: shm holding an older step means the
+                # requested stage never landed (e.g. its async staging
+                # died before zeroing the header) and a marker for it —
+                # written by the failed stage — must keep wait_saving
+                # from burning its full timeout on a step that will
+                # never commit.
+                self.storage.clear_persist_error(self.host_rank)
             if committed:
                 from ..common.config import get_context
 

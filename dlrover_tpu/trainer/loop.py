@@ -187,11 +187,27 @@ class ElasticTrainLoop:
                 # successor skip step 0), and when the previous
                 # iteration's save of this exact step already landed
                 # (a redundant full-model D2H inside the ack budget).
+                # An async stage still in flight (or failed) is not a
+                # handoff-grade save: confirm it before trusting it.
+                # COLLECTIVE verdict — last_save_ok is identical on all
+                # hosts (it comes from the save's allgather), so every
+                # host reaches this call together, and the AND keeps
+                # them on the same branch afterwards.
+                if last_save_ok and not self.engine.wait_staged_all(timeout=60.0):
+                    last_save_ok = False
                 if step > start and not last_save_ok:
-                    for _ in range(50):
+                    # 600 x 0.1s: must be able to outlast an in-flight
+                    # async stage (whose thread-alive guard makes these
+                    # attempts skip), not just a busy persister.
+                    for _ in range(600):
                         if self.engine.save_to_memory(step - 1, state):
                             break
                         time.sleep(0.1)
+                    else:
+                        logger.warning(
+                            "remesh handoff: could not stage step %s",
+                            step - 1,
+                        )
                 self._remesh.apply()
             try:
                 batch = next(it)
@@ -200,10 +216,21 @@ class ElasticTrainLoop:
             if self.ctx is not None:
                 self.ctx.start_step_timer()
             state, loss = self.step_fn(state, *batch)
+            # Cadence saves stage asynchronously (device-side snapshot +
+            # background D2H): the trainer blocks ~ms instead of the
+            # full D2H+memcpy. Costs ~+1x the state's bytes of HBM for
+            # the snapshot window; a device without that headroom OOMs
+            # once and the engine degrades itself back to blocking
+            # saves. Handoff saves below (pre-remesh, final) stay
+            # blocking — they must be durable before proceeding.
             if step % self.storage_every == 0:
-                last_save_ok = self.engine.save_to_storage(step, state)
+                last_save_ok = self.engine.save_to_storage(
+                    step, state, block=False
+                )
             elif step % self.memory_every == 0:
-                last_save_ok = self.engine.save_to_memory(step, state)
+                last_save_ok = self.engine.save_to_memory(
+                    step, state, block=False
+                )
             else:
                 last_save_ok = False
             if self.ctx is not None:
@@ -215,6 +242,8 @@ class ElasticTrainLoop:
                 # would serialize host and device
                 logger.info("step %s: loss %.4f", step, float(loss))
             step += 1
+        if last_save_ok and not self.engine.wait_staged_all():
+            last_save_ok = False  # async stage failed — redo blocking below
         if step > start and not last_save_ok:
             # In-loop saves skip while the persister holds the shard
             # lock (non-blocking by design); stage the FINAL state with

@@ -48,6 +48,7 @@ class RemoteBatchIterator(Iterator):
         prefetch: int = 2,
         index_fn: Optional[Callable[[int], Any]] = None,
         retry_for: float = 30.0,
+        boot_retry_for: Optional[float] = None,
     ):
         self._role = role
         self._method = method
@@ -55,6 +56,15 @@ class RemoteBatchIterator(Iterator):
         self._prefetch = max(0, prefetch)
         self._index_fn = index_fn
         self._retry_for = retry_for
+        # Startup and shutdown need DIFFERENT tolerances: until the
+        # first batch lands, the serving role may still be booting
+        # (retry long); once the stream is live, a connection failure
+        # usually means the peer exited and a long retry just stalls
+        # shutdown. Defaults to retry_for when unset.
+        self._boot_retry_for = (
+            retry_for if boot_retry_for is None else boot_retry_for
+        )
+        self._booted = False
         self._inflight: deque = deque()
         self._n = 0
         self._exhausted = False
@@ -72,7 +82,9 @@ class RemoteBatchIterator(Iterator):
                 self._method,
                 *args,
                 index=self._index,
-                retry_for=self._retry_for,
+                retry_for=(
+                    self._retry_for if self._booted else self._boot_retry_for
+                ),
             )
         )
 
@@ -83,6 +95,7 @@ class RemoteBatchIterator(Iterator):
             if "StopIteration" in str(e):
                 raise _EndOfData from e
             raise
+        self._booted = True
         if batch is None:
             raise _EndOfData
         return batch

@@ -166,15 +166,17 @@ def run_rollout() -> int:
     # this i.i.d. toy; true resume would persist a start offset.)
     my_index = current_role_index()
     stride = max(1, current_role_world())
-    # retry_for bounds BOTH startup tolerance (dataset role still
-    # booting) and the worst-case shutdown stall (in-flight fetches
-    # retrying against an exited dataset before the stop flag is seen)
+    # Split tolerances: boot_retry_for covers a slow-booting dataset
+    # role (first fetch), retry_for bounds the worst-case shutdown
+    # stall (in-flight fetches retrying against an exited dataset
+    # before the stop flag is seen).
     prompt_iter = RemoteBatchIterator(
         "dataset",
         "fetch_prompts",
         prefetch=2,
         index_fn=lambda i: i * stride + my_index,
         retry_for=15.0,
+        boot_retry_for=60.0,
     )
     reward = create_rpc_proxy(
         "reward", RewardService, ns="reward", retry_for=30.0

@@ -386,14 +386,15 @@ class AsyncCheckpointSaver:
                 self.storage.write_shard(meta, reader)
             self._persisted_steps[meta.step] = True
             committed = self.storage.commit(meta.step, self.num_hosts)
-            if meta.step >= step:
-                # Only a persist covering the REQUESTED step clears the
-                # fail-fast marker: shm holding an older step means the
-                # requested stage never landed (e.g. its async staging
-                # died before zeroing the header) and a marker for it —
-                # written by the failed stage — must keep wait_saving
-                # from burning its full timeout on a step that will
-                # never commit.
+            # Only clear the fail-fast marker when THIS persist covers
+            # the marker's recorded step: shm holding an older step
+            # means that stage never landed (e.g. its async staging died
+            # before zeroing the header) and the marker — written by the
+            # failed stage, possibly AFTER this persist started — must
+            # keep wait_saving from burning its full timeout on a step
+            # that will never commit.
+            marker = self.storage.persist_error(self.host_rank)
+            if marker is None or marker[0] <= meta.step:
                 self.storage.clear_persist_error(self.host_rank)
             if committed:
                 from ..common.config import get_context

@@ -141,6 +141,13 @@ def elastic_context(initialize: bool = True) -> ElasticContext:
     the JAX distributed runtime on first call."""
     global _context
     if _context is None:
+        # Worker-side profiling hook BEFORE any jax backend init: on
+        # axon platforms the agent defers plugin registration to us
+        # (env contract DLROVER_PROFILE_AXON) so the interposer wraps
+        # the real plugin. No-op elsewhere; never raises.
+        from ..profiler.pjrt import maybe_enable_worker_profiling
+
+        maybe_enable_worker_profiling()
         _context = ElasticContext.from_env()
         if initialize:
             _context.initialize_jax()

@@ -103,8 +103,12 @@ class RemoteBatchIterator(Iterator):
     def __next__(self) -> Any:
         if self._exhausted and not self._inflight:
             raise StopIteration
-        # keep the pipeline full: prefetch+1 total in flight
-        while not self._exhausted and len(self._inflight) <= self._prefetch:
+        # Keep the pipeline full: prefetch+1 total in flight. Until the
+        # first batch lands, only ONE request flies — prefetches issued
+        # pre-boot would all carry the long boot tolerance and stretch
+        # the worst-case shutdown stall past the retry_for bound.
+        limit = 0 if not self._booted else self._prefetch
+        while not self._exhausted and len(self._inflight) <= limit:
             self._launch()
         try:
             return self._resolve(self._inflight.popleft())

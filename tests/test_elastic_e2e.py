@@ -46,6 +46,9 @@ def test_kill_node_master_relaunches(tmp_path):
             sys.executable,
             "-m",
             "dlrover_tpu.launcher.elastic_run",
+            # CPU host simulation: also keeps profile-auto (TPU-only) off
+            "--accelerator",
+            "cpu",
             "--nnodes",
             "2",
             "--max_restarts",
@@ -112,6 +115,9 @@ def test_scale_down_releases_host_and_training_continues(tmp_path):
             sys.executable,
             "-m",
             "dlrover_tpu.launcher.elastic_run",
+            # CPU host simulation: also keeps profile-auto (TPU-only) off
+            "--accelerator",
+            "cpu",
             "--nnodes",
             "3",
             "--max_restarts",

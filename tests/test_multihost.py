@@ -224,6 +224,9 @@ def test_full_stack_two_host_jax_world(tmp_path):
             sys.executable,
             "-m",
             "dlrover_tpu.launcher.elastic_run",
+            # CPU host simulation: also keeps profile-auto (TPU-only) off
+            "--accelerator",
+            "cpu",
             "--nnodes",
             "2",
             str(script),
@@ -364,6 +367,9 @@ def test_chaos_kill_on_real_two_host_world(tmp_path):
             sys.executable,
             "-m",
             "dlrover_tpu.launcher.elastic_run",
+            # CPU host simulation: also keeps profile-auto (TPU-only) off
+            "--accelerator",
+            "cpu",
             "--nnodes",
             "2",
             "--max_restarts",

@@ -361,6 +361,9 @@ def test_torch_ddp_kill_node_resumes_from_memory(tmp_path):
             sys.executable,
             "-m",
             "dlrover_tpu.launcher.elastic_run",
+            # CPU host simulation: also keeps profile-auto (TPU-only) off
+            "--accelerator",
+            "cpu",
             "--nnodes",
             "2",
             "--max_restarts",

@@ -96,13 +96,45 @@ class DiagnosisAgent:
         self._stopped.set()
 
     def _heartbeat_loop(self) -> None:
+        # Orphan guard: an agent whose master is GONE (crashed, test
+        # runner killed, pod deleted without us) must not supervise
+        # forever — observed live: agents from a SIGTERMed run lingered
+        # over an hour respawning warm spares. After the master has been
+        # unreachable for master_lost_timeout_s straight, self-issue a
+        # JOB_ABORTION so the normal teardown path (stop workers, exit)
+        # runs. The reference relies on the platform reaping the pod;
+        # standalone/local runs have no such reaper.
+        from ..common.config import get_context
+
+        lost_timeout = get_context().master_lost_timeout_s
+        down_since: Optional[float] = None
         while not self._stopped.is_set():
             try:
                 actions = self._client.report_heartbeat()
+                down_since = None
                 for msg in actions:
                     self._dispatch(msg)
             except Exception as e:
+                # Monotonic: a wall-clock NTP step or VM suspend/resume
+                # must not fake a >timeout outage and abort a healthy job.
+                now = time.monotonic()
+                down_since = down_since or now
                 logger.warning("heartbeat failed: %s", e)
+                if lost_timeout > 0 and now - down_since >= lost_timeout:
+                    logger.error(
+                        "master unreachable for %.0fs; aborting agent "
+                        "(orphan guard)",
+                        now - down_since,
+                    )
+                    for handler in self._action_handlers:
+                        try:
+                            handler(
+                                DiagnosisActionType.JOB_ABORTION,
+                                {"reason": "master_unreachable"},
+                            )
+                        except Exception as he:  # noqa: BLE001
+                            logger.error("abort handler failed: %s", he)
+                    return
             self._stopped.wait(self._heartbeat_interval)
 
     def _dispatch(self, msg) -> None:

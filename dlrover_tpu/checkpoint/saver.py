@@ -394,7 +394,10 @@ class AsyncCheckpointSaver:
             # keep wait_saving from burning its full timeout on a step
             # that will never commit.
             marker = self.storage.persist_error(self.host_rank)
-            if marker is None or marker[0] <= meta.step:
+            # No marker read → nothing to clear; calling clear anyway
+            # would race a marker recorded between the read and the
+            # unlink (trainer staging thread) and delete it.
+            if marker is not None and marker[0] <= meta.step:
                 self.storage.clear_persist_error(self.host_rank)
             if committed:
                 from ..common.config import get_context

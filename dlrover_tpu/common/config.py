@@ -32,6 +32,10 @@ class Context:
     restart_budget_per_node: int = 3
     heartbeat_interval_s: float = DefaultValues.HEARTBEAT_INTERVAL_S
     heartbeat_deadline_s: float = 600.0
+    # Orphan guard: agent aborts after the master has been unreachable
+    # this long (0 disables). Mirrors the master's dead-node window so
+    # neither side supervises a world the other has given up on.
+    master_lost_timeout_s: float = 600.0
     monitor_interval_s: float = DefaultValues.MONITOR_INTERVAL_S
     seconds_to_wait_pending_pod: float = DefaultValues.SEC_TO_WAIT_PENDING_POD
     pending_fail_strategy: int = 1  # 0: ignore, 1: wait+abort, 2: wait+relaunch

@@ -309,8 +309,33 @@ def _load() -> ctypes.CDLL:
         lib.tt_stall_verdict.restype = ctypes.c_int
         lib.tt_device_inflight.restype = ctypes.c_int64
         lib.tt_last_device_complete_age_s.restype = ctypes.c_double
+        lib.tt_step_begin.argtypes = [ctypes.c_int64]
+        lib.tt_step_end.argtypes = [ctypes.c_int64]
         _lib = lib
         return _lib
+
+
+def ensure_core(port: int = 0) -> int:
+    """Initialize the tt core (metrics server) if nothing did yet —
+    idempotent: in an interposed process the plugin already called
+    tt_init at load and this returns the live port. Lets UNinterposed
+    workers (CPU accelerator, axon fallback) still serve step progress
+    for the agent's scraper. Returns the serving port (-1 on failure)."""
+    lib = _load()
+    lib.tt_init.argtypes = [ctypes.c_int]
+    lib.tt_init.restype = ctypes.c_int
+    return int(lib.tt_init(port))
+
+
+def step_begin(step: int) -> None:
+    """Mark a train-step boundary in the live interposer (feeds
+    tpu_timer_last_step / step_open_seconds — the hang watchdog's
+    host-progress signal)."""
+    _load().tt_step_begin(step)
+
+
+def step_end(step: int) -> None:
+    _load().tt_step_end(step)
 
 
 def metrics_text() -> str:

@@ -10,6 +10,7 @@ async storage saves on a cadence, and master step reports feeding the
 PerfMonitor/goodput/hang machinery.
 """
 
+import os
 import time
 from typing import Any, Callable, Iterable, Optional, Tuple
 
@@ -172,6 +173,23 @@ class ElasticTrainLoop:
         step = start
         last_save_ok = False
         it = iter(data_iter)
+        # Step boundaries into the native interposer when it is live in
+        # this process (DLROVER_TT_PORT is the agent's contract): feeds
+        # tpu_timer_last_step / step_open_seconds, the hang watchdog's
+        # host-progress signal (last_step stayed -1 in product runs
+        # before this wiring).
+        tt_begin = tt_end = None
+        if os.environ.get("DLROVER_TT_PORT"):
+            try:
+                from ..profiler import pjrt as _pjrt
+
+                # Idempotent: the interposer already inited the core at
+                # plugin load; an UNinterposed worker inits it here so
+                # the agent's scraper still sees step progress.
+                _pjrt.ensure_core(int(os.environ["DLROVER_TT_PORT"]))
+                tt_begin, tt_end = _pjrt.step_begin, _pjrt.step_end
+            except Exception as e:  # noqa: BLE001 — aux only
+                logger.warning("native step marks unavailable: %s", e)
         while True:
             # bound check BEFORE drawing: a resume at/past max_steps
             # must not consume (and discard) an element of a finite or
@@ -215,7 +233,11 @@ class ElasticTrainLoop:
                 break
             if self.ctx is not None:
                 self.ctx.start_step_timer()
+            if tt_begin is not None:
+                tt_begin(step)
             state, loss = self.step_fn(state, *batch)
+            if tt_end is not None:
+                tt_end(step)
             # Cadence saves stage asynchronously (device-side snapshot +
             # background D2H): the trainer blocks ~ms instead of the
             # full D2H+memcpy. Costs ~+1x the state's bytes of HBM for

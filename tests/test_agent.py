@@ -172,6 +172,36 @@ class TestDiagnosisClassification:
             d.diagnose_training_failure(f) == DiagnosisActionType.RELAUNCH_WORKER
         )
 
+    def test_orphan_guard_aborts_when_master_lost(self, monkeypatch):
+        """Agents whose master is GONE must self-abort, not supervise
+        forever (observed live: agents from a SIGTERMed run lingered
+        over an hour respawning warm spares)."""
+        import threading as _threading
+
+        from dlrover_tpu.common.config import get_context
+
+        class DeadClient:
+            def report_heartbeat(self):
+                raise ConnectionError("master gone")
+
+        monkeypatch.setattr(
+            get_context(), "master_lost_timeout_s", 0.3, raising=True
+        )
+        d = DiagnosisAgent(0, client=DeadClient(), heartbeat_interval=0.05)
+        aborted = _threading.Event()
+
+        def on_action(action_type, config):
+            if action_type == DiagnosisActionType.JOB_ABORTION:
+                assert config.get("reason") == "master_unreachable"
+                aborted.set()
+
+        d.register_action_handler(on_action)
+        d.start_heartbeat()
+        assert aborted.wait(5.0), "orphan guard never fired"
+        d._hb_thread.join(5.0)
+        assert not d._hb_thread.is_alive()
+        d.stop()
+
 
 def _make_agent(master, tmp_path, script, node_rank=0, **cfg_kw):
     cfg = ElasticLaunchConfig(

@@ -281,6 +281,46 @@ class TestProductWiring:
             master.stop()
 
 
+class TestStepMarks:
+    def test_train_loop_marks_steps_in_native_lib(
+        self, built, monkeypatch, tmp_path
+    ):
+        """With the agent's DLROVER_TT_PORT contract present, the train
+        loop feeds step boundaries to the live tt core — the hang
+        watchdog's host-progress signal (last_step stayed -1 in product
+        runs before this wiring)."""
+        import jax.numpy as jnp
+
+        from dlrover_tpu.checkpoint.engine import CheckpointEngine
+        from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+        from dlrover_tpu.profiler import pjrt
+        from dlrover_tpu.trainer.loop import ElasticTrainLoop
+
+        monkeypatch.setenv("DLROVER_TT_PORT", "0")
+        monkeypatch.setenv("DLROVER_JOB_NAME", f"ttmarks_{os.getpid()}")
+        AsyncCheckpointSaver.reset()
+        engine = CheckpointEngine(str(tmp_path / "ckpt"), standalone=True)
+        try:
+
+            def step_fn(state, x):
+                return {"w": state["w"] + x}, jnp.float32(0.0)
+
+            def data():
+                while True:
+                    yield (jnp.ones(()),)
+
+            loop = ElasticTrainLoop(
+                engine, step_fn, max_steps=7, memory_every=100
+            )
+            loop.run({"w": jnp.zeros(())}, data())
+            metrics = pjrt.parse_metrics(pjrt.metrics_text())
+            assert metrics.get("tpu_timer_last_step") == 6.0
+        finally:
+            engine.shm.unlink()
+            engine.close()
+            AsyncCheckpointSaver.reset()
+
+
 class TestAxonEnvContract:
     """The agent↔worker env contract for axon platforms (VERDICT r3 #2,
     proven live on silicon this round — see

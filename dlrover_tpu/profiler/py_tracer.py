@@ -304,7 +304,26 @@ FunctionTracer._EVENTS = (
 # -- crash exception hook ----------------------------------------------------
 
 
-_CRASH_HOOK_INSTALLED = False
+_CRASH_TIMER: Optional[TpuTimer] = None
+# Current-generation hook fns (None = never installed / superseded).
+_CUR_EXC_HOOK = None
+_CUR_THREAD_HOOK = None
+_LAST_RECORDED_EXC: Optional[int] = None
+
+
+def _record_crash(exc_type, exc) -> None:
+    global _LAST_RECORDED_EXC
+    try:
+        # One record per exception OBJECT: after a re-wrap, an external
+        # replacement hook may chain back into a superseded generation
+        # of ours — identity dedup stops the double count.
+        if exc is not None and id(exc) == _LAST_RECORDED_EXC:
+            return
+        _LAST_RECORDED_EXC = None if exc is None else id(exc)
+        t = _CRASH_TIMER or TpuTimer.singleton()
+        t.record(f"host_crash_{exc_type.__name__}", KIND_OTHER, _now_us(), 1)
+    except Exception:  # noqa: BLE001 — never mask the real crash
+        pass
 
 
 def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
@@ -314,29 +333,31 @@ def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
     (reference: py_syshook.c). Chains to the previous hooks — the
     events-SDK crash flush (common/error_handler.py) still runs.
     Idempotent per process: repeated calls (e.g. every loop run) must
-    not stack N-deep hook chains emitting duplicate crash records."""
-    global _CRASH_HOOK_INSTALLED
-    if _CRASH_HOOK_INSTALLED:
-        return
-    _CRASH_HOOK_INSTALLED = True
-    t = timer or TpuTimer.singleton()
-    prev_except = sys.excepthook
-    prev_thread = threading.excepthook
+    not stack N-deep hook chains emitting duplicate crash records —
+    each call REBINDS the sink (crash records land in the caller's
+    newest timer), and each of the two process hooks is re-wrapped
+    INDEPENDENTLY only when later code replaced it (a replacement
+    would otherwise silently disconnect crash recording; chains back
+    into superseded generations are deduped per exception object)."""
+    global _CRASH_TIMER, _CUR_EXC_HOOK, _CUR_THREAD_HOOK
+    _CRASH_TIMER = timer or TpuTimer.singleton()
 
-    def _record(exc_type, exc) -> None:
-        try:
-            now = _now_us()
-            t.record(f"host_crash_{exc_type.__name__}", KIND_OTHER, now, 1)
-        except Exception:  # noqa: BLE001 — never mask the real crash
-            pass
+    if sys.excepthook is not _CUR_EXC_HOOK:
+        prev_except = sys.excepthook
 
-    def hook(exc_type, exc, tb):
-        _record(exc_type, exc)
-        prev_except(exc_type, exc, tb)
+        def hook(exc_type, exc, tb, _prev=prev_except):
+            _record_crash(exc_type, exc)
+            _prev(exc_type, exc, tb)
 
-    def thread_hook(args):
-        _record(args.exc_type, args.exc_value)
-        prev_thread(args)
+        _CUR_EXC_HOOK = hook
+        sys.excepthook = hook
 
-    sys.excepthook = hook
-    threading.excepthook = thread_hook
+    if threading.excepthook is not _CUR_THREAD_HOOK:
+        prev_thread = threading.excepthook
+
+        def thread_hook(args, _prev=prev_thread):
+            _record_crash(args.exc_type, args.exc_value)
+            _prev(args)
+
+        _CUR_THREAD_HOOK = thread_hook
+        threading.excepthook = thread_hook

@@ -252,18 +252,27 @@ class TestProductWiring:
 
             # Rank 0 must also serve the cluster profiler daemon, and the
             # master metric context must fill up — all with no user code.
+            # Wait for the EXACT gauge the assertion needs: breaking on
+            # any tpu_timer_count raced a scrape that caught compile
+            # counts a beat before the first execute landed (flaked
+            # once per ~3 full-suite runs under load).
+            def has_execute(g):
+                return any(
+                    k.startswith("tpu_timer_count") and 'kind="execute"' in k
+                    for k in g
+                )
+
             deadline = time.time() + 60
             gauges = {}
             while time.time() < deadline:
                 all_gauges = get_metric_context().all_gauges()
                 gauges = all_gauges.get(0) or all_gauges.get("0") or {}
-                if any("tpu_timer_count" in k for k in gauges):
+                if has_execute(gauges):
                     break
                 time.sleep(0.25)
-            assert any(
-                k.startswith("tpu_timer_count") and 'kind="execute"' in k
-                for k in gauges
-            ), f"no execute counts reached the master: {sorted(gauges)[:10]}"
+            assert has_execute(gauges), (
+                f"no execute counts reached the master: {sorted(gauges)[:10]}"
+            )
             assert "tpu_timer_stall_verdict" in gauges
 
             daemon = agent._profiler_daemon

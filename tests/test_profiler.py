@@ -683,6 +683,31 @@ class TestPyTracer:
         finally:
             _sys.excepthook = orig
 
+    def test_crash_hook_rewrap_no_duplicate_records(self, tracer):
+        """After an external sys.excepthook replacement that chains back
+        into a superseded generation of our hook, a reinstall must not
+        double-count the crash (identity dedup per exception object)."""
+        import sys as _sys
+
+        from dlrover_tpu.profiler.py_tracer import install_crash_hook
+
+        orig = _sys.excepthook
+        try:
+            install_crash_hook(tracer.timer)
+            old_ours = _sys.excepthook
+
+            def external(tp, e, tb):  # replaces ours, chains back into it
+                old_ours(tp, e, tb)
+
+            _sys.excepthook = external
+            install_crash_hook(tracer.timer)  # re-wraps around external
+            before = len(_named_events(tracer.timer, "host_crash_KeyError"))
+            _sys.excepthook(KeyError, KeyError("dup"), None)
+            after = len(_named_events(tracer.timer, "host_crash_KeyError"))
+            assert after - before == 1  # ours -> external -> old ours: 1 record
+        finally:
+            _sys.excepthook = orig
+
     def test_loop_auto_traces_dataloader(self, tmp_path):
         """No user annotations: ElasticTrainLoop wires the tracer to its
         own data iterator; a slow loader shows up in the profiler."""

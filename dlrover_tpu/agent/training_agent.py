@@ -528,12 +528,27 @@ class ElasticTrainingAgent:
         logger.info(
             "worker stack dump (%s):\n%s", reason or "requested", text
         )
+        # Profiled workers also dump their trace ring — the device-side
+        # half of the post-mortem (what the chip was doing next to what
+        # the host was doing). The binary lands on the host; the event
+        # carries its path for the timeline merge tools.
+        ring_path = None
+        if self._metric_collector is not None:
+            try:
+                from ..profiler.stack_dump import request_ring_dump
+
+                ring_path = request_ring_dump()
+                if ring_path:
+                    logger.info("worker trace ring dumped: %s", ring_path)
+            except Exception as e:  # noqa: BLE001 — aux only
+                logger.warning("ring dump request failed: %s", e)
         try:
             self._client.report_event(
                 event_type="stack_dump",
                 instance=f"node-{self._config.node_id}",
                 action=reason or "requested",
-                msg=text[-8000:],
+                msg=(f"[ring:{ring_path}]\n" if ring_path else "")
+                + text[-8000:],
             )
         except Exception:
             logger.warning("stack dump report to master failed")

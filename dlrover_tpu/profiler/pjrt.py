@@ -327,6 +327,22 @@ def ensure_core(port: int = 0) -> int:
     return int(lib.tt_init(port))
 
 
+def dump_timeline(path: str) -> int:
+    """Dump the live trace ring (device executes/transfers/compiles the
+    interposer recorded) to ``path`` in the compact binary format, with
+    the interned-name sidecar at ``path + '.names'``. Returns the event
+    count. Convert/merge with ``dlrover_tpu.profiler.timeline``."""
+    lib = _load()
+    lib.tt_dump_timeline.restype = ctypes.c_int64
+    lib.tt_dump_timeline.argtypes = [ctypes.c_char_p]
+    lib.tt_dump_names.restype = ctypes.c_int64
+    lib.tt_dump_names.argtypes = [ctypes.c_char_p]
+    n = int(lib.tt_dump_timeline(path.encode()))
+    if n > 0:
+        lib.tt_dump_names((path + ".names").encode())
+    return n
+
+
 def step_begin(step: int) -> None:
     """Mark a train-step boundary in the live interposer (feeds
     tpu_timer_last_step / step_open_seconds — the hang watchdog's

@@ -308,18 +308,16 @@ _CRASH_TIMER: Optional[TpuTimer] = None
 # Current-generation hook fns (None = never installed / superseded).
 _CUR_EXC_HOOK = None
 _CUR_THREAD_HOOK = None
-_LAST_RECORDED_EXC: Optional[int] = None
+# Reentrancy guard: after a re-wrap, an external replacement hook may
+# chain back into a superseded generation of ours — only the OUTERMOST
+# generation on this thread records, so one crash is one record. (Object
+# -identity dedup was tried: builtin exception instances don't support
+# weakrefs, and raw id() aliases later exceptions at a reused address.)
+_HOOK_TLS = threading.local()
 
 
 def _record_crash(exc_type, exc) -> None:
-    global _LAST_RECORDED_EXC
     try:
-        # One record per exception OBJECT: after a re-wrap, an external
-        # replacement hook may chain back into a superseded generation
-        # of ours — identity dedup stops the double count.
-        if exc is not None and id(exc) == _LAST_RECORDED_EXC:
-            return
-        _LAST_RECORDED_EXC = None if exc is None else id(exc)
         t = _CRASH_TIMER or TpuTimer.singleton()
         t.record(f"host_crash_{exc_type.__name__}", KIND_OTHER, _now_us(), 1)
     except Exception:  # noqa: BLE001 — never mask the real crash
@@ -346,8 +344,15 @@ def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
         prev_except = sys.excepthook
 
         def hook(exc_type, exc, tb, _prev=prev_except):
-            _record_crash(exc_type, exc)
-            _prev(exc_type, exc, tb)
+            outermost = not getattr(_HOOK_TLS, "in_hook", False)
+            _HOOK_TLS.in_hook = True
+            try:
+                if outermost:
+                    _record_crash(exc_type, exc)
+                _prev(exc_type, exc, tb)
+            finally:
+                if outermost:
+                    _HOOK_TLS.in_hook = False
 
         _CUR_EXC_HOOK = hook
         sys.excepthook = hook
@@ -356,8 +361,15 @@ def install_crash_hook(timer: Optional[TpuTimer] = None) -> None:
         prev_thread = threading.excepthook
 
         def thread_hook(args, _prev=prev_thread):
-            _record_crash(args.exc_type, args.exc_value)
-            _prev(args)
+            outermost = not getattr(_HOOK_TLS, "in_hook", False)
+            _HOOK_TLS.in_hook = True
+            try:
+                if outermost:
+                    _record_crash(args.exc_type, args.exc_value)
+                _prev(args)
+            finally:
+                if outermost:
+                    _HOOK_TLS.in_hook = False
 
         _CUR_THREAD_HOOK = thread_hook
         threading.excepthook = thread_hook

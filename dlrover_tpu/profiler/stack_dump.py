@@ -87,3 +87,89 @@ def trigger_and_read(pid: int, timeout_s: float = 5.0) -> str:
             return f.read()
     except OSError:
         return ""
+
+
+# -- trace-ring dump (timeline) ----------------------------------------------
+#
+# The ring lives in the WORKER's interposer/tt core, and dumping it needs
+# a C call — which faulthandler's async-signal-safe SIGUSR2 path cannot
+# make, and a Python signal handler would never run while the main thread
+# is wedged in a blocked collective (exactly when dumps matter). So the
+# worker runs a tiny watcher THREAD: the agent drops a request file, the
+# watcher calls tt_dump_timeline and writes the ring next to it. Matches
+# the reference's daemon-coordinated timeline dump
+# (xpu_timer_gen_trace_timeline over dumped rings).
+
+
+def ring_paths():
+    from ..common.multi_process import _ipc_namespace
+
+    os.makedirs(_DUMP_DIR, exist_ok=True)
+    base = os.path.join(_DUMP_DIR, _ipc_namespace())
+    return base + ".ring.req", base + ".timeline"
+
+
+def start_ring_dump_watcher(poll_s: float = 2.0):
+    """Worker side. Returns the started thread (daemon) or None."""
+    import threading
+
+    req, out = ring_paths()
+
+    def watch():
+        from . import pjrt
+
+        while True:
+            try:
+                if os.path.exists(req):
+                    # Consume BEFORE dumping: removing after the ack
+                    # could delete a back-to-back fresh request written
+                    # while we were publishing.
+                    os.remove(req)
+                    n = pjrt.dump_timeline(out)
+                    # ack carries the event count; replace() publishes
+                    # it atomically
+                    with open(req + ".ack", "w") as f:
+                        f.write(str(n))
+                    os.replace(req + ".ack", req + ".done")
+                    logger.info("trace ring dumped: %s events -> %s", n, out)
+            except Exception as e:  # noqa: BLE001 — aux, keep watching
+                logger.warning("ring dump failed: %s", e)
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=watch, name="ring-dump-watch", daemon=True)
+    t.start()
+    return t
+
+
+def request_ring_dump(timeout_s: float = 8.0) -> Optional[str]:
+    """Agent side: ask the worker's watcher for a ring dump; returns the
+    timeline path once it lands (None on timeout / no watcher)."""
+    req, out = ring_paths()
+    # A stale request/ack from a previous timed-out round must not be
+    # mistaken for this round's answer.
+    for stale in (req, req + ".done"):
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+    with open(req, "w") as f:
+        f.write(str(time.time()))
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(req + ".done"):
+            try:
+                with open(req + ".done") as f:
+                    n = int(f.read() or 0)
+            except (OSError, ValueError):
+                n = 0
+            try:
+                os.remove(req + ".done")
+            except OSError:
+                pass
+            return out if n > 0 else None
+        time.sleep(0.2)
+    try:
+        os.remove(req)  # withdraw: don't leave a request for later dumps
+    except OSError:
+        pass
+    return None

@@ -62,11 +62,19 @@ class ElasticContext:
         also the standalone/test path where the process uses the local
         (or virtual CPU) devices directly.
         """
-        from ..profiler.stack_dump import install_stack_dump_handler
+        from ..profiler.stack_dump import (
+            install_stack_dump_handler,
+            start_ring_dump_watcher,
+        )
 
         # Hang post-mortems: the agent's SIGUSR2 lands here even when the
         # process is wedged inside a blocked collective.
         install_stack_dump_handler()
+        if os.environ.get("DLROVER_TT_PORT"):
+            # Profiled worker: serve trace-ring dump requests (a thread,
+            # so it works even while the main thread is wedged — the
+            # exact moment a timeline is wanted).
+            start_ring_dump_watcher()
         if self.num_processes <= 1 or not self.coordinator:
             logger.info("single-process world; skipping jax.distributed")
             return

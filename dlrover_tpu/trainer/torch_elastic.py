@@ -21,6 +21,7 @@ already exports for JAX workers:
   ``torch.utils.data.DataLoader`` as-is.
 """
 
+import os
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
@@ -140,9 +141,17 @@ class TorchElasticContext(ElasticContext):
         """
         import datetime
 
-        from ..profiler.stack_dump import install_stack_dump_handler
+        from ..profiler.stack_dump import (
+            install_stack_dump_handler,
+            start_ring_dump_watcher,
+        )
 
         install_stack_dump_handler()
+        if os.environ.get("DLROVER_TT_PORT"):
+            # Profiled worker: answer the agent's trace-ring dump
+            # requests (without this the agent's STACK_DUMP handling
+            # would block its full ring timeout on every dump).
+            start_ring_dump_watcher()
         if self.num_processes <= 1 or not self.coordinator:
             logger.info("single-process world; skipping torch.distributed")
             return False

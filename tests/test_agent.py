@@ -16,6 +16,7 @@ from dlrover_tpu.agent.config import ElasticLaunchConfig
 from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent, WorkerFailure
 from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
 from dlrover_tpu.agent.training_agent import (
+    AGENT_EXIT_FATAL,
     AGENT_EXIT_OK,
     AGENT_EXIT_RELAUNCH,
     ElasticTrainingAgent,
@@ -251,6 +252,51 @@ print('recovered')
         script = _write_script(tmp_path, "bad.py", "import sys\nsys.exit(5)\n")
         agent = _make_agent(master1, tmp_path, script, max_restarts=0)
         assert agent.run() == AGENT_EXIT_RELAUNCH
+
+    def test_agent_exits_when_master_dies_mid_training(
+        self, master1, tmp_path, monkeypatch
+    ):
+        """The orphan guard END TO END: a training agent whose master
+        disappears must tear down (worker + spare reaped) instead of
+        supervising forever — the exact state observed live after a
+        killed test run."""
+        import threading as _threading
+
+        from dlrover_tpu.common.config import get_context
+
+        monkeypatch.setattr(get_context(), "master_lost_timeout_s", 2.0)
+        monkeypatch.setattr(get_context(), "heartbeat_interval_s", 0.2)
+        script = _write_script(
+            tmp_path,
+            "sleep.py",
+            "import time\nprint('up', flush=True)\ntime.sleep(300)\n",
+        )
+        agent = _make_agent(master1, tmp_path, script)
+        rc = {}
+        # daemon: a guard regression must fail THIS test, not wedge the
+        # whole pytest process behind a non-daemon supervisor thread.
+        t = _threading.Thread(
+            target=lambda: rc.update(v=agent.run()), daemon=True
+        )
+        t.start()
+        try:
+            # Let the worker come up, then kill the master.
+            deadline = time.time() + 30
+            while time.time() < deadline and agent._worker is None:
+                time.sleep(0.1)
+            time.sleep(1.0)
+            master1.stop()
+            t.join(60)
+            assert not t.is_alive(), (
+                "agent kept supervising a masterless world"
+            )
+            assert rc.get("v") == AGENT_EXIT_FATAL
+        finally:
+            agent.stop()
+        # Worker and warm spare both reaped.
+        if agent._worker is not None and agent._worker._proc is not None:
+            assert agent._worker._proc.poll() is not None
+        assert agent._spare is None
 
     def test_membership_change_triggers_re_rendezvous(self, master2, tmp_path):
         """Two agents; kill one worker → both re-rendezvous into round 1.

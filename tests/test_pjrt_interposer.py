@@ -330,6 +330,44 @@ class TestStepMarks:
             AsyncCheckpointSaver.reset()
 
 
+class TestRingDump:
+    def test_ring_dump_request_roundtrip(self, built, monkeypatch, tmp_path):
+        """Agent drops a request file; the worker's watcher thread dumps
+        the live trace ring and acks with the event count; the timeline
+        converts. (The thread design is deliberate: a Python signal
+        handler would never run while the main thread is wedged in a
+        blocked collective.)"""
+        import ctypes
+
+        from dlrover_tpu.profiler import pjrt, stack_dump
+        from dlrover_tpu.profiler.timeline import convert
+
+        monkeypatch.setenv("DLROVER_JOB_NAME", f"ring_{os.getpid()}")
+        monkeypatch.setattr(
+            stack_dump, "_DUMP_DIR", str(tmp_path / "dumps")
+        )
+        # Feed the live tt core a few events (stand-in for interposed
+        # device executes on CPU CI).
+        pjrt.ensure_core(0)
+        lib = ctypes.CDLL(pjrt.build_interposer())
+        lib.tt_intern_name.restype = ctypes.c_int32
+        lib.tt_intern_name.argtypes = [ctypes.c_char_p]
+        nid = lib.tt_intern_name(b"exec:test_kernel")
+        for i in range(3):
+            lib.tt_record(nid, 1, 1000 * i, 250)
+
+        t = stack_dump.start_ring_dump_watcher(poll_s=0.1)
+        assert t is not None
+        out = stack_dump.request_ring_dump(timeout_s=10)
+        assert out, "ring dump did not land"
+        n = convert(out, out + ".json")
+        assert n >= 3
+        import json as _json
+
+        evs = _json.load(open(out + ".json"))["traceEvents"]
+        assert any(e.get("name") == "exec:test_kernel" for e in evs)
+
+
 class TestAxonEnvContract:
     """The agent↔worker env contract for axon platforms (VERDICT r3 #2,
     proven live on silicon this round — see

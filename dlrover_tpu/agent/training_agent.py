@@ -53,6 +53,7 @@ class ElasticTrainingAgent:
             run_module=config.run_module,
             env=config.worker_env(),
             log_dir=config.log_dir,
+            numa_affinity=config.numa_affinity,
         )
         self._rdzv_handler = MasterRendezvousHandler(
             RendezvousName.TRAINING,

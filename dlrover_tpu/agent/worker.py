@@ -168,6 +168,9 @@ class WorkerSpec:
     # TPU chips are held by a process until it fully exits; starting the
     # next process before the old one released the devices deadlocks.
     wait_release_s: float = 60.0
+    # Pin the worker to the TPU-local NUMA node's CPUs (reference
+    # --numa-affinity; agent/numa.py). No-op when topology is invisible.
+    numa_affinity: bool = False
 
 
 @dataclass
@@ -206,9 +209,22 @@ class WarmSpare:
                 spec.log_dir, f"worker_{tag}_{time.time_ns()}.log"
             )
             self._log_file = open(self.log_path, "wb")
+        preexec = None
+        if spec.numa_affinity:
+            # Pin BEFORE the interpreter starts: sched_setaffinity on a
+            # running pid covers only the main thread, and the spare's
+            # whole point is that jax/XLA threads are already spawned by
+            # adoption time. The cpu set is computed (and logged) in the
+            # PARENT; the child's preexec does only the raw syscall.
+            from .numa import tpu_numa_cpuset
+
+            cpus = tpu_numa_cpuset()
+            if cpus:
+                preexec = lambda: os.sched_setaffinity(0, cpus)  # noqa: E731
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "dlrover_tpu.agent.warm_worker"],
             env=env,
+            preexec_fn=preexec,
             stdin=subprocess.PIPE,
             # without a log dir, the spare's chatter (READY marker,
             # import warnings) must not leak into the agent's stdout
@@ -338,6 +354,19 @@ class WorkerProcess:
                 self._log_file = open(self._log_path, "wb")
                 stdout = self._log_file
 
+            preexec = None
+            if self.spec.numa_affinity:
+                # In the child BEFORE exec: threads spawned later (jax/
+                # XLA runtime) inherit the mask — pinning the pid after
+                # spawn would cover only the main thread. Cpu set from
+                # the parent; the child does only the raw syscall.
+                from .numa import tpu_numa_cpuset
+
+                cpus = tpu_numa_cpuset()
+                if cpus:
+                    preexec = (
+                        lambda: os.sched_setaffinity(0, cpus)  # noqa: E731
+                    )
             # New process group so teardown can kill the whole tree
             # (grand-children like dataloader workers), mirroring orphan
             # reaping in the reference (training.py:616).
@@ -347,6 +376,7 @@ class WorkerProcess:
                 stdout=stdout,
                 stderr=subprocess.STDOUT if stdout else None,
                 start_new_session=True,
+                preexec_fn=preexec,
             )
             how = "cold"
         self.start_time = time.time()

@@ -110,6 +110,13 @@ def parse_args(args: Optional[List[str]] = None) -> argparse.Namespace:
         choices=[Accelerators.TPU, Accelerators.CPU],
     )
     parser.add_argument(
+        "--numa-affinity",
+        action="store_true",
+        dest="numa_affinity",
+        help="pin each worker to the TPU-local NUMA node's CPUs "
+        "(no-op when the PCI topology is not visible)",
+    )
+    parser.add_argument(
         "--profile",
         default="auto",
         choices=["auto", "on", "off"],
@@ -190,6 +197,7 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         save_at_breakpoint=ns.save_at_breakpoint,
         training_port=ns.training_port,
         log_dir=ns.log_dir,
+        numa_affinity=ns.numa_affinity,
         profile=ns.profile,
         monitor_interval=ns.monitor_interval,
     )

@@ -121,15 +121,19 @@ def start_ring_dump_watcher(poll_s: float = 2.0):
         while True:
             try:
                 if os.path.exists(req):
-                    # Consume BEFORE dumping: removing after the ack
-                    # could delete a back-to-back fresh request written
-                    # while we were publishing.
+                    # Read the request token, then consume BEFORE
+                    # dumping: removing after the ack could delete a
+                    # back-to-back fresh request written while we were
+                    # publishing.
+                    with open(req) as f:
+                        token = f.read().strip()
                     os.remove(req)
                     n = pjrt.dump_timeline(out)
-                    # ack carries the event count; replace() publishes
-                    # it atomically
+                    # ack echoes the token + event count; replace()
+                    # publishes atomically. The token lets the requester
+                    # reject a LATE ack from a previous timed-out round.
                     with open(req + ".ack", "w") as f:
-                        f.write(str(n))
+                        f.write(f"{token} {n}")
                     os.replace(req + ".ack", req + ".done")
                     logger.info("trace ring dumped: %s events -> %s", n, out)
             except Exception as e:  # noqa: BLE001 — aux, keep watching
@@ -146,26 +150,31 @@ def request_ring_dump(timeout_s: float = 8.0) -> Optional[str]:
     timeline path once it lands (None on timeout / no watcher)."""
     req, out = ring_paths()
     # A stale request/ack from a previous timed-out round must not be
-    # mistaken for this round's answer.
+    # mistaken for this round's answer (acks additionally carry the
+    # request token, so even a LATE previous ack is rejected).
     for stale in (req, req + ".done"):
         try:
             os.remove(stale)
         except OSError:
             pass
+    token = f"{os.getpid()}_{time.time_ns()}"
     with open(req, "w") as f:
-        f.write(str(time.time()))
+        f.write(token)
     deadline = time.time() + timeout_s
     while time.time() < deadline:
         if os.path.exists(req + ".done"):
             try:
                 with open(req + ".done") as f:
-                    n = int(f.read() or 0)
+                    got_token, _, raw_n = f.read().strip().partition(" ")
+                n = int(raw_n or 0)
             except (OSError, ValueError):
-                n = 0
+                got_token, n = "", 0
             try:
                 os.remove(req + ".done")
             except OSError:
                 pass
+            if got_token != token:
+                continue  # late ack from a previous round — keep waiting
             return out if n > 0 else None
         time.sleep(0.2)
     try:

@@ -352,9 +352,20 @@ class TestRingDump:
         lib = ctypes.CDLL(pjrt.build_interposer())
         lib.tt_intern_name.restype = ctypes.c_int32
         lib.tt_intern_name.argtypes = [ctypes.c_char_p]
+        # Full 6-arg ABI (int32, int32, int64, int64, double, double):
+        # calling with fewer/untyped args reads garbage registers.
+        lib.tt_record.restype = None
+        lib.tt_record.argtypes = [
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_double,
+        ]
         nid = lib.tt_intern_name(b"exec:test_kernel")
         for i in range(3):
-            lib.tt_record(nid, 1, 1000 * i, 250)
+            lib.tt_record(nid, 1, 1000 * i, 250, 0.0, 0.0)
 
         t = stack_dump.start_ring_dump_watcher(poll_s=0.1)
         assert t is not None

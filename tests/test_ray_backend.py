@@ -268,6 +268,85 @@ ray_spec = pytest.importorskip  # alias keeps the marker obvious below
 
 
 @pytest.mark.slow
+class TestRayJobSubmitter:
+    """≙ reference client/platform/ray/ray_job_submitter.py (+ the pip/
+    env forwarding it left as TODOs), driven through a fake client."""
+
+    class FakeClient:
+        def __init__(self):
+            self.submitted = []
+            self.stopped = []
+            self._status = ["PENDING", "RUNNING", "SUCCEEDED"]
+
+        def submit_job(self, entrypoint, runtime_env):
+            self.submitted.append((entrypoint, runtime_env))
+            return "raysubmit_123"
+
+        def get_job_status(self, job_id):
+            return self._status.pop(0) if len(self._status) > 1 else self._status[0]
+
+        def get_job_logs(self, job_id):
+            return "log line\n"
+
+        def stop_job(self, job_id):
+            self.stopped.append(job_id)
+            return True
+
+    def _conf(self, tmp_path, **extra):
+        import yaml
+
+        conf = {
+            "dashboardUrl": "127.0.0.1:8265",
+            "command": "tpurun --nnodes 2 train.py",
+            "workingDir": "/ws",
+            **extra,
+        }
+        p = tmp_path / "job.yaml"
+        p.write_text(yaml.safe_dump(conf))
+        return str(p)
+
+    def test_submit_forwards_runtime_env(self, tmp_path):
+        from dlrover_tpu.scheduler.ray_submit import RayJobSubmitter
+
+        fake = self.FakeClient()
+        sub = RayJobSubmitter(
+            self._conf(
+                tmp_path,
+                requirements=["foo==1.0"],
+                env={"A": 1},
+            ),
+            client=fake,
+        )
+        assert sub.submit() == "raysubmit_123"
+        entrypoint, renv = fake.submitted[0]
+        assert entrypoint == "tpurun --nnodes 2 train.py"
+        assert renv["working_dir"] == "/ws"
+        assert renv["pip"] == ["foo==1.0"]
+        assert renv["env_vars"] == {"A": "1"}
+
+    def test_wait_polls_to_terminal_and_stop(self, tmp_path):
+        from dlrover_tpu.scheduler.ray_submit import RayJobSubmitter
+
+        fake = self.FakeClient()
+        sub = RayJobSubmitter(self._conf(tmp_path), client=fake)
+        sub.submit()
+        assert sub.wait(timeout_s=10, poll_s=0.01) == "SUCCEEDED"
+        assert "log line" in sub.logs()
+        assert sub.stop()
+        assert fake.stopped == ["raysubmit_123"]
+
+    def test_missing_keys_rejected(self, tmp_path):
+        import pytest as _pytest
+        import yaml
+
+        from dlrover_tpu.scheduler.ray_submit import RayJobSubmitter
+
+        p = tmp_path / "bad.yaml"
+        p.write_text(yaml.safe_dump({"command": "x"}))
+        with _pytest.raises(ValueError):
+            RayJobSubmitter(str(p), client=self.FakeClient())
+
+
 class TestRealRayIntegration:
     """VERDICT r3 #9: FakeRay encodes our ASSUMPTIONS about Ray
     semantics (detached named actors, namespace lookup, kill) — this

@@ -98,6 +98,18 @@ def tpu_numa_cpuset(
     return cpus
 
 
+def numa_preexec(pci_root: str = _PCI_ROOT, node_root: str = _NODE_ROOT):
+    """Spawn-path helper: compute (and log) the TPU-local cpu set in the
+    PARENT, return a logging-free callable for ``subprocess.Popen``'s
+    ``preexec_fn`` — or None when there is nothing to pin. Threads the
+    child spawns later inherit the mask, which pinning a live pid after
+    the fact cannot guarantee."""
+    cpus = tpu_numa_cpuset(pci_root, node_root)
+    if not cpus:
+        return None
+    return lambda: os.sched_setaffinity(0, cpus)
+
+
 def apply_numa_affinity(
     pid: int = 0,
     pci_root: str = _PCI_ROOT,

@@ -214,13 +214,10 @@ class WarmSpare:
             # Pin BEFORE the interpreter starts: sched_setaffinity on a
             # running pid covers only the main thread, and the spare's
             # whole point is that jax/XLA threads are already spawned by
-            # adoption time. The cpu set is computed (and logged) in the
-            # PARENT; the child's preexec does only the raw syscall.
-            from .numa import tpu_numa_cpuset
+            # adoption time.
+            from .numa import numa_preexec
 
-            cpus = tpu_numa_cpuset()
-            if cpus:
-                preexec = lambda: os.sched_setaffinity(0, cpus)  # noqa: E731
+            preexec = numa_preexec()
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "dlrover_tpu.agent.warm_worker"],
             env=env,
@@ -358,15 +355,10 @@ class WorkerProcess:
             if self.spec.numa_affinity:
                 # In the child BEFORE exec: threads spawned later (jax/
                 # XLA runtime) inherit the mask — pinning the pid after
-                # spawn would cover only the main thread. Cpu set from
-                # the parent; the child does only the raw syscall.
-                from .numa import tpu_numa_cpuset
+                # spawn would cover only the main thread.
+                from .numa import numa_preexec
 
-                cpus = tpu_numa_cpuset()
-                if cpus:
-                    preexec = (
-                        lambda: os.sched_setaffinity(0, cpus)  # noqa: E731
-                    )
+                preexec = numa_preexec()
             # New process group so teardown can kill the whole tree
             # (grand-children like dataloader workers), mirroring orphan
             # reaping in the reference (training.py:616).

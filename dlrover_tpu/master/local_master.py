@@ -49,6 +49,14 @@ class LocalJobMaster:
         ctx = get_context()
         if fresh_context:
             JobContext.reset()
+            # The metric context is a separate singleton: a fresh master
+            # inheriting the PREVIOUS job's device/profiler gauges would
+            # misread them as this job's state (stale tpu_timer counts
+            # from an earlier in-process job made a later job's hang/
+            # device-pressure logic — and tests — see ghost activity).
+            from .monitor.metric_context import JobMetricContext
+
+            JobMetricContext.reset()
         self._job_ctx = get_job_context()
         self._events = MasterEvents()
 

@@ -158,8 +158,12 @@ def request_ring_dump(timeout_s: float = 8.0) -> Optional[str]:
         except OSError:
             pass
     token = f"{os.getpid()}_{time.time_ns()}"
-    with open(req, "w") as f:
+    # Atomic publish: the watcher polls for req's existence, so a plain
+    # open+write could be consumed half-written (empty token) and the
+    # round would silently burn its timeout.
+    with open(req + ".tmp", "w") as f:
         f.write(token)
+    os.replace(req + ".tmp", req)
     deadline = time.time() + timeout_s
     while time.time() < deadline:
         if os.path.exists(req + ".done"):

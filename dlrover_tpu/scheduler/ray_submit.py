@@ -83,12 +83,19 @@ class RayJobSubmitter:
         return bool(self._client.stop_job(self.job_id))
 
     def wait(self, timeout_s: float = 3600.0, poll_s: float = 5.0) -> str:
-        """Block until the job reaches a terminal status; returns it."""
+        """Block until the job reaches a terminal status; returns it.
+        Raises TimeoutError when the job is still non-terminal at the
+        deadline — a silently returned 'RUNNING' would read as a
+        failure in CI while the job keeps consuming the cluster."""
         deadline = time.time() + timeout_s
         status = self.status()
         while status not in self.TERMINAL and time.time() < deadline:
             time.sleep(poll_s)
             status = self.status()
+        if status not in self.TERMINAL:
+            raise TimeoutError(
+                f"job {self.job_id} still {status} after {timeout_s}s"
+            )
         return status
 
 

@@ -1,0 +1,259 @@
+"""Autoregressive generation over the training parameters, TPU-first.
+
+The rollout half of an RL job. The reference delegates generation to
+vLLM actors (its PPO example wires `vllm_*` engine args straight into
+the rollout role — examples/unified/rl/openrlhf/ppo/main.py:26-60); in
+this framework generation is a first-class jit-compiled path over the
+same flax parameters the trainer optimizes, so a rollout role needs no
+second inference stack, no weight format conversion, and re-syncs
+weights by just receiving the new param pytree.
+
+Design (all shapes static, everything under one ``jit``):
+
+- **Left-padded prompts.** Every batch row ends at the same cache slot,
+  so the prefill and every decode step write the KV cache with a single
+  ``dynamic_update_slice`` — never a per-row scatter. Per-row absolute
+  positions (for RoPE / learned positional embeddings) and a per-slot
+  validity mask carry the variable prompt lengths instead.
+- **Prefill** runs the whole prompt through the model once in decode
+  mode (one MXU-friendly pass, T0 wide), filling cache slots [0, T0).
+- **Decode** is a ``lax.scan`` over single-token steps: sample, write
+  slot T0+t, advance. Rows that hit EOS keep stepping on a pad token
+  (static shapes) and are masked out of the result.
+- **Sampling**: temperature / top-k / top-p composed in fp32, then
+  ``jax.random.categorical``. Chosen-token logprobs (under the raw,
+  unfiltered distribution) are returned for RL objectives.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "SamplingConfig",
+    "build_generate_fn",
+    "generate",
+    "init_cache",
+    "left_pad_prompts",
+    "sample_logits",
+]
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = off
+    top_p: float = 1.0  # 1.0 = off
+    eos_id: int = -1  # -1 = never stop early
+    pad_id: int = 0
+
+
+def left_pad_prompts(prompts: list, pad_id: int = 0, width: int = 0):
+    """Pack variable-length token lists into LEFT-padded [B, T0] arrays.
+
+    Returns (tokens, mask) with mask True on real tokens. Left padding
+    is the generation-engine convention (see module docstring): all rows
+    end at the same slot so the decode loop writes one static slice.
+    """
+    import numpy as np
+
+    width = max(width, max(len(p) for p in prompts))
+    tokens = np.full((len(prompts), width), pad_id, dtype=np.int32)
+    mask = np.zeros((len(prompts), width), dtype=bool)
+    for i, p in enumerate(prompts):
+        if len(p):
+            tokens[i, width - len(p) :] = np.asarray(p, dtype=np.int32)
+            mask[i, width - len(p) :] = True
+    return jnp.asarray(tokens), jnp.asarray(mask)
+
+
+def init_cache(model, batch_size: int):
+    """Zero decode-cache pytree for ``model`` at the given batch size.
+
+    Shapes come from ``jax.eval_shape`` over ``model.init`` in decode
+    mode — nothing is computed, no params are materialized. The cache
+    spans ``cfg.max_seq_len`` slots per layer (KVH-wide for GQA models).
+    """
+    cfg = model.config
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    pos = jnp.zeros((batch_size, 1), jnp.int32)
+    valid = jnp.zeros((batch_size, cfg.max_seq_len), bool)
+
+    def _init():
+        return model.init(
+            jax.random.PRNGKey(0),
+            dummy,
+            decode=True,
+            positions=pos,
+            kv_valid=valid,
+        )
+
+    shapes = jax.eval_shape(_init)["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+
+def sample_logits(
+    logits,
+    rng,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """Sample token ids from [B, V] logits. Static sampling params.
+
+    temperature==0 is greedy argmax; top-k keeps the k largest; top-p
+    keeps the smallest prefix of the sorted distribution whose mass
+    reaches p (always at least the argmax). Filters compose: k first,
+    then p, matching the common serving convention.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / max(temperature, 1e-6)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose cumulative mass BEFORE them is < top_p
+        keep_sorted = (cum - probs) < top_p
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def build_generate_fn(
+    model,
+    sampling: SamplingConfig,
+    prompt_width: int,
+) -> Callable:
+    """Compile a generation function for fixed (prompt width, sampling).
+
+    Returns ``fn(params, prompt_tokens[B,T0], prompt_mask[B,T0], rng) ->
+    (tokens[B,N], mask[B,N], logprobs[B,N])`` — completions, a validity
+    mask that cuts off after the first EOS (the EOS token itself is
+    kept), and per-token logprobs under the raw model distribution
+    (what an RL objective wants as behavior logprobs). Build once per
+    rollout role; every call reuses the compiled executable.
+    """
+    cfg = model.config
+    s = sampling
+    max_len = cfg.max_seq_len
+    if prompt_width + s.max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt width {prompt_width} + max_new {s.max_new_tokens} "
+            f"exceeds max_seq_len {max_len}"
+        )
+
+    def _apply(params, cache, tokens, positions, kv_valid):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            decode=True,
+            positions=positions,
+            kv_valid=kv_valid,
+            mutable=["cache"],
+        )
+        return logits, mut["cache"]
+
+    def _sample(last_logits, done, rng):
+        """One sampling decision: (token, emit mask, logprob, done')."""
+        tok = sample_logits(
+            last_logits, rng, s.temperature, s.top_k, s.top_p
+        )
+        logp = jax.nn.log_softmax(last_logits, axis=-1)
+        tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+        tok = jnp.where(done, s.pad_id, tok)
+        emit_mask = ~done
+        done = done | (tok == s.eos_id) if s.eos_id >= 0 else done
+        return tok, emit_mask, tok_logp, done
+
+    @partial(jax.jit, static_argnames=())
+    def _generate(params, prompt_tokens, prompt_mask, rng):
+        B, T0 = prompt_tokens.shape
+        cache = init_cache(model, B)
+
+        # absolute positions of prompt tokens (pads clipped to 0 — their
+        # k/v are masked out of every attention anyway)
+        positions = jnp.maximum(
+            jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
+        )
+        kv_valid = jnp.zeros((B, max_len), bool)
+        kv_valid = kv_valid.at[:, :T0].set(prompt_mask)
+
+        logits, cache = _apply(
+            params, cache, prompt_tokens, positions, kv_valid
+        )
+        last_logits = logits[:, -1].astype(jnp.float32)
+        cur_pos = positions[:, -1]  # last real position per row
+
+        # N tokens need N-1 incremental forwards (the prefill supplied
+        # the first logits, the last sampled token is never fed back) —
+        # the scan covers tokens 0..N-2, the final sample happens after.
+        def step(carry, t):
+            cache, kv_valid, last_logits, cur_pos, done, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok, emit_mask, tok_logp, done = _sample(last_logits, done, sub)
+
+            slot = T0 + t
+            kv_valid = kv_valid | (
+                jnp.arange(max_len)[None, :] == slot
+            )
+            pos = cur_pos + 1
+            logits, cache = _apply(
+                params,
+                cache,
+                tok[:, None],
+                pos[:, None],
+                kv_valid,
+            )
+            carry = (
+                cache,
+                kv_valid,
+                logits[:, 0].astype(jnp.float32),
+                pos,
+                done,
+                rng,
+            )
+            return carry, (tok, emit_mask, tok_logp)
+
+        done0 = jnp.zeros((B,), bool)
+        carry = (cache, kv_valid, last_logits, cur_pos, done0, rng)
+        carry, (toks, masks, logps) = jax.lax.scan(
+            step, carry, jnp.arange(s.max_new_tokens - 1)
+        )
+        _, _, last_logits, _, done, rng = carry
+        tok_n, emit_n, logp_n, _ = _sample(
+            last_logits, done, jax.random.split(rng)[1]
+        )
+        # scan stacks on axis 0 → [N-1, B]; append the final sample
+        toks = jnp.concatenate([toks.T, tok_n[:, None]], axis=1)
+        masks = jnp.concatenate([masks.T, emit_n[:, None]], axis=1)
+        logps = jnp.concatenate([logps.T, logp_n[:, None]], axis=1)
+        return toks, masks, logps
+
+    return _generate
+
+
+def generate(
+    model,
+    params,
+    prompt_tokens,
+    prompt_mask,
+    rng,
+    sampling: Optional[SamplingConfig] = None,
+):
+    """One-shot convenience wrapper around :func:`build_generate_fn`."""
+    sampling = sampling or SamplingConfig()
+    fn = build_generate_fn(model, sampling, prompt_tokens.shape[1])
+    return fn(params, prompt_tokens, prompt_mask, rng)

@@ -75,11 +75,95 @@ def _constrain(x, *axes):
     return with_logical_constraint(x, *axes)
 
 
+def _update_decode_cache(module, max_len, k, v, kv_valid):
+    """Write this call's K/V into the module's decode cache; return the
+    full cache plus the attention mask for the queries of this call.
+
+    Incremental decoding the flax way (``"cache"`` variable collection),
+    shared by GPT and Llama attention. The engine convention
+    (:mod:`dlrover_tpu.models.generation`) is LEFT-padded prompts so
+    every batch row shares one static write offset — the cache update is
+    a single ``dynamic_update_slice``, never a per-row scatter, which is
+    the shape XLA tiles well on TPU. ``kv_valid`` [B, max_len] marks
+    which cache slots hold real tokens (False = left-pad); queries at
+    local position i attend valid slots s with s <= offset + i.
+
+    Reference RL rollouts lean on vLLM for this
+    (examples/unified/rl/openrlhf/ppo/main.py:26-60); here generation is
+    a first-class jit-compiled path over the training parameters.
+    """
+    B, T = k.shape[0], k.shape[1]
+    ck = module.variable(
+        "cache", "k", jnp.zeros, (B, max_len) + k.shape[2:], k.dtype
+    )
+    cv = module.variable(
+        "cache", "v", jnp.zeros, (B, max_len) + v.shape[2:], v.dtype
+    )
+    cidx = module.variable(
+        "cache", "index", lambda: jnp.zeros((), jnp.int32)
+    )
+    offset = cidx.value
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+    cidx.value = offset + T
+    if kv_valid is None:
+        # all slots up to the write frontier are real tokens
+        kv_valid = jnp.arange(max_len)[None, :] < (offset + T)
+        kv_valid = jnp.broadcast_to(kv_valid, (B, max_len))
+    # causal-by-slot: query at absolute slot offset+i sees slots <= it
+    slot_q = offset + jnp.arange(T)  # [T]
+    causal = jnp.arange(max_len)[None, :] <= slot_q[:, None]  # [T, max_len]
+    mask = kv_valid[:, None, :] & causal[None, :, :]  # [B, T, max_len]
+    return ck.value, cv.value, mask
+
+
+def _masked_attention(q, k, v, mask, wo, cfg):
+    """Dense attention over the full decode cache with an explicit mask.
+
+    Decode is HBM-bound gather work, not MXU work — a plain einsum over
+    the cache is the right TPU shape (the flash kernel's tiling pays off
+    only on long training sequences). When the cache is GQA-narrow
+    (k/v head count < q head count) the contraction is grouped instead
+    of widening the cache: re-materializing [B, max_len, H, Hd] every
+    single-token step would multiply exactly the HBM traffic the narrow
+    cache exists to avoid.
+    """
+    Hd = q.shape[-1]
+    H, KVH = q.shape[2], k.shape[2]
+    scale = 1.0 / jnp.sqrt(Hd).astype(q.dtype)
+    if H != KVH:
+        B, T = q.shape[:2]
+        G = H // KVH
+        qg = q.reshape(B, T, KVH, G, Hd)
+        logits = jnp.einsum("btgck,bsgk->bgcts", qg, k) * scale
+        logits = jnp.where(mask[:, None, None, :, :], logits, -1e9)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+            q.dtype
+        )
+        out = jnp.einsum("bgcts,bsgk->btgck", probs, v).reshape(B, T, H, Hd)
+    else:
+        logits = jnp.einsum("bqhk,bshk->bhqs", q, k) * scale
+        logits = jnp.where(mask[:, None, :, :], logits, -1e9)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+            q.dtype
+        )
+        out = jnp.einsum("bhqs,bshk->bqhk", probs, v)
+    y = jnp.einsum("bqhk,hkd->bqd", out, wo.astype(cfg.dtype))
+    return _constrain(y, "batch", "seq", "embed")
+
+
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        *,
+        deterministic: bool = True,
+        decode: bool = False,
+        kv_valid=None,
+    ):
         cfg = self.config
         B, T, D = x.shape
         H, Hd = cfg.num_heads, cfg.head_dim
@@ -103,6 +187,12 @@ class CausalSelfAttention(nn.Module):
         q = _constrain(q, "batch", "seq", "heads", "kv")
         k = _constrain(k, "batch", "seq", "heads", "kv")
         v = _constrain(v, "batch", "seq", "heads", "kv")
+
+        if decode:
+            k, v, mask = _update_decode_cache(
+                self, cfg.max_seq_len, k, v, kv_valid
+            )
+            return _masked_attention(q, k, v, mask, wo, cfg)
 
         impl = cfg.resolved_attention_impl()
         if impl not in ("dense", "flash", "ring"):
@@ -193,9 +283,19 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(
+        self,
+        x,
+        *,
+        deterministic: bool = True,
+        decode: bool = False,
+        kv_valid=None,
+    ):
         x = x + CausalSelfAttention(self.config)(
-            LayerNorm(self.config)(x), deterministic=deterministic
+            LayerNorm(self.config)(x),
+            deterministic=deterministic,
+            decode=decode,
+            kv_valid=kv_valid,
         )
         x = x + Mlp(self.config)(LayerNorm(self.config)(x))
         return x
@@ -207,7 +307,15 @@ class GPT(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic: bool = True):
+    def __call__(
+        self,
+        tokens,
+        *,
+        deterministic: bool = True,
+        decode: bool = False,
+        positions=None,
+        kv_valid=None,
+    ):
         cfg = self.config
         B, T = tokens.shape
         wte = param_with_axes(
@@ -224,18 +332,37 @@ class GPT(nn.Module):
             cfg.param_dtype,
             axes=(None, "embed"),
         )
-        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[None, :T]
+        if positions is None:
+            if decode:
+                raise ValueError("decode=True needs absolute positions")
+            pos_emb = wpe.astype(cfg.dtype)[None, :T]
+        else:
+            pos_emb = wpe.astype(cfg.dtype)[positions]  # [B, T, D]
+        x = wte.astype(cfg.dtype)[tokens] + pos_emb
         x = _constrain(x, "batch", "seq", "embed")
 
-        block = Block
-        if cfg.use_remat:
+        # remat trades FLOPs for HBM in training; during incremental
+        # decode there is no backward pass and the cache collection must
+        # stay plainly mutable, so bypass it. The decode kwargs must not
+        # cross nn.remat either — jax.checkpoint would trace the bool.
+        if cfg.use_remat and not decode:
             block = nn.remat(
                 Block,
                 prevent_cse=False,
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
-        for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x, deterministic=deterministic)
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"block_{i}")(
+                    x, deterministic=deterministic
+                )
+        else:
+            for i in range(cfg.num_layers):
+                x = Block(cfg, name=f"block_{i}")(
+                    x,
+                    deterministic=deterministic,
+                    decode=decode,
+                    kv_valid=kv_valid,
+                )
         x = LayerNorm(cfg, name="ln_f")(x)
 
         if cfg.tie_embeddings:

@@ -128,13 +128,29 @@ def apply_rope(x, cos, sin):
     ).astype(x.dtype)
 
 
+def apply_rope_at(x, cos_table, sin_table, positions):
+    """RoPE at per-row absolute positions; x [B,T,H,Hd], positions [B,T].
+
+    The decode path's variant of :func:`apply_rope`: left-padded rows
+    sit at different absolute token positions for the same cache slot,
+    so the angle tables are gathered per (row, slot) instead of shared
+    across the batch.
+    """
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos_table[positions][:, :, None, :]  # [B, T, 1, Hd//2]
+    sin = sin_table[positions][:, :, None, :]
+    return jnp.concatenate(
+        (x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1
+    ).astype(x.dtype)
+
+
 class LlamaAttention(nn.Module):
     """GQA causal attention with rotary embeddings."""
 
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None):
         cfg = self.config
         B, T, D = x.shape
         H, KVH, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -172,6 +188,27 @@ class LlamaAttention(nn.Module):
         q = jnp.einsum("btd,dhk->bthk", x, wq.astype(cfg.dtype))
         k = jnp.einsum("btd,dgk->btgk", x, wk.astype(cfg.dtype))
         v = jnp.einsum("btd,dgk->btgk", x, wv.astype(cfg.dtype))
+
+        if decode:
+            # RoPE at the tokens' absolute positions (left-padded prompts
+            # carry a per-row position array), then cache the SMALL
+            # pre-repeat GQA k/v — the KVH-wide cache is the whole point
+            # of grouped-query attention at decode time.
+            from .gpt import _masked_attention, _update_decode_cache
+
+            cos_t, sin_t = rope_tables(
+                cfg.max_seq_len, Hd, cfg.rope_theta
+            )
+            if positions is None:
+                raise ValueError("decode=True needs absolute positions")
+            q = apply_rope_at(q, cos_t, sin_t, positions)
+            k = apply_rope_at(k, cos_t, sin_t, positions)
+            k, v, mask = _update_decode_cache(
+                self, cfg.max_seq_len, k, v, kv_valid
+            )
+            # no repeat: _masked_attention groups q heads against the
+            # narrow KVH-wide cache instead of widening it every step
+            return _masked_attention(q, k, v, mask, wo, cfg)
 
         cos, sin = rope_tables(T, Hd, cfg.rope_theta)
         q = apply_rope(q, cos, sin)
@@ -354,9 +391,14 @@ class LlamaBlock(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None):
         cfg = self.config
-        x = x + LlamaAttention(cfg)(RMSNorm(cfg)(x))
+        x = x + LlamaAttention(cfg)(
+            RMSNorm(cfg)(x),
+            decode=decode,
+            positions=positions,
+            kv_valid=kv_valid,
+        )
         mlp = MoeMlp(cfg) if cfg.is_moe_block(self.layer_idx) else SwiGluMlp(cfg)
         x = x + mlp(RMSNorm(cfg)(x))
         return x
@@ -368,7 +410,14 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(
+        self,
+        tokens,
+        *,
+        decode: bool = False,
+        positions=None,
+        kv_valid=None,
+    ):
         cfg = self.config
         B, T = tokens.shape
         wte = param_with_axes(
@@ -380,16 +429,22 @@ class Llama(nn.Module):
         )
         x = wte.astype(cfg.dtype)[tokens]
         x = _constrain(x, "batch", "seq", "embed")
-        block = LlamaBlock
-        if cfg.use_remat:
+        # decode bypasses remat: no backward pass, and the decode kwargs
+        # must not cross jax.checkpoint (it would trace the bool).
+        if cfg.use_remat and not decode:
             block = nn.remat(
                 LlamaBlock,
                 prevent_cse=False,
                 policy=jax.checkpoint_policies.nothing_saveable,
                 static_argnums=(),
             )
-        for i in range(cfg.num_layers):
-            x = block(cfg, layer_idx=i, name=f"block_{i}")(x)
+            for i in range(cfg.num_layers):
+                x = block(cfg, layer_idx=i, name=f"block_{i}")(x)
+        else:
+            for i in range(cfg.num_layers):
+                x = LlamaBlock(cfg, layer_idx=i, name=f"block_{i}")(
+                    x, decode=decode, positions=positions, kv_valid=kv_valid
+                )
         x = RMSNorm(cfg, name="norm_f")(x)
         w_lm = param_with_axes(
             "lm_head",

@@ -92,6 +92,37 @@ def _axon_platform_active() -> bool:
     )
 
 
+def _non_tpu_platform_pin() -> str:
+    """The process's explicit platform pin, when it EXCLUDES axon/tpu.
+
+    ``axon.register.register()`` forces ``jax_platforms="axon,cpu"``
+    as part of registration, silently overriding an earlier
+    ``force_virtual_cpu`` pin — after which the first ``jax.devices()``
+    initializes the axon client and can block indefinitely on the
+    single-tenant tunnel (observed: every CPU-pinned goodput-storm
+    trainer froze in ``make_c_api_client`` when two workers raced for
+    the one chip). A process that pinned itself off the TPU must
+    therefore never replay the axon registration at all.
+    """
+    pin = os.environ.get("JAX_PLATFORMS", "")
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            pin = jax.config.jax_platforms or pin
+        except Exception:  # noqa: BLE001 — config introspection only
+            pass
+    return pin if _pin_excludes_tpu(pin) else ""
+
+
+def _pin_excludes_tpu(pin: str) -> bool:
+    """True when a platform selection names platforms but no TPU form."""
+    names = {p.strip() for p in pin.split(",") if p.strip()}
+    return bool(names) and not names & {"axon", "tpu"}
+
+
 def maybe_enable_worker_profiling() -> None:
     """Worker-side half of the axon profiling contract: called from the
     trainer bootstrap (``elastic_context``) BEFORE the first jax backend
@@ -103,6 +134,12 @@ def maybe_enable_worker_profiling() -> None:
     if os.environ.get("DLROVER_PROFILE_AXON") != "1":
         return
     os.environ["DLROVER_PROFILE_AXON"] = "0"  # once per process
+    pin = _non_tpu_platform_pin()
+    if pin:
+        logger.info(
+            "axon profiling skipped: process pinned jax_platforms=%r", pin
+        )
+        return
     port = int(os.environ.get("DLROVER_TT_PORT", "0") or 0)
     try:
         enable_axon_interposition(port)

@@ -427,9 +427,53 @@ class TestAxonEnvContract:
         monkeypatch.setenv("DLROVER_PROFILE_AXON", "1")
         monkeypatch.setenv("DLROVER_TT_PORT", "0")
         monkeypatch.setattr(pjrt_mod, "AXON_PJRT_SO", "/nonexistent/axon.so")
+        # the suite process pins cpu (conftest), which would short-
+        # circuit before the failure path this test exists to cover
+        monkeypatch.setattr(pjrt_mod, "_non_tpu_platform_pin", lambda: "")
         pjrt_mod.maybe_enable_worker_profiling()
         # consumed: a second call is a no-op even in the same process
         assert os.environ["DLROVER_PROFILE_AXON"] == "0"
+
+    def test_maybe_enable_respects_cpu_pin(self, monkeypatch):
+        """A worker that pinned itself off the TPU (force_virtual_cpu —
+        chaos harnesses, CPU-mesh tests) must never replay the axon
+        registration: ``axon.register.register`` forces
+        ``jax_platforms="axon,cpu"``, and the next ``jax.devices()``
+        then blocks initializing the single-tenant chip (the goodput
+        storm froze exactly this way: two CPU-pinned trainers stuck in
+        ``make_c_api_client``)."""
+        from dlrover_tpu.profiler import pjrt as pjrt_mod
+
+        monkeypatch.setenv("DLROVER_PROFILE_AXON", "1")
+
+        def _boom(port=0):
+            raise AssertionError("interposition must not run under a pin")
+
+        monkeypatch.setattr(pjrt_mod, "enable_axon_interposition", _boom)
+        monkeypatch.setattr(pjrt_mod, "_replay_axon_registration", _boom)
+        # the suite process IS cpu-pinned (conftest force_virtual_cpu)
+        assert pjrt_mod._non_tpu_platform_pin() != ""
+        pjrt_mod.maybe_enable_worker_profiling()
+        assert os.environ["DLROVER_PROFILE_AXON"] == "0"
+
+    def test_pin_detection_scopes(self, monkeypatch):
+        """Pin detection: an axon/tpu-containing (or absent) selection
+        is NOT a pin-away; an explicit cpu-only one is. The jax config
+        takes precedence over the env var (force_virtual_cpu updates
+        both, but ``register()`` rewrites only the config)."""
+        from dlrover_tpu.profiler import pjrt as pjrt_mod
+
+        # the suite's jax config pin (cpu) dominates whatever env says
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        assert pjrt_mod._non_tpu_platform_pin() == "cpu"
+        # every branch of the decision itself
+        assert pjrt_mod._pin_excludes_tpu("cpu")
+        assert pjrt_mod._pin_excludes_tpu("cpu, rocm")
+        assert not pjrt_mod._pin_excludes_tpu("")  # absent = auto
+        assert not pjrt_mod._pin_excludes_tpu(" , ")  # no names
+        assert not pjrt_mod._pin_excludes_tpu("axon")
+        assert not pjrt_mod._pin_excludes_tpu("tpu,cpu")
+        assert not pjrt_mod._pin_excludes_tpu("cpu,axon")
 
 
 class TestRealPlugin:

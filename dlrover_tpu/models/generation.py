@@ -181,6 +181,14 @@ def build_generate_fn(
     @partial(jax.jit, static_argnames=())
     def _generate(params, prompt_tokens, prompt_mask, rng):
         B, T0 = prompt_tokens.shape
+        if T0 != prompt_width:
+            # the build-time overflow guard validated prompt_width; a
+            # wider input would overflow the cache SILENTLY (clamped
+            # dynamic_update_slice writes + never-matching kv_valid)
+            raise ValueError(
+                f"prompt_tokens width {T0} != built prompt_width "
+                f"{prompt_width}"
+            )
         cache = init_cache(model, B)
 
         # absolute positions of prompt tokens (pads clipped to 0 — their

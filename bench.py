@@ -512,6 +512,67 @@ def _bench_long_context(extra):
     )
 
 
+def _bench_decode(extra, cfg, params, on_tpu):
+    """Autoregressive decode throughput through the generation engine
+    (models/generation.py) — the rollout half of an RL job. No
+    reference counterpart (it delegates to vLLM); reported as its own
+    datapoint. One jitted prefill+scan program, synced once via the
+    output fetch, dispatch floor subtracted.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.generation import (
+        SamplingConfig,
+        build_generate_fn,
+    )
+    from dlrover_tpu.models.gpt import GPT
+
+    model = GPT(cfg)  # same params; flax modules are cheap dataclasses
+    if on_tpu:
+        B, P, N = 32, 128, 64
+    else:
+        B, P, N = 2, 16, 8
+    toks = jnp.ones((B, P), jnp.int32)
+    mask = jnp.ones((B, P), bool)
+
+    def timed(n_new):
+        fn = build_generate_fn(
+            model,
+            SamplingConfig(max_new_tokens=n_new, temperature=1.0, top_k=40),
+            prompt_width=P,
+        )
+        out = fn(params, toks, mask, jax.random.PRNGKey(0))  # compile
+        jax.block_until_ready(out)
+        floor_s = _dispatch_floor(out[2][:1, :1])
+        ts = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            out = fn(params, toks, mask, jax.random.PRNGKey(1 + i))
+            _ = float(out[2].sum())  # hard sync on the logprobs
+            ts.append(time.perf_counter() - t0 - floor_s)
+        return max(float(np.median(ts)), 1e-9)
+
+    # Two-point measurement: one whole-call number (what a rollout
+    # role pays) plus t(N) - t(1) over N-1 steps, which cancels the
+    # prefill so the per-step figure is pure incremental decode.
+    t_full = timed(N)
+    t_one = timed(1)
+    step_s = max((t_full - t_one) / max(N - 1, 1), 1e-9)
+    extra.update(
+        {
+            "generate_tokens_per_s": round(B * N / t_full, 1),
+            "decode_batch": B,
+            "decode_prompt_len": P,
+            "decode_new_tokens": N,
+            "decode_ms_per_step": round(step_s * 1e3, 2),
+            "decode_tokens_per_s": round(B / step_s, 1),
+            "prefill_ms": round(max(t_one - step_s, 0.0) * 1e3, 1),
+        }
+    )
+
+
 def _bench_checkpoint(extra, state, mesh, flash_s):
     """Flash checkpoint on the real train state (~1.5 GB on TPU)."""
     import jax
@@ -740,6 +801,11 @@ def worker():
                 _bench_long_context(extra)
             except Exception as e:  # noqa: BLE001
                 extra["flash_seq4096_error"] = repr(e)[:200]
+
+        try:
+            _bench_decode(extra, cfg, state.params, on_tpu)
+        except Exception as e:  # noqa: BLE001
+            extra["decode_error"] = repr(e)[:200]
 
         try:
             _bench_checkpoint(extra, state, mesh, flash_s)

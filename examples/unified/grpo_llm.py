@@ -193,10 +193,16 @@ def run_rollout() -> int:
     version = -1
     stop_state = {"saw_running": False}
     while True:
-        blob = kv.get("policy")
-        if blob is not None and blob["version"] != version:
-            params = unpack_pytree(blob, template)
-            version = int(blob["version"])
+        # cheap version probe first: the full blob (every param leaf)
+        # only crosses the wire when the learner actually published a
+        # new version — at real weight sizes the difference is a full
+        # weights download per batch
+        latest = kv.get("policy_version")
+        if latest is not None and int(latest) != version:
+            blob = kv.get("policy")
+            if blob is not None and blob["version"] != version:
+                params = unpack_pytree(blob, template)
+                version = int(blob["version"])
         if _stop_requested(kv, stop_state):
             break
         if stop_state["stopped"]:
@@ -307,6 +313,9 @@ def run_learner() -> int:
         blob = pack_pytree(params)
         blob["version"] = version
         kv.set("policy", blob)
+        # version probe key LAST: a rollout that sees the new version
+        # is guaranteed to find the matching (or newer) blob
+        kv.set("policy_version", version)
 
     publish(0)
     probe_prompts = jnp.asarray(

@@ -568,7 +568,9 @@ def _bench_decode(extra, cfg, params, on_tpu):
             "decode_new_tokens": N,
             "decode_ms_per_step": round(step_s * 1e3, 2),
             "decode_tokens_per_s": round(B / step_s, 1),
-            "prefill_ms": round(max(t_one - step_s, 0.0) * 1e3, 1),
+            # t(1) runs the prefill + ONE sampling op and zero decode
+            # steps (the N-1 scan is empty), so it IS the prefill time
+            "prefill_ms": round(t_one * 1e3, 1),
         }
     )
 

@@ -26,7 +26,6 @@ Design (all shapes static, everything under one ``jit``):
 """
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -136,6 +135,9 @@ def build_generate_fn(
     model,
     sampling: SamplingConfig,
     prompt_width: int,
+    mesh=None,
+    param_shardings=None,
+    rules=None,
 ) -> Callable:
     """Compile a generation function for fixed (prompt width, sampling).
 
@@ -145,6 +147,14 @@ def build_generate_fn(
     kept), and per-token logprobs under the raw model distribution
     (what an RL objective wants as behavior logprobs). Build once per
     rollout role; every call reuses the compiled executable.
+
+    With ``mesh`` (+ optionally the params' ``NamedSharding`` tree and
+    logical-axis ``rules``), the whole prefill+decode program runs SPMD
+    over the mesh: params stay tp/fsdp-sharded exactly as the trainer
+    holds them, prompts shard over the data axes, and XLA inserts the
+    decode collectives — a rollout role serves a model bigger than one
+    chip with the same compiled path (the reference needs a separate
+    vLLM deployment for this; SURVEY.md §2.13).
     """
     cfg = model.config
     s = sampling
@@ -178,7 +188,6 @@ def build_generate_fn(
         done = done | (tok == s.eos_id) if s.eos_id >= 0 else done
         return tok, emit_mask, tok_logp, done
 
-    @partial(jax.jit, static_argnames=())
     def _generate(params, prompt_tokens, prompt_mask, rng):
         B, T0 = prompt_tokens.shape
         if T0 != prompt_width:
@@ -250,7 +259,34 @@ def build_generate_fn(
         logps = jnp.concatenate([logps.T, logp_n[:, None]], axis=1)
         return toks, masks, logps
 
-    return _generate
+    if mesh is None:
+        return jax.jit(_generate)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import current_mesh
+    from ..parallel.sharding import apply_rules, logical_to_sharding
+
+    jit_kwargs = {}
+    if param_shardings is not None:
+        data_sh = logical_to_sharding(
+            PartitionSpec("batch", None), mesh, rules
+        )
+        jit_kwargs["in_shardings"] = (
+            param_shardings,
+            data_sh,
+            data_sh,
+            NamedSharding(mesh, PartitionSpec()),
+        )
+    generate_jit = jax.jit(_generate, **jit_kwargs)
+
+    def _sharded(params, prompt_tokens, prompt_mask, rng):
+        # mesh + logical rules active around trace/execute so the
+        # modules' with_logical_constraint annotations resolve
+        with mesh, apply_rules(rules), current_mesh(mesh):
+            return generate_jit(params, prompt_tokens, prompt_mask, rng)
+
+    return _sharded
 
 
 def generate(

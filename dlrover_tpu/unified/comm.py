@@ -319,7 +319,10 @@ class DataQueue:
 def pack_array(arr) -> Dict[str, Any]:
     import numpy as np
 
-    a = np.ascontiguousarray(arr)
+    # np.asarray, not ascontiguousarray: the latter promotes 0-d
+    # arrays to shape (1,), silently changing the rank of scalars.
+    # tobytes() already produces contiguous C-order bytes.
+    a = np.asarray(arr)
     return {"dtype": str(a.dtype), "shape": list(a.shape), "data": a.tobytes()}
 
 
@@ -329,6 +332,60 @@ def unpack_array(obj: Dict[str, Any]):
     return np.frombuffer(
         obj["data"], dtype=np.dtype(obj["dtype"])
     ).reshape(obj["shape"])
+
+
+def pack_pytree(tree) -> Dict[str, Any]:
+    """Param-pytree → wire dict: leaves packed in flatten order.
+
+    The weight-sync primitive for learner→rollout publication (the
+    reference ships torch state dicts through Ray's object store; here
+    the raw jax/flax pytree crosses the queue/KV as packed leaves).
+    The STRUCTURE is not serialized — both sides share the model
+    definition, so the consumer re-hydrates with its own template via
+    :func:`unpack_pytree`. Device arrays are fetched to host by
+    ``np.asarray`` leaf-by-leaf.
+    """
+    import jax
+
+    # one batched fetch: per-leaf np.asarray would serialize N
+    # device→host transfers with a sync each on the weight-sync path
+    host_tree = jax.device_get(tree)
+    return {
+        "leaves": [
+            pack_array(leaf)
+            for leaf in jax.tree_util.tree_leaves(host_tree)
+        ]
+    }
+
+
+def unpack_pytree(blob: Dict[str, Any], template):
+    """Wire dict → pytree with ``template``'s structure (strict: leaf
+    count AND per-leaf shape/dtype must match the template — a
+    model-definition drift between producer and consumer fails loudly
+    here rather than mis-assigning weights; count alone would pass
+    same-count drift like reordered same-shape layers)."""
+    import jax
+
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    leaves = [unpack_array(x) for x in blob["leaves"]]
+    if len(t_leaves) != len(leaves):
+        raise ValueError(
+            f"pytree leaf count mismatch: template has "
+            f"{len(t_leaves)}, blob has {len(leaves)} — model "
+            "definitions out of sync between producer and consumer"
+        )
+    for i, (got, want) in enumerate(zip(leaves, t_leaves)):
+        want_shape = tuple(getattr(want, "shape", ()))
+        want_dtype = getattr(want, "dtype", None)
+        if tuple(got.shape) != want_shape or (
+            want_dtype is not None and got.dtype != want_dtype
+        ):
+            raise ValueError(
+                f"pytree leaf {i} mismatch: blob {got.shape}/{got.dtype}"
+                f" vs template {want_shape}/{want_dtype} — model "
+                "definitions out of sync between producer and consumer"
+            )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def queue_batches(

@@ -40,7 +40,11 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 
-from dlrover_tpu.unified.comm import rpc  # noqa: E402
+from dlrover_tpu.unified.comm import (  # noqa: E402
+    pack_pytree,
+    rpc,
+    unpack_pytree,
+)
 
 VOCAB = 16
 TARGET_TOKEN = 5
@@ -73,29 +77,6 @@ def policy_model():
             use_remat=False,
         )
     )
-
-
-def pack_pytree(params):
-    """Param pytree -> wire dict (leaves packed in flatten order)."""
-    import jax
-
-    from dlrover_tpu.unified.comm import pack_array
-
-    leaves = jax.tree_util.tree_leaves(params)
-    import numpy as np
-
-    return {"leaves": [pack_array(np.asarray(x)) for x in leaves]}
-
-
-def unpack_pytree(blob, template):
-    """Wire dict -> pytree with ``template``'s structure."""
-    import jax
-
-    from dlrover_tpu.unified.comm import unpack_array
-
-    treedef = jax.tree_util.tree_structure(template)
-    leaves = [unpack_array(x) for x in blob["leaves"]]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 # -- reward role -------------------------------------------------------------

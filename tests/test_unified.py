@@ -1044,3 +1044,39 @@ class TestTracerThreadSafety:
             assert all(d >= 50_000 for d in by_name["host_py_w60"]), by_name
         finally:
             tracer.uninstall()
+
+
+class TestPytreeCodec:
+    """pack_pytree/unpack_pytree — the learner→rollout weight-sync
+    primitive (examples/unified/grpo_llm.py publishes params this way;
+    reference ships torch state dicts through Ray's object store)."""
+
+    def test_roundtrip_preserves_values_and_structure(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dlrover_tpu.unified.comm import pack_pytree, unpack_pytree
+
+        tree = {
+            "layer": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)},
+            "scale": jnp.asarray(2.5),
+        }
+        blob = pack_pytree(tree)
+        # wire dict is msgpack-able primitives only
+        for leaf in blob["leaves"]:
+            assert isinstance(leaf["data"], bytes)
+        out = unpack_pytree(blob, tree)
+        assert set(out) == {"layer", "scale"}
+        np.testing.assert_array_equal(out["layer"]["w"], np.asarray(tree["layer"]["w"]))
+        np.testing.assert_array_equal(out["layer"]["b"], np.asarray(tree["layer"]["b"]))
+        assert float(out["scale"]) == 2.5
+
+    def test_leaf_count_mismatch_fails_loudly(self):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from dlrover_tpu.unified.comm import pack_pytree, unpack_pytree
+
+        blob = pack_pytree({"a": jnp.ones(2)})
+        with _pytest.raises(ValueError, match="leaf count mismatch"):
+            unpack_pytree(blob, {"a": jnp.ones(2), "b": jnp.ones(2)})

@@ -389,7 +389,12 @@ def _build(cfg_kwargs, batch, seq, mesh):
     import jax.numpy as jnp
     import numpy as np
 
-    from dlrover_tpu.models.gpt import GPT, GPTConfig, cross_entropy_loss
+    from dlrover_tpu.models.gpt import (
+        GPT,
+        GPTConfig,
+        cross_entropy_loss,
+        token_loss_mean,
+    )
     from dlrover_tpu.parallel.train_step import (
         build_train_step,
         default_optimizer,
@@ -401,7 +406,8 @@ def _build(cfg_kwargs, batch, seq, mesh):
     tx = default_optimizer()
     tokens = jnp.zeros((batch, seq), jnp.int32)
     state, shardings = init_train_state(model, tokens, mesh, tx)
-    step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, shardings)
+    loss = token_loss_mean if cfg.ce_chunk > 0 else cross_entropy_loss
+    step_fn = build_train_step(model, tx, loss, mesh, shardings)
     r = np.random.default_rng(0)
     x = jnp.asarray(r.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     y = jnp.roll(x, -1, axis=1)
@@ -779,6 +785,7 @@ def worker():
             }
         )
 
+        dense_tps = 0.0
         try:
             _, dstate, dstep_fn, dx, dy = _build(
                 dict(attention_impl="dense", **tiny), dense_bs, seq, mesh
@@ -808,6 +815,49 @@ def worker():
             _bench_decode(extra, cfg, state.params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["decode_error"] = repr(e)[:200]
+
+        # Fused chunked CE (flash + ce_chunk): the fp32 logits are the
+        # HBM ceiling of this config — fusing the head+CE frees ~10 GB
+        # and should admit batches the plain path cannot fit. Measure
+        # at the headline batch first; if parity holds, push the batch
+        # and let the BEST measured config take the headline.
+        try:
+            fused_batches = [flash_bs, flash_bs * 2] if on_tpu else [2]
+            best_fused = None  # (tokens_per_s, batch, step_s)
+            for fb in fused_batches:
+                try:
+                    _, fstate, fstep, fx, fy = _build(
+                        dict(attention_impl="flash", ce_chunk=128, **tiny),
+                        fb,
+                        seq,
+                        mesh,
+                    )
+                    fs, _ = _time_steps(fstate, fstep, fx, fy)
+                    del fstate, fstep, fx, fy
+                    tps = fb * seq / fs
+                    extra[f"fused_ce_b{fb}_step_s"] = round(fs, 4)
+                    extra[f"fused_ce_b{fb}_tokens_per_s"] = round(tps, 1)
+                    if best_fused is None or tps > best_fused[0]:
+                        best_fused = (tps, fb, fs)
+                    if tps < flash_tps * 0.98:
+                        break  # no parity at this batch; don't escalate
+                except Exception as e:  # noqa: BLE001 — e.g. OOM at 2x
+                    extra[f"fused_ce_b{fb}_error"] = repr(e)[:160]
+                    break
+            if best_fused is not None and best_fused[0] > flash_tps:
+                tps, fb, fs = best_fused
+                # headline consistency: value/mfu/vs_baseline/step/batch
+                # all describe the SAME (fused) config once it wins
+                extra["headline_config"] = "flash+fused_ce"
+                extra["mfu"] = round(_mfu(cfg, n_params, fb, seq, fs), 4)
+                extra["flash_step_s"] = round(fs, 4)
+                extra["flash_batch"] = fb
+                flash_tps = tps
+                if dense_tps:
+                    vs_baseline = flash_tps / dense_tps
+                    extra["flash_vs_dense"] = round(vs_baseline, 3)
+        except Exception as e:  # noqa: BLE001
+            extra["fused_ce_error"] = repr(e)[:200]
 
         try:
             _bench_checkpoint(extra, state, mesh, flash_s)

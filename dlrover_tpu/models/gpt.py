@@ -32,6 +32,12 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     use_remat: bool = True  # jax.checkpoint each block: HBM for FLOPs
+    # >0: when targets are passed to __call__, compute per-token CE
+    # inside the model over seq chunks of this size — the [B,T,V] fp32
+    # logits (the HBM ceiling: 6.6 GB at bs=32/seq=1024/vocab=50k)
+    # never materialize whole, and backward recomputes each chunk's
+    # logits (jax.checkpoint), unlocking larger batches.
+    ce_chunk: int = 0
     use_flash_attention: bool = False  # pallas kernel from dlrover_tpu.ops
     # "dense" | "flash" (pallas kernel, single-device/data-parallel) |
     # "ring" (sp-sharded exact attention via shard_map; needs
@@ -302,7 +308,14 @@ class Block(nn.Module):
 
 
 class GPT(nn.Module):
-    """Decoder-only LM. ``__call__(tokens[B,T]) -> logits[B,T,V]``."""
+    """Decoder-only LM. ``__call__(tokens[B,T]) -> logits[B,T,V]``.
+
+    With ``targets`` given the return value is per-token losses
+    ``[B, T]`` (fp32, 0.0 at ``ignore_index`` positions) — pair with
+    :func:`token_loss_mean` as the train-step loss. ``cfg.ce_chunk``
+    > 0 additionally fuses head + CE chunk-by-chunk so the full logits
+    tensor never exists (0 = one whole-sequence chunk).
+    """
 
     config: GPTConfig
 
@@ -311,6 +324,7 @@ class GPT(nn.Module):
         self,
         tokens,
         *,
+        targets=None,
         deterministic: bool = True,
         decode: bool = False,
         positions=None,
@@ -366,16 +380,31 @@ class GPT(nn.Module):
         x = LayerNorm(cfg, name="ln_f")(x)
 
         if cfg.tie_embeddings:
-            logits = jnp.einsum("btd,vd->btv", x, wte.astype(cfg.dtype))
+            w_head = wte.astype(cfg.dtype)  # [V, D]
+            vocab_first = True
         else:
-            w_lm = param_with_axes(
+            w_head = param_with_axes(
                 "lm_head",
                 nn.initializers.normal(0.02),
                 (cfg.embed_dim, cfg.vocab_size),
                 cfg.param_dtype,
                 axes=("embed", "vocab"),
+            ).astype(cfg.dtype)  # [D, V]
+            vocab_first = False
+
+        if targets is not None:
+            # uniform contract: targets given -> per-token losses.
+            # ce_chunk=0 degenerates to one whole-sequence chunk (the
+            # dense math, just routed through the fused path) so the
+            # pairing with token_loss_mean can never be silently wrong.
+            return _chunked_token_ce(
+                x, w_head, targets, cfg.ce_chunk or T, vocab_first
             )
-            logits = jnp.dot(x, w_lm.astype(cfg.dtype))
+
+        if vocab_first:
+            logits = jnp.einsum("btd,vd->btv", x, w_head)
+        else:
+            logits = jnp.dot(x, w_head)
         return _constrain(logits, "batch", "seq", "vocab")
 
 
@@ -388,3 +417,48 @@ def cross_entropy_loss(logits, targets, ignore_index: int = -1):
     token_loss = -jnp.take_along_axis(logps, safe_targets[..., None], axis=-1)[..., 0]
     token_loss = jnp.where(mask, token_loss, 0.0)
     return token_loss.sum() / jnp.maximum(mask.sum(), 1)
+
+
+def _chunked_token_ce(
+    x, w_head, targets, chunk: int, vocab_first: bool, ignore_index: int = -1
+):
+    """Per-token CE fused with the LM head, seq-chunked: [B,T,D] -> [B,T].
+
+    The fp32 logits for the full sequence are the HBM ceiling of a
+    small-model/large-vocab step (bs=32 x 1024 x 50304 fp32 = 6.6 GB).
+    A ``lax.scan`` over T/chunk slices computes each chunk's logits,
+    reduces them to token losses, and — with ``jax.checkpoint`` on the
+    body — recomputes them in backward instead of storing them, so live
+    logits are [B, chunk, V] at any moment. Costs one extra head matmul
+    in backward; buys the batch sizes the dense path cannot fit.
+    """
+    B, T, D = x.shape
+    if T % chunk:
+        raise ValueError(f"seq len {T} not divisible by ce_chunk {chunk}")
+    C = T // chunk
+    xc = jnp.swapaxes(x.reshape(B, C, chunk, D), 0, 1)  # [C, B, c, D]
+    tc = jnp.swapaxes(targets.reshape(B, C, chunk), 0, 1)  # [C, B, c]
+
+    @jax.checkpoint
+    def body(carry, xs):
+        xb, tb = xs
+        if vocab_first:  # w_head [V, D] (tied embeddings)
+            logits = jnp.einsum("bcd,vd->bcv", xb, w_head)
+        else:  # w_head [D, V]
+            logits = jnp.einsum("bcd,dv->bcv", xb, w_head)
+        logits = logits.astype(jnp.float32)
+        mask = tb != ignore_index
+        safe = jnp.where(mask, tb, 0)
+        logps = jax.nn.log_softmax(logits, axis=-1)
+        tl = -jnp.take_along_axis(logps, safe[..., None], axis=-1)[..., 0]
+        return carry, jnp.where(mask, tl, 0.0)
+
+    _, tls = jax.lax.scan(body, (), (xc, tc))  # [C, B, c]
+    return jnp.swapaxes(tls, 0, 1).reshape(B, T)
+
+
+def token_loss_mean(token_losses, targets, ignore_index: int = -1):
+    """Loss head for the fused-CE path: mean of model-computed per-token
+    losses over non-ignored positions (the model already zeroed them)."""
+    mask = targets != ignore_index
+    return token_losses.sum() / jnp.maximum(mask.sum(), 1)

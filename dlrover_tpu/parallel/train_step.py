@@ -190,15 +190,27 @@ def build_train_step(
     replicated = NamedSharding(mesh, PartitionSpec())
     accum = max(1, int(grad_accum_steps))
 
+    # Fused-CE contract (models/gpt.py): a model with ce_chunk > 0
+    # computes per-token losses internally when handed targets — the
+    # full logits never materialize. loss_fn then receives [B, T] token
+    # losses (pair with token_loss_mean), not [B, T, V] logits.
+    fused_ce = getattr(model.config, "ce_chunk", 0) > 0
+
     def grads_of(params, inputs, targets):
         def compute_loss(p):
             # mutable=("losses",) collects ``self.sow("losses", ...)``
             # auxiliary terms (MoE load-balance, GShard eq.4 — see
             # models/llama.py MoeMlp); without it flax silently drops
             # them and top-k routing trains with no balance pressure.
-            logits, mutated = model.apply(
-                {"params": p}, inputs, mutable=("losses",)
-            )
+            if fused_ce:
+                logits, mutated = model.apply(
+                    {"params": p}, inputs, targets=targets,
+                    mutable=("losses",),
+                )
+            else:
+                logits, mutated = model.apply(
+                    {"params": p}, inputs, mutable=("losses",)
+                )
             loss = loss_fn(logits, targets)
             aux_leaves = jax.tree.leaves(mutated.get("losses", {}))
             if aux_leaves and aux_loss_weight:

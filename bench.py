@@ -847,12 +847,14 @@ def worker():
             if best_fused is not None and best_fused[0] > flash_tps:
                 tps, fb, fs = best_fused
                 # headline consistency: value/mfu/vs_baseline/step/batch
-                # all describe the SAME (fused) config once it wins
+                # (and goodput_10 below via flash_s) all describe the
+                # SAME (fused) config once it wins
                 extra["headline_config"] = "flash+fused_ce"
                 extra["mfu"] = round(_mfu(cfg, n_params, fb, seq, fs), 4)
                 extra["flash_step_s"] = round(fs, 4)
                 extra["flash_batch"] = fb
                 flash_tps = tps
+                flash_s = fs
                 if dense_tps:
                     vs_baseline = flash_tps / dense_tps
                     extra["flash_vs_dense"] = round(vs_baseline, 3)

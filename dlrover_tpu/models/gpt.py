@@ -408,14 +408,24 @@ class GPT(nn.Module):
         return _constrain(logits, "batch", "seq", "vocab")
 
 
-def cross_entropy_loss(logits, targets, ignore_index: int = -1):
-    """Mean next-token CE in fp32 (MXU-friendly: one log_softmax fusion)."""
+def _token_ce(logits, targets, ignore_index: int = -1):
+    """Masked per-token CE in fp32: [..., V] logits -> [...] losses
+    (0.0 at ignored positions). Single source of the CE math for both
+    the dense loss and the chunked fused path."""
     logits = logits.astype(jnp.float32)
     mask = targets != ignore_index
     safe_targets = jnp.where(mask, targets, 0)
     logps = jax.nn.log_softmax(logits, axis=-1)
-    token_loss = -jnp.take_along_axis(logps, safe_targets[..., None], axis=-1)[..., 0]
-    token_loss = jnp.where(mask, token_loss, 0.0)
+    token_loss = -jnp.take_along_axis(
+        logps, safe_targets[..., None], axis=-1
+    )[..., 0]
+    return jnp.where(mask, token_loss, 0.0)
+
+
+def cross_entropy_loss(logits, targets, ignore_index: int = -1):
+    """Mean next-token CE in fp32 (MXU-friendly: one log_softmax fusion)."""
+    token_loss = _token_ce(logits, targets, ignore_index)
+    mask = targets != ignore_index
     return token_loss.sum() / jnp.maximum(mask.sum(), 1)
 
 
@@ -446,12 +456,7 @@ def _chunked_token_ce(
             logits = jnp.einsum("bcd,vd->bcv", xb, w_head)
         else:  # w_head [D, V]
             logits = jnp.einsum("bcd,dv->bcv", xb, w_head)
-        logits = logits.astype(jnp.float32)
-        mask = tb != ignore_index
-        safe = jnp.where(mask, tb, 0)
-        logps = jax.nn.log_softmax(logits, axis=-1)
-        tl = -jnp.take_along_axis(logps, safe[..., None], axis=-1)[..., 0]
-        return carry, jnp.where(mask, tl, 0.0)
+        return carry, _token_ce(logits, tb, ignore_index)
 
     _, tls = jax.lax.scan(body, (), (xc, tc))  # [C, B, c]
     return jnp.swapaxes(tls, 0, 1).reshape(B, T)

@@ -41,6 +41,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     use_remat: bool = True
+    # >0: targets passed to __call__ fuse head+CE over seq chunks of
+    # this size (see gpt.GPTConfig.ce_chunk — same contract/math)
+    ce_chunk: int = 0
     attention_impl: str = ""  # "" → dense; flash|ring as in gpt.py
     # MoE: num_experts > 0 replaces every `moe_every`-th block's MLP with
     # a top-2 expert layer (0 = dense model).
@@ -405,7 +408,12 @@ class LlamaBlock(nn.Module):
 
 
 class Llama(nn.Module):
-    """``__call__(tokens[B,T]) -> logits[B,T,V]``."""
+    """``__call__(tokens[B,T]) -> logits[B,T,V]``.
+
+    ``targets`` given → per-token losses ``[B, T]`` through the fused
+    chunked-CE path (gpt.py contract; pair with
+    :func:`dlrover_tpu.models.gpt.token_loss_mean`).
+    """
 
     config: LlamaConfig
 
@@ -414,6 +422,7 @@ class Llama(nn.Module):
         self,
         tokens,
         *,
+        targets=None,
         decode: bool = False,
         positions=None,
         kv_valid=None,
@@ -453,6 +462,16 @@ class Llama(nn.Module):
             cfg.param_dtype,
             axes=("embed", "vocab"),
         )
+        if targets is not None:
+            from .gpt import _chunked_token_ce
+
+            return _chunked_token_ce(
+                x,
+                w_lm.astype(cfg.dtype),
+                targets,
+                cfg.ce_chunk or T,
+                vocab_first=False,
+            )
         logits = jnp.dot(x, w_lm.astype(cfg.dtype))
         return _constrain(logits, "batch", "seq", "vocab")
 

@@ -292,9 +292,16 @@ def build_eval_step(
         in_sharding = tgt_sharding = data_sharding_for(jnp.zeros((1, 1)), mesh, rules)
     replicated = NamedSharding(mesh, PartitionSpec())
 
+    # same fused-CE contract as build_train_step: a ce_chunk model
+    # hands targets in and returns token losses, never whole logits
+    fused_ce = getattr(model.config, "ce_chunk", 0) > 0
+
     def eval_fn(params, inputs, targets):
-        logits = model.apply({"params": params}, inputs)
-        return loss_fn(logits, targets)
+        if fused_ce:
+            out = model.apply({"params": params}, inputs, targets=targets)
+        else:
+            out = model.apply({"params": params}, inputs)
+        return loss_fn(out, targets)
 
     jitted = jax.jit(
         eval_fn,

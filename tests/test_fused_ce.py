@@ -166,3 +166,73 @@ class TestFusedCeTrainStep:
         np.testing.assert_allclose(
             losses["dense"], losses["fused"], rtol=1e-4
         )
+
+
+class TestLlamaFusedCe:
+    """Same contract on the second model family (untied head + MoE)."""
+
+    def test_llama_token_losses_match_dense(self):
+        from dlrover_tpu.models.llama import Llama, LlamaConfig
+
+        dense = Llama(LlamaConfig.tiny())
+        fused = Llama(LlamaConfig.tiny(ce_chunk=32))
+        r = np.random.default_rng(0)
+        x = jnp.asarray(r.integers(0, 256, (2, 128)), jnp.int32)
+        y = jnp.roll(x, -1, axis=1)
+        params = dense.init(jax.random.PRNGKey(0), x)["params"]
+        want = cross_entropy_loss(dense.apply({"params": params}, x), y)
+        tls = fused.apply({"params": params}, x, targets=y)
+        got = token_loss_mean(tls, y)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_llama_moe_fused_step(self):
+        """ce_chunk composes with MoE blocks (aux losses still sowed)."""
+        from dlrover_tpu.models.llama import Llama, LlamaConfig
+
+        model = Llama(
+            LlamaConfig.tiny(num_experts=4, moe_every=2, ce_chunk=32)
+        )
+        r = np.random.default_rng(0)
+        x = jnp.asarray(r.integers(0, 256, (4, 128)), jnp.int32)
+        y = jnp.roll(x, -1, axis=1)
+        mesh = build_mesh(MeshConfig(dp=2, ep=2), jax.devices()[:4])
+        tx = default_optimizer(learning_rate=1e-2, warmup_steps=1)
+        state, shardings = init_train_state(model, x, mesh, tx)
+        step = build_train_step(model, tx, token_loss_mean, mesh, shardings)
+        new_state, loss = step(state, x, y)
+        assert np.isfinite(float(loss))
+        assert int(new_state.step) == 1
+
+
+class TestFusedCeEvalStep:
+    def test_eval_matches_dense_eval(self):
+        """build_eval_step honors the fused contract: a ce_chunk model
+        gets targets handed in and the eval loss equals the dense one
+        (a non-aware eval would feed logits into token_loss_mean and
+        return a silently wrong scalar)."""
+        from dlrover_tpu.parallel.train_step import build_eval_step
+
+        cfg_kw = dict(
+            vocab_size=128,
+            max_seq_len=64,
+            num_layers=1,
+            num_heads=2,
+            head_dim=8,
+            embed_dim=16,
+            use_remat=False,
+        )
+        mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+        losses = {}
+        for name, extra_cfg, loss in [
+            ("dense", {}, cross_entropy_loss),
+            ("fused", {"ce_chunk": 16}, token_loss_mean),
+        ]:
+            model = GPT(GPTConfig(**cfg_kw, **extra_cfg))
+            x, y = _data(model.config, batch=2)
+            tx = default_optimizer(learning_rate=1e-2, warmup_steps=1)
+            state, shardings = init_train_state(model, x, mesh, tx)
+            ev = build_eval_step(model, loss, mesh, shardings)
+            losses[name] = float(ev(state.params, x, y))
+        np.testing.assert_allclose(
+            losses["dense"], losses["fused"], rtol=1e-5
+        )

@@ -424,9 +424,9 @@ def _token_ce(logits, targets, ignore_index: int = -1):
 
 def cross_entropy_loss(logits, targets, ignore_index: int = -1):
     """Mean next-token CE in fp32 (MXU-friendly: one log_softmax fusion)."""
-    token_loss = _token_ce(logits, targets, ignore_index)
-    mask = targets != ignore_index
-    return token_loss.sum() / jnp.maximum(mask.sum(), 1)
+    return token_loss_mean(
+        _token_ce(logits, targets, ignore_index), targets, ignore_index
+    )
 
 
 def _chunked_token_ce(

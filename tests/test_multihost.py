@@ -112,14 +112,15 @@ base = pathlib.Path(os.environ["BASE"])
 '''
 
 
-@pytest.mark.slow
-def test_train_step_over_real_two_process_mesh(tmp_path):
-    """The data plane the agent bootstraps: 2 OS processes, one global
-    2-device mesh, dp across hosts — the sharded train step runs with
-    XLA-inserted cross-host collectives and both hosts see one loss."""
+def _run_two_ranks(tmp_path, worker_src, timeout, per_rank_env=None):
+    """Launch the worker source as 2 jax.distributed ranks; return their
+    outputs. The ONE copy of the launch/collect/kill scaffold: env
+    contract (RANK/COORD/BASE/REPO_ROOT, cpu pin, scrubbed XLA_FLAGS
+    and IPC namespace), sequential communicate with timeout, rc
+    asserts, and kill-on-exit."""
     port = find_free_port("127.0.0.1")
-    script = tmp_path / "train_worker.py"
-    script.write_text(TRAIN_WORKER)
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
     procs = []
     for rank in range(2):
         env = dict(
@@ -127,11 +128,17 @@ def test_train_step_over_real_two_process_mesh(tmp_path):
             RANK=str(rank),
             COORD=f"127.0.0.1:{port}",
             BASE=str(tmp_path),
-            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            REPO_ROOT=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
             JAX_PLATFORMS="cpu",
         )
+        # each process gets ONE cpu device (no virtual-8 override); an
+        # inherited IPC namespace would alias both ranks' shm/sockets
         env.pop("XLA_FLAGS", None)
         env.pop("DLROVER_IPC_NAMESPACE", None)
+        if per_rank_env:
+            env.update(per_rank_env(rank))
         procs.append(
             subprocess.Popen(
                 [sys.executable, str(script)],
@@ -143,13 +150,22 @@ def test_train_step_over_real_two_process_mesh(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out.decode(errors="replace"))
             assert p.returncode == 0, outs[-1][-3000:]
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return outs
+
+
+@pytest.mark.slow
+def test_train_step_over_real_two_process_mesh(tmp_path):
+    """The data plane the agent bootstraps: 2 OS processes, one global
+    2-device mesh, dp across hosts — the sharded train step runs with
+    XLA-inserted cross-host collectives and both hosts see one loss."""
+    _run_two_ranks(tmp_path, TRAIN_WORKER, timeout=240)
     l0 = json.loads((tmp_path / "train0.json").read_text())["losses"]
     l1 = json.loads((tmp_path / "train1.json").read_text())["losses"]
     assert l0 == l1  # one world, one loss
@@ -445,42 +461,12 @@ def test_chaos_kill_on_real_two_host_world(tmp_path):
 
 @pytest.mark.slow
 def test_load_consistent_over_real_jax_distributed(tmp_path):
-    port = find_free_port("127.0.0.1")
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    procs = []
-    for rank in range(2):
-        env = dict(
-            os.environ,
-            RANK=str(rank),
-            COORD=f"127.0.0.1:{port}",
-            BASE=str(tmp_path),
-            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            DLROVER_JOB_NAME=f"mh_{os.getpid()}_{rank}",
-            JAX_PLATFORMS="cpu",
-        )
-        # each process gets ONE cpu device (no virtual-8 override); an
-        # inherited IPC namespace would alias both ranks' shm/sockets
-        env.pop("XLA_FLAGS", None)
-        env.pop("DLROVER_IPC_NAMESPACE", None)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(script)],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-        )
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out.decode(errors="replace"))
-            assert p.returncode == 0, out.decode(errors="replace")[-3000:]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    outs = _run_two_ranks(
+        tmp_path,
+        WORKER,
+        timeout=180,
+        per_rank_env=lambda r: {"DLROVER_JOB_NAME": f"mh_{os.getpid()}_{r}"},
+    )
     for rank in range(2):
         got = json.loads((tmp_path / f"out{rank}.json").read_text())
         # disagreement (5 vs 3) resolved to the common storage step: no
@@ -534,41 +520,100 @@ def test_pruned_history_agreement_over_real_jax_distributed(tmp_path):
     """ADVICE r2 engine fix, proven on a genuine 2-process allgather:
     hosts with divergent pruned histories restore the newest step
     committed on EVERY host (the intersection), not min-of-trackers."""
-    port = find_free_port("127.0.0.1")
-    script = tmp_path / "worker.py"
-    script.write_text(PRUNED_WORKER)
-    procs = []
-    for rank in range(2):
-        env = dict(
-            os.environ,
-            RANK=str(rank),
-            COORD=f"127.0.0.1:{port}",
-            BASE=str(tmp_path),
-            REPO_ROOT=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            DLROVER_JOB_NAME=f"mhp_{os.getpid()}_{rank}",
-            JAX_PLATFORMS="cpu",
-        )
-        env.pop("XLA_FLAGS", None)
-        env.pop("DLROVER_IPC_NAMESPACE", None)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, str(script)],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-        )
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out.decode(errors="replace"))
-            assert p.returncode == 0, out.decode(errors="replace")[-3000:]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    outs = _run_two_ranks(
+        tmp_path,
+        PRUNED_WORKER,
+        timeout=180,
+        per_rank_env=lambda r: {"DLROVER_JOB_NAME": f"mhp_{os.getpid()}_{r}"},
+    )
     for rank in range(2):
         got = json.loads((tmp_path / f"out{rank}.json").read_text())
         assert got["step"] == 4, (rank, got, outs)
         assert got["w"] == [4.0] * 4, (rank, got)
+
+
+GEN_WORKER = r'''
+import os, sys, json, pathlib
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+rank = int(os.environ["RANK"])
+jax.distributed.initialize(
+    coordinator_address=os.environ["COORD"], num_processes=2, process_id=rank
+)
+assert len(jax.devices()) == 2
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from dlrover_tpu.models.generation import (
+    SamplingConfig, build_generate_fn, left_pad_prompts,
+)
+from dlrover_tpu.models.llama import Llama, LlamaConfig
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+from dlrover_tpu.parallel.train_step import (
+    default_optimizer, init_train_state,
+)
+
+model = Llama(LlamaConfig.tiny())
+mesh = build_mesh(MeshConfig(dp=2, fsdp=1))  # dp across the two HOSTS
+tokens = jnp.zeros((4, 8), jnp.int32)
+state, sh = init_train_state(
+    model, tokens, mesh, default_optimizer(warmup_steps=1)
+)
+
+# same global prompt batch on both hosts (same seed); each host feeds
+# its half into the SPMD generation program
+toks_g, mask_g = left_pad_prompts(
+    [[3, 7, 11], [9], [5, 5], [1, 2, 3, 4]], pad_id=0
+)
+spec = jax.sharding.PartitionSpec(("dp", "fsdp"))
+toks = multihost_utils.host_local_array_to_global_array(
+    np.asarray(toks_g)[rank * 2:(rank + 1) * 2], mesh, spec
+)
+mask = multihost_utils.host_local_array_to_global_array(
+    np.asarray(mask_g)[rank * 2:(rank + 1) * 2], mesh, spec
+)
+sampling = SamplingConfig(max_new_tokens=4, temperature=0.0)
+fn = build_generate_fn(
+    model, sampling, prompt_width=4, mesh=mesh, param_shardings=sh.params
+)
+out, omask, logp = fn(state.params, toks, mask, jax.random.PRNGKey(0))
+
+# this host's rows of the global result
+local = np.concatenate(
+    [np.asarray(s.data) for s in out.addressable_shards], axis=0
+)
+
+# single-device reference on the SAME params (replicated under dp-only
+# sharding, so each host can fetch them whole) and the FULL batch
+host_params = jax.device_get(state.params)
+fn1 = build_generate_fn(model, sampling, prompt_width=4)
+ref, _, _ = fn1(
+    jax.tree.map(jnp.asarray, host_params),
+    toks_g,
+    mask_g,
+    jax.random.PRNGKey(0),
+)
+want = np.asarray(ref)[rank * 2:(rank + 1) * 2]
+ok = bool((local == want).all())
+base = pathlib.Path(os.environ["BASE"])
+(base / f"gen{rank}.json").write_text(json.dumps({
+    "ok": ok, "local": local.tolist(), "want": want.tolist(),
+}))
+assert ok, (local.tolist(), want.tolist())
+'''
+
+
+@pytest.mark.slow
+def test_generation_over_real_two_process_mesh(tmp_path):
+    """SPMD generation on a REAL 2-process jax.distributed world: the
+    same compiled prefill+decode program runs dp-sharded across hosts
+    (tests/test_sharded_generation.py proves it on virtual devices;
+    this is the genuine multi-controller bootstrap the agent performs),
+    and each host's rows match a single-device run bit-for-bit."""
+    outs = _run_two_ranks(tmp_path, GEN_WORKER, timeout=300)
+    for rank in range(2):
+        got = json.loads((tmp_path / f"gen{rank}.json").read_text())
+        assert got["ok"], (rank, got, outs)

@@ -334,6 +334,55 @@ def unpack_array(obj: Dict[str, Any]):
     ).reshape(obj["shape"])
 
 
+class WeightBus:
+    """Versioned param-pytree publication over the cluster KV.
+
+    The learner→rollout weight-sync idiom as one object (the pattern
+    examples/unified/grpo_llm.py established): the producer publishes
+    the packed pytree under ``<name>`` and then bumps a tiny
+    ``<name>_version`` probe key; consumers poll the probe first, so
+    the full weight blob only crosses the wire when the version
+    actually advanced — at real weight sizes the difference is a full
+    weights download per batch. Reference counterpart: rollout actors
+    pulling state dicts through Ray's object store
+    (unified/api/runtime/queue.py upstream).
+    """
+
+    def __init__(self, kv=None, name: str = "weights"):
+        if kv is None:
+            from .comm_service import MasterKV
+
+            kv = MasterKV()
+        self._kv = kv
+        self._name = name
+        self._version = -1
+
+    def publish(self, tree, version: int) -> None:
+        """Pack and publish; the probe key is set LAST so a consumer
+        that sees the new version is guaranteed a matching-or-newer
+        blob."""
+        blob = pack_pytree(tree)
+        blob["version"] = int(version)
+        self._kv.set(self._name, blob)
+        self._kv.set(f"{self._name}_version", int(version))
+
+    def poll(self, template):
+        """(tree, version) when the published version DIFFERS from the
+        last seen, else (None, last_version). Deliberately not
+        monotonic: a restarted producer republishing from an earlier
+        version must win — consumers follow the producer, not their own
+        history. One tiny KV read on the no-change hot path."""
+        latest = self._kv.get(f"{self._name}_version")
+        if latest is None or int(latest) == self._version:
+            return None, self._version
+        blob = self._kv.get(self._name)
+        if blob is None or blob.get("version", -1) == self._version:
+            return None, self._version
+        tree = unpack_pytree(blob, template)
+        self._version = int(blob["version"])
+        return tree, self._version
+
+
 def pack_pytree(tree) -> Dict[str, Any]:
     """Param-pytree → wire dict: leaves packed in flatten order.
 

@@ -40,11 +40,7 @@ import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 
-from dlrover_tpu.unified.comm import (  # noqa: E402
-    pack_pytree,
-    rpc,
-    unpack_pytree,
-)
+from dlrover_tpu.unified.comm import WeightBus, rpc  # noqa: E402
 
 VOCAB = 16
 TARGET_TOKEN = 5
@@ -171,19 +167,12 @@ def run_rollout() -> int:
     rng = jax.random.PRNGKey(100 + current_role_index())
     prompt_rng = np.random.default_rng(7 + current_role_index())
     params = template
-    version = -1
+    bus = WeightBus(kv, name="policy")
     stop_state = {"saw_running": False}
     while True:
-        # cheap version probe first: the full blob (every param leaf)
-        # only crosses the wire when the learner actually published a
-        # new version — at real weight sizes the difference is a full
-        # weights download per batch
-        latest = kv.get("policy_version")
-        if latest is not None and int(latest) != version:
-            blob = kv.get("policy")
-            if blob is not None and blob["version"] != version:
-                params = unpack_pytree(blob, template)
-                version = int(blob["version"])
+        fresh, version = bus.poll(template)
+        if fresh is not None:
+            params = fresh
         if _stop_requested(kv, stop_state):
             break
         if stop_state["stopped"]:
@@ -290,13 +279,10 @@ def run_learner() -> int:
         probs = jax.nn.softmax(logits[:, -1].astype(jnp.float32), axis=-1)
         return probs[:, TARGET_TOKEN].mean()
 
+    bus = WeightBus(kv, name="policy")
+
     def publish(version):
-        blob = pack_pytree(params)
-        blob["version"] = version
-        kv.set("policy", blob)
-        # version probe key LAST: a rollout that sees the new version
-        # is guaranteed to find the matching (or newer) blob
-        kv.set("policy_version", version)
+        bus.publish(params, version)
 
     publish(0)
     probe_prompts = jnp.asarray(

@@ -1080,3 +1080,66 @@ class TestPytreeCodec:
         blob = pack_pytree({"a": jnp.ones(2)})
         with _pytest.raises(ValueError, match="leaf count mismatch"):
             unpack_pytree(blob, {"a": jnp.ones(2), "b": jnp.ones(2)})
+
+
+class TestWeightBus:
+    """Versioned weight publication: the blob crosses the wire only
+    when the version advanced (the probe-key protocol grpo_llm.py
+    established, now a comm primitive)."""
+
+    class _CountingKV:
+        def __init__(self):
+            self.store = {}
+            self.gets = []
+
+        def set(self, key, value):
+            self.store[key] = value
+
+        def get(self, key, default=None):
+            self.gets.append(key)
+            return self.store.get(key, default)
+
+    def test_poll_fetches_blob_only_on_new_version(self):
+        import jax.numpy as jnp
+
+        from dlrover_tpu.unified.comm import WeightBus
+
+        kv = self._CountingKV()
+        template = {"w": jnp.zeros(3), "b": jnp.zeros(())}
+        producer = WeightBus(kv, name="policy")
+        consumer = WeightBus(kv, name="policy")
+
+        # nothing published yet
+        tree, ver = consumer.poll(template)
+        assert tree is None and ver == -1
+        assert kv.gets == ["policy_version"]  # no blob fetch
+
+        producer.publish({"w": jnp.ones(3), "b": jnp.asarray(2.0)}, 0)
+        tree, ver = consumer.poll(template)
+        assert ver == 0 and float(tree["b"]) == 2.0
+        assert kv.gets.count("policy") == 1
+
+        # same version: only the probe key is read again
+        tree, ver = consumer.poll(template)
+        assert tree is None and ver == 0
+        assert kv.gets.count("policy") == 1
+
+        producer.publish({"w": jnp.full(3, 5.0), "b": jnp.asarray(7.0)}, 1)
+        tree, ver = consumer.poll(template)
+        assert ver == 1 and float(tree["w"][0]) == 5.0
+        assert kv.gets.count("policy") == 2
+
+    def test_publish_orders_probe_key_last(self):
+        from dlrover_tpu.unified.comm import WeightBus
+
+        order = []
+
+        class _KV(self._CountingKV):
+            def set(inner, key, value):
+                order.append(key)
+                super().set(key, value)
+
+        import jax.numpy as jnp
+
+        WeightBus(_KV(), name="policy").publish({"w": jnp.ones(2)}, 3)
+        assert order == ["policy", "policy_version"]

@@ -109,10 +109,40 @@ def _group_call_async(
     )
 
 
+def _group_call_rank0(
+    self: RoleGroup, method: str, *args, retry_for: float = 0.0, **kwargs
+) -> "Future[Any]":
+    """Only instance 0 (reference rpc_helper.py:254 call_rank0 — e.g.
+    a role-wide barrier owner or a singleton side-effect)."""
+    return self[0].call_async(method, *args, retry_for=retry_for, **kwargs)
+
+
+def _group_call_batch(
+    self: RoleGroup, method: str, args_list, retry_for: float = 0.0
+) -> FutureGroup:
+    """Scatter: ``args_list[i]`` (a tuple, or a single argument) goes
+    to instance i (reference rpc_helper.py:267 call_batch — e.g. each
+    rollout gets ITS shard of a prompt batch)."""
+    if len(args_list) != len(self):
+        raise ValueError(
+            f"args_list has {len(args_list)} items for "
+            f"{len(self)} instances of role {self.role!r}"
+        )
+    futures = []
+    for actor, item in zip(self, args_list):
+        args = item if isinstance(item, tuple) else (item,)
+        futures.append(
+            actor.call_async(method, *args, retry_for=retry_for)
+        )
+    return FutureGroup(futures)
+
+
 # Attached here (not in comm.py) so comm keeps zero threading deps for
 # the minimal role processes that never fan out.
 RoleActor.call_async = _actor_call_async
 RoleGroup.call_async = _group_call_async
+RoleGroup.call_rank0 = _group_call_rank0
+RoleGroup.call_batch = _group_call_batch
 
 
 class _ProxyMethod:

@@ -839,6 +839,30 @@ class TestRpcHelperDepth:
         assert fg.wait(timeout=10) == [42]
         assert len(fg) == 1
 
+    def test_call_rank0_and_call_batch(self, rollout_role):
+        comm = rollout_role
+        seen = []
+
+        def record(tag, extra=None):
+            seen.append((tag, extra))
+            return tag
+
+        comm.export_rpc_method("record", record)
+        group = comm.RoleGroup("rollout", world=1)
+        # rank0: exactly one call, to instance 0
+        assert group.call_rank0("record", "only0").result(timeout=10) == "only0"
+        # scatter: per-instance args (tuple form and bare form)
+        fg = group.call_batch("record", [("shard0", 7)])
+        assert fg.wait(timeout=10) == ["shard0"]
+        fg2 = group.call_batch("record", ["bare"])
+        assert fg2.wait(timeout=10) == ["bare"]
+        assert ("shard0", 7) in seen and ("bare", None) in seen
+        # scatter length must match the role world
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="args_list has 2 items"):
+            group.call_batch("record", ["a", "b"])
+
     def test_typed_proxy_follows_rpc_contract(self, rollout_role):
         from dlrover_tpu.unified.rpc_helper import create_rpc_proxy
 

@@ -15,15 +15,18 @@ from .comm import (  # noqa: F401
     DataQueue,
     RoleActor,
     RoleGroup,
+    WeightBus,
     call_role,
     current_role,
     current_role_index,
     export_rpc_instance,
     export_rpc_method,
     pack_array,
+    pack_pytree,
     queue_batches,
     rpc,
     unpack_array,
+    unpack_pytree,
 )
 from .comm_service import (  # noqa: F401
     MasterDataQueue,

@@ -97,24 +97,16 @@ def init_cache(model, batch_size: int):
     )
 
 
-def sample_logits(
-    logits,
-    rng,
-    temperature: float = 1.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
-):
-    """Sample token ids from [B, V] logits. Static sampling params.
+def filter_logits(logits, top_k: int = 0, top_p: float = 1.0):
+    """Mask [..., V] fp32 logits to the top-k/top-p support (-inf out).
 
-    temperature==0 is greedy argmax; top-k keeps the k largest; top-p
-    keeps the smallest prefix of the sorted distribution whose mass
-    reaches p (always at least the argmax). Filters compose: k first,
-    then p, matching the common serving convention.
+    top-k keeps the k largest; top-p keeps the smallest prefix of the
+    sorted distribution whose mass reaches p (always at least the
+    argmax). Filters compose: k first, then p, the common serving
+    convention. Shared by direct sampling and the speculative path
+    (whose acceptance math must target the SAME filtered
+    distribution).
     """
-    logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / max(temperature, 1e-6)
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -128,6 +120,25 @@ def sample_logits(
         inv = jnp.argsort(sort_idx, axis=-1)
         keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
+def sample_logits(
+    logits,
+    rng,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
+    """Sample token ids from [B, V] logits. Static sampling params.
+
+    temperature==0 is greedy argmax; see :func:`filter_logits` for the
+    top-k/top-p semantics.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = filter_logits(logits / max(temperature, 1e-6), top_k, top_p)
     return jax.random.categorical(rng, logits, axis=-1)
 
 

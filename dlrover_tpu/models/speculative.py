@@ -1,0 +1,375 @@
+"""Speculative decoding over the generation engine's decode contract.
+
+Rollout acceleration: a small DRAFT model proposes ``k`` tokens
+autoregressively; the TARGET model scores all of them in ONE decode
+call; standard rejection sampling accepts a prefix and emits one extra
+(resampled or bonus) token, so each target forward yields 1..k+1
+tokens while the output distribution provably stays the target's
+(Leviathan et al. / Chen et al. speculative sampling — public
+algorithm). The reference has nothing comparable; its rollouts inherit
+whatever vLLM deploys.
+
+TPU-first mechanics (everything under ONE jit, static shapes):
+
+- **Shared slot layout, per-model caches.** Each iteration claims
+  ``k+1`` cache slots: the previous iteration's emitted token, then
+  the k draft proposals. BOTH models write the same slots (the draft
+  feeds its own last proposal once more to stay aligned), so the two
+  caches share one validity mask. Rejected proposals are never
+  rewound — their slots are simply marked invalid ("holes") and the
+  per-row absolute positions (a count of valid slots) keep RoPE /
+  learned embeddings exact. The decode contract
+  (``positions`` + ``kv_valid``, models/gpt.py) already supports this.
+- **``lax.while_loop``** over speculation rounds: trip count is
+  data-dependent (acceptance varies), the body is compiled once.
+  Worst case each round emits 1 token; best case k+1.
+- **Cache budget**: ``max_seq_len`` must cover
+  ``prompt + (k+1) * max_new`` slots (holes included) — the price of
+  never rewinding. Callers size the config accordingly.
+
+EOS: rows keep stepping (static shapes) and the returned mask cuts
+off after the first EOS, like the plain engine; unlike it, tokens are
+still *generated* past EOS and simply masked out.
+"""
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .generation import SamplingConfig, filter_logits, init_cache
+
+__all__ = ["SpecConfig", "build_speculative_generate_fn"]
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    num_draft: int = 4  # k: proposals per round
+
+
+def _apply_decode(model, params, cache, tokens, positions, kv_valid):
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        decode=True,
+        positions=positions,
+        kv_valid=kv_valid,
+        mutable=["cache"],
+    )
+    return logits.astype(jnp.float32), mut["cache"]
+
+
+def _dist(logits, s: SamplingConfig):
+    """The SAMPLING distribution (temperature + top-k/top-p filters,
+    renormalized) — the acceptance math must target exactly what the
+    plain engine samples from, or the speculative output silently
+    follows a different distribution. Greedy is handled by callers."""
+    t = max(s.temperature, 1e-6)
+    return jax.nn.softmax(
+        filter_logits(logits / t, s.top_k, s.top_p), axis=-1
+    )
+
+
+def build_speculative_generate_fn(
+    target_model,
+    draft_model,
+    sampling: SamplingConfig,
+    prompt_width: int,
+    spec: SpecConfig = SpecConfig(),
+) -> Callable:
+    """fn(t_params, d_params, prompt_tokens, prompt_mask, rng) ->
+    (tokens[B,N], mask[B,N], logprobs[B,N], accept_stats).
+
+    Same contract as :func:`generation.build_generate_fn` plus the
+    draft params and per-call acceptance stats
+    ``{"rounds": r, "drafted": d, "accepted": a}``. Greedy
+    (temperature=0) speculative output is token-exact with plain
+    greedy decode for ANY draft model — the keystone test.
+    """
+    k = spec.num_draft
+    s = sampling
+    N = s.max_new_tokens
+    L = target_model.config.max_seq_len
+    if draft_model.config.max_seq_len != L:
+        raise ValueError("draft and target must share max_seq_len")
+    if draft_model.config.vocab_size != target_model.config.vocab_size:
+        raise ValueError("draft and target must share the vocabulary")
+    # worst case: every round emits one token and burns k+1 slots
+    need = prompt_width + (k + 1) * N
+    if need > L:
+        raise ValueError(
+            f"speculative cache budget: prompt {prompt_width} + "
+            f"(k+1)*max_new {(k + 1) * N} = {need} slots > max_seq_len "
+            f"{L}; raise max_seq_len or lower num_draft/max_new"
+        )
+    greedy = s.temperature == 0.0
+
+    def _sample_from(dist, rng):
+        if greedy:
+            return jnp.argmax(dist, axis=-1)
+        return jax.random.categorical(rng, jnp.log(dist + 1e-30), axis=-1)
+
+    def _generate(t_params, d_params, prompt_tokens, prompt_mask, rng):
+        B, T0 = prompt_tokens.shape
+        if T0 != prompt_width:
+            raise ValueError(
+                f"prompt width {T0} != built prompt_width {prompt_width}"
+            )
+        t_cache = init_cache(target_model, B)
+        d_cache = init_cache(draft_model, B)
+
+        positions = jnp.maximum(
+            jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
+        )
+        kv_valid = jnp.zeros((B, L), bool)
+        kv_valid = kv_valid.at[:, :T0].set(prompt_mask)
+
+        # prefill BOTH models on the prompt; first token from target
+        t_logits, t_cache = _apply_decode(
+            target_model, t_params, t_cache, prompt_tokens, positions,
+            kv_valid,
+        )
+        _, d_cache = _apply_decode(
+            draft_model, d_params, d_cache, prompt_tokens, positions,
+            kv_valid,
+        )
+        rng, sub = jax.random.split(rng)
+        p0 = _dist(t_logits[:, -1], s)
+        tok0 = _sample_from(p0, sub)
+        lp0 = jnp.log(
+            jnp.take_along_axis(
+                jax.nn.softmax(t_logits[:, -1], axis=-1),
+                tok0[:, None],
+                axis=-1,
+            )[:, 0]
+            + 1e-30
+        )
+
+        n_ctx = prompt_mask.sum(axis=1).astype(jnp.int32)  # valid tokens
+        out_toks = jnp.full((B, N), s.pad_id, jnp.int32)
+        out_toks = out_toks.at[:, 0].set(tok0)
+        out_lps = jnp.zeros((B, N), jnp.float32)
+        out_lps = out_lps.at[:, 0].set(lp0)
+        n_emit = jnp.ones((B,), jnp.int32)
+
+        def emit(buf, vals, offsets, active):
+            """buf[b, offsets[b]] = vals[b] where active[b]."""
+            oh = jax.nn.one_hot(
+                jnp.where(active, offsets, N), N + 1, dtype=buf.dtype
+            )[:, :N]
+            return buf * (1 - oh) + oh * vals[:, None]
+
+        def cond(carry):
+            (_tc, _dc, _kv, _ot, _ol, n_emit, _nc, _ft, ptr, _rg, stats) = (
+                carry
+            )
+            return (n_emit.min() < N) & (ptr + k + 1 <= L)
+
+        def body(carry):
+            (
+                t_cache,
+                d_cache,
+                kv_valid,
+                out_toks,
+                out_lps,
+                n_emit,
+                n_ctx,
+                final_tok,
+                ptr,
+                rng,
+                stats,
+            ) = carry
+
+            # -- draft k proposals, one decode step each; the previous
+            # emitted token leads the window at slot ptr
+            d_toks = []
+            q_dists = []
+            cur = final_tok
+            cur_pos = n_ctx  # final token's position per row
+            dc = d_cache
+            kv = kv_valid
+            # final token's slot is valid context for everyone
+            kv = kv | (jnp.arange(L)[None, :] == ptr)
+            for j in range(k):
+                q_logits, dc = _apply_decode(
+                    draft_model, d_params, dc, cur[:, None],
+                    cur_pos[:, None], kv,
+                )
+                qd = _dist(q_logits[:, 0], s)
+                rng, sub = jax.random.split(rng)
+                nxt = _sample_from(qd, sub)
+                q_dists.append(qd)
+                d_toks.append(nxt)
+                # tentatively treat the proposal's slot as valid
+                # context for the NEXT proposal
+                kv = kv | (jnp.arange(L)[None, :] == ptr + 1 + j)
+                cur = nxt
+                cur_pos = cur_pos + 1
+            # align the draft cache: feed the last proposal too, so
+            # both caches have written the same slots [ptr..ptr+k]
+            # (final + d_1..d_k) and one validity mask serves both
+            _, dc = _apply_decode(
+                draft_model, d_params, dc, cur[:, None],
+                cur_pos[:, None], kv,
+            )
+            drafted = jnp.stack(d_toks, axis=1)  # [B, k]
+
+            # -- target verifies the window [final, d_1..d_k] at once
+            win = jnp.concatenate([final_tok[:, None], drafted], axis=1)
+            win_pos = n_ctx[:, None] + jnp.arange(k + 1)[None, :]
+            t_logits, tc = _apply_decode(
+                target_model, t_params, t_cache, win, win_pos, kv,
+            )
+            p_dists = _dist(t_logits, s)  # [B, k+1, V]
+            p_raw = jax.nn.softmax(t_logits, axis=-1)
+
+            # -- rejection sampling per row
+            #    p_j = p_dists[:, j-1] scores d_j; p_dists[:, k] = bonus
+            p_at = jnp.take_along_axis(
+                p_dists[:, :k], drafted[:, :, None], axis=-1
+            )[:, :, 0]
+            q_at = jnp.stack(
+                [
+                    jnp.take_along_axis(q, d[:, None], axis=-1)[:, 0]
+                    for q, d in zip(q_dists, d_toks)
+                ],
+                axis=1,
+            )  # [B, k]
+            if greedy:
+                ok = drafted == jnp.argmax(p_dists[:, :k], axis=-1)
+            else:
+                rng, sub = jax.random.split(rng)
+                u = jax.random.uniform(sub, (B, k))
+                ok = u < jnp.minimum(1.0, p_at / jnp.maximum(q_at, 1e-30))
+            # a = accepted prefix length
+            a = jnp.where(
+                ok.all(axis=1), k, jnp.argmin(ok.astype(jnp.int32), axis=1)
+            )
+
+            # residual resample at the first rejected position; bonus
+            # sample from p_{k+1} when everything was accepted
+            rej_p = jnp.take_along_axis(
+                p_dists[:, :k],
+                jnp.minimum(a, k - 1)[:, None, None],
+                axis=1,
+            )[:, 0]
+            rej_q = jnp.stack(q_dists, axis=1)
+            rej_q = jnp.take_along_axis(
+                rej_q, jnp.minimum(a, k - 1)[:, None, None], axis=1
+            )[:, 0]
+            resid = jnp.maximum(rej_p - rej_q, 0.0)
+            resid = resid / jnp.maximum(
+                resid.sum(axis=-1, keepdims=True), 1e-30
+            )
+            # degenerate residual (p==q exactly): fall back to p
+            resid = jnp.where(
+                resid.sum(axis=-1, keepdims=True) > 0, resid, rej_p
+            )
+            bonus_p = p_dists[:, k]
+            rng, s1, s2 = jax.random.split(rng, 3)
+            if greedy:
+                resampled = jnp.argmax(rej_p, axis=-1)
+            else:
+                resampled = _sample_from(resid, s1)
+            bonus = _sample_from(bonus_p, s2)
+            all_ok = a == k
+            extra_tok = jnp.where(all_ok, bonus, resampled)
+
+            # -- validity: slots are [ptr]=final, [ptr+1..ptr+k]=
+            # drafts. The final + accepted prefix becomes real context;
+            # rejected slots become permanent holes (never rewound —
+            # positions count only valid slots, so RoPE stays exact)
+            slot_idx = jnp.arange(L)[None, :]
+            keep = slot_idx <= (ptr + a[:, None])  # final + accepted
+            window_slots = (slot_idx >= ptr) & (slot_idx < ptr + k + 1)
+            kv_valid = jnp.where(window_slots, keep, kv_valid)
+
+            # -- emit accepted drafts then the extra token
+            active_row = n_emit < N
+            ne = n_emit
+            ot, ol = out_toks, out_lps
+            t_lp_at = jnp.log(
+                jnp.take_along_axis(
+                    p_raw[:, :k], drafted[:, :, None], axis=-1
+                )[:, :, 0]
+                + 1e-30
+            )
+            for j in range(k):
+                put = active_row & (j < a) & (ne < N)
+                ot = emit(ot, drafted[:, j], ne, put)
+                ol = emit(ol, t_lp_at[:, j], ne, put)
+                ne = ne + put.astype(jnp.int32)
+            extra_raw_p = jnp.where(all_ok[:, None], p_raw[:, k], p_raw[
+                jnp.arange(B), jnp.minimum(a, k - 1)
+            ])
+            extra_lp = jnp.log(
+                jnp.take_along_axis(
+                    extra_raw_p, extra_tok[:, None], axis=-1
+                )[:, 0]
+                + 1e-30
+            )
+            put = active_row & (ne < N)
+            ot = emit(ot, extra_tok, ne, put)
+            ol = emit(ol, extra_lp, ne, put)
+            ne = ne + put.astype(jnp.int32)
+
+            n_ctx = n_ctx + 1 + a  # final + accepted (extra not in cache)
+            # stats count only rows still emitting: a finished row's
+            # free-running proposals would bias the acceptance rate a
+            # caller uses to tune num_draft
+            n_active = active_row.sum()
+            stats = (
+                stats[0] + 1,
+                stats[1] + k * n_active,
+                stats[2] + jnp.where(active_row, a, 0).sum(),
+            )
+            return (
+                tc,
+                dc,
+                kv_valid,
+                ot,
+                ol,
+                ne,
+                n_ctx,
+                extra_tok,
+                ptr + k + 1,
+                rng,
+                stats,
+            )
+
+        stats0 = (
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+        carry = (
+            t_cache,
+            d_cache,
+            kv_valid,
+            out_toks,
+            out_lps,
+            n_emit,
+            n_ctx,
+            tok0,
+            jnp.asarray(T0, jnp.int32),
+            rng,
+            stats0,
+        )
+        carry = jax.lax.while_loop(cond, body, carry)
+        (_tc, _dc, _kv, out_toks, out_lps, _ne, _nc, _ft, _ptr, _rg, st) = (
+            carry
+        )
+
+        # post-mask: cut after the first EOS (the EOS itself is kept)
+        if s.eos_id >= 0:
+            is_eos = out_toks == s.eos_id
+            after = jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+            mask = (after - is_eos.astype(jnp.int32)) == 0
+            out_toks = jnp.where(mask, out_toks, s.pad_id)
+        else:
+            mask = jnp.ones_like(out_toks, bool)
+        stats = {"rounds": st[0], "drafted": st[1], "accepted": st[2]}
+        return out_toks, mask, out_lps, stats
+
+    return jax.jit(_generate)

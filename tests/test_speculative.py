@@ -1,0 +1,240 @@
+"""Speculative decoding tests.
+
+Keystone property: greedy (temperature=0) speculative output is
+token-exact with plain greedy decode for ANY draft model — acceptance
+only changes how many target forwards it takes, never the tokens. A
+same-model draft must accept everything; a differently-initialized
+draft must still be exact while rejecting some proposals.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.generation import (
+    SamplingConfig,
+    build_generate_fn,
+    left_pad_prompts,
+)
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.models.llama import Llama, LlamaConfig
+from dlrover_tpu.models.speculative import (
+    SpecConfig,
+    build_speculative_generate_fn,
+)
+
+
+def _gpt(layers=2, seq=256):
+    return GPT(
+        GPTConfig(
+            vocab_size=64,
+            max_seq_len=seq,
+            num_layers=layers,
+            num_heads=2,
+            head_dim=8,
+            embed_dim=16,
+            use_remat=False,
+        )
+    )
+
+
+def _params(model, seed):
+    return model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+class TestGreedyExactness:
+    @pytest.mark.parametrize("draft_seed", [0, 7], ids=["same", "different"])
+    def test_greedy_matches_plain_decode(self, draft_seed):
+        target = _gpt()
+        draft = _gpt()  # same architecture; params differ by seed
+        t_params = _params(target, 0)
+        d_params = _params(draft, draft_seed)
+
+        toks, mask = left_pad_prompts([[3, 7, 11], [9]], pad_id=0)
+        sampling = SamplingConfig(max_new_tokens=10, temperature=0.0)
+        plain = build_generate_fn(target, sampling, toks.shape[1])
+        want, want_mask, want_lp = plain(
+            t_params, toks, mask, jax.random.PRNGKey(0)
+        )
+
+        spec_fn = build_speculative_generate_fn(
+            target, draft, sampling, toks.shape[1], SpecConfig(num_draft=3)
+        )
+        got, got_mask, got_lp, stats = spec_fn(
+            t_params, d_params, toks, mask, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        np.testing.assert_allclose(
+            np.asarray(got_lp), np.asarray(want_lp), rtol=2e-2, atol=2e-2
+        )
+        if draft_seed == 0:
+            # identical models: every proposal must be accepted
+            assert int(stats["accepted"]) == int(stats["drafted"])
+        else:
+            # a different draft must still be exact, with rejections
+            assert int(stats["accepted"]) < int(stats["drafted"])
+
+    def test_greedy_exact_on_llama_gqa(self):
+        cfg = dict(
+            vocab_size=64,
+            max_seq_len=256,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=8,
+            embed_dim=32,
+            mlp_dim=64,
+            use_remat=False,
+        )
+        target = Llama(LlamaConfig(num_layers=2, **cfg))
+        draft = Llama(LlamaConfig(num_layers=1, **cfg))  # smaller draft
+        t_params = _params(target, 0)
+        d_params = _params(draft, 1)
+        toks, mask = left_pad_prompts([[5, 9], [2, 4, 6]], pad_id=0)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        plain = build_generate_fn(target, sampling, toks.shape[1])
+        want, _, _ = plain(t_params, toks, mask, jax.random.PRNGKey(0))
+        spec_fn = build_speculative_generate_fn(
+            target, draft, sampling, toks.shape[1], SpecConfig(num_draft=4)
+        )
+        got, _, _, stats = spec_fn(
+            t_params, d_params, toks, mask, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["rounds"]) >= 1
+
+
+class TestAcceptanceEconomics:
+    def test_same_model_draft_uses_fewest_rounds(self):
+        """All-accept means each round emits k+1 tokens: rounds ==
+        ceil((N-1)/(k+1)) after the prefill-emitted first token."""
+        target = _gpt()
+        t_params = _params(target, 0)
+        toks, mask = left_pad_prompts([[3]], pad_id=0)
+        k, N = 3, 9
+        spec_fn = build_speculative_generate_fn(
+            target, target, SamplingConfig(max_new_tokens=N, temperature=0.0),
+            toks.shape[1], SpecConfig(num_draft=k),
+        )
+        _, _, _, stats = spec_fn(
+            t_params, t_params, toks, mask, jax.random.PRNGKey(0)
+        )
+        assert int(stats["rounds"]) == -(-(N - 1) // (k + 1))  # ceil
+
+
+class TestSampledSpec:
+    def test_sampled_path_runs_and_masks_eos(self):
+        target = _gpt()
+        draft = _gpt()
+        t_params = _params(target, 0)
+        d_params = _params(draft, 3)
+        toks, mask = left_pad_prompts([[3, 5], [9, 1]], pad_id=0)
+        sampling = SamplingConfig(
+            max_new_tokens=8, temperature=0.9, eos_id=4, pad_id=0
+        )
+        spec_fn = build_speculative_generate_fn(
+            target, draft, sampling, toks.shape[1], SpecConfig(num_draft=2)
+        )
+        got, got_mask, got_lp, stats = spec_fn(
+            t_params, d_params, toks, mask, jax.random.PRNGKey(5)
+        )
+        assert got.shape == (2, 8)
+        assert np.isfinite(np.asarray(got_lp)).all()
+        g = np.asarray(got)
+        m = np.asarray(got_mask)
+        for b in range(2):
+            eos_pos = np.where(g[b] == 4)[0]
+            if eos_pos.size:
+                first = eos_pos[0]
+                assert m[b, : first + 1].all()
+                assert not m[b, first + 1 :].any()
+                assert (g[b, first + 1 :] == 0).all()
+
+    def test_sampled_marginal_tracks_target_not_draft(self):
+        """Distribution preservation smoke: with a strongly-biased
+        draft, the sampled-token marginal must follow the TARGET. Use a
+        1-token generation so the marginal is directly comparable."""
+        target = _gpt(layers=1)
+        draft = _gpt(layers=1)
+        t_params = _params(target, 0)
+        d_params = _params(draft, 11)
+        toks, mask = left_pad_prompts([[3]], pad_id=0)
+        sampling = SamplingConfig(max_new_tokens=2, temperature=1.0)
+        spec_fn = build_speculative_generate_fn(
+            target, draft, sampling, toks.shape[1], SpecConfig(num_draft=2)
+        )
+        plain = build_generate_fn(target, sampling, toks.shape[1])
+        n = 300
+        spec_first = []
+        plain_first = []
+        for i in range(n):
+            g, _, _, _ = spec_fn(
+                t_params, d_params, toks, mask, jax.random.PRNGKey(i)
+            )
+            spec_first.append(int(g[0, 1]))
+            p, _, _ = plain(t_params, toks, mask, jax.random.PRNGKey(1000 + i))
+            plain_first.append(int(p[0, 1]))
+        # compare top-token frequencies between the two samplers
+        top = max(set(plain_first), key=plain_first.count)
+        f_spec = spec_first.count(top) / n
+        f_plain = plain_first.count(top) / n
+        assert abs(f_spec - f_plain) < 0.12, (f_spec, f_plain)
+
+
+
+    def test_filtered_sampling_runs(self):
+        """top-k/top-p filters flow into the acceptance math (the
+        speculative distribution must be the PLAIN engine's filtered
+        one, not the raw softmax)."""
+        target = _gpt(layers=1)
+        draft = _gpt(layers=1)
+        t_params = _params(target, 0)
+        d_params = _params(draft, 2)
+        toks, mask = left_pad_prompts([[3]], pad_id=0)
+        spec_fn = build_speculative_generate_fn(
+            target,
+            draft,
+            SamplingConfig(
+                max_new_tokens=6, temperature=0.8, top_k=8, top_p=0.9
+            ),
+            toks.shape[1],
+            SpecConfig(num_draft=2),
+        )
+        got, m, lp, stats = spec_fn(
+            t_params, d_params, toks, mask, jax.random.PRNGKey(0)
+        )
+        assert got.shape == (1, 6) and np.isfinite(np.asarray(lp)).all()
+
+
+class TestBudgetGuards:
+    def test_rejects_insufficient_cache(self):
+        target = _gpt(seq=32)
+        draft = _gpt(seq=32)
+        with pytest.raises(ValueError, match="cache budget"):
+            build_speculative_generate_fn(
+                target,
+                draft,
+                SamplingConfig(max_new_tokens=16),
+                prompt_width=8,
+                spec=SpecConfig(num_draft=4),
+            )
+
+    def test_rejects_vocab_mismatch(self):
+        target = _gpt()
+        draft = GPT(
+            GPTConfig(
+                vocab_size=128,
+                max_seq_len=256,
+                num_layers=1,
+                num_heads=2,
+                head_dim=8,
+                embed_dim=16,
+                use_remat=False,
+            )
+        )
+        with pytest.raises(ValueError, match="share the vocabulary"):
+            build_speculative_generate_fn(
+                target, draft, SamplingConfig(max_new_tokens=4), 8
+            )

@@ -26,7 +26,7 @@ Design (all shapes static, everything under one ``jit``):
 """
 
 from dataclasses import dataclass
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ everything traces once.
 """
 
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

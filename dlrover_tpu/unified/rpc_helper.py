@@ -21,7 +21,7 @@ Three layers on top of :mod:`unified.comm`'s socket RPC:
 import inspect
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import as_completed as _as_completed
-from typing import Any, Callable, List, Optional, Sequence, Type, TypeVar
+from typing import Any, List, Optional, Sequence, Type, TypeVar
 
 from .comm import RoleActor, RoleGroup, call_role
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 __all__ = [
     "SamplingConfig",
     "build_generate_fn",
+    "filter_logits",
     "generate",
     "init_cache",
     "left_pad_prompts",

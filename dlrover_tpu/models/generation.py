@@ -274,31 +274,11 @@ def build_generate_fn(
     if mesh is None:
         return jax.jit(_generate)
 
-    from jax.sharding import NamedSharding, PartitionSpec
+    from ..parallel.sharding import sharded_generate_jit
 
-    from ..parallel.mesh import current_mesh
-    from ..parallel.sharding import apply_rules, logical_to_sharding
-
-    jit_kwargs = {}
-    if param_shardings is not None:
-        data_sh = logical_to_sharding(
-            PartitionSpec("batch", None), mesh, rules
-        )
-        jit_kwargs["in_shardings"] = (
-            param_shardings,
-            data_sh,
-            data_sh,
-            NamedSharding(mesh, PartitionSpec()),
-        )
-    generate_jit = jax.jit(_generate, **jit_kwargs)
-
-    def _sharded(params, prompt_tokens, prompt_mask, rng):
-        # mesh + logical rules active around trace/execute so the
-        # modules' with_logical_constraint annotations resolve
-        with mesh, apply_rules(rules), current_mesh(mesh):
-            return generate_jit(params, prompt_tokens, prompt_mask, rng)
-
-    return _sharded
+    return sharded_generate_jit(
+        _generate, mesh, (param_shardings,), n_data_args=2, rules=rules
+    )
 
 
 def generate(

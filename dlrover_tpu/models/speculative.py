@@ -77,6 +77,10 @@ def build_speculative_generate_fn(
     sampling: SamplingConfig,
     prompt_width: int,
     spec: SpecConfig = SpecConfig(),
+    mesh=None,
+    target_shardings=None,
+    draft_shardings=None,
+    rules=None,
 ) -> Callable:
     """fn(t_params, d_params, prompt_tokens, prompt_mask, rng) ->
     (tokens[B,N], mask[B,N], logprobs[B,N], accept_stats).
@@ -86,6 +90,12 @@ def build_speculative_generate_fn(
     ``{"rounds": r, "drafted": d, "accepted": a}``. Greedy
     (temperature=0) speculative output is token-exact with plain
     greedy decode for ANY draft model — the keystone test.
+
+    With ``mesh`` (+ the two models' param sharding trees) the whole
+    speculation loop runs SPMD, mirroring
+    :func:`generation.build_generate_fn`'s sharded mode — a big target
+    can be served across chips while a small replicated draft
+    proposes.
     """
     k = spec.num_draft
     s = sampling
@@ -372,4 +382,17 @@ def build_speculative_generate_fn(
         stats = {"rounds": st[0], "drafted": st[1], "accepted": st[2]}
         return out_toks, mask, out_lps, stats
 
-    return jax.jit(_generate)
+    if mesh is None:
+        return jax.jit(_generate)
+
+    from ..parallel.sharding import sharded_generate_jit
+
+    # either tree may be None (that model replicates — the usual shape
+    # for a small draft next to a sharded target)
+    return sharded_generate_jit(
+        _generate,
+        mesh,
+        (target_shardings, draft_shardings),
+        n_data_args=2,
+        rules=rules,
+    )

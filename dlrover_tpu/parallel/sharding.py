@@ -82,3 +82,40 @@ def with_logical_constraint(x, *logical_axes: Optional[str], rules=None):
 def apply_rules(rules: Optional[LogicalRules] = None):
     """Context manager installing the logical axis rules for flax modules."""
     return nn_partitioning.axis_rules(rules or DEFAULT_RULES)
+
+
+def sharded_generate_jit(
+    fn, mesh: Mesh, param_trees, n_data_args: int, rules=None
+):
+    """jit ``fn(*param_trees, *data_args, rng)`` SPMD over ``mesh``.
+
+    The one copy of the sharded-generation wrapper (used by both
+    :mod:`models.generation` and :mod:`models.speculative`): data args
+    shard over the batch axes, the rng replicates, and each entry of
+    ``param_trees`` is a NamedSharding tree — or None, meaning that
+    model's params replicate (e.g. a small speculative draft next to a
+    sharded target). When EVERY tree is None, in_shardings is omitted
+    entirely so already-placed device arrays keep their layout. The
+    returned callable enters the mesh + logical-rule contexts around
+    every call so module constraints resolve.
+    """
+    from .mesh import current_mesh
+
+    jit_kwargs = {}
+    if any(t is not None for t in param_trees):
+        rep = NamedSharding(mesh, PartitionSpec())
+        data_sh = logical_to_sharding(
+            PartitionSpec("batch", None), mesh, rules
+        )
+        jit_kwargs["in_shardings"] = (
+            *[t if t is not None else rep for t in param_trees],
+            *([data_sh] * n_data_args),
+            rep,
+        )
+    jitted = jax.jit(fn, **jit_kwargs)
+
+    def run(*args):
+        with mesh, apply_rules(rules), current_mesh(mesh):
+            return jitted(*args)
+
+    return run

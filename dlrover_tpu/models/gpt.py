@@ -465,5 +465,12 @@ def _chunked_token_ce(
 def token_loss_mean(token_losses, targets, ignore_index: int = -1):
     """Loss head for the fused-CE path: mean of model-computed per-token
     losses over non-ignored positions (the model already zeroed them)."""
+    if token_losses.ndim != targets.ndim:
+        raise ValueError(
+            f"token_loss_mean expects per-token losses shaped like targets "
+            f"{targets.shape}, got {token_losses.shape} — a [B,T,V] rank "
+            f"means the model ran with ce_chunk=0 (raw logits); pair that "
+            f"with cross_entropy_loss instead"
+        )
     mask = targets != ignore_index
     return token_losses.sum() / jnp.maximum(mask.sum(), 1)

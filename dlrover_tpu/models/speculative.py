@@ -367,7 +367,7 @@ def build_speculative_generate_fn(
             stats0,
         )
         carry = jax.lax.while_loop(cond, body, carry)
-        (_tc, _dc, _kv, out_toks, out_lps, _ne, _nc, _ft, _ptr, _rg, st) = (
+        (_tc, _dc, _kv, out_toks, out_lps, n_emit, _nc, _ft, _ptr, _rg, st) = (
             carry
         )
 
@@ -379,6 +379,11 @@ def build_speculative_generate_fn(
             out_toks = jnp.where(mask, out_toks, s.pad_id)
         else:
             mask = jnp.ones_like(out_toks, bool)
+        # Positions past n_emit are unfilled pad slots. The constructor's
+        # cache-budget check makes early exit via the slot guard
+        # unreachable today, but if that invariant ever breaks, truncation
+        # must surface as masked-out slots, not as "valid" pad tokens.
+        mask = mask & (jnp.arange(N)[None, :] < n_emit[:, None])
         stats = {"rounds": st[0], "drafted": st[1], "accepted": st[2]}
         return out_toks, mask, out_lps, stats
 

@@ -122,7 +122,12 @@ def _group_call_batch(
 ) -> FutureGroup:
     """Scatter: ``args_list[i]`` (a tuple, or a single argument) goes
     to instance i (reference rpc_helper.py:267 call_batch — e.g. each
-    rollout gets ITS shard of a prompt batch)."""
+    rollout gets ITS shard of a prompt batch).
+
+    Convention: a TUPLE item is always unpacked as ``*args``. A method
+    whose single argument is itself a tuple must be double-wrapped —
+    ``args_list=[((x,),), ...]`` — or the tuple's elements are scattered
+    as separate positional arguments."""
     if len(args_list) != len(self):
         raise ValueError(
             f"args_list has {len(args_list)} items for "

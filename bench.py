@@ -81,8 +81,20 @@ CPU_WORKER_TIMEOUT_S = float(
 # this bench invocation; merged into extra.probe_history so the round's
 # record shows the chip's whole-day behavior, not just this window).
 WATCHER_LOG = os.environ.get(
-    "DLROVER_CHIP_WATCHER_LOG", "/tmp/chip_watcher_r04.jsonl"
+    "DLROVER_CHIP_WATCHER_LOG", "/tmp/chip_watcher_r05.jsonl"
 )
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+# Full (unbounded) probe/watcher histories land here, NOT in the JSON
+# line: BENCH_r04's line outgrew the driver's parse window and recorded
+# "parsed": null — the one-line contract means a BOUNDED line
+# (VERDICT r4 weak #1). ≤10 history entries, stderr ≤40 chars in-line.
+# Run-unique name: a fixed path would be clobbered by the next bench
+# invocation and a committed record's provenance pointer would dangle.
+SIDECAR_PATH = os.path.join(
+    _REPO_DIR, f"BENCH_probe_sidecar_{int(time.time())}_{os.getpid()}.json"
+)
+HISTORY_MAX = 10
+STDERR_MAX = 40
 
 
 def _run(cmd, env, timeout):
@@ -192,6 +204,28 @@ def _watcher_history():
     }
 
 
+def _merge_committed_artifacts(extra):
+    """Carry the last committed silicon result (written by the chip
+    watcher, ``launcher/chip_watch.py``) and the latest real-wedge hang
+    diagnosis into the bench record with provenance — so an outage-day
+    driver bench still shows the chip numbers and where they came from
+    (VERDICT r4 #1c, #4)."""
+    try:
+        with open(os.path.join(_REPO_DIR, "SILICON_LATEST.json")) as f:
+            extra["last_silicon"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    try:
+        with open(
+            os.path.join(_REPO_DIR, "HANG_DIAGNOSIS_LATEST.json")
+        ) as f:
+            diag = json.load(f)
+        diag["stack_excerpt"] = str(diag.get("stack_excerpt", ""))[-300:]
+        extra["hang_diagnosis"] = diag
+    except (OSError, ValueError):
+        pass
+
+
 def _interpose_env(env):
     """Worker env for an interposed TPU attempt (VERDICT r3 #3): stash
     the pool IPs so the worker's sitecustomize skips axon registration,
@@ -254,7 +288,10 @@ def orchestrate():
         # CI smoke: no TPU expected, run the worker directly.
         rc, out, err = _run(worker_cmd, env, CPU_WORKER_TIMEOUT_S)
         parsed = _last_json_line(out)
-        _emit(parsed or _fallback_json(f"cpu worker rc={rc}: {err[-400:]}"))
+        if parsed is None:
+            parsed = _fallback_json(f"cpu worker rc={rc}: {err[-400:]}")
+        _merge_committed_artifacts(parsed.setdefault("extra", {}))
+        _emit(parsed)
         return
 
     history = []
@@ -262,11 +299,35 @@ def orchestrate():
     def finish(parsed, tpu_error=None):
         extra = parsed.setdefault("extra", {})
         if tpu_error:
-            extra["tpu_error"] = str(tpu_error)[-500:]
-        extra["probe_history"] = history[-40:]
+            extra["tpu_error"] = str(tpu_error)[-300:]
         watcher = _watcher_history()
+        # Full histories go to the sidecar file; the JSON line carries a
+        # bounded digest so it always parses (VERDICT r4 weak #1).
+        try:
+            with open(SIDECAR_PATH, "w") as f:
+                json.dump(
+                    {"probe_history": history, "watcher": watcher}, f,
+                    indent=1,
+                )
+        except OSError:
+            pass
+        extra["probe_history"] = [
+            {
+                k: (v[-STDERR_MAX:] if isinstance(v, str) else v)
+                for k, v in h.items()
+            }
+            for h in history[-HISTORY_MAX:]
+        ]
+        extra["probe_sidecar"] = os.path.basename(SIDECAR_PATH)
         if watcher:
+            last = watcher.get("last") or {}
+            watcher = dict(watcher)
+            watcher["last"] = {
+                k: (v[-STDERR_MAX:] if isinstance(v, str) else v)
+                for k, v in last.items()
+            }
             extra["probe_history_watcher"] = watcher
+        _merge_committed_artifacts(extra)
         _emit(parsed)
 
     # -- phase 1: bring the TPU backend up (retry, fresh process each
@@ -581,6 +642,178 @@ def _bench_decode(extra, cfg, params, on_tpu):
     )
 
 
+def _bench_llama(extra, mesh, on_tpu):
+    """Second model family (Llama GQA+RoPE+SwiGLU) and its MoE variant
+    through the same train-step path — the PARITY silicon claims
+    (130k / 136k tokens/s) must be reproducible by THIS file, not an
+    ad-hoc script (VERDICT r4 #2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.gpt import cross_entropy_loss
+    from dlrover_tpu.models.llama import Llama, LlamaConfig
+    from dlrover_tpu.parallel.train_step import (
+        build_train_step,
+        default_optimizer,
+        init_train_state,
+    )
+
+    if on_tpu:
+        base = dict(
+            vocab_size=32000, max_seq_len=1024, num_layers=12,
+            num_heads=12, num_kv_heads=4, head_dim=64, embed_dim=768,
+            mlp_dim=2048, attention_impl="flash", use_remat=True,
+        )
+        bs, seq = 16, 1024
+    else:
+        base = dict(
+            vocab_size=256, max_seq_len=128, num_layers=2, num_heads=4,
+            num_kv_heads=2, head_dim=8, embed_dim=32, mlp_dim=96,
+            use_remat=False,
+        )
+        bs, seq = 2, 128
+
+    variants = (("llama", {}), ("moe", dict(num_experts=4, moe_every=2)))
+    for label, over in variants:
+        state = step_fn = None  # freed on BOTH paths (OOM mid-variant
+        # must not hold the failed attempt's HBM into the next variant)
+        try:
+            cfg = LlamaConfig(**{**base, **over})
+            model = Llama(cfg)
+            tx = default_optimizer()
+            tokens = jnp.zeros((bs, seq), jnp.int32)
+            state, shardings = init_train_state(model, tokens, mesh, tx)
+            step_fn = build_train_step(
+                model, tx, cross_entropy_loss, mesh, shardings
+            )
+            r = np.random.default_rng(2)
+            x = jnp.asarray(
+                r.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32
+            )
+            y = jnp.roll(x, -1, axis=1)
+            n_params = sum(l.size for l in jax.tree.leaves(state.params))
+            # rebind state so the finally actually drops the ~GB-scale
+            # final train state (a throwaway `_` would pin it in HBM
+            # into the next variant)
+            step_s, state = _time_steps(state, step_fn, x, y)
+            extra[f"{label}_params_m"] = round(n_params / 1e6, 1)
+            extra[f"{label}_step_s"] = round(step_s, 4)
+            extra[f"{label}_batch"] = bs
+            extra[f"{label}_tokens_per_s"] = round(bs * seq / step_s, 1)
+            if label == "llama":
+                # MFU only for the dense model: the 6N analytic count
+                # would charge the MoE's inactive experts as real flops.
+                extra["llama_mfu"] = round(
+                    _mfu(cfg, n_params, bs, seq, step_s), 4
+                )
+        except Exception as e:  # noqa: BLE001 — per-variant guard
+            extra[f"{label}_error"] = repr(e)[:160]
+        finally:
+            state = step_fn = None  # noqa: F841 — drop HBM references
+
+
+def _bench_longseq_train(extra, mesh, on_tpu):
+    """End-to-end long-context TRAINING (not just the kernel): GPT-2
+    small at 4x the headline seq, flash + remat — the PARITY seq-4096
+    MFU 0.461 claim, bench-reproducible."""
+    import jax
+
+    if on_tpu:
+        kwargs, batch, seq = dict(attention_impl="flash"), 8, 4096
+    else:
+        kwargs, batch, seq = dict(
+            attention_impl="flash", vocab_size=256, num_layers=2,
+            num_heads=4, head_dim=8, embed_dim=32, use_remat=False,
+        ), 2, 256
+    cfg, state, step_fn, x, y = _build(kwargs, batch, seq, mesh)
+    n_params = sum(l.size for l in jax.tree.leaves(state.params))
+    step_s, _ = _time_steps(state, step_fn, x, y)
+    extra.update(
+        {
+            "longseq_train_seq": seq,
+            "longseq_train_batch": batch,
+            "longseq_train_step_s": round(step_s, 4),
+            "longseq_train_tokens_per_s": round(batch * seq / step_s, 1),
+            "longseq_train_mfu": round(
+                _mfu(cfg, n_params, batch, seq, step_s), 4
+            ),
+        }
+    )
+    del state, step_fn, x, y
+
+
+def _bench_spec_decode(extra, cfg, params, on_tpu):
+    """Speculative decoding vs plain decode at the SAME sampling config
+    (greedy — the token-exactness regime): acceptance rate + tokens/s
+    (VERDICT r4 #2). Two drafts: a 2-layer random-init draft gives the
+    honest acceptance floor on untrained weights; the target drafting
+    for itself (acceptance ≡ 1) gives the machinery's speedup ceiling.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models.generation import (
+        SamplingConfig,
+        build_generate_fn,
+    )
+    from dlrover_tpu.models.gpt import GPT
+    from dlrover_tpu.models.speculative import (
+        SpecConfig,
+        build_speculative_generate_fn,
+    )
+
+    model = GPT(cfg)
+    B, P, N = (16, 64, 64) if on_tpu else (2, 16, 8)
+    k = 4
+    sampling = SamplingConfig(max_new_tokens=N, temperature=0.0)
+    toks = jnp.ones((B, P), jnp.int32)
+    mask = jnp.ones((B, P), bool)
+
+    def timed(fn, *fn_args):
+        out = fn(*fn_args, jax.random.PRNGKey(0))  # compile
+        jax.block_until_ready(out[:3])
+        floor_s = _dispatch_floor(out[2][:1, :1])
+        ts = []
+        last = out
+        for i in range(3):
+            t0 = time.perf_counter()
+            last = fn(*fn_args, jax.random.PRNGKey(1 + i))
+            _ = float(last[2].sum())  # hard sync on the logprobs
+            ts.append(time.perf_counter() - t0 - floor_s)
+        return max(float(np.median(ts)), 1e-9), last
+
+    plain_fn = build_generate_fn(model, sampling, prompt_width=P)
+    t_plain, _ = timed(plain_fn, params, toks, mask)
+    plain_tps = B * N / t_plain
+
+    draft = GPT(dataclasses.replace(cfg, num_layers=2))
+    d_params = draft.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    results = {"spec": (draft, d_params), "spec_self": (model, params)}
+    extra["spec_plain_greedy_tokens_per_s"] = round(plain_tps, 1)
+    extra["spec_num_draft"] = k
+    for label, (d_model, dp) in results.items():
+        try:
+            fn = build_speculative_generate_fn(
+                model, d_model, sampling, prompt_width=P,
+                spec=SpecConfig(num_draft=k),
+            )
+            t_spec, out = timed(fn, params, dp, toks, mask)
+            stats = out[3]
+            drafted = float(stats["drafted"])
+            acc = float(stats["accepted"]) / max(drafted, 1.0)
+            extra[f"{label}_tokens_per_s"] = round(B * N / t_spec, 1)
+            extra[f"{label}_acceptance"] = round(acc, 3)
+            extra[f"{label}_vs_plain"] = round(t_plain / t_spec, 3)
+        except Exception as e:  # noqa: BLE001 — per-variant guard
+            extra[f"{label}_error"] = repr(e)[:160]
+
+
 def _bench_checkpoint(extra, state, mesh, flash_s):
     """Flash checkpoint on the real train state (~1.5 GB on TPU)."""
     import jax
@@ -815,6 +1048,21 @@ def worker():
             _bench_decode(extra, cfg, state.params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["decode_error"] = repr(e)[:200]
+
+        try:
+            _bench_spec_decode(extra, cfg, state.params, on_tpu)
+        except Exception as e:  # noqa: BLE001
+            extra["spec_error"] = repr(e)[:200]
+
+        try:
+            _bench_llama(extra, mesh, on_tpu)  # per-variant guards inside
+        except Exception as e:  # noqa: BLE001 — e.g. module import failure
+            extra["llama_family_error"] = repr(e)[:200]
+
+        try:
+            _bench_longseq_train(extra, mesh, on_tpu)
+        except Exception as e:  # noqa: BLE001
+            extra["longseq_train_error"] = repr(e)[:200]
 
         # Fused chunked CE (flash + ce_chunk): the fp32 logits are the
         # HBM ceiling of this config — fusing the head+CE frees ~10 GB

@@ -1,0 +1,511 @@
+"""Opportunistic chip watcher: silicon capture + wedge diagnosis.
+
+The tunneled TPU chip is single-tenant and bursty — alive for short
+windows, wedged (PJRT client creation blocks forever in the tunnel
+dial) for hours. Two duties, both driven by one probe loop:
+
+1. **Silicon capture** (VERDICT r4 #1b): the moment a probe succeeds,
+   run the FULL bench and commit the raw output as
+   ``SILICON_r{N}_<ts>.json`` (+ ``.log``), plus a compact
+   ``SILICON_LATEST.json`` summary that ``bench.py`` merges into
+   ``extra.last_silicon`` — so an alive window, however brief, always
+   yields a committed, driver-independent artifact. Re-captures when
+   HEAD moves (new bench sections measure on the next window).
+
+2. **Wedge diagnosis** (VERDICT r4 #4): the probe child is
+   *diagnosable* — it installs the product stack-dump hook
+   (``profiler.stack_dump``, SIGUSR2 → faulthandler) and replays the
+   axon registration THROUGH the PJRT interposer
+   (``profiler.pjrt.enable_axon_interposition``) before touching jax.
+   When the probe times out, the parent scrapes the interposer's live
+   ``/metrics`` (stall verdict, device in-flight, completion age),
+   triggers the stack dump, and records the whole diagnosis chain as
+   ``HANG_DIAGNOSIS_r{N}_<ts>.json`` — the product hang path fired on
+   a REAL wedge, not a synthetic fake-plugin stall. Reference shape:
+   xpu_timer's doHang → all-rank pstack coordination
+   (``common/manager.cc:393-414``).
+
+Run:  python -m dlrover_tpu.launcher.chip_watch [--interval 240] [--once]
+Stop: kill, or create the pause file (``--pause-file``) to suspend
+probing temporarily (e.g. while another process owns the chip).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+ROUND = os.environ.get("DLROVER_ROUND", "r05")
+VERDICT_NAMES = {0: "none", 1: "device", 2: "host", None: "unknown"}
+
+
+def _log(path, rec):
+    rec.setdefault("ts", int(time.time()))
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def _git(*args, check=False):
+    p = subprocess.run(
+        ["git", "-C", REPO, *args], capture_output=True, text=True
+    )
+    if check and p.returncode != 0:
+        raise RuntimeError(f"git {args}: {p.stderr[-300:]}")
+    return p.stdout.strip()
+
+
+def _head_sha():
+    return _git("rev-parse", "--short", "HEAD")
+
+
+def _commit(paths, message):
+    """Best-effort commit (the interactive session may hold the index
+    lock for a moment — retry, then give up loudly; artifacts stay on
+    disk either way and the round's final sweep commits leftovers)."""
+    for attempt in range(5):
+        try:
+            _git("add", "--", *paths, check=True)
+            _git("commit", "-m", message, check=True)
+            return True
+        except RuntimeError as e:
+            if "nothing to commit" in str(e):
+                return True
+            time.sleep(3 + attempt * 3)
+    print(f"WATCHER: commit failed for {paths}", file=sys.stderr, flush=True)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Diagnosable probe (child mode)
+# ---------------------------------------------------------------------------
+
+
+def probe_child():
+    """Runs in a fresh process with the pool IPs stashed by the parent.
+    Phases printed (flushed) so a timeout localizes the hang:
+    PROBE_HOOK → stack-dump handler live; PROBE_REG <mode> → axon
+    registration replayed (interposed/plain); PROBE_INIT <platform> →
+    backend up; PROBE_OK <platform> → a real matmul executed."""
+    from dlrover_tpu.profiler.stack_dump import install_stack_dump_handler
+
+    if install_stack_dump_handler():
+        print("PROBE_HOOK", flush=True)
+    port = int(os.environ.get("DLROVER_TT_PORT", "0") or 0)
+    mode = "interposed"
+    try:
+        from dlrover_tpu.profiler.pjrt import enable_axon_interposition
+
+        enable_axon_interposition(port)
+    except Exception as e:  # noqa: BLE001 — fall back to plain registration
+        print(f"interposition failed: {e!r}", file=sys.stderr, flush=True)
+        mode = "plain"
+        try:
+            from dlrover_tpu.profiler.pjrt import (
+                AXON_PJRT_SO,
+                _replay_axon_registration,
+            )
+
+            _replay_axon_registration(AXON_PJRT_SO)
+        except Exception as e2:  # noqa: BLE001
+            print(f"plain registration failed: {e2!r}", file=sys.stderr)
+            raise SystemExit(7)
+    print(f"PROBE_REG {mode}", flush=True)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("PROBE_INIT", jax.devices()[0].platform, flush=True)
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    v = float(jnp.dot(x, x).sum())
+    assert np.isfinite(v), v
+    print("PROBE_OK", jax.devices()[0].platform, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent: probe spawn + diagnosis + silicon capture
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _probe_env(ns, dump_dir, port):
+    env = dict(os.environ)
+    pool = env.pop("PALLAS_AXON_POOL_IPS", "")
+    if pool:
+        env["DLROVER_SAVED_POOL_IPS"] = pool
+    env["DLROVER_IPC_NAMESPACE"] = ns
+    env["DLROVER_STACK_DUMP_DIR"] = dump_dir
+    env["DLROVER_TT_PORT"] = str(port)
+    return env
+
+
+def _scrape_metrics(port, timeout=5.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=timeout
+        ) as r:
+            return r.read().decode(errors="replace")
+    except Exception as e:  # noqa: BLE001 — diagnosis must not die
+        return f"SCRAPE_ERROR: {e!r}"
+
+
+def _tt_summary(text):
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        for key in (
+            "tpu_timer_stall_verdict",
+            "tpu_timer_device_launches_total",
+            "tpu_timer_device_inflight",
+            "tpu_timer_device_completes_total",
+            "tpu_timer_last_device_complete_age_s",
+            "tpu_timer_last_step",
+        ):
+            if name.startswith(key):
+                try:
+                    out[key] = float(value)
+                except ValueError:
+                    pass
+    return out
+
+
+def _read_stacks(proc_pid, stack_path, timeout_s=8.0):
+    """SIGUSR2 the wedged probe; faulthandler writes all-thread stacks."""
+    try:
+        before = os.path.getsize(stack_path)
+    except OSError:
+        return "(no stack hook file — probe hung before PROBE_HOOK)"
+    try:
+        os.kill(proc_pid, signal.SIGUSR2)
+    except OSError as e:
+        return f"(signal failed: {e!r})"
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            if os.path.getsize(stack_path) > before:
+                time.sleep(0.5)
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    try:
+        with open(stack_path) as f:
+            f.seek(before)
+            return f.read() or "(dump empty — signal not handled)"
+    except OSError as e:
+        return f"(read failed: {e!r})"
+
+
+def run_probe(timeout_s, keep_on_timeout=False):
+    """One diagnosable probe. Returns (record, proc_or_None, port,
+    stack_path): proc is the still-alive wedged child when
+    keep_on_timeout (caller must diagnose + kill)."""
+    ns = f"chipwatch_{os.getpid()}"
+    dump_dir = os.path.join("/tmp", "dlrover_tpu", "stacks")
+    stack_path = os.path.join(dump_dir, f"{ns}.stacks")
+    port = _free_port()
+    try:
+        os.remove(stack_path)  # stale dump from a previous probe round
+    except OSError:
+        pass
+    out_path = f"/tmp/chip_probe_{os.getpid()}.out"
+    t0 = time.time()
+    with open(out_path, "w") as out_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.launcher.chip_watch",
+             "--probe-child"],
+            env=_probe_env(ns, dump_dir, port),
+            stdout=out_f,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            rc = None
+    out = open(out_path).read() if os.path.exists(out_path) else ""
+    phase, platform = "none", ""
+    for mark in ("PROBE_HOOK", "PROBE_REG", "PROBE_INIT", "PROBE_OK"):
+        if mark in out:
+            phase = mark.split("_", 1)[1].lower()
+            tail = out.split(mark, 1)[1].strip().split()
+            if mark in ("PROBE_INIT", "PROBE_OK") and tail:
+                platform = tail[0]
+    last_line = ""
+    for line in reversed(out.strip().splitlines()):
+        if line.strip():
+            last_line = line.strip()[-120:]
+            break
+    rec = {
+        "ts": int(t0),
+        "rc": rc if rc is not None else -9,
+        "duration_s": round(time.time() - t0, 1),
+        "phase": phase,
+        "platform": platform,
+        "note": last_line[:80],
+    }
+    if rc is None and not keep_on_timeout:
+        proc.kill()
+        proc.wait()
+    return rec, (proc if rc is None and keep_on_timeout else None), port, (
+        stack_path
+    )
+
+
+def diagnose_wedge(rec, proc, port, stack_path):
+    """The product hang chain against a live, genuinely wedged probe."""
+    metrics_text = _scrape_metrics(port)
+    tt = _tt_summary(metrics_text)
+    verdict = tt.get("tpu_timer_stall_verdict")
+    stacks = _read_stacks(proc.pid, stack_path)
+    proc.kill()
+    proc.wait()
+    # Combine the three signals into a named diagnosis: the verdict
+    # alone cannot see a hang BEFORE any PJRT activity (launches==0
+    # reads as "none"), but zero launches + a host stack inside client
+    # creation names it precisely.
+    launches = tt.get("tpu_timer_device_launches_total")
+    wedge_frame = ""
+    for line in stacks.splitlines():
+        if line.strip().startswith("File"):
+            wedge_frame = line.strip()
+            break
+    if verdict == 1:
+        classification = "device_stall (program launched, never completed)"
+    elif verdict == 2:
+        classification = "host_stall (device idle, host loop stuck)"
+    elif (
+        tt
+        and not launches
+        and not tt.get("tpu_timer_device_completes_total")
+        and "make_c_api_client" in stacks
+    ):
+        classification = (
+            "pjrt_client_init_hang (zero device activity; host wedged "
+            "creating the PJRT client — tunnel dial never completed)"
+        )
+    else:
+        classification = "unclassified"
+    return {
+        "classification": classification,
+        "wedge_frame": wedge_frame,
+        "ts": int(time.time()),
+        "git_sha": _head_sha(),
+        "probe": rec,
+        "interposer_metrics": tt,
+        "metrics_raw_head": metrics_text[:2000],
+        "stall_verdict": (
+            None if verdict is None else int(verdict)
+        ),
+        "stall_verdict_name": VERDICT_NAMES.get(
+            None if verdict is None else int(verdict), "unknown"
+        ),
+        "stacks": stacks[-12000:],
+        "explanation": (
+            "diagnosable probe (stack-dump hook + PJRT interposer around "
+            "the real axon plugin) wedged at phase=%s; parent scraped the "
+            "interposer stall verdict and collected the SIGUSR2 "
+            "faulthandler all-thread stack dump from the live wedge"
+            % rec["phase"]
+        ),
+    }
+
+
+def capture_silicon(log_path, bench_timeout):
+    """Chip is alive: run the full bench NOW and commit the raw result."""
+    ts = int(time.time())
+    sha = _head_sha()
+    art = os.path.join(REPO, f"SILICON_{ROUND}_{ts}.json")
+    log_art = os.path.join(REPO, f"SILICON_{ROUND}_{ts}.log")
+    env = dict(os.environ)
+    env["DLROVER_BENCH_STORM"] = "0"  # storm is CPU-driven; save the window
+    env.setdefault("DLROVER_BENCH_PROBE_WINDOW_S", "300")
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=bench_timeout,
+            cwd=REPO,
+        )
+        out, err, rc = p.stdout, p.stderr, p.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode(errors="replace") if isinstance(
+            e.stdout, bytes
+        ) else (e.stdout or "")
+        err = f"BENCH TIMEOUT after {bench_timeout}s"
+        rc = -9
+    # bench.py owns the emitted-line contract; reuse its parser (REPO is
+    # on sys.path — the watcher runs as `python -m` from the repo root).
+    sys.path.insert(0, REPO)
+    from bench import _last_json_line
+
+    parsed = _last_json_line(out)
+    device = str((parsed or {}).get("extra", {}).get("device", ""))
+    on_tpu = bool(device) and "cpu" not in device.lower()
+    record = {
+        "ts": ts,
+        "git_sha": sha,
+        "round": ROUND,
+        "bench_rc": rc,
+        "elapsed_s": round(time.time() - t0, 1),
+        "device": device,
+        "on_silicon": on_tpu,
+        "result": parsed,
+    }
+    with open(art, "w") as f:
+        json.dump(record, f, indent=1)
+    with open(log_art, "w") as f:
+        f.write(out[-200000:] + "\n--- stderr ---\n" + err[-100000:])
+    paths = [art, log_art]
+    # The record's extra.probe_sidecar points at the full-history file
+    # bench wrote next to itself — commit it too or the committed
+    # record's provenance pointer dangles.
+    sidecar = (parsed or {}).get("extra", {}).get("probe_sidecar")
+    if sidecar and os.path.exists(os.path.join(REPO, sidecar)):
+        paths.append(os.path.join(REPO, sidecar))
+    if on_tpu and parsed:
+        extra = parsed.get("extra", {})
+        latest = {
+            "ts": ts,
+            "git_sha": sha,
+            "artifact": os.path.basename(art),
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value"),
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+            "device": device,
+            "headline": {
+                k: extra[k]
+                for k in (
+                    "mfu", "flash_step_s", "flash_batch", "seq_len",
+                    "model", "headline_config", "flash_seq4096_tflops",
+                    "decode_tokens_per_s", "generate_tokens_per_s",
+                    "llama_tokens_per_s", "moe_tokens_per_s",
+                    "spec_tokens_per_s", "spec_acceptance",
+                    "longseq_train_tokens_per_s", "longseq_train_mfu",
+                    "ckpt_async_stage_block_s",
+                    "goodput_ckpt_every_10_steps",
+                )
+                if k in extra
+            },
+        }
+        with open(os.path.join(REPO, "SILICON_LATEST.json"), "w") as f:
+            json.dump(latest, f, indent=1)
+        paths.append(os.path.join(REPO, "SILICON_LATEST.json"))
+    _commit(
+        paths,
+        f"Capture {'silicon' if on_tpu else 'attempted-silicon'} bench "
+        f"artifact {os.path.basename(art)} (device={device or 'unknown'})",
+    )
+    # "bench_rc", not "rc": bench.py's _watcher_history classifies any
+    # JSONL entry carrying "rc" as a chip PROBE — a capture record must
+    # not pollute the probe attempt/ok statistics.
+    _log(log_path, {
+        "silicon_capture": os.path.basename(art),
+        "on_silicon": on_tpu,
+        "bench_rc": rc,
+        "value": (parsed or {}).get("value"),
+    })
+    return on_tpu
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe-child", action="store_true")
+    ap.add_argument("--interval", type=float, default=240.0)
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--bench-timeout", type=float, default=3600.0)
+    ap.add_argument("--ttl-hours", type=float, default=10.0)
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument(
+        "--log", default=os.environ.get(
+            "DLROVER_CHIP_WATCHER_LOG", f"/tmp/chip_watcher_{ROUND}.jsonl"
+        )
+    )
+    ap.add_argument("--pause-file", default="/tmp/chip_watcher_pause")
+    args = ap.parse_args(argv)
+
+    if args.probe_child:
+        probe_child()
+        return
+
+    deadline = time.time() + args.ttl_hours * 3600
+    diagnosed_this_streak = False
+    captured_sha = None
+    _log(args.log, {"watcher_start": os.getpid(), "git_sha": _head_sha()})
+    while time.time() < deadline:
+        if os.path.exists(args.pause_file):
+            time.sleep(30)
+            continue
+        rec, wedged_proc, port, stack_path = run_probe(
+            args.probe_timeout, keep_on_timeout=not diagnosed_this_streak
+        )
+        alive = rec["phase"] == "ok" and rec["platform"] not in ("cpu", "")
+        _log(args.log, dict(rec, alive=alive))
+        if wedged_proc is not None:
+            diag = diagnose_wedge(rec, wedged_proc, port, stack_path)
+            ts = diag["ts"]
+            art = os.path.join(REPO, f"HANG_DIAGNOSIS_{ROUND}_{ts}.json")
+            with open(art, "w") as f:
+                json.dump(diag, f, indent=1)
+            latest = {
+                "ts": ts,
+                "git_sha": diag["git_sha"],
+                "artifact": os.path.basename(art),
+                "phase": rec["phase"],
+                "classification": diag["classification"],
+                "wedge_frame": diag["wedge_frame"],
+                "stall_verdict": diag["stall_verdict"],
+                "stall_verdict_name": diag["stall_verdict_name"],
+                "interposer_metrics": diag["interposer_metrics"],
+                "stack_excerpt": diag["stacks"][-600:],
+            }
+            with open(
+                os.path.join(REPO, "HANG_DIAGNOSIS_LATEST.json"), "w"
+            ) as f:
+                json.dump(latest, f, indent=1)
+            _commit(
+                [art, os.path.join(REPO, "HANG_DIAGNOSIS_LATEST.json")],
+                f"Record product-path hang diagnosis of a real chip wedge "
+                f"({os.path.basename(art)})",
+            )
+            diagnosed_this_streak = True
+            _log(args.log, {
+                "hang_diagnosis": os.path.basename(art),
+                "stall_verdict": diag["stall_verdict_name"],
+            })
+        if alive:
+            diagnosed_this_streak = False
+            if captured_sha != _head_sha():
+                ok = capture_silicon(args.log, args.bench_timeout)
+                if ok:
+                    captured_sha = _head_sha()
+        if args.once:
+            break
+        time.sleep(args.interval)
+    _log(args.log, {"watcher_exit": "ttl" if not args.once else "once"})
+
+
+if __name__ == "__main__":
+    main()

@@ -223,6 +223,33 @@ class DistributedJobManager(JobManager):
         )
         if target >= len(active):
             return []
+        # Multislice jobs shrink by WHOLE slices: a slice missing some
+        # hosts is dead weight (its ICI domain can't form the per-slice
+        # mesh), so round the target DOWN to a slice boundary. Ranks are
+        # slice-grouped by the rendezvous TopologySorter, so a boundary
+        # in rank order is a boundary between slices.
+        if len({n.slice_id for n in active}) > 1:
+            boundaries = [
+                i
+                for i in range(1, len(active))
+                if active[i].slice_id != active[i - 1].slice_id
+            ]
+            below = [b for b in boundaries if b <= target]
+            if below:
+                aligned = below[-1]
+            else:
+                # A nonzero target below the first boundary rounds UP to
+                # one whole slice: a shrink request must never be
+                # silently escalated into killing the entire job.
+                aligned = boundaries[0] if target > 0 else 0
+            if aligned != target:
+                logger.info(
+                    "scale_down target %s not slice-aligned; using slice "
+                    "boundary %s", target, aligned
+                )
+                target = aligned
+            if target >= len(active):
+                return []
         removed = active[target:]  # keep the lowest ranks: dp shrinks
         ids = []
         for node in removed:

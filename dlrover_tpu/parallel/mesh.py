@@ -144,6 +144,85 @@ def get_current_mesh() -> Optional[Mesh]:
     return _CURRENT_MESH[0]
 
 
+# -- multi-slice topology (SURVEY §7: the realistic elastic unit is a
+# SLICE — dp rides DCN between slices, everything else must stay on a
+# slice's ICI; reference node_unit semantics, rdzv_manager.py:179-181) --
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """``num_slices`` TPU slices of ``slice_size`` chips each. Chips
+    within a slice share ICI; traffic between slices rides DCN. The
+    elastic unit is a whole slice: a job grows/shrinks/loses capacity
+    slice-at-a-time, never chip-at-a-time."""
+
+    num_slices: int
+    slice_size: int
+
+    @property
+    def total(self) -> int:
+        return self.num_slices * self.slice_size
+
+
+def choose_multislice_shape(
+    topology: SliceTopology, ep: int = 1, tp: int = 1, sp: int = 1,
+    pp: int = 1,
+) -> MeshConfig:
+    """The multislice scaling recipe: dp across slices (DCN carries one
+    gradient all-reduce per step — the only inter-slice collective),
+    fsdp + the ICI-bound axes (ep/tp/sp/pp) within a slice. Losing a
+    slice = same call with ``num_slices - 1``: the per-slice layout is
+    unchanged, so re-mesh is a pure dp shrink."""
+    inner = ep * tp * sp * pp
+    if topology.slice_size % inner != 0:
+        raise ValueError(
+            f"slice size {topology.slice_size} not divisible by "
+            f"ep*tp*sp*pp={inner}: per-step collectives would cross DCN"
+        )
+    return MeshConfig(
+        dp=topology.num_slices,
+        fsdp=topology.slice_size // inner,
+        ep=ep, tp=tp, sp=sp, pp=pp,
+    )
+
+
+def build_multislice_mesh(
+    config: MeshConfig, topology: SliceTopology,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a mesh over a multi-slice world, validating that only the
+    dp axis crosses the DCN boundary between slices.
+
+    Devices must be listed slice-major (slice 0's chips first — the
+    order ``jax.devices()`` returns on multislice, hosts grouped per
+    slice). The [dp, fsdp, ep, tp, sp, pp] reshape puts each fixed-dp
+    block on ``inner = fsdp*ep*tp*sp*pp`` contiguous devices; requiring
+    ``inner | slice_size`` keeps every such block — and therefore every
+    non-dp collective — inside one slice's ICI domain, while dp strides
+    across blocks and is the only axis whose collective rides DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != topology.total:
+        raise ValueError(
+            f"{len(devices)} devices != {topology.num_slices} slices × "
+            f"{topology.slice_size}"
+        )
+    resolved = config.resolve(len(devices))
+    sizes = resolved.as_dict()
+    inner = math.prod(v for k, v in sizes.items() if k != "dp")
+    if topology.slice_size % inner != 0:
+        raise ValueError(
+            f"non-dp axes product {inner} does not divide slice size "
+            f"{topology.slice_size}: fsdp/ep/tp/sp/pp shards would span "
+            f"the DCN boundary and per-step collectives would leave ICI"
+        )
+    # inner | slice_size (+ the device-count check above) implies
+    # dp = num_slices * (slice_size // inner): slice boundaries always
+    # fall between fixed-dp blocks, never through a non-dp axis.
+    dev_array = np.asarray(devices).reshape(resolved.sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
 def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
     """Per-data-shard batch size on the current mesh."""
     data_extent = mesh.shape["dp"] * mesh.shape["fsdp"]

@@ -34,11 +34,14 @@ import jax.numpy as jnp
 __all__ = [
     "SamplingConfig",
     "build_generate_fn",
+    "decode_apply",
     "filter_logits",
     "generate",
     "init_cache",
     "left_pad_prompts",
+    "prefill_prompt",
     "sample_logits",
+    "sample_step",
 ]
 
 
@@ -143,6 +146,69 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def decode_apply(model, params, cache, tokens, positions, kv_valid):
+    """One decode-mode model application over an explicit cache pytree.
+
+    Returns (raw logits, updated cache). The single place the decode
+    contract (``decode=True, positions, kv_valid, mutable=["cache"]``)
+    is spelled, shared by the one-shot engine and the continuous-
+    batching scheduler — their token-exactness guarantee depends on
+    applying the model identically.
+    """
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        decode=True,
+        positions=positions,
+        kv_valid=kv_valid,
+        mutable=["cache"],
+    )
+    return logits, mut["cache"]
+
+
+def sample_step(last_logits, done, rng, s: SamplingConfig):
+    """One sampling decision: (token, emit mask, logprob, done').
+
+    ``done`` rows emit pad and are masked; an eos sample is emitted
+    (the eos token is kept) and marks the row done afterwards.
+    Logprobs are under the raw model distribution (RL behavior
+    logprobs). Shared by the one-shot and continuous engines.
+    """
+    tok = sample_logits(last_logits, rng, s.temperature, s.top_k, s.top_p)
+    logp = jax.nn.log_softmax(last_logits, axis=-1)
+    tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    tok = jnp.where(done, s.pad_id, tok)
+    emit_mask = ~done
+    if s.eos_id >= 0:
+        done = done | (tok == s.eos_id)
+    return tok, emit_mask, tok_logp, done
+
+
+def prefill_prompt(model, params, tokens, mask, batch_cache=None):
+    """Run a LEFT-padded [B, W] prompt through the model in decode mode
+    (one MXU-friendly pass), filling cache slots [0, W).
+
+    Returns ``(cache, last_logits[B,V] fp32, last_pos[B],
+    kv_valid[B,L])`` — everything a decode loop needs to start.
+    """
+    B, W = tokens.shape
+    L = model.config.max_seq_len
+    cache = batch_cache if batch_cache is not None else init_cache(model, B)
+    positions = jnp.maximum(
+        jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0
+    )
+    kv_valid = jnp.zeros((B, L), bool).at[:, :W].set(mask)
+    logits, cache = decode_apply(
+        model, params, cache, tokens, positions, kv_valid
+    )
+    return (
+        cache,
+        logits[:, -1].astype(jnp.float32),
+        positions[:, -1],
+        kv_valid,
+    )
+
+
 def build_generate_fn(
     model,
     sampling: SamplingConfig,
@@ -177,29 +243,6 @@ def build_generate_fn(
             f"exceeds max_seq_len {max_len}"
         )
 
-    def _apply(params, cache, tokens, positions, kv_valid):
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            tokens,
-            decode=True,
-            positions=positions,
-            kv_valid=kv_valid,
-            mutable=["cache"],
-        )
-        return logits, mut["cache"]
-
-    def _sample(last_logits, done, rng):
-        """One sampling decision: (token, emit mask, logprob, done')."""
-        tok = sample_logits(
-            last_logits, rng, s.temperature, s.top_k, s.top_p
-        )
-        logp = jax.nn.log_softmax(last_logits, axis=-1)
-        tok_logp = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
-        tok = jnp.where(done, s.pad_id, tok)
-        emit_mask = ~done
-        done = done | (tok == s.eos_id) if s.eos_id >= 0 else done
-        return tok, emit_mask, tok_logp, done
-
     def _generate(params, prompt_tokens, prompt_mask, rng):
         B, T0 = prompt_tokens.shape
         if T0 != prompt_width:
@@ -210,21 +253,9 @@ def build_generate_fn(
                 f"prompt_tokens width {T0} != built prompt_width "
                 f"{prompt_width}"
             )
-        cache = init_cache(model, B)
-
-        # absolute positions of prompt tokens (pads clipped to 0 — their
-        # k/v are masked out of every attention anyway)
-        positions = jnp.maximum(
-            jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
+        cache, last_logits, cur_pos, kv_valid = prefill_prompt(
+            model, params, prompt_tokens, prompt_mask
         )
-        kv_valid = jnp.zeros((B, max_len), bool)
-        kv_valid = kv_valid.at[:, :T0].set(prompt_mask)
-
-        logits, cache = _apply(
-            params, cache, prompt_tokens, positions, kv_valid
-        )
-        last_logits = logits[:, -1].astype(jnp.float32)
-        cur_pos = positions[:, -1]  # last real position per row
 
         # N tokens need N-1 incremental forwards (the prefill supplied
         # the first logits, the last sampled token is never fed back) —
@@ -232,14 +263,17 @@ def build_generate_fn(
         def step(carry, t):
             cache, kv_valid, last_logits, cur_pos, done, rng = carry
             rng, sub = jax.random.split(rng)
-            tok, emit_mask, tok_logp, done = _sample(last_logits, done, sub)
+            tok, emit_mask, tok_logp, done = sample_step(
+                last_logits, done, sub, s
+            )
 
             slot = T0 + t
             kv_valid = kv_valid | (
                 jnp.arange(max_len)[None, :] == slot
             )
             pos = cur_pos + 1
-            logits, cache = _apply(
+            logits, cache = decode_apply(
+                model,
                 params,
                 cache,
                 tok[:, None],
@@ -262,8 +296,8 @@ def build_generate_fn(
             step, carry, jnp.arange(s.max_new_tokens - 1)
         )
         _, _, last_logits, _, done, rng = carry
-        tok_n, emit_n, logp_n, _ = _sample(
-            last_logits, done, jax.random.split(rng)[1]
+        tok_n, emit_n, logp_n, _ = sample_step(
+            last_logits, done, jax.random.split(rng)[1], s
         )
         # scan stacks on axis 0 → [N-1, B]; append the final sample
         toks = jnp.concatenate([toks.T, tok_n[:, None]], axis=1)

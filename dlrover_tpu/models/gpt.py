@@ -32,6 +32,13 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     use_remat: bool = True  # jax.checkpoint each block: HBM for FLOPs
+    # Remat aggressiveness when use_remat: "nothing" recomputes the
+    # whole block in backward (min HBM, ~1 extra fwd of FLOPs); "dots"
+    # saves matmul outputs and recomputes only elementwise ops (middle
+    # ground — the MXU work is NOT redone, only VPU ops are). With
+    # fused-CE freeing the logits HBM, "dots" (or use_remat=False) can
+    # buy back most of the remat FLOPs at the headline batch.
+    remat_policy: str = "nothing"  # "nothing" | "dots"
     # >0: when targets are passed to __call__, compute per-token CE
     # inside the model over seq chunks of this size — the [B,T,V] fp32
     # logits (the HBM ceiling: 6.6 GB at bs=32/seq=1024/vocab=50k)
@@ -360,10 +367,20 @@ class GPT(nn.Module):
         # stay plainly mutable, so bypass it. The decode kwargs must not
         # cross nn.remat either — jax.checkpoint would trace the bool.
         if cfg.use_remat and not decode:
+            policies = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.dots_saveable,
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; "
+                    f"expected one of {sorted(policies)}"
+                )
+            policy = policies[cfg.remat_policy]
             block = nn.remat(
                 Block,
                 prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=policy,
             )
             for i in range(cfg.num_layers):
                 x = block(cfg, name=f"block_{i}")(

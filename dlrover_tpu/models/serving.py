@@ -1,0 +1,382 @@
+"""Continuous batching over the generation engine, TPU-first.
+
+The reference serves RL rollouts through vLLM (continuous batching +
+paged KV — examples/unified/rl/openrlhf/ppo/main.py:26-60). This
+module is that capability over the repo's own engine: a request-queue
+scheduler that admits new prompts into freed batch slots while other
+rows keep decoding, so a rollout role serving a mixed-length prompt
+stream does not pay worst-case padding in every batch
+(VERDICT r4 #5).
+
+TPU shape — every device program is static-shape and compiled once:
+
+- **Slot admission rides the hole-slot contract.** The decode cache
+  writes all rows at one shared frontier slot (gpt._update_decode_cache
+  — a single ``dynamic_update_slice``, never a per-row scatter). A new
+  request's prompt is prefilled into a fresh single-row cache at slots
+  ``[0, Pw)`` and the whole row is inserted into the batch cache; the
+  gap ``[Pw, frontier)`` is simply ``kv_valid=False`` — the same
+  hole-slot pattern speculative decoding already proves token-exact
+  (positions count only valid slots, so RoPE/posembs never see the
+  holes).
+- **Decode runs in chunks**: a ``lax.scan`` of ``decode_chunk`` steps
+  per scheduler iteration, so the host pays one dispatch + one result
+  fetch per chunk, not per token (the tunnel RTT is the cost model).
+- **Compaction instead of paging.** The shared frontier advances one
+  slot per step for the whole batch, so slots are a stream-wide budget.
+  When headroom runs out, the scheduler re-prefills every live row's
+  full history (prompt + emitted tokens, all host-known) into a fresh
+  cache — one batched MXU-friendly forward — and the frontier drops to
+  the longest live history. Width-bucketed to bound recompiles.
+- **Weight hot-swap between chunks**: ``set_params`` replaces the
+  parameter argument of the jitted programs (same shapes — no
+  recompile), so a WeightBus push lands at the next chunk boundary;
+  ``swap_latency_s`` of the last swap is recorded.
+
+Liveness: ``aligned(prompt_width + max_new_tokens) +
+max(max_new_tokens, decode_chunk) <= max_seq_len`` so that after the
+worst-case compaction (frontier at the aligned longest possible
+history) the next chunk still fits the cache and a freed slot can
+still admit a full request.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .generation import (
+    SamplingConfig,
+    decode_apply,
+    init_cache,
+    left_pad_prompts,
+    prefill_prompt,
+    sample_step,
+)
+
+__all__ = ["ContinuousBatchingEngine", "Completion"]
+
+
+@dataclass
+class Completion:
+    uid: int
+    tokens: List[int]
+    logprobs: List[float]
+
+
+@dataclass
+class _Slot:
+    uid: int = -1  # -1 = empty
+    prompt: List[int] = field(default_factory=list)
+    emitted: List[int] = field(default_factory=list)
+    logprobs: List[float] = field(default_factory=list)
+    finished: bool = False  # EOS seen (device done flag)
+
+
+class ContinuousBatchingEngine:
+    """Serve a stream of prompts through ``batch_size`` decode slots.
+
+    ``submit(tokens)`` enqueues a request and returns its uid;
+    ``run()`` drives the scheduler until queue and slots drain,
+    returning ``Completion``s. Greedy output is token-exact with
+    :func:`generation.build_generate_fn` on the same prompt — the
+    keystone test (admission holes and compaction are invisible to the
+    math).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        sampling: SamplingConfig,
+        batch_size: int,
+        prompt_width: int,
+        decode_chunk: int = 8,
+    ):
+        cfg = model.config
+        L = cfg.max_seq_len
+        # Liveness: the worst compacted frontier is the aligned longest
+        # possible history (prompt + full budget); after it there must
+        # still be room for a whole request's decode AND for the next
+        # chunk's writes — otherwise compaction can strand the stream
+        # (or the chunk would write past the cache end, which
+        # dynamic_update_slice silently CLAMPS into valid slots).
+        worst = self._align(prompt_width + sampling.max_new_tokens)
+        need = worst + max(sampling.max_new_tokens, decode_chunk)
+        if need > L:
+            raise ValueError(
+                f"continuous batching liveness: aligned(prompt_width + "
+                f"max_new_tokens) + max(max_new_tokens, decode_chunk) = "
+                f"{need} > max_seq_len {L}"
+            )
+        self.model = model
+        self.params = params
+        self.s = sampling
+        self.B = batch_size
+        self.Pw = prompt_width
+        self.L = L
+        self.d = decode_chunk
+        self.swap_latency_s: Optional[float] = None
+        self._uid = 0
+        self._queue: List[tuple] = []  # (uid, tokens)
+        self._slots = [_Slot() for _ in range(batch_size)]
+        self._completions: List[Completion] = []
+        self._compact_fns: Dict[int, Callable] = {}
+        self._build_programs()
+        self._reset_device_state()
+
+    # -- device programs (compiled once each; the decode contract and
+    # sampling live in generation.py — token-exactness with the
+    # one-shot engine depends on sharing them, not mirroring them) ----
+
+    def _build_programs(self):
+        s, L, d = self.s, self.L, self.d
+        model = self.model
+
+        def prefill_row(params, toks, mask):
+            """[1, W] prompt → (row cache, last logits, last pos,
+            row kv_valid)."""
+            cache, last_logits, last_pos, kv_valid = prefill_prompt(
+                model, params, toks, mask
+            )
+            return cache, last_logits[0], last_pos[0], kv_valid[0]
+
+        def admit(state, row_cache, row_logits, row_pos, row_kv, slot):
+            """Insert a prefilled row at ``slot`` (traced — one compile
+            covers every slot). The batch cache's shared frontier scalar
+            is kept; the row's KV live at low slots, the gap up to the
+            frontier is kv_valid=False holes."""
+            cache, kv_valid, last_logits, cur_pos, done = state
+            cache = jax.tree_util.tree_map(
+                lambda b, r: (
+                    b  # shared scalars (write frontier) stay the batch's
+                    if b.ndim == 0
+                    else jax.lax.dynamic_update_slice(
+                        b, r.astype(b.dtype), (slot,) + (0,) * (b.ndim - 1)
+                    )
+                ),
+                cache,
+                row_cache,
+            )
+            return (
+                cache,
+                kv_valid.at[slot].set(row_kv),
+                last_logits.at[slot].set(row_logits),
+                cur_pos.at[slot].set(row_pos),
+                done.at[slot].set(False),
+            )
+
+        def decode_chunk(params, state, frontier, rng):
+            """d decode steps for the whole batch; returns stacked
+            (toks, emits, logps) [d, B] and the advanced state."""
+            cache, kv_valid, last_logits, cur_pos, done = state
+
+            def step(carry, t):
+                cache, kv_valid, last_logits, cur_pos, done, rng = carry
+                rng, sub = jax.random.split(rng)
+                tok, emit, tok_logp, done = sample_step(
+                    last_logits, done, sub, s
+                )
+                slot = frontier + t
+                kv_valid = kv_valid | (
+                    jnp.arange(L)[None, :] == slot
+                )
+                pos = cur_pos + 1
+                logits, cache = decode_apply(
+                    model, params, cache, tok[:, None], pos[:, None],
+                    kv_valid,
+                )
+                return (
+                    cache,
+                    kv_valid,
+                    logits[:, 0].astype(jnp.float32),
+                    pos,
+                    done,
+                    rng,
+                ), (tok, emit, tok_logp)
+
+            carry = (cache, kv_valid, last_logits, cur_pos, done, rng)
+            carry, out = jax.lax.scan(step, carry, jnp.arange(d))
+            cache, kv_valid, last_logits, cur_pos, done, _ = carry
+            return (cache, kv_valid, last_logits, cur_pos, done), out
+
+        self._prefill_fn = jax.jit(prefill_row)
+        self._admit_fn = jax.jit(admit)
+        self._chunk_fn = jax.jit(decode_chunk)
+
+        def compact(params, toks, mask):
+            """Batched re-prefill of every live row's history into a
+            fresh cache: frontier drops to the aligned width W."""
+            cache, last_logits, last_pos, kv_valid = prefill_prompt(
+                model, params, toks, mask
+            )
+            return cache, kv_valid, last_logits, last_pos
+
+        self._compact_src = compact
+
+    def _compact_for(self, width):
+        if width not in self._compact_fns:
+            self._compact_fns[width] = jax.jit(self._compact_src)
+        return self._compact_fns[width]
+
+    @staticmethod
+    def _set_cache_frontier(cache, f: int):
+        """Pin the cache's shared write-index scalars (one per layer).
+        Decode writes land at the frontier for EVERY row, so it must
+        never sit below prompt_width — admitted prompts' KV live at
+        slots [0, Pw) and would be overwritten."""
+        return jax.tree_util.tree_map(
+            lambda b: jnp.asarray(f, b.dtype) if b.ndim == 0 else b, cache
+        )
+
+    def _reset_device_state(self):
+        V = self.model.config.vocab_size
+        self._frontier = self.Pw  # decode writes start past prompt KV
+        self._state = (
+            self._set_cache_frontier(
+                init_cache(self.model, self.B), self._frontier
+            ),
+            jnp.zeros((self.B, self.L), bool),
+            jnp.full((self.B, V), -1e9, jnp.float32),
+            jnp.zeros((self.B,), jnp.int32),
+            jnp.ones((self.B,), bool),  # empty slots: done (emit pad)
+        )
+
+    # -- host scheduler -------------------------------------------------
+
+    def submit(self, tokens: List[int]) -> int:
+        if len(tokens) > self.Pw:
+            raise ValueError(
+                f"prompt length {len(tokens)} > prompt_width {self.Pw}"
+            )
+        uid = self._uid
+        self._uid += 1
+        self._queue.append((uid, list(tokens)))
+        return uid
+
+    def set_params(self, params) -> float:
+        """Hot-swap weights between chunks (same pytree shapes — no
+        recompile). Returns the swap latency: the time to make the new
+        params device-resident and adopted for the next chunk."""
+        t0 = time.perf_counter()
+        params = jax.device_put(params)
+        jax.block_until_ready(params)  # every leaf — not just the first
+        self.params = params
+        self.swap_latency_s = time.perf_counter() - t0
+        return self.swap_latency_s
+
+    def _pad_rows(self, rows: List[List[int]], width: int):
+        # generation.left_pad_prompts owns the padding convention
+        return left_pad_prompts(rows, pad_id=self.s.pad_id, width=width)
+
+    @staticmethod
+    def _align(n: int, unit: int = 16) -> int:
+        """Compaction width alignment: bounds the number of distinct
+        re-prefill program shapes to L/unit (one compile each, and
+        compactions are rare) WITHOUT the overshoot of power-of-two
+        bucketing, which could blow the liveness budget (a bucket can
+        nearly double the longest history)."""
+        return max(unit, ((n + unit - 1) // unit) * unit)
+
+    def _admit_one(self, slot: int, uid: int, prompt: List[int]):
+        toks, mask = self._pad_rows([prompt], self.Pw)
+        row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
+            self.params, toks, mask
+        )
+        self._state = self._admit_fn(
+            self._state, row_cache, row_logits, row_pos, row_kv,
+            jnp.int32(slot),
+        )
+        self._slots[slot] = _Slot(uid=uid, prompt=prompt)
+
+    def _retire(self, slot: int):
+        st = self._slots[slot]
+        if st.uid >= 0:
+            self._completions.append(
+                Completion(st.uid, st.emitted, st.logprobs)
+            )
+        self._slots[slot] = _Slot()
+        # silence the freed slot until the next admission
+        cache, kv_valid, last_logits, cur_pos, done = self._state
+        self._state = (
+            cache, kv_valid, last_logits, cur_pos,
+            done.at[slot].set(True),
+        )
+
+    def _compact(self):
+        """Rebuild the cache from live histories; frontier drops from
+        near-L to the longest live history's bucket width."""
+        rows = [
+            (st.prompt + st.emitted) if st.uid >= 0 else []
+            for st in self._slots
+        ]
+        width = self._align(max((len(r) for r in rows), default=1))
+        toks, mask = self._pad_rows(rows, width)
+        cache, kv_valid, last_logits, cur_pos = self._compact_for(width)(
+            self.params, toks, mask
+        )
+        _, _, _, _, done = self._state
+        # frontier never drops below Pw: future admissions put prompt
+        # KV at [0, Pw) and decode writes must stay clear of it
+        self._frontier = max(width, self.Pw)
+        cache = self._set_cache_frontier(cache, self._frontier)
+        self._state = (cache, kv_valid, last_logits, cur_pos, done)
+
+    def step(self, rng):
+        """One scheduler iteration: compact if out of headroom, admit
+        into free slots, decode one chunk, retire finished rows.
+        Returns the number of tokens emitted this chunk."""
+        if self._queue and all(st.uid < 0 for st in self._slots) and (
+            self._frontier > self.Pw
+        ):
+            # Nothing live but the frontier has advanced (admission may
+            # be budget-blocked): a fresh cache beats dispatching dead
+            # all-done chunks until the compaction threshold — each one
+            # is a full device round-trip that emits zero tokens.
+            self._reset_device_state()
+        if self._frontier + self.d > self.L:
+            self._compact()
+        # admission: fills empty slots while the budget allows
+        for slot, st in enumerate(self._slots):
+            if st.uid >= 0 or not self._queue:
+                continue
+            if self._frontier + self.s.max_new_tokens > self.L:
+                break  # no room for a full request until compaction
+            uid, prompt = self._queue.pop(0)
+            self._admit_one(slot, uid, prompt)
+
+        self._state, (toks, emits, logps) = self._chunk_fn(
+            self.params, self._state, jnp.int32(self._frontier), rng
+        )
+        self._frontier += self.d
+        toks, emits, logps, done = jax.device_get(
+            (toks, emits, logps, self._state[4])
+        )
+        emitted = 0
+        for slot, st in enumerate(self._slots):
+            if st.uid < 0:
+                continue
+            for t in range(self.d):
+                if len(st.emitted) >= self.s.max_new_tokens:
+                    break
+                if emits[t, slot]:
+                    st.emitted.append(int(toks[t, slot]))
+                    st.logprobs.append(float(logps[t, slot]))
+                    emitted += 1
+            st.finished = bool(done[slot])
+            if st.finished or len(st.emitted) >= self.s.max_new_tokens:
+                self._retire(slot)
+        return emitted
+
+    def run(self, prompts=None, rng=None) -> List[Completion]:
+        """Drive the scheduler until every queued request completes."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        for p in prompts or []:
+            self.submit(p)
+        while self._queue or any(st.uid >= 0 for st in self._slots):
+            rng, sub = jax.random.split(rng)
+            self.step(sub)
+        out, self._completions = self._completions, []
+        return sorted(out, key=lambda c: c.uid)

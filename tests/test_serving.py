@@ -1,0 +1,194 @@
+"""Continuous batching scheduler (models/serving.py).
+
+Keystone: greedy output through the slot-admission/compaction engine
+is token-exact with the plain one-shot engine on every request — the
+hole-slot admission and the compaction re-prefill must be invisible to
+the math. Plus the VERDICT r4 #5 done-criteria: a stream of N >> B
+mixed-length prompts sustains >= 0.8x the homogeneous-batch rate, and
+a mid-decode weight hot-swap has a measured latency and changes
+subsequent output.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models.generation import (
+    SamplingConfig,
+    build_generate_fn,
+    left_pad_prompts,
+)
+from dlrover_tpu.models.gpt import GPT, GPTConfig
+from dlrover_tpu.models.serving import ContinuousBatchingEngine
+
+
+def _model(seq=256):
+    return GPT(
+        GPTConfig(
+            vocab_size=64,
+            max_seq_len=seq,
+            num_layers=2,
+            num_heads=2,
+            head_dim=8,
+            embed_dim=16,
+            use_remat=False,
+        )
+    )
+
+
+def _params(model, seed=0):
+    return model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _reference_completions(model, params, prompts, sampling):
+    """Plain engine, one prompt at a time (no cross-prompt padding)."""
+    out = []
+    for p in prompts:
+        toks, mask = left_pad_prompts([p], pad_id=sampling.pad_id)
+        fn = build_generate_fn(model, sampling, prompt_width=toks.shape[1])
+        t, m, _ = fn(params, toks, mask, jax.random.PRNGKey(0))
+        t, m = np.asarray(t)[0], np.asarray(m)[0]
+        out.append([int(x) for x, keep in zip(t, m) if keep])
+    return out
+
+
+def _mixed_prompts(n, rng_seed=0, lo=3, hi=14, vocab=64):
+    r = np.random.default_rng(rng_seed)
+    return [
+        [int(x) for x in r.integers(1, vocab, r.integers(lo, hi))]
+        for _ in range(n)
+    ]
+
+
+class TestGreedyExactness:
+    def test_stream_matches_plain_decode(self):
+        """12 mixed-length prompts through 4 slots, greedy: every
+        completion equals the plain engine's on that prompt."""
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=10, temperature=0.0)
+        prompts = _mixed_prompts(12)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=4, prompt_width=16,
+            decode_chunk=4,
+        )
+        got = eng.run(prompts)
+        assert [c.uid for c in got] == list(range(12))
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+            assert len(c.logprobs) == len(c.tokens)
+
+    def test_exactness_through_compaction(self):
+        """max_seq_len tight enough that the stream MUST compact
+        mid-flight; greedy parity must survive the re-prefill."""
+        model = _model(seq=48)  # Pw 16 + 2*N 16 = 48: liveness edge
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(10, rng_seed=3)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4,
+        )
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_eos_retires_slot_early(self):
+        """A model whose greedy output hits eos frees the slot before
+        max_new_tokens; the completion keeps the eos token."""
+        model = _model(seq=256)
+        params = _params(model)
+        base = SamplingConfig(max_new_tokens=12, temperature=0.0)
+        ref = _reference_completions(model, params, [[5, 9, 2]], base)[0]
+        eos = ref[2]  # force an early stop at the 3rd greedy token
+        sampling = SamplingConfig(
+            max_new_tokens=12, temperature=0.0, eos_id=eos
+        )
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=8,
+        )
+        (c,) = eng.run([[5, 9, 2]])
+        assert c.tokens == ref[: ref.index(eos) + 1]
+
+
+class TestThroughput:
+    def test_mixed_stream_within_80pct_of_homogeneous(self):
+        """VERDICT r4 #5 done-criterion: N >> B mixed-length prompts
+        through one engine sustain >= 0.8x the same engine's
+        homogeneous-batch tokens/s (same total decode work)."""
+        model = _model(seq=512)
+        params = _params(model)
+        N_TOK = 24
+        sampling = SamplingConfig(max_new_tokens=N_TOK, temperature=0.0)
+        B = 4
+
+        def run_engine(prompts):
+            eng = ContinuousBatchingEngine(
+                model, params, sampling, batch_size=B, prompt_width=16,
+                decode_chunk=8,
+            )
+            eng.run(prompts[:B])  # warmup: compiles prefill+chunk
+            t0 = time.perf_counter()
+            out = eng.run(prompts)
+            dt = time.perf_counter() - t0
+            return sum(len(c.tokens) for c in out) / dt
+
+        # homogeneous: every prompt identical length (no padding waste
+        # even in a static batch) — the best case continuous batching
+        # is allowed to approach
+        homog = [[7] * 12 for _ in range(24)]
+        mixed = _mixed_prompts(24, rng_seed=5, lo=3, hi=14)
+        rate_h = run_engine(homog)
+        rate_m = run_engine(mixed)
+        assert rate_m >= 0.8 * rate_h, (rate_m, rate_h)
+
+
+class TestWeightSwap:
+    def test_hot_swap_mid_decode(self):
+        """WeightBus-style swap between chunks: measured latency, and
+        the swapped weights actually take effect (output diverges from
+        the unswapped run after the swap point)."""
+        model = _model(seq=256)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=16, temperature=0.0)
+
+        def run(swap):
+            eng = ContinuousBatchingEngine(
+                model, p1, sampling, batch_size=2, prompt_width=8,
+                decode_chunk=4,
+            )
+            eng.submit([5, 9, 2])
+            rng = jax.random.PRNGKey(0)
+            lat = None
+            for i in range(64):
+                rng, sub = jax.random.split(rng)
+                eng.step(sub)
+                if i == 1 and swap:
+                    lat = eng.set_params(p2)
+                if not any(s.uid >= 0 for s in eng._slots):
+                    break
+            (comp,) = eng._completions
+            return comp.tokens, comp.logprobs, lat
+
+        base_toks, base_lps, _ = run(swap=False)
+        swap_toks, swap_lps, lat = run(swap=True)
+        assert lat is not None and lat > 0
+        assert len(swap_toks) == len(base_toks) == 16
+        # first chunk (4 tokens, sampled before the swap) agrees ...
+        assert swap_toks[:4] == base_toks[:4]
+        np.testing.assert_allclose(
+            swap_lps[:4], base_lps[:4], rtol=1e-5, atol=1e-6
+        )
+        # ... and the post-swap tail runs under DIFFERENT weights:
+        # greedy argmax of a degenerate tiny model may coincide, but the
+        # logprobs cannot
+        assert not np.allclose(
+            swap_lps[4:], base_lps[4:], rtol=1e-3, atol=1e-4
+        )

@@ -184,16 +184,16 @@ def sample_step(last_logits, done, rng, s: SamplingConfig):
     return tok, emit_mask, tok_logp, done
 
 
-def prefill_prompt(model, params, tokens, mask, batch_cache=None):
+def prefill_prompt(model, params, tokens, mask):
     """Run a LEFT-padded [B, W] prompt through the model in decode mode
-    (one MXU-friendly pass), filling cache slots [0, W).
+    (one MXU-friendly pass), filling a FRESH cache's slots [0, W).
 
     Returns ``(cache, last_logits[B,V] fp32, last_pos[B],
     kv_valid[B,L])`` — everything a decode loop needs to start.
     """
     B, W = tokens.shape
     L = model.config.max_seq_len
-    cache = batch_cache if batch_cache is not None else init_cache(model, B)
+    cache = init_cache(model, B)
     positions = jnp.maximum(
         jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0
     )

@@ -94,7 +94,17 @@ class ContinuousBatchingEngine:
         batch_size: int,
         prompt_width: int,
         decode_chunk: int = 8,
+        mesh=None,
+        rules=None,
     ):
+        """With ``mesh`` (+ optional logical-axis ``rules``) every
+        device program runs SPMD over it: pass params already placed in
+        their trainer shardings (tp/fsdp) and the whole engine serves a
+        model bigger than one chip — same scheduler, XLA inserts the
+        decode collectives. The stream state rides the batch axis
+        REPLICATED (serve-mesh convention: scale batch by running one
+        engine per data shard; the mesh scales the MODEL), so use
+        tp/fsdp axes only."""
         cfg = model.config
         L = cfg.max_seq_len
         # Liveness: the worst compacted frontier is the aligned longest
@@ -114,6 +124,8 @@ class ContinuousBatchingEngine:
         self.model = model
         self.params = params
         self.s = sampling
+        self.mesh = mesh
+        self.rules = rules
         self.B = batch_size
         self.Pw = prompt_width
         self.L = L
@@ -216,6 +228,23 @@ class ContinuousBatchingEngine:
 
         self._compact_src = compact
 
+    def _ctx(self):
+        """Mesh + logical-rule contexts around every device call in
+        SPMD mode (sharding constraints resolve at trace time, the mesh
+        must be active at call time); no-op single-device."""
+        import contextlib
+
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from ..parallel.mesh import current_mesh
+        from ..parallel.sharding import apply_rules
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.mesh)
+        stack.enter_context(apply_rules(self.rules))
+        stack.enter_context(current_mesh(self.mesh))
+        return stack
+
     def _compact_for(self, width):
         if width not in self._compact_fns:
             self._compact_fns[width] = jax.jit(self._compact_src)
@@ -261,7 +290,17 @@ class ContinuousBatchingEngine:
         recompile). Returns the swap latency: the time to make the new
         params device-resident and adopted for the next chunk."""
         t0 = time.perf_counter()
-        params = jax.device_put(params)
+        # Preserve each leaf's existing placement: a WeightBus push
+        # delivers HOST arrays, and a bare device_put would commit them
+        # to one device — collapsing tp/fsdp-sharded serving onto a
+        # single chip and forcing a recompile.
+        try:
+            spec = jax.tree_util.tree_map(
+                lambda x: x.sharding, self.params
+            )
+        except AttributeError:  # engine was built with host arrays
+            spec = None
+        params = jax.device_put(params, spec)
         jax.block_until_ready(params)  # every leaf — not just the first
         self.params = params
         self.swap_latency_s = time.perf_counter() - t0
@@ -282,13 +321,14 @@ class ContinuousBatchingEngine:
 
     def _admit_one(self, slot: int, uid: int, prompt: List[int]):
         toks, mask = self._pad_rows([prompt], self.Pw)
-        row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
-            self.params, toks, mask
-        )
-        self._state = self._admit_fn(
-            self._state, row_cache, row_logits, row_pos, row_kv,
-            jnp.int32(slot),
-        )
+        with self._ctx():
+            row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
+                self.params, toks, mask
+            )
+            self._state = self._admit_fn(
+                self._state, row_cache, row_logits, row_pos, row_kv,
+                jnp.int32(slot),
+            )
         self._slots[slot] = _Slot(uid=uid, prompt=prompt)
 
     def _retire(self, slot: int):
@@ -314,9 +354,10 @@ class ContinuousBatchingEngine:
         ]
         width = self._align(max((len(r) for r in rows), default=1))
         toks, mask = self._pad_rows(rows, width)
-        cache, kv_valid, last_logits, cur_pos = self._compact_for(width)(
-            self.params, toks, mask
-        )
+        with self._ctx():
+            cache, kv_valid, last_logits, cur_pos = self._compact_for(
+                width
+            )(self.params, toks, mask)
         _, _, _, _, done = self._state
         # frontier never drops below Pw: future admissions put prompt
         # KV at [0, Pw) and decode writes must stay clear of it
@@ -347,9 +388,10 @@ class ContinuousBatchingEngine:
             uid, prompt = self._queue.pop(0)
             self._admit_one(slot, uid, prompt)
 
-        self._state, (toks, emits, logps) = self._chunk_fn(
-            self.params, self._state, jnp.int32(self._frontier), rng
-        )
+        with self._ctx():
+            self._state, (toks, emits, logps) = self._chunk_fn(
+                self.params, self._state, jnp.int32(self._frontier), rng
+            )
         self._frontier += self.d
         toks, emits, logps, done = jax.device_get(
             (toks, emits, logps, self._state[4])

@@ -49,15 +49,12 @@ class SpecConfig:
 
 
 def _apply_decode(model, params, cache, tokens, positions, kv_valid):
-    logits, mut = model.apply(
-        {"params": params, "cache": cache},
-        tokens,
-        decode=True,
-        positions=positions,
-        kv_valid=kv_valid,
-        mutable=["cache"],
+    from .generation import decode_apply
+
+    logits, cache = decode_apply(
+        model, params, cache, tokens, positions, kv_valid
     )
-    return logits.astype(jnp.float32), mut["cache"]
+    return logits.astype(jnp.float32), cache
 
 
 def _dist(logits, s: SamplingConfig):

@@ -150,6 +150,57 @@ class TestThroughput:
         assert rate_m >= 0.8 * rate_h, (rate_m, rate_h)
 
 
+class TestShardedServing:
+    def test_tp_sharded_stream_matches_single_device(self):
+        """The whole scheduler SPMD over a tp mesh with trainer-held
+        param shardings: greedy stream output token-exact with the
+        single-device engine (the serve-a-bigger-model shape)."""
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+        from dlrover_tpu.parallel.train_step import (
+            default_optimizer,
+            init_train_state,
+        )
+
+        model = _model(seq=256)
+        mesh = build_mesh(MeshConfig(dp=1, tp=2), jax.devices()[:2])
+        state, sh = init_train_state(
+            model, jnp.zeros((4, 8), jnp.int32), mesh, default_optimizer()
+        )
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(7, rng_seed=11)
+
+        eng_s = ContinuousBatchingEngine(
+            model, state.params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4, mesh=mesh,
+        )
+        got = eng_s.run(prompts)
+
+        host_params = jax.tree.map(jnp.asarray, jax.device_get(state.params))
+        eng_1 = ContinuousBatchingEngine(
+            model, host_params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4,
+        )
+        want = eng_1.run(prompts)
+        for c, w in zip(got, want):
+            assert c.tokens == w.tokens, (c.uid, c.tokens, w.tokens)
+
+        # a WeightBus push delivers HOST arrays; the swap must restore
+        # the tp shardings, not collapse the model onto one device
+        host_push = jax.tree.map(
+            lambda x: np.asarray(x), jax.device_get(state.params)
+        )
+        lat = eng_s.set_params(host_push)
+        assert lat > 0
+        shardings = {
+            str(leaf.sharding)
+            for leaf in jax.tree.leaves(eng_s.params)
+        }
+        assert any("tp" in s for s in shardings), shardings
+        got2 = eng_s.run(prompts)
+        for c, w in zip(got2, want):
+            assert c.tokens == w.tokens
+
+
 class TestWeightSwap:
     def test_hot_swap_mid_decode(self):
         """WeightBus-style swap between chunks: measured latency, and

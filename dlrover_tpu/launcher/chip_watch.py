@@ -135,6 +135,16 @@ def probe_child():
 # ---------------------------------------------------------------------------
 
 
+def _seam_cmd(env_name, default_argv):
+    """Command override from the environment (test seam): shlex rules
+    so quoted/space-containing tokens survive; blank → default."""
+    import shlex
+
+    raw = os.environ.get(env_name, "")
+    argv = shlex.split(raw)
+    return argv or default_argv
+
+
 def _free_port():
     import socket
 
@@ -228,10 +238,16 @@ def run_probe(timeout_s, keep_on_timeout=False):
         pass
     out_path = f"/tmp/chip_probe_{os.getpid()}.out"
     t0 = time.time()
+    # test seam: substitute the probe child (e.g. a script that prints
+    # the phase marks, or one that wedges on purpose)
+    cmd = _seam_cmd(
+        "DLROVER_CHIPWATCH_PROBE_CMD",
+        [sys.executable, "-m", "dlrover_tpu.launcher.chip_watch",
+         "--probe-child"],
+    )
     with open(out_path, "w") as out_f:
         proc = subprocess.Popen(
-            [sys.executable, "-m", "dlrover_tpu.launcher.chip_watch",
-             "--probe-child"],
+            cmd,
             env=_probe_env(ns, dump_dir, port),
             stdout=out_f,
             stderr=subprocess.STDOUT,
@@ -338,10 +354,14 @@ def capture_silicon(log_path, bench_timeout):
     env = dict(os.environ)
     env["DLROVER_BENCH_STORM"] = "0"  # storm is CPU-driven; save the window
     env.setdefault("DLROVER_BENCH_PROBE_WINDOW_S", "300")
+    bench_cmd = _seam_cmd(
+        "DLROVER_CHIPWATCH_BENCH_CMD",
+        [sys.executable, os.path.join(REPO, "bench.py")],
+    )
     t0 = time.time()
     try:
         p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
+            bench_cmd,
             env=env,
             capture_output=True,
             text=True,

@@ -64,6 +64,10 @@ class Completion:
     uid: int
     tokens: List[int]
     logprobs: List[float]
+    # per-request service metrics (host wall-clock)
+    queue_s: float = 0.0  # submit → slot admission
+    ttft_s: float = 0.0  # admission → first emitted token
+    total_s: float = 0.0  # admission → retirement
 
 
 @dataclass
@@ -73,6 +77,9 @@ class _Slot:
     emitted: List[int] = field(default_factory=list)
     logprobs: List[float] = field(default_factory=list)
     finished: bool = False  # EOS seen (device done flag)
+    submit_t: float = 0.0
+    admit_t: float = 0.0
+    first_tok_t: float = 0.0
 
 
 class ContinuousBatchingEngine:
@@ -132,7 +139,7 @@ class ContinuousBatchingEngine:
         self.d = decode_chunk
         self.swap_latency_s: Optional[float] = None
         self._uid = 0
-        self._queue: List[tuple] = []  # (uid, tokens)
+        self._queue: List[tuple] = []  # (uid, tokens, submit_t)
         self._slots = [_Slot() for _ in range(batch_size)]
         self._completions: List[Completion] = []
         self._compact_fns: Dict[int, Callable] = {}
@@ -282,7 +289,7 @@ class ContinuousBatchingEngine:
             )
         uid = self._uid
         self._uid += 1
-        self._queue.append((uid, list(tokens)))
+        self._queue.append((uid, list(tokens), time.perf_counter()))
         return uid
 
     def set_params(self, params) -> float:
@@ -319,7 +326,9 @@ class ContinuousBatchingEngine:
         nearly double the longest history)."""
         return max(unit, ((n + unit - 1) // unit) * unit)
 
-    def _admit_one(self, slot: int, uid: int, prompt: List[int]):
+    def _admit_one(
+        self, slot: int, uid: int, prompt: List[int], submit_t: float
+    ):
         toks, mask = self._pad_rows([prompt], self.Pw)
         with self._ctx():
             row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
@@ -329,13 +338,24 @@ class ContinuousBatchingEngine:
                 self._state, row_cache, row_logits, row_pos, row_kv,
                 jnp.int32(slot),
             )
-        self._slots[slot] = _Slot(uid=uid, prompt=prompt)
+        self._slots[slot] = _Slot(
+            uid=uid, prompt=prompt, submit_t=submit_t,
+            admit_t=time.perf_counter(),
+        )
 
     def _retire(self, slot: int):
         st = self._slots[slot]
         if st.uid >= 0:
+            now = time.perf_counter()
             self._completions.append(
-                Completion(st.uid, st.emitted, st.logprobs)
+                Completion(
+                    st.uid, st.emitted, st.logprobs,
+                    queue_s=max(st.admit_t - st.submit_t, 0.0),
+                    ttft_s=max(
+                        (st.first_tok_t or now) - st.admit_t, 0.0
+                    ),
+                    total_s=max(now - st.admit_t, 0.0),
+                )
             )
         self._slots[slot] = _Slot()
         # silence the freed slot until the next admission
@@ -385,8 +405,8 @@ class ContinuousBatchingEngine:
                 continue
             if self._frontier + self.s.max_new_tokens > self.L:
                 break  # no room for a full request until compaction
-            uid, prompt = self._queue.pop(0)
-            self._admit_one(slot, uid, prompt)
+            uid, prompt, submit_t = self._queue.pop(0)
+            self._admit_one(slot, uid, prompt, submit_t)
 
         with self._ctx():
             self._state, (toks, emits, logps) = self._chunk_fn(
@@ -404,6 +424,8 @@ class ContinuousBatchingEngine:
                 if len(st.emitted) >= self.s.max_new_tokens:
                     break
                 if emits[t, slot]:
+                    if not st.emitted:
+                        st.first_tok_t = time.perf_counter()
                     st.emitted.append(int(toks[t, slot]))
                     st.logprobs.append(float(logps[t, slot]))
                     emitted += 1
@@ -412,12 +434,26 @@ class ContinuousBatchingEngine:
                 self._retire(slot)
         return emitted
 
+    @property
+    def pending(self) -> bool:
+        """True while any request is queued or decoding — the public
+        drain condition for callers driving step() themselves (e.g. to
+        land a weight swap mid-stream)."""
+        return bool(self._queue) or any(
+            st.uid >= 0 for st in self._slots
+        )
+
+    def drain_completions(self) -> List[Completion]:
+        """Hand over (and clear) finished requests, uid-ordered."""
+        out, self._completions = self._completions, []
+        return sorted(out, key=lambda c: c.uid)
+
     def run(self, prompts=None, rng=None) -> List[Completion]:
         """Drive the scheduler until every queued request completes."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for p in prompts or []:
             self.submit(p)
-        while self._queue or any(st.uid >= 0 for st in self._slots):
+        while self.pending:
             rng, sub = jax.random.split(rng)
             self.step(sub)
         out, self._completions = self._completions, []

@@ -83,6 +83,12 @@ class TestGreedyExactness:
         for c, w in zip(got, want):
             assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
             assert len(c.logprobs) == len(c.tokens)
+            # service metrics: first token can't precede admission and
+            # can't come after retirement; queue wait is non-negative
+            assert 0.0 <= c.ttft_s <= c.total_s
+            assert c.queue_s >= 0.0
+        # later uids waited in the queue behind a full batch
+        assert got[-1].queue_s > got[0].queue_s
 
     def test_exactness_through_compaction(self):
         """max_seq_len tight enough that the stream MUST compact
@@ -223,9 +229,9 @@ class TestWeightSwap:
                 eng.step(sub)
                 if i == 1 and swap:
                     lat = eng.set_params(p2)
-                if not any(s.uid >= 0 for s in eng._slots):
+                if not eng.pending:
                     break
-            (comp,) = eng._completions
+            (comp,) = eng.drain_completions()
             return comp.tokens, comp.logprobs, lat
 
         base_toks, base_lps, _ = run(swap=False)

@@ -90,6 +90,23 @@ class TestTrainToServe:
         )
         np.testing.assert_array_equal(np.asarray(served), np.asarray(live))
 
+        # ... and through the continuous-batching scheduler: the same
+        # restored params serve a request stream, and each greedy
+        # completion matches the one-shot engine's output row
+        from dlrover_tpu.models.serving import ContinuousBatchingEngine
+
+        eng = ContinuousBatchingEngine(
+            model2, restored.params, sampling, batch_size=2,
+            prompt_width=8, decode_chunk=4,
+        )
+        comps = eng.run([[5, 9], [3]])
+        assert [c.uid for c in comps] == [0, 1]  # nothing dropped
+        live_np = np.asarray(live)
+        for i, c in enumerate(comps):
+            assert c.tokens == [int(t) for t in live_np[i]], (
+                i, c.tokens, live_np[i]
+            )
+
     def test_orbax_export_feeds_generation(self, tmp_path):
         """The Orbax-interop artifact serves too: a consumer with only
         stock orbax (no dlrover_tpu checkpoint engine) restores the

@@ -34,8 +34,8 @@ def fake_repo(tmp_path, monkeypatch):
     return repo
 
 
-def _child_script(tmp_path, body):
-    p = tmp_path / "child.py"
+def _child_script(tmp_path, body, name="child.py"):
+    p = tmp_path / name  # distinct names: one tmp_path can host several
     p.write_text(textwrap.dedent(body))
     return f"{sys.executable} {p}"
 
@@ -125,7 +125,9 @@ class TestCaptureSilicon:
                 "extra": {"device": device, "mfu": 0.55},
             }
         )
-        return _child_script(tmp_path, f"print({line!r})")
+        return _child_script(
+            tmp_path, f"print({line!r})", name="bench_child.py"
+        )
 
     def test_silicon_result_commits_artifact_and_latest(
         self, tmp_path, monkeypatch, fake_repo
@@ -166,3 +168,66 @@ class TestCaptureSilicon:
         assert not (fake_repo / "SILICON_LATEST.json").exists()
         arts = [f for f in os.listdir(fake_repo) if f.startswith("SILICON_")]
         assert arts  # raw record of the attempt is kept
+
+
+class TestMainLoop:
+    def test_once_wedge_commits_diagnosis(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """main(--once) against a wedging probe: the classified
+        diagnosis artifact + LATEST pointer land in the repo."""
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_PROBE_CMD",
+            _child_script(tmp_path, "import time; time.sleep(120)"),
+        )
+        log = tmp_path / "w.jsonl"
+        chip_watch.main(
+            [
+                "--once", "--probe-timeout", "3", "--log", str(log),
+                # isolate from a real watcher's pause file on this host
+                "--pause-file", str(tmp_path / "pause"),
+            ]
+        )
+        arts = [
+            f for f in os.listdir(fake_repo)
+            if f.startswith("HANG_DIAGNOSIS_")
+        ]
+        assert "HANG_DIAGNOSIS_LATEST.json" in arts
+        assert any(f != "HANG_DIAGNOSIS_LATEST.json" for f in arts)
+        latest = json.load(open(fake_repo / "HANG_DIAGNOSIS_LATEST.json"))
+        assert latest["phase"] == "none"
+        msg = subprocess.run(
+            ["git", "log", "-1", "--format=%s"],
+            cwd=fake_repo, capture_output=True, text=True,
+        ).stdout
+        assert "hang diagnosis" in msg
+        events = [json.loads(l) for l in open(log)]
+        assert any("hang_diagnosis" in e for e in events)
+
+    def test_once_alive_probe_captures_silicon(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """main(--once) with an alive probe: the full bench runs and
+        the silicon artifact + LATEST summary are committed."""
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_PROBE_CMD",
+            _child_script(tmp_path, 'print("PROBE_OK tpu")'),
+        )
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD",
+            TestCaptureSilicon._bench_cmd(
+                TestCaptureSilicon(), tmp_path, "TPU_v5e"
+            ),
+        )
+        log = tmp_path / "w.jsonl"
+        chip_watch.main(
+            [
+                "--once", "--probe-timeout", "10", "--log", str(log),
+                "--pause-file", str(tmp_path / "pause"),
+            ]
+        )
+        assert (fake_repo / "SILICON_LATEST.json").exists()
+        latest = json.load(open(fake_repo / "SILICON_LATEST.json"))
+        assert latest["value"] == 123456.0 and latest["device"] == "TPU_v5e"
+        events = [json.loads(l) for l in open(log)]
+        assert any(e.get("on_silicon") for e in events)

@@ -1,0 +1,405 @@
+"""``tpurun-serve`` — HTTP rollout server over the continuous engine.
+
+The reference's serving story is "deploy vLLM next to the trainer"
+(examples/unified/rl/openrlhf/ppo/main.py:26-60 upstream); this is the
+TPU-native equivalent in one process: restore params from a flash
+checkpoint (zero format conversion — the trainer's pytree IS the
+serving pytree), stand up the continuous-batching scheduler
+(models/serving.py), and serve completions over HTTP:
+
+    POST /v1/completions        {"prompt": [ids...]}        → completion
+    POST /v1/weights/reload     {}                          → hot-swap from
+                                                              the ckpt dir
+    GET  /healthz                                           → stats
+
+The engine is single-threaded by design (one driver thread owns every
+device call); HTTP handler threads talk to it through an inbox of
+futures, so concurrent requests batch into the engine's decode slots
+naturally — that IS continuous batching.
+
+Run (CPU smoke):
+    tpurun-serve --cpu --port 8311
+    curl -d '{"prompt": [5, 9, 2]}' localhost:8311/v1/completions
+"""
+
+import argparse
+import json
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..common.log import logger
+
+__all__ = ["ServingDaemon", "main"]
+
+
+class ServingDaemon:
+    """Driver thread that owns a ContinuousBatchingEngine: requests and
+    weight swaps arrive through a thread-safe inbox, completions resolve
+    futures. Start/stop lifecycle; safe to call from many threads."""
+
+    def __init__(self, engine, rng_seed: int = 0):
+        import jax
+
+        self.eng = engine
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._inbox: "queue.Queue[tuple]" = queue.Queue()
+        self._waiters = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self.served = 0
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-driver", daemon=True
+        )
+
+    def start(self) -> "ServingDaemon":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+        self._fail_all(RuntimeError("serving daemon stopped"))
+
+    # -- client surface (any thread) -----------------------------------
+
+    def _submit_item(self, kind: str, payload, timeout: float):
+        if self._stop.is_set():
+            # the loop is gone; an enqueued future would never resolve
+            raise RuntimeError("serving daemon stopped")
+        fut: Future = Future()
+        self._inbox.put((kind, payload, fut))
+        return fut.result(timeout)
+
+    def complete(self, prompt, timeout: float = 300.0):
+        """Submit one prompt; block until its Completion arrives."""
+        return self._submit_item("req", list(prompt), timeout)
+
+    def swap_params(self, params, timeout: float = 300.0) -> float:
+        """Hand new params to the driver; returns the measured swap
+        latency once the driver adopts them between chunks."""
+        return self._submit_item("params", params, timeout)
+
+    # -- driver thread --------------------------------------------------
+
+    def _drain_inbox(self, block: bool):
+        try:
+            item = self._inbox.get(timeout=0.1 if block else 0.0)
+        except queue.Empty:
+            return
+        while item is not None:
+            kind, payload, fut = item
+            try:
+                if kind == "req":
+                    uid = self.eng.submit(payload)
+                    with self._mu:
+                        self._waiters[uid] = fut
+                elif kind == "params":
+                    fut.set_result(self.eng.set_params(payload))
+            except Exception as e:  # noqa: BLE001 — per-request failure
+                fut.set_exception(e)
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                item = None
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Resolve every in-flight and queued future with ``exc`` — a
+        dead driver must fail fast, not leave clients blocking out
+        their timeouts against a server whose /healthz still says OK."""
+        with self._mu:
+            waiters, self._waiters = self._waiters, {}
+        for fut in waiters.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        while True:
+            try:
+                _, _, fut = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _loop(self):
+        import jax
+
+        while not self._stop.is_set():
+            try:
+                # when idle, block briefly on the inbox, don't spin
+                self._drain_inbox(block=not self.eng.pending)
+                if self.eng.pending:
+                    self._rng, sub = jax.random.split(self._rng)
+                    self.eng.step(sub)
+                for c in self.eng.drain_completions():
+                    with self._mu:
+                        fut = self._waiters.pop(c.uid, None)
+                    if fut is not None:
+                        fut.set_result(c)
+                        self.served += 1
+            except Exception as e:  # noqa: BLE001 — driver must not die silently
+                logger.exception("serving driver error: %s", e)
+                self._fail_all(RuntimeError(f"serving driver error: {e!r}"))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint restore + model construction
+# ---------------------------------------------------------------------------
+
+
+def _build_model(family: str, config: dict):
+    if family == "llama":
+        from ..models.llama import Llama, LlamaConfig
+
+        return Llama(LlamaConfig(**config))
+    from ..models.gpt import GPT, GPTConfig
+
+    return GPT(GPTConfig(**config))
+
+
+_RESTORE_LOCK = threading.Lock()
+
+
+def _restore_params(model, mesh, ckpt_dir: str):
+    """Flash-checkpoint → serving params (the trainer's pytree, no
+    conversion). Returns (step, params).
+
+    - Template uses a STATELESS optimizer: ``_restore_into_template``
+      only looks up the template's leaves, so skipping Adam moments in
+      the template skips allocating (and restoring) 2x params of
+      optimizer state the server would immediately discard.
+    - Runs under a serve-private IPC namespace: the engine's shm
+      segment is named per host rank within a namespace, and a
+      colocated TRAINER owns that name in the job's namespace — the
+      unlink here must never destroy the trainer's flash-checkpoint
+      channel. The lock serializes concurrent reload requests.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from ..checkpoint.engine import CheckpointEngine
+    from ..parallel.train_step import init_train_state
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with _RESTORE_LOCK:
+        template, _ = init_train_state(model, tokens, mesh, optax.sgd(0.0))
+        prev_ns = os.environ.get("DLROVER_IPC_NAMESPACE")
+        os.environ["DLROVER_IPC_NAMESPACE"] = f"tpurun_serve_{os.getpid()}"
+        engine = None
+        try:
+            engine = CheckpointEngine(ckpt_dir, mesh=mesh, standalone=True)
+            step, restored = engine.load(template)
+            if restored is None:
+                raise FileNotFoundError(
+                    f"no restorable checkpoint under {ckpt_dir}"
+                )
+            return step, restored.params
+        finally:
+            if engine is not None:
+                engine.shm.unlink()
+                engine.close()
+            if prev_ns is None:
+                os.environ.pop("DLROVER_IPC_NAMESPACE", None)
+            else:
+                os.environ["DLROVER_IPC_NAMESPACE"] = prev_ns
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+
+
+def _make_handler(daemon: ServingDaemon, reload_fn):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.debug("serve: " + fmt, *args)
+
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(n) if n else b""
+            return json.loads(raw) if raw.strip() else {}
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(
+                    200,
+                    {
+                        "served": daemon.served,
+                        "pending": daemon.eng.pending,
+                        "slots": daemon.eng.B,
+                        "prompt_width": daemon.eng.Pw,
+                        "max_new_tokens": daemon.eng.s.max_new_tokens,
+                    },
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                body = self._body()
+            except ValueError as e:
+                self._send(400, {"error": f"bad json: {e}"})
+                return
+            if self.path == "/v1/completions":
+                prompt = body.get("prompt")
+                if not isinstance(prompt, list) or not all(
+                    isinstance(t, int) for t in prompt
+                ):
+                    self._send(
+                        400, {"error": "prompt must be a list of token ids"}
+                    )
+                    return
+                try:
+                    c = daemon.complete(
+                        prompt, timeout=float(body.get("timeout", 300.0))
+                    )
+                except ValueError as e:  # client-side: bad prompt
+                    self._send(400, {"error": repr(e)[:200]})
+                    return
+                except Exception as e:  # noqa: BLE001 — server-side
+                    self._send(500, {"error": repr(e)[:200]})
+                    return
+                self._send(
+                    200,
+                    {
+                        "uid": c.uid,
+                        "tokens": c.tokens,
+                        "logprobs": c.logprobs,
+                        "queue_s": round(c.queue_s, 4),
+                        "ttft_s": round(c.ttft_s, 4),
+                        "total_s": round(c.total_s, 4),
+                    },
+                )
+            elif self.path == "/v1/weights/reload":
+                if reload_fn is None:
+                    self._send(
+                        400, {"error": "no --ckpt-dir to reload from"}
+                    )
+                    return
+                try:
+                    step, params = reload_fn()
+                    lat = daemon.swap_params(params)
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)[:200]})
+                    return
+                self._send(
+                    200, {"step": step, "swap_latency_s": round(lat, 4)}
+                )
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+def serve(daemon: ServingDaemon, port: int, reload_fn=None):
+    """Bind and return the HTTP server (caller runs serve_forever)."""
+    httpd = ThreadingHTTPServer(
+        ("0.0.0.0", port), _make_handler(daemon, reload_fn)
+    )
+    return httpd
+
+
+DEFAULT_CONFIG = dict(
+    vocab_size=256, max_seq_len=512, num_layers=2, num_heads=4,
+    head_dim=16, embed_dim=64, use_remat=False,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun-serve",
+        description="rollout/serving daemon over the continuous engine",
+    )
+    ap.add_argument("--family", choices=["gpt", "llama"], default="gpt")
+    ap.add_argument(
+        "--config", default="",
+        help="model config as JSON (kwargs of GPTConfig/LlamaConfig); "
+        "default is a small smoke model",
+    )
+    ap.add_argument("--ckpt-dir", default="", help="flash ckpt to restore")
+    ap.add_argument("--port", type=int, default=8311)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--prompt-width", type=int, default=64)
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--eos-id", type=int, default=-1)
+    ap.add_argument("--decode-chunk", type=int, default=8)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="pin the virtual CPU backend (local smoke)",
+    )
+    ns = ap.parse_args(argv)
+
+    if ns.cpu:
+        from ..common.platform import force_virtual_cpu
+
+        force_virtual_cpu(1)
+
+    import jax
+
+    from ..models.generation import SamplingConfig
+    from ..models.serving import ContinuousBatchingEngine
+    from ..parallel.mesh import MeshConfig, build_mesh
+
+    config = dict(DEFAULT_CONFIG if not ns.config else json.loads(ns.config))
+    model = _build_model(ns.family, config)
+    mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+
+    reload_fn = None
+    if ns.ckpt_dir:
+        reload_fn = lambda: _restore_params(  # noqa: E731
+            model, mesh, ns.ckpt_dir
+        )
+        step, params = reload_fn()
+        logger.info("restored checkpoint step %s from %s", step, ns.ckpt_dir)
+    else:
+        params = model.init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 8), jax.numpy.int32),
+        )["params"]
+        logger.warning("no --ckpt-dir: serving RANDOM weights (smoke mode)")
+
+    sampling = SamplingConfig(
+        max_new_tokens=ns.max_new_tokens,
+        temperature=ns.temperature,
+        top_k=ns.top_k,
+        top_p=ns.top_p,
+        eos_id=ns.eos_id,
+    )
+    engine = ContinuousBatchingEngine(
+        model, params, sampling,
+        batch_size=ns.batch_size,
+        prompt_width=ns.prompt_width,
+        decode_chunk=ns.decode_chunk,
+    )
+    daemon = ServingDaemon(engine).start()
+    httpd = serve(daemon, ns.port, reload_fn)
+    logger.info(
+        "tpurun-serve on :%s — %s slots × %s new tokens, prompt width %s",
+        httpd.server_address[1], ns.batch_size, ns.max_new_tokens,
+        ns.prompt_width,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,7 +14,8 @@ TPU shape — every device program is static-shape and compiled once:
   writes all rows at one shared frontier slot (gpt._update_decode_cache
   — a single ``dynamic_update_slice``, never a per-row scatter). A new
   request's prompt is prefilled into a fresh single-row cache at slots
-  ``[0, Pw)`` and the whole row is inserted into the batch cache; the
+  ``[0, W)`` (W = the smallest width bucket that fits it, at most Pw)
+  and the whole row is inserted into the batch cache; the
   gap ``[Pw, frontier)`` is simply ``kv_valid=False`` — the same
   hole-slot pattern speculative decoding already proves token-exact
   (positions count only valid slots, so RoPE/posembs never see the
@@ -262,7 +263,7 @@ class ContinuousBatchingEngine:
         """Pin the cache's shared write-index scalars (one per layer).
         Decode writes land at the frontier for EVERY row, so it must
         never sit below prompt_width — admitted prompts' KV live at
-        slots [0, Pw) and would be overwritten."""
+        slots [0, W) with W <= Pw and would be overwritten."""
         return jax.tree_util.tree_map(
             lambda b: jnp.asarray(f, b.dtype) if b.ndim == 0 else b, cache
         )
@@ -329,7 +330,16 @@ class ContinuousBatchingEngine:
     def _admit_one(
         self, slot: int, uid: int, prompt: List[int], submit_t: float
     ):
-        toks, mask = self._pad_rows([prompt], self.Pw)
+        # Bucketed prefill width: a 5-token prompt must not pay a
+        # [1, Pw] forward on a Pw=256 engine. jit re-specializes per
+        # shape, so the same program object serves every bucket (at
+        # most 3 compiles); KV beyond the bucket stays a hole, which
+        # the decode contract already masks.
+        width = self.Pw
+        for b in (max(8, self.Pw // 4), max(8, self.Pw // 2)):
+            if len(prompt) <= b < width:
+                width = b
+        toks, mask = self._pad_rows([prompt], width)
         with self._ctx():
             row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
                 self.params, toks, mask
@@ -380,7 +390,7 @@ class ContinuousBatchingEngine:
             )(self.params, toks, mask)
         _, _, _, _, done = self._state
         # frontier never drops below Pw: future admissions put prompt
-        # KV at [0, Pw) and decode writes must stay clear of it
+        # KV at [0, W<=Pw) and decode writes must stay clear of it
         self._frontier = max(width, self.Pw)
         cache = self._set_cache_frontier(cache, self._frontier)
         self._state = (cache, kv_valid, last_logits, cur_pos, done)

@@ -7,7 +7,8 @@ checkpoint (zero format conversion — the trainer's pytree IS the
 serving pytree), stand up the continuous-batching scheduler
 (models/serving.py), and serve completions over HTTP:
 
-    POST /v1/completions        {"prompt": [ids...]}        → completion
+    POST /v1/completions        {"prompt": [ids...],
+                                 "max_tokens": n?}          → completion
     POST /v1/weights/reload     {}                          → hot-swap from
                                                               the ckpt dir
     GET  /healthz                                           → stats
@@ -74,9 +75,13 @@ class ServingDaemon:
         self._inbox.put((kind, payload, fut))
         return fut.result(timeout)
 
-    def complete(self, prompt, timeout: float = 300.0):
+    def complete(
+        self, prompt, timeout: float = 300.0, max_new_tokens=None
+    ):
         """Submit one prompt; block until its Completion arrives."""
-        return self._submit_item("req", list(prompt), timeout)
+        return self._submit_item(
+            "req", (list(prompt), max_new_tokens), timeout
+        )
 
     def swap_params(self, params, timeout: float = 300.0) -> float:
         """Hand new params to the driver; returns the measured swap
@@ -94,7 +99,8 @@ class ServingDaemon:
             kind, payload, fut = item
             try:
                 if kind == "req":
-                    uid = self.eng.submit(payload)
+                    prompt, cap = payload
+                    uid = self.eng.submit(prompt, max_new_tokens=cap)
                     with self._mu:
                         self._waiters[uid] = fut
                 elif kind == "params":
@@ -259,9 +265,18 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         400, {"error": "prompt must be a list of token ids"}
                     )
                     return
+                max_tokens = body.get("max_tokens")
+                if max_tokens is not None and (
+                    isinstance(max_tokens, bool)
+                    or not isinstance(max_tokens, int)
+                ):
+                    self._send(400, {"error": "max_tokens must be int"})
+                    return
                 try:
                     c = daemon.complete(
-                        prompt, timeout=float(body.get("timeout", 300.0))
+                        prompt,
+                        timeout=float(body.get("timeout", 300.0)),
+                        max_new_tokens=max_tokens,
                     )
                 except ValueError as e:  # client-side: bad prompt
                     self._send(400, {"error": repr(e)[:200]})

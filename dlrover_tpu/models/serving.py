@@ -78,6 +78,7 @@ class _Slot:
     emitted: List[int] = field(default_factory=list)
     logprobs: List[float] = field(default_factory=list)
     finished: bool = False  # EOS seen (device done flag)
+    cap: int = 0  # this request's max_new_tokens (<= engine budget)
     submit_t: float = 0.0
     admit_t: float = 0.0
     first_tok_t: float = 0.0
@@ -140,7 +141,7 @@ class ContinuousBatchingEngine:
         self.d = decode_chunk
         self.swap_latency_s: Optional[float] = None
         self._uid = 0
-        self._queue: List[tuple] = []  # (uid, tokens, submit_t)
+        self._queue: List[tuple] = []  # (uid, tokens, submit_t, cap)
         self._slots = [_Slot() for _ in range(batch_size)]
         self._completions: List[Completion] = []
         self._compact_fns: Dict[int, Callable] = {}
@@ -283,14 +284,27 @@ class ContinuousBatchingEngine:
 
     # -- host scheduler -------------------------------------------------
 
-    def submit(self, tokens: List[int]) -> int:
+    def submit(
+        self, tokens: List[int], max_new_tokens: Optional[int] = None
+    ) -> int:
+        """Enqueue a request. ``max_new_tokens`` caps THIS request
+        below the engine budget (``sampling.max_new_tokens``, which
+        sized the cache) — a capped request retires its slot early."""
         if len(tokens) > self.Pw:
             raise ValueError(
                 f"prompt length {len(tokens)} > prompt_width {self.Pw}"
             )
+        cap = self.s.max_new_tokens
+        if max_new_tokens is not None:
+            if not 1 <= max_new_tokens <= cap:
+                raise ValueError(
+                    f"max_new_tokens {max_new_tokens} outside [1, {cap}] "
+                    f"(the engine's cache budget)"
+                )
+            cap = max_new_tokens
         uid = self._uid
         self._uid += 1
-        self._queue.append((uid, list(tokens), time.perf_counter()))
+        self._queue.append((uid, list(tokens), time.perf_counter(), cap))
         return uid
 
     def set_params(self, params) -> float:
@@ -328,7 +342,8 @@ class ContinuousBatchingEngine:
         return max(unit, ((n + unit - 1) // unit) * unit)
 
     def _admit_one(
-        self, slot: int, uid: int, prompt: List[int], submit_t: float
+        self, slot: int, uid: int, prompt: List[int], submit_t: float,
+        cap: int,
     ):
         # Bucketed prefill width: a 5-token prompt must not pay a
         # [1, Pw] forward on a Pw=256 engine. jit re-specializes per
@@ -349,7 +364,7 @@ class ContinuousBatchingEngine:
                 jnp.int32(slot),
             )
         self._slots[slot] = _Slot(
-            uid=uid, prompt=prompt, submit_t=submit_t,
+            uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
             admit_t=time.perf_counter(),
         )
 
@@ -413,10 +428,12 @@ class ContinuousBatchingEngine:
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
                 continue
-            if self._frontier + self.s.max_new_tokens > self.L:
-                break  # no room for a full request until compaction
-            uid, prompt, submit_t = self._queue.pop(0)
-            self._admit_one(slot, uid, prompt, submit_t)
+            # headroom gate uses the HEAD request's own cap: a short
+            # request can still slip in near the end of the cache
+            if self._frontier + self._queue[0][3] > self.L:
+                break  # no room for this request until compaction
+            uid, prompt, submit_t, cap = self._queue.pop(0)
+            self._admit_one(slot, uid, prompt, submit_t, cap)
 
         with self._ctx():
             self._state, (toks, emits, logps) = self._chunk_fn(
@@ -431,7 +448,7 @@ class ContinuousBatchingEngine:
             if st.uid < 0:
                 continue
             for t in range(self.d):
-                if len(st.emitted) >= self.s.max_new_tokens:
+                if len(st.emitted) >= st.cap:
                     break
                 if emits[t, slot]:
                     if not st.emitted:
@@ -440,7 +457,7 @@ class ContinuousBatchingEngine:
                     st.logprobs.append(float(logps[t, slot]))
                     emitted += 1
             st.finished = bool(done[slot])
-            if st.finished or len(st.emitted) >= self.s.max_new_tokens:
+            if st.finished or len(st.emitted) >= st.cap:
                 self._retire(slot)
         return emitted
 

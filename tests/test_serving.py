@@ -106,6 +106,29 @@ class TestGreedyExactness:
         for c, w in zip(got, want):
             assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
 
+    def test_per_request_cap_is_a_greedy_prefix(self):
+        """A request capped below the engine budget retires early and
+        its tokens are exactly the prefix of the uncapped output."""
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=10, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=8,
+            decode_chunk=4,
+        )
+        full_uid = eng.submit([5, 9, 2])
+        capped_uid = eng.submit([5, 9, 2], max_new_tokens=3)
+        rng = jax.random.PRNGKey(0)
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        by_uid = {c.uid: c for c in eng.drain_completions()}
+        full, capped = by_uid[full_uid], by_uid[capped_uid]
+        assert len(full.tokens) == 10 and len(capped.tokens) == 3
+        assert capped.tokens == full.tokens[:3]
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1], max_new_tokens=11)  # above the cache budget
+
     def test_eos_retires_slot_early(self):
         """A model whose greedy output hits eos frees the slot before
         max_new_tokens; the completion keeps the eos token."""
@@ -141,10 +164,15 @@ class TestThroughput:
                 decode_chunk=8,
             )
             eng.run(prompts[:B])  # warmup: compiles prefill+chunk
-            t0 = time.perf_counter()
-            out = eng.run(prompts)
-            dt = time.perf_counter() - t0
-            return sum(len(c.tokens) for c in out) / dt
+            # best-of-3: host-scheduling noise only ever slows a run,
+            # and this ratio gates CI — both sides get the same trials
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = eng.run(prompts)
+                dt = time.perf_counter() - t0
+                best = max(best, sum(len(c.tokens) for c in out) / dt)
+            return best
 
         # homogeneous: every prompt identical length (no padding waste
         # even in a static batch) — the best case continuous batching

@@ -17,7 +17,14 @@ from .algorithms import (
     OomRecoveryAlgorithm,
 )
 from .client import BrainClient
-from .datastore import BrainDataStore, JobMetricSample, JobRecord
+from .datastore import (
+    BrainDataStore,
+    JobMetricSample,
+    JobProfile,
+    JobRecord,
+    profile_distance,
+    transformer_profile,
+)
 from .service import BrainService
 
 __all__ = [
@@ -26,7 +33,10 @@ __all__ = [
     "BrainService",
     "JobCreateResourceAlgorithm",
     "JobMetricSample",
+    "JobProfile",
     "JobRecord",
     "JobRunningResourceAlgorithm",
     "OomRecoveryAlgorithm",
+    "profile_distance",
+    "transformer_profile",
 ]

@@ -16,10 +16,10 @@ history-driven decision style, re-targeted at slice-count selection:
 """
 
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Dict, Optional
 
 from ..common.log import logger
-from .datastore import BrainDataStore
+from .datastore import BrainDataStore, JobProfile
 
 DEFAULT_MEMORY_SAFETY = 1.2  # headroom over historical peak
 OOM_MEMORY_FACTOR = 1.5  # reference OOM algorithms use 1.5x-2x bumps
@@ -68,8 +68,18 @@ def _knee_of_curve(
 class JobCreateResourceAlgorithm:
     """Initial resources for a brand-new job (reference
     ``optimize_job_worker_create_resource.go``): warm-start from similar
-    completed jobs; cold-start returns no opinion so the master falls
-    back to its configured defaults."""
+    completed jobs; with a :class:`JobProfile`, a model with NO
+    exact-signature history borrows shape-similar jobs' curves instead
+    (fleet-scale warm start); true cold-start returns no opinion so the
+    master falls back to its configured defaults."""
+
+    # Neighbors farther than this in log-shape space (weighted-mean
+    # per-feature |log ratio|) carry no transferable signal: e^2 ≈ 7.4x
+    # scale mismatch, or a closer scale with an arch-family mismatch.
+    MAX_PROFILE_DISTANCE = 2.0
+    # Per-host memory transfer: activations/optimizer state scale with
+    # params, but not past this clamp either way.
+    MEM_RATIO_CLAMP = (0.5, 4.0)
 
     def __init__(self, store: BrainDataStore, min_gain: float = 0.4):
         self._store = store
@@ -81,9 +91,14 @@ class JobCreateResourceAlgorithm:
         workload: str = "",
         node_unit: int = 1,
         max_workers: int = 0,
+        profile: Optional[JobProfile] = None,
     ) -> OptimizePlan:
         history = self._store.similar_jobs(model_signature, workload)
         if not history:
+            if profile is not None:
+                return self._profile_warm_start(
+                    profile, node_unit, max_workers
+                )
             return OptimizePlan(reason="cold start: no similar job history")
         uuids = [j.job_uuid for j in history]
         curve = self._store.speed_by_world_size(uuids)
@@ -101,6 +116,83 @@ class JobCreateResourceAlgorithm:
             predicted_speed=curve.get(worker_num, 0.0),
             reason=f"warm start from {len(history)} similar jobs",
             extra={"speed_curve": {str(k): v for k, v in curve.items()}},
+        )
+
+    def _profile_warm_start(
+        self, profile: JobProfile, node_unit: int, max_workers: int
+    ) -> OptimizePlan:
+        """Fleet-scale sizing: no job with this signature has ever run,
+        but shape-similar jobs have. Each neighbor's speed curve is
+        transferred by its FLOPs ratio (same tokens per step, a job
+        doing r× the FLOPs runs at 1/r the steps/s — the compute-bound
+        first-order model), then the transferred curves are merged and
+        the usual marginal-gain knee applies. Memory transfers by the
+        param-count ratio, clamped: parameters and optimizer state
+        scale linearly, activations sublinearly."""
+        neighbors = [
+            (job, prof, dist)
+            for job, prof, dist in self._store.nearest_profiles(profile)
+            if dist <= self.MAX_PROFILE_DISTANCE
+        ]
+        if not neighbors:
+            return OptimizePlan(
+                reason="cold start: no signature or shape-similar history"
+            )
+        curve: Dict[int, float] = {}
+        mem_mb = 0.0
+        for job, prof, dist in neighbors:
+            # Transfer scale: FLOPs ratio when both sides report it,
+            # param ratio as the proxy otherwise (FLOPs ∝ active params
+            # at equal tokens). A neighbor comparable on NEITHER never
+            # got past profile_distance's scale-feature requirement, so
+            # an unscaled (scale=1) transfer cannot happen here.
+            if profile.flops_per_step > 0 and prof.flops_per_step > 0:
+                scale = prof.flops_per_step / profile.flops_per_step
+            else:
+                scale = prof.param_count / profile.param_count
+            for size, speed in self._store.speed_by_world_size(
+                [job.job_uuid]
+            ).items():
+                transferred = speed * scale
+                if transferred > curve.get(size, 0.0):
+                    curve[size] = transferred
+            peak = self._store.peak_memory([job.job_uuid])
+            if peak > 0:
+                lo, hi = self.MEM_RATIO_CLAMP
+                if profile.param_count > 0 and prof.param_count > 0:
+                    ratio = min(
+                        hi, max(lo, profile.param_count / prof.param_count)
+                    )
+                else:
+                    # params not comparable: the donor's own peak is
+                    # still a better floor than recommending 0 MB
+                    ratio = 1.0
+                mem_mb = max(mem_mb, peak * ratio)
+        limit = max_workers or max(curve, default=0)
+        worker_num = _knee_of_curve(curve, node_unit, limit, self._min_gain)
+        if worker_num <= 0:
+            sizes = sorted(j.worker_num for j, _, _ in neighbors if j.worker_num > 0)
+            worker_num = sizes[len(sizes) // 2] if sizes else 0
+        nearest = neighbors[0]
+        return OptimizePlan(
+            worker_num=worker_num,
+            memory_mb_per_host=mem_mb * DEFAULT_MEMORY_SAFETY,
+            predicted_speed=curve.get(worker_num, 0.0),
+            reason=(
+                f"profile warm start from {len(neighbors)} shape-similar "
+                f"jobs (nearest: {nearest[0].model_signature!r} at "
+                f"distance {nearest[2]:.2f})"
+            ),
+            extra={
+                "profile_neighbors": [
+                    {
+                        "model_signature": j.model_signature,
+                        "distance": round(d, 3),
+                    }
+                    for j, _, d in neighbors
+                ],
+                "speed_curve": {str(k): round(v, 4) for k, v in curve.items()},
+            },
         )
 
 

@@ -76,6 +76,33 @@ class BrainClient:
             logger.debug("brain report_metrics failed: %r", e)
             return False
 
+    def report_profile(
+        self,
+        job_uuid: str,
+        param_count: float = 0.0,
+        flops_per_step: float = 0.0,
+        tokens_per_batch: float = 0.0,
+        seq_len: int = 0,
+        arch: str = "",
+    ) -> bool:
+        """Persist the job's workload shape so future jobs with no
+        exact-signature history can warm-start from it."""
+        try:
+            self._client.report(
+                bm.BrainProfileReport(
+                    job_uuid=job_uuid,
+                    param_count=param_count,
+                    flops_per_step=flops_per_step,
+                    tokens_per_batch=tokens_per_batch,
+                    seq_len=seq_len,
+                    arch=arch,
+                )
+            )
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain report_profile failed: %r", e)
+            return False
+
     def report_event(
         self, job_uuid: str, event_type: str, node_id: int = -1, detail: str = ""
     ) -> bool:
@@ -143,6 +170,17 @@ class BrainClient:
             return None
         except Exception as e:  # noqa: BLE001
             logger.debug("brain allocate unreachable: %r", e)
+            return None
+
+    def get_fleet_report(self) -> Optional[bm.BrainFleetReport]:
+        """Per-signature fleet aggregates (ops view of the datastore)."""
+        try:
+            resp = self._client.get(bm.BrainFleetQuery())
+            if isinstance(resp, bm.BrainFleetReport):
+                return resp
+            return None
+        except Exception as e:  # noqa: BLE001
+            logger.debug("brain fleet query unreachable: %r", e)
             return None
 
     def get_job_info(self, job_uuid: str) -> Optional[bm.BrainJobInfo]:

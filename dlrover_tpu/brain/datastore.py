@@ -35,6 +35,86 @@ class JobRecord:
 
 
 @dataclass
+class JobProfile:
+    """Workload shape features for cross-model similarity.
+
+    The reference Brain sizes new jobs from *exact* job-name history
+    (``optimize_job_worker_create_resource.go`` keys on job cohorts); at
+    fleet scale a brand-new model has no exact cohort, but its SHAPE
+    (parameter count, step FLOPs, batch tokens) predicts which history
+    transfers. Distances are computed in log-space — a 124M and a 350M
+    model are "one doubling and a bit" apart regardless of absolute
+    scale.
+    """
+
+    job_uuid: str
+    param_count: float = 0.0  # model parameters
+    flops_per_step: float = 0.0  # fwd+bwd FLOPs per optimizer step
+    tokens_per_batch: float = 0.0  # global batch tokens per step
+    seq_len: int = 0
+    arch: str = ""  # model family: gpt | llama | moe | ...
+
+
+def transformer_profile(
+    job_uuid: str,
+    n_params: float,
+    global_batch: int,
+    seq_len: int,
+    arch: str = "gpt",
+) -> JobProfile:
+    """Profile for a dense-transformer LM job from first principles:
+    tokens = batch*seq, step FLOPs ≈ 6*N*tokens (fwd 2N + bwd 4N per
+    token) — the same accounting bench.py's MFU uses."""
+    tokens = float(global_batch) * float(seq_len)
+    return JobProfile(
+        job_uuid=job_uuid,
+        param_count=float(n_params),
+        flops_per_step=6.0 * float(n_params) * tokens,
+        tokens_per_batch=tokens,
+        seq_len=int(seq_len),
+        arch=arch,
+    )
+
+
+def profile_distance(a: JobProfile, b: JobProfile) -> float:
+    """Log-space L1 distance over the shape features present on BOTH
+    profiles, plus a flat penalty for an architecture-family mismatch
+    (a MoE's step economics don't transfer to a dense model 1:1).
+
+    The per-feature distances are combined as a WEIGHTED MEAN, not a
+    sum: params and step FLOPs are near-perfectly correlated at equal
+    batch tokens (flops ≈ 6·N·tokens), so a sum would double-count
+    model scale and halve the effective transfer range.
+
+    At least one SCALE feature (param count or step FLOPs) must be
+    comparable: tokens-per-batch alone says nothing about model scale,
+    and a distance built only on it would rank a 124M donor as an
+    exact match for a 70B probe."""
+    import math
+
+    d = 0.0
+    total_weight = 0.0
+    scale_features = 0
+    for attr, weight in (
+        ("param_count", 1.0),
+        ("flops_per_step", 1.0),
+        ("tokens_per_batch", 0.5),
+    ):
+        va, vb = getattr(a, attr), getattr(b, attr)
+        if va > 0 and vb > 0:
+            d += weight * abs(math.log(va / vb))
+            total_weight += weight
+            if attr != "tokens_per_batch":
+                scale_features += 1
+    if scale_features == 0:
+        return float("inf")
+    d /= total_weight
+    if a.arch and b.arch and a.arch != b.arch:
+        d += 1.0
+    return d
+
+
+@dataclass
 class JobMetricSample:
     """One runtime observation of a running job."""
 
@@ -87,6 +167,14 @@ class BrainDataStore:
                     event_type TEXT,
                     node_id INTEGER,
                     detail TEXT
+                );
+                CREATE TABLE IF NOT EXISTS profiles (
+                    job_uuid TEXT PRIMARY KEY,
+                    param_count REAL,
+                    flops_per_step REAL,
+                    tokens_per_batch REAL,
+                    seq_len INTEGER,
+                    arch TEXT
                 );
                 """
             )
@@ -159,6 +247,112 @@ class BrainDataStore:
         with self._mu:
             rows = self._conn.execute(q, args).fetchall()
         return [self._row_to_job(r) for r in rows]
+
+    # -- profiles ----------------------------------------------------------
+
+    def upsert_profile(self, profile: JobProfile) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT INTO profiles VALUES (?,?,?,?,?,?) "
+                "ON CONFLICT(job_uuid) DO UPDATE SET "
+                "param_count=excluded.param_count, "
+                "flops_per_step=excluded.flops_per_step, "
+                "tokens_per_batch=excluded.tokens_per_batch, "
+                "seq_len=excluded.seq_len, "
+                "arch=excluded.arch",
+                (
+                    profile.job_uuid,
+                    profile.param_count,
+                    profile.flops_per_step,
+                    profile.tokens_per_batch,
+                    profile.seq_len,
+                    profile.arch,
+                ),
+            )
+            self._conn.commit()
+
+    def get_profile(self, job_uuid: str) -> Optional[JobProfile]:
+        with self._mu:
+            row = self._conn.execute(
+                "SELECT * FROM profiles WHERE job_uuid=?", (job_uuid,)
+            ).fetchone()
+        return self._row_to_profile(row) if row else None
+
+    def nearest_profiles(
+        self,
+        profile: JobProfile,
+        k: int = 8,
+        status: str = "completed",
+        limit: int = 500,
+    ) -> List[tuple]:
+        """The ``k`` profiled jobs (of the given status, most recent
+        ``limit`` considered) nearest to ``profile`` in workload-shape
+        space: ``[(JobRecord, JobProfile, distance), ...]`` ascending.
+        This is the fleet-scale warm-start query — a new model with no
+        exact-signature cohort borrows history from shape-similar jobs.
+        """
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT j.job_uuid, p.param_count, p.flops_per_step, "
+                "p.tokens_per_batch, p.seq_len, p.arch "
+                "FROM jobs j JOIN profiles p ON j.job_uuid = p.job_uuid "
+                "WHERE j.status=? AND j.job_uuid != ? "
+                "ORDER BY j.created_at DESC LIMIT ?",
+                (status, profile.job_uuid, limit),
+            ).fetchall()
+        scored = []
+        for r in rows:
+            cand = self._row_to_profile(r)
+            d = profile_distance(profile, cand)
+            if d != float("inf"):
+                scored.append((cand, d))
+        scored.sort(key=lambda t: t[1])
+        out = []
+        for cand, d in scored[:k]:
+            job = self.get_job(cand.job_uuid)
+            if job is not None:
+                out.append((job, cand, d))
+        return out
+
+    # -- fleet aggregates --------------------------------------------------
+
+    def fleet_summary(self) -> Dict:
+        """Per-signature fleet aggregates (reference Brain's cluster
+        stats processors): job counts by outcome, the best observed
+        speed and the peak memory across each cohort — the ops-facing
+        view of what the datastore knows."""
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT model_signature, status, COUNT(*) "
+                "FROM jobs GROUP BY model_signature, status"
+            ).fetchall()
+            worker_rows = self._conn.execute(
+                "SELECT model_signature, AVG(worker_num) "
+                "FROM jobs GROUP BY model_signature"
+            ).fetchall()
+            speed_rows = self._conn.execute(
+                "SELECT j.model_signature, MAX(m.steps_per_second), "
+                "MAX(m.peak_memory_mb) FROM jobs j "
+                "JOIN metrics m ON j.job_uuid = m.job_uuid "
+                "GROUP BY j.model_signature"
+            ).fetchall()
+        cohorts: Dict[str, Dict] = {}
+        for sig, status, count in rows:
+            c = cohorts.setdefault(
+                sig or "?", {"jobs": 0, "by_status": {}, "avg_workers": 0.0}
+            )
+            c["jobs"] += count
+            c["by_status"][status] = count
+        for sig, avg_workers in worker_rows:
+            cohorts.setdefault(sig or "?", {"jobs": 0, "by_status": {}})[
+                "avg_workers"
+            ] = round(float(avg_workers or 0.0), 1)
+        for sig, best_speed, peak_mem in speed_rows:
+            c = cohorts.setdefault(sig or "?", {"jobs": 0, "by_status": {}})
+            c["best_steps_per_s"] = round(float(best_speed or 0.0), 3)
+            c["peak_memory_mb"] = round(float(peak_mem or 0.0), 1)
+        total = sum(c["jobs"] for c in cohorts.values())
+        return {"cohorts": cohorts, "total_jobs": total}
 
     # -- metrics -----------------------------------------------------------
 
@@ -261,6 +455,17 @@ class BrainDataStore:
     def close(self) -> None:
         with self._mu:
             self._conn.close()
+
+    @staticmethod
+    def _row_to_profile(row) -> JobProfile:
+        return JobProfile(
+            job_uuid=row[0],
+            param_count=float(row[1] or 0.0),
+            flops_per_step=float(row[2] or 0.0),
+            tokens_per_batch=float(row[3] or 0.0),
+            seq_len=int(row[4] or 0),
+            arch=row[5] or "",
+        )
 
     @staticmethod
     def _row_to_job(row) -> JobRecord:

@@ -40,6 +40,34 @@ class BrainMetricReport:
 
 @register_message
 @dataclass
+class BrainProfileReport:
+    """Workload-shape features for fleet-scale similarity (profiles
+    table). Reported once at job registration; lets the create stage
+    warm-start models that have never run under this signature."""
+
+    job_uuid: str = ""
+    param_count: float = 0.0
+    flops_per_step: float = 0.0
+    tokens_per_batch: float = 0.0
+    seq_len: int = 0
+    arch: str = ""
+
+
+@register_message
+@dataclass
+class BrainFleetQuery:
+    """Ask for the per-signature fleet aggregates."""
+
+
+@register_message
+@dataclass
+class BrainFleetReport:
+    cohorts: Dict = field(default_factory=dict)
+    total_jobs: int = 0
+
+
+@register_message
+@dataclass
 class BrainEventReport:
     job_uuid: str = ""
     event_type: str = ""

@@ -20,7 +20,12 @@ from .algorithms import (
     OomRecoveryAlgorithm,
     OptimizePlan,
 )
-from .datastore import BrainDataStore, JobMetricSample, JobRecord
+from .datastore import (
+    BrainDataStore,
+    JobMetricSample,
+    JobProfile,
+    JobRecord,
+)
 
 
 class BrainServicer(ServicerApi):
@@ -71,6 +76,17 @@ class BrainServicer(ServicerApi):
                         cpu_percent=msg.cpu_percent,
                     )
                 )
+            elif isinstance(msg, bm.BrainProfileReport):
+                self._store.upsert_profile(
+                    JobProfile(
+                        job_uuid=msg.job_uuid,
+                        param_count=msg.param_count,
+                        flops_per_step=msg.flops_per_step,
+                        tokens_per_batch=msg.tokens_per_batch,
+                        seq_len=msg.seq_len,
+                        arch=msg.arch,
+                    )
+                )
             elif isinstance(msg, bm.BrainEventReport):
                 self._store.add_event(
                     msg.job_uuid, msg.event_type, msg.node_id, msg.detail
@@ -92,6 +108,12 @@ class BrainServicer(ServicerApi):
                 result = self._optimize(msg)
             elif isinstance(msg, bm.BrainJobQuery):
                 result = self._job_info(msg)
+            elif isinstance(msg, bm.BrainFleetQuery):
+                summary = self._store.fleet_summary()
+                result = bm.BrainFleetReport(
+                    cohorts=summary["cohorts"],
+                    total_jobs=summary["total_jobs"],
+                )
             elif isinstance(msg, bm.BrainAllocateRequest):
                 from .algorithms import ClusterResourceArbiter
 
@@ -115,11 +137,27 @@ class BrainServicer(ServicerApi):
 
     def _optimize(self, msg: bm.BrainOptimizeRequest) -> bm.BrainOptimizeResponse:
         if msg.stage == "create":
+            # A profile dict in extra enables fleet-scale (shape
+            # similarity) warm start when the signature has no history.
+            prof = msg.extra.get("profile")
+            profile = (
+                JobProfile(
+                    job_uuid=msg.job_uuid,
+                    param_count=float(prof.get("param_count", 0.0)),
+                    flops_per_step=float(prof.get("flops_per_step", 0.0)),
+                    tokens_per_batch=float(prof.get("tokens_per_batch", 0.0)),
+                    seq_len=int(prof.get("seq_len", 0)),
+                    arch=str(prof.get("arch", "")),
+                )
+                if isinstance(prof, dict)
+                else None
+            )
             plan = self._create_algo.optimize(
                 msg.model_signature,
                 workload=msg.workload,
                 node_unit=msg.node_unit,
                 max_workers=msg.max_workers,
+                profile=profile,
             )
         elif msg.stage == "running":
             plan = self._running_algo.optimize(

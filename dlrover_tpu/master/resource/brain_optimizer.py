@@ -113,8 +113,13 @@ class BrainReporter:
         world_size_fn=None,
         interval_s: float = 30.0,
         job_uuid: str = "",
+        profile=None,
     ):
         self.job_uuid = job_uuid or f"{job_name}-{uuid.uuid4().hex[:8]}"
+        # Optional JobProfile (brain.datastore): reported once at
+        # registration so models with no signature history become
+        # warm-start donors/consumers by workload shape.
+        self._profile = profile
         self._brain = brain_client
         self._job_name = job_name
         self._signature = model_signature
@@ -138,6 +143,15 @@ class BrainReporter:
             node_unit=self._node_unit,
             status="running",
         )
+        if self._profile is not None:
+            self._brain.report_profile(
+                self.job_uuid,
+                param_count=self._profile.param_count,
+                flops_per_step=self._profile.flops_per_step,
+                tokens_per_batch=self._profile.tokens_per_batch,
+                seq_len=self._profile.seq_len,
+                arch=self._profile.arch,
+            )
         self._thread = threading.Thread(
             target=self._loop, name="brain-reporter", daemon=True
         )

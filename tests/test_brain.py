@@ -11,6 +11,7 @@ import time
 import pytest
 
 from dlrover_tpu.brain import (
+    transformer_profile,
     BrainClient,
     BrainDataStore,
     BrainService,
@@ -582,3 +583,252 @@ class TestMasterInitAdjustIntegration:
             client.close()
         finally:
             svc.stop()
+
+
+class TestProfileWarmStart:
+    """Fleet-scale initial sizing: a model with NO exact-signature
+    history borrows curves from shape-similar profiled jobs (reference
+    Brain's history-driven create stage, generalized across model
+    signatures — dlrover/go/brain optimize_job_worker_create_resource)."""
+
+    @staticmethod
+    def _seed_profiled(store, signature, n_params, batch, seq, arch="gpt",
+                       curve=None, mem=10_000.0, uid=None):
+        uid = uid or f"{signature}-hist"
+        store.upsert_job(
+            JobRecord(
+                job_uuid=uid,
+                job_name=uid,
+                model_signature=signature,
+                workload="jax",
+                worker_num=8,
+                status="completed",
+            )
+        )
+        store.upsert_profile(
+            transformer_profile(uid, n_params, batch, seq, arch=arch)
+        )
+        for size, speed in (curve or {2: 2.0, 4: 3.8, 8: 7.0, 16: 7.7}).items():
+            store.add_metric(
+                JobMetricSample(
+                    job_uuid=uid,
+                    world_size=size,
+                    steps_per_second=speed,
+                    peak_memory_mb=mem,
+                )
+            )
+        return uid
+
+    def test_nearest_profiles_orders_by_shape_distance(self):
+        store = BrainDataStore()
+        self._seed_profiled(store, "gpt2-124M", 124e6, 32, 1024, uid="a")
+        self._seed_profiled(store, "gpt2-1.5B", 1.5e9, 32, 1024, uid="b")
+        probe = transformer_profile("new", 150e6, 32, 1024)
+        got = store.nearest_profiles(probe, k=2)
+        assert [job.job_uuid for job, _, _ in got] == ["a", "b"]
+        assert got[0][2] < got[1][2]
+
+    def test_arch_mismatch_is_penalized(self):
+        store = BrainDataStore()
+        self._seed_profiled(store, "moe-124M", 124e6, 32, 1024, arch="moe",
+                            uid="moe")
+        self._seed_profiled(store, "llama-110M", 110e6, 32, 1024, arch="gpt",
+                            uid="dense")
+        probe = transformer_profile("new", 124e6, 32, 1024, arch="gpt")
+        got = store.nearest_profiles(probe, k=2)
+        # identical scale but wrong family ranks below a near-scale match
+        assert got[0][0].job_uuid == "dense"
+
+    def test_profile_warm_start_scales_speed_by_flops(self):
+        store = BrainDataStore()
+        self._seed_profiled(store, "gpt2-124M", 124e6, 32, 1024)
+        # new model: 2x the params => 2x the step FLOPs at equal tokens
+        probe = transformer_profile("new", 248e6, 32, 1024)
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "gpt2-248M", profile=probe
+        )
+        assert not plan.empty()
+        assert "profile warm start" in plan.reason
+        assert plan.worker_num == 8  # knee transfers
+        # donor does 7.0 steps/s at 8 hosts; half the speed at 2x FLOPs
+        assert plan.predicted_speed == pytest.approx(3.5, rel=0.01)
+        # memory: 10 GB peak * 2.0 param ratio * 1.2 safety
+        assert plan.memory_mb_per_host == pytest.approx(24_000, rel=0.01)
+        assert plan.extra["profile_neighbors"][0]["model_signature"] == (
+            "gpt2-124M"
+        )
+
+    def test_exact_signature_history_still_preferred(self):
+        store = BrainDataStore()
+        _seed_history(store, "gpt2s")
+        self._seed_profiled(store, "other", 124e6, 32, 1024, uid="p")
+        probe = transformer_profile("new", 124e6, 32, 1024)
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "gpt2s", profile=probe
+        )
+        assert "warm start from 3 similar jobs" in plan.reason
+
+    def test_distant_profiles_are_not_borrowed(self):
+        store = BrainDataStore()
+        # 124M donor vs a 70B probe: ~2 orders of magnitude apart
+        self._seed_profiled(store, "gpt2-124M", 124e6, 32, 1024)
+        probe = transformer_profile("new", 70e9, 32, 1024)
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "llama-70B", profile=probe
+        )
+        assert plan.empty() and "cold start" in plan.reason
+
+    def test_memory_ratio_is_clamped(self):
+        from dlrover_tpu.brain import JobProfile
+
+        store = BrainDataStore()
+        self._seed_profiled(store, "tiny", 10e6, 32, 256)
+        # 5x the params at the SAME step FLOPs (sparse/MoE-shaped:
+        # most params inactive per token) — close in shape space, but
+        # naive memory transfer would 5x; the clamp caps it at 4x.
+        donor = transformer_profile("", 10e6, 32, 256)
+        probe = JobProfile(
+            "new",
+            param_count=50e6,
+            flops_per_step=donor.flops_per_step,
+            tokens_per_batch=donor.tokens_per_batch,
+            seq_len=256,
+            arch="gpt",
+        )
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "mid", profile=probe
+        )
+        assert not plan.empty()
+        # ratio clamped at 4.0: 10_000 * 4.0 * 1.2
+        assert plan.memory_mb_per_host == pytest.approx(48_000, rel=0.01)
+
+    def test_fleet_summary_aggregates_by_signature(self):
+        store = BrainDataStore()
+        _seed_history(store, "gpt2s", n_jobs=2)
+        store.upsert_job(
+            JobRecord(job_uuid="f1", model_signature="gpt2s", status="failed")
+        )
+        summary = store.fleet_summary()
+        cohort = summary["cohorts"]["gpt2s"]
+        assert cohort["jobs"] == 3
+        assert cohort["by_status"] == {"completed": 2, "failed": 1}
+        assert cohort["best_steps_per_s"] == pytest.approx(7.02, abs=0.01)
+        assert summary["total_jobs"] == 3
+
+    def test_profile_and_fleet_rpc_round_trip(self):
+        svc = BrainService(db_path=":memory:", service_type="grpc")
+        svc.start()
+        client = BrainClient(svc.addr)
+        try:
+            assert client.report_job(
+                "rp-1", job_name="donor", model_signature="donor-sig",
+                worker_num=4, status="completed",
+            )
+            assert client.report_profile(
+                "rp-1", param_count=124e6, flops_per_step=6 * 124e6 * 32768,
+                tokens_per_batch=32768, seq_len=1024, arch="gpt",
+            )
+            assert client.report_metrics(
+                "rp-1", world_size=4, steps_per_second=4.0,
+                peak_memory_mb=9_000,
+            )
+            plan = client.get_optimization_plan(
+                "create",
+                model_signature="never-seen",
+                extra={
+                    "profile": {
+                        "param_count": 124e6,
+                        "flops_per_step": 6 * 124e6 * 32768,
+                        "tokens_per_batch": 32768,
+                        "seq_len": 1024,
+                        "arch": "gpt",
+                    }
+                },
+            )
+            assert plan is not None and plan.worker_num == 4
+            assert "profile warm start" in plan.reason
+            fleet = client.get_fleet_report()
+            assert fleet.total_jobs == 1
+            assert "donor-sig" in fleet.cohorts
+        finally:
+            client.close()
+            svc.stop()
+
+    def test_reporter_registers_profile(self):
+        svc = BrainService(db_path=":memory:")
+        svc.start()
+        client = BrainClient(svc.addr)
+        try:
+            reporter = BrainReporter(
+                client,
+                "profiled-job",
+                model_signature="sig-x",
+                worker_num=2,
+                interval_s=60.0,
+                profile=transformer_profile("", 50e6, 16, 512, arch="llama"),
+            )
+            reporter.start()
+            deadline = time.time() + 5
+            prof = None
+            while time.time() < deadline and prof is None:
+                prof = svc.store.get_profile(reporter.job_uuid)
+                time.sleep(0.05)
+            assert prof is not None and prof.arch == "llama"
+            assert prof.param_count == pytest.approx(50e6)
+            reporter.stop()
+        finally:
+            client.close()
+            svc.stop()
+
+    def test_tokens_only_profile_never_matches(self):
+        """A profile carrying only tokens_per_batch has no scale signal;
+        it must not rank a small donor as an exact match for a huge
+        probe (code-review regression)."""
+        from dlrover_tpu.brain import JobProfile
+
+        store = BrainDataStore()
+        self._seed_profiled(store, "gpt2-124M", 124e6, 32, 1024)
+        probe = JobProfile("new", tokens_per_batch=32 * 1024.0)
+        assert store.nearest_profiles(probe) == []
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "llama-70B", profile=probe
+        )
+        assert plan.empty()
+
+    def test_memory_floor_when_params_not_comparable(self):
+        """Donor peak memory transfers unscaled (not dropped to 0) when
+        param counts aren't comparable (code-review regression)."""
+        from dlrover_tpu.brain import JobProfile
+
+        store = BrainDataStore()
+        self._seed_profiled(store, "gpt2-124M", 124e6, 32, 1024, mem=10_000)
+        donor = transformer_profile("", 124e6, 32, 1024)
+        probe = JobProfile(
+            "new",
+            flops_per_step=donor.flops_per_step,
+            tokens_per_batch=donor.tokens_per_batch,
+            seq_len=1024,
+            arch="gpt",
+        )
+        plan = JobCreateResourceAlgorithm(store).optimize(
+            "mystery", profile=probe
+        )
+        assert not plan.empty()
+        assert plan.memory_mb_per_host == pytest.approx(12_000, rel=0.01)
+
+    def test_fleet_avg_workers_is_cohort_wide(self):
+        """avg_workers must average the WHOLE cohort, not the last
+        status group sqlite happens to emit (code-review regression)."""
+        store = BrainDataStore()
+        for i, (status, workers) in enumerate(
+            [("completed", 8), ("completed", 8), ("completed", 8),
+             ("failed", 0)]
+        ):
+            store.upsert_job(
+                JobRecord(
+                    job_uuid=f"aw-{i}", model_signature="sig",
+                    worker_num=workers, status=status,
+                )
+            )
+        summary = store.fleet_summary()
+        assert summary["cohorts"]["sig"]["avg_workers"] == pytest.approx(6.0)

@@ -881,6 +881,30 @@ def _bench_serving(extra, cfg, params, on_tpu):
     )
 
 
+def _section_gc(extra, name):
+    """Between-section HBM hygiene + accounting: drop dead executables
+    (jit caches pin their handles), collect cycles, and record the live
+    device-array footprint so an OOM cascade (r05 first capture: every
+    section after llama died RESOURCE_EXHAUSTED) is attributable to a
+    specific section's leak rather than a mystery."""
+    import gc
+
+    import jax
+
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — accounting must never kill bench
+        pass
+    try:
+        live_mb = sum(
+            a.size * a.dtype.itemsize for a in jax.live_arrays()
+        ) / 1e6
+        extra.setdefault("hbm_live_mb", {})[name] = round(live_mb, 1)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _bench_checkpoint(extra, state, mesh, flash_s):
     """Flash checkpoint on the real train state (~1.5 GB on TPU)."""
     import jax
@@ -1108,6 +1132,22 @@ def worker():
         except Exception as e:  # noqa: BLE001 — keep the flash headline
             extra["dense_error"] = repr(e)[:200]
 
+        # Checkpoint EARLY, on clean HBM, while the full train state
+        # (params + optimizer) exists — last position cost the r05
+        # first capture its ckpt headline to an OOM cascade. goodput_10
+        # is recomputed at the end from the FINAL headline step time.
+        _section_gc(extra, "post_dense")
+        try:
+            _bench_checkpoint(extra, state, mesh, flash_s)
+        except Exception as e:  # noqa: BLE001
+            extra["ckpt_error"] = repr(e)[:200]
+
+        # The remaining generation/serving sections need only params —
+        # drop the optimizer state (~1 GB of the ~1.5 GB train state).
+        params = state.params
+        state = step_fn = x = y = None  # noqa: F841
+        _section_gc(extra, "post_ckpt")
+
         if on_tpu:
             try:
                 _bench_long_context(extra)
@@ -1115,29 +1155,34 @@ def worker():
                 extra["flash_seq4096_error"] = repr(e)[:200]
 
         try:
-            _bench_decode(extra, cfg, state.params, on_tpu)
+            _bench_decode(extra, cfg, params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["decode_error"] = repr(e)[:200]
 
         try:
-            _bench_spec_decode(extra, cfg, state.params, on_tpu)
+            _bench_spec_decode(extra, cfg, params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["spec_error"] = repr(e)[:200]
 
         try:
-            _bench_serving(extra, cfg, state.params, on_tpu)
+            _bench_serving(extra, cfg, params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["serving_error"] = repr(e)[:200]
+
+        params = None  # the model families below build their own
+        _section_gc(extra, "post_serving")
 
         try:
             _bench_llama(extra, mesh, on_tpu)  # per-variant guards inside
         except Exception as e:  # noqa: BLE001 — e.g. module import failure
             extra["llama_family_error"] = repr(e)[:200]
 
+        _section_gc(extra, "post_llama")
         try:
             _bench_longseq_train(extra, mesh, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["longseq_train_error"] = repr(e)[:200]
+        _section_gc(extra, "post_longseq")
 
         # Fused chunked CE (flash + ce_chunk): the fp32 logits are the
         # HBM ceiling of this config — fusing the head+CE frees ~10 GB
@@ -1252,10 +1297,14 @@ def worker():
         except Exception as e:  # noqa: BLE001
             extra["mfu_ladder_error"] = repr(e)[:200]
 
-        try:
-            _bench_checkpoint(extra, state, mesh, flash_s)
-        except Exception as e:  # noqa: BLE001
-            extra["ckpt_error"] = repr(e)[:200]
+        # goodput at a 10-step cadence re-derived from the FINAL
+        # headline step time (the ckpt block was measured early; the
+        # fused-CE / remat ladder may have changed flash_s since)
+        if "ckpt_async_stage_block_s" in extra:
+            ab = extra["ckpt_async_stage_block_s"]
+            extra["goodput_ckpt_every_10_steps"] = round(
+                10 * flash_s / (10 * flash_s + ab), 4
+            )
 
         if interposed:
             try:

@@ -352,6 +352,13 @@ def main(argv=None) -> int:
     ap.add_argument("--eos-id", type=int, default=-1)
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument(
+        "--cache-layout", choices=["frontier", "per_row"],
+        default="per_row",
+        help="per_row: each request advances its own cache frontier — "
+        "no compaction re-prefills (default). frontier: shared write "
+        "slot + compaction (the pre-r5 layout).",
+    )
+    ap.add_argument(
         "--cpu", action="store_true",
         help="pin the virtual CPU backend (local smoke)",
     )
@@ -398,6 +405,7 @@ def main(argv=None) -> int:
         batch_size=ns.batch_size,
         prompt_width=ns.prompt_width,
         decode_chunk=ns.decode_chunk,
+        cache_layout=ns.cache_layout,
     )
     daemon = ServingDaemon(engine).start()
     httpd = serve(daemon, ns.port, reload_fn)

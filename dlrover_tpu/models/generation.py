@@ -146,14 +146,18 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-def decode_apply(model, params, cache, tokens, positions, kv_valid):
+def decode_apply(
+    model, params, cache, tokens, positions, kv_valid, cache_slots=None
+):
     """One decode-mode model application over an explicit cache pytree.
 
     Returns (raw logits, updated cache). The single place the decode
     contract (``decode=True, positions, kv_valid, mutable=["cache"]``)
     is spelled, shared by the one-shot engine and the continuous-
     batching scheduler — their token-exactness guarantee depends on
-    applying the model identically.
+    applying the model identically. ``cache_slots`` [B] selects the
+    per-row write-slot mode (continuous batching's per-row cache
+    layout; see gpt._update_decode_cache).
     """
     logits, mut = model.apply(
         {"params": params, "cache": cache},
@@ -161,6 +165,7 @@ def decode_apply(model, params, cache, tokens, positions, kv_valid):
         decode=True,
         positions=positions,
         kv_valid=kv_valid,
+        cache_slots=cache_slots,
         mutable=["cache"],
     )
     return logits, mut["cache"]

@@ -88,18 +88,27 @@ def _constrain(x, *axes):
     return with_logical_constraint(x, *axes)
 
 
-def _update_decode_cache(module, max_len, k, v, kv_valid):
+def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     """Write this call's K/V into the module's decode cache; return the
     full cache plus the attention mask for the queries of this call.
 
     Incremental decoding the flax way (``"cache"`` variable collection),
-    shared by GPT and Llama attention. The engine convention
-    (:mod:`dlrover_tpu.models.generation`) is LEFT-padded prompts so
-    every batch row shares one static write offset — the cache update is
-    a single ``dynamic_update_slice``, never a per-row scatter, which is
-    the shape XLA tiles well on TPU. ``kv_valid`` [B, max_len] marks
-    which cache slots hold real tokens (False = left-pad); queries at
-    local position i attend valid slots s with s <= offset + i.
+    shared by GPT and Llama attention. The DEFAULT path follows the
+    engine convention (:mod:`dlrover_tpu.models.generation`): LEFT-
+    padded prompts, so every batch row shares one static write offset
+    and the cache update is a single ``dynamic_update_slice`` — the
+    shape XLA tiles well for multi-token prefill writes. ``kv_valid``
+    [B, max_len] marks which cache slots hold real tokens (False =
+    left-pad); queries at local position i attend valid slots s with
+    s <= offset + i.
+
+    ``cache_slots`` [B] int32 switches single-token decode to PER-ROW
+    write slots (the continuous-batching engine's per-row cache layout:
+    every request advances its own frontier, so admissions never leave
+    frontier-wide holes and the stream never compacts). The write is a
+    B-row scatter — tiny (B × KVH × Hd elements) next to the attention
+    pass that reads the whole cache anyway — and the causal mask keys
+    on each row's own slot. Requires an explicit ``kv_valid``.
 
     Reference RL rollouts lean on vLLM for this
     (examples/unified/rl/openrlhf/ppo/main.py:26-60); here generation is
@@ -115,6 +124,22 @@ def _update_decode_cache(module, max_len, k, v, kv_valid):
     cidx = module.variable(
         "cache", "index", lambda: jnp.zeros((), jnp.int32)
     )
+    if cache_slots is not None:
+        if T != 1:
+            raise ValueError(
+                f"cache_slots is a single-token decode contract (T={T})"
+            )
+        if kv_valid is None:
+            raise ValueError("cache_slots mode needs explicit kv_valid")
+        rows = jnp.arange(B)
+        ck.value = ck.value.at[rows, cache_slots].set(k[:, 0])
+        cv.value = cv.value.at[rows, cache_slots].set(v[:, 0])
+        # cidx (the shared frontier) is meaningless per-row; leave it.
+        causal = (
+            jnp.arange(max_len)[None, :] <= cache_slots[:, None]
+        )  # [B, max_len]
+        mask = (kv_valid & causal)[:, None, :]  # [B, 1, max_len]
+        return ck.value, cv.value, mask
     offset = cidx.value
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
@@ -176,6 +201,7 @@ class CausalSelfAttention(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         kv_valid=None,
+        cache_slots=None,
     ):
         cfg = self.config
         B, T, D = x.shape
@@ -203,7 +229,7 @@ class CausalSelfAttention(nn.Module):
 
         if decode:
             k, v, mask = _update_decode_cache(
-                self, cfg.max_seq_len, k, v, kv_valid
+                self, cfg.max_seq_len, k, v, kv_valid, cache_slots
             )
             return _masked_attention(q, k, v, mask, wo, cfg)
 
@@ -303,12 +329,14 @@ class Block(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         kv_valid=None,
+        cache_slots=None,
     ):
         x = x + CausalSelfAttention(self.config)(
             LayerNorm(self.config)(x),
             deterministic=deterministic,
             decode=decode,
             kv_valid=kv_valid,
+            cache_slots=cache_slots,
         )
         x = x + Mlp(self.config)(LayerNorm(self.config)(x))
         return x
@@ -336,6 +364,7 @@ class GPT(nn.Module):
         decode: bool = False,
         positions=None,
         kv_valid=None,
+        cache_slots=None,
     ):
         cfg = self.config
         B, T = tokens.shape
@@ -393,6 +422,7 @@ class GPT(nn.Module):
                     deterministic=deterministic,
                     decode=decode,
                     kv_valid=kv_valid,
+                    cache_slots=cache_slots,
                 )
         x = LayerNorm(cfg, name="ln_f")(x)
 

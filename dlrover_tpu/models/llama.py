@@ -153,7 +153,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None):
+    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None, cache_slots=None):
         cfg = self.config
         B, T, D = x.shape
         H, KVH, Hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -207,7 +207,7 @@ class LlamaAttention(nn.Module):
             q = apply_rope_at(q, cos_t, sin_t, positions)
             k = apply_rope_at(k, cos_t, sin_t, positions)
             k, v, mask = _update_decode_cache(
-                self, cfg.max_seq_len, k, v, kv_valid
+                self, cfg.max_seq_len, k, v, kv_valid, cache_slots
             )
             # no repeat: _masked_attention groups q heads against the
             # narrow KVH-wide cache instead of widening it every step
@@ -394,13 +394,14 @@ class LlamaBlock(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None):
+    def __call__(self, x, *, decode: bool = False, positions=None, kv_valid=None, cache_slots=None):
         cfg = self.config
         x = x + LlamaAttention(cfg)(
             RMSNorm(cfg)(x),
             decode=decode,
             positions=positions,
             kv_valid=kv_valid,
+            cache_slots=cache_slots,
         )
         mlp = MoeMlp(cfg) if cfg.is_moe_block(self.layer_idx) else SwiGluMlp(cfg)
         x = x + mlp(RMSNorm(cfg)(x))
@@ -426,6 +427,7 @@ class Llama(nn.Module):
         decode: bool = False,
         positions=None,
         kv_valid=None,
+        cache_slots=None,
     ):
         cfg = self.config
         B, T = tokens.shape
@@ -452,7 +454,11 @@ class Llama(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 x = LlamaBlock(cfg, layer_idx=i, name=f"block_{i}")(
-                    x, decode=decode, positions=positions, kv_valid=kv_valid
+                    x,
+                    decode=decode,
+                    positions=positions,
+                    kv_valid=kv_valid,
+                    cache_slots=cache_slots,
                 )
         x = RMSNorm(cfg, name="norm_f")(x)
         w_lm = param_with_axes(

@@ -10,35 +10,36 @@ stream does not pay worst-case padding in every batch
 
 TPU shape — every device program is static-shape and compiled once:
 
-- **Slot admission rides the hole-slot contract.** The decode cache
-  writes all rows at one shared frontier slot (gpt._update_decode_cache
-  — a single ``dynamic_update_slice``, never a per-row scatter). A new
-  request's prompt is prefilled into a fresh single-row cache at slots
-  ``[0, W)`` (W = the smallest width bucket that fits it, at most Pw)
-  and the whole row is inserted into the batch cache; the
-  gap ``[Pw, frontier)`` is simply ``kv_valid=False`` — the same
-  hole-slot pattern speculative decoding already proves token-exact
-  (positions count only valid slots, so RoPE/posembs never see the
-  holes).
+- **Slot admission.** A new request's prompt is prefilled into a fresh
+  single-row cache at slots ``[0, W)`` (W = the smallest width bucket
+  that fits it, at most Pw) and the whole row is inserted into the
+  batch cache; positions count only valid slots, so RoPE/posembs never
+  see pad holes — the same contract speculative decoding proves
+  token-exact.
 - **Decode runs in chunks**: a ``lax.scan`` of ``decode_chunk`` steps
   per scheduler iteration, so the host pays one dispatch + one result
   fetch per chunk, not per token (the tunnel RTT is the cost model).
-- **Compaction instead of paging.** The shared frontier advances one
-  slot per step for the whole batch, so slots are a stream-wide budget.
-  When headroom runs out, the scheduler re-prefills every live row's
-  full history (prompt + emitted tokens, all host-known) into a fresh
-  cache — one batched MXU-friendly forward — and the frontier drops to
-  the longest live history. Width-bucketed to bound recompiles.
+- **Two cache layouts** (``cache_layout=``):
+
+  - ``"frontier"``: every row writes at one shared slot per step (a
+    single ``dynamic_update_slice``). Admissions leave kv_valid holes
+    up to the frontier; slots are a stream-wide budget, and when
+    headroom runs out the scheduler re-prefills every live row's full
+    history into a fresh cache (compaction — one batched MXU-friendly
+    forward), width-bucketed to bound recompiles. Liveness:
+    ``aligned(prompt_width + max_new_tokens) + max(max_new_tokens,
+    decode_chunk) <= max_seq_len``.
+  - ``"per_row"``: every row writes at its OWN next slot (a B-row
+    scatter — gpt._update_decode_cache ``cache_slots`` mode). No
+    shared frontier, no holes past a prompt's bucket, no compaction
+    ever: the paged-KV property, recovered in a static ``[B, L]``
+    cache by per-request slot reuse. Liveness is per-request:
+    ``prompt_width + max_new_tokens <= max_seq_len``.
+
 - **Weight hot-swap between chunks**: ``set_params`` replaces the
   parameter argument of the jitted programs (same shapes — no
   recompile), so a WeightBus push lands at the next chunk boundary;
   ``swap_latency_s`` of the last swap is recorded.
-
-Liveness: ``aligned(prompt_width + max_new_tokens) +
-max(max_new_tokens, decode_chunk) <= max_seq_len`` so that after the
-worst-case compaction (frontier at the aligned longest possible
-history) the next chunk still fits the cache and a freed slot can
-still admit a full request.
 """
 
 import time
@@ -105,6 +106,7 @@ class ContinuousBatchingEngine:
         decode_chunk: int = 8,
         mesh=None,
         rules=None,
+        cache_layout: str = "frontier",
     ):
         """With ``mesh`` (+ optional logical-axis ``rules``) every
         device program runs SPMD over it: pass params already placed in
@@ -113,23 +115,54 @@ class ContinuousBatchingEngine:
         decode collectives. The stream state rides the batch axis
         REPLICATED (serve-mesh convention: scale batch by running one
         engine per data shard; the mesh scales the MODEL), so use
-        tp/fsdp axes only."""
+        tp/fsdp axes only.
+
+        ``cache_layout``:
+
+        - ``"frontier"`` (default): all rows write at one shared slot
+          (single ``dynamic_update_slice`` per step). Admissions leave
+          kv_valid holes up to the frontier, and the stream compacts
+          (a batched re-prefill) when the frontier nears the cache end.
+        - ``"per_row"``: every row writes at its OWN next slot via a
+          B-row scatter (``gpt._update_decode_cache`` ``cache_slots``
+          mode). No frontier, no holes past a request's prompt bucket,
+          and NO compaction ever — the paged-KV property that matters
+          on this engine (slots are reused in place; a request's
+          lifetime is bounded by its own prompt+budget, not by the
+          stream's). Liveness is simply prompt_width + max_new_tokens
+          <= max_seq_len. Preferred for long mixed streams.
+        """
         cfg = model.config
         L = cfg.max_seq_len
-        # Liveness: the worst compacted frontier is the aligned longest
-        # possible history (prompt + full budget); after it there must
-        # still be room for a whole request's decode AND for the next
-        # chunk's writes — otherwise compaction can strand the stream
-        # (or the chunk would write past the cache end, which
-        # dynamic_update_slice silently CLAMPS into valid slots).
-        worst = self._align(prompt_width + sampling.max_new_tokens)
-        need = worst + max(sampling.max_new_tokens, decode_chunk)
-        if need > L:
+        if cache_layout not in ("frontier", "per_row"):
             raise ValueError(
-                f"continuous batching liveness: aligned(prompt_width + "
-                f"max_new_tokens) + max(max_new_tokens, decode_chunk) = "
-                f"{need} > max_seq_len {L}"
+                f"cache_layout {cache_layout!r}: frontier | per_row"
             )
+        self.layout = cache_layout
+        if cache_layout == "per_row":
+            # per-row liveness: each request lives in its own slots
+            if prompt_width + sampling.max_new_tokens > L:
+                raise ValueError(
+                    f"per_row liveness: prompt_width + max_new_tokens = "
+                    f"{prompt_width + sampling.max_new_tokens} > "
+                    f"max_seq_len {L}"
+                )
+        else:
+            # Liveness: the worst compacted frontier is the aligned
+            # longest possible history (prompt + full budget); after it
+            # there must still be room for a whole request's decode AND
+            # for the next chunk's writes — otherwise compaction can
+            # strand the stream (or the chunk would write past the
+            # cache end, which dynamic_update_slice silently CLAMPS
+            # into valid slots).
+            worst = self._align(prompt_width + sampling.max_new_tokens)
+            need = worst + max(sampling.max_new_tokens, decode_chunk)
+            if need > L:
+                raise ValueError(
+                    f"continuous batching liveness: aligned(prompt_width"
+                    f" + max_new_tokens) + max(max_new_tokens, "
+                    f"decode_chunk) = {need} > max_seq_len {L}"
+                )
         self.model = model
         self.params = params
         self.s = sampling
@@ -164,12 +197,15 @@ class ContinuousBatchingEngine:
             )
             return cache, last_logits[0], last_pos[0], kv_valid[0]
 
-        def admit(state, row_cache, row_logits, row_pos, row_kv, slot):
+        def admit(state, row_cache, row_logits, row_pos, row_kv, slot,
+                  next_slot):
             """Insert a prefilled row at ``slot`` (traced — one compile
             covers every slot). The batch cache's shared frontier scalar
             is kept; the row's KV live at low slots, the gap up to the
-            frontier is kv_valid=False holes."""
-            cache, kv_valid, last_logits, cur_pos, done = state
+            frontier is kv_valid=False holes (frontier layout) or
+            nothing (per-row layout: the row's own write slot restarts
+            at ``next_slot`` = its prompt bucket width)."""
+            cache, kv_valid, last_logits, cur_pos, done, row_f = state
             cache = jax.tree_util.tree_map(
                 lambda b, r: (
                     b  # shared scalars (write frontier) stay the batch's
@@ -187,45 +223,71 @@ class ContinuousBatchingEngine:
                 last_logits.at[slot].set(row_logits),
                 cur_pos.at[slot].set(row_pos),
                 done.at[slot].set(False),
+                row_f.at[slot].set(next_slot),
             )
 
-        def decode_chunk(params, state, frontier, rng):
-            """d decode steps for the whole batch; returns stacked
-            (toks, emits, logps) [d, B] and the advanced state."""
-            cache, kv_valid, last_logits, cur_pos, done = state
+        def make_decode_chunk(per_row: bool):
+            """Build the d-step decode program for one layout; returns
+            stacked (toks, emits, logps) [d, B] and the advanced state.
+            ONE step body serves both layouts (the sampling contract,
+            kv_valid handling, and logits dtype must never diverge
+            between them — token-exactness in each layout is proven
+            against the same one-shot engine): ``per_row`` only selects
+            the write-slot source. Frontier layout: all rows write at
+            the stream-wide ``frontier + t`` (the per-row frontier in
+            the state rides along untouched). Per-row layout: each row
+            writes at its own frontier (``cache_slots`` scatter);
+            done/empty rows keep stepping on pad (static shapes) with
+            their write slot parked clamped at L-1 — their kv bit and
+            cache row are fully replaced at the next admission, so the
+            parked writes are invisible."""
 
-            def step(carry, t):
-                cache, kv_valid, last_logits, cur_pos, done, rng = carry
-                rng, sub = jax.random.split(rng)
-                tok, emit, tok_logp, done = sample_step(
-                    last_logits, done, sub, s
-                )
-                slot = frontier + t
-                kv_valid = kv_valid | (
-                    jnp.arange(L)[None, :] == slot
-                )
-                pos = cur_pos + 1
-                logits, cache = decode_apply(
-                    model, params, cache, tok[:, None], pos[:, None],
-                    kv_valid,
-                )
-                return (
-                    cache,
-                    kv_valid,
-                    logits[:, 0].astype(jnp.float32),
-                    pos,
-                    done,
-                    rng,
-                ), (tok, emit, tok_logp)
+            def chunk(params, state, frontier, rng):
+                def step(carry, t):
+                    (cache, kv_valid, last_logits, cur_pos, done, row_f,
+                     rng) = carry
+                    rng, sub = jax.random.split(rng)
+                    tok, emit, tok_logp, done = sample_step(
+                        last_logits, done, sub, s
+                    )
+                    if per_row:
+                        write_slots = jnp.minimum(row_f, L - 1)
+                        slot_hits = (
+                            jnp.arange(L)[None, :] == write_slots[:, None]
+                        )
+                        row_f = row_f + 1
+                    else:
+                        write_slots = None
+                        slot_hits = (
+                            jnp.arange(L)[None, :] == frontier + t
+                        )
+                    kv_valid = kv_valid | slot_hits
+                    pos = cur_pos + 1
+                    logits, cache = decode_apply(
+                        model, params, cache, tok[:, None], pos[:, None],
+                        kv_valid, cache_slots=write_slots,
+                    )
+                    return (
+                        cache,
+                        kv_valid,
+                        logits[:, 0].astype(jnp.float32),
+                        pos,
+                        done,
+                        row_f,
+                        rng,
+                    ), (tok, emit, tok_logp)
 
-            carry = (cache, kv_valid, last_logits, cur_pos, done, rng)
-            carry, out = jax.lax.scan(step, carry, jnp.arange(d))
-            cache, kv_valid, last_logits, cur_pos, done, _ = carry
-            return (cache, kv_valid, last_logits, cur_pos, done), out
+                carry, out = jax.lax.scan(
+                    step, (*state, rng), jnp.arange(d)
+                )
+                return carry[:-1], out
+
+            return chunk
 
         self._prefill_fn = jax.jit(prefill_row)
         self._admit_fn = jax.jit(admit)
-        self._chunk_fn = jax.jit(decode_chunk)
+        self._chunk_fn = jax.jit(make_decode_chunk(False))
+        self._chunk_per_row_fn = jax.jit(make_decode_chunk(True))
 
         def compact(params, toks, mask):
             """Batched re-prefill of every live row's history into a
@@ -280,6 +342,7 @@ class ContinuousBatchingEngine:
             jnp.full((self.B, V), -1e9, jnp.float32),
             jnp.zeros((self.B,), jnp.int32),
             jnp.ones((self.B,), bool),  # empty slots: done (emit pad)
+            jnp.zeros((self.B,), jnp.int32),  # per-row write frontier
         )
 
     # -- host scheduler -------------------------------------------------
@@ -361,7 +424,7 @@ class ContinuousBatchingEngine:
             )
             self._state = self._admit_fn(
                 self._state, row_cache, row_logits, row_pos, row_kv,
-                jnp.int32(slot),
+                jnp.int32(slot), jnp.int32(width),
             )
         self._slots[slot] = _Slot(
             uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
@@ -384,10 +447,10 @@ class ContinuousBatchingEngine:
             )
         self._slots[slot] = _Slot()
         # silence the freed slot until the next admission
-        cache, kv_valid, last_logits, cur_pos, done = self._state
+        cache, kv_valid, last_logits, cur_pos, done, row_f = self._state
         self._state = (
             cache, kv_valid, last_logits, cur_pos,
-            done.at[slot].set(True),
+            done.at[slot].set(True), row_f,
         )
 
     def _compact(self):
@@ -403,43 +466,62 @@ class ContinuousBatchingEngine:
             cache, kv_valid, last_logits, cur_pos = self._compact_for(
                 width
             )(self.params, toks, mask)
-        _, _, _, _, done = self._state
+        _, _, _, _, done, row_f = self._state
         # frontier never drops below Pw: future admissions put prompt
         # KV at [0, W<=Pw) and decode writes must stay clear of it
         self._frontier = max(width, self.Pw)
         cache = self._set_cache_frontier(cache, self._frontier)
-        self._state = (cache, kv_valid, last_logits, cur_pos, done)
+        self._state = (cache, kv_valid, last_logits, cur_pos, done, row_f)
 
     def step(self, rng):
-        """One scheduler iteration: compact if out of headroom, admit
-        into free slots, decode one chunk, retire finished rows.
-        Returns the number of tokens emitted this chunk."""
-        if self._queue and all(st.uid < 0 for st in self._slots) and (
-            self._frontier > self.Pw
-        ):
-            # Nothing live but the frontier has advanced (admission may
-            # be budget-blocked): a fresh cache beats dispatching dead
-            # all-done chunks until the compaction threshold — each one
-            # is a full device round-trip that emits zero tokens.
-            self._reset_device_state()
-        if self._frontier + self.d > self.L:
-            self._compact()
+        """One scheduler iteration: compact if out of headroom
+        (frontier layout only), admit into free slots, decode one
+        chunk, retire finished rows. Returns the number of tokens
+        emitted this chunk."""
+        frontier_layout = self.layout == "frontier"
+        if frontier_layout:
+            if self._queue and all(
+                st.uid < 0 for st in self._slots
+            ) and self._frontier > self.Pw:
+                # Nothing live but the frontier has advanced (admission
+                # may be budget-blocked): a fresh cache beats
+                # dispatching dead all-done chunks until the compaction
+                # threshold — each one is a full device round-trip that
+                # emits zero tokens.
+                self._reset_device_state()
+            if self._frontier + self.d > self.L:
+                self._compact()
         # admission: fills empty slots while the budget allows
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
                 continue
             # headroom gate uses the HEAD request's own cap: a short
-            # request can still slip in near the end of the cache
-            if self._frontier + self._queue[0][3] > self.L:
+            # request can still slip in near the end of the cache.
+            # per_row: a freed slot ALWAYS has room (per-request
+            # liveness was checked at construction).
+            if frontier_layout and (
+                self._frontier + self._queue[0][3] > self.L
+            ):
                 break  # no room for this request until compaction
             uid, prompt, submit_t, cap = self._queue.pop(0)
             self._admit_one(slot, uid, prompt, submit_t, cap)
 
         with self._ctx():
-            self._state, (toks, emits, logps) = self._chunk_fn(
-                self.params, self._state, jnp.int32(self._frontier), rng
-            )
-        self._frontier += self.d
+            if frontier_layout:
+                self._state, (toks, emits, logps) = self._chunk_fn(
+                    self.params, self._state, jnp.int32(self._frontier),
+                    rng,
+                )
+                self._frontier += self.d
+            else:
+                # frontier arg is unused in per_row (write slots come
+                # from the state's per-row frontier); pass a constant
+                # so the one compiled program serves every chunk
+                self._state, (toks, emits, logps) = (
+                    self._chunk_per_row_fn(
+                        self.params, self._state, jnp.int32(0), rng
+                    )
+                )
         toks, emits, logps, done = jax.device_get(
             (toks, emits, logps, self._state[4])
         )

@@ -277,3 +277,132 @@ class TestWeightSwap:
         assert not np.allclose(
             swap_lps[4:], base_lps[4:], rtol=1e-3, atol=1e-4
         )
+
+
+class TestPerRowLayout:
+    """cache_layout='per_row': every row writes at its own frontier
+    (gpt._update_decode_cache cache_slots scatter) — no stream-wide
+    frontier, no admission holes past the prompt bucket, and NO
+    compaction ever. The paged-KV property vLLM gets from block tables,
+    here from per-row slot reuse in a static [B, L] cache."""
+
+    def test_per_row_stream_matches_plain_decode(self):
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=10, temperature=0.0)
+        prompts = _mixed_prompts(12)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=4, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+        got = eng.run(prompts)
+        assert [c.uid for c in got] == list(range(12))
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+            assert len(c.logprobs) == len(c.tokens)
+
+    def test_per_row_never_compacts(self, monkeypatch):
+        """A cache tight enough that the frontier layout MUST compact:
+        per_row serves the same stream exactly, without ever touching
+        the compaction path."""
+        model = _model(seq=48)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(10, rng_seed=3)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+
+        def boom(*a, **k):
+            raise AssertionError("per_row must never compact")
+
+        monkeypatch.setattr(eng, "_compact", boom)
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_per_row_serves_caches_frontier_cannot(self):
+        """per_row's liveness bound is per-request (prompt + budget),
+        not stream-wide: a max_seq_len the frontier layout rejects at
+        construction still serves exactly under per_row."""
+        model = _model(seq=32)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        kwargs = dict(
+            batch_size=2, prompt_width=16, decode_chunk=4,
+        )
+        with pytest.raises(ValueError, match="liveness"):
+            ContinuousBatchingEngine(
+                model, params, sampling, **kwargs
+            )
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, cache_layout="per_row", **kwargs
+        )
+        prompts = _mixed_prompts(6, rng_seed=7)
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_per_row_long_stream_slot_reuse_over_stale_kv(self):
+        """N >> B through 2 slots: every admission rewrites a slot that
+        carries a previous request's full KV + a parked done-row write;
+        exactness proves the stale rows are fully invisible."""
+        model = _model(seq=64)
+        params = _params(model)
+        sampling = SamplingConfig(
+            max_new_tokens=6, temperature=0.0, eos_id=3
+        )
+        prompts = _mixed_prompts(20, rng_seed=9)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_per_row_tp_sharded_stream_matches_single_device(self):
+        """SPMD per_row: the cache_slots scatter rides the same tp mesh
+        as the training shardings."""
+        from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+        from dlrover_tpu.parallel.train_step import (
+            default_optimizer,
+            init_train_state,
+        )
+
+        model = _model(seq=256)
+        mesh = build_mesh(MeshConfig(dp=1, tp=2), jax.devices()[:2])
+        state, _ = init_train_state(
+            model, jnp.zeros((4, 8), jnp.int32), mesh, default_optimizer()
+        )
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(7, rng_seed=11)
+        eng_s = ContinuousBatchingEngine(
+            model, state.params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4, mesh=mesh, cache_layout="per_row",
+        )
+        got = eng_s.run(prompts)
+        host_params = jax.tree.map(
+            jnp.asarray, jax.device_get(state.params)
+        )
+        eng_1 = ContinuousBatchingEngine(
+            model, host_params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+        want = eng_1.run(prompts)
+        for c, w in zip(got, want):
+            assert c.tokens == w.tokens, (c.uid, c.tokens, w.tokens)
+
+    def test_rejects_unknown_layout(self):
+        model = _model(seq=256)
+        with pytest.raises(ValueError, match="cache_layout"):
+            ContinuousBatchingEngine(
+                model, _params(model),
+                SamplingConfig(max_new_tokens=4), batch_size=2,
+                prompt_width=8, cache_layout="paged",
+            )

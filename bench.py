@@ -1152,6 +1152,23 @@ def worker():
         )
 
         dense_tps = 0.0
+
+        def take_headline(config_label, b, step_s):
+            """Promote a measured config to the headline: value / mfu /
+            step / batch / vs_baseline (and goodput_10 later via
+            flash_s) must all describe the SAME config, in every block
+            that wins the race."""
+            nonlocal flash_tps, flash_s, vs_baseline
+            extra["headline_config"] = config_label
+            extra["mfu"] = round(_mfu(cfg, n_params, b, seq, step_s), 4)
+            extra["flash_step_s"] = round(step_s, 4)
+            extra["flash_batch"] = b
+            flash_tps = b * seq / step_s
+            flash_s = step_s
+            if dense_tps:
+                vs_baseline = flash_tps / dense_tps
+                extra["flash_vs_dense"] = round(vs_baseline, 3)
+
         try:
             _, dstate, dstep_fn, dx, dy = _build(
                 dict(attention_impl="dense", **tiny), dense_bs, seq, mesh
@@ -1268,19 +1285,8 @@ def worker():
                     # a failed rung must not pin its HBM into the next
                     fstate = fstep = fx = fy = None  # noqa: F841
             if best_fused is not None and best_fused[0] > flash_tps:
-                tps, fb, fs = best_fused
-                # headline consistency: value/mfu/vs_baseline/step/batch
-                # (and goodput_10 below via flash_s) all describe the
-                # SAME (fused) config once it wins
-                extra["headline_config"] = "flash+fused_ce"
-                extra["mfu"] = round(_mfu(cfg, n_params, fb, seq, fs), 4)
-                extra["flash_step_s"] = round(fs, 4)
-                extra["flash_batch"] = fb
-                flash_tps = tps
-                flash_s = fs
-                if dense_tps:
-                    vs_baseline = flash_tps / dense_tps
-                    extra["flash_vs_dense"] = round(vs_baseline, 3)
+                _, fb, fs = best_fused
+                take_headline("flash+fused_ce", fb, fs)
         except Exception as e:  # noqa: BLE001
             extra["fused_ce_error"] = repr(e)[:200]
 
@@ -1323,19 +1329,54 @@ def worker():
                 finally:
                     # a failed rung must not pin its HBM into the next
                     vstate = vstep = vx = vy = None  # noqa: F841
+            rung_won = False
             if ladder:
-                tps, label, vs = max(ladder)
+                tps, best_label, vs = max(ladder)
                 if tps > flash_tps:
-                    extra["headline_config"] = (
-                        extra.get("headline_config", "flash") + "+" + label
+                    rung_won = True
+                    take_headline(
+                        extra.get("headline_config", "flash")
+                        + "+" + best_label,
+                        hb,
+                        vs,
                     )
-                    extra["mfu"] = round(_mfu(cfg, n_params, hb, seq, vs), 4)
-                    extra["flash_step_s"] = round(vs, 4)
-                    extra["flash_batch"] = hb
-                    flash_tps, flash_s = tps, vs
-                    if dense_tps:
-                        vs_baseline = flash_tps / dense_tps
-                        extra["flash_vs_dense"] = round(vs_baseline, 3)
+
+            # Batch ladder on the WINNING config: throughput/MFU often
+            # rises with batch (fixed per-step costs amortize) until
+            # HBM runs out — the remat/fused rungs above changed the
+            # memory envelope, so the best batch must be re-searched,
+            # not assumed to stay at the base config's 32. The ce_chunk
+            # fused head keeps the logits out of HBM at any batch.
+            if on_tpu:
+                # measure at the HEADLINE config exactly: the rung
+                # override applies only if that rung actually took the
+                # headline, so the "+bNN" label always extends the
+                # config the numbers describe
+                win = dict(hk)
+                if rung_won:
+                    win.update(dict(variants)[best_label])
+                for bb in (hb * 3 // 2, hb * 2):
+                    try:
+                        _, bstate, bstep, bx, by = _build(
+                            win, bb, seq, mesh
+                        )
+                        bs_s, bstate = _time_steps(bstate, bstep, bx, by)
+                        tps = bb * seq / bs_s
+                        extra[f"batch{bb}_step_s"] = round(bs_s, 4)
+                        extra[f"batch{bb}_tokens_per_s"] = round(tps, 1)
+                        if tps <= flash_tps:
+                            break  # bigger batch stopped paying
+                        take_headline(
+                            extra.get("headline_config", "flash")
+                            + f"+b{bb}",
+                            bb,
+                            bs_s,
+                        )
+                    except Exception as e:  # noqa: BLE001 — e.g. OOM
+                        extra[f"batch{bb}_error"] = repr(e)[:160]
+                        break
+                    finally:
+                        bstate = bstep = bx = by = None  # noqa: F841
         except Exception as e:  # noqa: BLE001
             extra["mfu_ladder_error"] = repr(e)[:200]
 

@@ -641,6 +641,22 @@ def _bench_decode(extra, cfg, params, on_tpu):
         }
     )
 
+    # int8 KV cache rung: decode is HBM-bound on the cache read, so the
+    # half-width cache should shorten the per-step time (same params —
+    # only the cache storage changes; fidelity under test in
+    # tests/test_generation.py::TestInt8KvCache).
+    try:
+        import dataclasses
+
+        model = GPT(dataclasses.replace(cfg, kv_cache_int8=True))
+        t8_full, t8_one = timed(N), timed(1)
+        step8_s = max((t8_full - t8_one) / max(N - 1, 1), 1e-9)
+        extra["decode_int8_ms_per_step"] = round(step8_s * 1e3, 2)
+        extra["decode_int8_tokens_per_s"] = round(B / step8_s, 1)
+        extra["decode_int8_vs_bf16"] = round(step_s / step8_s, 3)
+    except Exception as e:  # noqa: BLE001 — keep the bf16 numbers
+        extra["decode_int8_error"] = repr(e)[:160]
+
 
 def _bench_llama(extra, mesh, on_tpu):
     """Second model family (Llama GQA+RoPE+SwiGLU) and its MoE variant

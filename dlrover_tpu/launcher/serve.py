@@ -8,7 +8,11 @@ serving pytree), stand up the continuous-batching scheduler
 (models/serving.py), and serve completions over HTTP:
 
     POST /v1/completions        {"prompt": [ids...],
-                                 "max_tokens": n?}          → completion
+                                 "max_tokens": n?,
+                                 "prefix_id": id?}          → completion
+    POST /v1/prefixes           {"tokens": [ids...]}        → {"prefix_id"}
+                                (shared system prompt: prefilled once,
+                                 reused by every request that names it)
     POST /v1/weights/reload     {}                          → hot-swap from
                                                               the ckpt dir
     GET  /healthz                                           → stats
@@ -76,12 +80,20 @@ class ServingDaemon:
         return fut.result(timeout)
 
     def complete(
-        self, prompt, timeout: float = 300.0, max_new_tokens=None
+        self, prompt, timeout: float = 300.0, max_new_tokens=None,
+        prefix_id=None,
     ):
-        """Submit one prompt; block until its Completion arrives."""
+        """Submit one prompt; block until its Completion arrives.
+        With ``prefix_id``, ``prompt`` is the suffix after that
+        registered prefix."""
         return self._submit_item(
-            "req", (list(prompt), max_new_tokens), timeout
+            "req", (list(prompt), max_new_tokens, prefix_id), timeout
         )
+
+    def register_prefix(self, tokens, timeout: float = 60.0) -> int:
+        """Register a shared prompt prefix on the engine (computed
+        lazily, invalidated by weight swaps)."""
+        return self._submit_item("prefix", list(tokens), timeout)
 
     def swap_params(self, params, timeout: float = 300.0) -> float:
         """Hand new params to the driver; returns the measured swap
@@ -99,10 +111,14 @@ class ServingDaemon:
             kind, payload, fut = item
             try:
                 if kind == "req":
-                    prompt, cap = payload
-                    uid = self.eng.submit(prompt, max_new_tokens=cap)
+                    prompt, cap, prefix_id = payload
+                    uid = self.eng.submit(
+                        prompt, max_new_tokens=cap, prefix_id=prefix_id
+                    )
                     with self._mu:
                         self._waiters[uid] = fut
+                elif kind == "prefix":
+                    fut.set_result(self.eng.register_prefix(payload))
                 elif kind == "params":
                     fut.set_result(self.eng.set_params(payload))
             except Exception as e:  # noqa: BLE001 — per-request failure
@@ -272,11 +288,19 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                 ):
                     self._send(400, {"error": "max_tokens must be int"})
                     return
+                prefix_id = body.get("prefix_id")
+                if prefix_id is not None and (
+                    isinstance(prefix_id, bool)
+                    or not isinstance(prefix_id, int)
+                ):
+                    self._send(400, {"error": "prefix_id must be int"})
+                    return
                 try:
                     c = daemon.complete(
                         prompt,
                         timeout=float(body.get("timeout", 300.0)),
                         max_new_tokens=max_tokens,
+                        prefix_id=prefix_id,
                     )
                 except ValueError as e:  # client-side: bad prompt
                     self._send(400, {"error": repr(e)[:200]})
@@ -295,6 +319,24 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         "total_s": round(c.total_s, 4),
                     },
                 )
+            elif self.path == "/v1/prefixes":
+                tokens = body.get("tokens")
+                if not isinstance(tokens, list) or not all(
+                    isinstance(t, int) for t in tokens
+                ):
+                    self._send(
+                        400, {"error": "tokens must be a list of token ids"}
+                    )
+                    return
+                try:
+                    pid = daemon.register_prefix(tokens)
+                except ValueError as e:
+                    self._send(400, {"error": repr(e)[:200]})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)[:200]})
+                    return
+                self._send(200, {"prefix_id": pid})
             elif self.path == "/v1/weights/reload":
                 if reload_fn is None:
                     self._send(
@@ -352,6 +394,11 @@ def main(argv=None) -> int:
     ap.add_argument("--eos-id", type=int, default=-1)
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument(
+        "--kv-int8", action="store_true",
+        help="int8 decode KV cache (halves cache HBM; lossy — see "
+        "docs/generation.md)",
+    )
+    ap.add_argument(
         "--cache-layout", choices=["frontier", "per_row"],
         default="per_row",
         help="per_row: each request advances its own cache frontier — "
@@ -376,6 +423,8 @@ def main(argv=None) -> int:
     from ..parallel.mesh import MeshConfig, build_mesh
 
     config = dict(DEFAULT_CONFIG if not ns.config else json.loads(ns.config))
+    if ns.kv_int8:
+        config["kv_cache_int8"] = True
     model = _build_model(ns.family, config)
     mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
 

@@ -51,6 +51,14 @@ class GPTConfig:
     # parallel.mesh.current_mesh to be active)
     attention_impl: str = ""
     tie_embeddings: bool = True
+    # int8 decode KV cache: values stored int8 with a per-token
+    # per-kv-head scale (amax/127), dequantized in-register on the
+    # attention read. Decode attention is HBM-bound — halving the
+    # cache bytes is the decode-throughput lever (and doubles the
+    # batch a given HBM budget serves). The whole decode-mode path
+    # (prefill AND incremental steps) attends over the quantized
+    # cache; only the training forward (no cache) is untouched.
+    kv_cache_int8: bool = False
 
     def resolved_attention_impl(self) -> str:
         if self.attention_impl:
@@ -88,6 +96,21 @@ def _constrain(x, *axes):
     return with_logical_constraint(x, *axes)
 
 
+def _quant_kv(x):
+    """Per-token per-kv-head symmetric int8: [B, T, KVH, Hd] →
+    (int8 values, f32 scales [B, T, KVH])."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequant_kv(q, scale, dtype):
+    """Inverse of :func:`_quant_kv`; XLA fuses this into the attention
+    einsum's read, so HBM traffic stays the int8 tensor + scales."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     """Write this call's K/V into the module's decode cache; return the
     full cache plus the attention mask for the queries of this call.
@@ -115,15 +138,42 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     a first-class jit-compiled path over the training parameters.
     """
     B, T = k.shape[0], k.shape[1]
+    int8_cache = bool(getattr(module.config, "kv_cache_int8", False))
+    if int8_cache:
+        k_store, k_scale = _quant_kv(k)
+        v_store, v_scale = _quant_kv(v)
+        store_dtype = jnp.int8
+    else:
+        k_store, v_store = k, v
+        k_scale = v_scale = None
+        store_dtype = k.dtype
     ck = module.variable(
-        "cache", "k", jnp.zeros, (B, max_len) + k.shape[2:], k.dtype
+        "cache", "k", jnp.zeros, (B, max_len) + k.shape[2:], store_dtype
     )
     cv = module.variable(
-        "cache", "v", jnp.zeros, (B, max_len) + v.shape[2:], v.dtype
+        "cache", "v", jnp.zeros, (B, max_len) + v.shape[2:], store_dtype
     )
+    if int8_cache:
+        csk = module.variable(
+            "cache", "k_scale", jnp.zeros, (B, max_len) + k.shape[2:3],
+            jnp.float32,
+        )
+        csv = module.variable(
+            "cache", "v_scale", jnp.zeros, (B, max_len) + v.shape[2:3],
+            jnp.float32,
+        )
     cidx = module.variable(
         "cache", "index", lambda: jnp.zeros((), jnp.int32)
     )
+
+    def _read():
+        if not int8_cache:
+            return ck.value, cv.value
+        return (
+            _dequant_kv(ck.value, csk.value, k.dtype),
+            _dequant_kv(cv.value, csv.value, v.dtype),
+        )
+
     if cache_slots is not None:
         if T != 1:
             raise ValueError(
@@ -132,17 +182,32 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
         if kv_valid is None:
             raise ValueError("cache_slots mode needs explicit kv_valid")
         rows = jnp.arange(B)
-        ck.value = ck.value.at[rows, cache_slots].set(k[:, 0])
-        cv.value = cv.value.at[rows, cache_slots].set(v[:, 0])
+        ck.value = ck.value.at[rows, cache_slots].set(k_store[:, 0])
+        cv.value = cv.value.at[rows, cache_slots].set(v_store[:, 0])
+        if int8_cache:
+            csk.value = csk.value.at[rows, cache_slots].set(k_scale[:, 0])
+            csv.value = csv.value.at[rows, cache_slots].set(v_scale[:, 0])
         # cidx (the shared frontier) is meaningless per-row; leave it.
         causal = (
             jnp.arange(max_len)[None, :] <= cache_slots[:, None]
         )  # [B, max_len]
         mask = (kv_valid & causal)[:, None, :]  # [B, 1, max_len]
-        return ck.value, cv.value, mask
+        k_full, v_full = _read()
+        return k_full, v_full, mask
     offset = cidx.value
-    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
-    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+    ck.value = jax.lax.dynamic_update_slice(
+        ck.value, k_store, (0, offset, 0, 0)
+    )
+    cv.value = jax.lax.dynamic_update_slice(
+        cv.value, v_store, (0, offset, 0, 0)
+    )
+    if int8_cache:
+        csk.value = jax.lax.dynamic_update_slice(
+            csk.value, k_scale, (0, offset, 0)
+        )
+        csv.value = jax.lax.dynamic_update_slice(
+            csv.value, v_scale, (0, offset, 0)
+        )
     cidx.value = offset + T
     if kv_valid is None:
         # all slots up to the write frontier are real tokens
@@ -152,7 +217,8 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     slot_q = offset + jnp.arange(T)  # [T]
     causal = jnp.arange(max_len)[None, :] <= slot_q[:, None]  # [T, max_len]
     mask = kv_valid[:, None, :] & causal[None, :, :]  # [B, T, max_len]
-    return ck.value, cv.value, mask
+    k_full, v_full = _read()
+    return k_full, v_full, mask
 
 
 def _masked_attention(q, k, v, mask, wo, cfg):

@@ -45,6 +45,9 @@ class LlamaConfig:
     # this size (see gpt.GPTConfig.ce_chunk — same contract/math)
     ce_chunk: int = 0
     attention_impl: str = ""  # "" → dense; flash|ring as in gpt.py
+    # int8 decode KV cache with per-token per-kv-head scales (see
+    # gpt.GPTConfig.kv_cache_int8 — same contract/math)
+    kv_cache_int8: bool = False
     # MoE: num_experts > 0 replaces every `moe_every`-th block's MLP with
     # a top-2 expert layer (0 = dense model).
     num_experts: int = 0

@@ -174,10 +174,17 @@ class ContinuousBatchingEngine:
         self.d = decode_chunk
         self.swap_latency_s: Optional[float] = None
         self._uid = 0
-        self._queue: List[tuple] = []  # (uid, tokens, submit_t, cap)
+        # (uid, tokens, submit_t, cap, prefix_id)
+        self._queue: List[tuple] = []
         self._slots = [_Slot() for _ in range(batch_size)]
         self._completions: List[Completion] = []
         self._compact_fns: Dict[int, Callable] = {}
+        # prefix caching: registered token lists + their lazily built
+        # device row states (dropped on weight swap — stale KV would
+        # silently serve the OLD model's prefix encoding)
+        self._prefixes: Dict[int, List[int]] = {}
+        self._prefix_states: Dict[int, tuple] = {}
+        self._next_prefix_id = 0
         self._build_programs()
         self._reset_device_state()
 
@@ -196,6 +203,31 @@ class ContinuousBatchingEngine:
                 model, params, toks, mask
             )
             return cache, last_logits[0], last_pos[0], kv_valid[0]
+
+        def continue_prefill_row(
+            params, row_cache, toks, mask, row_kv, last_pos, start
+        ):
+            """Extend a stored prefix row cache with a LEFT-padded
+            [1, W] suffix at slots [start, start+W) — prefix caching's
+            device half. ``start`` (static: one compile per bucket
+            pair) is the prefix's bucket width = the row cache's write
+            index; positions continue the prefix's real-token count.
+            The stored prefix cache is immutable — every admission
+            derives a fresh row from it."""
+            W = toks.shape[1]
+            positions = last_pos + jnp.cumsum(
+                mask.astype(jnp.int32), axis=1
+            )
+            kvv = row_kv[None, :].at[:, start:start + W].set(mask)
+            logits, cache = decode_apply(
+                model, params, row_cache, toks, positions, kvv
+            )
+            return (
+                cache,
+                logits[0, -1].astype(jnp.float32),
+                positions[0, -1],
+                kvv[0],
+            )
 
         def admit(state, row_cache, row_logits, row_pos, row_kv, slot,
                   next_slot):
@@ -285,6 +317,7 @@ class ContinuousBatchingEngine:
             return chunk
 
         self._prefill_fn = jax.jit(prefill_row)
+        self._continue_fn = jax.jit(continue_prefill_row, static_argnums=6)
         self._admit_fn = jax.jit(admit)
         self._chunk_fn = jax.jit(make_decode_chunk(False))
         self._chunk_per_row_fn = jax.jit(make_decode_chunk(True))
@@ -347,13 +380,72 @@ class ContinuousBatchingEngine:
 
     # -- host scheduler -------------------------------------------------
 
+    def register_prefix(self, tokens: List[int]) -> int:
+        """Register a shared prompt prefix (system prompt). Requests
+        submitted with the returned id prefill ONLY their suffix — the
+        prefix's KV is computed once per weight version and reused for
+        every admission (vLLM's prefix-caching capability). The device
+        state is built lazily on first use, so registration is cheap
+        and weight swaps just invalidate."""
+        if not tokens:
+            raise ValueError("empty prefix")
+        # the STORED state occupies the prefix's bucket width — a
+        # prefix whose bucket rounds up to Pw would register fine yet
+        # reject every submit
+        if self._bucket_width(len(tokens)) >= self.Pw:
+            raise ValueError(
+                f"prefix bucket width {self._bucket_width(len(tokens))} "
+                f"leaves no room for a suffix within prompt_width "
+                f"{self.Pw}"
+            )
+        pid = self._next_prefix_id
+        self._next_prefix_id += 1
+        self._prefixes[pid] = list(tokens)
+        return pid
+
+    def _prefix_state(self, pid: int) -> tuple:
+        """(row cache, last logits, last pos, row kv_valid, bucket
+        width) for a registered prefix at the CURRENT weights."""
+        if pid not in self._prefix_states:
+            prefix = self._prefixes[pid]
+            width = self._bucket_width(len(prefix))
+            toks, mask = self._pad_rows([prefix], width)
+            with self._ctx():
+                row = self._prefill_fn(self.params, toks, mask)
+            self._prefix_states[pid] = (*row, width)
+        return self._prefix_states[pid]
+
     def submit(
-        self, tokens: List[int], max_new_tokens: Optional[int] = None
+        self,
+        tokens: List[int],
+        max_new_tokens: Optional[int] = None,
+        prefix_id: Optional[int] = None,
     ) -> int:
         """Enqueue a request. ``max_new_tokens`` caps THIS request
         below the engine budget (``sampling.max_new_tokens``, which
-        sized the cache) — a capped request retires its slot early."""
-        if len(tokens) > self.Pw:
+        sized the cache) — a capped request retires its slot early.
+        With ``prefix_id``, ``tokens`` is the SUFFIX after that
+        registered prefix; the combined length must still fit
+        ``prompt_width`` (prefix caching saves prefill compute, not
+        cache capacity)."""
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            if not tokens:
+                raise ValueError("prefix_id needs a non-empty suffix")
+            # admission pads BOTH parts to bucket widths — the check
+            # must bound the admitted row width, not the raw lengths
+            # (a raw-length check admits rows wider than Pw, and
+            # decode writes then silently corrupt the suffix KV)
+            total = self._bucket_width(
+                len(self._prefixes[prefix_id])
+            ) + self._bucket_width(len(tokens))
+            if total > self.Pw:
+                raise ValueError(
+                    f"prefix bucket + suffix bucket = {total} > "
+                    f"prompt_width {self.Pw}"
+                )
+        elif len(tokens) > self.Pw:
             raise ValueError(
                 f"prompt length {len(tokens)} > prompt_width {self.Pw}"
             )
@@ -367,7 +459,9 @@ class ContinuousBatchingEngine:
             cap = max_new_tokens
         uid = self._uid
         self._uid += 1
-        self._queue.append((uid, list(tokens), time.perf_counter(), cap))
+        self._queue.append(
+            (uid, list(tokens), time.perf_counter(), cap, prefix_id)
+        )
         return uid
 
     def set_params(self, params) -> float:
@@ -388,6 +482,8 @@ class ContinuousBatchingEngine:
         params = jax.device_put(params, spec)
         jax.block_until_ready(params)  # every leaf — not just the first
         self.params = params
+        # stored prefix KV encodes the OLD weights — rebuild lazily
+        self._prefix_states.clear()
         self.swap_latency_s = time.perf_counter() - t0
         return self.swap_latency_s
 
@@ -404,30 +500,55 @@ class ContinuousBatchingEngine:
         nearly double the longest history)."""
         return max(unit, ((n + unit - 1) // unit) * unit)
 
-    def _admit_one(
-        self, slot: int, uid: int, prompt: List[int], submit_t: float,
-        cap: int,
-    ):
-        # Bucketed prefill width: a 5-token prompt must not pay a
-        # [1, Pw] forward on a Pw=256 engine. jit re-specializes per
-        # shape, so the same program object serves every bucket (at
-        # most 3 compiles); KV beyond the bucket stays a hole, which
-        # the decode contract already masks.
+    def _bucket_width(self, n: int) -> int:
+        """Bucketed prefill width: a 5-token prompt must not pay a
+        [1, Pw] forward on a Pw=256 engine. jit re-specializes per
+        shape, so the same program object serves every bucket (at
+        most 3 compiles); KV beyond the bucket stays a hole, which
+        the decode contract already masks."""
         width = self.Pw
         for b in (max(8, self.Pw // 4), max(8, self.Pw // 2)):
-            if len(prompt) <= b < width:
+            if n <= b < width:
                 width = b
-        toks, mask = self._pad_rows([prompt], width)
+        return width
+
+    def _admit_one(
+        self, slot: int, uid: int, prompt: List[int], submit_t: float,
+        cap: int, prefix_id: Optional[int] = None,
+    ):
         with self._ctx():
-            row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
-                self.params, toks, mask
-            )
+            if prefix_id is not None:
+                # prefix caching: derive the row from the stored prefix
+                # state (computed once per weight version) + a
+                # suffix-only forward
+                (p_cache, p_logits, p_pos, p_kv, p_width) = (
+                    self._prefix_state(prefix_id)
+                )
+                s_width = self._bucket_width(len(prompt))
+                toks, mask = self._pad_rows([prompt], s_width)
+                row_cache, row_logits, row_pos, row_kv = (
+                    self._continue_fn(
+                        self.params, p_cache, toks, mask, p_kv, p_pos,
+                        p_width,
+                    )
+                )
+                width = p_width + s_width
+                full_prompt = self._prefixes[prefix_id] + prompt
+            else:
+                width = self._bucket_width(len(prompt))
+                toks, mask = self._pad_rows([prompt], width)
+                row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
+                    self.params, toks, mask
+                )
+                full_prompt = prompt
             self._state = self._admit_fn(
                 self._state, row_cache, row_logits, row_pos, row_kv,
                 jnp.int32(slot), jnp.int32(width),
             )
+        # full prefix+suffix history: compaction (frontier layout)
+        # rebuilds rows from these tokens
         self._slots[slot] = _Slot(
-            uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
+            uid=uid, prompt=full_prompt, submit_t=submit_t, cap=cap,
             admit_t=time.perf_counter(),
         )
 
@@ -503,8 +624,8 @@ class ContinuousBatchingEngine:
                 self._frontier + self._queue[0][3] > self.L
             ):
                 break  # no room for this request until compaction
-            uid, prompt, submit_t, cap = self._queue.pop(0)
-            self._admit_one(slot, uid, prompt, submit_t, cap)
+            uid, prompt, submit_t, cap, prefix_id = self._queue.pop(0)
+            self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
 
         with self._ctx():
             if frontier_layout:

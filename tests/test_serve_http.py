@@ -195,3 +195,36 @@ class TestServeHttp:
             httpd.shutdown()
             httpd.server_close()
             daemon.stop()
+
+
+class TestPrefixHttp:
+    def test_register_and_complete_with_prefix(self, server):
+        base, model, params, sampling, _ = server
+        prefix = [11, 23, 5]
+        suffix = [7, 1]
+        status, r = _post(base, "/v1/prefixes", {"tokens": prefix})
+        assert status == 200
+        pid = r["prefix_id"]
+        status, got = _post(
+            base, "/v1/completions", {"prompt": suffix, "prefix_id": pid}
+        )
+        assert status == 200
+        toks, mask = left_pad_prompts([prefix + suffix])
+        want_t, want_m, _ = generate(
+            model, params, toks, mask, jax.random.PRNGKey(0), sampling
+        )
+        want = [
+            int(x) for x, keep in zip(np.asarray(want_t)[0],
+                                      np.asarray(want_m)[0]) if keep
+        ]
+        assert got["tokens"] == want
+
+    def test_prefix_validation_http(self, server):
+        base = server[0]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/prefixes", {"tokens": "nope"})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions",
+                  {"prompt": [1, 2], "prefix_id": 404})
+        assert ei.value.code == 400

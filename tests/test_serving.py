@@ -406,3 +406,130 @@ class TestPerRowLayout:
                 SamplingConfig(max_new_tokens=4), batch_size=2,
                 prompt_width=8, cache_layout="paged",
             )
+
+
+class TestPrefixCaching:
+    """Shared-prefix caching (vLLM's prefix-caching capability): a
+    registered prefix's KV is computed once per weight version; each
+    admission prefills only its suffix and continues from the stored
+    row. The keystone: completions equal the plain engine's on the
+    CONCATENATED prompt, in both layouts."""
+
+    @pytest.mark.parametrize("layout", ["frontier", "per_row"])
+    def test_prefix_completions_match_concatenated(self, layout):
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prefix = [11, 23, 5, 42, 9]
+        suffixes = [[7, 1], [3, 3, 8, 2], [19], [4, 4, 4, 4, 4, 4]]
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout=layout,
+        )
+        pid = eng.register_prefix(prefix)
+        for sfx in suffixes:
+            eng.submit(sfx, prefix_id=pid)
+        got = eng.run()
+        want = _reference_completions(
+            model, params, [prefix + s for s in suffixes], sampling
+        )
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_prefix_prefilled_once_across_requests(self):
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=6, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+        pid = eng.register_prefix([11, 23, 5, 42, 9, 8, 7])
+        calls = {"prefill": 0}
+        real_prefill = eng._prefill_fn
+
+        def counting_prefill(*a, **k):
+            calls["prefill"] += 1
+            return real_prefill(*a, **k)
+
+        eng._prefill_fn = counting_prefill
+        for sfx in ([7, 1], [3, 3], [19], [2, 2, 2], [5], [6, 6]):
+            eng.submit(sfx, prefix_id=pid)
+        eng.run()
+        # one full prefill (the prefix itself); every request paid only
+        # the suffix-continuation program
+        assert calls["prefill"] == 1
+
+    def test_weight_swap_invalidates_prefix(self):
+        model = _model(seq=256)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=6, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, p1, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row",
+        )
+        pid = eng.register_prefix([11, 23, 5])
+        eng.submit([7, 1], prefix_id=pid)
+        eng.run()
+        eng.set_params(p2)
+        eng.submit([7, 1], prefix_id=pid)
+        got = eng.run()
+        want = _reference_completions(
+            model, p2, [[11, 23, 5, 7, 1]], sampling
+        )
+        assert got[0].tokens == want[0]
+
+    def test_prefix_validation(self):
+        model = _model(seq=256)
+        eng = ContinuousBatchingEngine(
+            model, _params(model), SamplingConfig(max_new_tokens=4),
+            batch_size=2, prompt_width=16,
+        )
+        with pytest.raises(ValueError, match="unknown prefix_id"):
+            eng.submit([1, 2], prefix_id=99)
+        with pytest.raises(ValueError, match="empty prefix"):
+            eng.register_prefix([])
+        with pytest.raises(ValueError, match="no room"):
+            eng.register_prefix(list(range(16)))
+        pid = eng.register_prefix(list(range(7)))  # bucket width 8
+        with pytest.raises(ValueError, match="prompt_width"):
+            eng.submit(list(range(9)), prefix_id=pid)
+        with pytest.raises(ValueError, match="non-empty suffix"):
+            eng.submit([], prefix_id=pid)
+
+    def test_bucket_overflow_geometry_rejected(self):
+        """Code-review regression (confirmed corruption): admission
+        pads the suffix to its BUCKET width, so the capacity check must
+        bound prefix bucket + suffix bucket, not the raw lengths —
+        Pw=32 with a 7-token prefix (bucket 8) and a 17-token suffix
+        (bucket 32) would admit a 40-slot row whose KV the decode
+        writes then silently overwrite."""
+        model = _model(seq=256)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, _params(model), sampling, batch_size=2,
+            prompt_width=32, decode_chunk=4,
+        )
+        pid = eng.register_prefix(list(range(1, 8)))  # bucket 8
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit(list(range(17)), prefix_id=pid)  # bucket 32
+        # a suffix whose bucket fits is served exactly
+        sfx = list(range(1, 9))  # bucket 8: 8 + 8 <= 32
+        eng.submit(sfx, prefix_id=pid)
+        got = eng.run()
+        want = _reference_completions(
+            model, _params(model), [list(range(1, 8)) + sfx], sampling
+        )
+        assert got[0].tokens == want[0]
+
+    def test_prefix_bucket_rounding_rejected_at_register(self):
+        """A prefix whose BUCKET rounds up to prompt_width must be
+        rejected at registration, not at every later submit (code-
+        review regression)."""
+        model = _model(seq=256)
+        eng = ContinuousBatchingEngine(
+            model, _params(model), SamplingConfig(max_new_tokens=4),
+            batch_size=2, prompt_width=32,
+        )
+        with pytest.raises(ValueError, match="bucket"):
+            eng.register_prefix(list(range(17)))  # bucket 32 == Pw

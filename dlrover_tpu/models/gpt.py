@@ -106,8 +106,13 @@ def _quant_kv(x):
 
 
 def _dequant_kv(q, scale, dtype):
-    """Inverse of :func:`_quant_kv`; XLA fuses this into the attention
-    einsum's read, so HBM traffic stays the int8 tensor + scales."""
+    """Inverse of :func:`_quant_kv` — round-trip/debug helper only.
+
+    NOT used by the attention path: dequantizing the cache before the
+    einsums materializes the wide bf16 tensor to HBM (XLA does not
+    fuse converts into dot operands), which measured 0.81x the bf16
+    cache on silicon. The production path keeps operands int8 end to
+    end — see :func:`_masked_attention_int8`."""
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
@@ -166,13 +171,16 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
         "cache", "index", lambda: jnp.zeros((), jnp.int32)
     )
 
-    def _read():
+    def _read(mask):
+        """bf16 cache → (k, v, mask); int8 cache → the RAW int8
+        tensors + scales (k8, ks, v8, vs, mask). Never dequantize here:
+        a materialized [B, max_len, KVH, Hd] bf16 tensor costs more
+        HBM traffic than the narrow cache saves (measured 0.81x on
+        silicon) — the int8 attention path consumes the int8 operands
+        directly (see _masked_attention_int8)."""
         if not int8_cache:
-            return ck.value, cv.value
-        return (
-            _dequant_kv(ck.value, csk.value, k.dtype),
-            _dequant_kv(cv.value, csv.value, v.dtype),
-        )
+            return ck.value, cv.value, mask
+        return ck.value, csk.value, cv.value, csv.value, mask
 
     if cache_slots is not None:
         if T != 1:
@@ -192,8 +200,7 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
             jnp.arange(max_len)[None, :] <= cache_slots[:, None]
         )  # [B, max_len]
         mask = (kv_valid & causal)[:, None, :]  # [B, 1, max_len]
-        k_full, v_full = _read()
-        return k_full, v_full, mask
+        return _read(mask)
     offset = cidx.value
     ck.value = jax.lax.dynamic_update_slice(
         ck.value, k_store, (0, offset, 0, 0)
@@ -217,8 +224,73 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     slot_q = offset + jnp.arange(T)  # [T]
     causal = jnp.arange(max_len)[None, :] <= slot_q[:, None]  # [T, max_len]
     mask = kv_valid[:, None, :] & causal[None, :, :]  # [B, T, max_len]
-    k_full, v_full = _read()
-    return k_full, v_full, mask
+    return _read(mask)
+
+
+def cached_decode_attention(
+    module, max_len, q, k, v, kv_valid, cache_slots, wo, cfg
+):
+    """Update the module's decode cache with this call's K/V, then run
+    attention in the cache's STORAGE precision: the bf16 cache feeds
+    the plain masked einsum; the int8 cache feeds the int8 x int8 MXU
+    path. The single decode-attention entry point for GPT and Llama.
+    """
+    res = _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots)
+    if len(res) == 3:
+        k_full, v_full, mask = res
+        return _masked_attention(q, k_full, v_full, mask, wo, cfg)
+    k8, ks, v8, vs, mask = res
+    return _masked_attention_int8(q, k8, ks, v8, vs, mask, wo, cfg)
+
+
+def _masked_attention_int8(q, k8, ks, v8, vs, mask, wo, cfg):
+    """Decode attention computed IN int8 over the quantized cache.
+
+    The first int8 attempt dequantized the cache to bf16 before the
+    einsums; XLA materialized the [B, max_len, KVH, Hd] bf16 tensor to
+    HBM, so the step paid int8-read + bf16-write + bf16-read — 24%
+    SLOWER than the bf16 cache on silicon (SILICON_r05_1785579811:
+    decode_int8_vs_bf16 0.809). The fix is to never materialize a wide
+    dequantized tensor: quantize the QUERY too and run int8 x int8
+    MXU dots with the scales factored out of the contractions —
+
+    - QK: per-(token, head) q scales and per-(token, kv-head) k scales
+      both factor OUT of the dot (they are constant along the
+      contracted Hd axis): logits = (q8 . k8)_i32 * qs * ks.
+    - PV: the v scale varies along the CONTRACTED slot axis, so it
+      cannot factor out; instead fold it into the probs (a [.., S]
+      tensor, tiny next to the cache), re-quantize the folded weights
+      per row, and run int8 x int8 again.
+
+    HBM traffic per step: the int8 cache + scales, read once, directly
+    as dot operands.
+    """
+    Hd = q.shape[-1]
+    H, KVH = q.shape[2], k8.shape[2]
+    B, T = q.shape[:2]
+    G = H // KVH
+    qg = q.reshape(B, T, KVH, G, Hd)
+    q8, qs = _quant_kv(qg)  # scales [B, T, KVH, G]
+    logits = jnp.einsum(
+        "btgck,bsgk->bgcts", q8, k8, preferred_element_type=jnp.int32
+    ).astype(jnp.float32)
+    logits = logits * jnp.transpose(qs, (0, 2, 3, 1))[..., None]
+    logits = logits * jnp.transpose(ks, (0, 2, 1))[:, :, None, None, :]
+    logits = logits / jnp.sqrt(jnp.float32(Hd))
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e9)
+    probs = jax.nn.softmax(logits, axis=-1)  # fp32
+    w = probs * jnp.transpose(vs, (0, 2, 1))[:, :, None, None, :]
+    wscale = jnp.maximum(jnp.max(jnp.abs(w), axis=-1) / 127.0, 1e-12)
+    w8 = jnp.clip(jnp.round(w / wscale[..., None]), -127, 127).astype(
+        jnp.int8
+    )
+    out = jnp.einsum(
+        "bgcts,bsgk->btgck", w8, v8, preferred_element_type=jnp.int32
+    ).astype(jnp.float32)
+    out = out * jnp.transpose(wscale, (0, 3, 1, 2))[..., None]
+    out = out.reshape(B, T, H, Hd).astype(cfg.dtype)
+    y = jnp.einsum("bqhk,hkd->bqd", out, wo.astype(cfg.dtype))
+    return _constrain(y, "batch", "seq", "embed")
 
 
 def _masked_attention(q, k, v, mask, wo, cfg):
@@ -294,10 +366,10 @@ class CausalSelfAttention(nn.Module):
         v = _constrain(v, "batch", "seq", "heads", "kv")
 
         if decode:
-            k, v, mask = _update_decode_cache(
-                self, cfg.max_seq_len, k, v, kv_valid, cache_slots
+            return cached_decode_attention(
+                self, cfg.max_seq_len, q, k, v, kv_valid, cache_slots,
+                wo, cfg,
             )
-            return _masked_attention(q, k, v, mask, wo, cfg)
 
         impl = cfg.resolved_attention_impl()
         if impl not in ("dense", "flash", "ring"):

@@ -200,7 +200,7 @@ class LlamaAttention(nn.Module):
             # carry a per-row position array), then cache the SMALL
             # pre-repeat GQA k/v — the KVH-wide cache is the whole point
             # of grouped-query attention at decode time.
-            from .gpt import _masked_attention, _update_decode_cache
+            from .gpt import cached_decode_attention
 
             cos_t, sin_t = rope_tables(
                 cfg.max_seq_len, Hd, cfg.rope_theta
@@ -209,12 +209,13 @@ class LlamaAttention(nn.Module):
                 raise ValueError("decode=True needs absolute positions")
             q = apply_rope_at(q, cos_t, sin_t, positions)
             k = apply_rope_at(k, cos_t, sin_t, positions)
-            k, v, mask = _update_decode_cache(
-                self, cfg.max_seq_len, k, v, kv_valid, cache_slots
+            # no repeat: the grouped contraction runs q heads
+            # against the narrow KVH-wide cache instead of widening it
+            # every step (int8 caches take the int8 x int8 path)
+            return cached_decode_attention(
+                self, cfg.max_seq_len, q, k, v, kv_valid, cache_slots,
+                wo, cfg,
             )
-            # no repeat: _masked_attention groups q heads against the
-            # narrow KVH-wide cache instead of widening it every step
-            return _masked_attention(q, k, v, mask, wo, cfg)
 
         cos, sin = rope_tables(T, Hd, cfg.rope_theta)
         q = apply_rope(q, cos, sin)

@@ -157,6 +157,7 @@ class DistributedJobMaster:
         # falls back to the local throughput optimizer; a reporter thread
         # persists this job's record + metric samples into the Brain.
         self.brain_reporter = None
+        self.brain_create_advice = None
         if ctx.brain_addr:
             from ..brain.client import BrainClient
             from .resource.brain_optimizer import (
@@ -165,6 +166,29 @@ class DistributedJobMaster:
             )
 
             brain_client = BrainClient(ctx.brain_addr)
+            # Workload-shape profile (fleet-scale warm start): when the
+            # submitter supplies model_params (+ optionally
+            # global_batch/seq_len/model_arch) in ctx.extra, the job
+            # becomes a warm-start donor/consumer by SHAPE — a new
+            # model with no signature history borrows shape-similar
+            # jobs' scaling curves (brain.datastore.nearest_profiles).
+            profile = None
+            try:
+                n_params = float(ctx.extra.get("model_params", 0) or 0)
+                if n_params > 0:
+                    from ..brain.datastore import transformer_profile
+
+                    profile = transformer_profile(
+                        "",
+                        n_params,
+                        int(ctx.extra.get("global_batch", 0) or 0),
+                        int(ctx.extra.get("seq_len", 0) or 0),
+                        arch=str(ctx.extra.get("model_arch", "") or "gpt"),
+                    )
+            except (TypeError, ValueError) as e:
+                # warm-start metadata is optional — malformed values
+                # must not fail job startup
+                logger.warning("ignoring malformed profile extra: %r", e)
             self.brain_reporter = BrainReporter(
                 brain_client,
                 job_name=job_name,
@@ -175,7 +199,57 @@ class DistributedJobMaster:
                 stats_collector=self.stats_collector,
                 world_size_fn=training_rdzv.world_size,
                 interval_s=ctx.brain_report_interval_s,
+                profile=profile,
             )
+            # Create-stage consultation (reference: the Brain sizes new
+            # jobs from history before they start). ADVISORY here: the
+            # submitter chose num_workers; the advice is recorded (and
+            # logged) so operators/auto-tuning can adopt it, without
+            # the master silently overriding the requested size. The
+            # fetch runs on a daemon thread — an unreachable Brain
+            # (retries + 30s transport timeouts) must not delay master
+            # construction for advice that is advisory-only.
+            def _fetch_create_advice():
+                try:
+                    plan = brain_client.get_optimization_plan(
+                        "create",
+                        model_signature=ctx.extra.get(
+                            "model_signature", job_name
+                        ),
+                        node_unit=node_unit,
+                        max_workers=self.max_workers,
+                        extra=(
+                            {"profile": {
+                                "param_count": profile.param_count,
+                                "flops_per_step": profile.flops_per_step,
+                                "tokens_per_batch": (
+                                    profile.tokens_per_batch
+                                ),
+                                "seq_len": profile.seq_len,
+                                "arch": profile.arch,
+                            }}
+                            if profile is not None
+                            else None
+                        ),
+                    )
+                    if plan is not None and plan.worker_num > 0:
+                        self.brain_create_advice = plan
+                        if plan.worker_num != num_workers:
+                            logger.info(
+                                "brain create-stage advises %s workers "
+                                "(requested %s): %s",
+                                plan.worker_num, num_workers, plan.reason,
+                            )
+                except Exception:  # noqa: BLE001 — advisory only
+                    logger.debug(
+                        "brain create advice unavailable", exc_info=True
+                    )
+
+            threading.Thread(
+                target=_fetch_create_advice,
+                name="brain-create-advice",
+                daemon=True,
+            ).start()
             optimizer = BrainResourceOptimizer(
                 brain_client,
                 job_uuid=self.brain_reporter.job_uuid,

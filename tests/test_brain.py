@@ -832,3 +832,83 @@ class TestProfileWarmStart:
             )
         summary = store.fleet_summary()
         assert summary["cohorts"]["sig"]["avg_workers"] == pytest.approx(6.0)
+
+
+class TestMasterProfileWiring:
+    def test_master_reports_profile_and_records_create_advice(
+        self, tmp_ipc_dir, monkeypatch
+    ):
+        """model_params in ctx.extra → the master reports a workload
+        profile at registration AND records the Brain's create-stage
+        advice — a new job with no signature history warm-starts from a
+        shape-similar donor (product wiring of the fleet warm start)."""
+        from dlrover_tpu.common.config import get_context
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.master.scaler.base_scaler import NoopScaler
+
+        svc = BrainService(db_path=":memory:")
+        svc.start()
+        # donor: completed 124M job with a scaling curve
+        donor = transformer_profile("donor-1", 124e6, 32, 1024)
+        svc.store.upsert_job(
+            JobRecord(
+                job_uuid="donor-1", job_name="donor",
+                model_signature="gpt-124m", worker_num=4,
+                status="completed",
+            )
+        )
+        svc.store.upsert_profile(donor)
+        for size, speed in {1: 1.0, 2: 1.9, 4: 3.6, 8: 3.9}.items():
+            svc.store.add_metric(
+                JobMetricSample(
+                    job_uuid="donor-1", world_size=size,
+                    steps_per_second=speed, peak_memory_mb=8_000,
+                )
+            )
+        ctx = get_context()
+        old_addr, old_extra = ctx.brain_addr, dict(ctx.extra)
+        ctx.brain_addr = svc.addr
+        ctx.extra.update(
+            model_signature="gpt-350m-never-seen",
+            model_params=350e6, global_batch=32, seq_len=1024,
+            model_arch="gpt",
+        )
+        master = None
+        try:
+            master = DistributedJobMaster(
+                scaler=NoopScaler(),
+                num_workers=1,
+                max_workers=8,
+                job_name="profiled",
+                pre_check_ops=[],
+                fresh_context=True,
+            )
+            # the advisory fetch is async (an unreachable Brain must
+            # not block master construction) — poll for it
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                master.brain_create_advice is None
+            ):
+                time.sleep(0.05)
+            advice = master.brain_create_advice
+            assert advice is not None
+            assert advice.worker_num == 4  # donor's knee transfers
+            assert "profile warm start" in advice.reason
+            master.prepare()
+            deadline = time.time() + 10
+            prof = None
+            while time.time() < deadline and prof is None:
+                prof = svc.store.get_profile(
+                    master.brain_reporter.job_uuid
+                )
+                time.sleep(0.1)
+            assert prof is not None
+            assert prof.param_count == pytest.approx(350e6)
+            assert prof.arch == "gpt"
+        finally:
+            if master is not None:
+                master.stop()
+            ctx.brain_addr = old_addr
+            ctx.extra.clear()
+            ctx.extra.update(old_extra)
+            svc.stop()

@@ -877,10 +877,10 @@ def _bench_serving(extra, cfg, params, on_tpu):
     sampling = SamplingConfig(max_new_tokens=N, temperature=0.0)
     r = np.random.default_rng(9)
 
-    def stream_rate(prompts, layout="frontier"):
+    def stream_rate(prompts, layout="frontier", use_model=None, slots=None):
         eng = ContinuousBatchingEngine(
-            model, params, sampling, batch_size=B, prompt_width=Pw,
-            decode_chunk=8, cache_layout=layout,
+            use_model or model, params, sampling, batch_size=slots or B,
+            prompt_width=Pw, decode_chunk=8, cache_layout=layout,
         )
         # warm with the FULL stream: greedy + same prompts makes the
         # timed rerun hit identical compaction widths, so every jit
@@ -908,6 +908,25 @@ def _bench_serving(extra, cfg, params, on_tpu):
         extra["serving_per_row_vs_frontier"] = round(rate_pr / rate_m, 3)
     except Exception as e:  # noqa: BLE001 — keep the frontier numbers
         extra["serving_per_row_error"] = repr(e)[:160]
+
+    # int8 capacity rung: the int8 cache's headline value is CAPACITY —
+    # double the decode slots at the same cache HBM. Serve the same
+    # stream through 2x slots on the int8 cache (per-row layout) and
+    # report the throughput next to the bf16 engine's.
+    try:
+        import dataclasses
+
+        model8 = GPT(dataclasses.replace(cfg, kv_cache_int8=True))
+        rate8, _ = stream_rate(
+            mixed, layout="per_row", use_model=model8, slots=2 * B
+        )
+        extra["serving_int8_2x_slots_tokens_per_s"] = round(rate8, 1)
+        if "serving_per_row_tokens_per_s" in extra:
+            extra["serving_int8_2x_vs_per_row"] = round(
+                rate8 / extra["serving_per_row_tokens_per_s"], 3
+            )
+    except Exception as e:  # noqa: BLE001
+        extra["serving_int8_error"] = repr(e)[:160]
 
     # A REAL WeightBus-style hot-swap: distinct weights arriving as
     # host arrays (what the bus delivers), adopted mid-decode — the

@@ -261,6 +261,7 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         "slots": daemon.eng.B,
                         "prompt_width": daemon.eng.Pw,
                         "max_new_tokens": daemon.eng.s.max_new_tokens,
+                        **daemon.eng.stats(),
                     },
                 )
             else:

@@ -673,6 +673,22 @@ class ContinuousBatchingEngine:
             st.uid >= 0 for st in self._slots
         )
 
+    def stats(self) -> Dict:
+        """Operational snapshot (served over /healthz by tpurun-serve):
+        live occupancy, queue depth, and the cache configuration that
+        determines admission behavior."""
+        return {
+            "cache_layout": self.layout,
+            "busy_slots": sum(1 for st in self._slots if st.uid >= 0),
+            "queue_depth": len(self._queue),
+            "registered_prefixes": len(self._prefixes),
+            "prefix_states_cached": len(self._prefix_states),
+            "kv_cache_int8": bool(
+                getattr(self.model.config, "kv_cache_int8", False)
+            ),
+            "last_swap_latency_s": self.swap_latency_s,
+        }
+
     def drain_completions(self) -> List[Completion]:
         """Hand over (and clear) finished requests, uid-ordered."""
         out, self._completions = self._completions, []

@@ -228,3 +228,14 @@ class TestPrefixHttp:
             _post(base, "/v1/completions",
                   {"prompt": [1, 2], "prefix_id": 404})
         assert ei.value.code == 400
+
+
+class TestHealthzStats:
+    def test_healthz_reports_engine_stats(self, server):
+        base = server[0]
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["cache_layout"] in ("frontier", "per_row")
+        assert h["busy_slots"] == 0 and h["queue_depth"] == 0
+        assert h["registered_prefixes"] == 0
+        assert h["kv_cache_int8"] is False

@@ -909,6 +909,34 @@ def _bench_serving(extra, cfg, params, on_tpu):
     except Exception as e:  # noqa: BLE001 — keep the frontier numbers
         extra["serving_per_row_error"] = repr(e)[:160]
 
+    # speculative serving rung: the in-scheduler draft+verify engine on
+    # the same mixed stream (self-draft — near-random bench weights
+    # give tie-break-limited acceptance in bf16, reported honestly
+    # next to the rate; trained weights accept near 1.0, see
+    # tests/test_serving.py::TestSpeculativeServing)
+    try:
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        eng_sp = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=B, prompt_width=Pw,
+            num_draft=4,
+        )
+        eng_sp.run(mixed)  # warm
+        t0 = time.perf_counter()
+        out_sp = eng_sp.run(mixed)
+        dt_sp = time.perf_counter() - t0
+        rate_sp = sum(len(c.tokens) for c in out_sp) / dt_sp
+        extra["serving_spec_tokens_per_s"] = round(rate_sp, 1)
+        extra["serving_spec_acceptance"] = eng_sp.stats()[
+            "spec_acceptance"
+        ]
+        if "serving_per_row_tokens_per_s" in extra:
+            extra["serving_spec_vs_per_row"] = round(
+                rate_sp / extra["serving_per_row_tokens_per_s"], 3
+            )
+    except Exception as e:  # noqa: BLE001
+        extra["serving_spec_error"] = repr(e)[:160]
+
     # int8 capacity rung: the int8 cache's headline value is CAPACITY —
     # double the decode slots at the same cache HBM. Serve the same
     # stream through 2x slots on the int8 cache (per-row layout) and

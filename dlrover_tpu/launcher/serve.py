@@ -395,6 +395,12 @@ def main(argv=None) -> int:
     ap.add_argument("--eos-id", type=int, default=-1)
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument(
+        "--speculative-draft", type=int, default=0, metavar="K",
+        help="serve through the speculative scheduler: self-draft K "
+        "tokens per round, target verifies in one forward (greedy "
+        "only; trained weights accept near 1.0 per draft)",
+    )
+    ap.add_argument(
         "--kv-int8", action="store_true",
         help="int8 decode KV cache (halves cache HBM; lossy — see "
         "docs/generation.md)",
@@ -450,13 +456,29 @@ def main(argv=None) -> int:
         top_p=ns.top_p,
         eos_id=ns.eos_id,
     )
-    engine = ContinuousBatchingEngine(
-        model, params, sampling,
-        batch_size=ns.batch_size,
-        prompt_width=ns.prompt_width,
-        decode_chunk=ns.decode_chunk,
-        cache_layout=ns.cache_layout,
-    )
+    if ns.speculative_draft > 0:
+        from ..models.serving import SpeculativeBatchingEngine
+
+        if ns.cache_layout != "per_row" or ns.decode_chunk != 8:
+            logger.warning(
+                "--speculative-draft forces per_row layout with one "
+                "round per dispatch; --cache-layout/--decode-chunk "
+                "are ignored"
+            )
+        engine = SpeculativeBatchingEngine(
+            model, params, sampling,
+            batch_size=ns.batch_size,
+            prompt_width=ns.prompt_width,
+            num_draft=ns.speculative_draft,
+        )
+    else:
+        engine = ContinuousBatchingEngine(
+            model, params, sampling,
+            batch_size=ns.batch_size,
+            prompt_width=ns.prompt_width,
+            decode_chunk=ns.decode_chunk,
+            cache_layout=ns.cache_layout,
+        )
     daemon = ServingDaemon(engine).start()
     httpd = serve(daemon, ns.port, reload_fn)
     logger.info(

@@ -155,9 +155,11 @@ def decode_apply(
     contract (``decode=True, positions, kv_valid, mutable=["cache"]``)
     is spelled, shared by the one-shot engine and the continuous-
     batching scheduler — their token-exactness guarantee depends on
-    applying the model identically. ``cache_slots`` [B] selects the
-    per-row write-slot mode (continuous batching's per-row cache
-    layout; see gpt._update_decode_cache).
+    applying the model identically. ``cache_slots`` selects the
+    per-row write-slot mode: [B] for single-token decode (continuous
+    batching's per-row cache layout) or [B, T] for a T-token window
+    written at per-row slots (the in-scheduler speculative verify);
+    see gpt._update_decode_cache.
     """
     logits, mut = model.apply(
         {"params": params, "cache": cache},

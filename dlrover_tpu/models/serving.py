@@ -58,7 +58,11 @@ from .generation import (
     sample_step,
 )
 
-__all__ = ["ContinuousBatchingEngine", "Completion"]
+__all__ = [
+    "Completion",
+    "ContinuousBatchingEngine",
+    "SpeculativeBatchingEngine",
+]
 
 
 @dataclass
@@ -238,16 +242,8 @@ class ContinuousBatchingEngine:
             nothing (per-row layout: the row's own write slot restarts
             at ``next_slot`` = its prompt bucket width)."""
             cache, kv_valid, last_logits, cur_pos, done, row_f = state
-            cache = jax.tree_util.tree_map(
-                lambda b, r: (
-                    b  # shared scalars (write frontier) stay the batch's
-                    if b.ndim == 0
-                    else jax.lax.dynamic_update_slice(
-                        b, r.astype(b.dtype), (slot,) + (0,) * (b.ndim - 1)
-                    )
-                ),
-                cache,
-                row_cache,
+            cache = ContinuousBatchingEngine._insert_row(
+                cache, row_cache, slot
             )
             return (
                 cache,
@@ -492,6 +488,23 @@ class ContinuousBatchingEngine:
         return left_pad_prompts(rows, pad_id=self.s.pad_id, width=width)
 
     @staticmethod
+    def _insert_row(batch, row, slot):
+        """Insert a [1, ...] prefilled row pytree into the batch cache
+        at ``slot``; 0-d leaves (shared frontier scalars) stay the
+        batch's. Shared by the plain and speculative admit programs."""
+        return jax.tree_util.tree_map(
+            lambda b, r: (
+                b
+                if b.ndim == 0
+                else jax.lax.dynamic_update_slice(
+                    b, r.astype(b.dtype), (slot,) + (0,) * (b.ndim - 1)
+                )
+            ),
+            batch,
+            row,
+        )
+
+    @staticmethod
     def _align(n: int, unit: int = 16) -> int:
         """Compaction width alignment: bounds the number of distinct
         re-prefill program shapes to L/unit (one compile each, and
@@ -552,7 +565,9 @@ class ContinuousBatchingEngine:
             admit_t=time.perf_counter(),
         )
 
-    def _retire(self, slot: int):
+    def _finalize_slot(self, slot: int):
+        """Completion bookkeeping shared by every mode: record the
+        Completion (with service metrics) and free the host slot."""
         st = self._slots[slot]
         if st.uid >= 0:
             now = time.perf_counter()
@@ -567,6 +582,9 @@ class ContinuousBatchingEngine:
                 )
             )
         self._slots[slot] = _Slot()
+
+    def _retire(self, slot: int):
+        self._finalize_slot(slot)
         # silence the freed slot until the next admission
         cache, kv_valid, last_logits, cur_pos, done, row_f = self._state
         self._state = (
@@ -704,3 +722,351 @@ class ContinuousBatchingEngine:
             self.step(sub)
         out, self._completions = self._completions, []
         return sorted(out, key=lambda c: c.uid)
+
+
+class SpeculativeBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching WITH in-scheduler speculative decoding.
+
+    vLLM-grade composition: the request-queue scheduler admits and
+    retires mixed-length prompts into decode slots (per-row cache
+    layout), and every device round runs speculation — the draft
+    proposes ``k`` tokens per live row, the target verifies the whole
+    window in ONE forward (a per-row [B, k+1] cache_slots write), and
+    each row emits 1..k+1 tokens per round. Greedy only: the accepted
+    prefix is provably the plain greedy output for ANY draft, so the
+    stream stays token-exact with :class:`ContinuousBatchingEngine`
+    (general-temperature rejection sampling lives in the one-shot
+    engine, models/speculative.py).
+
+    Never-rewind slots (speculative.py's design, applied per row):
+    every round claims k+1 slots at the row's frontier; rejected
+    proposals become kv_valid=False holes, and positions count only
+    valid slots so RoPE/posembs stay exact. Liveness therefore needs
+    ``prompt_width + (k+1) * max_new_tokens + k <= max_seq_len``.
+
+    The draft shares the target's slot layout (its cache is written at
+    the same per-row slots, one validity mask serves both); admission
+    prefills BOTH models on the prompt. Prefix caching is not offered
+    in this mode yet (it would need dual prefix states) — submit with
+    ``prefix_id`` raises.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        sampling: SamplingConfig,
+        batch_size: int,
+        prompt_width: int,
+        draft_model=None,
+        draft_params=None,
+        num_draft: int = 4,
+        mesh=None,
+        rules=None,
+    ):
+        if sampling.temperature != 0.0:
+            raise ValueError(
+                "SpeculativeBatchingEngine is greedy-only "
+                "(temperature=0); sampled speculation lives in the "
+                "one-shot engine (models/speculative.py)"
+            )
+        self.draft_model = draft_model if draft_model is not None else model
+        self.k = int(num_draft)
+        if self.k < 1:
+            raise ValueError(f"num_draft {num_draft} must be >= 1")
+        L = model.config.max_seq_len
+        dcfg = self.draft_model.config
+        if dcfg.max_seq_len != L:
+            raise ValueError("draft and target must share max_seq_len")
+        if dcfg.vocab_size != model.config.vocab_size:
+            raise ValueError("draft and target must share the vocabulary")
+        need = prompt_width + (self.k + 1) * sampling.max_new_tokens + self.k
+        if need > L:
+            raise ValueError(
+                f"speculative serving liveness: prompt_width + "
+                f"(k+1)*max_new_tokens + k = {need} > max_seq_len {L}"
+            )
+        super().__init__(
+            model, params, sampling, batch_size, prompt_width,
+            decode_chunk=1, mesh=mesh, rules=rules,
+            cache_layout="per_row",
+        )
+        self.draft_params = (
+            draft_params if draft_params is not None else self.params
+        )
+        # acceptance accounting (stats()/bench): drafted vs accepted
+        self.rounds = 0
+        self.drafted_total = 0
+        self.accepted_total = 0
+
+    # -- device programs ------------------------------------------------
+
+    def _build_programs(self):
+        super()._build_programs()
+        model, draft = self.model, self.draft_model
+        s, L, k = self.s, self.L, self.k
+
+        def prefill_spec(t_params, d_params, toks, mask):
+            """Prefill BOTH models on one [1, W] prompt; the window
+            slots are shared, so one row kv_valid serves both caches."""
+            t_cache, last_logits, last_pos, kv_valid = prefill_prompt(
+                model, t_params, toks, mask
+            )
+            d_cache = init_cache(draft, toks.shape[0])
+            positions = jnp.maximum(
+                jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1, 0
+            )
+            _, d_cache = decode_apply(
+                draft, d_params, d_cache, toks, positions, kv_valid
+            )
+            return (
+                t_cache, d_cache, last_logits[0], last_pos[0], kv_valid[0]
+            )
+
+        def admit_spec(
+            state, t_row, d_row, row_logits, row_pos, row_kv, slot,
+            next_slot,
+        ):
+            t_cache, d_cache, kv_valid, last_logits, cur_pos, done, row_f = (
+                state
+            )
+            insert = ContinuousBatchingEngine._insert_row
+            return (
+                insert(t_cache, t_row, slot),
+                insert(d_cache, d_row, slot),
+                kv_valid.at[slot].set(row_kv),
+                last_logits.at[slot].set(row_logits),
+                cur_pos.at[slot].set(row_pos),
+                done.at[slot].set(False),
+                row_f.at[slot].set(next_slot),
+            )
+
+        def spec_round(t_params, d_params, state):
+            """One speculation round for the whole batch. Returns the
+            advanced state plus (window tokens [B, k+1], accepted draft
+            count [B], per-token target logprobs [B, k+1]) — the host
+            emits window[:1 + accepted] per live row.
+
+            Greedy: tok0 = argmax(pending logits) leads the window;
+            the draft proposes k continuations; the target scores the
+            window once; the accepted prefix is exactly what plain
+            greedy decode would have produced, and the logits after
+            the last accepted token become the next round's pending
+            logits (the "bonus" position)."""
+            (t_cache, d_cache, kv_valid, last_logits, cur_pos, done,
+             row_f) = state
+            tok0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            tok0 = jnp.where(done, s.pad_id, tok0)
+            lp_all = jax.nn.log_softmax(last_logits, axis=-1)
+            lp0 = jnp.take_along_axis(lp_all, tok0[:, None], axis=-1)[:, 0]
+
+            base = jnp.minimum(row_f, L - 1 - k)  # clamp: parked rows
+            # draft proposes k tokens, feeding its own cache per step
+            kv = kv_valid | (
+                jnp.arange(L)[None, :] == base[:, None]
+            )
+            cur = tok0
+            pos = cur_pos + 1
+            d_toks = []
+            dc = d_cache
+            for j in range(k):
+                d_logits, dc = decode_apply(
+                    draft, d_params, dc, cur[:, None], pos[:, None], kv,
+                    cache_slots=jnp.minimum(base + j, L - 1),
+                )
+                nxt = jnp.argmax(
+                    d_logits[:, 0].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                d_toks.append(nxt)
+                kv = kv | (
+                    jnp.arange(L)[None, :] == (base + 1 + j)[:, None]
+                )
+                cur = nxt
+                pos = pos + 1
+            # align the draft cache: write the last proposal's KV too,
+            # so both caches cover slots [base, base+k]
+            _, dc = decode_apply(
+                draft, d_params, dc, cur[:, None], pos[:, None], kv,
+                cache_slots=jnp.minimum(base + k, L - 1),
+            )
+            drafted = jnp.stack(d_toks, axis=1)  # [B, k]
+
+            # target verifies [tok0, d_1..d_k] in one per-row window
+            win = jnp.concatenate([tok0[:, None], drafted], axis=1)
+            win_pos = (cur_pos + 1)[:, None] + jnp.arange(k + 1)[None, :]
+            win_slots = jnp.minimum(
+                base[:, None] + jnp.arange(k + 1)[None, :], L - 1
+            )
+            t_logits, tc = decode_apply(
+                model, t_params, t_cache, win, win_pos, kv,
+                cache_slots=win_slots,
+            )
+            t_logits = t_logits.astype(jnp.float32)
+
+            ok = drafted == jnp.argmax(t_logits[:, :k], axis=-1)
+            a = jnp.where(
+                ok.all(axis=1), k,
+                jnp.argmin(ok.astype(jnp.int32), axis=1),
+            )
+            a = jnp.where(done, 0, a)
+
+            # logprobs for the emitted tokens: tok0 under the pending
+            # dist, d_j under the verify dist at position j-1
+            lp_win = jnp.take_along_axis(
+                jax.nn.log_softmax(t_logits[:, :k], axis=-1),
+                drafted[:, :, None],
+                axis=-1,
+            )[:, :, 0]
+            logps = jnp.concatenate([lp0[:, None], lp_win], axis=1)
+
+            # eos among the emitted prefix finishes the row
+            emit_idx = jnp.arange(k + 1)[None, :]
+            emitted_mask = (emit_idx <= a[:, None]) & ~done[:, None]
+            if s.eos_id >= 0:
+                eos_hits = (win == s.eos_id) & emitted_mask
+                done = done | eos_hits.any(axis=1)
+
+            # keep kv bits only for the accepted window prefix: slots
+            # base..base+a stay valid, rejected slots become holes
+            arange_l = jnp.arange(L)[None, :]
+            rejected = (arange_l > (base + a)[:, None]) & (
+                arange_l <= (base + k)[:, None]
+            )
+            kv = kv & ~rejected
+
+            # pending logits = after the last accepted token
+            nxt_logits = jnp.take_along_axis(
+                t_logits, a[:, None, None], axis=1
+            )[:, 0]
+            return (
+                tc, dc, kv, nxt_logits, cur_pos + 1 + a, done,
+                row_f + k + 1,
+            ), (win, a, logps)
+
+        self._prefill_spec_fn = jax.jit(prefill_spec)
+        self._admit_spec_fn = jax.jit(admit_spec)
+        self._round_fn = jax.jit(spec_round)
+
+    def _reset_device_state(self):
+        V = self.model.config.vocab_size
+        self._frontier = self.Pw  # unused (per-row), kept for stats
+        self._state = (
+            init_cache(self.model, self.B),
+            init_cache(self.draft_model, self.B),
+            jnp.zeros((self.B, self.L), bool),
+            jnp.full((self.B, V), -1e9, jnp.float32),
+            jnp.zeros((self.B,), jnp.int32),
+            jnp.ones((self.B,), bool),
+            jnp.zeros((self.B,), jnp.int32),
+        )
+
+    # -- host scheduler -------------------------------------------------
+
+    _NO_PREFIX = (
+        "prefix caching is not available in speculative serving"
+    )
+
+    def register_prefix(self, tokens):
+        # fail at REGISTRATION (a ValueError maps to HTTP 400), not on
+        # every later completion
+        raise ValueError(self._NO_PREFIX)
+
+    def submit(self, tokens, max_new_tokens=None, prefix_id=None):
+        if prefix_id is not None:
+            raise ValueError(self._NO_PREFIX)
+        return super().submit(tokens, max_new_tokens=max_new_tokens)
+
+    def set_params(self, params, draft_params=None) -> float:
+        """Swap target weights (and optionally the draft's). A self-
+        drafting engine whose draft_params were the target's follows
+        the target automatically."""
+        follow = self.draft_params is self.params
+        latency = super().set_params(params)
+        if draft_params is not None:
+            self.draft_params = jax.device_put(draft_params)
+        elif follow:
+            self.draft_params = self.params
+        return latency
+
+    def _admit_one(
+        self, slot, uid, prompt, submit_t, cap, prefix_id=None
+    ):
+        width = self._bucket_width(len(prompt))
+        toks, mask = self._pad_rows([prompt], width)
+        with self._ctx():
+            t_row, d_row, row_logits, row_pos, row_kv = (
+                self._prefill_spec_fn(
+                    self.params, self.draft_params, toks, mask
+                )
+            )
+            self._state = self._admit_spec_fn(
+                self._state, t_row, d_row, row_logits, row_pos, row_kv,
+                jnp.int32(slot), jnp.int32(width),
+            )
+        self._slots[slot] = _Slot(
+            uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
+            admit_t=time.perf_counter(),
+        )
+
+    def _retire(self, slot: int):
+        self._finalize_slot(slot)
+        (t_cache, d_cache, kv_valid, last_logits, cur_pos, done,
+         row_f) = self._state
+        self._state = (
+            t_cache, d_cache, kv_valid, last_logits, cur_pos,
+            done.at[slot].set(True), row_f,
+        )
+
+    def step(self, rng):
+        """One speculation round: admit, draft+verify, emit 1..k+1
+        tokens per live row, retire eos/cap rows. Returns tokens
+        emitted. ``rng`` is accepted for API parity (greedy rounds are
+        deterministic)."""
+        for slot, st in enumerate(self._slots):
+            if st.uid >= 0 or not self._queue:
+                continue
+            uid, prompt, submit_t, cap, prefix_id = self._queue.pop(0)
+            self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
+
+        with self._ctx():
+            self._state, (win, accept, logps) = self._round_fn(
+                self.params, self.draft_params, self._state
+            )
+        win, accept, logps, done = jax.device_get(
+            (win, accept, logps, self._state[5])
+        )
+        emitted = 0
+        self.rounds += 1
+        live = [st.uid >= 0 for st in self._slots]
+        self.drafted_total += self.k * sum(live)
+        self.accepted_total += int(
+            sum(int(accept[i]) for i, l in enumerate(live) if l)
+        )
+        for slot, st in enumerate(self._slots):
+            if st.uid < 0:
+                continue
+            for t in range(1 + int(accept[slot])):
+                if len(st.emitted) >= st.cap:
+                    break
+                tok = int(win[slot, t])
+                if not st.emitted:
+                    st.first_tok_t = time.perf_counter()
+                st.emitted.append(tok)
+                st.logprobs.append(float(logps[slot, t]))
+                emitted += 1
+                if self.s.eos_id >= 0 and tok == self.s.eos_id:
+                    break
+            st.finished = bool(done[slot])
+            if st.finished or len(st.emitted) >= st.cap:
+                self._retire(slot)
+        return emitted
+
+    def stats(self) -> Dict:
+        out = super().stats()
+        out["speculative_num_draft"] = self.k
+        out["self_drafting"] = self.draft_params is self.params
+        out["spec_rounds"] = self.rounds
+        out["spec_acceptance"] = round(
+            self.accepted_total / max(self.drafted_total, 1), 3
+        )
+        return out

@@ -533,3 +533,124 @@ class TestPrefixCaching:
         )
         with pytest.raises(ValueError, match="bucket"):
             eng.register_prefix(list(range(17)))  # bucket 32 == Pw
+
+
+class TestSpeculativeServing:
+    """In-scheduler speculative decoding (SpeculativeBatchingEngine):
+    continuous batching where every round drafts k tokens and the
+    target verifies the window in one forward. Keystone: the greedy
+    stream is token-exact with the plain engine for ANY draft."""
+
+    def _spec_model(self, seq=512):
+        return _model(seq=seq)
+
+    def test_stream_token_exact_with_arbitrary_draft(self):
+        import dataclasses
+
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = self._spec_model()
+        params = _params(model)
+        draft = type(model)(
+            dataclasses.replace(model.config, num_layers=1)
+        )
+        d_params = draft.init(
+            jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        sampling = SamplingConfig(max_new_tokens=10, temperature=0.0)
+        prompts = _mixed_prompts(10, rng_seed=2)
+        eng = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=16,
+            draft_model=draft, draft_params=d_params, num_draft=3,
+        )
+        got = eng.run(prompts)
+        assert [c.uid for c in got] == list(range(10))
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+            assert len(c.logprobs) == len(c.tokens)
+
+    def test_self_draft_accepts_everything(self):
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = self._spec_model()
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        eng = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            num_draft=3,
+        )
+        prompts = _mixed_prompts(4, rng_seed=5)
+        for p in prompts:
+            eng.submit(p)
+        rounds = 0
+        rng = jax.random.PRNGKey(0)
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+            rounds += 1
+        got = sorted(eng.drain_completions(), key=lambda c: c.uid)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w
+        # self-draft greedy acceptance is 1.0 (identical programs up to
+        # float noise on CPU): 8 tokens need ceil(8/(k+1)) = 2 rounds
+        # per wave of 2 slots x 2 waves = ~4 rounds, far under the
+        # 8-rounds-per-wave a no-acceptance engine would need
+        assert rounds <= 6, rounds
+
+    def test_eos_and_cap_retire_with_slot_reuse(self):
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = self._spec_model()
+        params = _params(model)
+        sampling = SamplingConfig(
+            max_new_tokens=8, temperature=0.0, eos_id=3
+        )
+        prompts = _mixed_prompts(8, rng_seed=9)
+        eng = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            num_draft=2,
+        )
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+        # per-request caps are greedy prefixes too
+        eng2 = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            num_draft=2,
+        )
+        eng2.submit(prompts[0], max_new_tokens=3)
+        short = eng2.run()[0]
+        assert short.tokens == want[0][:3]
+
+    def test_liveness_and_mode_guards(self):
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = self._spec_model(seq=64)
+        params = _params(model)
+        with pytest.raises(ValueError, match="liveness"):
+            SpeculativeBatchingEngine(
+                model, params,
+                SamplingConfig(max_new_tokens=16, temperature=0.0),
+                batch_size=2, prompt_width=16, num_draft=4,
+            )
+        with pytest.raises(ValueError, match="greedy-only"):
+            SpeculativeBatchingEngine(
+                model, params,
+                SamplingConfig(max_new_tokens=4, temperature=1.0),
+                batch_size=2, prompt_width=16,
+            )
+        eng = SpeculativeBatchingEngine(
+            model, params,
+            SamplingConfig(max_new_tokens=4, temperature=0.0),
+            batch_size=2, prompt_width=16, num_draft=2,
+        )
+        with pytest.raises(ValueError, match="prefix"):
+            eng.submit([1, 2], prefix_id=0)
+        with pytest.raises(ValueError, match="prefix"):
+            eng.register_prefix([1, 2])
+        stats = eng.stats()
+        assert stats["speculative_num_draft"] == 2
+        assert stats["self_drafting"] is True

@@ -33,6 +33,7 @@ import os
 import queue
 import threading
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -71,13 +72,21 @@ class ServingDaemon:
 
     # -- client surface (any thread) -----------------------------------
 
-    def _submit_item(self, kind: str, payload, timeout: float):
+    def _submit_item(
+        self, kind: str, payload, timeout: float,
+        cancel_on_timeout: bool = False,
+    ):
         if self._stop.is_set():
             # the loop is gone; an enqueued future would never resolve
             raise RuntimeError("serving daemon stopped")
         fut: Future = Future()
         self._inbox.put((kind, payload, fut))
-        return fut.result(timeout)
+        try:
+            return fut.result(timeout)
+        except FutureTimeout:
+            if cancel_on_timeout:
+                self._inbox.put(("cancel_fut", fut, None))
+            raise
 
     def complete(
         self, prompt, timeout: float = 300.0, max_new_tokens=None,
@@ -85,9 +94,13 @@ class ServingDaemon:
     ):
         """Submit one prompt; block until its Completion arrives.
         With ``prefix_id``, ``prompt`` is the suffix after that
-        registered prefix."""
+        registered prefix. On timeout the request is CANCELLED on the
+        engine (vLLM-abort semantics): its queue entry is dropped or
+        its decode slot freed, so an abandoned client stops consuming
+        serving capacity."""
         return self._submit_item(
-            "req", (list(prompt), max_new_tokens, prefix_id), timeout
+            "req", (list(prompt), max_new_tokens, prefix_id), timeout,
+            cancel_on_timeout=True,
         )
 
     def register_prefix(self, tokens, timeout: float = 60.0) -> int:
@@ -117,12 +130,24 @@ class ServingDaemon:
                     )
                     with self._mu:
                         self._waiters[uid] = fut
+                elif kind == "cancel_fut":
+                    # payload IS the abandoned future (fut slot None)
+                    with self._mu:
+                        uid = next(
+                            (u for u, f in self._waiters.items()
+                             if f is payload), None,
+                        )
+                        if uid is not None:
+                            self._waiters.pop(uid, None)
+                    if uid is not None:
+                        self.eng.cancel(uid)
                 elif kind == "prefix":
                     fut.set_result(self.eng.register_prefix(payload))
                 elif kind == "params":
                     fut.set_result(self.eng.set_params(payload))
             except Exception as e:  # noqa: BLE001 — per-request failure
-                fut.set_exception(e)
+                if fut is not None:  # cancel items carry no future
+                    fut.set_exception(e)
             try:
                 item = self._inbox.get_nowait()
             except queue.Empty:
@@ -135,14 +160,14 @@ class ServingDaemon:
         with self._mu:
             waiters, self._waiters = self._waiters, {}
         for fut in waiters.values():
-            if not fut.done():
+            if fut is not None and not fut.done():
                 fut.set_exception(exc)
         while True:
             try:
                 _, _, fut = self._inbox.get_nowait()
             except queue.Empty:
                 break
-            if not fut.done():
+            if fut is not None and not fut.done():
                 fut.set_exception(exc)
 
     def _loop(self):
@@ -459,6 +484,12 @@ def main(argv=None) -> int:
     if ns.speculative_draft > 0:
         from ..models.serving import SpeculativeBatchingEngine
 
+        if ns.temperature != 0.0:
+            ap.error(
+                "--speculative-draft is greedy-only: pass "
+                "--temperature 0.0 (sampled speculation lives in the "
+                "one-shot engine, models/speculative.py)"
+            )
         if ns.cache_layout != "per_row" or ns.decode_chunk != 8:
             logger.warning(
                 "--speculative-draft forces per_row layout with one "

@@ -585,12 +585,7 @@ class ContinuousBatchingEngine:
 
     def _retire(self, slot: int):
         self._finalize_slot(slot)
-        # silence the freed slot until the next admission
-        cache, kv_valid, last_logits, cur_pos, done, row_f = self._state
-        self._state = (
-            cache, kv_valid, last_logits, cur_pos,
-            done.at[slot].set(True), row_f,
-        )
+        self._retire_device_slot(slot)
 
     def _compact(self):
         """Rebuild the cache from live histories; frontier drops from
@@ -706,6 +701,33 @@ class ContinuousBatchingEngine:
             ),
             "last_swap_latency_s": self.swap_latency_s,
         }
+
+    def cancel(self, uid: int) -> bool:
+        """Abort a request (client disconnect / timeout): a queued
+        request is dropped; a decoding request's slot is freed for the
+        next admission (its device row keeps stepping until then —
+        static shapes — but emits to nobody). No Completion is
+        recorded. Returns whether the uid was found live."""
+        for i, item in enumerate(self._queue):
+            if item[0] == uid:
+                del self._queue[i]
+                return True
+        for slot, st in enumerate(self._slots):
+            if st.uid == uid:
+                self._slots[slot] = _Slot()
+                self._retire_device_slot(slot)
+                return True
+        return False
+
+    def _retire_device_slot(self, slot: int) -> None:
+        """Silence a freed slot on the device until the next admission
+        (the done bit makes it emit pad)."""
+        state = self._state
+        done_idx = len(state) - 2  # done is always second-to-last
+        done = state[done_idx].at[slot].set(True)
+        self._state = (
+            *state[:done_idx], done, *state[done_idx + 1:]
+        )
 
     def drain_completions(self) -> List[Completion]:
         """Hand over (and clear) finished requests, uid-ordered."""
@@ -1006,15 +1028,6 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self._slots[slot] = _Slot(
             uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
             admit_t=time.perf_counter(),
-        )
-
-    def _retire(self, slot: int):
-        self._finalize_slot(slot)
-        (t_cache, d_cache, kv_valid, last_logits, cur_pos, done,
-         row_f) = self._state
-        self._state = (
-            t_cache, d_cache, kv_valid, last_logits, cur_pos,
-            done.at[slot].set(True), row_f,
         )
 
     def step(self, rng):

@@ -654,3 +654,91 @@ class TestSpeculativeServing:
         stats = eng.stats()
         assert stats["speculative_num_draft"] == 2
         assert stats["self_drafting"] is True
+
+
+class TestCancellation:
+    """vLLM-abort semantics: a cancelled request stops consuming
+    capacity — queued entries drop, decoding slots free for the next
+    admission — and the survivors stay token-exact."""
+
+    @pytest.mark.parametrize("layout", ["frontier", "per_row"])
+    def test_cancel_queued_and_inflight(self, layout):
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=12, temperature=0.0)
+        prompts = _mixed_prompts(6, rng_seed=4)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout=layout,
+        )
+        uids = [eng.submit(p) for p in prompts]
+        rng = jax.random.PRNGKey(0)
+        rng, sub = jax.random.split(rng)
+        eng.step(sub)  # uids 0,1 decoding; 2..5 queued
+        assert eng.cancel(uids[1]) is True  # in-flight
+        assert eng.cancel(uids[3]) is True  # queued
+        assert eng.cancel(999) is False
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        got = {c.uid: c.tokens for c in eng.drain_completions()}
+        assert set(got) == {uids[0], uids[2], uids[4], uids[5]}
+        want = _reference_completions(model, params, prompts, sampling)
+        for i in (0, 2, 4, 5):
+            assert got[uids[i]] == want[i], i
+
+    def test_cancel_speculative(self):
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = _model(seq=512)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(4, rng_seed=6)
+        eng = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            num_draft=2,
+        )
+        uids = [eng.submit(p) for p in prompts]
+        rng = jax.random.PRNGKey(0)
+        rng, sub = jax.random.split(rng)
+        eng.step(sub)
+        assert eng.cancel(uids[0]) is True
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        got = {c.uid: c.tokens for c in eng.drain_completions()}
+        want = _reference_completions(model, params, prompts, sampling)
+        assert uids[0] not in got
+        for i in (1, 2, 3):
+            assert got[uids[i]] == want[i], i
+
+    def test_daemon_timeout_cancels(self):
+        from dlrover_tpu.launcher.serve import ServingDaemon
+
+        model = _model(seq=256)
+        params = _params(model)
+        # long budget: a 0-second client timeout fires long before
+        # the completion can
+        sampling = SamplingConfig(max_new_tokens=24, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=1, prompt_width=8,
+            decode_chunk=2, cache_layout="per_row",
+        )
+        daemon = ServingDaemon(eng).start()
+        try:
+            import concurrent.futures
+
+            with pytest.raises(concurrent.futures.TimeoutError):
+                daemon.complete([5, 9, 2], timeout=0.01)
+            # the abandoned request must eventually STOP consuming the
+            # slot: the engine drains with no completion recorded
+            deadline = time.time() + 30
+            while time.time() < deadline and eng.pending:
+                time.sleep(0.1)
+            assert not eng.pending
+            assert daemon.served == 0
+            # capacity is actually free again: a new request completes
+            c = daemon.complete([7, 1], timeout=120)
+            assert len(c.tokens) == 24
+        finally:
+            daemon.stop()

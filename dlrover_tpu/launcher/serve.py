@@ -9,7 +9,10 @@ serving pytree), stand up the continuous-batching scheduler
 
     POST /v1/completions        {"prompt": [ids...],
                                  "max_tokens": n?,
-                                 "prefix_id": id?}          → completion
+                                 "prefix_id": id?,
+                                 "stream": bool?}           → completion, or
+                                 chunked NDJSON token stream with a final
+                                 done-line when "stream": true
     POST /v1/prefixes           {"tokens": [ids...]}        → {"prefix_id"}
                                 (shared system prompt: prefilled once,
                                  reused by every request that names it)
@@ -32,6 +35,7 @@ import json
 import os
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -54,6 +58,8 @@ class ServingDaemon:
         self._rng = jax.random.PRNGKey(rng_seed)
         self._inbox: "queue.Queue[tuple]" = queue.Queue()
         self._waiters = {}
+        self._stream_uids = set()
+        self._stream_done = {}
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self.served = 0
@@ -103,6 +109,60 @@ class ServingDaemon:
             cancel_on_timeout=True,
         )
 
+    def submit_streaming(
+        self, prompt, max_new_tokens=None, prefix_id=None,
+        timeout: float = 60.0,
+    ) -> int:
+        """Submit WITHOUT blocking for the completion: returns the uid
+        as soon as the driver enqueues the request. Pair with
+        :meth:`partial` to stream tokens as they are emitted and with
+        :meth:`result` to collect the final Completion."""
+        return self._submit_item(
+            "req_stream", (list(prompt), max_new_tokens, prefix_id),
+            timeout, cancel_on_timeout=True,
+        )
+
+    def partial(self, uid: int):
+        """(tokens emitted so far, finished) for a streaming uid.
+        Reads the driver-owned slot state under the GIL (list appends
+        are atomic; a torn read only under-reports by one token, which
+        the next poll delivers). finished=True once the Completion is
+        collectable via :meth:`result`."""
+        with self._mu:
+            done = self._stream_done.get(uid)
+        if isinstance(done, Exception):
+            raise done  # the driver failed this stream: fail fast
+        if done is not None:
+            return list(done.tokens), True
+        toks = self.eng.partial(uid)
+        if toks is not None:
+            return toks, False
+        # not in a slot and not finished: still queued (or cancelled)
+        return [], False
+
+    def result(self, uid: int, timeout: float = 300.0):
+        """Block for a streaming request's final Completion."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                c = self._stream_done.pop(uid, None)
+            if isinstance(c, Exception):
+                raise c
+            if c is not None:
+                return c
+            if self._stop.is_set():
+                raise RuntimeError("serving daemon stopped")
+            time.sleep(0.02)
+        self.cancel(uid)
+        raise FutureTimeout(f"streaming uid {uid} timed out")
+
+    def cancel(self, uid: int, timeout: float = 30.0) -> bool:
+        """Abort a request by uid (streaming clients that disconnect)."""
+        try:
+            return self._submit_item("cancel_uid", uid, timeout)
+        except Exception:  # noqa: BLE001 — daemon stopping
+            return False
+
     def register_prefix(self, tokens, timeout: float = 60.0) -> int:
         """Register a shared prompt prefix on the engine (computed
         lazily, invalidated by weight swaps)."""
@@ -130,8 +190,26 @@ class ServingDaemon:
                     )
                     with self._mu:
                         self._waiters[uid] = fut
+                elif kind == "req_stream":
+                    prompt, cap, prefix_id = payload
+                    uid = self.eng.submit(
+                        prompt, max_new_tokens=cap, prefix_id=prefix_id
+                    )
+                    with self._mu:
+                        self._stream_uids.add(uid)
+                    fut.set_result(uid)
+                elif kind == "cancel_uid":
+                    with self._mu:
+                        self._waiters.pop(payload, None)
+                        self._stream_uids.discard(payload)
+                        self._stream_done.pop(payload, None)
+                    fut.set_result(self.eng.cancel(payload))
                 elif kind == "cancel_fut":
-                    # payload IS the abandoned future (fut slot None)
+                    # payload IS the abandoned future (fut slot None).
+                    # A plain completion's future is findable in
+                    # _waiters; a streaming submit's future resolved
+                    # with the uid at enqueue time (FIFO guarantees the
+                    # req_stream item was processed before this one).
                     with self._mu:
                         uid = next(
                             (u for u, f in self._waiters.items()
@@ -139,6 +217,13 @@ class ServingDaemon:
                         )
                         if uid is not None:
                             self._waiters.pop(uid, None)
+                    if uid is None and payload.done():
+                        r = payload.result()
+                        if isinstance(r, int):
+                            uid = r
+                            with self._mu:
+                                self._stream_uids.discard(uid)
+                                self._stream_done.pop(uid, None)
                     if uid is not None:
                         self.eng.cancel(uid)
                 elif kind == "prefix":
@@ -159,6 +244,11 @@ class ServingDaemon:
         their timeouts against a server whose /healthz still says OK."""
         with self._mu:
             waiters, self._waiters = self._waiters, {}
+            # fail in-flight STREAMS fast too: park the exception where
+            # partial()/result() will find (and raise) it
+            for uid in self._stream_uids:
+                self._stream_done[uid] = exc
+            self._stream_uids.clear()
         for fut in waiters.values():
             if fut is not None and not fut.done():
                 fut.set_exception(exc)
@@ -183,8 +273,14 @@ class ServingDaemon:
                 for c in self.eng.drain_completions():
                     with self._mu:
                         fut = self._waiters.pop(c.uid, None)
+                        streaming = c.uid in self._stream_uids
+                        if streaming:
+                            self._stream_uids.discard(c.uid)
+                            self._stream_done[c.uid] = c
                     if fut is not None:
                         fut.set_result(c)
+                        self.served += 1
+                    elif streaming:
                         self.served += 1
             except Exception as e:  # noqa: BLE001 — driver must not die silently
                 logger.exception("serving driver error: %s", e)
@@ -260,6 +356,10 @@ def _restore_params(model, mesh, ckpt_dir: str):
 
 def _make_handler(daemon: ServingDaemon, reload_fn):
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1: chunked transfer (streaming completions) needs it;
+        # _send always sets Content-Length so keep-alive stays sound
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("serve: " + fmt, *args)
 
@@ -292,6 +392,73 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
+        def _stream_completion(self, prompt, max_tokens, prefix_id,
+                               timeout):
+            """NDJSON chunked streaming: one {"tokens": [...]} line per
+            poll with NEW tokens, then a final line with the full
+            completion + metrics. ANY socket failure (client gone,
+            reset, timeout) cancels the request on the engine — a dead
+            client must not keep consuming decode capacity."""
+            try:
+                uid = daemon.submit_streaming(
+                    prompt, max_new_tokens=max_tokens,
+                    prefix_id=prefix_id,
+                )
+            except ValueError as e:
+                self._send(400, {"error": repr(e)[:200]})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": repr(e)[:200]})
+                return
+
+            def chunk(obj):
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            sent = 0
+            deadline = time.monotonic() + timeout
+            try:
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/x-ndjson"
+                )
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while time.monotonic() < deadline:
+                    toks, finished = daemon.partial(uid)
+                    if len(toks) > sent:
+                        chunk({"uid": uid, "tokens": toks[sent:]})
+                        sent = len(toks)
+                    if finished:
+                        c = daemon.result(uid, timeout=5.0)
+                        chunk({
+                            "uid": c.uid,
+                            "done": True,
+                            "tokens": c.tokens,
+                            "logprobs": c.logprobs,
+                            "queue_s": round(c.queue_s, 4),
+                            "ttft_s": round(c.ttft_s, 4),
+                            "total_s": round(c.total_s, 4),
+                        })
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        return
+                    time.sleep(0.02)
+                daemon.cancel(uid)
+                chunk({"uid": uid, "error": "timeout"})
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                daemon.cancel(uid)  # client hung up: free the slot
+            except Exception as e:  # noqa: BLE001 — driver-side failure
+                daemon.cancel(uid)
+                try:
+                    chunk({"uid": uid, "error": repr(e)[:200]})
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
         def do_POST(self):
             try:
                 body = self._body()
@@ -314,12 +481,23 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                 ):
                     self._send(400, {"error": "max_tokens must be int"})
                     return
+                stream = bool(body.get("stream", False))
                 prefix_id = body.get("prefix_id")
                 if prefix_id is not None and (
                     isinstance(prefix_id, bool)
                     or not isinstance(prefix_id, int)
                 ):
                     self._send(400, {"error": "prefix_id must be int"})
+                    return
+                if stream:
+                    try:
+                        stream_timeout = float(body.get("timeout", 300.0))
+                    except (TypeError, ValueError):
+                        self._send(400, {"error": "timeout must be a number"})
+                        return
+                    self._stream_completion(
+                        prompt, max_tokens, prefix_id, stream_timeout
+                    )
                     return
                 try:
                     c = daemon.complete(

@@ -702,6 +702,18 @@ class ContinuousBatchingEngine:
             "last_swap_latency_s": self.swap_latency_s,
         }
 
+    def partial(self, uid: int):
+        """Tokens emitted so far for a live uid, or None if the uid is
+        not currently decoding (queued, finished, or unknown). Safe to
+        call from other threads: list appends are GIL-atomic and a torn
+        read only under-reports by one token, which the caller's next
+        poll delivers. The streaming read API — external callers must
+        not reach into slot internals."""
+        for st in self._slots:
+            if st.uid == uid:
+                return list(st.emitted)
+        return None
+
     def cancel(self, uid: int) -> bool:
         """Abort a request (client disconnect / timeout): a queued
         request is dropped; a decoding request's slot is freed for the

@@ -239,3 +239,61 @@ class TestHealthzStats:
         assert h["busy_slots"] == 0 and h["queue_depth"] == 0
         assert h["registered_prefixes"] == 0
         assert h["kv_cache_int8"] is False
+
+
+class TestStreaming:
+    def test_stream_tokens_arrive_incrementally(self, server):
+        """stream=true: chunked NDJSON with partial token lines, then a
+        final done-line equal to the non-streamed completion."""
+        base, model, params, sampling, _ = server
+        prompt = [5, 9, 2]
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": prompt, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers.get("Content-Type") == "application/x-ndjson"
+            for raw in r:
+                raw = raw.strip()
+                if raw:
+                    lines.append(json.loads(raw))
+        assert lines, "no stream lines"
+        final = lines[-1]
+        assert final.get("done") is True
+        streamed = [t for ln in lines[:-1] for t in ln["tokens"]]
+        # the final line carries the full sequence; incremental lines
+        # must concatenate to its prefix (the last poll may batch the
+        # tail into the done-line)
+        assert streamed == final["tokens"][: len(streamed)]
+        _, plain = _post(base, "/v1/completions", {"prompt": prompt})
+        assert final["tokens"] == plain["tokens"]
+
+    def test_stream_and_plain_interleave(self, server):
+        """A streaming request and plain requests share the decode
+        slots; both finish with exact outputs."""
+        base = server[0]
+        results = {}
+
+        def plain(i):
+            _, results[i] = _post(
+                base, "/v1/completions", {"prompt": [7, 1, i]}
+            )
+
+        t = threading.Thread(target=plain, args=(2,))
+        t.start()
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps(
+                {"prompt": [5, 9, 2], "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            lines = [json.loads(x) for x in r if x.strip()]
+        t.join(120)
+        assert lines[-1]["done"] is True
+        assert len(results[2]["tokens"]) == 6

@@ -96,7 +96,7 @@ class ServingDaemon:
 
     def complete(
         self, prompt, timeout: float = 300.0, max_new_tokens=None,
-        prefix_id=None,
+        prefix_id=None, allowed_tokens=None,
     ):
         """Submit one prompt; block until its Completion arrives.
         With ``prefix_id``, ``prompt`` is the suffix after that
@@ -105,20 +105,22 @@ class ServingDaemon:
         its decode slot freed, so an abandoned client stops consuming
         serving capacity."""
         return self._submit_item(
-            "req", (list(prompt), max_new_tokens, prefix_id), timeout,
-            cancel_on_timeout=True,
+            "req", (list(prompt), max_new_tokens, prefix_id,
+                    allowed_tokens),
+            timeout, cancel_on_timeout=True,
         )
 
     def submit_streaming(
         self, prompt, max_new_tokens=None, prefix_id=None,
-        timeout: float = 60.0,
+        allowed_tokens=None, timeout: float = 60.0,
     ) -> int:
         """Submit WITHOUT blocking for the completion: returns the uid
         as soon as the driver enqueues the request. Pair with
         :meth:`partial` to stream tokens as they are emitted and with
         :meth:`result` to collect the final Completion."""
         return self._submit_item(
-            "req_stream", (list(prompt), max_new_tokens, prefix_id),
+            "req_stream", (list(prompt), max_new_tokens, prefix_id,
+                           allowed_tokens),
             timeout, cancel_on_timeout=True,
         )
 
@@ -184,16 +186,18 @@ class ServingDaemon:
             kind, payload, fut = item
             try:
                 if kind == "req":
-                    prompt, cap, prefix_id = payload
+                    prompt, cap, prefix_id, allowed = payload
                     uid = self.eng.submit(
-                        prompt, max_new_tokens=cap, prefix_id=prefix_id
+                        prompt, max_new_tokens=cap, prefix_id=prefix_id,
+                        allowed_tokens=allowed,
                     )
                     with self._mu:
                         self._waiters[uid] = fut
                 elif kind == "req_stream":
-                    prompt, cap, prefix_id = payload
+                    prompt, cap, prefix_id, allowed = payload
                     uid = self.eng.submit(
-                        prompt, max_new_tokens=cap, prefix_id=prefix_id
+                        prompt, max_new_tokens=cap, prefix_id=prefix_id,
+                        allowed_tokens=allowed,
                     )
                     with self._mu:
                         self._stream_uids.add(uid)
@@ -393,7 +397,7 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                 self._send(404, {"error": f"unknown path {self.path}"})
 
         def _stream_completion(self, prompt, max_tokens, prefix_id,
-                               timeout):
+                               allowed, timeout):
             """NDJSON chunked streaming: one {"tokens": [...]} line per
             poll with NEW tokens, then a final line with the full
             completion + metrics. ANY socket failure (client gone,
@@ -402,7 +406,7 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
             try:
                 uid = daemon.submit_streaming(
                     prompt, max_new_tokens=max_tokens,
-                    prefix_id=prefix_id,
+                    prefix_id=prefix_id, allowed_tokens=allowed,
                 )
             except ValueError as e:
                 self._send(400, {"error": repr(e)[:200]})
@@ -482,6 +486,16 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                     self._send(400, {"error": "max_tokens must be int"})
                     return
                 stream = bool(body.get("stream", False))
+                allowed = body.get("allowed_tokens")
+                if allowed is not None and (
+                    not isinstance(allowed, list)
+                    or not all(isinstance(t, int) for t in allowed)
+                ):
+                    self._send(
+                        400,
+                        {"error": "allowed_tokens must be a list of ids"},
+                    )
+                    return
                 prefix_id = body.get("prefix_id")
                 if prefix_id is not None and (
                     isinstance(prefix_id, bool)
@@ -496,7 +510,8 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         self._send(400, {"error": "timeout must be a number"})
                         return
                     self._stream_completion(
-                        prompt, max_tokens, prefix_id, stream_timeout
+                        prompt, max_tokens, prefix_id, allowed,
+                        stream_timeout,
                     )
                     return
                 try:
@@ -505,6 +520,7 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         timeout=float(body.get("timeout", 300.0)),
                         max_new_tokens=max_tokens,
                         prefix_id=prefix_id,
+                        allowed_tokens=allowed,
                     )
                 except ValueError as e:  # client-side: bad prompt
                     self._send(400, {"error": repr(e)[:200]})

@@ -178,8 +178,12 @@ def sample_step(last_logits, done, rng, s: SamplingConfig):
 
     ``done`` rows emit pad and are masked; an eos sample is emitted
     (the eos token is kept) and marks the row done afterwards.
-    Logprobs are under the raw model distribution (RL behavior
-    logprobs). Shared by the one-shot and continuous engines.
+    Logprobs are computed from the logits AS GIVEN (RL behavior
+    logprobs): the one-shot engine passes raw model logits; the
+    continuous engine may pass per-row MASKED logits (allowed_tokens
+    constrained decoding), in which case the logprobs are under the
+    masked distribution — exactly what the policy could emit. Shared
+    by the one-shot and continuous engines.
     """
     tok = sample_logits(last_logits, rng, s.temperature, s.top_k, s.top_p)
     logp = jax.nn.log_softmax(last_logits, axis=-1)

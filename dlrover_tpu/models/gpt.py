@@ -130,13 +130,16 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
     left-pad); queries at local position i attend valid slots s with
     s <= offset + i.
 
-    ``cache_slots`` [B] int32 switches single-token decode to PER-ROW
-    write slots (the continuous-batching engine's per-row cache layout:
-    every request advances its own frontier, so admissions never leave
-    frontier-wide holes and the stream never compacts). The write is a
-    B-row scatter — tiny (B × KVH × Hd elements) next to the attention
-    pass that reads the whole cache anyway — and the causal mask keys
-    on each row's own slot. Requires an explicit ``kv_valid``.
+    ``cache_slots`` int32 switches to PER-ROW write slots: ``[B]`` for
+    single-token decode (the continuous-batching engine's per-row
+    cache layout: every request advances its own frontier, so
+    admissions never leave frontier-wide holes and the stream never
+    compacts) or ``[B, T]`` for a T-token window written at per-row
+    slots (the in-scheduler speculative verify). The write is a
+    B(×T)-row scatter — tiny next to the attention pass that reads the
+    whole cache anyway — and the causal mask keys on each query's own
+    slot (returned mask is [B, T, max_len]). Requires an explicit
+    ``kv_valid``.
 
     Reference RL rollouts lean on vLLM for this
     (examples/unified/rl/openrlhf/ppo/main.py:26-60); here generation is
@@ -183,23 +186,31 @@ def _update_decode_cache(module, max_len, k, v, kv_valid, cache_slots=None):
         return ck.value, csk.value, cv.value, csv.value, mask
 
     if cache_slots is not None:
-        if T != 1:
-            raise ValueError(
-                f"cache_slots is a single-token decode contract (T={T})"
-            )
         if kv_valid is None:
             raise ValueError("cache_slots mode needs explicit kv_valid")
-        rows = jnp.arange(B)
-        ck.value = ck.value.at[rows, cache_slots].set(k_store[:, 0])
-        cv.value = cv.value.at[rows, cache_slots].set(v_store[:, 0])
+        # [B] (single-token decode) or [B, T] (a T-token window written
+        # at per-row slots — the in-engine speculative verify)
+        slots_bt = (
+            cache_slots[:, None] if cache_slots.ndim == 1 else cache_slots
+        )
+        if slots_bt.shape != (B, T):
+            raise ValueError(
+                f"cache_slots {cache_slots.shape} incompatible with "
+                f"tokens [B={B}, T={T}]"
+            )
+        rows = jnp.arange(B)[:, None]
+        ck.value = ck.value.at[rows, slots_bt].set(k_store)
+        cv.value = cv.value.at[rows, slots_bt].set(v_store)
         if int8_cache:
-            csk.value = csk.value.at[rows, cache_slots].set(k_scale[:, 0])
-            csv.value = csv.value.at[rows, cache_slots].set(v_scale[:, 0])
+            csk.value = csk.value.at[rows, slots_bt].set(k_scale)
+            csv.value = csv.value.at[rows, slots_bt].set(v_scale)
         # cidx (the shared frontier) is meaningless per-row; leave it.
+        # causal per (row, query): query written at slot slots_bt[b, t]
+        # sees valid slots <= its own
         causal = (
-            jnp.arange(max_len)[None, :] <= cache_slots[:, None]
-        )  # [B, max_len]
-        mask = (kv_valid & causal)[:, None, :]  # [B, 1, max_len]
+            jnp.arange(max_len)[None, None, :] <= slots_bt[:, :, None]
+        )  # [B, T, max_len]
+        mask = kv_valid[:, None, :] & causal  # [B, T, max_len]
         return _read(mask)
     offset = cidx.value
     ck.value = jax.lax.dynamic_update_slice(

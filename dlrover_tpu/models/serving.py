@@ -233,15 +233,16 @@ class ContinuousBatchingEngine:
                 kvv[0],
             )
 
-        def admit(state, row_cache, row_logits, row_pos, row_kv, slot,
-                  next_slot):
+        def admit(state, row_cache, row_logits, row_pos, row_kv,
+                  row_allow, slot, next_slot):
             """Insert a prefilled row at ``slot`` (traced — one compile
             covers every slot). The batch cache's shared frontier scalar
             is kept; the row's KV live at low slots, the gap up to the
             frontier is kv_valid=False holes (frontier layout) or
             nothing (per-row layout: the row's own write slot restarts
             at ``next_slot`` = its prompt bucket width)."""
-            cache, kv_valid, last_logits, cur_pos, done, row_f = state
+            (cache, kv_valid, last_logits, cur_pos, allow, done,
+             row_f) = state
             cache = ContinuousBatchingEngine._insert_row(
                 cache, row_cache, slot
             )
@@ -250,6 +251,7 @@ class ContinuousBatchingEngine:
                 kv_valid.at[slot].set(row_kv),
                 last_logits.at[slot].set(row_logits),
                 cur_pos.at[slot].set(row_pos),
+                allow.at[slot].set(row_allow),
                 done.at[slot].set(False),
                 row_f.at[slot].set(next_slot),
             )
@@ -272,11 +274,16 @@ class ContinuousBatchingEngine:
 
             def chunk(params, state, frontier, rng):
                 def step(carry, t):
-                    (cache, kv_valid, last_logits, cur_pos, done, row_f,
-                     rng) = carry
+                    (cache, kv_valid, last_logits, cur_pos, allow, done,
+                     row_f, rng) = carry
                     rng, sub = jax.random.split(rng)
+                    # per-request constrained decoding (RL action
+                    # spaces): sampling AND behavior logprobs come from
+                    # the masked distribution — what the policy can
+                    # actually emit. An all-True row is a no-op.
                     tok, emit, tok_logp, done = sample_step(
-                        last_logits, done, sub, s
+                        jnp.where(allow, last_logits, -jnp.inf), done,
+                        sub, s,
                     )
                     if per_row:
                         write_slots = jnp.minimum(row_f, L - 1)
@@ -300,6 +307,7 @@ class ContinuousBatchingEngine:
                         kv_valid,
                         logits[:, 0].astype(jnp.float32),
                         pos,
+                        allow,
                         done,
                         row_f,
                         rng,
@@ -370,6 +378,7 @@ class ContinuousBatchingEngine:
             jnp.zeros((self.B, self.L), bool),
             jnp.full((self.B, V), -1e9, jnp.float32),
             jnp.zeros((self.B,), jnp.int32),
+            jnp.ones((self.B, V), bool),  # per-row allowed-token mask
             jnp.ones((self.B,), bool),  # empty slots: done (emit pad)
             jnp.zeros((self.B,), jnp.int32),  # per-row write frontier
         )
@@ -416,6 +425,7 @@ class ContinuousBatchingEngine:
         tokens: List[int],
         max_new_tokens: Optional[int] = None,
         prefix_id: Optional[int] = None,
+        allowed_tokens: Optional[List[int]] = None,
     ) -> int:
         """Enqueue a request. ``max_new_tokens`` caps THIS request
         below the engine budget (``sampling.max_new_tokens``, which
@@ -423,7 +433,10 @@ class ContinuousBatchingEngine:
         With ``prefix_id``, ``tokens`` is the SUFFIX after that
         registered prefix; the combined length must still fit
         ``prompt_width`` (prefix caching saves prefill compute, not
-        cache capacity)."""
+        cache capacity). ``allowed_tokens`` constrains THIS request's
+        sampling to the given token ids (RL action spaces / structured
+        output): both the sampled tokens and the behavior logprobs
+        come from the masked distribution."""
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
                 raise ValueError(f"unknown prefix_id {prefix_id}")
@@ -453,10 +466,20 @@ class ContinuousBatchingEngine:
                     f"(the engine's cache budget)"
                 )
             cap = max_new_tokens
+        if allowed_tokens is not None:
+            V = self.model.config.vocab_size
+            allowed_tokens = sorted(set(int(t) for t in allowed_tokens))
+            if not allowed_tokens:
+                raise ValueError("allowed_tokens must not be empty")
+            if allowed_tokens[0] < 0 or allowed_tokens[-1] >= V:
+                raise ValueError(
+                    f"allowed_tokens outside [0, {V})"
+                )
         uid = self._uid
         self._uid += 1
         self._queue.append(
-            (uid, list(tokens), time.perf_counter(), cap, prefix_id)
+            (uid, list(tokens), time.perf_counter(), cap, prefix_id,
+             allowed_tokens)
         )
         return uid
 
@@ -528,7 +551,17 @@ class ContinuousBatchingEngine:
     def _admit_one(
         self, slot: int, uid: int, prompt: List[int], submit_t: float,
         cap: int, prefix_id: Optional[int] = None,
+        allowed_tokens: Optional[List[int]] = None,
     ):
+        V = self.model.config.vocab_size
+        if allowed_tokens is None:
+            row_allow = jnp.ones((V,), bool)
+        else:
+            row_allow = (
+                jnp.zeros((V,), bool)
+                .at[jnp.asarray(allowed_tokens, jnp.int32)]
+                .set(True)
+            )
         with self._ctx():
             if prefix_id is not None:
                 # prefix caching: derive the row from the stored prefix
@@ -556,7 +589,7 @@ class ContinuousBatchingEngine:
                 full_prompt = prompt
             self._state = self._admit_fn(
                 self._state, row_cache, row_logits, row_pos, row_kv,
-                jnp.int32(slot), jnp.int32(width),
+                row_allow, jnp.int32(slot), jnp.int32(width),
             )
         # full prefix+suffix history: compaction (frontier layout)
         # rebuilds rows from these tokens
@@ -600,12 +633,14 @@ class ContinuousBatchingEngine:
             cache, kv_valid, last_logits, cur_pos = self._compact_for(
                 width
             )(self.params, toks, mask)
-        _, _, _, _, done, row_f = self._state
+        _, _, _, _, allow, done, row_f = self._state
         # frontier never drops below Pw: future admissions put prompt
         # KV at [0, W<=Pw) and decode writes must stay clear of it
         self._frontier = max(width, self.Pw)
         cache = self._set_cache_frontier(cache, self._frontier)
-        self._state = (cache, kv_valid, last_logits, cur_pos, done, row_f)
+        self._state = (
+            cache, kv_valid, last_logits, cur_pos, allow, done, row_f
+        )
 
     def step(self, rng):
         """One scheduler iteration: compact if out of headroom
@@ -637,8 +672,12 @@ class ContinuousBatchingEngine:
                 self._frontier + self._queue[0][3] > self.L
             ):
                 break  # no room for this request until compaction
-            uid, prompt, submit_t, cap, prefix_id = self._queue.pop(0)
-            self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
+            (uid, prompt, submit_t, cap, prefix_id, allowed) = (
+                self._queue.pop(0)
+            )
+            self._admit_one(
+                slot, uid, prompt, submit_t, cap, prefix_id, allowed
+            )
 
         with self._ctx():
             if frontier_layout:
@@ -657,7 +696,7 @@ class ContinuousBatchingEngine:
                     )
                 )
         toks, emits, logps, done = jax.device_get(
-            (toks, emits, logps, self._state[4])
+            (toks, emits, logps, self._state[-2])  # -2: the done flags
         )
         emitted = 0
         for slot, st in enumerate(self._slots):
@@ -1005,9 +1044,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         # every later completion
         raise ValueError(self._NO_PREFIX)
 
-    def submit(self, tokens, max_new_tokens=None, prefix_id=None):
+    def submit(self, tokens, max_new_tokens=None, prefix_id=None,
+               allowed_tokens=None):
         if prefix_id is not None:
             raise ValueError(self._NO_PREFIX)
+        if allowed_tokens is not None:
+            raise ValueError(
+                "allowed_tokens is not available in speculative serving"
+            )
         return super().submit(tokens, max_new_tokens=max_new_tokens)
 
     def set_params(self, params, draft_params=None) -> float:
@@ -1023,7 +1067,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         return latency
 
     def _admit_one(
-        self, slot, uid, prompt, submit_t, cap, prefix_id=None
+        self, slot, uid, prompt, submit_t, cap, prefix_id=None,
+        allowed_tokens=None,
     ):
         width = self._bucket_width(len(prompt))
         toks, mask = self._pad_rows([prompt], width)
@@ -1050,7 +1095,9 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
                 continue
-            uid, prompt, submit_t, cap, prefix_id = self._queue.pop(0)
+            (uid, prompt, submit_t, cap, prefix_id, _allowed) = (
+                self._queue.pop(0)
+            )
             self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
 
         with self._ctx():
@@ -1058,7 +1105,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 self.params, self.draft_params, self._state
             )
         win, accept, logps, done = jax.device_get(
-            (win, accept, logps, self._state[5])
+            (win, accept, logps, self._state[-2])  # -2: the done flags
         )
         emitted = 0
         self.rounds += 1

@@ -297,3 +297,37 @@ class TestStreaming:
         t.join(120)
         assert lines[-1]["done"] is True
         assert len(results[2]["tokens"]) == 6
+
+
+class TestConstrainedHttp:
+    def test_allowed_tokens_over_http(self, server):
+        """allowed_tokens forwards through the daemon payload on both
+        the blocking and streaming paths; bad values 400."""
+        base = server[0]
+        allowed = [3, 9, 17]
+        _, c = _post(
+            base, "/v1/completions",
+            {"prompt": [5, 9, 2], "allowed_tokens": allowed},
+        )
+        assert c["tokens"] and all(t in allowed for t in c["tokens"])
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({
+                "prompt": [5, 9, 2], "allowed_tokens": allowed,
+                "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            lines = [json.loads(x) for x in r if x.strip()]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == c["tokens"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions",
+                  {"prompt": [1], "allowed_tokens": "nope"})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/completions",
+                  {"prompt": [1], "allowed_tokens": []})
+        assert ei.value.code == 400

@@ -742,3 +742,90 @@ class TestCancellation:
             assert len(c.tokens) == 24
         finally:
             daemon.stop()
+
+
+class TestConstrainedDecoding:
+    """Per-request allowed_tokens (RL action spaces / structured
+    output): sampling and behavior logprobs come from the masked
+    distribution; unconstrained rows in the same batch are unaffected."""
+
+    @staticmethod
+    def _masked_reference(model, params, prompt, allowed, n):
+        """Greedy decode constrained to `allowed`, built directly on
+        the decode contract (the one-shot engine has no mask arg)."""
+        from dlrover_tpu.models.generation import (
+            decode_apply,
+            left_pad_prompts,
+            prefill_prompt,
+        )
+
+        toks, mask = left_pad_prompts([prompt])
+        cache, last, pos, kvv = prefill_prompt(
+            model, params, toks, mask
+        )
+        L = model.config.max_seq_len
+        V = model.config.vocab_size
+        allow = np.zeros((V,), bool)
+        allow[allowed] = True
+        T0 = toks.shape[1]
+        out = []
+        for t in range(n):
+            logits = np.array(last)[0]  # writable copy
+            logits[~allow] = -np.inf
+            tok = int(np.argmax(logits))
+            out.append(tok)
+            kvv = kvv | (jnp.arange(L)[None, :] == T0 + t)
+            pos = pos + 1
+            nxt, cache = decode_apply(
+                model, params, cache,
+                jnp.asarray([[tok]], jnp.int32), pos[:, None], kvv,
+            )
+            last = nxt[:, 0].astype(jnp.float32)
+        return out
+
+    @pytest.mark.parametrize("layout", ["frontier", "per_row"])
+    def test_constrained_matches_masked_reference(self, layout):
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        allowed = [3, 9, 17, 33, 40]
+        prompt = [5, 9, 2]
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=8,
+            decode_chunk=4, cache_layout=layout,
+        )
+        # one constrained and one unconstrained request share the batch
+        uid_c = eng.submit(prompt, allowed_tokens=allowed)
+        uid_u = eng.submit(prompt)
+        rng = jax.random.PRNGKey(0)
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        got = {c.uid: c for c in eng.drain_completions()}
+        want_c = self._masked_reference(model, params, prompt, allowed, 8)
+        assert got[uid_c].tokens == want_c
+        assert all(t in allowed for t in got[uid_c].tokens)
+        want_u = _reference_completions(model, params, [prompt], sampling)
+        assert got[uid_u].tokens == want_u[0]
+        # behavior logprobs are from the MASKED distribution: finite
+        assert all(np.isfinite(got[uid_c].logprobs))
+
+    def test_allowed_tokens_validation(self):
+        model = _model(seq=256)
+        eng = ContinuousBatchingEngine(
+            model, _params(model), SamplingConfig(max_new_tokens=4),
+            batch_size=2, prompt_width=8,
+        )
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit([1], allowed_tokens=[])
+        with pytest.raises(ValueError, match="outside"):
+            eng.submit([1], allowed_tokens=[999])
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        sp = SpeculativeBatchingEngine(
+            model, _params(model),
+            SamplingConfig(max_new_tokens=4, temperature=0.0),
+            batch_size=2, prompt_width=8, num_draft=2,
+        )
+        with pytest.raises(ValueError, match="allowed_tokens"):
+            sp.submit([1], allowed_tokens=[3])

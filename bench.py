@@ -1148,6 +1148,33 @@ def _interposed_metrics():
 def worker():
     extra = {}
     interposed = False
+    # pid-unique IPC namespace: the checkpoint section spins up
+    # socket-served queues named by the job namespace, and two
+    # concurrent bench processes (chip-watcher capture overlapping a
+    # manual smoke run) under the same name race for the sockets —
+    # SILICON_r05_1785597608 lost its ckpt section to exactly that
+    # ("IPC server queue_ckpt_events unavailable"). Override BOTH vars:
+    # DLROVER_IPC_NAMESPACE, when inherited from a harness shell, wins
+    # over DLROVER_JOB_NAME (multi_process._ipc_namespace).
+    os.environ["DLROVER_JOB_NAME"] = f"bench_{os.getpid()}"
+    os.environ["DLROVER_IPC_NAMESPACE"] = f"bench_{os.getpid()}"
+    # Reclaim segments orphaned by SIGKILLed earlier workers (the
+    # orchestrator's subprocess timeout skips their unlink; pid-unique
+    # names mean nobody else ever reopens them): any
+    # /dev/shm/dlrover_bench_<pid>_* whose pid is dead is ~1.5 GB of
+    # tmpfs nobody can free but us.
+    try:
+        import re
+
+        for seg in os.listdir("/dev/shm"):
+            m = re.match(r"dlrover_bench_(\d+)_", seg)
+            if m and not os.path.exists(f"/proc/{m.group(1)}"):
+                try:
+                    os.unlink(os.path.join("/dev/shm", seg))
+                except OSError:
+                    pass
+    except OSError:
+        pass
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # This environment's sitecustomize re-registers the hardware
         # plugin after env-var resolution, so pin explicitly.

@@ -46,6 +46,19 @@ TARGET_SAVE_BLOCK_S = 5.0  # BASELINE.json north star
 
 METRIC = "gpt2s_train_tokens_per_s"
 
+# Section-level error keys that mean a capture LOST a headline section
+# (vs optional probe rungs that degrade into *_error by design — batch
+# walk ends on OOM, int8/f32/spec sub-rungs may fail while the section
+# headline stands). Owned here, next to the emitters, so a new section
+# adds its key in the same diff; the chip watcher imports this to gate
+# SILICON_LATEST promotion.
+HEADLINE_SECTION_ERRORS = frozenset({
+    "tpu_error", "fatal_error", "dense_error", "ckpt_error",
+    "flash_seq4096_error", "decode_error", "spec_error",
+    "serving_error", "serving_per_row_error", "llama_family_error",
+    "longseq_train_error",
+})
+
 # ---------------------------------------------------------------------------
 # Orchestrator — no jax imports in this half.
 # ---------------------------------------------------------------------------
@@ -1445,6 +1458,15 @@ def worker():
                 win = dict(hk)
                 if rung_won:
                     win.update(dict(variants)[best_label])
+                # No early break on a non-improving rung: the r5 silicon
+                # capture showed a NON-monotonic batch response (b48
+                # regressed to 104.5k tok/s while b32 held 114.9k —
+                # late-bench allocator fragmentation), so breaking at the
+                # first loss would hide a b64 win. Only OOM ends the walk.
+                # Label from the PRE-walk config: if both b48 and b64
+                # win, stacking suffixes off the live headline would
+                # yield a self-contradictory "…+b48+b64".
+                walk_base_label = extra.get("headline_config", "flash")
                 for bb in (hb * 3 // 2, hb * 2):
                     try:
                         _, bstate, bstep, bx, by = _build(
@@ -1454,14 +1476,10 @@ def worker():
                         tps = bb * seq / bs_s
                         extra[f"batch{bb}_step_s"] = round(bs_s, 4)
                         extra[f"batch{bb}_tokens_per_s"] = round(tps, 1)
-                        if tps <= flash_tps:
-                            break  # bigger batch stopped paying
-                        take_headline(
-                            extra.get("headline_config", "flash")
-                            + f"+b{bb}",
-                            bb,
-                            bs_s,
-                        )
+                        if tps > flash_tps:
+                            take_headline(
+                                walk_base_label + f"+b{bb}", bb, bs_s
+                            )
                     except Exception as e:  # noqa: BLE001 — e.g. OOM
                         extra[f"batch{bb}_error"] = repr(e)[:160]
                         break

@@ -404,7 +404,37 @@ def capture_silicon(log_path, bench_timeout):
     sidecar = (parsed or {}).get("extra", {}).get("probe_sidecar")
     if sidecar and os.path.exists(os.path.join(REPO, sidecar)):
         paths.append(os.path.join(REPO, sidecar))
-    if on_tpu and parsed:
+    # Promote to SILICON_LATEST only when the capture kept every
+    # headline SECTION (taxonomy owned by bench.py, next to the
+    # emitters). An on-TPU capture that lost one (e.g. the ckpt block
+    # when the chip wedged mid-bench) must not displace a COMPLETE
+    # older pointer: the driver bench merges SILICON_LATEST into
+    # extra.last_silicon, and that record is the round's citable
+    # headline set (this round needed a manual repoint for exactly
+    # this case — commit 73b84be). An incomplete capture may still
+    # replace a missing or equally-incomplete pointer: among
+    # incomplete records the newest sha wins, and the first-ever
+    # capture always lands (outage-day driver benches would otherwise
+    # carry nothing).
+    from bench import HEADLINE_SECTION_ERRORS
+
+    blocking_errors = sorted(
+        HEADLINE_SECTION_ERRORS & set((parsed or {}).get("extra", {}))
+    )
+    latest_path = os.path.join(REPO, "SILICON_LATEST.json")
+    latest_is_complete = False
+    if os.path.exists(latest_path):
+        try:
+            with open(latest_path) as f:
+                latest_is_complete = not json.load(f).get(
+                    "incomplete_sections"
+                )
+        except (OSError, ValueError):
+            latest_is_complete = False
+    promote = bool(on_tpu and parsed) and (
+        not blocking_errors or not latest_is_complete
+    )
+    if promote:
         extra = parsed.get("extra", {})
         latest = {
             "ts": ts,
@@ -426,13 +456,25 @@ def capture_silicon(log_path, bench_timeout):
                     "longseq_train_tokens_per_s", "longseq_train_mfu",
                     "ckpt_async_stage_block_s",
                     "goodput_ckpt_every_10_steps",
+                    "serving_per_row_tokens_per_s",
+                    "serving_per_row_vs_frontier",
+                    "serving_spec_tokens_per_s",
+                    "serving_spec_vs_per_row",
+                    "serving_spec_acceptance",
                 )
                 if k in extra
             },
         }
-        with open(os.path.join(REPO, "SILICON_LATEST.json"), "w") as f:
+        if blocking_errors:
+            latest["incomplete_sections"] = blocking_errors
+        with open(latest_path, "w") as f:
             json.dump(latest, f, indent=1)
-        paths.append(os.path.join(REPO, "SILICON_LATEST.json"))
+        paths.append(latest_path)
+    elif on_tpu and blocking_errors:
+        _log(log_path, {
+            "silicon_latest_skip": os.path.basename(art),
+            "section_errors": blocking_errors[:8],
+        })
     _commit(
         paths,
         f"Capture {'silicon' if on_tpu else 'attempted-silicon'} bench "

@@ -156,6 +156,140 @@ class TestCaptureSilicon:
         assert logged[-1]["on_silicon"] is True
         assert "rc" not in logged[-1]  # must not pollute probe stats
 
+    def test_incomplete_capture_keeps_existing_latest(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """An on-TPU capture that lost a section (any *_error key in
+        extra) must commit its artifact but NOT displace the existing
+        complete SILICON_LATEST pointer (the mid-bench-wedge case that
+        needed a manual repoint in r5)."""
+        existing = {"ts": 1, "value": 111111.0, "headline": {"mfu": 0.5}}
+        (fake_repo / "SILICON_LATEST.json").write_text(json.dumps(existing))
+        line = json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_s",
+                "value": 99999.0,
+                "unit": "tokens/s",
+                "vs_baseline": 1.1,
+                "extra": {
+                    "device": "TPU_v5e(chip=0)",
+                    "mfu": 0.4,
+                    "ckpt_error": "RuntimeError('chip wedged mid-save')",
+                },
+            }
+        )
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD",
+            _child_script(tmp_path, f"print({line!r})", name="bench_err.py"),
+        )
+        log = tmp_path / "w.jsonl"
+        ok = chip_watch.capture_silicon(str(log), bench_timeout=60)
+        assert ok is True  # it IS a silicon capture — just incomplete
+        latest = json.load(open(fake_repo / "SILICON_LATEST.json"))
+        assert latest["value"] == 111111.0  # untouched
+        logged = [json.loads(l) for l in open(log)]
+        skip = [r for r in logged if "silicon_latest_skip" in r]
+        assert skip and skip[0]["section_errors"] == ["ckpt_error"]
+
+    def test_optional_rung_error_still_promotes(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """Bench walks some ladders UNTIL failure by design (batch walk
+        ends on OOM, int8/f32 sub-rungs may degrade) — those *_error
+        keys must not veto promotion of a healthy headline."""
+        line = json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_s",
+                "value": 130000.0,
+                "unit": "tokens/s",
+                "vs_baseline": 1.4,
+                "extra": {
+                    "device": "TPU_v5e(chip=0)",
+                    "mfu": 0.53,
+                    "batch64_error": "RESOURCE_EXHAUSTED",
+                    "decode_int8_error": "XlaRuntimeError(...)",
+                },
+            }
+        )
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD",
+            _child_script(tmp_path, f"print({line!r})", name="bench_opt.py"),
+        )
+        ok = chip_watch.capture_silicon(
+            str(tmp_path / "w.jsonl"), bench_timeout=60
+        )
+        assert ok is True
+        latest = json.load(open(fake_repo / "SILICON_LATEST.json"))
+        assert latest["value"] == 130000.0
+        assert "incomplete_sections" not in latest
+
+    def test_first_capture_promotes_even_incomplete(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """No SILICON_LATEST yet: an incomplete capture beats no
+        pointer at all — promote it, flagged."""
+        line = json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_s",
+                "value": 88888.0,
+                "unit": "tokens/s",
+                "vs_baseline": 1.0,
+                "extra": {
+                    "device": "TPU_v5e(chip=0)",
+                    "mfu": 0.4,
+                    "ckpt_error": "chip wedged",
+                },
+            }
+        )
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD",
+            _child_script(tmp_path, f"print({line!r})", name="bench_1st.py"),
+        )
+        ok = chip_watch.capture_silicon(
+            str(tmp_path / "w.jsonl"), bench_timeout=60
+        )
+        assert ok is True
+        latest = json.load(open(fake_repo / "SILICON_LATEST.json"))
+        assert latest["value"] == 88888.0
+        assert latest["incomplete_sections"] == ["ckpt_error"]
+
+    def test_incomplete_capture_replaces_incomplete_latest(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """Among incomplete records the newest wins: an incomplete
+        capture may replace a pointer that is itself flagged
+        incomplete_sections — just never a complete one."""
+        existing = {
+            "ts": 1,
+            "value": 111111.0,
+            "incomplete_sections": ["ckpt_error"],
+        }
+        (fake_repo / "SILICON_LATEST.json").write_text(json.dumps(existing))
+        line = json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_s",
+                "value": 99999.0,
+                "unit": "tokens/s",
+                "vs_baseline": 1.1,
+                "extra": {
+                    "device": "TPU_v5e(chip=0)",
+                    "mfu": 0.45,
+                    "ckpt_error": "still wedging",
+                },
+            }
+        )
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD",
+            _child_script(tmp_path, f"print({line!r})", name="bench_inc.py"),
+        )
+        ok = chip_watch.capture_silicon(
+            str(tmp_path / "w.jsonl"), bench_timeout=60
+        )
+        assert ok is True
+        latest = json.load(open(fake_repo / "SILICON_LATEST.json"))
+        assert latest["value"] == 99999.0  # newest incomplete wins
+        assert latest["incomplete_sections"] == ["ckpt_error"]
+
     def test_cpu_fallback_is_not_marked_silicon(
         self, tmp_path, monkeypatch, fake_repo
     ):

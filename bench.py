@@ -84,6 +84,21 @@ PROBE_WINDOW_S = float(os.environ.get("DLROVER_BENCH_PROBE_WINDOW_S", 1500.0))
 PROBE_TIMEOUT_S = float(os.environ.get("DLROVER_BENCH_PROBE_TIMEOUT_S", 180.0))
 # Generous: a full worker now includes the ~8 min goodput storm on top
 # of the model/ckpt sections (and first TPU compiles are slow).
+# Total wall budget for the WHOLE orchestration (probe + TPU attempts
+# + CPU fallback). 0 = unbounded (the driver's direct run owns its own
+# timeout). The chip watcher sets this just under its kill timeout so
+# bench stops starting attempts it can't finish and always reaches the
+# emit: without it, attempt 1 overrunning (e.g. a loaded box stretching
+# a 23-min bench past the 45-min per-attempt cap) left attempt 2 doomed
+# to die by SIGKILL mid-run with NO JSON line — the exact parse-nothing
+# artifact r4 was dinged for, reproduced live this round.
+TOTAL_BUDGET_S = float(os.environ.get("DLROVER_BENCH_TOTAL_BUDGET_S", 0) or 0)
+# Budget slice an attempt must have left to be worth starting: a full
+# bench needs ~23 min (~1380 s) on a quiet box; below this plus margin
+# the attempt cannot reach its emit before the deadline, so the time
+# is better spent on the CPU fallback + last_silicon merge.
+MIN_TPU_ATTEMPT_S = 1500.0
+
 WORKER_TIMEOUT_S = float(
     os.environ.get("DLROVER_BENCH_WORKER_TIMEOUT_S", 2700.0)
 )
@@ -110,21 +125,45 @@ HISTORY_MAX = 10
 STDERR_MAX = 40
 
 
+def _kill_group(p):
+    """SIGKILL the child's whole process group (it was started as a
+    session leader), falling back to a direct kill. A parent-only kill
+    leaves grandchildren (e.g. a worker's own spawns) orphaned — and a
+    PJRT client wedged in the tunnel dial survives as an init-reparented
+    zombie holding the tunnel against every later probe (observed live
+    this round: bench timeout left `bench.py --worker` pid 6357 wedged
+    for 20+ min until hand-reaped)."""
+    import signal
+
+    try:
+        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            p.kill()
+        except OSError:
+            pass
+
+
 def _run(cmd, env, timeout):
     try:
-        p = subprocess.run(
-            cmd, env=env, timeout=timeout, capture_output=True, text=True
+        p = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, start_new_session=True,
         )
-        return p.returncode, p.stdout or "", p.stderr or ""
-    except subprocess.TimeoutExpired as e:
-
-        def _s(v):
-            if v is None:
-                return ""
-            return v.decode(errors="replace") if isinstance(v, bytes) else v
-
-        return -9, _s(e.stdout), _s(e.stderr) + f"\nTIMEOUT after {timeout}s"
     except Exception as e:  # noqa: BLE001 — orchestrator must not die
+        return -1, "", repr(e)
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out or "", err or ""
+    except subprocess.TimeoutExpired:
+        _kill_group(p)
+        try:
+            out, err = p.communicate(timeout=10)
+        except Exception:  # noqa: BLE001 — group is dead; don't hang
+            out, err = "", ""
+        return -9, out or "", (err or "") + f"\nTIMEOUT after {timeout}s"
+    except Exception as e:  # noqa: BLE001
+        _kill_group(p)
         return -1, "", repr(e)
 
 
@@ -258,10 +297,18 @@ def _interpose_env(env):
     return env2
 
 
-def _try_tpu_worker(worker_cmd, env, history):
+def _try_tpu_worker(worker_cmd, env, history, deadline=None,
+                    cpu_reserve=None):
     """Run the full bench on TPU: interposed first (driver-boundary
     corroboration of MFU), plain on any interposed failure. Returns the
-    parsed JSON or None."""
+    parsed JSON or None. ``deadline`` (absolute, from TOTAL_BUDGET_S)
+    bounds the attempt series: an attempt only starts if it has enough
+    budget left to plausibly finish AND leave the CPU fallback its
+    slice — a worker killed mid-run emits nothing, so starting it is
+    strictly worse than falling back. ``cpu_reserve`` is the budget to
+    hold back for that fallback: the serial default before it exists;
+    pass ~0 once the fallback already runs concurrently (reserving its
+    full slice then would forfeit achievable silicon attempts)."""
     attempts = []
     ienv = _interpose_env(env)
     if ienv is not None:
@@ -269,9 +316,21 @@ def _try_tpu_worker(worker_cmd, env, history):
     else:
         history.append({"note": "interposition unavailable (no axon so/pool)"})
     attempts += [("plain", dict(env)), ("plain_retry", dict(env))]
+    if cpu_reserve is None:
+        cpu_reserve = CPU_WORKER_TIMEOUT_S + 180.0
     for label, aenv in attempts:
+        timeout_s = WORKER_TIMEOUT_S
+        if deadline is not None:
+            remaining = deadline - time.time() - cpu_reserve
+            if remaining < MIN_TPU_ATTEMPT_S:
+                history.append({
+                    "ts": int(time.time()),
+                    "note": f"budget exhausted before attempt {label}",
+                })
+                break
+            timeout_s = min(WORKER_TIMEOUT_S, remaining)
         aenv.setdefault("DLROVER_BENCH_STORM", "1")
-        rc, out, err = _run(worker_cmd, aenv, WORKER_TIMEOUT_S)
+        rc, out, err = _run(worker_cmd, aenv, timeout_s)
         parsed = _last_json_line(out)
         if parsed is not None:
             # A JSON line is a finished measurement even if the process
@@ -296,10 +355,16 @@ def _try_tpu_worker(worker_cmd, env, history):
 def orchestrate():
     env = dict(os.environ)
     worker_cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+    budget_deadline = (
+        time.time() + TOTAL_BUDGET_S if TOTAL_BUDGET_S > 0 else None
+    )
 
     if env.get("JAX_PLATFORMS", "") == "cpu":
         # CI smoke: no TPU expected, run the worker directly.
-        rc, out, err = _run(worker_cmd, env, CPU_WORKER_TIMEOUT_S)
+        cpu_timeout = CPU_WORKER_TIMEOUT_S
+        if TOTAL_BUDGET_S > 0:
+            cpu_timeout = min(cpu_timeout, max(TOTAL_BUDGET_S - 30.0, 1.0))
+        rc, out, err = _run(worker_cmd, env, cpu_timeout)
         parsed = _last_json_line(out)
         if parsed is None:
             parsed = _fallback_json(f"cpu worker rc={rc}: {err[-400:]}")
@@ -368,7 +433,7 @@ def orchestrate():
 
     # -- phase 2: the real bench on TPU
     if alive:
-        parsed = _try_tpu_worker(worker_cmd, env, history)
+        parsed = _try_tpu_worker(worker_cmd, env, history, budget_deadline)
         if parsed is not None:
             finish(parsed)
             return
@@ -410,11 +475,30 @@ def orchestrate():
     while True:
         if not cpu_done and cpu_proc.poll() is not None:
             cpu_done = True
+        # Budget hammer: past the deadline (minus a parse/emit margin)
+        # stop everything and emit from whatever output exists — the
+        # watcher's SIGKILL lands shortly after and must find the line
+        # already printed.
+        if (
+            budget_deadline is not None
+            and time.time() > budget_deadline - 30.0
+        ):
+            if not cpu_done:
+                cpu_proc.kill()
+                cpu_proc.wait()
+                cpu_done = True
+                tpu_error = tpu_error or "budget exhausted"
+            break
         if time.time() < probe_deadline:
             rec = _probe_once(env)
             history.append(rec)
             if _probe_alive(rec):
-                parsed = _try_tpu_worker(worker_cmd, env, history)
+                # the CPU fallback already runs concurrently — hold
+                # back only a finishing margin, not its whole slice
+                parsed = _try_tpu_worker(
+                    worker_cmd, env, history, budget_deadline,
+                    cpu_reserve=60.0,
+                )
                 if parsed is not None:
                     if not cpu_done:
                         cpu_proc.kill()
@@ -431,13 +515,15 @@ def orchestrate():
             break
         else:
             # window closed; just wait the CPU worker out. Elapsed time
-            # counts from the worker's OWN start (it ran concurrently).
-            try:
-                cpu_proc.wait(
-                    timeout=max(
-                        5.0, CPU_WORKER_TIMEOUT_S - (time.time() - cpu_t0)
-                    )
+            # counts from the worker's OWN start (it ran concurrently),
+            # further bounded by the total budget.
+            wait_s = max(5.0, CPU_WORKER_TIMEOUT_S - (time.time() - cpu_t0))
+            if budget_deadline is not None:
+                wait_s = max(
+                    1.0, min(wait_s, budget_deadline - 30.0 - time.time())
                 )
+            try:
+                cpu_proc.wait(timeout=wait_s)
             except subprocess.TimeoutExpired:
                 cpu_proc.kill()
                 cpu_proc.wait()

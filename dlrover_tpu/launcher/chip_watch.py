@@ -345,6 +345,42 @@ def diagnose_wedge(rec, proc, port, stack_path):
     }
 
 
+def _kill_group(pid):
+    try:
+        os.killpg(os.getpgid(pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+
+def _reap_orphan_workers():
+    """Kill `bench.py --worker` processes that reparented to init —
+    only those (ppid 1), so a concurrently running driver bench's
+    worker (live parent) is never touched."""
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                cmd = f.read().decode(errors="replace").split("\0")
+            with open(f"/proc/{pid_s}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if (
+            ppid == 1
+            and any(c.endswith("bench.py") for c in cmd)
+            and "--worker" in cmd
+        ):
+            try:
+                os.kill(int(pid_s), signal.SIGKILL)
+                print(f"WATCHER: reaped orphan worker {pid_s}", flush=True)
+            except OSError:
+                pass
+
+
 def capture_silicon(log_path, bench_timeout):
     """Chip is alive: run the full bench NOW and commit the raw result."""
     ts = int(time.time())
@@ -354,27 +390,50 @@ def capture_silicon(log_path, bench_timeout):
     env = dict(os.environ)
     env["DLROVER_BENCH_STORM"] = "0"  # storm is CPU-driven; save the window
     env.setdefault("DLROVER_BENCH_PROBE_WINDOW_S", "300")
+    # Agree on the clock: bench stops starting attempts it can't finish
+    # within OUR kill timeout, so it always reaches its emit (a SIGKILL
+    # mid-attempt leaves no JSON line and an unparseable artifact). The
+    # budget must never exceed the kill timeout, including for small
+    # timeouts (tests): max(t-180, 0.8t) stays below t for all t > 0.
+    env.setdefault(
+        "DLROVER_BENCH_TOTAL_BUDGET_S",
+        str(max(int(bench_timeout - 180), int(bench_timeout * 0.8), 1)),
+    )
     bench_cmd = _seam_cmd(
         "DLROVER_CHIPWATCH_BENCH_CMD",
         [sys.executable, os.path.join(REPO, "bench.py")],
     )
     t0 = time.time()
     try:
-        p = subprocess.run(
+        p = subprocess.Popen(
             bench_cmd,
             env=env,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=bench_timeout,
             cwd=REPO,
+            start_new_session=True,
         )
-        out, err, rc = p.stdout, p.stderr, p.returncode
-    except subprocess.TimeoutExpired as e:
-        out = (e.stdout or b"").decode(errors="replace") if isinstance(
-            e.stdout, bytes
-        ) else (e.stdout or "")
-        err = f"BENCH TIMEOUT after {bench_timeout}s"
-        rc = -9
+        try:
+            out, err = p.communicate(timeout=bench_timeout)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            # Kill bench's whole group, then reap init-reparented
+            # workers: bench starts each worker as its own session
+            # leader (so IT can group-kill them on per-attempt
+            # timeout), which also detaches them from OUR killpg — a
+            # wedged PJRT client left behind holds the tunnel against
+            # every later probe (observed live this round, pid 6357).
+            _kill_group(p.pid)
+            try:
+                out, _err2 = p.communicate(timeout=10)
+            except Exception:  # noqa: BLE001 — group is dead
+                out = ""
+            _reap_orphan_workers()
+            err = f"BENCH TIMEOUT after {bench_timeout}s"
+            rc = -9
+    except OSError as e:
+        out, err, rc = "", f"bench spawn failed: {e!r}", -1
     # bench.py owns the emitted-line contract; reuse its parser (REPO is
     # on sys.path — the watcher runs as `python -m` from the repo root).
     sys.path.insert(0, REPO)
@@ -497,7 +556,12 @@ def main(argv=None):
     ap.add_argument("--probe-child", action="store_true")
     ap.add_argument("--interval", type=float, default=240.0)
     ap.add_argument("--probe-timeout", type=float, default=150.0)
-    ap.add_argument("--bench-timeout", type=float, default=3600.0)
+    # 90 min: with the shared budget (DLROVER_BENCH_TOTAL_BUDGET_S =
+    # timeout - 180) the first TPU attempt keeps its full 45-min cap
+    # even on a loaded box, the retry gets the remainder, and the CPU
+    # fallback's reserve still fits — bench always emits before the
+    # kill.
+    ap.add_argument("--bench-timeout", type=float, default=5400.0)
     ap.add_argument("--ttl-hours", type=float, default=10.0)
     ap.add_argument("--once", action="store_true")
     ap.add_argument(

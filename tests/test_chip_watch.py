@@ -290,6 +290,53 @@ class TestCaptureSilicon:
         assert latest["value"] == 99999.0  # newest incomplete wins
         assert latest["incomplete_sections"] == ["ckpt_error"]
 
+    def test_timeout_kills_group_and_reaps_orphan_worker(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """A bench that times out must not leave a wedged worker
+        behind: the whole group is killed, and a worker that detached
+        into its own session (as the real bench starts them) is reaped
+        once it reparents to init (the live r5 leak: a PJRT client
+        wedged in the tunnel dial held the tunnel against every later
+        probe)."""
+        import textwrap
+
+        fake_worker = tmp_path / "bench.py"  # name must match the reap
+        fake_worker.write_text("import time; time.sleep(300)\n")
+        spawner = tmp_path / "spawner.py"
+        spawner.write_text(textwrap.dedent(f"""
+            import subprocess, sys, time
+            subprocess.Popen(
+                [sys.executable, {str(fake_worker)!r}, "--worker"],
+                start_new_session=True,
+            )
+            time.sleep(300)
+        """))
+        monkeypatch.setenv(
+            "DLROVER_CHIPWATCH_BENCH_CMD", f"{sys.executable} {spawner}"
+        )
+        ok = chip_watch.capture_silicon(
+            str(tmp_path / "w.jsonl"), bench_timeout=4
+        )
+        assert ok is False  # timeout -> no silicon
+        # the detached "--worker" must be gone
+        import time as _t
+
+        _t.sleep(0.5)
+        leftovers = []
+        for pid_s in os.listdir("/proc"):
+            if not pid_s.isdigit():
+                continue
+            try:
+                cmd = open(f"/proc/{pid_s}/cmdline", "rb").read().decode(
+                    errors="replace"
+                )
+            except OSError:
+                continue
+            if str(fake_worker) in cmd and "--worker" in cmd:
+                leftovers.append(pid_s)
+        assert not leftovers, leftovers
+
     def test_cpu_fallback_is_not_marked_silicon(
         self, tmp_path, monkeypatch, fake_repo
     ):

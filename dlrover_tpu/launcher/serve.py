@@ -175,6 +175,14 @@ class ServingDaemon:
         latency once the driver adopts them between chunks."""
         return self._submit_item("params", params, timeout)
 
+    def swap_params_async(self, params, timeout: float = 300.0) -> bool:
+        """Non-blocking swap: the driver only ENQUEUES the H2D
+        transfer (engine.set_params_async) and keeps decoding; the new
+        weights land at the first chunk boundary after the transfer
+        completes. The measured latency appears in the engine stats
+        (``swap_latency_s``) once adopted."""
+        return self._submit_item("params_async", params, timeout)
+
     # -- driver thread --------------------------------------------------
 
     def _drain_inbox(self, block: bool):
@@ -234,6 +242,9 @@ class ServingDaemon:
                     fut.set_result(self.eng.register_prefix(payload))
                 elif kind == "params":
                     fut.set_result(self.eng.set_params(payload))
+                elif kind == "params_async":
+                    self.eng.set_params_async(payload)
+                    fut.set_result(True)
             except Exception as e:  # noqa: BLE001 — per-request failure
                 if fut is not None:  # cancel items carry no future
                     fut.set_exception(e)
@@ -563,15 +574,25 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         400, {"error": "no --ckpt-dir to reload from"}
                     )
                     return
+                swap_async = bool(body.get("async", False))
                 try:
                     step, params = reload_fn()
-                    lat = daemon.swap_params(params)
+                    if swap_async:
+                        daemon.swap_params_async(params)
+                    else:
+                        lat = daemon.swap_params(params)
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"error": repr(e)[:200]})
                     return
-                self._send(
-                    200, {"step": step, "swap_latency_s": round(lat, 4)}
-                )
+                if swap_async:
+                    # decode keeps running; adoption lands at the first
+                    # chunk boundary after the transfer — the measured
+                    # latency then shows in /healthz last_swap_latency_s
+                    self._send(200, {"step": step, "accepted": True})
+                else:
+                    self._send(
+                        200, {"step": step, "swap_latency_s": round(lat, 4)}
+                    )
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 

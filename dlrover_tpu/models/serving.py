@@ -177,6 +177,8 @@ class ContinuousBatchingEngine:
         self.L = L
         self.d = decode_chunk
         self.swap_latency_s: Optional[float] = None
+        self._pending_params = None  # in-flight async weight swap
+        self._pending_t0 = 0.0
         self._uid = 0
         # (uid, tokens, submit_t, cap, prefix_id)
         self._queue: List[tuple] = []
@@ -486,8 +488,24 @@ class ContinuousBatchingEngine:
     def set_params(self, params) -> float:
         """Hot-swap weights between chunks (same pytree shapes — no
         recompile). Returns the swap latency: the time to make the new
-        params device-resident and adopted for the next chunk."""
-        t0 = time.perf_counter()
+        params device-resident and adopted for the next chunk. Blocks
+        the caller for the full H2D transfer — use
+        :meth:`set_params_async` to hide the transfer behind ongoing
+        decode instead."""
+        self.set_params_async(params)
+        jax.block_until_ready(self._pending_params)
+        self._maybe_adopt_pending()
+        return self.swap_latency_s
+
+    def set_params_async(self, params) -> None:
+        """Begin a NON-blocking weight swap: ``jax.device_put`` only
+        enqueues the H2D transfer, so it proceeds behind ongoing decode
+        chunks, and the engine adopts the new weights at the first
+        ``step()`` boundary where every leaf has landed — a WeightBus
+        push never stalls the rollout loop (the measured transfer is
+        ~12 s for 124M params over the tunneled chip; blocking that
+        long mid-decode is the exact stall this avoids). A second call
+        before adoption supersedes the first (latest weights win)."""
         # Preserve each leaf's existing placement: a WeightBus push
         # delivers HOST arrays, and a bare device_put would commit them
         # to one device — collapsing tp/fsdp-sharded serving onto a
@@ -498,13 +516,27 @@ class ContinuousBatchingEngine:
             )
         except AttributeError:  # engine was built with host arrays
             spec = None
-        params = jax.device_put(params, spec)
-        jax.block_until_ready(params)  # every leaf — not just the first
-        self.params = params
+        self._pending_t0 = time.perf_counter()
+        self._pending_params = jax.device_put(params, spec)
+
+    def _maybe_adopt_pending(self) -> bool:
+        """Adopt a pending async swap if the transfer has completed —
+        checked without blocking (``Array.is_ready``)."""
+        pending = self._pending_params
+        if pending is None:
+            return False
+        leaves = jax.tree_util.tree_leaves(pending)
+        if not all(
+            leaf.is_ready() for leaf in leaves
+            if hasattr(leaf, "is_ready")
+        ):
+            return False
+        self.params = pending
+        self._pending_params = None
         # stored prefix KV encodes the OLD weights — rebuild lazily
         self._prefix_states.clear()
-        self.swap_latency_s = time.perf_counter() - t0
-        return self.swap_latency_s
+        self.swap_latency_s = time.perf_counter() - self._pending_t0
+        return True
 
     def _pad_rows(self, rows: List[List[int]], width: int):
         # generation.left_pad_prompts owns the padding convention
@@ -647,6 +679,9 @@ class ContinuousBatchingEngine:
         (frontier layout only), admit into free slots, decode one
         chunk, retire finished rows. Returns the number of tokens
         emitted this chunk."""
+        # a completed async weight swap lands here, between chunks —
+        # the non-blocking check costs ~nothing when none is pending
+        self._maybe_adopt_pending()
         frontier_layout = self.layout == "frontier"
         if frontier_layout:
             if self._queue and all(
@@ -739,6 +774,7 @@ class ContinuousBatchingEngine:
                 getattr(self.model.config, "kv_cache_int8", False)
             ),
             "last_swap_latency_s": self.swap_latency_s,
+            "swap_pending": self._pending_params is not None,
         }
 
     def partial(self, uid: int):
@@ -1065,6 +1101,17 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         elif follow:
             self.draft_params = self.params
         return latency
+
+    def _maybe_adopt_pending(self) -> bool:
+        """Async-swap adoption keeps a self-following draft in sync
+        (set_params_async carries no draft_params — an explicit draft
+        swap stays a blocking set_params concern)."""
+        follow = self.draft_params is self.params
+        if super()._maybe_adopt_pending():
+            if follow:
+                self.draft_params = self.params
+            return True
+        return False
 
     def _admit_one(
         self, slot, uid, prompt, submit_t, cap, prefix_id=None,

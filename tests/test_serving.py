@@ -278,6 +278,71 @@ class TestWeightSwap:
             swap_lps[4:], base_lps[4:], rtol=1e-3, atol=1e-4
         )
 
+    def test_async_swap_adopts_at_chunk_boundary(self):
+        """set_params_async never blocks the scheduler: the transfer
+        is enqueued, decode keeps stepping, and adoption lands at the
+        first step() boundary after the transfer completes — which on
+        the host backend is the very next step, making the output
+        token-exact with a blocking swap at the same point."""
+        import numpy as np
+
+        model = _model(seq=256)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=16, temperature=0.0)
+
+        def run(swap_fn):
+            eng = ContinuousBatchingEngine(
+                model, p1, sampling, batch_size=2, prompt_width=8,
+                decode_chunk=4,
+            )
+            eng.submit([5, 9, 2])
+            rng = jax.random.PRNGKey(0)
+            for i in range(64):
+                rng, sub = jax.random.split(rng)
+                eng.step(sub)
+                if i == 1:
+                    swap_fn(eng)
+                if not eng.pending:
+                    break
+            (comp,) = eng.drain_completions()
+            return comp.tokens, comp.logprobs, eng
+
+        blk_toks, blk_lps, _ = run(lambda e: e.set_params(p2))
+        # async: same swap point; host-backend transfer completes
+        # immediately, so adoption happens at the top of step i=2 —
+        # the same effective boundary as the blocking swap
+        asy_toks, asy_lps, eng = run(lambda e: e.set_params_async(p2))
+        assert asy_toks == blk_toks
+        np.testing.assert_allclose(asy_lps, blk_lps, rtol=1e-5, atol=1e-6)
+        # adoption bookkeeping: pending cleared, latency recorded
+        assert eng.stats()["swap_pending"] is False
+        assert eng.swap_latency_s is not None and eng.swap_latency_s > 0
+
+    def test_async_swap_self_draft_follows(self):
+        """A self-drafting speculative engine keeps draft == target
+        through an ASYNC adoption (the blocking set_params already
+        guarantees this; the async path must too)."""
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = _model(seq=256)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        eng = SpeculativeBatchingEngine(
+            model, p1, model, p1, sampling, batch_size=2,
+            prompt_width=8, decode_chunk=4, num_draft=2,
+        )
+        assert eng.draft_params is eng.params
+        eng.submit([5, 9, 2])
+        eng.set_params_async(p2)
+        rng = jax.random.PRNGKey(0)
+        for _ in range(32):
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+            if not eng.pending:
+                break
+        assert eng.stats()["swap_pending"] is False
+        assert eng.draft_params is eng.params  # still following
+
 
 class TestPerRowLayout:
     """cache_layout='per_row': every row writes at its own frontier

@@ -56,7 +56,7 @@ HEADLINE_SECTION_ERRORS = frozenset({
     "tpu_error", "fatal_error", "dense_error", "ckpt_error",
     "flash_seq4096_error", "decode_error", "spec_error",
     "serving_error", "serving_per_row_error", "llama_family_error",
-    "longseq_train_error",
+    "longseq_train_error", "attr_error",
 })
 
 # ---------------------------------------------------------------------------
@@ -181,7 +181,70 @@ def _last_json_line(stdout):
     return None
 
 
-def _emit(result):
+# Hard cap on the ONE emitted JSON line: the driver's parse window is
+# ~2,000 chars and has truncated mid-string 3 rounds out of 5. Under
+# pressure the FULL extra goes to a run-unique sidecar and the line
+# keeps a priority-ordered subset of scalars + the sidecar pointer.
+LINE_BUDGET_BYTES = 1800
+
+# In-line survival priority when the full line overflows: errors and
+# provenance first (an unparseable failure is the worst artifact), then
+# the headline floats, then the attribution/serving rung, then pointers.
+_PRIORITY_KEYS = (
+    "device", "fatal_error", "tpu_error", "worker_rc", "tpu_attempt",
+    # EVERY headline-section error marker survives in-line: the chip
+    # watcher's SILICON_LATEST promotion gate reads them, and a
+    # truncated line that dropped one could promote an incomplete
+    # capture as complete
+    *sorted(HEADLINE_SECTION_ERRORS - {"fatal_error", "tpu_error"}),
+    "headline_config", "model", "mfu", "flash_step_s", "flash_batch",
+    "seq_len", "flash_vs_dense", "serving_host_frac", "attr_report",
+    "attr_ring", "attr_top_residual", "attr_top_residual_frac",
+    "attr_matmul_frac",
+    "serving_per_row_tokens_per_s", "decode_tokens_per_s",
+    "generate_tokens_per_s", "ckpt_async_stage_block_s",
+    "goodput_ckpt_every_10_steps", "last_silicon", "hang_diagnosis",
+    "probe_sidecar", "extra_sidecar", "line_truncated",
+)
+
+
+def _shrink_to_budget(result):
+    """Enforce LINE_BUDGET_BYTES on the emitted line. Over budget: the
+    complete extra is written to ``BENCH_extra_<ts>_<pid>.json`` and the
+    line is rebuilt from _PRIORITY_KEYS, adding each key only while the
+    serialized line stays under budget (later, smaller keys still get
+    their chance when a big one was skipped)."""
+    if len(json.dumps(result)) <= LINE_BUDGET_BYTES:
+        return result
+    extra = dict(result.get("extra") or {})
+    slim = {"line_truncated": True}
+    sidecar = os.path.join(
+        _REPO_DIR, f"BENCH_extra_{int(time.time())}_{os.getpid()}.json"
+    )
+    try:
+        with open(sidecar, "w") as f:
+            json.dump(extra, f, indent=1)
+        slim["extra_sidecar"] = os.path.basename(sidecar)
+    except OSError:
+        pass
+    for key in _PRIORITY_KEYS:
+        if key not in extra or key in slim:
+            continue
+        trial = dict(slim)
+        trial[key] = extra[key]
+        if len(json.dumps(dict(result, extra=trial))) <= LINE_BUDGET_BYTES:
+            slim[key] = extra[key]
+    return dict(result, extra=slim)
+
+
+def _emit(result, enforce_budget=True):
+    """Print the one JSON line. The budget applies to the line the
+    DRIVER parses (the orchestrator's final emit and the CPU-smoke
+    merge); the worker→orchestrator pipe line stays complete — the
+    orchestrator and the silicon capture want the full sections, and
+    the final emit re-enforces the cap after merging."""
+    if enforce_budget:
+        result = _shrink_to_budget(result)
     print(json.dumps(result))
     sys.stdout.flush()
 
@@ -256,15 +319,41 @@ def _watcher_history():
     }
 
 
+# The silicon headline floats carried IN the line (everything else in
+# SILICON_LATEST stays behind the artifact pointer): the citable core.
+_SILICON_HEADLINE_KEYS = (
+    "mfu", "flash_step_s", "serving_per_row_tokens_per_s",
+    "serving_host_frac", "goodput_ckpt_every_10_steps",
+)
+
+
 def _merge_committed_artifacts(extra):
-    """Carry the last committed silicon result (written by the chip
-    watcher, ``launcher/chip_watch.py``) and the latest real-wedge hang
-    diagnosis into the bench record with provenance — so an outage-day
-    driver bench still shows the chip numbers and where they came from
-    (VERDICT r4 #1c, #4)."""
+    """Carry POINTERS to the last committed silicon result (written by
+    the chip watcher, ``launcher/chip_watch.py``) and the latest
+    real-wedge hang diagnosis — artifact path + sha + ≤5 headline
+    floats, never the payloads. Embedding the full LATEST files blew
+    the emitted line past the driver's parse window in 3 of 5 rounds
+    (VERDICT r5 #2); the committed artifacts hold the detail."""
     try:
         with open(os.path.join(_REPO_DIR, "SILICON_LATEST.json")) as f:
-            extra["last_silicon"] = json.load(f)
+            latest = json.load(f)
+        head = latest.get("headline") or {}
+        pointer = {
+            "artifact": latest.get("artifact"),
+            "git_sha": latest.get("git_sha"),
+            "ts": latest.get("ts"),
+            # metric+unit label the carried value — a bare float would
+            # send the reader to the artifact just to name the quantity
+            "metric": latest.get("metric"),
+            "value": latest.get("value"),
+            "unit": latest.get("unit"),
+        }
+        for k in _SILICON_HEADLINE_KEYS:
+            if k in head:
+                pointer[k] = head[k]
+        if latest.get("incomplete_sections"):
+            pointer["incomplete"] = len(latest["incomplete_sections"])
+        extra["last_silicon"] = pointer
     except (OSError, ValueError):
         pass
     try:
@@ -272,8 +361,13 @@ def _merge_committed_artifacts(extra):
             os.path.join(_REPO_DIR, "HANG_DIAGNOSIS_LATEST.json")
         ) as f:
             diag = json.load(f)
-        diag["stack_excerpt"] = str(diag.get("stack_excerpt", ""))[-300:]
-        extra["hang_diagnosis"] = diag
+        extra["hang_diagnosis"] = {
+            "artifact": diag.get("artifact"),
+            "git_sha": diag.get("git_sha"),
+            "ts": diag.get("ts"),
+            "classification": str(diag.get("classification", ""))[:80],
+            "stall_verdict": diag.get("stall_verdict"),
+        }
     except (OSError, ValueError):
         pass
 
@@ -455,7 +549,11 @@ def orchestrate():
         mode="w+", prefix="bench_cpu_err_", delete=False
     )
     cpu_proc = subprocess.Popen(
-        worker_cmd, env=env_cpu, stdout=out_f, stderr=err_f, text=True
+        worker_cmd, env=env_cpu, stdout=out_f, stderr=err_f, text=True,
+        # session leader like every other worker spawn: the chip
+        # watcher's orphan reap only considers session leaders, so a
+        # worker orphaned by a SIGKILLed orchestrator stays reapable
+        start_new_session=True,
     )
 
     def cpu_output():
@@ -957,6 +1055,32 @@ def _bench_spec_decode(extra, cfg, params, on_tpu):
             extra["spec_self_f32_error"] = repr(e)[:160]
 
 
+def _timed_stream(model, params, sampling, slots, prompt_width, prompts,
+                  layout="frontier", decode_chunk=8):
+    """One warmed, timed serving stream; returns (tokens/s, engine).
+    The warm/reset convention lives HERE only (both the serving rates
+    and the attribution rung's fallback depend on it): warm with the
+    FULL stream — greedy + same prompts makes the timed rerun hit
+    identical compaction widths, so every jit (prefill, chunk, each
+    compaction bucket) is hot when the clock starts — then drop the
+    warm run's phase stamps so the engine's host/device split
+    describes the same steady-state stream as the rate (compiles land
+    in dispatch/prefill and would dominate host_frac)."""
+    from dlrover_tpu.models.serving import ContinuousBatchingEngine
+
+    eng = ContinuousBatchingEngine(
+        model, params, sampling, batch_size=slots,
+        prompt_width=prompt_width, decode_chunk=decode_chunk,
+        cache_layout=layout,
+    )
+    eng.run(prompts)
+    eng.phases.reset()
+    t0 = time.perf_counter()
+    out = eng.run(prompts)
+    dt = time.perf_counter() - t0
+    return sum(len(c.tokens) for c in out) / dt, eng
+
+
 def _bench_serving(extra, cfg, params, on_tpu):
     """Continuous batching (models/serving.py): mixed-length stream
     tokens/s vs the same engine on a homogeneous batch, plus the
@@ -966,7 +1090,6 @@ def _bench_serving(extra, cfg, params, on_tpu):
 
     from dlrover_tpu.models.generation import SamplingConfig
     from dlrover_tpu.models.gpt import GPT
-    from dlrover_tpu.models.serving import ContinuousBatchingEngine
 
     model = GPT(cfg)
     if on_tpu:
@@ -977,19 +1100,10 @@ def _bench_serving(extra, cfg, params, on_tpu):
     r = np.random.default_rng(9)
 
     def stream_rate(prompts, layout="frontier", use_model=None, slots=None):
-        eng = ContinuousBatchingEngine(
-            use_model or model, params, sampling, batch_size=slots or B,
-            prompt_width=Pw, decode_chunk=8, cache_layout=layout,
+        return _timed_stream(
+            use_model or model, params, sampling, slots or B, Pw,
+            prompts, layout=layout,
         )
-        # warm with the FULL stream: greedy + same prompts makes the
-        # timed rerun hit identical compaction widths, so every jit
-        # (prefill, chunk, each compaction bucket) is hot when the
-        # clock starts
-        eng.run(prompts)
-        t0 = time.perf_counter()
-        out = eng.run(prompts)
-        dt = time.perf_counter() - t0
-        return sum(len(c.tokens) for c in out) / dt, eng
 
     mixed = [
         [int(x) for x in r.integers(1, cfg.vocab_size, r.integers(4, Pw))]
@@ -1001,10 +1115,16 @@ def _bench_serving(extra, cfg, params, on_tpu):
 
     # per-row cache layout: no compaction re-prefills on the same
     # mixed stream — the layouts compete for the serving recommendation
+    serving_split = None
     try:
-        rate_pr, _ = stream_rate(mixed, layout="per_row")
+        rate_pr, eng_pr = stream_rate(mixed, layout="per_row")
         extra["serving_per_row_tokens_per_s"] = round(rate_pr, 1)
         extra["serving_per_row_vs_frontier"] = round(rate_pr / rate_m, 3)
+        # hand the steady-state phase split to the attribution rung —
+        # it describes the SAME timed stream as the per-row rate, and
+        # reusing it saves the rung its own engine + recompiles on the
+        # budgeted chip window
+        serving_split = eng_pr.phases.split()
     except Exception as e:  # noqa: BLE001 — keep the frontier numbers
         extra["serving_per_row_error"] = repr(e)[:160]
 
@@ -1055,34 +1175,129 @@ def _bench_serving(extra, cfg, params, on_tpu):
     except Exception as e:  # noqa: BLE001
         extra["serving_int8_error"] = repr(e)[:160]
 
-    # A REAL WeightBus-style hot-swap: distinct weights arriving as
-    # host arrays (what the bus delivers), adopted mid-decode — the
-    # latency includes the full H2D transfer of every leaf.
-    host_params = jax.tree_util.tree_map(
-        lambda x: np.asarray(x) * 1.0001, jax.device_get(params)
-    )
-    for p in mixed[:B]:
-        eng.submit(p)
-    rng = jax.random.PRNGKey(1)
-    for i in range(3):
-        rng, sub = jax.random.split(rng)
-        eng.step(sub)  # decode in flight when the push lands
-    swap_s = eng.set_params(host_params)
-    # Adoption-only swap (already device-resident pytree): separates the
-    # engine's own cost from the link's H2D floor — on the tunneled
-    # chip the host-array swap above is ~wholly transfer time.
-    adopt_s = eng.set_params(eng.params)
     extra.update(
         {
-            "serving_weight_adopt_s": round(adopt_s, 4),
             "serving_stream_tokens_per_s": round(rate_m, 1),
             "serving_homogeneous_tokens_per_s": round(rate_h, 1),
             "serving_mixed_vs_homogeneous": round(rate_m / rate_h, 3),
-            "serving_weight_swap_s": round(swap_s, 4),
             "serving_batch_slots": B,
             "serving_requests": n_req,
         }
     )
+    # A REAL WeightBus-style hot-swap: distinct weights arriving as
+    # host arrays (what the bus delivers), adopted mid-decode — the
+    # latency includes the full H2D transfer of every leaf. Guarded
+    # separately: a flaky ~12 s H2D over the tunnel must not forfeit
+    # the rates above or the serving_split handoff to the attribution
+    # rung (which would then rebuild an engine and recompile on the
+    # budgeted chip window).
+    try:
+        host_params = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) * 1.0001, jax.device_get(params)
+        )
+        for p in mixed[:B]:
+            eng.submit(p)
+        rng = jax.random.PRNGKey(1)
+        for i in range(3):
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)  # decode in flight when the push lands
+        swap_s = eng.set_params(host_params)
+        # Adoption-only swap (already device-resident pytree):
+        # separates the engine's own cost from the link's H2D floor —
+        # on the tunneled chip the host-array swap above is ~wholly
+        # transfer time.
+        adopt_s = eng.set_params(eng.params)
+        extra["serving_weight_swap_s"] = round(swap_s, 4)
+        extra["serving_weight_adopt_s"] = round(adopt_s, 4)
+    except Exception as e:  # noqa: BLE001 — rates + split already stand
+        extra["serving_swap_error"] = repr(e)[:160]
+    return serving_split
+
+
+def _bench_attribution(extra, cfg, params, on_tpu, interposed,
+                       serving_split=None):
+    """Performance-attribution rung (r6): the serving host/device
+    split from the engine's phase accounting, plus the op-bucket table
+    from the interposer's trace ring when this worker runs interposed.
+    The FULL Report goes to a run-unique artifact; the line carries the
+    POINTER (``attr_report``) + ≤5 headline floats — the instrument the
+    next perf rounds aim with (VERDICT r5 #4/#5).
+
+    ``serving_split`` is the per-row engine's steady-state split handed
+    over by ``_bench_serving`` (same timed stream as the per-row rate);
+    the rung only builds its own small engine when the serving section
+    failed to produce one — recompiles are the scarce resource on a
+    budgeted chip window."""
+    import numpy as np
+
+    from dlrover_tpu.attribution import build_report
+    from dlrover_tpu.models.generation import SamplingConfig
+    from dlrover_tpu.models.gpt import GPT
+
+    split = serving_split
+    if split is None:
+        model = GPT(cfg)
+        if on_tpu:
+            B, Pw, N, n_req = 8, 64, 16, 16
+        else:
+            B, Pw, N, n_req = 2, 16, 6, 4
+        sampling = SamplingConfig(max_new_tokens=N, temperature=0.0)
+        r = np.random.default_rng(17)
+        prompts = [
+            [int(x) for x in r.integers(
+                1, cfg.vocab_size, r.integers(4, Pw)
+            )]
+            for _ in range(n_req)
+        ]
+        _, eng = _timed_stream(
+            model, params, sampling, B, Pw, prompts, layout="per_row",
+        )
+        split = eng.phases.split()
+
+    op_table = None
+    if interposed:
+        try:
+            from dlrover_tpu.attribution.ops import account_events
+            from dlrover_tpu.profiler import pjrt
+
+            ring_path = os.path.join(
+                _REPO_DIR,
+                f"BENCH_attr_ring_{int(time.time())}_{os.getpid()}"
+                ".timeline",
+            )
+            events, names = pjrt.drain_trace_events(keep_path=ring_path)
+            if events:
+                # record the pointer the moment the kept files exist:
+                # an accounting failure below must not strand an
+                # unreferenced (hence never-committed) ring artifact
+                extra["attr_ring"] = os.path.basename(ring_path)
+                op_table = account_events(events, names)
+        except Exception as e:  # noqa: BLE001 — keep the serving split
+            extra["attr_ring_error"] = repr(e)[:160]
+
+    report = build_report(
+        op_table=op_table, serving=split,
+        meta={"device": extra.get("device", ""),
+              "source": "serving_rung" if serving_split else "own_engine"},
+    )
+    path = os.path.join(
+        _REPO_DIR, f"BENCH_attr_{int(time.time())}_{os.getpid()}.json"
+    )
+    try:
+        report.save(path)
+        extra["attr_report"] = os.path.basename(path)
+    except OSError as e:
+        extra["attr_report_error"] = repr(e)[:120]
+    # the ≤5-float headline contract is owned by Report.headline()
+    head = report.headline()
+    if "serving_host_frac" in head:
+        extra["serving_host_frac"] = head["serving_host_frac"]
+    if "matmul_frac" in head:
+        extra["attr_matmul_frac"] = head["matmul_frac"]
+    res = report.top_residual()
+    if res.get("bucket"):
+        extra["attr_top_residual"] = res["bucket"]
+        extra["attr_top_residual_frac"] = res["frac"]
 
 
 def _section_gc(extra, name):
@@ -1412,10 +1627,18 @@ def worker():
         except Exception as e:  # noqa: BLE001
             extra["spec_error"] = repr(e)[:200]
 
+        serving_split = None
         try:
-            _bench_serving(extra, cfg, params, on_tpu)
+            serving_split = _bench_serving(extra, cfg, params, on_tpu)
         except Exception as e:  # noqa: BLE001
             extra["serving_error"] = repr(e)[:200]
+
+        try:
+            _bench_attribution(
+                extra, cfg, params, on_tpu, interposed, serving_split
+            )
+        except Exception as e:  # noqa: BLE001
+            extra["attr_error"] = repr(e)[:200]
 
         params = None  # the model families below build their own
         _section_gc(extra, "post_serving")
@@ -1627,7 +1850,10 @@ def worker():
             "unit": "tokens/s",
             "vs_baseline": round(vs_baseline, 3),
             "extra": extra,
-        }
+        },
+        # full line over the pipe: the orchestrator merges and its own
+        # final emit enforces the byte budget
+        enforce_budget=False,
     )
 
 

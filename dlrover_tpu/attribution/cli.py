@@ -1,0 +1,71 @@
+"""``tpurun-attr`` — op-bucket table from a saved trace ring.
+
+The offline half of the attribution subsystem: point it at a ring the
+native core dumped (``TpuTimer.dump_timeline`` / ``pjrt.dump_timeline``,
+or the one a bench run saved) and get the bucketed device-time table
+plus the ``top_residual`` recommendation — no jax, no device.
+
+    tpurun-attr RING.timeline                  # human table
+    tpurun-attr RING.timeline --json           # machine-readable
+    tpurun-attr RING.timeline --out report.json  # full Report artifact
+
+The interned-name sidecar is auto-discovered at ``RING + '.names'``;
+override with ``--names``.
+"""
+
+import argparse
+import json
+import sys
+
+from ..profiler import timeline
+from .ops import account_events, format_table
+from .report import build_report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun-attr",
+        description="op-bucket device-time attribution from a trace ring",
+    )
+    ap.add_argument("ring", help="ring file (TPUTL001 format)")
+    ap.add_argument(
+        "--names", default=None,
+        help="interned-name sidecar (default: RING + '.names')",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="print the table as JSON"
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="also write the full Report artifact to this path",
+    )
+    ap.add_argument(
+        "--top", type=int, default=10,
+        help="top-N op names in the --json output (the --out Report "
+        "artifact is always written in full)",
+    )
+    ns = ap.parse_args(argv)
+
+    try:
+        events = timeline.read_timeline(ns.ring)
+    except (OSError, ValueError) as e:
+        print(f"tpurun-attr: {e}", file=sys.stderr)
+        return 2
+    names = timeline.read_names(ns.names or ns.ring + ".names")
+    table = account_events(events, names)
+
+    if ns.out:
+        report = build_report(
+            op_table=table, meta={"ring": ns.ring, "events": len(events)}
+        )
+        report.save(ns.out)
+        print(f"wrote {ns.out}", file=sys.stderr)
+    if ns.json:
+        print(json.dumps(table.to_dict(max_top_ops=ns.top)))
+    else:
+        print(format_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,338 @@
+"""Op-bucket accounting over the interposer's trace ring.
+
+Input is the compact timeline the native core dumps
+(``profiler.timeline`` reads it: events of (name_id, kind, start_us,
+dur_us, step)); output is a per-step device-time table bucketed by
+what the op IS — the reduction that turns 256k raw events into "the
+residual is N% optimizer-HBM time, attack that".
+
+Classification is two-stage: the native kind wins when it already
+names the bucket (``TT_KIND_MATMUL``/``TT_KIND_COLLECTIVE`` are
+op-granular in the core), then an ordered fingerprint table matches
+the interned op name. XLA program names concatenate the fused ops
+(``fusion.123.dot_general.add``), so fingerprints are ordered most-
+specific-first: a fused attention softmax must not land in ``vpu``
+just because it also contains an ``add``.
+
+Granularity depends on the ring's producer: ``profiler.hooks``
+``profile_op`` spans and HLO-named programs bucket precisely; the
+bare PJRT interposer records whole-executable envelopes whose names
+(``jit_train_step``) mostly land in ``other`` — ``gap_dispatch`` and
+``top_ops`` stay meaningful there, bucket fractions do not (see
+docs/profiler.md §Performance attribution).
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Native kinds: ONE Python mirror of TT_KIND_* lives in
+# profiler.native (pure constants at import time — no library load);
+# re-exported here because every classifier caller passes them.
+from ..profiler.native import (  # noqa: F401 — re-exports
+    KIND_COLLECTIVE,
+    KIND_COMPILE,
+    KIND_D2H,
+    KIND_EXECUTE,
+    KIND_H2D,
+    KIND_HLO_COMM,
+    KIND_HLO_FLOPS,
+    KIND_MATMUL,
+    KIND_OTHER,
+    KIND_STEP,
+)
+
+# Device-execution kinds that enter the accounting. Step markers bound
+# spans, transfers are tallied apart, hlo_* are static analysis rows,
+# compiles are one-time.
+_DEVICE_KINDS = frozenset({KIND_MATMUL, KIND_COLLECTIVE, KIND_OTHER,
+                           KIND_EXECUTE})
+_TRANSFER_KINDS = frozenset({KIND_H2D, KIND_D2H})
+
+BUCKETS = (
+    "matmul",          # MXU — the work MFU credits
+    "attention",       # softmax/flash/attention fusions
+    "vpu",             # layernorm/activation/residual element-wise
+    "optimizer_hbm",   # optimizer update + casts: params-bytes HBM traffic
+    "collective",      # cross-chip
+    "transfer",        # H2D/D2H on the device timeline
+    "gap_dispatch",    # step span not covered by any device op
+    "other",
+)
+
+# Ordered fingerprint table: first match wins. Collectives before
+# attention before matmul before optimizer before vpu — a fused
+# all-reduce-of-gradients name containing "add" is collective time.
+_FINGERPRINTS: Tuple[Tuple[str, "re.Pattern"], ...] = tuple(
+    (bucket, re.compile(pat, re.IGNORECASE))
+    for bucket, pat in (
+        ("collective",
+         r"all-reduce|all_reduce|allreduce|all-gather|all_gather|"
+         r"allgather|reduce-scatter|reduce_scatter|all-to-all|"
+         r"collective|ppermute|psum"),
+        ("attention",
+         r"attention|softmax|flash|mha\b|sdpa"),
+        ("matmul",
+         r"dot_general|\bdot\b|matmul|einsum|\bconv\b|convolution|gemm"),
+        ("optimizer_hbm",
+         r"adam|sgd|lamb\b|momentum|optimizer|adafactor|"
+         r"apply_grad|weight_update|update_step|convert_element_type|"
+         r"\bcast\b|\bcopy\b|transpose"),
+        ("vpu",
+         r"layer_?norm|rms_?norm|\bnorm\b|gelu|silu|relu|swiglu|"
+         r"residual|\badd\b|\bsub\b|\bmul\b|\bexp\b|tanh|reduce|"
+         r"iota|select|compare|scatter|gather|slice|pad\b|concatenate"),
+    )
+)
+
+# The next-lever table the top_residual recommendation reads from —
+# what attacking each non-matmul bucket concretely means on this
+# runtime (docs/profiler.md §Performance attribution).
+RECOMMENDATIONS = {
+    "attention": (
+        "retune the flash kernel for this shape (block sizes / fwd "
+        "residual reads) — softmax-adjacent time is kernel-owned"
+    ),
+    "vpu": (
+        "fuse layernorm/residual chains (XLA fusion barriers around "
+        "remat boundaries) — VPU time overlaps the MXU only when fused"
+    ),
+    "optimizer_hbm": (
+        "donate optimizer buffers and fuse the update (2x params bytes "
+        "of HBM round-trip per step is the floor to beat)"
+    ),
+    "collective": (
+        "overlap collectives with compute (latency-hiding sharding "
+        "rules / async collective start)"
+    ),
+    "transfer": (
+        "keep feeds device-resident: prefetch H2D under the step, "
+        "fetch only scalars back"
+    ),
+    "gap_dispatch": (
+        "cut dispatch count: scan-over-layers, larger decode chunks, "
+        "fewer host round-trips per step"
+    ),
+    "other": "inspect top_ops — unclassified names dominate the residual",
+}
+
+
+def classify_op(name: str, kind: Optional[int] = None) -> str:
+    """Bucket for one op: native kind first, then the fingerprint
+    table over the interned name, then ``other``."""
+    if kind == KIND_MATMUL:
+        return "matmul"
+    if kind in (KIND_COLLECTIVE, KIND_HLO_COMM):
+        return "collective"
+    if kind in _TRANSFER_KINDS:
+        return "transfer"
+    for bucket, pat in _FINGERPRINTS:
+        if pat.search(name or ""):
+            return bucket
+    return "other"
+
+
+@dataclass
+class BucketStat:
+    time_us: float = 0.0
+    count: int = 0
+    frac: float = 0.0  # of the accounted step span
+
+
+@dataclass
+class StepRow:
+    step: int
+    span_us: float
+    busy_us: float
+    buckets: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class OpTable:
+    """Per-step device-time accounting over one ring."""
+
+    steps: List[StepRow]
+    buckets: Dict[str, BucketStat]
+    total_span_us: float
+    events: int
+    top_ops: List[Tuple[str, str, float]]  # (name, bucket, time_us)
+
+    def top_residual(self) -> Dict:
+        """The largest non-matmul bucket — the next lever — with the
+        concrete recommendation for attacking it."""
+        best_name, best = None, None
+        for name, stat in self.buckets.items():
+            if name == "matmul" or stat.time_us <= 0:
+                continue
+            if best is None or stat.time_us > best.time_us:
+                best_name, best = name, stat
+        if best_name is None:
+            return {"bucket": None, "frac": 0.0,
+                    "recommendation": "no residual: ring empty or all-MXU"}
+        return {
+            "bucket": best_name,
+            "frac": round(best.frac, 4),
+            "time_us": round(best.time_us, 1),
+            "recommendation": RECOMMENDATIONS.get(
+                best_name, RECOMMENDATIONS["other"]
+            ),
+        }
+
+    def to_dict(
+        self,
+        max_steps: Optional[int] = None,
+        max_top_ops: Optional[int] = None,
+    ) -> Dict:
+        """Serialize; unbounded by default — the saved Report is the
+        FULL payload (the bench LINE is what gets truncated, never the
+        artifact). Pass limits only for size-sensitive views."""
+        return {
+            "events": self.events,
+            "total_span_us": round(self.total_span_us, 1),
+            "buckets": {
+                name: {
+                    "time_us": round(s.time_us, 1),
+                    "count": s.count,
+                    "frac": round(s.frac, 4),
+                }
+                for name, s in self.buckets.items()
+            },
+            "top_residual": self.top_residual(),
+            "top_ops": [
+                {"name": n[:80], "bucket": b, "time_us": round(t, 1)}
+                for n, b, t in self.top_ops[:max_top_ops]
+            ],
+            "steps": [
+                {
+                    "step": r.step,
+                    "span_us": round(r.span_us, 1),
+                    "busy_us": round(r.busy_us, 1),
+                    "buckets": {
+                        k: round(v, 1) for k, v in r.buckets.items()
+                    },
+                }
+                for r in self.steps[:max_steps]
+            ],
+        }
+
+
+def account_events(
+    events: Sequence, names: Optional[Dict[int, str]] = None
+) -> OpTable:
+    """Reduce ring events to the per-step bucket table.
+
+    ``events`` are ``profiler.timeline.TimelineEvent``-shaped (any
+    object with name_id/kind/start_us/dur_us/step). Step span comes
+    from the step-kind marker when one exists for that step id,
+    otherwise from the step's own event envelope; ``gap_dispatch`` is
+    the span not covered by summed op time (dispatch stalls, host
+    round-trips). Concurrent streams can make busy > span — the gap
+    clamps at zero rather than going negative.
+    """
+    names = names or {}
+    step_spans: Dict[int, float] = {}
+    per_step: Dict[int, Dict] = {}
+    name_time: Dict[Tuple[str, str], float] = {}
+
+    for ev in events:
+        if ev.kind == KIND_STEP:
+            step_spans[ev.step] = step_spans.get(ev.step, 0.0) + ev.dur_us
+            continue
+        if ev.kind not in _DEVICE_KINDS and ev.kind not in _TRANSFER_KINDS:
+            continue
+        name = names.get(ev.name_id, f"op_{ev.name_id}")
+        bucket = classify_op(name, ev.kind)
+        row = per_step.setdefault(
+            ev.step,
+            {"busy": 0.0, "lo": ev.start_us, "hi": ev.start_us + ev.dur_us,
+             "buckets": {}, "counts": {}},
+        )
+        row["busy"] += ev.dur_us
+        row["lo"] = min(row["lo"], ev.start_us)
+        row["hi"] = max(row["hi"], ev.start_us + ev.dur_us)
+        row["buckets"][bucket] = row["buckets"].get(bucket, 0.0) + ev.dur_us
+        row["counts"][bucket] = row["counts"].get(bucket, 0) + 1
+        key = (name, bucket)
+        name_time[key] = name_time.get(key, 0.0) + ev.dur_us
+
+    # a step MARKER with no surviving device ops (ring overflow ate
+    # them, or a pure dispatch stall) is still accounted: its whole
+    # span is gap_dispatch — dropping it would hide the worst stalls
+    # and inflate every other bucket's fraction
+    for step_id in step_spans:
+        per_step.setdefault(
+            step_id,
+            {"busy": 0.0, "lo": 0, "hi": 0, "buckets": {}, "counts": {}},
+        )
+
+    steps: List[StepRow] = []
+    totals: Dict[str, BucketStat] = {b: BucketStat() for b in BUCKETS}
+    total_span = 0.0
+    n_events = 0
+    for step_id in sorted(per_step):
+        row = per_step[step_id]
+        span = step_spans.get(step_id) or (row["hi"] - row["lo"])
+        gap = max(span - row["busy"], 0.0)
+        buckets = dict(row["buckets"])
+        if gap > 0:
+            buckets["gap_dispatch"] = buckets.get("gap_dispatch", 0.0) + gap
+        steps.append(
+            StepRow(step=step_id, span_us=max(span, row["busy"]),
+                    busy_us=row["busy"], buckets=buckets)
+        )
+        total_span += max(span, row["busy"])
+        for b, t in buckets.items():
+            stat = totals.setdefault(b, BucketStat())
+            stat.time_us += t
+            stat.count += row["counts"].get(b, 0)
+            n_events += row["counts"].get(b, 0)
+    if total_span > 0:
+        for stat in totals.values():
+            stat.frac = stat.time_us / total_span
+    top = sorted(
+        ((n, b, t) for (n, b), t in name_time.items()),
+        key=lambda r: -r[2],
+    )
+    return OpTable(
+        steps=steps,
+        buckets=totals,
+        total_span_us=total_span,
+        events=n_events,
+        top_ops=top,
+    )
+
+
+def format_table(table) -> str:
+    """Human table: bucket rows sorted by time, then the verdict.
+    Accepts a live :class:`OpTable` or its ``to_dict()`` form (the
+    shape a saved Report carries) — ONE renderer serves the CLI and
+    ``Report.format`` so the two can never drift."""
+    d = table.to_dict() if isinstance(table, OpTable) else table
+    lines = [f"{'bucket':14} {'time_ms':>10} {'frac':>7} {'count':>7}"]
+    for name, stat in sorted(
+        (d.get("buckets") or {}).items(),
+        key=lambda kv: -(kv[1].get("time_us") or 0),
+    ):
+        if not stat.get("time_us"):
+            continue
+        lines.append(
+            f"{name:14} {stat['time_us'] / 1e3:>10.2f} "
+            f"{stat.get('frac', 0.0):>7.3f} {stat.get('count', 0):>7}"
+        )
+    res = d.get("top_residual") or {}
+    lines.append("")
+    lines.append(
+        f"steps accounted: {len(d.get('steps') or [])}  "
+        f"span: {(d.get('total_span_us') or 0.0) / 1e3:.2f} ms  "
+        f"events: {d.get('events', 0)}"
+    )
+    if res.get("bucket"):
+        lines.append(
+            f"top residual: {res['bucket']} ({res.get('frac', 0.0):.1%})"
+            f" — {res.get('recommendation', '')}"
+        )
+    else:
+        lines.append(
+            f"top residual: {res.get('recommendation', 'empty table')}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,158 @@
+"""The machine-readable attribution Report.
+
+One ``Report`` holds both pillars (op-bucket table, serving phase
+split) plus provenance metadata. The serialization contract follows
+the bench-line lesson (the driver's parse window is ~2 KB and the
+line budget is 1,800 bytes): the FULL report is saved to its own JSON
+artifact, and :meth:`Report.headline` yields the ≤5 floats + pointer
+that ride in the bench line. Payloads never enter the line.
+"""
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .ops import OpTable, format_table
+from .phases import PhaseSplit
+
+SCHEMA = "dlrover_tpu.attribution.report/v1"
+
+
+@dataclass
+class Report:
+    op_table: Optional[Dict] = None  # OpTable.to_dict()
+    serving: Optional[Dict] = None  # PhaseSplit.__dict__-shaped
+    meta: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {
+            "schema": SCHEMA,
+            "meta": self.meta,
+            "op_table": self.op_table,
+            "serving": self.serving,
+        }
+
+    def to_json(self, indent: Optional[int] = 1) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Report":
+        if d.get("schema") != SCHEMA:
+            raise ValueError(f"not an attribution report: {d.get('schema')!r}")
+        return cls(
+            op_table=d.get("op_table"),
+            serving=d.get("serving"),
+            meta=d.get("meta") or {},
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Report":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Report":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    def headline(self) -> Dict:
+        """The ≤5 floats that summarize the whole report for the bench
+        line: host fraction, MXU fraction, the residual's size, the
+        dispatch gap, and how many rounds/steps back them."""
+        out: Dict = {}
+        if self.serving:
+            out["serving_host_frac"] = round(
+                float(self.serving.get("serving_host_frac", 0.0)), 4
+            )
+        if self.op_table:
+            buckets = self.op_table.get("buckets") or {}
+            mm = buckets.get("matmul") or {}
+            if mm:
+                out["matmul_frac"] = round(float(mm.get("frac", 0.0)), 4)
+            gap = buckets.get("gap_dispatch") or {}
+            if gap:
+                out["gap_frac"] = round(float(gap.get("frac", 0.0)), 4)
+            res = self.op_table.get("top_residual") or {}
+            if res.get("bucket"):
+                out["top_residual_frac"] = round(
+                    float(res.get("frac", 0.0)), 4
+                )
+        n = 0
+        if self.serving:
+            n = int(self.serving.get("rounds", 0) or 0)
+        if not n and self.op_table:
+            n = len(self.op_table.get("steps") or [])
+        out["samples"] = n
+        return out
+
+    def top_residual(self) -> Dict:
+        if self.op_table and self.op_table.get("top_residual"):
+            return self.op_table["top_residual"]
+        if self.serving:
+            # no ring: the residual IS the host side of the split
+            frac = float(self.serving.get("serving_host_frac", 0.0))
+            return {
+                "bucket": "serving_host",
+                "frac": round(frac, 4),
+                "recommendation": (
+                    "raise decode_chunk / overlap admission prefill "
+                    "with decode / batch retirement reads"
+                ),
+            }
+        return {"bucket": None, "frac": 0.0,
+                "recommendation": "empty report"}
+
+    def format(self) -> str:
+        parts = []
+        if self.meta:
+            parts.append(
+                "  ".join(f"{k}={v}" for k, v in sorted(self.meta.items()))
+            )
+        if self.op_table:
+            # ops.format_table renders the serialized dict form too —
+            # one renderer for the CLI and saved reports
+            parts.append(format_table(self.op_table))
+        if self.serving:
+            parts.append(_format_serving(self.serving))
+        return "\n\n".join(parts) if parts else "(empty report)"
+
+
+def _format_serving(sv: Dict) -> str:
+    lines = [
+        f"serving_host_frac: {sv.get('serving_host_frac', 0.0):.3f} "
+        f"over {sv.get('rounds', 0)} rounds "
+        f"(host {sv.get('host_s', 0.0):.3f}s / "
+        f"device {sv.get('device_s', 0.0):.3f}s)"
+    ]
+    for name, stat in sorted(
+        (sv.get("phases") or {}).items(),
+        key=lambda kv: -(kv[1].get("total_s") or 0),
+    ):
+        side = "host" if stat.get("host") else "device"
+        lines.append(
+            f"  {name:16} {side:6} total {stat.get('total_s', 0.0):8.4f}s"
+            f"  mean {stat.get('mean_ms', 0.0):8.3f}ms"
+            f"  max {stat.get('max_ms', 0.0):8.3f}ms"
+            f"  n={stat.get('count', 0)}"
+        )
+    return "\n".join(lines)
+
+
+def build_report(
+    op_table: Optional[OpTable] = None,
+    serving: Optional[PhaseSplit] = None,
+    meta: Optional[Dict] = None,
+) -> Report:
+    """Assemble a Report from live objects (either pillar optional)."""
+    return Report(
+        op_table=op_table.to_dict() if op_table is not None else None,
+        serving=dict(serving.__dict__) if serving is not None else None,
+        meta=dict(meta or {}),
+    )
+
+
+__all__ = ["Report", "build_report", "SCHEMA", "format_table"]

@@ -285,6 +285,13 @@ class ServingDaemon:
                 if self.eng.pending:
                     self._rng, sub = jax.random.split(self._rng)
                     self.eng.step(sub)
+                else:
+                    # idle-server swap convergence: step() (which
+                    # adopts landed async swaps at chunk boundaries)
+                    # never runs while no request is live, so an async
+                    # reload on an idle server would leave
+                    # swap_pending=true forever without this poll
+                    self.eng.poll_pending_swap()
                 for c in self.eng.drain_completions():
                     with self._mu:
                         fut = self._waiters.pop(c.uid, None)
@@ -393,6 +400,7 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
 
         def do_GET(self):
             if self.path == "/healthz":
+                stats = daemon.eng.stats()
                 self._send(
                     200,
                     {
@@ -401,7 +409,13 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                         "slots": daemon.eng.B,
                         "prompt_width": daemon.eng.Pw,
                         "max_new_tokens": daemon.eng.s.max_new_tokens,
-                        **daemon.eng.stats(),
+                        # top-level for scrapers: the host/device split
+                        # headline (full per-phase table under
+                        # stats.phase_split)
+                        "serving_host_frac": (
+                            stats.get("phase_split") or {}
+                        ).get("serving_host_frac"),
+                        **stats,
                     },
                 )
             else:

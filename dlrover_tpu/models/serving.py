@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..attribution.phases import PhaseAccumulator
 from .generation import (
     SamplingConfig,
     decode_apply,
@@ -74,6 +75,19 @@ class Completion:
     queue_s: float = 0.0  # submit → slot admission
     ttft_s: float = 0.0  # admission → first emitted token
     total_s: float = 0.0  # admission → retirement
+
+
+def _device_put_like(tree, like):
+    """Enqueue ``tree`` to the device preserving ``like``'s per-leaf
+    placement: a WeightBus push delivers HOST arrays, and a bare
+    ``device_put`` would commit them to one device — collapsing
+    tp/fsdp-sharded serving onto a single chip and forcing a
+    recompile. Shared by the target and draft swap paths."""
+    try:
+        spec = jax.tree_util.tree_map(lambda x: x.sharding, like)
+    except AttributeError:  # engine was built with host arrays
+        spec = None
+    return jax.device_put(tree, spec)
 
 
 @dataclass
@@ -191,6 +205,11 @@ class ContinuousBatchingEngine:
         self._prefixes: Dict[int, List[int]] = {}
         self._prefix_states: Dict[int, tuple] = {}
         self._next_prefix_id = 0
+        # host/device phase accounting: every scheduler round stamps
+        # admission / prefill / decode_dispatch / host_sync /
+        # retirement spans; attribution.phases reduces them to
+        # serving_host_frac (the VERDICT r5 #4 unmeasured gap)
+        self.phases = PhaseAccumulator()
         self._build_programs()
         self._reset_device_state()
 
@@ -506,18 +525,8 @@ class ContinuousBatchingEngine:
         ~12 s for 124M params over the tunneled chip; blocking that
         long mid-decode is the exact stall this avoids). A second call
         before adoption supersedes the first (latest weights win)."""
-        # Preserve each leaf's existing placement: a WeightBus push
-        # delivers HOST arrays, and a bare device_put would commit them
-        # to one device — collapsing tp/fsdp-sharded serving onto a
-        # single chip and forcing a recompile.
-        try:
-            spec = jax.tree_util.tree_map(
-                lambda x: x.sharding, self.params
-            )
-        except AttributeError:  # engine was built with host arrays
-            spec = None
         self._pending_t0 = time.perf_counter()
-        self._pending_params = jax.device_put(params, spec)
+        self._pending_params = _device_put_like(params, self.params)
 
     def _maybe_adopt_pending(self) -> bool:
         """Adopt a pending async swap if the transfer has completed —
@@ -537,6 +546,13 @@ class ContinuousBatchingEngine:
         self._prefix_states.clear()
         self.swap_latency_s = time.perf_counter() - self._pending_t0
         return True
+
+    def poll_pending_swap(self) -> bool:
+        """Public adoption poll for drivers whose engine may sit IDLE:
+        ``step()`` adopts pending async swaps at chunk boundaries, but
+        an idle server never steps — without this poll an async swap on
+        an idle engine would leave ``swap_pending`` true forever."""
+        return self._maybe_adopt_pending()
 
     def _pad_rows(self, rows: List[List[int]], width: int):
         # generation.left_pad_prompts owns the padding convention
@@ -678,11 +694,16 @@ class ContinuousBatchingEngine:
         """One scheduler iteration: compact if out of headroom
         (frontier layout only), admit into free slots, decode one
         chunk, retire finished rows. Returns the number of tokens
-        emitted this chunk."""
+        emitted this chunk. Phase boundaries are stamped into
+        ``self.phases`` — admission / prefill / decode_dispatch /
+        host_sync / retirement — so ``stats()`` (and the bench's
+        attribution rung) can report the host/device split."""
+        t0 = time.perf_counter()
         # a completed async weight swap lands here, between chunks —
         # the non-blocking check costs ~nothing when none is pending
         self._maybe_adopt_pending()
         frontier_layout = self.layout == "frontier"
+        prefill_s = 0.0
         if frontier_layout:
             if self._queue and all(
                 st.uid < 0 for st in self._slots
@@ -694,7 +715,9 @@ class ContinuousBatchingEngine:
                 # emits zero tokens.
                 self._reset_device_state()
             if self._frontier + self.d > self.L:
-                self._compact()
+                tc = time.perf_counter()
+                self._compact()  # a batched re-prefill: device work
+                prefill_s += time.perf_counter() - tc
         # admission: fills empty slots while the budget allows
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
@@ -710,9 +733,14 @@ class ContinuousBatchingEngine:
             (uid, prompt, submit_t, cap, prefix_id, allowed) = (
                 self._queue.pop(0)
             )
+            ta = time.perf_counter()
             self._admit_one(
                 slot, uid, prompt, submit_t, cap, prefix_id, allowed
             )
+            prefill_s += time.perf_counter() - ta
+        t_admit = time.perf_counter()
+        self.phases.add("prefill", prefill_s)
+        self.phases.add("admission", t_admit - t0 - prefill_s)
 
         with self._ctx():
             if frontier_layout:
@@ -730,9 +758,13 @@ class ContinuousBatchingEngine:
                         self.params, self._state, jnp.int32(0), rng
                     )
                 )
+        t_disp = time.perf_counter()
+        self.phases.add("decode_dispatch", t_disp - t_admit)
         toks, emits, logps, done = jax.device_get(
             (toks, emits, logps, self._state[-2])  # -2: the done flags
         )
+        t_sync = time.perf_counter()
+        self.phases.add("host_sync", t_sync - t_disp)
         emitted = 0
         for slot, st in enumerate(self._slots):
             if st.uid < 0:
@@ -749,6 +781,8 @@ class ContinuousBatchingEngine:
             st.finished = bool(done[slot])
             if st.finished or len(st.emitted) >= st.cap:
                 self._retire(slot)
+        self.phases.add("retirement", time.perf_counter() - t_sync)
+        self.phases.rounds += 1
         return emitted
 
     @property
@@ -775,6 +809,10 @@ class ContinuousBatchingEngine:
             ),
             "last_swap_latency_s": self.swap_latency_s,
             "swap_pending": self._pending_params is not None,
+            # host/device attribution (attribution.phases): host_frac
+            # plus per-phase totals, compact enough for /healthz and
+            # the bench line budget
+            "phase_split": self.phases.split().summary(),
         }
 
     def partial(self, uid: int):
@@ -864,15 +902,72 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self,
         model,
         params,
-        sampling: SamplingConfig,
-        batch_size: int,
-        prompt_width: int,
+        *args,
+        sampling: Optional[SamplingConfig] = None,
+        batch_size: Optional[int] = None,
+        prompt_width: Optional[int] = None,
         draft_model=None,
         draft_params=None,
         num_draft: int = 4,
+        decode_chunk: int = 1,
         mesh=None,
         rules=None,
     ):
+        """Two positional shapes are accepted:
+
+        - ``(model, params, sampling, ...)`` — self-drafting (classic);
+        - ``(model, params, draft_model, draft_params, sampling, ...)``
+          — the draft pair rides directly after the target pair, so a
+          separate-draft engine reads like its arguments mean.
+
+        ``draft_model``/``draft_params`` also work as keywords in
+        either shape. ``decode_chunk`` is accepted for constructor
+        parity with :class:`ContinuousBatchingEngine` and ignored: a
+        speculative round IS the dispatch unit (each round emits 1..k+1
+        tokens per row in one draft+verify exchange)."""
+        def _take(name, current, value):
+            # positional/keyword double-supply must raise like a
+            # normal signature would, never silently prefer one
+            if current is not None:
+                raise TypeError(f"got multiple values for {name!r}")
+            return value
+
+        if args:
+            if isinstance(args[0], SamplingConfig):
+                # base-class parity: (sampling[, batch_size[,
+                # prompt_width]]) positionally, like
+                # ContinuousBatchingEngine
+                if len(args) > 3:
+                    raise TypeError(
+                        "too many positional args after sampling"
+                    )
+                tail = args
+            else:
+                if draft_model is not None or draft_params is not None:
+                    raise TypeError(
+                        "don't mix the positional draft pair with "
+                        "draft_model/draft_params keywords"
+                    )
+                if len(args) < 2 or len(args) > 5:
+                    raise TypeError(
+                        "expected (model, params, sampling, ...) or "
+                        "(model, params, draft_model, draft_params, "
+                        "sampling[, batch_size[, prompt_width]], ...)"
+                    )
+                draft_model, draft_params = args[0], args[1]
+                tail = args[2:]
+            if len(tail) >= 1:
+                sampling = _take("sampling", sampling, tail[0])
+            if len(tail) >= 2:
+                batch_size = _take("batch_size", batch_size, tail[1])
+            if len(tail) >= 3:
+                prompt_width = _take(
+                    "prompt_width", prompt_width, tail[2]
+                )
+        if sampling is None or batch_size is None or prompt_width is None:
+            raise TypeError(
+                "sampling, batch_size and prompt_width are required"
+            )
         if sampling.temperature != 0.0:
             raise ValueError(
                 "SpeculativeBatchingEngine is greedy-only "
@@ -880,6 +975,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 "one-shot engine (models/speculative.py)"
             )
         self.draft_model = draft_model if draft_model is not None else model
+        self._pending_draft = None  # in-flight async DRAFT swap
         self.k = int(num_draft)
         if self.k < 1:
             raise ValueError(f"num_draft {num_draft} must be >= 1")
@@ -1094,21 +1190,56 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         """Swap target weights (and optionally the draft's). A self-
         drafting engine whose draft_params were the target's follows
         the target automatically."""
-        follow = self.draft_params is self.params
-        latency = super().set_params(params)
+        self.set_params_async(params, draft_params=draft_params)
+        jax.block_until_ready(self._pending_params)
+        if self._pending_draft is not None:
+            jax.block_until_ready(self._pending_draft)
+        self._maybe_adopt_pending()
+        return self.swap_latency_s
+
+    def set_params_async(self, params, draft_params=None) -> None:
+        """Non-blocking swap of the target AND (optionally) the draft:
+        both transfers are enqueued now, and adoption is ATOMIC at a
+        round boundary — the engine never runs a round with a new
+        target against an old explicit draft (their logits disagree and
+        acceptance collapses for that round). A self-following draft
+        (draft_params is params) keeps following without a transfer.
+        Superseding pushes compose per component: a later target-only
+        call keeps the latest draft push pending, so target and draft
+        still land together.
+
+        Like every engine method, this must be called from the one
+        driver thread that owns the engine (the serving daemon routes
+        all swaps through its inbox). The draft is staged BEFORE the
+        target as cheap defense in depth: adoption gates on the target
+        being pending, so an out-of-contract concurrent poll between
+        the two stores sees draft-without-target and adopts nothing,
+        rather than target-without-draft."""
         if draft_params is not None:
-            self.draft_params = jax.device_put(draft_params)
-        elif follow:
-            self.draft_params = self.params
-        return latency
+            self._pending_draft = _device_put_like(
+                draft_params, self.draft_params
+            )
+        super().set_params_async(params)
 
     def _maybe_adopt_pending(self) -> bool:
-        """Async-swap adoption keeps a self-following draft in sync
-        (set_params_async carries no draft_params — an explicit draft
-        swap stays a blocking set_params concern)."""
+        """Atomic target+draft adoption: when an explicit draft swap is
+        in flight, adoption waits until BOTH pytrees have landed; a
+        self-following draft re-aliases to the new target at the same
+        boundary."""
+        pending_draft = self._pending_draft
+        if pending_draft is not None and self._pending_params is not None:
+            if not all(
+                leaf.is_ready()
+                for leaf in jax.tree_util.tree_leaves(pending_draft)
+                if hasattr(leaf, "is_ready")
+            ):
+                return False
         follow = self.draft_params is self.params
         if super()._maybe_adopt_pending():
-            if follow:
+            if pending_draft is not None:
+                self.draft_params = pending_draft
+                self._pending_draft = None
+            elif follow:
                 self.draft_params = self.params
             return True
         return False
@@ -1135,25 +1266,40 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         )
 
     def step(self, rng):
-        """One speculation round: admit, draft+verify, emit 1..k+1
-        tokens per live row, retire eos/cap rows. Returns tokens
-        emitted. ``rng`` is accepted for API parity (greedy rounds are
+        """One speculation round: adopt any landed async swap (target
+        AND draft, atomically), admit, draft+verify, emit 1..k+1 tokens
+        per live row, retire eos/cap rows. Returns tokens emitted.
+        ``rng`` is accepted for API parity (greedy rounds are
         deterministic)."""
+        t0 = time.perf_counter()
+        # the chunk boundary of this engine is the round boundary — an
+        # async swap (WeightBus push) lands here, never mid-round
+        self._maybe_adopt_pending()
+        prefill_s = 0.0
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
                 continue
             (uid, prompt, submit_t, cap, prefix_id, _allowed) = (
                 self._queue.pop(0)
             )
+            ta = time.perf_counter()
             self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
+            prefill_s += time.perf_counter() - ta
+        t_admit = time.perf_counter()
+        self.phases.add("prefill", prefill_s)
+        self.phases.add("admission", t_admit - t0 - prefill_s)
 
         with self._ctx():
             self._state, (win, accept, logps) = self._round_fn(
                 self.params, self.draft_params, self._state
             )
+        t_disp = time.perf_counter()
+        self.phases.add("decode_dispatch", t_disp - t_admit)
         win, accept, logps, done = jax.device_get(
             (win, accept, logps, self._state[-2])  # -2: the done flags
         )
+        t_sync = time.perf_counter()
+        self.phases.add("host_sync", t_sync - t_disp)
         emitted = 0
         self.rounds += 1
         live = [st.uid >= 0 for st in self._slots]
@@ -1178,6 +1324,8 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             st.finished = bool(done[slot])
             if st.finished or len(st.emitted) >= st.cap:
                 self._retire(slot)
+        self.phases.add("retirement", time.perf_counter() - t_sync)
+        self.phases.rounds += 1
         return emitted
 
     def stats(self) -> Dict:

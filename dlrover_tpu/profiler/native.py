@@ -31,6 +31,11 @@ KIND_OTHER = 5
 # families so step durations don't pollute op-granular latency gauges.
 KIND_HLO_FLOPS = 6
 KIND_HLO_COMM = 7
+# PJRT driver-boundary events (the interposer's whole-executable
+# envelopes) — see TT_KIND_* in native/tpu_timer/tpu_timer.h, the one
+# authoritative enum this block mirrors.
+KIND_EXECUTE = 8
+KIND_COMPILE = 9
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()

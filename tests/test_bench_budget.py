@@ -88,3 +88,192 @@ def test_no_deadline_is_unbounded():
         [sys.executable, "-c", f"print({line!r})"], {}, [], None
     )
     assert parsed is not None and parsed["value"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Byte budget (VERDICT r5 #2): the ONE emitted line must stay parseable
+# inside the driver's ~2,000-char window. The shrink is exercised on the
+# WORST case: both LATEST artifacts merged, 10 probe entries, every
+# bench section populated.
+# ---------------------------------------------------------------------------
+
+
+def _worst_case_extra(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_REPO_DIR", str(tmp_path))
+    # realistic committed artifacts (shapes from the r5 round)
+    with open(tmp_path / "SILICON_LATEST.json", "w") as f:
+        json.dump(
+            {
+                "ts": 1785575775, "git_sha": "6e56865",
+                "artifact": "SILICON_r05_1785575775.json",
+                "metric": "gpt2s_train_tokens_per_s", "value": 114100.0,
+                "unit": "tokens/s", "vs_baseline": 1.58,
+                "device": "TPU_v5e(chip=0)",
+                "headline": {
+                    k: 0.1 * i
+                    for i, k in enumerate(
+                        (
+                            "mfu", "flash_step_s", "flash_batch",
+                            "seq_len", "flash_seq4096_tflops",
+                            "decode_tokens_per_s",
+                            "generate_tokens_per_s",
+                            "llama_tokens_per_s", "moe_tokens_per_s",
+                            "spec_tokens_per_s", "spec_acceptance",
+                            "longseq_train_tokens_per_s",
+                            "ckpt_async_stage_block_s",
+                            "goodput_ckpt_every_10_steps",
+                            "serving_per_row_tokens_per_s",
+                            "serving_host_frac",
+                        )
+                    )
+                },
+            },
+            f,
+        )
+    with open(tmp_path / "HANG_DIAGNOSIS_LATEST.json", "w") as f:
+        json.dump(
+            {
+                "ts": 1785692011, "git_sha": "01f7eac",
+                "artifact": "HANG_DIAGNOSIS_r05_1785692011.json",
+                "phase": "reg",
+                "classification": (
+                    "pjrt_client_init_hang (zero device activity; host "
+                    "wedged creating the PJRT client — tunnel dial "
+                    "never completed)"
+                ),
+                "wedge_frame": 'File "axon/register.py", line 88',
+                "stall_verdict": None,
+                "stall_verdict_name": "unknown",
+                "interposer_metrics": {
+                    "tpu_timer_device_launches_total": 0.0
+                },
+                "stack_excerpt": "x" * 600,
+            },
+            f,
+        )
+    # every section populated: ~90 keys the real worker can emit
+    extra = {"device": "TPU_v5e(chip=0) at tunnel", "model": "gpt2-small-124M"}
+    sections = (
+        "flash_step_s flash_batch seq_len mfu dense_step_s dense_batch "
+        "dense_tokens_per_s flash_vs_dense headline_config ckpt_bytes "
+        "flash_ckpt_save_block_s ckpt_save_block_s ckpt_async_stage_block_s "
+        "ckpt_save_vs_target restore_s h2d_floor_s restore_overhead_x "
+        "goodput_ckpt_every_10_steps flash_seq4096_ms flash_seq4096_tflops "
+        "flash_seq4096_dispatch_floor_ms generate_tokens_per_s decode_batch "
+        "decode_prompt_len decode_new_tokens decode_ms_per_step "
+        "decode_tokens_per_s prefill_ms decode_int8_ms_per_step "
+        "decode_int8_tokens_per_s decode_int8_vs_bf16 spec_tokens_per_s "
+        "spec_acceptance spec_self_acceptance spec_self_acceptance_f32 "
+        "spec_vs_plain serving_weight_adopt_s serving_stream_tokens_per_s "
+        "serving_homogeneous_tokens_per_s serving_mixed_vs_homogeneous "
+        "serving_weight_swap_s serving_batch_slots serving_requests "
+        "serving_per_row_tokens_per_s serving_per_row_vs_frontier "
+        "serving_spec_tokens_per_s serving_spec_acceptance "
+        "serving_spec_vs_per_row serving_int8_2x_slots_tokens_per_s "
+        "serving_int8_2x_vs_per_row serving_host_frac "
+        "attr_top_residual_frac attr_matmul_frac llama_tokens_per_s "
+        "llama_step_s moe_tokens_per_s moe_step_s longseq_train_tokens_per_s "
+        "longseq_train_mfu fused_ce_b32_step_s fused_ce_b32_tokens_per_s "
+        "fused_ce_b64_step_s fused_ce_b64_tokens_per_s remat_dots_step_s "
+        "remat_dots_tokens_per_s no_remat_step_s no_remat_tokens_per_s "
+        "batch48_step_s batch48_tokens_per_s batch64_step_s "
+        "batch64_tokens_per_s worker_rc"
+    ).split()
+    for i, k in enumerate(sections):
+        extra[k] = round(1234.5678 + i, 4)
+    extra["headline_config"] = "flash+fused_ce+remat_dots+b64"
+    extra["tpu_attempt"] = "interposed"
+    extra["attr_report"] = "BENCH_attr_1785575775_1234.json"
+    extra["attr_ring"] = "BENCH_attr_ring_1785575775_1234.timeline"
+    extra["attr_top_residual"] = "optimizer_hbm"
+    extra["hbm_live_mb"] = {
+        n: 1234.5 for n in (
+            "post_dense", "post_ckpt", "post_serving", "post_llama",
+            "post_longseq",
+        )
+    }
+    extra["interposed"] = {
+        "execute_count": 50000.0, "execute_avg_us": 3300.0,
+        "execute_max_us": 410000.0, "h2d_count": 900.0,
+        "compile_count": 44.0, "device_completes": 50000.0,
+        "stall_verdict": 0.0,
+    }
+    extra["goodput_storm"] = {
+        "goodput": 0.83, "steps": 400, "restarts": 3,
+        "elapsed_s": 481.2, "trainers": 2,
+    }
+    bench._merge_committed_artifacts(extra)
+    extra["probe_history"] = [
+        {
+            "ts": 1785575700 + i, "rc": -9, "duration_s": 180.0,
+            "phase": "none", "platform": "",
+            "last_stderr": "y" * bench.STDERR_MAX,
+        }
+        for i in range(10)
+    ]
+    extra["probe_sidecar"] = "BENCH_probe_sidecar_1785575775_1234.json"
+    extra["probe_history_watcher"] = {
+        "attempts": 120, "ok": 3, "first_ts": 1785500000,
+        "last_ts": 1785575775, "span_s": 75775,
+        "last": {"ts": 1785575775, "rc": -9, "phase": "none"},
+    }
+    return extra
+
+
+def test_merge_committed_artifacts_is_pointers_not_payloads(
+    tmp_path, monkeypatch
+):
+    bench = _bench()
+    extra = _worst_case_extra(bench, tmp_path, monkeypatch)
+    # the merged records are POINTERS: artifact + sha + a handful of
+    # floats, bounded regardless of what the LATEST files hold
+    assert extra["last_silicon"]["artifact"].startswith("SILICON_r05")
+    assert extra["last_silicon"]["git_sha"] == "6e56865"
+    assert len(json.dumps(extra["last_silicon"])) < 400
+    assert extra["hang_diagnosis"]["artifact"].startswith("HANG_")
+    assert "stack_excerpt" not in extra["hang_diagnosis"]
+    assert len(json.dumps(extra["hang_diagnosis"])) < 300
+
+
+def test_line_budget_worst_case(tmp_path, monkeypatch):
+    """Both LATEST artifacts merged + 10 probe entries + every section
+    populated: the emitted line must stay ≤ 1,800 bytes with the vital
+    keys in-line and the complete extra in the sidecar."""
+    bench = _bench()
+    extra = _worst_case_extra(bench, tmp_path, monkeypatch)
+    result = {
+        "metric": bench.METRIC, "value": 114100.0, "unit": "tokens/s",
+        "vs_baseline": 1.58, "extra": extra,
+    }
+    assert len(json.dumps(result)) > bench.LINE_BUDGET_BYTES  # truly worst
+    line = bench._shrink_to_budget(result)
+    s = json.dumps(line)
+    assert len(s) <= bench.LINE_BUDGET_BYTES, len(s)
+    # the driver's contract fields are intact
+    assert line["metric"] == bench.METRIC and line["value"] == 114100.0
+    assert line["vs_baseline"] == 1.58
+    # the vital keys survived in-line
+    slim = line["extra"]
+    assert slim["line_truncated"] is True
+    assert slim["mfu"] == extra["mfu"]
+    assert slim["serving_host_frac"] == extra["serving_host_frac"]
+    assert slim["attr_report"] == extra["attr_report"]
+    assert slim["last_silicon"]["artifact"] == (
+        extra["last_silicon"]["artifact"]
+    )
+    # the COMPLETE extra is recoverable from the sidecar
+    sidecar = tmp_path / slim["extra_sidecar"]
+    full = json.load(open(sidecar))
+    assert set(extra) == set(full)
+    assert full["probe_history"] == extra["probe_history"]
+
+
+def test_under_budget_line_passes_through_untouched(tmp_path, monkeypatch):
+    bench = _bench()
+    monkeypatch.setattr(bench, "_REPO_DIR", str(tmp_path))
+    result = {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+        "extra": {"device": "cpu"},
+    }
+    assert bench._shrink_to_budget(result) is result
+    assert not list(tmp_path.glob("BENCH_extra_*"))  # no sidecar spam

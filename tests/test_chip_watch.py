@@ -301,7 +301,10 @@ class TestCaptureSilicon:
         probe)."""
         import textwrap
 
-        fake_worker = tmp_path / "bench.py"  # name must match the reap
+        # the reap is scoped to THIS repo's bench.py (chip_watch.REPO,
+        # monkeypatched to fake_repo) — a machine-wide bench.py from
+        # another checkout must never match
+        fake_worker = fake_repo / "bench.py"
         fake_worker.write_text("import time; time.sleep(300)\n")
         spawner = tmp_path / "spawner.py"
         spawner.write_text(textwrap.dedent(f"""
@@ -336,6 +339,73 @@ class TestCaptureSilicon:
             if str(fake_worker) in cmd and "--worker" in cmd:
                 leftovers.append(pid_s)
         assert not leftovers, leftovers
+
+    def test_reap_skips_foreign_bench_worker(
+        self, tmp_path, monkeypatch, fake_repo
+    ):
+        """A `bench.py --worker` from ANOTHER checkout (machine-wide
+        match) must survive the reap — only THIS repo's workers are
+        fair game."""
+        foreign = tmp_path / "bench.py"
+        foreign.write_text("import time; time.sleep(300)\n")
+        proc = subprocess.Popen(
+            [sys.executable, str(foreign), "--worker"],
+            start_new_session=True,
+        )
+        try:
+            chip_watch._reap_orphan_workers()
+            import time as _t
+
+            _t.sleep(0.3)
+            assert proc.poll() is None, "foreign worker was reaped"
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_reap_skips_hand_run_worker_in_shell_session(
+        self, monkeypatch, fake_repo
+    ):
+        """A developer's `python bench.py --worker` shares its shell's
+        session (bench-spawned workers are session LEADERS) — it must
+        survive the reap even though its parent is not bench.py."""
+        worker = fake_repo / "bench.py"
+        worker.write_text("import time; time.sleep(300)\n")
+        proc = subprocess.Popen(
+            [sys.executable, str(worker), "--worker"]
+        )  # no start_new_session: same session as this process
+        try:
+            chip_watch._reap_orphan_workers()
+            import time as _t
+
+            _t.sleep(0.3)
+            assert proc.poll() is None, "hand-run worker was reaped"
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_reap_repo_worker_with_non_bench_parent(
+        self, monkeypatch, fake_repo
+    ):
+        """Child-subreaper containers: a dead orchestrator's worker
+        (a session leader, as bench spawns them) reparents to the
+        subreaper (NOT pid 1), so the orphan test is 'session leader
+        whose parent is no longer a bench.py orchestrator'. This
+        pytest process plays the subreaper: it is alive but is not
+        bench.py, so the worker must be reaped."""
+        worker = fake_repo / "bench.py"
+        worker.write_text("import time; time.sleep(300)\n")
+        proc = subprocess.Popen(
+            [sys.executable, str(worker), "--worker"],
+            start_new_session=True,
+        )
+        try:
+            chip_watch._reap_orphan_workers()
+            proc.wait(timeout=10)
+            assert proc.returncode == -9  # SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
 
     def test_cpu_fallback_is_not_marked_silicon(
         self, tmp_path, monkeypatch, fake_repo

@@ -240,6 +240,51 @@ class TestHealthzStats:
         assert h["registered_prefixes"] == 0
         assert h["kv_cache_int8"] is False
 
+    def test_healthz_exposes_attribution_breakdown(self, server):
+        """/healthz carries the host/device split: the top-level
+        serving_host_frac headline plus the per-phase table."""
+        base, *_ = server
+        _post(base, "/v1/completions", {"prompt": [5, 9, 2]})
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            h = json.loads(r.read())
+        assert "serving_host_frac" in h
+        split = h["phase_split"]
+        assert split["rounds"] > 0
+        assert 0.0 < split["serving_host_frac"] < 1.0
+        for phase in ("admission", "prefill", "decode_dispatch",
+                      "host_sync", "retirement"):
+            assert f"{phase}_ms" in split
+
+
+class TestIdleSwap:
+    def test_async_swap_converges_on_idle_server(self):
+        """An async weight swap submitted while NO request is live must
+        still be adopted (the driver polls adoption in its idle branch;
+        before the fix swap_pending stayed true until the next request
+        arrived — indefinitely on a quiet server)."""
+        import time
+
+        model = _model()
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=6, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, p1, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4,
+        )
+        daemon = ServingDaemon(eng).start()
+        try:
+            assert not eng.pending  # idle from the start
+            assert daemon.swap_params_async(p2) is True
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if not eng.stats()["swap_pending"]:
+                    break
+                time.sleep(0.05)
+            assert eng.stats()["swap_pending"] is False
+            assert eng.stats()["last_swap_latency_s"] > 0
+        finally:
+            daemon.stop()
+
 
 class TestStreaming:
     def test_stream_tokens_arrive_incrementally(self, server):

@@ -719,6 +719,21 @@ class TestSpeculativeServing:
         stats = eng.stats()
         assert stats["speculative_num_draft"] == 2
         assert stats["self_drafting"] is True
+        # mixing the positional draft pair with the draft keywords is
+        # ambiguous — it must raise, never silently prefer one
+        greedy = SamplingConfig(max_new_tokens=4, temperature=0.0)
+        with pytest.raises(TypeError, match="don't mix"):
+            SpeculativeBatchingEngine(
+                model, params, model, params, greedy,
+                draft_params=_params(model, 1),
+                batch_size=2, prompt_width=16, num_draft=2,
+            )
+        with pytest.raises(TypeError, match="don't mix"):
+            SpeculativeBatchingEngine(
+                model, params, model, params, greedy,
+                draft_model=model,
+                batch_size=2, prompt_width=16, num_draft=2,
+            )
 
 
 class TestCancellation:

@@ -13,10 +13,19 @@ throughput loss vs raw decode that nothing measured):
 - ``host_sync``   device: blocking fetch of the chunk's tokens — the
                   wait measures device execution on a sync backend
 - ``retirement``  host: emit loop, completion bookkeeping
+- ``overlap_hidden``  the pipelined scheduler's third category: host
+                  work (admission, emission, retirement) performed
+                  WHILE a decode chunk is in flight on the device.
+                  The device is not idle during it, so it is neither
+                  host nor device time — it is the host cost the
+                  double-buffered round hid.
 
 ``serving_host_frac`` = host time / total — the fraction of a serving
-round the DEVICE sits idle while the host schedules. The accumulator
-is pure arithmetic over (phase, seconds) samples, so the split math is
+round the DEVICE sits idle while the host schedules. Overlap-hidden
+time counts toward the total but not toward host: the pipelined
+scheduler's win shows up as a nonzero ``overlap_s`` and a reduced
+``serving_host_frac`` over the same stream. The accumulator is pure
+arithmetic over (phase, seconds) samples, so the split math is
 unit-testable on synthetic timestamps without an engine.
 """
 
@@ -30,9 +39,11 @@ PHASES = (
     "decode_dispatch",
     "host_sync",
     "retirement",
+    "overlap_hidden",
 )
 HOST_PHASES = frozenset({"admission", "decode_dispatch", "retirement"})
 DEVICE_PHASES = frozenset({"prefill", "host_sync"})
+OVERLAP_PHASES = frozenset({"overlap_hidden"})
 
 # log2(µs) histogram: bucket i covers [2^i, 2^(i+1)) µs; 20 buckets
 # reach ~10 min — far past any sane phase span.
@@ -57,6 +68,9 @@ class PhaseSplit:
     serving_host_frac: float
     rounds: int
     phases: Dict[str, Dict]
+    # host time hidden behind in-flight device chunks (the pipelined
+    # scheduler's round): in total_s, in neither host_s nor device_s
+    overlap_s: float = 0.0
 
     def summary(self) -> Dict:
         """Compact dict for /healthz and bench extras (floats only,
@@ -65,6 +79,8 @@ class PhaseSplit:
             "serving_host_frac": round(self.serving_host_frac, 4),
             "rounds": self.rounds,
         }
+        if self.overlap_s:
+            out["overlap_hidden_s"] = round(self.overlap_s, 4)
         for name, stat in self.phases.items():
             out[f"{name}_ms"] = round(stat["total_s"] * 1e3, 2)
         return out
@@ -117,15 +133,19 @@ class PhaseAccumulator:
         host_s = sum(
             s.total_s for p, s in stats.items() if p in HOST_PHASES
         )
+        overlap_s = sum(
+            s.total_s for p, s in stats.items() if p in OVERLAP_PHASES
+        )
         device_s = sum(
             s.total_s for p, s in stats.items()
-            if p not in HOST_PHASES
+            if p not in HOST_PHASES and p not in OVERLAP_PHASES
         )
-        total_s = host_s + device_s
+        total_s = host_s + device_s + overlap_s
         return PhaseSplit(
             total_s=total_s,
             host_s=host_s,
             device_s=device_s,
+            overlap_s=overlap_s,
             serving_host_frac=(host_s / total_s) if total_s > 0 else 0.0,
             rounds=self.rounds,
             phases={

@@ -95,13 +95,22 @@ class Report:
         if self.serving:
             # no ring: the residual IS the host side of the split
             frac = float(self.serving.get("serving_host_frac", 0.0))
+            if self.serving.get("overlap_s"):
+                rec = (
+                    "pipeline already overlapping: residual host time "
+                    "is dispatch — raise decode_chunk (auto_chunk) or "
+                    "cut per-round dispatch work"
+                )
+            else:
+                rec = (
+                    "enable the overlapped scheduler round "
+                    "(overlap=True) / raise decode_chunk / batch "
+                    "retirement reads"
+                )
             return {
                 "bucket": "serving_host",
                 "frac": round(frac, 4),
-                "recommendation": (
-                    "raise decode_chunk / overlap admission prefill "
-                    "with decode / batch retirement reads"
-                ),
+                "recommendation": rec,
             }
         return {"bucket": None, "frac": 0.0,
                 "recommendation": "empty report"}
@@ -122,17 +131,24 @@ class Report:
 
 
 def _format_serving(sv: Dict) -> str:
-    lines = [
+    head = (
         f"serving_host_frac: {sv.get('serving_host_frac', 0.0):.3f} "
         f"over {sv.get('rounds', 0)} rounds "
         f"(host {sv.get('host_s', 0.0):.3f}s / "
         f"device {sv.get('device_s', 0.0):.3f}s)"
-    ]
+    )
+    if sv.get("overlap_s"):
+        # pipelined scheduler: host work hidden behind in-flight chunks
+        head += f" + {sv['overlap_s']:.3f}s host hidden by overlap"
+    lines = [head]
     for name, stat in sorted(
         (sv.get("phases") or {}).items(),
         key=lambda kv: -(kv[1].get("total_s") or 0),
     ):
-        side = "host" if stat.get("host") else "device"
+        if name == "overlap_hidden":
+            side = "hidden"
+        else:
+            side = "host" if stat.get("host") else "device"
         lines.append(
             f"  {name:16} {side:6} total {stat.get('total_s', 0.0):8.4f}s"
             f"  mean {stat.get('mean_ms', 0.0):8.3f}ms"

@@ -49,7 +49,15 @@ __all__ = ["ServingDaemon", "main"]
 class ServingDaemon:
     """Driver thread that owns a ContinuousBatchingEngine: requests and
     weight swaps arrive through a thread-safe inbox, completions resolve
-    futures. Start/stop lifecycle; safe to call from many threads."""
+    futures. Start/stop lifecycle; safe to call from many threads.
+
+    With the overlapped (default) engine round the driver tolerates a
+    one-chunk emission latency by construction: ``engine.pending``
+    stays true while a dispatched chunk's results are unread, so the
+    loop keeps stepping until the pipeline tail drains; streaming
+    ``partial()`` reads simply lag the device by one chunk; and a
+    cancel between steps frees the slot while the in-flight chunk's
+    tokens for it are dropped at the engine's uid-snapshot check."""
 
     def __init__(self, engine, rng_seed: int = 0):
         import jax
@@ -652,7 +660,24 @@ def main(argv=None) -> int:
         "--speculative-draft", type=int, default=0, metavar="K",
         help="serve through the speculative scheduler: self-draft K "
         "tokens per round, target verifies in one forward (greedy "
-        "only; trained weights accept near 1.0 per draft)",
+        "only; trained weights accept near 1.0 per draft). Measured "
+        "status: no silicon capture has yet shown spec_vs_plain > 1.0 "
+        "on this chip (r5: serving_spec_vs_per_row 0.727 self-draft) — "
+        "the win needs a draft meaningfully cheaper than the target",
+    )
+    ap.add_argument(
+        "--sync-round", action="store_true",
+        help="serve with the host-serialized scheduler round (the "
+        "pre-pipeline behavior; A/B and debugging). Default is the "
+        "double-buffered overlapped round: chunk N+1 dispatches "
+        "before chunk N's tokens are read, hiding host scheduling "
+        "behind device execution at a one-chunk emission latency.",
+    )
+    ap.add_argument(
+        "--auto-chunk", action="store_true",
+        help="retune --decode-chunk between dispatches from the "
+        "measured serving_host_frac (grow when host-bound, shrink "
+        "when device-bound)",
     )
     ap.add_argument(
         "--kv-int8", action="store_true",
@@ -730,6 +755,7 @@ def main(argv=None) -> int:
             batch_size=ns.batch_size,
             prompt_width=ns.prompt_width,
             num_draft=ns.speculative_draft,
+            overlap=not ns.sync_round,
         )
     else:
         engine = ContinuousBatchingEngine(
@@ -738,6 +764,8 @@ def main(argv=None) -> int:
             prompt_width=ns.prompt_width,
             decode_chunk=ns.decode_chunk,
             cache_layout=ns.cache_layout,
+            overlap=not ns.sync_round,
+            auto_chunk=ns.auto_chunk,
         )
     daemon = ServingDaemon(engine).start()
     httpd = serve(daemon, ns.port, reload_fn)

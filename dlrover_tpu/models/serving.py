@@ -40,14 +40,37 @@ TPU shape — every device program is static-shape and compiled once:
   parameter argument of the jitted programs (same shapes — no
   recompile), so a WeightBus push lands at the next chunk boundary;
   ``swap_latency_s`` of the last swap is recorded.
+- **Overlapped (double-buffered) round** (``overlap=True``, the
+  default): each ``step()`` dispatches chunk N+1 *before* it syncs and
+  retires chunk N, so the device queue never drains between rounds and
+  the host's emission/retirement/admission work runs while the next
+  chunk executes. Per-row stop enforcement lives ON THE DEVICE for
+  this (cap counters + done-masking inside the jitted chunk fn): a row
+  that hits its cap or EOS mid-flight is silenced by the device state
+  itself, so the one-chunk lag between device progress and host
+  bookkeeping can neither over-emit nor corrupt KV. The host sees a
+  one-chunk emission latency; greedy streams are bit-identical with
+  the synchronous round (``overlap=False``, kept as the A/B baseline).
+  Weight swaps adopt only at a drained pipeline (no chunk in flight),
+  so a push can never split a round between parameter versions.
+  Host time hidden behind in-flight chunks is stamped as the
+  ``overlap_hidden`` phase (attribution.phases).
+- **decode_chunk auto-tuning** (``auto_chunk=True``): the measured
+  ``serving_host_frac`` drives the chunk length between dispatches —
+  host-bound streams grow the chunk (amortize per-round host cost over
+  more tokens), device-bound streams shrink it back (less wasted tail
+  decode and faster admission). One compiled program per candidate
+  length, all liveness-checked.
 """
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..attribution.phases import PhaseAccumulator
 from .generation import (
@@ -77,6 +100,17 @@ class Completion:
     total_s: float = 0.0  # admission → retirement
 
 
+def _tree_ready(tree) -> bool:
+    """Non-blocking: every leaf of ``tree`` has finished computing /
+    transferring (``Array.is_ready``). The one readiness poll shared
+    by async weight adoption and the pipeline's zero-lag probe."""
+    return all(
+        leaf.is_ready()
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "is_ready")
+    )
+
+
 def _device_put_like(tree, like):
     """Enqueue ``tree`` to the device preserving ``like``'s per-leaf
     placement: a WeightBus push delivers HOST arrays, and a bare
@@ -103,6 +137,73 @@ class _Slot:
     first_tok_t: float = 0.0
 
 
+class _ChunkAutoTuner:
+    """Retunes ``decode_chunk`` between dispatches from the measured
+    ``serving_host_frac`` (attribution.phases): when the host fraction
+    of a window of rounds runs high, per-round host cost dominates —
+    grow the chunk so one dispatch/readback amortizes over more
+    tokens; when it runs low, shrink back — small chunks waste fewer
+    tail steps on finished rows and admit queued requests sooner.
+    Candidates are fixed at construction (one compiled program each)
+    and every one satisfies the engine's liveness bound, so a retune
+    can never strand the stream."""
+
+    WINDOW = 8  # rounds per decision — enough samples to smooth noise
+    HIGH = 0.35
+    LOW = 0.10
+
+    def __init__(self, engine):
+        s = engine.s
+        cands = {engine.d} | {4, 8, 16, 32}
+        cands = {
+            c for c in cands
+            if c == engine.d or 1 <= c <= s.max_new_tokens
+        }
+        if engine.layout == "frontier":
+            worst = engine._align(engine.Pw + s.max_new_tokens)
+            cands = {
+                c for c in cands
+                if c == engine.d
+                or worst + max(s.max_new_tokens, c) <= engine.L
+            }
+        self.candidates = sorted(cands)
+        self.engine = engine
+        self.retunes = 0
+        self._mark = self._snapshot()
+
+    def _snapshot(self):
+        split = self.engine.phases.split()
+        return (split.host_s, split.total_s, split.rounds)
+
+    def maybe_retune(self) -> Optional[int]:
+        """Called once per scheduler round; returns the new chunk
+        length when a retune happened, else None. The off-decision
+        rounds pay one integer compare — a full split() only builds
+        on decision rounds."""
+        h0, t0, r0 = self._mark
+        rounds = self.engine.phases.rounds
+        if rounds < r0:  # accumulator was reset (bench warm/reset)
+            self._mark = self._snapshot()
+            return None
+        if rounds - r0 < self.WINDOW:
+            return None
+        split = self.engine.phases.split()
+        dh, dt = split.host_s - h0, split.total_s - t0
+        self._mark = (split.host_s, split.total_s, split.rounds)
+        if dt <= 0 or dh < 0:  # accumulator was reset mid-window
+            return None
+        frac = dh / dt
+        idx = self.candidates.index(self.engine.d)
+        if frac > self.HIGH and idx + 1 < len(self.candidates):
+            self.engine.d = self.candidates[idx + 1]
+        elif frac < self.LOW and idx > 0:
+            self.engine.d = self.candidates[idx - 1]
+        else:
+            return None
+        self.retunes += 1
+        return self.engine.d
+
+
 class ContinuousBatchingEngine:
     """Serve a stream of prompts through ``batch_size`` decode slots.
 
@@ -125,6 +226,8 @@ class ContinuousBatchingEngine:
         mesh=None,
         rules=None,
         cache_layout: str = "frontier",
+        overlap: bool = True,
+        auto_chunk: bool = False,
     ):
         """With ``mesh`` (+ optional logical-axis ``rules``) every
         device program runs SPMD over it: pass params already placed in
@@ -149,6 +252,14 @@ class ContinuousBatchingEngine:
           lifetime is bounded by its own prompt+budget, not by the
           stream's). Liveness is simply prompt_width + max_new_tokens
           <= max_seq_len. Preferred for long mixed streams.
+
+        ``overlap`` selects the double-buffered scheduler round (the
+        default): chunk N+1 is dispatched before chunk N's results are
+        synced, and the host's emission/retirement/admission runs while
+        the device executes. ``overlap=False`` keeps the host-serial
+        round (the pre-pipeline behavior; the bench's A/B baseline).
+        ``auto_chunk`` lets the engine retune ``decode_chunk`` between
+        dispatches from the measured host fraction.
         """
         cfg = model.config
         L = cfg.max_seq_len
@@ -190,6 +301,17 @@ class ContinuousBatchingEngine:
         self.Pw = prompt_width
         self.L = L
         self.d = decode_chunk
+        self.overlap = bool(overlap)
+        # double-buffer queue: chunks dispatched but not yet synced /
+        # emitted. Each entry is (output futures..., done futures, the
+        # per-slot uid snapshot AT DISPATCH — emission only credits a
+        # slot whose uid still matches, so a cancel + re-admit during
+        # the one-chunk lag can never leak another request's tokens).
+        self._inflight: List[tuple] = []
+        # tokens emitted by drains OUTSIDE a step (swap adoption):
+        # folded into the next step()'s return so the per-call count
+        # never silently drops a chunk
+        self._drained_uncounted = 0
         self.swap_latency_s: Optional[float] = None
         self._pending_params = None  # in-flight async weight swap
         self._pending_t0 = 0.0
@@ -199,6 +321,11 @@ class ContinuousBatchingEngine:
         self._slots = [_Slot() for _ in range(batch_size)]
         self._completions: List[Completion] = []
         self._compact_fns: Dict[int, Callable] = {}
+        # eager admission prefill (overlapped round): queued requests'
+        # prompt rows computed WHILE a decode chunk is in flight, so
+        # admission later pays only the cheap insert. Keyed by uid;
+        # dropped on weight swap (stale-weight KV) and on cancel.
+        self._prefilled: Dict[int, tuple] = {}
         # prefix caching: registered token lists + their lazily built
         # device row states (dropped on weight swap — stale KV would
         # silently serve the OLD model's prefix encoding)
@@ -212,13 +339,14 @@ class ContinuousBatchingEngine:
         self.phases = PhaseAccumulator()
         self._build_programs()
         self._reset_device_state()
+        self._tuner = _ChunkAutoTuner(self) if auto_chunk else None
 
     # -- device programs (compiled once each; the decode contract and
     # sampling live in generation.py — token-exactness with the
     # one-shot engine depends on sharing them, not mirroring them) ----
 
     def _build_programs(self):
-        s, L, d = self.s, self.L, self.d
+        s, L = self.s, self.L
         model = self.model
 
         def prefill_row(params, toks, mask):
@@ -255,14 +383,18 @@ class ContinuousBatchingEngine:
             )
 
         def admit(state, row_cache, row_logits, row_pos, row_kv,
-                  row_allow, slot, next_slot):
+                  row_allow, slot, next_slot, cap):
             """Insert a prefilled row at ``slot`` (traced — one compile
             covers every slot). The batch cache's shared frontier scalar
             is kept; the row's KV live at low slots, the gap up to the
             frontier is kv_valid=False holes (frontier layout) or
             nothing (per-row layout: the row's own write slot restarts
-            at ``next_slot`` = its prompt bucket width)."""
-            (cache, kv_valid, last_logits, cur_pos, allow, done,
+            at ``next_slot`` = its prompt bucket width). ``cap`` arms
+            the row's DEVICE-side emission budget: the chunk fn
+            decrements it per emitted token and done-masks the row at
+            zero, so cap enforcement cannot lag the device (the
+            overlapped round's one-chunk window)."""
+            (cache, kv_valid, last_logits, cur_pos, allow, budget, done,
              row_f) = state
             cache = ContinuousBatchingEngine._insert_row(
                 cache, row_cache, slot
@@ -273,11 +405,12 @@ class ContinuousBatchingEngine:
                 last_logits.at[slot].set(row_logits),
                 cur_pos.at[slot].set(row_pos),
                 allow.at[slot].set(row_allow),
+                budget.at[slot].set(cap),
                 done.at[slot].set(False),
                 row_f.at[slot].set(next_slot),
             )
 
-        def make_decode_chunk(per_row: bool):
+        def make_decode_chunk(per_row: bool, d: int):
             """Build the d-step decode program for one layout; returns
             stacked (toks, emits, logps) [d, B] and the advanced state.
             ONE step body serves both layouts (the sampling contract,
@@ -291,12 +424,20 @@ class ContinuousBatchingEngine:
             done/empty rows keep stepping on pad (static shapes) with
             their write slot parked clamped at L-1 — their kv bit and
             cache row are fully replaced at the next admission, so the
-            parked writes are invisible."""
+            parked writes are invisible.
+
+            Per-row stop enforcement is ON THE DEVICE: each row carries
+            a remaining-emission budget (its request cap), decremented
+            per emitted token; at zero the row is done-masked exactly
+            like EOS. The host never needs to intervene to stop a row,
+            which is what makes dispatching chunk N+1 before reading
+            chunk N safe — a capped row cannot emit past its cap or
+            consume liveness headroom during the lag window."""
 
             def chunk(params, state, frontier, rng):
                 def step(carry, t):
-                    (cache, kv_valid, last_logits, cur_pos, allow, done,
-                     row_f, rng) = carry
+                    (cache, kv_valid, last_logits, cur_pos, allow,
+                     budget, done, row_f, rng) = carry
                     rng, sub = jax.random.split(rng)
                     # per-request constrained decoding (RL action
                     # spaces): sampling AND behavior logprobs come from
@@ -306,6 +447,12 @@ class ContinuousBatchingEngine:
                         jnp.where(allow, last_logits, -jnp.inf), done,
                         sub, s,
                     )
+                    # device-side cap: the token that exhausts the
+                    # budget is still emitted (host parity: emit while
+                    # count < cap), then the row is done
+                    emit = emit & (budget > 0)
+                    budget = budget - emit.astype(jnp.int32)
+                    done = done | (budget <= 0)
                     if per_row:
                         write_slots = jnp.minimum(row_f, L - 1)
                         slot_hits = (
@@ -329,6 +476,7 @@ class ContinuousBatchingEngine:
                         logits[:, 0].astype(jnp.float32),
                         pos,
                         allow,
+                        budget,
                         done,
                         row_f,
                         rng,
@@ -341,11 +489,28 @@ class ContinuousBatchingEngine:
 
             return chunk
 
+        def admit_many(state, rows, slots, next_slots, caps):
+            """Burst admission: K row inserts in ONE dispatch. A wave
+            of slots tends to retire together (equal caps), so the
+            scheduler frequently admits K rows back-to-back — K
+            separate admit calls cost K jit dispatches of the full
+            batch state (~1 ms each on CPU), the dominant host-serial
+            cost left in the overlapped round. Row shapes are
+            width-independent ([1, L] caches), so jax re-traces only
+            per distinct K (at most B traces)."""
+            for row, slot, nxt, cap in zip(rows, slots, next_slots,
+                                           caps):
+                state = admit(state, *row, slot, nxt, cap)
+            return state
+
         self._prefill_fn = jax.jit(prefill_row)
         self._continue_fn = jax.jit(continue_prefill_row, static_argnums=6)
         self._admit_fn = jax.jit(admit)
-        self._chunk_fn = jax.jit(make_decode_chunk(False))
-        self._chunk_per_row_fn = jax.jit(make_decode_chunk(True))
+        self._admit_many_fn = jax.jit(admit_many)
+        # chunk programs are cached per (layout, d): the auto-tuner
+        # changes d between dispatches and each length is one compile
+        self._chunk_src = make_decode_chunk
+        self._chunk_fns: Dict[tuple, Callable] = {}
 
         def compact(params, toks, mask):
             """Batched re-prefill of every live row's history into a
@@ -357,14 +522,16 @@ class ContinuousBatchingEngine:
 
         self._compact_src = compact
 
+    _NULL_CTX = contextlib.nullcontext()
+
     def _ctx(self):
         """Mesh + logical-rule contexts around every device call in
         SPMD mode (sharding constraints resolve at trace time, the mesh
-        must be active at call time); no-op single-device."""
-        import contextlib
-
+        must be active at call time); no-op single-device. On the hot
+        path twice per round (admission + dispatch) — the no-op case
+        must stay allocation-free."""
         if self.mesh is None:
-            return contextlib.nullcontext()
+            return self._NULL_CTX
         from ..parallel.mesh import current_mesh
         from ..parallel.sharding import apply_rules
 
@@ -374,10 +541,27 @@ class ContinuousBatchingEngine:
         stack.enter_context(current_mesh(self.mesh))
         return stack
 
+    def _i32(self, v: int):
+        """Cached device scalar: ``jnp.int32(v)`` dispatches a
+        conversion op per call (~0.2 ms on CPU), and the scheduler
+        passes the same few slot/width/cap/frontier values every
+        round — host time the dispatch path does not need to pay."""
+        cache = self.__dict__.setdefault("_i32_cache", {})
+        arr = cache.get(v)
+        if arr is None:
+            arr = cache[v] = jnp.int32(v)
+        return arr
+
     def _compact_for(self, width):
         if width not in self._compact_fns:
             self._compact_fns[width] = jax.jit(self._compact_src)
         return self._compact_fns[width]
+
+    def _chunk_for(self, d: int) -> Callable:
+        key = (self.layout == "per_row", d)
+        if key not in self._chunk_fns:
+            self._chunk_fns[key] = jax.jit(self._chunk_src(*key))
+        return self._chunk_fns[key]
 
     @staticmethod
     def _set_cache_frontier(cache, f: int):
@@ -400,6 +584,7 @@ class ContinuousBatchingEngine:
             jnp.full((self.B, V), -1e9, jnp.float32),
             jnp.zeros((self.B,), jnp.int32),
             jnp.ones((self.B, V), bool),  # per-row allowed-token mask
+            jnp.zeros((self.B,), jnp.int32),  # per-row emission budget
             jnp.ones((self.B,), bool),  # empty slots: done (emit pad)
             jnp.zeros((self.B,), jnp.int32),  # per-row write frontier
         )
@@ -530,20 +715,26 @@ class ContinuousBatchingEngine:
 
     def _maybe_adopt_pending(self) -> bool:
         """Adopt a pending async swap if the transfer has completed —
-        checked without blocking (``Array.is_ready``)."""
+        checked without blocking (``Array.is_ready``). In the
+        overlapped scheduler, adoption first DRAINS the pipeline
+        (processes any in-flight chunk): the swap lands at a point
+        where host bookkeeping matches device state, so no round is
+        ever split between parameter versions — the pipeline's drain
+        point is the only adoption boundary."""
         pending = self._pending_params
         if pending is None:
             return False
-        leaves = jax.tree_util.tree_leaves(pending)
-        if not all(
-            leaf.is_ready() for leaf in leaves
-            if hasattr(leaf, "is_ready")
-        ):
+        if not _tree_ready(pending):
             return False
+        # catch-up tokens are credited to slots/completions; the count
+        # is surfaced through the next step()'s return
+        self._drained_uncounted += self._drain_inflight()
         self.params = pending
         self._pending_params = None
-        # stored prefix KV encodes the OLD weights — rebuild lazily
+        # stored prefix KV and eager-prefilled rows encode the OLD
+        # weights — rebuild lazily / re-prefill at admission
         self._prefix_states.clear()
+        self._prefilled.clear()
         self.swap_latency_s = time.perf_counter() - self._pending_t0
         return True
 
@@ -596,14 +787,23 @@ class ContinuousBatchingEngine:
                 width = b
         return width
 
-    def _admit_one(
-        self, slot: int, uid: int, prompt: List[int], submit_t: float,
-        cap: int, prefix_id: Optional[int] = None,
+    def _build_row(
+        self, uid: int, prompt: List[int],
+        prefix_id: Optional[int] = None,
         allowed_tokens: Optional[List[int]] = None,
     ):
+        """Everything an admission needs short of the insert: the
+        prefilled row pytree (cache, logits, pos, kv, allow), its
+        bucket width, and the full token history (prefix + suffix for
+        compaction). Shared by the single and the burst insert."""
         V = self.model.config.vocab_size
         if allowed_tokens is None:
-            row_allow = jnp.ones((V,), bool)
+            # cached: rebuilding (and re-transferring) an all-True [V]
+            # mask per admission was measurable host time on the
+            # admission path the overlapped round now hides
+            if not hasattr(self, "_allow_all"):
+                self._allow_all = jnp.ones((V,), bool)
+            row_allow = self._allow_all
         else:
             row_allow = (
                 jnp.zeros((V,), bool)
@@ -629,15 +829,33 @@ class ContinuousBatchingEngine:
                 width = p_width + s_width
                 full_prompt = self._prefixes[prefix_id] + prompt
             else:
-                width = self._bucket_width(len(prompt))
-                toks, mask = self._pad_rows([prompt], width)
-                row_cache, row_logits, row_pos, row_kv = self._prefill_fn(
-                    self.params, toks, mask
-                )
+                pre = self._prefilled.pop(uid, None)
+                if pre is not None:
+                    # eager prefill already ran (hidden behind an
+                    # in-flight chunk): admission is only the insert
+                    row_cache, row_logits, row_pos, row_kv, width = pre
+                else:
+                    width = self._bucket_width(len(prompt))
+                    toks, mask = self._pad_rows([prompt], width)
+                    row_cache, row_logits, row_pos, row_kv = (
+                        self._prefill_fn(self.params, toks, mask)
+                    )
                 full_prompt = prompt
+        row = (row_cache, row_logits, row_pos, row_kv, row_allow)
+        return row, width, full_prompt
+
+    def _admit_one(
+        self, slot: int, uid: int, prompt: List[int], submit_t: float,
+        cap: int, prefix_id: Optional[int] = None,
+        allowed_tokens: Optional[List[int]] = None,
+    ):
+        row, width, full_prompt = self._build_row(
+            uid, prompt, prefix_id, allowed_tokens
+        )
+        with self._ctx():
             self._state = self._admit_fn(
-                self._state, row_cache, row_logits, row_pos, row_kv,
-                row_allow, jnp.int32(slot), jnp.int32(width),
+                self._state, *row, self._i32(slot), self._i32(width),
+                self._i32(cap),
             )
         # full prefix+suffix history: compaction (frontier layout)
         # rebuilds rows from these tokens
@@ -681,44 +899,39 @@ class ContinuousBatchingEngine:
             cache, kv_valid, last_logits, cur_pos = self._compact_for(
                 width
             )(self.params, toks, mask)
-        _, _, _, _, allow, done, row_f = self._state
+        _, _, _, _, allow, budget, done, row_f = self._state
         # frontier never drops below Pw: future admissions put prompt
-        # KV at [0, W<=Pw) and decode writes must stay clear of it
+        # KV at [0, W<=Pw) and decode writes must stay clear of it.
+        # budget rides through: the device counters already hold each
+        # live row's remaining cap (cap minus tokens emitted so far).
         self._frontier = max(width, self.Pw)
         cache = self._set_cache_frontier(cache, self._frontier)
         self._state = (
-            cache, kv_valid, last_logits, cur_pos, allow, done, row_f
+            cache, kv_valid, last_logits, cur_pos, allow, budget, done,
+            row_f,
         )
 
-    def step(self, rng):
-        """One scheduler iteration: compact if out of headroom
-        (frontier layout only), admit into free slots, decode one
-        chunk, retire finished rows. Returns the number of tokens
-        emitted this chunk. Phase boundaries are stamped into
-        ``self.phases`` — admission / prefill / decode_dispatch /
-        host_sync / retirement — so ``stats()`` (and the bench's
-        attribution rung) can report the host/device split."""
-        t0 = time.perf_counter()
-        # a completed async weight swap lands here, between chunks —
-        # the non-blocking check costs ~nothing when none is pending
-        self._maybe_adopt_pending()
+    # burst insert available (one jitted multi-row admit); the
+    # speculative engine overrides admission wholesale and opts out
+    _burst_admit = True
+
+    def _admit_free_slots(self) -> float:
+        """Fill empty slots from the queue while the budget allows;
+        returns the seconds spent in the admission device path
+        (prefill + admit programs). The caller stamps phases — in the
+        overlapped round this whole span runs while a chunk is in
+        flight and is accounted as hidden.
+
+        The overlapped round admits a whole burst through ONE
+        ``admit_many`` dispatch: a wave of equal-cap slots retires
+        together, and per-row insert calls each pay full-state jit
+        dispatch — the largest host-serial cost the pipeline had
+        left. The synchronous baseline keeps the per-row path it
+        always had."""
         frontier_layout = self.layout == "frontier"
+        burst = self.overlap and self._burst_admit
         prefill_s = 0.0
-        if frontier_layout:
-            if self._queue and all(
-                st.uid < 0 for st in self._slots
-            ) and self._frontier > self.Pw:
-                # Nothing live but the frontier has advanced (admission
-                # may be budget-blocked): a fresh cache beats
-                # dispatching dead all-done chunks until the compaction
-                # threshold — each one is a full device round-trip that
-                # emits zero tokens.
-                self._reset_device_state()
-            if self._frontier + self.d > self.L:
-                tc = time.perf_counter()
-                self._compact()  # a batched re-prefill: device work
-                prefill_s += time.perf_counter() - tc
-        # admission: fills empty slots while the budget allows
+        batch = []
         for slot, st in enumerate(self._slots):
             if st.uid >= 0 or not self._queue:
                 continue
@@ -734,42 +947,259 @@ class ContinuousBatchingEngine:
                 self._queue.pop(0)
             )
             ta = time.perf_counter()
-            self._admit_one(
-                slot, uid, prompt, submit_t, cap, prefix_id, allowed
-            )
+            if not burst:
+                self._admit_one(
+                    slot, uid, prompt, submit_t, cap, prefix_id,
+                    allowed,
+                )
+            else:
+                row, width, full_prompt = self._build_row(
+                    uid, prompt, prefix_id, allowed
+                )
+                batch.append(
+                    (slot, row, width, cap, uid, full_prompt, submit_t)
+                )
             prefill_s += time.perf_counter() - ta
-        t_admit = time.perf_counter()
-        self.phases.add("prefill", prefill_s)
-        self.phases.add("admission", t_admit - t0 - prefill_s)
+        if batch:
+            ta = time.perf_counter()
+            with self._ctx():
+                self._state = self._admit_many_fn(
+                    self._state,
+                    tuple(b[1] for b in batch),
+                    tuple(self._i32(b[0]) for b in batch),
+                    tuple(self._i32(b[2]) for b in batch),
+                    tuple(self._i32(b[3]) for b in batch),
+                )
+            now = time.perf_counter()
+            for slot, _row, _w, cap, uid, full_prompt, submit_t in batch:
+                self._slots[slot] = _Slot(
+                    uid=uid, prompt=full_prompt, submit_t=submit_t,
+                    cap=cap, admit_t=now,
+                )
+            prefill_s += now - ta
+        return prefill_s
 
+    def _frontier_housekeeping(self) -> int:
+        """Frontier-layout cache management (no-op for per_row):
+        idle-reset and compaction. Both are pipeline DRAIN points —
+        compaction rebuilds the cache from host-side histories, which
+        must first catch up with the device. Returns tokens emitted by
+        any drain."""
+        emitted = 0
+        if self.layout != "frontier":
+            return emitted
+        if (
+            not self._inflight
+            and self._queue
+            and all(st.uid < 0 for st in self._slots)
+            and self._frontier > self.Pw
+        ):
+            # Nothing live but the frontier has advanced (admission
+            # may be budget-blocked): a fresh cache beats dispatching
+            # dead all-done chunks until the compaction threshold —
+            # each one is a full device round-trip that emits zero
+            # tokens.
+            self._reset_device_state()
+        if self._frontier + self.d > self.L:
+            emitted += self._drain_inflight()
+            tc = time.perf_counter()
+            self._compact()  # a batched re-prefill: device work
+            self.phases.add("prefill", time.perf_counter() - tc)
+        return emitted
+
+    def _dispatch_round(self, rng) -> tuple:
+        """Enqueue one decode chunk on the device; returns the
+        in-flight record (output futures + done futures + the uid
+        snapshot) without reading anything back."""
         with self._ctx():
-            if frontier_layout:
-                self._state, (toks, emits, logps) = self._chunk_fn(
-                    self.params, self._state, jnp.int32(self._frontier),
-                    rng,
+            chunk_fn = self._chunk_for(self.d)
+            if self.layout == "frontier":
+                self._state, (toks, emits, logps) = chunk_fn(
+                    self.params, self._state,
+                    self._i32(self._frontier), rng,
                 )
                 self._frontier += self.d
             else:
                 # frontier arg is unused in per_row (write slots come
                 # from the state's per-row frontier); pass a constant
                 # so the one compiled program serves every chunk
-                self._state, (toks, emits, logps) = (
-                    self._chunk_per_row_fn(
-                        self.params, self._state, jnp.int32(0), rng
-                    )
+                self._state, (toks, emits, logps) = chunk_fn(
+                    self.params, self._state, self._i32(0), rng
                 )
+        return (
+            toks, emits, logps, self._state[-2],  # -2: the done flags
+            [st.uid for st in self._slots],
+        )
+
+    def _emit_outputs(self, fetched, uids) -> int:
+        """Credit one synced chunk's tokens to its slots and retire
+        finished rows — one fused readback drove this, not per-token
+        host polls. A slot whose uid changed since dispatch (cancel,
+        or cancel + re-admit during the lag window) is skipped: the
+        old row's emit mask is the device's own guarantee that a
+        re-admitted request never sees a predecessor's tokens.
+        Overridden by the speculative subclass (round-shaped
+        outputs)."""
+        toks, emits, logps, done = fetched
+        emitted = 0
+        now = time.perf_counter()
+        for slot, st in enumerate(self._slots):
+            if st.uid < 0 or st.uid != uids[slot]:
+                continue
+            sel = emits[:, slot]
+            if sel.any():
+                new = toks[sel, slot].tolist()
+                room = st.cap - len(st.emitted)
+                if room < len(new):  # belt: device budget enforces cap
+                    new = new[:max(room, 0)]
+                if new:
+                    if not st.emitted:
+                        st.first_tok_t = now
+                    st.emitted.extend(int(t) for t in new)
+                    st.logprobs.extend(
+                        float(x)
+                        for x in logps[sel, slot][: len(new)]
+                    )
+                    emitted += len(new)
+            st.finished = bool(done[slot])
+            if st.finished or len(st.emitted) >= st.cap:
+                # the device already done-masked this row (budget/EOS),
+                # so only the host slot needs freeing
+                self._finalize_slot(slot)
+        return emitted
+
+    def _process_oldest(self) -> int:
+        """Sync + emit + retire the oldest in-flight chunk. When a
+        newer chunk is still in flight behind it, the host work here
+        is hidden by device execution — stamped ``overlap_hidden``."""
+        entry = self._inflight.pop(0)
+        ts = time.perf_counter()
+        fetched = jax.device_get(entry[:-1])
+        t_sync = time.perf_counter()
+        self.phases.add("host_sync", t_sync - ts)
+        emitted = self._emit_outputs(fetched, entry[-1])
+        self.phases.add(
+            "overlap_hidden" if self._inflight else "retirement",
+            time.perf_counter() - t_sync,
+        )
+        return emitted
+
+    def _drain_inflight(self) -> int:
+        """Process every dispatched-but-unread chunk (the pipeline
+        drain point: host bookkeeping catches up with the device)."""
+        emitted = 0
+        while self._inflight:
+            emitted += self._process_oldest()
+        return emitted
+
+    def _eager_prefill(self) -> None:
+        """Prefill queue-head prompts WHILE a chunk is in flight (the
+        overlapped round calls this right after dispatch): prompt rows
+        are computed into ``self._prefilled`` so the later admission
+        pays only the insert program. At most B rows are held (each a
+        [1, L] cache); prefix-path requests keep the lazy path (their
+        row derives from the stored prefix state). Overridden to a
+        no-op by the speculative engine, whose admission prefills two
+        models and keeps the classic path."""
+        if not self._queue:
+            return
+        held = 0
+        for item in self._queue:
+            if held >= self.B:
+                break
+            held += 1
+            uid, prompt, _submit_t, _cap, prefix_id, _allowed = item
+            if prefix_id is not None or uid in self._prefilled:
+                continue
+            width = self._bucket_width(len(prompt))
+            toks, mask = self._pad_rows([prompt], width)
+            with self._ctx():
+                row = self._prefill_fn(self.params, toks, mask)
+            self._prefilled[uid] = (*row, width)
+
+    def _oldest_ready(self) -> bool:
+        """Non-blocking: has the oldest in-flight chunk already
+        finished on the device? (same readiness poll as the async
+        weight swap)."""
+        return bool(self._inflight) and _tree_ready(
+            self._inflight[0][:-1]
+        )
+
+    def step(self, rng):
+        """One scheduler iteration. Returns the number of tokens
+        emitted this call. Phase boundaries are stamped into
+        ``self.phases`` so ``stats()`` (and the bench's attribution
+        rung) can report the host/device/hidden split.
+
+        Synchronous round (``overlap=False``): compact if out of
+        headroom (frontier layout only), admit into free slots, decode
+        one chunk, block on its results, retire finished rows — the
+        device idles while the host schedules.
+
+        Overlapped round (default): admit and dispatch chunk N FIRST
+        (the device queue stays non-empty), then sync chunk N-1 —
+        whose execution already overlapped the previous call's host
+        work — and do emission/retirement while chunk N runs. Rows
+        stop themselves on the device (cap budget + EOS done-mask), so
+        the one-chunk lag cannot over-emit; emission is one fused
+        readback of tokens+emit-mask+logps+done. Streams are
+        bit-identical with the synchronous round under greedy
+        sampling; with temperature > 0 the admission lag shifts which
+        rng a refilled slot consumes (either stream is a valid
+        sample)."""
+        emitted = (
+            self._step_overlapped(rng) if self.overlap
+            else self._step_sync(rng)
+        )
+        emitted += self._drained_uncounted
+        self._drained_uncounted = 0
+        if self._tuner is not None:
+            self._tuner.maybe_retune()
+        return emitted
+
+    def _step_sync(self, rng):
+        """The host-serial round (pre-pipeline behavior, kept as the
+        measured A/B baseline): dispatch, block, emit, retire."""
+        t0 = time.perf_counter()
+        # a completed async weight swap lands here, between chunks —
+        # the non-blocking check costs ~nothing when none is pending
+        self._maybe_adopt_pending()
+        t_adopt = time.perf_counter()
+        # housekeeping stamps its own compaction span as "prefill" —
+        # exclude it from the admission bucket (double-counting it
+        # would inflate serving_host_frac, the metric under test)
+        self._frontier_housekeeping()
+        t_hk = time.perf_counter()
+        prefill_s = self._admit_free_slots()
+        t_admit = time.perf_counter()
+        self.phases.add("prefill", prefill_s)
+        self.phases.add(
+            "admission",
+            (t_adopt - t0) + (t_admit - t_hk - prefill_s),
+        )
+
+        entry = self._dispatch_round(rng)
         t_disp = time.perf_counter()
         self.phases.add("decode_dispatch", t_disp - t_admit)
-        toks, emits, logps, done = jax.device_get(
-            (toks, emits, logps, self._state[-2])  # -2: the done flags
-        )
+        fetched = jax.device_get(entry[:-1])
         t_sync = time.perf_counter()
         self.phases.add("host_sync", t_sync - t_disp)
+        emitted = self._emit_outputs_sync(fetched, entry[-1])
+        self.phases.add("retirement", time.perf_counter() - t_sync)
+        self.phases.rounds += 1
+        return emitted
+
+    def _emit_outputs_sync(self, fetched, uids) -> int:
+        """The synchronous round's per-token host loop, kept verbatim
+        as the measured baseline the overlapped round's fused emission
+        is A/B'd against (greedy equality between the two paths is
+        under test)."""
+        toks, emits, logps, done = fetched
         emitted = 0
         for slot, st in enumerate(self._slots):
             if st.uid < 0:
                 continue
-            for t in range(self.d):
+            for t in range(toks.shape[0]):
                 if len(st.emitted) >= st.cap:
                     break
                 if emits[t, slot]:
@@ -781,17 +1211,70 @@ class ContinuousBatchingEngine:
             st.finished = bool(done[slot])
             if st.finished or len(st.emitted) >= st.cap:
                 self._retire(slot)
-        self.phases.add("retirement", time.perf_counter() - t_sync)
+        return emitted
+
+    def _step_overlapped(self, rng):
+        """The double-buffered round: dispatch chunk N before reading
+        chunk N-1, so every host span between two dispatches runs
+        under an executing chunk."""
+        emitted = 0
+        # adoption drains the pipeline first (_maybe_adopt_pending):
+        # a landed WeightBus push costs one catch-up, never a split
+        # round
+        self._maybe_adopt_pending()
+        emitted += self._frontier_housekeeping()
+        # Zero-lag retirement: when the device already finished the
+        # oldest chunk (it outran the host — the host-bound regime
+        # this pipeline targets), process it BEFORE dispatching, so
+        # slots it freed refill in THIS round's admission instead of
+        # one chunk later. When the device is still busy, keep the
+        # dispatch-first order — the queue must never drain.
+        if self._oldest_ready():
+            emitted += self._process_oldest()
+        # admission overlaps the in-flight chunk: the prefill + admit
+        # programs enqueue behind it and the host-side cost is hidden
+        hidden = bool(self._inflight)
+        ta = time.perf_counter()
+        prefill_s = self._admit_free_slots()
+        t_admit = time.perf_counter()
+        if hidden:
+            self.phases.add("overlap_hidden", t_admit - ta)
+        else:
+            self.phases.add("prefill", prefill_s)
+            self.phases.add("admission", t_admit - ta - prefill_s)
+
+        dispatched = False
+        if any(st.uid >= 0 for st in self._slots):
+            self._inflight.append(self._dispatch_round(rng))
+            self.phases.add(
+                "decode_dispatch", time.perf_counter() - t_admit
+            )
+            dispatched = True
+            # queued requests' prompt rows prefill NOW, behind the
+            # chunk just dispatched — their admission later is only
+            # the insert
+            tp = time.perf_counter()
+            self._eager_prefill()
+            self.phases.add(
+                "overlap_hidden", time.perf_counter() - tp
+            )
+        # keep pipeline depth at one: process the previous chunk while
+        # the new one runs; with nothing dispatched, drain the tail
+        if len(self._inflight) > (1 if dispatched else 0):
+            emitted += self._process_oldest()
         self.phases.rounds += 1
         return emitted
 
     @property
     def pending(self) -> bool:
-        """True while any request is queued or decoding — the public
-        drain condition for callers driving step() themselves (e.g. to
-        land a weight swap mid-stream)."""
-        return bool(self._queue) or any(
-            st.uid >= 0 for st in self._slots
+        """True while any request is queued or decoding, or a
+        dispatched chunk's results are still unread (the overlapped
+        round's tail) — the public drain condition for callers driving
+        step() themselves (e.g. to land a weight swap mid-stream)."""
+        return (
+            bool(self._queue)
+            or any(st.uid >= 0 for st in self._slots)
+            or bool(self._inflight)
         )
 
     def stats(self) -> Dict:
@@ -800,6 +1283,12 @@ class ContinuousBatchingEngine:
         determines admission behavior."""
         return {
             "cache_layout": self.layout,
+            "overlap": self.overlap,
+            "inflight_chunks": len(self._inflight),
+            "decode_chunk": self.d,
+            "auto_chunk_retunes": (
+                self._tuner.retunes if self._tuner is not None else None
+            ),
             "busy_slots": sum(1 for st in self._slots if st.uid >= 0),
             "queue_depth": len(self._queue),
             "registered_prefixes": len(self._prefixes),
@@ -818,10 +1307,12 @@ class ContinuousBatchingEngine:
     def partial(self, uid: int):
         """Tokens emitted so far for a live uid, or None if the uid is
         not currently decoding (queued, finished, or unknown). Safe to
-        call from other threads: list appends are GIL-atomic and a torn
-        read only under-reports by one token, which the caller's next
-        poll delivers. The streaming read API — external callers must
-        not reach into slot internals."""
+        call from other threads: emission extends the list in one
+        GIL-atomic C call, so a torn read only under-reports by at
+        most one chunk's tokens, which the caller's next poll
+        delivers. In the overlapped round the view additionally lags
+        the device by one in-flight chunk. The streaming read API —
+        external callers must not reach into slot internals."""
         for st in self._slots:
             if st.uid == uid:
                 return list(st.emitted)
@@ -836,6 +1327,7 @@ class ContinuousBatchingEngine:
         for i, item in enumerate(self._queue):
             if item[0] == uid:
                 del self._queue[i]
+                self._prefilled.pop(uid, None)
                 return True
         for slot, st in enumerate(self._slots):
             if st.uid == uid:
@@ -860,13 +1352,22 @@ class ContinuousBatchingEngine:
         return sorted(out, key=lambda c: c.uid)
 
     def run(self, prompts=None, rng=None) -> List[Completion]:
-        """Drive the scheduler until every queued request completes."""
+        """Drive the scheduler until every queued request completes.
+        Step keys are pre-split in blocks: one ``jax.random.split``
+        dispatch per 64 rounds instead of per round (the per-round
+        split was measurable host-serial time on both scheduler
+        paths). The keys differ from chained per-round splitting but
+        are an equally valid independent stream; greedy output is
+        key-independent either way."""
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for p in prompts or []:
             self.submit(p)
+        keys: List = []
         while self.pending:
-            rng, sub = jax.random.split(rng)
-            self.step(sub)
+            if not keys:
+                rng, *block = jax.random.split(rng, 65)
+                keys = list(block)
+            self.step(keys.pop(0))
         out, self._completions = self._completions, []
         return sorted(out, key=lambda c: c.uid)
 
@@ -912,6 +1413,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         decode_chunk: int = 1,
         mesh=None,
         rules=None,
+        overlap: bool = True,
     ):
         """Two positional shapes are accepted:
 
@@ -994,7 +1496,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         super().__init__(
             model, params, sampling, batch_size, prompt_width,
             decode_chunk=1, mesh=mesh, rules=rules,
-            cache_layout="per_row",
+            cache_layout="per_row", overlap=overlap,
         )
         self.draft_params = (
             draft_params if draft_params is not None else self.params
@@ -1030,11 +1532,10 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
         def admit_spec(
             state, t_row, d_row, row_logits, row_pos, row_kv, slot,
-            next_slot,
+            next_slot, cap,
         ):
-            t_cache, d_cache, kv_valid, last_logits, cur_pos, done, row_f = (
-                state
-            )
+            (t_cache, d_cache, kv_valid, last_logits, cur_pos, budget,
+             done, row_f) = state
             insert = ContinuousBatchingEngine._insert_row
             return (
                 insert(t_cache, t_row, slot),
@@ -1042,6 +1543,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 kv_valid.at[slot].set(row_kv),
                 last_logits.at[slot].set(row_logits),
                 cur_pos.at[slot].set(row_pos),
+                budget.at[slot].set(cap),
                 done.at[slot].set(False),
                 row_f.at[slot].set(next_slot),
             )
@@ -1057,9 +1559,15 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             window once; the accepted prefix is exactly what plain
             greedy decode would have produced, and the logits after
             the last accepted token become the next round's pending
-            logits (the "bonus" position)."""
-            (t_cache, d_cache, kv_valid, last_logits, cur_pos, done,
-             row_f) = state
+            logits (the "bonus" position).
+
+            Device-side cap: each row's remaining-emission budget
+            clamps the accepted count so a round never emits past the
+            request cap, and exhausting it done-masks the row — the
+            overlapped scheduler's one-round lag cannot over-emit or
+            claim window slots for a finished request."""
+            (t_cache, d_cache, kv_valid, last_logits, cur_pos, budget,
+             done, row_f) = state
             tok0 = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
             tok0 = jnp.where(done, s.pad_id, tok0)
             lp_all = jax.nn.log_softmax(last_logits, axis=-1)
@@ -1114,6 +1622,10 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 jnp.argmin(ok.astype(jnp.int32), axis=1),
             )
             a = jnp.where(done, 0, a)
+            # device-side cap: a live row has budget >= 1; accept at
+            # most budget-1 drafts so tok0 + accepted <= budget
+            a = jnp.minimum(a, jnp.maximum(budget - 1, 0))
+            n_emit = jnp.where(done, 0, a + 1)
 
             # logprobs for the emitted tokens: tok0 under the pending
             # dist, d_j under the verify dist at position j-1
@@ -1143,8 +1655,12 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             nxt_logits = jnp.take_along_axis(
                 t_logits, a[:, None, None], axis=1
             )[:, 0]
+            # budget burn-down AFTER the eos update: an eos'd row is
+            # already done, so its residual budget is irrelevant
+            budget = jnp.maximum(budget - n_emit, 0)
+            done = done | (budget <= 0)
             return (
-                tc, dc, kv, nxt_logits, cur_pos + 1 + a, done,
+                tc, dc, kv, nxt_logits, cur_pos + 1 + a, budget, done,
                 row_f + k + 1,
             ), (win, a, logps)
 
@@ -1161,6 +1677,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             jnp.zeros((self.B, self.L), bool),
             jnp.full((self.B, V), -1e9, jnp.float32),
             jnp.zeros((self.B,), jnp.int32),
+            jnp.zeros((self.B,), jnp.int32),  # per-row emission budget
             jnp.ones((self.B,), bool),
             jnp.zeros((self.B,), jnp.int32),
         )
@@ -1228,11 +1745,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         boundary."""
         pending_draft = self._pending_draft
         if pending_draft is not None and self._pending_params is not None:
-            if not all(
-                leaf.is_ready()
-                for leaf in jax.tree_util.tree_leaves(pending_draft)
-                if hasattr(leaf, "is_ready")
-            ):
+            if not _tree_ready(pending_draft):
                 return False
         follow = self.draft_params is self.params
         if super()._maybe_adopt_pending():
@@ -1258,57 +1771,51 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             )
             self._state = self._admit_spec_fn(
                 self._state, t_row, d_row, row_logits, row_pos, row_kv,
-                jnp.int32(slot), jnp.int32(width),
+                self._i32(slot), self._i32(width), self._i32(cap),
             )
         self._slots[slot] = _Slot(
             uid=uid, prompt=prompt, submit_t=submit_t, cap=cap,
             admit_t=time.perf_counter(),
         )
 
-    def step(self, rng):
-        """One speculation round: adopt any landed async swap (target
-        AND draft, atomically), admit, draft+verify, emit 1..k+1 tokens
-        per live row, retire eos/cap rows. Returns tokens emitted.
-        ``rng`` is accepted for API parity (greedy rounds are
-        deterministic)."""
-        t0 = time.perf_counter()
-        # the chunk boundary of this engine is the round boundary — an
-        # async swap (WeightBus push) lands here, never mid-round
-        self._maybe_adopt_pending()
-        prefill_s = 0.0
-        for slot, st in enumerate(self._slots):
-            if st.uid >= 0 or not self._queue:
-                continue
-            (uid, prompt, submit_t, cap, prefix_id, _allowed) = (
-                self._queue.pop(0)
-            )
-            ta = time.perf_counter()
-            self._admit_one(slot, uid, prompt, submit_t, cap, prefix_id)
-            prefill_s += time.perf_counter() - ta
-        t_admit = time.perf_counter()
-        self.phases.add("prefill", prefill_s)
-        self.phases.add("admission", t_admit - t0 - prefill_s)
-
+    def _dispatch_round(self, rng) -> tuple:
+        """One speculation round enqueued on the device (draft k,
+        verify once); nothing read back. ``rng`` is accepted for API
+        parity (greedy rounds are deterministic). The base class's
+        step() drives this for both the synchronous and the
+        overlapped scheduler — a speculative ROUND is this engine's
+        pipeline unit, and async weight adoption (target AND draft,
+        atomically) happens only at a drained pipeline, exactly like
+        the plain engine's chunk."""
         with self._ctx():
             self._state, (win, accept, logps) = self._round_fn(
                 self.params, self.draft_params, self._state
             )
-        t_disp = time.perf_counter()
-        self.phases.add("decode_dispatch", t_disp - t_admit)
-        win, accept, logps, done = jax.device_get(
-            (win, accept, logps, self._state[-2])  # -2: the done flags
+        return (
+            win, accept, logps, self._state[-2],  # -2: the done flags
+            [st.uid for st in self._slots],
         )
-        t_sync = time.perf_counter()
-        self.phases.add("host_sync", t_sync - t_disp)
+
+    def _emit_outputs(self, fetched, uids) -> int:
+        """Emit one synced round: window[:1+accepted] per row whose
+        uid still matches the dispatch snapshot (a slot cancelled —
+        or cancelled and re-admitted — during the one-round lag gets
+        nothing), with eos/cap truncation on the host exactly as the
+        synchronous round did. Acceptance accounting happens here, per
+        PROCESSED round, so stats stay exact in both modes."""
+        win, accept, logps, done = fetched
         emitted = 0
         self.rounds += 1
-        live = [st.uid >= 0 for st in self._slots]
+        live = [
+            st.uid >= 0 and st.uid == uids[i]
+            for i, st in enumerate(self._slots)
+        ]
         self.drafted_total += self.k * sum(live)
         self.accepted_total += int(
             sum(int(accept[i]) for i, l in enumerate(live) if l)
         )
         for slot, st in enumerate(self._slots):
-            if st.uid < 0:
+            if not live[slot]:
                 continue
             for t in range(1 + int(accept[slot])):
                 if len(st.emitted) >= st.cap:
@@ -1323,10 +1830,21 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                     break
             st.finished = bool(done[slot])
             if st.finished or len(st.emitted) >= st.cap:
-                self._retire(slot)
-        self.phases.add("retirement", time.perf_counter() - t_sync)
-        self.phases.rounds += 1
+                # the device already done-masked the row (budget/EOS)
+                self._finalize_slot(slot)
         return emitted
+
+    # the speculative round's emission is identical in both modes (it
+    # was already window-fused); the sync path reuses it
+    _emit_outputs_sync = _emit_outputs
+
+    # speculative admission inserts into BOTH caches through its own
+    # program — the plain engine's burst insert does not apply
+    _burst_admit = False
+
+    def _eager_prefill(self) -> None:
+        """No-op: speculative admission prefills BOTH models through
+        its own program; the plain engine's eager rows don't apply."""
 
     def stats(self) -> Dict:
         out = super().stats()

@@ -409,7 +409,10 @@ def drain_trace_events(keep_path: Optional[str] = None):
         # a valid ring is a keeper from here on — a corrupt NAMES
         # sidecar must not destroy the timeline the caller asked for
         ok = bool(events)
-        names = timeline.read_names(path + ".names")
+        try:
+            names = timeline.read_names(path + ".names")
+        except (OSError, ValueError):  # torn/garbled sidecar line
+            names = {}
         return events, names
     finally:
         # keep the files only for a successful non-empty parse of a

@@ -25,6 +25,7 @@ from dlrover_tpu.attribution import ops as attr_ops
 from dlrover_tpu.attribution.phases import (
     DEVICE_PHASES,
     HOST_PHASES,
+    OVERLAP_PHASES,
     PHASES,
 )
 
@@ -193,8 +194,34 @@ class TestPhaseSplit:
         assert split.phases["admission"]["hist_log2us"][13] == 3
 
     def test_phase_name_partition(self):
-        assert set(PHASES) == HOST_PHASES | DEVICE_PHASES
+        assert set(PHASES) == HOST_PHASES | DEVICE_PHASES | OVERLAP_PHASES
         assert not (HOST_PHASES & DEVICE_PHASES)
+        assert not (OVERLAP_PHASES & (HOST_PHASES | DEVICE_PHASES))
+
+    def test_overlap_hidden_counts_toward_total_not_host(self):
+        """The pipelined scheduler's hidden host work: in total_s (it
+        is real wall time inside rounds), in neither host_s nor
+        device_s — serving_host_frac must DROP when the same host work
+        moves from retirement to overlap_hidden."""
+        serial = PhaseAccumulator()
+        serial.add_round(
+            [("decode_dispatch", 0.01), ("host_sync", 0.05),
+             ("retirement", 0.04)]
+        )
+        piped = PhaseAccumulator()
+        piped.add_round(
+            [("decode_dispatch", 0.01), ("host_sync", 0.05),
+             ("overlap_hidden", 0.04)]
+        )
+        s, p = serial.split(), piped.split()
+        assert s.serving_host_frac == pytest.approx(0.5)
+        assert p.overlap_s == pytest.approx(0.04)
+        assert p.host_s == pytest.approx(0.01)
+        assert p.total_s == pytest.approx(s.total_s)
+        assert p.serving_host_frac == pytest.approx(0.1)
+        assert p.summary()["overlap_hidden_s"] == pytest.approx(0.04)
+        # a split with no overlap keeps the compact summary unchanged
+        assert "overlap_hidden_s" not in s.summary()
 
     def test_empty_and_reset(self):
         acc = PhaseAccumulator()
@@ -214,12 +241,13 @@ class TestPhaseSplit:
         acc = PhaseAccumulator()
         acc.add_round([(p, 0.001) for p in PHASES])
         s = acc.split().summary()
-        assert s["serving_host_frac"] == pytest.approx(0.6)
+        # 3 host / 6 total (2 device + 1 overlap-hidden)
+        assert s["serving_host_frac"] == pytest.approx(0.5)
         assert s["rounds"] == 1
         for p in PHASES:
             assert isinstance(s[f"{p}_ms"], float)
         # bounded: the 1,800-byte bench line must fit this whole
-        assert len(json.dumps(s)) < 300
+        assert len(json.dumps(s)) < 350
 
 
 class TestReport:
@@ -338,9 +366,11 @@ class TestCli:
 
 class TestEngineIntegration:
     """The serving engine stamps real phases: one tiny CPU stream must
-    populate every phase and expose the split through stats()."""
+    populate the split and expose it through stats() — the classic
+    five phases in the synchronous round, plus ``overlap_hidden`` in
+    the pipelined round."""
 
-    def test_engine_phase_split_populates(self):
+    def _engine(self, overlap):
         import jax
         import jax.numpy as jnp
 
@@ -357,18 +387,40 @@ class TestEngineIntegration:
         params = model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
         )["params"]
-        eng = ContinuousBatchingEngine(
+        return ContinuousBatchingEngine(
             model, params,
             SamplingConfig(max_new_tokens=4, temperature=0.0),
             batch_size=2, prompt_width=8, decode_chunk=2,
-            cache_layout="per_row",
+            cache_layout="per_row", overlap=overlap,
         )
+
+    def test_sync_engine_stamps_classic_phases(self):
+        eng = self._engine(overlap=False)
         eng.run([[5, 9, 2], [7, 1]])
         split = eng.phases.split()
         assert split.rounds > 0
-        for phase in PHASES:
+        for phase in set(PHASES) - OVERLAP_PHASES:
             assert phase in split.phases, phase
+        assert "overlap_hidden" not in split.phases
+        assert split.overlap_s == 0.0
         assert 0.0 < split.serving_host_frac < 1.0
         stats = eng.stats()
         assert stats["phase_split"]["rounds"] == split.rounds
         assert "serving_host_frac" in stats["phase_split"]
+
+    def test_overlapped_engine_hides_host_time(self):
+        """The pipelined round must report nonzero overlap_hidden —
+        host work that ran under an in-flight chunk — and the split
+        accounting must balance."""
+        eng = self._engine(overlap=True)
+        # enough requests that the pipeline is warm across rounds
+        eng.run([[5, 9, 2], [7, 1], [3, 3, 8], [9], [2, 4], [6, 1, 1]])
+        split = eng.phases.split()
+        assert split.rounds > 0
+        assert "overlap_hidden" in split.phases
+        assert split.overlap_s > 0.0
+        assert split.total_s == pytest.approx(
+            split.host_s + split.device_s + split.overlap_s
+        )
+        assert 0.0 <= split.serving_host_frac < 1.0
+        assert eng.stats()["phase_split"]["overlap_hidden_s"] > 0.0

@@ -168,6 +168,11 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
         "serving_homogeneous_tokens_per_s serving_mixed_vs_homogeneous "
         "serving_weight_swap_s serving_batch_slots serving_requests "
         "serving_per_row_tokens_per_s serving_per_row_vs_frontier "
+        "serving_sync_tokens_per_s serving_overlap_tokens_per_s "
+        "serving_overlap_vs_sync serving_overlap_hidden_ms "
+        "serving_overlap_slots serving_auto_chunk_final "
+        "serving_auto_chunk_retunes interposer_overhead_pct "
+        "interposer_plain_step_s flash_base_step_s "
         "serving_spec_tokens_per_s serving_spec_acceptance "
         "serving_spec_vs_per_row serving_int8_2x_slots_tokens_per_s "
         "serving_int8_2x_vs_per_row serving_host_frac "
@@ -181,6 +186,12 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     ).split()
     for i, k in enumerate(sections):
         extra[k] = round(1234.5678 + i, 4)
+    extra["serving_overlap_exact"] = True
+    extra["ckpt_note"] = "c" * 220  # the artifact-note string rides extra
+    extra["section_retry"] = {
+        "sections": ["ckpt", "serving"], "cleared": ["ckpt_error"],
+        "retry_on_tpu": True, "elapsed_s": 812.4,
+    }
     extra["headline_config"] = "flash+fused_ce+remat_dots+b64"
     extra["tpu_attempt"] = "interposed"
     extra["attr_report"] = "BENCH_attr_1785575775_1234.json"
@@ -257,6 +268,14 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["line_truncated"] is True
     assert slim["mfu"] == extra["mfu"]
     assert slim["serving_host_frac"] == extra["serving_host_frac"]
+    # the overlap A/B verdict (PR 2 headline rung) must ride the line
+    assert slim["serving_overlap_vs_sync"] == (
+        extra["serving_overlap_vs_sync"]
+    )
+    assert slim["serving_overlap_exact"] is True
+    assert slim["interposer_overhead_pct"] == (
+        extra["interposer_overhead_pct"]
+    )
     assert slim["attr_report"] == extra["attr_report"]
     assert slim["last_silicon"]["artifact"] == (
         extra["last_silicon"]["artifact"]
@@ -266,6 +285,102 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     full = json.load(open(sidecar))
     assert set(extra) == set(full)
     assert full["probe_history"] == extra["probe_history"]
+
+
+# ---------------------------------------------------------------------------
+# Section filter + interposer-overhead A/B (PR 2): the worker's
+# DLROVER_BENCH_SECTIONS contract and the orchestrator-side plain
+# headline child are pinned with fake workers (no jax).
+# ---------------------------------------------------------------------------
+
+
+def test_section_filter_parsing(monkeypatch):
+    bench = _bench()
+    monkeypatch.delenv("DLROVER_BENCH_SECTIONS", raising=False)
+    want, filtered = bench._section_filter()
+    assert not filtered and want("ckpt") and want("anything")
+    monkeypatch.setenv("DLROVER_BENCH_SECTIONS", "ckpt, serving")
+    want, filtered = bench._section_filter()
+    assert filtered
+    assert want("ckpt") and want("serving")
+    assert not want("decode") and not want("ladder")
+    # "headline" names no optional section: everything optional skips
+    monkeypatch.setenv("DLROVER_BENCH_SECTIONS", "headline")
+    want, filtered = bench._section_filter()
+    assert filtered and not any(
+        want(s) for s in set(bench.SECTION_OF_ERROR.values())
+    )
+
+
+def test_section_of_error_maps_into_headline_errors():
+    bench = _bench()
+    # every retryable error key is a headline-section error, and the
+    # run-scoped markers stay non-retryable
+    assert set(bench.SECTION_OF_ERROR) <= bench.HEADLINE_SECTION_ERRORS
+    assert "tpu_error" not in bench.SECTION_OF_ERROR
+    assert "fatal_error" not in bench.SECTION_OF_ERROR
+
+
+def _interposed_parsed(step=0.05):
+    return {
+        "metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+        "extra": {
+            "tpu_attempt": "interposed", "flash_base_step_s": step,
+        },
+    }
+
+
+def test_interposer_ab_computes_overhead_pct():
+    bench = _bench()
+    line = json.dumps({
+        "metric": "m", "value": 2.0, "unit": "u", "vs_baseline": 1.0,
+        "extra": {"flash_base_step_s": 0.04},
+    })
+    parsed = _interposed_parsed(step=0.05)
+    bench._interposer_overhead_rung(
+        parsed, {}, [sys.executable, "-c", f"print({line!r})"], [],
+    )
+    extra = parsed["extra"]
+    assert extra["interposer_plain_step_s"] == 0.04
+    # 0.05 / 0.04 - 1 = 25%
+    assert extra["interposer_overhead_pct"] == 25.0
+
+
+def test_interposer_ab_skips_plain_attempt_and_budget():
+    bench = _bench()
+    # a plain main attempt never spawns the child
+    parsed = _interposed_parsed()
+    parsed["extra"]["tpu_attempt"] = "plain"
+    t0 = time.time()
+    bench._interposer_overhead_rung(
+        parsed, {}, [sys.executable, "-c", "import time; time.sleep(60)"],
+        [],
+    )
+    assert time.time() - t0 < 5.0
+    assert "interposer_overhead_pct" not in parsed["extra"]
+    # an exhausted budget records the skip instead of spawning
+    parsed = _interposed_parsed()
+    history = []
+    bench._interposer_overhead_rung(
+        parsed, {}, [sys.executable, "-c", "import time; time.sleep(60)"],
+        history, deadline=time.time() + 60.0,
+    )
+    assert "interposer_overhead_pct" not in parsed["extra"]
+    assert any("skipped" in h.get("note", "") for h in history)
+
+
+def test_interposer_ab_failed_child_records_history():
+    bench = _bench()
+    parsed = _interposed_parsed()
+    history = []
+    bench._interposer_overhead_rung(
+        parsed, {}, [sys.executable, "-c", "raise SystemExit(3)"],
+        history,
+    )
+    assert "interposer_overhead_pct" not in parsed["extra"]
+    assert any(
+        h.get("worker_attempt") == "interposer_ab_plain" for h in history
+    )
 
 
 def test_under_budget_line_passes_through_untouched(tmp_path, monkeypatch):

@@ -660,9 +660,11 @@ class TestSpeculativeServing:
             assert c.tokens == w
         # self-draft greedy acceptance is 1.0 (identical programs up to
         # float noise on CPU): 8 tokens need ceil(8/(k+1)) = 2 rounds
-        # per wave of 2 slots x 2 waves = ~4 rounds, far under the
-        # 8-rounds-per-wave a no-acceptance engine would need
-        assert rounds <= 6, rounds
+        # per wave of 2 slots x 2 waves = ~4 rounds — plus the
+        # overlapped scheduler's cold-start and tail-drain step()
+        # calls, still far under the 8-rounds-per-wave (~16+ calls) a
+        # no-acceptance engine would need
+        assert rounds <= 8, rounds
 
     def test_eos_and_cap_retire_with_slot_reuse(self):
         from dlrover_tpu.models.serving import SpeculativeBatchingEngine
@@ -822,6 +824,243 @@ class TestCancellation:
             assert len(c.tokens) == 24
         finally:
             daemon.stop()
+
+
+class TestOverlappedPipeline:
+    """The double-buffered scheduler round (overlap=True, the engine
+    default): chunk N+1 dispatches before chunk N's tokens are read,
+    with per-row cap/stop enforcement on the device. Keystones: the
+    emitted stream is BIT-IDENTICAL to the synchronous round in both
+    layouts; cancellation and async weight swaps landing mid-overlap
+    neither lose nor duplicate tokens."""
+
+    def _run(self, layout, overlap, prompts, caps=None, seq=256,
+             max_new=10, model=None, params=None):
+        model = model or _model(seq=seq)
+        params = params if params is not None else _params(model)
+        sampling = SamplingConfig(max_new_tokens=max_new, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=16,
+            decode_chunk=4, cache_layout=layout, overlap=overlap,
+        )
+        for i, p in enumerate(prompts):
+            eng.submit(p, max_new_tokens=(caps or {}).get(i))
+        out = eng.run()
+        return out, eng
+
+    @pytest.mark.parametrize("layout", ["frontier", "per_row"])
+    def test_bit_identical_with_sync_round(self, layout):
+        """Mixed stream with per-request caps through both schedulers:
+        every completion's tokens AND logprobs must match exactly —
+        including rows the device-side budget stops mid-chunk."""
+        model = _model(seq=256)
+        params = _params(model)
+        # narrow length range: the plain-engine reference compiles one
+        # program per distinct prompt length
+        prompts = _mixed_prompts(10, rng_seed=21, lo=4, hi=9)
+        caps = {1: 3, 4: 7, 9: 1}  # device-side budget paths
+        sync_out, _ = self._run(
+            layout, False, prompts, caps, model=model, params=params
+        )
+        ovl_out, eng = self._run(
+            layout, True, prompts, caps, model=model, params=params
+        )
+        assert [c.uid for c in ovl_out] == [c.uid for c in sync_out]
+        for o, s in zip(ovl_out, sync_out):
+            assert o.tokens == s.tokens, (o.uid, o.tokens, s.tokens)
+            np.testing.assert_allclose(
+                o.logprobs, s.logprobs, rtol=1e-6, atol=1e-7
+            )
+        # the pipeline actually ran overlapped
+        assert eng.phases.split().overlap_s > 0.0
+        assert not eng._inflight  # drained at stream end
+
+    def test_device_side_cap_stops_rows_mid_flight(self):
+        """A capped request's tokens are exactly the uncapped prefix
+        even though the engine dispatched a further chunk before the
+        host saw the cap hit (the one-chunk lag window)."""
+        model = _model(seq=256)
+        params = _params(model)
+        prompts = [[5, 9, 2], [5, 9, 2]]
+        out, _ = self._run(
+            "per_row", True, prompts, caps={1: 3}, model=model,
+            params=params,
+        )
+        full, capped = out[0], out[1]
+        assert len(full.tokens) == 10 and len(capped.tokens) == 3
+        assert capped.tokens == full.tokens[:3]
+
+    @pytest.mark.parametrize("layout", ["frontier", "per_row"])
+    def test_cancel_mid_overlap_no_lost_or_leaked_tokens(self, layout):
+        """Cancel while a chunk is in flight: the freed slot's
+        re-admitted request must start from ITS OWN first token (the
+        uid snapshot drops the stale chunk's emissions), survivors
+        stay exact, and no uid appears twice."""
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(6, rng_seed=4, lo=4, hi=9)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout=layout, overlap=True,
+        )
+        uids = [eng.submit(p) for p in prompts]
+        rng = jax.random.PRNGKey(0)
+        rng, sub = jax.random.split(rng)
+        eng.step(sub)  # chunk 0 in flight for uids 0,1; 2..5 queued
+        assert eng._inflight  # cancel lands mid-overlap
+        assert eng.cancel(uids[1]) is True  # in-flight
+        assert eng.cancel(uids[3]) is True  # queued
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        got = eng.drain_completions()
+        seen = [c.uid for c in got]
+        assert len(seen) == len(set(seen))  # no duplicates
+        by_uid = {c.uid: c.tokens for c in got}
+        assert set(by_uid) == {uids[0], uids[2], uids[4], uids[5]}
+        want = _reference_completions(model, params, prompts, sampling)
+        for i in (0, 2, 4, 5):
+            assert by_uid[uids[i]] == want[i], i
+
+    def test_async_swap_lands_at_drain_point(self):
+        """An async swap landing mid-overlap adopts at the pipeline
+        drain: output equals the blocking swap at the same point, no
+        token is lost or doubled, and bookkeeping settles."""
+        model = _model(seq=256)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=16, temperature=0.0)
+
+        def run(swap_fn):
+            eng = ContinuousBatchingEngine(
+                model, p1, sampling, batch_size=2, prompt_width=8,
+                decode_chunk=4, overlap=True,
+            )
+            eng.submit([5, 9, 2])
+            rng = jax.random.PRNGKey(0)
+            for i in range(64):
+                rng, sub = jax.random.split(rng)
+                eng.step(sub)
+                if i == 1:
+                    swap_fn(eng)
+                if not eng.pending:
+                    break
+            (comp,) = eng.drain_completions()
+            return comp, eng
+
+        blk, _ = run(lambda e: e.set_params(p2))
+        asy, eng = run(lambda e: e.set_params_async(p2))
+        assert len(blk.tokens) == 16 and asy.tokens == blk.tokens
+        np.testing.assert_allclose(
+            asy.logprobs, blk.logprobs, rtol=1e-5, atol=1e-6
+        )
+        assert eng.stats()["swap_pending"] is False
+        assert eng.swap_latency_s is not None and eng.swap_latency_s > 0
+
+    def test_spec_async_swap_mid_overlap_follows_draft(self):
+        """Speculative overlapped round: an async target swap adopts
+        target+draft atomically at the drained pipeline and the stream
+        completes exactly (right count, no dup slots)."""
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = _model(seq=512)
+        p1, p2 = _params(model, 0), _params(model, 1)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        eng = SpeculativeBatchingEngine(
+            model, p1, sampling, batch_size=2, prompt_width=16,
+            num_draft=2, overlap=True,
+        )
+        prompts = _mixed_prompts(4, rng_seed=5)
+        uids = [eng.submit(p) for p in prompts]
+        rng = jax.random.PRNGKey(0)
+        rng, sub = jax.random.split(rng)
+        eng.step(sub)
+        assert eng._inflight
+        eng.set_params_async(p2)  # lands mid-overlap
+        while eng.pending:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        assert eng.stats()["swap_pending"] is False
+        assert eng.draft_params is eng.params  # still self-following
+        got = eng.drain_completions()
+        assert sorted(c.uid for c in got) == uids
+        for c in got:
+            assert len(c.tokens) == 8
+            assert len(c.logprobs) == len(c.tokens)
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_spec_stream_exact_both_modes(self, overlap):
+        """The speculative scheduler stays token-exact with the plain
+        engine in both round modes (the pipeline unit is the round)."""
+        from dlrover_tpu.models.serving import SpeculativeBatchingEngine
+
+        model = _model(seq=512)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+        prompts = _mixed_prompts(5, rng_seed=2, lo=4, hi=9)
+        eng = SpeculativeBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=16,
+            num_draft=3, overlap=overlap,
+        )
+        eng.submit(prompts[0], max_new_tokens=4)  # device-cap path
+        for p in prompts[1:]:
+            eng.submit(p)
+        got = eng.run()
+        want = _reference_completions(model, params, prompts, sampling)
+        assert got[0].tokens == want[0][:4]
+        for c, w in zip(got[1:], want[1:]):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+    def test_auto_chunk_tuner_retunes_and_stays_exact(self):
+        """auto_chunk: the tuner moves decode_chunk with the measured
+        host fraction — and a retuned stream stays token-exact."""
+        model = _model(seq=256)
+        params = _params(model)
+        sampling = SamplingConfig(max_new_tokens=16, temperature=0.0)
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="per_row", auto_chunk=True,
+        )
+        tuner = eng._tuner
+        assert tuner is not None
+        assert eng.d in tuner.candidates
+        assert all(c <= 16 for c in tuner.candidates)  # <= max_new
+
+        # drive the decision with synthetic phase windows: host-bound
+        # rounds must grow the chunk...
+        for _ in range(tuner.WINDOW):
+            eng.phases.add_round(
+                [("decode_dispatch", 0.02), ("host_sync", 0.01)]
+            )
+            tuner.maybe_retune()
+        assert eng.d > 4
+        # ...and device-bound rounds shrink it back
+        grown = eng.d
+        for _ in range(tuner.WINDOW):
+            eng.phases.add_round(
+                [("decode_dispatch", 0.0001), ("host_sync", 0.05)]
+            )
+            tuner.maybe_retune()
+        assert eng.d < grown
+        assert tuner.retunes >= 2
+
+        # a real stream after retunes stays exact
+        eng.phases.reset()
+        prompts = _mixed_prompts(4, rng_seed=13, lo=4, hi=9)
+        got = eng.run(prompts)
+        want = _reference_completions(model, params, prompts, sampling)
+        for c, w in zip(got, want):
+            assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
+
+        # frontier candidates respect the compaction liveness bound
+        eng_f = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="frontier", auto_chunk=True,
+        )
+        L, mn = 256, 16
+        aligned = ContinuousBatchingEngine._align(16 + mn)
+        for c in eng_f._tuner.candidates:
+            assert aligned + max(mn, c) <= L
 
 
 class TestConstrainedDecoding:

@@ -65,6 +65,14 @@ class ElasticLaunchConfig:
     soft_remesh_timeout_s: float = 15.0
     extra_env: Dict[str, str] = field(default_factory=dict)
 
+    def slice_id(self) -> int:
+        """TPU slice this host belongs to. Ranks are assigned
+        slice-contiguously (node_unit hosts per slice), so the slice is
+        derivable from the rank — reported at rendezvous join so the
+        master's TopologySorter and slice-granular relaunch see real
+        membership instead of a uniform 0."""
+        return self.node_rank // self.node_unit if self.node_unit > 1 else 0
+
     def profile_enabled(self) -> bool:
         if self.profile == "on":
             return True

@@ -18,10 +18,19 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from ..chaos import faults
 from ..common import comm
 from ..common.constants import RendezvousName
 from ..common.log import logger
 from ..rpc.client import MasterClient
+
+# Control-plane hiccups a rendezvous must ride out rather than die on:
+# the MasterClient raises ConnectionError once its own retry budget is
+# spent (master restarting, transient network partition), and the chaos
+# layer raises FaultInjectedError at the rdzv points. Both are retried
+# until the rendezvous timeout — the master going briefly dark must not
+# cost a whole node relaunch.
+_RETRIABLE = (ConnectionError, faults.FaultInjectedError)
 
 
 class RendezvousTimeoutError(RuntimeError):
@@ -72,6 +81,7 @@ class MasterRendezvousHandler:
         poll_interval: float = 0.2,
         training_port: int = 0,
         coordinator_host: str = "127.0.0.1",
+        slice_id: int = 0,
     ):
         self._name = name
         self._node_rank = node_rank
@@ -82,23 +92,45 @@ class MasterRendezvousHandler:
         self._poll_interval = poll_interval
         self._training_port = training_port
         self._coordinator_host = coordinator_host
+        self._slice_id = slice_id
 
     @property
     def name(self) -> str:
         return self._name
 
     def _join(self) -> int:
+        faults.inject("rdzv.join", node_rank=self._node_rank, rdzv=self._name)
         return self._client.join_rendezvous(
             node_rank=self._node_rank,
             local_world_size=self._local_world_size,
             rdzv_name=self._name,
             node_ip=self._coordinator_host,
+            slice_id=self._slice_id,
         )
+
+    def _join_retrying(self, start: float) -> int:
+        """Join, riding out control-plane failures until the rdzv
+        deadline — a transiently dark master must not kill the agent."""
+        while True:
+            try:
+                return self._join()
+            except _RETRIABLE as e:
+                if time.time() - start > self._timeout:
+                    raise RendezvousTimeoutError(
+                        f"rendezvous {self._name} join never succeeded "
+                        f"within {self._timeout}s: {e!r}"
+                    ) from e
+                logger.warning(
+                    "rendezvous %s join failed (%s); retrying",
+                    self._name,
+                    e,
+                )
+                time.sleep(self._poll_interval)
 
     def next_rendezvous(self) -> RendezvousWorld:
         """Join and block until the master completes a world containing us."""
         start = time.time()
-        rdzv_round = self._join()
+        rdzv_round = self._join_retrying(start)
         logger.info(
             "node %s joined rendezvous %s round %s",
             self._node_rank,
@@ -106,9 +138,32 @@ class MasterRendezvousHandler:
             rdzv_round,
         )
         while True:
-            resp = self._client.get_comm_world(
-                rdzv_name=self._name, node_rank=self._node_rank
-            )
+            try:
+                faults.inject("rdzv.poll", node_rank=self._node_rank)
+                resp = self._client.get_comm_world(
+                    rdzv_name=self._name, node_rank=self._node_rank
+                )
+                if not hasattr(resp, "world"):
+                    # The master answered but REJECTED the call (e.g. a
+                    # servicer-side drop injection returns a bare error
+                    # response): retriable like a dark master, not a
+                    # crash on the missing .world attribute.
+                    raise ConnectionError(
+                        f"master rejected get_comm_world: {resp!r}"
+                    )
+            except _RETRIABLE as e:
+                if time.time() - start > self._timeout:
+                    raise RendezvousTimeoutError(
+                        f"rendezvous {self._name} timed out after "
+                        f"{self._timeout}s polling the world: {e!r}"
+                    ) from e
+                logger.warning(
+                    "rendezvous %s world poll failed (%s); retrying",
+                    self._name,
+                    e,
+                )
+                time.sleep(self._poll_interval)
+                continue
             # The world is keyed by process_id (topology-sorted position);
             # find ourselves by the node_rank recorded in each meta.
             my_rank = next(
@@ -133,7 +188,7 @@ class MasterRendezvousHandler:
                     self._node_rank,
                     sorted(m.node_rank for m in resp.world.values()),
                 )
-                rdzv_round = self._join()
+                rdzv_round = self._join_retrying(start)
             if time.time() - start > self._timeout:
                 raise RendezvousTimeoutError(
                     f"rendezvous {self._name} timed out after "

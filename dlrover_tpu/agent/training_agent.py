@@ -19,8 +19,14 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..chaos import faults
 from ..checkpoint.saver import AsyncCheckpointSaver
-from ..common.constants import NodeEnv, NodeStatus, RendezvousName
+from ..common.constants import (
+    NodeEnv,
+    NodeExitReason,
+    NodeStatus,
+    RendezvousName,
+)
 from ..common.events import EventEmitter
 from ..common.log import logger
 from ..master.diagnosis.action import DiagnosisActionType
@@ -63,6 +69,7 @@ class ElasticTrainingAgent:
             local_world_size=config.local_world_size,
             rdzv_timeout=config.rdzv_timeout,
             training_port=config.training_port,
+            slice_id=config.slice_id(),
         )
         self._diagnosis = DiagnosisAgent(
             config.node_id, client=self._client, max_restarts=config.max_restarts
@@ -213,6 +220,14 @@ class ElasticTrainingAgent:
                         pass
                 except PermissionError:
                     pass  # alive under another uid: not ours to judge
+        # Chaos hook: a delay here stretches the recovery critical path
+        # (MTTR must absorb it); an error kills the agent mid-recovery
+        # (the master's relaunch budget takes over).
+        faults.inject(
+            "agent.worker_start",
+            node_rank=self._config.node_rank,
+            restart=self._restart_count,
+        )
         self._worker = WorkerProcess(self._spec, restart_count=self._restart_count)
         spare = self._take_spare()
         how = self._worker.start(
@@ -374,6 +389,11 @@ class ElasticTrainingAgent:
     def _invoke_run(self) -> int:
         while not self._stopped.is_set():
             time.sleep(self._config.monitor_interval)
+            # Chaos hook: wedging the supervision loop simulates a hung
+            # agent — the master's heartbeat deadline must catch it.
+            faults.inject(
+                "agent.monitor_poll", node_rank=self._config.node_rank
+            )
             action = self._take_pending_action()
             if action is not None:
                 code = self._apply_master_action(action)
@@ -427,7 +447,14 @@ class ElasticTrainingAgent:
             self._remaining_restarts -= 1
             self._restart_workers("worker failure")
             return None
-        self._report_status(NodeStatus.FAILED, exit_reason="fatal_error")
+        # RELAUNCH_REQUESTED, not FATAL_ERROR: this exit path IS the
+        # agent asking the master for a replacement node. FATAL_ERROR is
+        # the one reason should_relaunch() never honors, so reporting it
+        # here stranded the node forever (storm-observed: the job kept
+        # training one host short with budget to spare).
+        self._report_status(
+            NodeStatus.FAILED, exit_reason=NodeExitReason.RELAUNCH_REQUESTED
+        )
         logger.error("worker failure unrecoverable on this node; relaunching")
         return AGENT_EXIT_RELAUNCH
 

@@ -7,8 +7,31 @@ TPU build's equivalent is programmatic: these harnesses run a real
 master + real agent processes + real trainers on one machine and
 inject failures, returning the measured outcome (e.g. goodput under a
 preemption storm) so both the test suite and the benchmark can assert
-on it.
+on it. :mod:`dlrover_tpu.chaos.faults` adds the deterministic layer:
+seeded, env-activated fault plans firing at named injection points
+wired through the runtime (see docs/chaos.md).
+
+Package attributes resolve lazily: runtime modules (rpc client,
+servicer, checkpoint, serving) import ``chaos.faults`` from their own
+import paths, so this package must not eagerly pull the master stack
+back in (circular import).
 """
 
-from .harness import cleanup_namespaces, make_process_master  # noqa: F401
-from .goodput_storm import run_goodput_storm  # noqa: F401
+_LAZY = {
+    "cleanup_namespaces": ("harness", "cleanup_namespaces"),
+    "make_process_master": ("harness", "make_process_master"),
+    "run_goodput_storm": ("goodput_storm", "run_goodput_storm"),
+    "SCENARIOS": ("scenarios", "SCENARIOS"),
+    "run_scenario": ("scenarios", "run_scenario"),
+}
+
+__all__ = sorted(_LAZY) + ["faults"]
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(f".{module}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

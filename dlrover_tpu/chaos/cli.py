@@ -1,0 +1,82 @@
+"""``tpurun-chaos`` — run a named chaos scenario from the CLI.
+
+    tpurun-chaos list                 # scenarios + injection points
+    tpurun-chaos run flaky_rpc        # one scenario, JSON verdict
+    tpurun-chaos run slice_kill --workdir /tmp/chaos
+    tpurun-chaos plan "rpc.client.get:error@at=2"   # validate a plan
+
+Exit code 0 iff the scenario reports ``recovered`` (and the injection
+actually fired) — wired for CI chaos gates.
+"""
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import faults
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpurun-chaos",
+        description="deterministic fault injection & chaos scenarios",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list", help="list scenarios and injection points")
+
+    run_p = sub.add_parser("run", help="run one named scenario")
+    run_p.add_argument("scenario")
+    run_p.add_argument(
+        "--workdir", default=None, help="scratch dir (default: mkdtemp)"
+    )
+
+    plan_p = sub.add_parser(
+        "plan", help="validate a DLROVER_FAULT_PLAN string"
+    )
+    plan_p.add_argument("text")
+
+    ns = parser.parse_args(argv)
+
+    if ns.cmd == "list":
+        from .scenarios import SCENARIOS
+
+        print(json.dumps(
+            {
+                "scenarios": sorted(SCENARIOS),
+                "injection_points": faults.INJECTION_POINTS,
+            },
+            indent=1,
+        ))
+        return 0
+
+    if ns.cmd == "plan":
+        try:
+            plan = faults.FaultPlan.parse(ns.text)
+        except ValueError as e:
+            print(f"invalid plan: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(
+            {
+                "ok": True,
+                "normalized": plan.to_text(),
+                "specs": len(plan.specs),
+                "seed": plan.seed,
+            }
+        ))
+        return 0
+
+    from .scenarios import run_scenario
+
+    try:
+        result = run_scenario(ns.scenario, ns.workdir)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(json.dumps(result))
+    return 0 if result.get("recovered") and result.get("fired") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

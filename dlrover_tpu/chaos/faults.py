@@ -1,0 +1,370 @@
+"""Deterministic fault injection for the elastic runtime.
+
+The reference validates fault tolerance with chaosblade experiments
+against live clusters; this module is the programmatic equivalent with
+one property chaosblade can't give: *determinism*. A seeded
+:class:`FaultPlan` names exact injection points wired through the
+runtime's layers (agent supervision, rendezvous, master RPC, checkpoint
+IPC/replication, serving swap/admission) and fires on exact hit counts,
+so a chaos test reproduces byte-for-byte and a recovery regression
+bisects cleanly.
+
+Activation is environment-driven so the REAL processes spawned by the
+chaos harness (agents via :class:`ProcessScaler`, trainers via the
+agent's :class:`WorkerProcess`) pick the plan up with zero plumbing:
+
+    DLROVER_FAULT_PLAN="seed=7;log=/tmp/faults.jsonl;rpc.client.get:error@at=2"
+
+Plan grammar (full reference: docs/chaos.md)::
+
+    plan      := item (";" item)*
+    item      := "seed=" INT | "log=" PATH | spec
+    spec      := POINT ":" MODE [":" ARG] ("@" COND)*
+    MODE      := delay | error | wedge | drop
+    COND      := once | every=N | at=N | after=N | times=N | p=F
+
+``delay`` sleeps ARG seconds (default 0.1); ``wedge`` sleeps ARG
+seconds (default 3600 — a hang, not a latency blip); ``error`` raises
+:class:`FaultInjectedError` (ARG becomes the message detail); ``drop``
+returns ``"drop"`` to the call site, which implements drop semantics
+(skip the RPC, return an error response, ...). Conditions AND together
+and count per-point, per-process, starting at hit 1; ``p=F`` draws from
+``random.Random(f"{seed}:{point}:{hit}")`` so the same plan fires on
+the same hits every run.
+
+Every fire is recorded in-process (:func:`records`) and, when the plan
+carries ``log=``, appended as one JSON line to that file (O_APPEND, one
+write per record — safe across the multi-process harness). Tests
+assert against this log: an injection that didn't demonstrably fire
+proves nothing about recovery.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PLAN_ENV = "DLROVER_FAULT_PLAN"
+LOG_ENV = "DLROVER_FAULT_LOG"
+
+# Every injection point wired through the runtime. Plans naming an
+# unregistered point fail to parse (a typo'd point would otherwise
+# "pass" every recovery test by never firing), and docs/chaos.md must
+# table each one (tests/test_faults.py doc-lint).
+INJECTION_POINTS: Dict[str, str] = {
+    "rpc.client.get": "MasterClient get verb, before the transport call",
+    "rpc.client.report": "MasterClient report verb, before the transport call",
+    "master.servicer.get": "master servicer get dispatch entry",
+    "master.servicer.report": "master servicer report dispatch entry",
+    "rdzv.join": "agent-side join_rendezvous RPC",
+    "rdzv.poll": "agent-side get_comm_world poll while a world assembles",
+    "agent.worker_start": "agent about to start/restart its JAX worker",
+    "agent.monitor_poll": "each tick of the agent's worker monitor loop",
+    "ckpt.engine.save": "trainer engine save_to_memory entry",
+    "ckpt.engine.load": "trainer engine load/load_consistent entry",
+    "ckpt.saver.factory": "agent saver about to act on a factory message",
+    "ckpt.saver.persist": "agent saver draining shm to storage",
+    "ckpt.replica.push": "replica push of the staged shard to the backup peer",
+    "ckpt.replica.fetch": "replica fetch of this host's shard from a peer",
+    "serving.swap": "serving engine async weight-swap device transfer",
+    "serving.admit": "serving engine slot-admission entry",
+}
+
+_MODES = ("delay", "error", "wedge", "drop")
+
+# ``drop`` needs the call site's cooperation (it must read inject()'s
+# return value and implement drop semantics); only these points do.
+# Accepting a drop spec anywhere else would log a "fire" that perturbed
+# nothing — a recovery test asserting against the log would then pass
+# vacuously — so plans naming drop at other points fail to parse.
+DROP_POINTS = frozenset(
+    (
+        "rpc.client.get",
+        "rpc.client.report",
+        "master.servicer.get",
+        "master.servicer.report",
+    )
+)
+
+
+class FaultInjectedError(RuntimeError):
+    """Raised by ``error``-mode injections (and by drop-aware call
+    sites when a drop cannot be expressed as a return value)."""
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    mode: str
+    arg: str = ""
+    once: bool = False
+    every: int = 0
+    at: int = 0
+    after: int = 0
+    times: int = 0
+    p: float = 1.0
+    fired: int = 0  # per-process fire count (not part of the plan text)
+
+    def seconds(self, default: float) -> float:
+        try:
+            return float(self.arg)
+        except (TypeError, ValueError):
+            return default
+
+    def matches(self, hit: int, seed: int) -> bool:
+        if self.once and self.fired >= 1:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        if self.at and hit != self.at:
+            return False
+        if self.after and hit <= self.after:
+            return False
+        if self.every and hit % self.every != 0:
+            return False
+        if self.p < 1.0:
+            draw = random.Random(f"{seed}:{self.point}:{hit}").random()
+            if draw >= self.p:
+                return False
+        return True
+
+    def to_text(self) -> str:
+        out = f"{self.point}:{self.mode}"
+        if self.arg:
+            out += f":{self.arg}"
+        if self.once:
+            out += "@once"
+        for k in ("every", "at", "after", "times"):
+            v = getattr(self, k)
+            if v:
+                out += f"@{k}={v}"
+        if self.p < 1.0:
+            out += f"@p={self.p}"
+        return out
+
+
+@dataclass
+class FaultPlan:
+    specs: List[FaultSpec] = field(default_factory=list)
+    seed: int = 0
+    log_path: Optional[str] = None
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        plan = cls()
+        for raw in (text or "").split(";"):
+            item = raw.strip()
+            if not item:
+                continue
+            if item.startswith("seed="):
+                plan.seed = int(item[len("seed="):])
+                continue
+            if item.startswith("log="):
+                plan.log_path = item[len("log="):]
+                continue
+            plan.specs.append(cls._parse_spec(item))
+        return plan
+
+    @staticmethod
+    def _parse_spec(item: str) -> FaultSpec:
+        head, *conds = item.split("@")
+        parts = head.split(":", 2)
+        if len(parts) < 2:
+            raise ValueError(f"fault spec needs point:mode — got {item!r}")
+        point, mode = parts[0].strip(), parts[1].strip()
+        arg = parts[2].strip() if len(parts) > 2 else ""
+        if point not in INJECTION_POINTS:
+            raise ValueError(
+                f"unknown injection point {point!r}; registered: "
+                f"{sorted(INJECTION_POINTS)}"
+            )
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; one of {_MODES}")
+        if mode == "drop" and point not in DROP_POINTS:
+            raise ValueError(
+                f"point {point!r} does not implement drop; drop-capable: "
+                f"{sorted(DROP_POINTS)}"
+            )
+        spec = FaultSpec(point=point, mode=mode, arg=arg)
+        for cond in conds:
+            cond = cond.strip()
+            if cond == "once":
+                spec.once = True
+            elif cond.startswith("p="):
+                spec.p = float(cond[2:])
+            elif "=" in cond:
+                key, _, val = cond.partition("=")
+                if key not in ("every", "at", "after", "times"):
+                    raise ValueError(f"unknown fault condition {cond!r}")
+                setattr(spec, key, int(val))
+            else:
+                raise ValueError(f"unknown fault condition {cond!r}")
+        return spec
+
+    def to_text(self) -> str:
+        items = []
+        if self.seed:
+            items.append(f"seed={self.seed}")
+        if self.log_path:
+            items.append(f"log={self.log_path}")
+        items.extend(s.to_text() for s in self.specs)
+        return ";".join(items)
+
+
+class FaultInjector:
+    """Executes a plan: counts hits per point, applies matching specs,
+    records every fire (in memory and to the plan's JSONL log)."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._hits: Dict[str, int] = {}
+        self._records: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def fire(self, point: str, ctx: Dict[str, Any]) -> Optional[str]:
+        with self._lock:
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            matched = [
+                s
+                for s in self.plan.specs
+                if s.point == point and s.matches(hit, self.plan.seed)
+            ]
+            for spec in matched:
+                spec.fired += 1
+                self._record(point, spec, hit, ctx)
+        # Apply OUTSIDE the lock: a wedge must not serialize every other
+        # point's bookkeeping behind its sleep.
+        mode = None
+        for spec in matched:
+            mode = spec.mode
+            if spec.mode == "delay":
+                time.sleep(spec.seconds(0.1))
+            elif spec.mode == "wedge":
+                time.sleep(spec.seconds(3600.0))
+            elif spec.mode == "error":
+                raise FaultInjectedError(
+                    f"injected fault at {point}"
+                    + (f": {spec.arg}" if spec.arg else "")
+                )
+        # "drop" wins over co-matching delay specs regardless of plan
+        # order: every matched spec was logged as fired, so the call
+        # site must honor the drop or the log would claim a drop that
+        # never happened.
+        if any(s.mode == "drop" for s in matched):
+            return "drop"
+        return mode
+
+    def _record(
+        self, point: str, spec: FaultSpec, hit: int, ctx: Dict[str, Any]
+    ) -> None:
+        entry = {
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "point": point,
+            "mode": spec.mode,
+            "hit": hit,
+            "ctx": {k: str(v)[:120] for k, v in ctx.items()},
+        }
+        self._records.append(entry)
+        path = self.plan.log_path or os.getenv(LOG_ENV)
+        if not path:
+            return
+        try:
+            line = (json.dumps(entry) + "\n").encode()
+            fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            try:
+                os.write(fd, line)  # one write: atomic under PIPE_BUF
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # the in-memory record still exists
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._records)
+
+
+# Lazily resolved from the environment so every process — test, agent,
+# trainer, master — self-activates on its first injection-point hit.
+_UNINIT = object()
+_injector: Any = _UNINIT
+_init_lock = threading.Lock()
+
+
+def _active() -> Optional[FaultInjector]:
+    global _injector
+    if _injector is _UNINIT:
+        with _init_lock:
+            if _injector is _UNINIT:
+                text = os.getenv(PLAN_ENV, "")
+                if text:
+                    try:
+                        _injector = FaultInjector(FaultPlan.parse(text))
+                    except ValueError as e:
+                        # A malformed plan must be LOUD, not silently
+                        # inert — but it must not take the runtime down.
+                        from ..common.log import logger
+
+                        logger.error("ignoring bad %s: %s", PLAN_ENV, e)
+                        _injector = None
+                else:
+                    _injector = None
+    return _injector
+
+
+def activate(plan: FaultPlan) -> FaultInjector:
+    """Install a plan in-process (tests); overrides the env plan."""
+    global _injector
+    with _init_lock:
+        _injector = FaultInjector(plan)
+        return _injector
+
+
+def deactivate() -> None:
+    """Remove any active plan: every :func:`inject` becomes a no-op,
+    including for a plan still present in the environment. Call
+    :func:`reset` instead to re-read ``DLROVER_FAULT_PLAN``."""
+    global _injector
+    with _init_lock:
+        _injector = None
+
+
+def reset() -> None:
+    """Forget the cached env plan so a changed env re-activates."""
+    global _injector
+    with _init_lock:
+        _injector = _UNINIT
+
+
+def inject(point: str, **ctx: Any) -> Optional[str]:
+    """The one hook call sites use. No-op (returns None) without an
+    active plan; otherwise returns the fired mode ("drop" tells the
+    call site to drop the operation) or raises FaultInjectedError."""
+    injector = _active()
+    if injector is None:
+        return None
+    return injector.fire(point, ctx)
+
+
+def records() -> List[Dict[str, Any]]:
+    """Fires recorded in THIS process (empty without an active plan)."""
+    injector = _active()
+    return injector.records() if injector is not None else []
+
+
+def read_log(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL injection log written by any process of the job."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    except OSError:
+        pass
+    return out

@@ -127,11 +127,22 @@ def run_goodput_storm(
     timeout_s: float = 720.0,
     monitor_interval_s: float = 1.0,
     job_name: str = "goodput_storm",
+    # Slice-granular chaos: after the host kills, SIGKILL entire
+    # node_unit groups at once (the realistic TPU fault — a slice, not
+    # a host, is the unit that dies) and measure recovery separately.
+    node_unit: int = 1,
+    slice_kills: int = 0,
+    extra_env: Optional[Dict[str, str]] = None,
 ) -> Optional[Dict[str, float]]:
     """Run the storm; returns the measured outcome or None on timeout.
 
     Result keys: ``goodput`` (PerfMonitor's number), ``steps`` (global
-    watermark reached), ``kills``, ``elapsed_s``, ``steps_per_second``.
+    watermark reached), ``kills``, ``elapsed_s``, ``steps_per_second``,
+    ``mttr_s`` (host-kill recovery). With ``slice_kills`` > 0 the
+    recovery-SLO matrix gains the slice class: ``slice_mttr_s``,
+    ``slice_goodput`` (productive fraction of the slice-kill window),
+    and ``slice_relaunches`` (how many times the master's slice-aligned
+    group relaunch actually ran).
     """
     os.makedirs(workdir, exist_ok=True)
     cache_dir = os.path.join(workdir, "xla_cache")
@@ -160,7 +171,22 @@ def run_goodput_storm(
 
     from .harness import make_process_master
 
-    total_budget = first_kill_step + kills * kill_interval_steps + settle_steps
+    node_unit = max(1, node_unit)
+    kills_total = kills + slice_kills
+    total_budget = (
+        first_kill_step + kills_total * kill_interval_steps + settle_steps
+    )
+    env = {
+        "STORM_CACHE_DIR": cache_dir,
+        "STORM_CKPT_DIR": ckpt_dir,
+        "STORM_STEP_SLEEP": str(step_sleep),
+        "STORM_STORAGE_EVERY": str(storage_every),
+        # far past the budget: ranks must never FINISH mid-storm
+        "STORM_MAX_STEPS": str(total_budget * 10),
+        "DLROVER_LOCAL_DEVICES": "1",
+        "PYTHONPATH": os.pathsep.join(sys.path),
+    }
+    env.update(extra_env or {})
     master, scaler, watcher = make_process_master(
         job_name,
         command=[
@@ -169,23 +195,17 @@ def run_goodput_storm(
             "dlrover_tpu.launcher.elastic_run",
             "--nnodes",
             str(num_workers),
+            "--node_unit",
+            str(node_unit),
             "--max_restarts",
             "3",
             "--monitor_interval",
             str(monitor_interval_s),
             script,
         ],
-        env={
-            "STORM_CACHE_DIR": cache_dir,
-            "STORM_CKPT_DIR": ckpt_dir,
-            "STORM_STEP_SLEEP": str(step_sleep),
-            "STORM_STORAGE_EVERY": str(storage_every),
-            # far past the budget: ranks must never FINISH mid-storm
-            "STORM_MAX_STEPS": str(total_budget * 10),
-            "DLROVER_LOCAL_DEVICES": "1",
-            "PYTHONPATH": os.pathsep.join(sys.path),
-        },
+        env=env,
         num_workers=num_workers,
+        node_unit=node_unit,
     )
     deadline = time.time() + timeout_s
     t0 = time.time()
@@ -197,7 +217,9 @@ def run_goodput_storm(
     stalls = []
     last_advance = (0, t0)
     first_step_at = 0.0
-    kill_times = []
+    first_slice_kill_t = 0.0
+    kill_times = []  # [{"t": wall clock, "kind": "host"|"slice"}]
+    num_slices = max(1, num_workers // node_unit)
     try:
         master.prepare()
         master.run_in_background()
@@ -218,7 +240,7 @@ def run_goodput_storm(
                         (
                             kt
                             for kt in kill_times
-                            if last_advance[1] - 5.0 <= kt <= now
+                            if last_advance[1] - 5.0 <= kt["t"] <= now
                         ),
                         None,
                     )
@@ -229,30 +251,60 @@ def run_goodput_storm(
                             "at_step": last_advance[0],
                             "gap_s": round(gap, 1),
                             "kill": matched is not None,
+                            "kind": matched["kind"] if matched else None,
                         }
                     )
                 if last_advance[0] == 0:
                     first_step_at = now
                 last_advance = (step, now)
-            if kills_done < kills and step >= next_kill:
-                victim = kills_done % num_workers
-                pid = scaler.node_pid(victim)
-                if pid is not None:
-                    logger.info(
-                        "storm: SIGKILL node %s at global step %s",
-                        victim,
-                        step,
-                    )
+            if kills_done < kills_total and step >= next_kill:
+                if kills_done < kills:
+                    kind = "host"
+                    victims = [kills_done % num_workers]
+                else:
+                    # Slice storm: the whole node_unit group dies at
+                    # once — the fault class a TPU job actually sees
+                    # when a slice is preempted or its ICI fails.
+                    kind = "slice"
+                    s = (kills_done - kills) % num_slices
+                    victims = [
+                        v
+                        for v in range(
+                            s * node_unit, (s + 1) * node_unit
+                        )
+                        if v < num_workers
+                    ]
+                killed = []
+                for victim in victims:
+                    pid = scaler.node_pid(victim)
+                    if pid is None:
+                        continue
                     try:
                         os.killpg(pid, signal.SIGKILL)
+                        killed.append(victim)
                     except (ProcessLookupError, PermissionError):
                         pass
-                    kill_times.append(time.time())
+                if killed:
+                    logger.info(
+                        "storm: SIGKILL %s nodes %s at global step %s",
+                        kind,
+                        killed,
+                        step,
+                    )
+                    kill_times.append({"t": time.time(), "kind": kind})
+                    if kind == "slice" and not first_slice_kill_t:
+                        first_slice_kill_t = time.time()
                     kills_done += 1
                     next_kill += kill_interval_steps
-            if kills_done >= kills and step >= total_budget:
-                kill_stalls = [s["gap_s"] for s in stalls if s["kill"]]
-                return {
+            if kills_done >= kills_total and step >= total_budget:
+                end_t = time.time()
+                host_stalls = [
+                    s["gap_s"] for s in stalls if s.get("kind") == "host"
+                ]
+                slice_stalls = [
+                    s["gap_s"] for s in stalls if s.get("kind") == "slice"
+                ]
+                result = {
                     "goodput": round(master.perf_monitor.goodput(), 4),
                     # productive fraction once training began — the
                     # number the recovery machinery controls (strict
@@ -262,24 +314,50 @@ def run_goodput_storm(
                     ),
                     "steps": int(step),
                     "kills": kills_done,
-                    "elapsed_s": round(time.time() - t0, 1),
+                    "elapsed_s": round(end_t - t0, 1),
                     "steps_per_second": round(
                         master.perf_monitor.steps_per_second(), 3
                     ),
                     "first_step_s": round(first_step_at - t0, 1),
                     "mttr_s": round(
-                        sum(kill_stalls) / len(kill_stalls), 1
+                        sum(host_stalls) / len(host_stalls), 1
                     )
-                    if kill_stalls
+                    if host_stalls
                     else 0.0,
                     "stalls": stalls[:20],
                 }
+                if slice_kills:
+                    window = (
+                        end_t - first_slice_kill_t
+                        if first_slice_kill_t
+                        else 0.0
+                    )
+                    result["slice_mttr_s"] = (
+                        round(sum(slice_stalls) / len(slice_stalls), 1)
+                        if slice_stalls
+                        else 0.0
+                    )
+                    # Productive fraction of the window the slice class
+                    # owned (first slice kill → finish): the slice-kill
+                    # row of the recovery-SLO matrix, directly
+                    # comparable with the host-kill goodput above.
+                    result["slice_goodput"] = (
+                        round(
+                            max(0.0, 1.0 - sum(slice_stalls) / window), 4
+                        )
+                        if window > 0
+                        else 0.0
+                    )
+                    result["slice_relaunches"] = int(
+                        getattr(master.job_manager, "slice_relaunches", 0)
+                    )
+                return result
             time.sleep(0.5)
         logger.warning(
             "storm timed out at step %s with %s/%s kills",
             master.perf_monitor.last_step()[0],
             kills_done,
-            kills,
+            kills_total,
         )
         return None
     finally:
@@ -300,6 +378,9 @@ def main(argv=None) -> int:
     parser.add_argument("--kills", type=int, default=None)
     parser.add_argument("--kill-interval", type=int, default=None)
     parser.add_argument("--step-sleep", type=float, default=None)
+    parser.add_argument("--num-workers", type=int, default=None)
+    parser.add_argument("--node-unit", type=int, default=None)
+    parser.add_argument("--slice-kills", type=int, default=None)
     ns = parser.parse_args(argv)
     workdir = ns.workdir or tempfile.mkdtemp(prefix="goodput_storm_")
     overrides = {
@@ -308,6 +389,9 @@ def main(argv=None) -> int:
             "kills": ns.kills,
             "kill_interval_steps": ns.kill_interval,
             "step_sleep": ns.step_sleep,
+            "num_workers": ns.num_workers,
+            "node_unit": ns.node_unit,
+            "slice_kills": ns.slice_kills,
         }.items()
         if v is not None
     }

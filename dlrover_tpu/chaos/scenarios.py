@@ -1,0 +1,415 @@
+"""Named chaos scenarios: one callable per shipped fault class.
+
+Each scenario injects a deterministic fault (chaos/faults.py) into the
+REAL runtime path it targets, verifies the injection actually fired
+(via the injection records/log — an injection that never fired proves
+nothing), and verifies the runtime recovered. Tests and the
+``tpurun-chaos`` CLI share these callables, so the recovery-SLO claims
+in docs/chaos.md are backed by the same code in both places.
+
+Every scenario returns a JSON-able dict::
+
+    {"scenario": name, "fired": <int>, "recovered": <bool>, ...detail}
+
+``fired`` counts injection-log records for the scenario's points;
+``recovered`` is the scenario-specific "runtime came back" predicate.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, Optional
+
+from ..common.log import logger
+from . import faults
+
+
+def _fired(points) -> int:
+    return sum(1 for r in faults.records() if r["point"] in points)
+
+
+# ---------------------------------------------------------------------------
+# flaky_rpc: transient master RPC failures — the client's jittered
+# exponential backoff must converge without surfacing an error.
+# ---------------------------------------------------------------------------
+
+
+def flaky_rpc(workdir: Optional[str] = None) -> Dict:
+    from ..master.job_context import JobContext
+    from ..master.local_master import LocalJobMaster
+    from ..rpc.client import MasterClient
+
+    faults.activate(
+        faults.FaultPlan.parse(
+            "seed=7;rpc.client.get:error:flaky@at=1;"
+            "rpc.client.report:error:flaky@at=1"
+        )
+    )
+    master = LocalJobMaster(num_workers=1, fresh_context=True)
+    try:
+        master.prepare()
+        client = MasterClient(master_addr=master.addr, node_id=0)
+        # First attempt of each verb dies injected; the retry loop must
+        # converge and the kv round-trip must be intact.
+        client.kv_store_set("chaos/flaky", b"survived")
+        value = client.kv_store_get("chaos/flaky")
+        fired = _fired(("rpc.client.get", "rpc.client.report"))
+        return {
+            "scenario": "flaky_rpc",
+            "fired": fired,
+            "recovered": value == b"survived" and fired >= 2,
+        }
+    finally:
+        master.stop()
+        JobContext.reset()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# rdzv_retry: the join RPC dies under the agent — the rendezvous
+# handler must retry within its deadline and still form the world.
+# ---------------------------------------------------------------------------
+
+
+def rdzv_retry(workdir: Optional[str] = None) -> Dict:
+    from ..agent.rendezvous import MasterRendezvousHandler
+    from ..common.constants import RendezvousName
+    from ..master.job_context import JobContext
+    from ..master.local_master import LocalJobMaster
+    from ..rpc.client import MasterClient
+
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;rdzv.join:error:join-blip@at=1")
+    )
+    master = LocalJobMaster(num_workers=1, fresh_context=True)
+    try:
+        master.prepare()
+        client = MasterClient(master_addr=master.addr, node_id=0)
+        handler = MasterRendezvousHandler(
+            RendezvousName.TRAINING,
+            node_rank=0,
+            client=client,
+            rdzv_timeout=30.0,
+        )
+        world = handler.next_rendezvous()
+        fired = _fired(("rdzv.join",))
+        return {
+            "scenario": "rdzv_retry",
+            "fired": fired,
+            "recovered": world.world_size == 1
+            and world.rank == 0
+            and bool(world.coordinator)
+            and fired >= 1,
+        }
+    finally:
+        master.stop()
+        JobContext.reset()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# peer_replica_loss: the backup peer is gone mid-restore — the load
+# fallback chain (memory → peer → storage) must complete from storage.
+# ---------------------------------------------------------------------------
+
+
+def peer_replica_loss(workdir: Optional[str] = None) -> Dict:
+    import numpy as np
+
+    from ..checkpoint.engine import CheckpointEngine
+    from ..checkpoint.saver import AsyncCheckpointSaver
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_replica_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    pytree = {"w": np.arange(16, dtype=np.float32), "b": np.float32(3.5)}
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;ckpt.replica.fetch:error:peer-lost")
+    )
+    try:
+        # Commit step 5 to storage, then clear the staged memory image —
+        # the restore must walk the chain instead of shortcutting.
+        writer = CheckpointEngine(ckpt_dir, host_rank=0, num_hosts=1)
+        try:
+            assert writer.save_to_storage(5, pytree)
+            assert writer.wait_saving(30.0)
+            writer.shm.invalidate()
+        finally:
+            writer.close()
+        engine = CheckpointEngine(
+            ckpt_dir,
+            host_rank=0,
+            num_hosts=2,
+            replicate=True,
+            # A registered-but-dead peer: even without the injection the
+            # fetch would fail; the injection makes the failure
+            # deterministic and logged.
+            replica_peers={1: "127.0.0.1:9"},
+        )
+        try:
+            step, restored = engine.load(
+                {"w": np.zeros(16, np.float32), "b": np.float32(0)}
+            )
+        finally:
+            engine.close()
+        fired = _fired(("ckpt.replica.fetch",))
+        return {
+            "scenario": "peer_replica_loss",
+            "fired": fired,
+            "recovered": step == 5
+            and restored is not None
+            and bool(np.array_equal(restored["w"], pytree["w"]))
+            and fired >= 1,
+        }
+    finally:
+        AsyncCheckpointSaver.shutdown()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# saver_wedge: the agent saver's IPC answers but its runner is wedged —
+# the trainer engine must time out and fall back to a standalone saver
+# in a fresh IPC namespace (checkpointing survives a wedged agent).
+# ---------------------------------------------------------------------------
+
+_WEDGED_SAVER_SRC = """
+from dlrover_tpu.common.platform import force_virtual_cpu
+force_virtual_cpu(1)
+from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+import time
+AsyncCheckpointSaver.start_async_saving_ckpt()
+print("WEDGED_SAVER_UP", flush=True)
+time.sleep(120)
+"""
+
+
+def saver_wedge(workdir: Optional[str] = None) -> Dict:
+    import numpy as np
+
+    from ..checkpoint.engine import CheckpointEngine
+    from ..checkpoint.saver import FACTORY_QUEUE, AsyncCheckpointSaver
+    from ..common.multi_process import LocalSocketClient
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_wedge_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    log_path = os.path.join(workdir, "faults.jsonl")
+    ns = f"chaos_wedge_{os.getpid()}"
+    env = dict(
+        os.environ,
+        DLROVER_IPC_NAMESPACE=ns,
+        DLROVER_FAULT_PLAN=(
+            f"seed=7;log={log_path};ckpt.saver.factory:wedge:90@once"
+        ),
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(sys.path),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _WEDGED_SAVER_SRC],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    old_ns = os.environ.get("DLROVER_IPC_NAMESPACE")
+    try:
+        # Adopt the child's namespace FIRST: the availability probe and
+        # the engine must look where the wedged saver actually serves.
+        os.environ["DLROVER_IPC_NAMESPACE"] = ns
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if LocalSocketClient("queue_" + FACTORY_QUEUE).available():
+                break
+            if proc.poll() is not None:
+                return {
+                    "scenario": "saver_wedge",
+                    "fired": 0,
+                    "recovered": False,
+                    "error": "wedged-saver subprocess died at boot",
+                }
+            time.sleep(0.2)
+        else:
+            return {
+                "scenario": "saver_wedge",
+                "fired": 0,
+                "recovered": False,
+                "error": "wedged saver never served its factory socket",
+            }
+        pytree = {"w": np.arange(8, dtype=np.float32)}
+        engine = CheckpointEngine(
+            ckpt_dir, host_rank=0, num_hosts=1, saver_timeout_s=3.0
+        )
+        try:
+            fell_back = engine._standalone  # the fallback flipped this
+            ok_save = engine.save_to_storage(2, pytree)
+            ok_wait = engine.wait_saving(30.0)
+            step, restored = engine.load({"w": np.zeros(8, np.float32)})
+        finally:
+            engine.close()
+        log = faults.read_log(log_path)
+        fired = sum(1 for r in log if r["point"] == "ckpt.saver.factory")
+        return {
+            "scenario": "saver_wedge",
+            "fired": fired,
+            "recovered": fell_back
+            and ok_save
+            and ok_wait
+            and step == 2
+            and restored is not None
+            and fired >= 1,
+        }
+    finally:
+        if old_ns is None:
+            os.environ.pop("DLROVER_IPC_NAMESPACE", None)
+        else:
+            os.environ["DLROVER_IPC_NAMESPACE"] = old_ns
+        AsyncCheckpointSaver.shutdown()
+        proc.kill()
+        proc.wait(10)
+
+
+# ---------------------------------------------------------------------------
+# poisoned_swap: a weight push fails on the device-transfer path mid-
+# overlap — the serving pipeline must abort the swap, keep serving the
+# OLD weights (no wedge), and surface the failure in stats().
+# ---------------------------------------------------------------------------
+
+
+def poisoned_swap(workdir: Optional[str] = None) -> Dict:
+    import jax
+    import numpy as np
+
+    from ..models.generation import SamplingConfig
+    from ..models.gpt import GPT, GPTConfig
+    from ..models.serving import ContinuousBatchingEngine
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64,
+            max_seq_len=128,
+            num_layers=2,
+            num_heads=2,
+            head_dim=8,
+            embed_dim=16,
+            use_remat=False,
+        )
+    )
+    import jax.numpy as jnp
+
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    sampling = SamplingConfig(max_new_tokens=6, temperature=0.0)
+    eng = ContinuousBatchingEngine(
+        model, params, sampling, batch_size=2, prompt_width=16,
+        decode_chunk=4, overlap=True,
+    )
+    r = np.random.default_rng(0)
+    prompts = [
+        [int(x) for x in r.integers(1, 64, 5)] for _ in range(3)
+    ]
+    baseline = [c.tokens for c in eng.run(prompts)]
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;serving.swap:error:poisoned@once")
+    )
+    try:
+        eng.set_params_async(params)  # poisoned push: aborted
+        stats = eng.stats()
+        after = [c.tokens for c in eng.run(prompts)]  # old weights serve
+        fired = _fired(("serving.swap",))
+        return {
+            "scenario": "poisoned_swap",
+            "fired": fired,
+            "recovered": stats["swap_pending"] is False
+            and stats["swap_failures"] >= 1
+            and after == baseline
+            and fired >= 1,
+            "swap_failures": stats["swap_failures"],
+        }
+    finally:
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# host_kill / slice_kill: the full process storms (real master, real
+# agents, real trainers). Compressed parameters — the bench runs the
+# production-shaped storm; these are the CLI/e2e-test variants.
+# ---------------------------------------------------------------------------
+
+
+def host_kill(workdir: Optional[str] = None) -> Dict:
+    from .goodput_storm import run_goodput_storm
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_hostkill_")
+    result = run_goodput_storm(
+        os.path.join(workdir, "storm"),
+        num_workers=2,
+        kills=1,
+        kill_interval_steps=10,
+        settle_steps=5,
+        first_kill_step=5,
+        step_sleep=0.2,
+        storage_every=5,
+        timeout_s=300.0,
+        job_name=f"chaos_hostkill_{os.getpid()}",
+    )
+    return {
+        "scenario": "host_kill",
+        "fired": int(result["kills"]) if result else 0,
+        "recovered": bool(result) and result["steps"] >= 15,
+        "storm": result,
+    }
+
+
+def slice_kill(workdir: Optional[str] = None) -> Dict:
+    from .goodput_storm import run_goodput_storm
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_slicekill_")
+    result = run_goodput_storm(
+        os.path.join(workdir, "storm"),
+        num_workers=4,
+        node_unit=2,
+        kills=0,
+        slice_kills=1,
+        kill_interval_steps=15,
+        settle_steps=10,
+        first_kill_step=8,
+        step_sleep=0.3,
+        storage_every=5,
+        timeout_s=420.0,
+        job_name=f"chaos_slicekill_{os.getpid()}",
+    )
+    return {
+        "scenario": "slice_kill",
+        "fired": int(result["kills"]) if result else 0,
+        "recovered": bool(result)
+        and result.get("slice_relaunches", 0) >= 1
+        and result["steps"] >= 20,
+        "storm": result,
+    }
+
+
+SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
+    "flaky_rpc": flaky_rpc,
+    "rdzv_retry": rdzv_retry,
+    "peer_replica_loss": peer_replica_loss,
+    "saver_wedge": saver_wedge,
+    "poisoned_swap": poisoned_swap,
+    "host_kill": host_kill,
+    "slice_kill": slice_kill,
+}
+
+
+def run_scenario(name: str, workdir: Optional[str] = None) -> Dict:
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r}; available: {sorted(SCENARIOS)}"
+        )
+    logger.info("chaos scenario %s starting", name)
+    result = SCENARIOS[name](workdir)
+    logger.info(
+        "chaos scenario %s: fired=%s recovered=%s",
+        name,
+        result.get("fired"),
+        result.get("recovered"),
+    )
+    return result

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..chaos import faults
 from ..common.constants import NodeEnv
 from ..common.log import logger
 from ..common.multi_process import LocalSocketClient, SharedLock, SharedQueue
@@ -96,6 +97,7 @@ class CheckpointEngine:
         standalone: Optional[bool] = None,
         replicate: Optional[bool] = None,
         replica_peers: Optional[Dict[int, str]] = None,
+        saver_timeout_s: Optional[float] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.mesh = mesh
@@ -123,6 +125,14 @@ class CheckpointEngine:
         )
         self._replica_peers = replica_peers
 
+        # How long to wait for the saver's shard-lock server before
+        # declaring its IPC wedged (chaos tests shorten this; the
+        # default matches the old hard-coded 30 s).
+        self._saver_timeout_s = (
+            saver_timeout_s
+            if saver_timeout_s is not None
+            else float(os.getenv("DLROVER_CKPT_SAVER_TIMEOUT_S", "30"))
+        )
         if standalone is None:
             standalone = not LocalSocketClient("queue_" + FACTORY_QUEUE).available()
         self._standalone = standalone
@@ -137,17 +147,13 @@ class CheckpointEngine:
         self.storage.clear_persist_error(self.host_rank)
         self._factory_q = SharedQueue(FACTORY_QUEUE)
         self._event_q = SharedQueue(EVENT_QUEUE)
-        self._factory_q.put(
-            {
-                "type": "create",
-                "storage_root": checkpoint_dir,
-                "host_rank": self.host_rank,
-                "num_hosts": self.num_hosts,
-                "replicate": self._replicate,
-                "replica_peers": self._replica_peers,
-            }
-        )
-        self._shard_lock = self._wait_lock()
+        self._factory_q.put(self._factory_msg())
+        try:
+            self._shard_lock = self._wait_lock(self._saver_timeout_s)
+        except TimeoutError:
+            if self._standalone:
+                raise  # our own in-process saver failed: nothing to fall to
+            self._fallback_standalone_saver()
         # Async staging (save_to_memory(block=False)): the trainer's
         # blocking cost is one device-side snapshot dispatch; a
         # background thread does the D2H + shm memcpy and releases the
@@ -161,6 +167,16 @@ class CheckpointEngine:
         # saves transparently degrade to the blocking path.
         self._async_disabled = False
 
+    def _factory_msg(self) -> Dict:
+        return {
+            "type": "create",
+            "storage_root": self.checkpoint_dir,
+            "host_rank": self.host_rank,
+            "num_hosts": self.num_hosts,
+            "replicate": self._replicate,
+            "replica_peers": self._replica_peers,
+        }
+
     def _wait_lock(self, timeout: float = 30.0) -> SharedLock:
         deadline = time.time() + timeout
         lock = SharedLock(lock_name(self.host_rank))
@@ -169,6 +185,41 @@ class CheckpointEngine:
                 raise TimeoutError("checkpoint saver did not come up")
             time.sleep(0.05)
         return lock
+
+    def _fallback_standalone_saver(self) -> None:
+        """The agent saver's IPC is wedged: its factory socket accepted
+        our create message (``available()`` said yes) but the shard-lock
+        server never came up within ``saver_timeout_s``. Checkpointing
+        must not die with it — re-point this process at a FRESH private
+        IPC namespace and run an in-process saver there. The wedged
+        namespace's sockets/shm are left to the wedged owner; staging
+        restarts clean in the fallback namespace (memory restore of the
+        old incarnation's image is sacrificed — storage history, which
+        the fallback saver keeps writing, is not)."""
+        from ..common.multi_process import _ipc_namespace
+
+        old_ns = _ipc_namespace()
+        fresh_ns = f"{old_ns}_fb{os.getpid()}"
+        logger.error(
+            "checkpoint saver IPC wedged (no shard lock within %.0fs); "
+            "falling back to a standalone saver in fresh namespace %s",
+            self._saver_timeout_s,
+            fresh_ns,
+        )
+        for res in (self._factory_q, self._event_q):
+            try:
+                res.close()
+            except Exception:  # noqa: BLE001 — old namespace, best effort
+                pass
+        self.shm.close()
+        os.environ["DLROVER_IPC_NAMESPACE"] = fresh_ns
+        self.shm = SharedMemoryHandler(self.host_rank)
+        self._standalone = True
+        self._saver_thread = AsyncCheckpointSaver.start_async_saving_ckpt()
+        self._factory_q = SharedQueue(FACTORY_QUEUE)
+        self._event_q = SharedQueue(EVENT_QUEUE)
+        self._factory_q.put(self._factory_msg())
+        self._shard_lock = self._wait_lock(self._saver_timeout_s)
 
     # -- save --------------------------------------------------------------
 
@@ -221,6 +272,10 @@ class CheckpointEngine:
         folded into the all-hosts allreduce so every host skips the
         same step together.
         """
+        # Chaos hook: a delay here stretches the trainer's blocking
+        # window; an error must surface to the loop (which re-saves
+        # blocking or skips the step), never wedge the shard lock.
+        faults.inject("ckpt.engine.save", step=step)
         staging = self._stage_thread is not None and self._stage_thread.is_alive()
         if staging:
             logger.warning(
@@ -486,6 +541,7 @@ class CheckpointEngine:
 
         Returns (step, restored_pytree) or (-1, None) if nothing to load.
         """
+        faults.inject("ckpt.engine.load", host_rank=self.host_rank)
         # Drain any in-flight async stage first: the shard lock is
         # reentrant for this engine, so _load_from_memory would NOT
         # block on the staging thread and could read a half-written
@@ -685,6 +741,7 @@ class CheckpointEngine:
           can't shadow the live history);
         - no common storage step → everyone starts fresh, consistently.
         """
+        faults.inject("ckpt.engine.load", host_rank=self.host_rank)
         self._drain_stage_for_read()
         meta = self.shm.read_meta() if self.shm.attach() else None
         if meta is None and self._refill_from_peer():

@@ -31,6 +31,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from ..chaos import faults
 from ..common.log import logger
 from ..common.multi_process import SharedMemorySegment
 from .meta import HEADER_LEN_BYTES, CheckpointMeta
@@ -333,6 +334,9 @@ class ReplicaClient:
         req.add_header("Content-Length", str(total))
         req.add_header(_TOKEN_HEADER, _job_token())
         try:
+            # Chaos hook inside the try: an injected push failure rides
+            # the real log-and-drop path (replication is best-effort).
+            faults.inject("ckpt.replica.push", rank=owner_rank, addr=addr)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status == 200
         except Exception as e:
@@ -352,6 +356,9 @@ class ReplicaClient:
             headers={_TOKEN_HEADER: _job_token()},
         )
         try:
+            # Chaos hook: peer-replica loss mid-restore — the engine's
+            # load must continue down the fallback chain to storage.
+            faults.inject("ckpt.replica.fetch", rank=owner_rank, addr=addr)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 total = int(resp.headers.get("Content-Length", 0))
                 if resp.status != 200 or total <= 0:

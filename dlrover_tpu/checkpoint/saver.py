@@ -20,6 +20,7 @@ import threading
 import queue as _queue
 from typing import Dict, Optional
 
+from ..chaos import faults
 from ..common.log import logger
 from ..common.multi_process import SharedLock, SharedQueue
 from .shm_handler import SharedMemoryHandler
@@ -171,6 +172,12 @@ class AsyncCheckpointSaver:
                     if msg is None or msg.get("type") == "exit":
                         return
                     try:
+                        # Chaos hook: a wedge here leaves the factory
+                        # socket answering but the shard-lock server
+                        # never created — the trainer engine's wait
+                        # must time out and fall back to a standalone
+                        # saver in a fresh IPC namespace.
+                        faults.inject("ckpt.saver.factory")
                         saver = cls.get_or_create(
                             storage_root=msg["storage_root"],
                             host_rank=msg.get("host_rank", 0),
@@ -368,6 +375,10 @@ class AsyncCheckpointSaver:
         to the blocked trainer).
         """
         try:
+            # Chaos hook: an error lands in the persist-error marker
+            # (wait_saving fails fast); a wedge holds the shard lock —
+            # the trainer's non-blocking saves must skip, not stall.
+            faults.inject("ckpt.saver.persist", step=step)
             with self._shard_lock:
                 meta = self.shm.read_meta()
                 if meta is None:

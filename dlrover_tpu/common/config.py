@@ -21,6 +21,13 @@ class Context:
     master_service_type: str = DefaultValues.SERVICE_TYPE
     master_port: int = DefaultValues.MASTER_PORT
 
+    # Master RPC client: per-call transport deadline and the jittered
+    # exponential backoff between retries (DLROVER_RPC_* env overrides).
+    rpc_deadline_s: float = 30.0
+    rpc_retries: int = 3
+    rpc_backoff_base_s: float = 0.5
+    rpc_backoff_cap_s: float = 5.0
+
     # Rendezvous
     rdzv_timeout_s: float = DefaultValues.RDZV_TIMEOUT_S
     rdzv_lastcall_s: float = DefaultValues.RDZV_LASTCALL_S

@@ -47,9 +47,18 @@ class NodeExitReason:
     FATAL_ERROR = "fatal_error"
     HARDWARE_ERROR = "hardware_error"
     PREEMPTED = "preempted"
+    # The agent itself asked to be replaced (worker restart budget
+    # exhausted / diagnosis said relaunch): the node-level relaunch
+    # budget still bounds the loop, but the master MUST honor the
+    # request — reporting FATAL_ERROR here silently stranded the node
+    # (observed in the goodput storm: a replacement whose worker
+    # crash-looped left the job permanently one host short).
+    RELAUNCH_REQUESTED = "relaunch_requested"
     UNKNOWN = "unknown"
 
-    RELAUNCHABLE = {KILLED, OOM, HARDWARE_ERROR, PREEMPTED}
+    # The relaunch gate is Node.should_relaunch(): every reason is
+    # honored EXCEPT FATAL_ERROR (there is deliberately no allowlist —
+    # an unforeseen exit reason defaults to recovering the node).
 
 
 class JobStage:

@@ -125,6 +125,13 @@ class Node:
     reported_unhealthy: bool = False
     # Rendezvous bookkeeping
     paral_config_version: int = 0
+    # Set on a slice-relaunch replacement: the fault that killed the
+    # slice may still have members' DELETED events in flight when the
+    # replacements (same node ids) are registered — the first deletion
+    # arriving before this deadline, while the replacement is still
+    # INITIAL, reports the dead predecessor and must not fail the fresh
+    # node (see DistributedJobManager.process_event).
+    stale_delete_until: float = 0.0
 
     def inc_relaunch_count(self) -> None:
         self.relaunch_count += 1
@@ -166,6 +173,7 @@ class Node:
         new_node.heartbeat_time = 0
         new_node.start_hang_time = 0
         new_node.reported_unhealthy = False
+        new_node.stale_delete_until = 0.0
         return new_node
 
 

@@ -31,6 +31,13 @@ from .job_manager import JobManager
 
 
 class DistributedJobManager(JobManager):
+    # How long a slice-relaunch replacement shields itself from its
+    # predecessor's in-flight DELETED event (see process_event). The
+    # watcher polls at ~0.5-1 s, so stale events land within a couple
+    # of polls; a replacement that genuinely dies inside this window
+    # while still INITIAL is caught by the pending/heartbeat monitors.
+    STALE_DELETE_GRACE_S = 5.0
+
     def __init__(
         self,
         num_workers: int,
@@ -45,9 +52,18 @@ class DistributedJobManager(JobManager):
         self._watch_thread: Optional[threading.Thread] = None
         self._pending_since: Optional[float] = None
         self._suspended = False
+        # Observability for chaos harnesses: how many times the
+        # slice-granular recovery path actually ran.
+        self.slice_relaunches = 0
 
     def start(self) -> None:
         super().start()
+        if self._node_unit > 1:
+            # Ranks are slice-contiguous (node_unit hosts per slice) —
+            # the same mapping the agents report at rendezvous join.
+            for node in self._job_ctx.get_nodes(NodeType.WORKER).values():
+                node.slice_id = max(0, node.rank_index) // self._node_unit
+                self._job_ctx.update_node(node)
         self._scaler.start()
         # Materialize the initial world.
         self._scaler.scale(ScalePlan(worker_num=self.num_workers))
@@ -77,10 +93,25 @@ class DistributedJobManager(JobManager):
                 logger.exception("node watcher error; retrying")
                 time.sleep(1)
 
+    def _slice_of(self, node: Node) -> int:
+        """Slice membership derived from the rank (ranks are assigned
+        slice-contiguously, node_unit hosts per slice). Derived, not
+        read from node.slice_id: watcher-built event nodes carry the
+        default 0, and a stale 0 here would group-relaunch the WRONG
+        slice."""
+        if self._node_unit <= 1:
+            return 0
+        return max(0, node.rank_index) // self._node_unit
+
     def process_event(self, event: NodeEvent) -> None:
         node = event.node
         if node is None:
             return
+        if self._node_unit > 1 and node.node_type == NodeType.WORKER:
+            # Watcher-built event nodes default slice_id to 0; stamp the
+            # derived membership so the adoption paths below never
+            # insert a mis-sliced record into the job context.
+            node.slice_id = self._slice_of(node)
         if self._suspended and event.event_type == NodeEventType.DELETED:
             # Suspension removes the pods on purpose; their deletions are
             # not failures and must not consume the relaunch budget.
@@ -91,8 +122,31 @@ class DistributedJobManager(JobManager):
             return
         if event.event_type == NodeEventType.DELETED:
             current = self._job_ctx.get_node(node.node_type, node.node_id)
+            if (
+                current is not None
+                and current.status == NodeStatus.INITIAL
+                and current.stale_delete_until > time.time()
+            ):
+                # A slice relaunch registered this replacement while its
+                # predecessor's death was still in the watcher pipeline:
+                # this deletion is the predecessor's, already handled by
+                # the group relaunch — consuming it as the REPLACEMENT's
+                # failure would burn budget and kill the fresh node.
+                current.stale_delete_until = 0.0
+                self._job_ctx.update_node(current)
+                logger.info(
+                    "ignoring stale deletion for relaunched node %s",
+                    node.node_id,
+                )
+                return
             if current is not None:
-                current.exit_reason = node.exit_reason or current.exit_reason
+                # The agent's own status report (RPC, arrives first) knows
+                # WHY it exited — e.g. RELAUNCH_REQUESTED. The watcher only
+                # guesses from the return code (any rc>0 reads FATAL_ERROR),
+                # so its guess must never clobber a reported reason: that
+                # clobber turned every agent-requested relaunch into a
+                # never-relaunch verdict and stranded the node.
+                current.exit_reason = current.exit_reason or node.exit_reason
                 if not current.exited():
                     current.update_status(
                         NodeStatus.FAILED
@@ -144,6 +198,13 @@ class DistributedJobManager(JobManager):
                     JobAbortionAction(reason=JobExitReason.MAX_RELAUNCH)
                 )
             return
+        if self._node_unit > 1:
+            # TPU shape: one dead host means the slice's ICI domain
+            # cannot run collectives at all — surviving members would
+            # only rejoin as a short slice the rendezvous must truncate
+            # away. Replace the whole slice as a unit instead.
+            self.relaunch_slice(self._slice_of(node))
+            return
         replacement = self._consume_budget(node)
         logger.info(
             "relaunching node %s via scaler (count %s/%s)",
@@ -186,24 +247,42 @@ class DistributedJobManager(JobManager):
 
     def relaunch_slice(self, slice_id: int) -> None:
         """Group relaunch (reference :1046): replace every host of a
-        slice together — a slice is the unit of ICI connectivity."""
+        slice together — a slice is the unit of ICI connectivity.
+
+        The replacements (same node ids: a relaunched "pod" lands on
+        the same simulated host, reattaching its staged shm checkpoint)
+        are registered in the job context NOW, so the fleet's view never
+        holds terminal records for ids that are about to come back —
+        and each carries a short stale-delete shield because members
+        killed by the same fault may still have DELETED events in the
+        watcher pipeline when this runs."""
         workers = self._job_ctx.get_nodes(NodeType.WORKER)
-        members = [n for n in workers.values() if n.slice_id == slice_id]
+        members = [
+            n
+            for n in workers.values()
+            if self._slice_of(n) == slice_id and not self._scaled_out(n)
+        ]
         if not members:
             return
+        self.slice_relaunches += 1
         logger.info(
             "slice %s group relaunch: nodes %s",
             slice_id,
             sorted(n.node_id for n in members),
         )
-        plan = ScalePlan(
-            remove_nodes=[n.node_id for n in members],
-            launch_nodes=[n.get_relaunch_node(n.node_id) for n in members],
-        )
+        shield_until = time.time() + self.STALE_DELETE_GRACE_S
+        replacements = []
         for node in members:
-            node.inc_relaunch_count()
-            self._job_ctx.update_node(node)
-        self._scaler.scale(plan)
+            replacement = self._consume_budget(node)
+            replacement.stale_delete_until = shield_until
+            self._job_ctx.update_node(replacement)
+            replacements.append(replacement)
+        self._scaler.scale(
+            ScalePlan(
+                remove_nodes=[n.node_id for n in members],
+                launch_nodes=replacements,
+            )
+        )
 
     # -- scale down (reference job_auto_scaler.py:276-345 shrink path) -----
 

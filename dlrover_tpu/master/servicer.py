@@ -9,6 +9,7 @@ manager, diagnosis queues).
 import time
 from typing import Dict
 
+from ..chaos import faults
 from ..common import comm
 from ..common.constants import JobStage, RendezvousName
 from ..common.log import logger
@@ -44,6 +45,12 @@ class MasterServicer:
     # -- transport entry points (bytes in/out) -----------------------------
 
     def get(self, request_bytes: bytes) -> bytes:
+        # Chaos hook: error propagates to the transport (the client sees
+        # a failed RPC and retries); "drop" answers with a rejection.
+        if faults.inject("master.servicer.get") == "drop":
+            return dumps(
+                comm.BaseResponse(success=False, reason="fault-injected drop")
+            )
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._GET_HANDLERS.get(type(message))
@@ -58,6 +65,10 @@ class MasterServicer:
         return dumps(comm.BaseResponse(success=True, data=dumps(result)))
 
     def report(self, request_bytes: bytes) -> bytes:
+        if faults.inject("master.servicer.report") == "drop":
+            return dumps(
+                comm.BaseResponse(success=False, reason="fault-injected drop")
+            )
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._REPORT_HANDLERS.get(type(message))

@@ -73,6 +73,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..attribution.phases import PhaseAccumulator
+from ..chaos import faults
 from .generation import (
     SamplingConfig,
     decode_apply,
@@ -315,6 +316,12 @@ class ContinuousBatchingEngine:
         self.swap_latency_s: Optional[float] = None
         self._pending_params = None  # in-flight async weight swap
         self._pending_t0 = 0.0
+        # A failed swap (device transfer error, poisoned payload) is
+        # ABORTED, not served: the engine keeps the old weights, clears
+        # the pending state so the pipeline never wedges waiting on a
+        # transfer that will not land, and surfaces the failure here.
+        self.swap_failures = 0
+        self.last_swap_error: Optional[str] = None
         self._uid = 0
         # (uid, tokens, submit_t, cap, prefix_id)
         self._queue: List[tuple] = []
@@ -709,9 +716,28 @@ class ContinuousBatchingEngine:
         push never stalls the rollout loop (the measured transfer is
         ~12 s for 124M params over the tunneled chip; blocking that
         long mid-decode is the exact stall this avoids). A second call
-        before adoption supersedes the first (latest weights win)."""
+        before adoption supersedes the first (latest weights win).
+
+        A transfer that fails to even enqueue (mismatched payload, a
+        dead device) ABORTS the swap: the engine keeps serving the old
+        weights, ``swap_pending`` clears, and the failure is surfaced
+        via :meth:`stats` — a poisoned push must cost one swap, never
+        the serving pipeline."""
         self._pending_t0 = time.perf_counter()
-        self._pending_params = _device_put_like(params, self.params)
+        try:
+            faults.inject("serving.swap")
+            self._pending_params = _device_put_like(params, self.params)
+        except Exception as e:  # noqa: BLE001 — swap aborted, not served
+            self._abort_pending_swap(e)
+
+    def _abort_pending_swap(self, err: BaseException) -> None:
+        """Drop an in-flight swap and keep the current weights."""
+        self._pending_params = None
+        self.swap_failures += 1
+        self.last_swap_error = repr(err)[:300]
+        from ..common.log import logger
+
+        logger.error("weight swap aborted (serving old weights): %r", err)
 
     def _maybe_adopt_pending(self) -> bool:
         """Adopt a pending async swap if the transfer has completed —
@@ -720,11 +746,17 @@ class ContinuousBatchingEngine:
         (processes any in-flight chunk): the swap lands at a point
         where host bookkeeping matches device state, so no round is
         ever split between parameter versions — the pipeline's drain
-        point is the only adoption boundary."""
+        point is the only adoption boundary. An async transfer that
+        FAILED in flight (readiness probe raises) aborts the swap: old
+        weights stay live, the pipeline keeps stepping."""
         pending = self._pending_params
         if pending is None:
             return False
-        if not _tree_ready(pending):
+        try:
+            if not _tree_ready(pending):
+                return False
+        except Exception as e:  # noqa: BLE001 — failed transfer
+            self._abort_pending_swap(e)
             return False
         # catch-up tokens are credited to slots/completions; the count
         # is surfaced through the next step()'s return
@@ -928,6 +960,10 @@ class ContinuousBatchingEngine:
         dispatch — the largest host-serial cost the pipeline had
         left. The synchronous baseline keeps the per-row path it
         always had."""
+        # Chaos hook: a delay models a slow admission host path (the
+        # overlapped round must hide it); an error surfaces to the
+        # driver loop rather than silently corrupting slot state.
+        faults.inject("serving.admit", queue_depth=len(self._queue))
         frontier_layout = self.layout == "frontier"
         burst = self.overlap and self._burst_admit
         prefill_s = 0.0
@@ -1298,6 +1334,8 @@ class ContinuousBatchingEngine:
             ),
             "last_swap_latency_s": self.swap_latency_s,
             "swap_pending": self._pending_params is not None,
+            "swap_failures": self.swap_failures,
+            "last_swap_error": self.last_swap_error,
             # host/device attribution (attribution.phases): host_frac
             # plus per-phase totals, compact enough for /healthz and
             # the bench line budget
@@ -1733,10 +1771,23 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         the two stores sees draft-without-target and adopts nothing,
         rather than target-without-draft."""
         if draft_params is not None:
-            self._pending_draft = _device_put_like(
-                draft_params, self.draft_params
-            )
+            try:
+                self._pending_draft = _device_put_like(
+                    draft_params, self.draft_params
+                )
+            except Exception as e:  # noqa: BLE001 — swap aborted
+                self._abort_pending_swap(e)
+                return
         super().set_params_async(params)
+
+    def _abort_pending_swap(self, err: BaseException) -> None:
+        # The pair aborts together: a new draft adopted against the old
+        # target (or vice versa) collapses acceptance — exactly the
+        # mismatch atomic adoption exists to prevent. This covers every
+        # abort source, including a target transfer that fails in
+        # flight under _maybe_adopt_pending.
+        self._pending_draft = None
+        super()._abort_pending_swap(err)
 
     def _maybe_adopt_pending(self) -> bool:
         """Atomic target+draft adoption: when an explicit draft swap is
@@ -1745,7 +1796,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         boundary."""
         pending_draft = self._pending_draft
         if pending_draft is not None and self._pending_params is not None:
-            if not _tree_ready(pending_draft):
+            try:
+                if not _tree_ready(pending_draft):
+                    return False
+            except Exception as e:  # noqa: BLE001 — failed draft transfer
+                self._abort_pending_swap(e)
                 return False
         follow = self.draft_params is self.params
         if super()._maybe_adopt_pending():

@@ -6,6 +6,7 @@ tasks, checkpoint sync, heartbeat, pre-check) over either gRPC or HTTP.
 """
 
 import os
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -13,6 +14,7 @@ from urllib import request as _urlreq
 
 import grpc
 
+from ..chaos import faults
 from ..common import comm
 from ..common.config import get_context
 from ..common.constants import GRPC, CommsType, NodeEnv
@@ -33,7 +35,8 @@ class MasterTransport:
 
 
 class GrpcTransport(MasterTransport):
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, deadline_s: float = 30.0):
+        self._deadline_s = deadline_s
         self._channel = grpc.insecure_channel(
             addr,
             options=[
@@ -53,18 +56,19 @@ class GrpcTransport(MasterTransport):
         )
 
     def get(self, payload: bytes) -> bytes:
-        return self._get(payload, timeout=30)
+        return self._get(payload, timeout=self._deadline_s)
 
     def report(self, payload: bytes) -> bytes:
-        return self._report(payload, timeout=30)
+        return self._report(payload, timeout=self._deadline_s)
 
     def close(self) -> None:
         self._channel.close()
 
 
 class HttpTransport(MasterTransport):
-    def __init__(self, addr: str):
+    def __init__(self, addr: str, deadline_s: float = 30.0):
         self._base = f"http://{addr}"
+        self._deadline_s = deadline_s
 
     def _post(self, path: str, payload: bytes) -> bytes:
         req = _urlreq.Request(
@@ -72,7 +76,7 @@ class HttpTransport(MasterTransport):
             data=payload,
             headers={"Content-Type": "application/msgpack"},
         )
-        with _urlreq.urlopen(req, timeout=30) as resp:
+        with _urlreq.urlopen(req, timeout=self._deadline_s) as resp:
             return resp.read()
 
     def get(self, payload: bytes) -> bytes:
@@ -94,17 +98,28 @@ class MasterClient:
         node_id: int = -1,
         node_type: str = "worker",
         service_type: str = "",
-        retries: int = 3,
+        retries: Optional[int] = None,
+        deadline_s: Optional[float] = None,
     ):
+        ctx = get_context()
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
-        service_type = service_type or get_context().master_comms()
+        service_type = service_type or ctx.master_comms()
+        deadline_s = deadline_s if deadline_s is not None else ctx.rpc_deadline_s
         if service_type == CommsType.HTTP:
-            self._transport: MasterTransport = HttpTransport(master_addr)
+            self._transport: MasterTransport = HttpTransport(
+                master_addr, deadline_s=deadline_s
+            )
         else:
-            self._transport = GrpcTransport(master_addr)
-        self._retries = retries
+            self._transport = GrpcTransport(master_addr, deadline_s=deadline_s)
+        self._retries = retries if retries is not None else ctx.rpc_retries
+        self._backoff_base_s = ctx.rpc_backoff_base_s
+        self._backoff_cap_s = ctx.rpc_backoff_cap_s
+        # Per-client jitter stream: independent clients must not sleep in
+        # lockstep (a whole fleet retrying a recovering master at the
+        # same instants is the thundering herd backoff exists to break).
+        self._rng = random.Random(os.getpid() ^ id(self))
 
     # -- low-level verbs ---------------------------------------------------
 
@@ -114,11 +129,27 @@ class MasterClient:
         )
         return dumps(req)
 
+    def _backoff_delay(self, attempt: int) -> float:
+        """Jittered exponential backoff before retry ``attempt`` (>=1):
+        uniform in [half, full] of ``base * 2^(attempt-1)`` capped at
+        ``rpc_backoff_cap_s`` — "equal jitter", which decorrelates a
+        fleet without ever retrying unrealistically early."""
+        full = min(
+            self._backoff_cap_s, self._backoff_base_s * (2 ** (attempt - 1))
+        )
+        return full * (0.5 + 0.5 * self._rng.random())
+
     def _call(self, verb: str, message: Any) -> Any:
         payload = self._wrap(message)
         last_err: Optional[Exception] = None
         for attempt in range(self._retries):
+            if attempt:
+                # Sleep only BETWEEN attempts: the old post-failure sleep
+                # also charged the final raise a full backoff for nothing.
+                time.sleep(self._backoff_delay(attempt))
             try:
+                if faults.inject(f"rpc.client.{verb}", node_id=self.node_id) == "drop":
+                    raise faults.FaultInjectedError(f"rpc {verb} dropped")
                 fn = self._transport.get if verb == "get" else self._transport.report
                 raw = fn(payload)
                 resp = loads(raw)
@@ -129,7 +160,6 @@ class MasterClient:
                 return resp
             except Exception as e:  # noqa: BLE001 — transport errors retried
                 last_err = e
-                time.sleep(min(2**attempt, 5))
         raise ConnectionError(
             f"master {verb} failed after {self._retries} tries: {last_err!r}"
         )
@@ -180,7 +210,17 @@ class MasterClient:
                 slice_id=slice_id,
             )
         )
-        return resp.round if isinstance(resp, comm.JoinRendezvousResponse) else 0
+        if not isinstance(resp, comm.JoinRendezvousResponse):
+            # The master answered but REJECTED the join (e.g. a
+            # servicer-side drop injection returns a bare error
+            # response). Coercing that to round 0 would read as a
+            # successful join: the master never registered the node, so
+            # the agent would poll a world that can never contain it
+            # until the whole rdzv deadline. Raise the same retriable
+            # error a dark master produces — the handler's join retry
+            # loop rides it out.
+            raise ConnectionError(f"master rejected join_rendezvous: {resp!r}")
+        return resp.round
 
     def get_comm_world(
         self, rdzv_name: str, node_rank: int = -1

@@ -209,10 +209,23 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
         "compile_count": 44.0, "device_completes": 50000.0,
         "stall_verdict": 0.0,
     }
+    # slice-storm recovery-SLO matrix (full dict incl. stall forensics
+    # rides extra/sidecar; the storm_* scalars must survive in-line)
     extra["goodput_storm"] = {
-        "goodput": 0.83, "steps": 400, "restarts": 3,
-        "elapsed_s": 481.2, "trainers": 2,
+        "goodput": 0.83, "training_goodput": 0.95, "steps": 520,
+        "kills": 4, "elapsed_s": 812.2, "steps_per_second": 0.71,
+        "first_step_s": 24.3, "mttr_s": 11.4, "slice_mttr_s": 17.9,
+        "slice_goodput": 0.88, "slice_relaunches": 3,
+        "stalls": [
+            {"at_step": 100 + 30 * i, "gap_s": 12.5, "kill": True,
+             "kind": "slice" if i % 2 else "host"}
+            for i in range(8)
+        ],
     }
+    extra["storm_goodput"] = 0.83
+    extra["storm_mttr_s"] = 11.4
+    extra["storm_slice_mttr_s"] = 17.9
+    extra["storm_slice_goodput"] = 0.88
     bench._merge_committed_artifacts(extra)
     extra["probe_history"] = [
         {
@@ -276,6 +289,12 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["interposer_overhead_pct"] == (
         extra["interposer_overhead_pct"]
     )
+    # the recovery-SLO matrix rides the line as pointer-style scalars
+    # (the full storm dict with its stall list stays sidecar-only)
+    assert slim["storm_mttr_s"] == extra["storm_mttr_s"]
+    assert slim["storm_slice_mttr_s"] == extra["storm_slice_mttr_s"]
+    assert slim["storm_slice_goodput"] == extra["storm_slice_goodput"]
+    assert slim["storm_goodput"] == extra["storm_goodput"]
     assert slim["attr_report"] == extra["attr_report"]
     assert slim["last_silicon"]["artifact"] == (
         extra["last_silicon"]["artifact"]

@@ -63,9 +63,11 @@ def _worker(node_id, status=NodeStatus.RUNNING, **kw):
 
 
 class TestDistributedJobManager:
-    def _manager(self, n=2):
+    def _manager(self, n=2, node_unit=1):
         scaler = RecordingScaler()
-        m = DistributedJobManager(num_workers=n, scaler=scaler)
+        m = DistributedJobManager(
+            num_workers=n, scaler=scaler, node_unit=node_unit
+        )
         return m, scaler
 
     def test_start_materializes_world(self):
@@ -191,13 +193,15 @@ class TestDistributedJobManager:
         assert action.config.get("reason") == JobExitReason.MAX_RELAUNCH
 
     def test_slice_group_relaunch(self):
-        m, scaler = self._manager(4)
+        # Slice membership derives from the rank (node_unit hosts per
+        # slice, assigned slice-contiguously at start()) — no manual
+        # slice_id stamping, the manager owns the mapping.
+        m, scaler = self._manager(4, node_unit=2)
         m.start()
         ctx = get_job_context()
-        for node_id in range(4):
-            node = ctx.get_node(NodeType.WORKER, node_id)
-            node.slice_id = node_id // 2
-            ctx.update_node(node)
+        assert [
+            ctx.get_node(NodeType.WORKER, i).slice_id for i in range(4)
+        ] == [0, 0, 1, 1]
         m.relaunch_slice(1)
         m.stop()
         plan = scaler.plans[-1]

@@ -1,0 +1,590 @@
+"""Deterministic fault-injection layer (chaos/faults.py): plan
+grammar, fire semantics, the injection log, the RPC retry/backoff
+hardening, the slice-aware relaunch wiring, and the docs contract
+(every registered injection point is documented in docs/chaos.md).
+
+The end-to-end scenario runs (real master/agents/trainers) live in
+tests/test_zz_chaos_e2e.py so the unit suite stays fast.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.chaos import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+class TestPlanGrammar:
+    def test_parse_roundtrip(self):
+        text = (
+            "seed=11;log=/tmp/x.jsonl;rpc.client.get:error@at=2;"
+            "ckpt.saver.factory:wedge:45@once;master.servicer.get:drop@every=3"
+        )
+        plan = faults.FaultPlan.parse(text)
+        assert plan.seed == 11
+        assert plan.log_path == "/tmp/x.jsonl"
+        assert len(plan.specs) == 3
+        again = faults.FaultPlan.parse(plan.to_text())
+        assert [s.to_text() for s in again.specs] == [
+            s.to_text() for s in plan.specs
+        ]
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown injection point"):
+            faults.FaultPlan.parse("no.such.point:error")
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            faults.FaultPlan.parse("rpc.client.get:explode")
+
+    def test_drop_rejected_at_non_drop_point(self):
+        # drop needs the call site to read inject()'s return value;
+        # accepting it elsewhere would log fires that perturbed nothing
+        with pytest.raises(ValueError, match="does not implement drop"):
+            faults.FaultPlan.parse("serving.admit:drop@every=2")
+        for point in sorted(faults.DROP_POINTS):
+            faults.FaultPlan.parse(f"{point}:drop@once")  # parses
+
+    def test_unknown_condition_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault condition"):
+            faults.FaultPlan.parse("rpc.client.get:error@sometimes")
+
+    def test_registered_points_is_the_wired_set(self):
+        # the registry IS the documentation contract; a point wired in
+        # code but missing here never parses in a plan
+        assert "rpc.client.get" in faults.INJECTION_POINTS
+        assert "serving.swap" in faults.INJECTION_POINTS
+        assert len(faults.INJECTION_POINTS) >= 14
+
+
+class TestFireSemantics:
+    def test_once_fires_exactly_once(self):
+        faults.activate(faults.FaultPlan.parse("serving.admit:delay:0@once"))
+        assert faults.inject("serving.admit") == "delay"
+        assert faults.inject("serving.admit") is None
+        assert faults.inject("serving.admit") is None
+        assert len(faults.records()) == 1
+
+    def test_every_n(self):
+        faults.activate(faults.FaultPlan.parse("rpc.client.get:drop@every=2"))
+        got = [faults.inject("rpc.client.get") for _ in range(6)]
+        assert got == [None, "drop", None, "drop", None, "drop"]
+
+    def test_at_n_and_times(self):
+        faults.activate(
+            faults.FaultPlan.parse(
+                "rpc.client.get:drop@at=3;rpc.client.report:drop@times=2"
+            )
+        )
+        got = [faults.inject("rpc.client.get") for _ in range(5)]
+        assert got == [None, None, "drop", None, None]
+        got = [faults.inject("rpc.client.report") for _ in range(5)]
+        assert got == ["drop", "drop", None, None, None]
+
+    def test_error_mode_raises(self):
+        faults.activate(
+            faults.FaultPlan.parse("rpc.client.get:error:boom@once")
+        )
+        with pytest.raises(faults.FaultInjectedError, match="boom"):
+            faults.inject("rpc.client.get")
+
+    def test_probability_is_seed_deterministic(self):
+        def run(seed):
+            faults.activate(
+                faults.FaultPlan.parse(
+                    f"seed={seed};master.servicer.get:drop@p=0.5"
+                )
+            )
+            return [
+                faults.inject("master.servicer.get") is not None
+                for _ in range(32)
+            ]
+
+        a, b = run(123), run(123)
+        assert a == b  # same seed → identical fires
+        assert run(124) != a  # different seed → different draws
+        assert 4 < sum(a) < 28  # p=0.5 actually thins
+
+    def test_inactive_is_noop(self):
+        assert faults.inject("rpc.client.get") is None
+        assert faults.records() == []
+
+    def test_after_n_fires_strictly_after(self):
+        faults.activate(faults.FaultPlan.parse("rpc.client.get:drop@after=3"))
+        got = [faults.inject("rpc.client.get") for _ in range(6)]
+        assert got == [None, None, None, "drop", "drop", "drop"]
+
+    def test_conditions_and_together(self):
+        # every=2 AND times=2: hits 2 and 4 fire, hit 6 is spent
+        faults.activate(
+            faults.FaultPlan.parse("rpc.client.get:drop@every=2@times=2")
+        )
+        got = [faults.inject("rpc.client.get") for _ in range(7)]
+        assert got == [None, "drop", None, "drop", None, None, None]
+
+    def test_delay_arg_fallback(self):
+        # a non-numeric arg must not crash the injection — the mode's
+        # default duration applies instead
+        spec = faults.FaultPlan.parse("serving.admit:delay:oops").specs[0]
+        assert spec.seconds(0.25) == 0.25
+        assert faults.FaultPlan.parse(
+            "serving.admit:delay:0.5"
+        ).specs[0].seconds(0.25) == 0.5
+
+    def test_multiple_specs_same_point_all_fire(self):
+        faults.activate(
+            faults.FaultPlan.parse(
+                "master.servicer.get:drop@at=1;"
+                "master.servicer.get:delay:0@at=1"
+            )
+        )
+        # both specs match hit 1 and both are recorded; drop wins the
+        # return value regardless of plan order — every logged fire
+        # must be honored by the call site, and drop is the one mode
+        # that needs its cooperation
+        assert faults.inject("master.servicer.get") == "drop"
+        assert [r["mode"] for r in faults.records()] == ["drop", "delay"]
+
+    def test_hit_counting_is_thread_safe(self):
+        import threading
+
+        faults.activate(faults.FaultPlan.parse("rpc.client.get:drop@every=2"))
+        fired = []
+
+        def worker():
+            for _ in range(100):
+                if faults.inject("rpc.client.get") == "drop":
+                    fired.append(1)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 800 hits, every=2 → exactly 400 fires, no lost updates
+        assert len(fired) == 400
+        assert len(faults.records()) == 400
+
+    def test_activate_overrides_env_plan(self, monkeypatch):
+        monkeypatch.setenv(
+            faults.PLAN_ENV, "rpc.client.get:drop@every=1"
+        )
+        faults.reset()
+        faults.activate(faults.FaultPlan.parse("rpc.client.report:drop@once"))
+        # the in-process plan replaced the env plan entirely
+        assert faults.inject("rpc.client.get") is None
+        assert faults.inject("rpc.client.report") == "drop"
+
+
+class TestInjectionLog:
+    def test_log_file_and_reader(self, tmp_path):
+        log = tmp_path / "faults.jsonl"
+        faults.activate(
+            faults.FaultPlan.parse(
+                f"log={log};serving.admit:delay:0@every=1"
+            )
+        )
+        faults.inject("serving.admit", queue_depth=3)
+        faults.inject("serving.admit", queue_depth=4)
+        entries = faults.read_log(str(log))
+        assert len(entries) == 2
+        assert entries[0]["point"] == "serving.admit"
+        assert entries[0]["hit"] == 1 and entries[1]["hit"] == 2
+        assert entries[1]["ctx"]["queue_depth"] == "4"
+        assert entries[0]["pid"] == os.getpid()
+
+    def test_env_activation(self, tmp_path, monkeypatch):
+        log = tmp_path / "env.jsonl"
+        monkeypatch.setenv(
+            faults.PLAN_ENV, f"log={log};serving.admit:delay:0@once"
+        )
+        faults.reset()  # re-read env
+        assert faults.inject("serving.admit") == "delay"
+        assert len(faults.read_log(str(log))) == 1
+
+    def test_bad_env_plan_is_inert_not_fatal(self, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV, "not.a.point:error")
+        faults.reset()
+        assert faults.inject("serving.admit") is None
+
+
+class TestRpcRetryBackoff:
+    """Satellite: configurable deadline + jittered exponential backoff
+    replacing the hard-coded 30 s timeouts; retry exhaustion raises."""
+
+    def _client(self, retries=3):
+        from dlrover_tpu.rpc.client import MasterClient, MasterTransport
+
+        class FailingTransport(MasterTransport):
+            calls = 0
+
+            def get(self, payload):
+                FailingTransport.calls += 1
+                raise OSError("transport down")
+
+            report = get
+
+        client = MasterClient(
+            "127.0.0.1:1", node_id=0, service_type="grpc", retries=retries
+        )
+        client._transport = FailingTransport()
+        return client, FailingTransport
+
+    def test_retry_exhaustion_raises_connection_error(self, monkeypatch):
+        client, transport = self._client(retries=3)
+        sleeps = []
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        with pytest.raises(ConnectionError, match="after 3 tries"):
+            client.get({"x": 1})
+        assert transport.calls == 3
+        # backoff BETWEEN attempts only: 2 sleeps for 3 attempts
+        assert len(sleeps) == 2
+
+    def test_backoff_is_jittered_exponential(self):
+        client, _ = self._client()
+        base = client._backoff_base_s
+        for attempt in (1, 2, 3, 4):
+            full = min(client._backoff_cap_s, base * 2 ** (attempt - 1))
+            delays = {client._backoff_delay(attempt) for _ in range(64)}
+            assert all(full / 2 <= d <= full for d in delays)
+            assert len(delays) > 8  # actually jittered, not constant
+
+    def test_deadline_env_reaches_transports(self, monkeypatch):
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.rpc.client import GrpcTransport, HttpTransport
+
+        monkeypatch.setenv("DLROVER_RPC_DEADLINE_S", "7.5")
+        ctx = Context()
+        ctx.apply_env()
+        assert ctx.rpc_deadline_s == 7.5
+        g = GrpcTransport("127.0.0.1:1", deadline_s=ctx.rpc_deadline_s)
+        h = HttpTransport("127.0.0.1:1", deadline_s=ctx.rpc_deadline_s)
+        assert g._deadline_s == 7.5 and h._deadline_s == 7.5
+        g.close()
+
+    def test_injected_flake_converges_within_retries(self):
+        from dlrover_tpu.rpc.client import MasterClient, MasterTransport
+        from dlrover_tpu.common.serialize import dumps
+        from dlrover_tpu.common import comm
+
+        class OkTransport(MasterTransport):
+            def get(self, payload):
+                return dumps(comm.BaseResponse(success=True))
+
+            report = get
+
+        faults.activate(
+            faults.FaultPlan.parse("rpc.client.get:error:flake@at=1")
+        )
+        client = MasterClient(
+            "127.0.0.1:1", node_id=0, service_type="grpc", retries=3
+        )
+        client._transport = OkTransport()
+        client._backoff_base_s = 0.0  # no real sleeping in unit tests
+        resp = client.get({"q": 1})
+        assert isinstance(resp, comm.BaseResponse) and resp.success
+        assert [r["point"] for r in faults.records()] == ["rpc.client.get"]
+
+
+class TestRendezvousPollRejection:
+    """A master-side rejection (e.g. a servicer drop injection answers
+    with a bare error response instead of a world) must ride the
+    rendezvous retry path, not crash the agent on the missing .world."""
+
+    def test_rejected_world_poll_retries_then_converges(self):
+        from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
+        from dlrover_tpu.common import comm
+        from dlrover_tpu.common.constants import RendezvousName
+
+        class StubClient:
+            def __init__(self):
+                self.polls = 0
+
+            def join_rendezvous(self, **kw):
+                return 1
+
+            def get_comm_world(self, rdzv_name, node_rank):
+                self.polls += 1
+                if self.polls == 1:
+                    return comm.BaseResponse(success=False)
+                return comm.CommWorldResponse(
+                    rdzv_name=rdzv_name,
+                    round=1,
+                    world={0: comm.NodeMeta(node_id=0, node_rank=0)},
+                )
+
+        client = StubClient()
+        handler = MasterRendezvousHandler(
+            RendezvousName.NETWORK_CHECK,
+            node_rank=0,
+            client=client,
+            rdzv_timeout=10.0,
+            poll_interval=0.01,
+        )
+        world = handler.next_rendezvous()
+        assert client.polls == 2  # the rejection was retried, not fatal
+        assert world.world_size == 1 and world.rank == 0
+
+
+class TestSliceRelaunchWiring:
+    """node_unit > 1: one dead host replaces the whole slice (the ICI
+    domain is the unit of recovery), replacements are registered with a
+    stale-delete shield, and in-flight deletions of co-killed members
+    don't burn the fresh nodes."""
+
+    def _manager(self, n=4, node_unit=2):
+        from dlrover_tpu.master.node.dist_job_manager import (
+            DistributedJobManager,
+        )
+        from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+
+        class RecordingScaler(Scaler):
+            def __init__(self):
+                super().__init__("test")
+                self.plans = []
+
+            def scale(self, plan: ScalePlan) -> None:
+                self.plans.append(plan)
+
+        scaler = RecordingScaler()
+        m = DistributedJobManager(
+            num_workers=n, scaler=scaler, node_unit=node_unit
+        )
+        return m, scaler
+
+    @pytest.fixture(autouse=True)
+    def fresh_ctx(self):
+        from dlrover_tpu.master.job_context import JobContext
+
+        JobContext.reset()
+        yield
+        JobContext.reset()
+
+    def _fail_event(self, node_id):
+        from dlrover_tpu.common.constants import (
+            NodeEventType,
+            NodeExitReason,
+            NodeStatus,
+            NodeType,
+        )
+        from dlrover_tpu.common.node import Node, NodeEvent
+
+        node = Node(
+            node_type=NodeType.WORKER,
+            node_id=node_id,
+            rank_index=node_id,
+            status=NodeStatus.FAILED,
+        )
+        node.exit_reason = NodeExitReason.KILLED
+        return NodeEvent(event_type=NodeEventType.DELETED, node=node)
+
+    def test_start_assigns_slice_ids(self):
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.master.job_context import get_job_context
+
+        m, _ = self._manager(4, node_unit=2)
+        m.start()
+        m.stop()
+        nodes = get_job_context().get_nodes(NodeType.WORKER)
+        assert [nodes[i].slice_id for i in range(4)] == [0, 0, 1, 1]
+
+    def test_host_failure_relaunches_whole_slice(self):
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+        from dlrover_tpu.master.job_context import get_job_context
+
+        m, scaler = self._manager(4, node_unit=2)
+        m.start()
+        m.process_event(self._fail_event(2))
+        m.stop()
+        plan = scaler.plans[-1]
+        assert sorted(plan.remove_nodes) == [2, 3]
+        assert sorted(n.node_id for n in plan.launch_nodes) == [2, 3]
+        assert m.slice_relaunches == 1
+        ctx = get_job_context()
+        for nid in (2, 3):
+            node = ctx.get_node(NodeType.WORKER, nid)
+            assert node.status == NodeStatus.INITIAL
+            assert node.relaunch_count == 1
+            assert node.stale_delete_until > time.time()
+        # the untouched slice kept its nodes
+        for nid in (0, 1):
+            assert ctx.get_node(NodeType.WORKER, nid).relaunch_count == 0
+
+    def test_stale_deletion_of_co_killed_member_is_ignored(self):
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+        from dlrover_tpu.master.job_context import get_job_context
+
+        m, scaler = self._manager(4, node_unit=2)
+        m.start()
+        m.process_event(self._fail_event(2))  # slice relaunch of {2, 3}
+        plans_before = len(scaler.plans)
+        # node 3 died in the same SIGKILL; its DELETED event was still
+        # in the watcher pipeline when the replacements registered
+        m.process_event(self._fail_event(3))
+        m.stop()
+        assert len(scaler.plans) == plans_before  # no double relaunch
+        assert m.slice_relaunches == 1
+        node = get_job_context().get_node(NodeType.WORKER, 3)
+        assert node.status == NodeStatus.INITIAL  # fresh node unharmed
+        assert node.relaunch_count == 1
+        assert node.stale_delete_until == 0.0  # shield consumed
+
+    def test_relaunch_derives_slice_from_rank_not_stored_id(self):
+        """A job-context record with a stale slice_id (e.g. re-adopted
+        from a watcher-built event node, which defaults to 0) must not
+        mis-route the group relaunch: membership derives from the rank."""
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.master.job_context import get_job_context
+
+        m, scaler = self._manager(4, node_unit=2)
+        m.start()
+        ctx = get_job_context()
+        node = ctx.get_node(NodeType.WORKER, 3)
+        node.slice_id = 0  # stale: really slice 1 by rank
+        ctx.update_node(node)
+        m.process_event(self._fail_event(3))
+        m.stop()
+        plan = scaler.plans[-1]
+        assert sorted(plan.remove_nodes) == [2, 3]  # not [0, 1]
+        assert m.slice_relaunches == 1
+
+    def test_real_second_failure_still_relaunches(self):
+        """Once the replacement is RUNNING the shield is moot: a second
+        genuine failure goes through the normal slice relaunch."""
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+        from dlrover_tpu.master.job_context import get_job_context
+
+        m, scaler = self._manager(4, node_unit=2)
+        m.start()
+        m.process_event(self._fail_event(2))
+        ctx = get_job_context()
+        for nid in (2, 3):
+            node = ctx.get_node(NodeType.WORKER, nid)
+            node.update_status(NodeStatus.PENDING)
+            node.update_status(NodeStatus.RUNNING)
+            ctx.update_node(node)
+        m.process_event(self._fail_event(3))
+        m.stop()
+        assert m.slice_relaunches == 2
+        assert ctx.get_node(NodeType.WORKER, 3).relaunch_count == 2
+
+
+class TestAgentRequestedRelaunchHonored:
+    """Storm-observed stranding (fixed in this PR): an agent whose
+    worker exhausted its restart budget exits AGENT_EXIT_RELAUNCH —
+    explicitly asking for a replacement node — but used to report
+    exit_reason=fatal_error, the one reason the master never
+    relaunches; the watcher's rc>0→FATAL_ERROR guess then clobbered
+    any better report. The job silently ran one host short forever."""
+
+    @pytest.fixture(autouse=True)
+    def fresh_ctx(self):
+        from dlrover_tpu.master.job_context import JobContext
+
+        JobContext.reset()
+        yield
+        JobContext.reset()
+
+    def test_relaunch_requested_node_is_replaced(self):
+        from dlrover_tpu.common.constants import (
+            NodeEventType,
+            NodeExitReason,
+            NodeStatus,
+            NodeType,
+        )
+        from dlrover_tpu.common.node import Node, NodeEvent
+        from dlrover_tpu.master.node.dist_job_manager import (
+            DistributedJobManager,
+        )
+        from dlrover_tpu.master.scaler.base_scaler import ScalePlan, Scaler
+
+        class RecordingScaler(Scaler):
+            def __init__(self):
+                super().__init__("test")
+                self.plans = []
+
+            def scale(self, plan: ScalePlan) -> None:
+                self.plans.append(plan)
+
+        scaler = RecordingScaler()
+        m = DistributedJobManager(num_workers=2, scaler=scaler)
+        m.start()
+        # 1. the agent's own report arrives over RPC first
+        m.update_node_status(0, NodeType.WORKER, NodeStatus.RUNNING)
+        m.update_node_status(
+            0,
+            NodeType.WORKER,
+            NodeStatus.FAILED,
+            NodeExitReason.RELAUNCH_REQUESTED,
+        )
+        # 2. then the watcher sees the rc=1 exit and guesses FATAL_ERROR
+        before = len(scaler.plans)
+        dead = Node(
+            node_type=NodeType.WORKER,
+            node_id=0,
+            rank_index=0,
+            status=NodeStatus.FAILED,
+        )
+        dead.exit_reason = NodeExitReason.FATAL_ERROR  # watcher's guess
+        m.process_event(
+            NodeEvent(event_type=NodeEventType.DELETED, node=dead)
+        )
+        m.stop()
+        launch = [p for p in scaler.plans[before:] if p.launch_nodes]
+        assert launch, "agent-requested relaunch was not honored"
+        assert launch[0].launch_nodes[0].node_id == 0
+
+    def test_agent_reports_relaunch_requested_not_fatal(self):
+        import inspect
+
+        from dlrover_tpu.agent import training_agent
+
+        src = inspect.getsource(
+            training_agent.ElasticTrainingAgent._handle_worker_failure
+        )
+        assert "RELAUNCH_REQUESTED" in src
+        assert '"fatal_error"' not in src
+
+
+class TestDocsContract:
+    def test_every_injection_point_documented(self):
+        """Doc-lint (satellite): docs/chaos.md tables every registered
+        injection point — a wired-but-undocumented point is invisible
+        to operators writing plans."""
+        path = os.path.join(_REPO, "docs", "chaos.md")
+        assert os.path.exists(path), "docs/chaos.md missing"
+        text = open(path).read()
+        missing = [p for p in faults.INJECTION_POINTS if p not in text]
+        assert not missing, f"undocumented injection points: {missing}"
+
+    def test_chaos_doc_linked(self):
+        for rel in ("README.md", os.path.join("docs", "deploy.md")):
+            text = open(os.path.join(_REPO, rel)).read()
+            assert "chaos.md" in text, f"{rel} does not link docs/chaos.md"
+
+    def test_scenarios_registry_matches_cli(self):
+        from dlrover_tpu.chaos.scenarios import SCENARIOS
+
+        text = open(os.path.join(_REPO, "docs", "chaos.md")).read()
+        missing = [s for s in SCENARIOS if s not in text]
+        assert not missing, f"undocumented scenarios: {missing}"
+
+    def test_cli_plan_validation(self, capsys):
+        from dlrover_tpu.chaos.cli import main
+
+        assert main(["plan", "rpc.client.get:error@at=2"]) == 0
+        assert main(["plan", "bogus:error"]) == 2
+        assert main(["list"]) == 0
+        out = capsys.readouterr().out
+        assert "rpc.client.get" in out and "slice_kill" in out

@@ -77,6 +77,8 @@ SECTION_OF_ERROR = {
     "llama_family_error": "llama",
     "longseq_train_error": "longseq",
     "dense_error": "dense",
+    # storm/recovery_ab are NOT here on purpose: a ~minutes-long storm
+    # retry would blow the capture budget; their errors ride the line.
 }
 
 
@@ -257,6 +259,14 @@ _PRIORITY_KEYS = (
     # storm dict with stall forensics goes to the sidecar)
     "storm_goodput", "storm_mttr_s", "storm_slice_mttr_s",
     "storm_slice_goodput",
+    # MTTR phase breakdown + the warm-vs-cold A/B verdict
+    # (docs/recovery.md). Verdict = delta + warm compile only: the
+    # line has ~130 spare bytes and the per-leg scalars
+    # (recovery_{cold,warm}_mttr_s, recovery_cold_compile_s) are
+    # recoverable from the sidecar's full recovery_ab dict.
+    "storm_rdzv_s", "storm_restore_s", "storm_compile_s",
+    "storm_first_step_s",
+    "recovery_mttr_delta_s", "recovery_warm_compile_s",
     "last_silicon", "hang_diagnosis",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
@@ -2125,7 +2135,7 @@ def worker():
                     shutil.rmtree(storm_dir, ignore_errors=True)
                 if storm:
                     extra["goodput_storm"] = storm
-                    # Pointer-style SLO matrix: these four scalars must
+                    # Pointer-style SLO matrix: these scalars must
                     # survive the 1800-byte line budget (priority keys);
                     # the full storm dict (stall forensics) rides the
                     # sidecar under pressure.
@@ -2135,10 +2145,53 @@ def worker():
                     extra["storm_slice_goodput"] = storm.get(
                         "slice_goodput"
                     )
+                    # the MTTR phase breakdown: which serial phase of
+                    # recovery the time went to (docs/recovery.md)
+                    extra["storm_rdzv_s"] = storm.get("rdzv_s")
+                    extra["storm_restore_s"] = storm.get("restore_s")
+                    extra["storm_compile_s"] = storm.get("compile_s")
+                    extra["storm_first_step_s"] = storm.get("first_step_s")
                 else:
                     extra["goodput_storm_error"] = "harness timed out"
             except Exception as e:  # noqa: BLE001
                 extra["goodput_storm_error"] = repr(e)[:200]
+
+        # Warm-vs-cold recovery A/B (docs/recovery.md): two compressed
+        # storms at the IDENTICAL fault plan — the cold leg runs with
+        # the cache DISABLED (every incarnation pays the XLA compile
+        # inside the measured window), the warm leg with a prewarmed
+        # cache (recovery compiles are reads). Proves the warm-restart
+        # fast path as a measured MTTR delta (warm compile_s ≈ 0), not
+        # a code path. Opted in with the storm (same ~minutes cost
+        # class, same CPU-pinned control-plane trainers).
+        if os.environ.get("DLROVER_BENCH_STORM", "0") == "1" and want(
+            "recovery_ab"
+        ):
+            try:
+                from dlrover_tpu.chaos import run_recovery_ab
+
+                ab_dir = tempfile.mkdtemp(prefix="bench_recovery_ab_")
+                try:
+                    ab = run_recovery_ab(
+                        ab_dir, job_name=f"bench_rec_ab_{os.getpid()}"
+                    )
+                finally:
+                    shutil.rmtree(ab_dir, ignore_errors=True)
+                if ab:
+                    extra["recovery_ab"] = ab
+                    extra["recovery_cold_mttr_s"] = ab["cold"].get("mttr_s")
+                    extra["recovery_warm_mttr_s"] = ab["warm"].get("mttr_s")
+                    extra["recovery_mttr_delta_s"] = ab.get("mttr_delta_s")
+                    extra["recovery_cold_compile_s"] = ab.get(
+                        "cold_compile_s"
+                    )
+                    extra["recovery_warm_compile_s"] = ab.get(
+                        "warm_compile_s"
+                    )
+                else:
+                    extra["recovery_ab_error"] = "a leg timed out"
+            except Exception as e:  # noqa: BLE001
+                extra["recovery_ab_error"] = repr(e)[:200]
     except Exception as e:  # noqa: BLE001 — JSON line on every path
         extra["fatal_error"] = repr(e)[:500]
 

@@ -63,6 +63,15 @@ class ElasticLaunchConfig:
     # boundary (trainer/remesh.py) before falling back to a restart.
     soft_remesh: bool = True
     soft_remesh_timeout_s: float = 15.0
+    # Persistent XLA compile cache shared by every worker incarnation
+    # of this job (warm-restart fast path, docs/recovery.md). Empty =
+    # inherit DLROVER_COMPILE_CACHE_DIR from the environment (possibly
+    # unset → disabled).
+    compile_cache_dir: str = ""
+    # Double-buffered input pipeline in ElasticTrainLoop (default on;
+    # tpurun --sync-input turns it off for sources that must not see a
+    # draw ahead of the step that consumes it).
+    input_prefetch: bool = True
     extra_env: Dict[str, str] = field(default_factory=dict)
 
     def slice_id(self) -> int:
@@ -106,7 +115,14 @@ class ElasticLaunchConfig:
         env[NodeEnv.NODE_ID] = str(self.node_id)
         env[NodeEnv.NODE_RANK] = str(self.node_rank)
         env[NodeEnv.NODE_NUM] = str(self.max_nodes)
+        # NODE_NUM above is overwritten per rendezvous round with the
+        # live world size (_world_env); this one stays the job ceiling.
+        env[NodeEnv.MAX_NODES] = str(self.max_nodes)
         env[NodeEnv.NODE_UNIT] = str(self.node_unit)
         if self.auto_tunning:
             env[NodeEnv.AUTO_TUNNING] = "1"
+        if self.compile_cache_dir:
+            env["DLROVER_COMPILE_CACHE_DIR"] = self.compile_cache_dir
+        if not self.input_prefetch:
+            env["DLROVER_INPUT_PREFETCH"] = "0"
         return env

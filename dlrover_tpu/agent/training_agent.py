@@ -170,6 +170,12 @@ class ElasticTrainingAgent:
         if world is not None:
             self._world = world
         else:
+            # Overlapped restore: while the rendezvous below polls for
+            # the new world, the saver makes this host's shm restorable
+            # (refilling from the backup peer if the image is gone) so
+            # the worker's restore pays no peer fetch after the join.
+            AsyncCheckpointSaver.prefetch_restore_async()
+            t_rdzv = time.monotonic()
             with self._evt.duration(
                 "rendezvous", node_rank=self._config.node_rank
             ) as span:
@@ -181,6 +187,20 @@ class ElasticTrainingAgent:
                         "world_size": self._world.world_size,
                     }
                 )
+            # MTTR phase attribution: rdzv_s is the agent's phase of
+            # the recovery breakdown (attribution/recovery.py); the
+            # spool no-ops without DLROVER_RECOVERY_DIR.
+            from ..attribution.recovery import record_phase_file
+
+            record_phase_file(
+                "rdzv",
+                {
+                    "rdzv_s": round(time.monotonic() - t_rdzv, 3),
+                    "round": self._world.round,
+                    "restart": self._restart_count,
+                    "node_rank": self._config.node_rank,
+                },
+            )
         logger.info(
             "world ready: round=%s rank=%s/%s coordinator=%s",
             self._world.round,

@@ -34,6 +34,15 @@ def main() -> int:
             importlib.import_module(mod)
         except ImportError:
             pass
+    # Pre-apply the shared compile cache (safe: config stays mutable
+    # until backend init, which the spare never triggers) so even this
+    # knob's setup cost is paid before the handoff.
+    try:
+        from dlrover_tpu.common.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+    except Exception:  # noqa: BLE001 — an optimization only
+        pass
     # Tell the agent we are ready (it may wait to avoid racing a
     # half-imported spare into a rendezvous round). The marker is a
     # file because stdout is usually redirected into the worker log.

@@ -38,9 +38,19 @@ from .phases import (  # noqa: F401
     PhaseAccumulator,
     PhaseSplit,
 )
+from .recovery import (  # noqa: F401
+    RECOVERY_DIR_ENV,
+    aggregate as aggregate_recovery,
+    record_phase_file,
+)
+from .recovery import PHASES as RECOVERY_PHASES  # noqa: F401
 from .report import Report, build_report  # noqa: F401
 
 __all__ = [
+    "RECOVERY_DIR_ENV",
+    "RECOVERY_PHASES",
+    "aggregate_recovery",
+    "record_phase_file",
     "BUCKETS",
     "OpTable",
     "account_events",

@@ -8,9 +8,13 @@ plus the ``top_residual`` recommendation — no jax, no device.
     tpurun-attr RING.timeline                  # human table
     tpurun-attr RING.timeline --json           # machine-readable
     tpurun-attr RING.timeline --out report.json  # full Report artifact
+    tpurun-attr --recovery SPOOL_DIR           # MTTR phase breakdown
 
 The interned-name sidecar is auto-discovered at ``RING + '.names'``;
-override with ``--names``.
+override with ``--names``. ``--recovery`` points at a
+``DLROVER_RECOVERY_DIR`` spool (docs/recovery.md) and folds the
+per-recovery ``rdzv_s``/``restore_s``/``compile_s``/``first_step_s``
+means into the Report — alone or alongside a ring.
 """
 
 import argparse
@@ -19,6 +23,7 @@ import sys
 
 from ..profiler import timeline
 from .ops import account_events, format_table
+from .recovery import aggregate as aggregate_recovery
 from .report import build_report
 
 
@@ -27,7 +32,10 @@ def main(argv=None) -> int:
         prog="tpurun-attr",
         description="op-bucket device-time attribution from a trace ring",
     )
-    ap.add_argument("ring", help="ring file (TPUTL001 format)")
+    ap.add_argument(
+        "ring", nargs="?", default=None,
+        help="ring file (TPUTL001 format)",
+    )
     ap.add_argument(
         "--names", default=None,
         help="interned-name sidecar (default: RING + '.names')",
@@ -44,26 +52,49 @@ def main(argv=None) -> int:
         help="top-N op names in the --json output (the --out Report "
         "artifact is always written in full)",
     )
+    ap.add_argument(
+        "--recovery", default=None,
+        help="recovery spool directory (DLROVER_RECOVERY_DIR, "
+        "docs/recovery.md): fold the MTTR phase breakdown into the "
+        "report — alone or alongside a ring",
+    )
     ns = ap.parse_args(argv)
+    if ns.ring is None and ns.recovery is None:
+        ap.error("need a ring file and/or --recovery SPOOL_DIR")
 
-    try:
-        events = timeline.read_timeline(ns.ring)
-    except (OSError, ValueError) as e:
-        print(f"tpurun-attr: {e}", file=sys.stderr)
-        return 2
-    names = timeline.read_names(ns.names or ns.ring + ".names")
-    table = account_events(events, names)
+    table = None
+    events = []
+    if ns.ring is not None:
+        try:
+            events = timeline.read_timeline(ns.ring)
+        except (OSError, ValueError) as e:
+            print(f"tpurun-attr: {e}", file=sys.stderr)
+            return 2
+        names = timeline.read_names(ns.names or ns.ring + ".names")
+        table = account_events(events, names)
+    recovery = aggregate_recovery(ns.recovery) if ns.recovery else None
 
     if ns.out:
-        report = build_report(
-            op_table=table, meta={"ring": ns.ring, "events": len(events)}
-        )
+        meta = {"ring": ns.ring, "events": len(events)}
+        if ns.recovery:
+            meta["recovery_spool"] = ns.recovery
+        report = build_report(op_table=table, recovery=recovery, meta=meta)
         report.save(ns.out)
         print(f"wrote {ns.out}", file=sys.stderr)
     if ns.json:
-        print(json.dumps(table.to_dict(max_top_ops=ns.top)))
+        out = table.to_dict(max_top_ops=ns.top) if table else {}
+        if recovery:
+            out["recovery"] = recovery
+        print(json.dumps(out))
     else:
-        print(format_table(table))
+        parts = []
+        if table is not None:
+            parts.append(format_table(table))
+        if recovery is not None:
+            parts.append(
+                build_report(recovery=recovery).format()
+            )
+        print("\n\n".join(parts))
     return 0
 
 

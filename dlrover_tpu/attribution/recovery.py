@@ -1,0 +1,118 @@
+"""MTTR phase attribution: where does a recovery's time go?
+
+The chaos storm (and production) measure MTTR as one number — the
+watermark stall. This module splits it into the four serial phases of
+the recovery path so a regression (or a win, like the warm-restart
+fast path) is attributable per phase instead of inferred:
+
+- ``rdzv_s``       agent: rendezvous join → world formed (measured in
+                   ``ElasticTrainingAgent._initialize_workers``);
+- ``restore_s``    worker: ``load_consistent`` wall time (overlapped
+                   restore shrinks this — the host read ran during
+                   model build);
+- ``compile_s``    worker: first-step time minus steady-step time —
+                   the XLA (re)compile the persistent cache turns into
+                   a disk read;
+- ``first_step_s`` worker: the first full step after restore (compile
+                   + the step itself), the moment the watermark moves.
+
+Transport is a spool DIRECTORY (``DLROVER_RECOVERY_DIR``): each
+participant appends one small JSON file (unique name — no locking, no
+partial-read hazard beyond atomic rename), and the storm/bench
+aggregates the spool after the run. Files carry enough provenance
+(``restart``, ``round``, ``resumed``) for the aggregator to keep
+first-boot records out of the recovery means.
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+RECOVERY_DIR_ENV = "DLROVER_RECOVERY_DIR"
+
+PHASES = ("rdzv_s", "restore_s", "compile_s", "first_step_s")
+
+
+def recovery_dir() -> Optional[str]:
+    return os.environ.get(RECOVERY_DIR_ENV) or None
+
+
+def record_phase_file(kind: str, payload: Dict[str, Any]) -> Optional[str]:
+    """Append one record to the spool (no-op when the env is unset).
+    ``kind`` prefixes the filename (``rdzv`` / ``worker``). Atomic via
+    rename so a concurrently-aggregating storm never reads half a
+    record. Never raises — attribution must not take recovery down."""
+    root = recovery_dir()
+    if not root:
+        return None
+    try:
+        os.makedirs(root, exist_ok=True)
+        name = f"{kind}_{os.getpid()}_{time.time_ns()}.json"
+        tmp = os.path.join(root, "." + name)
+        path = os.path.join(root, name)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.rename(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def read_records(root: str) -> List[Dict[str, Any]]:
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(".json") or name.startswith("."):
+            continue
+        try:
+            with open(os.path.join(root, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rec["_kind"] = name.split("_", 1)[0]
+        out.append(rec)
+    return out
+
+
+def aggregate(root: str) -> Dict[str, Any]:
+    """Reduce the spool to the per-recovery breakdown.
+
+    Recovery records only: ``rdzv`` files from a re-rendezvous
+    (``round > 0`` — round 0 is first boot) and ``worker`` files whose
+    loop actually RESUMED from a checkpoint. Means per phase, plus
+    ``recovery_samples`` so a 0.0 from "no recoveries happened" is
+    distinguishable from a measured zero. The count is PER-HOST
+    records, not recovery events: one kill in an N-host job makes
+    every host re-rendezvous and resume, contributing N records to one
+    event (the per-host means remain the meaningful statistic).
+    """
+    records = read_records(root)
+    rdzv = [
+        float(r["rdzv_s"])
+        for r in records
+        if r["_kind"] == "rdzv"
+        and "rdzv_s" in r
+        and int(r.get("round", 0)) > 0
+    ]
+    workers = [
+        r
+        for r in records
+        if r["_kind"] == "worker" and r.get("resumed")
+    ]
+
+    def _mean(vals: List[float]) -> float:
+        return round(sum(vals) / len(vals), 3) if vals else 0.0
+
+    out: Dict[str, Any] = {
+        "rdzv_s": _mean(rdzv),
+        "recovery_samples": max(len(rdzv), len(workers)),
+    }
+    for key in ("restore_s", "compile_s", "first_step_s"):
+        out[key] = _mean(
+            [float(w[key]) for w in workers if key in w]
+        )
+    return out

@@ -22,6 +22,9 @@ SCHEMA = "dlrover_tpu.attribution.report/v1"
 class Report:
     op_table: Optional[Dict] = None  # OpTable.to_dict()
     serving: Optional[Dict] = None  # PhaseSplit.__dict__-shaped
+    # MTTR phase breakdown (recovery.aggregate() shape): rdzv_s /
+    # restore_s / compile_s / first_step_s + recovery_samples.
+    recovery: Optional[Dict] = None
     meta: Dict = field(default_factory=dict)
 
     def to_dict(self) -> Dict:
@@ -30,6 +33,7 @@ class Report:
             "meta": self.meta,
             "op_table": self.op_table,
             "serving": self.serving,
+            "recovery": self.recovery,
         }
 
     def to_json(self, indent: Optional[int] = 1) -> str:
@@ -42,6 +46,7 @@ class Report:
         return cls(
             op_table=d.get("op_table"),
             serving=d.get("serving"),
+            recovery=d.get("recovery"),
             meta=d.get("meta") or {},
         )
 
@@ -127,7 +132,20 @@ class Report:
             parts.append(format_table(self.op_table))
         if self.serving:
             parts.append(_format_serving(self.serving))
+        if self.recovery:
+            parts.append(_format_recovery(self.recovery))
         return "\n\n".join(parts) if parts else "(empty report)"
+
+
+def _format_recovery(rc: Dict) -> str:
+    n = int(rc.get("recovery_samples", 0) or 0)
+    lines = [
+        f"recovery breakdown over {n} per-host recovery records "
+        "(mean per phase):"
+    ]
+    for key in ("rdzv_s", "restore_s", "compile_s", "first_step_s"):
+        lines.append(f"  {key:14} {float(rc.get(key, 0.0) or 0.0):8.3f}s")
+    return "\n".join(lines)
 
 
 def _format_serving(sv: Dict) -> str:
@@ -161,12 +179,14 @@ def _format_serving(sv: Dict) -> str:
 def build_report(
     op_table: Optional[OpTable] = None,
     serving: Optional[PhaseSplit] = None,
+    recovery: Optional[Dict] = None,
     meta: Optional[Dict] = None,
 ) -> Report:
-    """Assemble a Report from live objects (either pillar optional)."""
+    """Assemble a Report from live objects (any pillar optional)."""
     return Report(
         op_table=op_table.to_dict() if op_table is not None else None,
         serving=dict(serving.__dict__) if serving is not None else None,
+        recovery=dict(recovery) if recovery else None,
         meta=dict(meta or {}),
     )
 

@@ -21,6 +21,7 @@ _LAZY = {
     "cleanup_namespaces": ("harness", "cleanup_namespaces"),
     "make_process_master": ("harness", "make_process_master"),
     "run_goodput_storm": ("goodput_storm", "run_goodput_storm"),
+    "run_recovery_ab": ("goodput_storm", "run_recovery_ab"),
     "SCENARIOS": ("scenarios", "SCENARIOS"),
     "run_scenario": ("scenarios", "run_scenario"),
 }

@@ -30,11 +30,13 @@ import os, time
 from dlrover_tpu.common.platform import force_virtual_cpu
 force_virtual_cpu(1)
 import jax
-# Same-host persistent compile cache: replacements of THIS run must
-# not pay the jit compile again (cross-machine reuse is the unsound
-# case; one tmpdir per storm run is single-machine by construction).
-jax.config.update("jax_compilation_cache_dir", os.environ["STORM_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+# Same-host persistent compile cache through the SHARED runtime knob
+# (common/compile_cache.py, DLROVER_COMPILE_CACHE_DIR in the storm
+# env): replacements of THIS run must not pay the jit compile again —
+# production, storm, and tests now ride one code path, and importing
+# any module has no config side effects.
+from dlrover_tpu.common.compile_cache import enable_compile_cache
+enable_compile_cache()
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,7 +50,8 @@ from dlrover_tpu.parallel.train_step import (
 if os.environ.get("STORM_PREWARM"):
     # Populate the shared XLA cache BEFORE the measured window starts:
     # a real job's one-time compile amortizes over days; a 5-minute
-    # storm must not charge it to goodput.
+    # storm must not charge it to goodput. (The warm-vs-cold A/B skips
+    # this leg on purpose — the cold leg measures exactly this cost.)
     cfg = GPTConfig.tiny()
     model = GPT(cfg)
     mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
@@ -75,24 +78,32 @@ ckpt_dir = os.path.join(os.environ["STORM_CKPT_DIR"], f"rank{rank}")
 os.makedirs(ckpt_dir, exist_ok=True)
 
 cfg = GPTConfig.tiny()
-model = GPT(cfg)
 mesh = build_mesh(MeshConfig(dp=-1), jax.devices()[:1])
+# Engine FIRST: its overlapped-restore prefetch reads the staged shm
+# image on a background thread while the lines below pay model init
+# and the train-step compile — the restore call then only places
+# already-host-side bytes onto the device.
+engine = CheckpointEngine(
+    ckpt_dir, mesh=mesh, host_rank=rank, num_hosts=1, replicate=False
+)
+model = GPT(cfg)
 tx = default_optimizer(learning_rate=1e-2, warmup_steps=2)
 tokens = jnp.zeros((2, cfg.max_seq_len), jnp.int32)
 state, shardings = init_train_state(model, tokens, mesh, tx)
 step_fn = build_train_step(model, tx, cross_entropy_loss, mesh, shardings)
 
-engine = CheckpointEngine(
-    ckpt_dir, mesh=mesh, host_rank=rank, num_hosts=1, replicate=False
-)
-
 r = np.random.default_rng(rank)
 def data():
+    # Host numpy on purpose: the loop's input prefetch pulls this
+    # generator on a background thread — batch prep belongs on the
+    # host there; the device transfer rides the jitted step on the
+    # main thread (a jax-dispatching producer would race the live
+    # compile).
     while True:
-        x = jnp.asarray(
-            r.integers(0, cfg.vocab_size, (2, cfg.max_seq_len)), jnp.int32
-        )
-        yield x, jnp.roll(x, -1, axis=1)
+        x = r.integers(
+            0, cfg.vocab_size, (2, cfg.max_seq_len)
+        ).astype(np.int32)
+        yield x, np.roll(x, -1, axis=1)
 
 # step_sleep stands in for the real step's device time so the control
 # plane is measured at a realistic step cadence, not at toy speed.
@@ -133,41 +144,65 @@ def run_goodput_storm(
     node_unit: int = 1,
     slice_kills: int = 0,
     extra_env: Optional[Dict[str, str]] = None,
+    prewarm: bool = True,
+    cache_dir: Optional[str] = None,
+    max_relaunch: Optional[int] = None,
 ) -> Optional[Dict[str, float]]:
     """Run the storm; returns the measured outcome or None on timeout.
 
     Result keys: ``goodput`` (PerfMonitor's number), ``steps`` (global
     watermark reached), ``kills``, ``elapsed_s``, ``steps_per_second``,
-    ``mttr_s`` (host-kill recovery). With ``slice_kills`` > 0 the
-    recovery-SLO matrix gains the slice class: ``slice_mttr_s``,
-    ``slice_goodput`` (productive fraction of the slice-kill window),
-    and ``slice_relaunches`` (how many times the master's slice-aligned
+    ``mttr_s`` (host-kill recovery), plus the per-recovery MTTR phase
+    breakdown (``rdzv_s`` / ``restore_s`` / ``compile_s`` /
+    ``first_step_s``, means over ``recovery_samples`` recoveries —
+    docs/recovery.md). With ``slice_kills`` > 0 the recovery-SLO matrix
+    gains the slice class: ``slice_mttr_s``, ``slice_goodput``
+    (productive fraction of the slice-kill window), and
+    ``slice_relaunches`` (how many times the master's slice-aligned
     group relaunch actually ran).
+
+    ``cache_dir`` controls the persistent compile cache: None (default)
+    uses a per-run directory under ``workdir`` — every replacement of
+    this run reuses its first boot's compiles; ``""`` DISABLES the
+    cache entirely (the cold leg of :func:`run_recovery_ab` — every
+    incarnation, replacements included, pays the full XLA compile
+    inside the measured window).
+
+    ``max_relaunch`` overrides both the agent worker-restart budget and
+    the master's node-relaunch budget for this run (None keeps the
+    defaults). A measuring run — the A/B above all — must not be
+    aborted by budget exhaustion when the environment (not the fault
+    plan) crash-loops workers; the kills stay identical either way.
     """
     os.makedirs(workdir, exist_ok=True)
-    cache_dir = os.path.join(workdir, "xla_cache")
+    if cache_dir is None:
+        cache_dir = os.path.join(workdir, "xla_cache")
     ckpt_dir = os.path.join(workdir, "ckpt")
-    os.makedirs(cache_dir, exist_ok=True)
+    recovery_dir = os.path.join(workdir, "recovery")
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
     os.makedirs(ckpt_dir, exist_ok=True)
+    os.makedirs(recovery_dir, exist_ok=True)
     script = os.path.join(workdir, "storm_trainer.py")
     with open(script, "w") as f:
         f.write(_TRAINER_TEMPLATE)
 
-    # Prewarm the shared compile cache outside the measured window.
-    import subprocess
+    if prewarm and cache_dir:
+        # Prewarm the shared compile cache outside the measured window.
+        import subprocess
 
-    prewarm_env = dict(
-        os.environ,
-        STORM_PREWARM="1",
-        STORM_CACHE_DIR=cache_dir,
-        PYTHONPATH=os.pathsep.join(sys.path),
-    )
-    subprocess.run(
-        [sys.executable, script],
-        env=prewarm_env,
-        timeout=120,
-        capture_output=True,
-    )
+        prewarm_env = dict(
+            os.environ,
+            STORM_PREWARM="1",
+            DLROVER_COMPILE_CACHE_DIR=cache_dir,
+            PYTHONPATH=os.pathsep.join(sys.path),
+        )
+        subprocess.run(
+            [sys.executable, script],
+            env=prewarm_env,
+            timeout=120,
+            capture_output=True,
+        )
 
     from .harness import make_process_master
 
@@ -177,7 +212,9 @@ def run_goodput_storm(
         first_kill_step + kills_total * kill_interval_steps + settle_steps
     )
     env = {
-        "STORM_CACHE_DIR": cache_dir,
+        # MTTR phase spool (attribution/recovery.py): agents record
+        # rdzv_s, trainers record restore/compile/first-step
+        "DLROVER_RECOVERY_DIR": recovery_dir,
         "STORM_CKPT_DIR": ckpt_dir,
         "STORM_STEP_SLEEP": str(step_sleep),
         "STORM_STORAGE_EVERY": str(storage_every),
@@ -186,6 +223,11 @@ def run_goodput_storm(
         "DLROVER_LOCAL_DEVICES": "1",
         "PYTHONPATH": os.pathsep.join(sys.path),
     }
+    # The shared runtime knob (common/compile_cache.py): agents inherit
+    # it and export it to every trainer incarnation. Explicitly "" when
+    # disabled, so a cache dir in the CALLER's environment (bench) can
+    # never leak into a cold leg.
+    env["DLROVER_COMPILE_CACHE_DIR"] = cache_dir or ""
     env.update(extra_env or {})
     master, scaler, watcher = make_process_master(
         job_name,
@@ -198,7 +240,7 @@ def run_goodput_storm(
             "--node_unit",
             str(node_unit),
             "--max_restarts",
-            "3",
+            str(max_relaunch if max_relaunch is not None else 3),
             "--monitor_interval",
             str(monitor_interval_s),
             script,
@@ -220,6 +262,17 @@ def run_goodput_storm(
     first_slice_kill_t = 0.0
     kill_times = []  # [{"t": wall clock, "kind": "host"|"slice"}]
     num_slices = max(1, num_workers // node_unit)
+    # The master consumes the relaunch budget from the process-wide
+    # Context each time it registers a node — replacements included, so
+    # the override must hold for the whole run. Mutated immediately
+    # before the try so the restoring finally can never be skipped and
+    # leak the override into later in-process masters.
+    from ..common.config import get_context
+
+    ctx = get_context()
+    prev_max_relaunch = ctx.max_relaunch_count
+    if max_relaunch is not None:
+        ctx.max_relaunch_count = max_relaunch
     try:
         master.prepare()
         master.run_in_background()
@@ -318,7 +371,9 @@ def run_goodput_storm(
                     "steps_per_second": round(
                         master.perf_monitor.steps_per_second(), 3
                     ),
-                    "first_step_s": round(first_step_at - t0, 1),
+                    # storm-start → first global step (boot/provision);
+                    # NOT the per-recovery first_step_s phase below
+                    "boot_s": round(first_step_at - t0, 1),
                     "mttr_s": round(
                         sum(host_stalls) / len(host_stalls), 1
                     )
@@ -326,6 +381,12 @@ def run_goodput_storm(
                     else 0.0,
                     "stalls": stalls[:20],
                 }
+                # MTTR phase breakdown: means over the run's actual
+                # recoveries (re-rendezvous rounds + resumed workers),
+                # so a goodput/MTTR miss says WHICH phase regressed.
+                from ..attribution.recovery import aggregate
+
+                result.update(aggregate(recovery_dir))
                 if slice_kills:
                     window = (
                         end_t - first_slice_kill_t
@@ -361,10 +422,89 @@ def run_goodput_storm(
         )
         return None
     finally:
+        ctx.max_relaunch_count = prev_max_relaunch
         try:
             master.stop()
         finally:
             scaler.stop()
+
+
+# Compressed storm shape for the warm-vs-cold A/B: ONE worker, one
+# kill, short window — each leg is ~1 min. One worker makes the
+# watermark stall EQUAL the recovery time (a survivor can't keep it
+# moving), so mttr_s is the per-recovery number the legs compare.
+_AB_STORM = dict(
+    num_workers=1,
+    kills=1,
+    kill_interval_steps=10,
+    settle_steps=15,
+    first_kill_step=6,
+    step_sleep=0.2,
+    # the smoke-proven persist cadence: persisting every ~0.4 s
+    # (storage_every=2) thrashes the staging thread against the live
+    # step hard enough to destabilize CPU-jaxlib trainers
+    storage_every=5,
+    timeout_s=300.0,
+    # generous budget: a leg must survive environment-induced worker
+    # crashes (observed: GC segfaults on some CPU-jaxlib containers
+    # with the persistent cache active) and still finish its plan —
+    # the measured kills are identical across legs regardless
+    max_relaunch=12,
+)
+
+
+def run_recovery_ab(
+    workdir: str, **overrides
+) -> Optional[Dict[str, object]]:
+    """Warm-vs-cold recovery A/B at EQUAL fault plans (docs/recovery.md).
+
+    Two compressed storms, identical kills, differing ONLY in the
+    compile-cache knob:
+
+    - **cold**: persistent cache DISABLED — the replacement pays the
+      full XLA recompile inside its measured recovery (the pre-PR
+      recovery path);
+    - **warm**: cache enabled and prewarmed outside the measured
+      window — the replacement's "compile" is a cache read.
+
+    (The cold leg can't just share an empty cache dir: its own first
+    boot would populate it and hand the replacement a warm cache,
+    erasing the thing being measured.)
+
+    Returns ``{"cold": ..., "warm": ..., "mttr_delta_s",
+    "cold_compile_s", "warm_compile_s"}`` or None when either leg
+    timed out. The warm leg's ``compile_s ≈ 0`` (and strictly lower
+    MTTR) is the acceptance number for the warm-restart fast path.
+    """
+    os.makedirs(workdir, exist_ok=True)
+    params = dict(_AB_STORM)
+    params.update(overrides)
+    job = params.pop("job_name", f"recovery_ab_{os.getpid()}")
+    cold = run_goodput_storm(
+        os.path.join(workdir, "cold"),
+        prewarm=False,
+        cache_dir="",  # disabled: recoveries recompile from scratch
+        job_name=f"{job}_cold",
+        **params,
+    )
+    if cold is None:
+        return None
+    warm = run_goodput_storm(
+        os.path.join(workdir, "warm"),
+        prewarm=True,
+        cache_dir=os.path.join(workdir, "warm_xla_cache"),
+        job_name=f"{job}_warm",
+        **params,
+    )
+    if warm is None:
+        return None
+    return {
+        "cold": cold,
+        "warm": warm,
+        "mttr_delta_s": round(cold["mttr_s"] - warm["mttr_s"], 1),
+        "cold_compile_s": cold.get("compile_s", 0.0),
+        "warm_compile_s": warm.get("compile_s", 0.0),
+    }
 
 
 def main(argv=None) -> int:
@@ -374,6 +514,18 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(description="goodput preemption storm")
     parser.add_argument("--workdir", default="")
+    parser.add_argument(
+        "--ab",
+        action="store_true",
+        help="run the warm-vs-cold recovery A/B (two compressed storms "
+        "at the identical fault plan: cache disabled vs prewarmed) "
+        "instead of a single storm",
+    )
+    parser.add_argument(
+        "--no-prewarm",
+        action="store_true",
+        help="skip the compile-cache prewarm (measure the cold path)",
+    )
     # None = defer to run_goodput_storm's tuned defaults
     parser.add_argument("--kills", type=int, default=None)
     parser.add_argument("--kill-interval", type=int, default=None)
@@ -395,7 +547,12 @@ def main(argv=None) -> int:
         }.items()
         if v is not None
     }
-    result = run_goodput_storm(workdir, **overrides)
+    if ns.ab:
+        result = run_recovery_ab(workdir, **overrides)
+    else:
+        if ns.no_prewarm:
+            overrides["prewarm"] = False
+        result = run_goodput_storm(workdir, **overrides)
     print(json.dumps(result))
     return 0 if result else 1
 

@@ -98,6 +98,7 @@ class CheckpointEngine:
         replicate: Optional[bool] = None,
         replica_peers: Optional[Dict[int, str]] = None,
         saver_timeout_s: Optional[float] = None,
+        prefetch_restore: Optional[bool] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.mesh = mesh
@@ -166,6 +167,29 @@ class CheckpointEngine:
         # attempt fails RESOURCE_EXHAUSTED and all later block=False
         # saves transparently degrade to the blocking path.
         self._async_disabled = False
+        # Overlapped restore (warm-restart fast path, docs/recovery.md):
+        # the host-side half of the restore — shm attach + copy-out, or
+        # the peer replica fetch when this host's shm is empty
+        # (replica-first ordering for a replaced node) — starts NOW, in
+        # the background, so it overlaps whatever runs between engine
+        # construction and load()/load_consistent() (model build, train
+        # step compile, the restore-source agreement's allgather). The
+        # restore call then pays only the fused host→device put.
+        self._prefetched: Optional[Tuple[Any, Dict[str, np.ndarray]]] = None
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._prefetch_invalid = False
+        self.prefetch_used = False  # last restore consumed the prefetch
+        if prefetch_restore is None:
+            from ..common.config import get_context
+
+            prefetch_restore = get_context().ckpt_prefetch_restore
+        if prefetch_restore:
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_restore_host,
+                name="ckpt-restore-prefetch",
+                daemon=True,
+            )
+            self._prefetch_thread.start()
 
     def _factory_msg(self) -> Dict:
         return {
@@ -220,6 +244,85 @@ class CheckpointEngine:
         self._event_q = SharedQueue(EVENT_QUEUE)
         self._factory_q.put(self._factory_msg())
         self._shard_lock = self._wait_lock(self._saver_timeout_s)
+
+    # -- overlapped restore ------------------------------------------------
+
+    def _read_staged_host(
+        self, timeout: float = 60.0
+    ) -> Optional[Tuple[Any, Dict[str, np.ndarray]]]:
+        """(meta, arrays) copied out of shm under the shard lock, or
+        None when there is no readable image."""
+        if not self._shard_lock.acquire(blocking=True, timeout=timeout):
+            return None
+        try:
+            if not self.shm.attach():
+                return None
+            return self.shm.load_pytree_host(copy=True)
+        finally:
+            self._shard_lock.release()
+
+    def _prefetch_restore_host(self) -> None:
+        """Background half of the overlapped restore: read this host's
+        staged image out of shm — or, when shm is empty, pull the
+        replica of this host's shard from its backup peer FIRST (the
+        replaced-node case, where the peer fetch is the expensive part)
+        — so the foreground restore call finds the host bytes ready."""
+        try:
+            got = self._read_staged_host(timeout=30.0)
+            # A save (or close) sets _prefetch_invalid to CANCEL this
+            # thread: never start the peer fetch afterwards — a late
+            # refill would overwrite shm with a replica OLDER than the
+            # step the save is about to stage.
+            if (
+                got is None
+                and not self._prefetch_invalid
+                and self._replicate
+                and self._refill_from_peer()
+                and not self._prefetch_invalid
+            ):
+                got = self._read_staged_host(timeout=30.0)
+            self._prefetched = got
+        except Exception as e:  # noqa: BLE001 — an optimization only
+            logger.warning("restore prefetch failed: %s", e)
+
+    def _restore_from_prefetch(
+        self, template: Any, pre: Optional[Tuple[Any, Dict[str, np.ndarray]]]
+    ) -> Optional[Tuple[int, Any]]:
+        """Place a consumed prefetch onto the device — the one restore
+        path shared by load() and load_consistent(). None when there is
+        no prefetch or the image does not fit ``template`` (callers
+        fall through to the locked re-read)."""
+        if pre is None:
+            return None
+        meta, arrays = pre
+        try:
+            restored = _restore_into_template(template, arrays)
+        except (KeyError, ValueError) as e:
+            logger.warning("prefetched image unusable (%s); re-reading", e)
+            return None
+        self.prefetch_used = True
+        logger.info("restored step %s from prefetched host read", meta.step)
+        return meta.step, restored
+
+    def _consume_prefetch(
+        self,
+    ) -> Optional[Tuple[Any, Dict[str, np.ndarray]]]:
+        """Join the prefetch and hand over its result — None when it is
+        disabled, still running, empty, or invalidated by a save that
+        restaged the segment after the prefetch read it."""
+        t = self._prefetch_thread
+        if t is not None:
+            t.join(60.0)
+            if t.is_alive():
+                logger.warning(
+                    "restore prefetch still running; ignoring its result"
+                )
+                self._prefetch_invalid = True
+            self._prefetch_thread = None
+        got, self._prefetched = self._prefetched, None
+        if self._prefetch_invalid or got is None:
+            return None
+        return got
 
     # -- save --------------------------------------------------------------
 
@@ -276,6 +379,20 @@ class CheckpointEngine:
         # window; an error must surface to the loop (which re-saves
         # blocking or skips the step), never wedge the shard lock.
         faults.inject("ckpt.engine.save", step=step)
+        # Any save supersedes the restore prefetch: a later consume of
+        # the pre-save image would silently restore an older step.
+        # Invalid FIRST — it doubles as the cancel signal, so a thread
+        # that has not yet started its peer fetch skips it instead of
+        # stalling this save (a saving host's state is newer than any
+        # replica of it). Then wait the remainder out: the prefetch
+        # briefly holds the shard lock and the non-blocking acquire
+        # below must not misread the init-time read as "persister busy"
+        # and skip the step.
+        self._prefetch_invalid = True
+        self._prefetched = None
+        pt = self._prefetch_thread
+        if pt is not None and pt.is_alive():
+            pt.join(30.0)
         staging = self._stage_thread is not None and self._stage_thread.is_alive()
         if staging:
             logger.warning(
@@ -548,6 +665,10 @@ class CheckpointEngine:
         # image.
         self._drain_stage_for_read()
         with self._events.ckpt_load():
+            pre = self._consume_prefetch()
+            result = self._restore_from_prefetch(template, pre)
+            if result is not None:
+                return result
             result = self._load_from_memory(template)
             if result is not None:
                 return result
@@ -583,28 +704,11 @@ class CheckpointEngine:
             manager.stop()
             return False
         try:
-            fetched = manager.fetch_own_shard(self.shm.write_image_stream)
-            if not fetched:
-                return False
             # Staleness check BEFORE the expensive host->device restore:
             # a replica can lag behind storage (push failures are
             # log-and-drop), and restoring a multi-GB pytree only to
             # throw it away wastes minutes on the recovery path.
-            meta = self.shm.read_meta()
-            storage_step = self.storage.latest_step()
-            storage_step = -1 if storage_step is None else storage_step
-            if meta is not None and storage_step > meta.step:
-                logger.info(
-                    "peer replica holds step %s but storage has %s; "
-                    "preferring storage",
-                    meta.step,
-                    storage_step,
-                )
-                # Drop the stale image: a later breakpoint save would
-                # otherwise persist it and regress the tracker.
-                self.shm.invalidate()
-                return False
-            return meta is not None
+            return manager.refill_shm(self.shm, self.storage) == "refilled"
         finally:
             self._shard_lock.release()
             manager.stop()
@@ -743,9 +847,16 @@ class CheckpointEngine:
         """
         faults.inject("ckpt.engine.load", host_rank=self.host_rank)
         self._drain_stage_for_read()
-        meta = self.shm.read_meta() if self.shm.attach() else None
-        if meta is None and self._refill_from_peer():
-            meta = self.shm.read_meta()
+        # Prefetched host read first: it already did shm attach (and
+        # the peer refill for a replaced node) in the background, so
+        # the agreement below runs on bytes that are ALREADY host-side.
+        pre = self._consume_prefetch()
+        if pre is not None:
+            meta = pre[0]
+        else:
+            meta = self.shm.read_meta() if self.shm.attach() else None
+            if meta is None and self._refill_from_peer():
+                meta = self.shm.read_meta()
         mem_step = -1 if meta is None else meta.step
         storage_latest = self.storage.latest_step()
         st_step = -1 if storage_latest is None else storage_latest
@@ -753,6 +864,14 @@ class CheckpointEngine:
             mem_step, st_step, self.storage.list_steps()
         )
         if mem_steps[0] >= 0 and len(set(mem_steps)) == 1:
+            # only a prefetch of the AGREED step may serve the restore;
+            # on an unusable image, fall through to the locked re-read —
+            # the multi-process unreadable case is handled below exactly
+            # as without prefetch
+            if pre is not None and pre[0].step == mem_steps[0]:
+                result = self._restore_from_prefetch(template, pre)
+                if result is not None:
+                    return result
             result = self._load_from_memory(template)
             if result is not None:
                 return result
@@ -803,6 +922,12 @@ class CheckpointEngine:
         also tear down the in-process saver (thread + servers), so a
         re-meshed world can build a fresh engine without leaking one
         saver stack per topology round."""
+        self._prefetch_invalid = True  # cancel: skip a not-yet-started fetch
+        pt = self._prefetch_thread
+        if pt is not None and pt.is_alive():
+            pt.join(30.0)
+        self._prefetch_thread = None
+        self._prefetched = None
         t = self._stage_thread
         if t is not None and t.is_alive():
             t.join(60.0)

@@ -451,6 +451,31 @@ class ReplicaManager:
             )
         return ok
 
+    def refill_shm(self, shm, storage) -> str:
+        """Fetch this host's replicated shard into ``shm`` with the
+        storage-staleness guard — the ONE refill rule shared by the
+        engine-side restore fallback and the agent-side overlapped
+        prefetch (callers hold their own shard lock). Returns
+        ``empty`` (no replica / unreadable) | ``stale`` (replica lags
+        committed storage; image dropped so a later breakpoint save
+        cannot persist it and regress the tracker) | ``refilled``."""
+        if not self.fetch_own_shard(shm.write_image_stream):
+            return "empty"
+        meta = shm.read_meta()
+        if meta is None:
+            return "empty"
+        storage_step = storage.latest_step()
+        if storage_step is not None and storage_step > meta.step:
+            logger.info(
+                "peer replica holds step %s but storage has %s; "
+                "preferring storage",
+                meta.step,
+                storage_step,
+            )
+            shm.invalidate()
+            return "stale"
+        return "refilled"
+
     def fetch_own_shard(
         self, sink: Callable[[int, Callable[[int], bytes]], None]
     ) -> bool:

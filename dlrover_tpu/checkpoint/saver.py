@@ -471,6 +471,52 @@ class AsyncCheckpointSaver:
                 if after is not None and after.step == before:
                     break  # clean push
 
+    def prefetch_restore(self) -> str:
+        """Warm-restart fast path, agent side: make this host's shm
+        restorable BEFORE the worker boots — called while the agent's
+        rendezvous is still polling for the new world (overlapped
+        restore). With an image already staged this is a no-op; an
+        empty shm (the previous trainer never staged, or the segment
+        was torn down) pulls the replica of this host's shard from its
+        backup peer, with the same storage-staleness guard as the
+        engine-side refill. Returns the outcome for logging/tests:
+        ``staged`` | ``refilled`` | ``stale`` | ``empty`` |
+        ``unavailable``."""
+        if self.shm.read_meta() is not None:
+            return "staged"
+        if self.replica_manager is None:
+            return "unavailable"
+        with self._shard_lock:
+            if self.shm.read_meta() is not None:
+                return "staged"
+            # shared refill rule (ReplicaManager.refill_shm): on
+            # "stale" the image is dropped and the worker's normal
+            # chain picks storage
+            return self.replica_manager.refill_shm(self.shm, self.storage)
+
+    @classmethod
+    def prefetch_restore_async(cls) -> Optional[threading.Thread]:
+        """Kick :meth:`prefetch_restore` on a background thread (the
+        agent calls this right before ``next_rendezvous`` so the peer
+        fetch rides under the rendezvous poll). None when no saver
+        instance exists yet — a first-boot agent has nothing to
+        prefetch; the worker engine's own prefetch covers that case."""
+        inst = cls._instance
+        if inst is None:
+            return None
+
+        def run() -> None:
+            try:
+                logger.info(
+                    "agent restore prefetch: %s", inst.prefetch_restore()
+                )
+            except Exception:  # noqa: BLE001 — an optimization only
+                logger.exception("agent restore prefetch failed")
+
+        t = threading.Thread(target=run, name="restore-prefetch", daemon=True)
+        t.start()
+        return t
+
     def save_shm_to_storage(self) -> bool:
         """Breakpoint save: persist whatever step is staged in shm
         (reference :758, called from the agent when workers fail)."""

@@ -1,0 +1,80 @@
+"""Persistent XLA compilation-cache: the shared runtime knob.
+
+Recovery is compile-dominated once restore is overlapped: a restarted
+(or re-meshed) worker re-traces and re-compiles the train step before
+its first step runs, and on real models that is tens of seconds of
+pure MTTR. XLA's persistent compilation cache turns that into a disk
+read — but only if every process of the job points at the SAME cache
+directory with the SAME thresholds. Before this module each consumer
+wired its own (``goodput_storm`` set a private ``STORM_CACHE_DIR`` at
+trainer-template import time); now there is one Context/env-driven
+knob that the agent exports to every worker, the warm spare pre-applies
+during its idle imports, and the chaos storm shares with production.
+
+Knobs (Context fields, ``DLROVER_*`` env overridable):
+
+- ``compile_cache_dir`` — cache directory; empty disables the cache.
+- ``compile_cache_min_compile_s`` — only compilations at least this
+  expensive are persisted (kernel-sized entries would bloat the cache
+  for no MTTR win).
+
+Same-machine/same-topology reuse is the sound case (one directory per
+job; the fingerprint covers the computation + compile options, so a
+stale entry can mislead only across incompatible XLA versions, which
+the cache itself guards). Calling :func:`enable_compile_cache` is
+idempotent and must happen before the first compilation it should
+serve — jax config stays mutable until then.
+"""
+
+import os
+import threading
+from typing import Optional
+
+from .log import logger
+
+_lock = threading.Lock()
+_applied_dir: Optional[str] = None
+
+
+def enable_compile_cache(
+    cache_dir: Optional[str] = None,
+    min_compile_s: Optional[float] = None,
+) -> Optional[str]:
+    """Point jax's persistent compilation cache at the job's shared
+    directory. Resolution order: explicit arg → Context (env-applied
+    ``DLROVER_COMPILE_CACHE_DIR``). Returns the directory in effect, or
+    None when the knob is unset (cache disabled). Idempotent; never
+    raises — a broken cache dir must not take training down with it.
+    """
+    global _applied_dir
+    from .config import get_context
+
+    ctx = get_context()
+    cache_dir = cache_dir if cache_dir is not None else ctx.compile_cache_dir
+    if not cache_dir:
+        return None
+    if min_compile_s is None:
+        min_compile_s = ctx.compile_cache_min_compile_s
+    with _lock:
+        if _applied_dir == cache_dir:
+            return cache_dir
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(min_compile_s),
+            )
+            _applied_dir = cache_dir
+            logger.info("persistent compile cache: %s", cache_dir)
+            return cache_dir
+        except Exception as e:  # noqa: BLE001 — an optimization only
+            logger.warning("compile cache unavailable (%s): %s", cache_dir, e)
+            return None
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory :func:`enable_compile_cache` applied, or None."""
+    return _applied_dir

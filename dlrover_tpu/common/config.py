@@ -57,6 +57,23 @@ class Context:
     # committed steps kept on storage (0 = unlimited); pruned by the
     # saver after each successful commit
     ckpt_keep_latest: int = 3
+    # Warm-restart fast path (docs/recovery.md): engine starts the
+    # host-side restore read (shm attach / peer replica fetch) in the
+    # background at construction, so it overlaps model build + compile
+    # instead of serializing after them.
+    ckpt_prefetch_restore: bool = True
+
+    # Persistent XLA compilation cache shared by every process of the
+    # job (common/compile_cache.py); empty disables it. Recompiles
+    # after a worker restart / re-mesh become cache reads.
+    compile_cache_dir: str = ""
+    compile_cache_min_compile_s: float = 1.0
+
+    # Input pipeline: the train loop keeps one batch in flight on a
+    # background thread (trainer/dataloader.py PrefetchIterator);
+    # disable for strictly-replayable finite datasets that must not
+    # consume a batch ahead of the step that uses it.
+    input_prefetch: bool = True
 
     # Pre-check
     precheck_enabled: bool = True

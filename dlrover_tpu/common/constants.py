@@ -133,6 +133,11 @@ class NodeEnv:
     NODE_ID = "DLROVER_NODE_ID"
     NODE_RANK = "DLROVER_NODE_RANK"
     NODE_NUM = "DLROVER_NODE_NUM"
+    # Static job maximum (ElasticLaunchConfig.max_nodes). NODE_NUM is
+    # clobbered per rendezvous round with the CURRENT world size by the
+    # agent's dynamic env; consumers that need the job's ceiling (the
+    # compile-ahead shrink ladder) must read this one.
+    MAX_NODES = "DLROVER_MAX_NODES"
     NODE_UNIT = "DLROVER_NODE_UNIT"
     # JAX distributed bootstrap (filled in by the rendezvous handler).
     COORDINATOR_ADDRESS = "DLROVER_COORDINATOR_ADDRESS"

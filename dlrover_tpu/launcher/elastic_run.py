@@ -144,6 +144,23 @@ def parse_args(args: Optional[List[str]] = None) -> argparse.Namespace:
         default=0,
         help="base port for the jax.distributed coordinator (0 = free port)",
     )
+    parser.add_argument(
+        "--compile-cache-dir",
+        default="",
+        dest="compile_cache_dir",
+        help="persistent XLA compile cache shared by every worker "
+        "incarnation (warm-restart fast path; also settable via "
+        "DLROVER_COMPILE_CACHE_DIR). Empty disables it.",
+    )
+    parser.add_argument(
+        "--sync-input",
+        action="store_true",
+        dest="sync_input",
+        help="disable the train loop's double-buffered input prefetch "
+        "(exports DLROVER_INPUT_PREFETCH=0): the loop then draws each "
+        "batch synchronously, for sources that must not observe a draw "
+        "ahead of the step that consumes it",
+    )
     parser.add_argument("--log_dir", default=None, help="worker log directory")
     parser.add_argument(
         "-m",
@@ -200,6 +217,9 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         numa_affinity=ns.numa_affinity,
         profile=ns.profile,
         monitor_interval=ns.monitor_interval,
+        compile_cache_dir=ns.compile_cache_dir
+        or os.environ.get("DLROVER_COMPILE_CACHE_DIR", ""),
+        input_prefetch=not ns.sync_input,
     )
     config.auto_configure_params()
     return config

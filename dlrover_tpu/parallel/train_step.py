@@ -278,6 +278,16 @@ def build_train_step(
         with mesh, apply_rules(rules), current_mesh(mesh):
             return jitted(state, inputs, targets)
 
+    def lower(state, inputs, targets):
+        # AOT path (trainer/precompile.py compile-ahead): lowering
+        # traces too, so it needs the same mesh/rules context. Accepts
+        # concrete arrays or ShapeDtypeStructs; ``.compile()`` on the
+        # result populates the persistent compilation cache.
+        with mesh, apply_rules(rules), current_mesh(mesh):
+            return jitted.lower(state, inputs, targets)
+
+    run_step.lower = lower
+    run_step.jitted = jitted
     return run_step
 
 

@@ -23,12 +23,29 @@ mesh spans processes).
 """
 
 import math
+import queue
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from ..agent.sharding import ShardingClient
 from ..common.log import logger
+
+# jax resolved once per process, lazily: torch-family workers import
+# this module for ElasticDistributedSampler and must not pay the jax
+# import at module load — but the hot path (make_global_array, every
+# step) must not pay the importlib machinery per call either.
+_jax = None
+
+
+def _get_jax():
+    global _jax
+    if _jax is None:
+        import jax
+
+        _jax = jax
+    return _jax
 
 
 class ElasticDistributedSampler:
@@ -180,7 +197,7 @@ def make_global_array(local_batch, mesh, pspec):
     The data axes of ``pspec`` span processes; each host contributes the
     rows it read. This is the host-pipeline → device-mesh handoff.
     """
-    import jax
+    jax = _get_jax()
 
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(
@@ -188,3 +205,113 @@ def make_global_array(local_batch, mesh, pspec):
         ),
         local_batch,
     )
+
+
+class PrefetchIterator:
+    """Double-buffered input pipeline: one element always in flight.
+
+    A background thread pulls ``next()`` from the source (and maps it
+    through ``stage_fn`` — typically :func:`make_global_array`, so the
+    host→device staging of batch N+1 runs under step N's device time)
+    while the trainer consumes the previous element. ``depth`` bounds
+    how far ahead the producer runs; the default of 1 is true double
+    buffering — deeper pipelines mostly buy queue memory, not speed,
+    because one step of lookahead already hides the host work.
+
+    Semantics the train loop relies on:
+
+    - element ORDER and VALUES are identical to iterating the source
+      directly (the bit-exactness contract — staging h2d early does not
+      change the bytes);
+    - the producer thread starts LAZILY on the first ``__next__``, so a
+      loop that breaks before drawing (resume at/past ``max_steps``)
+      consumes nothing from a finite/replayable source;
+    - producer exceptions (including from ``stage_fn``) re-raise on the
+      consumer's next draw, not on a hidden thread;
+    - once running, the pipeline holds up to ``depth`` elements drawn
+      ahead of the step that uses them — sources that must not observe
+      early draws use the synchronous path (``--sync-input`` /
+      ``input_prefetch=False``);
+    - the source (and ``stage_fn``) run on the producer THREAD: sources
+      should do host-side work (numpy, disk, decode) and leave device
+      placement to ``stage_fn`` or the jitted step — a source that
+      dispatches jax computations per batch contends with the main
+      thread's live compile for no overlap win.
+    """
+
+    _STOP = object()
+
+    def __init__(
+        self,
+        source,
+        stage_fn: Optional[Callable[[Any], Any]] = None,
+        depth: int = 1,
+    ):
+        self._source = iter(source)
+        self._stage = stage_fn
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                if self._stage is not None:
+                    item = self._stage(item)
+                while not self._stopped.is_set():
+                    try:
+                        self._q.put(("item", item), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stopped.is_set():
+                    return
+            while not self._stopped.is_set():
+                try:
+                    self._q.put(("stop", self._STOP), timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            # Same stopped-aware retry as the item path: dropping the
+            # error on a momentarily-full queue would leave the consumer
+            # blocked forever on a queue nothing will ever feed again.
+            while not self._stopped.is_set():
+                try:
+                    self._q.put(("error", e), timeout=0.2)
+                    return
+                except queue.Full:
+                    continue
+            logger.warning("prefetch error after close (dropped): %r", e)
+
+    def __next__(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._produce, name="input-prefetch", daemon=True
+            )
+            self._thread.start()
+        if self._stopped.is_set():
+            raise StopIteration
+        kind, payload = self._q.get()
+        if kind == "item":
+            return payload
+        self._stopped.set()
+        if kind == "error":
+            raise payload
+        raise StopIteration
+
+    def close(self) -> None:
+        """Stop the producer (idempotent). Elements already staged are
+        dropped — callers resume by STEP position (``data_factory``),
+        never by iterator position."""
+        self._stopped.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -156,6 +156,13 @@ def elastic_context(initialize: bool = True) -> ElasticContext:
         from ..profiler.pjrt import maybe_enable_worker_profiling
 
         maybe_enable_worker_profiling()
+        # Shared persistent compile cache (warm-restart fast path): the
+        # agent exports DLROVER_COMPILE_CACHE_DIR in the env contract;
+        # applying it here — before any compilation — makes every
+        # restart's re-compile a cache read. No-op when unset.
+        from ..common.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         _context = ElasticContext.from_env()
         if initialize:
             _context.initialize_jax()

@@ -14,6 +14,12 @@ import os
 import time
 from typing import Any, Callable, Iterable, Optional, Tuple
 
+# Imported at module load on purpose: _write_recovery_record runs right
+# after the first steady step, and a package import at that point means
+# dataclass machinery + a GC burst in the middle of live training — the
+# exact moment a worker can least afford allocator churn.
+from ..attribution.recovery import record_phase_file
+from ..common.constants import NodeEnv
 from ..common.log import logger
 
 # Process-wide GC tracer installed by the first loop run (gc.callbacks
@@ -63,6 +69,9 @@ class ElasticTrainLoop:
         trace_host: bool = True,
         soft_remesh: bool = True,
         on_remesh: Optional[Callable] = None,
+        prefetch_input: Optional[bool] = None,
+        input_stage_fn: Optional[Callable[[Tuple], Tuple]] = None,
+        compile_ahead=None,
     ):
         self.engine = engine
         self.step_fn = step_fn
@@ -92,12 +101,40 @@ class ElasticTrainLoop:
             candidate = SoftRemesh(ctx, on_remesh=on_remesh)
             if candidate.available:
                 self._remesh = candidate
+        # Double-buffered input (trainer/dataloader.py PrefetchIterator):
+        # the next batch (and its optional h2d staging via
+        # ``input_stage_fn``, e.g. make_global_array) is pulled one step
+        # ahead on a background thread. None defers to the Context knob
+        # (DLROVER_INPUT_PREFETCH); pass False (--sync-input) for
+        # sources that must not observe a draw ahead of the step.
+        self._prefetch_input = prefetch_input
+        self._input_stage_fn = input_stage_fn
+        # Compile-ahead remesh (trainer/precompile.py): a
+        # CompileAheadService (or a bare ``build_fn(world)`` the loop
+        # wraps in one) that AOT-compiles anticipated world sizes into
+        # the persistent compile cache while this world trains. Started
+        # only after the first step — it must not race the live
+        # compile for the CPU.
+        self._compile_ahead = compile_ahead
+        self._compile_svc = None
+        # MTTR phase attribution (attribution/recovery.py): wall time of
+        # the phases this process owns, spooled to DLROVER_RECOVERY_DIR.
+        self.last_restore_s = 0.0
+        self.last_first_step_s = 0.0
+        self.last_compile_s: Optional[float] = None
+        self._recovery_written = False
 
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
+        t0 = time.monotonic()
         loaded, restored = self.engine.load_consistent(state)
+        self.last_restore_s = time.monotonic() - t0
         if loaded >= 0 and restored is not None:
-            logger.info("resuming from step %s", loaded)
+            logger.info(
+                "resuming from step %s (restore %.2fs)",
+                loaded,
+                self.last_restore_s,
+            )
             self.start_step = loaded + 1
             return self.start_step, restored
         self.start_step = 0
@@ -125,7 +162,25 @@ class ElasticTrainLoop:
         if data_iter is None:
             raise ValueError("run() needs data_iter or data_factory")
         if self._trace_host:
+            # on the RAW iterator: draw timings must cover the real
+            # source even when the prefetch thread does the drawing
             self._install_host_tracer(data_iter)
+        prefetch = self._prefetch_input
+        if prefetch is None:
+            from ..common.config import get_context
+
+            prefetch = get_context().input_prefetch
+        prefetcher = None
+        if prefetch:
+            from .dataloader import PrefetchIterator
+
+            data_iter = prefetcher = PrefetchIterator(
+                data_iter, stage_fn=self._input_stage_fn
+            )
+        elif self._input_stage_fn is not None:
+            # sync escape hatch still applies the staging, inline
+            stage = self._input_stage_fn
+            data_iter = (stage(batch) for batch in data_iter)
         if self._remesh is not None:
             self._remesh.install()
         if self._device_monitor is not None:
@@ -133,6 +188,10 @@ class ElasticTrainLoop:
         try:
             return self._run_inner(state, data_iter, start)
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
+            if self._compile_svc is not None:
+                self._compile_svc.stop()
             if self._remesh is not None:
                 self._remesh.uninstall()
             # stop() even when step_fn raises: a leaked daemon reporter
@@ -168,6 +227,87 @@ class ElasticTrainLoop:
                 _gc_tracer = GcStallTracer(tracer.timer).install()
         except Exception as e:  # noqa: BLE001 — aux, never blocks training
             logger.warning("host tracer unavailable: %s", e)
+
+    # -- warm-restart instrumentation --------------------------------------
+
+    def _record_boot_step(self, idx: int, loss, t0: float) -> None:
+        """Time the first two steps after (re)start. The first carries
+        the XLA (re)compile; the second is steady state, so their
+        difference attributes ``compile_s`` — the phase the persistent
+        compile cache (and compile-ahead) collapses. Blocks on the loss
+        so the measurement covers execution, not just dispatch — paid
+        on exactly two steps."""
+        try:
+            import jax
+
+            jax.block_until_ready(loss)
+        except Exception:  # noqa: BLE001 — non-jax step_fn outputs
+            pass
+        dt = time.monotonic() - t0
+        if idx == 0:
+            self.last_first_step_s = dt
+            # Start anticipating only now: the service must never
+            # compete with the live first compile for the CPU.
+            self._start_compile_ahead()
+        else:
+            self.last_compile_s = max(0.0, self.last_first_step_s - dt)
+            self._write_recovery_record()
+
+    def _start_compile_ahead(self) -> None:
+        ca = self._compile_ahead
+        if ca is None:
+            return
+        if self._compile_svc is not None:
+            # a retried run() stopped the service in its finally;
+            # start() clears the stop flag and respawns the thread
+            self._compile_svc.start()
+            return
+        try:
+            from .precompile import CompileAheadService
+
+            if isinstance(ca, CompileAheadService):
+                svc = ca
+            else:
+                current = (
+                    self.ctx.num_processes if self.ctx is not None else 1
+                )
+                node_unit = int(
+                    os.environ.get(NodeEnv.NODE_UNIT, "1") or 1
+                )
+                # MAX_NODES is the static job ceiling; NODE_NUM is
+                # clobbered to the CURRENT world each rendezvous round,
+                # so reading it here would hide every grow world and
+                # skew the shrink ladder's accumulation factors.
+                max_workers = max(
+                    current,
+                    int(os.environ.get(NodeEnv.MAX_NODES, "0") or 0),
+                )
+                svc = CompileAheadService(
+                    ca,
+                    current_world=current,
+                    max_workers=max_workers,
+                    node_unit=node_unit,
+                )
+            self._compile_svc = svc.start()
+        except Exception as e:  # noqa: BLE001 — an optimization only
+            logger.warning("compile-ahead unavailable: %s", e)
+
+    def _write_recovery_record(self) -> None:
+        """Spool this boot's phase breakdown for the storm/bench
+        aggregator (no-op without DLROVER_RECOVERY_DIR)."""
+        if self._recovery_written:
+            return
+        self._recovery_written = True
+        payload = {
+            "resumed": self.start_step > 0,
+            "restart": int(os.environ.get(NodeEnv.RESTART_COUNT, "0") or 0),
+            "restore_s": round(self.last_restore_s, 3),
+            "first_step_s": round(self.last_first_step_s, 3),
+        }
+        if self.last_compile_s is not None:
+            payload["compile_s"] = round(self.last_compile_s, 3)
+        if record_phase_file("worker", payload):
+            logger.info("recovery breakdown: %s", payload)
 
     def _run_inner(self, state, data_iter, start):
         step = start
@@ -226,7 +366,12 @@ class ElasticTrainLoop:
                             "remesh handoff: could not stage step %s",
                             step - 1,
                         )
-                self._remesh.apply()
+                if self._remesh.apply() and self._compile_svc is not None:
+                    # The likely-next worlds shifted with the adopted
+                    # one: re-anticipate so the NEXT remesh is warm too.
+                    self._compile_svc.anticipate(
+                        self.ctx.num_processes if self.ctx else 1
+                    )
             try:
                 batch = next(it)
             except StopIteration:
@@ -235,7 +380,11 @@ class ElasticTrainLoop:
                 self.ctx.start_step_timer()
             if tt_begin is not None:
                 tt_begin(step)
+            timed = step - start < 2  # first step = compile + step
+            t_step0 = time.monotonic() if timed else 0.0
             state, loss = self.step_fn(state, *batch)
+            if timed:
+                self._record_boot_step(step - start, loss, t_step0)
             if tt_end is not None:
                 tt_end(step)
             # Cadence saves stage asynchronously (device-side snapshot +
@@ -264,6 +413,10 @@ class ElasticTrainLoop:
                 # would serialize host and device
                 logger.info("step %s: loss %.4f", step, float(loss))
             step += 1
+        if step > start and not self._recovery_written:
+            # one-step runs never saw a steady step: record without the
+            # compile split rather than not at all
+            self._write_recovery_record()
         if last_save_ok and not self.engine.wait_staged_all():
             last_save_ok = False  # async stage failed — redo blocking below
         if step > start and not last_save_ok:

@@ -1,0 +1,255 @@
+"""Compile-ahead remesh: AOT-compile anticipated worlds ahead of need.
+
+The cold recovery path pays XLA compilation *after* the new world
+forms: rendezvous settles, the checkpoint restores, and only then does
+the first step trace + compile the train step for the new shape. With
+the persistent compilation cache on (``common/compile_cache.py``) that
+compile is payable AHEAD of the fault instead: a background service in
+the trainer AOT-lowers and compiles the train step for the worlds a
+re-mesh is likely to produce, populating the shared cache while the
+current world trains. When the re-mesh lands, the "compile" is a cache
+read and ``compile_s`` in the recovery breakdown collapses toward
+zero.
+
+Anticipated worlds (:func:`anticipated_worlds`): the current world
+± ``node_unit`` (one slice joins or leaves — the dominant elasticity
+event), plus the shrink ladder implied by the fixed-global-batch rule
+— each smaller world whose ``gradient_accumulation_steps`` factor is
+distinct compiles a genuinely different program (the scan length
+changes), so each distinct factor gets one ahead-of-time compile.
+
+What this can honestly pre-compile: worlds that keep this host's local
+device count (shrink/grow by whole hosts with an unchanged per-host
+mesh — exactly the soft-remesh acceptance class) and any world whose
+only signature change is the accumulation factor. A world that changes
+the per-host device mesh cannot be lowered against devices this
+process does not hold; its ``build_fn`` raises, the error is recorded
+in :meth:`CompileAheadService.stats`, and the remesh falls back to the
+normal cold compile.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.log import logger
+from .loop import gradient_accumulation_steps
+
+
+def anticipated_worlds(
+    current: int, max_workers: Optional[int] = None, node_unit: int = 1
+) -> List[int]:
+    """World sizes a re-mesh is likely to produce, most likely first.
+
+    - ``current ± node_unit`` (a slice replaced/lost/added);
+    - the shrink ladder: one world per distinct gradient-accumulation
+      factor below ``current`` (distinct factor = distinct program).
+    """
+    if current <= 0:
+        return []
+    max_workers = max_workers if max_workers and max_workers > 0 else current
+    unit = max(1, node_unit)
+    worlds = set()
+    for w in (current - unit, current + unit):
+        if unit <= w <= max_workers:
+            worlds.add(w)
+    seen_accum = {gradient_accumulation_steps(max_workers, current)}
+    w = current - unit
+    while w >= unit:
+        accum = gradient_accumulation_steps(max_workers, w)
+        if accum not in seen_accum:
+            seen_accum.add(accum)
+            worlds.add(w)
+        w -= unit
+    worlds.discard(current)
+    return sorted(worlds, key=lambda w: (abs(w - current), -w))
+
+
+class CompileAheadService:
+    """Background AOT compiler for anticipated world sizes.
+
+    ``build_fn(world_size)`` does the world-specific lowering+compile
+    (see :func:`make_train_step_build_fn`); the service owns the
+    threading, the anticipation set, dedup across re-anticipations, and
+    per-world timing/error bookkeeping. One daemon thread, compiles
+    serially — XLA parallelizes internally, and recovery anticipation
+    must never compete with the live step for every core at once.
+    """
+
+    def __init__(
+        self,
+        build_fn: Callable[[int], Any],
+        current_world: int = 1,
+        max_workers: Optional[int] = None,
+        node_unit: int = 1,
+        worlds: Optional[List[int]] = None,
+    ):
+        self._build_fn = build_fn
+        self._max_workers = max_workers
+        self._node_unit = max(1, node_unit)
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.compiled: Dict[int, float] = {}  # world -> compile seconds
+        self.errors: Dict[int, str] = {}
+        self.anticipate(current_world, worlds=worlds)
+
+    def anticipate(
+        self, current_world: int, worlds: Optional[List[int]] = None
+    ) -> List[int]:
+        """(Re-)derive the anticipation set around ``current_world`` —
+        called at construction and again after an adopted re-mesh, when
+        the likely next worlds shift with the new current."""
+        targets = (
+            list(worlds)
+            if worlds is not None
+            else anticipated_worlds(
+                current_world, self._max_workers, self._node_unit
+            )
+        )
+        with self._lock:
+            fresh = [
+                w
+                for w in targets
+                if w not in self.compiled and w not in self._pending
+            ]
+            self._pending.extend(fresh)
+            if fresh:
+                self._idle.clear()
+        self._wake.set()
+        return fresh
+
+    def start(self) -> "CompileAheadService":
+        """Start — or revive after :meth:`stop` — the compile thread.
+        A loop whose ``run()`` is retried stops the service in its
+        finally and restarts it here on the next boot; clearing the
+        stop flag keeps the pending set drainable across retries."""
+        self._stop = False
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="compile-ahead", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the current anticipation set has been attempted
+        (compiled or errored). For tests and the A/B bench."""
+        return self._idle.wait(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "compiled": dict(self.compiled),
+                "errors": dict(self.errors),
+                "pending": list(self._pending),
+            }
+
+    def _run(self) -> None:
+        while not self._stop:
+            with self._lock:
+                world = self._pending.popleft() if self._pending else None
+                if world is None:
+                    # set under the SAME lock as the emptiness check:
+                    # an anticipate() between check and set would
+                    # otherwise be masked and wait() would report a
+                    # warm cache with zero worlds attempted
+                    self._idle.set()
+            if world is None:
+                self._wake.wait(timeout=5.0)
+                self._wake.clear()
+                continue
+            t0 = time.monotonic()
+            try:
+                self._build_fn(world)
+            except Exception as e:  # noqa: BLE001 — per-world, recorded
+                with self._lock:
+                    self.errors[world] = repr(e)[:200]
+                logger.warning(
+                    "compile-ahead for world %s failed: %s", world, e
+                )
+                continue
+            dt = time.monotonic() - t0
+            with self._lock:
+                self.compiled[world] = round(dt, 3)
+            logger.info(
+                "compile-ahead: world %s ready in %.1fs (cache warm)",
+                world,
+                dt,
+            )
+
+
+def make_train_step_build_fn(
+    model,
+    tx,
+    loss_fn,
+    mesh,
+    sharding_tree,
+    state,
+    example_inputs,
+    example_targets,
+    max_workers: int,
+    **build_kwargs,
+) -> Callable[[int], Any]:
+    """``build_fn(world)`` for :class:`CompileAheadService` over the
+    standard :func:`~dlrover_tpu.parallel.train_step.build_train_step`
+    product.
+
+    ``example_inputs/targets`` are one per-host batch at the FULL world
+    (accumulation factor 1). A world of size ``w`` runs the same global
+    batch as ``accum = gradient_accumulation_steps(max_workers, w)``
+    micro-slices, so its per-host input is the example scaled by
+    ``accum`` on the leading axis — the AOT lower uses shape structs,
+    never materializing the bigger batch. With the persistent compile
+    cache enabled the ``.compile()`` result lands on disk keyed by the
+    computation fingerprint; the post-remesh trainer's first step then
+    hits the cache instead of recompiling.
+    """
+    import jax
+
+    from ..parallel.train_step import build_train_step
+
+    def _scaled(x, scale: int):
+        return jax.ShapeDtypeStruct(
+            (x.shape[0] * scale,) + tuple(x.shape[1:]), x.dtype
+        )
+
+    # Lowering only needs avals: capture the state's shapes/dtypes, not
+    # the concrete arrays — build_fn lives as long as the service, and a
+    # closure over the live boot state would pin a full device copy of
+    # model + optimizer for the whole run.
+    state = jax.tree_util.tree_map(
+        lambda x: (
+            jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype")
+            else x
+        ),
+        state,
+    )
+
+    def build(world: int):
+        accum = gradient_accumulation_steps(max_workers, world)
+        step = build_train_step(
+            model,
+            tx,
+            loss_fn,
+            mesh,
+            sharding_tree,
+            grad_accum_steps=accum,
+            **build_kwargs,
+        )
+        lowered = step.lower(
+            state, _scaled(example_inputs, accum), _scaled(example_targets, accum)
+        )
+        return lowered.compile()
+
+    return build

@@ -59,12 +59,15 @@ def main():
     rng = np.random.default_rng(ctx.process_id)
 
     def data():
+        # Host numpy on purpose: ElasticTrainLoop prefetches this
+        # generator on a background thread (docs/recovery.md) — batch
+        # prep belongs on the host there; the jitted step moves the
+        # batch to the device on the main thread.
         while True:
-            x = jnp.asarray(
-                rng.integers(0, cfg.vocab_size, (batch, cfg.max_seq_len)),
-                jnp.int32,
-            )
-            yield x, jnp.roll(x, -1, axis=1)
+            x = rng.integers(
+                0, cfg.vocab_size, (batch, cfg.max_seq_len)
+            ).astype(np.int32)
+            yield x, np.roll(x, -1, axis=1)
 
     loop = ElasticTrainLoop(
         engine, step_fn, ctx=ctx, max_steps=TOTAL_STEPS, storage_every=50

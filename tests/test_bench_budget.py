@@ -214,8 +214,10 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["goodput_storm"] = {
         "goodput": 0.83, "training_goodput": 0.95, "steps": 520,
         "kills": 4, "elapsed_s": 812.2, "steps_per_second": 0.71,
-        "first_step_s": 24.3, "mttr_s": 11.4, "slice_mttr_s": 17.9,
+        "boot_s": 24.3, "mttr_s": 11.4, "slice_mttr_s": 17.9,
         "slice_goodput": 0.88, "slice_relaunches": 3,
+        "rdzv_s": 2.1, "restore_s": 0.4, "compile_s": 6.2,
+        "first_step_s": 7.0, "recovery_samples": 4,
         "stalls": [
             {"at_step": 100 + 30 * i, "gap_s": 12.5, "kill": True,
              "kind": "slice" if i % 2 else "host"}
@@ -226,6 +228,23 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["storm_mttr_s"] = 11.4
     extra["storm_slice_mttr_s"] = 17.9
     extra["storm_slice_goodput"] = 0.88
+    # MTTR phase breakdown + warm-vs-cold recovery A/B (docs/recovery.md):
+    # the full two-leg dict is sidecar-class; the scalars ride the line
+    extra["storm_rdzv_s"] = 2.1
+    extra["storm_restore_s"] = 0.4
+    extra["storm_compile_s"] = 6.2
+    extra["storm_first_step_s"] = 7.0
+    extra["recovery_ab"] = {
+        "cold": dict(extra["goodput_storm"], compile_s=12.1),
+        "warm": dict(extra["goodput_storm"], compile_s=0.3),
+        "mttr_delta_s": 11.8, "cold_compile_s": 12.1,
+        "warm_compile_s": 0.3,
+    }
+    extra["recovery_cold_mttr_s"] = 22.9
+    extra["recovery_warm_mttr_s"] = 11.1
+    extra["recovery_mttr_delta_s"] = 11.8
+    extra["recovery_cold_compile_s"] = 12.1
+    extra["recovery_warm_compile_s"] = 0.3
     bench._merge_committed_artifacts(extra)
     extra["probe_history"] = [
         {
@@ -295,6 +314,16 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["storm_slice_mttr_s"] == extra["storm_slice_mttr_s"]
     assert slim["storm_slice_goodput"] == extra["storm_slice_goodput"]
     assert slim["storm_goodput"] == extra["storm_goodput"]
+    # the MTTR phase breakdown and the warm-vs-cold A/B verdict ride
+    # the line; per-leg details and the two full storm dicts are
+    # sidecar-only
+    for key in (
+        "storm_rdzv_s", "storm_restore_s", "storm_compile_s",
+        "storm_first_step_s", "recovery_mttr_delta_s",
+        "recovery_warm_compile_s",
+    ):
+        assert slim[key] == extra[key], key
+    assert "recovery_ab" not in slim
     assert slim["attr_report"] == extra["attr_report"]
     assert slim["last_silicon"]["artifact"] == (
         extra["last_silicon"]["artifact"]

@@ -119,12 +119,14 @@ class TestElasticTrainLoop:
         r = np.random.default_rng(0)
 
         def data():
+            # host numpy: the loop's default input prefetch draws this
+            # on a background thread, where a jax-dispatching producer
+            # would race the main thread's compile
             while True:
-                x = jnp.asarray(
-                    r.integers(0, cfg.vocab_size, (2, cfg.max_seq_len)),
-                    jnp.int32,
-                )
-                yield x, jnp.roll(x, -1, axis=1)
+                x = r.integers(
+                    0, cfg.vocab_size, (2, cfg.max_seq_len)
+                ).astype(np.int32)
+                yield x, np.roll(x, -1, axis=1)
 
         self._mesh = mesh
         return step, state, data

@@ -45,16 +45,23 @@ class ProfilerMetricCollector:
         port: int,
         client: Optional[MasterClient] = None,
         interval_s: float = 30.0,
+        scrape_timeout_s: float = 5.0,
     ):
         self._url = f"http://127.0.0.1:{port}/metrics"
         self._client = client or MasterClient.singleton()
         self._interval = interval_s
+        # Localhost scrape of the in-process profiler endpoint — a
+        # short deadline of its own, injectable rather than inline
+        # (tpurun-lint rpc-deadline).
+        self._scrape_timeout_s = scrape_timeout_s
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
 
     def collect_once(self) -> Optional[Dict[str, float]]:
         try:
-            with urllib.request.urlopen(self._url, timeout=5) as resp:
+            with urllib.request.urlopen(
+                self._url, timeout=self._scrape_timeout_s
+            ) as resp:
                 text = resp.read().decode()
         except Exception as e:
             logger.debug("profiler scrape failed: %s", e)

@@ -1,0 +1,19 @@
+"""tpurun-lint: runtime-invariant static analysis for dlrover_tpu.
+
+Six AST passes, each encoding a rule this repo learned from an incident
+(docs/analysis.md): import-purity, blocking-under-lock, host-sync,
+rpc-deadline, env-knobs, injection-coverage. Pure stdlib — importing
+this package never imports jax or any runtime module.
+
+Run it::
+
+    tpurun-lint dlrover_tpu            # or: python -m dlrover_tpu.analysis.cli
+
+Suppress one site with a written reason::
+
+    time.sleep(0.1)  # tpulint: ignore[blocking-under-lock] <why>
+"""
+
+from .core import Baseline, LintResult, Violation, run_lint
+
+__all__ = ["Baseline", "LintResult", "Violation", "run_lint"]

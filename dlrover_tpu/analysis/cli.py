@@ -1,0 +1,190 @@
+"""``tpurun-lint`` — run the runtime-invariant suite from the shell.
+
+Exit status: 0 when clean (no unsuppressed violations, no stale
+baseline entries, no malformed suppressions), 1 otherwise, 2 on usage
+errors. Pure stdlib: safe in CI images without jax installed.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import Baseline, find_repo_root, iter_py_files, run_lint
+from .passes import ALL_PASSES, PASS_BY_ID
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun-lint",
+        description=(
+            "AST lint suite encoding dlrover_tpu's runtime invariants "
+            "(import purity, no blocking under locks, no host syncs in "
+            "hot paths, Context-sourced RPC deadlines, the DLROVER_* "
+            "knob registry, chaos injection coverage). See "
+            "docs/analysis.md."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["dlrover_tpu"],
+        help="files/directories to lint (default: dlrover_tpu)",
+    )
+    p.add_argument(
+        "--select",
+        metavar="PASS[,PASS...]",
+        help="run only these passes (see --list-passes)",
+    )
+    p.add_argument(
+        "--list-passes", action="store_true", help="list passes and exit"
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        default=None,
+        help=(
+            "baseline file of grandfathered violations (default: the "
+            "checked-in dlrover_tpu/analysis/baseline.json when present)"
+        ),
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        nargs="?",
+        const="",
+        default=None,
+        help=(
+            "write current violations to FILE (default: the active "
+            "baseline path) and exit 0; edit in the per-entry reasons"
+        ),
+    )
+    p.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="report format",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also list suppressed sites and their reasons",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_passes:
+        for lp in ALL_PASSES:
+            doc = (lp.__doc__ or "").strip().splitlines()[0]
+            print(f"{lp.PASS_ID:22s} {doc}")
+        return 0
+
+    passes = ALL_PASSES
+    if args.select:
+        wanted = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [w for w in wanted if w not in PASS_BY_ID]
+        if unknown:
+            print(
+                f"unknown pass(es): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(PASS_BY_ID))})",
+                file=sys.stderr,
+            )
+            return 2
+        passes = [PASS_BY_ID[w] for w in wanted]
+
+    # A typo'd path (or the relative default run from the wrong cwd)
+    # must not green-light CI by linting zero files.
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(
+            f"path(s) do not exist: {', '.join(missing)}", file=sys.stderr
+        )
+        return 2
+    if not any(True for _ in iter_py_files(args.paths)):
+        print(
+            f"no Python files under: {', '.join(args.paths)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    baseline = None
+    if baseline_path and not args.no_baseline and args.write_baseline is None:
+        if os.path.exists(baseline_path):
+            baseline = Baseline.load(baseline_path)
+
+    root = find_repo_root(args.paths[0])
+    result = run_lint(
+        args.paths, passes=passes, baseline=baseline, repo_root=root
+    )
+
+    if args.write_baseline is not None:
+        out = args.write_baseline or baseline_path or DEFAULT_BASELINE
+        Baseline.from_violations(
+            result.violations, reason="grandfathered — TODO: justify"
+        ).save(out)
+        print(
+            f"wrote {len(result.violations)} baseline entr"
+            f"{'y' if len(result.violations) == 1 else 'ies'} to {out}"
+        )
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "violations": [v.__dict__ for v in result.violations],
+                    "suppressed": len(result.suppressed),
+                    "baselined": result.baselined,
+                    "stale_baseline": [
+                        e.__dict__ for e in result.stale_baseline
+                    ],
+                    "errors": result.errors,
+                    "clean": result.clean,
+                },
+                indent=2,
+            )
+        )
+        return 0 if result.clean else 1
+
+    for v in result.violations:
+        print(v.render())
+    for err in result.errors:
+        print(f"ERROR: {err}")
+    for e in result.stale_baseline:
+        print(
+            f"ERROR: stale baseline entry {e.key()} — the site was fixed "
+            "or moved; delete the entry (baselines only shrink)"
+        )
+    if args.show_suppressed:
+        for v, s in result.suppressed:
+            print(f"suppressed {v.render()}  [reason: {s.reason}]")
+    n = len(result.violations)
+    print(
+        f"tpurun-lint: {n} violation{'s' if n != 1 else ''}, "
+        f"{len(result.suppressed)} suppressed, "
+        f"{result.baselined} baselined, "
+        f"{len(result.stale_baseline)} stale baseline entr"
+        f"{'y' if len(result.stale_baseline) == 1 else 'ies'}, "
+        f"{len(result.errors)} error{'s' if len(result.errors) != 1 else ''}"
+    )
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,431 @@
+"""tpurun-lint core: file model, suppressions, baseline, runner.
+
+The suite encodes runtime invariants this repo has paid for in
+incidents (docs/analysis.md tables each pass with the PR that motivated
+it).  Everything here is pure ``ast`` + text — importing the analysis
+package never imports jax, grpc, or any runtime module, so the suite
+runs in milliseconds on any host (CI, a laptop without accelerators, a
+pre-commit hook).
+
+Vocabulary:
+
+- A *pass* inspects one parsed file (``FileContext``) or the whole repo
+  (``repo_check``) and yields :class:`Violation` records.
+- An inline suppression ``# tpulint: ignore[<pass>] <reason>`` on the
+  violating line (or the full-line comment directly above it) silences
+  one site; the reason is mandatory — a bare ignore is itself reported.
+- A *baseline* file grandfathers known sites so the suite can gate CI
+  at zero new violations while old debt is paid down; every entry
+  carries a written reason and stale entries (the site was fixed or
+  moved) are reported as errors so the baseline can only shrink.
+"""
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# violations
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Violation:
+    pass_id: str
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based; 0 for repo-level findings
+    message: str
+    # What baseline matching keys on besides (pass, path): the stripped
+    # source line for code findings, or a stable token (knob name,
+    # injection point) for repo-level findings. Line numbers drift with
+    # every edit, so they are display-only.
+    code: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.pass_id}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+# `# tpulint: ignore[pass-a,pass-b] reason text`
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*ignore\[([a-z0-9_,\s-]*)\]\s*(.*)$"
+)
+# `# tpulint: hotpath [reason]` — marks the NEXT (or same-line) `def` as
+# a host-sync hot path (see passes/host_sync.py).
+_HOTPATH_RE = re.compile(r"#\s*tpulint:\s*hotpath\b")
+
+
+@dataclass
+class Suppression:
+    line: int
+    passes: Set[str]
+    reason: str
+    full_line: bool  # comment-only line (applies to the line below)
+
+
+@dataclass
+class FileContext:
+    """One parsed source file, shared by every per-file pass."""
+
+    path: str  # absolute
+    rel: str  # repo-relative, forward slashes
+    source: str
+    lines: List[str]
+    tree: ast.AST
+    suppressions: List[Suppression] = field(default_factory=list)
+    hotpath_lines: Set[int] = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, path: str, rel: str) -> Optional["FileContext"]:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return None
+        ctx = cls(
+            path=path,
+            rel=rel,
+            source=source,
+            lines=source.splitlines(),
+            tree=tree,
+        )
+        for i, text in enumerate(ctx.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                passes = {
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                }
+                ctx.suppressions.append(
+                    Suppression(
+                        line=i,
+                        passes=passes,
+                        reason=m.group(2).strip(),
+                        full_line=text.lstrip().startswith("#"),
+                    )
+                )
+            if _HOTPATH_RE.search(text):
+                ctx.hotpath_lines.add(i)
+        return ctx
+
+    def suppression_for(self, pass_id: str, line: int) -> Optional[Suppression]:
+        """Suppression covering ``line``: same line, or a comment-only
+        line directly above (stacked full-line comments chain up)."""
+        by_line = {s.line: s for s in self.suppressions}
+        s = by_line.get(line)
+        if s is not None and pass_id in s.passes:
+            return s
+        # walk up through contiguous full-line comments
+        probe = line - 1
+        while probe >= 1 and self.lines[probe - 1].lstrip().startswith("#"):
+            s = by_line.get(probe)
+            if s is not None and s.full_line and pass_id in s.passes:
+                return s
+            probe -= 1
+        return None
+
+    def code_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``node``'s subtree WITHOUT descending into nested function
+    or lambda bodies — code inside a nested ``def`` does not execute in
+    the enclosing region (the saver's factory runner is defined under
+    the class lock but runs on its own thread)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        # function/lambda nodes are opaque wherever they appear —
+        # including as the walk root (a nested `def` statement in a
+        # with-body is handed to this walker directly)
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called function: ``a.b.c()`` → ``c``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def receiver_name(node: ast.Call) -> str:
+    """Name of the attribute-call receiver: ``self._q.get()`` → ``_q``."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Attribute):
+            return v.attr
+        if isinstance(v, ast.Name):
+            return v.id
+    return ""
+
+
+def dotted_name(expr: ast.AST) -> str:
+    """``jax.config.update`` → "jax.config.update" (best effort)."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def keyword_map(node: ast.Call) -> Dict[str, ast.expr]:
+    return {k.arg: k.value for k in node.keywords if k.arg}
+
+
+def is_number(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Constant) and isinstance(
+        expr.value, (int, float)
+    ) and not isinstance(expr.value, bool):
+        return True
+    # -5, +2.5
+    if isinstance(expr, ast.UnaryOp) and isinstance(
+        expr.op, (ast.USub, ast.UAdd)
+    ):
+        return is_number(expr.operand)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BaselineEntry:
+    pass_id: str
+    path: str
+    code: str
+    reason: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.pass_id, self.path, self.code)
+
+
+class Baseline:
+    """Checked-in grandfather list. Matching ignores line numbers (they
+    drift); a baselined site is keyed by (pass, file, stripped source
+    line / stable token). Entries that no longer match anything are
+    *stale* and reported as errors — the file can only shrink."""
+
+    def __init__(self, entries: Optional[List[BaselineEntry]] = None):
+        self.entries = entries or []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entries = [
+            BaselineEntry(
+                pass_id=e["pass"],
+                path=e["path"],
+                code=e.get("code", ""),
+                reason=e.get("reason", ""),
+            )
+            for e in data.get("entries", [])
+        ]
+        return cls(entries)
+
+    @classmethod
+    def from_violations(
+        cls, violations: List[Violation], reason: str = "grandfathered"
+    ) -> "Baseline":
+        return cls(
+            [
+                BaselineEntry(
+                    pass_id=v.pass_id, path=v.path, code=v.code, reason=reason
+                )
+                for v in violations
+            ]
+        )
+
+    def save(self, path: str) -> None:
+        data = {
+            "_comment": (
+                "tpurun-lint baseline: grandfathered violations. Every "
+                "entry MUST carry a reason; stale entries are reported "
+                "as errors (the file can only shrink). Regenerate with "
+                "tpurun-lint --write-baseline."
+            ),
+            "entries": [
+                {
+                    "pass": e.pass_id,
+                    "path": e.path,
+                    "code": e.code,
+                    "reason": e.reason,
+                }
+                for e in self.entries
+            ],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def filter(
+        self, violations: List[Violation]
+    ) -> Tuple[List[Violation], List[BaselineEntry], List[str]]:
+        """→ (surviving violations, stale entries, entry errors).
+
+        Entry errors cover malformed entries (missing reason)."""
+        errors = [
+            f"baseline entry {e.key()} has no reason"
+            for e in self.entries
+            if not e.reason.strip()
+        ]
+        matched: Set[Tuple[str, str, str]] = set()
+        keys = {e.key() for e in self.entries}
+        surviving = []
+        for v in violations:
+            k = (v.pass_id, v.path, v.code)
+            if k in keys:
+                matched.add(k)
+            else:
+                surviving.append(v)
+        stale = [e for e in self.entries if e.key() not in matched]
+        return surviving, stale, errors
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def find_repo_root(start: str) -> str:
+    """Walk up from ``start`` to the directory holding pyproject.toml
+    (falls back to ``start`` so the suite still runs on a bare tree)."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start if os.path.isdir(start) else os.path.dirname(start))
+        cur = parent
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__"
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation]  # unsuppressed, unbaselined
+    suppressed: List[Tuple[Violation, Suppression]]
+    baselined: int
+    stale_baseline: List[BaselineEntry]
+    errors: List[str]  # bad suppressions, malformed baseline entries
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations and not self.stale_baseline and not self.errors
+
+
+def run_lint(
+    paths: List[str],
+    passes: Optional[List] = None,
+    baseline: Optional[Baseline] = None,
+    repo_root: Optional[str] = None,
+) -> LintResult:
+    """Run ``passes`` (default: the full registry) over ``paths``."""
+    from .passes import ALL_PASSES
+
+    active = passes if passes is not None else list(ALL_PASSES)
+    root = repo_root or find_repo_root(paths[0] if paths else ".")
+
+    contexts: List[FileContext] = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+        ctx = FileContext.parse(path, rel)
+        if ctx is not None:
+            contexts.append(ctx)
+
+    raw: List[Violation] = []
+    suppressed: List[Tuple[Violation, Suppression]] = []
+    errors: List[str] = []
+
+    for lp in active:
+        check_file = getattr(lp, "check_file", None)
+        if check_file is None:
+            continue
+        for ctx in contexts:
+            for v in check_file(ctx):
+                s = ctx.suppression_for(v.pass_id, v.line)
+                if s is not None:
+                    if not s.reason:
+                        errors.append(
+                            f"{ctx.rel}:{s.line}: tpulint: ignore"
+                            f"[{v.pass_id}] needs a reason — a bare "
+                            "ignore hides the incident the rule encodes"
+                        )
+                    suppressed.append((v, s))
+                else:
+                    raw.append(v)
+
+    ctx_by_rel = {c.rel: c for c in contexts}
+    for lp in active:
+        repo_check = getattr(lp, "repo_check", None)
+        if repo_check is None:
+            continue
+        for v in repo_check(root, contexts):
+            c = ctx_by_rel.get(v.path)
+            s = c.suppression_for(v.pass_id, v.line) if c and v.line else None
+            if s is not None:
+                if not s.reason:
+                    errors.append(
+                        f"{v.path}:{s.line}: tpulint: ignore"
+                        f"[{v.pass_id}] needs a reason — a bare "
+                        "ignore hides the incident the rule encodes"
+                    )
+                suppressed.append((v, s))
+            else:
+                raw.append(v)
+
+    baselined = 0
+    stale: List[BaselineEntry] = []
+    if baseline is not None:
+        before = len(raw)
+        raw, stale, bl_errors = baseline.filter(raw)
+        baselined = before - len(raw)
+        errors.extend(bl_errors)
+
+    raw.sort(key=lambda v: (v.path, v.line, v.pass_id))
+    return LintResult(
+        violations=raw,
+        suppressed=suppressed,
+        baselined=baselined,
+        stale_baseline=stale,
+        errors=errors,
+    )

@@ -1,0 +1,28 @@
+"""Pass registry for tpurun-lint.
+
+Each pass module exposes ``PASS_ID`` plus ``check_file(ctx)`` (per-file)
+and/or ``repo_check(root, contexts)`` (whole-repo). The registry order
+is the report order.
+"""
+
+from . import (
+    blocking_under_lock,
+    env_knobs,
+    host_sync,
+    import_purity,
+    injection_coverage,
+    rpc_deadline,
+)
+
+ALL_PASSES = [
+    import_purity,
+    blocking_under_lock,
+    host_sync,
+    rpc_deadline,
+    env_knobs,
+    injection_coverage,
+]
+
+PASS_BY_ID = {p.PASS_ID: p for p in ALL_PASSES}
+
+__all__ = ["ALL_PASSES", "PASS_BY_ID"]

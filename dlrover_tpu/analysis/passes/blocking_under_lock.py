@@ -1,0 +1,130 @@
+"""blocking-under-lock: no unbounded blocking while holding a lock.
+
+Incident (PR 3): the checkpoint saver's IPC wait and the serving weight
+swap both held ``threading.Lock`` attributes across calls that chaos
+storms wedged (a dead peer, a dropped RPC) — every other thread wanting
+the lock then wedged behind them, turning one slow dependency into a
+whole-process hang. PR 3's fixes (saver-IPC timeout → standalone saver,
+swap abort paths) each started as exactly this pattern.
+
+Rule: inside a ``with <lock>:`` body (any context-manager whose name
+contains ``lock``/``mutex``/``cond``), the following are flagged:
+
+- ``time.sleep(...)``
+- untimed ``.join()`` (thread/process join with no timeout)
+- untimed ``.wait()`` (Event/Condition wait with no timeout)
+- untimed queue ``.get()``/``.put()`` (receiver named like a queue)
+- untimed nested ``.acquire()`` (no ``timeout=``, classic ABBA setup)
+- ``subprocess`` waits without ``timeout=`` (``run``, ``check_call``,
+  ``check_output``, ``communicate``, ``wait``)
+- network calls: ``urlopen``, and any call on a ``*client*`` receiver
+  (the RPC clients' verbs — the master client retries with backoff
+  *sleeps* internally, so holding a lock across it wedges for the whole
+  retry budget)
+
+Nested ``def``/``lambda`` bodies are skipped — they do not execute
+under the lock (the saver factory's runner thread is *defined* under
+the class lock but runs on its own thread).
+
+The pass sees only syntactic locks (``with self._lock:``). Manual
+``acquire()``/``release()`` spans are not tracked; keep those short or
+convert them to ``with`` so the pass can see them.
+"""
+
+import ast
+import re
+from typing import Iterable
+
+from ..core import (
+    FileContext,
+    Violation,
+    call_name,
+    keyword_map,
+    receiver_name,
+    walk_skip_defs,
+)
+
+PASS_ID = "blocking-under-lock"
+
+_LOCKY = re.compile(r"(lock|mutex|cond)", re.I)
+_QUEUEY = re.compile(r"(^q$|^_q$|queue|inbox|outbox)", re.I)
+_CLIENTY = re.compile(r"client", re.I)
+_SUBPROC_WAITS = {"run", "check_call", "check_output", "communicate", "wait_for"}
+
+
+def _is_locky(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Attribute):
+        return bool(_LOCKY.search(expr.attr))
+    if isinstance(expr, ast.Name):
+        return bool(_LOCKY.search(expr.id))
+    if isinstance(expr, ast.Call):
+        # with self._lock_for(x): / with threading.Lock():
+        return _is_locky(expr.func)
+    return False
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_is_locky(item.context_expr) for item in node.items):
+            continue
+        for st in node.body:
+            for sub in walk_skip_defs(st):
+                if not isinstance(sub, ast.Call):
+                    continue
+                v = _classify(ctx, sub)
+                if v is not None:
+                    yield v
+
+
+def _classify(ctx: FileContext, call: ast.Call):
+    name = call_name(call)
+    recv = receiver_name(call)
+    kw = keyword_map(call)
+    timed = "timeout" in kw
+    msg = None
+    if name == "sleep":
+        msg = "time.sleep while holding a lock"
+    elif name == "join" and not timed and not call.args and recv:
+        msg = f"untimed {recv}.join() while holding a lock"
+    elif name == "wait" and not timed and not call.args:
+        msg = f"untimed {recv}.wait() while holding a lock"
+    elif name in ("get", "put") and not timed and _QUEUEY.search(recv or ""):
+        # queue.get(False) / get_nowait are fine; only the blocking form
+        # with no deadline wedges
+        if not (
+            call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value is False
+        ) and not (
+            "block" in kw
+            and isinstance(kw["block"], ast.Constant)
+            and kw["block"].value is False
+        ):
+            msg = f"untimed {recv}.{name}() while holding a lock"
+    elif name == "acquire" and not timed and recv:
+        blocking = kw.get("blocking")
+        if not (
+            isinstance(blocking, ast.Constant) and blocking.value is False
+        ):
+            msg = f"untimed nested {recv}.acquire() while holding a lock"
+    elif name in _SUBPROC_WAITS and not timed and recv in (
+        "subprocess",
+        "p",
+        "proc",
+        "popen",
+    ):
+        msg = f"{recv}.{name}() with no timeout while holding a lock"
+    elif name == "urlopen":
+        msg = "network call (urlopen) while holding a lock"
+    elif recv and _CLIENTY.search(recv):
+        msg = (
+            f"RPC/API call {recv}.{name}() while holding a lock — the "
+            "client blocks for its whole retry budget"
+        )
+    if msg is None:
+        return None
+    return Violation(
+        PASS_ID, ctx.rel, call.lineno, msg, code=ctx.code_at(call.lineno)
+    )

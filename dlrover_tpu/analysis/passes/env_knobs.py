@@ -1,0 +1,320 @@
+"""env-knobs: every ``DLROVER_*`` variable lives in the typed registry.
+
+Incident (PR 4): env knobs kept drifting out of the docs — a knob wired
+into the runtime but invisible to operators. PR 4 added an ad-hoc
+doc-lint test with its own exemption list; this pass replaces it with a
+single source of truth: ``common/constants.py::ENV_KNOBS``, a typed
+registry every ``DLROVER_*`` name must be declared in. The invariant is
+*documented ⇔ registered ⇔ referenced*:
+
+- (per file) every ``os.environ`` / ``os.getenv`` access of a
+  ``DLROVER_*`` name must name a registered knob — an unregistered
+  knob is typo-prone, undocumented, and invisible to ``apply_env``
+  tooling;
+- (repo) every ``DLROVER_*`` token anywhere in runtime source must be a
+  registered name or a prefix of one (prose like ``DLROVER_RPC_*``);
+- (repo) every registered *operator-tunable* knob (``internal=False``)
+  must appear in the docs corpus (README.md + docs/*.md);
+- (repo) every registered knob must still be referenced — by a literal
+  in source, or through its declared ``Context`` field
+  (``context_field``) — a stale registry entry is an error, so the
+  exemption list can never rot (the staleness check PR 4's test did by
+  hand);
+- (repo) every ``Context`` dataclass field of a scalar type must have
+  its derived ``DLROVER_<UPPER>`` knob registered (``apply_env``
+  accepts the env var whether or not anyone wrote it down — this makes
+  writing it down mandatory);
+- (repo) every ``DLROVER_*`` token in the docs corpus must be
+  registered or a prefix of a registered name (no documenting knobs
+  that do not exist).
+
+Internal process-contract variables (agent→worker env contract, bench
+plumbing) are registered with ``internal=True`` — exempt from the docs
+requirement but still subject to every other rule.
+"""
+
+import ast
+import importlib.util
+import os
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import FileContext, Violation, call_name, dotted_name
+
+PASS_ID = "env-knobs"
+
+_ENV_TOKEN = re.compile(r"DLROVER_[A-Z0-9]+(?:_[A-Z0-9]+)*")
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str"}
+
+_CONSTANTS_REL = os.path.join("dlrover_tpu", "common", "constants.py")
+_CONFIG_REL = os.path.join("dlrover_tpu", "common", "config.py")
+_CONSTANTS_POSIX = "dlrover_tpu/common/constants.py"
+
+
+def context_fields(root: str) -> List[Tuple[str, str]]:
+    """(field_name, annotation) for Context's scalar dataclass fields,
+    by AST so the runtime config module is never imported."""
+    path = os.path.join(root, _CONFIG_REL)
+    if not os.path.exists(path):
+        return []
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    out: List[Tuple[str, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Context":
+            for st in node.body:
+                if isinstance(st, ast.AnnAssign) and isinstance(
+                    st.target, ast.Name
+                ):
+                    ann = ""
+                    if isinstance(st.annotation, ast.Name):
+                        ann = st.annotation.id
+                    out.append((st.target.id, ann))
+    return out
+
+
+def _env_access_name(call: ast.Call) -> object:
+    """The name expression of an env access, or None.
+
+    Matches ``os.getenv(X, ...)``, ``os.environ.get(X, ...)``,
+    ``os.environ.setdefault(X, ...)``, ``os.environ.pop(X, ...)``."""
+    dn = dotted_name(call.func)
+    name = call_name(call)
+    if dn in ("os.getenv", "getenv"):
+        return call.args[0] if call.args else None
+    if name in ("get", "setdefault", "pop") and isinstance(
+        call.func, ast.Attribute
+    ):
+        recv = dotted_name(call.func.value)
+        if recv in ("os.environ", "environ"):
+            return call.args[0] if call.args else None
+    return None
+
+
+def _literal_knob(expr: ast.AST, constants) -> str:
+    """Resolve an env-name expression to a DLROVER_* string: a literal,
+    or a ``NodeEnv.X``-style attribute on a constants-module class."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value if expr.value.startswith("DLROVER_") else ""
+    if constants is not None and isinstance(expr, ast.Attribute):
+        dn = dotted_name(expr)
+        parts = dn.split(".")
+        obj = constants
+        # e.g. NodeEnv.MASTER_ADDR (drop any leading module aliases)
+        for p in parts:
+            obj = getattr(obj, p, None)
+            if obj is None:
+                obj = constants
+                continue
+        if isinstance(obj, str) and obj.startswith("DLROVER_"):
+            return obj
+    return ""
+
+
+class EnvKnobsPass:
+    """Stateful so the registry is loaded once per run."""
+
+    pass_id = PASS_ID
+
+    def __init__(self):
+        self._registry = None
+        self._constants_mod = None
+        self._root = None
+
+    def _ensure(self, root: str):
+        if self._root != root:
+            self._root = root
+            path = os.path.join(root, _CONSTANTS_REL)
+            spec = importlib.util.spec_from_file_location(
+                "_tpulint_constants", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            self._constants_mod = mod
+            self._registry = dict(getattr(mod, "ENV_KNOBS", {}))
+
+    # -- per-file ----------------------------------------------------------
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        # the file's own repo root: constants.py sits two levels up from
+        # common/, three from deeper packages — derive from rel path
+        root = ctx.path[: -len(ctx.rel) - 1] if ctx.path.endswith(ctx.rel.replace("/", os.sep)) else None
+        if root is None or not os.path.exists(
+            os.path.join(root, _CONSTANTS_REL)
+        ):
+            return
+        self._ensure(root)
+        if self._registry is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name_expr = _env_access_name(node)
+            if name_expr is None:
+                continue
+            knob = _literal_knob(name_expr, self._constants_mod)
+            if knob and knob not in self._registry:
+                yield Violation(
+                    PASS_ID,
+                    ctx.rel,
+                    node.lineno,
+                    f"env access of unregistered knob {knob!r} — declare "
+                    "it in common/constants.py ENV_KNOBS (type, default, "
+                    "doc, internal flag)",
+                    code=ctx.code_at(node.lineno),
+                )
+
+    # -- repo-level --------------------------------------------------------
+
+    def repo_check(
+        self, root: str, contexts: List[FileContext]
+    ) -> Iterable[Violation]:
+        if not os.path.exists(os.path.join(root, _CONSTANTS_REL)):
+            return
+        self._ensure(root)
+        registry = self._registry or {}
+        names = set(registry)
+
+        def covered(token: str) -> bool:
+            return token in names or any(
+                n.startswith(token + "_") for n in names
+            )
+
+        # 1. every token in runtime source is registered (or a prefix).
+        # Scanned from disk, not from the lint target set: staleness and
+        # reference checks must see the whole package even when only a
+        # subdirectory is being linted.
+        seen_tokens: Dict[str, Tuple[str, int]] = {}
+        # Reference set for the staleness rule (4): tokens OUTSIDE
+        # constants.py — the registry's own declaration of a knob must
+        # not count as a "reference" or the staleness check is vacuous.
+        # Attribute-style usages (os.getenv(NodeEnv.MASTER_ADDR)) are
+        # resolved through the loaded constants module: many contract
+        # vars appear as a literal ONLY in the NodeEnv class.
+        ref_tokens: Set[str] = set()
+        attr_re = re.compile(r"\bNodeEnv\.([A-Z][A-Z0-9_]*)\b")
+        node_env = getattr(self._constants_mod, "NodeEnv", None)
+        pkg = os.path.join(root, "dlrover_tpu")
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fn)
+                rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+                try:
+                    text = open(fpath, encoding="utf-8").read()
+                except OSError:
+                    continue
+                is_registry = rel == _CONSTANTS_POSIX
+                for i, line in enumerate(text.splitlines(), start=1):
+                    for m in _ENV_TOKEN.finditer(line):
+                        seen_tokens.setdefault(m.group(0), (rel, i))
+                        if not is_registry:
+                            ref_tokens.add(m.group(0))
+                if not is_registry and node_env is not None:
+                    for m in attr_re.finditer(text):
+                        val = getattr(node_env, m.group(1), None)
+                        if isinstance(val, str):
+                            ref_tokens.add(val)
+        for tok, (rel, line) in sorted(seen_tokens.items()):
+            if not covered(tok):
+                yield Violation(
+                    PASS_ID,
+                    rel,
+                    line,
+                    f"{tok!r} referenced in source but not registered in "
+                    "ENV_KNOBS — register it (or fix the name)",
+                    code=tok,
+                )
+
+        # 2. docs coverage for operator-tunable knobs; 3. docs tokens
+        #    must be registered
+        corpus, doc_tokens = _doc_corpus(root)
+        for name in sorted(names):
+            knob = registry[name]
+            if getattr(knob, "internal", False):
+                continue
+            if name not in corpus:
+                yield Violation(
+                    PASS_ID,
+                    _CONSTANTS_POSIX,
+                    0,
+                    f"operator-tunable knob {name!r} is registered but "
+                    "undocumented — add it to README.md or docs/ (the "
+                    "docs/analysis.md knob table)",
+                    code=f"undocumented:{name}",
+                )
+        for tok, src in sorted(doc_tokens.items()):
+            if not covered(tok):
+                yield Violation(
+                    PASS_ID,
+                    src,
+                    0,
+                    f"{tok!r} appears in the docs but is not a registered "
+                    "knob — fix the docs or register it",
+                    code=f"doc-unknown:{tok}",
+                )
+
+        # 4. staleness: every registered knob must still be referenced
+        # OUTSIDE its own registry entry (literal token, resolved
+        # NodeEnv attribute, or its declared Context field)
+        ctx_fields = {f for f, _ann in context_fields(root)}
+        for name in sorted(names):
+            knob = registry[name]
+            cf = getattr(knob, "context_field", "")
+            referenced = name in ref_tokens or (cf and cf in ctx_fields)
+            if not referenced:
+                yield Violation(
+                    PASS_ID,
+                    _CONSTANTS_POSIX,
+                    0,
+                    f"registered knob {name!r} is no longer referenced "
+                    "anywhere in dlrover_tpu/ — delete the entry (the "
+                    "registry must not rot)",
+                    code=f"stale:{name}",
+                )
+
+        # 5. every scalar Context field has its derived knob registered
+        for field, ann in context_fields(root):
+            if ann not in _SCALAR_ANNOTATIONS:
+                continue
+            derived = "DLROVER_" + field.upper()
+            if derived not in names:
+                yield Violation(
+                    PASS_ID,
+                    _CONSTANTS_POSIX,
+                    0,
+                    f"Context.{field} is env-overridable as {derived!r} "
+                    "but unregistered — apply_env accepts it whether or "
+                    "not it is written down; register it",
+                    code=f"context-unregistered:{derived}",
+                )
+
+
+def _doc_corpus(root: str) -> Tuple[str, Dict[str, str]]:
+    texts: List[str] = []
+    tokens: Dict[str, str] = {}
+    candidates = [os.path.join(root, "README.md")]
+    docs = os.path.join(root, "docs")
+    if os.path.isdir(docs):
+        candidates.extend(
+            os.path.join(docs, n)
+            for n in sorted(os.listdir(docs))
+            if n.endswith(".md")
+        )
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        text = open(path, encoding="utf-8").read()
+        texts.append(text)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        for m in _ENV_TOKEN.finditer(text):
+            tokens.setdefault(m.group(0), rel)
+    return "\n".join(texts), tokens
+
+
+# the runner instantiates stateless module-level passes via functions;
+# this one is a singleton object
+PASS = EnvKnobsPass()
+check_file = PASS.check_file
+repo_check = PASS.repo_check

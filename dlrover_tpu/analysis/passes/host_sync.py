@@ -1,0 +1,142 @@
+"""host-sync: no device→host synchronization inside a hot path.
+
+Incident (PR 2 / PR 4): one stray ``float(logits)`` in the serving
+round serializes host and device — the whole point of the overlapped
+decode pipeline (PR 2) and the prefetched input pipeline (PR 4) is
+that the device never waits for host bookkeeping. The same applies to
+the train-loop step path (a per-step ``float(loss)`` halves step rate
+on small models) and to jitted function bodies (where a host sync is a
+tracer leak waiting to happen).
+
+Hot paths are explicit, not guessed:
+
+- any function decorated with ``jax.jit`` / ``pjit`` / ``jit`` (bare or
+  via ``functools.partial``), and
+- any function whose ``def`` carries a ``# tpulint: hotpath`` marker on
+  the def line or the comment line directly above it.
+
+Inside a hot function's own body (nested defs excluded — an inner
+jitted fn is its own region) the pass flags:
+
+- ``float(...)`` on non-literal arguments (the incident call),
+  ``.item()``, ``.tolist()``,
+- ``np.asarray`` / ``np.array`` / ``jax.device_get`` /
+  ``block_until_ready``,
+- per-call heavy imports (``import jax`` / ``import numpy`` inside the
+  hot body — the importlib machinery is host work on every call).
+
+Designed sync points (the pipeline drain, the sync A/B baseline, a
+log-cadence scalar fetch) stay — with an inline
+``# tpulint: ignore[host-sync] <reason>`` that documents *why* the
+sync is intentional, which is exactly the review trail PR 2 had to
+reconstruct by hand.
+"""
+
+import ast
+from typing import Iterable, Set
+
+from ..core import FileContext, Violation, call_name, dotted_name, walk_skip_defs
+
+PASS_ID = "host-sync"
+
+_JIT_NAMES = {"jit", "pjit"}
+_SYNC_CALLS = {"item", "tolist", "block_until_ready"}
+_SYNC_DOTTED = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+    "jax.block_until_ready",
+}
+_HEAVY_IMPORTS = {"jax", "numpy"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        # functools.partial(jax.jit, ...) or jax.jit(static_argnums=...)
+        fn = dec.func
+        if dotted_name(fn).endswith("partial") and dec.args:
+            return _is_jit_decorator(dec.args[0])
+        return _is_jit_decorator(fn)
+    dn = dotted_name(dec)
+    return dn.split(".")[-1] in _JIT_NAMES
+
+
+def _hot_functions(ctx: FileContext) -> Iterable[ast.FunctionDef]:
+    marker_lines: Set[int] = set(ctx.hotpath_lines)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            yield node
+            continue
+        # marker on the def line, or on the comment line(s) directly
+        # above the def (skipping decorators)
+        first = min(
+            [node.lineno] + [d.lineno for d in node.decorator_list]
+        )
+        probe = {node.lineno, first - 1}
+        probe.update(
+            ln
+            for ln in marker_lines
+            if first - 3 <= ln <= node.lineno
+        )
+        if probe & marker_lines:
+            yield node
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    for fn in _hot_functions(ctx):
+        for st in fn.body:
+            for node in walk_skip_defs(st):
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mods = (
+                        [a.name for a in node.names]
+                        if isinstance(node, ast.Import)
+                        else [node.module or ""]
+                    )
+                    for m in mods:
+                        if m.split(".")[0] in _HEAVY_IMPORTS:
+                            yield Violation(
+                                PASS_ID,
+                                ctx.rel,
+                                node.lineno,
+                                f"per-call import of {m!r} inside hot "
+                                f"path {fn.name!r} — hoist to module "
+                                "level (or a module-local memo)",
+                                code=ctx.code_at(node.lineno),
+                            )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                dn = dotted_name(node.func)
+                sync = None
+                if dn in _SYNC_DOTTED:
+                    sync = dn
+                elif name in _SYNC_CALLS and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    sync = f".{name}()"
+                elif (
+                    # float() is the incident call (PR 2's serving
+                    # round, PR 4's train loop); int()/bool() on
+                    # non-array values are everywhere and would bury
+                    # the signal in suppressions
+                    name == "float"
+                    and isinstance(node.func, ast.Name)
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    sync = f"{name}()"
+                if sync is not None:
+                    yield Violation(
+                        PASS_ID,
+                        ctx.rel,
+                        node.lineno,
+                        f"host sync {sync} inside hot path {fn.name!r} "
+                        "— breaks the decode/input overlap; move it to "
+                        "the drain point or suppress with the reason",
+                        code=ctx.code_at(node.lineno),
+                    )

@@ -1,0 +1,147 @@
+"""import-purity: importing a runtime module must have no side effects.
+
+Incident (PR 4): ``goodput_storm`` set the persistent XLA compile cache
+by calling ``jax.config.update`` at module import ("STORM_CACHE_DIR
+hack") — every process that merely *imported* the module got its jax
+config mutated, and the fix had to re-plumb the knob through Context.
+Import-time ``jax.distributed.initialize`` is worse (it binds sockets),
+and an import-time ``os.environ`` write or thread/process start makes
+import order load-bearing across the whole runtime.
+
+Rule: at import time (module body, including module-level ``if``/
+``try``/``with`` bodies and class bodies, which also execute at import)
+a runtime module must not
+
+- call ``jax.config.update`` / ``jax.distributed.initialize``,
+- mutate ``os.environ`` (subscript assign, ``setdefault``, ``pop``,
+  ``update``, ``putenv``),
+- start a thread/process (``*.start()``, ``threading.Thread``,
+  ``multiprocessing.Process``, ``subprocess.Popen``, ``os.fork``) or
+  install signal handlers,
+- call ``multiprocessing.set_start_method``.
+
+A ``if __name__ == "__main__":`` block is exempt (that's a program, not
+an import). Function and lambda bodies are exempt — they run when
+called, not when imported.
+"""
+
+import ast
+from typing import Iterable, List
+
+from ..core import FileContext, Violation, call_name, dotted_name
+
+PASS_ID = "import-purity"
+
+_BANNED_DOTTED = {
+    "jax.config.update": "jax config mutated at import",
+    "jax.distributed.initialize": "jax.distributed.initialize at import",
+    "multiprocessing.set_start_method": "start-method pinned at import",
+    "os.fork": "process forked at import",
+    "os.putenv": "environment mutated at import",
+    "signal.signal": "signal handler installed at import",
+}
+
+_BANNED_CTORS = {
+    "threading.Thread",
+    "multiprocessing.Process",
+    "subprocess.Popen",
+}
+
+_ENV_MUTATORS = {"setdefault", "pop", "update", "__setitem__"}
+
+
+def _is_main_guard(node: ast.AST) -> bool:
+    if not isinstance(node, ast.If):
+        return False
+    t = node.test
+    return (
+        isinstance(t, ast.Compare)
+        and isinstance(t.left, ast.Name)
+        and t.left.id == "__name__"
+    )
+
+
+def _import_time_nodes(tree: ast.Module) -> Iterable[ast.AST]:
+    """Every node whose code executes at import: reachable from the
+    module body WITHOUT entering function/lambda bodies (class bodies
+    do execute at import and are included)."""
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        if _is_main_guard(n):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    for node in _import_time_nodes(ctx.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript) and dotted_name(t.value) in (
+                "os.environ",
+                "environ",
+            ):
+                yield Violation(
+                    PASS_ID,
+                    ctx.rel,
+                    node.lineno,
+                    "os.environ mutated at module import time — make it "
+                    "a Context knob or move it under the caller",
+                    code=ctx.code_at(node.lineno),
+                )
+
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn in _BANNED_DOTTED:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                node.lineno,
+                f"{_BANNED_DOTTED[dn]} ({dn!r}) — importing this "
+                "module must be side-effect free",
+                code=ctx.code_at(node.lineno),
+            )
+            continue
+        if dn in _BANNED_CTORS:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                node.lineno,
+                f"{dn} constructed at module import time",
+                code=ctx.code_at(node.lineno),
+            )
+            continue
+        name = call_name(node)
+        recv = (
+            dotted_name(node.func.value)
+            if isinstance(node.func, ast.Attribute)
+            else ""
+        )
+        if recv in ("os.environ", "environ") and name in _ENV_MUTATORS:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                node.lineno,
+                f"os.environ.{name}() at module import time",
+                code=ctx.code_at(node.lineno),
+            )
+        elif name == "start" and isinstance(node.func, ast.Attribute):
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                node.lineno,
+                "thread/process started at module import time",
+                code=ctx.code_at(node.lineno),
+            )

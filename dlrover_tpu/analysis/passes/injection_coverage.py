@@ -1,0 +1,148 @@
+"""injection-coverage: every chaos injection point is exercised.
+
+Incident (PR 3): the fault plan grammar rejects unknown point names
+precisely because a typo'd point would "pass" every recovery test by
+never firing. The same failure mode exists one level up: an injection
+point wired through the runtime but never *exercised* by any test is a
+recovery path nobody has ever actually broken — new points can ship
+untested and the first real exercise is production chaos.
+
+Rule: every key of ``chaos/faults.INJECTION_POINTS`` must appear (as a
+string) in at least one file under ``tests/`` — directly, or through a
+named scenario: a point referenced by ``chaos/scenarios.py`` counts as
+covered **because** the pass separately requires every registered
+scenario name (``SCENARIOS`` keys) to be exercised by tests, so the
+indirection cannot dangle. Both dicts are read from the AST, never by
+importing the chaos package.
+"""
+
+import ast
+import os
+from typing import Iterable, List, Tuple
+
+from ..core import FileContext, Violation
+
+PASS_ID = "injection-coverage"
+
+_FAULTS_REL = os.path.join("dlrover_tpu", "chaos", "faults.py")
+_FAULTS_POSIX = "dlrover_tpu/chaos/faults.py"
+_SCENARIOS_REL = os.path.join("dlrover_tpu", "chaos", "scenarios.py")
+_SCENARIOS_POSIX = "dlrover_tpu/chaos/scenarios.py"
+
+
+def scenario_names(scenarios_path: str) -> List[Tuple[str, int]]:
+    """(name, line) for every SCENARIOS registry key, by AST."""
+    if not os.path.exists(scenarios_path):
+        return []
+    tree = ast.parse(open(scenarios_path, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.target is not None:
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id == "SCENARIOS"
+                and isinstance(getattr(node, "value", None), ast.Dict)
+            ):
+                return [
+                    (k.value, k.lineno)
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                ]
+    return []
+
+
+def injection_points(
+    faults_path: str,
+) -> List[Tuple[str, int]]:
+    """(point_name, line) for every INJECTION_POINTS key, by AST."""
+    if not os.path.exists(faults_path):
+        return []
+    tree = ast.parse(open(faults_path, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.target is not None:
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Name)
+                and t.id == "INJECTION_POINTS"
+                and isinstance(getattr(node, "value", None), ast.Dict)
+            ):
+                return [
+                    (k.value, k.lineno)
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                ]
+    return []
+
+
+def tests_corpus(tests_dir: str) -> str:
+    texts = []
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                texts.append(
+                    open(
+                        os.path.join(dirpath, fn), encoding="utf-8"
+                    ).read()
+                )
+    return "\n".join(texts)
+
+
+def check_coverage(
+    faults_path: str,
+    tests_dir: str,
+    scenarios_path: str = "",
+    rel: str = _FAULTS_POSIX,
+    scenarios_rel: str = _SCENARIOS_POSIX,
+) -> Iterable[Violation]:
+    points = injection_points(faults_path)
+    if not points:
+        return
+    corpus = tests_corpus(tests_dir) if os.path.isdir(tests_dir) else ""
+    scenarios_src = ""
+    if scenarios_path and os.path.exists(scenarios_path):
+        scenarios_src = open(scenarios_path, encoding="utf-8").read()
+        # a scenario only extends coverage if it is itself exercised
+        for name, line in scenario_names(scenarios_path):
+            if name not in corpus:
+                yield Violation(
+                    PASS_ID,
+                    scenarios_rel,
+                    line,
+                    f"scenario {name!r} is registered but exercised by "
+                    "no test under tests/ — its injection points would "
+                    "count as covered through a drill nobody runs",
+                    code=f"scenario:{name}",
+                )
+    for name, line in points:
+        if name not in corpus and name not in scenarios_src:
+            yield Violation(
+                PASS_ID,
+                rel,
+                line,
+                f"injection point {name!r} is exercised by no test under "
+                "tests/ (directly or via a named scenario) — a recovery "
+                "path nobody has ever broken; add a drill (see "
+                "tests/test_faults.py)",
+                code=name,
+            )
+
+
+def repo_check(
+    root: str, contexts: List[FileContext]
+) -> Iterable[Violation]:
+    yield from check_coverage(
+        os.path.join(root, _FAULTS_REL),
+        os.path.join(root, "tests"),
+        scenarios_path=os.path.join(root, _SCENARIOS_REL),
+    )

@@ -1,0 +1,129 @@
+"""rpc-deadline: every RPC carries a deadline sourced from Context.
+
+Incident (PR 3): the master client shipped three hard-coded 30s
+timeouts; under a chaos storm every retry path waited the same fixed
+30s with no backoff, and tuning recovery SLOs meant editing source.
+PR 3 re-plumbed them as ``Context.rpc_deadline_s``/``rpc_retries``/
+``rpc_backoff_*`` — this pass keeps the next RPC surface from
+regressing to a literal.
+
+Rule, applied to RPC call surfaces (``urlopen``, gRPC channel/stub
+calls, and any call on a ``channel``/``stub``/``transport`` receiver):
+
+- the call must pass ``timeout=`` (an RPC with *no* deadline blocks
+  forever on a dark master), and
+- the value must not be a numeric literal — it must be a name/attribute
+  ultimately sourced from ``Context`` (``ctx.rpc_deadline_s``, a
+  constructor-injected ``self._deadline_s``, a parameter default
+  resolved from ``get_context()``).
+
+Additionally, inside the ``rpc/`` package, function parameter defaults
+named ``deadline*``/``timeout*`` must not be numeric literals — default
+``None`` and resolve from ``get_context()`` at call time, so one env
+override (``DLROVER_RPC_DEADLINE_S``) reaches every transport.
+"""
+
+import ast
+import re
+from typing import Iterable
+
+from ..core import (
+    FileContext,
+    Violation,
+    call_name,
+    is_number,
+    keyword_map,
+    receiver_name,
+)
+
+PASS_ID = "rpc-deadline"
+
+_RPCISH_RECV = re.compile(r"(channel|stub|transport)", re.I)
+_DEADLINE_PARAM = re.compile(r"^(deadline|timeout)", re.I)
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    in_rpc_pkg = "/rpc/" in f"/{ctx.rel}"
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            yield from _check_call(ctx, node)
+        elif in_rpc_pkg and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            yield from _check_defaults(ctx, node)
+
+
+def _check_call(ctx: FileContext, call: ast.Call) -> Iterable[Violation]:
+    name = call_name(call)
+    recv = receiver_name(call)
+    is_urlopen = name == "urlopen"
+    is_rpcish = bool(recv and _RPCISH_RECV.search(recv)) or bool(
+        _RPCISH_RECV.search(name)
+    )
+    if not (is_urlopen or is_rpcish):
+        return
+    kw = keyword_map(call)
+    timeout = kw.get("timeout", kw.get("deadline", kw.get("deadline_s")))
+    if timeout is None:
+        # urlopen's positional timeout is arg 2
+        if is_urlopen and len(call.args) >= 3:
+            timeout = call.args[2]
+    if timeout is None:
+        # Only urlopen is REQUIRED to carry an explicit deadline: a
+        # channel/stub receiver also matches setup/teardown calls
+        # (unary_unary, close) whose deadline lives elsewhere.
+        if is_urlopen:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                call.lineno,
+                "urlopen() with no deadline — it blocks forever on a "
+                "dark peer; pass timeout= from Context",
+                code=ctx.code_at(call.lineno),
+            )
+    elif is_number(timeout):
+        surface = "urlopen" if is_urlopen else f"{recv}.{name}"
+        yield Violation(
+            PASS_ID,
+            ctx.rel,
+            call.lineno,
+            f"hard-coded deadline on RPC call {surface}() — source it "
+            "from Context (rpc_deadline_s) so operators can tune "
+            "recovery SLOs without editing source",
+            code=ctx.code_at(call.lineno),
+        )
+
+
+def _check_defaults(
+    ctx: FileContext, fn: ast.FunctionDef
+) -> Iterable[Violation]:
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    defaults = list(args.defaults)
+    # align defaults to the tail of positional args
+    for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+        if _DEADLINE_PARAM.match(arg.arg) and is_number(default):
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                default.lineno,
+                f"literal default for {fn.name}({arg.arg}=...) in the "
+                "rpc package — default None and resolve from "
+                "get_context() so DLROVER_RPC_* overrides reach it",
+                code=ctx.code_at(default.lineno),
+            )
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if (
+            default is not None
+            and _DEADLINE_PARAM.match(arg.arg)
+            and is_number(default)
+        ):
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                default.lineno,
+                f"literal default for {fn.name}({arg.arg}=...) in the "
+                "rpc package — default None and resolve from "
+                "get_context() so DLROVER_RPC_* overrides reach it",
+                code=ctx.code_at(default.lineno),
+            )

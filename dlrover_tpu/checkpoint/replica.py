@@ -32,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from ..chaos import faults
+from ..common.config import get_context
 from ..common.log import logger
 from ..common.multi_process import SharedMemorySegment
 from .meta import HEADER_LEN_BYTES, CheckpointMeta
@@ -312,9 +313,14 @@ class ReplicaClient:
         owner_rank: int,
         total: int,
         read: Callable[[int, int], bytes],
-        timeout: float = 120.0,
+        timeout: Optional[float] = None,
     ) -> bool:
-        """PUT ``total`` bytes (``read(offset, n)``) as rank's shard."""
+        """PUT ``total`` bytes (``read(offset, n)``) as rank's shard.
+
+        ``timeout`` None → ``Context.ckpt_replica_timeout_s``
+        (DLROVER_CKPT_REPLICA_TIMEOUT_S): replica transfers move whole
+        shard images, so they get their own deadline knob rather than
+        the control-plane ``rpc_deadline_s``."""
 
         class _Reader:
             def __init__(self):
@@ -337,6 +343,8 @@ class ReplicaClient:
             # Chaos hook inside the try: an injected push failure rides
             # the real log-and-drop path (replication is best-effort).
             faults.inject("ckpt.replica.push", rank=owner_rank, addr=addr)
+            if timeout is None:
+                timeout = get_context().ckpt_replica_timeout_s
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status == 200
         except Exception as e:
@@ -348,7 +356,7 @@ class ReplicaClient:
         addr: str,
         owner_rank: int,
         sink: Callable[[int, Callable[[int], bytes]], None],
-        timeout: float = 30.0,
+        timeout: Optional[float] = None,
     ) -> bool:
         """GET rank's shard from ``addr``; call ``sink(total, read)``."""
         req = urllib.request.Request(
@@ -359,6 +367,8 @@ class ReplicaClient:
             # Chaos hook: peer-replica loss mid-restore — the engine's
             # load must continue down the fallback chain to storage.
             faults.inject("ckpt.replica.fetch", rank=owner_rank, addr=addr)
+            if timeout is None:
+                timeout = get_context().ckpt_replica_timeout_s
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 total = int(resp.headers.get("Content-Length", 0))
                 if resp.status != 200 or total <= 0:

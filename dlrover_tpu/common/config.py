@@ -62,6 +62,10 @@ class Context:
     # background at construction, so it overlaps model build + compile
     # instead of serializing after them.
     ckpt_prefetch_restore: bool = True
+    # Peer-replica shard transfers (checkpoint/replica.py) move whole
+    # shard images — their deadline is separate from the control-plane
+    # rpc_deadline_s (DLROVER_CKPT_REPLICA_TIMEOUT_S override).
+    ckpt_replica_timeout_s: float = 120.0
 
     # Persistent XLA compilation cache shared by every process of the
     # job (common/compile_cache.py); empty disables it. Recompiles

@@ -4,7 +4,18 @@ Re-creates the vocabulary of the reference runtime
 (``dlrover/python/common/constants.py``) for a TPU/JAX world: nodes are TPU
 hosts, the data plane is ICI/DCN via XLA collectives, and elasticity operates
 at slice granularity (``node_unit``).
+
+Also home of :data:`ENV_KNOBS`, the typed registry of every ``DLROVER_*``
+environment variable the runtime reads or writes — the single source of
+truth the ``tpurun-lint`` env-knobs pass enforces (documented ⇔
+registered ⇔ referenced; see docs/analysis.md). This module must stay
+stdlib-pure: the lint suite loads it standalone, without importing the
+package.
 """
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
 
 
 class NodeType:
@@ -144,7 +155,6 @@ class NodeEnv:
     NUM_PROCESSES = "DLROVER_NUM_PROCESSES"
     PROCESS_ID = "DLROVER_PROCESS_ID"
     RESTART_COUNT = "DLROVER_RESTART_COUNT"
-    MONITOR_ENABLED = "DLROVER_MONITOR_ENABLED"
     AUTO_TUNNING = "DLROVER_AUTO_TUNNING"
 
 
@@ -183,3 +193,158 @@ class DefaultValues:
     MONITOR_INTERVAL_S = 5
     SAVE_AT_BREAKPOINT = True
     SEC_TO_WAIT_PENDING_POD = 900
+
+
+# ---------------------------------------------------------------------------
+# DLROVER_* env-knob registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """One registered ``DLROVER_*`` environment variable.
+
+    ``internal=True`` marks a process-contract variable: set BY the
+    runtime for its own child processes (agent→worker env contract,
+    harness→bench plumbing), never tuned by an operator — exempt from
+    the documentation requirement but still registry-checked.
+    ``context_field`` links a knob to the ``Context`` dataclass field it
+    overrides via ``Context.apply_env`` (those knobs may never appear as
+    a literal in source; the link is what keeps the registry's
+    staleness check honest)."""
+
+    name: str
+    type: str = "str"  # str | int | float | bool
+    doc: str = ""
+    internal: bool = False
+    context_field: str = ""
+
+    def get(self, default=None, environ: Optional[Dict[str, str]] = None):
+        """Typed read of the knob from ``environ`` (default
+        ``os.environ``). The one sanctioned accessor for call sites
+        that do not go through ``Context.apply_env``."""
+        env = os.environ if environ is None else environ
+        raw = env.get(self.name)
+        if raw is None:
+            return default
+        if self.type == "int":
+            return int(raw)
+        if self.type == "float":
+            return float(raw)
+        if self.type == "bool":
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return raw
+
+
+def _knobs(*knobs: EnvKnob) -> Dict[str, EnvKnob]:
+    reg: Dict[str, EnvKnob] = {}
+    for k in knobs:
+        if k.name in reg:
+            raise ValueError(f"duplicate env knob {k.name}")
+        reg[k.name] = k
+    return reg
+
+
+ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
+    # -- agent → worker process contract (internal) ------------------------
+    EnvKnob(NodeEnv.MASTER_ADDR, doc="master control-plane address", internal=True),
+    EnvKnob(NodeEnv.JOB_NAME, doc="job name", internal=True),
+    EnvKnob(NodeEnv.NODE_ID, "int", doc="node id", internal=True),
+    EnvKnob(NodeEnv.NODE_RANK, "int", doc="node rank this round", internal=True),
+    EnvKnob(NodeEnv.NODE_NUM, "int", doc="CURRENT world size (clobbered per round)", internal=True),
+    EnvKnob(NodeEnv.MAX_NODES, "int", doc="static job maximum world size", internal=True),
+    EnvKnob(NodeEnv.NODE_UNIT, "int", doc="slice granularity (hosts per slice)", internal=True),
+    EnvKnob(NodeEnv.COORDINATOR_ADDRESS, doc="jax.distributed coordinator", internal=True),
+    EnvKnob(NodeEnv.NUM_PROCESSES, "int", doc="jax.distributed world size", internal=True),
+    EnvKnob(NodeEnv.PROCESS_ID, "int", doc="jax.distributed process id", internal=True),
+    EnvKnob(NodeEnv.RESTART_COUNT, "int", doc="restarts of this worker so far", internal=True),
+    EnvKnob(NodeEnv.AUTO_TUNNING, "bool", doc="hyperparam auto-tuning contract flag", internal=True),
+    EnvKnob("DLROVER_MASTER_HOST", doc="master bind host (launcher contract)", internal=True),
+    EnvKnob("DLROVER_MASTER_SERVICE_ADDR", doc="master service address (unified contract)", internal=True),
+    EnvKnob("DLROVER_NODE_SLOT", "int", doc="warm-spare slot index", internal=True),
+    EnvKnob("DLROVER_ROUND", "int", doc="rendezvous round (agent contract)", internal=True),
+    EnvKnob("DLROVER_JOB_UID", doc="k8s owner uid for pod GC scoping", internal=True),
+    EnvKnob("DLROVER_REMESH_DIR", doc="soft-remesh handshake directory", internal=True),
+    EnvKnob("DLROVER_REPLICA_TOKEN", doc="replica peer-fetch auth token", internal=True),
+    EnvKnob("DLROVER_WARM_READY_FILE", doc="warm-spare readiness marker file", internal=True),
+    EnvKnob("DLROVER_WORKER_COMMAND", doc="worker launch command (scaler contract)", internal=True),
+    EnvKnob("DLROVER_WORKER_IMAGE", doc="worker container image (scaler contract)", internal=True),
+    EnvKnob("DLROVER_IPC_NAMESPACE", doc="shm/socket namespace isolating saver IPC", internal=True),
+    EnvKnob("DLROVER_TT_PORT", "int", doc="native interposer metrics port (agent contract)", internal=True),
+    EnvKnob("DLROVER_UNIFIED_JOB", doc="unified job name (manager contract)", internal=True),
+    EnvKnob("DLROVER_UNIFIED_COMM_TOKEN", doc="unified comm auth token", internal=True),
+    EnvKnob("DLROVER_ROLE", doc="unified role name (manager contract)", internal=True),
+    EnvKnob("DLROVER_ROLE_INDEX", "int", doc="rank within the unified role", internal=True),
+    EnvKnob("DLROVER_ROLE_WORLD", "int", doc="unified role world size", internal=True),
+    EnvKnob("DLROVER_ROLE_WORLDS", doc="JSON {role: world} map for peer groups", internal=True),
+    EnvKnob("DLROVER_LOCAL_DEVICES", "int", doc="device count visible to a CPU-mesh worker", internal=True),
+    # -- bench / chip-watch plumbing (internal) ----------------------------
+    EnvKnob("DLROVER_BENCH_PROBE_WINDOW_S", "float", doc="probe window budget (harness contract)", internal=True),
+    EnvKnob("DLROVER_BENCH_TOTAL_BUDGET_S", "float", doc="total bench budget (harness contract)", internal=True),
+    EnvKnob("DLROVER_CHIPWATCH_BENCH_CMD", doc="chip-watch bench command override", internal=True),
+    EnvKnob("DLROVER_CHIPWATCH_PROBE_CMD", doc="chip-watch probe command override", internal=True),
+    EnvKnob("DLROVER_CHIP_WATCHER_LOG", doc="chip-watch log path", internal=True),
+    # -- operator-tunable knobs -------------------------------------------
+    EnvKnob("DLROVER_LOG_LEVEL", doc="runtime log level", context_field="log_level"),
+    EnvKnob("DLROVER_EVENT_DIR", doc="crash/exit event JSON directory"),
+    EnvKnob("DLROVER_IPC_DIR", doc="unix-socket directory for saver IPC"),
+    EnvKnob("DLROVER_PIDFILE_DIR", doc="worker pidfile directory (orphan reaping)"),
+    EnvKnob("DLROVER_TPU_PER_HOST", "int", doc="TPU chips per host for resource accounting"),
+    EnvKnob("DLROVER_RECOVERY_DIR", doc="MTTR phase-attribution spool directory"),
+    EnvKnob("DLROVER_FAULT_PLAN", doc="chaos fault plan (docs/chaos.md grammar)"),
+    EnvKnob("DLROVER_FAULT_LOG", doc="chaos injection JSONL log path"),
+    EnvKnob("DLROVER_CKPT_SAVER_TIMEOUT_S", "float", doc="saver-IPC wedge timeout before standalone fallback"),
+    EnvKnob("DLROVER_INPUT_PREFETCH", "bool", doc="double-buffered input pipeline on/off", context_field="input_prefetch"),
+    EnvKnob("DLROVER_COMPILE_CACHE_DIR", doc="persistent XLA compile cache directory", context_field="compile_cache_dir"),
+    EnvKnob("DLROVER_COMPILE_CACHE_MIN_COMPILE_S", "float", doc="min compile time worth caching", context_field="compile_cache_min_compile_s"),
+    EnvKnob("DLROVER_CKPT_PREFETCH_RESTORE", "bool", doc="overlapped restore prefetch on/off", context_field="ckpt_prefetch_restore"),
+    EnvKnob("DLROVER_CKPT_REPLICA_TIMEOUT_S", "float", doc="peer-replica shard transfer deadline", context_field="ckpt_replica_timeout_s"),
+    EnvKnob("DLROVER_BENCH_STORM", "bool", doc="bench: run the goodput storm section"),
+    EnvKnob("DLROVER_BENCH_SECTIONS", doc="bench: comma list of sections to run"),
+    EnvKnob("DLROVER_PY_TRACE_TARGETS", doc="module:function list for the host tracer"),
+    EnvKnob("DLROVER_STACK_DUMP_DIR", doc="hang-watchdog stack dump directory"),
+    EnvKnob("DLROVER_PROFILE_AXON", "bool", doc="wrap workers with the PJRT interposer"),
+    EnvKnob("DLROVER_PJRT_REAL_PLUGIN", doc="real libtpu path behind the interposer"),
+    EnvKnob("DLROVER_AXON_PJRT_SO", doc="interposer shared-object override"),
+    EnvKnob("DLROVER_SAVED_POOL_IPS", doc="saved tunnel pool IPs for interposer replay"),
+    EnvKnob("DLROVER_UNIFIED_COMM_ADDR", doc="unified cluster KV/queue service address"),
+    EnvKnob("DLROVER_UNIFIED_P2P", "bool", doc="unified payloads: direct P2P transfer on/off"),
+    EnvKnob("DLROVER_UNIFIED_P2P_TTL_S", "float", doc="unified P2P payload TTL"),
+    EnvKnob("DLROVER_UNIFIED_P2P_STORE_CAP", "int", doc="unified P2P store capacity (bytes)"),
+    EnvKnob("DLROVER_UNIFIED_P2P_INLINE_MAX", "int", doc="unified payload inline-size threshold (bytes)"),
+    # -- Context-backed knobs (Context.apply_env reads DLROVER_<FIELD>) ----
+    EnvKnob(NodeEnv.MASTER_SERVICE_TYPE, doc="master comms transport (grpc|http)", context_field="master_service_type"),
+    EnvKnob("DLROVER_MASTER_PORT", "int", doc="master bind port (0 = free port)", context_field="master_port"),
+    EnvKnob("DLROVER_RPC_DEADLINE_S", "float", doc="per-call RPC transport deadline", context_field="rpc_deadline_s"),
+    EnvKnob("DLROVER_RPC_RETRIES", "int", doc="RPC retry budget", context_field="rpc_retries"),
+    EnvKnob("DLROVER_RPC_BACKOFF_BASE_S", "float", doc="RPC backoff base (equal jitter)", context_field="rpc_backoff_base_s"),
+    EnvKnob("DLROVER_RPC_BACKOFF_CAP_S", "float", doc="RPC backoff cap", context_field="rpc_backoff_cap_s"),
+    EnvKnob("DLROVER_RDZV_TIMEOUT_S", "float", doc="rendezvous deadline", context_field="rdzv_timeout_s"),
+    EnvKnob("DLROVER_RDZV_LASTCALL_S", "float", doc="rendezvous last-call window", context_field="rdzv_lastcall_s"),
+    EnvKnob("DLROVER_NODE_CHECK_TIMEOUT_S", "float", doc="node network-check deadline", context_field="node_check_timeout_s"),
+    EnvKnob("DLROVER_MAX_RELAUNCH_COUNT", "int", doc="per-node relaunch budget", context_field="max_relaunch_count"),
+    EnvKnob("DLROVER_RELAUNCH_ALWAYS", "bool", doc="relaunch regardless of exit reason", context_field="relaunch_always"),
+    EnvKnob("DLROVER_RESTART_BUDGET_PER_NODE", "int", doc="agent-local worker restart budget", context_field="restart_budget_per_node"),
+    EnvKnob("DLROVER_HEARTBEAT_INTERVAL_S", "float", doc="agent heartbeat interval", context_field="heartbeat_interval_s"),
+    EnvKnob("DLROVER_HEARTBEAT_DEADLINE_S", "float", doc="master-side dead-node window", context_field="heartbeat_deadline_s"),
+    EnvKnob("DLROVER_MASTER_LOST_TIMEOUT_S", "float", doc="agent aborts after master dark this long", context_field="master_lost_timeout_s"),
+    EnvKnob("DLROVER_MONITOR_INTERVAL_S", "float", doc="resource monitor interval", context_field="monitor_interval_s"),
+    EnvKnob("DLROVER_SECONDS_TO_WAIT_PENDING_POD", "float", doc="pending-pod wait budget", context_field="seconds_to_wait_pending_pod"),
+    EnvKnob("DLROVER_PENDING_FAIL_STRATEGY", "int", doc="pending-pod strategy (0 ignore, 1 abort, 2 relaunch)", context_field="pending_fail_strategy"),
+    EnvKnob("DLROVER_HANG_DOWNTIME_S", "float", doc="hang detector downtime threshold", context_field="hang_downtime_s"),
+    EnvKnob("DLROVER_HANG_DETECTION_ENABLED", "bool", doc="hang detection on/off", context_field="hang_detection_enabled"),
+    EnvKnob("DLROVER_SAVE_AT_BREAKPOINT", "bool", doc="checkpoint at breakpoint on failure", context_field="save_at_breakpoint"),
+    EnvKnob("DLROVER_CKPT_REPLICA_COUNT", "int", doc="peer-memory replicas per shard", context_field="ckpt_replica_count"),
+    EnvKnob("DLROVER_CKPT_KEEP_LATEST", "int", doc="committed steps kept on storage (0 = all)", context_field="ckpt_keep_latest"),
+    EnvKnob("DLROVER_PRECHECK_ENABLED", "bool", doc="pre-check gate on/off", context_field="precheck_enabled"),
+    EnvKnob("DLROVER_PRECHECK_TIMEOUT_S", "float", doc="pre-check deadline", context_field="precheck_timeout_s"),
+    EnvKnob("DLROVER_NETWORK_CHECK_ENABLED", "bool", doc="network check rounds on/off", context_field="network_check_enabled"),
+    EnvKnob("DLROVER_STRAGGLER_MEDIAN_RATIO", "float", doc="straggler threshold vs median", context_field="straggler_median_ratio"),
+    EnvKnob("DLROVER_EXCLUDE_STRAGGLERS", "bool", doc="drop stragglers from the world", context_field="exclude_stragglers"),
+    EnvKnob("DLROVER_AUTO_TUNING_ENABLED", "bool", doc="hyperparam auto-tuning on/off", context_field="auto_tuning_enabled"),
+    EnvKnob("DLROVER_AUTO_SCALING_INTERVAL_S", "float", doc="auto-scaler evaluation interval", context_field="auto_scaling_interval_s"),
+    EnvKnob("DLROVER_BRAIN_ADDR", doc="brain service address (empty = disabled)", context_field="brain_addr"),
+    EnvKnob("DLROVER_BRAIN_REPORT_INTERVAL_S", "float", doc="brain stats report interval", context_field="brain_report_interval_s"),
+    EnvKnob("DLROVER_HOST_MEMORY_MB", "float", doc="host RAM capacity hint for hyperparam strategies", context_field="host_memory_mb"),
+    EnvKnob("DLROVER_INITIAL_BATCH_SIZE", "int", doc="starting per-host dataloader batch size", context_field="initial_batch_size"),
+)

@@ -77,43 +77,73 @@ class PodScaler(Scaler):
         self._stopped.set()
 
     def scale(self, plan: ScalePlan) -> None:
+        # Bookkeeping under the lock, k8s API calls OUTSIDE it
+        # (tpurun-lint blocking-under-lock, the PR 3 wedge class): a
+        # hung apiserver call held under _lock would block the
+        # reconcile loop — and any other scale() caller — for the whole
+        # API timeout.
         with self._lock:
             if plan.worker_num >= 0:
                 self._target = plan.worker_num
             for node_id in plan.remove_nodes:
-                self._client.delete_pod(f"{self._job_name}-worker-{node_id}")
                 self._removed.add(node_id)
                 self._retry.pop(node_id, None)
             for node in plan.launch_nodes:
                 self._removed.discard(node.node_id)
                 self._ranks[node.node_id] = node.rank_index
-                self._create_worker(node.node_id, node.rank_index)
-            self._reconcile()
+        for node_id in plan.remove_nodes:
+            self._client.delete_pod(f"{self._job_name}-worker-{node_id}")
+        for node in plan.launch_nodes:
+            self._create_worker(node.node_id, node.rank_index)
+        self._reconcile()
 
     def _reconcile(self) -> None:
+        """Converge the pod set to the bookkeeping state. Snapshots the
+        state under the lock, then talks to the API lock-free — a
+        concurrent scale() can interleave, so convergence runs BOTH
+        directions: missing pods are created, and a pod resurrected by
+        a create that raced a remove-plan delete is torn down on the
+        next pass instead of living forever."""
+        with self._lock:
+            target = self._target
+            removed = set(self._removed)
+            retry = dict(self._retry)
+            ranks = dict(self._ranks)
         pods = self._client.list_pods(f"{ELASTIC_JOB_LABEL}={self._job_name}")
         # A Terminating pod still occupies its name (creates 409) but is
         # going away — treat it as absent so its replacement stays queued.
         existing = {pod_name(p) for p in pods if not pod_terminating(p)}
-        for node_id in range(self._target):
+        for node_id in range(target):
             name = f"{self._job_name}-worker-{node_id}"
             if (
                 name not in existing
-                and node_id not in self._removed
-                and node_id not in self._retry
+                and node_id not in removed
+                and node_id not in retry
             ):
-                self._create_worker(node_id, self._ranks.get(node_id, node_id))
-        for node_id, rank in list(self._retry.items()):
+                self._create_worker(node_id, ranks.get(node_id, node_id))
+        for node_id, rank in retry.items():
             if f"{self._job_name}-worker-{node_id}" in existing:
-                self._retry.pop(node_id, None)
+                with self._lock:
+                    self._retry.pop(node_id, None)
             else:
                 self._create_worker(node_id, rank)
+        for node_id in removed:
+            name = f"{self._job_name}-worker-{node_id}"
+            if name in existing:
+                # Re-check under the lock right before the delete: a
+                # concurrent scale() may have relaunched this node
+                # (discarding it from _removed and creating the pod)
+                # since the snapshot — tearing down the fresh pod here
+                # would burn a worker boot for nothing.
+                with self._lock:
+                    if node_id not in self._removed:
+                        continue
+                self._client.delete_pod(name)
 
     def _reconcile_loop(self) -> None:
         while not self._stopped.wait(self._reconcile_interval):
             try:
-                with self._lock:
-                    self._reconcile()
+                self._reconcile()
             except Exception:
                 logger.exception("pod reconcile failed")
 
@@ -132,13 +162,17 @@ class PodScaler(Scaler):
             env=self._env,
             owner_uid=self._owner_uid,
         )
+        # The API call stays outside the lock; only the retry-queue
+        # update takes it.
         if self._client.create_pod(pod):
             logger.info("created worker pod %s", pod_name(pod))
-            self._retry.pop(node_id, None)
+            with self._lock:
+                self._retry.pop(node_id, None)
         else:
             # Likely a 409 against a still-Terminating pod — leave it for
             # the periodic reconcile to retry.
             logger.warning(
                 "create of %s failed; queued for retry", pod_name(pod)
             )
-            self._retry[node_id] = node_rank
+            with self._lock:
+                self._retry[node_id] = node_rank

@@ -30,6 +30,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "SamplingConfig",
@@ -62,8 +63,6 @@ def left_pad_prompts(prompts: list, pad_id: int = 0, width: int = 0):
     is the generation-engine convention (see module docstring): all rows
     end at the same slot so the decode loop writes one static slice.
     """
-    import numpy as np
-
     width = max(width, max(len(p) for p in prompts))
     tokens = np.full((len(prompts), width), pad_id, dtype=np.int32)
     mask = np.zeros((len(prompts), width), dtype=bool)

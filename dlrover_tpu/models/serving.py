@@ -947,6 +947,7 @@ class ContinuousBatchingEngine:
     # speculative engine overrides admission wholesale and opts out
     _burst_admit = True
 
+    # tpulint: hotpath — admission runs under the in-flight chunk
     def _admit_free_slots(self) -> float:
         """Fill empty slots from the queue while the budget allows;
         returns the seconds spent in the admission device path
@@ -1015,6 +1016,7 @@ class ContinuousBatchingEngine:
             prefill_s += now - ta
         return prefill_s
 
+    # tpulint: hotpath — drains happen via _drain_inflight, never inline
     def _frontier_housekeeping(self) -> int:
         """Frontier-layout cache management (no-op for per_row):
         idle-reset and compaction. Both are pipeline DRAIN points —
@@ -1043,6 +1045,7 @@ class ContinuousBatchingEngine:
             self.phases.add("prefill", time.perf_counter() - tc)
         return emitted
 
+    # tpulint: hotpath — dispatch must never read the device back
     def _dispatch_round(self, rng) -> tuple:
         """Enqueue one decode chunk on the device; returns the
         in-flight record (output futures + done futures + the uid
@@ -1128,6 +1131,7 @@ class ContinuousBatchingEngine:
             emitted += self._process_oldest()
         return emitted
 
+    # tpulint: hotpath — runs behind the dispatched chunk
     def _eager_prefill(self) -> None:
         """Prefill queue-head prompts WHILE a chunk is in flight (the
         overlapped round calls this right after dispatch): prompt rows
@@ -1161,6 +1165,7 @@ class ContinuousBatchingEngine:
             self._inflight[0][:-1]
         )
 
+    # tpulint: hotpath — the scheduler round; syncs live in _process_oldest
     def step(self, rng):
         """One scheduler iteration. Returns the number of tokens
         emitted this call. Phase boundaries are stamped into
@@ -1193,6 +1198,7 @@ class ContinuousBatchingEngine:
             self._tuner.maybe_retune()
         return emitted
 
+    # tpulint: hotpath
     def _step_sync(self, rng):
         """The host-serial round (pre-pipeline behavior, kept as the
         measured A/B baseline): dispatch, block, emit, retire."""
@@ -1217,6 +1223,8 @@ class ContinuousBatchingEngine:
         entry = self._dispatch_round(rng)
         t_disp = time.perf_counter()
         self.phases.add("decode_dispatch", t_disp - t_admit)
+        # tpulint: ignore[host-sync] the sync round IS the measured
+        # A/B baseline the overlapped pipeline is compared against
         fetched = jax.device_get(entry[:-1])
         t_sync = time.perf_counter()
         self.phases.add("host_sync", t_sync - t_disp)
@@ -1249,6 +1257,7 @@ class ContinuousBatchingEngine:
                 self._retire(slot)
         return emitted
 
+    # tpulint: hotpath — every host span here runs under a chunk
     def _step_overlapped(self, rng):
         """The double-buffered round: dispatch chunk N before reading
         chunk N-1, so every host span between two dispatches runs
@@ -1833,6 +1842,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             admit_t=time.perf_counter(),
         )
 
+    # tpulint: hotpath — dispatch must never read the device back
     def _dispatch_round(self, rng) -> tuple:
         """One speculation round enqueued on the device (draft k,
         verify once); nothing read back. ``rng`` is accepted for API

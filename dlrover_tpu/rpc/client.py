@@ -35,8 +35,14 @@ class MasterTransport:
 
 
 class GrpcTransport(MasterTransport):
-    def __init__(self, addr: str, deadline_s: float = 30.0):
-        self._deadline_s = deadline_s
+    def __init__(self, addr: str, deadline_s: Optional[float] = None):
+        # None → Context: one DLROVER_RPC_DEADLINE_S override reaches
+        # every transport (tpurun-lint rpc-deadline keeps literals out)
+        self._deadline_s = (
+            deadline_s
+            if deadline_s is not None
+            else get_context().rpc_deadline_s
+        )
         self._channel = grpc.insecure_channel(
             addr,
             options=[
@@ -66,9 +72,13 @@ class GrpcTransport(MasterTransport):
 
 
 class HttpTransport(MasterTransport):
-    def __init__(self, addr: str, deadline_s: float = 30.0):
+    def __init__(self, addr: str, deadline_s: Optional[float] = None):
         self._base = f"http://{addr}"
-        self._deadline_s = deadline_s
+        self._deadline_s = (
+            deadline_s
+            if deadline_s is not None
+            else get_context().rpc_deadline_s
+        )
 
     def _post(self, path: str, payload: bytes) -> bytes:
         req = _urlreq.Request(

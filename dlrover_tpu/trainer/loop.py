@@ -309,6 +309,8 @@ class ElasticTrainLoop:
         if record_phase_file("worker", payload):
             logger.info("recovery breakdown: %s", payload)
 
+    # tpulint: hotpath — the per-step path; scalar fetches only at
+    # designed points (log cadence, boot timing), each with its reason
     def _run_inner(self, state, data_iter, start):
         step = start
         last_save_ok = False
@@ -411,6 +413,8 @@ class ElasticTrainLoop:
             if step % self.log_every == 0:
                 # scalar fetch only when logging: a per-step float()
                 # would serialize host and device
+                # tpulint: ignore[host-sync] log-cadence scalar fetch,
+                # amortized over log_every steps by design
                 logger.info("step %s: loss %.4f", step, float(loss))
             step += 1
         if step > start and not self._recovery_written:

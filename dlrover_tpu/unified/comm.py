@@ -27,6 +27,11 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+# numpy at module level (it is a hard dependency and cheap); jax stays
+# lazy below — non-jax role processes import this module for the KV/
+# queue clients and must not pay (or require) the jax import.
+import numpy as np
+
 from ..common.log import logger
 from ..common.multi_process import (
     LocalSocketClient,
@@ -317,8 +322,6 @@ class DataQueue:
 
 
 def pack_array(arr) -> Dict[str, Any]:
-    import numpy as np
-
     # np.asarray, not ascontiguousarray: the latter promotes 0-d
     # arrays to shape (1,), silently changing the rank of scalars.
     # tobytes() already produces contiguous C-order bytes.
@@ -327,8 +330,6 @@ def pack_array(arr) -> Dict[str, Any]:
 
 
 def unpack_array(obj: Dict[str, Any]):
-    import numpy as np
-
     return np.frombuffer(
         obj["data"], dtype=np.dtype(obj["dtype"])
     ).reshape(obj["shape"])

@@ -1,0 +1,5 @@
+# Fixture mini-modules for tests/test_lint.py: each fx_* file plants
+# exactly one unsuppressed violation for one tpurun-lint pass (plus a
+# suppressed twin proving the suppression forms work). These files are
+# PARSED by the lint suite, never imported — the jax/config calls in
+# them do not run.

@@ -1,0 +1,14 @@
+"""A suppression with no reason: itself reported as an error.
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import time
+import threading
+
+_lock = threading.Lock()
+
+
+def bare_ignore():
+    with _lock:
+        time.sleep(0.5)  # tpulint: ignore[blocking-under-lock]

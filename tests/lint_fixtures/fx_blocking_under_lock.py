@@ -1,0 +1,33 @@
+"""Planted blocking-under-lock violation: sleep while holding a lock.
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import threading
+import time
+
+
+class Wedgeable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=lambda: None)
+
+    def bad(self):
+        with self._lock:
+            time.sleep(1.0)  # the planted violation
+
+    def suppressed(self):
+        with self._lock:
+            self._thread.join()  # tpulint: ignore[blocking-under-lock] fixture: bounded by test harness
+
+    def fine(self):
+        with self._lock:
+            # nested defs run on their own thread, not under the lock
+            def runner():
+                time.sleep(1.0)
+
+            t = threading.Thread(target=runner)
+        t.start()
+        # timed waits are bounded — not flagged
+        with self._lock:
+            self._thread.join(timeout=1.0)

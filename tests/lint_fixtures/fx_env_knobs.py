@@ -1,0 +1,19 @@
+"""Planted env-knobs violation: access of an unregistered knob.
+
+Parsed by tests/test_lint.py, never imported. The name below is
+deliberately absent from common/constants.py ENV_KNOBS.
+"""
+
+import os
+
+
+def bad():
+    return os.getenv("DLROVER_NOT_A_REGISTERED_KNOB")
+
+
+def suppressed():
+    return os.environ.get("DLROVER_ALSO_NOT_REGISTERED")  # tpulint: ignore[env-knobs] fixture: planted name
+
+
+def fine():
+    return os.getenv("DLROVER_FAULT_PLAN", "")

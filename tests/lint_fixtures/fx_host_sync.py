@@ -1,0 +1,28 @@
+"""Planted host-sync violation: scalar fetch inside a marked hot path.
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import jax
+
+
+# tpulint: hotpath
+def dispatch_round(state, loss):
+    fetched = float(loss)  # the planted violation
+    return state, fetched
+
+
+@jax.jit
+def jitted_body(x):
+    return x.item()  # jit-decorated functions are hot automatically
+
+
+# tpulint: hotpath
+def drainpoint(entry):
+    # tpulint: ignore[host-sync] fixture: the designed drain point
+    return jax.device_get(entry)
+
+
+def cold_path(loss):
+    # unmarked functions may sync freely
+    return float(loss)

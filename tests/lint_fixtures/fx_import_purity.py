@@ -1,0 +1,23 @@
+"""Planted import-purity violation: import-time jax config mutation.
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import jax
+
+# the planted violation (the PR 4 STORM_CACHE_DIR incident shape):
+jax.config.update("jax_compilation_cache_dir", "/tmp/cache")
+
+# suppressed twin — line-above comment form:
+# tpulint: ignore[import-purity] fixture: documented exception
+jax.config.update("jax_platforms", "cpu")
+
+
+def fine_inside_a_function():
+    # the same call inside a function body is not an import side effect
+    jax.config.update("jax_platforms", "cpu")
+
+
+if __name__ == "__main__":
+    # main-guard blocks are programs, not imports
+    jax.distributed.initialize()

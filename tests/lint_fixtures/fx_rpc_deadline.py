@@ -1,0 +1,19 @@
+"""Planted rpc-deadline violation: hard-coded urlopen deadline.
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import urllib.request
+
+DEADLINE_S = 30.0
+
+
+def bad(url):
+    return urllib.request.urlopen(url, timeout=30)  # the planted violation
+
+
+def suppressed(url):
+    return urllib.request.urlopen(url)  # tpulint: ignore[rpc-deadline] fixture: localhost probe
+
+def fine(url, deadline_s):
+    return urllib.request.urlopen(url, timeout=deadline_s)

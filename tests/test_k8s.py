@@ -226,8 +226,7 @@ class TestPodScaler:
         assert len(fake_client.pods) == 3
         # a pod vanishes outside a plan -> reconcile recreates it
         fake_client.pods.pop("job-worker-1")
-        with scaler._lock:
-            scaler._reconcile()
+        scaler._reconcile()
         assert "job-worker-1" in fake_client.pods
 
     def test_remove_only_plan_not_resurrected(self, fake_client):
@@ -235,8 +234,7 @@ class TestPodScaler:
         scaler.scale(ScalePlan(worker_num=3))
         scaler.scale(ScalePlan(worker_num=-1, remove_nodes=[1]))
         assert "job-worker-1" not in fake_client.pods
-        with scaler._lock:
-            scaler._reconcile()
+        scaler._reconcile()
         assert "job-worker-1" not in fake_client.pods
 
     def test_terminating_409_retry_keeps_rank(self, fake_client):
@@ -253,8 +251,7 @@ class TestPodScaler:
                 launch_nodes=[Node(node_id=2, rank_index=7)],
             )
         )
-        with scaler._lock:
-            scaler._reconcile()
+        scaler._reconcile()
         assert 2 in scaler._retry, "Terminating pod cancelled the retry"
         # old pod finally goes; retry loop heals with the planned rank
         del fake_client.pods["job-worker-2"]

@@ -1,0 +1,368 @@
+"""tpurun-lint unit tests: every pass fires on its planted fixture,
+both suppression forms work (same-line and line-above), bare ignores
+are errors, and the baseline round-trips (stale entries reported).
+
+The repo-wide zero-violation gate lives in tests/test_lint_clean.py;
+this file exercises the machinery against tests/lint_fixtures/.
+"""
+
+import json
+import os
+
+import pytest
+
+from dlrover_tpu.analysis import Baseline, run_lint
+from dlrover_tpu.analysis.cli import main as lint_main
+from dlrover_tpu.analysis.passes import (
+    ALL_PASSES,
+    PASS_BY_ID,
+    blocking_under_lock,
+    env_knobs,
+    host_sync,
+    import_purity,
+    injection_coverage,
+    rpc_deadline,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "lint_fixtures")
+
+
+def _fx(name):
+    return os.path.join(_FIXTURES, name)
+
+
+def _run(path, lint_pass):
+    return run_lint([path], passes=[lint_pass], repo_root=_REPO)
+
+
+class TestPassesFireOnFixtures:
+    def test_import_purity_fires(self):
+        r = _run(_fx("fx_import_purity.py"), import_purity)
+        assert len(r.violations) == 1, r.violations
+        v = r.violations[0]
+        assert v.pass_id == "import-purity"
+        assert "jax_compilation_cache_dir" in v.code
+        # the suppressed twin (line-above form) and only it
+        assert len(r.suppressed) == 1
+        assert not r.errors
+
+    def test_import_purity_main_guard_and_functions_exempt(self):
+        r = _run(_fx("fx_import_purity.py"), import_purity)
+        flagged_lines = {v.line for v in r.violations} | {
+            v.line for v, _s in r.suppressed
+        }
+        src = open(_fx("fx_import_purity.py")).readlines()
+        for i, text in enumerate(src, start=1):
+            if "fine_inside_a_function" in text or "__main__" in text:
+                assert i not in flagged_lines
+
+    def test_blocking_under_lock_fires(self):
+        r = _run(_fx("fx_blocking_under_lock.py"), blocking_under_lock)
+        assert len(r.violations) == 1, r.violations
+        assert "sleep" in r.violations[0].message
+        # same-line suppression on the untimed join
+        assert len(r.suppressed) == 1
+        assert "join" in r.suppressed[0][0].message
+        assert not r.errors
+
+    def test_host_sync_fires_on_marker_and_jit(self):
+        r = _run(_fx("fx_host_sync.py"), host_sync)
+        msgs = [v.message for v in r.violations]
+        assert any("float()" in m and "dispatch_round" in m for m in msgs)
+        assert any(".item()" in m and "jitted_body" in m for m in msgs)
+        assert len(r.violations) == 2, r.violations
+        # the drain point is suppressed; the cold path is not hot
+        assert len(r.suppressed) == 1
+        assert "device_get" in r.suppressed[0][0].message
+
+    def test_host_sync_flags_per_call_heavy_import(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "# tpulint: hotpath\n"
+            "def step(state):\n"
+            "    import jax\n"
+            "    return state\n"
+        )
+        r = _run(str(p), host_sync)
+        assert len(r.violations) == 1
+        assert "per-call import" in r.violations[0].message
+
+    def test_rpc_deadline_fires(self):
+        r = _run(_fx("fx_rpc_deadline.py"), rpc_deadline)
+        assert len(r.violations) == 1, r.violations
+        assert "hard-coded deadline" in r.violations[0].message
+        # urlopen with NO deadline is also a violation — suppressed here
+        assert len(r.suppressed) == 1
+        assert "no deadline" in r.suppressed[0][0].message
+
+    def test_env_knobs_fires_on_unregistered_access(self):
+        r = _run(_fx("fx_env_knobs.py"), env_knobs)
+        assert len(r.violations) == 1, r.violations
+        assert "DLROVER_NOT_A_REGISTERED_KNOB" in r.violations[0].message
+        assert len(r.suppressed) == 1
+
+    def test_bare_ignore_is_an_error(self):
+        r = _run(_fx("fx_bad_suppression.py"), blocking_under_lock)
+        assert not r.violations  # the site IS suppressed...
+        assert r.errors and "needs a reason" in r.errors[0]
+        assert not r.clean  # ...but the bare ignore fails the run
+
+
+class TestInjectionCoveragePass:
+    def _tree(self, tmp_path, tests_text):
+        faults = tmp_path / "faults.py"
+        faults.write_text(
+            "INJECTION_POINTS = {\n"
+            '    "covered.point": "a point with a drill",\n'
+            '    "uncovered.point": "a point nobody exercises",\n'
+            "}\n"
+        )
+        scenarios = tmp_path / "scenarios.py"
+        scenarios.write_text(
+            "def drill(w=None):\n    return {}\n\n"
+            'SCENARIOS = {"my_drill": drill, "dusty_drill": drill}\n'
+        )
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(tests_text)
+        return str(faults), str(tests), str(scenarios)
+
+    def test_uncovered_point_and_unexercised_scenario_flagged(
+        self, tmp_path
+    ):
+        faults, tests, scenarios = self._tree(
+            tmp_path, 'def test_a():\n    fire("covered.point")\n'
+        )
+        got = list(
+            injection_coverage.check_coverage(
+                faults, tests, scenarios_path=scenarios
+            )
+        )
+        codes = {v.code for v in got}
+        assert "uncovered.point" in codes
+        assert "scenario:my_drill" in codes
+        assert "scenario:dusty_drill" in codes
+        assert "covered.point" not in codes
+
+    def test_point_covered_through_exercised_scenario(self, tmp_path):
+        faults, tests, scenarios = self._tree(
+            tmp_path,
+            "def test_a():\n"
+            '    run("my_drill"); run("dusty_drill")\n'
+            '    fire("covered.point")\n',
+        )
+        # point the scenario file at the uncovered point: the scenario
+        # is exercised, so the point counts as covered
+        open(scenarios, "a").write('PLAN = "uncovered.point:error"\n')
+        got = list(
+            injection_coverage.check_coverage(
+                faults, tests, scenarios_path=scenarios
+            )
+        )
+        assert not got, [v.render() for v in got]
+
+
+class TestBaseline:
+    def _fixture_violations(self):
+        return run_lint(
+            [_FIXTURES], passes=list(ALL_PASSES), repo_root=_REPO
+        )
+
+    def test_round_trip(self, tmp_path):
+        first = self._fixture_violations()
+        assert first.violations  # the planted set
+        path = str(tmp_path / "baseline.json")
+        Baseline.from_violations(
+            first.violations, reason="fixture grandfather"
+        ).save(path)
+        again = run_lint(
+            [_FIXTURES],
+            passes=list(ALL_PASSES),
+            baseline=Baseline.load(path),
+            repo_root=_REPO,
+        )
+        assert not again.violations
+        assert again.baselined == len(first.violations)
+        assert not again.stale_baseline
+        # the bare-ignore error is NOT baselineable
+        assert again.errors
+
+    def test_stale_entry_reported(self, tmp_path):
+        first = self._fixture_violations()
+        bl = Baseline.from_violations(first.violations, reason="ok")
+        bl.entries.append(
+            type(bl.entries[0])(
+                pass_id="host-sync",
+                path="tests/lint_fixtures/fx_host_sync.py",
+                code="this_line_was_fixed_long_ago()",
+                reason="ghost of a fixed site",
+            )
+        )
+        path = str(tmp_path / "baseline.json")
+        bl.save(path)
+        again = run_lint(
+            [_FIXTURES],
+            passes=list(ALL_PASSES),
+            baseline=Baseline.load(path),
+            repo_root=_REPO,
+        )
+        assert len(again.stale_baseline) == 1
+        assert again.stale_baseline[0].code == "this_line_was_fixed_long_ago()"
+        assert not again.clean
+
+    def test_entry_without_reason_is_an_error(self, tmp_path):
+        first = self._fixture_violations()
+        bl = Baseline.from_violations(first.violations, reason="")
+        path = str(tmp_path / "baseline.json")
+        bl.save(path)
+        again = run_lint(
+            [_FIXTURES],
+            passes=list(ALL_PASSES),
+            baseline=Baseline.load(path),
+            repo_root=_REPO,
+        )
+        assert any("no reason" in e for e in again.errors)
+
+
+class TestCli:
+    def test_list_passes(self, capsys):
+        assert lint_main(["--list-passes"]) == 0
+        out = capsys.readouterr().out
+        for pid in PASS_BY_ID:
+            assert pid in out
+
+    def test_unknown_pass_is_usage_error(self, capsys):
+        assert lint_main(["--select", "no-such-pass", _FIXTURES]) == 2
+
+    def test_fixtures_fail_and_json_format(self, capsys):
+        rc = lint_main(
+            ["--no-baseline", "--format", "json", _FIXTURES]
+        )
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["violations"] and not data["clean"]
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        path = str(tmp_path / "bl.json")
+        assert lint_main([_FIXTURES, "--write-baseline", path]) == 0
+        capsys.readouterr()
+        # violations are baselined now, but the bare ignore still fails
+        rc = lint_main([_FIXTURES, "--baseline", path])
+        out = capsys.readouterr().out
+        assert "0 violations" in out
+        assert rc == 1 and "needs a reason" in out
+
+
+class TestSuppressionForms:
+    def test_stacked_comment_lines_chain_up(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import time, threading\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        # tpulint: ignore[blocking-under-lock] long reason\n"
+            "        # that wraps onto a second comment line\n"
+            "        time.sleep(1)\n"
+        )
+        r = _run(str(p), blocking_under_lock)
+        assert not r.violations and len(r.suppressed) == 1
+
+    def test_suppression_for_other_pass_does_not_apply(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import time, threading\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1)  # tpulint: ignore[host-sync] wrong pass\n"
+        )
+        r = _run(str(p), blocking_under_lock)
+        assert len(r.violations) == 1 and not r.suppressed
+
+
+class TestReviewRegressions:
+    """Review findings on PR 6 itself: the staleness rule must not be
+    satisfied by the registry's own declaration, bare ignores on
+    repo-level violations are errors too, and the CLI refuses to
+    green-light a typo'd path."""
+
+    def _fake_tree(self, tmp_path, mod_source):
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        common = tmp_path / "dlrover_tpu" / "common"
+        common.mkdir(parents=True)
+        (common / "constants.py").write_text(
+            "class K:\n"
+            "    def __init__(self, name, internal=False,"
+            " context_field=''):\n"
+            "        self.name = name\n"
+            "        self.internal = internal\n"
+            "        self.context_field = context_field\n"
+            "\n"
+            "class NodeEnv:\n"
+            "    ATTR_ONLY = 'DLROVER_ATTR_ONLY'\n"
+            "\n"
+            "ENV_KNOBS = {k.name: k for k in [\n"
+            "    K('DLROVER_USED', internal=True),\n"
+            "    K('DLROVER_GHOST', internal=True),\n"
+            "    K('DLROVER_ATTR_ONLY', internal=True),\n"
+            "]}\n"
+        )
+        (tmp_path / "dlrover_tpu" / "mod.py").write_text(mod_source)
+        return tmp_path
+
+    def test_registry_self_reference_does_not_hide_staleness(
+        self, tmp_path
+    ):
+        root = self._fake_tree(
+            tmp_path,
+            "import os\n"
+            "A = os.getenv('DLROVER_USED')\n"
+            "from .common.constants import NodeEnv\n"
+            "B = os.getenv(NodeEnv.ATTR_ONLY)\n",
+        )
+        r = run_lint(
+            [str(root / "dlrover_tpu")],
+            passes=[env_knobs],
+            repo_root=str(root),
+        )
+        codes = {v.code for v in r.violations}
+        # GHOST appears ONLY in ENV_KNOBS itself -> stale; USED is
+        # referenced by literal, ATTR_ONLY through the NodeEnv attr
+        assert "stale:DLROVER_GHOST" in codes, [
+            v.render() for v in r.violations
+        ]
+        assert "stale:DLROVER_USED" not in codes
+        assert "stale:DLROVER_ATTR_ONLY" not in codes
+
+    def test_bare_ignore_on_repo_level_violation_is_an_error(
+        self, tmp_path
+    ):
+        root = self._fake_tree(
+            tmp_path,
+            "X = 'DLROVER_TYPO_KNOB'  # tpulint: ignore[env-knobs]\n"
+            "import os\n"
+            "A = os.getenv('DLROVER_USED')\n"
+            "B = 'DLROVER_ATTR_ONLY'\n",
+        )
+        r = run_lint(
+            [str(root / "dlrover_tpu")],
+            passes=[env_knobs],
+            repo_root=str(root),
+        )
+        assert any(
+            v.pass_id == "env-knobs" for v, _s in r.suppressed
+        ), [v.render() for v in r.violations]
+        assert any("needs a reason" in e for e in r.errors)
+        assert not r.clean
+
+    def test_cli_rejects_nonexistent_path(self, capsys):
+        assert lint_main(["definitely_no_such_dir_xyz"]) == 2
+        assert "do not exist" in capsys.readouterr().err
+
+    def test_cli_rejects_pathless_lint(self, tmp_path, capsys):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert lint_main([str(empty)]) == 2
+        assert "no Python files" in capsys.readouterr().err

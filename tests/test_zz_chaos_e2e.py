@@ -258,3 +258,12 @@ class TestSwapFailureMidOverlap:
         eng.set_params_async(params)
         assert eng._maybe_adopt_pending() is True
         assert eng.draft_params is old_draft
+
+
+@pytest.mark.slow
+def test_host_kill_scenario(tmp_path):
+    from dlrover_tpu.chaos.scenarios import host_kill
+
+    result = host_kill(str(tmp_path))
+    assert result["fired"] >= 1, result
+    assert result["recovered"], result

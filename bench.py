@@ -56,7 +56,7 @@ HEADLINE_SECTION_ERRORS = frozenset({
     "tpu_error", "fatal_error", "dense_error", "ckpt_error",
     "flash_seq4096_error", "decode_error", "spec_error",
     "serving_error", "serving_per_row_error", "llama_family_error",
-    "longseq_train_error", "attr_error",
+    "longseq_train_error", "attr_error", "fleet_error",
 })
 
 # Error key -> the DLROVER_BENCH_SECTIONS name that re-runs ONLY that
@@ -74,6 +74,7 @@ SECTION_OF_ERROR = {
     "serving_error": "serving",
     "serving_per_row_error": "serving",
     "attr_error": "attr",
+    "fleet_error": "fleet",
     "llama_family_error": "llama",
     "longseq_train_error": "longseq",
     "dense_error": "dense",
@@ -250,9 +251,17 @@ _PRIORITY_KEYS = (
     "attr_report",
     "attr_ring", "attr_top_residual", "attr_top_residual_frac",
     "attr_matmul_frac",
-    "serving_per_row_tokens_per_s", "serving_sync_tokens_per_s",
-    "serving_overlap_tokens_per_s", "decode_tokens_per_s",
-    "generate_tokens_per_s", "ckpt_async_stage_block_s",
+    # serving-fleet SLO trio (docs/serving_fleet.md): throughput,
+    # availability under a replica kill, rollout readiness floor.
+    # Byte offsets for it: the overlap A/B per-leg rates
+    # (serving_{sync,overlap}_tokens_per_s) and generate_tokens_per_s
+    # moved sidecar-only — their verdicts (serving_overlap_vs_sync +
+    # exactness flag, decode_tokens_per_s) still ride the line, same
+    # rationale as the recovery_ab per-leg scalars above
+    "fleet_requests_per_s", "fleet_kill_availability",
+    "fleet_rollout_max_unready",
+    "serving_per_row_tokens_per_s", "decode_tokens_per_s",
+    "ckpt_async_stage_block_s",
     "restore_overhead_x",
     "goodput_ckpt_every_10_steps",
     # recovery-SLO matrix (per-fault-class, pointer-style — the full
@@ -1449,6 +1458,193 @@ def _bench_serving(extra, cfg, params, on_tpu):
     return serving_split
 
 
+def _bench_fleet(extra, cfg, params, on_tpu):
+    """Elastic serving fleet rung (dlrover_tpu/fleet/): gateway
+    requests/s at 2 replicas vs 1, availability through a mid-load
+    replica kill, and max unready replicas through a full staged
+    weight rollout. In-process replicas over real HTTP — the gateway,
+    supervisor, and rollout paths are the production code; only the
+    process boundary is folded (so on a single chip the 2v1 ratio
+    reads host-parallelism + batching headroom, not chip count)."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from dlrover_tpu.fleet import (
+        FleetConfig,
+        Gateway,
+        InProcessReplica,
+        ReplicaSupervisor,
+        staged_rollout,
+    )
+    from dlrover_tpu.models.generation import SamplingConfig
+    from dlrover_tpu.models.gpt import GPT
+
+    model = GPT(cfg)
+    if on_tpu:
+        B, Pw, N, n_req = 8, 64, 32, 32
+    else:
+        B, Pw, N, n_req = 2, 16, 8, 12
+    sampling = SamplingConfig(max_new_tokens=N, temperature=0.0)
+    r = np.random.default_rng(11)
+    prompts = [
+        [int(x) for x in r.integers(1, cfg.vocab_size, r.integers(4, Pw))]
+        for _ in range(n_req)
+    ]
+
+    def engine_factory():
+        from dlrover_tpu.models.serving import ContinuousBatchingEngine
+
+        return ContinuousBatchingEngine(
+            model, params, sampling, batch_size=B, prompt_width=Pw,
+            decode_chunk=4, cache_layout="per_row",
+        )
+
+    def make_fleet(n):
+        # lenient poll thresholds: jit tracing holds the GIL for
+        # seconds, and a false-positive death would relaunch a replica
+        # mid-measurement; induced kills are still detected instantly
+        # through proc.alive()
+        fc = FleetConfig(
+            replicas=n, max_replicas=max(n, 2),
+            health_interval_s=0.2, health_fails=100,
+            health_timeout_s=30.0, relaunch_budget=3,
+            start_timeout_s=600.0, queue_limit=256,
+        )
+        sup = ReplicaSupervisor(
+            lambda rid, port: InProcessReplica(
+                rid, port, engine_factory=engine_factory,
+                reload_fn=lambda: (1, params),
+            ),
+            fc,
+        ).start()
+        gw = Gateway(sup, fc)
+        if not sup.wait_ready(n, timeout=600.0):
+            sup.stop()
+            raise RuntimeError(f"fleet never reached {n} READY")
+        return sup, gw
+
+    def pump(gw, reqs, on_index=None, pace_s=0.0):
+        """Threaded client pump through the gateway; returns
+        (ok, failed, wall_s). ``on_index`` maps a request index to a
+        callable fired right after that request launches (the kill
+        hook); ``pace_s`` spaces the launches so a mid-pump event
+        lands among in-flight requests instead of after them."""
+        results = {"ok": 0, "failed": 0}
+        mu = threading.Lock()
+
+        def hit(p):
+            try:
+                out = gw.complete({"prompt": list(p)})
+                assert out["tokens"]
+                with mu:
+                    results["ok"] += 1
+            except Exception:  # noqa: BLE001 — counted
+                with mu:
+                    results["failed"] += 1
+
+        t0 = time.perf_counter()
+        threads = []
+        for i, p in enumerate(reqs):
+            t = threading.Thread(target=hit, args=(p,))
+            t.start()
+            threads.append(t)
+            if on_index and i in on_index:
+                on_index[i]()
+            if pace_s:
+                time.sleep(pace_s)
+        for t in threads:
+            t.join(timeout=600)
+        return results["ok"], results["failed"], time.perf_counter() - t0
+
+    def warm_fleet(sup, gw):
+        """Warm EVERY replica's engine with the full prompt set (drain
+        the others so routing can't skip one) — otherwise the timed
+        window pays whichever compiles the warm pump's routing
+        happened to miss."""
+        for h in sup.replicas():
+            for other in sup.replicas():
+                if other.rid != h.rid:
+                    sup.drain(other.rid)
+            pump(gw, prompts)
+            for other in sup.replicas():
+                if other.rid != h.rid:
+                    sup.readmit(other.rid)
+
+    # -- throughput: 1 replica vs 2 (same total request stream) -------
+    sup1, gw1 = make_fleet(1)
+    try:
+        warm_fleet(sup1, gw1)
+        ok, failed, wall = pump(gw1, prompts)
+        rate1 = ok / wall
+    finally:
+        sup1.stop()
+    sup2, gw2 = make_fleet(2)
+    try:
+        warm_fleet(sup2, gw2)
+        ok, failed, wall = pump(gw2, prompts)
+        rate2 = ok / wall
+        extra["fleet_requests_per_s"] = round(rate2, 2)
+        extra["fleet_1rep_requests_per_s"] = round(rate1, 2)
+        extra["fleet_2v1_x"] = round(rate2 / max(rate1, 1e-9), 3)
+
+        # -- availability through a replica kill ----------------------
+        kill_reqs = prompts * 2
+        ok, failed, _ = pump(
+            gw2, kill_reqs,
+            on_index={len(kill_reqs) // 3: lambda: sup2.kill_replica(0)},
+            pace_s=0.02,
+        )
+        extra["fleet_kill_availability"] = round(
+            ok / max(ok + failed, 1), 4
+        )
+        extra["fleet_kill_redispatches"] = gw2.redispatches
+        sup2.wait_ready(2, timeout=600.0)
+
+        # -- staged rollout under light load --------------------------
+        stop_load = threading.Event()
+        roll_results = {"ok": 0, "failed": 0}
+
+        roll_mu = threading.Lock()
+
+        def background_load():
+            i = 0
+            while not stop_load.is_set():
+                try:
+                    gw2.complete({"prompt": list(prompts[i % n_req])})
+                    with roll_mu:
+                        roll_results["ok"] += 1
+                except Exception:  # noqa: BLE001 — counted
+                    with roll_mu:
+                        roll_results["failed"] += 1
+                i += 1
+        loader = threading.Thread(target=background_load)
+        loader.start()
+        try:
+            report = staged_rollout(sup2, gw2)
+        finally:
+            stop_load.set()
+            loader.join(timeout=600)
+        extra["fleet_rollout_max_unready"] = report["max_unready"]
+        extra["fleet_rollout_aborted"] = report["aborted"]
+        extra["fleet_rollout_load_failed"] = roll_results["failed"]
+        # fleet status round-trip over real HTTP (the gateway's own
+        # endpoint, not the in-process object)
+        port = gw2.start_http(0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet/status",
+                timeout=gw2.cfg.health_timeout_s,
+            ) as resp:
+                status = json.loads(resp.read())
+            extra["fleet_ready"] = status["ready"]
+        finally:
+            gw2.stop_http()
+    finally:
+        sup2.stop()
+
+
 def _bench_attribution(extra, cfg, params, on_tpu, interposed,
                        serving_split=None):
     """Performance-attribution rung (r6): the serving host/device
@@ -1914,6 +2110,12 @@ def worker():
                 )
             except Exception as e:  # noqa: BLE001
                 extra["attr_error"] = repr(e)[:200]
+
+        if want("fleet"):
+            try:
+                _bench_fleet(extra, cfg, params, on_tpu)
+            except Exception as e:  # noqa: BLE001
+                extra["fleet_error"] = repr(e)[:200]
 
         params = None  # the model families below build their own
         _section_gc(extra, "post_serving")

@@ -41,8 +41,22 @@ PHASES = (
     "retirement",
     "overlap_hidden",
 )
-HOST_PHASES = frozenset({"admission", "decode_dispatch", "retirement"})
-DEVICE_PHASES = frozenset({"prefill", "host_sync"})
+# Fleet gateway phases (dlrover_tpu/fleet/gateway.py) — a SEPARATE
+# accumulator from the engine's: "route" and "redispatch" are
+# gateway-host work (replica selection, failover bookkeeping);
+# "proxy" is time spent waiting on the chosen replica's engine — the
+# gateway's equivalent of device time, so a gateway accumulator's
+# serving_host_frac reads as gateway overhead over end-to-end request
+# time.
+GATEWAY_PHASES = (
+    "route",
+    "proxy",
+    "redispatch",
+)
+HOST_PHASES = frozenset(
+    {"admission", "decode_dispatch", "retirement", "route", "redispatch"}
+)
+DEVICE_PHASES = frozenset({"prefill", "host_sync", "proxy"})
 OVERLAP_PHASES = frozenset({"overlap_hidden"})
 
 # log2(µs) histogram: bucket i covers [2^i, 2^(i+1)) µs; 20 buckets

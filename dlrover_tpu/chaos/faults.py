@@ -71,6 +71,9 @@ INJECTION_POINTS: Dict[str, str] = {
     "ckpt.replica.fetch": "replica fetch of this host's shard from a peer",
     "serving.swap": "serving engine async weight-swap device transfer",
     "serving.admit": "serving engine slot-admission entry",
+    "fleet.route": "gateway replica-selection for one fleet request",
+    "fleet.replica_health": "supervisor health poll of one serving replica",
+    "fleet.replica_kill": "supervisor about to hard-kill a serving replica",
 }
 
 _MODES = ("delay", "error", "wedge", "drop")

@@ -347,4 +347,20 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_BRAIN_REPORT_INTERVAL_S", "float", doc="brain stats report interval", context_field="brain_report_interval_s"),
     EnvKnob("DLROVER_HOST_MEMORY_MB", "float", doc="host RAM capacity hint for hyperparam strategies", context_field="host_memory_mb"),
     EnvKnob("DLROVER_INITIAL_BATCH_SIZE", "int", doc="starting per-host dataloader batch size", context_field="initial_batch_size"),
+    # -- serving fleet (dlrover_tpu/fleet/, docs/serving_fleet.md) ---------
+    EnvKnob("DLROVER_FLEET_REPLICAS", "int", doc="serving fleet: initial replica count"),
+    EnvKnob("DLROVER_FLEET_MIN_REPLICAS", "int", doc="serving fleet: autoscaler lower bound"),
+    EnvKnob("DLROVER_FLEET_MAX_REPLICAS", "int", doc="serving fleet: autoscaler upper bound"),
+    EnvKnob("DLROVER_FLEET_HEALTH_INTERVAL_S", "float", doc="serving fleet: seconds between /healthz polls"),
+    EnvKnob("DLROVER_FLEET_HEALTH_TIMEOUT_S", "float", doc="serving fleet: per-poll /healthz deadline"),
+    EnvKnob("DLROVER_FLEET_HEALTH_FAILS", "int", doc="serving fleet: consecutive failed polls before a replica is declared dead"),
+    EnvKnob("DLROVER_FLEET_START_TIMEOUT_S", "float", doc="serving fleet: STARTING-state deadline before a replica relaunch"),
+    EnvKnob("DLROVER_FLEET_RELAUNCH_BUDGET", "int", doc="serving fleet: per-replica relaunch budget"),
+    EnvKnob("DLROVER_FLEET_QUEUE_LIMIT", "int", doc="serving fleet: gateway in-flight bound before 429 admission rejects"),
+    EnvKnob("DLROVER_FLEET_RETRY_AFTER_S", "float", doc="serving fleet: Retry-After hint on 429 rejects"),
+    EnvKnob("DLROVER_FLEET_REQUEST_TIMEOUT_S", "float", doc="serving fleet: gateway-to-replica proxy deadline"),
+    EnvKnob("DLROVER_FLEET_DRAIN_TIMEOUT_S", "float", doc="serving fleet: rollout per-replica drain deadline"),
+    EnvKnob("DLROVER_FLEET_AUTOSCALE_INTERVAL_S", "float", doc="serving fleet: autoscaler evaluation interval (0 disables)"),
+    EnvKnob("DLROVER_FLEET_QUEUE_HIGH", "float", doc="serving fleet: mean queued-per-replica threshold to grow"),
+    EnvKnob("DLROVER_FLEET_P95_TARGET_S", "float", doc="serving fleet: p95 completion-latency target to grow (0 disables)"),
 )

@@ -1,0 +1,55 @@
+"""Elastic serving fleet: supervised replicas behind a slot-aware
+gateway with staged weight rollout and autoscaling.
+
+The serving-side twin of the training runtime's elasticity (ROADMAP
+north star: "serve heavy traffic from millions of users"): where one
+``tpurun-serve`` process is a single point of failure whose weight
+swaps stall every live stream, the fleet runs N supervised replicas —
+a replica death is a health-poll transition plus a relaunch, a
+checkpoint push is a one-replica-at-a-time drain→swap→readmit, and
+throughput scales with replica count under a queue/latency autoscaler.
+
+Layers (each importable alone; nothing here imports jax):
+
+- :mod:`.config`      — FleetConfig + the ``DLROVER_FLEET_*`` knobs
+- :mod:`.replica`     — subprocess / in-process replica backends
+- :mod:`.supervisor`  — ReplicaSupervisor (STARTING→READY→DRAINING→DEAD)
+- :mod:`.gateway`     — slot-aware routing, re-dispatch, admission, prefixes
+- :mod:`.rollout`     — staged zero-downtime weight rollout
+- :mod:`.autoscaler`  — queue-depth / p95 fleet autoscaler
+- :mod:`.cli`         — ``tpurun-fleet``
+
+See docs/serving_fleet.md for topology, semantics, and the measured
+availability SLO matrix.
+"""
+
+from .autoscaler import FleetAutoscaler  # noqa: F401
+from .config import FleetConfig  # noqa: F401
+from .gateway import (  # noqa: F401
+    Gateway,
+    GatewayBusy,
+    NoReadyReplica,
+    UnknownPrefix,
+)
+from .replica import InProcessReplica, SubprocessReplica  # noqa: F401
+from .rollout import staged_rollout  # noqa: F401
+from .supervisor import (  # noqa: F401
+    ReplicaHandle,
+    ReplicaState,
+    ReplicaSupervisor,
+)
+
+__all__ = [
+    "FleetAutoscaler",
+    "FleetConfig",
+    "Gateway",
+    "GatewayBusy",
+    "InProcessReplica",
+    "NoReadyReplica",
+    "ReplicaHandle",
+    "ReplicaState",
+    "ReplicaSupervisor",
+    "SubprocessReplica",
+    "UnknownPrefix",
+    "staged_rollout",
+]

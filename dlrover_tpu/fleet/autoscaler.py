@@ -1,0 +1,162 @@
+"""Queue-depth / p95-latency fleet autoscaler.
+
+The serving twin of the master's job auto-scaler: a periodic evaluator
+that grows or shrinks the replica count within
+``[min_replicas, max_replicas]`` off two signals the replicas already
+export through ``/healthz``:
+
+- **pressure** — mean queued work per READY replica
+  (``queue_depth + busy_slots`` beyond capacity is what actually backs
+  up: the engine admits into slots immediately, so sustained
+  ``queue_depth`` means every slot is full);
+- **latency** — the worst READY replica's rolling ``latency_p95_s``
+  (models/serving.py's completion-latency window) against the operator
+  SLO ``p95_target_s``.
+
+Grow on either signal. Shrink only on sustained idleness
+(``shrink_after`` consecutive idle evaluations — hysteresis, so a gap
+between bursts doesn't flap the fleet) and never below
+``min_replicas``. ``decide()`` is pure (signals in, target out) so the
+policy is unit-testable without a fleet; ``step()`` applies it through
+``ReplicaSupervisor.scale_to``.
+"""
+
+import threading
+from typing import Dict, List, Optional
+
+from ..common.log import logger
+from .config import FleetConfig
+
+__all__ = ["FleetAutoscaler"]
+
+
+class FleetAutoscaler:
+    # consecutive idle evaluations before a shrink (hysteresis)
+    SHRINK_AFTER = 3
+
+    def __init__(self, supervisor, config: Optional[FleetConfig] = None):
+        self.sup = supervisor
+        self.cfg = config or supervisor.cfg
+        self._idle_evals = 0
+        self.evaluations = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.last_signals: Dict = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals ----------------------------------------------------------
+
+    def signals(self) -> Dict:
+        """Fleet-wide pressure/latency snapshot from the supervisor's
+        health-poll cache."""
+        ready = self.sup.ready_replicas()
+        stats: List[Dict] = [h.stats for h in ready]
+        queued = [int(s.get("queue_depth") or 0) for s in stats]
+        busy = [int(s.get("busy_slots") or 0) for s in stats]
+        p95s = [
+            float(s["latency_p95_s"])
+            for s in stats
+            if s.get("latency_p95_s") is not None
+        ]
+        return {
+            "ready": len(ready),
+            "queue_mean": (
+                sum(queued) / len(queued) if queued else 0.0
+            ),
+            "busy_total": sum(busy),
+            "p95_worst_s": max(p95s) if p95s else None,
+        }
+
+    # -- policy -----------------------------------------------------------
+
+    def decide(self, sig: Dict) -> int:
+        """Target replica count for one evaluation (pure policy)."""
+        n = len(self.sup.replicas())
+        ready = sig.get("ready", 0)
+        if ready == 0:
+            return n  # nothing healthy to measure: never scale blind
+        queue_mean = sig.get("queue_mean") or 0.0
+        p95 = sig.get("p95_worst_s")
+        over_queue = queue_mean >= self.cfg.queue_high
+        over_latency = (
+            self.cfg.p95_target_s > 0
+            and p95 is not None
+            and p95 > self.cfg.p95_target_s
+        )
+        if over_queue or over_latency:
+            self._idle_evals = 0
+            return min(n + 1, self.cfg.max_replicas)
+        idle = (
+            queue_mean == 0
+            and sig.get("busy_total", 0) == 0
+            and (
+                self.cfg.p95_target_s <= 0
+                or p95 is None
+                or p95 < self.cfg.p95_target_s / 2
+            )
+        )
+        if idle:
+            self._idle_evals += 1
+            if self._idle_evals >= self.SHRINK_AFTER:
+                self._idle_evals = 0
+                return max(n - 1, self.cfg.min_replicas)
+        else:
+            self._idle_evals = 0
+        return n
+
+    def step(self) -> Dict:
+        """One evaluate→decide→apply round; returns the decision."""
+        sig = self.signals()
+        self.last_signals = sig
+        self.evaluations += 1
+        n = len(self.sup.replicas())
+        target = self.decide(sig)
+        if target > n:
+            self.scale_ups += 1
+            logger.info(
+                "fleet autoscaler: %s -> %s (queue_mean=%.2f "
+                "p95=%s)", n, target, sig["queue_mean"],
+                sig["p95_worst_s"],
+            )
+            self.sup.scale_to(target)
+        elif target < n:
+            self.scale_downs += 1
+            logger.info("fleet autoscaler: %s -> %s (idle)", n, target)
+            self.sup.scale_to(target)
+        return {"n": n, "target": target, **sig}
+
+    def status(self) -> Dict:
+        return {
+            "evaluations": self.evaluations,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "signals": self.last_signals,
+            "bounds": [self.cfg.min_replicas, self.cfg.max_replicas],
+        }
+
+    # -- periodic driver ---------------------------------------------------
+
+    def start(self) -> "FleetAutoscaler":
+        """Periodic evaluation at ``autoscale_interval_s`` (a config of
+        0 means manual ``step()`` only — start() is then a no-op)."""
+        if self.cfg.autoscale_interval_s <= 0:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — scaler survives
+                logger.exception("fleet autoscaler error: %s", e)
+            self._stop.wait(self.cfg.autoscale_interval_s)

@@ -1,0 +1,111 @@
+"""``tpurun-fleet`` — run a serving fleet on this host.
+
+Spawns N ``tpurun-serve`` replica subprocesses, supervises them, and
+serves the gateway API on ``--port``::
+
+    tpurun-fleet --cpu --replicas 2 --port 8400 -- --max-new-tokens 64
+
+Everything after ``--`` is forwarded verbatim to every replica's
+``tpurun-serve`` command line (model family/config, ``--ckpt-dir``,
+engine shape flags); ``--port``/``--replica-id`` are per-replica and
+owned by the supervisor. Fleet shape and SLOs come from flags or their
+``DLROVER_FLEET_*`` env twins (docs/serving_fleet.md knob table).
+"""
+
+import argparse
+import signal
+from typing import List, Optional
+
+from ..common.log import logger
+from .autoscaler import FleetAutoscaler
+from .config import FleetConfig
+from .gateway import Gateway
+from .replica import SubprocessReplica
+from .supervisor import ReplicaSupervisor
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun-fleet",
+        description="elastic serving fleet: replica supervisor + "
+        "slot-aware gateway",
+    )
+    ap.add_argument("--port", type=int, default=8400,
+                    help="gateway bind port")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="initial replica count "
+                    "(DLROVER_FLEET_REPLICAS)")
+    ap.add_argument("--min-replicas", type=int, default=None)
+    ap.add_argument("--max-replicas", type=int, default=None)
+    ap.add_argument("--queue-limit", type=int, default=None,
+                    help="gateway admission bound before 429 "
+                    "(DLROVER_FLEET_QUEUE_LIMIT)")
+    ap.add_argument("--autoscale-interval", type=float, default=None,
+                    help="autoscaler period in seconds; 0 disables "
+                    "(DLROVER_FLEET_AUTOSCALE_INTERVAL_S)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="forward --cpu to every replica (local smoke)")
+    ap.add_argument(
+        "serve_args", nargs=argparse.REMAINDER,
+        help="args after -- are forwarded to every tpurun-serve "
+        "replica",
+    )
+    ns = ap.parse_args(argv)
+
+    overrides = {}
+    if ns.replicas is not None:
+        overrides["replicas"] = ns.replicas
+    if ns.min_replicas is not None:
+        overrides["min_replicas"] = ns.min_replicas
+    if ns.max_replicas is not None:
+        overrides["max_replicas"] = ns.max_replicas
+    if ns.queue_limit is not None:
+        overrides["queue_limit"] = ns.queue_limit
+    if ns.autoscale_interval is not None:
+        overrides["autoscale_interval_s"] = ns.autoscale_interval
+    cfg = FleetConfig.from_env(**overrides)
+
+    serve_args = list(ns.serve_args)
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    if ns.cpu and "--cpu" not in serve_args:
+        serve_args.append("--cpu")
+
+    def factory(rid: int, port: int) -> SubprocessReplica:
+        return SubprocessReplica(rid, port, serve_args=serve_args)
+
+    # Replicas run in their own sessions (a replica SIGKILL must never
+    # signal the fleet), so the DEFAULT SIGTERM action — immediate
+    # death, no finally — would orphan every replica process. k8s
+    # stops pods with SIGTERM: route it through KeyboardInterrupt so
+    # the teardown below terminates the fleet.
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    supervisor = ReplicaSupervisor(factory, cfg).start()
+    gateway = Gateway(supervisor, cfg)
+    scaler = FleetAutoscaler(supervisor, cfg).start()
+    httpd = gateway.serve(ns.port)
+    logger.info(
+        "tpurun-fleet gateway on :%s — %s replicas (bounds %s..%s), "
+        "queue_limit %s",
+        httpd.server_address[1], cfg.replicas, cfg.min_replicas,
+        cfg.max_replicas, cfg.queue_limit,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        scaler.stop()
+        supervisor.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

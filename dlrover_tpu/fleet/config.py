@@ -1,0 +1,90 @@
+"""Fleet configuration: the ``DLROVER_FLEET_*`` operator surface.
+
+One typed dataclass consumed by every fleet component (supervisor,
+gateway, rollout, autoscaler). Every field is overridable through a
+registered env knob (``common/constants.py ENV_KNOBS`` — the
+``tpurun-lint`` env-knobs pass enforces registered ⇔ documented ⇔
+referenced from day one) and through ``tpurun-fleet`` flags; the env
+path exists so a k8s Deployment tunes the fleet without re-templating
+its command line, mirroring the trainer's ``DLROVER_*`` contract.
+"""
+
+from dataclasses import dataclass, fields
+
+from ..common.constants import ENV_KNOBS
+
+# field name -> env knob. Declared next to the dataclass so a new field
+# and its knob land in the same diff (the lint staleness check fails on
+# either half missing).
+_FLEET_KNOBS = {
+    "replicas": "DLROVER_FLEET_REPLICAS",
+    "min_replicas": "DLROVER_FLEET_MIN_REPLICAS",
+    "max_replicas": "DLROVER_FLEET_MAX_REPLICAS",
+    "health_interval_s": "DLROVER_FLEET_HEALTH_INTERVAL_S",
+    "health_timeout_s": "DLROVER_FLEET_HEALTH_TIMEOUT_S",
+    "health_fails": "DLROVER_FLEET_HEALTH_FAILS",
+    "start_timeout_s": "DLROVER_FLEET_START_TIMEOUT_S",
+    "relaunch_budget": "DLROVER_FLEET_RELAUNCH_BUDGET",
+    "queue_limit": "DLROVER_FLEET_QUEUE_LIMIT",
+    "retry_after_s": "DLROVER_FLEET_RETRY_AFTER_S",
+    "request_timeout_s": "DLROVER_FLEET_REQUEST_TIMEOUT_S",
+    "drain_timeout_s": "DLROVER_FLEET_DRAIN_TIMEOUT_S",
+    "autoscale_interval_s": "DLROVER_FLEET_AUTOSCALE_INTERVAL_S",
+    "queue_high": "DLROVER_FLEET_QUEUE_HIGH",
+    "p95_target_s": "DLROVER_FLEET_P95_TARGET_S",
+}
+
+
+@dataclass
+class FleetConfig:
+    """Knobs for one serving fleet (docs/serving_fleet.md table)."""
+
+    # topology
+    replicas: int = 2  # initial replica count
+    min_replicas: int = 1  # autoscaler floor
+    max_replicas: int = 4  # autoscaler ceiling
+
+    # replica supervision (STARTING→READY→DRAINING→DEAD machine)
+    health_interval_s: float = 0.5  # seconds between /healthz polls
+    health_timeout_s: float = 5.0  # per-poll deadline
+    health_fails: int = 3  # consecutive failures before DEAD
+    start_timeout_s: float = 120.0  # STARTING deadline before relaunch
+    relaunch_budget: int = 3  # relaunches per replica slot
+
+    # gateway admission + proxying
+    queue_limit: int = 64  # in-flight bound before 429
+    retry_after_s: float = 1.0  # Retry-After hint on 429
+    request_timeout_s: float = 300.0  # gateway→replica proxy deadline
+
+    # staged weight rollout
+    drain_timeout_s: float = 120.0  # per-replica drain deadline
+
+    # autoscaler
+    autoscale_interval_s: float = 0.0  # 0 = manual stepping only
+    queue_high: float = 4.0  # mean queued/replica to grow
+    p95_target_s: float = 0.0  # p95 latency target to grow (0 = off)
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if not (
+            1 <= self.min_replicas <= self.replicas <= self.max_replicas
+        ):
+            raise ValueError(
+                "need 1 <= min_replicas <= replicas <= max_replicas, got "
+                f"{self.min_replicas}/{self.replicas}/{self.max_replicas}"
+            )
+        if self.health_fails < 1:
+            raise ValueError("health_fails must be >= 1")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "FleetConfig":
+        """Defaults ← ``DLROVER_FLEET_*`` env ← explicit overrides."""
+        kwargs = {}
+        for f in fields(cls):
+            knob = ENV_KNOBS[_FLEET_KNOBS[f.name]]
+            val = knob.get()
+            if val is not None:
+                kwargs[f.name] = val
+        kwargs.update(overrides)
+        return cls(**kwargs)

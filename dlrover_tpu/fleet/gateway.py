@@ -1,0 +1,598 @@
+"""Slot-aware HTTP gateway over a replica fleet.
+
+The fleet's single client-facing endpoint, speaking the same API one
+``tpurun-serve`` replica speaks (``/v1/completions``,
+``/v1/prefixes``) plus the fleet control surface (``/fleet/status``,
+``/fleet/rollout``, ``/fleet/scale``). Behavior contract
+(docs/serving_fleet.md):
+
+- **Slot-aware least-loaded routing**: each request goes to the READY
+  replica with the lowest load score — ``busy_slots + queue_depth``
+  from its last health poll plus the gateway's own in-flight count to
+  that replica (the poll snapshot alone lags by up to one health
+  interval; the in-flight term keeps a burst from dogpiling one
+  replica inside that window).
+- **Stream pinning**: a streaming completion is pinned to its replica
+  for its whole life (its KV cache lives there). If the replica dies
+  mid-stream the stream errors — re-dispatching would silently replay
+  token history from a different cache.
+- **Transparent re-dispatch**: a NON-streamed request whose replica
+  dies mid-flight — a connection error, or a replica-side 5xx (a
+  SIGKILLed subprocess drops the socket; an in-process driver death
+  answers ``500 serving daemon stopped`` on its way down) — is
+  re-sent to another READY replica. Completions are deterministic per
+  weight version and a failed attempt emitted nothing to the client,
+  so a replay is safe; the client sees one slower success instead of
+  an error. Replica 4xx are the client's own fault and forward as-is.
+- **Admission control**: total in-flight proxied requests are bounded
+  (``queue_limit``); beyond it the gateway answers 429 with a
+  ``Retry-After`` hint instead of queueing without bound — overload
+  degrades into explicit backpressure, not a wedged fleet.
+- **Prefix fan-out**: ``/v1/prefixes`` registers on the gateway; the
+  gateway replays registrations onto every replica — keyed by
+  (generation, weight_version), so a relaunched or re-weighted
+  replica gets fresh registrations before serving prefix requests
+  (the engine refuses stale prefix encodings by construction; the
+  gateway's job is re-registration, not cache validity).
+
+Gateway request time is stamped into an attribution
+:class:`PhaseAccumulator` (``route``/``proxy``/``redispatch`` —
+attribution/phases.py), so ``/fleet/status`` reports the gateway's own
+host fraction next to each replica's serving split.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..attribution.phases import PhaseAccumulator
+from ..chaos import faults
+from ..common.log import logger
+from .config import FleetConfig
+from .supervisor import ReplicaHandle, ReplicaSupervisor
+
+__all__ = ["Gateway", "GatewayBusy", "NoReadyReplica"]
+
+
+class GatewayBusy(Exception):
+    """Admission control rejected the request (fleet queue bound)."""
+
+
+class NoReadyReplica(Exception):
+    """No READY replica can take the request right now."""
+
+
+class UnknownPrefix(Exception):
+    """The client named a fleet prefix_id that was never registered —
+    a CLIENT error (400), never grounds for re-dispatch: every replica
+    would reject it identically."""
+
+
+class Gateway:
+    """Routes fleet traffic; owns fleet-level prefix state."""
+
+    def __init__(
+        self,
+        supervisor: ReplicaSupervisor,
+        config: Optional[FleetConfig] = None,
+    ):
+        self.sup = supervisor
+        self.cfg = config or supervisor.cfg
+        self._mu = threading.Lock()
+        self._inflight: Dict[int, int] = {}  # rid -> proxied now
+        self._total_inflight = 0
+        self.served = 0
+        self.rejected = 0  # 429s
+        self.redispatches = 0
+        self.routed: Dict[int, int] = {}  # rid -> total routed
+        # fleet prefixes: fleet_pid -> token list, and the per-replica
+        # registration map (rid, generation, weight_version, fleet_pid)
+        # -> replica-local pid
+        self._prefixes: Dict[int, List[int]] = {}
+        self._next_prefix_id = 0
+        self._replica_pids: Dict[tuple, int] = {}
+        self.phases = PhaseAccumulator()
+        self._rollout_mu = threading.Lock()
+        self.last_rollout: Optional[Dict] = None
+        # the supervisor announces every STARTING->READY transition;
+        # fresh processes need their prefix registrations replayed
+        supervisor.on_ready = self.replay_prefixes
+        self._httpd = None
+        self._http_thread = None
+
+    # -- admission + routing --------------------------------------------
+
+    def _admit(self) -> None:
+        with self._mu:
+            if self._total_inflight >= self.cfg.queue_limit:
+                self.rejected += 1
+                raise GatewayBusy(
+                    f"fleet at queue_limit={self.cfg.queue_limit}"
+                )
+            self._total_inflight += 1
+
+    def _release(self, rid: Optional[int]) -> None:
+        with self._mu:
+            self._total_inflight -= 1
+            if rid is not None and rid in self._inflight:
+                self._inflight[rid] -= 1
+
+    def _pick(self, exclude=()) -> ReplicaHandle:
+        """Least-loaded READY replica (the chaos ``fleet.route`` point
+        fires here: an injected error models a routing-layer fault and
+        surfaces as 503, not a wedge)."""
+        faults.inject("fleet.route", exclude=list(exclude))
+        candidates = [
+            h for h in self.sup.ready_replicas() if h.rid not in exclude
+        ]
+        if not candidates:
+            raise NoReadyReplica(
+                f"no READY replica (excluded: {sorted(exclude)})"
+            )
+        with self._mu:
+            def load(h: ReplicaHandle) -> tuple:
+                stats = h.stats
+                return (
+                    (stats.get("busy_slots") or 0)
+                    + (stats.get("queue_depth") or 0)
+                    + self._inflight.get(h.rid, 0),
+                    # equal load rotates by fewest-ever-routed (plain
+                    # round-robin for an idle fleet), then rid
+                    self.routed.get(h.rid, 0),
+                    h.rid,
+                )
+
+            best = min(candidates, key=load)
+            self._inflight[best.rid] = (
+                self._inflight.get(best.rid, 0) + 1
+            )
+            self.routed[best.rid] = self.routed.get(best.rid, 0) + 1
+        return best
+
+    def _unpin(self, rid: int) -> None:
+        with self._mu:
+            if rid in self._inflight:
+                self._inflight[rid] -= 1
+
+    # -- replica HTTP helpers -------------------------------------------
+
+    def _post_replica(self, h: ReplicaHandle, path: str, payload: Dict,
+                      timeout: float):
+        req = urllib.request.Request(
+            h.url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    # -- prefix fan-out -------------------------------------------------
+
+    def register_prefix(self, tokens: List[int]) -> int:
+        """Fleet-level prefix registration: stored once here, replayed
+        to replicas. Registration on the replicas is best-effort NOW
+        (a dead replica catches up through replay_prefixes on its next
+        READY transition) but at least one replica must accept —
+        otherwise the client would hold an id nobody can serve."""
+        with self._mu:
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = list(tokens)
+        ok = 0
+        last_err: Optional[Exception] = None
+        for h in self.sup.ready_replicas():
+            try:
+                self._ensure_prefix(h, pid)
+                ok += 1
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    # a 4xx is the PREFIX being bad (too wide, empty):
+                    # every replica would reject it the same way —
+                    # forget it and surface the verdict verbatim
+                    with self._mu:
+                        self._prefixes.pop(pid, None)
+                    raise
+                last_err = e  # a 5xx is the replica failing, not the prefix
+            except Exception as e:  # noqa: BLE001 — replica-side blip
+                last_err = e
+        if ok == 0:
+            with self._mu:
+                self._prefixes.pop(pid, None)
+            raise NoReadyReplica(
+                f"prefix registered on no replica ({last_err!r})"
+            )
+        return pid
+
+    def _ensure_prefix(self, h: ReplicaHandle, fleet_pid: int) -> int:
+        """The replica-local prefix id for ``fleet_pid`` at this
+        replica's CURRENT (generation, weight_version) — registering
+        on demand. The weight_version key is what makes rollout
+        prefix serving version-consistent: the first request after a
+        swap re-registers rather than trusting ids minted against the
+        old weights."""
+        key = (h.rid, h.generation, h.weight_version, fleet_pid)
+        with self._mu:
+            rpid = self._replica_pids.get(key)
+            tokens = self._prefixes.get(fleet_pid)
+        if rpid is not None:
+            return rpid
+        if tokens is None:
+            raise UnknownPrefix(f"unknown fleet prefix_id {fleet_pid}")
+        _, out = self._post_replica(
+            h, "/v1/prefixes", {"tokens": tokens},
+            timeout=self.cfg.request_timeout_s,
+        )
+        rpid = out["prefix_id"]
+        with self._mu:
+            self._replica_pids[key] = rpid
+        return rpid
+
+    def replay_prefixes(self, h: ReplicaHandle) -> int:
+        """Re-register every fleet prefix on ``h`` (READY transitions
+        and post-swap rollout calls). Returns how many registered."""
+        with self._mu:
+            pids = list(self._prefixes)
+        n = 0
+        for pid in pids:
+            try:
+                self._ensure_prefix(h, pid)
+                n += 1
+            except Exception as e:  # noqa: BLE001 — next poll retries
+                logger.warning(
+                    "fleet prefix %s replay on replica %s failed: %r",
+                    pid, h.rid, e,
+                )
+        return n
+
+    # -- completions ----------------------------------------------------
+
+    def complete(self, body: Dict) -> Dict:
+        """Route one NON-streamed completion; re-dispatch on replica
+        death. Raises GatewayBusy (429), NoReadyReplica (503),
+        UnknownPrefix (400), urllib.error.HTTPError (replica's own
+        4xx, forwarded)."""
+        self._admit()
+        rid = None
+        try:
+            tried: set = set()
+            t0 = time.perf_counter()
+            while True:
+                h = self._pick(exclude=tried)
+                rid = h.rid
+                t1 = time.perf_counter()
+                self.phases.add("route", t1 - t0)
+                try:
+                    payload = self._translate(h, body)
+                    _, out = self._post_replica(
+                        h, "/v1/completions", payload,
+                        timeout=self.cfg.request_timeout_s,
+                    )
+                    self.phases.add("proxy", time.perf_counter() - t1)
+                    self.phases.rounds += 1
+                    with self._mu:
+                        self.served += 1
+                    out["replica"] = h.rid
+                    return out
+                except UnknownPrefix:
+                    # the client's own bad prefix_id: every replica
+                    # would reject it identically — never a re-dispatch
+                    self._unpin(h.rid)
+                    rid = None
+                    raise
+                except urllib.error.HTTPError as e:
+                    if e.code < 500:
+                        self.phases.add(
+                            "proxy", time.perf_counter() - t1
+                        )
+                        raise  # the client's own error: verdict stands
+                    # 5xx: the replica is failing, not the request —
+                    # fall through to the re-dispatch path
+                    self.phases.add("proxy", time.perf_counter() - t1)
+                    t0 = time.perf_counter()
+                    tried.add(h.rid)
+                    self._unpin(h.rid)
+                    rid = None
+                    with self._mu:
+                        self.redispatches += 1
+                    logger.warning(
+                        "fleet re-dispatching off replica %s "
+                        "(HTTP %s)", h.rid, e.code,
+                    )
+                    self.phases.add(
+                        "redispatch", time.perf_counter() - t0
+                    )
+                    continue
+                except Exception as e:  # noqa: BLE001 — replica died mid-flight
+                    self.phases.add("proxy", time.perf_counter() - t1)
+                    t0 = time.perf_counter()
+                    tried.add(h.rid)
+                    self._unpin(h.rid)
+                    rid = None
+                    with self._mu:
+                        self.redispatches += 1
+                    logger.warning(
+                        "fleet re-dispatching off replica %s: %r",
+                        h.rid, e,
+                    )
+                    self.phases.add(
+                        "redispatch", time.perf_counter() - t0
+                    )
+        finally:
+            self._release(rid)
+
+    def _translate(self, h: ReplicaHandle, body: Dict) -> Dict:
+        """Client payload -> replica payload (fleet prefix id -> the
+        replica-local id at its current generation/weight version)."""
+        payload = dict(body)
+        pid = payload.get("prefix_id")
+        if pid is not None:
+            payload["prefix_id"] = self._ensure_prefix(h, int(pid))
+        return payload
+
+    # -- status ----------------------------------------------------------
+
+    def status(self) -> Dict:
+        sup = self.sup.status()
+        with self._mu:
+            gw = {
+                "inflight": self._total_inflight,
+                "served": self.served,
+                "rejected": self.rejected,
+                "redispatches": self.redispatches,
+                "routed": dict(self.routed),
+                "queue_limit": self.cfg.queue_limit,
+                "prefixes": len(self._prefixes),
+            }
+        return {
+            **sup,
+            "gateway": gw,
+            "phase_split": self.phases.split().summary(),
+            "rollout": self.last_rollout,
+        }
+
+    # -- HTTP front end ---------------------------------------------------
+
+    def serve(self, port: int = 0) -> ThreadingHTTPServer:
+        """Bind the gateway's HTTP server (caller runs serve_forever,
+        or use start_http for a daemon thread)."""
+        self._httpd = ThreadingHTTPServer(
+            ("0.0.0.0", port), _make_handler(self)
+        )
+        return self._httpd
+
+    def start_http(self, port: int = 0) -> int:
+        httpd = self.serve(port)
+        self._http_thread = threading.Thread(
+            target=httpd.serve_forever, name="fleet-gateway", daemon=True
+        )
+        self._http_thread.start()
+        return httpd.server_address[1]
+
+    def stop_http(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=10)
+
+
+def _make_handler(gw: Gateway):
+    from ..common.http import JsonRequestHandler
+
+    class Handler(JsonRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("fleet-gw: " + fmt, *args)
+
+        def do_GET(self):
+            if self.path in ("/fleet/status", "/healthz"):
+                self._send(200, gw.status())
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                body = self._body()
+            except ValueError as e:
+                self._send(400, {"error": f"bad json: {e}"})
+                return
+            if self.path == "/v1/completions":
+                if body.get("stream"):
+                    self._stream(body)
+                else:
+                    self._complete(body)
+            elif self.path == "/v1/prefixes":
+                self._prefixes(body)
+            elif self.path == "/fleet/rollout":
+                self._rollout(body)
+            elif self.path == "/fleet/scale":
+                self._scale(body)
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        # -- route handlers ------------------------------------------
+
+        def _complete(self, body):
+            try:
+                out = gw.complete(body)
+            except GatewayBusy as e:
+                self._send(
+                    429,
+                    {"error": str(e)},
+                    headers=(
+                        ("Retry-After", str(gw.cfg.retry_after_s)),
+                    ),
+                )
+                return
+            except NoReadyReplica as e:
+                self._send(503, {"error": str(e)})
+                return
+            except UnknownPrefix as e:
+                self._send(400, {"error": str(e)})
+                return
+            except urllib.error.HTTPError as e:
+                # the replica's own verdict (400 bad prompt, ...)
+                try:
+                    detail = json.loads(e.read())
+                except Exception:  # noqa: BLE001
+                    detail = {"error": str(e)}
+                self._send(e.code, detail)
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": repr(e)[:200]})
+                return
+            self._send(200, out)
+
+        def _stream(self, body):
+            """Pinned streaming proxy: relay the replica's chunked
+            NDJSON. A replica death mid-stream breaks the relay — the
+            client sees a truncated stream and re-submits (pinning
+            contract; the KV died with the replica)."""
+            try:
+                gw._admit()
+            except GatewayBusy as e:
+                self._send(
+                    429,
+                    {"error": str(e)},
+                    headers=(
+                        ("Retry-After", str(gw.cfg.retry_after_s)),
+                    ),
+                )
+                return
+            rid = None
+            try:
+                try:
+                    h = gw._pick()
+                    rid = h.rid
+                    payload = gw._translate(h, body)
+                except NoReadyReplica as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except UnknownPrefix as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except urllib.error.HTTPError as e:
+                    # on-demand prefix registration got the replica's
+                    # verdict — forward it, don't drop the socket
+                    try:
+                        detail = json.loads(e.read())
+                    except Exception:  # noqa: BLE001
+                        detail = {"error": str(e)}
+                    self._send(e.code, detail)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(503, {"error": repr(e)[:200]})
+                    return
+                req = urllib.request.Request(
+                    h.url + "/v1/completions",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    upstream = urllib.request.urlopen(
+                        req, timeout=gw.cfg.request_timeout_s
+                    )
+                except urllib.error.HTTPError as e:
+                    try:
+                        detail = json.loads(e.read())
+                    except Exception:  # noqa: BLE001
+                        detail = {"error": str(e)}
+                    self._send(e.code, detail)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(503, {"error": repr(e)[:200]})
+                    return
+                with upstream:
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("X-Fleet-Replica", str(h.rid))
+                    self.end_headers()
+                    try:
+                        while True:
+                            line = upstream.readline()
+                            if not line:
+                                break
+                            self.wfile.write(
+                                f"{len(line):x}\r\n".encode()
+                            )
+                            self.wfile.write(line + b"\r\n")
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                        with gw._mu:
+                            gw.served += 1
+                    except OSError:
+                        # client or replica hung up mid-relay: the
+                        # stream dies (pinned), nothing to clean here —
+                        # the replica's own disconnect handling cancels
+                        # the engine request
+                        pass
+            finally:
+                gw._release(rid)
+
+        def _prefixes(self, body):
+            tokens = body.get("tokens")
+            if not isinstance(tokens, list) or not all(
+                isinstance(t, int) for t in tokens
+            ):
+                self._send(
+                    400, {"error": "tokens must be a list of token ids"}
+                )
+                return
+            try:
+                pid = gw.register_prefix(tokens)
+            except urllib.error.HTTPError as e:
+                try:
+                    detail = json.loads(e.read())
+                except Exception:  # noqa: BLE001
+                    detail = {"error": str(e)}
+                self._send(e.code, detail)
+                return
+            except NoReadyReplica as e:
+                self._send(503, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": repr(e)[:200]})
+                return
+            self._send(200, {"prefix_id": pid})
+
+        def _rollout(self, body):
+            from .rollout import staged_rollout
+
+            if not gw._rollout_mu.acquire(blocking=False):
+                self._send(409, {"error": "rollout already running"})
+                return
+            if body.get("wait"):
+                try:
+                    report = staged_rollout(gw.sup, gw)
+                finally:
+                    gw._rollout_mu.release()
+                self._send(200, report)
+                return
+
+            def run_and_release():
+                try:
+                    staged_rollout(gw.sup, gw)
+                finally:
+                    gw._rollout_mu.release()
+
+            threading.Thread(
+                target=run_and_release, name="fleet-rollout", daemon=True
+            ).start()
+            self._send(202, {"started": True})
+
+        def _scale(self, body):
+            n = body.get("replicas")
+            if not isinstance(n, int) or isinstance(n, bool):
+                self._send(400, {"error": "replicas must be an int"})
+                return
+            self._send(200, {"replicas": gw.sup.scale_to(n)})
+
+    return Handler

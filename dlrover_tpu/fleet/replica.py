@@ -1,0 +1,193 @@
+"""Replica process backends for the fleet supervisor.
+
+A replica is ONE ``tpurun-serve``-shaped HTTP serving daemon. The
+supervisor only needs a tiny lifecycle protocol from it::
+
+    start()      bind and begin serving (port resolved after start)
+    alive()      process/thread still running
+    terminate()  graceful stop (drain-friendly)
+    kill()       hard stop — SIGKILL for subprocesses, an abrupt
+                 socket+driver teardown in-process (mid-flight requests
+                 fail with connection errors, exactly like a SIGKILL)
+    port         the bound HTTP port (valid once start() returned)
+
+Two implementations:
+
+- :class:`SubprocessReplica` — production shape: one ``tpurun-serve``
+  process per replica (own jax runtime, own device footprint, crash
+  isolation; a replica SIGKILL cannot take the gateway down).
+- :class:`InProcessReplica` — test/bench shape: a real
+  ``ServingDaemon`` + HTTP server on a thread, so fleet semantics
+  (routing, failover, rollout) are exercised over genuine HTTP without
+  paying a jax interpreter boot per replica.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Callable, List, Optional
+
+from ..common.log import logger
+
+__all__ = ["SubprocessReplica", "InProcessReplica", "serve_command"]
+
+
+def serve_command(
+    port: int, replica_id: int, serve_args: Optional[List[str]] = None
+) -> List[str]:
+    """The ``tpurun-serve`` argv for one replica. ``serve_args`` carries
+    the fleet-wide model/engine flags (``--cpu``, ``--ckpt-dir``,
+    ``--config``, ...); port and replica id are per-replica."""
+    return [
+        sys.executable,
+        "-m",
+        "dlrover_tpu.launcher.serve",
+        "--port",
+        str(port),
+        "--replica-id",
+        str(replica_id),
+        *(serve_args or []),
+    ]
+
+
+class SubprocessReplica:
+    """One ``tpurun-serve`` child process."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        port: int,
+        serve_args: Optional[List[str]] = None,
+        env: Optional[dict] = None,
+    ):
+        self.replica_id = replica_id
+        self.port = port
+        self._argv = serve_command(port, replica_id, serve_args)
+        self._env = env
+        self._proc: Optional[subprocess.Popen] = None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def start(self) -> None:
+        env = dict(os.environ if self._env is None else self._env)
+        # each replica gets a private IPC namespace: its checkpoint
+        # restore engine must never unlink a sibling's (or a colocated
+        # trainer's) shm segment
+        env["DLROVER_IPC_NAMESPACE"] = (
+            f"fleet_r{self.replica_id}_p{self.port}_{os.getpid()}"
+        )
+        self._proc = subprocess.Popen(
+            self._argv,
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,  # our kill never signals the fleet
+        )
+        logger.info(
+            "fleet replica %s: spawned pid %s on port %s",
+            self.replica_id, self._proc.pid, self.port,
+        )
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def terminate(self) -> None:
+        if self.alive():
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.kill()
+
+    def kill(self) -> None:
+        if self._proc is None:
+            return
+        try:
+            os.kill(self._proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+class InProcessReplica:
+    """A real serving daemon + HTTP server on a thread.
+
+    ``engine_factory`` builds the ContinuousBatchingEngine (called on
+    every (re)launch — a killed replica restarts with FRESH engine
+    state, like a respawned process restoring from the checkpoint);
+    ``reload_fn`` is the ``/v1/weights/reload`` source, ``() -> (step,
+    params)``, so rollout tests/bench drive real weight swaps."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        port: int = 0,
+        engine_factory: Optional[Callable] = None,
+        reload_fn: Optional[Callable] = None,
+    ):
+        if engine_factory is None:
+            raise ValueError("InProcessReplica needs an engine_factory")
+        self.replica_id = replica_id
+        self.port = port  # rebound to the real port after start()
+        self._engine_factory = engine_factory
+        self._reload_fn = reload_fn
+        self._daemon = None
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self._alive = False
+
+    @property
+    def pid(self) -> Optional[int]:
+        return os.getpid()
+
+    def start(self) -> None:
+        from ..launcher.serve import ServingDaemon, serve
+
+        engine = self._engine_factory()
+        self._daemon = ServingDaemon(engine).start()
+        self._httpd = serve(
+            self._daemon,
+            port=0,
+            reload_fn=self._reload_fn,
+            replica_id=self.replica_id,
+        )
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"fleet-replica-{self.replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        self._alive = True
+
+    def alive(self) -> bool:
+        return self._alive
+
+    def terminate(self) -> None:
+        self._stop()
+
+    def kill(self) -> None:
+        # abrupt: close the listening socket first, then drop the
+        # driver — in-flight gateway proxies see connection resets,
+        # the same failure surface a SIGKILLed subprocess produces
+        self._stop()
+
+    def _stop(self) -> None:
+        if not self._alive:
+            return
+        self._alive = False
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        self._daemon.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

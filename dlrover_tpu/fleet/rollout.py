@@ -1,0 +1,184 @@
+"""Staged zero-downtime weight rollout: drain → swap → readmit, one
+replica at a time.
+
+A checkpoint push to a serving fleet must never be an outage. The
+rollout walks the READY replicas in rid order and, for each one:
+
+1. **drain** — the gateway stops routing to it; the rollout waits for
+   its in-flight work to retire (``busy_slots == 0``, empty queue, no
+   in-flight pipeline chunk, no gateway proxy still open against it),
+   bounded by ``drain_timeout_s``.
+2. **swap** — ``POST /v1/weights/reload`` on the replica: the engine
+   restores the new checkpoint and hot-swaps between chunks. A swap
+   failure rides the engine's existing abort path (``swap_failures`` /
+   ``last_swap_error`` — old weights keep serving), so rollback here
+   is simply *readmitting the un-swapped replica* and aborting the
+   rollout: the fleet keeps serving the old version at full strength.
+3. **re-register prefixes** — the replica's ``weight_version`` bumps,
+   which invalidates the gateway's (generation, weight_version) prefix
+   map; the gateway re-registers every fleet prefix so prefix requests
+   are version-consistent from the first post-swap completion (the
+   engine itself already refuses to serve a stale prefix KV encoding —
+   re-registration keeps the *ids* honest too).
+4. **readmit** — back to READY; only then does the next replica drain.
+
+Invariant: at most ONE replica is out of rotation at any instant, so a
+rollout never takes the fleet below N−1 READY replicas; the report's
+``max_unready`` proves it per run (bench: ``fleet_rollout_max_unready``).
+"""
+
+import json
+import time
+import urllib.request
+from typing import Dict, Optional
+
+from ..common.log import logger
+from .supervisor import ReplicaState
+
+__all__ = ["staged_rollout"]
+
+
+def _replica_stats(h, timeout: float) -> Dict:
+    """A FRESH /healthz snapshot (the supervisor's poll cache can lag
+    a health interval — drain decisions need the live counters)."""
+    with urllib.request.urlopen(
+        h.url + "/healthz", timeout=timeout
+    ) as r:
+        return json.loads(r.read())
+
+
+def _gateway_inflight(gateway, rid: int) -> int:
+    with gateway._mu:
+        return gateway._inflight.get(rid, 0)
+
+
+def staged_rollout(
+    supervisor,
+    gateway,
+    swap_async: bool = False,
+    drain_timeout_s: Optional[float] = None,
+) -> Dict:
+    cfg = supervisor.cfg
+    drain_timeout_s = (
+        cfg.drain_timeout_s if drain_timeout_s is None else drain_timeout_s
+    )
+    targets = sorted(supervisor.ready_replicas(), key=lambda h: h.rid)
+    report: Dict = {
+        "replicas": [],
+        "target_count": len(targets),
+        "aborted": False,
+        "max_unready": 0,
+        "steps": [],
+    }
+
+    def sample_unready():
+        reps = supervisor.replicas()
+        unready = sum(
+            1 for h in reps if h.state != ReplicaState.READY
+        )
+        report["max_unready"] = max(report["max_unready"], unready)
+
+    for h in targets:
+        entry: Dict = {"rid": h.rid, "generation": h.generation}
+        report["replicas"].append(entry)
+        if h.state != ReplicaState.READY:
+            # died (or was drained by someone else) since the snapshot:
+            # the supervisor owns its recovery; skip, don't abort — the
+            # rollout's job is the replicas that ARE serving
+            entry["skipped"] = h.state
+            continue
+        t0 = time.perf_counter()
+        supervisor.drain(h.rid)
+        sample_unready()
+
+        # 1. wait for the replica to finish its in-flight work
+        deadline = time.monotonic() + drain_timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            sample_unready()
+            try:
+                stats = _replica_stats(h, cfg.health_timeout_s)
+            except Exception as e:  # noqa: BLE001 — replica died mid-drain
+                entry["error"] = f"died during drain: {e!r}"
+                break
+            if (
+                stats.get("busy_slots") == 0
+                and stats.get("queue_depth") == 0
+                and not stats.get("inflight_chunks")
+                and _gateway_inflight(gateway, h.rid) == 0
+            ):
+                drained = True
+                break
+            time.sleep(0.05)
+        entry["drain_s"] = round(time.perf_counter() - t0, 3)
+        if not drained:
+            entry.setdefault("error", "drain timeout")
+            supervisor.readmit(h.rid)
+            report["aborted"] = True
+            logger.error(
+                "fleet rollout aborted at replica %s: %s",
+                h.rid, entry["error"],
+            )
+            break
+
+        # 2. swap — failure rolls back to the old weights (the engine
+        #    aborts the swap itself via its swap_failures path; we just
+        #    put the un-swapped replica back into rotation)
+        t1 = time.perf_counter()
+        failures_before = int(stats.get("swap_failures") or 0)
+        try:
+            req = urllib.request.Request(
+                h.url + "/v1/weights/reload",
+                data=json.dumps({"async": bool(swap_async)}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=cfg.request_timeout_s
+            ) as r:
+                out = json.loads(r.read())
+            if swap_async:
+                # adoption lands at a later drain point; wait it out so
+                # an in-flight transfer failure still aborts THIS stage
+                adopt_deadline = time.monotonic() + cfg.request_timeout_s
+                while time.monotonic() < adopt_deadline:
+                    stats = _replica_stats(h, cfg.health_timeout_s)
+                    if not stats.get("swap_pending"):
+                        break
+                    time.sleep(0.05)
+            else:
+                stats = _replica_stats(h, cfg.health_timeout_s)
+            if int(stats.get("swap_failures") or 0) > failures_before:
+                raise RuntimeError(
+                    f"engine aborted the swap: "
+                    f"{stats.get('last_swap_error')}"
+                )
+        except Exception as e:  # noqa: BLE001 — swap failed: rollback
+            entry["error"] = f"swap failed: {e!r}"[:300]
+            supervisor.readmit(h.rid)
+            sample_unready()
+            report["aborted"] = True
+            logger.error(
+                "fleet rollout aborted at replica %s (old weights keep "
+                "serving): %r", h.rid, e,
+            )
+            break
+        entry["swap_s"] = round(time.perf_counter() - t1, 3)
+        entry["step"] = out.get("step")
+        report["steps"].append(out.get("step"))
+
+        # 3. new weight version: re-register fleet prefixes against it
+        h.weight_version += 1
+        entry["weight_version"] = h.weight_version
+        entry["prefixes_replayed"] = gateway.replay_prefixes(h)
+
+        # 4. back into rotation before the next replica drains
+        supervisor.readmit(h.rid)
+        sample_unready()
+        entry["total_s"] = round(time.perf_counter() - t0, 3)
+
+    report["version_consistent"] = (
+        len(set(report["steps"])) <= 1
+    )
+    gateway.last_rollout = report
+    return report

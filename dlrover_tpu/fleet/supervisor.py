@@ -1,0 +1,399 @@
+"""ReplicaSupervisor: N supervised serving replicas, health-driven.
+
+The serving-side twin of the training agent's worker supervision: where
+the agent restarts a crashed JAX worker through a restart budget and
+reports to the master, the supervisor drives each serving replica
+through a STARTING→READY→DRAINING→DEAD state machine off ``/healthz``
+polls and relaunches dead replicas under a per-slot relaunch budget
+with exponential backoff. A replica death is a capacity dip, never an
+outage: the gateway routes around anything not READY.
+
+State machine (docs/serving_fleet.md)::
+
+    STARTING --healthz 200--> READY <--readmit-- DRAINING
+       |  ^                     |                   |
+       |  | relaunch            | health_fails      | health_fails
+       v  | (budget+backoff)    v                   v
+      DEAD <-------------------DEAD <--------------DEAD
+
+- STARTING: process launched, engine still compiling/restoring; a
+  replica stuck past ``start_timeout_s`` is declared dead.
+- READY: polls healthy — the ONLY state the gateway routes to.
+- DRAINING: deliberately out of rotation (staged rollout, scale-down);
+  still polled, still serving its in-flight requests.
+- DEAD: process gone or ``health_fails`` consecutive poll failures;
+  relaunched while the slot's budget lasts, else left dead (the fleet
+  degrades to the surviving replicas — mirroring the agent's
+  budget-exhausted RELAUNCH_REQUESTED path, not a crash loop).
+
+Locking discipline: ``_mu`` guards the handle table only; every poll,
+kill, spawn, and callback runs outside it (snapshot-under-lock /
+act-outside — the PodScaler incident class).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from ..chaos import faults
+from ..common.log import logger
+from .config import FleetConfig
+
+__all__ = ["ReplicaState", "ReplicaHandle", "ReplicaSupervisor"]
+
+
+class ReplicaState:
+    STARTING = "starting"
+    READY = "ready"
+    DRAINING = "draining"
+    DEAD = "dead"
+
+
+def free_port() -> int:
+    """A currently-free TCP port (bind(0) probe). Inherently racy —
+    the supervisor treats a failed bind as a normal replica death and
+    relaunches on a fresh port."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ReplicaHandle:
+    """Supervisor-side bookkeeping for one replica slot."""
+
+    def __init__(self, rid: int, proc):
+        self.rid = rid
+        self.proc = proc
+        self.state = ReplicaState.STARTING
+        self.state_since = time.monotonic()
+        self.generation = 0  # bumps every (re)launch
+        self.weight_version = 0  # bumps per adopted rollout swap
+        self.relaunches = 0
+        self.consecutive_fails = 0
+        self.next_launch_t = 0.0  # backoff gate for the next relaunch
+        self.stats: Dict = {}  # last /healthz payload
+        self.last_error: Optional[str] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.proc.port}"
+
+    def set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.state_since = time.monotonic()
+
+    def snapshot(self) -> Dict:
+        return {
+            "rid": self.rid,
+            "state": self.state,
+            "port": self.proc.port,
+            "pid": self.proc.pid,
+            "generation": self.generation,
+            "weight_version": self.weight_version,
+            "relaunches": self.relaunches,
+            "busy_slots": self.stats.get("busy_slots"),
+            "queue_depth": self.stats.get("queue_depth"),
+            "latency_p95_s": self.stats.get("latency_p95_s"),
+            "tokens_per_s": self.stats.get("tokens_per_s"),
+            "last_error": self.last_error,
+        }
+
+
+class ReplicaSupervisor:
+    """Spawns and supervises N replicas through a replica factory.
+
+    ``factory(rid, port)`` returns a replica process object
+    (fleet/replica.py protocol). ``on_ready(handle)`` fires from the
+    monitor thread every time a replica TRANSITIONS to READY — the
+    gateway hooks it to replay prefix registrations onto fresh
+    processes (engine prefix state dies with a replica)."""
+
+    # relaunch backoff: base * 2^(n-1), capped — the agent's
+    # restart-budget idiom (bounded retries, growing spacing)
+    BACKOFF_BASE_S = 0.5
+    BACKOFF_CAP_S = 10.0
+
+    def __init__(
+        self,
+        factory: Callable[[int, int], object],
+        config: Optional[FleetConfig] = None,
+        on_ready: Optional[Callable] = None,
+    ):
+        self._factory = factory
+        self.cfg = config or FleetConfig.from_env()
+        self.on_ready = on_ready
+        self._mu = threading.Lock()
+        self._handles: Dict[int, ReplicaHandle] = {}
+        self._next_rid = 0
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ReplicaSupervisor":
+        for _ in range(self.cfg.replicas):
+            self._spawn_slot()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=30)
+        for h in self.replicas():
+            try:
+                h.proc.terminate()
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                logger.warning("fleet replica %s teardown: %r", h.rid, e)
+
+    def _spawn_slot(self) -> ReplicaHandle:
+        with self._mu:
+            rid = self._next_rid
+            self._next_rid += 1
+        proc = self._factory(rid, free_port())
+        handle = ReplicaHandle(rid, proc)
+        try:
+            proc.start()
+        except Exception as e:  # noqa: BLE001 — a bad spawn is a death
+            handle.last_error = repr(e)[:200]
+            handle.set_state(ReplicaState.DEAD)
+            logger.error("fleet replica %s failed to spawn: %r", rid, e)
+        with self._mu:
+            self._handles[rid] = handle
+        return handle
+
+    # -- views ----------------------------------------------------------
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._mu:
+            return list(self._handles.values())
+
+    def ready_replicas(self) -> List[ReplicaHandle]:
+        return [
+            h for h in self.replicas() if h.state == ReplicaState.READY
+        ]
+
+    def get(self, rid: int) -> Optional[ReplicaHandle]:
+        with self._mu:
+            return self._handles.get(rid)
+
+    def status(self) -> Dict:
+        reps = self.replicas()
+        return {
+            "replicas": [h.snapshot() for h in reps],
+            "ready": sum(
+                1 for h in reps if h.state == ReplicaState.READY
+            ),
+            "target": len(reps),
+        }
+
+    def wait_ready(self, n: Optional[int] = None,
+                   timeout: float = 120.0) -> bool:
+        """Block until ``n`` (default: every slot) replicas are READY."""
+        want = len(self.replicas()) if n is None else n
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.ready_replicas()) >= want:
+                return True
+            if self._stop.is_set():
+                return False
+            time.sleep(0.05)
+        return False
+
+    # -- control surface ------------------------------------------------
+
+    def drain(self, rid: int) -> bool:
+        """Take a READY replica out of rotation (it keeps serving its
+        in-flight work; the gateway stops routing to it)."""
+        h = self.get(rid)
+        if h is None or h.state != ReplicaState.READY:
+            return False
+        h.set_state(ReplicaState.DRAINING)
+        return True
+
+    def readmit(self, rid: int) -> bool:
+        """Return a DRAINING replica to rotation."""
+        h = self.get(rid)
+        if h is None or h.state != ReplicaState.DRAINING:
+            return False
+        h.set_state(ReplicaState.READY)
+        return True
+
+    def kill_replica(self, rid: int) -> bool:
+        """Hard-kill one replica (chaos drills, scale-down of a wedged
+        member). The monitor detects the death and relaunches under
+        the normal budget — this is an induced fault, not a removal."""
+        h = self.get(rid)
+        if h is None:
+            return False
+        faults.inject("fleet.replica_kill", replica=rid, state=h.state)
+        h.proc.kill()
+        return True
+
+    def remove_replica(
+        self, rid: int, drain_timeout_s: Optional[float] = None
+    ) -> bool:
+        """Scale-down removal: DRAIN (out of rotation, in-flight work
+        finishes), then terminate and forget the slot (no relaunch —
+        unlike kill_replica this shrinks N). A voluntary shrink must
+        not truncate live streams; the drain is bounded by
+        ``drain_timeout_s`` (default: config) and the replica is
+        terminated regardless at the deadline."""
+        h = self.get(rid)
+        if h is None:
+            return False
+        h.set_state(ReplicaState.DRAINING)
+        deadline = time.monotonic() + (
+            self.cfg.drain_timeout_s
+            if drain_timeout_s is None
+            else drain_timeout_s
+        )
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    h.url + "/healthz",
+                    timeout=self.cfg.health_timeout_s,
+                ) as r:
+                    stats = json.loads(r.read())
+            except Exception:  # noqa: BLE001 — dead already: just reap
+                break
+            if (
+                stats.get("busy_slots") == 0
+                and stats.get("queue_depth") == 0
+                and not stats.get("inflight_chunks")
+            ):
+                break
+            time.sleep(0.05)
+        with self._mu:
+            self._handles.pop(rid, None)
+        h.proc.terminate()
+        return True
+
+    def scale_to(self, n: int) -> int:
+        """Grow/shrink toward ``n`` live slots within config bounds.
+        Shrink picks the highest-rid replicas (newest first) so the
+        fleet's stable core keeps its warmed caches."""
+        n = max(self.cfg.min_replicas, min(n, self.cfg.max_replicas))
+        current = self.replicas()
+        if n > len(current):
+            for _ in range(n - len(current)):
+                self._spawn_slot()
+        elif n < len(current):
+            for h in sorted(current, key=lambda h: -h.rid)[
+                : len(current) - n
+            ]:
+                self.remove_replica(h.rid)
+        return n
+
+    # -- monitor thread --------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for h in self.replicas():
+                if self._stop.is_set():
+                    break
+                try:
+                    self._poll_one(h)
+                except Exception as e:  # noqa: BLE001 — monitor survives
+                    logger.exception(
+                        "fleet monitor error on replica %s: %s", h.rid, e
+                    )
+            self._stop.wait(self.cfg.health_interval_s)
+
+    def _poll_one(self, h: ReplicaHandle) -> None:
+        if h.state == ReplicaState.DEAD:
+            self._maybe_relaunch(h)
+            return
+        if not h.proc.alive():
+            self._declare_dead(h, "process exited")
+            return
+        try:
+            # chaos hook: error mode models a health endpoint that
+            # answers garbage / refuses; delay models a slow poll
+            faults.inject(
+                "fleet.replica_health", replica=h.rid, state=h.state
+            )
+            with urllib.request.urlopen(
+                h.url + "/healthz", timeout=self.cfg.health_timeout_s
+            ) as r:
+                stats = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — one failed poll
+            h.consecutive_fails += 1
+            h.last_error = repr(e)[:200]
+            if h.state == ReplicaState.STARTING:
+                if (
+                    time.monotonic() - h.state_since
+                    > self.cfg.start_timeout_s
+                ):
+                    self._declare_dead(h, "start timeout")
+            elif h.consecutive_fails >= self.cfg.health_fails:
+                self._declare_dead(
+                    h, f"{h.consecutive_fails} failed health polls"
+                )
+            return
+        h.consecutive_fails = 0
+        h.stats = stats
+        if h.state == ReplicaState.STARTING:
+            h.set_state(ReplicaState.READY)
+            logger.info(
+                "fleet replica %s READY on port %s (gen %s)",
+                h.rid, h.proc.port, h.generation,
+            )
+            self._fire_ready(h)
+
+    def _fire_ready(self, h: ReplicaHandle) -> None:
+        if self.on_ready is None:
+            return
+        try:
+            self.on_ready(h)
+        except Exception as e:  # noqa: BLE001 — callback must not kill monitor
+            logger.exception("fleet on_ready(%s) failed: %s", h.rid, e)
+
+    def _declare_dead(self, h: ReplicaHandle, why: str) -> None:
+        logger.error("fleet replica %s dead: %s", h.rid, why)
+        h.last_error = why
+        h.set_state(ReplicaState.DEAD)
+        h.stats = {}
+        h.proc.kill()  # reap whatever is left
+        if h.relaunches < self.cfg.relaunch_budget:
+            backoff = min(
+                self.BACKOFF_BASE_S * (2 ** h.relaunches),
+                self.BACKOFF_CAP_S,
+            )
+            h.next_launch_t = time.monotonic() + backoff
+        else:
+            h.next_launch_t = float("inf")
+            logger.error(
+                "fleet replica %s: relaunch budget (%s) exhausted — "
+                "slot stays dead, fleet degraded",
+                h.rid, self.cfg.relaunch_budget,
+            )
+
+    def _maybe_relaunch(self, h: ReplicaHandle) -> None:
+        if time.monotonic() < h.next_launch_t:
+            return
+        h.relaunches += 1
+        h.generation += 1
+        h.consecutive_fails = 0
+        proc = self._factory(h.rid, free_port())
+        try:
+            proc.start()
+        except Exception as e:  # noqa: BLE001 — spawn failed: stay dead
+            h.last_error = repr(e)[:200]
+            self._declare_dead(h, f"relaunch spawn failed: {e!r}")
+            return
+        h.proc = proc
+        h.set_state(ReplicaState.STARTING)
+        logger.info(
+            "fleet replica %s relaunched (gen %s, %s/%s budget) on "
+            "port %s",
+            h.rid, h.generation, h.relaunches,
+            self.cfg.relaunch_budget, proc.port,
+        )

@@ -18,12 +18,19 @@ serving pytree), stand up the continuous-batching scheduler
                                  reused by every request that names it)
     POST /v1/weights/reload     {}                          → hot-swap from
                                                               the ckpt dir
-    GET  /healthz                                           → stats
+    GET  /healthz                                           → stats, incl. the
+                                rolling per-request latency percentiles
+                                (latency_p50_s/latency_p95_s) and tokens_per_s
+                                the fleet gateway routes on, and replica_id
+                                when run under a ReplicaSupervisor
 
 The engine is single-threaded by design (one driver thread owns every
 device call); HTTP handler threads talk to it through an inbox of
 futures, so concurrent requests batch into the engine's decode slots
-naturally — that IS continuous batching.
+naturally — that IS continuous batching. To serve more than one
+engine's slots — replica supervision, zero-downtime weight rollout,
+autoscaling — run N of these behind ``tpurun-fleet``
+(dlrover_tpu/fleet/, docs/serving_fleet.md).
 
 Run (CPU smoke):
     tpurun-serve --cpu --port 8311
@@ -38,7 +45,7 @@ import threading
 import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Optional
 
 from ..common.log import logger
@@ -384,27 +391,12 @@ def _restore_params(model, mesh, ckpt_dir: str):
 # ---------------------------------------------------------------------------
 
 
-def _make_handler(daemon: ServingDaemon, reload_fn):
-    class Handler(BaseHTTPRequestHandler):
-        # HTTP/1.1: chunked transfer (streaming completions) needs it;
-        # _send always sets Content-Length so keep-alive stays sound
-        protocol_version = "HTTP/1.1"
+def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
+    from ..common.http import JsonRequestHandler
 
+    class Handler(JsonRequestHandler):
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("serve: " + fmt, *args)
-
-        def _send(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def _body(self) -> dict:
-            n = int(self.headers.get("Content-Length", 0) or 0)
-            raw = self.rfile.read(n) if n else b""
-            return json.loads(raw) if raw.strip() else {}
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -412,6 +404,10 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
                 self._send(
                     200,
                     {
+                        # which fleet member answered (None outside a
+                        # fleet) — the supervisor asserts identity on
+                        # relaunch and operators read it in curl output
+                        "replica_id": replica_id,
                         "served": daemon.served,
                         "pending": daemon.eng.pending,
                         "slots": daemon.eng.B,
@@ -621,10 +617,12 @@ def _make_handler(daemon: ServingDaemon, reload_fn):
     return Handler
 
 
-def serve(daemon: ServingDaemon, port: int, reload_fn=None):
+def serve(daemon: ServingDaemon, port: int, reload_fn=None,
+          replica_id=None):
     """Bind and return the HTTP server (caller runs serve_forever)."""
     httpd = ThreadingHTTPServer(
-        ("0.0.0.0", port), _make_handler(daemon, reload_fn)
+        ("0.0.0.0", port),
+        _make_handler(daemon, reload_fn, replica_id=replica_id),
     )
     return httpd
 
@@ -648,6 +646,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--ckpt-dir", default="", help="flash ckpt to restore")
     ap.add_argument("--port", type=int, default=8311)
+    ap.add_argument(
+        "--replica-id", type=int, default=None,
+        help="fleet member id (set by the ReplicaSupervisor; tags "
+        "/healthz so the gateway can assert replica identity)",
+    )
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--prompt-width", type=int, default=64)
     ap.add_argument("--max-new-tokens", type=int, default=64)
@@ -768,7 +771,7 @@ def main(argv=None) -> int:
             auto_chunk=ns.auto_chunk,
         )
     daemon = ServingDaemon(engine).start()
-    httpd = serve(daemon, ns.port, reload_fn)
+    httpd = serve(daemon, ns.port, reload_fn, replica_id=ns.replica_id)
     logger.info(
         "tpurun-serve on :%s — %s slots × %s new tokens, prompt width %s",
         httpd.server_address[1], ns.batch_size, ns.max_new_tokens,

@@ -253,6 +253,10 @@ print('recovered')
         agent = _make_agent(master1, tmp_path, script, max_restarts=0)
         assert agent.run() == AGENT_EXIT_RELAUNCH
 
+    @pytest.mark.slow  # ~34 s: waits out the master-lost deadline for
+    # real; the fast orphan-guard case (TestDiagnosisClassification::
+    # test_orphan_guard_aborts_when_master_lost) keeps the master-dark
+    # abort path in tier-1
     def test_agent_exits_when_master_dies_mid_training(
         self, master1, tmp_path, monkeypatch
     ):

@@ -194,7 +194,14 @@ class TestPhaseSplit:
         assert split.phases["admission"]["hist_log2us"][13] == 3
 
     def test_phase_name_partition(self):
-        assert set(PHASES) == HOST_PHASES | DEVICE_PHASES | OVERLAP_PHASES
+        from dlrover_tpu.attribution.phases import GATEWAY_PHASES
+
+        # engine + gateway phase names jointly partition into
+        # host / device / overlap — split() classifies by these sets
+        assert set(PHASES) | set(GATEWAY_PHASES) == (
+            HOST_PHASES | DEVICE_PHASES | OVERLAP_PHASES
+        )
+        assert not (set(PHASES) & set(GATEWAY_PHASES))
         assert not (HOST_PHASES & DEVICE_PHASES)
         assert not (OVERLAP_PHASES & (HOST_PHASES | DEVICE_PHASES))
 

@@ -245,6 +245,17 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["recovery_mttr_delta_s"] = 11.8
     extra["recovery_cold_compile_s"] = 12.1
     extra["recovery_warm_compile_s"] = 0.3
+    # serving-fleet section (docs/serving_fleet.md): the SLO trio must
+    # survive in-line; the supporting scalars may shrink to the sidecar
+    extra["fleet_requests_per_s"] = 8.42
+    extra["fleet_1rep_requests_per_s"] = 4.91
+    extra["fleet_2v1_x"] = 1.715
+    extra["fleet_kill_availability"] = 1.0
+    extra["fleet_kill_redispatches"] = 3
+    extra["fleet_rollout_max_unready"] = 1
+    extra["fleet_rollout_aborted"] = False
+    extra["fleet_rollout_load_failed"] = 0
+    extra["fleet_ready"] = 2
     bench._merge_committed_artifacts(extra)
     extra["probe_history"] = [
         {
@@ -324,6 +335,13 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     ):
         assert slim[key] == extra[key], key
     assert "recovery_ab" not in slim
+    # the fleet SLO trio rides the line (fleet_2v1_x and the per-rep
+    # rate are sidecar-recoverable, like the A/B per-leg scalars)
+    for key in (
+        "fleet_requests_per_s", "fleet_kill_availability",
+        "fleet_rollout_max_unready",
+    ):
+        assert slim[key] == extra[key], key
     assert slim["attr_report"] == extra["attr_report"]
     assert slim["last_silicon"]["artifact"] == (
         extra["last_silicon"]["artifact"]

@@ -103,6 +103,9 @@ class TestWedgeDiagnosis:
         assert diag["classification"] == "unclassified"
         assert proc.poll() is not None  # killed
 
+    @pytest.mark.slow  # ~10 s of pure waiting on the no-signal grace
+    # window; the wedge-diagnosis path keeps its tier-1 representative
+    # in test_diagnosis_collects_stacks_and_kills
     def test_hang_before_hook_is_not_signaled(self, tmp_path, monkeypatch):
         """No stack hook installed → SIGUSR2 would TERMINATE the child;
         diagnosis must skip the signal and say why."""
@@ -290,6 +293,9 @@ class TestCaptureSilicon:
         assert latest["value"] == 99999.0  # newest incomplete wins
         assert latest["incomplete_sections"] == ["ckpt_error"]
 
+    @pytest.mark.slow  # ~14 s sleeping out the capture timeout; group
+    # kill + orphan reaping stay tier-1 via the fast reap-scoping
+    # cases in this class
     def test_timeout_kills_group_and_reaps_orphan_worker(
         self, tmp_path, monkeypatch, fake_repo
     ):

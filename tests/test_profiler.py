@@ -7,6 +7,7 @@ collector parser tests in dlrover/python/tests.
 
 import json
 import os
+import sys
 import time
 import urllib.request
 
@@ -563,6 +564,10 @@ def _named_events(timer, name, tmp=[0]):
     ]
 
 
+@pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="sys.monitoring (PEP 669) needs Python 3.12",
+)
 class TestPyTracer:
     """sys.monitoring host tracer (VERDICT r3 #8; reference
     py_tracing.c): configured functions and data iterators appear in
@@ -743,6 +748,10 @@ class TestPyTracer:
             FunctionTracer.singleton().uninstall()
 
 
+@pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="sys.monitoring (PEP 669) needs Python 3.12",
+)
 class TestTracerSlotSharing:
     """The sys.monitoring slot is process-global; instances share it
     through the module registry. Reinstall and cross-instance teardown

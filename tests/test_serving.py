@@ -412,6 +412,10 @@ class TestPerRowLayout:
         for c, w in zip(got, want):
             assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
 
+    @pytest.mark.slow  # ~16 s: the long-tail stress variant; slot
+    # reuse over stale KV stays in tier-1 via
+    # test_per_row_never_compacts + test_per_row_stream_matches_
+    # plain_decode on the same layout
     def test_per_row_long_stream_slot_reuse_over_stale_kv(self):
         """N >> B through 2 slots: every admission rewrites a slot that
         carries a previous request's full KV + a parked done-row write;
@@ -431,6 +435,9 @@ class TestPerRowLayout:
         for c, w in zip(got, want):
             assert c.tokens == w, f"uid {c.uid}: {c.tokens} != {w}"
 
+    @pytest.mark.slow  # ~6 s: sharded serving exactness is tier-1 via
+    # the frontier-layout twin (TestShardedServing); this re-proves it
+    # on per_row, whose unsharded exactness is already tier-1
     def test_per_row_tp_sharded_stream_matches_single_device(self):
         """SPMD per_row: the cache_slots scatter rides the same tp mesh
         as the training shardings."""

@@ -473,6 +473,10 @@ class TestPpoE2E:
         # trainer learned the target through the experience stream
         assert abs(result["w"] - 3.0) < 0.5, result
 
+    @pytest.mark.slow  # ~3 min: full PPO loop + mid-loop kill; the
+    # tier-1 representative is test_data_flows_and_weights_sync, and
+    # kill-recovery stays drilled by test_zz_chaos_e2e's storm smoke
+    # and the fleet failover e2e
     def test_mid_loop_rollout_kill_recovers(self, tmp_path):
         """SIGKILL the rollout mid-loop: the manager restarts it, the
         re-bound RPC/queue endpoints pick the flow back up, and the job
@@ -1015,6 +1019,10 @@ class TestPayloadServerConcurrency:
             PayloadServer.reset_singleton()
 
 
+@pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="sys.monitoring (PEP 669) needs Python 3.12",
+)
 class TestTracerThreadSafety:
     def test_traced_function_from_multiple_threads(self):
         """Per-thread timing stacks: concurrent traced calls must not

@@ -56,7 +56,7 @@ HEADLINE_SECTION_ERRORS = frozenset({
     "tpu_error", "fatal_error", "dense_error", "ckpt_error",
     "flash_seq4096_error", "decode_error", "spec_error",
     "serving_error", "serving_per_row_error", "llama_family_error",
-    "longseq_train_error", "attr_error", "fleet_error",
+    "longseq_train_error", "attr_error", "fleet_error", "pool_error",
 })
 
 # Error key -> the DLROVER_BENCH_SECTIONS name that re-runs ONLY that
@@ -75,6 +75,7 @@ SECTION_OF_ERROR = {
     "serving_per_row_error": "serving",
     "attr_error": "attr",
     "fleet_error": "fleet",
+    "pool_error": "pool",
     "llama_family_error": "llama",
     "longseq_train_error": "longseq",
     "dense_error": "dense",
@@ -247,10 +248,15 @@ _PRIORITY_KEYS = (
     "headline_config", "model", "mfu", "flash_step_s", "flash_batch",
     "seq_len", "flash_vs_dense", "serving_host_frac",
     "serving_overlap_vs_sync", "serving_overlap_exact",
-    "serving_overlap_hidden_ms", "interposer_overhead_pct",
+    "interposer_overhead_pct",
     "attr_report",
-    "attr_ring", "attr_top_residual", "attr_top_residual_frac",
-    "attr_matmul_frac",
+    # Byte offsets for the pool section's SLO trio (same rationale as
+    # PR 7's per-leg demotions): the attr supporting floats + ring
+    # pointer live in the attr_report artifact and the sidecar;
+    # serving_overlap_hidden_ms is the verdict's detail;
+    # restore_overhead_x / goodput_ckpt_every_10_steps also ride the
+    # SILICON headline dict the last_silicon pointer names. All
+    # sidecar-recoverable — only their in-line seats moved.
     # serving-fleet SLO trio (docs/serving_fleet.md): throughput,
     # availability under a replica kill, rollout readiness floor.
     # Byte offsets for it: the overlap A/B per-leg rates
@@ -260,10 +266,17 @@ _PRIORITY_KEYS = (
     # rationale as the recovery_ab per-leg scalars above
     "fleet_requests_per_s", "fleet_kill_availability",
     "fleet_rollout_max_unready",
+    # chip-pool arbitration SLO trio (docs/pool.md): preempt latency,
+    # availability through the preemption, training goodput over the
+    # disruption window (supporting scalars ride the sidecar)
+    "pool_preempt_to_ready_s", "pool_spike_availability",
+    "pool_train_goodput",
+    # committed-artifact provenance pointers: promoted above the
+    # per-section supporting floats (the header rule — provenance
+    # before detail) when the pool section filled the line past them
+    "last_silicon", "hang_diagnosis",
     "serving_per_row_tokens_per_s", "decode_tokens_per_s",
     "ckpt_async_stage_block_s",
-    "restore_overhead_x",
-    "goodput_ckpt_every_10_steps",
     # recovery-SLO matrix (per-fault-class, pointer-style — the full
     # storm dict with stall forensics goes to the sidecar)
     "storm_goodput", "storm_mttr_s", "storm_slice_mttr_s",
@@ -276,7 +289,6 @@ _PRIORITY_KEYS = (
     "storm_rdzv_s", "storm_restore_s", "storm_compile_s",
     "storm_first_step_s",
     "recovery_mttr_delta_s", "recovery_warm_compile_s",
-    "last_silicon", "hang_diagnosis",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
 
@@ -1645,6 +1657,42 @@ def _bench_fleet(extra, cfg, params, on_tpu):
         sup2.stop()
 
 
+def _bench_pool(extra):
+    """Chip-pool arbitration rung (dlrover_tpu/pool/): the full
+    traffic-spike drill — serving SLO breach → flash-checkpointed
+    training shrink → replica grant to READY → hysteresis handback —
+    measured end to end with real engines (the drill's own tiny GPT:
+    the pool's verdicts are latencies and availability, not model
+    throughput, so the headline model is not re-entered and the rung
+    is deliberately device-shape-agnostic). Emits the SLO trio
+    (docs/pool.md): ``pool_preempt_to_ready_s``,
+    ``pool_spike_availability``, ``pool_train_goodput``."""
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+    from dlrover_tpu.pool.drill import run_traffic_spike_drill
+
+    try:
+        result = run_traffic_spike_drill(
+            real_engines=True, timeout_s=300.0
+        )
+    finally:
+        AsyncCheckpointSaver.shutdown()
+    if not result.get("ok"):
+        raise RuntimeError(
+            f"pool drill failed: {result.get('error', result)}"
+        )
+    extra["pool_preempt_to_ready_s"] = result["preempt_to_ready_s"]
+    extra["pool_spike_availability"] = result["availability"]
+    extra["pool_train_goodput"] = result["train_goodput"]
+    extra["pool_handback"] = result["handback"]
+    extra["pool_requests_ok"] = result["requests_ok"]
+    extra["pool_revokes"] = result["revokes"]
+    extra["pool_escalations"] = result["escalations"]
+    extra["pool_recovered_vs_baseline"] = result.get(
+        "recovered_vs_baseline"
+    )
+    extra["pool_window_s"] = result["window_s"]
+
+
 def _bench_attribution(extra, cfg, params, on_tpu, interposed,
                        serving_split=None):
     """Performance-attribution rung (r6): the serving host/device
@@ -2116,6 +2164,12 @@ def worker():
                 _bench_fleet(extra, cfg, params, on_tpu)
             except Exception as e:  # noqa: BLE001
                 extra["fleet_error"] = repr(e)[:200]
+
+        if want("pool"):
+            try:
+                _bench_pool(extra)
+            except Exception as e:  # noqa: BLE001
+                extra["pool_error"] = repr(e)[:200]
 
         params = None  # the model families below build their own
         _section_gc(extra, "post_serving")

@@ -53,10 +53,30 @@ GATEWAY_PHASES = (
     "proxy",
     "redispatch",
 )
-HOST_PHASES = frozenset(
-    {"admission", "decode_dispatch", "retirement", "route", "redispatch"}
+# Chip-pool arbiter phases (dlrover_tpu/pool/arbiter.py) — a third
+# separate accumulator: "revoke" and "grant" are arbiter-host work
+# (ledger transitions, dispatching the tenant call); "drain" is the
+# wall time waiting on the tenant's cooperative reclaim (checkpointed
+# training shrink, replica drain) — the arbiter's equivalent of
+# backend time, so its host_frac reads as arbitration overhead over
+# end-to-end capacity-move latency.
+POOL_PHASES = (
+    "revoke",
+    "drain",
+    "grant",
 )
-DEVICE_PHASES = frozenset({"prefill", "host_sync", "proxy"})
+HOST_PHASES = frozenset(
+    {
+        "admission",
+        "decode_dispatch",
+        "retirement",
+        "route",
+        "redispatch",
+        "revoke",
+        "grant",
+    }
+)
+DEVICE_PHASES = frozenset({"prefill", "host_sync", "proxy", "drain"})
 OVERLAP_PHASES = frozenset({"overlap_hidden"})
 
 # log2(µs) histogram: bucket i covers [2^i, 2^(i+1)) µs; 20 buckets

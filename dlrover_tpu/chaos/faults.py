@@ -74,6 +74,9 @@ INJECTION_POINTS: Dict[str, str] = {
     "fleet.route": "gateway replica-selection for one fleet request",
     "fleet.replica_health": "supervisor health poll of one serving replica",
     "fleet.replica_kill": "supervisor about to hard-kill a serving replica",
+    "pool.revoke": "arbiter issuing a capacity revocation to a tenant",
+    "pool.grant": "arbiter applying freed capacity to a tenant",
+    "pool.tenant_report": "arbiter collecting one tenant's live signals",
 }
 
 _MODES = ("delay", "error", "wedge", "drop")

@@ -484,6 +484,48 @@ def replica_loss(workdir: Optional[str] = None) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# traffic_spike_preempt: the chip-pool arbitration drill under
+# injected arbiter faults — a serving spike must preempt training
+# (flash-checkpointed shrink), grow serving on the freed unit, and
+# hand the unit back when traffic subsides, with ZERO failed requests,
+# while the arbiter rides through a dark tenant report and delayed
+# revoke/grant dispatches.
+# ---------------------------------------------------------------------------
+
+
+def traffic_spike_preempt(workdir: Optional[str] = None) -> Dict:
+    from ..checkpoint.saver import AsyncCheckpointSaver
+    from ..pool.drill import run_traffic_spike_drill
+
+    faults.activate(
+        faults.FaultPlan.parse(
+            "seed=7;pool.revoke:delay:0.01@once;"
+            "pool.grant:delay:0.01@once;"
+            "pool.tenant_report:error:dark@at=2"
+        )
+    )
+    try:
+        result = run_traffic_spike_drill(
+            workdir=workdir, real_engines=True, timeout_s=300.0
+        )
+        fired = _fired(
+            ("pool.revoke", "pool.grant", "pool.tenant_report")
+        )
+        return {
+            "scenario": "traffic_spike_preempt",
+            "fired": fired,
+            "recovered": bool(result.get("ok"))
+            and result.get("requests_failed") == 0
+            and result.get("handback") is True
+            and fired >= 3,
+            "drill": result,
+        }
+    finally:
+        AsyncCheckpointSaver.shutdown()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
 # host_kill / slice_kill: the full process storms (real master, real
 # agents, real trainers). Compressed parameters — the bench runs the
 # production-shaped storm; these are the CLI/e2e-test variants.
@@ -549,6 +591,7 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "saver_wedge": saver_wedge,
     "poisoned_swap": poisoned_swap,
     "replica_loss": replica_loss,
+    "traffic_spike_preempt": traffic_spike_preempt,
     "host_kill": host_kill,
     "slice_kill": slice_kill,
 }

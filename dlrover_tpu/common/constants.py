@@ -363,4 +363,18 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_FLEET_AUTOSCALE_INTERVAL_S", "float", doc="serving fleet: autoscaler evaluation interval (0 disables)"),
     EnvKnob("DLROVER_FLEET_QUEUE_HIGH", "float", doc="serving fleet: mean queued-per-replica threshold to grow"),
     EnvKnob("DLROVER_FLEET_P95_TARGET_S", "float", doc="serving fleet: p95 completion-latency target to grow (0 disables)"),
+    # -- chip-pool arbiter (dlrover_tpu/pool/, docs/pool.md) ---------------
+    EnvKnob("DLROVER_POOL_TOTAL_UNITS", "int", doc="chip pool: device-capacity units in the shared inventory"),
+    EnvKnob("DLROVER_POOL_TRAIN_FLOOR", "int", doc="chip pool: units training is never revoked below"),
+    EnvKnob("DLROVER_POOL_TRAIN_CEILING", "int", doc="chip pool: max units training may hold (0 = whole pool)"),
+    EnvKnob("DLROVER_POOL_SERVE_FLOOR", "int", doc="chip pool: units serving is never revoked below"),
+    EnvKnob("DLROVER_POOL_SERVE_CEILING", "int", doc="chip pool: max units serving may hold (0 = whole pool)"),
+    EnvKnob("DLROVER_POOL_EVAL_INTERVAL_S", "float", doc="chip pool: arbiter evaluation interval (0 = manual stepping)"),
+    EnvKnob("DLROVER_POOL_REVOKE_DEADLINE_S", "float", doc="chip pool: cooperative drain budget before escalation"),
+    EnvKnob("DLROVER_POOL_HANDBACK_EVALS", "int", doc="chip pool: consecutive calm evaluations before training reclaims surge units"),
+    EnvKnob("DLROVER_POOL_SPIKE_UNITS", "int", doc="chip pool: units moved per preempt/handback decision"),
+    EnvKnob("DLROVER_POOL_QUEUE_HIGH", "float", doc="chip pool: mean queued-per-replica threshold that preempts training"),
+    EnvKnob("DLROVER_POOL_P95_TARGET_S", "float", doc="chip pool: serving p95 latency target that preempts training (0 disables)"),
+    EnvKnob("DLROVER_POOL_JOURNAL", doc="chip pool: decision-journal JSONL path (empty = in-memory only)"),
+    EnvKnob("DLROVER_POOL_STATUS_TIMEOUT_S", "float", doc="chip pool: /pool/status HTTP client deadline"),
 )

@@ -27,7 +27,29 @@ from typing import Dict, List, Optional
 from ..common.log import logger
 from .config import FleetConfig
 
-__all__ = ["FleetAutoscaler"]
+__all__ = ["FleetAutoscaler", "fleet_signals"]
+
+
+def fleet_signals(supervisor) -> Dict:
+    """Fleet-wide pressure/latency snapshot from the supervisor's
+    health-poll cache. Shared by the autoscaler's grow/shrink policy
+    and the chip-pool arbiter's serving tenant (pool/tenants.py) so
+    one signal definition drives both layers."""
+    ready = supervisor.ready_replicas()
+    stats: List[Dict] = [h.stats for h in ready]
+    queued = [int(s.get("queue_depth") or 0) for s in stats]
+    busy = [int(s.get("busy_slots") or 0) for s in stats]
+    p95s = [
+        float(s["latency_p95_s"])
+        for s in stats
+        if s.get("latency_p95_s") is not None
+    ]
+    return {
+        "ready": len(ready),
+        "queue_mean": (sum(queued) / len(queued) if queued else 0.0),
+        "busy_total": sum(busy),
+        "p95_worst_s": max(p95s) if p95s else None,
+    }
 
 
 class FleetAutoscaler:
@@ -48,25 +70,9 @@ class FleetAutoscaler:
     # -- signals ----------------------------------------------------------
 
     def signals(self) -> Dict:
-        """Fleet-wide pressure/latency snapshot from the supervisor's
-        health-poll cache."""
-        ready = self.sup.ready_replicas()
-        stats: List[Dict] = [h.stats for h in ready]
-        queued = [int(s.get("queue_depth") or 0) for s in stats]
-        busy = [int(s.get("busy_slots") or 0) for s in stats]
-        p95s = [
-            float(s["latency_p95_s"])
-            for s in stats
-            if s.get("latency_p95_s") is not None
-        ]
-        return {
-            "ready": len(ready),
-            "queue_mean": (
-                sum(queued) / len(queued) if queued else 0.0
-            ),
-            "busy_total": sum(busy),
-            "p95_worst_s": max(p95s) if p95s else None,
-        }
+        """Fleet-wide pressure/latency snapshot (see
+        :func:`fleet_signals` — the shared definition)."""
+        return fleet_signals(self.sup)
 
     # -- policy -----------------------------------------------------------
 

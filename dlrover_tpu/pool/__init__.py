@@ -1,0 +1,32 @@
+"""Chip-pool arbiter: one TPU pool, two elastic tenants, one SLO.
+
+The subsystem that joins the repo's two halves (docs/pool.md): a
+ledger of device-capacity units with revocable leases
+(:mod:`~dlrover_tpu.pool.arbiter`), tenant adapters onto the training
+runtime and the serving fleet (:mod:`~dlrover_tpu.pool.tenants`), the
+``DLROVER_POOL_*`` config surface (:mod:`~dlrover_tpu.pool.config`),
+the end-to-end traffic-spike drill (:mod:`~dlrover_tpu.pool.drill`),
+and the ``tpurun-pool`` CLI + HTTP status endpoint
+(:mod:`~dlrover_tpu.pool.cli`).
+"""
+
+from .arbiter import ChipPoolArbiter, Lease, LeaseState, decide
+from .config import PoolConfig
+from .tenants import (
+    LoopTrainingController,
+    MasterTrainingController,
+    ServingTenant,
+    TrainingTenant,
+)
+
+__all__ = [
+    "ChipPoolArbiter",
+    "Lease",
+    "LeaseState",
+    "decide",
+    "PoolConfig",
+    "ServingTenant",
+    "TrainingTenant",
+    "LoopTrainingController",
+    "MasterTrainingController",
+]

@@ -1,0 +1,688 @@
+"""ChipPoolArbiter: SLO-driven co-scheduling of one TPU chip pool.
+
+After PR 7 the repo held two complete but *disjoint* elastic systems —
+the training runtime (agent/master/remesh/flash-checkpoint) and the
+serving fleet (supervisor/gateway/autoscaler) — each assuming it owns
+every chip. This module is the missing third piece: a ledger of
+device-capacity units with **revocable leases** to two tenant adapters
+(``pool/tenants.py``), arbitrated by an explicit SLO policy
+(docs/pool.md):
+
+- **Priority preemption**: a serving SLO breach (rolling p95 over
+  ``p95_target_s``, or mean queue depth over ``queue_high``) revokes
+  training capacity — training checkpoints (flash checkpoint) and
+  shrinks to the next valid world on its shrink ladder; the freed
+  units are granted to serving, which grows replicas on them.
+- **Handback hysteresis**: when traffic subsides for
+  ``handback_evals`` consecutive evaluations, the surge units are
+  revoked from serving (cooperative drain through the fleet's drain
+  path) and granted back to training (grow remesh, pre-warmed by the
+  compile-ahead service).
+- **Revocation deadlines with escalation**: a cooperative revoke that
+  misses ``revoke_deadline_s`` escalates — the arbiter forces the
+  reclaim through the tenant's hard path (replica terminate / hard
+  relaunch) so a wedged tenant cannot squat on the pool.
+- **Floors and ceilings**: no tenant is ever revoked below its floor
+  or granted above its ceiling; one in-flight move at a time keeps
+  every ledger transition journaled and attributable.
+
+Every decision lands in the **journal** (in-memory ring + optional
+JSONL file, same O_APPEND one-write discipline as the fault log), and
+the revoke→drain→grant wall time is stamped into an attribution
+:class:`PhaseAccumulator` (``POOL_PHASES`` — attribution/phases.py),
+so ``/pool/status`` reports arbitration latency next to the ledger.
+
+Locking discipline: ``_mu`` guards the ledger/journal only; every
+tenant call (report/grant/revoke/escalate) and every fault-injection
+hook runs outside it (snapshot-under-lock / act-outside — the
+PodScaler incident class).
+"""
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..attribution.phases import PhaseAccumulator
+from ..chaos import faults
+from ..common.log import logger
+from .config import PoolConfig
+
+__all__ = ["ChipPoolArbiter", "Lease", "LeaseState", "decide"]
+
+TRAINING = "training"
+SERVING = "serving"
+
+# journal ring bound: decisions are low-rate (one per eval at most);
+# 1000 entries cover hours of arbitration — the JSONL file keeps all
+JOURNAL_KEEP = 1000
+
+
+class LeaseState:
+    REVOKING = "revoking"  # cooperative drain in flight
+    RELEASED = "released"  # tenant confirmed; units back in the pool
+    ESCALATED = "escalated"  # deadline missed; reclaim was forced
+
+
+@dataclass
+class Lease:
+    """One in-flight capacity revocation (grants apply instantly and
+    are journal-only; a revoke is the async half that needs a state
+    machine: issued → drained/escalated → re-granted)."""
+
+    lease_id: int
+    tenant: str
+    units: int
+    deadline_t: float  # monotonic escalation deadline
+    grant_to: str = ""  # tenant the freed units go to ("" = free pool)
+    reason: str = ""
+    state: str = LeaseState.REVOKING
+    created_t: float = field(default_factory=time.monotonic)
+    released_units: int = 0
+
+    def snapshot(self) -> Dict:
+        return {
+            "lease_id": self.lease_id,
+            "tenant": self.tenant,
+            "units": self.units,
+            "state": self.state,
+            "grant_to": self.grant_to,
+            "reason": self.reason,
+            "age_s": round(time.monotonic() - self.created_t, 3),
+            "deadline_in_s": round(
+                self.deadline_t - time.monotonic(), 3
+            ),
+        }
+
+
+def decide(
+    serving_sig: Optional[Dict],
+    alloc: Dict[str, int],
+    free: int,
+    cfg: PoolConfig,
+    calm_streak: int,
+    serve_baseline: int,
+    trainable: bool = True,
+) -> Dict[str, Any]:
+    """Pure policy: one evaluation's move (or none).
+
+    Returns ``{"action": "preempt"|"handback"|"reclaim"|None,
+    "units": n, "reason": str, "calm_streak": n}``. Kept free of
+    ledger and tenant state so every branch is unit-testable on plain
+    dicts.
+
+    - **preempt** — serving SLO breach and serving below its ceiling:
+      move ``spike_units`` to serving (free pool first, then training
+      down to its floor).
+    - **reclaim** — unowned free units while training is below its
+      ceiling and serving does not need them (no breach): grant them
+      to training immediately — they need no revocation, and without
+      this branch grid-overshoot excess and rolled-back grants would
+      strand in the free ledger. ``trainable=False`` (no training
+      adapter attached) disables it.
+    - **handback** — serving calm (no queue, no busy slots, p95 well
+      under target) for ``handback_evals`` consecutive evaluations and
+      serving above its calm baseline: return one spike step toward
+      training (capped by training's ceiling).
+    """
+    out = {"action": None, "units": 0, "reason": "", "calm_streak": 0}
+    if serving_sig is None or serving_sig.get("ready", 0) == 0:
+        # nothing healthy to measure: never arbitrate blind (the
+        # fleet autoscaler's rule, applied pool-wide)
+        out["reason"] = "no serving signal"
+        return out
+    queue_mean = serving_sig.get("queue_mean") or 0.0
+    p95 = serving_sig.get("p95_worst_s")
+    over_queue = queue_mean >= cfg.queue_high
+    over_latency = (
+        cfg.p95_target_s > 0
+        and p95 is not None
+        and p95 > cfg.p95_target_s
+    )
+    if over_queue or over_latency:
+        headroom = cfg.serve_ceiling - alloc.get(SERVING, 0)
+        available = free + max(
+            0, alloc.get(TRAINING, 0) - cfg.train_floor
+        )
+        units = min(cfg.spike_units, headroom, available)
+        if units > 0:
+            out.update(
+                action="preempt",
+                units=units,
+                reason=(
+                    f"queue_mean={queue_mean:.2f}"
+                    if over_queue
+                    else f"p95={p95:.3f}s>{cfg.p95_target_s:.3f}s"
+                ),
+            )
+            return out
+        out["reason"] = "breach but no capacity movable"
+        # fall through: free units serving cannot take (its ceiling)
+        # may still return to training below
+    if trainable and free > 0:
+        units = min(free, cfg.train_ceiling - alloc.get(TRAINING, 0))
+        if units > 0:
+            out.update(
+                action="reclaim",
+                units=units,
+                reason=f"{free} unowned free unit(s)",
+                # a breach (stuck at the serving ceiling) resets the
+                # calm streak; a quiet reclaim preserves it — the
+                # serving-surge hysteresis keeps its own clock
+                calm_streak=0 if out["reason"] else calm_streak,
+            )
+            return out
+    if out["reason"]:
+        return out  # the breach-but-stuck verdict from above
+    calm = (
+        queue_mean == 0
+        and serving_sig.get("busy_total", 0) == 0
+        and (
+            cfg.p95_target_s <= 0
+            or p95 is None
+            or p95 < cfg.p95_target_s / 2
+        )
+    )
+    if not calm:
+        out["reason"] = "serving active, within SLO"
+        return out
+    streak = calm_streak + 1
+    out["calm_streak"] = streak
+    surge = alloc.get(SERVING, 0) - max(cfg.serve_floor, serve_baseline)
+    if streak >= cfg.handback_evals and surge > 0:
+        units = min(
+            cfg.spike_units,
+            surge,
+            cfg.train_ceiling - alloc.get(TRAINING, 0),
+        )
+        if units > 0:
+            out.update(
+                action="handback",
+                units=units,
+                reason=f"calm for {streak} evals",
+                calm_streak=0,
+            )
+            return out
+    out["reason"] = f"calm ({streak} evals)"
+    return out
+
+
+class ChipPoolArbiter:
+    """Owns the unit ledger; issues and reclaims leases.
+
+    ``serving`` is required (the latency tenant whose SLO drives
+    preemption); ``training`` is optional — without it, spikes draw
+    from the free pool only and handback returns units there (the
+    ``tpurun-pool serve`` shape where the training half lives in the
+    master)."""
+
+    def __init__(
+        self,
+        serving,
+        training=None,
+        config: Optional[PoolConfig] = None,
+    ):
+        self.cfg = config or PoolConfig.from_env()
+        self._mu = threading.Lock()
+        self._tenants: Dict[str, Any] = {SERVING: serving}
+        if training is not None:
+            self._tenants[TRAINING] = training
+        alloc_serve = int(getattr(serving, "initial_units", 0)) or (
+            self.cfg.serve_floor
+        )
+        alloc_train = 0
+        if training is not None:
+            alloc_train = int(getattr(training, "initial_units", 0)) or (
+                self.cfg.train_floor
+            )
+        if alloc_serve + alloc_train > self.cfg.total_units:
+            raise ValueError(
+                "tenants hold more units than the pool: "
+                f"{alloc_serve}+{alloc_train} > {self.cfg.total_units}"
+            )
+        self._alloc: Dict[str, int] = {
+            SERVING: alloc_serve,
+            TRAINING: alloc_train,
+        }
+        self._serve_baseline = alloc_serve
+        self._free = self.cfg.total_units - alloc_serve - alloc_train
+        self._pending: List[Lease] = []
+        self._next_lease_id = 0
+        self._calm_streak = 0
+        self._seq = 0
+        self._journal: List[Dict] = []
+        self.last_signals: Dict[str, Optional[Dict]] = {}
+        self.evaluations = 0
+        self.revokes = 0
+        self.grants = 0
+        self.escalations = 0
+        self.phases = PhaseAccumulator()
+        # serializes whole evaluations: the periodic loop and a manual
+        # POST /pool/step must not both pass the pending-lease check
+        # and issue two concurrent moves
+        self._step_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ledger views ----------------------------------------------------
+
+    def allocations(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._alloc)
+
+    def free_units(self) -> int:
+        with self._mu:
+            return self._free
+
+    def pending_leases(self) -> List[Lease]:
+        with self._mu:
+            return list(self._pending)
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no revocation is in flight (drill/test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                if not self._pending:
+                    return True
+            if self._stop.wait(0.05):
+                with self._mu:
+                    return not self._pending
+        return False
+
+    # -- journal ---------------------------------------------------------
+
+    def _record(self, event: str, **detail) -> Dict:
+        """Journal one ledger event. Caller may hold ``_mu`` — the file
+        append is a single O_APPEND write (atomic under PIPE_BUF, the
+        fault-log discipline), never a blocking wait."""
+        entry = {
+            "ts": round(time.time(), 3),
+            "seq": self._seq,
+            "event": event,
+            "alloc": dict(self._alloc),
+            "free": self._free,
+            **detail,
+        }
+        self._seq += 1
+        self._journal.append(entry)
+        if len(self._journal) > JOURNAL_KEEP:
+            del self._journal[: -JOURNAL_KEEP]
+        path = self.cfg.journal_path
+        if path:
+            try:
+                line = (json.dumps(entry) + "\n").encode()
+                fd = os.open(
+                    path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+                )
+                try:
+                    os.write(fd, line)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass  # the in-memory journal still exists
+        return entry
+
+    def journal(self, tail: int = 0) -> List[Dict]:
+        with self._mu:
+            return list(self._journal[-tail:] if tail else self._journal)
+
+    # -- signal collection -----------------------------------------------
+
+    def _collect(self, name: str) -> Optional[Dict]:
+        tenant = self._tenants.get(name)
+        if tenant is None:
+            return None
+        try:
+            # chaos hook: an errored report models a tenant whose
+            # control plane is dark — the arbiter must skip the eval
+            # for that side, never wedge or crash
+            faults.inject("pool.tenant_report", tenant=name)
+            return tenant.report()
+        except Exception as e:  # noqa: BLE001 — one dark report
+            logger.warning("pool: %s report failed: %r", name, e)
+            with self._mu:
+                self._record(
+                    "report_error", tenant=name, error=repr(e)[:200]
+                )
+            return None
+
+    # -- policy loop -----------------------------------------------------
+
+    def step(self) -> Dict:
+        """One evaluate→decide→execute round; returns the decision."""
+        with self._step_mu:
+            return self._step_locked()
+
+    def _step_locked(self) -> Dict:
+        self.evaluations += 1
+        signals = {
+            name: self._collect(name) for name in self._tenants
+        }
+        self.last_signals = signals
+        self._check_deadlines()
+        with self._mu:
+            if self._pending:
+                # one move at a time: a second decision while a drain
+                # is in flight would race the ledger it is based on
+                return {
+                    "action": None,
+                    "reason": "revocation in flight",
+                    "pending": [l.snapshot() for l in self._pending],
+                }
+            alloc = dict(self._alloc)
+            free = self._free
+            calm = self._calm_streak
+            baseline = self._serve_baseline
+        verdict = decide(
+            signals.get(SERVING),
+            alloc,
+            free,
+            self.cfg,
+            calm,
+            baseline,
+            trainable=TRAINING in self._tenants,
+        )
+        self._calm_streak = verdict.get("calm_streak", 0)
+        if verdict["action"] == "preempt":
+            self._preempt(verdict["units"], verdict["reason"])
+        elif verdict["action"] == "handback":
+            self._handback(verdict["units"], verdict["reason"])
+        elif verdict["action"] == "reclaim":
+            self._grant(
+                TRAINING, verdict["units"], reason=verdict["reason"]
+            )
+        return verdict
+
+    def _check_deadlines(self) -> None:
+        with self._mu:
+            overdue = [
+                l
+                for l in self._pending
+                if time.monotonic() > l.deadline_t
+            ]
+        for lease in overdue:
+            self._escalate(lease)
+
+    # -- moves -----------------------------------------------------------
+
+    def _preempt(self, units: int, reason: str) -> None:
+        """Serving breach: free pool first, then revoke training."""
+        with self._mu:
+            # free units move inside _grant's ledger transition; here
+            # only the split between pool draw and revoke is decided
+            from_free = min(self._free, units)
+            self._record(
+                "breach", reason=reason, units=units, from_free=from_free
+            )
+        if from_free:
+            self._grant(SERVING, from_free, reason="breach:free-pool")
+        deficit = units - from_free
+        if deficit > 0:
+            self._revoke(
+                TRAINING, deficit, grant_to=SERVING, reason=reason
+            )
+
+    def _handback(self, units: int, reason: str) -> None:
+        self._revoke(SERVING, units, grant_to=TRAINING, reason=reason)
+
+    def _revoke(
+        self, frm: str, units: int, grant_to: str, reason: str
+    ) -> None:
+        tenant = self._tenants.get(frm)
+        if tenant is None:
+            # no adapter on that side (serving-only pool): the units
+            # come from / return to the free ledger directly
+            with self._mu:
+                self._free += units
+                self._record(
+                    "release", tenant=frm, units=units, reason="no tenant"
+                )
+            if grant_to:
+                self._grant(grant_to, units, reason=reason)
+            return
+        t0 = time.perf_counter()
+        with self._mu:
+            lease = Lease(
+                lease_id=self._next_lease_id,
+                tenant=frm,
+                units=units,
+                deadline_t=time.monotonic() + self.cfg.revoke_deadline_s,
+                grant_to=grant_to,
+                reason=reason,
+            )
+            self._next_lease_id += 1
+            self._pending.append(lease)
+            self.revokes += 1
+            self._record(
+                "revoke",
+                lease_id=lease.lease_id,
+                tenant=frm,
+                units=units,
+                grant_to=grant_to,
+                reason=reason,
+                deadline_s=self.cfg.revoke_deadline_s,
+            )
+        try:
+            faults.inject("pool.revoke", tenant=frm, units=units)
+            tenant.revoke(
+                units,
+                self.cfg.revoke_deadline_s,
+                lambda released=units, _l=lease: self._on_released(
+                    _l, released
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — dispatch failed: the
+            # deadline still stands; escalation reclaims at expiry
+            logger.warning(
+                "pool: revoke dispatch to %s failed: %r", frm, e
+            )
+            with self._mu:
+                self._record(
+                    "revoke_error",
+                    lease_id=lease.lease_id,
+                    tenant=frm,
+                    error=repr(e)[:200],
+                )
+        self.phases.add("revoke", time.perf_counter() - t0)
+
+    def _on_released(self, lease: Lease, released: int) -> None:
+        """Tenant-side confirmation that the drained units are free
+        (called from the tenant's drain thread). ``released`` may
+        EXCEED the leased units — a node_unit shrink ladder can only
+        land on grid worlds — and the ledger must move by what was
+        actually freed (the grant is ceiling-clamped; any excess stays
+        in the free pool)."""
+        with self._mu:
+            if lease.state != LeaseState.REVOKING:
+                # late cooperative release after an escalation already
+                # reclaimed: the ledger moved once; journal and drop
+                self._record(
+                    "late_release",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    units=released,
+                )
+                return
+            lease.state = LeaseState.RELEASED
+            lease.released_units = released
+            self._pending.remove(lease)
+            self._alloc[lease.tenant] -= released
+            self._free += released
+            drain_s = time.monotonic() - lease.created_t
+            self._record(
+                "release",
+                lease_id=lease.lease_id,
+                tenant=lease.tenant,
+                units=released,
+                drain_s=round(drain_s, 3),
+            )
+        self.phases.add("drain", drain_s)
+        if lease.grant_to and released > 0:
+            # the grant stays at the leased size (the policy's spike
+            # step); any grid-forced excess sits in the free pool for
+            # the next eval to place
+            self._grant(
+                lease.grant_to,
+                min(released, lease.units),
+                reason=lease.reason,
+            )
+
+    def _escalate(self, lease: Lease) -> None:
+        """Cooperative drain missed its deadline: force the reclaim."""
+        tenant = self._tenants.get(lease.tenant)
+        with self._mu:
+            if lease.state != LeaseState.REVOKING:
+                return
+            lease.state = LeaseState.ESCALATED
+            self.escalations += 1
+            self._record(
+                "escalate",
+                lease_id=lease.lease_id,
+                tenant=lease.tenant,
+                units=lease.units,
+                overdue_s=round(
+                    time.monotonic() - lease.deadline_t, 3
+                ),
+            )
+        freed = 0
+        try:
+            freed = int(tenant.escalate(lease.units))
+        except Exception as e:  # noqa: BLE001 — even the hard path
+            # failed: journal it; the units stay with the tenant (the
+            # ledger must never claim capacity nobody actually freed)
+            logger.error(
+                "pool: escalation on %s failed: %r", lease.tenant, e
+            )
+            with self._mu:
+                self._record(
+                    "escalate_error",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    error=repr(e)[:200],
+                )
+        with self._mu:
+            if lease in self._pending:
+                self._pending.remove(lease)
+            lease.released_units = freed
+            self._alloc[lease.tenant] -= freed
+            self._free += freed
+            drain_s = time.monotonic() - lease.created_t
+            if freed:
+                self._record(
+                    "escalate_freed",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    units=freed,
+                    drain_s=round(drain_s, 3),
+                )
+        self.phases.add("drain", drain_s)
+        if lease.grant_to and freed > 0:
+            self._grant(
+                lease.grant_to,
+                min(freed, lease.units),
+                reason=lease.reason,
+            )
+
+    def _grant(self, to: str, units: int, reason: str) -> None:
+        tenant = self._tenants.get(to)
+        ceiling = (
+            self.cfg.serve_ceiling if to == SERVING else self.cfg.train_ceiling
+        )
+        with self._mu:
+            # clamp to the FREE ledger too, not just the ceiling: a
+            # drain-thread release and a concurrent step() can both
+            # try to place the same freed units (the release's
+            # deferred grant runs outside _step_mu) — whichever grant
+            # runs second must find them already spent, never drive
+            # _free negative
+            grantable = min(
+                units, ceiling - self._alloc.get(to, 0), self._free
+            )
+            if tenant is None or grantable <= 0:
+                # over ceiling / already spent (or no adapter on that
+                # side): the units stay in the free ledger
+                self._record(
+                    "grant_skipped", tenant=to, units=units, reason=reason
+                )
+                return
+            units = grantable
+            self._alloc[to] = self._alloc.get(to, 0) + units
+            self._free -= units
+            self.grants += 1
+            self._record(
+                "grant", tenant=to, units=units, reason=reason
+            )
+        t0 = time.perf_counter()
+        try:
+            faults.inject("pool.grant", tenant=to, units=units)
+            tenant.grant(units)
+        except Exception as e:  # noqa: BLE001 — the tenant could not
+            # apply the capacity: roll the ledger back to free so a
+            # later eval can retry the move
+            logger.warning("pool: grant to %s failed: %r", to, e)
+            with self._mu:
+                self._alloc[to] -= units
+                self._free += units
+                self._record(
+                    "grant_error",
+                    tenant=to,
+                    units=units,
+                    error=repr(e)[:200],
+                )
+            return
+        self.phases.add("grant", time.perf_counter() - t0)
+
+    # -- status ----------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._mu:
+            out = {
+                "total_units": self.cfg.total_units,
+                "allocations": dict(self._alloc),
+                "free": self._free,
+                "pending": [l.snapshot() for l in self._pending],
+                "calm_streak": self._calm_streak,
+                "counters": {
+                    "evaluations": self.evaluations,
+                    "revokes": self.revokes,
+                    "grants": self.grants,
+                    "escalations": self.escalations,
+                },
+                "journal_tail": list(self._journal[-20:]),
+            }
+        out["signals"] = self.last_signals
+        out["phase_split"] = self.phases.split().summary()
+        out["bounds"] = {
+            "train": [self.cfg.train_floor, self.cfg.train_ceiling],
+            "serve": [self.cfg.serve_floor, self.cfg.serve_ceiling],
+        }
+        return out
+
+    # -- periodic driver -------------------------------------------------
+
+    def start(self) -> "ChipPoolArbiter":
+        """Periodic evaluation at ``eval_interval_s`` (0 = manual
+        ``step()`` only — start() is then a no-op)."""
+        if self.cfg.eval_interval_s <= 0:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="pool-arbiter", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — arbiter survives
+                logger.exception("pool arbiter error: %s", e)
+            self._stop.wait(self.cfg.eval_interval_s)

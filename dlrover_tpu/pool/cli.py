@@ -1,0 +1,188 @@
+"""``tpurun-pool`` — run the chip-pool arbiter.
+
+Two subcommands:
+
+- ``tpurun-pool drill [--synthetic]`` runs the scripted traffic-spike
+  arbitration drill (pool/drill.py — the same code path behind the
+  docs/pool.md SLO matrix and the bench ``pool`` section) and prints
+  the measured verdict JSON; exit 0 only when the drill passed.
+- ``tpurun-pool serve`` runs the production fleet shape: a subprocess
+  serving fleet (``tpurun-serve`` replicas, gateway on
+  ``--gateway-port`` — the tpurun-fleet topology) arbitrated against
+  the pool's free capacity, with the arbiter's status endpoint on
+  ``--port`` (``/pool/status``, ``/pool/journal``, ``/healthz`` —
+  same JSON conventions as ``/fleet/status``). The training tenant in
+  this shape lives beside the master (``MasterTrainingController``,
+  docs/pool.md deployment section); without it, spike grants draw
+  from the free ledger and handback returns there.
+"""
+
+import argparse
+import json
+import signal
+import threading
+from http.server import ThreadingHTTPServer
+from typing import List, Optional
+
+from ..common.log import logger
+from .arbiter import ChipPoolArbiter
+from .config import PoolConfig
+
+__all__ = ["main", "serve_status"]
+
+
+def _make_handler(arbiter: ChipPoolArbiter):
+    from ..common.http import JsonRequestHandler
+
+    class Handler(JsonRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("pool: " + fmt, *args)
+
+        def do_GET(self):
+            if self.path in ("/pool/status", "/healthz"):
+                self._send(200, arbiter.status())
+            elif self.path == "/pool/journal":
+                self._send(200, {"journal": arbiter.journal()})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path == "/pool/step":
+                # manual evaluation (eval_interval_s=0 deployments)
+                self._send(200, arbiter.step())
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+def serve_status(
+    arbiter: ChipPoolArbiter, port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind the arbiter's status endpoint (caller runs serve_forever
+    or wraps it in a daemon thread)."""
+    return ThreadingHTTPServer(
+        ("0.0.0.0", port), _make_handler(arbiter)
+    )
+
+
+def _cmd_drill(ns) -> int:
+    from .drill import run_traffic_spike_drill
+
+    result = run_traffic_spike_drill(
+        workdir=ns.workdir,
+        real_engines=not ns.synthetic,
+        timeout_s=ns.timeout,
+    )
+    print(json.dumps(result, indent=1))
+    return 0 if result.get("ok") else 1
+
+
+def _cmd_serve(ns, overrides) -> int:
+    from ..fleet.config import FleetConfig
+    from ..fleet.gateway import Gateway
+    from ..fleet.replica import SubprocessReplica
+    from ..fleet.supervisor import ReplicaSupervisor
+    from .tenants import ServingTenant
+
+    cfg = PoolConfig.from_env(**overrides)
+    serve_args = list(ns.serve_args)
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    if ns.cpu and "--cpu" not in serve_args:
+        serve_args.append("--cpu")
+
+    base = FleetConfig.from_env()
+    # the fleet's own bounds must admit the pool ceiling, or grants
+    # would be clamped out from under the ledger (tenants.py warning)
+    fleet_cfg = FleetConfig.from_env(
+        max_replicas=max(base.max_replicas, cfg.serve_ceiling)
+    )
+
+    def factory(rid: int, port: int) -> SubprocessReplica:
+        return SubprocessReplica(rid, port, serve_args=serve_args)
+
+    # the tpurun-fleet SIGTERM contract: replicas run in their own
+    # sessions, so k8s pod stops must route through KeyboardInterrupt
+    # for the teardown below to reach them
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    supervisor = ReplicaSupervisor(factory, fleet_cfg).start()
+    gateway = Gateway(supervisor, fleet_cfg)
+    arbiter = ChipPoolArbiter(
+        ServingTenant(supervisor), config=cfg
+    ).start()
+    gw_port = gateway.start_http(ns.gateway_port)
+    httpd = serve_status(arbiter, ns.port)
+    logger.info(
+        "tpurun-pool: %s units (serve floor %s / ceiling %s), gateway "
+        "on :%s, status on :%s",
+        cfg.total_units,
+        cfg.serve_floor,
+        cfg.serve_ceiling,
+        gw_port,
+        httpd.server_address[1],
+    )
+    status_thread = threading.Thread(
+        target=httpd.serve_forever, name="pool-status", daemon=True
+    )
+    status_thread.start()
+    try:
+        threading.Event().wait()  # arbiter + monitors run on threads
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        gateway.stop_http()
+        arbiter.stop()
+        supervisor.stop()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun-pool",
+        description="chip-pool arbiter: SLO-driven co-scheduling of "
+        "elastic training and the serving fleet on one TPU pool",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("drill", help="run the traffic-spike drill")
+    d.add_argument("--synthetic", action="store_true",
+                   help="scripted replicas + numpy train step (no XLA)")
+    d.add_argument("--workdir", default=None)
+    d.add_argument("--timeout", type=float, default=240.0)
+
+    s = sub.add_parser("serve", help="fleet + arbiter + status endpoint")
+    s.add_argument("--port", type=int, default=8500,
+                   help="arbiter status endpoint port")
+    s.add_argument("--gateway-port", type=int, default=8400,
+                   help="fleet gateway port")
+    s.add_argument("--units", type=int, default=None,
+                   help="pool inventory (DLROVER_POOL_TOTAL_UNITS)")
+    s.add_argument("--eval-interval", type=float, default=None,
+                   help="arbiter period (DLROVER_POOL_EVAL_INTERVAL_S)")
+    s.add_argument("--cpu", action="store_true",
+                   help="forward --cpu to every replica (local smoke)")
+    s.add_argument(
+        "serve_args", nargs=argparse.REMAINDER,
+        help="args after -- are forwarded to every tpurun-serve replica",
+    )
+
+    ns = ap.parse_args(argv)
+    if ns.cmd == "drill":
+        return _cmd_drill(ns)
+    overrides = {}
+    if ns.units is not None:
+        overrides["total_units"] = ns.units
+    if ns.eval_interval is not None:
+        overrides["eval_interval_s"] = ns.eval_interval
+    return _cmd_serve(ns, overrides)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

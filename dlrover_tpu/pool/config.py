@@ -1,0 +1,108 @@
+"""Pool configuration: the ``DLROVER_POOL_*`` operator surface.
+
+One typed dataclass consumed by the arbiter, the tenant adapters, the
+``tpurun-pool`` CLI, and the drill. Every field is overridable through
+a registered env knob (``common/constants.py ENV_KNOBS`` — the
+``tpurun-lint`` env-knobs pass enforces registered ⇔ documented ⇔
+referenced) and through ``tpurun-pool`` flags, mirroring the fleet's
+``DLROVER_FLEET_*`` contract (docs/pool.md knob table).
+"""
+
+from dataclasses import dataclass, fields
+
+from ..common.constants import ENV_KNOBS
+
+# field name -> env knob. Declared next to the dataclass so a new field
+# and its knob land in the same diff (the lint staleness check fails on
+# either half missing).
+_POOL_KNOBS = {
+    "total_units": "DLROVER_POOL_TOTAL_UNITS",
+    "train_floor": "DLROVER_POOL_TRAIN_FLOOR",
+    "train_ceiling": "DLROVER_POOL_TRAIN_CEILING",
+    "serve_floor": "DLROVER_POOL_SERVE_FLOOR",
+    "serve_ceiling": "DLROVER_POOL_SERVE_CEILING",
+    "eval_interval_s": "DLROVER_POOL_EVAL_INTERVAL_S",
+    "revoke_deadline_s": "DLROVER_POOL_REVOKE_DEADLINE_S",
+    "handback_evals": "DLROVER_POOL_HANDBACK_EVALS",
+    "spike_units": "DLROVER_POOL_SPIKE_UNITS",
+    "queue_high": "DLROVER_POOL_QUEUE_HIGH",
+    "p95_target_s": "DLROVER_POOL_P95_TARGET_S",
+    "journal_path": "DLROVER_POOL_JOURNAL",
+    "status_timeout_s": "DLROVER_POOL_STATUS_TIMEOUT_S",
+}
+
+
+@dataclass
+class PoolConfig:
+    """Knobs for one chip-pool arbiter (docs/pool.md table)."""
+
+    # inventory: device-capacity units (1 unit = 1 serving replica =
+    # 1 training worker-host at node_unit granularity)
+    total_units: int = 4
+
+    # per-tenant bounds. Floors are the capacity a tenant can never be
+    # revoked below (a serving fleet must keep answering; a training
+    # job must keep a restorable world); ceilings cap grants (0 = the
+    # whole pool).
+    train_floor: int = 1
+    train_ceiling: int = 0
+    serve_floor: int = 1
+    serve_ceiling: int = 0
+
+    # policy loop
+    eval_interval_s: float = 0.0  # 0 = manual step() only
+    revoke_deadline_s: float = 30.0  # cooperative drain budget
+    handback_evals: int = 3  # calm evals before training reclaims
+    spike_units: int = 1  # units moved per breach decision
+
+    # serving SLO (breach = revoke training capacity). Defaults match
+    # the fleet autoscaler's signals so one SLO governs both layers.
+    queue_high: float = 4.0  # mean queued/replica to preempt
+    p95_target_s: float = 0.0  # p95 latency target (0 = off)
+
+    # decision journal (JSONL; empty = in-memory only)
+    journal_path: str = ""
+
+    # HTTP status endpoint client deadline (CLI, drill watchers)
+    status_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        if self.total_units < 2:
+            raise ValueError(
+                f"total_units must be >= 2 (one per tenant floor), got "
+                f"{self.total_units}"
+            )
+        if self.train_ceiling <= 0:
+            self.train_ceiling = self.total_units
+        if self.serve_ceiling <= 0:
+            self.serve_ceiling = self.total_units
+        if self.train_floor < 0 or self.serve_floor < 0:
+            raise ValueError("tenant floors must be >= 0")
+        if self.train_floor + self.serve_floor > self.total_units:
+            raise ValueError(
+                "tenant floors exceed the pool: "
+                f"{self.train_floor}+{self.serve_floor} > "
+                f"{self.total_units}"
+            )
+        if self.train_floor > self.train_ceiling:
+            raise ValueError("train_floor above train_ceiling")
+        if self.serve_floor > self.serve_ceiling:
+            raise ValueError("serve_floor above serve_ceiling")
+        if self.revoke_deadline_s <= 0:
+            raise ValueError("revoke_deadline_s must be > 0")
+        if self.handback_evals < 1:
+            raise ValueError("handback_evals must be >= 1")
+        if self.spike_units < 1:
+            raise ValueError("spike_units must be >= 1")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "PoolConfig":
+        """Defaults ← ``DLROVER_POOL_*`` env ← explicit overrides."""
+        kwargs = {}
+        for f in fields(cls):
+            knob = ENV_KNOBS[_POOL_KNOBS[f.name]]
+            val = knob.get()
+            if val is not None:
+                kwargs[f.name] = val
+        kwargs.update(overrides)
+        return cls(**kwargs)

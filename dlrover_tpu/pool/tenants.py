@@ -1,0 +1,653 @@
+"""Tenant adapters: how the arbiter's leases act on the two runtimes.
+
+The arbiter (``pool/arbiter.py``) speaks one small protocol::
+
+    initial_units          units held when attached to the pool
+    report() -> dict       live signals (the policy's inputs)
+    grant(units)           capacity granted — apply it (non-blocking)
+    revoke(units, deadline_s, on_released)
+                           cooperative reclaim — drain on your own
+                           thread, call on_released(freed) when the
+                           units are genuinely free (non-blocking)
+    escalate(units) -> int deadline missed — force the reclaim NOW,
+                           return how many units actually freed
+
+Two adapters:
+
+- :class:`ServingTenant` wraps the fleet (PR 7): units are replicas. A
+  grant adds replicas through ``ReplicaSupervisor.scale_to``; a revoke
+  drains the newest replicas through the fleet's bounded drain path
+  (``remove_replica`` — in-flight requests finish, the gateway routes
+  around); escalation terminates without the drain wait. Signals are
+  the fleet autoscaler's (``fleet_signals`` — one SLO definition for
+  both layers).
+- :class:`TrainingTenant` wraps a training *controller*: units are
+  worker-hosts at ``node_unit`` granularity. A revoke triggers a
+  flash-checkpoint-backed shrink to the next valid world on the shrink
+  ladder; a grant triggers a grow remesh — both pre-warmed by the
+  PR 4 compile-ahead service. Two controllers:
+  :class:`LoopTrainingController` drives a real
+  :class:`~dlrover_tpu.trainer.loop.ElasticTrainLoop` in-process
+  (drill/bench/colocated shape), and :class:`MasterTrainingController`
+  issues the master's ScalePlan / drain-shrink operations (the
+  embedded-in-master deployment shape, docs/pool.md).
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from ..common.log import logger
+from ..fleet.autoscaler import fleet_signals
+from ..trainer.loop import gradient_accumulation_steps
+
+__all__ = [
+    "ServingTenant",
+    "TrainingTenant",
+    "LoopTrainingController",
+    "MasterTrainingController",
+]
+
+
+class ServingTenant:
+    """Units = serving replicas, applied through the fleet supervisor."""
+
+    name = "serving"
+
+    def __init__(self, supervisor):
+        self.sup = supervisor
+        self.initial_units = len(supervisor.replicas())
+        # the in-flight revoke's victim rids: escalation must finish
+        # THIS victim set, not re-derive one over whatever replicas
+        # remain (a fresh pick could cut non-victims below the floor
+        # while the half-drained victims' units leak)
+        self._revoke_victims: Optional[list] = None
+
+    def report(self) -> Dict:
+        sig = fleet_signals(self.sup)
+        sig["units_held"] = len(self.sup.replicas())
+        return sig
+
+    def grant(self, units: int) -> None:
+        target = len(self.sup.replicas()) + units
+        got = self.sup.scale_to(target)
+        if got < target:
+            # the fleet's own max_replicas clamped the grant — a
+            # misconfiguration (the fleet bounds must admit the pool
+            # ceiling); loud, because the pool ledger now over-counts
+            logger.warning(
+                "pool serving grant clamped by fleet bounds: wanted %s "
+                "replicas, got %s (raise max_replicas to the pool "
+                "ceiling)",
+                target,
+                got,
+            )
+
+    def _victims(self, units: int):
+        """Newest replicas first (highest rid) — the fleet's stable
+        core keeps its warmed caches, mirroring scale_to's shrink."""
+        return sorted(self.sup.replicas(), key=lambda h: -h.rid)[:units]
+
+    def revoke(
+        self, units: int, deadline_s: float, on_released: Callable
+    ) -> None:
+        victims = self._victims(units)
+        rids = [h.rid for h in victims]
+        self._revoke_victims = rids
+
+        def drain():
+            deadline = time.monotonic() + deadline_s
+            removed = 0
+            for h in victims:
+                budget = max(0.0, deadline - time.monotonic())
+                if self.sup.remove_replica(h.rid, drain_timeout_s=budget):
+                    removed += 1
+            # cleared only AFTER the arbiter consumed the release: an
+            # escalation whose deadline raced the last drain must
+            # still see THIS victim set, while a LATER revoke whose
+            # dispatch failed before storing its own must see None
+            # (a stale set would report a previous lease's capacity
+            # as freshly freed). Identity-guarded: a LATE drain (its
+            # lease already escalated) finishing after a newer revoke
+            # stored ITS set must not wipe the newer lease's context.
+            on_released(removed)
+            if self._revoke_victims is rids:
+                self._revoke_victims = None
+
+        threading.Thread(
+            target=drain, name="pool-serve-drain", daemon=True
+        ).start()
+
+    def escalate(self, units: int) -> int:
+        rids = self._revoke_victims
+        # escalation CONSUMES the context: the lease it belonged to is
+        # closed either way, and a later failed-dispatch revoke must
+        # not inherit it (it would recount these rids as freed)
+        self._revoke_victims = None
+        if rids is None:
+            rids = [h.rid for h in self._victims(units)]
+        for rid in rids:
+            # zero drain budget: terminate now (in-flight work on the
+            # victim fails over through the gateway's re-dispatch).
+            # remove_replica pops the handle first-come, so a still-
+            # running cooperative drain and this pass never double-
+            # remove the same slot.
+            self.sup.remove_replica(rid, drain_timeout_s=0.0)
+        # freed = victims genuinely GONE, whichever path removed them
+        # (counting only own removals would leak the units a
+        # half-finished cooperative drain freed — its late on_released
+        # is ignored by the arbiter once the lease escalated)
+        return sum(1 for rid in rids if self.sup.get(rid) is None)
+
+
+class TrainingTenant:
+    """Units = training worker-hosts at ``node_unit`` granularity."""
+
+    name = "training"
+
+    def __init__(self, controller, node_unit: int = 1,
+                 floor_units: int = 0):
+        self.controller = controller
+        self.node_unit = max(1, node_unit)
+        # the pool's train_floor, enforced on the GRID: decide()
+        # bounds revokes in units, but a node_unit ladder rung can
+        # overshoot (4-1 rounds to 0 on a unit-4 grid) — the tenant
+        # must refuse a shrink that would land below the floor rather
+        # than shut training down past its guarantee
+        self.floor_units = max(0, floor_units)
+        self.initial_units = controller.world()
+        # the in-flight revoke's (from, target) ABSOLUTE worlds:
+        # escalation must finish driving to THAT target, not re-derive
+        # a delta from a world the cooperative drain may already have
+        # shrunk (a recomputed delta would shrink twice). Cleared only
+        # after the release is consumed — a deadline racing the
+        # drain's completion must still see it, but a later revoke
+        # whose dispatch failed must NOT inherit it (stale state would
+        # report a previous lease's units as freshly freed).
+        self._revoke_from: Optional[int] = None
+        self._revoke_world: Optional[int] = None
+
+    def report(self) -> Dict:
+        rep = dict(self.controller.report())
+        rep.setdefault("units_held", rep.get("world", 0))
+        return rep
+
+    def _current(self) -> int:
+        """The world all arithmetic is computed against: the
+        controller's TARGET world (a dispatched-but-not-yet-applied
+        grow/shrink counts — a revoke landing right after a grant must
+        see the granted world, or the grant is silently clobbered and
+        the ledger drifts from real capacity)."""
+        return self.controller.target_world()
+
+    def _shrink_target(self, units: int) -> int:
+        """Next valid world at/below ``current - units``: worlds move
+        in node_unit steps (the slice constraint the shrink ladder and
+        ``relaunch_slice`` already encode), clamped so the grid never
+        lands below ``floor_units``. Returns the CURRENT world when no
+        valid smaller world exists — the revoke then frees nothing
+        (released 0 / escalation freed 0) instead of violating the
+        floor."""
+        current = self._current()
+        target = current - units
+        target = max(0, target - target % self.node_unit)
+        if target < self.floor_units:
+            # smallest grid world satisfying the floor
+            target = (
+                -(-self.floor_units // self.node_unit) * self.node_unit
+            )
+        return target if target < current else current
+
+    def revoke(
+        self, units: int, deadline_s: float, on_released: Callable
+    ) -> None:
+        current = self._current()
+        target = self._shrink_target(units)
+        if target >= current:
+            # no grid world between the floor and here: close the
+            # lease immediately with nothing freed (the arbiter
+            # journals it; capacity simply cannot move at this grain)
+            logger.warning(
+                "pool training revoke of %s unit(s) refused: no valid "
+                "world below %s on a node_unit=%s grid above floor %s",
+                units, current, self.node_unit, self.floor_units,
+            )
+            on_released(0)
+            return
+        self._revoke_from = current
+        self._revoke_world = target
+
+        def drain():
+            # flash-checkpoint-backed shrink: the controller stops at a
+            # step boundary, stages state, and reboots the loop at the
+            # smaller world (bigger accumulation factor, same global
+            # batch). Only a COMPLETED reconfig frees the units; a miss
+            # says nothing and the arbiter escalates at the deadline.
+            # current - target may EXCEED the leased units when
+            # node_unit forces a deeper ladder step — the arbiter
+            # ledgers what was actually freed.
+            if self.controller.reconfigure(target, timeout_s=deadline_s):
+                on_released(current - target)
+                # identity-guarded clear: a LATE drain (lease already
+                # escalated) finishing after a newer revoke stored ITS
+                # context must not wipe the newer lease's worlds
+                if self._revoke_world == target:
+                    self._revoke_from = self._revoke_world = None
+
+        threading.Thread(
+            target=drain, name="pool-train-shrink", daemon=True
+        ).start()
+
+    def grant(self, units: int) -> None:
+        current = self._current()
+        target = current + units
+        if target % self.node_unit:
+            # a world off the node_unit grid cannot form; raising here
+            # (synchronously) makes the arbiter roll the ledger back
+            # to free instead of counting capacity training can never
+            # apply. Operators of node_unit pools set
+            # DLROVER_POOL_SPIKE_UNITS to a node_unit multiple.
+            raise ValueError(
+                f"granted world {target} is not a multiple of "
+                f"node_unit={self.node_unit}"
+            )
+
+        def grow():
+            # grow remesh: async — capacity applies when the new world
+            # forms (the compile-ahead service pre-warmed its program)
+            self.controller.reconfigure(target, timeout_s=None)
+
+        threading.Thread(
+            target=grow, name="pool-train-grow", daemon=True
+        ).start()
+
+    def escalate(self, units: int) -> int:
+        if self._revoke_world is not None:
+            frm, target = self._revoke_from, self._revoke_world
+            # consumed: the lease this context belonged to is closed
+            # either way, and a later failed-dispatch revoke must not
+            # inherit it (it would report phantom freed units)
+            self._revoke_from = self._revoke_world = None
+        else:
+            frm = self._current()
+            target = self._shrink_target(units)
+        if self.controller.world() > target:
+            # drive to the SAME absolute target the revoke named
+            # (idempotent if the cooperative drain got there first)
+            self.controller.escalate_to(target)
+        # freed counts from the pre-revoke world the ledger still
+        # holds — the cooperative drain's late on_released is ignored
+        # once the lease escalated, so whatever the world ACTUALLY
+        # dropped by is reported here, whichever path dropped it
+        return max(0, frm - self.controller.world())
+
+
+class LoopTrainingController:
+    """In-process training world driven by a real ElasticTrainLoop.
+
+    The loop trains in *segments*: each segment is one
+    ``ElasticTrainLoop.run`` at the current world's program. A
+    reconfig (pool revoke/grant) asks the live segment to stop at a
+    step boundary (``request_stop`` — the loop stages the final step
+    to shm on its way out), then the next segment rebuilds the train
+    step for the new world's accumulation factor and resumes through
+    ``load_consistent`` from the staged flash checkpoint. The PR 4
+    :class:`~dlrover_tpu.trainer.precompile.CompileAheadService`
+    pre-builds the anticipated worlds' programs on its background
+    thread (into this controller's program cache — in-process AOT, no
+    persistent-cache dependency), so the post-reconfig "compile" is a
+    table lookup.
+
+    ``build_step_fn(world) -> step_fn`` and
+    ``data_fn(world, start_step) -> iterable`` supply the
+    world-specific program and data stream (per-host batch scales with
+    the accumulation factor — the fixed-global-batch rule).
+    """
+
+    def __init__(
+        self,
+        engine,
+        build_step_fn: Callable[[int], Callable],
+        state: Any,
+        data_fn: Callable[[int, int], Iterable],
+        max_units: int,
+        start_world: Optional[int] = None,
+        node_unit: int = 1,
+        compile_ahead: bool = True,
+        max_steps: int = 0,
+        memory_every: int = 1,
+        storage_every: int = 50,
+        rate_window: int = 20,
+    ):
+        self.engine = engine
+        self._build_step_fn = build_step_fn
+        self._state = state
+        self._data_fn = data_fn
+        self.max_units = max_units
+        self.node_unit = max(1, node_unit)
+        self._world = start_world or max_units
+        self._max_steps = max_steps
+        self._memory_every = memory_every
+        self._storage_every = storage_every
+        self._mu = threading.Lock()
+        self._programs: Dict[int, Callable] = {}
+        self._loop = None
+        self._pending_world: Optional[int] = None
+        self._applied = threading.Event()
+        self._stopped = False
+        self._finished = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # progress bookkeeping: (monotonic, world) per completed step.
+        # ``microbatches`` counts GLOBAL micro-batches (world × accum
+        # factor per step) — sample-true goodput currency: a shrunk
+        # world's slower steps carry proportionally more micro-batches,
+        # so (Δmicrobatches / Δt) / baseline reads as the fraction of
+        # full-pool training throughput actually achieved.
+        self._steps: deque = deque(maxlen=max(2, rate_window))
+        self.steps_total = 0
+        self.microbatches = 0.0
+        self.reconfigs = 0
+        self.last_reconfig_s = 0.0
+        self._svc = None
+        if compile_ahead:
+            from ..trainer.precompile import CompileAheadService
+
+            self._svc = CompileAheadService(
+                self._program,
+                current_world=self._world,
+                max_workers=max_units,
+                node_unit=self.node_unit,
+            )
+
+    # -- programs ---------------------------------------------------------
+
+    def _program(self, world: int) -> Callable:
+        """The train step for ``world`` — cached, so the compile-ahead
+        thread's build and a reconfig's synchronous miss share one
+        table."""
+        with self._mu:
+            fn = self._programs.get(world)
+        if fn is not None:
+            return fn
+        fn = self._build_step_fn(world)
+        with self._mu:
+            return self._programs.setdefault(world, fn)
+
+    @property
+    def compile_ahead_service(self):
+        return self._svc
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "LoopTrainingController":
+        self._thread = threading.Thread(
+            target=self._run, name="pool-train-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        with self._mu:
+            self._stopped = True
+            loop = self._loop
+        if loop is not None:
+            loop.request_stop()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        if self._svc is not None:
+            self._svc.stop()
+
+    def wait_finished(self, timeout: Optional[float] = None) -> bool:
+        return self._finished.wait(timeout)
+
+    def _on_step(self, step: int, loss) -> None:
+        now = time.monotonic()
+        world = self._world
+        self._steps.append((now, world))
+        self.steps_total += 1
+        self.microbatches += world * gradient_accumulation_steps(
+            self.max_units, world
+        )
+
+    def _run(self) -> None:
+        state = self._state
+        try:
+            while True:
+                with self._mu:
+                    if self._stopped:
+                        break
+                    tgt = self._pending_world
+                    self._pending_world = None
+                if tgt is not None:
+                    self._world = tgt
+                    self.reconfigs += 1
+                    self._applied.set()
+                    if self._svc is not None:
+                        # the likely-next worlds shifted with this one
+                        self._svc.anticipate(tgt)
+                if self._world <= 0:
+                    # fully revoked: park until a grant raises us
+                    if self._wait_for_world():
+                        continue
+                    break
+                from ..trainer.loop import ElasticTrainLoop
+
+                step_fn = self._program(self._world)
+                loop = ElasticTrainLoop(
+                    self.engine,
+                    step_fn,
+                    max_steps=self._max_steps,
+                    memory_every=self._memory_every,
+                    storage_every=self._storage_every,
+                    on_step=self._on_step,
+                    trace_host=False,
+                    soft_remesh=False,
+                    prefetch_input=False,
+                    compile_ahead=self._svc,
+                )
+                with self._mu:
+                    self._loop = loop
+                    if self._stopped or self._pending_world is not None:
+                        # a stop/reconfig landed between segments:
+                        # consume it before paying a restore+compile
+                        self._loop = None
+                        continue
+                world = self._world
+                state = loop.run(
+                    state,
+                    data_factory=lambda start: self._data_fn(
+                        world, start
+                    ),
+                )
+                with self._mu:
+                    self._loop = None
+                    natural = (
+                        self._pending_world is None and not self._stopped
+                    )
+                if natural and not loop.stop_requested:
+                    break  # max_steps / data exhausted: training done
+        except Exception:  # noqa: BLE001 — surfaced via report()
+            logger.exception("pool training loop died")
+        finally:
+            self._state = state
+            self._finished.set()
+
+    def _wait_for_world(self) -> bool:
+        """World 0 (everything revoked): block until a grant or stop.
+        Returns True to continue the segment loop."""
+        while True:
+            with self._mu:
+                if self._stopped:
+                    return False
+                if self._pending_world:
+                    return True
+            time.sleep(0.05)
+
+    # -- controller protocol ---------------------------------------------
+
+    def world(self) -> int:
+        return self._world
+
+    def target_world(self) -> int:
+        """The world the controller is COMMITTED to: a dispatched but
+        not-yet-applied reconfigure counts. Tenant arithmetic uses
+        this, never the live world — a revoke computed against the
+        live world while a grant's target is still pending would
+        clobber the grant and drift the pool ledger."""
+        with self._mu:
+            return (
+                self._pending_world
+                if self._pending_world is not None
+                else self._world
+            )
+
+    def state(self) -> Any:
+        return self._state
+
+    def report(self) -> Dict:
+        steps = list(self._steps)
+        rate = 0.0
+        if len(steps) >= 2:
+            span = steps[-1][0] - steps[0][0]
+            if span > 0:
+                rate = (len(steps) - 1) / span
+        return {
+            "world": self._world,
+            "units_held": self._world,
+            "steps_total": self.steps_total,
+            "steps_per_s": round(rate, 3),
+            "step_time_s": round(1.0 / rate, 4) if rate > 0 else None,
+            "reconfigs": self.reconfigs,
+            "finished": self._finished.is_set(),
+        }
+
+    def reconfigure(
+        self, target: int, timeout_s: Optional[float] = None
+    ) -> bool:
+        """Move to ``target`` world at the next step boundary. Blocks
+        (up to ``timeout_s``) until the old segment has stopped AND
+        staged its state — the moment the capacity delta is real."""
+        t0 = time.monotonic()
+        with self._mu:
+            if self._stopped:
+                return False
+            if target == self._world and self._pending_world is None:
+                return True
+            self._pending_world = target
+            self._applied.clear()
+            loop = self._loop
+        if loop is not None:
+            loop.request_stop()
+        if timeout_s is None:
+            return True
+        ok = self._applied.wait(timeout_s)
+        if ok:
+            self.last_reconfig_s = time.monotonic() - t0
+        return ok
+
+    def escalate_to(self, target: int, grace_s: float = 5.0) -> int:
+        """Forced reclaim: same stop mechanism, short grace. In-process
+        there is no harder lever than the step-boundary stop — a
+        segment wedged INSIDE a step cannot free its units, and
+        returning 0 keeps the ledger honest about that."""
+        current = self._world
+        if self.reconfigure(target, timeout_s=grace_s):
+            return max(0, current - target)
+        return 0
+
+
+class MasterTrainingController:
+    """Master-embedded controller: reconfigure through the job's
+    scale machinery (the deployment shape — the arbiter runs beside
+    the master and the real agents do the flash-checkpoint shrink /
+    grow remesh that PRs 3–4 built).
+
+    ``scaler`` executes :class:`~dlrover_tpu.master.scaler.base_scaler.
+    ScalePlan`; ``world_size_fn`` reports the live rendezvous world;
+    ``shrink_handler(target)`` is the drain-aware shrink path (the
+    same hook :class:`~dlrover_tpu.master.node.job_auto_scaler.
+    JobAutoScaler` uses — released nodes are marked intentional before
+    the kill). Grow goes through a plain ScalePlan; escalation is a
+    forced ScalePlan (hard relaunch semantics — the agents checkpoint
+    at breakpoint and die)."""
+
+    def __init__(
+        self,
+        scaler,
+        world_size_fn: Callable[[], int],
+        max_units: int,
+        shrink_handler: Optional[Callable[[int], None]] = None,
+        stats_fn: Optional[Callable[[], Dict]] = None,
+        poll_interval_s: float = 0.5,
+    ):
+        self._scaler = scaler
+        self._world_size_fn = world_size_fn
+        self.max_units = max_units
+        self._shrink_handler = shrink_handler
+        self._stats_fn = stats_fn
+        self._poll_interval_s = poll_interval_s
+        self.reconfigs = 0
+        self._last_target: Optional[int] = None
+
+    def world(self) -> int:
+        return int(self._world_size_fn())
+
+    def target_world(self) -> int:
+        """The last dispatched target (the rendezvous takes a while to
+        converge; arithmetic against the live world mid-transition
+        would double-apply a move), falling back to the live world
+        before any dispatch."""
+        return (
+            self._last_target
+            if self._last_target is not None
+            else self.world()
+        )
+
+    def report(self) -> Dict:
+        rep = {"world": self.world(), "reconfigs": self.reconfigs}
+        if self._stats_fn is not None:
+            rep.update(self._stats_fn() or {})
+        rep.setdefault("units_held", rep["world"])
+        return rep
+
+    def _dispatch(self, target: int) -> None:
+        from ..master.scaler.base_scaler import ScalePlan
+
+        current = self.world()
+        if target < current and self._shrink_handler is not None:
+            # drain path: intentional release, rendezvous bounds drop,
+            # THEN the kill — never a bare ScalePlan for a shrink
+            self._shrink_handler(target)
+        else:
+            self._scaler.scale(ScalePlan(worker_num=target))
+        self._last_target = target
+        self.reconfigs += 1
+
+    def reconfigure(
+        self, target: int, timeout_s: Optional[float] = None
+    ) -> bool:
+        self._dispatch(target)
+        if timeout_s is None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.world() == target:
+                return True
+            time.sleep(self._poll_interval_s)
+        return self.world() == target
+
+    def escalate_to(self, target: int, grace_s: float = 5.0) -> int:
+        from ..master.scaler.base_scaler import ScalePlan
+
+        current = self.world()
+        # the hard path: a direct plan — the scaler kills what the
+        # drain did not release; agents save at breakpoint on the way
+        self._scaler.scale(ScalePlan(worker_num=target))
+        self._last_target = target
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline and self.world() > target:
+            time.sleep(self._poll_interval_s)
+        # only capacity the world ACTUALLY shed counts as freed — a
+        # plan still converging frees nothing yet (ledger honesty)
+        return max(0, current - self.world())

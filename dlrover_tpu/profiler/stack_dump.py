@@ -72,14 +72,33 @@ def trigger_and_read(pid: int, timeout_s: float = 5.0) -> str:
     except (ProcessLookupError, PermissionError) as e:
         logger.warning("cannot signal worker %s for stack dump: %s", pid, e)
         return ""
+    # Wait for the dump to be COMPLETE, not merely started: the
+    # faulthandler write is one write() per frame across every thread,
+    # and on a loaded host it can take far longer than a fixed grace —
+    # reading at first growth returned partial dumps missing the
+    # threads written last (exactly the main thread a hang post-mortem
+    # is about). Done = the file stopped growing for ~0.3 s.
     deadline = time.time() + timeout_s
+    size = before
+    stable = 0
     while time.time() < deadline:
         try:
-            if os.path.getsize(path) > before:
-                time.sleep(0.2)  # let the write finish
-                break
+            now_size = os.path.getsize(path)
         except OSError:
-            pass
+            # no information: neither growth nor stability — a
+            # transient stat failure must not count toward the
+            # stable-polls early break (it would re-admit the partial
+            # read this loop exists to prevent)
+            time.sleep(0.1)
+            continue
+        if now_size > before:
+            if now_size == size:
+                stable += 1
+                if stable >= 3:
+                    break
+            else:
+                stable = 0
+        size = now_size
         time.sleep(0.1)
     try:
         with open(path) as f:

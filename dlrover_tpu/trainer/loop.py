@@ -11,6 +11,7 @@ PerfMonitor/goodput/hang machinery.
 """
 
 import os
+import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Tuple
 
@@ -123,6 +124,25 @@ class ElasticTrainLoop:
         self.last_first_step_s = 0.0
         self.last_compile_s: Optional[float] = None
         self._recovery_written = False
+        # Cooperative step-boundary stop (chip-pool revocation,
+        # operator pause): run() breaks at the NEXT boundary and walks
+        # its normal tail — the final state is staged to shm with
+        # retries, pending persists drain — so the returned state is
+        # flash-checkpoint-backed and a successor (smaller world, new
+        # accumulation factor) resumes exactly where this run stopped.
+        # One-shot per loop instance: construct a fresh loop (the
+        # repo-wide pattern) rather than re-running a stopped one.
+        self._stop_requested = threading.Event()
+
+    def request_stop(self) -> None:
+        """Ask a running :meth:`run` to stop at the next step boundary
+        (thread-safe; callable from any thread). The loop stages the
+        final step before returning, so the stop is handoff-grade."""
+        self._stop_requested.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested.is_set()
 
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
@@ -337,6 +357,11 @@ class ElasticTrainLoop:
             # must not consume (and discard) an element of a finite or
             # replayable dataset
             if self.max_steps and step >= self.max_steps:
+                break
+            if self._stop_requested.is_set():
+                # cooperative stop (pool revocation): break BEFORE
+                # drawing — the boundary is clean and the tail below
+                # stages this step's state for the successor world
                 break
             if self._remesh is not None and self._remesh.requested:
                 # Stage BEFORE deciding: an accepted world continues

@@ -194,14 +194,19 @@ class TestPhaseSplit:
         assert split.phases["admission"]["hist_log2us"][13] == 3
 
     def test_phase_name_partition(self):
-        from dlrover_tpu.attribution.phases import GATEWAY_PHASES
+        from dlrover_tpu.attribution.phases import (
+            GATEWAY_PHASES,
+            POOL_PHASES,
+        )
 
-        # engine + gateway phase names jointly partition into
+        # engine + gateway + pool phase names jointly partition into
         # host / device / overlap — split() classifies by these sets
-        assert set(PHASES) | set(GATEWAY_PHASES) == (
+        assert set(PHASES) | set(GATEWAY_PHASES) | set(POOL_PHASES) == (
             HOST_PHASES | DEVICE_PHASES | OVERLAP_PHASES
         )
         assert not (set(PHASES) & set(GATEWAY_PHASES))
+        assert not (set(PHASES) & set(POOL_PHASES))
+        assert not (set(GATEWAY_PHASES) & set(POOL_PHASES))
         assert not (HOST_PHASES & DEVICE_PHASES)
         assert not (OVERLAP_PHASES & (HOST_PHASES | DEVICE_PHASES))
 

@@ -256,6 +256,17 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["fleet_rollout_aborted"] = False
     extra["fleet_rollout_load_failed"] = 0
     extra["fleet_ready"] = 2
+    # chip-pool section (docs/pool.md): the SLO trio must survive
+    # in-line; the supporting scalars may shrink to the sidecar
+    extra["pool_preempt_to_ready_s"] = 0.54
+    extra["pool_spike_availability"] = 1.0
+    extra["pool_train_goodput"] = 0.62
+    extra["pool_handback"] = True
+    extra["pool_requests_ok"] = 212
+    extra["pool_revokes"] = 2
+    extra["pool_escalations"] = 0
+    extra["pool_recovered_vs_baseline"] = 0.98
+    extra["pool_window_s"] = 10.4
     bench._merge_committed_artifacts(extra)
     extra["probe_history"] = [
         {
@@ -340,6 +351,13 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     for key in (
         "fleet_requests_per_s", "fleet_kill_availability",
         "fleet_rollout_max_unready",
+    ):
+        assert slim[key] == extra[key], key
+    # the chip-pool SLO trio rides the line (supporting pool scalars
+    # are sidecar-recoverable)
+    for key in (
+        "pool_preempt_to_ready_s", "pool_spike_availability",
+        "pool_train_goodput",
     ):
         assert slim[key] == extra[key], key
     assert slim["attr_report"] == extra["attr_report"]

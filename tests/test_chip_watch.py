@@ -13,6 +13,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -405,6 +406,20 @@ class TestCaptureSilicon:
             start_new_session=True,
         )
         try:
+            # wait for the exec: between fork and exec the child's
+            # /proc cmdline still shows THIS process's argv (no
+            # --worker), so an immediate reap scan can miss it — a
+            # coin-flip flake on a loaded box. (Real orphans have been
+            # running for ages; only the test spawns-then-reaps.)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    with open(f"/proc/{proc.pid}/cmdline", "rb") as f:
+                        if b"--worker" in f.read():
+                            break
+                except OSError:
+                    pass
+                time.sleep(0.02)
             chip_watch._reap_orphan_workers()
             proc.wait(timeout=10)
             assert proc.returncode == -9  # SIGKILL

@@ -117,6 +117,17 @@ base = pathlib.Path(os.environ["BASE"])
 '''
 
 
+# This container's jaxlib refuses ANY cross-process computation on the
+# CPU backend (jit, process_allgather — "Multiprocess computations
+# aren't implemented on the CPU backend"), so every genuine 2-process
+# world here is environmentally impossible: skip-with-reason, don't
+# fail. Matched against the child's output so the suite still runs in
+# full on a jaxlib that can (TPU hosts, newer CPU collectives).
+_MULTIPROC_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
+
+
 def _run_two_ranks(tmp_path, worker_src, timeout, per_rank_env=None):
     """Launch the worker source as 2 jax.distributed ranks; return their
     outputs. The ONE copy of the launch/collect/kill scaffold: env
@@ -157,6 +168,12 @@ def _run_two_ranks(tmp_path, worker_src, timeout, per_rank_env=None):
         for p in procs:
             out, _ = p.communicate(timeout=timeout)
             outs.append(out.decode(errors="replace"))
+            if p.returncode != 0 and _MULTIPROC_UNSUPPORTED in outs[-1]:
+                pytest.skip(
+                    "this jaxlib cannot run multiprocess computations "
+                    "on the CPU backend (environmental; the real "
+                    "2-process world is untestable here)"
+                )
             assert p.returncode == 0, outs[-1][-3000:]
     finally:
         for p in procs:

@@ -100,10 +100,13 @@ class TestExportImport:
     def test_import_then_engine_load(self, tmp_path):
         import orbax.checkpoint as ocp
 
-        # an Orbax user's existing checkpoint...
+        # an Orbax user's existing checkpoint... (0-d ndarray, not a
+        # bare np.int32 scalar: this orbax's StandardCheckpointHandler
+        # accepts only int/float/ndarray/jax.Array leaves and rejects
+        # numpy scalar types at save validation)
         tree = {
             "w": np.arange(8, dtype=np.float32).reshape(2, 4),
-            "opt": {"count": np.int32(5)},
+            "opt": {"count": np.asarray(5, dtype=np.int32)},
         }
         odir = str(tmp_path / "orbax_in")
         ckptr = ocp.StandardCheckpointer()

@@ -1,0 +1,1062 @@
+"""Chip-pool arbiter (dlrover_tpu/pool/): ledger/lease mechanics, the
+pure policy, tenant adapters, escalation, the decision journal, the
+status endpoint, chaos drills, and the synthetic end-to-end
+arbitration drill.
+
+Mechanics run over FAKE tenants (scripted report/grant/revoke) so
+every ledger transition is pinned without an engine; adapter tests run
+over scripted HTTP replicas (drill.ScriptedReplica) and a numpy-backed
+real ElasticTrainLoop; the synthetic drill exercises the whole
+breach → revoke → drain → grant → READY → handback arc in-process.
+The real-engine twin lives in tests/test_zz_pool_e2e.py (subprocess,
+via the ``traffic_spike_preempt`` scenario).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.chaos import faults
+from dlrover_tpu.pool import (
+    ChipPoolArbiter,
+    LoopTrainingController,
+    MasterTrainingController,
+    PoolConfig,
+    ServingTenant,
+    TrainingTenant,
+    decide,
+)
+from dlrover_tpu.pool.arbiter import SERVING, TRAINING, LeaseState
+
+
+@pytest.fixture(autouse=True)
+def fresh_saver(tmp_ipc_dir, monkeypatch):
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+    from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+
+    job = f"pool_{os.getpid()}_{id(tmp_ipc_dir)}"
+    monkeypatch.setenv("DLROVER_JOB_NAME", job)
+    AsyncCheckpointSaver.reset()
+    yield
+    AsyncCheckpointSaver.reset()
+    for name in os.listdir("/dev/shm"):
+        if name.startswith(f"dlrover_{job}_"):
+            SharedMemoryHandler(
+                0, name=name.split(f"dlrover_{job}_", 1)[1]
+            ).unlink()
+
+
+class FakeTenant:
+    """Scripted tenant: instant (or timed/stubborn) drains, recorded
+    grant/revoke/escalate calls, canned signals."""
+
+    def __init__(
+        self,
+        name,
+        units,
+        signals=None,
+        drain_s=0.0,
+        stubborn=False,
+        escalate_frees=True,
+        grant_error=False,
+        report_error=False,
+    ):
+        self.name = name
+        self.initial_units = units
+        self.signals = dict(signals or {})
+        self.drain_s = drain_s
+        self.stubborn = stubborn
+        self.escalate_frees = escalate_frees
+        self.grant_error = grant_error
+        self.report_error = report_error
+        self.granted = []
+        self.revoked = []
+        self.escalated = []
+
+    def report(self):
+        if self.report_error:
+            raise RuntimeError("control plane dark")
+        return dict(self.signals)
+
+    def grant(self, units):
+        if self.grant_error:
+            raise RuntimeError("grant failed")
+        self.granted.append(units)
+
+    def revoke(self, units, deadline_s, on_released):
+        self.revoked.append(units)
+        if self.stubborn:
+            return  # never confirms: the arbiter must escalate
+        if self.drain_s:
+            threading.Timer(
+                self.drain_s, lambda: on_released(units)
+            ).start()
+        else:
+            on_released(units)
+
+    def escalate(self, units):
+        self.escalated.append(units)
+        return units if self.escalate_frees else 0
+
+
+def _cfg(**kw):
+    base = dict(
+        total_units=4,
+        train_floor=1,
+        serve_floor=1,
+        queue_high=2.0,
+        handback_evals=2,
+        revoke_deadline_s=5.0,
+    )
+    base.update(kw)
+    return PoolConfig(**base)
+
+
+BREACH = {"ready": 1, "queue_mean": 5.0, "busy_total": 2, "p95_worst_s": None}
+CALM = {"ready": 1, "queue_mean": 0.0, "busy_total": 0, "p95_worst_s": None}
+ACTIVE = {"ready": 1, "queue_mean": 1.0, "busy_total": 1, "p95_worst_s": None}
+
+
+def _arbiter(serving, training, **cfg_kw):
+    return ChipPoolArbiter(serving, training, config=_cfg(**cfg_kw))
+
+
+class TestPoolConfig:
+    def test_ceilings_default_to_pool(self):
+        cfg = PoolConfig(total_units=6)
+        assert cfg.train_ceiling == 6 and cfg.serve_ceiling == 6
+
+    def test_floor_sum_must_fit(self):
+        with pytest.raises(ValueError, match="exceed the pool"):
+            PoolConfig(total_units=4, train_floor=3, serve_floor=2)
+
+    def test_floor_above_ceiling_rejected(self):
+        with pytest.raises(ValueError, match="above train_ceiling"):
+            PoolConfig(total_units=8, train_floor=5, train_ceiling=4)
+
+    def test_from_env_reads_knobs(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_POOL_TOTAL_UNITS", "8")
+        monkeypatch.setenv("DLROVER_POOL_QUEUE_HIGH", "7.5")
+        monkeypatch.setenv("DLROVER_POOL_HANDBACK_EVALS", "5")
+        cfg = PoolConfig.from_env()
+        assert cfg.total_units == 8
+        assert cfg.queue_high == 7.5
+        assert cfg.handback_evals == 5
+
+    def test_overrides_beat_env(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_POOL_TOTAL_UNITS", "8")
+        assert PoolConfig.from_env(total_units=5).total_units == 5
+
+
+class TestDecidePolicy:
+    def test_no_signal_never_arbitrates(self):
+        cfg = _cfg()
+        out = decide(None, {SERVING: 1, TRAINING: 3}, 0, cfg, 0, 1)
+        assert out["action"] is None
+        out = decide(
+            {"ready": 0}, {SERVING: 1, TRAINING: 3}, 0, cfg, 0, 1
+        )
+        assert out["action"] is None
+
+    def test_queue_breach_preempts(self):
+        out = decide(BREACH, {SERVING: 1, TRAINING: 3}, 0, _cfg(), 0, 1)
+        assert out["action"] == "preempt" and out["units"] == 1
+
+    def test_p95_breach_preempts(self):
+        sig = dict(CALM, p95_worst_s=0.9, busy_total=1)
+        out = decide(
+            sig,
+            {SERVING: 1, TRAINING: 3},
+            0,
+            _cfg(p95_target_s=0.5),
+            0,
+            1,
+        )
+        assert out["action"] == "preempt"
+        assert "p95" in out["reason"]
+
+    def test_breach_respects_training_floor(self):
+        # training at its floor and nothing free: the breach cannot move
+        out = decide(BREACH, {SERVING: 3, TRAINING: 1}, 0, _cfg(), 0, 1)
+        assert out["action"] is None
+        assert "no capacity movable" in out["reason"]
+
+    def test_breach_respects_serve_ceiling(self):
+        # serving is capped; the free unit it cannot take returns to
+        # training instead of stranding
+        out = decide(
+            BREACH,
+            {SERVING: 2, TRAINING: 1},
+            1,
+            _cfg(serve_ceiling=2),
+            0,
+            1,
+        )
+        assert out["action"] == "reclaim" and out["units"] == 1
+        # and with nothing free, the breach-but-stuck verdict stands
+        out = decide(
+            BREACH,
+            {SERVING: 2, TRAINING: 2},
+            0,
+            _cfg(serve_ceiling=2),
+            0,
+            1,
+        )
+        assert out["action"] is None
+
+    def test_free_units_reclaimed_to_training(self):
+        # unowned units (grid overshoot, rolled-back grants) go back
+        # to training without waiting for hysteresis — they need no
+        # revocation
+        out = decide(CALM, {SERVING: 1, TRAINING: 2}, 1, _cfg(), 5, 1)
+        assert out["action"] == "reclaim" and out["units"] == 1
+        assert out["calm_streak"] == 5  # surge hysteresis undisturbed
+        # disabled without a training adapter (serving-only pools)
+        out = decide(
+            CALM, {SERVING: 1, TRAINING: 0}, 3, _cfg(train_floor=0),
+            0, 1, trainable=False,
+        )
+        assert out["action"] is None
+
+    def test_handback_needs_consecutive_calm(self):
+        cfg = _cfg(handback_evals=3)
+        alloc = {SERVING: 2, TRAINING: 2}
+        out = decide(CALM, alloc, 0, cfg, 0, 1)
+        assert out["action"] is None and out["calm_streak"] == 1
+        out = decide(CALM, alloc, 0, cfg, 1, 1)
+        assert out["action"] is None and out["calm_streak"] == 2
+        out = decide(CALM, alloc, 0, cfg, 2, 1)
+        assert out["action"] == "handback" and out["units"] == 1
+
+    def test_activity_resets_calm_streak(self):
+        out = decide(ACTIVE, {SERVING: 2, TRAINING: 2}, 0, _cfg(), 5, 1)
+        assert out["action"] is None and out["calm_streak"] == 0
+
+    def test_handback_stops_at_serve_baseline(self):
+        # serving at its calm baseline: nothing to hand back
+        out = decide(
+            CALM, {SERVING: 2, TRAINING: 2}, 0, _cfg(), 9, 2
+        )
+        assert out["action"] is None
+
+    def test_handback_capped_by_train_ceiling(self):
+        out = decide(
+            CALM,
+            {SERVING: 3, TRAINING: 1},
+            0,
+            _cfg(train_ceiling=1),
+            9,
+            1,
+        )
+        assert out["action"] is None
+
+
+class TestArbiterLedger:
+    def test_breach_takes_free_pool_first(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 2, signals={})
+        arb = _arbiter(serving, training)  # 1 + 2 of 4: 1 free
+        out = arb.step()
+        assert out["action"] == "preempt"
+        assert serving.granted == [1]
+        assert training.revoked == []  # the free unit covered it
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+        assert arb.free_units() == 0
+        events = [e["event"] for e in arb.journal()]
+        assert events == ["breach", "grant"]
+
+    def test_breach_revokes_training_when_pool_empty(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3, signals={})
+        arb = _arbiter(serving, training)
+        arb.step()
+        assert training.revoked == [1]
+        assert serving.granted == [1]
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+        events = [e["event"] for e in arb.journal()]
+        assert events == ["breach", "revoke", "release", "grant"]
+        release = [e for e in arb.journal() if e["event"] == "release"][0]
+        assert release["drain_s"] >= 0
+
+    def test_handback_after_hysteresis(self):
+        serving = FakeTenant("serving", 2, signals=CALM)
+        training = FakeTenant("training", 1, signals={})
+        arb = _arbiter(serving, training)
+        # baseline is serving's initial 2 — drop it so surge exists
+        arb._serve_baseline = 1
+        # eval 1: the pool's 1 unowned free unit reclaims to training
+        out = arb.step()
+        assert out["action"] == "reclaim"
+        assert training.granted == [1]
+        assert arb.free_units() == 0
+        # evals 2-3: calm hysteresis, then the surge hands back
+        assert arb.step()["action"] is None
+        out = arb.step()
+        assert out["action"] == "handback"
+        assert serving.revoked == [1]
+        assert training.granted == [1, 1]
+        assert arb.allocations() == {SERVING: 1, TRAINING: 3}
+
+    def test_inflight_revocation_blocks_decisions(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3, stubborn=True)
+        arb = _arbiter(serving, training, revoke_deadline_s=30.0)
+        arb.step()
+        assert len(arb.pending_leases()) == 1
+        out = arb.step()
+        assert out["action"] is None
+        assert out["reason"] == "revocation in flight"
+        assert training.revoked == [1]  # not re-issued
+
+    def test_deadline_escalates_and_regrants(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3, stubborn=True)
+        arb = _arbiter(serving, training, revoke_deadline_s=0.1)
+        arb.step()
+        time.sleep(0.15)
+        arb.step()  # past the deadline: escalation fires
+        assert training.escalated == [1]
+        assert arb.escalations == 1
+        assert serving.granted == [1]
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+        events = [e["event"] for e in arb.journal()]
+        assert "escalate" in events and "escalate_freed" in events
+
+    def test_failed_escalation_keeps_ledger_honest(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant(
+            "training", 3, stubborn=True, escalate_frees=False
+        )
+        arb = _arbiter(serving, training, revoke_deadline_s=0.1)
+        arb.step()
+        first = arb.pending_leases()[0]
+        time.sleep(0.15)
+        arb.step()
+        # nothing actually freed: the ledger must not claim capacity
+        assert arb.allocations() == {SERVING: 1, TRAINING: 3}
+        assert serving.granted == []
+        # the failed lease is closed; the persisting breach is allowed
+        # to open a RETRY lease (new id) — it must not be the old one
+        assert first.state == LeaseState.ESCALATED
+        assert all(
+            l.lease_id != first.lease_id for l in arb.pending_leases()
+        )
+
+    def test_late_release_after_escalation_is_ignored(self):
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3, stubborn=True)
+        arb = _arbiter(serving, training, revoke_deadline_s=0.1)
+        arb.step()
+        lease = arb.pending_leases()[0]
+        time.sleep(0.15)
+        arb.step()  # escalated; ledger moved once
+        alloc = arb.allocations()
+        arb._on_released(lease, 1)  # the tardy cooperative confirm
+        assert arb.allocations() == alloc  # no double move
+        assert lease.state == LeaseState.ESCALATED
+        assert any(
+            e["event"] == "late_release" for e in arb.journal()
+        )
+
+    def test_grant_failure_rolls_back_to_free(self):
+        serving = FakeTenant(
+            "serving", 1, signals=BREACH, grant_error=True
+        )
+        training = FakeTenant("training", 2)
+        arb = _arbiter(serving, training)
+        arb.step()
+        assert arb.allocations() == {SERVING: 1, TRAINING: 2}
+        assert arb.free_units() == 1  # rolled back, retryable
+        assert any(
+            e["event"] == "grant_error" for e in arb.journal()
+        )
+        # the breach persists: the next eval retries the move
+        serving.grant_error = False
+        arb.step()
+        assert serving.granted == [1]
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+
+    def test_report_error_skips_eval(self):
+        serving = FakeTenant(
+            "serving", 1, signals=BREACH, report_error=True
+        )
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        out = arb.step()
+        assert out["action"] is None
+        assert training.revoked == []
+        assert any(
+            e["event"] == "report_error" for e in arb.journal()
+        )
+
+    def test_journal_file_is_jsonl(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training, journal_path=path)
+        arb.step()
+        lines = [
+            json.loads(l)
+            for l in open(path).read().splitlines()
+            if l.strip()
+        ]
+        assert [e["event"] for e in lines] == [
+            "breach", "revoke", "release", "grant",
+        ]
+        assert all("alloc" in e and "seq" in e for e in lines)
+
+    def test_status_shape(self):
+        serving = FakeTenant("serving", 1, signals=CALM)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        arb.step()
+        st = arb.status()
+        assert st["total_units"] == 4
+        assert st["allocations"] == {SERVING: 1, TRAINING: 3}
+        assert st["counters"]["evaluations"] == 1
+        assert "phase_split" in st and "journal_tail" in st
+        assert st["bounds"]["train"] == [1, 4]
+
+    def test_serving_only_pool_uses_free_ledger(self):
+        # no training adapter (the tpurun-pool serve shape): spikes
+        # draw from free, handback returns there
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        arb = ChipPoolArbiter(
+            serving, config=_cfg(train_floor=0)
+        )
+        arb.step()
+        assert serving.granted == [1]
+        assert arb.allocations()[SERVING] == 2
+        assert arb.free_units() == 2
+        serving.signals = dict(CALM)
+        arb.step()
+        arb.step()  # hysteresis: second calm eval hands back
+        arb.wait_idle(5.0)
+        assert arb.allocations()[SERVING] == 1
+        assert arb.free_units() == 3
+
+
+class TestPoolInjectionDrills:
+    """The three pool injection points, fired deterministically against
+    a live arbiter (chaos/faults.py): the arbitration loop must ride
+    through a dark tenant report, a delayed revoke dispatch, and a
+    poisoned grant — and every fire must be visible in the records."""
+
+    def teardown_method(self):
+        faults.deactivate()
+
+    def test_tenant_report_error_rides_through(self):
+        faults.activate(
+            faults.FaultPlan.parse(
+                "seed=7;pool.tenant_report:error:dark@at=1"
+            )
+        )
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        out = arb.step()  # first collection dies injected
+        assert out["action"] is None
+        assert any(
+            e["event"] == "report_error" for e in arb.journal()
+        )
+        arb.step()  # next eval proceeds normally
+        assert serving.granted == [1]
+        fired = [
+            r for r in faults.records()
+            if r["point"] == "pool.tenant_report"
+        ]
+        assert len(fired) == 1
+
+    def test_revoke_delay_injection_fires(self):
+        faults.activate(
+            faults.FaultPlan.parse("seed=7;pool.revoke:delay:0.01@once")
+        )
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        arb.step()
+        assert training.revoked == [1]
+        assert [
+            r["point"] for r in faults.records()
+        ] == ["pool.revoke"]
+
+    def test_poisoned_grant_rolls_back_then_retries(self):
+        faults.activate(
+            faults.FaultPlan.parse("seed=7;pool.grant:error:poisoned@at=1")
+        )
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        arb.step()  # grant dies injected -> rollback to free
+        assert arb.free_units() == 1
+        assert any(
+            e["event"] == "grant_error" for e in arb.journal()
+        )
+        arb.step()  # breach persists: retried from the free pool
+        assert serving.granted == [1]
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+        assert any(
+            r["point"] == "pool.grant" for r in faults.records()
+        )
+
+
+class TestServingTenant:
+    def _fleet(self, n=2, max_replicas=4):
+        from dlrover_tpu.fleet import FleetConfig, ReplicaSupervisor
+        from dlrover_tpu.pool.drill import ScriptedReplica
+
+        script = {}
+        cfg = FleetConfig(
+            replicas=n,
+            min_replicas=1,
+            max_replicas=max_replicas,
+            health_interval_s=0.05,
+            health_timeout_s=5.0,
+            drain_timeout_s=5.0,
+        )
+        sup = ReplicaSupervisor(
+            lambda rid, port: ScriptedReplica(rid, port, script=script),
+            cfg,
+        ).start()
+        assert sup.wait_ready(n, timeout=30.0)
+        return sup, script
+
+    def test_report_units_and_signals(self):
+        sup, script = self._fleet(2)
+        try:
+            tenant = ServingTenant(sup)
+            assert tenant.initial_units == 2
+            script["queue_depth"] = 6
+            time.sleep(0.2)  # two poll intervals
+            rep = tenant.report()
+            assert rep["units_held"] == 2
+            assert rep["ready"] == 2
+            assert rep["queue_mean"] == 6.0
+        finally:
+            sup.stop()
+
+    def test_grant_adds_replicas(self):
+        sup, _ = self._fleet(1)
+        try:
+            tenant = ServingTenant(sup)
+            tenant.grant(2)
+            assert len(sup.replicas()) == 3
+            assert sup.wait_ready(3, timeout=30.0)
+        finally:
+            sup.stop()
+
+    def test_revoke_drains_newest_and_confirms(self):
+        sup, _ = self._fleet(3)
+        try:
+            tenant = ServingTenant(sup)
+            released = []
+            tenant.revoke(2, 10.0, released.append)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not released:
+                time.sleep(0.05)
+            assert released == [2]
+            rids = sorted(h.rid for h in sup.replicas())
+            assert rids == [0]  # newest (1, 2) drained away
+        finally:
+            sup.stop()
+
+    def test_escalate_terminates_without_drain(self):
+        sup, _ = self._fleet(2)
+        try:
+            tenant = ServingTenant(sup)
+            assert tenant.escalate(1) == 1
+            assert len(sup.replicas()) == 1
+        finally:
+            sup.stop()
+
+
+class TestLoopTrainingController:
+    """The in-process training tenant: a REAL ElasticTrainLoop over a
+    numpy step program — the flash-checkpoint reconfigure machinery
+    without an XLA compile in sight."""
+
+    def _controller(self, tmp_path, max_units=3, step_s=0.01):
+        from dlrover_tpu.pool.drill import _synthetic_training
+
+        engine, build, state, data = _synthetic_training(
+            str(tmp_path), max_units, step_s=step_s
+        )
+        ctl = LoopTrainingController(
+            engine,
+            build,
+            state,
+            data,
+            max_units=max_units,
+            start_world=max_units,
+            storage_every=10_000,
+        )
+        return engine, ctl
+
+    def _wait_steps(self, ctl, n, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if ctl.steps_total >= n:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_shrink_is_checkpoint_backed_and_lossless(self, tmp_path):
+        engine, ctl = self._controller(tmp_path)
+        try:
+            ctl.start()
+            assert self._wait_steps(ctl, 5)
+            assert ctl.reconfigure(2, timeout_s=30.0)
+            assert ctl.world() == 2
+            before = ctl.steps_total
+            assert self._wait_steps(ctl, before + 5)
+            assert ctl.reconfigure(3, timeout_s=30.0)  # grow back
+            assert self._wait_steps(ctl, ctl.steps_total + 3)
+        finally:
+            ctl.stop()
+            engine.shm.unlink()
+            engine.close()
+        # every step applied exactly once across both reconfigs: the
+        # state's own counter equals the observed step count — a lossy
+        # or replayed resume would break the equality
+        assert int(ctl.state()["step"]) == ctl.steps_total
+        assert ctl.reconfigs == 2
+
+    def test_report_and_rate(self, tmp_path):
+        engine, ctl = self._controller(tmp_path)
+        try:
+            ctl.start()
+            assert self._wait_steps(ctl, 8)
+            rep = ctl.report()
+            assert rep["world"] == 3
+            assert rep["units_held"] == 3
+            assert rep["steps_per_s"] > 0
+        finally:
+            ctl.stop()
+            engine.shm.unlink()
+            engine.close()
+
+    def test_tenant_shrink_ladder_respects_node_unit(self, tmp_path):
+        engine, ctl = self._controller(tmp_path, max_units=4)
+        try:
+            tenant = TrainingTenant(ctl, node_unit=2)
+            assert tenant._shrink_target(1) == 2  # 4-1=3 -> 2 (unit=2)
+            assert tenant._shrink_target(2) == 2
+            assert tenant._shrink_target(3) == 0
+        finally:
+            engine.shm.unlink()
+            engine.close()
+
+    def test_escalate_to_uses_grace(self, tmp_path):
+        engine, ctl = self._controller(tmp_path)
+        try:
+            ctl.start()
+            assert self._wait_steps(ctl, 3)
+            freed = ctl.escalate_to(1, grace_s=30.0)
+            assert freed == 2
+            assert ctl.world() == 1
+        finally:
+            ctl.stop()
+            engine.shm.unlink()
+            engine.close()
+
+
+class _FakeController:
+    """Scripted training controller for tenant-arithmetic tests where
+    the live loop's timing would hide the race being pinned."""
+
+    def __init__(self, world=3):
+        self.world_val = world
+        self.pending = None
+        self.reconfig_calls = []
+        self.escalate_calls = []
+        self.complete_reconfigs = True
+
+    def world(self):
+        return self.world_val
+
+    def target_world(self):
+        return self.pending if self.pending is not None else self.world_val
+
+    def reconfigure(self, target, timeout_s=None):
+        self.reconfig_calls.append(target)
+        self.pending = target
+        if self.complete_reconfigs:
+            self.world_val = target
+            self.pending = None
+            return True
+        return False
+
+    def escalate_to(self, target, grace_s=5.0):
+        self.escalate_calls.append(target)
+        before = self.world_val
+        self.world_val = target
+        self.pending = None
+        return max(0, before - target)
+
+    def report(self):
+        return {"world": self.world_val}
+
+
+class TestTenantLedgerConsistency:
+    """Regression pins for the review findings: stale-world targets,
+    revoke/escalate double-reclaim, and node_unit grid mismatches —
+    each of which silently drifted the pool ledger from real
+    capacity."""
+
+    def test_revoke_after_pending_grant_sees_granted_world(self):
+        # a grant's reconfigure is dispatched but not yet applied; the
+        # next revoke must compute against the GRANTED world, not
+        # clobber the grant with a stale-world target
+        ctl = _FakeController(world=2)
+        ctl.complete_reconfigs = False  # grant stays pending
+        tenant = TrainingTenant(ctl)
+        tenant.grant(1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not ctl.reconfig_calls:
+            time.sleep(0.01)
+        assert ctl.reconfig_calls == [3]  # the pending grant
+        released = []
+        ctl.complete_reconfigs = True
+        tenant.revoke(1, 10.0, released.append)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not released:
+            time.sleep(0.01)
+        # 3 (granted) - 1 = 2 — NOT 2 - 1 = 1 (the stale-world bug)
+        assert released == [1]
+        assert ctl.reconfig_calls[-1] == 2
+        assert ctl.world() == 2
+
+    def test_escalate_finishes_stored_target_not_a_fresh_delta(self):
+        # the cooperative drain already reached the revoke's target
+        # when the deadline fired: escalation must drive to the SAME
+        # absolute world (a no-op here) and report the freed delta —
+        # never re-derive a delta from the already-shrunk world
+        ctl = _FakeController(world=3)
+        ctl.complete_reconfigs = False  # coop "hangs"
+        tenant = TrainingTenant(ctl)
+        tenant.revoke(1, 10.0, lambda n: None)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not ctl.reconfig_calls:
+            time.sleep(0.01)
+        # the coop drain lands just as the deadline fires
+        ctl.world_val = 2
+        ctl.pending = None
+        freed = tenant.escalate(1)
+        assert freed == 1  # from the pre-revoke world 3, not 2-1
+        assert ctl.escalate_calls == []  # already at target: no force
+        assert ctl.world() == 2  # NEVER shrunk twice
+
+    def test_serving_escalate_finishes_stored_victims(self):
+        from dlrover_tpu.fleet import FleetConfig, ReplicaSupervisor
+        from dlrover_tpu.pool.drill import ScriptedReplica
+
+        script = {}
+        cfg = FleetConfig(
+            replicas=3, min_replicas=1, max_replicas=4,
+            health_interval_s=0.05, health_timeout_s=5.0,
+            drain_timeout_s=5.0,
+        )
+        sup = ReplicaSupervisor(
+            lambda rid, port: ScriptedReplica(rid, port, script=script),
+            cfg,
+        ).start()
+        try:
+            assert sup.wait_ready(3, timeout=30.0)
+            tenant = ServingTenant(sup)
+            # busy replicas: the cooperative drain blocks on queue>0
+            script["queue_depth"] = 5
+            released = []
+            tenant.revoke(2, 20.0, released.append)
+            time.sleep(0.3)  # drain is stuck mid-victim
+            freed = tenant.escalate(2)
+            assert freed == 2
+            # the STORED victims (newest rids 1, 2) went; replica 0 —
+            # which a fresh victim pick over the survivors would have
+            # cut — is untouched
+            assert sorted(h.rid for h in sup.replicas()) == [0]
+            # and the context is consumed: a later lease's escalation
+            # must never recount these rids as freshly freed
+            assert tenant._revoke_victims is None
+        finally:
+            script["queue_depth"] = 0
+            sup.stop()
+
+    def test_escalation_consumes_context_for_next_lease(self):
+        # lease A times out cooperatively and is escalated; lease B's
+        # dispatch then fails (the pool.revoke error-injection path)
+        # and B escalates too. B must compute from the LIVE world —
+        # replaying A's consumed context would report phantom freed
+        # units and leave the world untouched
+        ctl = _FakeController(world=4)
+        ctl.complete_reconfigs = False  # A's coop drain hangs
+        tenant = TrainingTenant(ctl)
+        tenant.revoke(1, 10.0, lambda n: None)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not ctl.reconfig_calls:
+            time.sleep(0.01)
+        assert tenant.escalate(1) == 1  # A forced 4 -> 3
+        assert ctl.world() == 3
+        freed = tenant.escalate(1)  # B: no stored context
+        assert freed == 1
+        assert ctl.world() == 2  # really moved — not A's replay
+
+    def test_escalate_after_failed_dispatch_uses_fresh_world(self):
+        # revoke #1 completed and was released (its stored context is
+        # consumed); revoke #2's dispatch failed before the tenant
+        # stored anything. Escalation must compute from the LIVE
+        # world — stale context would re-report revoke #1's units as
+        # freshly freed (phantom capacity in the ledger)
+        ctl = _FakeController(world=4)
+        tenant = TrainingTenant(ctl)
+        released = []
+        tenant.revoke(2, 10.0, released.append)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not released:
+            time.sleep(0.01)
+        assert released == [2] and ctl.world() == 2
+        # dispatch of revoke #2 "failed": escalate fires with no
+        # stored context
+        freed = tenant.escalate(1)
+        assert freed == 1  # 2 -> 1, NOT the stale 4-2=2
+        assert ctl.world() == 1
+
+    def test_serving_escalate_after_consumed_release_is_fresh(self):
+        from dlrover_tpu.fleet import FleetConfig, ReplicaSupervisor
+        from dlrover_tpu.pool.drill import ScriptedReplica
+
+        cfg = FleetConfig(
+            replicas=3, min_replicas=1, max_replicas=4,
+            health_interval_s=0.05, health_timeout_s=5.0,
+            drain_timeout_s=5.0,
+        )
+        sup = ReplicaSupervisor(
+            lambda rid, port: ScriptedReplica(rid, port, script={}),
+            cfg,
+        ).start()
+        try:
+            assert sup.wait_ready(3, timeout=30.0)
+            tenant = ServingTenant(sup)
+            released = []
+            tenant.revoke(1, 10.0, released.append)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not released:
+                time.sleep(0.05)
+            assert released == [1]
+            # escalation for a LATER failed-dispatch revoke must pick
+            # fresh victims, not recount the consumed set as "gone"
+            assert tenant.escalate(1) == 1
+            assert len(sup.replicas()) == 1
+        finally:
+            sup.stop()
+
+    def test_grant_clamped_to_free_ledger(self):
+        # the double-spend race: a release's deferred grant and a
+        # concurrent step() both placing the same freed units — the
+        # second grant must find them spent, never drive free negative
+        serving = FakeTenant("serving", 1, signals=CALM)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)  # free = 0
+        arb._grant(SERVING, 1, reason="race-loser")
+        assert arb.free_units() == 0
+        assert arb.allocations() == {SERVING: 1, TRAINING: 3}
+        assert serving.granted == []
+        assert any(
+            e["event"] == "grant_skipped" for e in arb.journal()
+        )
+
+    def test_shrink_ladder_respects_floor_on_grid(self, tmp_path):
+        # node_unit=4, floor 2: the only grid worlds are 0/4/8 — a
+        # 1-unit revoke must be REFUSED (released 0), not shut
+        # training down to world 0 past its floor
+        ctl = _FakeController(world=4)
+        tenant = TrainingTenant(ctl, node_unit=4, floor_units=2)
+        assert tenant._shrink_target(1) == 4  # no valid world
+        released = []
+        tenant.revoke(1, 5.0, released.append)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not released:
+            time.sleep(0.01)
+        assert released == [0]
+        assert ctl.world() == 4  # untouched
+        assert ctl.reconfig_calls == []
+        # a grid that CAN satisfy the floor still shrinks to it
+        tenant2 = TrainingTenant(
+            _FakeController(world=4), node_unit=2, floor_units=2
+        )
+        assert tenant2._shrink_target(3) == 2  # clamped at the floor
+
+    def test_grant_off_node_unit_grid_raises_for_rollback(self):
+        ctl = _FakeController(world=2)
+        tenant = TrainingTenant(ctl, node_unit=2)
+        with pytest.raises(ValueError, match="node_unit"):
+            tenant.grant(1)
+        assert ctl.reconfig_calls == []  # nothing dispatched
+
+    def test_node_unit_deep_shrink_overfree_reaches_ledger(self):
+        # node_unit grids can force freeing MORE than the leased
+        # units; the arbiter must ledger the actual freed count (the
+        # excess lands in the free pool via the ceiling clamp)
+        class _DeepTenant(FakeTenant):
+            def revoke(self, units, deadline_s, on_released):
+                self.revoked.append(units)
+                on_released(units + 1)  # the ladder skipped a rung
+
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = _DeepTenant("training", 4, signals={})
+        arb = ChipPoolArbiter(
+            serving, training, config=_cfg(total_units=5)
+        )
+        arb.step()
+        # 2 freed: 1 granted to serving (spike_units), 1 left free
+        assert arb.allocations() == {SERVING: 2, TRAINING: 2}
+        assert arb.free_units() == 1
+
+
+class TestMasterTrainingController:
+    class _Scaler:
+        def __init__(self):
+            self.plans = []
+
+        def scale(self, plan):
+            self.plans.append(plan)
+
+    def test_grow_issues_scale_plan(self):
+        scaler = self._Scaler()
+        world = {"n": 2}
+        ctl = MasterTrainingController(
+            scaler, lambda: world["n"], max_units=4
+        )
+        assert ctl.reconfigure(4) is True
+        assert scaler.plans[-1].worker_num == 4
+
+    def test_shrink_prefers_drain_handler(self):
+        scaler = self._Scaler()
+        drained = []
+        ctl = MasterTrainingController(
+            scaler,
+            lambda: 4,
+            max_units=4,
+            shrink_handler=drained.append,
+        )
+        ctl.reconfigure(2)
+        assert drained == [2]
+        assert scaler.plans == []  # never a bare kill for a shrink
+
+    def test_blocking_reconfigure_polls_world(self):
+        scaler = self._Scaler()
+        world = {"n": 2}
+
+        def grow_soon():
+            time.sleep(0.2)
+            world["n"] = 3
+
+        threading.Thread(target=grow_soon, daemon=True).start()
+        ctl = MasterTrainingController(
+            scaler, lambda: world["n"], max_units=4,
+            poll_interval_s=0.05,
+        )
+        assert ctl.reconfigure(3, timeout_s=5.0) is True
+        assert (
+            ctl.reconfigure(8, timeout_s=0.2) is False
+        )  # never forms
+
+    def test_escalate_forces_plan_and_counts_actual(self):
+        scaler = self._Scaler()
+        world = {"n": 4}
+
+        # the platform applies forced plans promptly in this fake
+        class _ApplyingScaler(self._Scaler):
+            def scale(self, plan):
+                super().scale(plan)
+                if plan.worker_num >= 0:
+                    world["n"] = plan.worker_num
+
+        scaler = _ApplyingScaler()
+        ctl = MasterTrainingController(
+            scaler, lambda: world["n"], max_units=4,
+            poll_interval_s=0.01,
+        )
+        assert ctl.escalate_to(2) == 2
+        assert scaler.plans[-1].worker_num == 2
+
+    def test_escalate_frees_nothing_until_world_drops(self):
+        # a plan still converging frees nothing yet (ledger honesty)
+        scaler = self._Scaler()
+        ctl = MasterTrainingController(
+            scaler, lambda: 4, max_units=4, poll_interval_s=0.02
+        )
+        assert ctl.escalate_to(2, grace_s=0.1) == 0
+        assert scaler.plans[-1].worker_num == 2
+
+
+class TestStatusEndpoint:
+    def test_status_journal_and_step_over_http(self):
+        from dlrover_tpu.pool.cli import serve_status
+
+        serving = FakeTenant("serving", 1, signals=BREACH)
+        training = FakeTenant("training", 3)
+        arb = _arbiter(serving, training)
+        httpd = serve_status(arb, 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            deadline = arb.cfg.status_timeout_s
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pool/status", timeout=deadline
+            ) as r:
+                st = json.loads(r.read())
+            assert st["total_units"] == 4
+            assert st["allocations"] == {SERVING: 1, TRAINING: 3}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/pool/step", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=deadline) as r:
+                out = json.loads(r.read())
+            assert out["action"] == "preempt"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/pool/journal",
+                timeout=deadline,
+            ) as r:
+                j = json.loads(r.read())["journal"]
+            assert [e["event"] for e in j] == [
+                "breach", "revoke", "release", "grant",
+            ]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            t.join(timeout=10)
+
+
+class TestSyntheticDrill:
+    def test_full_arbitration_arc(self, tmp_path):
+        """The whole breach → revoke (checkpointed shrink) → grant →
+        READY → hysteresis handback arc over scripted replicas and a
+        numpy ElasticTrainLoop — the tier-1 twin of the real-engine
+        ``traffic_spike_preempt`` scenario (test_zz_pool_e2e.py)."""
+        from dlrover_tpu.pool.drill import run_traffic_spike_drill
+
+        result = run_traffic_spike_drill(
+            workdir=str(tmp_path),
+            real_engines=False,
+            calibration_window_s=0.5,
+            spike_hold_s=0.3,
+            eval_interval_s=0.1,
+            timeout_s=90.0,
+        )
+        assert result["ok"], result
+        assert result["drill"] == "traffic_spike_preempt"
+        assert result["requests_failed"] == 0
+        assert result["availability"] == 1.0
+        assert result["preempt_to_ready_s"] >= 0
+        assert result["handback"] is True
+        assert result["escalations"] == 0
+        assert result["train_goodput"] > 0
+        events = [e["event"] for e in result["journal"]]
+        assert "breach" in events and "grant" in events
+        # the shrink genuinely moved the training world
+        assert result["world_during_spike"] < 3

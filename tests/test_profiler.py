@@ -293,28 +293,65 @@ class TestTimelineNames:
         )
 
 
+_STACK_DUMP_ROUNDTRIP_SRC = r"""
+import os, threading
+import dlrover_tpu.profiler.stack_dump as sd
+
+sd._DUMP_DIR = os.environ["DUMP_DIR"]
+path = sd.install_stack_dump_handler()
+assert path is not None
+done = threading.Event()
+t = threading.Thread(
+    target=lambda: done.wait(60), name="wedged-collective"
+)
+t.start()
+text = sd.trigger_and_read(os.getpid(), timeout_s=30.0)
+done.set()
+t.join()
+print("DUMP_BEGIN")
+print(text)
+print("DUMP_END", flush=True)
+"""
+
+
 class TestStackDump:
-    def test_install_trigger_read_roundtrip(self, tmp_path, monkeypatch):
+    def test_install_trigger_read_roundtrip(self, tmp_path):
+        """SIGUSR2 → faulthandler dump → trigger_and_read, in a CLEAN
+        subprocess. In-process this test was a tier-1 load-order
+        flake with a hard ceiling behind it: faulthandler dumps
+        threads newest-first and truncates the list at 100, and the
+        MAIN thread — dumped last, the one a hang post-mortem is
+        about — fell off the end whenever the suite process had
+        leaked its 100th daemon thread (monitors, http servers).
+        A fresh process has a handful of threads, so the roundtrip is
+        deterministic under any suite load."""
         import os
-        import threading
-        import time
+        import subprocess
+        import sys
 
-        monkeypatch.setenv("DLROVER_JOB_NAME", f"sd_{os.getpid()}")
-        import dlrover_tpu.profiler.stack_dump as sd
-
-        monkeypatch.setattr(sd, "_DUMP_DIR", str(tmp_path))
-        path = sd.install_stack_dump_handler()
-        assert path is not None
-
-        def waiter():
-            time.sleep(3)
-
-        t = threading.Thread(target=waiter, name="wedged-collective")
-        t.start()
-        text = sd.trigger_and_read(os.getpid())
-        t.join()
-        assert "wedged-collective" in text or "Thread" in text
-        assert "test_install_trigger_read_roundtrip" in text
+        env = dict(
+            os.environ,
+            DUMP_DIR=str(tmp_path),
+            DLROVER_JOB_NAME=f"sd_{os.getpid()}",
+            PYTHONPATH=os.pathsep.join(sys.path),
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", _STACK_DUMP_ROUNDTRIP_SRC],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            timeout=120,
+        )
+        out = proc.stdout.decode(errors="replace")
+        assert proc.returncode == 0, out[-3000:]
+        text = out.split("DUMP_BEGIN", 1)[-1].split("DUMP_END", 1)[0]
+        # both the wedged worker thread (faulthandler prints thread
+        # IDS, not names — its frames are the Event.wait) and the main
+        # thread's live frames made it into one artifact
+        assert "Thread 0x" in text, text
+        assert "in wait" in text, text  # the wedged thread's frame
+        assert "Current thread" in text, text
+        assert "trigger_and_read" in text, text
 
 
 def _write_ring(path, records, names=None):

@@ -41,8 +41,8 @@ def main() -> int:
         from dlrover_tpu.common.compile_cache import enable_compile_cache
 
         enable_compile_cache()
-    except Exception:  # noqa: BLE001 — an optimization only
-        pass
+    except Exception as e:  # noqa: BLE001 — an optimization only
+        print(f"warm spare: compile cache unavailable: {e!r}", file=sys.stderr)
     # Tell the agent we are ready (it may wait to avoid racing a
     # half-imported spare into a rendezvous round). The marker is a
     # file because stdout is usually redirected into the worker log.

@@ -262,6 +262,14 @@ class WarmSpare:
                 os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+            try:
+                # killpg alone leaves a zombie holding the pid table slot
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "warm spare pid=%s survived SIGKILL reap window",
+                    self.proc.pid,
+                )
         if self._log_file is not None:
             try:
                 self._log_file.close()

@@ -1,9 +1,13 @@
 """tpurun-lint: runtime-invariant static analysis for dlrover_tpu.
 
-Six AST passes, each encoding a rule this repo learned from an incident
-(docs/analysis.md): import-purity, blocking-under-lock, host-sync,
-rpc-deadline, env-knobs, injection-coverage. Pure stdlib — importing
-this package never imports jax or any runtime module.
+Ten AST passes, each encoding a rule this repo learned from an incident
+(docs/analysis.md): import-purity, blocking-under-lock, lock-order,
+thread-lifecycle, exception-swallow, host-sync, rpc-deadline,
+env-knobs, injection-coverage, endpoint-conformance — plus the runtime
+lock-witness sanitizer (``analysis/witness.py``,
+``DLROVER_LOCK_WITNESS=1``) for the inversions static analysis cannot
+see. Pure stdlib — importing this package never imports jax or any
+runtime module.
 
 Run it::
 

@@ -8,6 +8,7 @@ errors. Pure stdlib: safe in CI images without jax installed.
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -19,14 +20,44 @@ DEFAULT_BASELINE = os.path.join(
 )
 
 
+def changed_files(root: str, ref: str) -> List[str]:
+    """Absolute paths of files changed vs ``ref`` (tracked diffs plus
+    untracked files, .gitignore respected). Raises CalledProcessError
+    outside a git checkout."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=60,
+    ).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=root,
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=60,
+    ).stdout.splitlines()
+    out = []
+    for rel in dict.fromkeys(diff + untracked):  # ordered de-dupe
+        path = os.path.join(root, rel)
+        if os.path.exists(path):  # deleted files have nothing to lint
+            out.append(os.path.abspath(path))
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpurun-lint",
         description=(
             "AST lint suite encoding dlrover_tpu's runtime invariants "
-            "(import purity, no blocking under locks, no host syncs in "
-            "hot paths, Context-sourced RPC deadlines, the DLROVER_* "
-            "knob registry, chaos injection coverage). See "
+            "(import purity, no blocking under locks, acyclic lock "
+            "order, thread/Popen lifecycle, no swallowed exceptions, "
+            "no host syncs in hot paths, Context-sourced RPC "
+            "deadlines, the DLROVER_* knob registry, chaos injection "
+            "coverage, HTTP endpoint conformance). See "
             "docs/analysis.md."
         ),
     )
@@ -40,6 +71,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--select",
         metavar="PASS[,PASS...]",
         help="run only these passes (see --list-passes)",
+    )
+    p.add_argument(
+        "--changed",
+        metavar="REF",
+        nargs="?",
+        const="HEAD",
+        default=None,
+        help=(
+            "lint only files changed vs REF (git diff --name-only, "
+            "default HEAD, plus untracked) — the pre-commit fast path: "
+            "repo-wide passes are skipped and baseline staleness is "
+            "not assessed (the full gate is tests/test_lint_clean.py)"
+        ),
     )
     p.add_argument(
         "--list-passes", action="store_true", help="list passes and exit"
@@ -129,9 +173,75 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline = Baseline.load(baseline_path)
 
     root = find_repo_root(args.paths[0])
+    lint_paths = list(args.paths)
+    if args.changed is not None:
+        if args.write_baseline is not None:
+            # a subset run would silently truncate the repo-wide
+            # baseline to the changed files' violations
+            print(
+                "--changed cannot be combined with --write-baseline: "
+                "regenerate the baseline from a full run",
+                file=sys.stderr,
+            )
+            return 2
+        ref = args.changed
+        # argparse ambiguity: `--changed dlrover_tpu` binds the PATH as
+        # the ref. A "ref" that is not a rev but exists on disk is a
+        # path — shift it back and diff against HEAD.
+        if ref != "HEAD" and os.path.exists(ref):
+            probe = subprocess.run(
+                ["git", "rev-parse", "--verify", "--quiet", ref + "^{commit}"],
+                cwd=root,
+                capture_output=True,
+                timeout=60,
+            )
+            if probe.returncode != 0:
+                if ref not in args.paths:
+                    args.paths.append(ref)
+                ref = "HEAD"
+        try:
+            changed = changed_files(root, ref)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"--changed needs a git checkout: {e}", file=sys.stderr)
+            return 2
+        scope = [os.path.abspath(p) for p in args.paths]
+        lint_paths = [
+            f
+            for f in changed
+            if f.endswith(".py")
+            and any(f == s or f.startswith(s + os.sep) for s in scope)
+        ]
+        if not lint_paths:
+            print(
+                f"tpurun-lint: no Python files changed vs {args.changed} "
+                f"under {', '.join(args.paths)}"
+            )
+            return 0
+        # repo-wide passes need the whole tree: meaningless on a subset
+        skipped = [lp.PASS_ID for lp in passes if not hasattr(lp, "check_file")]
+        passes = [lp for lp in passes if hasattr(lp, "check_file")]
+        if not passes:
+            # --select named only repo-wide passes: exiting 0 here
+            # would report "clean" having checked nothing
+            print(
+                "--changed left no runnable pass (the selected passes "
+                f"are all repo-wide: {', '.join(skipped)}); run without "
+                "--changed",
+                file=sys.stderr,
+            )
+            return 2
+        if skipped:
+            print(
+                "tpurun-lint: --changed skips repo-wide passes: "
+                + ", ".join(skipped)
+            )
+
     result = run_lint(
-        args.paths, passes=passes, baseline=baseline, repo_root=root
+        lint_paths, passes=passes, baseline=baseline, repo_root=root
     )
+    if args.changed is not None:
+        # staleness cannot be assessed against a subset of the tree
+        result.stale_baseline = []
 
     if args.write_baseline is not None:
         out = args.write_baseline or baseline_path or DEFAULT_BASELINE

@@ -7,20 +7,28 @@ is the report order.
 
 from . import (
     blocking_under_lock,
+    endpoint_conformance,
     env_knobs,
+    exception_swallow,
     host_sync,
     import_purity,
     injection_coverage,
+    lock_order,
     rpc_deadline,
+    thread_lifecycle,
 )
 
 ALL_PASSES = [
     import_purity,
     blocking_under_lock,
+    lock_order,
+    thread_lifecycle,
+    exception_swallow,
     host_sync,
     rpc_deadline,
     env_knobs,
     injection_coverage,
+    endpoint_conformance,
 ]
 
 PASS_BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

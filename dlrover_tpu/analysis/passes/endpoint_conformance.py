@@ -1,0 +1,196 @@
+"""endpoint-conformance: HTTP clients and handlers must agree on routes.
+
+Incident (PR 7/8): three subsystems now speak HTTP to each other —
+gateway→replica (``/v1/completions``, ``/v1/prefixes``,
+``/v1/weights/reload``), supervisor health polls (``/healthz``), the
+pool CLI's status plane (``/pool/status``/``journal``/``step``) — and
+the route strings live as literals on both sides. A client path that
+drifts from its handler 404s only at runtime, in exactly the
+least-exercised code (a rollout, a drill); a handler nobody calls is
+dead surface that still has to be security-reviewed.
+
+Rule (repo-wide, over the linted tree):
+
+- *Registered routes* are collected from request handlers: every
+  string compared against ``self.path`` (``==``, ``in (tuple)``) and
+  every ``self.path.startswith("...")`` prefix.
+- *Client paths* are collected from in-repo HTTP clients: a string
+  literal starting with ``/`` concatenated onto something named like a
+  URL (``h.url + "/healthz"``), the trailing path of an
+  ``http://...`` f-string, and the first route-like argument of
+  helper calls named like ``_post``/``_post_replica``/``_get``/
+  ``get_json`` (the path is not always the first positional).
+- A client path with **no registered handler** (exact match, or under
+  a registered ``startswith`` prefix) is an error at the client site.
+- A registered route **no client or doc references** is an error at
+  the handler site — docs (README.md, docs/*.md) count as a reference
+  because operator-facing status endpoints are driven by curl, not by
+  in-repo code.
+
+Matching is by path string across the whole tree (the pass does not
+model which server a client connects to); tests are excluded simply
+because the lint gate only walks ``dlrover_tpu/``. Dynamic protocols
+that build paths from variables (checkpoint replica peers, unified
+payload store) contribute no literals on either side and are out of
+scope — by design, this pass is exactly the literal-drift tripwire.
+"""
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from ..core import FileContext, Violation, dotted_name
+
+PASS_ID = "endpoint-conformance"
+
+_ROUTE_RE = re.compile(r"^/[A-Za-z0-9_\-./]*$")
+_URLY = re.compile(r"(url|addr|base|endpoint)", re.I)
+# HTTP helper methods: the gateway's _post_replica(h, "/v1/...", ...),
+# the rpc client's _post("/get", ...) — the path may not be the first
+# argument, so take the first route-like literal among the positionals
+_HELPER_RE = re.compile(r"(^_?(post|request)|_post$|^_get$|^get_json$)")
+
+
+def _is_self_path(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "path"
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    )
+
+
+def _route_like(s: object) -> bool:
+    return (
+        isinstance(s, str)
+        and len(s) > 1
+        and _ROUTE_RE.match(s) is not None
+    )
+
+
+def collect_routes(
+    ctx: FileContext,
+) -> List[Tuple[str, bool, int]]:
+    """(path, is_prefix, line) registered by handlers in this file."""
+    out: List[Tuple[str, bool, int]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Compare) and _is_self_path(node.left):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, ast.Eq) and isinstance(comp, ast.Constant):
+                    if _route_like(comp.value):
+                        out.append((comp.value, False, node.lineno))
+                elif isinstance(op, ast.In) and isinstance(
+                    comp, (ast.Tuple, ast.List, ast.Set)
+                ):
+                    for e in comp.elts:
+                        if isinstance(e, ast.Constant) and _route_like(e.value):
+                            out.append((e.value, False, node.lineno))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "startswith"
+            and _is_self_path(node.func.value)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and _route_like(node.args[0].value)
+        ):
+            out.append((node.args[0].value, True, node.lineno))
+    return out
+
+
+def collect_client_paths(ctx: FileContext) -> List[Tuple[str, int]]:
+    """(path, line) sent by HTTP clients in this file."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            if (
+                isinstance(node.right, ast.Constant)
+                and _route_like(node.right.value)
+                and _URLY.search(dotted_name(node.left) or "")
+            ):
+                out.append((node.right.value, node.lineno))
+        elif isinstance(node, ast.JoinedStr):
+            parts = node.values
+            if (
+                parts
+                and isinstance(parts[0], ast.Constant)
+                and str(parts[0].value).startswith("http")
+                and isinstance(parts[-1], ast.Constant)
+            ):
+                tail = str(parts[-1].value)
+                if _route_like(tail):
+                    out.append((tail, node.lineno))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else ""
+            )
+            if name and _HELPER_RE.search(name):
+                for a in node.args:
+                    if isinstance(a, ast.Constant) and _route_like(a.value):
+                        out.append((a.value, node.lineno))
+                        break
+    return out
+
+
+def check_conformance(
+    contexts: List[FileContext], docs_text: str
+) -> Iterable[Violation]:
+    routes: Dict[str, List[Tuple[bool, str, int]]] = {}
+    clients: List[Tuple[str, str, int]] = []
+    for ctx in contexts:
+        for path, is_prefix, line in collect_routes(ctx):
+            routes.setdefault(path, []).append((is_prefix, ctx.rel, line))
+        for path, line in collect_client_paths(ctx):
+            clients.append((path, ctx.rel, line))
+
+    prefixes = [p for p, regs in routes.items() if any(r[0] for r in regs)]
+
+    referenced: set = set()
+    for path, rel, line in clients:
+        hit = path in routes or any(path.startswith(p) for p in prefixes)
+        if hit:
+            referenced.add(path)
+            for p in prefixes:
+                if path.startswith(p):
+                    referenced.add(p)
+        else:
+            yield Violation(
+                PASS_ID,
+                rel,
+                line,
+                f"client sends {path!r} but no handler registers that "
+                "route — this 404s at runtime (the gateway/pool "
+                "route-drift class); fix the path or register the "
+                "handler",
+                code=f"client:{path}",
+            )
+
+    for path, regs in sorted(routes.items()):
+        if path in referenced or path in docs_text:
+            continue
+        _is_prefix, rel, line = regs[0]
+        yield Violation(
+            PASS_ID,
+            rel,
+            line,
+            f"route {path!r} is registered but referenced by no in-repo "
+            "client and no doc — dead (or drifted) surface; wire a "
+            "client, document it, or delete the handler",
+            code=f"route:{path}",
+        )
+
+
+def repo_check(
+    root: str, contexts: List[FileContext]
+) -> Iterable[Violation]:
+    docs: List[str] = []
+    for p in [os.path.join(root, "README.md")] + sorted(
+        glob.glob(os.path.join(root, "docs", "*.md"))
+    ):
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                docs.append(f.read())
+    yield from check_conformance(contexts, "\n".join(docs))

@@ -1,0 +1,110 @@
+"""exception-swallow: broad handlers must log, re-raise, or record.
+
+Incident (PR 8): the pool ledger only stayed honest because review
+passes kept adding journaling by hand to ``except Exception`` bodies —
+a poisoned grant that was silently swallowed would have left capacity
+stranded with no trace, and the post-mortem would have had nothing to
+read. The same class produced the "late cooperative confirm after
+escalation" bug: the confirm was dropped on the floor instead of
+journaled+ignored, and only a regression test caught it.
+
+Rule: a broad handler — ``except:``, ``except Exception``, or
+``except BaseException`` (alone or in a tuple) — must do at least one
+of:
+
+- re-raise (any ``raise`` in the body),
+- log (a logging-verb call: ``logger.warning(...)``, ``print``, ...),
+- record (bump a counter via ``+=``, or call something named like a
+  journal/stats sink: ``journal``/``record``/``emit``/``note``/
+  ``observe``/``mark``/``incr``/``stat``/``report``/``fail``),
+- actually *use* the caught exception (``except Exception as e`` where
+  ``e`` is referenced — stored, forwarded, formatted into a result).
+
+A handler that does none of these erases the failure; suppress a
+deliberate drop with ``# tpulint: ignore[exception-swallow] <why>`` on
+the ``except`` line — the reason is the review trail. Narrow handlers
+(``except OSError:``) are out of scope: naming the exception type is
+already a statement of intent.
+
+Nested ``def``/``lambda`` bodies inside the handler do not count as
+handling — they run later, if ever.
+"""
+
+import ast
+import re
+from typing import Iterable
+
+from ..core import FileContext, Violation, call_name, walk_skip_defs
+
+PASS_ID = "exception-swallow"
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_VERBS = {
+    "debug",
+    "info",
+    "warning",
+    "warn",
+    "error",
+    "exception",
+    "critical",
+    "log",
+    "print",
+}
+_RECORDY = re.compile(
+    r"(journal|record|emit|note|observe|mark|incr|stat|report|fail)", re.I
+)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    exc_name = handler.name
+    for st in handler.body:
+        for node in walk_skip_defs(st):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.AugAssign):
+                return True  # counter bump
+            if exc_name and isinstance(node, ast.Name) and node.id == exc_name:
+                return True  # the exception goes somewhere
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _LOG_VERBS or _RECORDY.search(name):
+                    return True
+    return False
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if _handles(node):
+            continue
+        what = (
+            "except:" if node.type is None
+            else f"except {ast.unparse(node.type)}"  # py>=3.9
+        )
+        yield Violation(
+            PASS_ID,
+            ctx.rel,
+            node.lineno,
+            f"{what} swallows the failure — it neither re-raises, logs, "
+            "records to a journal/counter, nor uses the exception; a "
+            "dead component keeps looking healthy (the poisoned-grant "
+            "class). Log/journal it, or suppress with the reason the "
+            "drop is safe",
+            code=ctx.code_at(node.lineno),
+        )

@@ -1,0 +1,271 @@
+"""lock-order: the static lock-acquisition graph must be acyclic.
+
+Incident (PR 8): the pool arbiter's ``step()`` and the tenants' drain
+threads each took the same two locks in opposite orders — ``step()``
+held the step lock while touching the ledger, a drain-completion
+callback held the ledger lock while re-entering arbiter bookkeeping.
+The review pass serialized ``step()`` by hand; nothing stops the next
+thread from reintroducing the inversion, and an ABBA pair only
+deadlocks under exactly the interleaving chaos storms produce.
+
+Rule: build the per-module lock-acquisition graph and error on cycles.
+
+- A *lock* is any ``with``-acquired context manager whose name looks
+  like a lock (``lock``/``mutex``/``cond``), identified by its
+  qualified attribute path: ``self._ledger_lock`` inside ``class
+  Arbiter`` is the node ``Arbiter.self._ledger_lock``; a module-global
+  ``_lock`` is ``_lock``. Two instances of one class share the node —
+  the *order discipline* is per-site, not per-object.
+- An edge ``a -> b`` is recorded whenever ``with b:`` executes while
+  ``a`` is held: direct syntactic nesting, and nesting through direct
+  same-module calls (``with a: self.m()`` where ``m`` acquires ``b`` —
+  transitively through the module's own call graph).
+- A cycle means two threads can wait on each other forever. Self-edges
+  (re-acquiring the same named lock) are ignored — that is the RLock
+  re-entrancy pattern, and the non-reentrant variant is already flagged
+  by blocking-under-lock's nested ``acquire`` rule.
+
+The pass sees one module at a time: cross-module lock cycles (arbiter
+lock -> tenant lock -> arbiter lock through an object reference) are
+invisible to it — that is what the runtime lock-witness sanitizer
+(``analysis/witness.py``, ``DLROVER_LOCK_WITNESS=1``) exists to catch.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Violation, dotted_name
+
+PASS_ID = "lock-order"
+
+_LOCKY = re.compile(r"(lock|mutex|cond)", re.I)
+
+
+def _lock_node(expr: ast.expr, cls: str) -> Optional[str]:
+    """Qualified lock id for a with-item, or None if not lock-like."""
+    d = dotted_name(expr)
+    if isinstance(expr, ast.Call):
+        d = dotted_name(expr.func)
+    if not d:
+        return None
+    leaf = d.split(".")[-1]
+    if not _LOCKY.search(leaf):
+        return None
+    if d.startswith("self."):
+        return f"{cls}.{d}" if cls else d
+    return d
+
+
+class _Func:
+    """One function's lock facts: edges it creates and locks it may
+    acquire (directly; the transitive set is a later fixpoint)."""
+
+    def __init__(self, key: Tuple[str, str]):
+        self.key = key  # (class name or "", func name)
+        self.acquires: Set[str] = set()
+        # (held locks at the call site, callee key candidates)
+        self.calls: List[Tuple[Tuple[str, ...], Tuple[str, str], int]] = []
+        # direct nesting edges: (held, acquired, line)
+        self.edges: List[Tuple[str, str, int]] = []
+
+    def merge(self, other: "_Func") -> None:
+        self.acquires |= other.acquires
+        self.calls.extend(other.calls)
+        self.edges.extend(other.edges)
+
+
+def _collect_funcs(
+    tree: ast.AST,
+) -> List[Tuple[str, Tuple[str, str], ast.AST]]:
+    """(lock class context, call key, fn) for EVERY function def —
+    including closures: the PR 8 drain threads are nested ``def``s
+    whose lock takes must participate in the graph. A method is
+    callable as ``self.m()`` -> key (cls, m); module functions and
+    closures are callable bare -> key ("", name). Closures keep the
+    enclosing class as lock context (``self`` binds through the
+    closure)."""
+    out: List[Tuple[str, Tuple[str, str], ast.AST]] = []
+
+    def walk(node: ast.AST, cls: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                is_method = isinstance(node, ast.ClassDef)
+                key = (cls if is_method else "", child.name)
+                out.append((cls, key, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, "")
+    return out
+
+
+def _analyze_func(cls: str, key: Tuple[str, str], fn: ast.AST) -> _Func:
+    f = _Func(key)
+
+    def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested defs run on their own thread/time, not here
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lock = _lock_node(item.context_expr, cls)
+                if lock is None:
+                    continue
+                f.acquires.add(lock)
+                for h in new_held:
+                    if h != lock:
+                        f.edges.append((h, lock, node.lineno))
+                new_held = new_held + (lock,)
+            for st in node.body:
+                visit(st, new_held)
+            return
+        if isinstance(node, ast.Call):
+            callee = _callee_key(node, cls)
+            if callee is not None:
+                # held may be empty: the call still feeds the fixpoint
+                # (mid() holding nothing can reach leaf()'s locks)
+                f.calls.append((held, callee, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for st in fn.body:
+        visit(st, ())
+    return f
+
+
+def _callee_key(call: ast.Call, cls: str) -> Optional[Tuple[str, str]]:
+    """Same-module callee candidate: ``self.m()`` -> (cls, m);
+    bare ``f()`` -> ("", f). Anything else is opaque."""
+    fc = call.func
+    if (
+        isinstance(fc, ast.Attribute)
+        and isinstance(fc.value, ast.Name)
+        and fc.value.id == "self"
+        and cls
+    ):
+        return (cls, fc.attr)
+    if isinstance(fc, ast.Name):
+        return ("", fc.id)
+    return None
+
+
+def _transitive_acquires(funcs: Dict[Tuple[str, str], _Func]) -> Dict[
+    Tuple[str, str], Set[str]
+]:
+    """Locks each function may acquire, through same-module calls
+    (fixpoint over the module's own call graph)."""
+    acq = {k: set(f.acquires) for k, f in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, f in funcs.items():
+            for _held, callee, _line in f.calls:
+                target = acq.get(callee)
+                if target and not target.issubset(acq[k]):
+                    acq[k] |= target
+                    changed = True
+    return acq
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs, iterative; only components of size >= 2 matter."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) >= 2:
+                    out.append(sorted(comp))
+    return out
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    funcs: Dict[Tuple[str, str], _Func] = {}
+    for cls, key, fn in _collect_funcs(ctx.tree):
+        f = _analyze_func(cls, key, fn)
+        if key in funcs:
+            funcs[key].merge(f)  # same-named closures share the key
+        else:
+            funcs[key] = f
+    if not funcs:
+        return
+    acq = _transitive_acquires(funcs)
+
+    # edge -> first (line) where it is created, for reporting
+    edges: Dict[Tuple[str, str], int] = {}
+    for f in funcs.values():
+        for a, b, line in f.edges:
+            edges.setdefault((a, b), line)
+        for held, callee, line in f.calls:
+            for b in acq.get(callee, ()):
+                for a in held:
+                    if a != b:
+                        edges.setdefault((a, b), line)
+    if not edges:
+        return
+
+    graph: Dict[str, Set[str]] = {}
+    for (a, b), _line in edges.items():
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    for comp in _sccs(graph):
+        comp_set = set(comp)
+        cyc_edges = sorted(
+            (line, a, b)
+            for (a, b), line in edges.items()
+            if a in comp_set and b in comp_set
+        )
+        line, _a, _b = cyc_edges[0]
+        detail = ", ".join(f"{a}->{b} (line {ln})" for ln, a, b in cyc_edges)
+        yield Violation(
+            PASS_ID,
+            ctx.rel,
+            line,
+            "lock-order cycle — two threads taking these locks in the "
+            f"orders shown can deadlock: {detail}; pick one global order "
+            "(or narrow a critical section) so the graph is acyclic",
+            code="cycle:" + "->".join(comp),
+        )

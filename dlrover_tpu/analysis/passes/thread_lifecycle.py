@@ -1,0 +1,244 @@
+"""thread-lifecycle: every thread/child process has an owner that
+reaps it.
+
+Incident (PR 8): the profiler's stack-dump test could never pass once
+the suite process leaked its 100th thread — faulthandler hard-truncates
+the dump at 100 threads, newest-first, so the main thread fell off the
+end. The leak came from exactly this class: threads started by an
+owner whose stop path never joined them, and orphaned ``Popen``
+children (the chip-watch reaper exists because of the same class one
+level down).
+
+Rule, per ``threading.Thread(...)`` creation:
+
+- ``daemon=True`` at construction (or ``x.daemon = True`` before
+  ``start``) is fine — the interpreter reaps it; OR
+- the handle the thread is stored in (``self._t = Thread(...)``,
+  ``t = Thread(...)``, ``threads.append(Thread(...))``, a
+  comprehension assigned to a name) must be ``join``-ed **with a
+  timeout** somewhere in the same file (the owner's stop/close path;
+  an untimed join just moves the hang to the joiner — PR 3's
+  blocking-under-lock incidents); OR
+- a thread constructed and started with no handle at all is an error:
+  nobody can ever join it.
+
+Per ``subprocess.Popen(...)`` creation: the stored handle must have a
+reachable ``wait``/``communicate``/``kill``/``terminate`` in the same
+file — a Popen nobody reaps is a zombie on exit and an orphan on
+crash (the chip-watch ``_reap_orphan_workers`` incident). Passing the
+handle into a function named like a reaper
+(``kill_process_group(proc)``) also counts — that is the scalers'
+shared teardown idiom.
+
+The check is per-file and name-based: a handle handed to another
+module for reaping needs a ``# tpulint: ignore[thread-lifecycle]``
+with the reason naming the reaper.
+"""
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Violation, dotted_name
+
+PASS_ID = "thread-lifecycle"
+
+_REAP_VERBS = {"wait", "communicate", "kill", "terminate"}
+# a handle passed INTO a reaper function counts: the scalers hand their
+# Popen to common.proc.kill_process_group, which waits and escalates
+_REAPER_FN = re.compile(r"(kill|reap|stop|wait|terminate|shutdown|join)", re.I)
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    d = dotted_name(call.func)
+    return d in ("threading.Thread", "Thread")
+
+
+def _is_popen_ctor(call: ast.Call) -> bool:
+    d = dotted_name(call.func)
+    return d in ("subprocess.Popen", "Popen")
+
+
+def _daemon_true(call: ast.Call) -> bool:
+    for k in call.keywords:
+        if k.arg == "daemon":
+            return isinstance(k.value, ast.Constant) and k.value.value is True
+    return False
+
+
+def _leaf_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _timed_join(call: ast.Call) -> bool:
+    if call.args and not isinstance(
+        call.args[0], (ast.GeneratorExp, ast.ListComp)
+    ):
+        return True
+    return any(k.arg == "timeout" for k in call.keywords)
+
+
+class _FileFacts(ast.NodeVisitor):
+    """One linear scan: creations with their handles, join/reap
+    receivers, daemon-after-construction names, loop aliases."""
+
+    def __init__(self) -> None:
+        self.threads: List[Tuple[ast.Call, Optional[str]]] = []
+        self.popens: List[Tuple[ast.Call, Optional[str]]] = []
+        self.joined: Set[str] = set()  # timed-join receivers
+        self.reaped: Set[str] = set()  # wait/kill/... receivers
+        self.daemonized: Set[str] = set()  # x.daemon = True after ctor
+        # for-loop variable -> names appearing in the iterable
+        self.aliases: List[Tuple[str, Set[str]]] = []
+        self._handle: List[Optional[str]] = [None]
+
+    # -- handle tracking -------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        handle = _leaf_name(node.targets[0]) if len(node.targets) == 1 else None
+        # x.daemon = True after construction
+        if (
+            isinstance(node.targets[0], ast.Attribute)
+            and node.targets[0].attr == "daemon"
+            and isinstance(node.value, ast.Constant)
+            and node.value.value is True
+        ):
+            owner = _leaf_name(node.targets[0].value)
+            if owner:
+                self.daemonized.add(owner)
+        self._handle.append(handle)
+        self.generic_visit(node)
+        self._handle.pop()
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._handle.append(_leaf_name(node.target))
+        self.generic_visit(node)
+        self._handle.pop()
+
+    def visit_For(self, node: ast.For) -> None:
+        var = _leaf_name(node.target)
+        if var:
+            src_names = {
+                n for n in (
+                    _leaf_name(sub)
+                    for sub in ast.walk(node.iter)
+                    if isinstance(sub, (ast.Name, ast.Attribute))
+                )
+                if n
+            }
+            self.aliases.append((var, src_names))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_thread_ctor(node):
+            self.threads.append((node, self._current_handle(node)))
+        elif _is_popen_ctor(node):
+            self.popens.append((node, self._current_handle(node)))
+        else:
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                recv = _leaf_name(f.value)
+                if recv:
+                    if f.attr == "join" and _timed_join(node):
+                        self.joined.add(recv)
+                    elif f.attr in _REAP_VERBS:
+                        self.reaped.add(recv)
+                    elif f.attr == "setDaemon" and node.args and isinstance(
+                        node.args[0], ast.Constant
+                    ) and node.args[0].value is True:
+                        self.daemonized.add(recv)
+                if _REAPER_FN.search(f.attr):
+                    self._note_reaper_args(node)
+                # xs.append(Thread(...)) -> handle is the container
+                if f.attr == "append":
+                    recv = _leaf_name(f.value)
+                    if recv:
+                        self._handle.append(recv)
+                        self.generic_visit(node)
+                        self._handle.pop()
+                        return
+            elif isinstance(f, ast.Name) and _REAPER_FN.search(f.id):
+                self._note_reaper_args(node)
+        self.generic_visit(node)
+
+    def _note_reaper_args(self, node: ast.Call) -> None:
+        for a in node.args:
+            n = _leaf_name(a)
+            if n:
+                self.reaped.add(n)
+                self.joined.add(n)
+
+    def _current_handle(self, node: ast.Call) -> Optional[str]:
+        return self._handle[-1]
+
+
+def _reachable(handle: str, receivers: Set[str], aliases) -> bool:
+    if handle in receivers:
+        return True
+    # for t in self._threads: t.join(timeout=...) — the loop variable
+    # stands for the container handle
+    for var, src_names in aliases:
+        if handle in src_names and var in receivers:
+            return True
+    return False
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    facts = _FileFacts()
+    facts.visit(ctx.tree)
+
+    for call, handle in facts.threads:
+        if _daemon_true(call):
+            continue
+        if handle is None:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                call.lineno,
+                "non-daemon Thread constructed without a handle — nobody "
+                "can ever join it; store it on the owner and join "
+                "(timeout=...) in the stop path, or pass daemon=True",
+                code=ctx.code_at(call.lineno),
+            )
+            continue
+        if handle in facts.daemonized:
+            continue
+        if not _reachable(handle, facts.joined, facts.aliases):
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                call.lineno,
+                f"non-daemon Thread stored in {handle!r} is never "
+                "join(timeout=...)-ed in this file — the owner's "
+                "stop/close path must reap it (the 100-thread "
+                "faulthandler-truncation class), or pass daemon=True",
+                code=ctx.code_at(call.lineno),
+            )
+
+    for call, handle in facts.popens:
+        if handle is None:
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                call.lineno,
+                "Popen constructed without a handle — the child can "
+                "never be waited or killed (zombie on exit, orphan on "
+                "crash)",
+                code=ctx.code_at(call.lineno),
+            )
+            continue
+        if not _reachable(
+            handle, facts.reaped | facts.joined, facts.aliases
+        ):
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                call.lineno,
+                f"Popen stored in {handle!r} has no reachable "
+                "wait/communicate/kill/terminate in this file — reap it "
+                "in the owner's stop path (the orphan-worker class)",
+                code=ctx.code_at(call.lineno),
+            )

@@ -1,0 +1,335 @@
+"""Runtime lock-witness sanitizer: observe real acquisition order.
+
+The static ``lock-order`` pass proves the absence of cycles in what it
+can *see* — one module at a time, ``with``-acquired locks, same-module
+call edges. Cross-object cycles (arbiter lock → tenant lock → arbiter
+lock through an object reference), manual ``acquire()`` spans, and
+order decided by data are invisible to it. This module is the dynamic
+half: when ``DLROVER_LOCK_WITNESS=1``, :func:`install` (or
+:func:`maybe_install` at a runtime entry point) wraps
+``threading.Lock``/``threading.RLock`` **creation** so every lock
+minted by an instrumented package afterwards records, process-wide,
+which locks were held when it was acquired. An observed edge ``A→B``
+with an already-witnessed path ``B→…→A`` is an *inversion* — the
+interleaving that deadlocks exists, whether or not this run hit it.
+
+Pure stdlib, like the rest of the analysis package: importing (and
+running) the witness never touches jax or any runtime module.
+
+Knobs (registered in ``common/constants.py::ENV_KNOBS``):
+
+- ``DLROVER_LOCK_WITNESS``      — truthy: ``maybe_install`` installs.
+- ``DLROVER_LOCK_WITNESS_LOG``  — JSONL path: one line per new edge
+  and per inversion (post-mortem food).
+- ``DLROVER_LOCK_WITNESS_MODE`` — ``report`` (default: count, log) or
+  ``raise`` (raise :class:`LockOrderInversion` in the acquiring
+  thread — the sanitizer-under-test shape).
+
+Locks are named by creation site (``module:lineno``): two instances
+minted at the same site share a name, which is exactly the order
+*discipline* the graph checks (same-site self-edges are ignored — the
+per-instance order of sibling objects is the static pass's
+blocking-under-lock territory). Locks created *before* install (module
+globals of already-imported modules) stay raw: install early.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockOrderInversion",
+    "install",
+    "uninstall",
+    "maybe_install",
+    "reset",
+    "stats",
+    "installed",
+]
+
+
+class LockOrderInversion(RuntimeError):
+    """Acquiring this lock creates a cycle in the observed order."""
+
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+# witness-internal state guard: ALWAYS a raw lock (never witnessed)
+_state_lock = _ORIG_LOCK()
+_installed = False
+_packages: Tuple[str, ...] = ()
+_mode = "report"
+_log_path: Optional[str] = None
+
+# thread ident -> held _WitnessLocks, guarded by _state_lock. NOT a
+# threading.local: threading.Lock permits cross-thread release (the
+# gateway's async rollout acquires in the handler thread and releases
+# in the rollout thread), so release must be able to clean up the
+# ACQUIRER's stack from any thread.
+_holds_by_thread: Dict[int, List["_WitnessLock"]] = {}
+_edges: Dict[Tuple[str, str], int] = {}  # (a, b) -> observation count
+_graph: Dict[str, Set[str]] = {}  # adjacency over lock names
+_inversions: List[Dict] = []
+_lock_count = 0
+
+
+def _log_line(payload: Dict) -> None:
+    if not _log_path:
+        return
+    try:
+        with open(_log_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(payload) + "\n")
+    except OSError:
+        pass  # the witness must never take the runtime down over a log
+
+
+def _path_exists(src: str, dst: str) -> bool:
+    """DFS: is there a witnessed path src -> ... -> dst? (graph is
+    small: one node per lock creation site)"""
+    seen = {src}
+    stack = [src]
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        for nxt in _graph.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+def _on_acquired(lock: "_WitnessLock") -> None:
+    tid = threading.get_ident()
+    tname = threading.current_thread().name
+    inversion: Optional[Dict] = None
+    new_edges: List[Dict] = []
+    with _state_lock:
+        held = _holds_by_thread.setdefault(tid, [])
+        for h in held:
+            if h.name == lock.name:
+                continue  # re-entrant / same-site sibling
+            key = (h.name, lock.name)
+            first = key not in _edges
+            _edges[key] = _edges.get(key, 0) + 1
+            if first:
+                # inversion iff the REVERSE order was already witnessed
+                if _path_exists(lock.name, h.name):
+                    inversion = {
+                        "type": "inversion",
+                        "edge": [h.name, lock.name],
+                        "thread": tname,
+                        "ts": time.time(),
+                    }
+                    _inversions.append(inversion)
+                _graph.setdefault(h.name, set()).add(lock.name)
+                _graph.setdefault(lock.name, set())
+                new_edges.append(
+                    {
+                        "type": "edge",
+                        "edge": [h.name, lock.name],
+                        "thread": tname,
+                        "ts": time.time(),
+                    }
+                )
+        held.append(lock)
+        lock._owner_stack.append(tid)
+    # file I/O OUTSIDE the state lock: the witness must not serialize
+    # every acquisition process-wide behind a disk write
+    for e in new_edges:
+        _log_line(e)
+    if inversion is not None:
+        _log_line(inversion)
+        if _mode == "raise":
+            raise LockOrderInversion(
+                f"lock-order inversion: acquired {lock.name} while "
+                f"holding {inversion['edge'][0]}, but the reverse order "
+                "was already witnessed — two threads interleaving these "
+                "paths deadlock"
+            )
+
+
+def _on_released(lock: "_WitnessLock") -> None:
+    with _state_lock:
+        # cross-thread release: clean up the ACQUIRER's stack, not the
+        # releasing thread's (threading.Lock permits handoff release)
+        owner = (
+            lock._owner_stack.pop()
+            if lock._owner_stack
+            else threading.get_ident()
+        )
+        held = _holds_by_thread.get(owner)
+        if held:
+            # release order may differ from acquire order
+            # (Condition.wait): drop the LAST occurrence of this lock
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is lock:
+                    del held[i]
+                    break
+            if not held:
+                del _holds_by_thread[owner]
+
+
+class _WitnessLock:
+    """Order-witnessing wrapper over a real Lock/RLock."""
+
+    # Condition must NOT find these on the wrapper: without them it
+    # falls back to calling our acquire/release, which keeps the
+    # witness's held-stack honest across cond.wait()
+    _BLOCKED = ("_release_save", "_acquire_restore", "_is_owned")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+        # thread idents that currently hold this lock, in acquire
+        # order (guarded by _state_lock) — lets a cross-thread release
+        # find the acquirer's held stack
+        self._owner_stack: List[int] = []
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            try:
+                _on_acquired(self)
+            except LockOrderInversion:
+                # raise-mode: hand the lock back before raising, or the
+                # sanitizer's own report wedges every waiter behind us
+                _on_released(self)
+                self._inner.release()
+                raise
+        return got
+
+    def release(self):
+        _on_released(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, item):
+        if item in _WitnessLock._BLOCKED:
+            raise AttributeError(item)
+        return getattr(self._inner, item)
+
+    def __repr__(self):
+        return f"<WitnessLock {self.name} over {self._inner!r}>"
+
+
+def _caller_site() -> Tuple[str, int]:
+    f = sys._getframe(2)
+    return f.f_globals.get("__name__", "?"), f.f_lineno
+
+
+def _should_instrument(module: str) -> bool:
+    if module.startswith("dlrover_tpu.analysis"):
+        return False  # never witness the witness (or the lint suite)
+    return any(
+        module == p or module.startswith(p + ".") for p in _packages
+    )
+
+
+def _witness_lock_factory():
+    module, lineno = _caller_site()
+    inner = _ORIG_LOCK()
+    if not _should_instrument(module):
+        return inner
+    global _lock_count
+    with _state_lock:
+        _lock_count += 1
+    return _WitnessLock(inner, f"{module}:{lineno}")
+
+
+def _witness_rlock_factory():
+    module, lineno = _caller_site()
+    inner = _ORIG_RLOCK()
+    if not _should_instrument(module):
+        return inner
+    global _lock_count
+    with _state_lock:
+        _lock_count += 1
+    return _WitnessLock(inner, f"{module}:{lineno}")
+
+
+def install(
+    packages: Tuple[str, ...] = ("dlrover_tpu",),
+    mode: Optional[str] = None,
+    log_path: Optional[str] = None,
+) -> None:
+    """Patch ``threading.Lock``/``RLock`` so locks created by
+    ``packages`` from now on are witnessed. Idempotent."""
+    global _installed, _packages, _mode, _log_path
+    _packages = tuple(packages)
+    _mode = (
+        mode
+        or os.environ.get("DLROVER_LOCK_WITNESS_MODE", "report").strip()
+        or "report"
+    )
+    _log_path = log_path or os.environ.get("DLROVER_LOCK_WITNESS_LOG") or None
+    if _installed:
+        return
+    threading.Lock = _witness_lock_factory
+    threading.RLock = _witness_rlock_factory
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the real factories (already-wrapped locks stay wrapped
+    and keep working — they delegate to real locks)."""
+    global _installed
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _installed = False
+
+
+def maybe_install() -> bool:
+    """Install iff ``DLROVER_LOCK_WITNESS`` is truthy. The runtime
+    entry points (pool drill, fleet/pool CLIs) call this so an
+    operator can turn the sanitizer on with one env var."""
+    if os.environ.get("DLROVER_LOCK_WITNESS", "").strip().lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        install()
+        return True
+    return False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Clear observations (not the installation). Call quiescent —
+    held-lock tracking is dropped too."""
+    with _state_lock:
+        _edges.clear()
+        _graph.clear()
+        _holds_by_thread.clear()
+        del _inversions[:]
+        global _lock_count
+        _lock_count = 0
+
+
+def stats() -> Dict:
+    with _state_lock:
+        return {
+            "installed": _installed,
+            "locks": _lock_count,
+            "edges": len(_edges),
+            "acquisitions_with_held": sum(_edges.values()),
+            "inversions": list(_inversions),
+        }

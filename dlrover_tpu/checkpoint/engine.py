@@ -82,7 +82,8 @@ def _process_count() -> int:
         from jax._src import distributed
 
         return int(getattr(distributed.global_state, "num_processes", None) or 1)
-    except Exception:  # noqa: BLE001 — private-module drift
+    except Exception as e:  # noqa: BLE001 — private-module drift
+        logger.debug("jax distributed state unreadable: %r", e)
         return 1
 
 
@@ -233,8 +234,8 @@ class CheckpointEngine:
         for res in (self._factory_q, self._event_q):
             try:
                 res.close()
-            except Exception:  # noqa: BLE001 — old namespace, best effort
-                pass
+            except Exception as e:  # noqa: BLE001 — old namespace, best effort
+                logger.debug("closing old-namespace IPC resource: %r", e)
         self.shm.close()
         os.environ["DLROVER_IPC_NAMESPACE"] = fresh_ns
         self.shm = SharedMemoryHandler(self.host_rank)
@@ -531,8 +532,12 @@ class CheckpointEngine:
                     self.storage.record_persist_error(
                         self.host_rank, step, f"async stage failed: {e!r}"
                     )
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as rec_err:  # noqa: BLE001
+                    logger.warning(
+                        "could not record persist error for step %s: %r",
+                        step,
+                        rec_err,
+                    )
         finally:
             self._shard_lock.release()
         if ok and self._replicate:
@@ -946,7 +951,7 @@ class CheckpointEngine:
         for res in (self._event_q, self._factory_q, self._shard_lock, self.shm):
             try:
                 res.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown, best effort
+                logger.debug("engine close: %r", e)
         if self._standalone:
             AsyncCheckpointSaver.shutdown()

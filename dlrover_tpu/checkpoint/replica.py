@@ -62,7 +62,8 @@ def default_master_client():
         from ..rpc.client import MasterClient
 
         return MasterClient.singleton()
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — master optional for replicas
+        logger.debug("no master client for replica placement: %r", e)
         return None
 
 
@@ -180,7 +181,8 @@ class ReplicaStore:
                     seg.read(HEADER_LEN_BYTES, meta_len).decode()
                 )
                 return meta.step
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — torn header reads as absent
+                logger.debug("replica meta unreadable: %r", e)
                 return None
 
     def close(self) -> None:
@@ -188,8 +190,8 @@ class ReplicaStore:
             for seg in self._segments.values():
                 try:
                     seg.close()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — teardown
+                    logger.debug("replica segment close: %r", e)
             self._segments.clear()
 
     def unlink(self) -> None:
@@ -197,8 +199,8 @@ class ReplicaStore:
             for seg in self._segments.values():
                 try:
                     seg.unlink()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — teardown
+                    logger.debug("replica segment unlink: %r", e)
             self._segments.clear()
             self._sizes.clear()
 
@@ -300,8 +302,8 @@ class ReplicaServer:
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown
+            logger.debug("replica server stop: %r", e)
 
 
 class ReplicaClient:

@@ -279,27 +279,27 @@ class AsyncCheckpointSaver:
         if event_q is not None and thread is not None and thread.is_alive():
             try:
                 event_q.put({"type": CheckpointEvent.EXIT}, timeout=2.0)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — peer may be gone
+                logger.debug("saver exit event not delivered: %r", e)
         if factory_q is not None and thread is not None and thread.is_alive():
             try:
                 factory_q.put({"type": "exit"}, timeout=2.0)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — peer may be gone
+                logger.debug("saver factory exit not delivered: %r", e)
         if thread is not None:
             thread.join(timeout)
         for q in (factory_q, event_q):
             if q is not None:
                 try:
                     q.close()
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — teardown
+                    logger.debug("saver queue close: %r", e)
         if inst is not None:
             inst.shm.close()
             try:
                 inst._shard_lock.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown
+                logger.debug("saver shard lock close: %r", e)
 
     @classmethod
     def _install_signal_handlers(cls) -> None:

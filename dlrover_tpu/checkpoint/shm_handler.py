@@ -43,7 +43,8 @@ def segment_image_size(segment: SharedMemorySegment) -> int:
             segment.read(HEADER_LEN_BYTES, meta_len).decode()
         )
         return HEADER_LEN_BYTES + meta_len + meta.total_bytes
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — torn/absent header reads as empty
+        logger.debug("shm size probe: %r", e)
         return 0
 
 
@@ -98,7 +99,8 @@ def _leaf_records(path: str, leaf) -> List[Tuple[ShardRecord, Any]]:
         spec = []
         try:
             spec = spec_to_jsonable(leaf.sharding.spec)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — exotic sharding: no spec
+            logger.debug("sharding spec not jsonable: %r", e)
             spec = []
         seen_indices = set()
         for shard in leaf.addressable_shards:

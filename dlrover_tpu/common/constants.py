@@ -293,6 +293,9 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_RECOVERY_DIR", doc="MTTR phase-attribution spool directory"),
     EnvKnob("DLROVER_FAULT_PLAN", doc="chaos fault plan (docs/chaos.md grammar)"),
     EnvKnob("DLROVER_FAULT_LOG", doc="chaos injection JSONL log path"),
+    EnvKnob("DLROVER_LOCK_WITNESS", "bool", doc="lock-witness sanitizer: instrument runtime locks (docs/analysis.md)"),
+    EnvKnob("DLROVER_LOCK_WITNESS_LOG", doc="lock-witness JSONL log path (edges + inversions)"),
+    EnvKnob("DLROVER_LOCK_WITNESS_MODE", doc="lock-witness on inversion: report (default) or raise"),
     EnvKnob("DLROVER_CKPT_SAVER_TIMEOUT_S", "float", doc="saver-IPC wedge timeout before standalone fallback"),
     EnvKnob("DLROVER_INPUT_PREFETCH", "bool", doc="double-buffered input pipeline on/off", context_field="input_prefetch"),
     EnvKnob("DLROVER_COMPILE_CACHE_DIR", doc="persistent XLA compile cache directory", context_field="compile_cache_dir"),

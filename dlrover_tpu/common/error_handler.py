@@ -85,6 +85,7 @@ class ErrorHandler:
                         error=summary[:500],
                         frame=_last_app_frame(exc_tb),
                     )
+                # tpulint: ignore[exception-swallow] inside the excepthook: anything raised (or logged, which can raise) here masks the real crash
                 except Exception:  # noqa: BLE001
                     pass
                 self.flush_all()
@@ -102,6 +103,7 @@ class ErrorHandler:
                 global_emitter().instant(
                     "fatal_signal", signum=int(signum)
                 )
+            # tpulint: ignore[exception-swallow] inside a fatal-signal handler: logging is not async-signal-safe and must not mask the signal path
             except Exception:  # noqa: BLE001
                 pass
             self.flush_all()

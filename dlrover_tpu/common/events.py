@@ -79,8 +79,8 @@ class TextFileExporter(Exporter):
     def close(self) -> None:
         try:
             self._file.close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown
+            logger.debug("event file close: %r", e)
 
 
 class AsyncExporter(Exporter):
@@ -109,8 +109,9 @@ class AsyncExporter(Exporter):
                 break
             try:
                 self._inner.export(event)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — exporter must outlive sinks
+                self._dropped += 1
+                logger.debug("event export failed: %r", e)
 
     def close(self) -> None:
         try:

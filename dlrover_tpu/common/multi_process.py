@@ -549,8 +549,8 @@ def _sweep_uncloseable() -> None:
                 shm.close()
             except BufferError:
                 still.append(shm)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — sweep, best effort
+                logger.debug("deferred shm close: %r", e)
         _UNCLOSEABLE[:] = still
 
 
@@ -574,8 +574,8 @@ class SharedMemorySegment:
         # by the agent through unlink(), so always untrack.
         try:
             resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — tracker impl varies by platform
+            logger.debug("resource tracker unregister: %r", e)
 
     @staticmethod
     def _posix_unlink(shm: shared_memory.SharedMemory) -> None:
@@ -672,8 +672,8 @@ class SharedMemorySegment:
         except BufferError:
             with _UNCLOSEABLE_LOCK:
                 _UNCLOSEABLE.append(shm)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — teardown
+            logger.debug("shm close: %r", e)
 
     def close(self) -> None:
         if self._shm is not None:

@@ -27,6 +27,9 @@ __all__ = ["main"]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from ..analysis.witness import maybe_install
+
+    maybe_install()  # DLROVER_LOCK_WITNESS=1 -> sanitize lock order
     ap = argparse.ArgumentParser(
         prog="tpurun-fleet",
         description="elastic serving fleet: replica supervisor + "

@@ -381,6 +381,17 @@ class Gateway:
                 self._http_thread.join(timeout=10)
 
 
+def _http_error_detail(e: "urllib.error.HTTPError") -> Dict:
+    """The replica's own JSON verdict, or a synthesized one when the
+    error body is unreadable/not JSON (the synthesized detail keeps
+    the parse failure — an opaque 502 was PR 7's route-drift blind
+    spot)."""
+    try:
+        return json.loads(e.read())
+    except Exception as body_err:  # noqa: BLE001 — verdict body optional
+        return {"error": str(e), "detail_unreadable": repr(body_err)[:200]}
+
+
 def _make_handler(gw: Gateway):
     from ..common.http import JsonRequestHandler
 
@@ -436,11 +447,7 @@ def _make_handler(gw: Gateway):
                 return
             except urllib.error.HTTPError as e:
                 # the replica's own verdict (400 bad prompt, ...)
-                try:
-                    detail = json.loads(e.read())
-                except Exception:  # noqa: BLE001
-                    detail = {"error": str(e)}
-                self._send(e.code, detail)
+                self._send(e.code, _http_error_detail(e))
                 return
             except Exception as e:  # noqa: BLE001
                 self._send(500, {"error": repr(e)[:200]})
@@ -478,11 +485,7 @@ def _make_handler(gw: Gateway):
                 except urllib.error.HTTPError as e:
                     # on-demand prefix registration got the replica's
                     # verdict — forward it, don't drop the socket
-                    try:
-                        detail = json.loads(e.read())
-                    except Exception:  # noqa: BLE001
-                        detail = {"error": str(e)}
-                    self._send(e.code, detail)
+                    self._send(e.code, _http_error_detail(e))
                     return
                 except Exception as e:  # noqa: BLE001
                     self._send(503, {"error": repr(e)[:200]})
@@ -498,11 +501,7 @@ def _make_handler(gw: Gateway):
                         req, timeout=gw.cfg.request_timeout_s
                     )
                 except urllib.error.HTTPError as e:
-                    try:
-                        detail = json.loads(e.read())
-                    except Exception:  # noqa: BLE001
-                        detail = {"error": str(e)}
-                    self._send(e.code, detail)
+                    self._send(e.code, _http_error_detail(e))
                     return
                 except Exception as e:  # noqa: BLE001
                     self._send(503, {"error": repr(e)[:200]})
@@ -549,11 +548,7 @@ def _make_handler(gw: Gateway):
             try:
                 pid = gw.register_prefix(tokens)
             except urllib.error.HTTPError as e:
-                try:
-                    detail = json.loads(e.read())
-                except Exception:  # noqa: BLE001
-                    detail = {"error": str(e)}
-                self._send(e.code, detail)
+                self._send(e.code, _http_error_detail(e))
                 return
             except NoReadyReplica as e:
                 self._send(503, {"error": str(e)})

@@ -262,7 +262,8 @@ class ReplicaSupervisor:
                     timeout=self.cfg.health_timeout_s,
                 ) as r:
                     stats = json.loads(r.read())
-            except Exception:  # noqa: BLE001 — dead already: just reap
+            except Exception as e:  # noqa: BLE001 — dead already: just reap
+                logger.debug("drain poll of %s ended: %r", h.rid, e)
                 break
             if (
                 stats.get("busy_slots") == 0

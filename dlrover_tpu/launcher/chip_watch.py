@@ -478,8 +478,8 @@ def _retry_failed_sections(parsed, env, bench_cmd, bench_timeout,
             _kill_group(p.pid)
             try:
                 out, _ = p.communicate(timeout=10)
-            except Exception:  # noqa: BLE001 — group is dead
-                out = ""
+            except Exception as comm_err:  # noqa: BLE001 — group is dead
+                out = f"(no output: communicate after kill failed: {comm_err!r})"
             _reap_orphan_workers()
     except OSError as e:
         out = f"retry spawn failed: {e!r}"
@@ -569,8 +569,8 @@ def capture_silicon(log_path, bench_timeout):
             _kill_group(p.pid)
             try:
                 out, _err2 = p.communicate(timeout=10)
-            except Exception:  # noqa: BLE001 — group is dead
-                out = ""
+            except Exception as comm_err:  # noqa: BLE001 — group is dead
+                out = f"(no output: communicate after kill failed: {comm_err!r})"
             _reap_orphan_workers()
             err = f"BENCH TIMEOUT after {bench_timeout}s"
             rc = -9

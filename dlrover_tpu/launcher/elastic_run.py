@@ -331,7 +331,8 @@ def merge_elastic_config_from_master(
     """Master-side overrides win over CLI defaults (reference :408-447)."""
     try:
         run_config = client.get_elastic_run_config()
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — master overrides are optional
+        logger.debug("no master run-config overrides: %r", e)
         return
     if not run_config:
         return

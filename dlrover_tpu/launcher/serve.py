@@ -177,7 +177,8 @@ class ServingDaemon:
         """Abort a request by uid (streaming clients that disconnect)."""
         try:
             return self._submit_item("cancel_uid", uid, timeout)
-        except Exception:  # noqa: BLE001 — daemon stopping
+        except Exception as e:  # noqa: BLE001 — daemon stopping
+            logger.debug("cancel of uid=%s not delivered: %r", uid, e)
             return False
 
     def register_prefix(self, tokens, timeout: float = 60.0) -> int:

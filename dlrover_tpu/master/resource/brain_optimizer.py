@@ -198,6 +198,6 @@ class BrainReporter:
                 node_unit=self._node_unit,
                 status=status,
             )
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — final report, brain may be gone
+            logger.warning("final brain report failed: %r", e)
         self._thread = None

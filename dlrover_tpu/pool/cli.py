@@ -144,6 +144,9 @@ def _cmd_serve(ns, overrides) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from ..analysis.witness import maybe_install
+
+    maybe_install()  # DLROVER_LOCK_WITNESS=1 -> sanitize lock order
     ap = argparse.ArgumentParser(
         prog="tpurun-pool",
         description="chip-pool arbiter: SLO-driven co-scheduling of "

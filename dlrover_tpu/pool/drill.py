@@ -64,7 +64,8 @@ def _no_persistent_compile_cache():
     try:
         import jax
         from jax._src import compilation_cache as cc
-    except Exception:  # noqa: BLE001 — no jax (synthetic mode)
+    except Exception as e:  # noqa: BLE001 — no jax (synthetic mode)
+        logger.debug("compile cache scope skipped (no jax): %r", e)
         yield
         return
     prev = jax.config.jax_compilation_cache_dir
@@ -310,6 +311,9 @@ def run_traffic_spike_drill(
     section, ``tpurun-pool drill``, and the e2e test all run THIS
     function — the docs/pool.md numbers are reproducible from any of
     them."""
+    from ..analysis.witness import maybe_install
+
+    maybe_install()  # DLROVER_LOCK_WITNESS=1 -> sanitize lock order
     workdir = workdir or tempfile.mkdtemp(prefix="pool_drill_")
     t_drill0 = time.monotonic()
     deadline = t_drill0 + timeout_s

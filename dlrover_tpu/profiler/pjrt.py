@@ -112,8 +112,8 @@ def _non_tpu_platform_pin() -> str:
             import jax
 
             pin = jax.config.jax_platforms or pin
-        except Exception:  # noqa: BLE001 — config introspection only
-            pass
+        except Exception as e:  # noqa: BLE001 — config introspection only
+            logger.debug("jax platform pin unreadable: %r", e)
     return pin if _pin_excludes_tpu(pin) else ""
 
 

@@ -320,6 +320,7 @@ def _record_crash(exc_type, exc) -> None:
     try:
         t = _CRASH_TIMER or TpuTimer.singleton()
         t.record(f"host_crash_{exc_type.__name__}", KIND_OTHER, _now_us(), 1)
+    # tpulint: ignore[exception-swallow] crash hook: a failing record (or a logging call that raises) must never mask the crash being recorded
     except Exception:  # noqa: BLE001 — never mask the real crash
         pass
 

@@ -61,7 +61,8 @@ class k8sClient:
         require_k8s()
         try:
             k8s_config.load_incluster_config()
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — standard out-of-cluster fallback
+            logger.debug("not in-cluster (%r); using kubeconfig", e)
             k8s_config.load_kube_config()
         self.namespace = namespace
         self.core = k8s_api.CoreV1Api()
@@ -165,7 +166,8 @@ class k8sClient:
             return self.custom.get_namespaced_custom_object(
                 group, version, self.namespace, plural, name
             )
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — absent object reads as None
+            logger.debug("custom object %s/%s unreadable: %r", plural, name, e)
             return None
 
     def list_custom_objects(

@@ -15,7 +15,6 @@ the genuine module unchanged).
 """
 
 import subprocess
-import time
 from typing import Any, Dict, List, Optional
 
 from ..common.log import logger
@@ -57,23 +56,13 @@ class AgentActor:
         return self._proc.poll()
 
     def stop(self, grace_s: float = 5.0) -> int:
-        import os
-        import signal
+        from ..common.proc import kill_process_group
 
-        if self._proc.poll() is None:
-            try:
-                os.killpg(self._proc.pid, signal.SIGTERM)
-            except OSError:
-                pass
-            deadline = time.time() + grace_s
-            while time.time() < deadline and self._proc.poll() is None:
-                time.sleep(0.1)
-            if self._proc.poll() is None:
-                try:
-                    os.killpg(self._proc.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-        return self._proc.poll() if self._proc.poll() is not None else -9
+        # SIGTERM -> grace -> SIGKILL, and REAP: the old inline loop
+        # polled but never waited, leaving a zombie per stopped actor
+        kill_process_group(self._proc, grace_s=grace_s)
+        rc = self._proc.poll()
+        return rc if rc is not None else -9
 
     def pid(self) -> int:
         return self._proc.pid
@@ -159,7 +148,8 @@ class RayClient:
             logger.warning("ray actor %s did not stop gracefully", name)
         try:
             self._ray.kill(handle)
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            logger.warning("ray.kill(%s) failed: %r", name, e)
             return False
         logger.info("killed ray actor %s", name)
         return True
@@ -171,7 +161,8 @@ class RayClient:
             return ("absent", None)
         try:
             rc = self._ray.get(handle.poll.remote(), timeout=timeout)
-        except Exception:  # noqa: BLE001 — dead/unreachable actor
+        except Exception as e:  # noqa: BLE001 — dead/unreachable actor
+            logger.debug("actor %s poll failed: %r", name, e)
             return ("absent", None)
         return ("alive", None) if rc is None else ("exited", rc)
 

@@ -39,7 +39,8 @@ def jax_device_stats() -> DeviceStats:
     for idx, dev in enumerate(jax.local_devices()):
         try:
             stats = dev.memory_stats() or {}
-        except Exception:  # noqa: BLE001 — per-device, best effort
+        except Exception as e:  # noqa: BLE001 — per-device, best effort
+            logger.debug("memory_stats on device %s: %r", idx, e)
             stats = {}
         used = float(stats.get("bytes_in_use", 0)) / 1e6
         limit = float(stats.get("bytes_limit", 0)) / 1e6
@@ -58,7 +59,8 @@ class _BusyCounter:
             from ..profiler.pjrt import metrics_text, parse_metrics
 
             gauges = parse_metrics(metrics_text())
-        except Exception:  # noqa: BLE001 — profiler optional
+        except Exception as e:  # noqa: BLE001 — profiler optional
+            logger.debug("tpu timer gauges unavailable: %r", e)
             return None
         for fam in self._FAMILIES:
             count = gauges.get(f'tpu_timer_count{{kind="{fam}"}}')
@@ -130,7 +132,8 @@ class DeviceMonitor:
         if self._host_usage is not None:
             try:
                 cpu, host_mem = self._host_usage()
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                logger.debug("host usage probe failed: %r", e)
                 cpu, host_mem = (None, None)
         try:
             client.report_resource_usage(
@@ -166,7 +169,7 @@ class DeviceMonitor:
         # Prime the busy counter so the first report has a real delta.
         try:
             self.sample()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — priming only
+            logger.debug("monitor priming sample failed: %r", e)
         while not self._stopped.wait(self._interval):
             self.report_once()

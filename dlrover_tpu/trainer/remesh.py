@@ -46,7 +46,8 @@ def _jax_distributed_initialized() -> bool:
         from jax._src import distributed
 
         return getattr(distributed.global_state, "client", None) is not None
-    except Exception:  # noqa: BLE001 — private-module drift
+    except Exception as e:  # noqa: BLE001 — private-module drift
+        logger.debug("jax distributed state unreadable: %r", e)
         return False
 
 

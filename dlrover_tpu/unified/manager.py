@@ -298,13 +298,13 @@ class PrimeManager:
             for handle in self._sub_masters.values():
                 try:
                     handle.stop()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 — keep stopping the rest
+                    logger.warning("sub-master stop failed: %r", e)
             self._sub_masters.clear()
             try:
                 self.comm_service.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown
+                logger.warning("comm service stop failed: %r", e)
             self.status = status
             self._save_state()
 

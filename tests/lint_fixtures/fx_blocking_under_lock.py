@@ -26,7 +26,7 @@ class Wedgeable:
             def runner():
                 time.sleep(1.0)
 
-            t = threading.Thread(target=runner)
+            t = threading.Thread(target=runner, daemon=True)
         t.start()
         # timed waits are bounded — not flagged
         with self._lock:

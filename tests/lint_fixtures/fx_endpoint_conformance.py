@@ -1,0 +1,39 @@
+"""Planted endpoint-conformance violation: a client path with no
+registered handler (the gateway/pool route-drift class).
+
+Parsed by tests/test_lint.py, never imported. Routes use an ``/fx/``
+prefix so the real repo's docs can never accidentally "document" them.
+"""
+
+import json
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/fx/registered":
+            self.send_response(200)
+        elif self.path == "/fx/dead-route":  # tpulint: ignore[endpoint-conformance] fixture: suppressed-twin dead surface
+            self.send_response(200)
+        elif self.path.startswith("/fx/tree/"):
+            self.send_response(200)
+        else:
+            self.send_response(404)
+
+
+class Client:
+    def __init__(self, base_url):
+        self.base_url = base_url
+
+    def ok_exact(self):
+        return urllib.request.urlopen(self.base_url + "/fx/registered")
+
+    def ok_under_prefix(self):
+        return urllib.request.urlopen(self.base_url + "/fx/tree/leaf")
+
+    def drifted(self):
+        # the planted violation: no handler registers this path
+        return json.loads(
+            urllib.request.urlopen(self.base_url + "/fx/drifted").read()
+        )

@@ -1,0 +1,62 @@
+"""Planted exception-swallow violation: a broad handler that erases
+the failure (the poisoned-grant class).
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class Ledger:
+    def __init__(self):
+        self.failures = 0
+
+    def bad(self):
+        try:
+            self._apply()
+        except Exception:  # the planted violation: the failure vanishes
+            pass
+
+    def suppressed(self):
+        try:
+            self._apply()
+        except Exception:  # tpulint: ignore[exception-swallow] fixture: deliberate drop with a written reason
+            pass
+
+    # -- each of the sanctioned handlings -----------------------------
+
+    def fine_logs(self):
+        try:
+            self._apply()
+        except Exception:
+            logger.warning("apply failed")
+
+    def fine_reraises(self):
+        try:
+            self._apply()
+        except Exception:
+            raise
+
+    def fine_counts(self):
+        try:
+            self._apply()
+        except Exception:
+            self.failures += 1
+
+    def fine_uses_exception(self):
+        try:
+            self._apply()
+        except Exception as e:
+            self.last_error = str(e)
+
+    def fine_narrow(self):
+        # naming the type is a statement of intent: out of scope
+        try:
+            self._apply()
+        except OSError:
+            pass
+
+    def _apply(self):
+        raise RuntimeError("boom")

@@ -1,0 +1,57 @@
+"""Planted lock-order violation: an ABBA cycle, one arm through a
+same-module call edge (the PR 8 arbiter-vs-drain shape).
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import threading
+
+
+class Arbiter:
+    def __init__(self):
+        self._step_lock = threading.Lock()
+        self._ledger_lock = threading.Lock()
+        # the suppressed twin's pair
+        self._journal_lock = threading.Lock()
+        self._ring_lock = threading.Lock()
+
+    # -- the planted cycle: step -> ledger (via a call), ledger -> step
+
+    def step(self):
+        with self._step_lock:
+            self._touch_ledger()  # call edge: step_lock -> ledger_lock
+
+    def _touch_ledger(self):
+        with self._ledger_lock:
+            pass
+
+    def drain_done(self):
+        with self._ledger_lock:
+            with self._step_lock:  # reverse order: the cycle closes
+                pass
+
+    # -- the suppressed twin: same shape, reasoned away
+
+    def journal(self):
+        with self._journal_lock:
+            # the cycle is reported at its first edge — this line
+            # tpulint: ignore[lock-order] fixture: suppressed-twin cycle
+            with self._ring_lock:
+                pass
+
+    def ring_flush(self):
+        with self._ring_lock:
+            with self._journal_lock:
+                pass
+
+    # -- fine: consistent order everywhere is no cycle
+
+    def consistent_a(self):
+        with self._step_lock:
+            with self._journal_lock:
+                pass
+
+    def consistent_b(self):
+        with self._step_lock:
+            with self._journal_lock:
+                pass

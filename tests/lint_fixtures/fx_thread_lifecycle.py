@@ -1,0 +1,48 @@
+"""Planted thread-lifecycle violation: a non-daemon thread nobody
+joins (the 100-thread faulthandler-truncation class).
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+import subprocess
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        # the planted violation: non-daemon, never joined in this file
+        self._leaked = threading.Thread(target=lambda: None)
+        # the suppressed twin: handed to another module for reaping
+        self._handed_off = subprocess.Popen(["true"])  # tpulint: ignore[thread-lifecycle] fixture: reaped by the harness
+
+    def fine_daemon(self):
+        t = threading.Thread(target=lambda: None, daemon=True)
+        t.start()
+
+    def fine_daemonized_later(self):
+        t = threading.Thread(target=lambda: None)
+        t.daemon = True
+        t.start()
+
+
+class Clean:
+    def __init__(self):
+        self._t = threading.Thread(target=lambda: None)
+        self._proc = subprocess.Popen(["true"])
+        self._pool = []
+        self._pool.append(threading.Thread(target=lambda: None))
+
+    def stop(self):
+        self._t.join(timeout=5.0)
+        self._proc.kill()
+        for t in self._pool:
+            t.join(timeout=5.0)
+
+
+def fine_escapes_to_reaper():
+    proc = subprocess.Popen(["true"])
+    _reap_group(proc)
+
+
+def _reap_group(proc):
+    proc.wait()

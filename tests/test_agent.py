@@ -453,6 +453,28 @@ class TestWarmSpare:
         # the whole point: handoff->exit must beat a cold python start
         assert warm_latency < 5.0, warm_latency
 
+    def test_kill_reaps_the_spare_no_zombie(self, tmp_path):
+        """PR 9 thread-lifecycle finding: kill() SIGKILLed the group
+        but never wait()ed — every killed spare left a zombie holding
+        its pid-table slot for the agent's lifetime."""
+        from dlrover_tpu.agent.worker import WarmSpare
+
+        script = tmp_path / "train.py"
+        script.write_text("print('ok')\n")
+        spec = WorkerSpec(entrypoint=str(script))
+        spare = WarmSpare(spec, tag="z")
+        assert spare.wait_ready(timeout=30), "spare never became ready"
+        spare.kill()
+        # reaped: returncode collected, and /proc no longer shows a
+        # zombie ('Z') for the pid
+        assert spare.proc.returncode is not None
+        stat = f"/proc/{spare.proc.pid}/stat"
+        if os.path.exists(stat):  # pid not reused yet
+            with open(stat, "rb") as f:
+                data = f.read()
+            state = data[data.rindex(b")") + 2 :].split()[0]
+            assert state != b"Z", "killed spare left a zombie"
+
     def test_unready_spare_falls_back_cold(self, tmp_path):
         from dlrover_tpu.agent.worker import WarmSpare, WorkerProcess
 

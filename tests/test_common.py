@@ -157,6 +157,26 @@ class TestEvents:
         async_exp.close()
         assert len(exp.events) == 100
 
+    def test_async_exporter_counts_inner_export_failures(self):
+        """PR 9 exception-swallow finding: a sink that throws silently
+        ate events — now they count as dropped (the exporter still
+        outlives the sink)."""
+
+        class BoomExporter(_ListExporter):
+            def export(self, event):
+                if len(self.events) >= 2:
+                    raise RuntimeError("sink died")
+                super().export(event)
+
+        exp = BoomExporter()
+        async_exp = AsyncExporter(exp)
+        em = EventEmitter("test", exporter=async_exp)
+        for i in range(5):
+            em.instant("e", i=i)
+        async_exp.close()
+        assert len(exp.events) == 2
+        assert async_exp._dropped == 3
+
 
 class TestSerializeEscaping:
     def test_plain_dict_with_reserved_key(self):

@@ -324,6 +324,33 @@ class TestSupervisor:
 # ---------------------------------------------------------------------------
 
 
+class TestHttpErrorDetail:
+    """PR 9: the 4 copies of the error-body parser folded into
+    gateway._http_error_detail — the replica's JSON verdict passes
+    through, and an unreadable body keeps BOTH failures."""
+
+    def _err(self, code, body):
+        import io
+        import urllib.error
+
+        return urllib.error.HTTPError(
+            "http://x/v1/completions", code, "nope", {}, io.BytesIO(body)
+        )
+
+    def test_json_verdict_passes_through(self):
+        from dlrover_tpu.fleet.gateway import _http_error_detail
+
+        d = _http_error_detail(self._err(400, b'{"error": "bad prompt"}'))
+        assert d == {"error": "bad prompt"}
+
+    def test_unreadable_body_keeps_both_failures(self):
+        from dlrover_tpu.fleet.gateway import _http_error_detail
+
+        d = _http_error_detail(self._err(502, b"<html>oops</html>"))
+        assert "502" in d["error"]
+        assert "detail_unreadable" in d
+
+
 class TestGatewayRouting:
     def test_least_loaded_routing_spreads_load(self):
         sup, gw, _ = _stub_fleet(2)

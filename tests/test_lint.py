@@ -17,11 +17,15 @@ from dlrover_tpu.analysis.passes import (
     ALL_PASSES,
     PASS_BY_ID,
     blocking_under_lock,
+    endpoint_conformance,
     env_knobs,
+    exception_swallow,
     host_sync,
     import_purity,
     injection_coverage,
+    lock_order,
     rpc_deadline,
+    thread_lifecycle,
 )
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -107,6 +111,45 @@ class TestPassesFireOnFixtures:
         assert not r.violations  # the site IS suppressed...
         assert r.errors and "needs a reason" in r.errors[0]
         assert not r.clean  # ...but the bare ignore fails the run
+
+    def test_lock_order_fires_through_call_edge(self):
+        r = _run(_fx("fx_lock_order.py"), lock_order)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        v = r.violations[0]
+        assert v.pass_id == "lock-order"
+        assert v.code.startswith("cycle:")
+        # one arm of the planted cycle goes through self._touch_ledger()
+        assert "_step_lock" in v.message and "_ledger_lock" in v.message
+        # the suppressed-twin cycle (journal/ring) and only it
+        assert len(r.suppressed) == 1
+        assert "_journal_lock" in r.suppressed[0][0].message
+        assert not r.errors
+
+    def test_thread_lifecycle_fires(self):
+        r = _run(_fx("fx_thread_lifecycle.py"), thread_lifecycle)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        assert "_leaked" in r.violations[0].message
+        # the suppressed twin is the handed-off Popen
+        assert len(r.suppressed) == 1
+        assert "Popen" in r.suppressed[0][0].message
+        assert not r.errors
+
+    def test_exception_swallow_fires(self):
+        r = _run(_fx("fx_exception_swallow.py"), exception_swallow)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        assert "swallows" in r.violations[0].message
+        assert len(r.suppressed) == 1
+        assert not r.errors
+
+    def test_endpoint_conformance_fires(self):
+        r = _run(_fx("fx_endpoint_conformance.py"), endpoint_conformance)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        assert r.violations[0].code == "client:/fx/drifted"
+        # the dead route is the suppressed twin; the exact and
+        # under-prefix clients are conformant
+        assert len(r.suppressed) == 1
+        assert r.suppressed[0][0].code == "route:/fx/dead-route"
+        assert not r.errors
 
 
 class TestInjectionCoveragePass:
@@ -280,6 +323,339 @@ class TestSuppressionForms:
         )
         r = _run(str(p), blocking_under_lock)
         assert len(r.violations) == 1 and not r.suppressed
+
+
+class TestLockOrderMachinery:
+    def test_closure_edges_participate(self, tmp_path):
+        """The PR 8 drain threads are nested defs: a cycle whose second
+        arm lives in a closure must still be found."""
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "    def fwd(self):\n"
+            "        with self._a_lock:\n"
+            "            with self._b_lock:\n"
+            "                pass\n"
+            "    def spawn(self):\n"
+            "        def drain():\n"
+            "            with self._b_lock:\n"
+            "                with self._a_lock:\n"
+            "                    pass\n"
+            "        threading.Thread(target=drain, daemon=True).start()\n"
+        )
+        r = _run(str(p), lock_order)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        assert "cycle" in r.violations[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with _a_lock:\n"
+            "        with _b_lock:\n"
+            "            pass\n"
+        )
+        r = _run(str(p), lock_order)
+        assert not r.violations
+
+    def test_reentrant_same_lock_is_not_a_cycle(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._rlock = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._rlock:\n"
+            "            self.inner()\n"
+            "    def inner(self):\n"
+            "        with self._rlock:\n"
+            "            pass\n"
+        )
+        r = _run(str(p), lock_order)
+        assert not r.violations
+
+    def test_transitive_call_chain_closes_cycle(self, tmp_path):
+        """a held -> call f -> call g -> acquires b; elsewhere b->a."""
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "_a_lock = threading.Lock()\n"
+            "_b_lock = threading.Lock()\n"
+            "def top():\n"
+            "    with _a_lock:\n"
+            "        mid()\n"
+            "def mid():\n"
+            "    leaf()\n"
+            "def leaf():\n"
+            "    with _b_lock:\n"
+            "        pass\n"
+            "def reverse():\n"
+            "    with _b_lock:\n"
+            "        with _a_lock:\n"
+            "            pass\n"
+        )
+        r = _run(str(p), lock_order)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+
+
+class TestThreadLifecycleMachinery:
+    def test_handle_passed_to_reaper_counts(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import subprocess\n"
+            "class C:\n"
+            "    def launch(self):\n"
+            "        self._proc = subprocess.Popen(['true'])\n"
+            "    def stop(self):\n"
+            "        kill_process_group(self._proc, grace_s=5)\n"
+        )
+        r = _run(str(p), thread_lifecycle)
+        assert not r.violations
+
+    def test_killpg_on_pid_is_not_a_reap(self, tmp_path):
+        """The warm-spare bug shape: os.killpg(getpgid(pid)) never
+        waits — the handle itself is unreaped."""
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import os, signal, subprocess\n"
+            "class C:\n"
+            "    def launch(self):\n"
+            "        self._proc = subprocess.Popen(['true'])\n"
+            "    def stop(self):\n"
+            "        os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)\n"
+        )
+        r = _run(str(p), thread_lifecycle)
+        assert len(r.violations) == 1
+        assert "_proc" in r.violations[0].message
+
+    def test_loop_over_container_join_counts(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._threads = []\n"
+            "    def go(self):\n"
+            "        self._threads.append(threading.Thread(target=int))\n"
+            "    def stop(self):\n"
+            "        for t in self._threads:\n"
+            "            t.join(timeout=5)\n"
+        )
+        r = _run(str(p), thread_lifecycle)
+        assert not r.violations
+
+    def test_untimed_join_does_not_satisfy(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def go(self):\n"
+            "        self._t = threading.Thread(target=int)\n"
+            "    def stop(self):\n"
+            "        self._t.join()\n"
+        )
+        r = _run(str(p), thread_lifecycle)
+        assert len(r.violations) == 1
+
+
+class TestExceptionSwallowMachinery:
+    def test_broad_in_tuple_is_flagged(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except (ValueError, Exception):\n"
+            "        pass\n"
+        )
+        r = _run(str(p), exception_swallow)
+        assert len(r.violations) == 1
+
+    def test_handler_in_nested_def_does_not_count(self, tmp_path):
+        """A log call inside a nested def runs later, if ever — the
+        handler still swallows."""
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "import logging\n"
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        def later():\n"
+            "            logging.warning('x')\n"
+            "        keep = later\n"
+        )
+        r = _run(str(p), exception_swallow)
+        assert len(r.violations) == 1
+
+    def test_counter_bump_counts(self, tmp_path):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "def f(stats):\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        stats['fail'] += 1\n"
+        )
+        r = _run(str(p), exception_swallow)
+        assert not r.violations
+
+
+class TestEndpointConformanceMachinery:
+    def _ctx(self, tmp_path, name, source):
+        from dlrover_tpu.analysis.core import FileContext
+
+        p = tmp_path / name
+        p.write_text(source)
+        return FileContext.parse(str(p), name)
+
+    def test_route_referenced_only_by_docs_is_clean(self, tmp_path):
+        server = self._ctx(
+            tmp_path,
+            "server.py",
+            "class H:\n"
+            "    def do_GET(self):\n"
+            "        if self.path == '/fx/status':\n"
+            "            pass\n",
+        )
+        got = list(
+            endpoint_conformance.check_conformance(
+                [server], "curl the `/fx/status` endpoint"
+            )
+        )
+        assert not got
+        got = list(endpoint_conformance.check_conformance([server], ""))
+        assert len(got) == 1 and got[0].code == "route:/fx/status"
+
+    def test_helper_call_path_not_first_arg(self, tmp_path):
+        """The gateway shape: _post_replica(h, '/v1/x', payload)."""
+        client = self._ctx(
+            tmp_path,
+            "client.py",
+            "class C:\n"
+            "    def go(self, h):\n"
+            "        self._post_replica(h, '/fx/x', {})\n",
+        )
+        got = list(endpoint_conformance.check_conformance([client], ""))
+        assert len(got) == 1 and got[0].code == "client:/fx/x"
+
+    def test_fstring_url_tail_collected(self, tmp_path):
+        client = self._ctx(
+            tmp_path,
+            "client.py",
+            "def go(host, port):\n"
+            "    url = f'http://{host}:{port}/fx/poll'\n"
+            "    return url\n",
+        )
+        got = list(endpoint_conformance.check_conformance([client], ""))
+        assert len(got) == 1 and got[0].code == "client:/fx/poll"
+
+    def test_filesystem_paths_are_not_clients(self, tmp_path):
+        client = self._ctx(
+            tmp_path,
+            "client.py",
+            "import os\n"
+            "def go(base_dir):\n"
+            "    return os.path.join(base_dir, '/tmp/x.json')\n",
+        )
+        got = list(endpoint_conformance.check_conformance([client], ""))
+        assert not got
+
+
+class TestChangedMode:
+    def _git_repo(self, tmp_path):
+        import subprocess
+
+        def git(*args):
+            subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+                + list(args),
+                cwd=tmp_path,
+                check=True,
+                capture_output=True,
+            )
+
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        violation = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1)\n"
+        )
+        (pkg / "old.py").write_text(violation)
+        (pkg / "other.py").write_text("X = 1\n")
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        return pkg, violation
+
+    def test_changed_lints_only_changed_files(self, tmp_path, capsys):
+        pkg, violation = self._git_repo(tmp_path)
+        # old.py's committed violation must NOT be reported; the fresh
+        # edit to other.py must be
+        (pkg / "other.py").write_text(violation)
+        rc = lint_main(["--changed", "--no-baseline", str(pkg)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "other.py" in out and "old.py" not in out
+        assert "skips repo-wide passes" in out
+
+    def test_changed_with_no_edits_is_clean(self, tmp_path, capsys):
+        pkg, _ = self._git_repo(tmp_path)
+        rc = lint_main(["--changed", "--no-baseline", str(pkg)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "no Python files changed" in out
+
+    def test_changed_sees_untracked_files(self, tmp_path, capsys):
+        pkg, violation = self._git_repo(tmp_path)
+        (pkg / "fresh.py").write_text(violation)
+        rc = lint_main(["--changed", "--no-baseline", str(pkg)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "fresh.py" in out
+
+    def test_changed_rejects_write_baseline(self, tmp_path, capsys):
+        """A subset run must not silently truncate the repo-wide
+        baseline file."""
+        pkg, _ = self._git_repo(tmp_path)
+        rc = lint_main(
+            [
+                "--changed",
+                "--write-baseline",
+                str(tmp_path / "bl.json"),
+                str(pkg),
+            ]
+        )
+        assert rc == 2
+        assert "cannot be combined" in capsys.readouterr().err
+
+    def test_changed_with_only_repo_passes_is_usage_error(
+        self, tmp_path, capsys
+    ):
+        """--select naming only repo-wide passes + --changed must not
+        exit 0 having checked nothing."""
+        pkg, violation = self._git_repo(tmp_path)
+        (pkg / "other.py").write_text(violation)
+        rc = lint_main(
+            ["--changed", "--select", "endpoint-conformance", str(pkg)]
+        )
+        assert rc == 2
+        assert "no runnable pass" in capsys.readouterr().err
 
 
 class TestReviewRegressions:

@@ -85,3 +85,72 @@ def test_analysis_package_is_jax_free():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+def test_lock_witness_is_jax_free():
+    """The runtime sanitizer must install and witness locks with jax
+    poisoned — it runs inside arbitrary runtime processes, including
+    ones that must never import jax (the agent, the lint CI image)."""
+    import sys
+    import subprocess
+
+    code = (
+        "import sys, threading, types\n"
+        "sys.modules['jax'] = None  # poison: any import attempt dies\n"
+        "from dlrover_tpu.analysis import witness\n"
+        "witness.install()\n"
+        "mod = types.ModuleType('dlrover_tpu._poison_probe')\n"
+        "sys.modules[mod.__name__] = mod\n"
+        "src = ('import threading\\n'\n"
+        "       'def make():\\n'\n"
+        "       '    a = threading.Lock()\\n'\n"
+        "       '    b = threading.Lock()\\n'\n"
+        "       '    return a, b\\n')\n"
+        "exec(compile(src, 'probe.py', 'exec'), mod.__dict__)\n"
+        "a, b = mod.make()\n"
+        "assert type(a).__name__ == '_WitnessLock', type(a)\n"
+        "with a:\n"
+        "    with b:\n"
+        "        pass\n"
+        "s = witness.stats()\n"
+        "assert s['edges'] == 1 and not s['inversions'], s\n"
+        "witness.uninstall()\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_no_new_timestamped_artifacts_tracked():
+    """Repo hygiene: generated probe/diagnosis artifacts are gitignored
+    from PR 9 on — only the ``*_LATEST`` pointers and the numbered
+    ``BENCH_r0*.json`` trajectory files the bench reads stay tracked."""
+    import re
+    import subprocess
+
+    proc = subprocess.run(
+        ["git", "ls-files"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    if proc.returncode != 0:
+        import pytest
+
+        pytest.skip("not a git checkout")
+    timestamped = re.compile(
+        r"^(BENCH_probe_sidecar_\d|SILICON_r\d+_\d|HANG_DIAGNOSIS_r\d+_\d)"
+    )
+    offenders = [
+        f for f in proc.stdout.splitlines() if timestamped.match(f)
+    ]
+    assert not offenders, (
+        "timestamped artifacts tracked (add to .gitignore, git rm "
+        f"--cached): {offenders}"
+    )

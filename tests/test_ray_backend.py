@@ -263,6 +263,21 @@ class TestAgentActorDirect:
         assert rc is not None and rc != 0
         assert actor.poll() is not None
 
+    def test_stop_reaps_no_zombie(self):
+        """PR 9 thread-lifecycle finding: the old inline stop loop
+        polled but never waited — every stopped actor left a zombie."""
+        import os
+
+        actor = AgentActor(SLEEPER, {})
+        actor.stop(grace_s=0.5)
+        assert actor._proc.returncode is not None
+        stat = f"/proc/{actor._proc.pid}/stat"
+        if os.path.exists(stat):  # pid not reused yet
+            with open(stat, "rb") as f:
+                data = f.read()
+            state = data[data.rindex(b")") + 2 :].split()[0]
+            assert state != b"Z", "stopped actor left a zombie"
+
 
 ray_spec = pytest.importorskip  # alias keeps the marker obvious below
 

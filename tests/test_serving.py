@@ -181,6 +181,15 @@ class TestThroughput:
         mixed = _mixed_prompts(24, rng_seed=5, lo=3, hi=14)
         rate_h = run_engine(homog)
         rate_m = run_engine(mixed)
+        if rate_m < 0.8 * rate_h:
+            # Observed once in a full tier-1 run under box
+            # oversubscription (PR 8): a noise burst landing on only
+            # ONE side of the comparison defeats per-side best-of-3.
+            # Re-measure BOTH sides in one fresh window so the pair
+            # shares scheduling conditions; the ratio gate itself is
+            # unchanged and still fails on a real regression.
+            rate_h = run_engine(homog)
+            rate_m = run_engine(mixed)
         assert rate_m >= 0.8 * rate_h, (rate_m, rate_h)
 
 

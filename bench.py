@@ -79,8 +79,9 @@ SECTION_OF_ERROR = {
     "llama_family_error": "llama",
     "longseq_train_error": "longseq",
     "dense_error": "dense",
-    # storm/recovery_ab are NOT here on purpose: a ~minutes-long storm
-    # retry would blow the capture budget; their errors ride the line.
+    # storm/recovery_ab/master_kill are NOT here on purpose: a
+    # minutes-long storm retry would blow the capture budget; their
+    # errors ride the line.
 }
 
 
@@ -286,8 +287,16 @@ _PRIORITY_KEYS = (
     # line has ~130 spare bytes and the per-leg scalars
     # (recovery_{cold,warm}_mttr_s, recovery_cold_compile_s) are
     # recoverable from the sidecar's full recovery_ab dict.
-    "storm_rdzv_s", "storm_restore_s", "storm_compile_s",
-    "storm_first_step_s",
+    # Byte offsets for the master-kill pair below: storm_restore_s and
+    # storm_first_step_s moved sidecar-only (both recoverable from the
+    # full goodput_storm dict the sidecar carries; the phase VERDICT
+    # signal rides on compile_s — the warm-restart claim — and rdzv_s).
+    "storm_rdzv_s", "storm_compile_s",
+    # master crash tolerance (docs/recovery.md master failover): the
+    # coordination-outage MTTR and the productive fraction of the kill
+    # window; the full drill dict (epoch, replay_s, restart audit) is
+    # sidecar-recoverable
+    "master_mttr_s", "master_kill_goodput",
     "recovery_mttr_delta_s", "recovery_warm_compile_s",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
@@ -2411,6 +2420,44 @@ def worker():
                     extra["goodput_storm_error"] = "harness timed out"
             except Exception as e:  # noqa: BLE001
                 extra["goodput_storm_error"] = repr(e)[:200]
+
+        # Master crash tolerance (docs/recovery.md master failover):
+        # SIGKILL the coordinating master mid-storm, restart it against
+        # its state journal, and measure the coordination outage
+        # (master_mttr_s) + the productive step fraction of the kill
+        # window (master_kill_goodput) with ZERO worker restarts. Opted
+        # in with the storm (same minutes-cost class; the trainers are
+        # the storm's CPU-pinned control-plane GPTs).
+        if os.environ.get("DLROVER_BENCH_STORM", "0") == "1" and want(
+            "master_kill"
+        ):
+            try:
+                from dlrover_tpu.chaos import run_master_kill_storm
+
+                mk_dir = tempfile.mkdtemp(prefix="bench_master_kill_")
+                try:
+                    mk = run_master_kill_storm(
+                        mk_dir,
+                        num_workers=2,
+                        job_name=f"bench_master_kill_{os.getpid()}",
+                    )
+                finally:
+                    shutil.rmtree(mk_dir, ignore_errors=True)
+                if mk:
+                    extra["master_kill"] = mk
+                    # priority-key scalars (the full dict rides the
+                    # sidecar under line pressure)
+                    extra["master_mttr_s"] = mk.get("master_mttr_s")
+                    extra["master_kill_goodput"] = mk.get(
+                        "master_kill_goodput"
+                    )
+                    extra["master_kill_worker_restarts"] = mk.get(
+                        "worker_restarts"
+                    )
+                else:
+                    extra["master_kill_error"] = "drill timed out"
+            except Exception as e:  # noqa: BLE001
+                extra["master_kill_error"] = repr(e)[:200]
 
         # Warm-vs-cold recovery A/B (docs/recovery.md): two compressed
         # storms at the IDENTICAL fault plan — the cold leg runs with

@@ -37,6 +37,39 @@ class RendezvousTimeoutError(RuntimeError):
     """The world did not assemble within the configured timeout."""
 
 
+class RendezvousProtocolError(RuntimeError):
+    """The master rejected a rendezvous call for a NON-transient reason
+    (unknown message type / missing handler): a wire-contract bug that
+    no amount of retrying can fix — surfacing it beats burning the whole
+    rdzv deadline on a call that can never succeed."""
+
+
+class MasterRejectedError(ConnectionError):
+    """The master answered but rejected the call transiently — the
+    typical cause is a restarted master that does not (yet) know this
+    node. Recovery is re-REGISTRATION (a fresh join) within the rdzv
+    deadline, not bare re-polling: polling a world the master will never
+    put us in just spins to the timeout."""
+
+
+# Rejections that can never succeed on retry (wire-contract bugs); every
+# other rejection is treated as an unknown-node-after-restart class and
+# answered by re-registration.
+_PROTOCOL_REJECTIONS = ("unknown message",)
+
+
+def _triage_rejection(resp, call: str) -> None:
+    """Classify a master rejection (a BaseResponse instead of the typed
+    reply): protocol bug → RendezvousProtocolError (fatal); anything
+    else → MasterRejectedError (re-register + retry)."""
+    reason = str(getattr(resp, "reason", "") or "")
+    if any(tok in reason for tok in _PROTOCOL_REJECTIONS):
+        raise RendezvousProtocolError(
+            f"master rejected {call} with a protocol error: {reason!r}"
+        )
+    raise MasterRejectedError(f"master rejected {call}: {resp!r}")
+
+
 class RendezvousOutSyncError(RuntimeError):
     """A concurrent rendezvous (node check) has waiters; caller must retry.
 
@@ -127,10 +160,14 @@ class MasterRendezvousHandler:
                 )
                 time.sleep(self._poll_interval)
 
+    def _master_epoch(self) -> int:
+        return getattr(self._client, "master_epoch", 0)
+
     def next_rendezvous(self) -> RendezvousWorld:
         """Join and block until the master completes a world containing us."""
         start = time.time()
         rdzv_round = self._join_retrying(start)
+        joined_epoch = self._master_epoch()
         logger.info(
             "node %s joined rendezvous %s round %s",
             self._node_rank,
@@ -144,13 +181,28 @@ class MasterRendezvousHandler:
                     rdzv_name=self._name, node_rank=self._node_rank
                 )
                 if not hasattr(resp, "world"):
-                    # The master answered but REJECTED the call (e.g. a
-                    # servicer-side drop injection returns a bare error
-                    # response): retriable like a dark master, not a
-                    # crash on the missing .world attribute.
-                    raise ConnectionError(
-                        f"master rejected get_comm_world: {resp!r}"
-                    )
+                    # The master answered but REJECTED the call (a bare
+                    # error response). Triage instead of crashing on the
+                    # missing .world attribute: a protocol error is
+                    # fatal; anything else (a restarted master that does
+                    # not know this node, an injected servicer drop) is
+                    # answered by re-registration below.
+                    _triage_rejection(resp, "get_comm_world")
+            except MasterRejectedError as e:
+                if time.time() - start > self._timeout:
+                    raise RendezvousTimeoutError(
+                        f"rendezvous {self._name} timed out after "
+                        f"{self._timeout}s re-registering: {e!r}"
+                    ) from e
+                logger.warning(
+                    "rendezvous %s rejected (%s); re-registering",
+                    self._name,
+                    e,
+                )
+                time.sleep(self._poll_interval)
+                rdzv_round = self._join_retrying(start)
+                joined_epoch = self._master_epoch()
+                continue
             except _RETRIABLE as e:
                 if time.time() - start > self._timeout:
                     raise RendezvousTimeoutError(
@@ -179,7 +231,23 @@ class MasterRendezvousHandler:
                 if self._name == RendezvousName.TRAINING:
                     world.coordinator = self._elect_coordinator(world)
                 return world
-            if resp.world:
+            # Epoch fence: the master restarted since our join. Joins are
+            # not journaled (only completed worlds are), so unless the
+            # replayed world already contains us — handled above — our
+            # join died with the old master and polling would spin to
+            # the deadline. Re-register with the new incarnation.
+            current_epoch = self._master_epoch()
+            if current_epoch and joined_epoch and current_epoch != joined_epoch:
+                logger.warning(
+                    "master epoch %s -> %s mid-rendezvous; node %s "
+                    "re-registering",
+                    joined_epoch,
+                    current_epoch,
+                    self._node_rank,
+                )
+                rdzv_round = self._join_retrying(start)
+                joined_epoch = self._master_epoch()
+            elif resp.world:
                 # A world completed without us: the master truncated to a
                 # node_unit multiple, or we joined late. Re-join the next
                 # round rather than spinning on a world we are not in.
@@ -231,3 +299,67 @@ class MasterRendezvousHandler:
 
     def num_nodes_waiting(self) -> int:
         return self._client.num_nodes_waiting(self._name)
+
+
+def reattach_world(
+    handler: MasterRendezvousHandler,
+    current: Optional[RendezvousWorld],
+) -> tuple:
+    """Epoch-fenced re-attach: decide what a recovered master implies
+    for a live worker. Shared by :class:`ElasticTrainingAgent` and the
+    master-kill chaos drill's scripted agents so both exercise the same
+    protocol.
+
+    Returns ``(outcome, world)``:
+
+    - ``("intact", None)`` — the replayed world still contains this node
+      at the same rank with the same membership: the worker keeps
+      training untouched (a master crash costs coordination time only);
+    - ``("matched", world)`` — the master lost the world, but the fresh
+      rendezvous reproduced an equivalent one (same rank / size /
+      members / coordinator — the live worker's ``jax.distributed``
+      bootstrap stays valid), so the worker adopts it without a restart;
+    - ``("restart", world)`` — the recovered world genuinely changed;
+      the caller takes the existing remesh/restart path with the
+      already-formed world.
+    """
+    client = handler._client
+    cur_members = (
+        {m.node_rank for m in current.world.values()}
+        if current is not None
+        else set()
+    )
+    try:
+        resp = client.get_comm_world(
+            rdzv_name=handler.name, node_rank=handler._node_rank
+        )
+        world_map = dict(getattr(resp, "world", None) or {})
+    except Exception as e:  # noqa: BLE001 — probe only; re-join decides
+        logger.warning("re-attach world probe failed: %s", e)
+        world_map = {}
+    if current is not None and world_map:
+        members = {m.node_rank for m in world_map.values()}
+        my_rank = next(
+            (
+                pid
+                for pid, meta in world_map.items()
+                if meta.node_rank == handler._node_rank
+            ),
+            None,
+        )
+        if (
+            members == cur_members
+            and my_rank == current.rank
+            and len(world_map) == current.world_size
+        ):
+            return "intact", None
+    world = handler.next_rendezvous()
+    if (
+        current is not None
+        and world.rank == current.rank
+        and world.world_size == current.world_size
+        and {m.node_rank for m in world.world.values()} == cur_members
+        and world.coordinator == current.coordinator
+    ):
+        return "matched", world
+    return "restart", world

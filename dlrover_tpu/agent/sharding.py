@@ -53,6 +53,11 @@ class ShardingClient:
         self._registered = False
         self._current_task: Optional[comm.TaskMsg] = None
         self._lock = threading.Lock()
+        # Master-epoch fence: a restarted master reconstructs its
+        # in-flight shard state from these re-reports (the replayed
+        # doing-set starts unconfirmed — see master/shard/task_manager).
+        if hasattr(self._client, "add_epoch_listener"):
+            self._client.add_epoch_listener(self._on_master_epoch)
 
     def register_dataset(self) -> None:
         """Idempotent on the master side; every host calls it so any host
@@ -81,6 +86,28 @@ class ShardingClient:
         with self._lock:
             return self._current_task
 
+    def _inflight_task_ids(self) -> List[int]:
+        with self._lock:
+            task = self._current_task
+        return [task.task_id] if task is not None and task.task_id >= 0 else []
+
+    def _on_master_epoch(self, old_epoch: int, new_epoch: int) -> None:
+        """Claim the shards this worker still holds so the replayed
+        master confirms them (exactly-once re-issue) and promptly
+        requeues anything this node does NOT hold. An empty claim is
+        still sent: it tells the master this node's unclaimed doing
+        entries are requeueable now, not at the grace deadline."""
+        try:
+            self._client.report_task_inflight(
+                self.dataset_name, self._inflight_task_ids()
+            )
+        except Exception as e:  # noqa: BLE001 — reconcile falls back to grace
+            logger.warning(
+                "in-flight shard re-report failed for %s: %s",
+                self.dataset_name,
+                e,
+            )
+
     # -- data-state checkpoint (resume exactly where data delivery was) ----
 
     def get_shard_checkpoint(self) -> str:
@@ -102,6 +129,16 @@ class IndexShardingClient(ShardingClient):
         self._indices: Deque[int] = deque()
         self._pending_task: Optional[comm.TaskMsg] = None
         self._consumed_of_task = 0
+
+    def _inflight_task_ids(self) -> List[int]:
+        # Index mode keeps the partially-consumed shard in _pending_task
+        # (auto-reported only when its last index is drawn) — that is
+        # the in-flight shard a restarted master must not re-issue.
+        ids = set(super()._inflight_task_ids())
+        pending = self._pending_task
+        if pending is not None and pending.task_id >= 0:
+            ids.add(pending.task_id)
+        return sorted(ids)
 
     def fetch_sample_index(self) -> Optional[int]:
         if not self._indices and not self._refill():

@@ -34,7 +34,11 @@ from ..rpc.client import MasterClient
 from .config import ElasticLaunchConfig
 from .diagnosis_agent import DiagnosisAgent, WorkerFailure
 from .monitor import ResourceMonitor
-from .rendezvous import MasterRendezvousHandler, RendezvousWorld
+from .rendezvous import (
+    MasterRendezvousHandler,
+    RendezvousWorld,
+    reattach_world,
+)
 from .worker import RunResult, WorkerProcess, WorkerSpec, WorkerState
 
 AGENT_EXIT_OK = 0
@@ -85,6 +89,13 @@ class ElasticTrainingAgent:
         self._stopped = threading.Event()
         self._pending_action: Optional[str] = None
         self._action_lock = threading.Lock()
+        # Master-epoch fence: any RPC (heartbeat, step report, monitor
+        # poll) observing a bumped epoch flags a restarted master; the
+        # monitor loop then re-attaches instead of treating the blip —
+        # or the re-registration joins it causes — as a world change.
+        self._master_epoch_changed = threading.Event()
+        if hasattr(self._client, "add_epoch_listener"):
+            self._client.add_epoch_listener(self._on_master_epoch)
         self._evt = EventEmitter("agent")
         self._metric_collector = None
         self._profiler_daemon = None
@@ -430,7 +441,17 @@ class ElasticTrainingAgent:
                 if code is not None:
                     return code
                 continue
-            if self._membership_changed():
+            changed = self._membership_changed()
+            # The epoch check runs AFTER the membership poll on purpose:
+            # that poll's own response may be the first to carry the new
+            # epoch, and a restarted master's re-registering peers read
+            # as waiters — re-attach must own that signal, not the
+            # restart path.
+            if self._master_epoch_changed.is_set():
+                self._master_epoch_changed.clear()
+                self._reattach_master()
+                continue
+            if changed:
                 outcome, world = self._try_soft_remesh()
                 if outcome == "worker_exited":
                     continue  # normal poll handling owns exits/failures
@@ -440,6 +461,59 @@ class ElasticTrainingAgent:
                     # spares every peer a second global round
                     self._restart_workers("membership changed", world=world)
         return AGENT_EXIT_OK
+
+    # -- master crash re-attach (epoch fence) -----------------------------
+
+    def _on_master_epoch(self, old_epoch: int, new_epoch: int) -> None:
+        logger.warning(
+            "master epoch %s -> %s: restarted master; scheduling re-attach",
+            old_epoch,
+            new_epoch,
+        )
+        self._master_epoch_changed.set()
+
+    def _reattach_master(self) -> None:
+        """A restarted master replayed its journal: re-register this node
+        and verify the recovered world. When the replayed world matches
+        the cached one the live JAX worker keeps training — the master
+        crash costs seconds of coordination, zero worker restarts."""
+        t0 = time.monotonic()
+        with self._evt.duration(
+            "master_reattach", node_rank=self._config.node_rank
+        ) as span:
+            # Re-register first: the replayed node table is re-asserted
+            # even if the journal was lost (update_node_status creates
+            # the node when missing).
+            self._report_status(NodeStatus.RUNNING)
+            outcome, world = reattach_world(self._rdzv_handler, self._world)
+            span.end({"outcome": outcome})
+        from ..attribution.recovery import record_phase_file
+
+        record_phase_file(
+            "reattach",
+            {
+                "reattach_s": round(time.monotonic() - t0, 3),
+                "outcome": outcome,
+                "node_rank": self._config.node_rank,
+            },
+        )
+        if outcome == "intact":
+            logger.info(
+                "master re-attach: recovered world intact (rank %s/%s); "
+                "worker untouched",
+                self._world.rank if self._world else -1,
+                self._world.world_size if self._world else 0,
+            )
+            return
+        if outcome == "matched":
+            self._world = world
+            logger.info(
+                "master re-attach: re-formed world matches the cached one "
+                "(round %s); worker untouched",
+                world.round,
+            )
+            return
+        self._restart_workers("master restarted with changed world", world=world)
 
     def _handle_worker_failure(self, result: RunResult) -> Optional[int]:
         """Breakpoint-save, diagnose, restart or relaunch (training.py:1074)."""

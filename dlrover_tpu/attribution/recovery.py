@@ -115,4 +115,24 @@ def aggregate(root: str) -> Dict[str, Any]:
         out[key] = _mean(
             [float(w[key]) for w in workers if key in w]
         )
+    # Master-crash phases (docs/recovery.md master failover): ``master``
+    # records are spooled by a replaying master boot, ``reattach`` by
+    # every agent's epoch-fenced re-attach. Only present when a master
+    # recovery actually happened, so plain worker storms keep their
+    # exact key set.
+    replays = [
+        float(r["replay_s"])
+        for r in records
+        if r["_kind"] == "master" and r.get("replayed") and "replay_s" in r
+    ]
+    if replays:
+        out["master_replay_s"] = _mean(replays)
+        out["master_boot_samples"] = len(replays)
+    reattaches = [
+        float(r["reattach_s"])
+        for r in records
+        if r["_kind"] == "reattach" and "reattach_s" in r
+    ]
+    if reattaches:
+        out["reattach_s"] = _mean(reattaches)
     return out

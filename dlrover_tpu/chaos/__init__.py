@@ -22,6 +22,8 @@ _LAZY = {
     "make_process_master": ("harness", "make_process_master"),
     "run_goodput_storm": ("goodput_storm", "run_goodput_storm"),
     "run_recovery_ab": ("goodput_storm", "run_recovery_ab"),
+    "run_master_kill_storm": ("master_kill", "run_master_kill_storm"),
+    "run_master_kill_synthetic": ("master_kill", "run_master_kill_synthetic"),
     "SCENARIOS": ("scenarios", "SCENARIOS"),
     "run_scenario": ("scenarios", "run_scenario"),
 }

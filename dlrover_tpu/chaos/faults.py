@@ -59,6 +59,8 @@ INJECTION_POINTS: Dict[str, str] = {
     "rpc.client.report": "MasterClient report verb, before the transport call",
     "master.servicer.get": "master servicer get dispatch entry",
     "master.servicer.report": "master servicer report dispatch entry",
+    "master.boot.replay": "restarted master about to replay its state journal",
+    "rpc.client.epoch": "client observed a master-epoch bump (re-attach trigger)",
     "rdzv.join": "agent-side join_rendezvous RPC",
     "rdzv.poll": "agent-side get_comm_world poll while a world assembles",
     "agent.worker_start": "agent about to start/restart its JAX worker",

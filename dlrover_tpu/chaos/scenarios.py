@@ -584,6 +584,50 @@ def slice_kill(workdir: Optional[str] = None) -> Dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# master_kill: SIGKILL the coordinating master mid-storm — the restarted
+# master must replay its state journal and every agent must re-attach
+# under the epoch fence with ZERO worker process restarts (the recovered
+# world is unchanged); master_mttr_s is the measured coordination outage.
+# ---------------------------------------------------------------------------
+
+
+def master_kill(workdir: Optional[str] = None) -> Dict:
+    from .master_kill import run_master_kill_storm
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_masterkill_")
+    log_path = os.path.join(workdir, "faults.jsonl")
+    result = run_master_kill_storm(
+        os.path.join(workdir, "storm"),
+        num_workers=2,
+        kill_step=20,
+        settle_steps=12,
+        step_sleep=0.2,
+        storage_every=5,
+        timeout_s=420.0,
+        job_name=f"chaos_masterkill_{os.getpid()}",
+        # Deterministic replay-path injection inside the REAL restarted
+        # master process: the delay stretches replay (MTTR absorbs it)
+        # and its log line proves the point fired where it matters.
+        master_fault_plan=(
+            f"seed=7;log={log_path};master.boot.replay:delay:0.05@once"
+        ),
+    )
+    log = faults.read_log(log_path)
+    fired = sum(1 for r in log if r["point"] == "master.boot.replay")
+    return {
+        "scenario": "master_kill",
+        "fired": fired,
+        "recovered": bool(result)
+        and result.get("worker_restarts") == 0
+        and int(result.get("epoch", 0)) >= 2
+        and bool(result.get("kv_survived"))
+        and float(result.get("master_mttr_s", 1e9)) <= 120.0
+        and fired >= 1,
+        "storm": result,
+    }
+
+
 SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "flaky_rpc": flaky_rpc,
     "rdzv_retry": rdzv_retry,
@@ -594,6 +638,7 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "traffic_spike_preempt": traffic_spike_preempt,
     "host_kill": host_kill,
     "slice_kill": slice_kill,
+    "master_kill": master_kill,
 }
 
 

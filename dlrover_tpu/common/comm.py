@@ -28,6 +28,11 @@ class BaseResponse:
     success: bool = True
     reason: str = ""
     data: bytes = b""
+    # Master boot epoch (0 = master without a state journal). Bumped
+    # once per boot from DLROVER_MASTER_STATE_DIR and stamped on every
+    # response so agents/clients detect a restarted master, fence stale
+    # in-flight answers from the dead incarnation, and re-attach.
+    master_epoch: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +319,21 @@ class TaskMsg:
     task_id: int = -1
     task_type: str = ""
     shard: Optional[ShardMsg] = None
+
+
+@register_message
+@dataclass
+class TaskInFlightReport:
+    """Shards a worker still holds, re-asserted after a master restart.
+
+    The replayed master's ``doing`` set starts unconfirmed; this report
+    confirms the ids the node actually holds and lets the master requeue
+    the rest of that node's entries immediately (exactly-once re-issue
+    — see master/shard/task_manager.py)."""
+
+    node_id: int = 0
+    dataset_name: str = ""
+    task_ids: List[int] = field(default_factory=list)
 
 
 @register_message

@@ -21,6 +21,19 @@ class Context:
     master_service_type: str = DefaultValues.SERVICE_TYPE
     master_port: int = DefaultValues.MASTER_PORT
 
+    # Master crash tolerance (master/persistence.py): a non-empty state
+    # dir makes the master journal its coordination state (atomic
+    # snapshot + JSONL WAL) and stamp a per-boot epoch on every RPC
+    # response; a restarted master replays the journal and agents
+    # re-attach under the epoch fence without restarting workers.
+    master_state_dir: str = ""
+    # WAL records accumulated before the run loop compacts them into a
+    # fresh snapshot.
+    master_snapshot_every: int = 64
+    # How long a replayed master waits for agents to re-report their
+    # in-flight shards before requeueing unconfirmed ones.
+    master_reattach_grace_s: float = 30.0
+
     # Master RPC client: per-call transport deadline and the jittered
     # exponential backoff between retries (DLROVER_RPC_* env overrides).
     rpc_deadline_s: float = 30.0

@@ -318,6 +318,9 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     # -- Context-backed knobs (Context.apply_env reads DLROVER_<FIELD>) ----
     EnvKnob(NodeEnv.MASTER_SERVICE_TYPE, doc="master comms transport (grpc|http)", context_field="master_service_type"),
     EnvKnob("DLROVER_MASTER_PORT", "int", doc="master bind port (0 = free port)", context_field="master_port"),
+    EnvKnob("DLROVER_MASTER_STATE_DIR", doc="master crash-tolerance journal directory (empty = no journal)", context_field="master_state_dir"),
+    EnvKnob("DLROVER_MASTER_SNAPSHOT_EVERY", "int", doc="WAL records between master snapshot compactions", context_field="master_snapshot_every"),
+    EnvKnob("DLROVER_MASTER_REATTACH_GRACE_S", "float", doc="post-replay wait for agent shard re-reports before requeue", context_field="master_reattach_grace_s"),
     EnvKnob("DLROVER_RPC_DEADLINE_S", "float", doc="per-call RPC transport deadline", context_field="rpc_deadline_s"),
     EnvKnob("DLROVER_RPC_RETRIES", "int", doc="RPC retry budget", context_field="rpc_retries"),
     EnvKnob("DLROVER_RPC_BACKOFF_BASE_S", "float", doc="RPC backoff base (equal jitter)", context_field="rpc_backoff_base_s"),

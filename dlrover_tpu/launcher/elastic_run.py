@@ -203,6 +203,14 @@ def config_from_args(ns: argparse.Namespace) -> ElasticLaunchConfig:
         entry_args=list(ns.entry_args),
         run_module=ns.module,
         master_addr=os.environ.get(NodeEnv.MASTER_ADDR, ""),
+        # Propagate the transport into the worker env contract: the
+        # agent's own client reads the env directly, but worker_env()
+        # re-exports config.master_service_type — leaving it at the
+        # default silently pointed every trainer of an HTTP-master job
+        # at a gRPC transport (step reports died at debug level).
+        master_service_type=os.environ.get(
+            NodeEnv.MASTER_SERVICE_TYPE, DefaultValues.SERVICE_TYPE
+        ),
         job_name=os.environ.get(NodeEnv.JOB_NAME, "local_job"),
         accelerator=ns.accelerator,
         network_check=ns.network_check,

@@ -316,6 +316,16 @@ class DistributedJobMaster:
             shrink_handler=_scale_down,
             quota=quota,
         )
+        # Crash tolerance (master/persistence.py): replay the journaled
+        # coordination state into the freshly-built components and stamp
+        # the new boot epoch on every RPC response so agents re-attach
+        # under the epoch fence instead of dying with the old master.
+        from .persistence import MasterPersistence
+
+        self.persistence = MasterPersistence.from_env()
+        self.master_epoch = 0
+        if self.persistence is not None:
+            self.master_epoch = self.persistence.boot(self)
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
             rdzv_managers=self.rdzv_managers,
@@ -323,6 +333,7 @@ class DistributedJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             perf_monitor=self.perf_monitor,
+            epoch=self.master_epoch,
         )
         service_type = service_type or ctx.master_comms()
         self._server, self.port = create_master_server(
@@ -345,6 +356,10 @@ class DistributedJobMaster:
             self.brain_reporter.start()
         self._job_ctx.set_stage(JobStage.PRE_CHECK)
         self._events.start(port=self.port)
+        if self.persistence is not None:
+            # Initial snapshot: a crash before the first WAL compaction
+            # must still replay the node table and rdzv params.
+            self.persistence.tick(force=True)
         # Pre-check runs in the background so prepare() doesn't block the
         # servicer; agents poll get_pre_check_result.
         threading.Thread(
@@ -393,6 +408,10 @@ class DistributedJobMaster:
                 slow = self.task_manager.recover_timeout_tasks()
                 if slow:
                     logger.warning("recovered tasks from slow nodes %s", slow)
+                # Post-replay shard reconciliation + WAL compaction.
+                self.task_manager.reconcile_unconfirmed()
+                if self.persistence is not None:
+                    self.persistence.tick()
             except Exception:
                 logger.exception("master run loop error")
 
@@ -409,6 +428,8 @@ class DistributedJobMaster:
 
     def stop(self) -> None:
         self._stopped.set()
+        if self.persistence is not None:
+            self.persistence.tick(force=True)
         if self.brain_reporter is not None:
             self.brain_reporter.stop()
         self.diagnosis_master.stop()

@@ -76,6 +76,73 @@ class JobContext:
                 self.last_training_step = step
                 self.last_step_time = timestamp
 
+    # -- persistence (snapshot / replay) -----------------------------------
+
+    _NODE_FIELDS = (
+        "node_type", "node_id", "name", "rank_index", "status", "slice_id",
+        "host_ip", "relaunch_count", "max_relaunch_count", "relaunchable",
+        "is_released", "exit_reason", "heartbeat_time",
+    )
+
+    def export_state(self) -> Dict:
+        with self._mu:
+            nodes = []
+            for per_type in self._nodes.values():
+                for node in per_type.values():
+                    nodes.append(
+                        {f: getattr(node, f) for f in self._NODE_FIELDS}
+                    )
+            return {
+                "nodes": nodes,
+                "job_stage": self.job_stage,
+                "job_exit_reason": self.job_exit_reason,
+                "pre_check_status": self.pre_check_status,
+                "pre_check_reason": self.pre_check_reason,
+                "last_training_step": self.last_training_step,
+                "elastic_run_config": dict(self.elastic_run_config),
+            }
+
+    def import_state(self, state: Dict) -> None:
+        from ..common.node import Node
+
+        with self._mu:
+            self._nodes = {}
+            for fields in state.get("nodes") or []:
+                node = Node(**{
+                    k: v
+                    for k, v in fields.items()
+                    if k in self._NODE_FIELDS
+                })
+                self._nodes.setdefault(node.node_type, {})[
+                    node.node_id
+                ] = node
+            self.job_stage = state.get("job_stage", self.job_stage)
+            self.job_exit_reason = state.get("job_exit_reason", "")
+            self.pre_check_status = state.get(
+                "pre_check_status", self.pre_check_status
+            )
+            self.pre_check_reason = state.get("pre_check_reason", "")
+            self.last_training_step = int(
+                state.get("last_training_step", 0)
+            )
+            self.elastic_run_config = dict(
+                state.get("elastic_run_config") or {}
+            )
+
+    def mark_replayed(self) -> None:
+        """Post-replay normalization: heartbeat timestamps replayed from
+        the journal predate the outage — re-stamp live nodes NOW so the
+        dead-node monitor measures silence from this boot, not from the
+        dead master's last observation."""
+        import time as _time
+
+        now = _time.time()
+        with self._mu:
+            for per_type in self._nodes.values():
+                for node in per_type.values():
+                    if not node.exited() and node.heartbeat_time > 0:
+                        node.heartbeat_time = now
+
     # -- singleton ---------------------------------------------------------
 
     @classmethod

@@ -3,8 +3,16 @@
 Reference: ``master/elastic_training/kv_store_service.py:18``. Backs the
 agents' :class:`~dlrover_tpu.agent.master_kv_store.MasterKVStore` (barriers,
 rendezvous state) and the ``jax.distributed`` bootstrap hand-off.
+
+Crash tolerance: when the master journal is attached (``journal`` set by
+:mod:`dlrover_tpu.master.persistence`), every mutation appends one WAL
+record and the full store rides the snapshot — the coordinator-address
+keys and barrier counters survive a master restart, so re-attaching
+agents read the same world they were trained against. The ``import_*``
+entry points apply replayed mutations without re-journaling them.
 """
 
+import base64
 import threading
 from typing import Dict, List
 
@@ -13,10 +21,19 @@ class KVStoreService:
     def __init__(self):
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
+        self.journal = None  # set by MasterPersistence.attach
+
+    def _record(self, kind: str, payload: Dict) -> None:
+        if self.journal is not None:
+            self.journal(kind, payload)
 
     def set(self, key: str, value: bytes) -> None:
         with self._lock:
             self._store[key] = value
+            self._record(
+                "kv.set",
+                {"key": key, "v": base64.b64encode(value or b"").decode()},
+            )
 
     def get(self, key: str) -> bytes:
         with self._lock:
@@ -25,9 +42,21 @@ class KVStoreService:
     def add(self, key: str, amount: int) -> int:
         """Atomic counter add; value stored as decimal string bytes."""
         with self._lock:
+            existed = key in self._store
             current = int(self._store.get(key, b"0") or b"0")
             current += amount
-            self._store[key] = str(current).encode()
+            value = str(current).encode()
+            self._store[key] = value
+            # Journaled as the RESULT, not the delta (replaying a delta
+            # on a snapshot that already contains it would double-count)
+            # — and only when something changed: add(key, 0) is the
+            # agents' barrier POLL idiom, and journaling each poll would
+            # flood the WAL into back-to-back snapshot compactions.
+            if amount or not existed:
+                self._record(
+                    "kv.set",
+                    {"key": key, "v": base64.b64encode(value).decode()},
+                )
             return current
 
     def multi_get(self, keys: List[str]) -> Dict[str, bytes]:
@@ -37,11 +66,50 @@ class KVStoreService:
     def multi_set(self, kvs: Dict[str, bytes]) -> None:
         with self._lock:
             self._store.update(kvs)
+            self._record(
+                "kv.multi",
+                {
+                    "kvs": {
+                        k: base64.b64encode(v or b"").decode()
+                        for k, v in kvs.items()
+                    }
+                },
+            )
 
     def delete(self, key: str) -> None:
         with self._lock:
             self._store.pop(key, None)
+            self._record("kv.del", {"key": key})
 
     def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._record("kv.clear", {})
+
+    # -- persistence (snapshot / replay) -----------------------------------
+
+    def export_state(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                k: base64.b64encode(v or b"").decode()
+                for k, v in self._store.items()
+            }
+
+    def import_state(self, state: Dict[str, str]) -> None:
+        with self._lock:
+            self._store = {
+                k: base64.b64decode(v or "") for k, v in state.items()
+            }
+
+    def import_pairs(self, kvs: Dict[str, bytes]) -> None:
+        """Replay entry: apply without journaling."""
+        with self._lock:
+            self._store.update(kvs)
+
+    def import_delete(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def import_clear(self) -> None:
         with self._lock:
             self._store.clear()

@@ -83,6 +83,17 @@ class LocalJobMaster:
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(default_expected=num_workers)
         self.perf_monitor = PerfMonitor()
+        # Crash tolerance (master/persistence.py): with a state dir
+        # configured, bump the boot epoch and replay the journaled
+        # coordination state into the components just built — a
+        # SIGKILLed master restarted by its orchestrator resumes the
+        # job instead of losing it.
+        from .persistence import MasterPersistence
+
+        self.persistence = MasterPersistence.from_env()
+        self.master_epoch = 0
+        if self.persistence is not None:
+            self.master_epoch = self.persistence.boot(self)
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
             rdzv_managers=self.rdzv_managers,
@@ -90,6 +101,7 @@ class LocalJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             perf_monitor=self.perf_monitor,
+            epoch=self.master_epoch,
         )
         service_type = service_type or ctx.master_comms()
         self._server, self.port = create_master_server(
@@ -111,6 +123,10 @@ class LocalJobMaster:
         self._job_ctx.pre_check_status = PreCheckStatus.PASSED
         self._job_ctx.set_stage(JobStage.RUNNING)
         self._events.start(port=self.port)
+        if self.persistence is not None:
+            # Initial snapshot: a crash before the first WAL compaction
+            # must still replay the node table and rdzv params.
+            self.persistence.tick(force=True)
 
     def run_in_background(self) -> None:
         self._run_thread = threading.Thread(
@@ -137,6 +153,10 @@ class LocalJobMaster:
                 slow = self.task_manager.recover_timeout_tasks()
                 if slow:
                     logger.warning("recovered timed-out tasks from nodes %s", slow)
+                # Post-replay shard reconciliation + WAL compaction.
+                self.task_manager.reconcile_unconfirmed()
+                if self.persistence is not None:
+                    self.persistence.tick()
                 if self.task_manager.finished():
                     logger.info("all dataset tasks completed")
             except Exception:
@@ -152,6 +172,8 @@ class LocalJobMaster:
     def stop(self) -> None:
         self._stopped.set()
         self.job_manager.stop()
+        if self.persistence is not None:
+            self.persistence.tick(force=True)
         self._server.stop()
 
 

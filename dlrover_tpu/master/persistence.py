@@ -1,0 +1,397 @@
+"""Master crash tolerance: durable state journal + epoch-fenced reboot.
+
+The master is the coordination plane's last single point of failure:
+node tables, rendezvous rounds, shard doing/done sets, kv-store and
+sync-service contents all lived purely in memory, so a SIGKILLed master
+cost the whole job even though every worker was healthy. This module
+makes the master restartable by its orchestrator with the job riding
+through:
+
+- :class:`MasterStateStore` — a durable journal under
+  ``DLROVER_MASTER_STATE_DIR`` (Context ``master_state_dir``): an atomic
+  snapshot (tmp + rename) plus an O_APPEND JSONL WAL, the same idiom as
+  the chip-pool decision journal. Every record carries a monotonic
+  ``seq``; the snapshot stamps the last seq it covers, so a crash
+  between snapshot-rename and WAL-truncate replays each record exactly
+  once.
+- a **master epoch** — an integer bumped once per boot from the same
+  state dir and stamped on every RPC response. Agents and the rpc
+  client detect a restarted master by the bump, fence stale in-flight
+  responses from the dead incarnation, and re-attach (re-register +
+  verify the recovered world) instead of dying on it.
+- :class:`MasterPersistence` — the façade a master wires in: ``boot``
+  bumps the epoch and replays snapshot + WAL into the freshly-built
+  components (``master.boot.replay`` injection point), ``attach`` hangs
+  the journal hooks off the kv store / sync service / task manager /
+  rendezvous managers, and ``tick`` (called from the master run loop,
+  never from inside a component lock) compacts the WAL into a new
+  snapshot.
+
+Shard state is the one thing replay alone cannot make exact: a task
+issued between the last WAL write and the crash window is closed by
+WAL-before-respond ordering (the issue record lands before the agent
+ever sees the task), and the replayed ``doing`` set starts *unconfirmed*
+— agents re-report the task ids they actually hold
+(``TaskInFlightReport``), confirmed entries stay in flight, everything
+else is re-queued exactly once (per-node immediately on its report,
+stragglers at the ``master_reattach_grace_s`` deadline).
+"""
+
+import base64
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..chaos import faults
+from ..common.config import get_context
+from ..common.log import logger
+
+SNAPSHOT_NAME = "snapshot.json"
+WAL_NAME = "wal.jsonl"
+EPOCH_NAME = "epoch"
+
+
+def b64e(value: bytes) -> str:
+    return base64.b64encode(value or b"").decode("ascii")
+
+
+def b64d(value: str) -> bytes:
+    return base64.b64decode(value or "")
+
+
+class MasterStateStore:
+    """Snapshot + WAL + epoch files under one state directory.
+
+    Single-writer by contract (one master process owns a state dir at a
+    time — the orchestrator restarts the master, it never runs two).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._mu = threading.Lock()
+        self._seq = self._scan_last_seq()
+
+    # -- epoch -------------------------------------------------------------
+
+    def _epoch_path(self) -> str:
+        return os.path.join(self.root, EPOCH_NAME)
+
+    def read_epoch(self) -> int:
+        try:
+            return int(open(self._epoch_path()).read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def bump_epoch(self) -> int:
+        """Increment the boot epoch atomically; first boot yields 1."""
+        epoch = self.read_epoch() + 1
+        self._atomic_write(self._epoch_path(), str(epoch))
+        return epoch
+
+    # -- WAL ---------------------------------------------------------------
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.root, WAL_NAME)
+
+    def _scan_last_seq(self) -> int:
+        last = 0
+        snap = self._read_json(os.path.join(self.root, SNAPSHOT_NAME))
+        if snap:
+            last = int(snap.get("wal_seq", 0))
+        for rec in self._read_wal():
+            last = max(last, int(rec.get("seq", 0)))
+        return last
+
+    def last_seq(self) -> int:
+        with self._mu:
+            return self._seq
+
+    def append(self, kind: str, payload: Dict[str, Any]) -> int:
+        """One O_APPEND write per record; the write stays under the
+        store lock so a concurrent compaction (WAL rewrite) can never
+        interleave with it. Never raises — a full disk must degrade
+        durability, not take the control plane down."""
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            entry = {"seq": seq, "ts": round(time.time(), 3), "kind": kind,
+                     "data": payload}
+            try:
+                line = (json.dumps(entry) + "\n").encode()
+                fd = os.open(
+                    self._wal_path(),
+                    os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                    0o644,
+                )
+                try:
+                    os.write(fd, line)
+                finally:
+                    os.close(fd)
+            except (OSError, TypeError, ValueError):
+                logger.warning("master WAL append failed for %s", kind)
+        return seq
+
+    def _read_wal(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self._wal_path()) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        # a torn tail write (crash mid-append) ends the
+                        # replayable prefix; later records cannot exist
+                        break
+        except OSError:
+            pass
+        return out
+
+    # -- snapshot ----------------------------------------------------------
+
+    def write_snapshot(
+        self, state: Dict[str, Any], floor: Optional[int] = None
+    ) -> None:
+        """Atomic snapshot, then WAL compaction.
+
+        ``floor`` is the seq the caller observed BEFORE capturing
+        ``state`` — records at or below it are covered by the snapshot;
+        records above it may or may not be (a mutation journaled while
+        capture was reading other components), so compaction REWRITES
+        the WAL keeping them instead of truncating — replay applies
+        them idempotently. Crash windows: before the snapshot rename
+        the old pair still replays; between rename and rewrite the
+        old WAL's covered records are filtered by seq on load."""
+        with self._mu:
+            if floor is None:
+                floor = self._seq
+            state = dict(state, wal_seq=floor)
+            path = os.path.join(self.root, SNAPSHOT_NAME)
+            try:
+                self._atomic_write(path, json.dumps(state))
+                keep = [
+                    json.dumps(r)
+                    for r in self._read_wal()
+                    if int(r.get("seq", 0)) > floor
+                ]
+                self._atomic_write(
+                    self._wal_path(),
+                    "".join(line + "\n" for line in keep),
+                )
+            except (OSError, TypeError, ValueError):
+                logger.warning("master snapshot write failed")
+
+    def load(self) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
+        """(snapshot or None, WAL records newer than the snapshot)."""
+        snap = self._read_json(os.path.join(self.root, SNAPSHOT_NAME))
+        floor = int(snap.get("wal_seq", 0)) if snap else 0
+        wal = [r for r in self._read_wal() if int(r.get("seq", 0)) > floor]
+        return snap, wal
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _atomic_write(path: str, text: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.rename(tmp, path)
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+
+# ---------------------------------------------------------------------------
+# capture / restore
+# ---------------------------------------------------------------------------
+
+
+def capture_master_state(master) -> Dict[str, Any]:
+    """Full coordination-plane state: node tables + job stage, kv store,
+    sync barriers, shard task queues, completed rendezvous worlds. Each
+    component exports under its own lock; no lock spans components."""
+    return {
+        "job": master._job_ctx.export_state(),
+        "kv": master.kv_store.export_state(),
+        "sync": master.sync_service.export_state(),
+        "tasks": master.task_manager.export_state(),
+        "rdzv": {
+            name: mgr.export_state()
+            for name, mgr in master.rdzv_managers.items()
+        },
+    }
+
+
+def restore_master_state(master, state: Dict[str, Any]) -> None:
+    master._job_ctx.import_state(state.get("job") or {})
+    master.kv_store.import_state(state.get("kv") or {})
+    master.sync_service.import_state(state.get("sync") or {})
+    master.task_manager.import_state(state.get("tasks") or {})
+    for name, mgr_state in (state.get("rdzv") or {}).items():
+        mgr = master.rdzv_managers.get(name)
+        if mgr is not None:
+            mgr.import_state(mgr_state)
+
+
+def apply_wal_record(master, record: Dict[str, Any]) -> None:
+    """Replay one WAL record onto restored components. Records are
+    idempotent against the snapshot (a snapshot taken after the record
+    already contains its effect; seq filtering makes that the rare
+    crash-window case, but replay must still never double-apply)."""
+    kind = record.get("kind", "")
+    data = record.get("data") or {}
+    if kind == "kv.set":
+        master.kv_store.import_pairs({data["key"]: b64d(data["v"])})
+    elif kind == "kv.multi":
+        master.kv_store.import_pairs(
+            {k: b64d(v) for k, v in (data.get("kvs") or {}).items()}
+        )
+    elif kind == "kv.del":
+        master.kv_store.import_delete(data["key"])
+    elif kind == "kv.clear":
+        master.kv_store.import_clear()
+    elif kind == "sync.join":
+        master.sync_service.join(data["name"], int(data["node"]))
+    elif kind == "sync.finish":
+        master.sync_service.finish(data["name"])
+    elif kind == "sync.expected":
+        master.sync_service.set_expected(data["name"], int(data["count"]))
+    elif kind == "sync.default":
+        master.sync_service.set_default_expected(int(data["count"]))
+    elif kind == "rdzv.complete":
+        mgr = master.rdzv_managers.get(data.get("rdzv", ""))
+        if mgr is not None:
+            mgr.import_completed_world(
+                int(data["round"]), data.get("world") or []
+            )
+    elif kind in ("task.dataset", "task.refill", "task.issue", "task.done"):
+        master.task_manager.apply_journal(kind, data)
+    else:
+        logger.warning("unknown master WAL record kind %r", kind)
+
+
+class MasterPersistence:
+    """The façade a master composes: journal hooks in, replay on boot,
+    periodic WAL compaction from the supervision loop."""
+
+    def __init__(
+        self,
+        store: MasterStateStore,
+        snapshot_every: int = 64,
+    ):
+        self.store = store
+        self.snapshot_every = max(1, snapshot_every)
+        self.epoch = 0
+        self.replayed = False
+        self.replay_s = 0.0
+        self._records_since_snapshot = 0
+        self._capture = None  # set by attach()
+
+    @classmethod
+    def from_env(cls) -> Optional["MasterPersistence"]:
+        ctx = get_context()
+        if not ctx.master_state_dir:
+            return None
+        return cls(
+            MasterStateStore(ctx.master_state_dir),
+            snapshot_every=ctx.master_snapshot_every,
+        )
+
+    # -- boot --------------------------------------------------------------
+
+    def boot(self, master) -> int:
+        """Bump the epoch, replay any prior state into the freshly-built
+        components, attach the journal hooks. Returns the new epoch.
+        Replay failures degrade to a fresh boot — an unreadable journal
+        must never brick the master."""
+        self.epoch = self.store.bump_epoch()
+        t0 = time.monotonic()
+        wal_count = 0
+        try:
+            # Chaos hook: a delay here stretches master MTTR (the drill
+            # measures it); an error simulates a poisoned journal — the
+            # master must boot fresh, not crash-loop.
+            faults.inject("master.boot.replay", epoch=self.epoch)
+            snapshot, wal = self.store.load()
+            if snapshot is not None:
+                restore_master_state(master, snapshot)
+            for record in wal:
+                apply_wal_record(master, record)
+            wal_count = len(wal)
+            self.replayed = snapshot is not None or wal_count > 0
+        except Exception:  # noqa: BLE001 — degrade to a fresh boot
+            logger.exception(
+                "master state replay failed; booting with empty state"
+            )
+            self.replayed = False
+        self.replay_s = round(time.monotonic() - t0, 3)
+        if self.replayed:
+            grace = get_context().master_reattach_grace_s
+            master.task_manager.begin_reattach(grace)
+            master._job_ctx.mark_replayed()
+            logger.info(
+                "master epoch %s: replayed journal in %.3fs (%s WAL records)",
+                self.epoch,
+                self.replay_s,
+                wal_count,
+            )
+        self.attach(master)
+        # MTTR attribution: the master's own phase of a master-kill
+        # recovery (aggregated as master_replay_s; no-op without
+        # DLROVER_RECOVERY_DIR).
+        from ..attribution.recovery import record_phase_file
+
+        record_phase_file(
+            "master",
+            {
+                "replay_s": self.replay_s,
+                "epoch": self.epoch,
+                "replayed": self.replayed,
+                "wal_records": wal_count,
+            },
+        )
+        return self.epoch
+
+    def attach(self, master) -> None:
+        """Hang journal hooks off every stateful component. Hooks are
+        invoked with the component's lock held, so they only append to
+        the WAL (persistence never calls back into a component)."""
+        self._capture = lambda: capture_master_state(master)
+        master.kv_store.journal = self.record
+        master.sync_service.journal = self.record
+        master.task_manager.set_journal(self.record)
+        for mgr in master.rdzv_managers.values():
+            mgr.journal = self.record
+
+    # -- journal -----------------------------------------------------------
+
+    def record(self, kind: str, payload: Dict[str, Any]) -> None:
+        self.store.append(kind, payload)
+        self._records_since_snapshot += 1
+
+    def tick(self, force: bool = False) -> bool:
+        """Compact the WAL into a snapshot when it has grown past the
+        threshold. Called from the master run loop (or stop) only —
+        capture takes every component lock, so it must never run from
+        inside a journal hook."""
+        if self._capture is None:
+            return False
+        if not force and self._records_since_snapshot < self.snapshot_every:
+            return False
+        # Floor BEFORE capture: a mutation journaled while capture reads
+        # the components may be missing from the snapshot — keeping its
+        # WAL record (idempotent replay) is what makes that window safe.
+        floor = self.store.last_seq()
+        self.store.write_snapshot(self._capture(), floor=floor)
+        self._records_since_snapshot = 0
+        return True

@@ -15,6 +15,7 @@ Key behaviors carried over (reference line cites in methods):
   slowest) to isolate faulty hosts; stragglers = elapsed > ratio × median
 """
 
+import dataclasses
 import statistics
 import threading
 import time
@@ -63,6 +64,7 @@ class RendezvousManager:
         self._lastcall_time = 0.0
         self._start_rdzv_time = 0.0
         self._ckpt_sync_nodes: Dict[int, int] = {}  # node_id → step
+        self.journal = None  # set by MasterPersistence.attach
 
     @property
     def name(self) -> str:
@@ -165,6 +167,21 @@ class RendezvousManager:
             self._rdzv_round - 1,
             len(self._rdzv_nodes),
         )
+        if self.journal is not None:
+            # A completed world is the coordination fact a restarted
+            # master must replay: re-attaching agents keep training on
+            # it (zero worker restarts) when the membership still holds.
+            self.journal(
+                "rdzv.complete",
+                {
+                    "rdzv": self._name,
+                    "round": self._rdzv_round,
+                    "world": [
+                        dataclasses.asdict(m)
+                        for m in self._rdzv_nodes.values()
+                    ],
+                },
+            )
 
     def get_comm_world(
         self, node_rank: int
@@ -212,6 +229,50 @@ class RendezvousManager:
                 self._ckpt_sync_nodes = {node_id: step}
                 return False
             return len(self._ckpt_sync_nodes) >= len(self._rdzv_nodes) > 0
+
+    # -- persistence (snapshot / replay) -----------------------------------
+
+    def export_state(self) -> Dict:
+        """Round counter + the completed world (the part re-attaching
+        agents depend on). Waiting joins are deliberately NOT exported:
+        a join is lost with the master, and the joiner's epoch-fenced
+        re-registration (agent/rendezvous.py) replaces it."""
+        with self._lock:
+            return {
+                "round": self._rdzv_round,
+                "world": [
+                    dataclasses.asdict(m) for m in self._rdzv_nodes.values()
+                ],
+                "latest_members": sorted(self._latest_members),
+            }
+
+    def import_state(self, state: Dict) -> None:
+        with self._lock:
+            self._rdzv_round = int(state.get("round", 0))
+            self._rdzv_nodes = {}
+            for meta in state.get("world") or []:
+                m = comm.NodeMeta(**meta)
+                self._rdzv_nodes[m.node_rank] = m
+            self._latest_members = set(state.get("latest_members") or [])
+            self._waiting_nodes = {}
+            self._lastcall_time = 0.0
+            self._start_rdzv_time = 0.0
+
+    def import_completed_world(self, round_: int, world: List[Dict]) -> None:
+        """Replay entry for a WAL'd completion newer than the snapshot.
+        ``round_`` is the post-completion round counter."""
+        with self._lock:
+            if round_ < self._rdzv_round:
+                return  # older than what the snapshot already holds
+            self._rdzv_round = round_
+            self._rdzv_nodes = {}
+            for meta in world:
+                m = comm.NodeMeta(**meta)
+                self._rdzv_nodes[m.node_rank] = m
+            self._latest_members = set(self._rdzv_nodes)
+            self._waiting_nodes = {}
+            self._lastcall_time = 0.0
+            self._start_rdzv_time = 0.0
 
 
 class ElasticTrainingRendezvousManager(RendezvousManager):

@@ -32,6 +32,7 @@ class MasterServicer:
         kv_store: KVStoreService = None,
         sync_service: SyncService = None,
         perf_monitor=None,
+        epoch: int = 0,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -41,6 +42,13 @@ class MasterServicer:
         self._perf_monitor = perf_monitor
         self._job_ctx = get_job_context()
         self._start_time = time.time()
+        # Master boot epoch, stamped on EVERY response (0 = journal-less
+        # master, no fencing). Clients detect a restarted master by the
+        # bump and re-attach; stale in-flight responses are fenced.
+        self._epoch = epoch
+
+    def _respond(self, **kwargs) -> bytes:
+        return dumps(comm.BaseResponse(master_epoch=self._epoch, **kwargs))
 
     # -- transport entry points (bytes in/out) -----------------------------
 
@@ -48,39 +56,35 @@ class MasterServicer:
         # Chaos hook: error propagates to the transport (the client sees
         # a failed RPC and retries); "drop" answers with a rejection.
         if faults.inject("master.servicer.get") == "drop":
-            return dumps(
-                comm.BaseResponse(success=False, reason="fault-injected drop")
-            )
+            return self._respond(success=False, reason="fault-injected drop")
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._GET_HANDLERS.get(type(message))
         if handler is None:
             logger.warning("no get handler for %s", type(message).__name__)
-            return dumps(comm.BaseResponse(success=False, reason="unknown message"))
+            return self._respond(success=False, reason="unknown message")
         try:
             result = handler(self, message)
         except Exception as e:  # noqa: BLE001 — reported, not retried
             logger.exception("get handler failed for %s", type(message).__name__)
-            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
-        return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+            return self._respond(success=False, reason=repr(e))
+        return self._respond(success=True, data=dumps(result))
 
     def report(self, request_bytes: bytes) -> bytes:
         if faults.inject("master.servicer.report") == "drop":
-            return dumps(
-                comm.BaseResponse(success=False, reason="fault-injected drop")
-            )
+            return self._respond(success=False, reason="fault-injected drop")
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._REPORT_HANDLERS.get(type(message))
         if handler is None:
             logger.warning("no report handler for %s", type(message).__name__)
-            return dumps(comm.BaseResponse(success=False, reason="unknown message"))
+            return self._respond(success=False, reason="unknown message")
         try:
             handler(self, message)
-            return dumps(comm.BaseResponse(success=True))
+            return self._respond(success=True)
         except Exception as e:  # noqa: BLE001
             logger.exception("report handler failed")
-            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+            return self._respond(success=False, reason=repr(e))
 
     # -- kv store ----------------------------------------------------------
 
@@ -233,6 +237,11 @@ class MasterServicer:
     def _task_result(self, msg: comm.TaskResult) -> None:
         self._task_manager.report_task_result(msg.dataset_name, msg.task_id, msg.success)
 
+    def _task_inflight(self, msg: comm.TaskInFlightReport) -> None:
+        self._task_manager.confirm_tasks(
+            msg.node_id, msg.dataset_name, list(msg.task_ids)
+        )
+
     def _shard_ckpt_get(self, msg: comm.ShardCheckpointRequest) -> comm.ShardCheckpointMsg:
         return comm.ShardCheckpointMsg(
             dataset_name=msg.dataset_name,
@@ -375,6 +384,7 @@ class MasterServicer:
         comm.TrainingStepReport: _training_step,
         comm.DatasetShardParams: _dataset_params,
         comm.TaskResult: _task_result,
+        comm.TaskInFlightReport: _task_inflight,
         comm.ShardCheckpointMsg: _shard_ckpt_restore,
         comm.EventReport: _event_report,
     }

@@ -9,7 +9,7 @@ data delivery stays correct under worker churn.
 
 import random
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 @dataclass
@@ -43,6 +43,29 @@ class DatasetSplitter:
 
     def epoch_finished(self) -> bool:
         return self.epoch >= self.num_epochs
+
+    # -- persistence (master crash-tolerance journal) ----------------------
+
+    def export_state(self) -> Dict:
+        """Everything create_shards depends on beyond the constructor
+        params: the epoch cursor and — for the shuffling splitters —
+        the RNG stream position. Without the RNG state, a refill
+        replayed over a snapshot would draw a DIFFERENT permutation
+        than the shards agents already hold (samples dropped and
+        duplicated at index granularity)."""
+        state: Dict = {"epoch": self.epoch}
+        rng = getattr(self, "_rng", None)
+        if rng is not None:
+            version, internal, gauss = rng.getstate()
+            state["rng"] = [version, list(internal), gauss]
+        return state
+
+    def import_state(self, state: Dict) -> None:
+        self.epoch = int(state.get("epoch", self.epoch))
+        rng = getattr(self, "_rng", None)
+        if rng is not None and state.get("rng"):
+            version, internal, gauss = state["rng"]
+            rng.setstate((version, tuple(internal), gauss))
 
 
 class TableDatasetSplitter(DatasetSplitter):
@@ -120,6 +143,17 @@ class StreamingDatasetSplitter(DatasetSplitter):
 
     def epoch_finished(self) -> bool:
         return False
+
+    def export_state(self) -> Dict:
+        state = super().export_state()
+        state["offset"] = self._offset
+        state["shard_idx"] = self._shard_idx
+        return state
+
+    def import_state(self, state: Dict) -> None:
+        super().import_state(state)
+        self._offset = int(state.get("offset", self._offset))
+        self._shard_idx = int(state.get("shard_idx", self._shard_idx))
 
 
 def new_dataset_splitter(

@@ -5,6 +5,17 @@ DatasetShardCheckpoint:60), ``batch_dataset_manager.py:29`` and
 ``task_manager.py:35``: todo/doing queues with at-least-once redelivery —
 shards of dead or timed-out workers are re-queued, which is what makes
 worker-count elasticity safe for data order.
+
+Crash tolerance (master journal): dataset creation and every task
+issue/completion are WAL'd — the issue record is appended *before* the
+task is handed to the agent, so a task the agent holds is always in the
+replayed ``doing`` set. After a replay the doing entries start
+*unconfirmed*; agents re-report the task ids they actually hold
+(``confirm_tasks``), which confirms real in-flight shards exactly once
+and immediately re-queues anything the reporting node does not hold
+(finished-but-unacked or never-received). Nodes that never re-report
+within the re-attach grace have their tasks re-queued by
+``reconcile_unconfirmed`` — no sample is dropped, none double-issued.
 """
 
 import json
@@ -34,6 +45,26 @@ class DoingTask:
     task: Task
     node_id: int
     start_time: float
+    # False only on replayed entries awaiting the owner's re-report.
+    confirmed: bool = True
+
+
+def _shard_dict(shard: Shard) -> Dict:
+    return {
+        "name": shard.name,
+        "start": shard.start,
+        "end": shard.end,
+        "indices": list(shard.record_indices or []),
+    }
+
+
+def _shard_from(data: Dict) -> Shard:
+    return Shard(
+        name=data.get("name", ""),
+        start=int(data.get("start", 0)),
+        end=int(data.get("end", 0)),
+        record_indices=list(data.get("indices") or []),
+    )
 
 
 class DatasetManager:
@@ -47,7 +78,14 @@ class DatasetManager:
         self.doing: Dict[int, DoingTask] = {}
         self._task_id = 0
         self._completed = 0
+        self._done_ids: List[int] = []  # recent, for replay idempotence
         self._lock = threading.Lock()
+        self.journal = None  # threaded down from TaskManager
+
+    def _record(self, kind: str, payload: Dict) -> None:
+        if self.journal is not None:
+            payload = dict(payload, dataset=self.dataset_name)
+            self.journal(kind, payload)
 
     def _refill(self) -> None:
         if self.todo or self._splitter.epoch_finished():
@@ -57,6 +95,13 @@ class DatasetManager:
                 Task(task_id=self._task_id, task_type=self._task_type, shard=shard)
             )
             self._task_id += 1
+        # Journaled by the post-refill task-id watermark, not by shard
+        # list: splitters are seeded and sequential, so replaying the
+        # same create_shards sequence reproduces the exact shards and
+        # task ids (see apply_journal) — without this a replayed dataset
+        # whose snapshot predates the refill would re-create
+        # already-issued shards (duplicate samples).
+        self._record("task.refill", {"next_task_id": self._task_id})
 
     def get_task(self, node_id: int) -> Task:
         with self._lock:
@@ -65,6 +110,19 @@ class DatasetManager:
                 return Task.create_invalid_task()
             task = self.todo.pop(0)
             self.doing[task.task_id] = DoingTask(task, node_id, time.time())
+            # WAL BEFORE the task leaves this call: a master crash after
+            # the agent received the task but before the record landed
+            # would otherwise lose the doing entry — with this ordering
+            # a held task is always replayable (exactly-once re-issue).
+            self._record(
+                "task.issue",
+                {
+                    "task_id": task.task_id,
+                    "node_id": node_id,
+                    "task_type": task.task_type,
+                    "shard": _shard_dict(task.shard),
+                },
+            )
             return task
 
     def report_task_status(self, task_id: int, success: bool) -> Optional[Task]:
@@ -72,11 +130,18 @@ class DatasetManager:
             doing = self.doing.pop(task_id, None)
             if doing is None:
                 return None
+            self._record("task.done", {"task_id": task_id, "success": success})
             if success:
-                self._completed += 1
+                self._complete_id(task_id)
                 return doing.task
             self.todo.insert(0, doing.task)
             return None
+
+    def _complete_id(self, task_id: int) -> None:
+        self._completed += 1
+        self._done_ids.append(task_id)
+        if len(self._done_ids) > 4096:
+            del self._done_ids[:-2048]
 
     def recover_tasks_of_node(self, node_id: int) -> int:
         """Requeue uncompleted shards of a dead worker (reference
@@ -86,6 +151,10 @@ class DatasetManager:
             for doing in recovered:
                 del self.doing[doing.task.task_id]
                 self.todo.insert(0, doing.task)
+                self._record(
+                    "task.done",
+                    {"task_id": doing.task.task_id, "success": False},
+                )
             if recovered:
                 logger.info(
                     "requeued %s tasks of dead node %s on dataset %s",
@@ -107,6 +176,7 @@ class DatasetManager:
             for tid in timed_out:
                 doing = self.doing.pop(tid)
                 self.todo.insert(0, doing.task)
+                self._record("task.done", {"task_id": tid, "success": False})
                 nodes.append(doing.node_id)
             return nodes
 
@@ -156,37 +226,235 @@ class DatasetManager:
                 )
                 self._task_id += 1
 
+    # -- persistence (snapshot / replay / re-attach) -----------------------
+
+    def export_state(self) -> Dict:
+        """Exact-id export for the master journal — unlike the
+        agent-facing ``checkpoint`` above, task ids must survive so
+        replayed doing entries match agent re-reports byte-for-byte."""
+        with self._lock:
+            return {
+                "task_type": self._task_type,
+                "next_task_id": self._task_id,
+                "completed": self._completed,
+                "done_ids": list(self._done_ids[-2048:]),
+                # Full splitter cursor (epoch + streaming offset + RNG
+                # stream position): a post-restart refill must continue
+                # the dead master's shard sequence, not restart it.
+                "splitter": self._splitter.export_state(),
+                "todo": [
+                    {
+                        "task_id": t.task_id,
+                        "task_type": t.task_type,
+                        "shard": _shard_dict(t.shard),
+                    }
+                    for t in self.todo
+                ],
+                "doing": [
+                    {
+                        "task_id": d.task.task_id,
+                        "node_id": d.node_id,
+                        "task_type": d.task.task_type,
+                        "shard": _shard_dict(d.task.shard),
+                    }
+                    for d in self.doing.values()
+                ],
+            }
+
+    def import_state(self, state: Dict) -> None:
+        with self._lock:
+            self._task_id = int(state.get("next_task_id", 0))
+            self._completed = int(state.get("completed", 0))
+            self._done_ids = list(state.get("done_ids") or [])
+            self._splitter.import_state(state.get("splitter") or {})
+            self.todo = [
+                Task(
+                    task_id=int(t["task_id"]),
+                    task_type=t.get("task_type", self._task_type),
+                    shard=_shard_from(t.get("shard") or {}),
+                )
+                for t in state.get("todo") or []
+            ]
+            self.doing = {}
+            for d in state.get("doing") or []:
+                task = Task(
+                    task_id=int(d["task_id"]),
+                    task_type=d.get("task_type", self._task_type),
+                    shard=_shard_from(d.get("shard") or {}),
+                )
+                # unconfirmed until the owner re-reports (or the grace
+                # deadline re-queues it)
+                self.doing[task.task_id] = DoingTask(
+                    task, int(d.get("node_id", -1)), time.time(),
+                    confirmed=False,
+                )
+
+    def apply_journal(self, kind: str, data: Dict) -> None:
+        """Replay one WAL record. Idempotent against the snapshot."""
+        with self._lock:
+            task_id = int(data.get("task_id", -1))
+            if kind == "task.refill":
+                # Re-run the seeded splitter up to the journaled task-id
+                # watermark: identical shards, identical sequential ids
+                # (works for epoch splitters AND the streaming one,
+                # whose cursor lives outside `epoch`).
+                target = int(data.get("next_task_id", 0))
+                while (
+                    self._task_id < target
+                    and not self._splitter.epoch_finished()
+                ):
+                    made = self._splitter.create_shards()
+                    if not made:
+                        break  # exhausted splitter can't reach the mark
+                    for shard in made:
+                        self.todo.append(
+                            Task(
+                                task_id=self._task_id,
+                                task_type=self._task_type,
+                                shard=shard,
+                            )
+                        )
+                        self._task_id += 1
+            elif kind == "task.issue":
+                if task_id in self.doing or task_id in self._done_ids:
+                    return
+                match = next(
+                    (t for t in self.todo if t.task_id == task_id), None
+                )
+                if match is not None:
+                    self.todo.remove(match)
+                    task = match
+                else:
+                    task = Task(
+                        task_id=task_id,
+                        task_type=data.get("task_type", self._task_type),
+                        shard=_shard_from(data.get("shard") or {}),
+                    )
+                    self._task_id = max(self._task_id, task_id + 1)
+                self.doing[task_id] = DoingTask(
+                    task, int(data.get("node_id", -1)), time.time(),
+                    confirmed=False,
+                )
+            elif kind == "task.done":
+                doing = self.doing.pop(task_id, None)
+                if bool(data.get("success")):
+                    if task_id not in self._done_ids:
+                        self._complete_id(task_id)
+                elif doing is not None:
+                    self.todo.insert(0, doing.task)
+
+    def confirm_tasks(self, node_id: int, task_ids: List[int]) -> int:
+        """An agent re-asserted the shards it holds after a master
+        restart: confirm those, and immediately requeue any other
+        replayed doing entry of the SAME node — the worker does not
+        hold it (finished-but-unacked or never received), so waiting
+        for the grace deadline would only stall redelivery. Returns the
+        number of confirmed tasks."""
+        claimed = set(task_ids)
+        confirmed = 0
+        with self._lock:
+            for tid in list(self.doing):
+                doing = self.doing[tid]
+                if doing.node_id != node_id:
+                    continue
+                if tid in claimed:
+                    if not doing.confirmed:
+                        doing.confirmed = True
+                        confirmed += 1
+                elif not doing.confirmed:
+                    del self.doing[tid]
+                    self.todo.insert(0, doing.task)
+                    self._record(
+                        "task.done", {"task_id": tid, "success": False}
+                    )
+                    logger.info(
+                        "requeued unclaimed task %s of node %s on %s "
+                        "after master restart",
+                        tid, node_id, self.dataset_name,
+                    )
+        return confirmed
+
+    def reconcile_unconfirmed(self) -> int:
+        """Grace expired: requeue every still-unconfirmed doing entry
+        (its node never re-attached). Returns how many were requeued."""
+        with self._lock:
+            stale = [
+                tid for tid, d in self.doing.items() if not d.confirmed
+            ]
+            for tid in stale:
+                doing = self.doing.pop(tid)
+                self.todo.insert(0, doing.task)
+                self._record("task.done", {"task_id": tid, "success": False})
+            if stale:
+                logger.warning(
+                    "requeued %s unconfirmed tasks on %s after the "
+                    "re-attach grace expired",
+                    len(stale), self.dataset_name,
+                )
+            return len(stale)
+
 
 class TaskManager:
     """All datasets of the job (reference task_manager.py:35)."""
 
     def __init__(self, task_timeout_s: float = 1800.0):
         self._datasets: Dict[str, DatasetManager] = {}
+        self._dataset_params: Dict[str, Dict] = {}
         self._lock = threading.Lock()
         self._task_timeout_s = task_timeout_s
         self._worker_restart_callbacks = []
+        self._journal = None
+        self._reattach_deadline = 0.0
+
+    def set_journal(self, journal) -> None:
+        with self._lock:
+            self._journal = journal
+            for ds in self._datasets.values():
+                ds.journal = journal
 
     def new_dataset(self, params: comm.DatasetShardParams) -> None:
+        self._new_dataset_dict(
+            {
+                "dataset_name": params.dataset_name,
+                "batch_size": params.batch_size,
+                "num_epochs": params.num_epochs,
+                "dataset_size": params.dataset_size,
+                "shuffle": bool(params.shuffle),
+                "num_minibatches_per_shard": params.num_minibatches_per_shard,
+                "storage_type": params.storage_type,
+                "task_type": params.task_type,
+            }
+        )
+
+    def _new_dataset_dict(self, params: Dict, journal: bool = True) -> None:
         from .dataset_splitter import new_dataset_splitter
 
         with self._lock:
-            if params.dataset_name in self._datasets:
+            name = params["dataset_name"]
+            if name in self._datasets:
                 return
             shard_size = max(
-                1, params.batch_size * params.num_minibatches_per_shard
+                1,
+                int(params.get("batch_size", 0))
+                * int(params.get("num_minibatches_per_shard", 2)),
             )
             splitter = new_dataset_splitter(
-                params.storage_type or "table",
-                params.dataset_name,
-                params.dataset_size,
+                params.get("storage_type") or "table",
+                name,
+                int(params.get("dataset_size", 0)),
                 shard_size,
-                num_epochs=params.num_epochs,
-                shuffle=params.shuffle,
+                num_epochs=int(params.get("num_epochs", 1)),
+                shuffle=bool(params.get("shuffle", False)),
             )
-            self._datasets[params.dataset_name] = DatasetManager(
-                params.dataset_name, splitter, params.task_type
+            ds = DatasetManager(
+                name, splitter, params.get("task_type", "training")
             )
-            logger.info("created dataset manager %s", params.dataset_name)
+            ds.journal = self._journal
+            self._datasets[name] = ds
+            self._dataset_params[name] = dict(params)
+            if journal and self._journal is not None:
+                self._journal("task.dataset", dict(params))
+            logger.info("created dataset manager %s", name)
 
     def get_dataset(self, name: str) -> Optional[DatasetManager]:
         with self._lock:
@@ -231,3 +499,57 @@ class TaskManager:
         ds = self.get_dataset(dataset_name)
         if ds is not None:
             ds.restore_checkpoint(content)
+
+    # -- persistence (snapshot / replay / re-attach) -----------------------
+
+    def export_state(self) -> Dict:
+        with self._lock:
+            datasets = dict(self._datasets)
+            params = {k: dict(v) for k, v in self._dataset_params.items()}
+        return {
+            "params": params,
+            "datasets": {
+                name: ds.export_state() for name, ds in datasets.items()
+            },
+        }
+
+    def import_state(self, state: Dict) -> None:
+        for name, params in (state.get("params") or {}).items():
+            self._new_dataset_dict(dict(params), journal=False)
+        for name, ds_state in (state.get("datasets") or {}).items():
+            ds = self.get_dataset(name)
+            if ds is not None:
+                ds.import_state(ds_state)
+
+    def apply_journal(self, kind: str, data: Dict) -> None:
+        if kind == "task.dataset":
+            self._new_dataset_dict(dict(data), journal=False)
+            return
+        ds = self.get_dataset(data.get("dataset", ""))
+        if ds is not None:
+            ds.apply_journal(kind, data)
+
+    def begin_reattach(self, grace_s: float) -> None:
+        """Arm the post-replay reconfirmation window."""
+        with self._lock:
+            self._reattach_deadline = time.time() + max(0.0, grace_s)
+
+    def confirm_tasks(
+        self, node_id: int, dataset_name: str, task_ids: List[int]
+    ) -> int:
+        ds = self.get_dataset(dataset_name)
+        if ds is None:
+            return 0
+        return ds.confirm_tasks(node_id, task_ids)
+
+    def reconcile_unconfirmed(self) -> int:
+        """Called from the master run loop: once the re-attach grace has
+        expired, requeue in-flight shards whose owners never re-reported."""
+        with self._lock:
+            if not self._reattach_deadline:
+                return 0
+            if time.time() < self._reattach_deadline:
+                return 0
+            self._reattach_deadline = 0.0
+            datasets = list(self._datasets.values())
+        return sum(ds.reconcile_unconfirmed() for ds in datasets)

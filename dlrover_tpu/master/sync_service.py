@@ -4,6 +4,12 @@ Protocol: every member calls ``join``; the barrier finishes when
 ``expected`` members have joined (``expected`` defaults to the job's
 worker count, settable per barrier). Members then poll ``is_finished``.
 ``finish`` force-completes a barrier (master/admin path).
+
+Crash tolerance: with the master journal attached every membership
+mutation is WAL'd and the full barrier state rides the snapshot, so a
+restarted master answers ``is_finished`` for barriers that completed
+before the crash instead of silently dropping them (pre-journal, every
+in-flight barrier wedged its members until their own timeouts).
 """
 
 import threading
@@ -17,21 +23,29 @@ class SyncService:
         self._expected: Dict[str, int] = {}
         self._finished: Set[str] = set()
         self._default_expected = default_expected
+        self.journal = None  # set by MasterPersistence.attach
+
+    def _record(self, kind: str, payload: Dict) -> None:
+        if self.journal is not None:
+            self.journal(kind, payload)
 
     def set_default_expected(self, count: int) -> None:
         with self._lock:
             self._default_expected = count
+            self._record("sync.default", {"count": count})
 
     def set_expected(self, sync_name: str, count: int) -> None:
         with self._lock:
             self._expected[sync_name] = count
             self._maybe_finish(sync_name)
+            self._record("sync.expected", {"name": sync_name, "count": count})
 
     def join(self, sync_name: str, node_id: int) -> bool:
         """Register a member; returns True if the barrier is now finished."""
         with self._lock:
             self._syncs.setdefault(sync_name, set()).add(node_id)
             self._maybe_finish(sync_name)
+            self._record("sync.join", {"name": sync_name, "node": node_id})
             return sync_name in self._finished
 
     def _maybe_finish(self, sync_name: str) -> None:
@@ -42,8 +56,31 @@ class SyncService:
     def finish(self, sync_name: str) -> bool:
         with self._lock:
             self._finished.add(sync_name)
+            self._record("sync.finish", {"name": sync_name})
             return True
 
     def is_finished(self, sync_name: str) -> bool:
         with self._lock:
             return sync_name in self._finished
+
+    # -- persistence (snapshot / replay) -----------------------------------
+
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {
+                "default_expected": self._default_expected,
+                "expected": dict(self._expected),
+                "syncs": {k: sorted(v) for k, v in self._syncs.items()},
+                "finished": sorted(self._finished),
+            }
+
+    def import_state(self, state: Dict) -> None:
+        with self._lock:
+            self._default_expected = int(state.get("default_expected", 0))
+            self._expected = {
+                k: int(v) for k, v in (state.get("expected") or {}).items()
+            }
+            self._syncs = {
+                k: set(v) for k, v in (state.get("syncs") or {}).items()
+            }
+            self._finished = set(state.get("finished") or [])

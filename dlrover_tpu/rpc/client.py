@@ -23,6 +23,13 @@ from ..common.serialize import dumps, loads
 from .server import SERVICE_NAME, _identity
 
 
+class MasterEpochFenced(ConnectionError):
+    """A response carried an OLDER master epoch than this client has
+    already observed: a stale in-flight answer from a dead master
+    incarnation racing the restarted one. Fenced (dropped) and retried —
+    the retry reaches the live master and observes the current epoch."""
+
+
 class MasterTransport:
     def get(self, payload: bytes) -> bytes:
         raise NotImplementedError
@@ -130,6 +137,14 @@ class MasterClient:
         # lockstep (a whole fleet retrying a recovering master at the
         # same instants is the thundering herd backoff exists to break).
         self._rng = random.Random(os.getpid() ^ id(self))
+        # Master-epoch fence (master/persistence.py): the highest boot
+        # epoch observed on any response. A bump means the master
+        # restarted — listeners (agent re-attach, shard re-reports)
+        # fire once per bump; an older epoch is a stale in-flight
+        # response and is fenced.
+        self._seen_epoch = 0
+        self._epoch_lock = threading.Lock()
+        self._epoch_listeners: List[Any] = []
 
     # -- low-level verbs ---------------------------------------------------
 
@@ -164,6 +179,7 @@ class MasterClient:
                 raw = fn(payload)
                 resp = loads(raw)
                 if isinstance(resp, comm.BaseResponse):
+                    self._observe_epoch(getattr(resp, "master_epoch", 0))
                     if not resp.success and resp.reason:
                         logger.debug("master rejected %s: %s", verb, resp.reason)
                     return loads(resp.data) if resp.data else resp
@@ -173,6 +189,56 @@ class MasterClient:
         raise ConnectionError(
             f"master {verb} failed after {self._retries} tries: {last_err!r}"
         )
+
+    # -- master-epoch fence ------------------------------------------------
+
+    @property
+    def master_epoch(self) -> int:
+        """Highest master boot epoch observed (0 = none seen yet)."""
+        with self._epoch_lock:
+            return self._seen_epoch
+
+    def add_epoch_listener(self, callback) -> None:
+        """``callback(old_epoch, new_epoch)`` fires once per observed
+        epoch bump (a restarted master). Callbacks run on the calling
+        RPC's thread with no client lock held; they may issue RPCs on
+        this client (a nested call sees the already-recorded epoch and
+        cannot re-fire), but must not block indefinitely."""
+        self._epoch_listeners.append(callback)
+
+    def _observe_epoch(self, epoch: int) -> None:
+        if not epoch:
+            return  # journal-less master: no fencing
+        with self._epoch_lock:
+            prev = self._seen_epoch
+            if prev and epoch < prev:
+                raise MasterEpochFenced(
+                    f"stale response from master epoch {epoch} "
+                    f"(current {prev})"
+                )
+            self._seen_epoch = epoch
+        if prev and epoch > prev:
+            logger.warning(
+                "master epoch %s -> %s observed: master restarted",
+                prev,
+                epoch,
+            )
+            try:
+                # Chaos hook: perturb the bump-observation path — the
+                # injected error is retried like any transport failure,
+                # but the listeners below must still fire (finally).
+                faults.inject(
+                    "rpc.client.epoch",
+                    old=prev,
+                    new=epoch,
+                    node_id=self.node_id,
+                )
+            finally:
+                for callback in list(self._epoch_listeners):
+                    try:
+                        callback(prev, epoch)
+                    except Exception as e:  # noqa: BLE001 — isolate listeners
+                        logger.warning("epoch listener failed: %s", e)
 
     def get(self, message: Any) -> Any:
         return self._call("get", message)
@@ -362,6 +428,20 @@ class MasterClient:
                 task_id=task_id,
                 success=success,
                 reason=reason,
+            )
+        )
+
+    def report_task_inflight(
+        self, dataset_name: str, task_ids: List[int]
+    ) -> None:
+        """Re-assert the shard tasks this node still holds (sent after a
+        master-epoch bump so the replayed master confirms real in-flight
+        shards and requeues the rest exactly once)."""
+        self.report(
+            comm.TaskInFlightReport(
+                node_id=self.node_id,
+                dataset_name=dataset_name,
+                task_ids=list(task_ids),
             )
         )
 

@@ -116,7 +116,11 @@ class HttpMasterServer:
         logger.info("HTTP master server listening on :%s", self.port)
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() handshakes with serve_forever via an event the loop
+        # itself manages — on a server that was never started it would
+        # block forever (the event is never set).
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
 
 

@@ -245,6 +245,20 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["recovery_mttr_delta_s"] = 11.8
     extra["recovery_cold_compile_s"] = 12.1
     extra["recovery_warm_compile_s"] = 0.3
+    # master crash tolerance (docs/recovery.md master failover): the
+    # MTTR + goodput scalars must survive in-line; the full drill dict
+    # (epoch, replay_s, restart audit) is sidecar-class
+    extra["master_kill"] = {
+        "master_mttr_s": 3.4, "master_kill_goodput": 0.91,
+        "steps": 42, "epoch": 2, "worker_restarts": 0,
+        "kv_survived": True, "master_replay_s": 0.012,
+        "master_boot_samples": 1, "reattach_s": 0.05,
+        "rdzv_s": 0.0, "restore_s": 0.0, "compile_s": 0.0,
+        "first_step_s": 0.0, "recovery_samples": 0,
+    }
+    extra["master_mttr_s"] = 3.4
+    extra["master_kill_goodput"] = 0.91
+    extra["master_kill_worker_restarts"] = 0
     # serving-fleet section (docs/serving_fleet.md): the SLO trio must
     # survive in-line; the supporting scalars may shrink to the sidecar
     extra["fleet_requests_per_s"] = 8.42
@@ -336,16 +350,21 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["storm_slice_mttr_s"] == extra["storm_slice_mttr_s"]
     assert slim["storm_slice_goodput"] == extra["storm_slice_goodput"]
     assert slim["storm_goodput"] == extra["storm_goodput"]
-    # the MTTR phase breakdown and the warm-vs-cold A/B verdict ride
-    # the line; per-leg details and the two full storm dicts are
-    # sidecar-only
+    # the MTTR phase verdict keys and the warm-vs-cold A/B verdict ride
+    # the line; per-leg details, the two full storm dicts, and the
+    # demoted breakdown scalars (storm_restore_s / storm_first_step_s —
+    # recoverable from the sidecar's goodput_storm dict) are sidecar-only
     for key in (
-        "storm_rdzv_s", "storm_restore_s", "storm_compile_s",
-        "storm_first_step_s", "recovery_mttr_delta_s",
+        "storm_rdzv_s", "storm_compile_s", "recovery_mttr_delta_s",
         "recovery_warm_compile_s",
     ):
         assert slim[key] == extra[key], key
     assert "recovery_ab" not in slim
+    # the master-kill SLO pair rides the line; the full drill dict is
+    # sidecar-only
+    assert slim["master_mttr_s"] == extra["master_mttr_s"]
+    assert slim["master_kill_goodput"] == extra["master_kill_goodput"]
+    assert "master_kill" not in slim
     # the fleet SLO trio rides the line (fleet_2v1_x and the per-rep
     # rate are sidecar-recoverable, like the A/B per-leg scalars)
     for key in (

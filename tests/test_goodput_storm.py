@@ -58,6 +58,29 @@ def test_slice_storm_recovers_via_relaunch_slice(tmp_path):
 
 
 @pytest.mark.slow
+def test_master_kill_storm_scenario(tmp_path):
+    """Master crash tolerance, full shape (docs/recovery.md master
+    failover): real agents + real trainers, the MASTER SIGKILLed
+    mid-storm and restarted against its state journal. The tier-1
+    synthetic twin (scripted agents, no jax) lives in
+    tests/test_master_persistence.py — this subprocess storm carries
+    the production-shaped acceptance: replay + epoch-fenced re-attach
+    with zero worker restarts and a bounded coordination MTTR."""
+    from dlrover_tpu.chaos.scenarios import master_kill
+
+    result = master_kill(str(tmp_path))
+    assert result["fired"] >= 1, result
+    assert result["recovered"], result
+    storm = result["storm"]
+    assert storm["worker_restarts"] == 0, storm
+    assert storm["epoch"] >= 2, storm
+    assert storm["kv_survived"], storm
+    assert storm["master_mttr_s"] <= 60.0, storm
+    # the replay phase is attributed through the recovery spool
+    assert storm.get("master_boot_samples", 0) >= 1, storm
+
+
+@pytest.mark.slow
 def test_goodput_storm_meets_north_star(tmp_path):
     from dlrover_tpu.chaos import run_goodput_storm
 

@@ -85,6 +85,17 @@ def test_auto_config_from_env(monkeypatch):
     assert config.network_check  # ≥4 nodes auto-enables the health check
 
 
+def test_service_type_propagates_into_worker_env(monkeypatch):
+    """Regression: the launcher must carry DLROVER_MASTER_SERVICE_TYPE
+    into the worker env contract — worker_env() re-exports the config
+    field, and the old grpc default silently pointed every trainer of
+    an HTTP-master job at the wrong transport (step reports lost)."""
+    monkeypatch.setenv(NodeEnv.MASTER_SERVICE_TYPE, "http")
+    config = config_from_args(parse_args(["train.py"]))
+    assert config.master_service_type == "http"
+    assert config.worker_env()[NodeEnv.MASTER_SERVICE_TYPE] == "http"
+
+
 def test_wait_pre_check_passes(monkeypatch):
     master = LocalJobMaster(num_workers=1, fresh_context=True)
     master.prepare()

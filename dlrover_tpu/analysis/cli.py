@@ -12,12 +12,73 @@ import subprocess
 import sys
 from typing import List, Optional
 
-from .core import Baseline, find_repo_root, iter_py_files, run_lint
+from .core import Baseline, LintResult, find_repo_root, iter_py_files, run_lint
 from .passes import ALL_PASSES, PASS_BY_ID
 
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json"
 )
+
+# --format json schema version. Findings are keyed for cross-commit
+# diffing: ``rule`` is the same line-number-free identity the baseline
+# matches on (stripped source line or stable token), so a gate can diff
+# two commits' findings without every edit above a site reading as a
+# new violation. Bump on any breaking key change.
+JSON_SCHEMA = "tpurun-lint-findings/1"
+
+
+def findings_json(result: LintResult) -> dict:
+    """The stable machine-readable report: every finding —
+    unsuppressed AND suppressed — as (pass, file, line, rule,
+    suppression state), deterministically sorted."""
+    findings = [
+        {
+            "pass": v.pass_id,
+            "file": v.path,
+            "line": v.line,
+            "rule": v.code,
+            "message": v.message,
+            "suppressed": False,
+            "reason": "",
+        }
+        for v in result.violations
+    ] + [
+        {
+            "pass": v.pass_id,
+            "file": v.path,
+            "line": v.line,
+            "rule": v.code,
+            "message": v.message,
+            "suppressed": True,
+            "reason": s.reason,
+        }
+        for v, s in result.suppressed
+    ]
+    findings.sort(
+        key=lambda f: (f["file"], f["line"], f["pass"], f["rule"], f["suppressed"])
+    )
+    return {
+        "schema": JSON_SCHEMA,
+        "findings": findings,
+        "counts": {
+            "violations": len(result.violations),
+            "suppressed": len(result.suppressed),
+            "baselined": result.baselined,
+            "stale_baseline": len(result.stale_baseline),
+            "errors": len(result.errors),
+        },
+        "stale_baseline": [
+            {
+                "pass": e.pass_id,
+                "file": e.path,
+                "rule": e.code,
+                "reason": e.reason,
+            }
+            for e in result.stale_baseline
+        ],
+        "errors": list(result.errors),
+        "clean": result.clean,
+    }
 
 
 def changed_files(root: str, ref: str) -> List[str]:
@@ -57,7 +118,9 @@ def build_parser() -> argparse.ArgumentParser:
             "order, thread/Popen lifecycle, no swallowed exceptions, "
             "no host syncs in hot paths, Context-sourced RPC "
             "deadlines, the DLROVER_* knob registry, chaos injection "
-            "coverage, HTTP endpoint conformance). See "
+            "coverage, HTTP endpoint conformance, the SPMD mesh-axis "
+            "registry, checkpoint reshard-rule coverage, WAL "
+            "record/replay conformance, the master-epoch fence). See "
             "docs/analysis.md."
         ),
     )
@@ -212,10 +275,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             and any(f == s or f.startswith(s + os.sep) for s in scope)
         ]
         if not lint_paths:
+            # stderr: --format json owns stdout (the machine contract)
             print(
                 f"tpurun-lint: no Python files changed vs {args.changed} "
-                f"under {', '.join(args.paths)}"
+                f"under {', '.join(args.paths)}",
+                file=sys.stderr,
             )
+            if args.format == "json":
+                empty = LintResult([], [], 0, [], [])
+                print(json.dumps(findings_json(empty), indent=2, sort_keys=True))
             return 0
         # repo-wide passes need the whole tree: meaningless on a subset
         skipped = [lp.PASS_ID for lp in passes if not hasattr(lp, "check_file")]
@@ -233,7 +301,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if skipped:
             print(
                 "tpurun-lint: --changed skips repo-wide passes: "
-                + ", ".join(skipped)
+                + ", ".join(skipped),
+                file=sys.stderr,
             )
 
     result = run_lint(
@@ -255,21 +324,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.format == "json":
-        print(
-            json.dumps(
-                {
-                    "violations": [v.__dict__ for v in result.violations],
-                    "suppressed": len(result.suppressed),
-                    "baselined": result.baselined,
-                    "stale_baseline": [
-                        e.__dict__ for e in result.stale_baseline
-                    ],
-                    "errors": result.errors,
-                    "clean": result.clean,
-                },
-                indent=2,
-            )
-        )
+        print(json.dumps(findings_json(result), indent=2, sort_keys=True))
         return 0 if result.clean else 1
 
     for v in result.violations:

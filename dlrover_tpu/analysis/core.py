@@ -401,6 +401,16 @@ def run_lint(
             continue
         for v in repo_check(root, contexts):
             c = ctx_by_rel.get(v.path)
+            if c is None and v.line:
+                # hybrid repo passes scan beyond the linted subset
+                # (mesh-axes under --changed): a line-anchored finding
+                # in an un-linted file must still honor that file's
+                # inline suppressions, or the pre-commit fast path
+                # reports sites the full gate accepts
+                p = os.path.join(root, v.path.replace("/", os.sep))
+                c = FileContext.parse(p, v.path)
+                if c is not None:
+                    ctx_by_rel[v.path] = c
             s = c.suppression_for(v.pass_id, v.line) if c and v.line else None
             if s is not None:
                 if not s.reason:

@@ -9,11 +9,15 @@ from . import (
     blocking_under_lock,
     endpoint_conformance,
     env_knobs,
+    epoch_fence,
     exception_swallow,
     host_sync,
     import_purity,
     injection_coverage,
+    journal_conformance,
     lock_order,
+    mesh_axes,
+    reshard_coverage,
     rpc_deadline,
     thread_lifecycle,
 )
@@ -29,6 +33,10 @@ ALL_PASSES = [
     env_knobs,
     injection_coverage,
     endpoint_conformance,
+    mesh_axes,
+    reshard_coverage,
+    journal_conformance,
+    epoch_fence,
 ]
 
 PASS_BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

@@ -1,0 +1,147 @@
+"""epoch-fence: every RPC response stamps the master epoch; every
+client entry rides the fenced path.
+
+Incident (PR 10): the master-kill drill works because BOTH sides of the
+fence hold: every servicer response carries ``master_epoch`` (stamped
+by the ``_respond`` helper) and every client RPC funnels through
+``MasterClient._call``, whose ``_observe_epoch`` detects restarts,
+fires the re-attach listeners exactly once per bump, and fences stale
+in-flight answers from a dead incarnation. Nothing but convention stops
+a NEW endpoint from constructing a bare ``BaseResponse`` (the bump is
+invisible to its callers — agents poll a restarted master forever) or
+a new client from calling a transport directly (stale responses from
+the dead master are believed). The rail must hold through the
+resharding refactor's new control-plane surface.
+
+Rule (per file):
+
+- every ``BaseResponse(...)`` construction must pass ``master_epoch=``
+  explicitly — via the servicer's ``_respond`` stamping helper in
+  practice. A journal-less service stamps 0 (= unfenced) as an
+  explicit, greppable decision instead of an accidental default;
+- a ``_transport`` verb access (``self._transport.get/report`` —
+  called directly OR aliased to a bound method, the
+  ``MasterClient._call`` idiom) may only appear in a function that
+  also calls ``_observe_epoch`` — the fenced path;
+- a ``*Transport`` class may only be instantiated inside
+  ``MasterClient`` — anything else is a client-side RPC entry that
+  bypasses the fence entirely.
+"""
+
+import ast
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from ..core import FileContext, Violation, call_name
+
+PASS_ID = "epoch-fence"
+
+_TRANSPORT_CLASS_RE = re.compile(r"^[A-Z]\w*Transport$")
+_TRANSPORT_VERBS = {"get", "report"}
+
+
+def _chain_attrs(expr: ast.AST) -> List[str]:
+    """Attribute names along ``a.b.c`` (leftmost name excluded)."""
+    out: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        out.append(expr.attr)
+        expr = expr.value
+    return out
+
+
+def _function_calls(fn: ast.AST) -> set:
+    """Trailing names of every call inside ``fn`` (nested defs
+    included: a listener closure calling _observe_epoch still fences)."""
+    return {
+        call_name(n)
+        for n in ast.walk(fn)
+        if isinstance(n, ast.Call)
+    }
+
+
+def check_file(ctx: FileContext) -> Iterable[Violation]:
+    # enclosing-scope maps, innermost-first
+    func_stack: List[ast.AST] = []
+    class_stack: List[ast.ClassDef] = []
+
+    def visit(node: ast.AST):
+        is_func = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        is_class = isinstance(node, ast.ClassDef)
+        if is_func:
+            func_stack.append(node)
+        if is_class:
+            class_stack.append(node)
+        try:
+            if isinstance(node, ast.Call):
+                yield from _check_call(node)
+            elif isinstance(node, ast.Attribute):
+                yield from _check_attribute(node)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+        finally:
+            if is_func:
+                func_stack.pop()
+            if is_class:
+                class_stack.pop()
+
+    def _check_call(node: ast.Call):
+        name = call_name(node)
+        # 1. BaseResponse must stamp master_epoch
+        if name == "BaseResponse":
+            kwargs = {k.arg for k in node.keywords}
+            if "master_epoch" not in kwargs:
+                yield Violation(
+                    PASS_ID,
+                    ctx.rel,
+                    node.lineno,
+                    "BaseResponse constructed without master_epoch= — "
+                    "an unstamped response is invisible to the client "
+                    "fence (agents cannot detect this service's "
+                    "restart); route it through a _respond helper that "
+                    "stamps self._epoch (0 = journal-less, as an "
+                    "explicit decision)",
+                    code=ctx.code_at(node.lineno),
+                )
+        # 3. transports are only built inside MasterClient
+        if _TRANSPORT_CLASS_RE.match(name):
+            yield from _check_transport_ctor(node, name)
+
+    def _check_attribute(node: ast.Attribute):
+        # 2. raw transport verbs only on the fenced path — matched on
+        # the ATTRIBUTE access so bound-method aliasing
+        # (``fn = self._transport.get; fn(payload)``, the
+        # MasterClient._call idiom) cannot evade the fence
+        if (
+            node.attr in _TRANSPORT_VERBS
+            and "_transport" in _chain_attrs(node.value)
+        ):
+            fn = func_stack[-1] if func_stack else None
+            if fn is None or "_observe_epoch" not in _function_calls(fn):
+                yield Violation(
+                    PASS_ID,
+                    ctx.rel,
+                    node.lineno,
+                    "raw transport call bypasses the epoch fence — the "
+                    "enclosing function never calls _observe_epoch, so "
+                    "a stale response from a dead master incarnation "
+                    "is believed; go through MasterClient._call",
+                    code=ctx.code_at(node.lineno),
+                )
+
+    def _check_transport_ctor(node: ast.Call, name: str):
+        owner: Optional[ast.ClassDef] = (
+            class_stack[-1] if class_stack else None
+        )
+        if owner is None or owner.name != "MasterClient":
+            yield Violation(
+                PASS_ID,
+                ctx.rel,
+                node.lineno,
+                f"{name} instantiated outside MasterClient — a "
+                "client-side RPC entry that never observes the "
+                "master epoch; use MasterClient (it owns the "
+                "fence, retry and re-attach machinery)",
+                code=ctx.code_at(node.lineno),
+            )
+
+    yield from visit(ctx.tree)

@@ -1,0 +1,233 @@
+"""journal-conformance: WAL record kinds and snapshot components agree.
+
+Incident (PR 10): the master's crash tolerance rests on string-matched
+dispatch — components journal ``self._record("kv.set", ...)`` literals
+and ``master/persistence.py::apply_wal_record`` replays them through an
+``elif kind == "kv.set"`` chain, with an ``else: logger.warning`` for
+anything unknown. A record kind that drifts from its replay branch (or
+a new component that journals a kind nobody applies) REPLAYS AS A
+SILENT NO-OP: the master boots "successfully" and has lost state — the
+exact failure mode the journal exists to prevent, detectable only by a
+kill drill that happens to cover the lost component. The elastic
+resharding refactor (ROADMAP items 1/4) will add record kinds to this
+dispatcher.
+
+Rule (repo-wide, two-sided — the endpoint-conformance idiom applied to
+the journal protocol):
+
+- *Recorded kinds* are collected from recorder calls — functions named
+  ``record``/``_record``/``journal``/``_journal`` whose first argument
+  is a dotted-kind string literal (``"kv.set"``).
+- *Applied kinds* are collected from replay dispatchers — ``kind ==
+  "..."`` / ``kind in (...)`` comparisons inside functions named
+  ``apply_wal_record``/``apply_journal``.
+- A recorded kind with **no replay branch** errors at the recorder site
+  (the silent-no-op class); a replay branch for a kind **nothing
+  records** errors at the comparison site (dead or drifted dispatch).
+- Every class that implements one of ``export_state``/``import_state``
+  must implement the other — a component captured into the snapshot
+  but not restorable (or vice versa) loses state exactly once, on the
+  boot that needed it.
+- ``capture_master_state``'s snapshot keys must match
+  ``restore_master_state``'s reads: a component added to capture but
+  not restore is exported dead weight, one added to restore but not
+  capture replays nothing.
+"""
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import FileContext, Violation, call_name
+
+PASS_ID = "journal-conformance"
+
+_RECORDER_NAMES = {"record", "_record", "journal", "_journal"}
+_APPLIER_NAMES = {"apply_wal_record", "apply_journal"}
+_KIND_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+
+
+def _dotted_kind(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        if _KIND_RE.match(expr.value):
+            return expr.value
+    return ""
+
+
+def collect_recorded(ctx: FileContext) -> List[Tuple[str, int]]:
+    """(kind, line) for every journal-recorder call in this file."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if call_name(node) not in _RECORDER_NAMES:
+            continue
+        kind = _dotted_kind(node.args[0])
+        if kind:
+            out.append((kind, node.lineno))
+    return out
+
+
+def collect_applied(ctx: FileContext) -> List[Tuple[str, int]]:
+    """(kind, line) for every replay-dispatch comparison in this file."""
+    out: List[Tuple[str, int]] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in _APPLIER_NAMES:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, ast.Eq):
+                    kind = _dotted_kind(comp)
+                    if kind:
+                        out.append((kind, node.lineno))
+                elif isinstance(op, ast.In) and isinstance(
+                    comp, (ast.Tuple, ast.List, ast.Set)
+                ):
+                    for e in comp.elts:
+                        kind = _dotted_kind(e)
+                        if kind:
+                            out.append((kind, node.lineno))
+    return out
+
+
+def _class_state_methods(ctx: FileContext) -> List[Tuple[str, int, Set[str]]]:
+    """(class name, line, {state methods defined}) per class."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        defined = {
+            st.name
+            for st in node.body
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and st.name in ("export_state", "import_state")
+        }
+        if defined:
+            out.append((node.name, node.lineno, defined))
+    return out
+
+
+def _capture_keys(ctx: FileContext) -> Tuple[Set[str], int]:
+    """Top-level keys of the dict ``capture_master_state`` returns."""
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "capture_master_state":
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and isinstance(
+                    node.value, ast.Dict
+                ):
+                    keys = {
+                        k.value
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                    return keys, fn.lineno
+            return set(), fn.lineno
+    return set(), 0
+
+
+def _restore_keys(ctx: FileContext) -> Tuple[Set[str], int]:
+    """String keys ``restore_master_state`` reads off its state arg."""
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "restore_master_state":
+            if len(fn.args.args) < 2:
+                return set(), fn.lineno
+            state_name = fn.args.args[1].arg
+            keys: Set[str] = set()
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and call_name(node) == "get"
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == state_name
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    keys.add(node.args[0].value)
+                elif (
+                    isinstance(node, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == state_name
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    keys.add(node.slice.value)
+            return keys, fn.lineno
+    return set(), 0
+
+
+def repo_check(
+    root: str, contexts: List[FileContext]
+) -> Iterable[Violation]:
+    recorded: Dict[str, List[Tuple[str, int]]] = {}
+    applied: Dict[str, List[Tuple[str, int]]] = {}
+    for ctx in contexts:
+        for kind, line in collect_recorded(ctx):
+            recorded.setdefault(kind, []).append((ctx.rel, line))
+        for kind, line in collect_applied(ctx):
+            applied.setdefault(kind, []).append((ctx.rel, line))
+
+    # Only meaningful when a replay dispatcher is in the linted tree:
+    # a subset lint of, say, models/ sees recorder helpers but no
+    # appliers — every kind would read as unreplayable.
+    if applied:
+        for kind in sorted(set(recorded) - set(applied)):
+            rel, line = recorded[kind][0]
+            yield Violation(
+                PASS_ID, rel, line,
+                f"journaled record kind {kind!r} has no branch in "
+                "apply_wal_record/apply_journal — it replays as a "
+                "silent no-op and the master loses this state on "
+                "reboot; add the replay branch",
+                code=f"recorded:{kind}",
+            )
+        for kind in sorted(set(applied) - set(recorded)):
+            rel, line = applied[kind][0]
+            yield Violation(
+                PASS_ID, rel, line,
+                f"replay branch for kind {kind!r} that no recorder "
+                "journals — dead dispatch, or the recorder's literal "
+                "drifted; fix the kind or delete the branch",
+                code=f"applied:{kind}",
+            )
+
+    for ctx in contexts:
+        for cls, line, defined in _class_state_methods(ctx):
+            missing = {"export_state", "import_state"} - defined
+            if missing:
+                yield Violation(
+                    PASS_ID, ctx.rel, line,
+                    f"class {cls} defines {sorted(defined)[0]} but not "
+                    f"{sorted(missing)[0]} — a snapshot component must "
+                    "implement the export_state/import_state pair or "
+                    "its state survives in only one direction",
+                    code=f"pair:{cls}",
+                )
+
+    for ctx in contexts:
+        cap, cap_line = _capture_keys(ctx)
+        res, res_line = _restore_keys(ctx)
+        if not cap_line or not res_line:
+            continue
+        for key in sorted(cap - res):
+            yield Violation(
+                PASS_ID, ctx.rel, res_line,
+                f"snapshot captures component {key!r} but "
+                "restore_master_state never reads it — the exported "
+                "state is dead weight and the component boots empty",
+                code=f"capture-only:{key}",
+            )
+        for key in sorted(res - cap):
+            yield Violation(
+                PASS_ID, ctx.rel, cap_line,
+                f"restore_master_state reads component {key!r} that "
+                "capture_master_state never writes — it always "
+                "restores empty",
+                code=f"restore-only:{key}",
+            )

@@ -1,0 +1,327 @@
+"""reshard-coverage: every saved state-tree category has a reshard rule.
+
+Incident (ROADMAP items 1/4 prep): the flash-checkpoint image is what
+carries live state between shardings on an elastic world change, and
+the durable tier's whole point is "restore INTO a different DP×TP×PP
+sharding than the save". Today the restore path reshards whatever the
+template's shardings say — there is no table stating what SHOULD happen
+to each category of saved state on a rung change, so a new category
+(a LoRA adapter tree, EMA params, a new optimizer slot family) rides
+along until the first real reshard silently replicates it or crashes
+the restore. The dynamic reshard path will be built against
+``parallel/sharding.py::RESHARD_RULES``; this pass makes the table
+load-bearing before that code exists.
+
+Rule:
+
+- (repo) ``RESHARD_RULES`` must be a pure-literal table; every policy
+  must be one of ``RESHARD_POLICIES``; every axis it references must
+  be a registered mesh axis (``MESH_AXIS_REGISTRY``);
+- (repo) every field of the ``TrainState`` the train loop saves must
+  have a rule — a category on the save path with no restore/reshard
+  rule is the silent-replication class; a rule for a category that no
+  longer exists is stale (tables must not rot);
+- (repo) every mesh axis ``DEFAULT_RULES`` can put on a saved leaf
+  must be covered by every ``respec``/``mirror_params`` rule, and the
+  world ladder's ``ELASTIC_AXES`` must be covered too — otherwise a
+  rung change moves an axis the rule table never answered for;
+- (per file) a dict-literal state tree handed to
+  ``save_to_memory``/``save_to_storage`` may only use categories the
+  table covers, and passing ``extra=`` requires the ``extra`` rule —
+  new save-site categories fail lint at the call site, with a line to
+  suppress on if the category is genuinely out of scope.
+"""
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import FileContext, Violation, call_name, keyword_map
+from .mesh_axes import _literal_assign, _stamp, load_axis_registry
+
+PASS_ID = "reshard-coverage"
+
+_SHARDING_REL = os.path.join("dlrover_tpu", "parallel", "sharding.py")
+_SHARDING_POSIX = "dlrover_tpu/parallel/sharding.py"
+_TRAIN_STEP_REL = os.path.join("dlrover_tpu", "parallel", "train_step.py")
+_TRAIN_STEP_POSIX = "dlrover_tpu/parallel/train_step.py"
+_MESH_REL = os.path.join("dlrover_tpu", "parallel", "mesh.py")
+
+_SAVE_CALLS = {"save_to_memory", "save_to_storage"}
+# policies whose axes set must cover everything a reshard can move
+_SHARDED_POLICIES = {"respec", "mirror_params"}
+
+
+def _literals_from(path: str, names: Tuple[str, ...]) -> Dict[str, object]:
+    """One parse of ``path``, literal-eval of each requested
+    module-level assignment (missing/computed names map to None)."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return {n: None for n in names}
+    out: Dict[str, object] = {}
+    for n in names:
+        node = _literal_assign(tree, n)
+        try:
+            out[n] = ast.literal_eval(node) if node is not None else None
+        except (ValueError, TypeError):
+            out[n] = None
+    return out
+
+
+def _parse_rules(
+    raw: object,
+) -> Optional[Dict[str, Tuple[str, Tuple[str, ...]]]]:
+    if not isinstance(raw, dict):
+        return None
+    try:
+        return {
+            str(k): (str(v[0]), tuple(str(a) for a in v[1]))
+            for k, v in raw.items()
+        }
+    except (TypeError, IndexError):
+        return None
+
+
+def load_tables(root: str) -> Tuple[
+    Optional[Dict[str, Tuple[str, Tuple[str, ...]]]],
+    Tuple[str, ...],
+    Tuple[str, ...],
+]:
+    """(RESHARD_RULES, RESHARD_POLICIES, ELASTIC_AXES) parsed by AST."""
+    lits = _literals_from(
+        os.path.join(root, _SHARDING_REL),
+        ("RESHARD_RULES", "RESHARD_POLICIES", "ELASTIC_AXES"),
+    )
+    return (
+        _parse_rules(lits["RESHARD_RULES"]),
+        tuple(lits["RESHARD_POLICIES"] or ()),
+        tuple(lits["ELASTIC_AXES"] or ()),
+    )
+
+
+def train_state_fields(root: str) -> Optional[List[str]]:
+    """Field names of parallel/train_step.py::TrainState, by AST.
+    None when the file or class is unreadable — callers must NOT treat
+    that as "zero fields" (it would misreport every rule as stale)."""
+    path = os.path.join(root, _TRAIN_STEP_REL)
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "TrainState":
+            return [
+                st.target.id
+                for st in node.body
+                if isinstance(st, ast.AnnAssign)
+                and isinstance(st.target, ast.Name)
+            ]
+    return None
+
+
+def _default_rules_axes(rules: object) -> Set[str]:
+    """Mesh axes a DEFAULT_RULES literal can place on a saved leaf."""
+    axes: Set[str] = set()
+    for entry in rules if isinstance(rules, list) else []:
+        target = entry[1]
+        targets = (
+            tuple(target) if isinstance(target, (tuple, list)) else (target,)
+        )
+        axes.update(t for t in targets if isinstance(t, str))
+    return axes
+
+
+class ReshardCoveragePass:
+    """Stateful so the tables are parsed once per run."""
+
+    pass_id = PASS_ID
+
+    def __init__(self):
+        self._key = None
+        self._rules = None
+        self._policies: Tuple[str, ...] = ()
+        self._elastic: Tuple[str, ...] = ()
+        self._default_axes: Set[str] = set()
+
+    def _ensure(self, root: str):
+        sharding = os.path.join(root, _SHARDING_REL)
+        key = (root, _stamp(sharding))
+        if self._key == key:
+            return
+        self._key = key
+        lits = _literals_from(
+            sharding,
+            (
+                "RESHARD_RULES",
+                "RESHARD_POLICIES",
+                "ELASTIC_AXES",
+                "DEFAULT_RULES",
+            ),
+        )
+        self._rules = _parse_rules(lits["RESHARD_RULES"])
+        self._policies = tuple(lits["RESHARD_POLICIES"] or ())
+        self._elastic = tuple(lits["ELASTIC_AXES"] or ())
+        self._default_axes = _default_rules_axes(lits["DEFAULT_RULES"])
+
+    def _root_of(self, ctx: FileContext) -> Optional[str]:
+        suffix = ctx.rel.replace("/", os.sep)
+        if ctx.path.endswith(suffix):
+            root = ctx.path[: -len(suffix) - 1]
+            if os.path.exists(os.path.join(root, _SHARDING_REL)):
+                return root
+        return None
+
+    # -- per-file ----------------------------------------------------------
+
+    def check_file(self, ctx: FileContext) -> Iterable[Violation]:
+        root = self._root_of(ctx)
+        if root is None:
+            return
+        self._ensure(root)
+        if self._rules is None:
+            return  # table parse failure is reported repo-level
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _SAVE_CALLS:
+                continue
+            state_arg = node.args[1] if len(node.args) > 1 else None
+            if isinstance(state_arg, ast.Dict):
+                for key in state_arg.keys:
+                    if not (
+                        isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                    ):
+                        continue
+                    if key.value not in self._rules:
+                        yield Violation(
+                            PASS_ID,
+                            ctx.rel,
+                            node.lineno,
+                            f"state-tree category {key.value!r} is saved "
+                            "here but parallel/sharding.py RESHARD_RULES "
+                            "has no restore/reshard rule for it — on a "
+                            "world-ladder rung change it silently "
+                            "replicates or breaks the restore; add the "
+                            "rule",
+                            code=ctx.code_at(node.lineno),
+                        )
+            if "extra" in keyword_map(node) and "extra" not in self._rules:
+                yield Violation(
+                    PASS_ID,
+                    ctx.rel,
+                    node.lineno,
+                    "save site passes extra= but RESHARD_RULES has no "
+                    "'extra' rule — the side-channel payload has no "
+                    "declared restore behavior across a reshard",
+                    code=ctx.code_at(node.lineno),
+                )
+
+    # -- repo-level --------------------------------------------------------
+
+    def repo_check(
+        self, root: str, contexts: List[FileContext]
+    ) -> Iterable[Violation]:
+        if not os.path.exists(os.path.join(root, _SHARDING_REL)):
+            return
+        self._ensure(root)
+        if self._rules is None:
+            yield Violation(
+                PASS_ID, _SHARDING_POSIX, 0,
+                "RESHARD_RULES missing or not a pure-literal dict of "
+                "category -> (policy, axes) — the reshard rail cannot "
+                "be statically verified",
+                code="table-parse",
+            )
+            return
+        rules = self._rules
+        registry, _mesh_axes, _err = load_axis_registry(
+            os.path.join(root, _MESH_REL)
+        )
+        mesh_axes = {
+            k for k, v in (registry or {}).items() if v == "mesh"
+        }
+
+        for cat in sorted(rules):
+            policy, axes = rules[cat]
+            if self._policies and policy not in self._policies:
+                yield Violation(
+                    PASS_ID, _SHARDING_POSIX, 0,
+                    f"reshard rule {cat!r} uses unknown policy "
+                    f"{policy!r} (known: {', '.join(self._policies)})",
+                    code=f"policy:{cat}",
+                )
+            for a in axes:
+                if registry is not None and a not in mesh_axes:
+                    yield Violation(
+                        PASS_ID, _SHARDING_POSIX, 0,
+                        f"reshard rule {cat!r} references {a!r}, which "
+                        "is not a registered mesh axis",
+                        code=f"axis:{cat}:{a}",
+                    )
+
+        fields = train_state_fields(root)
+        if fields is None:
+            # NOT zero fields: reporting every rule as "stale; delete
+            # it" against a mid-edit syntax error would be destructive
+            # advice. One parse finding, coverage checks skipped.
+            yield Violation(
+                PASS_ID, _TRAIN_STEP_POSIX, 0,
+                "TrainState unreadable (missing file, syntax error, or "
+                "renamed class) — the reshard coverage/staleness "
+                "checks cannot run; fix parallel/train_step.py",
+                code="trainstate-parse",
+            )
+        else:
+            for f in fields:
+                if f not in rules:
+                    yield Violation(
+                        PASS_ID, _TRAIN_STEP_POSIX, 0,
+                        f"TrainState.{f} rides the checkpoint save path "
+                        "but RESHARD_RULES has no rule for it — "
+                        "'restore into a different sharding' is "
+                        "undefined for this category; add the rule",
+                        code=f"uncovered:{f}",
+                    )
+            known = set(fields) | {"extra"}
+            for cat in sorted(set(rules) - known):
+                yield Violation(
+                    PASS_ID, _SHARDING_POSIX, 0,
+                    f"reshard rule {cat!r} matches no TrainState field "
+                    "and no engine category — stale entry; delete it "
+                    "(the table must not rot)",
+                    code=f"stale:{cat}",
+                )
+
+        reachable = set(self._default_axes)
+        if registry is not None:
+            reachable &= mesh_axes  # unregistered targets are mesh-axes' finding
+        for cat in sorted(rules):
+            policy, axes = rules[cat]
+            if policy not in _SHARDED_POLICIES:
+                continue
+            for a in sorted(reachable - set(axes)):
+                yield Violation(
+                    PASS_ID, _SHARDING_POSIX, 0,
+                    f"DEFAULT_RULES can shard a saved leaf over {a!r} "
+                    f"but reshard rule {cat!r} does not cover that axis "
+                    "— a save under that sharding has no declared "
+                    "restore behavior",
+                    code=f"axis-gap:{cat}:{a}",
+                )
+            for a in self._elastic:
+                if a not in axes:
+                    yield Violation(
+                        PASS_ID, _SHARDING_POSIX, 0,
+                        f"world-ladder rung changes move {a!r} "
+                        f"(ELASTIC_AXES) but reshard rule {cat!r} does "
+                        "not cover it — the elastic path would hit an "
+                        "unanswered reshard",
+                        code=f"rung-gap:{cat}:{a}",
+                    )
+
+
+PASS = ReshardCoveragePass()
+check_file = PASS.check_file
+repo_check = PASS.repo_check

@@ -46,6 +46,14 @@ class BrainServicer(ServicerApi):
 
         self._init_adjust_algo = JobInitAdjustAlgorithm(store, min_gain)
         self._deadline_algo = CompletionTimePredictor(store, min_gain)
+        # Master-epoch stamp (rpc/client.py fence): the brain service is
+        # journal-less, so every response stamps 0 — "unfenced" as an
+        # explicit decision rather than an accidental default; when the
+        # brain gains a journal, only this attribute moves.
+        self._epoch = 0
+
+    def _respond(self, **kwargs) -> bytes:
+        return dumps(comm.BaseResponse(master_epoch=self._epoch, **kwargs))
 
     # -- transport entry points -------------------------------------------
 
@@ -92,13 +100,11 @@ class BrainServicer(ServicerApi):
                     msg.job_uuid, msg.event_type, msg.node_id, msg.detail
                 )
             else:
-                return dumps(
-                    comm.BaseResponse(success=False, reason="unknown message")
-                )
-            return dumps(comm.BaseResponse(success=True))
+                return self._respond(success=False, reason="unknown message")
+            return self._respond(success=True)
         except Exception as e:  # noqa: BLE001
             logger.exception("brain report failed")
-            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+            return self._respond(success=False, reason=repr(e))
 
     def get(self, request_bytes: bytes) -> bytes:
         req = loads(request_bytes)
@@ -125,13 +131,11 @@ class BrainServicer(ServicerApi):
                     )
                 )
             else:
-                return dumps(
-                    comm.BaseResponse(success=False, reason="unknown message")
-                )
-            return dumps(comm.BaseResponse(success=True, data=dumps(result)))
+                return self._respond(success=False, reason="unknown message")
+            return self._respond(success=True, data=dumps(result))
         except Exception as e:  # noqa: BLE001
             logger.exception("brain get failed")
-            return dumps(comm.BaseResponse(success=False, reason=repr(e)))
+            return self._respond(success=False, reason=repr(e))
 
     # -- handlers ----------------------------------------------------------
 

@@ -27,6 +27,51 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+# ---------------------------------------------------------------------------
+# mesh-axis registry
+# ---------------------------------------------------------------------------
+#
+# The single source of truth for axis NAMES, the ENV_KNOBS idiom applied
+# to SPMD: every PartitionSpec / NamedSharding / shard_map spec literal,
+# every ``param_with_axes``/``with_logical_constraint`` annotation and
+# every collective axis name across parallel/, models/, ops/ and
+# checkpoint/meta.py must name an axis registered here — enforced by the
+# ``mesh-axes`` tpurun-lint pass (docs/analysis.md), which also
+# cross-checks ``MESH_AXES`` and ``sharding.DEFAULT_RULES`` against this
+# table. Keep the values PURE LITERALS: the lint pass reads this file by
+# AST (it can never import jax), so computed entries are invisible to it
+# and are reported as a registry parse failure.
+#
+# kind "mesh":    an axis of the physical device mesh (a Mesh
+#                 construction axis; collectives ride it).
+# kind "logical": a model-side logical axis, mapped onto mesh axes by
+#                 ``sharding.DEFAULT_RULES``.
+MESH_AXIS_REGISTRY: Dict[str, Tuple[str, str]] = {
+    # name: (kind, doc)
+    "dp": ("mesh", "pure data parallel (replicated params) — the elastic axis; DCN on multislice"),
+    "fsdp": ("mesh", "data parallel with ZeRO-style sharded params/optimizer"),
+    "ep": ("mesh", "expert parallel (MoE experts distributed; a2a dispatch)"),
+    "tp": ("mesh", "tensor (model) parallel — ICI neighbors"),
+    "sp": ("mesh", "sequence/context parallel (ring attention)"),
+    "pp": ("mesh", "pipeline stages"),
+    "batch": ("logical", "leading data dim of inputs/activations"),
+    "seq": ("logical", "sequence dim (context parallelism)"),
+    "embed": ("logical", "model hidden dim of params"),
+    "heads": ("logical", "attention query heads"),
+    "kv": ("logical", "per-head projection dim (kept local)"),
+    "kv_heads": ("logical", "GQA kv-head groups (few; kept local)"),
+    "mlp": ("logical", "feed-forward hidden dim"),
+    "vocab": ("logical", "embedding/logits vocabulary dim"),
+    "expert": ("logical", "MoE expert index"),
+    "expert_mlp": ("logical", "per-expert feed-forward hidden dim"),
+    "stage": ("logical", "pipeline stage index"),
+    "norm": ("logical", "norm scale vectors (kept local)"),
+}
+
+# Physical mesh axes IN RESHAPE ORDER (build_mesh depends on the order:
+# tp/sp innermost → ICI neighbors). The mesh-axes lint pass enforces
+# that this tuple equals the registry's kind-"mesh" entries exactly, so
+# the two can never drift.
 MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp", "pp")
 
 

@@ -12,7 +12,7 @@ Rules follow the standard TPU transformer recipe:
 - vocab        → tp: sharded embedding/logits
 """
 
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 from flax.linen import partitioning as nn_partitioning
@@ -35,6 +35,50 @@ DEFAULT_RULES: LogicalRules = [
     ("stage", "pp"),
     ("norm", None),
 ]
+
+# ---------------------------------------------------------------------------
+# reshard rule table
+# ---------------------------------------------------------------------------
+#
+# The statically-verified half of "restore INTO a different sharding"
+# (ROADMAP items 1/4): before the dynamic reshard path exists, every
+# state-tree category the checkpoint engine saves must declare how it
+# restores when the elastic world moves along the DP×TP×PP rung ladder.
+# The ``reshard-coverage`` tpurun-lint pass (docs/analysis.md)
+# cross-checks this table against ``TrainState``'s fields, against the
+# mesh axes ``DEFAULT_RULES`` can put on a saved leaf, and against
+# dict-literal save sites — a category saved with no rule for a rung
+# fails lint instead of failing (or silently replicating) at restore.
+# Pure literals only: the lint pass reads this file by AST, never by
+# import.
+
+# The world ladder re-extents these mesh axes on a rung change; every
+# "respec"/"mirror_params" rule below must cover them.
+ELASTIC_AXES = ("dp", "fsdp")
+
+RESHARD_POLICIES = (
+    # replicate:     scalar/small leaves — restore replicated on any rung
+    # respec:        re-derive the PartitionSpec on the target mesh and
+    #                reshard the assembled global array via device_put
+    # mirror_params: optimizer slots adopt the matching param leaf's rule
+    #                (shape-matched; scalar counts replicate)
+    # host_local:    per-host payloads (rng, data cursors, metadata) —
+    #                never cross a reshard boundary
+    "replicate",
+    "respec",
+    "mirror_params",
+    "host_local",
+)
+
+RESHARD_RULES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    # category: (policy, mesh axes the category's shardings may reference)
+    "step": ("replicate", ()),
+    "params": ("respec", ("dp", "fsdp", "ep", "tp", "sp", "pp")),
+    "opt_state": ("mirror_params", ("dp", "fsdp", "ep", "tp", "sp", "pp")),
+    # the engine's ``extra=`` side-channel (dataloader cursors, torch
+    # host trees): opaque host bytes, restored verbatim per host
+    "extra": ("host_local", ()),
+}
 
 
 def logical_to_sharding(

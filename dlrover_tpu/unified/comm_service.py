@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..common import comm
 from ..common.log import logger
 from ..common.serialize import dumps, loads, register_message
 from ..rpc.server import create_master_server
@@ -113,6 +114,14 @@ class UnifiedCommServicer:
         self._stats_mu = threading.Lock()
         self.bytes_in = 0
         self.bytes_out = 0
+        # Master-epoch stamp (rpc/client.py fence): the unified comm
+        # service is journal-less, so every response stamps 0 —
+        # "unfenced" as an explicit decision rather than an accidental
+        # default; a future journaled service only moves this attribute.
+        self._epoch = 0
+
+    def _respond(self, **kwargs) -> bytes:
+        return dumps(comm.BaseResponse(master_epoch=self._epoch, **kwargs))
 
     def _q(self, name: str) -> "_queue.Queue[Any]":
         with self._mu:
@@ -198,26 +207,20 @@ class UnifiedCommServicer:
     # ServicerApi surface (both verbs dispatch the same way here)
 
     def _dispatch(self, request_bytes: bytes) -> bytes:
-        from ..common import comm
-
         with self._stats_mu:
             self.bytes_in += len(request_bytes)
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
         handler = self._HANDLERS.get(type(message))
         if handler is None:
-            out = dumps(
-                comm.BaseResponse(success=False, reason="unknown message")
-            )
+            out = self._respond(success=False, reason="unknown message")
         else:
             try:
                 result = handler(self, message)
-                out = dumps(
-                    comm.BaseResponse(success=True, data=dumps(result))
-                )
+                out = self._respond(success=True, data=dumps(result))
             except Exception as e:  # noqa: BLE001 — reported to caller
                 logger.exception("unified comm handler failed")
-                out = dumps(comm.BaseResponse(success=False, reason=repr(e)))
+                out = self._respond(success=False, reason=repr(e))
         with self._stats_mu:
             self.bytes_out += len(out)
         return out

@@ -1,0 +1,54 @@
+"""Planted epoch-fence violations: an unstamped servicer response and a
+client built on a raw transport (both bypass the PR 10 master fence).
+
+Parsed by tests/test_lint.py, never imported.
+"""
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.serialize import dumps
+
+
+class FxServicer:
+    def __init__(self, epoch=0):
+        self._epoch = epoch
+
+    def _respond(self, **kwargs):
+        # conformant: the stamping helper
+        return dumps(comm.BaseResponse(master_epoch=self._epoch, **kwargs))
+
+    def get(self, request_bytes):
+        return self._respond(success=True)
+
+    def report(self, request_bytes):
+        # the planted violation: a new endpoint forgets the stamp
+        return dumps(comm.BaseResponse(success=True))
+
+    def probe(self, request_bytes):
+        # the suppressed twin: a diagnostics-only response, reasoned away
+        return dumps(comm.BaseResponse(success=True))  # tpulint: ignore[epoch-fence] fixture: suppressed-twin diagnostics response
+
+
+class FxRogueClient:
+    """A client-side RPC entry that never observes the epoch."""
+
+    def __init__(self, transport):
+        self._transport = transport
+
+    def fetch(self, payload):
+        # planted violation: raw transport call, no _observe_epoch
+        return self._transport.get(payload)
+
+
+class FxFencedClient:
+    def __init__(self, transport):
+        self._transport = transport
+        self._seen = 0
+
+    def _observe_epoch(self, epoch):
+        self._seen = max(self._seen, epoch)
+
+    def fetch(self, payload):
+        # conformant: the enclosing function observes the epoch
+        raw = self._transport.get(payload)
+        self._observe_epoch(getattr(raw, "master_epoch", 0))
+        return raw

@@ -1,0 +1,46 @@
+"""Planted journal-conformance violations: a drifted WAL record kind
+(replays as a silent no-op), a dead replay branch, and an
+export-without-import component.
+
+Parsed by tests/test_lint.py, never imported. Kinds use an ``fx.``
+prefix so the real dispatcher can never accidentally cover them.
+"""
+
+
+class FxStore:
+    def __init__(self):
+        self.journal = None
+
+    def _record(self, kind, payload):
+        if self.journal is not None:
+            self.journal(kind, payload)
+
+    def set(self, key, value):
+        # the planted violation: "fx.sett" has no replay branch below
+        self._record("fx.sett", {"key": key, "v": value})
+
+    def delete(self, key):
+        self._record("fx.del", {"key": key})
+
+    def export_state(self):
+        return {}
+
+    def import_state(self, state):
+        return None
+
+
+# the suppressed twin: exports but deliberately does not import
+# tpulint: ignore[journal-conformance] fixture: suppressed-twin one-way component
+class FxHalfComponent:
+    def export_state(self):
+        return {}
+
+
+def apply_wal_record(master, record):
+    kind = record.get("kind", "")
+    data = record.get("data") or {}
+    if kind == "fx.del":
+        master.store.delete(data["key"])
+    elif kind in ("fx.ghost", "fx.del"):
+        # "fx.ghost" is dead dispatch: nothing records it
+        pass

@@ -1,0 +1,23 @@
+"""Planted mesh-axes violation: an axis literal the registry does not
+know (the silent-no-constraint drift class).
+
+Parsed by tests/test_lint.py, never imported. Axis names use a
+``zz_``/``fx`` flavor so the real registry can never accidentally
+cover them.
+"""
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def build_specs(mesh):
+    ok = NamedSharding(mesh, P("batch", "seq"))
+    # the planted violation: "zz_bogus" is not a registered axis
+    drifted = NamedSharding(mesh, P("zz_bogus", None))
+    # the suppressed twin: a deliberately unregistered experiment axis
+    twin = P("zz_experiment")  # tpulint: ignore[mesh-axes] fixture: suppressed-twin experimental axis
+    return ok, drifted, twin
+
+
+def lookup(mesh):
+    # registered mesh axis: conformant
+    return mesh.shape["dp"]

@@ -1,0 +1,28 @@
+"""Planted reshard-coverage violation: a save-site state-tree category
+with no RESHARD_RULES entry (the silent-replication-on-reshard class).
+
+Parsed by tests/test_lint.py, never imported. Category names use a
+``zz_`` flavor so the real rule table can never accidentally cover
+them.
+"""
+
+
+def checkpoint_ok(engine, step, params, opt_state):
+    # every category covered by parallel/sharding.py RESHARD_RULES
+    return engine.save_to_memory(
+        step, {"params": params, "opt_state": opt_state}
+    )
+
+
+def checkpoint_drifted(engine, step, params, adapters):
+    # the planted violation: "zz_lora" has no reshard rule
+    return engine.save_to_memory(
+        step, {"params": params, "zz_lora": adapters}
+    )
+
+
+def checkpoint_twin(engine, step, params, probe):
+    # the suppressed twin: a debug-only category, reasoned away
+    return engine.save_to_storage(  # tpulint: ignore[reshard-coverage] fixture: suppressed-twin debug-only category
+        step, {"params": params, "zz_probe": probe}
+    )

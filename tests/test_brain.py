@@ -206,6 +206,28 @@ class TestBrainServiceRpc:
         finally:
             client.close()
 
+    def test_responses_stamp_master_epoch(self):
+        """epoch-fence regression: every brain response carries the
+        master_epoch stamp (0 = journal-less, an explicit decision) so
+        the client-side fence machinery sees a well-formed response —
+        including the unknown-message and handler-error paths."""
+        from dlrover_tpu.brain.datastore import BrainDataStore
+        from dlrover_tpu.brain.service import BrainServicer
+        from dlrover_tpu.common import comm
+        from dlrover_tpu.common.serialize import dumps, loads
+
+        servicer = BrainServicer(BrainDataStore(":memory:"))
+        for verb, msg in (
+            ("report", comm.HeartbeatRequest(node_id=0)),  # unknown here
+            ("get", comm.HeartbeatRequest(node_id=0)),  # unknown here
+            ("report", None),  # handler-error path (loads of raw None)
+        ):
+            raw = getattr(servicer, verb)(dumps(msg))
+            resp = loads(raw)
+            assert isinstance(resp, comm.BaseResponse)
+            assert resp.master_epoch == 0
+            assert not resp.success
+
 
 class TestMasterIntegration:
     def test_brain_optimizer_prefers_brain_plan(self):

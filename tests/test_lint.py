@@ -19,11 +19,15 @@ from dlrover_tpu.analysis.passes import (
     blocking_under_lock,
     endpoint_conformance,
     env_knobs,
+    epoch_fence,
     exception_swallow,
     host_sync,
     import_purity,
     injection_coverage,
+    journal_conformance,
     lock_order,
+    mesh_axes,
+    reshard_coverage,
     rpc_deadline,
     thread_lifecycle,
 )
@@ -149,6 +153,49 @@ class TestPassesFireOnFixtures:
         # under-prefix clients are conformant
         assert len(r.suppressed) == 1
         assert r.suppressed[0][0].code == "route:/fx/dead-route"
+        assert not r.errors
+
+    def test_mesh_axes_fires(self):
+        r = _run(_fx("fx_mesh_axes.py"), mesh_axes)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        v = r.violations[0]
+        assert v.pass_id == "mesh-axes" and "zz_bogus" in v.message
+        # the suppressed twin; registered axes (batch/seq, shape["dp"])
+        # are conformant
+        assert len(r.suppressed) == 1
+        assert "zz_experiment" in r.suppressed[0][0].message
+        assert not r.errors
+
+    def test_reshard_coverage_fires(self):
+        r = _run(_fx("fx_reshard_coverage.py"), reshard_coverage)
+        assert len(r.violations) == 1, [v.render() for v in r.violations]
+        v = r.violations[0]
+        assert v.pass_id == "reshard-coverage" and "zz_lora" in v.message
+        # covered categories (params/opt_state) and the suppressed twin
+        assert len(r.suppressed) == 1
+        assert "zz_probe" in r.suppressed[0][0].message
+        assert not r.errors
+
+    def test_journal_conformance_fires(self):
+        r = _run(_fx("fx_journal_conformance.py"), journal_conformance)
+        codes = {v.code for v in r.violations}
+        # the drifted record kind AND the dead replay branch
+        assert codes == {"recorded:fx.sett", "applied:fx.ghost"}, [
+            v.render() for v in r.violations
+        ]
+        # the one-way component is the suppressed twin
+        assert len(r.suppressed) == 1
+        assert r.suppressed[0][0].code == "pair:FxHalfComponent"
+        assert not r.errors
+
+    def test_epoch_fence_fires(self):
+        r = _run(_fx("fx_epoch_fence.py"), epoch_fence)
+        assert len(r.violations) == 2, [v.render() for v in r.violations]
+        msgs = [v.message for v in r.violations]
+        # the unstamped servicer response AND the raw transport client
+        assert any("master_epoch" in m for m in msgs)
+        assert any("bypasses the epoch fence" in m for m in msgs)
+        assert len(r.suppressed) == 1
         assert not r.errors
 
 
@@ -284,7 +331,48 @@ class TestCli:
         )
         assert rc == 1
         data = json.loads(capsys.readouterr().out)
-        assert data["violations"] and not data["clean"]
+        assert data["findings"] and not data["clean"]
+
+    def test_json_schema_round_trips(self, capsys):
+        """The --format json report is the machine contract the lint
+        gate diffs across commits: schema-stamped, deterministically
+        sorted, and exactly reconstructable from a direct run_lint —
+        including suppressed findings and their reasons."""
+        from dlrover_tpu.analysis.cli import JSON_SCHEMA, findings_json
+
+        rc = lint_main(["--no-baseline", "--format", "json", _FIXTURES])
+        assert rc == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data["schema"] == JSON_SCHEMA
+
+        direct = run_lint(
+            [_FIXTURES], passes=list(ALL_PASSES), repo_root=_REPO
+        )
+        expect = findings_json(direct)
+        # byte-for-byte identical after a JSON round trip: the report
+        # is diffable across commits with no run-order noise
+        assert json.loads(json.dumps(expect)) == data
+
+        # every finding carries the full key tuple; rules are the
+        # line-number-free identities the baseline also matches on
+        for f in data["findings"]:
+            assert set(f) == {
+                "pass", "file", "line", "rule", "message",
+                "suppressed", "reason",
+            }
+            if f["suppressed"]:
+                assert f["reason"].strip() or f["file"].endswith(
+                    "fx_bad_suppression.py"
+                )
+        keys = [
+            (f["file"], f["line"], f["pass"], f["rule"], f["suppressed"])
+            for f in data["findings"]
+        ]
+        assert keys == sorted(keys)
+        assert data["counts"]["violations"] == len(direct.violations)
+        assert data["counts"]["suppressed"] == len(direct.suppressed)
+        # the bare-ignore fixture keeps the errors channel non-empty
+        assert data["counts"]["errors"] == len(direct.errors) > 0
 
     def test_write_baseline_then_clean(self, tmp_path, capsys):
         path = str(tmp_path / "bl.json")
@@ -610,17 +698,49 @@ class TestChangedMode:
         # edit to other.py must be
         (pkg / "other.py").write_text(violation)
         rc = lint_main(["--changed", "--no-baseline", str(pkg)])
-        out = capsys.readouterr().out
+        captured = capsys.readouterr()
         assert rc == 1
-        assert "other.py" in out and "old.py" not in out
-        assert "skips repo-wide passes" in out
+        assert "other.py" in captured.out and "old.py" not in captured.out
+        # the notice rides stderr: stdout belongs to --format json
+        assert "skips repo-wide passes" in captured.err
 
     def test_changed_with_no_edits_is_clean(self, tmp_path, capsys):
         pkg, _ = self._git_repo(tmp_path)
         rc = lint_main(["--changed", "--no-baseline", str(pkg)])
-        out = capsys.readouterr().out
+        captured = capsys.readouterr()
         assert rc == 0
-        assert "no Python files changed" in out
+        assert "no Python files changed" in captured.err
+
+    def test_changed_json_stdout_is_pure(self, tmp_path, capsys):
+        """Review regression: the --changed notices must not corrupt the
+        --format json machine contract — stdout parses as the schema
+        document, notices go to stderr."""
+        pkg, violation = self._git_repo(tmp_path)
+        (pkg / "other.py").write_text(violation)
+        rc = lint_main(
+            ["--changed", "--no-baseline", "--format", "json", str(pkg)]
+        )
+        captured = capsys.readouterr()
+        doc = json.loads(captured.out)
+        assert rc == 1
+        assert doc["schema"] == "tpurun-lint-findings/1"
+        assert doc["counts"]["violations"] == 1
+        assert "skips repo-wide passes" in captured.err
+
+    def test_changed_json_no_edits_emits_empty_document(
+        self, tmp_path, capsys
+    ):
+        """A gate diffing findings across commits always gets a
+        document, even when nothing changed."""
+        pkg, _ = self._git_repo(tmp_path)
+        rc = lint_main(
+            ["--changed", "--no-baseline", "--format", "json", str(pkg)]
+        )
+        captured = capsys.readouterr()
+        doc = json.loads(captured.out)
+        assert rc == 0
+        assert doc["clean"] is True and doc["findings"] == []
+        assert "no Python files changed" in captured.err
 
     def test_changed_sees_untracked_files(self, tmp_path, capsys):
         pkg, violation = self._git_repo(tmp_path)
@@ -742,3 +862,734 @@ class TestReviewRegressions:
         empty.mkdir()
         assert lint_main([str(empty)]) == 2
         assert "no Python files" in capsys.readouterr().err
+
+
+class TestMeshAxesMachinery:
+    """Fake-tree cases: the registry cross-checks must catch drift in
+    every direction, not just unknown literals."""
+
+    def _tree(self, tmp_path, mesh_src, sharding_src="", probe_src=""):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        par = tmp_path / "dlrover_tpu" / "parallel"
+        par.mkdir(parents=True)
+        (par / "mesh.py").write_text(mesh_src)
+        if sharding_src:
+            (par / "sharding.py").write_text(sharding_src)
+        if probe_src:
+            (tmp_path / "dlrover_tpu" / "probe.py").write_text(probe_src)
+        return tmp_path
+
+    _REGISTRY = (
+        "MESH_AXIS_REGISTRY = {\n"
+        '    "dp": ("mesh", "data"),\n'
+        '    "tp": ("mesh", "tensor"),\n'
+        '    "batch": ("logical", "batch"),\n'
+        "}\n"
+        'MESH_AXES = ("dp", "tp")\n'
+    )
+    _RULES = 'DEFAULT_RULES = [("batch", ("dp",))]\n'
+
+    def _lint(self, root):
+        return run_lint(
+            [str(root / "dlrover_tpu")],
+            passes=[mesh_axes],
+            repo_root=str(root),
+        )
+
+    def test_conformant_fake_tree_is_clean(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._REGISTRY,
+            self._RULES,
+            "from jax.sharding import PartitionSpec\n"
+            "def f(mesh):\n"
+            '    return PartitionSpec("batch"), mesh.shape["dp"]\n',
+        )
+        r = self._lint(root)
+        assert not r.violations, [v.render() for v in r.violations]
+
+    def test_mesh_axis_in_logical_annotation_flagged(self, tmp_path):
+        """A mesh axis in param_with_axes is the silent-no-constraint
+        drift even though the name is registered."""
+        root = self._tree(
+            tmp_path,
+            self._REGISTRY,
+            self._RULES,
+            "def f(init):\n"
+            '    return param_with_axes("w", init, (4,), axes=("dp",))\n',
+        )
+        r = self._lint(root)
+        assert len(r.violations) == 1
+        assert "requires a logical axis" in r.violations[0].message
+
+    def test_logical_axis_in_collective_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._REGISTRY,
+            self._RULES,
+            "import jax\n"
+            "def f(x):\n"
+            '    return jax.lax.psum(x, "batch")\n',
+        )
+        r = self._lint(root)
+        assert len(r.violations) == 1
+        assert "requires a mesh axis" in r.violations[0].message
+
+    def test_mesh_axes_tuple_drift_flagged(self, tmp_path):
+        registry = self._REGISTRY.replace(
+            'MESH_AXES = ("dp", "tp")', 'MESH_AXES = ("dp",)'
+        )
+        root = self._tree(tmp_path, registry, self._RULES)
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "mesh-axes-drift" in codes, [
+            v.render() for v in r.violations
+        ]
+
+    def test_mesh_construction_with_unregistered_axes_flagged(
+        self, tmp_path
+    ):
+        registry = self._REGISTRY + (
+            "def build(devs):\n"
+            '    return Mesh(devs, ("dp", "zz_rogue"))\n'
+        )
+        root = self._tree(tmp_path, registry, self._RULES)
+        r = self._lint(root)
+        assert any(
+            "Mesh(...)" in v.message and "zz_rogue" in v.message
+            for v in r.violations
+        ), [v.render() for v in r.violations]
+
+    def test_suppressed_site_outside_lint_subset_honored(self, tmp_path):
+        """Review regression: the hybrid repo_check scans the whole
+        tree even when run_lint's subset (--changed) excludes the
+        suppressed file — its inline suppression must still be
+        honored, or the pre-commit fast path blocks commits the full
+        gate accepts."""
+        registry = self._REGISTRY + (
+            "def build(devs):\n"
+            '    return Mesh(devs, ("dp", "zz_probe"))'
+            "  # tpulint: ignore[mesh-axes] drill mesh, not a training axis\n"
+        )
+        root = self._tree(tmp_path, registry, self._RULES, "X = 1\n")
+        r = run_lint(
+            [str(root / "dlrover_tpu" / "probe.py")],
+            passes=[mesh_axes],
+            repo_root=str(root),
+        )
+        assert not r.violations, [v.render() for v in r.violations]
+        assert any(v.pass_id == "mesh-axes" for v, _s in r.suppressed)
+
+    def test_mesh_construction_keyword_form_checked(self, tmp_path):
+        """Review regression: jax's Mesh accepts axis_names as a
+        keyword — the cross-check must not skip that form."""
+        registry = self._REGISTRY + (
+            "def build(devs):\n"
+            '    return Mesh(devs, axis_names=("dp", "zz_kwrogue"))\n'
+        )
+        root = self._tree(tmp_path, registry, self._RULES)
+        r = self._lint(root)
+        assert any(
+            "zz_kwrogue" in v.message for v in r.violations
+        ), [v.render() for v in r.violations]
+
+    def test_default_rules_unregistered_target_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._REGISTRY,
+            'DEFAULT_RULES = [("batch", ("zz_ghost_mesh",))]\n',
+        )
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "rule-target:batch:zz_ghost_mesh" in codes
+
+    def test_unmapped_logical_axis_flagged(self, tmp_path):
+        registry = self._REGISTRY.replace(
+            '    "batch": ("logical", "batch"),\n',
+            '    "batch": ("logical", "batch"),\n'
+            '    "seq": ("logical", "sequence"),\n',
+        )
+        # seq registered + referenced by a spec, but DEFAULT_RULES
+        # never maps it
+        root = self._tree(
+            tmp_path,
+            registry,
+            self._RULES,
+            "from jax.sharding import PartitionSpec as P\n"
+            'S = P("seq")\n',
+        )
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "unmapped:seq" in codes, [v.render() for v in r.violations]
+
+    def test_stale_registry_entry_flagged(self, tmp_path):
+        registry = self._REGISTRY.replace(
+            '    "batch": ("logical", "batch"),\n',
+            '    "batch": ("logical", "batch"),\n'
+            '    "zz_unused": ("logical", "nobody references this"),\n',
+        )
+        rules = (
+            'DEFAULT_RULES = [("batch", ("dp",)), ("zz_unused", None)]\n'
+        )
+        root = self._tree(tmp_path, registry, rules)
+        r = self._lint(root)
+        # mapped by DEFAULT_RULES -> referenced -> NOT stale
+        assert not any("stale" in v.code for v in r.violations)
+        root2 = self._tree(
+            tmp_path / "two", registry, self._RULES
+        )
+        r2 = self._lint(root2)
+        codes = {v.code for v in r2.violations}
+        assert "stale:zz_unused" in codes
+        # registered-but-unmapped also fires for it
+        assert "unmapped:zz_unused" in codes
+
+    def test_computed_registry_is_a_parse_violation(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            "MESH_AXIS_REGISTRY = dict(dp=(\"mesh\", \"d\"))\n"
+            "MESH_AXES = tuple(MESH_AXIS_REGISTRY)\n",
+        )
+        r = self._lint(root)
+        assert any(v.code == "registry-parse" for v in r.violations)
+
+    def test_registry_edit_reparsed_within_one_process(self, tmp_path):
+        """Review regression: the pass singleton caches the parsed
+        registry keyed by (root, mtime/size) — registering the axis and
+        re-running run_lint in the SAME process must go clean (watch
+        modes, harnesses looping over one tmp root)."""
+        probe = (
+            "from jax.sharding import PartitionSpec\n"
+            'SPEC = PartitionSpec("zz_new")\n'
+        )
+        root = self._tree(tmp_path, self._REGISTRY, self._RULES, probe)
+        r = self._lint(root)
+        assert any("zz_new" in v.message for v in r.violations)
+        (root / "dlrover_tpu" / "parallel" / "mesh.py").write_text(
+            self._REGISTRY.replace(
+                '    "batch": ("logical", "batch"),\n',
+                '    "batch": ("logical", "batch"),\n'
+                '    "zz_new": ("logical", "fresh"),\n',
+            )
+        )
+        (root / "dlrover_tpu" / "parallel" / "sharding.py").write_text(
+            'DEFAULT_RULES = [("batch", ("dp",)), ("zz_new", ("tp",))]\n'
+        )
+        r2 = self._lint(root)
+        assert not r2.violations, [v.render() for v in r2.violations]
+
+
+class TestReshardCoverageMachinery:
+    """Fake-tree cases over the rule-table cross-checks."""
+
+    _MESH = (
+        "MESH_AXIS_REGISTRY = {\n"
+        '    "dp": ("mesh", "d"),\n'
+        '    "tp": ("mesh", "t"),\n'
+        '    "batch": ("logical", "b"),\n'
+        "}\n"
+        'MESH_AXES = ("dp", "tp")\n'
+    )
+
+    def _tree(self, tmp_path, sharding_src, train_state_fields=("step",)):
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        par = tmp_path / "dlrover_tpu" / "parallel"
+        par.mkdir(parents=True)
+        (par / "mesh.py").write_text(self._MESH)
+        (par / "sharding.py").write_text(sharding_src)
+        fields = "".join(f"    {f}: int\n" for f in train_state_fields)
+        (par / "train_step.py").write_text(
+            "class TrainState:\n" + fields
+        )
+        return tmp_path
+
+    def _lint(self, root):
+        return run_lint(
+            [str(root / "dlrover_tpu")],
+            passes=[reshard_coverage],
+            repo_root=str(root),
+        )
+
+    _BASE = (
+        'DEFAULT_RULES = [("batch", ("dp",))]\n'
+        'ELASTIC_AXES = ("dp",)\n'
+        'RESHARD_POLICIES = ("replicate", "respec")\n'
+    )
+
+    def test_conformant_table_is_clean(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._BASE
+            + 'RESHARD_RULES = {"step": ("replicate", ()),'
+            ' "params": ("respec", ("dp", "tp"))}\n',
+            train_state_fields=("step", "params"),
+        )
+        r = self._lint(root)
+        assert not r.violations, [v.render() for v in r.violations]
+
+    def test_train_state_field_without_rule_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._BASE + 'RESHARD_RULES = {"step": ("replicate", ())}\n',
+            train_state_fields=("step", "ema_params"),
+        )
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "uncovered:ema_params" in codes, [
+            v.render() for v in r.violations
+        ]
+
+    def test_stale_rule_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._BASE
+            + 'RESHARD_RULES = {"step": ("replicate", ()),'
+            ' "zz_gone": ("replicate", ())}\n',
+        )
+        r = self._lint(root)
+        assert any("stale:zz_gone" == v.code for v in r.violations)
+
+    def test_unknown_policy_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._BASE + 'RESHARD_RULES = {"step": ("teleport", ())}\n',
+        )
+        r = self._lint(root)
+        assert any("policy:step" == v.code for v in r.violations)
+
+    def test_axis_gap_vs_default_rules_flagged(self, tmp_path):
+        """DEFAULT_RULES can shard over tp, but the respec rule only
+        covers dp — the save path can produce a sharding the table
+        never answers for."""
+        root = self._tree(
+            tmp_path,
+            'DEFAULT_RULES = [("batch", ("dp", "tp"))]\n'
+            'ELASTIC_AXES = ("dp",)\n'
+            'RESHARD_POLICIES = ("replicate", "respec")\n'
+            'RESHARD_RULES = {"step": ("replicate", ()),'
+            ' "params": ("respec", ("dp",))}\n',
+            train_state_fields=("step", "params"),
+        )
+        r = self._lint(root)
+        assert any(
+            v.code == "axis-gap:params:tp" for v in r.violations
+        ), [v.render() for v in r.violations]
+
+    def test_rung_gap_vs_elastic_axes_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            'DEFAULT_RULES = [("batch", ("dp",))]\n'
+            'ELASTIC_AXES = ("dp", "tp")\n'
+            'RESHARD_POLICIES = ("replicate", "respec")\n'
+            'RESHARD_RULES = {"step": ("replicate", ()),'
+            ' "params": ("respec", ("dp",))}\n',
+            train_state_fields=("step", "params"),
+        )
+        r = self._lint(root)
+        assert any(
+            v.code == "rung-gap:params:tp" for v in r.violations
+        ), [v.render() for v in r.violations]
+
+    def test_missing_table_flagged(self, tmp_path):
+        root = self._tree(tmp_path, "DEFAULT_RULES = []\n")
+        r = self._lint(root)
+        assert any(v.code == "table-parse" for v in r.violations)
+
+    def test_unreadable_train_state_is_parse_finding_not_stale(
+        self, tmp_path
+    ):
+        """Review regression: a mid-edit syntax error in train_step.py
+        must NOT misreport every rule as 'stale entry; delete it' —
+        one parse finding, coverage checks skipped."""
+        root = self._tree(
+            tmp_path,
+            self._BASE + 'RESHARD_RULES = {"step": ("replicate", ())}\n',
+        )
+        (root / "dlrover_tpu" / "parallel" / "train_step.py").write_text(
+            "def broken(:\n"
+        )
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "trainstate-parse" in codes, [
+            v.render() for v in r.violations
+        ]
+        assert not any(c.startswith("stale:") for c in codes)
+
+    def test_rule_table_edit_reparsed_within_one_process(self, tmp_path):
+        """Review regression: same (root, mtime/size)-keyed cache as
+        mesh-axes — adding the missing rule and re-running run_lint in
+        the SAME process must go clean."""
+        root = self._tree(
+            tmp_path, self._BASE + "RESHARD_RULES = {}\n"
+        )
+        r = self._lint(root)
+        assert any(v.code == "uncovered:step" for v in r.violations)
+        (root / "dlrover_tpu" / "parallel" / "sharding.py").write_text(
+            self._BASE + 'RESHARD_RULES = {"step": ("replicate", ())}\n'
+        )
+        r2 = self._lint(root)
+        assert not r2.violations, [v.render() for v in r2.violations]
+
+    def test_extra_kwarg_without_rule_flagged(self, tmp_path):
+        root = self._tree(
+            tmp_path,
+            self._BASE + 'RESHARD_RULES = {"step": ("replicate", ())}\n',
+        )
+        (tmp_path / "dlrover_tpu" / "probe.py").write_text(
+            "def f(engine, step, tree, cursors):\n"
+            "    return engine.save_to_memory(step, tree, extra=cursors)\n"
+        )
+        r = self._lint(root)
+        assert any(
+            "extra" in v.message and v.path.endswith("probe.py")
+            for v in r.violations
+        ), [v.render() for v in r.violations]
+
+    def test_real_repo_tables_are_loadable_and_match_runtime(self):
+        """The AST-parsed tables must agree with what the runtime
+        imports — a computed entry would silently vanish from lint."""
+        jax = pytest.importorskip("jax")  # noqa: F841 — sharding imports jax
+        from dlrover_tpu.analysis.passes.reshard_coverage import (
+            load_tables,
+            train_state_fields,
+        )
+        from dlrover_tpu.parallel import sharding as runtime
+
+        rules, policies, elastic = load_tables(_REPO)
+        assert rules == runtime.RESHARD_RULES
+        assert policies == runtime.RESHARD_POLICIES
+        assert elastic == runtime.ELASTIC_AXES
+        assert set(train_state_fields(_REPO)) == {
+            "step", "params", "opt_state",
+        }
+
+    def test_real_repo_registry_matches_runtime(self):
+        jax = pytest.importorskip("jax")  # noqa: F841 — mesh imports jax
+        from dlrover_tpu.analysis.passes.mesh_axes import load_axis_registry
+        from dlrover_tpu.parallel import mesh as runtime
+
+        registry, axes, err = load_axis_registry(
+            os.path.join(_REPO, "dlrover_tpu", "parallel", "mesh.py")
+        )
+        assert not err
+        assert axes == runtime.MESH_AXES
+        assert registry == {
+            k: v[0] for k, v in runtime.MESH_AXIS_REGISTRY.items()
+        }
+
+
+class TestJournalConformanceMachinery:
+    def _ctx(self, tmp_path, name, source):
+        from dlrover_tpu.analysis.core import FileContext
+
+        p = tmp_path / name
+        p.write_text(source)
+        return FileContext.parse(str(p), name)
+
+    def test_capture_restore_key_mismatch_flagged(self, tmp_path):
+        ctx = self._ctx(
+            tmp_path,
+            "persistence.py",
+            "def capture_master_state(master):\n"
+            '    return {"job": 1, "kv": 2}\n'
+            "def restore_master_state(master, state):\n"
+            '    use(state.get("job"))\n'
+            '    use(state.get("phantom"))\n',
+        )
+        got = list(journal_conformance.repo_check(str(tmp_path), [ctx]))
+        codes = {v.code for v in got}
+        assert "capture-only:kv" in codes
+        assert "restore-only:phantom" in codes
+
+    def test_subscript_restore_read_counts(self, tmp_path):
+        ctx = self._ctx(
+            tmp_path,
+            "persistence.py",
+            "def capture_master_state(master):\n"
+            '    return {"job": 1}\n'
+            "def restore_master_state(master, state):\n"
+            '    use(state["job"])\n',
+        )
+        got = list(journal_conformance.repo_check(str(tmp_path), [ctx]))
+        assert not got, [v.render() for v in got]
+
+    def test_direct_journal_call_is_a_recorder(self, tmp_path):
+        """The rdzv manager journals via self.journal(...) directly —
+        no _record wrapper."""
+        ctx = self._ctx(
+            tmp_path,
+            "mgr.py",
+            "class M:\n"
+            "    def complete(self):\n"
+            '        self.journal("fx.complete", {})\n',
+        )
+        got = list(journal_conformance.repo_check(str(tmp_path), [ctx]))
+        # no applier in the tree -> recorder conformance is skipped
+        # (a subset lint must not read every kind as unreplayable)
+        assert not got
+        applier = self._ctx(
+            tmp_path,
+            "persist.py",
+            "def apply_wal_record(m, record):\n"
+            '    kind = record.get("kind")\n'
+            '    if kind == "fx.other":\n'
+            "        pass\n",
+        )
+        got = list(
+            journal_conformance.repo_check(str(tmp_path), [ctx, applier])
+        )
+        codes = {v.code for v in got}
+        assert "recorded:fx.complete" in codes
+        assert "applied:fx.other" in codes
+
+    def test_non_dotted_literals_ignored(self, tmp_path):
+        """Profiler timers call .record("train_step", ...) — not a WAL
+        kind; the dotted-kind shape keeps them out of scope."""
+        ctx = self._ctx(
+            tmp_path,
+            "timer.py",
+            "class T:\n"
+            "    def hit(self):\n"
+            '        self.timer.record("train_step", 1, 2)\n'
+            "def apply_wal_record(m, r):\n"
+            '    kind = r.get("kind")\n'
+            '    if kind == "fx.x":\n'
+            "        pass\n",
+        )
+        got = list(journal_conformance.repo_check(str(tmp_path), [ctx]))
+        assert not any("train_step" in v.code for v in got)
+
+    def test_repo_kinds_conform_both_ways(self):
+        """The real WAL protocol: every recorded kind has a branch and
+        vice versa (the invariant the pass rails)."""
+        from dlrover_tpu.analysis.core import FileContext, iter_py_files
+        from dlrover_tpu.analysis.passes.journal_conformance import (
+            collect_applied,
+            collect_recorded,
+        )
+
+        rec, app = set(), set()
+        for p in iter_py_files([os.path.join(_REPO, "dlrover_tpu")]):
+            ctx = FileContext.parse(p, os.path.relpath(p, _REPO))
+            if ctx is None:
+                continue
+            rec |= {k for k, _l in collect_recorded(ctx)}
+            app |= {k for k, _l in collect_applied(ctx)}
+        assert rec and rec == app, (rec - app, app - rec)
+
+
+class TestEpochFenceMachinery:
+    def _run_src(self, tmp_path, source):
+        p = tmp_path / "fx.py"
+        p.write_text(source)
+        return _run(str(p), epoch_fence)
+
+    def test_transport_built_outside_masterclient_flagged(self, tmp_path):
+        r = self._run_src(
+            tmp_path,
+            "class SideChannel:\n"
+            "    def __init__(self, addr):\n"
+            "        self._t = HttpTransport(addr)\n",
+        )
+        assert len(r.violations) == 1
+        assert "outside MasterClient" in r.violations[0].message
+
+    def test_transport_built_inside_masterclient_clean(self, tmp_path):
+        r = self._run_src(
+            tmp_path,
+            "class MasterClient:\n"
+            "    def __init__(self, addr):\n"
+            "        self._transport = HttpTransport(addr)\n",
+        )
+        assert not r.violations
+
+    def test_kwargs_splat_does_not_count_as_stamp(self, tmp_path):
+        r = self._run_src(
+            tmp_path,
+            "def respond(**kw):\n"
+            "    return dumps(BaseResponse(**kw))\n",
+        )
+        assert len(r.violations) == 1
+        assert "master_epoch" in r.violations[0].message
+
+    def test_observe_epoch_in_nested_def_counts(self, tmp_path):
+        """A retry closure that observes the epoch still fences the
+        enclosing call path."""
+        r = self._run_src(
+            tmp_path,
+            "class C:\n"
+            "    def call(self, payload):\n"
+            "        def once():\n"
+            "            raw = self._transport.get(payload)\n"
+            "            self._observe_epoch(raw)\n"
+            "            return raw\n"
+            "        return once()\n",
+        )
+        assert not r.violations
+
+    def test_module_level_transport_call_flagged(self, tmp_path):
+        r = self._run_src(
+            tmp_path,
+            "RAW = CLIENT._transport.report(b'x')\n",
+        )
+        assert len(r.violations) == 1
+
+    def test_aliased_transport_method_flagged(self, tmp_path):
+        """Review regression: the fence matches the ATTRIBUTE access,
+        so the repo's own bound-method idiom
+        (``fn = self._transport.get; fn(payload)``) cannot evade it in
+        an unfenced function."""
+        r = self._run_src(
+            tmp_path,
+            "class Rogue:\n"
+            "    def fetch(self, verb, payload):\n"
+            "        fn = (self._transport.get if verb == 'get'\n"
+            "              else self._transport.report)\n"
+            "        return fn(payload)\n",
+        )
+        assert len(r.violations) == 2, [
+            v.render() for v in r.violations
+        ]
+        assert all("epoch fence" in v.message for v in r.violations)
+
+    def test_aliased_transport_method_fenced_clean(self, tmp_path):
+        """MasterClient._call's real shape: aliasing inside a function
+        that observes the epoch is the fenced path."""
+        r = self._run_src(
+            tmp_path,
+            "class C:\n"
+            "    def _call(self, verb, payload):\n"
+            "        fn = (self._transport.get if verb == 'get'\n"
+            "              else self._transport.report)\n"
+            "        raw = fn(payload)\n"
+            "        self._observe_epoch(raw)\n"
+            "        return raw\n",
+        )
+        assert not r.violations, [v.render() for v in r.violations]
+
+
+class TestPrecommitHook:
+    """The checked-in pre-commit fast path: scripts/precommit-lint on a
+    throwaway git repo catches a planted violation in a CHANGED file
+    and skips clean/committed files entirely."""
+
+    _SCRIPT = os.path.join(_REPO, "scripts", "precommit-lint")
+
+    def _git_repo(self, tmp_path):
+        import subprocess
+
+        def git(*args):
+            subprocess.run(
+                ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+                + list(args),
+                cwd=tmp_path,
+                check=True,
+                capture_output=True,
+            )
+
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        violation = (
+            "import threading, time\n"
+            "_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(1)\n"
+        )
+        # a COMMITTED violation: the fast path must not report it
+        (pkg / "old.py").write_text(violation)
+        (pkg / "clean.py").write_text("X = 1\n")
+        git("init", "-q")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        return pkg, violation
+
+    def _hook(self, tmp_path, lint_path="pkg"):
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["PRECOMMIT_ROOT"] = str(tmp_path)
+        env["PYTHON"] = sys.executable
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.run(
+            ["sh", self._SCRIPT, lint_path],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=env,
+        )
+
+    def test_catches_planted_violation_in_changed_file(self, tmp_path):
+        pkg, violation = self._git_repo(tmp_path)
+        (pkg / "fresh.py").write_text(violation)
+        proc = self._hook(tmp_path)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "fresh.py" in proc.stdout
+        assert "blocking-under-lock" in proc.stdout
+        # the committed twin is skipped — the hook is a fast path, not
+        # the repo gate
+        assert "old.py" not in proc.stdout
+
+    def test_skips_clean_tree(self, tmp_path):
+        self._git_repo(tmp_path)
+        proc = self._hook(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no Python files changed" in proc.stderr
+
+    def test_clean_edit_passes(self, tmp_path):
+        pkg, _ = self._git_repo(tmp_path)
+        (pkg / "clean.py").write_text("X = 2\n")
+        proc = self._hook(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 violations" in proc.stdout
+
+    def test_config_wires_the_script(self):
+        cfg = open(os.path.join(_REPO, ".pre-commit-config.yaml")).read()
+        assert "scripts/precommit-lint" in cfg
+        assert os.access(self._SCRIPT, os.X_OK), (
+            "scripts/precommit-lint must be executable"
+        )
+
+    def test_documented_symlink_install(self, tmp_path):
+        """Review regression: the documented
+        ``ln -s ../../scripts/precommit-lint .git/hooks/pre-commit``
+        install runs the hook as .git/hooks/pre-commit, where the old
+        script-relative cd landed in .git/ and rejected every commit.
+        Git runs hooks with cwd = repo toplevel; drill exactly that."""
+        import shutil
+        import subprocess
+        import sys
+
+        pkg, violation = self._git_repo(tmp_path)
+        scripts = tmp_path / "scripts"
+        scripts.mkdir()
+        shutil.copy(self._SCRIPT, scripts / "precommit-lint")
+        hook = tmp_path / ".git" / "hooks" / "pre-commit"
+        hook.symlink_to("../../scripts/precommit-lint")
+
+        def run_hook():
+            env = dict(os.environ)
+            env.pop("PRECOMMIT_ROOT", None)  # the real install has none
+            env["PYTHON"] = sys.executable
+            env["PYTHONPATH"] = _REPO + os.pathsep + env.get(
+                "PYTHONPATH", ""
+            )
+            return subprocess.run(
+                ["sh", str(hook), "pkg"],
+                cwd=tmp_path,
+                capture_output=True,
+                text=True,
+                timeout=120,
+                env=env,
+            )
+
+        (pkg / "fresh.py").write_text(violation)
+        proc = run_hook()
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "fresh.py" in proc.stdout
+        (pkg / "fresh.py").unlink()
+        proc = run_hook()
+        assert proc.returncode == 0, proc.stdout + proc.stderr

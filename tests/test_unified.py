@@ -527,6 +527,27 @@ class TestMasterCommService:
         yield svc
         svc.stop()
 
+    def test_responses_stamp_master_epoch(self):
+        """epoch-fence regression: every unified comm response carries
+        the master_epoch stamp (0 = journal-less, an explicit decision)
+        on the success, unknown-message and handler-error paths."""
+        from dlrover_tpu.common import comm
+        from dlrover_tpu.common.serialize import dumps, loads
+        from dlrover_tpu.unified.comm_service import (
+            UKvSet,
+            UnifiedCommServicer,
+        )
+
+        servicer = UnifiedCommServicer()
+        for msg, ok in (
+            (UKvSet(key="k", value=1), True),
+            (comm.HeartbeatRequest(node_id=0), False),  # unknown here
+        ):
+            resp = loads(servicer.get(dumps(msg)))
+            assert isinstance(resp, comm.BaseResponse)
+            assert resp.master_epoch == 0
+            assert resp.success is ok
+
     def test_queue_roundtrip_across_clients(self, service):
         from dlrover_tpu.unified.comm_service import MasterDataQueue
 

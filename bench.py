@@ -276,8 +276,13 @@ _PRIORITY_KEYS = (
     # per-section supporting floats (the header rule — provenance
     # before detail) when the pool section filled the line past them
     "last_silicon", "hang_diagnosis",
-    "serving_per_row_tokens_per_s", "decode_tokens_per_s",
-    "ckpt_async_stage_block_s",
+    # Byte offsets for the detection-SLO pair below:
+    # serving_per_row_tokens_per_s and ckpt_async_stage_block_s moved
+    # sidecar-only (both ride the SILICON headline dict the
+    # last_silicon pointer names, same recoverability class as
+    # restore_overhead_x above; decode_tokens_per_s stays — the
+    # serving-verdict comment above already pins it in-line)
+    "decode_tokens_per_s",
     # recovery-SLO matrix (per-fault-class, pointer-style — the full
     # storm dict with stall forensics goes to the sidecar)
     "storm_goodput", "storm_mttr_s", "storm_slice_mttr_s",
@@ -292,6 +297,12 @@ _PRIORITY_KEYS = (
     # full goodput_storm dict the sidecar carries; the phase VERDICT
     # signal rides on compile_s — the warm-restart claim — and rdzv_s).
     "storm_rdzv_s", "storm_compile_s",
+    # incident-trace detection SLOs (docs/observability.md): MTTD from
+    # the merged cross-process trace plus the detect phase share. The
+    # other trace phase scalars (trace_mttr_s, rendezvous_s, reshard_s,
+    # recompile_s) are sidecar-recoverable from the full goodput_storm
+    # dict — only the detection headline rides the line.
+    "storm_mttd_s", "storm_detect_s",
     # master crash tolerance (docs/recovery.md master failover): the
     # coordination-outage MTTR and the productive fraction of the kill
     # window; the full drill dict (epoch, replay_s, restart audit) is
@@ -2416,6 +2427,13 @@ def worker():
                     extra["storm_restore_s"] = storm.get("restore_s")
                     extra["storm_compile_s"] = storm.get("compile_s")
                     extra["storm_first_step_s"] = storm.get("first_step_s")
+                    # trace-derived detection SLOs (docs/observability.md):
+                    # fault-to-detect latency from the merged incident
+                    # trace. The remaining trace phase scalars
+                    # (rendezvous/reshard/recompile) stay
+                    # sidecar-recoverable inside the storm dict.
+                    extra["storm_mttd_s"] = storm.get("mttd_s")
+                    extra["storm_detect_s"] = storm.get("detect_s")
                 else:
                     extra["goodput_storm_error"] = "harness timed out"
             except Exception as e:  # noqa: BLE001

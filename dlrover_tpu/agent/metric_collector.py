@@ -13,13 +13,24 @@ import urllib.request
 from typing import Dict, Optional
 
 from ..common.log import logger
+from ..observability.metrics import get_registry
 from ..rpc.client import MasterClient
 
 _LINE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([-0-9.eE+]+)$")
 
 
 def parse_prometheus(text: str) -> Dict[str, float]:
-    """metric{labels} value → {"metric[labels]": value} flat map."""
+    """Prometheus exposition text → flat ``{key: value}`` map.
+
+    Flattening rule: every sample keeps its FULL exposition key
+    (``name{labels}``), and each metric additionally gets a bare-name
+    convenience key holding the LAST sample of that family in file
+    order — so unlabeled consumers (hang checks reading
+    ``tpu_timer_hang``) don't parse label syntax, at the documented
+    cost that a multi-labeled family's bare key is whichever series
+    the endpoint rendered last. Comment lines, blank lines, and
+    malformed samples (bad name, non-numeric value) are skipped.
+    """
     gauges: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -30,12 +41,12 @@ def parse_prometheus(text: str) -> Dict[str, float]:
             continue
         name, labels, value = m.group(1), m.group(2) or "", m.group(3)
         try:
-            gauges[name + labels] = float(value)
+            parsed = float(value)
         except ValueError:
             continue
-        # convenience: bare name keeps the last seen value
-        gauges.setdefault(name, 0.0)
-        gauges[name] = float(value)
+        gauges[name + labels] = parsed
+        if labels:
+            gauges[name] = parsed
     return gauges
 
 
@@ -68,6 +79,10 @@ class ProfilerMetricCollector:
             return None
         gauges = parse_prometheus(text)
         if gauges:
+            # Local half of the unified plane: the agent's own /metrics
+            # re-serves the worker scrape (keys are already exposition
+            # syntax), so operators read one endpoint per host.
+            get_registry().ingest(gauges)
             try:
                 self._client.report_node_metrics(gauges)
             except Exception as e:

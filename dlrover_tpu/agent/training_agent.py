@@ -30,6 +30,8 @@ from ..common.constants import (
 from ..common.events import EventEmitter
 from ..common.log import logger
 from ..master.diagnosis.action import DiagnosisActionType
+from ..observability import trace
+from ..observability.metrics import get_registry, maybe_start_metrics_server
 from ..rpc.client import MasterClient
 from .config import ElasticLaunchConfig
 from .diagnosis_agent import DiagnosisAgent, WorkerFailure
@@ -98,6 +100,7 @@ class ElasticTrainingAgent:
             self._client.add_epoch_listener(self._on_master_epoch)
         self._evt = EventEmitter("agent")
         self._metric_collector = None
+        self._metrics_server = None
         self._profiler_daemon = None
         self._spare = None
         # Soft-remesh handshake dir, exported to the worker (unique per
@@ -139,6 +142,12 @@ class ElasticTrainingAgent:
             AsyncCheckpointSaver.start_async_saving_ckpt()
         self._diagnosis.start_heartbeat()
         self._resource_monitor.start()
+        # Agent half of the unified metrics plane: off unless the port
+        # knob is set; serves this process's registry (rendezvous/
+        # restart counters, world gauges, ingested worker scrapes).
+        self._metrics_server = maybe_start_metrics_server(
+            "DLROVER_METRICS_AGENT_PORT"
+        )
         try:
             self._setup_profiling()
             # Spawn the first spare NOW, concurrently with the
@@ -156,6 +165,9 @@ class ElasticTrainingAgent:
             self._stopped.set()
             self._diagnosis.stop()
             self._resource_monitor.stop()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
             self._teardown_profiling()
             if self._spare is not None:
                 self._spare.kill()
@@ -212,6 +224,10 @@ class ElasticTrainingAgent:
                     "node_rank": self._config.node_rank,
                 },
             )
+        registry = get_registry()
+        registry.counter("dlrover_agent_rendezvous_rounds_total").inc()
+        registry.gauge("dlrover_agent_world_size").set(self._world.world_size)
+        registry.gauge("dlrover_agent_rendezvous_round").set(self._world.round)
         logger.info(
             "world ready: round=%s rank=%s/%s coordinator=%s",
             self._world.round,
@@ -275,14 +291,32 @@ class ElasticTrainingAgent:
         self._report_status(NodeStatus.RUNNING)
 
     def _world_env(self, world: RendezvousWorld) -> Dict[str, str]:
-        """The dynamic (per-rendezvous-round) part of the env contract."""
-        return {
+        """The dynamic (per-rendezvous-round) part of the env contract.
+
+        Includes the trace contract (DLROVER_TRACE_ID/_PARENT_SPAN) when
+        an incident is active, so the worker spawned BY a recovery joins
+        the incident's timeline; both start paths (cold spawn and
+        warm-spare hand-off) carry dynamic_env, so both inherit it.
+        """
+        env = {
             NodeEnv.COORDINATOR_ADDRESS: world.coordinator,
             NodeEnv.NUM_PROCESSES: str(world.world_size),
             NodeEnv.PROCESS_ID: str(world.rank),
             NodeEnv.NODE_RANK: str(self._config.node_rank),
             NodeEnv.NODE_NUM: str(world.world_size),
         }
+        env.update(trace.child_env())
+        return env
+
+    def _begin_incident(self, kind: str, **content) -> None:
+        """Open a new incident trace at a detection point: every event
+        this process emits from here on — and, via the RPC and spawn
+        contracts, the master's handler-side events and the replacement
+        worker's — shares one trace_id until the next incident."""
+        ctx = trace.start_incident()
+        get_registry().counter("dlrover_agent_incidents_total").inc()
+        self._evt.instant("incident_detected", kind=kind, **content)
+        logger.info("incident %s opened (trace %s)", kind, ctx.trace_id)
 
     # -- warm-spare pool (one pre-imported interpreter per agent) ---------
 
@@ -409,6 +443,7 @@ class ElasticTrainingAgent:
 
     def _restart_workers(self, reason: str, world=None) -> None:
         logger.info("restarting worker (%s)", reason)
+        get_registry().counter("dlrover_agent_worker_restarts_total").inc()
         self._evt.instant("restart_worker", reason=reason)
         if self._worker is not None:
             self._worker.stop()
@@ -452,6 +487,9 @@ class ElasticTrainingAgent:
                 self._reattach_master()
                 continue
             if changed:
+                self._begin_incident(
+                    "membership_change", node_rank=self._config.node_rank
+                )
                 outcome, world = self._try_soft_remesh()
                 if outcome == "worker_exited":
                     continue  # normal poll handling owns exits/failures
@@ -477,6 +515,9 @@ class ElasticTrainingAgent:
         and verify the recovered world. When the replayed world matches
         the cached one the live JAX worker keeps training — the master
         crash costs seconds of coordination, zero worker restarts."""
+        self._begin_incident(
+            "master_restart", node_rank=self._config.node_rank
+        )
         t0 = time.monotonic()
         with self._evt.duration(
             "master_reattach", node_rank=self._config.node_rank
@@ -522,6 +563,12 @@ class ElasticTrainingAgent:
             result.returncode,
             result.signal,
             self._restart_count,
+        )
+        self._begin_incident(
+            "worker_failure",
+            returncode=result.returncode,
+            signal=result.signal,
+            node_rank=self._config.node_rank,
         )
         if self._config.save_at_breakpoint:
             self._save_ckpt_at_breakpoint()

@@ -179,10 +179,26 @@ def run_goodput_storm(
         cache_dir = os.path.join(workdir, "xla_cache")
     ckpt_dir = os.path.join(workdir, "ckpt")
     recovery_dir = os.path.join(workdir, "recovery")
+    trace_dir = os.path.join(workdir, "trace")
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
     os.makedirs(ckpt_dir, exist_ok=True)
     os.makedirs(recovery_dir, exist_ok=True)
+    os.makedirs(trace_dir, exist_ok=True)
+    # Incident tracing: every process of the drill (the in-process
+    # master included) writes events + flight dumps into ONE dir, so
+    # the result can carry the tpurun-trace phase breakdown (MTTD +
+    # detect/rendezvous/reshard/recompile) next to the stall-derived
+    # MTTR. The master's lazily-built default exporter is flushed so
+    # the next emit rebuilds against the redirected dir.
+    from ..common.events import EventEmitter, flush_default_exporter
+
+    prev_event_dir = os.environ.get("DLROVER_EVENT_DIR")
+    prev_trace_dir = os.environ.get("DLROVER_TRACE_DIR")
+    os.environ["DLROVER_EVENT_DIR"] = trace_dir
+    os.environ["DLROVER_TRACE_DIR"] = trace_dir
+    flush_default_exporter()
+    storm_evt = EventEmitter("chaos")
     script = os.path.join(workdir, "storm_trainer.py")
     with open(script, "w") as f:
         f.write(_TRAINER_TEMPLATE)
@@ -222,6 +238,9 @@ def run_goodput_storm(
         "STORM_MAX_STEPS": str(total_budget * 10),
         "DLROVER_LOCAL_DEVICES": "1",
         "PYTHONPATH": os.pathsep.join(sys.path),
+        # agents + trainers join the drill's shared trace/event dir
+        "DLROVER_EVENT_DIR": trace_dir,
+        "DLROVER_TRACE_DIR": trace_dir,
     }
     # The shared runtime knob (common/compile_cache.py): agents inherit
     # it and export it to every trainer incarnation. Explicitly "" when
@@ -345,6 +364,11 @@ def run_goodput_storm(
                         step,
                     )
                     kill_times.append({"t": time.time(), "kind": kind})
+                    # fault anchor for the merged trace's MTTD/phase
+                    # tiling — the one event only the killer can emit
+                    storm_evt.instant(
+                        "chaos_kill", kind=kind, victims=killed, step=int(step)
+                    )
                     if kind == "slice" and not first_slice_kill_t:
                         first_slice_kill_t = time.time()
                     kills_done += 1
@@ -387,6 +411,26 @@ def run_goodput_storm(
                 from ..attribution.recovery import aggregate
 
                 result.update(aggregate(recovery_dir))
+                # Trace-derived incident breakdown (tpurun-trace): the
+                # exporter is flushed first so buffered events hit the
+                # files summarize() reads; emitters rebuild lazily.
+                flush_default_exporter()
+                from ..observability.trace_merge import summarize
+
+                tr = summarize(trace_dir)
+                result["trace_incidents"] = len(tr.get("incidents", []))
+                for key in (
+                    "mttd_s",
+                    "detect_s",
+                    "rendezvous_s",
+                    "reshard_s",
+                    "recompile_s",
+                ):
+                    if key in tr:
+                        result[key] = tr[key]
+                if "mttr_s" in tr:
+                    # trace clock, vs the stall-derived mttr_s above
+                    result["trace_mttr_s"] = tr["mttr_s"]
                 if slice_kills:
                     window = (
                         end_t - first_slice_kill_t
@@ -423,6 +467,18 @@ def run_goodput_storm(
         return None
     finally:
         ctx.max_relaunch_count = prev_max_relaunch
+        # Undo the event/trace redirection for later in-process work
+        # (bench sections, other drills): restore the env and flush so
+        # the next emit rebuilds from the restored environment.
+        if prev_event_dir is None:
+            os.environ.pop("DLROVER_EVENT_DIR", None)
+        else:
+            os.environ["DLROVER_EVENT_DIR"] = prev_event_dir
+        if prev_trace_dir is None:
+            os.environ.pop("DLROVER_TRACE_DIR", None)
+        else:
+            os.environ["DLROVER_TRACE_DIR"] = prev_trace_dir
+        flush_default_exporter()
         try:
             master.stop()
         finally:

@@ -20,6 +20,11 @@ class BaseRequest:
     node_id: int = -1
     node_type: str = ""
     data: bytes = b""
+    # Incident trace context (observability/trace.py): empty outside an
+    # active trace. The servicer adopts it for the handler's duration so
+    # master-side events join the caller's incident timeline.
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @register_message
@@ -33,6 +38,12 @@ class BaseResponse:
     # response so agents/clients detect a restarted master, fence stale
     # in-flight answers from the dead incarnation, and re-attach.
     master_epoch: int = 0
+    # Echo of the request's trace_id (correlation receipt) and the
+    # master's wall clock at respond time — the client's clock-offset
+    # estimator (trace.note_master_offset) feeds on it so tpurun-trace
+    # can align per-host timelines. 0.0 = pre-trace master.
+    trace_id: str = ""
+    server_ts: float = 0.0
 
 
 # ---------------------------------------------------------------------------

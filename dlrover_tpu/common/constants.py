@@ -315,6 +315,13 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_UNIFIED_P2P_TTL_S", "float", doc="unified P2P payload TTL"),
     EnvKnob("DLROVER_UNIFIED_P2P_STORE_CAP", "int", doc="unified P2P store capacity (bytes)"),
     EnvKnob("DLROVER_UNIFIED_P2P_INLINE_MAX", "int", doc="unified payload inline-size threshold (bytes)"),
+    # -- observability (dlrover_tpu/observability/, docs/observability.md) -
+    EnvKnob("DLROVER_TRACE_ID", doc="inherited incident trace id (spawn contract)", internal=True),
+    EnvKnob("DLROVER_TRACE_PARENT_SPAN", doc="inherited parent span id (spawn contract)", internal=True),
+    EnvKnob("DLROVER_TRACE_DIR", doc="flight-recorder dump directory (empty = dumps off)"),
+    EnvKnob("DLROVER_TRACE_RING_CAP", "int", doc="flight-recorder ring capacity (events kept per process)"),
+    EnvKnob("DLROVER_METRICS_PORT", "int", doc="master /metrics port (unset = off, 0 = free port)"),
+    EnvKnob("DLROVER_METRICS_AGENT_PORT", "int", doc="agent /metrics port (unset = off, 0 = free port)"),
     # -- Context-backed knobs (Context.apply_env reads DLROVER_<FIELD>) ----
     EnvKnob(NodeEnv.MASTER_SERVICE_TYPE, doc="master comms transport (grpc|http)", context_field="master_service_type"),
     EnvKnob("DLROVER_MASTER_PORT", "int", doc="master bind port (0 = free port)", context_field="master_port"),

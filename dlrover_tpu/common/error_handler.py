@@ -165,8 +165,14 @@ def init_error_handler() -> ErrorHandler:
     error_handler.py:142). The span SDK's shared exporter is always a
     flushable; callers add their own (timeline dumps, checkpoints)."""
     handler = ErrorHandler.singleton()
+    from ..observability.flight_recorder import dump_on_fault
     from .events import flush_default_exporter
 
+    # Ring dump first: the crash/fatal_signal event just emitted is in
+    # the ring, and the dump must not wait on the exporter drain.
+    handler.register_flushable(
+        "flight_recorder", lambda: dump_on_fault("fault")
+    )
     handler.register_flushable("events", flush_default_exporter)
     handler.register()
     return handler

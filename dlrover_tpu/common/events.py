@@ -17,6 +17,7 @@ import time
 import uuid
 from typing import Any, Dict, Optional
 
+from ..observability import flight_recorder, trace
 from .log import logger
 
 
@@ -27,7 +28,17 @@ class EventType:
 
 
 class Event:
-    __slots__ = ("event_id", "event_time", "target", "name", "event_type", "content", "pid")
+    __slots__ = (
+        "event_id",
+        "event_time",
+        "target",
+        "name",
+        "event_type",
+        "content",
+        "pid",
+        "trace_id",
+        "span_id",
+    )
 
     def __init__(self, target: str, name: str, event_type: str, content: Dict[str, Any]):
         self.event_id = uuid.uuid4().hex[:16]
@@ -37,20 +48,27 @@ class Event:
         self.event_type = event_type
         self.content = content
         self.pid = os.getpid()
+        # Incident correlation: empty outside an active trace, so
+        # steady-state event lines keep their pre-trace shape.
+        self.trace_id, self.span_id = trace.current_ids()
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "id": self.event_id,
+            "ts": round(self.event_time, 6),
+            "pid": self.pid,
+            "target": self.target,
+            "name": self.name,
+            "type": self.event_type,
+            "content": self.content,
+        }
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
+        return d
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "id": self.event_id,
-                "ts": round(self.event_time, 6),
-                "pid": self.pid,
-                "target": self.target,
-                "name": self.name,
-                "type": self.event_type,
-                "content": self.content,
-            },
-            default=str,
-        )
+        return json.dumps(self.to_dict(), default=str)
 
 
 class Exporter:
@@ -90,17 +108,37 @@ class AsyncExporter(Exporter):
         self._inner = inner
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue(max_queue)
         self._dropped = 0
+        self._drop_counter = None  # registry counter, bound on first drop
         self._thread = threading.Thread(
             target=self._run, name="event-exporter", daemon=True
         )
         self._thread.start()
         atexit.register(self.close)
 
+    @property
+    def dropped(self) -> int:
+        """Events lost to a full queue or a failing sink."""
+        return self._dropped
+
+    def _count_drop(self) -> None:
+        self._dropped += 1
+        try:
+            if self._drop_counter is None:
+                from ..observability.metrics import get_registry
+
+                self._drop_counter = get_registry().counter(
+                    "dlrover_events_dropped_total"
+                )
+            self._drop_counter.inc()
+        # tpulint: ignore[exception-swallow] the drop is already journaled in _dropped above; the registry mirror is best-effort and must not break the drop path
+        except Exception:  # noqa: BLE001 — metrics must not break the drop path
+            pass
+
     def export(self, event: Event) -> None:
         try:
             self._queue.put_nowait(event)
         except queue.Full:
-            self._dropped += 1
+            self._count_drop()
 
     def _run(self) -> None:
         while True:
@@ -110,7 +148,7 @@ class AsyncExporter(Exporter):
             try:
                 self._inner.export(event)
             except Exception as e:  # noqa: BLE001 — exporter must outlive sinks
-                self._dropped += 1
+                self._count_drop()
                 logger.debug("event export failed: %r", e)
 
     def close(self) -> None:
@@ -121,6 +159,22 @@ class AsyncExporter(Exporter):
         except queue.Full:
             pass
         self._thread.join(timeout=10)
+        if self._dropped:
+            # Post-drain summary straight to the sink: the one durable
+            # breadcrumb that the timeline has holes (and how many).
+            # Written synchronously so a full queue can't drop the
+            # drop report itself; its own failure is not re-counted.
+            try:
+                self._inner.export(
+                    Event(
+                        "events",
+                        "events_dropped",
+                        EventType.INSTANT,
+                        {"dropped": self._dropped},
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                logger.debug("drop-summary export failed: %r", e)
         self._inner.close()
 
 
@@ -133,9 +187,13 @@ class DurationSpan:
         self.content = dict(content)
         self._begin_time: Optional[float] = None
         self._ended = False
+        self._trace_token = None
 
     def begin(self) -> "DurationSpan":
         self._begin_time = time.time()
+        # Child span for the duration: begin/end share a span_id and
+        # events emitted inside nest under it in the merged trace.
+        self._trace_token = trace.push_child()
         self._emitter.emit(self.name, EventType.BEGIN, self.content)
         return self
 
@@ -149,6 +207,8 @@ class DurationSpan:
         if self._begin_time is not None:
             content["duration_s"] = round(time.time() - self._begin_time, 6)
         self._emitter.emit(self.name, EventType.END, content)
+        trace.release(self._trace_token)
+        self._trace_token = None
 
     def fail(self, error: str) -> None:
         self.end({"error": error, "success": False})
@@ -170,7 +230,11 @@ class EventEmitter:
 
     def emit(self, name: str, event_type: str, content: Dict[str, Any]) -> None:
         try:
-            self._exporter.export(Event(self.target, name, event_type, content))
+            event = Event(self.target, name, event_type, content)
+            # Ring first: the flight recorder must see the event even
+            # when the exporter path is the thing that is failing.
+            flight_recorder.record_event(event.to_dict())
+            self._exporter.export(event)
         except Exception:
             logger.debug("failed to emit event %s", name, exc_info=True)
 

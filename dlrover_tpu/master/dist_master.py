@@ -28,6 +28,7 @@ from ..common.constants import (
 )
 from ..common.events import MasterEvents
 from ..common.log import logger
+from ..observability.metrics import get_registry, maybe_start_metrics_server
 from ..rpc.server import create_master_server
 from .diagnosis.action import DiagnosisActionType, JobAbortionAction
 from .diagnosis.diagnosis_master import (
@@ -341,6 +342,64 @@ class DistributedJobMaster:
         )
         self._stopped = threading.Event()
         self.exit_reason = ""
+        self._metrics_server = None
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        """Bind the master's live objects into the unified registry as
+        render-time callbacks: PerfMonitor (step rate/goodput), the
+        rendezvous round counters, and the per-node profiler gauges the
+        agents report into JobMetricContext — one /metrics (and one
+        ``metrics_snapshot()`` for brain/) covers them all."""
+        registry = get_registry()
+        pm = self.perf_monitor
+        registry.gauge_fn(
+            "dlrover_job_steps_per_second", pm.steps_per_second
+        )
+        registry.gauge_fn(
+            "dlrover_job_step_time_s",
+            lambda: (
+                1.0 / pm.steps_per_second() if pm.steps_per_second() > 0 else 0.0
+            ),
+        )
+        registry.gauge_fn("dlrover_job_goodput", pm.goodput)
+        registry.gauge_fn(
+            "dlrover_job_training_goodput", pm.training_goodput
+        )
+        registry.gauge_fn(
+            "dlrover_job_last_step", lambda: float(pm.last_step()[0])
+        )
+        registry.gauge_fn(
+            "dlrover_job_seconds_since_last_step",
+            lambda: pm.seconds_since_last_step() or 0.0,
+        )
+        for name, mgr in self.rdzv_managers.items():
+            registry.gauge_fn(
+                f"dlrover_rendezvous_rounds_{name}",
+                lambda m=mgr: float(getattr(m, "_rdzv_round", 0)),
+            )
+
+        def _node_gauges() -> Dict[str, float]:
+            from .monitor.metric_context import get_metric_context
+
+            flat: Dict[str, float] = {}
+            for node_id, gauges in get_metric_context().all_gauges().items():
+                for gname, value in gauges.items():
+                    # Re-label the agent's flattened scrape keys as
+                    # per-node series; keys already carrying labels keep
+                    # them nested in the name-safe reported form.
+                    safe = gname.replace('"', "'")
+                    flat[
+                        f'dlrover_node_metric{{node="{node_id}",name="{safe}"}}'
+                    ] = value
+            return flat
+
+        registry.collector(_node_gauges)
+
+    def metrics_snapshot(self) -> Dict[str, float]:
+        """Master-side aggregation of the unified plane — the observed-
+        signal source for ``brain/`` (ROADMAP item 3)."""
+        return get_registry().snapshot()
 
     @property
     def addr(self) -> str:
@@ -356,6 +415,10 @@ class DistributedJobMaster:
             self.brain_reporter.start()
         self._job_ctx.set_stage(JobStage.PRE_CHECK)
         self._events.start(port=self.port)
+        # Unified metrics plane: off unless DLROVER_METRICS_PORT is set.
+        self._metrics_server = maybe_start_metrics_server(
+            "DLROVER_METRICS_PORT"
+        )
         if self.persistence is not None:
             # Initial snapshot: a crash before the first WAL compaction
             # must still replay the node table and rdzv params.
@@ -436,6 +499,9 @@ class DistributedJobMaster:
         self.stats_collector.stop()
         self.auto_scaler.stop()
         self.job_manager.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         self._server.stop()
 
     # -- construction ------------------------------------------------------

@@ -14,6 +14,7 @@ from ..common import comm
 from ..common.constants import JobStage, RendezvousName
 from ..common.log import logger
 from ..common.serialize import dumps, loads
+from ..observability import trace
 from .diagnosis.action import action_to_msg
 from .job_context import get_job_context
 from .kv_store import KVStoreService
@@ -48,7 +49,17 @@ class MasterServicer:
         self._epoch = epoch
 
     def _respond(self, **kwargs) -> bytes:
-        return dumps(comm.BaseResponse(master_epoch=self._epoch, **kwargs))
+        # server_ts feeds the clients' clock-offset estimators; trace_id
+        # echoes the adopted request context (empty outside a trace).
+        trace_id, _ = trace.current_ids()
+        return dumps(
+            comm.BaseResponse(
+                master_epoch=self._epoch,
+                trace_id=trace_id,
+                server_ts=time.time(),
+                **kwargs,
+            )
+        )
 
     # -- transport entry points (bytes in/out) -----------------------------
 
@@ -59,32 +70,46 @@ class MasterServicer:
             return self._respond(success=False, reason="fault-injected drop")
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
-        handler = self._GET_HANDLERS.get(type(message))
-        if handler is None:
-            logger.warning("no get handler for %s", type(message).__name__)
-            return self._respond(success=False, reason="unknown message")
+        # Scoped adoption: master events emitted while handling this
+        # request join the caller's incident trace.
+        token = trace.adopt_request(req)
         try:
-            result = handler(self, message)
-        except Exception as e:  # noqa: BLE001 — reported, not retried
-            logger.exception("get handler failed for %s", type(message).__name__)
-            return self._respond(success=False, reason=repr(e))
-        return self._respond(success=True, data=dumps(result))
+            handler = self._GET_HANDLERS.get(type(message))
+            if handler is None:
+                logger.warning("no get handler for %s", type(message).__name__)
+                return self._respond(success=False, reason="unknown message")
+            try:
+                result = handler(self, message)
+            except Exception as e:  # noqa: BLE001 — reported, not retried
+                logger.exception(
+                    "get handler failed for %s", type(message).__name__
+                )
+                return self._respond(success=False, reason=repr(e))
+            return self._respond(success=True, data=dumps(result))
+        finally:
+            trace.release(token)
 
     def report(self, request_bytes: bytes) -> bytes:
         if faults.inject("master.servicer.report") == "drop":
             return self._respond(success=False, reason="fault-injected drop")
         req = loads(request_bytes)
         message = loads(req.data) if isinstance(req, comm.BaseRequest) else req
-        handler = self._REPORT_HANDLERS.get(type(message))
-        if handler is None:
-            logger.warning("no report handler for %s", type(message).__name__)
-            return self._respond(success=False, reason="unknown message")
+        token = trace.adopt_request(req)
         try:
-            handler(self, message)
-            return self._respond(success=True)
-        except Exception as e:  # noqa: BLE001
-            logger.exception("report handler failed")
-            return self._respond(success=False, reason=repr(e))
+            handler = self._REPORT_HANDLERS.get(type(message))
+            if handler is None:
+                logger.warning(
+                    "no report handler for %s", type(message).__name__
+                )
+                return self._respond(success=False, reason="unknown message")
+            try:
+                handler(self, message)
+                return self._respond(success=True)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("report handler failed")
+                return self._respond(success=False, reason=repr(e))
+        finally:
+            trace.release(token)
 
     # -- kv store ----------------------------------------------------------
 

@@ -1,0 +1,135 @@
+"""Fault-triggered flight recorder: a bounded per-process ring of
+recent events, dumped to disk when the process faults.
+
+Every event the SDK emits (:mod:`dlrover_tpu.common.events`) is also
+appended to this in-memory ring — cheap enough to stay always-on. On a
+crash, fatal signal, chaos kill, or explicit request, ``dump()`` writes
+the ring plus identity metadata (pid, role, trace ids, the master
+clock-offset estimate) as one atomic JSON file under
+``DLROVER_TRACE_DIR``. The ``tpurun-trace`` merger joins these dumps
+with the durable event files into one cross-process timeline.
+
+The dump path is wired through :mod:`dlrover_tpu.common.error_handler`
+(excepthook + fatal-signal hooks), so the last ~2k events before any
+death are post-mortemable without always-on verbose logging — the
+TorchTitan flight-recorder idea, applied to the elastic runtime."""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..common.constants import ENV_KNOBS
+from ..common.log import logger
+from . import trace
+
+TRACE_DIR_ENV = "DLROVER_TRACE_DIR"
+RING_CAP_ENV = "DLROVER_TRACE_RING_CAP"
+
+
+class FlightRecorder:
+    """Bounded ring of event dicts; ``dump`` is atomic and idempotent
+    per (reason) — repeated faults each leave their own file."""
+
+    def __init__(self, capacity: int = 2048, role: str = ""):
+        self.capacity = capacity
+        self.role = role
+        self._mu = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._dumped_reasons: List[str] = []
+
+    def record(self, event_dict: Dict) -> None:
+        with self._mu:
+            self._ring.append(event_dict)
+
+    def snapshot(self) -> List[Dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def dump(self, reason: str, out_dir: Optional[str] = None) -> Optional[str]:
+        """Write the ring to ``flight_{pid}_{reason}_{ts}.json`` under
+        ``out_dir`` (default: ``DLROVER_TRACE_DIR``). Returns the path,
+        or None when no directory is configured or the write fails —
+        a dying process must not die twice over its post-mortem."""
+        out_dir = out_dir or os.getenv(TRACE_DIR_ENV, "")
+        if not out_dir:
+            return None
+        events = self.snapshot()
+        trace_id, span_id = trace.current_ids()
+        payload = {
+            "pid": os.getpid(),
+            "role": self.role,
+            "reason": reason,
+            "dump_ts": time.time(),
+            "trace_id": trace_id,
+            "span_id": span_id,
+            # (local - master) clock estimate; the merger subtracts it
+            # to express this process's timestamps on the master clock.
+            "clock_offset_s": trace.master_clock_offset(),
+            "events": events,
+        }
+        safe_reason = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in reason
+        )[:40]
+        fname = f"flight_{os.getpid()}_{safe_reason}_{int(time.time() * 1000)}.json"
+        path = os.path.join(out_dir, fname)
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("flight-recorder dump failed: %r", e)
+            return None
+        with self._mu:
+            self._dumped_reasons.append(reason)
+        logger.info(
+            "flight recorder dumped %d events to %s", len(events), path
+        )
+        return path
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder(role: str = "") -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                cap = ENV_KNOBS[RING_CAP_ENV].get(2048)
+                _recorder = FlightRecorder(capacity=int(cap), role=role)
+    if role and not _recorder.role:
+        _recorder.role = role
+    return _recorder
+
+
+def reset_recorder() -> None:
+    """Test hook: drop the process recorder."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+def record_event(event_dict: Dict) -> None:
+    """Feed one emitted event into the ring (called by the event SDK on
+    every emit; must stay O(1) and never raise)."""
+    try:
+        get_recorder().record(event_dict)
+    # tpulint: ignore[exception-swallow] per-event hot path: logging here would spam at emit cadence, and a broken ring must never take the emitter down with it
+    except Exception:  # noqa: BLE001 — observability never breaks the emitter
+        pass
+
+
+def dump_on_fault(reason: str = "fault") -> Optional[str]:
+    """Crash-hook entry point: dump the ring if a recorder exists and a
+    trace dir is configured. Registered as an error-handler flushable so
+    excepthook/fatal-signal paths leave a post-mortem."""
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.dump(reason)

@@ -1,0 +1,332 @@
+"""Unified process-local metrics registry with a Prometheus-text
+``/metrics`` endpoint.
+
+The runtime previously had per-process metric islands: the trainer's
+native tpu_timer endpoint, the agent's scrape-and-forward collector,
+the master's ``PerfMonitor``/``JobMetricContext``. This registry is the
+one place a process's counters/gauges/histograms live; masters and
+agents serve it over HTTP (``DLROVER_METRICS_PORT`` /
+``DLROVER_METRICS_AGENT_PORT``), the agent collector ingests the
+worker's scraped gauges into it, and the master registers callback
+gauges over ``PerfMonitor``/``JobMetricContext`` so ``brain/`` and
+operators read ONE plane.
+
+Render-time callbacks (``gauge_fn``/``collector``) keep the hot paths
+free: a gauge backed by a live object costs nothing until somebody
+scrapes."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common.constants import ENV_KNOBS
+from ..common.log import logger
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._mu = threading.Lock()
+        self._values: Dict[_LabelKey, float] = {(): 0.0}
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._mu:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels: str) -> float:
+        with self._mu:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        with self._mu:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_render_labels(k)} {v}" for k, v in items]
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._mu = threading.Lock()
+        self._values: Dict[_LabelKey, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._mu:
+            self._values[_label_key(labels)] = float(value)
+
+    def value(self, default: float = 0.0, **labels: str) -> float:
+        with self._mu:
+            return self._values.get(_label_key(labels), default)
+
+    def render(self) -> List[str]:
+        with self._mu:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_render_labels(k)} {v}" for k, v in items]
+
+
+# Buckets sized for step/recovery latencies (seconds).
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0
+)
+
+
+class Histogram:
+    def __init__(self, name: str, buckets=DEFAULT_BUCKETS, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._mu = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._mu:
+            self._sum += value
+            self._count += 1
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def render(self) -> List[str]:
+        with self._mu:
+            counts = list(self._counts)
+            total = self._count
+            sum_ = self._sum
+        out = []
+        cum = 0
+        for edge, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{edge}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {round(sum_, 6)}")
+        out.append(f"{self.name}_count {total}")
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe family registry; renders Prometheus exposition text."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+        self._gauge_fns: Dict[str, Callable[[], float]] = {}
+        self._collectors: List[Callable[[], Dict[str, float]]] = []
+        # Ingested external samples (the agent's worker-endpoint scrape):
+        # keys are full exposition keys ('name{labels}'), rendered
+        # verbatim — the source already speaks Prometheus text.
+        self._ingested: Dict[str, float] = {}
+        # Always present so every /metrics answers the event-loss
+        # question, even at zero (common/events.py increments it).
+        self.counter(
+            "dlrover_events_dropped_total",
+            help_="events dropped by the async exporter (full queue or sink failure)",
+        )
+
+    def _family(self, name: str, factory, kind):
+        with self._mu:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = factory()
+                self._metrics[name] = metric
+            elif not isinstance(metric, kind):
+                raise ValueError(
+                    f"metric {name} already registered as "
+                    f"{type(metric).__name__}"
+                )
+            return metric
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._family(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._family(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(
+        self, name: str, buckets=DEFAULT_BUCKETS, help_: str = ""
+    ) -> Histogram:
+        return self._family(
+            name, lambda: Histogram(name, buckets, help_), Histogram
+        )
+
+    def gauge_fn(
+        self, name: str, fn: Callable[[], float], help_: str = ""
+    ) -> None:
+        """Register a render-time gauge callback (overwrites a previous
+        registration under the same name — rebuilt components re-bind)."""
+        with self._mu:
+            self._gauge_fns[name] = fn
+
+    def collector(self, fn: Callable[[], Dict[str, float]]) -> None:
+        """Register a render-time callback returning a flat
+        ``{exposition_key: value}`` map (e.g. the master flattening
+        ``JobMetricContext`` into labeled per-node gauges)."""
+        with self._mu:
+            self._collectors.append(fn)
+
+    def ingest(self, gauges: Dict[str, float]) -> None:
+        """Merge externally-scraped samples (full exposition keys,
+        rendered verbatim) — the agent's worker /metrics scrape path."""
+        with self._mu:
+            self._ingested.update(gauges)
+
+    def render(self) -> str:
+        with self._mu:
+            metrics = sorted(self._metrics.items())
+            gauge_fns = sorted(self._gauge_fns.items())
+            collectors = list(self._collectors)
+            ingested = sorted(self._ingested.items())
+        lines: List[str] = []
+        for name, metric in metrics:
+            kind = {
+                Counter: "counter", Gauge: "gauge", Histogram: "histogram"
+            }[type(metric)]
+            if getattr(metric, "help", ""):
+                lines.append(f"# HELP {name} {metric.help}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(metric.render())
+        for name, fn in gauge_fns:
+            try:
+                value = float(fn())
+            except Exception as e:  # noqa: BLE001 — one bad callback must not kill the scrape
+                logger.debug("gauge_fn %s failed: %r", name, e)
+                continue
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        for fn in collectors:
+            try:
+                flat = fn()
+            except Exception as e:  # noqa: BLE001 — same isolation as gauge_fns
+                logger.debug("metrics collector failed: %r", e)
+                continue
+            lines.extend(f"{k} {v}" for k, v in sorted(flat.items()))
+        lines.extend(f"{k} {v}" for k, v in ingested)
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat scalar view (unlabeled series + callbacks) — the
+        master-side aggregation handed to ``brain/``."""
+        out: Dict[str, float] = {}
+        with self._mu:
+            metrics = list(self._metrics.items())
+            gauge_fns = list(self._gauge_fns.items())
+        for name, metric in metrics:
+            if isinstance(metric, (Counter, Gauge)):
+                try:
+                    out[name] = metric.value()
+                except Exception as e:  # noqa: BLE001 — snapshot must be total
+                    logger.debug("snapshot of %s failed: %r", name, e)
+                    continue
+            elif isinstance(metric, Histogram):
+                with metric._mu:
+                    out[f"{name}_count"] = float(metric._count)
+                    out[f"{name}_sum"] = metric._sum
+        for name, fn in gauge_fns:
+            try:
+                out[name] = float(fn())
+            except Exception as e:  # noqa: BLE001 — one bad callback must not kill the snapshot
+                logger.debug("gauge_fn %s failed in snapshot: %r", name, e)
+                continue
+        return out
+
+
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = MetricsRegistry()
+    return _registry
+
+
+def reset_registry() -> None:
+    """Test hook: drop the process registry."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = None  # set per-server subclass
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path != "/metrics":
+            self.send_error(404)
+            return
+        body = self.registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-scrape stderr spam
+        pass
+
+
+class MetricsServer:
+    """Tiny threaded HTTP server exposing one registry at /metrics."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+    ):
+        handler_cls = type(
+            "Handler", (_MetricsHandler,), {"registry": registry or get_registry()}
+        )
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-server", daemon=True
+        )
+        self._thread.start()
+        logger.info("metrics server listening on :%s/metrics", self.port)
+        return self
+
+    def stop(self) -> None:
+        # shutdown() handshakes with serve_forever; guard the
+        # never-started case (the event would never be set).
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def maybe_start_metrics_server(
+    knob: str, registry: Optional[MetricsRegistry] = None
+) -> Optional[MetricsServer]:
+    """Start a server when the named port knob is set (0 = ephemeral
+    free port, logged); unset knob → no listener, no surprise ports."""
+    port = ENV_KNOBS[knob].get(None)
+    if port is None:
+        return None
+    try:
+        return MetricsServer(registry=registry, port=int(port)).start()
+    except Exception as e:  # noqa: BLE001 — observability never blocks training
+        logger.warning("metrics server failed to start (%s=%s): %r", knob, port, e)
+        return None

@@ -1,0 +1,194 @@
+"""Cross-process trace context for incident timelines.
+
+One elastic incident (fault → detect → drain → rendezvous → reshard →
+recompile → resume) spans the master, every agent, and every trainer
+incarnation. This module gives each process a ``trace_id``/``span_id``
+pair that (a) stamps every event the SDK emits
+(:mod:`dlrover_tpu.common.events`), (b) rides every master RPC on the
+epoch-fenced ``MasterClient`` path (``BaseRequest.trace_id`` /
+``span_id``, echoed in ``BaseResponse.trace_id``), and (c) is inherited
+across process spawns through the worker env contract
+(``DLROVER_TRACE_ID`` / ``DLROVER_TRACE_PARENT_SPAN``) — so the
+``tpurun-trace`` merger can stitch the per-process files into one
+causal timeline.
+
+Scoping model (the runtime is thread-heavy, not asyncio-heavy):
+
+- a **process-level** current context (``start_incident``, env
+  adoption): every thread of the process stamps it — the agent's
+  monitor loop detects a failure and the rendezvous/restart work that
+  follows happens on several threads that must share the incident;
+- a **contextvar overlay** (``adopt``/``release``, ``child``): scoped
+  adoption for the master servicer, which handles many concurrent
+  agents and must stamp each request's context only for the duration
+  of its handler.
+
+Also owns the master clock-offset estimate: the RPC client feeds
+``note_master_offset`` with ``midpoint(local send/recv) - server_ts``
+per response, and the flight recorder persists the EWMA so the merger
+can align per-host clocks (master clock = reference).
+"""
+
+import contextvars
+import os
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+# Process spawn contract (registered in common/constants.py ENV_KNOBS):
+# the spawner exports the incident trace so children (agent → worker,
+# launcher → agent, warm-spare adoption) join the same timeline.
+TRACE_ID_ENV = "DLROVER_TRACE_ID"
+PARENT_SPAN_ENV = "DLROVER_TRACE_PARENT_SPAN"
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id, parent_id) triple."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    def child(self) -> "SpanContext":
+        return SpanContext(self.trace_id, _new_id(), self.span_id)
+
+    def __repr__(self) -> str:  # debugging aid only
+        return (
+            f"SpanContext(trace={self.trace_id}, span={self.span_id}, "
+            f"parent={self.parent_id})"
+        )
+
+
+_scoped: "contextvars.ContextVar[Optional[SpanContext]]" = (
+    contextvars.ContextVar("dlrover_trace", default=None)
+)
+_process_ctx: Optional[SpanContext] = None
+_env_checked = False
+_lock = threading.Lock()
+
+# EWMA of (local clock - master clock); None until the first RPC sample.
+_offset_s: Optional[float] = None
+_OFFSET_ALPHA = 0.2
+
+
+def current() -> Optional[SpanContext]:
+    """The active span context: the contextvar overlay if set, else the
+    process-level context (adopting the env contract lazily)."""
+    ctx = _scoped.get()
+    if ctx is not None:
+        return ctx
+    global _process_ctx, _env_checked
+    if _process_ctx is None and not _env_checked:
+        with _lock:
+            if _process_ctx is None and not _env_checked:
+                _env_checked = True
+                trace_id = os.environ.get(TRACE_ID_ENV, "")
+                if trace_id:
+                    _process_ctx = SpanContext(
+                        trace_id,
+                        _new_id(),
+                        os.environ.get(PARENT_SPAN_ENV, ""),
+                    )
+    return _process_ctx
+
+
+def current_ids() -> Tuple[str, str]:
+    """(trace_id, span_id) of the active context, or ("", "")."""
+    ctx = current()
+    return (ctx.trace_id, ctx.span_id) if ctx is not None else ("", "")
+
+
+def start_incident() -> SpanContext:
+    """Open a NEW root trace and make it the process-level current —
+    the detection point of an incident calls this so every event that
+    follows (this process's and, via the env/RPC contracts, its
+    children's and the master's) shares one trace_id."""
+    global _process_ctx
+    ctx = SpanContext(_new_id(), _new_id(), "")
+    with _lock:
+        _process_ctx = ctx
+    return ctx
+
+
+def adopt(trace_id: str, parent_span: str = "") -> "contextvars.Token":
+    """Scoped adoption of a caller's context (servicer handler path).
+    Returns a token for :func:`release`."""
+    return _scoped.set(SpanContext(trace_id, _new_id(), parent_span))
+
+
+def adopt_request(req) -> Optional["contextvars.Token"]:
+    """Adopt the trace context a ``comm.BaseRequest`` carries (no-op
+    for untraced requests and non-BaseRequest payloads)."""
+    trace_id = getattr(req, "trace_id", "")
+    if not trace_id:
+        return None
+    return adopt(trace_id, getattr(req, "span_id", ""))
+
+
+def release(token: Optional["contextvars.Token"]) -> None:
+    if token is None:
+        return
+    try:
+        _scoped.reset(token)
+    except ValueError:
+        # token from another context (cross-thread begin/end): clear
+        _scoped.set(None)
+
+
+def push_child() -> Optional["contextvars.Token"]:
+    """Enter a child span of the current context (DurationSpan begin);
+    returns None when no trace is active."""
+    ctx = current()
+    if ctx is None:
+        return None
+    return _scoped.set(ctx.child())
+
+
+def child_env() -> Dict[str, str]:
+    """Env-contract vars carrying the current trace to a spawned
+    process (empty when no trace is active)."""
+    ctx = current()
+    if ctx is None:
+        return {}
+    return {TRACE_ID_ENV: ctx.trace_id, PARENT_SPAN_ENV: ctx.span_id}
+
+
+# -- master clock offset ----------------------------------------------------
+
+
+def note_master_offset(offset_s: float) -> None:
+    """Feed one (local - master) clock-offset sample, estimated by the
+    RPC client as ``midpoint(send, recv) - response.server_ts``. EWMA
+    smooths transport-latency asymmetry across calls."""
+    global _offset_s
+    with _lock:
+        if _offset_s is None:
+            _offset_s = offset_s
+        else:
+            _offset_s += _OFFSET_ALPHA * (offset_s - _offset_s)
+
+
+def master_clock_offset() -> Optional[float]:
+    """Current (local - master) estimate; None before any RPC sample.
+    Subtract it from a local timestamp to express it on the master's
+    clock — the merger's alignment reference."""
+    with _lock:
+        return _offset_s
+
+
+def reset() -> None:
+    """Test hook: drop the process context, env adoption memo, and the
+    clock-offset estimate."""
+    global _process_ctx, _env_checked, _offset_s
+    with _lock:
+        _process_ctx = None
+        _env_checked = False
+        _offset_s = None
+    _scoped.set(None)

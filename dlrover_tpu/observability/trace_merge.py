@@ -1,0 +1,306 @@
+"""``tpurun-trace``: merge per-process event files and flight-recorder
+dumps into one causal, clock-aligned incident timeline.
+
+Inputs (one directory, typically a job's ``DLROVER_EVENT_DIR`` /
+``DLROVER_TRACE_DIR``):
+
+- ``events_*.jsonl`` — durable per-process event streams
+  (:class:`dlrover_tpu.common.events.TextFileExporter` lines);
+- ``flight_*.json`` — flight-recorder dumps, which both repeat the
+  ring's recent events (deduped by event id) and carry the dumping
+  process's ``clock_offset_s`` — the RPC-estimated (local − master)
+  clock offset the merger subtracts so every timestamp is expressed on
+  the master clock (the reference; processes with no estimate are
+  assumed aligned).
+
+Outputs: a Chrome-trace/Perfetto JSON (load in ``ui.perfetto.dev`` or
+``chrome://tracing``) and an incident summary that tiles each trace
+into consecutive phases anchored at shared milestones::
+
+    fault ──detect_s──▶ detected ──rendezvous_s──▶ rdzv end
+          ──reshard_s──▶ restore end ──recompile_s──▶ resumed
+
+The phases tile the interval, so ``mttd_s (= detect_s) + rendezvous_s +
+reshard_s + recompile_s == mttr_s`` by construction; a milestone that
+never fired collapses its phase to 0 and folds the time into the next
+one. This is what lets chaos drills report *where* recovery time goes
+instead of one MTTR scalar."""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Milestone vocabulary: event names produced by the runtime (agents,
+# master, trainers, chaos harness). Order of the tiling is fixed; each
+# set marks the END of the phase named in _PHASE_KEYS.
+FAULT_NAMES = {"chaos_kill", "fatal_signal", "crash", "process_fail", "node_fail"}
+DETECT_NAMES = {
+    "incident_detected",
+    "node_relaunch",
+    "process_restart",
+    "worker_failure",
+    "membership_changed",
+}
+RDZV_NAMES = {"rendezvous", "rendezvous_complete"}
+RESHARD_NAMES = {"ckpt_load", "train_restore"}
+RESUME_NAMES = {"train_resume"}
+
+_PHASE_KEYS = ("detect_s", "rendezvous_s", "reshard_s", "recompile_s")
+
+# A fault more than this far before an incident's first event is a
+# different incident's fault — don't attribute it.
+FAULT_WINDOW_S = 300.0
+
+
+def _load_jsonl(path: str) -> List[Dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail line of a killed process
+    except OSError:
+        pass
+    return out
+
+
+def load_dir(dir_path: str) -> Tuple[List[Dict], Dict[int, float]]:
+    """Read every event file and flight dump under ``dir_path``.
+
+    Returns ``(events, offsets)``: deduped event dicts (by event id)
+    and the per-pid (local − master) clock offsets found in dumps."""
+    events: List[Dict] = []
+    offsets: Dict[int, float] = {}
+    for path in sorted(glob.glob(os.path.join(dir_path, "events_*.jsonl"))):
+        events.extend(_load_jsonl(path))
+    for path in sorted(glob.glob(os.path.join(dir_path, "flight_*.json"))):
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        pid = dump.get("pid")
+        offset = dump.get("clock_offset_s")
+        if pid is not None and offset is not None:
+            offsets[int(pid)] = float(offset)
+        events.extend(e for e in dump.get("events", []) if isinstance(e, dict))
+    seen = set()
+    deduped = []
+    for e in events:
+        eid = e.get("id")
+        if eid is not None and eid in seen:
+            continue
+        if eid is not None:
+            seen.add(eid)
+        deduped.append(e)
+    return deduped, offsets
+
+
+def align(events: List[Dict], offsets: Dict[int, float]) -> List[Dict]:
+    """Stamp ``aligned_ts`` (master-clock seconds) into each event and
+    return them time-sorted. ``ts − offset(pid)`` with offset 0 for
+    processes that never estimated one (the master itself, or a process
+    that died before its first RPC)."""
+    for e in events:
+        offset = offsets.get(e.get("pid", -1), 0.0)
+        e["aligned_ts"] = float(e.get("ts", 0.0)) - offset
+    events.sort(key=lambda e: e["aligned_ts"])
+    return events
+
+
+def _milestone(e: Dict, names: set, end_only: bool = False) -> bool:
+    if e.get("name") not in names:
+        return False
+    if end_only and e.get("type") == "begin":
+        return False
+    return True
+
+
+def _first_after(
+    events: List[Dict], names: set, t_min: float, end_only: bool = False
+) -> Optional[float]:
+    for e in events:
+        if e["aligned_ts"] >= t_min and _milestone(e, names, end_only):
+            return e["aligned_ts"]
+    return None
+
+
+def phase_breakdown(
+    trace_events: List[Dict], all_events: List[Dict]
+) -> Dict[str, float]:
+    """Tile one incident's interval into the fixed phase chain.
+
+    ``trace_events``: the incident's own (trace-stamped) events.
+    ``all_events``: the full aligned stream — the fault instant usually
+    predates the trace (the killer doesn't know the trace the detector
+    will open), so it is searched globally, bounded by
+    :data:`FAULT_WINDOW_S`."""
+    if not trace_events:
+        return {}
+    t_start = trace_events[0]["aligned_ts"]
+    # Fault anchor: last fault event at-or-before the incident opened.
+    t_fault = None
+    for e in all_events:
+        if e["aligned_ts"] > t_start:
+            break
+        if _milestone(e, FAULT_NAMES) and t_start - e["aligned_ts"] <= FAULT_WINDOW_S:
+            t_fault = e["aligned_ts"]
+    if t_fault is None:
+        t_fault = t_start  # undetectable fault time → detect_s = 0
+
+    t_detect = _first_after(trace_events, DETECT_NAMES, t_fault)
+    if t_detect is None:
+        t_detect = t_start
+    chain = [t_fault, t_detect]
+    for names in (RDZV_NAMES, RESHARD_NAMES, RESUME_NAMES):
+        t = _first_after(trace_events, names, chain[-1], end_only=True)
+        chain.append(t if t is not None else chain[-1])
+    # Resume fallback: the first train step after restore proves the
+    # job is back even if no explicit train_resume event landed.
+    if chain[4] == chain[3]:
+        t_step = _first_after(trace_events, {"train_step"}, chain[3])
+        if t_step is not None:
+            chain[4] = t_step
+
+    out = {
+        key: round(chain[i + 1] - chain[i], 6)
+        for i, key in enumerate(_PHASE_KEYS)
+    }
+    out["mttd_s"] = out["detect_s"]
+    out["mttr_s"] = round(chain[4] - chain[0], 6)
+    out["fault_ts"] = round(t_fault, 6)
+    out["resume_ts"] = round(chain[4], 6)
+    return out
+
+
+def incidents(events: List[Dict]) -> List[Dict]:
+    """Group aligned events by trace_id and break each into phases."""
+    by_trace: Dict[str, List[Dict]] = {}
+    for e in events:
+        tid = e.get("trace_id", "")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    out = []
+    for tid, tev in sorted(
+        by_trace.items(), key=lambda kv: kv[1][0]["aligned_ts"]
+    ):
+        info = {
+            "trace_id": tid,
+            "events": len(tev),
+            "pids": sorted({e.get("pid", -1) for e in tev}),
+            "targets": sorted({e.get("target", "") for e in tev}),
+        }
+        info.update(phase_breakdown(tev, events))
+        out.append(info)
+    return out
+
+
+def to_chrome_trace(events: List[Dict]) -> Dict:
+    """Render the aligned stream as Chrome-trace JSON (B/E spans for
+    begin/end pairs, instants elsewhere; µs since the first event)."""
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = events[0]["aligned_ts"]
+    trace_events = []
+    for e in events:
+        ts_us = (e["aligned_ts"] - t0) * 1e6
+        etype = e.get("type", "instant")
+        args = {"content": e.get("content", {})}
+        if e.get("trace_id"):
+            args["trace_id"] = e["trace_id"]
+            args["span_id"] = e.get("span_id", "")
+        base = {
+            "name": f'{e.get("target", "")}.{e.get("name", "")}',
+            "pid": e.get("pid", 0),
+            "tid": e.get("pid", 0),
+            "ts": round(ts_us, 1),
+            "args": args,
+        }
+        if etype == "begin":
+            base["ph"] = "B"
+        elif etype == "end":
+            base["ph"] = "E"
+        else:
+            base["ph"] = "i"
+            base["s"] = "p"
+        trace_events.append(base)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def summarize(dir_path: str) -> Dict:
+    """One-call merge: load, align, group; the programmatic API the
+    chaos drills use to report MTTD + phase costs."""
+    events, offsets = load_dir(dir_path)
+    aligned = align(events, offsets)
+    incs = incidents(aligned)
+    summary = {
+        "events": len(aligned),
+        "processes": sorted({e.get("pid", -1) for e in aligned}),
+        "clock_offsets": offsets,
+        "incidents": incs,
+    }
+    if incs:
+        # Headline = worst (slowest-recovering) incident, the one an
+        # operator triages first.
+        worst = max(incs, key=lambda i: i.get("mttr_s", 0.0))
+        for key in ("mttd_s", "mttr_s") + _PHASE_KEYS:
+            if key in worst:
+                summary[key] = worst[key]
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpurun-trace",
+        description=(
+            "Merge per-process event files and flight-recorder dumps "
+            "into a clock-aligned Perfetto/Chrome trace with a "
+            "per-phase incident breakdown."
+        ),
+    )
+    parser.add_argument(
+        "dir", help="directory holding events_*.jsonl / flight_*.json"
+    )
+    parser.add_argument(
+        "-o",
+        "--output",
+        default="",
+        help="write Chrome-trace JSON here (default: <dir>/trace.json)",
+    )
+    parser.add_argument(
+        "--summary-only",
+        action="store_true",
+        help="print the incident summary JSON and skip the trace file",
+    )
+    args = parser.parse_args(argv)
+
+    events, offsets = load_dir(args.dir)
+    if not events:
+        print(f"no event files or flight dumps found in {args.dir}", file=sys.stderr)
+        return 1
+    aligned = align(events, offsets)
+    summary = {
+        "events": len(aligned),
+        "processes": sorted({e.get("pid", -1) for e in aligned}),
+        "clock_offsets": offsets,
+        "incidents": incidents(aligned),
+    }
+    if not args.summary_only:
+        out_path = args.output or os.path.join(args.dir, "trace.json")
+        with open(out_path, "w") as f:
+            json.dump(to_chrome_trace(aligned), f)
+        summary["trace_file"] = out_path
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ from ..common.config import get_context
 from ..common.constants import GRPC, CommsType, NodeEnv
 from ..common.log import logger
 from ..common.serialize import dumps, loads
+from ..observability import trace
 from .server import SERVICE_NAME, _identity
 
 
@@ -149,8 +150,13 @@ class MasterClient:
     # -- low-level verbs ---------------------------------------------------
 
     def _wrap(self, message: Any) -> bytes:
+        trace_id, span_id = trace.current_ids()
         req = comm.BaseRequest(
-            node_id=self.node_id, node_type=self.node_type, data=dumps(message)
+            node_id=self.node_id,
+            node_type=self.node_type,
+            data=dumps(message),
+            trace_id=trace_id,
+            span_id=span_id,
         )
         return dumps(req)
 
@@ -176,9 +182,20 @@ class MasterClient:
                 if faults.inject(f"rpc.client.{verb}", node_id=self.node_id) == "drop":
                     raise faults.FaultInjectedError(f"rpc {verb} dropped")
                 fn = self._transport.get if verb == "get" else self._transport.report
+                t_send = time.time()
                 raw = fn(payload)
+                t_recv = time.time()
                 resp = loads(raw)
                 if isinstance(resp, comm.BaseResponse):
+                    server_ts = getattr(resp, "server_ts", 0.0)
+                    if server_ts:
+                        # (local − master) clock estimate: the server
+                        # stamped its clock somewhere inside [send,
+                        # recv]; the midpoint halves the RTT error and
+                        # the EWMA in trace smooths the rest.
+                        trace.note_master_offset(
+                            (t_send + t_recv) / 2.0 - server_ts
+                        )
                     self._observe_epoch(getattr(resp, "master_epoch", 0))
                     if not resp.success and resp.reason:
                         logger.debug("master rejected %s: %s", verb, resp.reason)

@@ -21,7 +21,9 @@ from typing import Any, Callable, Iterable, Optional, Tuple
 # exact moment a worker can least afford allocator churn.
 from ..attribution.recovery import record_phase_file
 from ..common.constants import NodeEnv
+from ..common.events import EventEmitter
 from ..common.log import logger
+from ..observability.metrics import get_registry
 
 # Process-wide GC tracer installed by the first loop run (gc.callbacks
 # hooks must not stack when run() is called repeatedly).
@@ -133,6 +135,11 @@ class ElasticTrainLoop:
         # One-shot per loop instance: construct a fresh loop (the
         # repo-wide pattern) rather than re-running a stopped one.
         self._stop_requested = threading.Event()
+        # Incident-timeline milestones (observability/trace_merge.py):
+        # train_restore spans the checkpoint reload, train_resume marks
+        # steady state — the reshard and resume anchors of the merged
+        # phase breakdown. Inherits DLROVER_TRACE_ID from the agent.
+        self._evt = EventEmitter("trainer")
 
     def request_stop(self) -> None:
         """Ask a running :meth:`run` to stop at the next step boundary
@@ -147,7 +154,9 @@ class ElasticTrainLoop:
     def restore(self, state: Any) -> Tuple[int, Any]:
         """(start_step, state) — consistent across hosts."""
         t0 = time.monotonic()
-        loaded, restored = self.engine.load_consistent(state)
+        with self._evt.duration("train_restore") as span:
+            loaded, restored = self.engine.load_consistent(state)
+            span.end({"loaded_step": loaded})
         self.last_restore_s = time.monotonic() - t0
         if loaded >= 0 and restored is not None:
             logger.info(
@@ -272,6 +281,13 @@ class ElasticTrainLoop:
             self._start_compile_ahead()
         else:
             self.last_compile_s = max(0.0, self.last_first_step_s - dt)
+            # Steady state reached: the incident (if any) is over.
+            self._evt.instant(
+                "train_resume",
+                restore_s=round(self.last_restore_s, 3),
+                first_step_s=round(self.last_first_step_s, 3),
+                compile_s=round(self.last_compile_s, 3),
+            )
             self._write_recovery_record()
 
     def _start_compile_ahead(self) -> None:
@@ -442,6 +458,9 @@ class ElasticTrainLoop:
                 # tpulint: ignore[host-sync] log-cadence scalar fetch,
                 # amortized over log_every steps by design
                 logger.info("step %s: loss %.4f", step, float(loss))
+                # registry gauges at log cadence only — the hot path
+                # stays free of lock traffic between log points
+                get_registry().gauge("dlrover_trainer_last_step").set(step)
             step += 1
         if step > start and not self._recovery_written:
             # one-step runs never saw a steady step: record without the

@@ -218,6 +218,10 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
         "slice_goodput": 0.88, "slice_relaunches": 3,
         "rdzv_s": 2.1, "restore_s": 0.4, "compile_s": 6.2,
         "first_step_s": 7.0, "recovery_samples": 4,
+        # incident-trace phase breakdown (docs/observability.md)
+        "mttd_s": 0.8, "detect_s": 0.8, "rendezvous_s": 2.0,
+        "reshard_s": 0.5, "recompile_s": 6.1, "trace_mttr_s": 9.4,
+        "trace_incidents": 4,
         "stalls": [
             {"at_step": 100 + 30 * i, "gap_s": 12.5, "kill": True,
              "kind": "slice" if i % 2 else "host"}
@@ -234,6 +238,11 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["storm_restore_s"] = 0.4
     extra["storm_compile_s"] = 6.2
     extra["storm_first_step_s"] = 7.0
+    # trace-derived detection SLOs (docs/observability.md): MTTD + the
+    # detect phase share ride the line; the remaining trace phase
+    # scalars stay inside the sidecar's goodput_storm dict
+    extra["storm_mttd_s"] = 0.8
+    extra["storm_detect_s"] = 0.8
     extra["recovery_ab"] = {
         "cold": dict(extra["goodput_storm"], compile_s=12.1),
         "warm": dict(extra["goodput_storm"], compile_s=0.3),
@@ -360,6 +369,10 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     ):
         assert slim[key] == extra[key], key
     assert "recovery_ab" not in slim
+    # the trace-derived detection SLOs ride the line (the remaining
+    # trace phase scalars are sidecar-recoverable from goodput_storm)
+    assert slim["storm_mttd_s"] == extra["storm_mttd_s"]
+    assert slim["storm_detect_s"] == extra["storm_detect_s"]
     # the master-kill SLO pair rides the line; the full drill dict is
     # sidecar-only
     assert slim["master_mttr_s"] == extra["master_mttr_s"]

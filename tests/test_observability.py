@@ -1,0 +1,648 @@
+"""Distributed incident tracing, the unified metrics plane, and the
+flight recorder (docs/observability.md).
+
+Everything here is a fast synthetic — no JAX, no master/agent
+processes except the one real subprocess in the acceptance drill,
+which proves the spawn contract (``trace.child_env()``) carries a
+trace id across a process boundary through the real event SDK.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.agent.metric_collector import parse_prometheus
+from dlrover_tpu.common import comm, events
+from dlrover_tpu.observability import (
+    flight_recorder,
+    metrics,
+    trace,
+    trace_merge,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability_state(monkeypatch):
+    """Every test starts with no trace, a fresh registry/recorder, and
+    no inherited env contract."""
+    for var in (
+        trace.TRACE_ID_ENV,
+        trace.PARENT_SPAN_ENV,
+        flight_recorder.TRACE_DIR_ENV,
+        flight_recorder.RING_CAP_ENV,
+        "DLROVER_EVENT_DIR",
+        "DLROVER_METRICS_PORT",
+        "DLROVER_METRICS_AGENT_PORT",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    trace.reset()
+    metrics.reset_registry()
+    flight_recorder.reset_recorder()
+    yield
+    trace.reset()
+    metrics.reset_registry()
+    flight_recorder.reset_recorder()
+    events.flush_default_exporter()
+
+
+# ---------------------------------------------------------------------------
+# Trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_no_trace_by_default(self):
+        assert trace.current() is None
+        assert trace.current_ids() == ("", "")
+        assert trace.child_env() == {}
+
+    def test_start_incident_sets_process_context(self):
+        ctx = trace.start_incident()
+        assert len(ctx.trace_id) == 16 and len(ctx.span_id) == 16
+        assert trace.current_ids() == (ctx.trace_id, ctx.span_id)
+        # every thread of the process shares the incident
+        seen = {}
+        t = threading.Thread(target=lambda: seen.update(ids=trace.current_ids()))
+        t.start()
+        t.join()
+        assert seen["ids"] == (ctx.trace_id, ctx.span_id)
+
+    def test_child_env_round_trips_through_env_adoption(self, monkeypatch):
+        ctx = trace.start_incident()
+        env = trace.child_env()
+        assert env[trace.TRACE_ID_ENV] == ctx.trace_id
+        assert env[trace.PARENT_SPAN_ENV] == ctx.span_id
+        # simulate the spawned process: fresh module state + contract env
+        trace.reset()
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        adopted = trace.current()
+        assert adopted is not None
+        assert adopted.trace_id == ctx.trace_id
+        assert adopted.parent_id == ctx.span_id
+        assert adopted.span_id != ctx.span_id  # own span in the child
+
+    def test_adopt_release_overlay_scopes_servicer_requests(self):
+        trace.start_incident()
+        base = trace.current_ids()
+        req = comm.BaseRequest(node_id=1, data="{}")
+        req.trace_id, req.span_id = "a" * 16, "b" * 16
+        token = trace.adopt_request(req)
+        assert trace.current_ids()[0] == "a" * 16
+        trace.release(token)
+        assert trace.current_ids() == base
+        # untraced requests are a no-op
+        assert trace.adopt_request(comm.BaseRequest()) is None
+        trace.release(None)
+
+    def test_push_child_nests_under_current(self):
+        ctx = trace.start_incident()
+        token = trace.push_child()
+        child = trace.current()
+        assert child.trace_id == ctx.trace_id
+        assert child.parent_id == ctx.span_id
+        assert child.span_id != ctx.span_id
+        trace.release(token)
+        assert trace.current_ids() == (ctx.trace_id, ctx.span_id)
+        # no active trace → no token, no crash
+        trace.reset()
+        assert trace.push_child() is None
+
+    def test_master_clock_offset_ewma(self):
+        assert trace.master_clock_offset() is None
+        trace.note_master_offset(1.0)
+        assert trace.master_clock_offset() == 1.0
+        trace.note_master_offset(2.0)
+        # EWMA, alpha 0.2: 1.0 + 0.2 * (2.0 - 1.0)
+        assert abs(trace.master_clock_offset() - 1.2) < 1e-9
+
+    def test_request_and_response_carry_trace_fields(self):
+        # the epoch-fenced RPC envelope grew the correlation fields
+        req = comm.BaseRequest()
+        assert req.trace_id == "" and req.span_id == ""
+        resp = comm.BaseResponse(master_epoch=3, trace_id="t" * 16, server_ts=5.0)
+        assert resp.trace_id == "t" * 16 and resp.server_ts == 5.0
+
+
+# ---------------------------------------------------------------------------
+# Event SDK integration
+# ---------------------------------------------------------------------------
+
+
+class TestEventTraceStamping:
+    def test_untraced_event_keeps_pre_trace_shape(self):
+        e = events.Event("t", "n", events.EventType.INSTANT, {})
+        d = e.to_dict()
+        assert "trace_id" not in d and "span_id" not in d
+        assert "trace_id" not in e.to_json()
+
+    def test_traced_event_is_stamped(self):
+        ctx = trace.start_incident()
+        e = events.Event("t", "n", events.EventType.INSTANT, {})
+        d = e.to_dict()
+        assert d["trace_id"] == ctx.trace_id
+        assert d["span_id"] == ctx.span_id
+
+    def test_duration_span_pushes_child_span(self):
+        ctx = trace.start_incident()
+        sink = []
+
+        class _ListExporter(events.Exporter):
+            def export(self, event):
+                sink.append(event)
+
+        em = events.EventEmitter("agent", exporter=_ListExporter())
+        with em.duration("rendezvous", round=1):
+            pass
+        begin, end = sink
+        assert begin.trace_id == end.trace_id == ctx.trace_id
+        # begin/end share the child span, nested under the incident span
+        assert begin.span_id == end.span_id
+        assert begin.span_id != ctx.span_id
+        # the overlay was released
+        assert trace.current_ids() == (ctx.trace_id, ctx.span_id)
+
+    def test_emitted_events_land_in_flight_ring(self):
+        class _Null(events.Exporter):
+            def export(self, event):
+                pass
+
+        em = events.EventEmitter("agent", exporter=_Null())
+        em.instant("incident_detected", kind="test")
+        ring = flight_recorder.get_recorder().snapshot()
+        assert any(e["name"] == "incident_detected" for e in ring)
+
+
+class TestAsyncExporterDropAccounting:
+    def test_full_queue_drop_is_counted_and_summarized(self):
+        """Satellite (a): drops are observable three ways — the
+        ``dropped`` property, the registry counter, and a close-time
+        ``events_dropped`` summary event written through the sink."""
+        gate = threading.Event()
+        inner_events = []
+
+        class _GatedExporter(events.Exporter):
+            def export(self, event):
+                gate.wait(timeout=10)
+                inner_events.append(event)
+
+        async_exp = events.AsyncExporter(_GatedExporter(), max_queue=1)
+        e1 = events.Event("t", "first", events.EventType.INSTANT, {})
+        async_exp.export(e1)
+        # wait until the worker thread is inside export (queue empty)
+        for _ in range(100):
+            if async_exp._queue.empty():
+                break
+            time.sleep(0.01)
+        async_exp.export(events.Event("t", "queued", events.EventType.INSTANT, {}))
+        async_exp.export(events.Event("t", "drop1", events.EventType.INSTANT, {}))
+        async_exp.export(events.Event("t", "drop2", events.EventType.INSTANT, {}))
+        assert async_exp.dropped == 2
+        assert (
+            metrics.get_registry()
+            .counter("dlrover_events_dropped_total")
+            .value()
+            == 2
+        )
+        gate.set()
+        async_exp.close()
+        # both real events drained, then the synchronous drop summary
+        names = [e.name for e in inner_events]
+        assert names[:2] == ["first", "queued"]
+        assert names[-1] == "events_dropped"
+        assert inner_events[-1].content == {"dropped": 2}
+
+    def test_no_drops_no_summary(self):
+        sink = []
+
+        class _ListExporter(events.Exporter):
+            def export(self, event):
+                sink.append(event)
+
+        async_exp = events.AsyncExporter(_ListExporter())
+        async_exp.export(events.Event("t", "only", events.EventType.INSTANT, {}))
+        async_exp.close()
+        assert [e.name for e in sink] == ["only"]
+
+
+# ---------------------------------------------------------------------------
+# parse_prometheus flattening (satellite b)
+# ---------------------------------------------------------------------------
+
+
+class TestParsePrometheus:
+    def test_labeled_sample_keeps_full_key_and_bare_alias(self):
+        gauges = parse_prometheus('tpu_timer_lat{kind="execute"} 3.5\n')
+        assert gauges['tpu_timer_lat{kind="execute"}'] == 3.5
+        assert gauges["tpu_timer_lat"] == 3.5
+
+    def test_duplicate_family_bare_key_is_last_in_file_order(self):
+        text = (
+            'lat{kind="a"} 1.0\n'
+            'lat{kind="b"} 2.0\n'
+        )
+        gauges = parse_prometheus(text)
+        assert gauges['lat{kind="a"}'] == 1.0
+        assert gauges['lat{kind="b"}'] == 2.0
+        assert gauges["lat"] == 2.0  # LAST sample wins, documented
+
+    def test_unlabeled_sample_has_one_key(self):
+        gauges = parse_prometheus("tpu_timer_hang 1\n")
+        assert gauges == {"tpu_timer_hang": 1.0}
+
+    def test_comments_blanks_and_malformed_are_skipped(self):
+        text = (
+            "# HELP lat latency\n"
+            "# TYPE lat gauge\n"
+            "\n"
+            "lat 1.5\n"
+            "9bad_name 2.0\n"
+            "no_value_here\n"
+            "not_a_number nan-garbage\n"
+        )
+        assert parse_prometheus(text) == {"lat": 1.5}
+
+    def test_registry_render_is_parseable(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("c_total").inc(2)
+        reg.gauge("g").set(1.5, node="0")
+        gauges = parse_prometheus(reg.render())
+        assert gauges["c_total"] == 2.0
+        assert gauges['g{node="0"}'] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry + /metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_render(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("req_total", help_="requests").inc()
+        reg.counter("req_total").inc(2, code="500")
+        reg.gauge("world_size").set(4)
+        h = reg.histogram("step_s", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = reg.render()
+        assert "# HELP req_total requests" in text
+        assert "# TYPE req_total counter" in text
+        assert "req_total 1.0" in text
+        assert 'req_total{code="500"} 2.0' in text
+        assert "world_size 4.0" in text
+        assert 'step_s_bucket{le="0.1"} 1' in text
+        assert 'step_s_bucket{le="+Inf"} 2' in text
+        assert "step_s_count 2" in text
+
+    def test_family_type_conflict_raises(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(ValueError):
+            reg.gauge("x")
+
+    def test_get_or_create_returns_same_family(self):
+        reg = metrics.MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+
+    def test_gauge_fn_collector_and_ingest(self):
+        reg = metrics.MetricsRegistry()
+        reg.gauge_fn("sps", lambda: 2.5)
+        reg.gauge_fn("boom", lambda: 1 / 0)  # skipped, not fatal
+        reg.collector(lambda: {'node_metric{node="0",name="hang"}': 0.0})
+        reg.ingest({'tpu_timer_lat{kind="execute"}': 3.25})
+        text = reg.render()
+        assert "sps 2.5" in text
+        assert "boom" not in text
+        assert 'node_metric{node="0",name="hang"} 0.0' in text
+        assert 'tpu_timer_lat{kind="execute"} 3.25' in text
+
+    def test_snapshot_is_flat_scalars(self):
+        reg = metrics.MetricsRegistry()
+        reg.counter("c").inc(3)
+        reg.gauge("g").set(7)
+        reg.histogram("h").observe(1.0)
+        reg.gauge_fn("fn", lambda: 9.0)
+        snap = reg.snapshot()
+        assert snap["c"] == 3.0 and snap["g"] == 7.0 and snap["fn"] == 9.0
+        assert snap["h_count"] == 1.0 and snap["h_sum"] == 1.0
+
+    def test_drop_counter_preregistered(self):
+        reg = metrics.MetricsRegistry()
+        assert "dlrover_events_dropped_total 0.0" in reg.render()
+
+
+class TestMetricsServer:
+    def test_serves_prometheus_text(self):
+        reg = metrics.MetricsRegistry()
+        reg.gauge("dlrover_job_steps_per_second").set(1.25)
+        server = metrics.MetricsServer(registry=reg, port=0).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                assert "text/plain" in resp.headers["Content-Type"]
+                text = resp.read().decode()
+            gauges = parse_prometheus(text)
+            assert gauges["dlrover_job_steps_per_second"] == 1.25
+            assert gauges["dlrover_events_dropped_total"] == 0.0
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/other", timeout=5
+                )
+        finally:
+            server.stop()
+
+    def test_maybe_start_respects_knob(self, monkeypatch):
+        assert metrics.maybe_start_metrics_server("DLROVER_METRICS_PORT") is None
+        monkeypatch.setenv("DLROVER_METRICS_PORT", "0")
+        server = metrics.maybe_start_metrics_server("DLROVER_METRICS_PORT")
+        try:
+            assert server is not None and server.port > 0
+        finally:
+            server.stop()
+
+    def test_stop_never_started_is_safe(self):
+        metrics.MetricsServer(registry=metrics.MetricsRegistry(), port=0).stop()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = flight_recorder.FlightRecorder(capacity=3, role="agent")
+        for i in range(10):
+            rec.record({"name": f"e{i}"})
+        names = [e["name"] for e in rec.snapshot()]
+        assert names == ["e7", "e8", "e9"]
+
+    def test_dump_writes_atomic_json(self, tmp_path):
+        trace.start_incident()
+        trace.note_master_offset(0.25)
+        rec = flight_recorder.FlightRecorder(capacity=8, role="trainer")
+        rec.record({"name": "train_step", "id": "x1"})
+        path = rec.dump("chaos kill!", out_dir=str(tmp_path))
+        assert path is not None and os.path.exists(path)
+        assert "chaos_kill_" in os.path.basename(path)  # sanitized reason
+        dump = json.load(open(path))
+        assert dump["pid"] == os.getpid()
+        assert dump["role"] == "trainer"
+        assert dump["clock_offset_s"] == 0.25
+        assert dump["trace_id"] == trace.current_ids()[0]
+        assert dump["events"] == [{"name": "train_step", "id": "x1"}]
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_dump_without_dir_is_noop(self):
+        rec = flight_recorder.FlightRecorder()
+        rec.record({"name": "e"})
+        assert rec.dump("fault") is None
+
+    def test_ring_cap_knob(self, monkeypatch):
+        monkeypatch.setenv(flight_recorder.RING_CAP_ENV, "5")
+        assert flight_recorder.get_recorder("agent").capacity == 5
+
+    def test_dump_on_fault_without_recorder_is_none(self):
+        assert flight_recorder.dump_on_fault() is None
+
+    def test_dump_on_fault_dumps_existing_recorder(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(flight_recorder.TRACE_DIR_ENV, str(tmp_path))
+        flight_recorder.get_recorder("agent").record({"name": "crash"})
+        path = flight_recorder.dump_on_fault("fatal_signal")
+        assert path is not None
+        assert json.load(open(path))["events"] == [{"name": "crash"}]
+
+
+# ---------------------------------------------------------------------------
+# tpurun-trace merge
+# ---------------------------------------------------------------------------
+
+
+def _evt(eid, ts, pid, target, name, etype="instant", trace_id="", **content):
+    e = {
+        "id": eid, "ts": ts, "pid": pid, "target": target,
+        "name": name, "type": etype, "content": content,
+    }
+    if trace_id:
+        e["trace_id"] = trace_id
+        e["span_id"] = "s" + eid
+    return e
+
+
+def _write_jsonl(path, evts):
+    with open(path, "w") as f:
+        for e in evts:
+            f.write(json.dumps(e) + "\n")
+
+
+class TestTraceMerge:
+    def _skewed_dir(self, tmp_path):
+        """Two processes, one clock 5 s fast. Master-clock truth:
+        fault 999 → detect 1000 → rdzv end 1002 → restore end 1003.5
+        → resume 1004."""
+        tid = "deadbeef00000000"
+        master = [
+            _evt("m1", 999.0, 100, "chaos", "chaos_kill", victims=[200]),
+            _evt("m2", 1000.0, 100, "agent", "incident_detected",
+                 trace_id=tid, kind="worker_failure"),
+            _evt("m3", 1001.0, 100, "agent", "rendezvous", etype="begin",
+                 trace_id=tid),
+            _evt("m4", 1002.0, 100, "agent", "rendezvous", etype="end",
+                 trace_id=tid),
+        ]
+        # trainer clock runs 5 s AHEAD of the master's
+        trainer = [
+            _evt("t1", 1008.5, 200, "trainer", "train_restore",
+                 etype="end", trace_id=tid),
+            _evt("t2", 1009.0, 200, "trainer", "train_resume",
+                 trace_id=tid),
+        ]
+        _write_jsonl(tmp_path / "events_100_1.jsonl", master)
+        _write_jsonl(tmp_path / "events_200_1.jsonl", trainer)
+        # the flight dump carries the offset estimate AND repeats a
+        # ring event (dedup by id must keep one copy)
+        with open(tmp_path / "flight_200_fault_1.json", "w") as f:
+            json.dump(
+                {"pid": 200, "role": "trainer", "clock_offset_s": 5.0,
+                 "events": [trainer[0]]},
+                f,
+            )
+        return tid
+
+    def test_clock_skew_alignment_and_phases(self, tmp_path):
+        tid = self._skewed_dir(tmp_path)
+        summary = trace_merge.summarize(str(tmp_path))
+        assert summary["events"] == 6  # deduped: t1 counted once
+        assert summary["processes"] == [100, 200]
+        assert summary["clock_offsets"] == {200: 5.0}
+        (inc,) = summary["incidents"]
+        assert inc["trace_id"] == tid
+        assert inc["pids"] == [100, 200]  # ≥2 processes, one trace
+        # aligned phases: without the −5 s correction reshard_s would
+        # be 6.5 and the breakdown nonsense
+        assert abs(inc["mttd_s"] - 1.0) < 1e-6
+        assert abs(inc["detect_s"] - 1.0) < 1e-6
+        assert abs(inc["rendezvous_s"] - 2.0) < 1e-6
+        assert abs(inc["reshard_s"] - 1.5) < 1e-6
+        assert abs(inc["recompile_s"] - 0.5) < 1e-6
+        assert abs(inc["mttr_s"] - 5.0) < 1e-6
+        # the tiling invariant: phases sum to MTTR exactly
+        phases = (
+            inc["detect_s"] + inc["rendezvous_s"]
+            + inc["reshard_s"] + inc["recompile_s"]
+        )
+        assert abs(phases - inc["mttr_s"]) < 1e-6
+        # headline keys mirror the worst incident
+        assert summary["mttr_s"] == inc["mttr_s"]
+        assert summary["mttd_s"] == inc["mttd_s"]
+
+    def test_missing_milestone_collapses_phase(self, tmp_path):
+        tid = "feedface00000000"
+        _write_jsonl(
+            tmp_path / "events_1_1.jsonl",
+            [
+                _evt("a", 10.0, 1, "agent", "incident_detected", trace_id=tid),
+                # no rendezvous / restore events at all
+                _evt("b", 14.0, 1, "trainer", "train_resume", trace_id=tid),
+            ],
+        )
+        (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
+        assert inc["rendezvous_s"] == 0.0 and inc["reshard_s"] == 0.0
+        assert inc["recompile_s"] == 4.0  # the gap folded forward
+        assert inc["mttd_s"] == 0.0  # no fault event → undetectable
+        assert inc["mttr_s"] == 4.0
+
+    def test_train_step_is_resume_fallback(self, tmp_path):
+        tid = "cafebabe00000000"
+        _write_jsonl(
+            tmp_path / "events_1_1.jsonl",
+            [
+                _evt("a", 10.0, 1, "agent", "incident_detected", trace_id=tid),
+                _evt("b", 12.0, 1, "trainer", "train_step", trace_id=tid, step=7),
+            ],
+        )
+        (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
+        assert inc["mttr_s"] == 2.0
+
+    def test_stale_fault_outside_window_not_attributed(self, tmp_path):
+        tid = "0123456789abcdef"
+        _write_jsonl(
+            tmp_path / "events_1_1.jsonl",
+            [
+                _evt("a", 100.0, 1, "chaos", "chaos_kill"),
+                _evt("b", 100.0 + trace_merge.FAULT_WINDOW_S + 60.0, 1,
+                     "agent", "incident_detected", trace_id=tid),
+            ],
+        )
+        (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
+        assert inc["mttd_s"] == 0.0  # the old kill is someone else's
+
+    def test_cli_writes_chrome_trace(self, tmp_path, capsys):
+        self._skewed_dir(tmp_path)
+        assert trace_merge.main([str(tmp_path)]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["events"] == 6
+        chrome = json.load(open(tmp_path / "trace.json"))
+        phases = {e["ph"] for e in chrome["traceEvents"]}
+        assert phases == {"B", "E", "i"}
+        # µs timeline starts at the (aligned) first event
+        assert chrome["traceEvents"][0]["ts"] == 0
+        named = {e["name"] for e in chrome["traceEvents"]}
+        assert "agent.rendezvous" in named and "chaos.chaos_kill" in named
+
+    def test_cli_empty_dir_fails(self, tmp_path):
+        assert trace_merge.main([str(tmp_path), "--summary-only"]) == 1
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        with open(tmp_path / "events_1_1.jsonl", "w") as f:
+            f.write(json.dumps(_evt("a", 1.0, 1, "t", "train_step")) + "\n")
+            f.write('{"id": "torn", "ts": 2.0, "pi')  # killed mid-write
+        evts, _ = trace_merge.load_dir(str(tmp_path))
+        assert [e["id"] for e in evts] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# Acceptance drill: one incident, two real processes, one trace_id
+# ---------------------------------------------------------------------------
+
+
+_CHILD_SCRIPT = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from dlrover_tpu.common import events
+
+em = events.EventEmitter("trainer")
+with em.duration("train_restore") as span:
+    time.sleep(0.02)
+    span.end({{"loaded_step": 7}})
+em.instant("train_resume", restore_s=0.02)
+events.flush_default_exporter()
+"""
+
+
+class TestSyntheticTwinDrill:
+    def test_cross_process_incident_trace(self, tmp_path, monkeypatch):
+        """The ISSUE acceptance drill, synthetic-twin form: an agent-role
+        parent detects a chaos kill and runs rendezvous; a REAL trainer
+        subprocess (env contract from ``trace.child_env()``) restores and
+        resumes. The merged trace must show one trace_id spanning ≥2
+        pids with MTTD + phase breakdown summing to the measured MTTR
+        (within 10%)."""
+        trace_dir = tmp_path / "trace"
+        trace_dir.mkdir()
+        monkeypatch.setenv("DLROVER_EVENT_DIR", str(trace_dir))
+        events.flush_default_exporter()  # rebuild from the redirected env
+        try:
+            chaos_evt = events.EventEmitter("chaos")
+            agent_evt = events.EventEmitter("agent")
+
+            # fault (untraced: the killer cannot know the detector's
+            # future trace), then detection opens the incident
+            chaos_evt.instant("chaos_kill", kind="host_kill", victims=[1])
+            time.sleep(0.03)
+            ctx = trace.start_incident()
+            agent_evt.instant("incident_detected", kind="worker_failure")
+            with agent_evt.duration("rendezvous", round=1):
+                time.sleep(0.03)
+
+            # the worker env contract carries the trace to the child
+            env = dict(os.environ)
+            env.update(trace.child_env())
+            env["DLROVER_EVENT_DIR"] = str(trace_dir)
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            proc = subprocess.run(
+                [sys.executable, "-c", _CHILD_SCRIPT.format(repo=repo)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=60,
+            )
+            assert proc.returncode == 0, proc.stderr
+        finally:
+            events.flush_default_exporter()
+
+        summary = trace_merge.summarize(str(trace_dir))
+        (inc,) = summary["incidents"]
+        assert inc["trace_id"] == ctx.trace_id
+        assert len(inc["pids"]) >= 2  # parent + real subprocess
+        assert os.getpid() in inc["pids"]
+        # the full chain fired: every phase has real width
+        assert inc["mttd_s"] > 0  # chaos_kill → incident_detected
+        assert inc["rendezvous_s"] > 0
+        assert inc["reshard_s"] > 0  # → child's train_restore end
+        assert inc["mttr_s"] > 0
+        phases = (
+            inc["detect_s"] + inc["rendezvous_s"]
+            + inc["reshard_s"] + inc["recompile_s"]
+        )
+        assert abs(phases - inc["mttr_s"]) <= 0.1 * inc["mttr_s"]
+        # both targets visible in one incident
+        assert "agent" in inc["targets"] and "trainer" in inc["targets"]

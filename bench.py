@@ -246,8 +246,8 @@ _PRIORITY_KEYS = (
     # truncated line that dropped one could promote an incomplete
     # capture as complete
     *sorted(HEADLINE_SECTION_ERRORS - {"fatal_error", "tpu_error"}),
-    "headline_config", "model", "mfu", "flash_step_s", "flash_batch",
-    "seq_len", "flash_vs_dense", "serving_host_frac",
+    "headline_config", "model", "mfu", "flash_step_s",
+    "flash_vs_dense", "serving_host_frac",
     "serving_overlap_vs_sync", "serving_overlap_exact",
     "interposer_overhead_pct",
     "attr_report",
@@ -308,6 +308,15 @@ _PRIORITY_KEYS = (
     # window; the full drill dict (epoch, replay_s, restart audit) is
     # sidecar-recoverable
     "master_mttr_s", "master_kill_goodput",
+    # durable-tier SLO pair (docs/recovery.md durable section): the
+    # train-loop hand-off of a durable-enabled save and the
+    # whole-pool-loss restore cost. Byte offsets for the pair:
+    # flash_batch and seq_len moved sidecar-only above (both ride the
+    # SILICON headline dict the last_silicon pointer names — PR 7/8
+    # demotion precedent), and the supporting ratio
+    # (durable_block_vs_flash_x) stays sidecar-recoverable too: it
+    # re-derives from durable_save_block_s / ckpt_async_stage_block_s.
+    "durable_save_block_s", "durable_restore_s",
     "recovery_mttr_delta_s", "recovery_warm_compile_s",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
@@ -1831,9 +1840,16 @@ def _bench_checkpoint(extra, state, mesh, flash_s):
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
 
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    durable_root = os.path.join(ckpt_dir, "durable")
     engine = None
     try:
-        engine = CheckpointEngine(ckpt_dir, mesh=mesh, standalone=True)
+        engine = CheckpointEngine(
+            ckpt_dir,
+            mesh=mesh,
+            standalone=True,
+            durable_dir=durable_root,
+            durable_lineage="bench",
+        )
         if not engine.save_to_memory(0, state):
             raise RuntimeError("warmup save_to_memory failed")
         runs = []
@@ -1872,6 +1888,40 @@ def _bench_checkpoint(extra, state, mesh, flash_s):
         if step != 7 or restored is None:
             raise RuntimeError(f"restore failed (step={step})")
         del restored
+
+        # Durable tier (r16): the committed flash image drains to the
+        # generation store on the writer's own thread, so the train
+        # loop's hand-off for a durable-enabled save must stay at the
+        # flash async block (acceptance: within 2x). Timed the same
+        # way the async stage block is — non-blocking dispatch, min of
+        # the runs — then the drain's commit is awaited off the timer.
+        from dlrover_tpu.checkpoint.durable import DurableLayout
+
+        dur_runs = []
+        for step in (8, 9):
+            t0 = time.perf_counter()
+            if not engine.save_to_storage(step, state, block=False):
+                raise RuntimeError(f"durable save failed at step {step}")
+            dur_runs.append(time.perf_counter() - t0)
+            if not engine.wait_saving(timeout=600):
+                raise RuntimeError(f"persist failed at step {step}")
+        durable_block_s = min(dur_runs)
+        layout = DurableLayout(durable_root, "bench")
+        deadline = time.monotonic() + 600
+        while layout.latest_committed() != 9:
+            if time.monotonic() > deadline:
+                raise RuntimeError("durable drain did not commit")
+            time.sleep(0.05)
+        # Whole-pool-loss rung in isolation: read_generation (checksum
+        # verify + global assembly) + reshard-on-read placement under
+        # the current mesh. shm/flash stay intact — this prices ONLY
+        # what a restart pays when both are gone.
+        t0 = time.perf_counter()
+        loaded = engine._load_from_durable(state)
+        durable_restore_s = time.perf_counter() - t0
+        if not loaded or loaded[0] != 9:
+            raise RuntimeError("durable restore failed")
+        del loaded
 
         nbytes = sum(
             leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(state)
@@ -1919,6 +1969,13 @@ def _bench_checkpoint(extra, state, mesh, flash_s):
                     restore_s / max(h2d_ref_s, 1e-9), 2
                 ),
                 "goodput_ckpt_every_10_steps": round(goodput_10, 4),
+                "durable_save_block_s": round(durable_block_s, 4),
+                "durable_restore_s": round(durable_restore_s, 4),
+                # the acceptance ratio (<= 2.0): durable hand-off over
+                # the flash async stage block
+                "durable_block_vs_flash_x": round(
+                    durable_block_s / max(async_block_s, 1e-9), 2
+                ),
                 # artifact note: the r5 capture-to-capture blocking-save
                 # drift (0.47 s -> 1.43 s for the same ~1.5 GB state)
                 # tracks the tunneled link's D2H bandwidth between

@@ -71,6 +71,8 @@ INJECTION_POINTS: Dict[str, str] = {
     "ckpt.saver.persist": "agent saver draining shm to storage",
     "ckpt.replica.push": "replica push of the staged shard to the backup peer",
     "ckpt.replica.fetch": "replica fetch of this host's shard from a peer",
+    "ckpt.durable_write": "durable writer draining a committed image to the durable tier",
+    "ckpt.durable_commit": "durable two-phase commit: barrier met, about to write manifest+marker",
     "serving.swap": "serving engine async weight-swap device transfer",
     "serving.admit": "serving engine slot-admission entry",
     "fleet.route": "gateway replica-selection for one fleet request",

@@ -168,6 +168,104 @@ def peer_replica_loss(workdir: Optional[str] = None) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# durable_loss: whole-pool loss — every shm image wiped, no peer
+# replicas, no flash storage. The job restarted at a SMALLER world must
+# restore from the durable tier through the reshard-on-read path,
+# surviving a torn shard write (retried) and a slowed commit window.
+# ---------------------------------------------------------------------------
+
+
+def durable_loss(workdir: Optional[str] = None) -> Dict:
+    import numpy as np
+
+    from ..checkpoint.durable.writer import DurableWriter
+    from ..checkpoint.engine import CheckpointEngine
+    from ..checkpoint.saver import AsyncCheckpointSaver
+    from ..checkpoint.shm_handler import SharedMemoryHandler
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_durable_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    durable_dir = os.path.join(workdir, "durable")
+    lineage = "chaos_job"
+    pytree = {
+        "step": np.int64(5),
+        "params": {"w": np.arange(16, dtype=np.float32)},
+    }
+    faults.activate(
+        faults.FaultPlan.parse(
+            "seed=7;ckpt.durable_write:error:torn-shard@at=1;"
+            "ckpt.durable_commit:delay:0.01@once"
+        )
+    )
+    shms = []
+    writers = []
+    try:
+        # A genuine 2-host generation: each host stages its shard in its
+        # own segment and drains it with its own DurableWriter. Rank 1
+        # drains first (non-committer: returns after its done signal);
+        # rank 0 then meets the barrier and runs the two-phase commit.
+        # The injected error tears the first shard write (the drain
+        # must retry it); the delay stretches the commit window.
+        for rank in (1, 0):
+            shm = SharedMemoryHandler(
+                rank, name=f"chaos_durable_{os.getpid()}_{rank}"
+            )
+            shms.append(shm)
+            shm.save_pytree(5, pytree, num_hosts=2)
+            writer = DurableWriter(durable_dir, lineage, rank, 2, shm)
+            writers.append(writer)
+            committed = writer.drain(5)
+        assert committed, "rank 0 drain did not commit the generation"
+        # Whole-pool loss: every staged image gone. (There was never a
+        # flash storage step or peer replica — the durable tier is all
+        # that survives.)
+        for shm in shms:
+            shm.invalidate()
+        engine = CheckpointEngine(
+            ckpt_dir,
+            host_rank=0,
+            num_hosts=1,  # restarted SMALLER than the saved world of 2
+            standalone=True,
+            durable_dir=durable_dir,
+            durable_lineage=lineage,
+        )
+        try:
+            engine.shm.invalidate()
+            step, restored = engine.load(
+                {
+                    "step": np.int64(0),
+                    "params": {"w": np.zeros(16, np.float32)},
+                }
+            )
+        finally:
+            engine.close()
+        fired_write = _fired(("ckpt.durable_write",))
+        fired_commit = _fired(("ckpt.durable_commit",))
+        return {
+            "scenario": "durable_loss",
+            "fired": fired_write + fired_commit,
+            "recovered": step == 5
+            and restored is not None
+            and bool(np.array_equal(restored["params"]["w"], pytree["params"]["w"]))
+            and int(restored["step"]) == 5
+            and fired_write >= 1
+            and fired_commit >= 1,
+            "saved_world": 2,
+            "restored_world": 1,
+        }
+    finally:
+        for writer in writers:
+            writer.stop()
+        for shm in shms:
+            try:
+                shm.unlink()
+            except Exception as e:  # noqa: BLE001 — teardown
+                logger.debug("durable_loss shm cleanup: %r", e)
+        AsyncCheckpointSaver.shutdown()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
 # saver_wedge: the agent saver's IPC answers but its runner is wedged —
 # the trainer engine must time out and fall back to a standalone saver
 # in a fresh IPC namespace (checkpointing survives a wedged agent).
@@ -632,6 +730,7 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "flaky_rpc": flaky_rpc,
     "rdzv_retry": rdzv_retry,
     "peer_replica_loss": peer_replica_loss,
+    "durable_loss": durable_loss,
     "saver_wedge": saver_wedge,
     "poisoned_swap": poisoned_swap,
     "replica_loss": replica_loss,

@@ -1,0 +1,37 @@
+"""Durable checkpoint tier: async sharded persistence with
+reshard-on-read restore and cross-job warm pools.
+
+The third rung of the restore chain (shm → peer replica → flash storage
+→ **durable**): a background writer drains each flash-committed image to
+durable storage behind a two-phase, checksum-verified commit, and the
+restore path reshards on read via ``parallel/sharding.py``'s
+RESHARD_RULES — so a job restarted at a different world size, or a
+different job entirely (warm pool), can materialize the state under its
+own mesh. See ``docs/recovery.md`` (durable tier section).
+"""
+
+from .commit import FsBarrier, MasterKVBarrier, commit_generation
+from .gc import collect_generations
+from .layout import DurableLayout, GenerationManifest, list_lineages
+from .restore import (
+    DurableShardError,
+    place_with_rules,
+    read_generation,
+    warm_start,
+)
+from .writer import DurableWriter
+
+__all__ = [
+    "DurableLayout",
+    "GenerationManifest",
+    "DurableWriter",
+    "DurableShardError",
+    "FsBarrier",
+    "MasterKVBarrier",
+    "commit_generation",
+    "collect_generations",
+    "list_lineages",
+    "read_generation",
+    "place_with_rules",
+    "warm_start",
+]

@@ -1,0 +1,184 @@
+"""Two-phase commit of a durable generation.
+
+Phase 1 (per host, :meth:`.layout.DurableLayout.write_shard`): stream
+the shard, record its crc32 + size in a done file. Phase 2 (rank 0
+only): wait for a barrier saying every host is checksummed-and-done,
+then write ``manifest.json`` → ``commit_success`` → advance ``LATEST``
+— each write atomic, tracker strictly last, so a crash anywhere in the
+window leaves either the previous generation visible or this one,
+never a torn tail.
+
+The barrier rides the master's **journaled kv store** when a master is
+reachable (``kv_store_add`` — every mutation lands in the master WAL as
+``kv.set``, so a failed-over master replays the barrier count and a
+re-driven commit converges); standalone mode falls back to the done
+files themselves, which the committer re-verifies on the filesystem in
+both modes before writing the marker (the kv count is a fast signal,
+the done files are the truth).
+"""
+
+import time
+from typing import Optional
+
+from ...chaos import faults
+from ...common.log import logger
+from .layout import DurableLayout, GenerationManifest
+
+BARRIER_POLL_S = 0.1
+KV_PREFIX = "ckpt/durable"
+
+
+class FsBarrier:
+    """Done-file barrier for standalone (no-master) jobs: the phase-1
+    done files double as the arrival signal."""
+
+    def __init__(self, layout: DurableLayout, num_hosts: int):
+        self.layout = layout
+        self.num_hosts = num_hosts
+
+    def signal(self, step: int, rank: int) -> None:
+        pass  # write_shard's done file IS the signal
+
+    def wait_all(self, step: int, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if self.layout.all_shards_done(step, self.num_hosts):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(BARRIER_POLL_S)
+
+
+class MasterKVBarrier:
+    """Commit barrier through the master's journaled kv store.
+
+    Each host bumps one counter key per (lineage, step); the committer
+    polls it with the kv ``add(key, 0)`` read idiom. No new master
+    endpoints: ``kv_store_add`` is already journaled (the WAL records
+    the resulting value), so the barrier survives master failover.
+    """
+
+    def __init__(self, client, lineage: str, num_hosts: int):
+        self.client = client
+        self.lineage = lineage
+        self.num_hosts = num_hosts
+
+    def key(self, step: int) -> str:
+        return f"{KV_PREFIX}/{self.lineage}/{step}/done"
+
+    def signal(self, step: int, rank: int) -> None:
+        self.client.kv_store_add(self.key(step), 1)
+
+    def wait_all(self, step: int, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                count = int(self.client.kv_store_add(self.key(step), 0))
+            except Exception as e:  # noqa: BLE001 — master flapping mid-barrier
+                logger.warning(
+                    "durable barrier poll failed for step %s: %s", step, e
+                )
+                count = -1
+            if count >= self.num_hosts:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(BARRIER_POLL_S)
+
+
+def build_manifest(
+    layout: DurableLayout, step: int, num_hosts: int
+) -> GenerationManifest:
+    """Assemble the phase-2 manifest from the phase-1 artifacts: shard
+    checksums from the done files, the save-time sharding from the
+    per-rank metas, and a snapshot of the reshard rule table."""
+    from ...parallel.sharding import RESHARD_RULES, category_of_path
+
+    manifest = GenerationManifest(
+        step=step,
+        lineage=layout.lineage,
+        num_hosts=num_hosts,
+        timestamp=time.time(),
+        reshard_rules={
+            cat: [policy, list(axes)]
+            for cat, (policy, axes) in RESHARD_RULES.items()
+        },
+    )
+    from ..meta import CheckpointMeta
+
+    for rank in range(num_hosts):
+        done = layout.read_done(step, rank)
+        if done is None:
+            raise RuntimeError(
+                f"durable commit for gen_{step}: shard {rank} has no "
+                "done record despite a met barrier"
+            )
+        manifest.shards[str(rank)] = {
+            "checksum": int(done["checksum"]),
+            "nbytes": int(done["nbytes"]),
+        }
+        with open(layout.shard_meta_path(step, rank)) as f:
+            meta = CheckpointMeta.from_json(f.read())
+        if rank == 0:
+            manifest.mesh_axes = list(meta.mesh_axes)
+            manifest.mesh_shape = list(meta.mesh_shape)
+        for rec in meta.records:
+            cat = category_of_path(rec.path)
+            manifest.category_specs.setdefault(cat, {}).setdefault(
+                rec.path, list(rec.spec or [])
+            )
+    return manifest
+
+
+def commit_generation(
+    layout: DurableLayout,
+    step: int,
+    num_hosts: int,
+    barrier=None,
+    timeout_s: float = 120.0,
+) -> bool:
+    """Rank-0 phase 2. Returns True iff the generation committed. On a
+    barrier timeout the generation is left uncommitted (invisible to
+    readers) for a later retry or the GC's stale-partial sweep."""
+    barrier = barrier or FsBarrier(layout, num_hosts)
+    if not barrier.wait_all(step, timeout_s):
+        logger.warning(
+            "durable commit barrier for %s gen_%s timed out after %.0fs",
+            layout.lineage,
+            step,
+            timeout_s,
+        )
+        return False
+    # The kv barrier is a signal; the done files are the truth — verify
+    # them regardless of which barrier fired.
+    if not layout.all_shards_done(step, num_hosts):
+        logger.warning(
+            "durable barrier met for gen_%s but done files missing; "
+            "refusing to commit",
+            step,
+        )
+        return False
+    faults.inject("ckpt.durable_commit", step=step, lineage=layout.lineage)
+    manifest = build_manifest(layout, step, num_hosts)
+    layout.atomic_write(
+        layout.manifest_path(step), manifest.to_json().encode()
+    )
+    layout.atomic_write(layout.commit_path(step), b"ok")
+    layout.advance_tracker(step)
+    logger.info(
+        "durable generation committed: %s gen_%s (%s shards)",
+        layout.lineage,
+        step,
+        num_hosts,
+    )
+    return True
+
+
+def make_barrier(
+    layout: DurableLayout, num_hosts: int, master_client=None
+) -> "Optional[FsBarrier]":
+    """Pick the barrier for this deployment: master kv when a client is
+    available, else the done-file fallback."""
+    if master_client is not None:
+        return MasterKVBarrier(master_client, layout.lineage, num_hosts)
+    return FsBarrier(layout, num_hosts)

@@ -1,0 +1,222 @@
+"""Reshard-on-read restore from the durable tier.
+
+Reads a generation written under one (world size, sharding) and
+materializes it under the *current* mesh — the first dynamic consumer
+of the statically-verified ``RESHARD_RULES``/``ELASTIC_AXES`` rails in
+:mod:`dlrover_tpu.parallel.sharding`:
+
+1. discover the newest committed generation (torn-tracker hardened),
+   take a GC lease on it;
+2. verify every shard's crc32 against the manifest *before* touching
+   its contents — a torn or bit-rotted shard fails the restore loudly;
+3. assemble each leaf's global array from all saved shards (records
+   are deduped by slice: replicated save-shardings write the same
+   slice from several hosts);
+4. place each leaf under the current mesh by the leaf's category rule
+   (replicate / respec / mirror_params via the manifest's saved specs;
+   host_local payloads stay host-side, keyed by the current rank);
+5. release the lease.
+
+Host-side reads and device placement are split (:func:`read_generation`
+vs :func:`place_with_rules`) so the engine can reuse its own batched
+template placement while the warm-pool path — no template, possibly a
+different job — derives shardings purely from manifest + rules.
+"""
+
+import os
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...common.log import logger
+from ..meta import CheckpointMeta, ShardRecord, assemble_global
+from .layout import CHUNK, DurableLayout, GenerationManifest
+from .layout import list_lineages as list_lineages  # re-export for callers
+
+
+class DurableShardError(RuntimeError):
+    """A shard failed checksum or coverage validation."""
+
+
+def verify_shards(
+    layout: DurableLayout, step: int, manifest: GenerationManifest
+) -> None:
+    """crc32 every shard payload against the manifest before reading
+    state out of it."""
+    for rank_s, rec in manifest.shards.items():
+        rank = int(rank_s)
+        path = layout.shard_bin_path(step, rank)
+        crc = 0
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                while True:
+                    block = f.read(CHUNK)
+                    if not block:
+                        break
+                    crc = zlib.crc32(block, crc)
+        except OSError as e:
+            raise DurableShardError(
+                f"durable shard {rank} of gen_{step} unreadable: {e}"
+            ) from e
+        if size != int(rec["nbytes"]) or crc != int(rec["checksum"]):
+            raise DurableShardError(
+                f"durable shard {rank} of gen_{step} failed verification: "
+                f"size {size}/{rec['nbytes']}, crc {crc}/{rec['checksum']}"
+            )
+
+
+def _dedupe_records(
+    metas: Dict[int, CheckpointMeta],
+) -> Dict[str, List[Tuple[int, ShardRecord]]]:
+    """Group records by leaf path across all saved ranks, keeping one
+    record per distinct slice (replicated shardings stage the same
+    slice on several hosts)."""
+    by_path: Dict[str, List[Tuple[int, ShardRecord]]] = {}
+    seen = set()
+    for rank in sorted(metas):
+        for rec in metas[rank].records:
+            key = (rec.path, tuple(tuple(i) for i in rec.index))
+            if key in seen:
+                continue
+            seen.add(key)
+            by_path.setdefault(rec.path, []).append((rank, rec))
+    return by_path
+
+
+def read_generation(
+    root: str,
+    lineage: str,
+    step: Optional[int] = None,
+    host_rank: int = 0,
+    verify: bool = True,
+) -> Tuple[Optional[int], Optional[GenerationManifest], Dict[str, np.ndarray], Dict[str, Any]]:
+    """Host-side half of the restore: (step, manifest, {leaf path:
+    global np array}, this-host extra). ``(None, None, {}, {})`` when
+    the lineage has no committed generation. Holds a GC lease for the
+    duration of the read."""
+    layout = DurableLayout(root, lineage)
+    if step is None:
+        step = layout.latest_committed()
+    if step is None or not layout.committed(step):
+        return None, None, {}, {}
+    token = layout.take_lease(step)
+    handles = []
+    try:
+        manifest = layout.read_manifest(step)
+        if manifest is None:
+            raise DurableShardError(
+                f"gen_{step} committed but manifest unreadable"
+            )
+        if verify:
+            verify_shards(layout, step, manifest)
+        metas: Dict[int, CheckpointMeta] = {}
+        for rank in range(manifest.num_hosts):
+            with open(layout.shard_meta_path(step, rank)) as f:
+                metas[rank] = CheckpointMeta.from_json(f.read())
+        files = {}
+        for rank in range(manifest.num_hosts):
+            f = open(layout.shard_bin_path(step, rank), "rb")
+            handles.append(f)
+            files[rank] = f
+
+        def record_read(rank: int):
+            def read(rec: ShardRecord) -> bytes:
+                f = files[rank]
+                f.seek(rec.offset)
+                return f.read(rec.nbytes)
+
+            return read
+
+        arrays: Dict[str, np.ndarray] = {}
+        for path, recs in _dedupe_records(metas).items():
+            # assemble_global takes one reader; close over per-record rank
+            rank_of = {id(rec): rank for rank, rec in recs}
+
+            def read_any(rec: ShardRecord) -> bytes:
+                return record_read(rank_of[id(rec)])(rec)
+
+            arrays[path] = assemble_global(
+                [rec for _, rec in recs], read_any
+            )
+        # host_local: this host's extra comes from the same-rank saved
+        # shard; a host beyond the saved world starts with nothing
+        # (rng/data cursors are rebuilt by the loop).
+        extra = (
+            dict(metas[host_rank].extra)
+            if host_rank in metas
+            else {}
+        )
+        return step, manifest, arrays, extra
+    finally:
+        for f in handles:
+            try:
+                f.close()
+            except OSError:
+                pass
+        layout.release_lease(step, token)
+
+
+def place_with_rules(
+    manifest: GenerationManifest,
+    arrays: Dict[str, np.ndarray],
+    mesh,
+) -> Dict[str, Any]:
+    """Templateless device placement (the warm-pool path): derive each
+    leaf's target sharding from its category rule + the manifest's
+    saved spec, then place everything in one batched device_put."""
+    import jax
+
+    from ...parallel.sharding import category_of_path, respec_sharding
+
+    saved_specs: Dict[str, Any] = {}
+    for specs in manifest.category_specs.values():
+        saved_specs.update(specs)
+    paths, host_arrs, shardings = [], [], []
+    placed: Dict[str, Any] = {}
+    for path, arr in arrays.items():
+        sharding = respec_sharding(
+            category_of_path(path),
+            saved_specs.get(path, []),
+            mesh,
+            arr.shape,
+        )
+        if sharding is None:  # host_local — stays on the host
+            placed[path] = arr
+            continue
+        paths.append(path)
+        host_arrs.append(arr)
+        shardings.append(sharding)
+    if paths:
+        placed.update(zip(paths, jax.device_put(host_arrs, shardings)))
+    return placed
+
+
+def warm_start(
+    root: str,
+    lineage: str,
+    mesh,
+    step: Optional[int] = None,
+    host_rank: int = 0,
+) -> Tuple[Optional[int], Dict[str, Any], Dict[str, Any]]:
+    """Cross-job warm pool entry: restore another job's newest durable
+    generation under *this* job's mesh, no template required. Returns
+    (step, {leaf path: placed jax array}, extra); (None, {}, {}) when
+    the lineage is empty."""
+    step, manifest, arrays, extra = read_generation(
+        root, lineage, step=step, host_rank=host_rank
+    )
+    if step is None or manifest is None:
+        return None, {}, {}
+    placed = place_with_rules(manifest, arrays, mesh)
+    logger.info(
+        "warm start from lineage %s gen_%s: %s leaves, saved world %s "
+        "→ current mesh %s",
+        lineage,
+        step,
+        len(placed),
+        manifest.num_hosts,
+        dict(getattr(mesh, "shape", {})),
+    )
+    return step, placed, extra

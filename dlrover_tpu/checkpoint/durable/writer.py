@@ -1,0 +1,213 @@
+"""Background durable writer: committed flash image → durable tier.
+
+The flash tier's persist path already keeps the trainer's blocking cost
+at D2H + memcpy; the durable tier must not move that number. So the
+DurableWriter never runs on the trainer's or the persister's critical
+path: the saver *submits* a step after the flash commit succeeds
+(latest-wins, a newer submit supersedes an undrained older one) and a
+dedicated thread drains it — snapshot the shm payload into a private
+buffer under the shard lock (memcpy only, no I/O under the lock: the
+double-buffer), then stream + checksum that buffer to durable storage
+with the lock released and the trainer free to stage the next step.
+
+Rank 0's writer additionally runs phase 2 (:func:`.commit.commit_generation`)
+after the cross-host barrier, then applies the GC keep-policy.
+"""
+
+import threading
+from typing import Optional
+
+from ...chaos import faults
+from ...common.log import logger
+from ..meta import CheckpointMeta
+from ..shm_handler import SharedMemoryHandler
+from .commit import commit_generation, make_barrier
+from .gc import collect_generations
+from .layout import CHUNK, DurableLayout
+
+DRAIN_RETRIES = 3
+DRAIN_RETRY_DELAY_S = 0.2
+
+
+class DurableWriter:
+    """One per host. ``submit`` is the async entry (saver hook);
+    ``drain`` is the synchronous core (tests, breakpoint saves, and the
+    worker thread all share it)."""
+
+    def __init__(
+        self,
+        root: str,
+        lineage: str,
+        host_rank: int,
+        num_hosts: int,
+        shm: SharedMemoryHandler,
+        shard_lock=None,
+        master_client=None,
+        keep: int = 3,
+        commit_timeout_s: float = 120.0,
+    ):
+        self.layout = DurableLayout(root, lineage)
+        self.host_rank = host_rank
+        self.num_hosts = num_hosts
+        self.shm = shm
+        # Coordinates with the trainer's staging writes; standalone
+        # tests may run without the cross-process lock.
+        self.shard_lock = shard_lock or threading.Lock()
+        self.barrier = make_barrier(self.layout, num_hosts, master_client)
+        self.keep = keep
+        self.commit_timeout_s = commit_timeout_s
+        self._cond = threading.Condition()
+        self._pending: Optional[int] = None  # latest-wins slot
+        self._running = True
+        self._thread: Optional[threading.Thread] = None
+        self._busy = False
+        self.drained_steps = 0
+        self.failed_steps = 0
+
+    # -- async path ---------------------------------------------------------
+
+    def submit(self, step: int) -> None:
+        """Queue a flash-committed step for durable drain. Latest wins:
+        an undrained older step is superseded, never queued behind."""
+        with self._cond:
+            if self._pending is None or step > self._pending:
+                self._pending = step
+            if self._thread is None:
+                # Lazy start: jobs without a durable tier never pay for
+                # the thread.
+                self._thread = threading.Thread(
+                    target=self._worker,
+                    name=f"durable-writer-{self.host_rank}",
+                    daemon=True,
+                )
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and self._pending is None:
+                    self._cond.wait(timeout=1.0)
+                if not self._running and self._pending is None:
+                    return
+                step, self._pending = self._pending, None
+            try:
+                self.drain(step)
+            except Exception as e:  # noqa: BLE001 — durable tier is best-effort; flash tier unaffected
+                self.failed_steps += 1
+                logger.error(
+                    "durable drain of step %s failed permanently: %s",
+                    step,
+                    e,
+                )
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Test/bench helper: block until the queued step (if any) has
+        been drained. Returns False on timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                idle = self._pending is None
+            if idle and (self._thread is None or not self._busy):
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- synchronous core ---------------------------------------------------
+
+    def drain(self, step: int) -> bool:
+        """Copy the shm image for ``step`` to the durable tier, signal
+        the barrier, and (rank 0) commit. Retries transient shard-write
+        faults; raises when the image is gone or retries exhaust."""
+        self._busy = True
+        try:
+            return self._drain(step)
+        finally:
+            self._busy = False
+
+    def _drain(self, step: int) -> bool:
+        import time
+
+        last_err: Optional[Exception] = None
+        for attempt in range(DRAIN_RETRIES):
+            try:
+                meta, buf = self._snapshot(step)
+                if meta is None:
+                    logger.warning(
+                        "durable drain: shm no longer holds step %s "
+                        "(superseded); skipping",
+                        step,
+                    )
+                    return False
+                self._write_shard(meta, buf)
+                break
+            except Exception as e:  # noqa: BLE001 — retried; re-raised when exhausted
+                last_err = e
+                logger.warning(
+                    "durable shard write for step %s failed "
+                    "(attempt %s/%s): %s",
+                    step,
+                    attempt + 1,
+                    DRAIN_RETRIES,
+                    e,
+                )
+                time.sleep(DRAIN_RETRY_DELAY_S)
+        else:
+            raise RuntimeError(
+                f"durable shard write for step {step} failed after "
+                f"{DRAIN_RETRIES} attempts"
+            ) from last_err
+        self.barrier.signal(step, self.host_rank)
+        self.drained_steps += 1
+        if self.host_rank != 0:
+            return True
+        committed = commit_generation(
+            self.layout,
+            step,
+            self.num_hosts,
+            barrier=self.barrier,
+            timeout_s=self.commit_timeout_s,
+        )
+        if committed and self.keep > 0:
+            collect_generations(self.layout, keep=self.keep)
+        return committed
+
+    def _snapshot(self, step: int):
+        """Double-buffer: memcpy meta + payload out of shm under the
+        shard lock. Chunked so the lock hold is bounded by memcpy speed,
+        never by durable-tier I/O."""
+        with self.shard_lock:
+            meta = self.shm.read_meta()
+            if meta is None or meta.step != step:
+                return None, None
+            reader = self.shm.payload_reader(copy=False)
+            if reader is None:
+                return None, None
+            buf = bytearray(meta.total_bytes)
+            offset = 0
+            while offset < meta.total_bytes:
+                n = min(CHUNK, meta.total_bytes - offset)
+                buf[offset : offset + n] = reader(offset, n)
+                offset += n
+        return meta, buf
+
+    def _write_shard(self, meta: CheckpointMeta, buf: bytearray) -> None:
+        faults.inject(
+            "ckpt.durable_write", step=meta.step, rank=self.host_rank
+        )
+        view = memoryview(buf)
+
+        def read(offset: int, nbytes: int) -> bytes:
+            return view[offset : offset + nbytes]
+
+        self.layout.write_shard(meta, read)

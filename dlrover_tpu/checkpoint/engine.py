@@ -100,9 +100,28 @@ class CheckpointEngine:
         replica_peers: Optional[Dict[int, str]] = None,
         saver_timeout_s: Optional[float] = None,
         prefetch_restore: Optional[bool] = None,
+        durable_dir: Optional[str] = None,
+        durable_lineage: Optional[str] = None,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.mesh = mesh
+        # Durable tier (checkpoint/durable/): None → Context knobs, so
+        # production jobs configure via DLROVER_DURABLE_* while tests
+        # and warm-pool callers pass explicit values.
+        if durable_dir is None or durable_lineage is None:
+            from ..common.config import get_context
+
+            _ctx = get_context()
+            if durable_dir is None:
+                durable_dir = _ctx.durable_dir
+            if durable_lineage is None:
+                durable_lineage = _ctx.durable_lineage
+        self.durable_dir = durable_dir or ""
+        self.durable_lineage = (
+            durable_lineage
+            or os.environ.get("DLROVER_JOB_NAME", "")
+            or "default"
+        )
         self.host_rank = (
             host_rank
             if host_rank is not None
@@ -200,6 +219,8 @@ class CheckpointEngine:
             "num_hosts": self.num_hosts,
             "replicate": self._replicate,
             "replica_peers": self._replica_peers,
+            "durable_dir": self.durable_dir,
+            "durable_lineage": self.durable_lineage,
         }
 
     def _wait_lock(self, timeout: float = 30.0) -> SharedLock:
@@ -683,6 +704,9 @@ class CheckpointEngine:
             result = self._load_from_storage(template)
             if result is not None:
                 return result
+            result = self._load_from_durable(template)
+            if result is not None:
+                return result
         return -1, None
 
     def _refill_from_peer(self) -> bool:
@@ -775,6 +799,46 @@ class CheckpointEngine:
             return None
         logger.info("restored step %s from storage %s", step, self.checkpoint_dir)
         return step, restored
+
+    def _load_from_durable(self, template: Any, step: Optional[int] = None):
+        """Last rung of the restore chain: the durable tier
+        (``checkpoint/durable/``). The generation may have been written
+        by a DIFFERENT world — world size and axis layout both — so
+        this is a reshard-on-read: saved specs are validated against
+        RESHARD_RULES, the global arrays are assembled from all saved
+        shards, and the template's current-mesh shardings place them."""
+        if not self.durable_dir:
+            return None
+        try:
+            from ..parallel.sharding import validate_saved_spec
+            from .durable.restore import read_generation
+
+            got_step, manifest, arrays, _extra = read_generation(
+                self.durable_dir,
+                self.durable_lineage,
+                step=step,
+                host_rank=self.host_rank,
+            )
+            if got_step is None or manifest is None:
+                return None
+            for cat, specs in manifest.category_specs.items():
+                for _path, saved_spec in specs.items():
+                    validate_saved_spec(cat, saved_spec)
+            restored = _restore_into_template(template, arrays)
+        except Exception as e:  # noqa: BLE001 — last rung: a torn durable tier degrades to a fresh start, never a crash
+            logger.warning("durable restore failed (%s); starting fresh", e)
+            return None
+        logger.info(
+            "restored step %s from durable tier %s/%s "
+            "(saved world %s, mesh %sx%s -> current mesh)",
+            got_step,
+            self.durable_dir,
+            self.durable_lineage,
+            manifest.num_hosts,
+            manifest.mesh_axes,
+            manifest.mesh_shape,
+        )
+        return got_step, restored
 
     # Floor for how many of each host's newest committed steps enter the
     # cross-host agreement; the effective count always exceeds the
@@ -903,8 +967,66 @@ class CheckpointEngine:
                 target,
             )
         if target < 0:
-            return -1, None
+            # Whole-pool loss: no usable shm image, peer replica, or
+            # flash storage step anywhere — the durable tier is what's
+            # left, under the same agree-then-restore discipline.
+            return self._load_consistent_durable(template)
         return target, self._reload(template, target)
+
+    def _durable_latest(self) -> int:
+        """This host's view of the newest committed durable generation
+        (-1 when the tier is off, empty, or unreachable)."""
+        if not self.durable_dir:
+            return -1
+        try:
+            from .durable.layout import DurableLayout
+
+            latest = DurableLayout(
+                self.durable_dir, self.durable_lineage
+            ).latest_committed()
+        except Exception as e:  # noqa: BLE001 — probe only; absence of the tier is not an error
+            logger.warning("durable tier probe failed: %r", e)
+            return -1
+        return -1 if latest is None else latest
+
+    def _load_consistent_durable(
+        self, template: Any
+    ) -> Tuple[int, Optional[Any]]:
+        """Cross-host agreement for the durable rung, mirroring the
+        flash rungs: gather each host's newest committed generation
+        first (host-only metadata), then every host runs the SAME
+        collective restore. The target is the min over hosts — the
+        newest generation visible on EVERY host, robust to a shared
+        filesystem propagating the newest commit unevenly."""
+        own = self._durable_latest()
+        if _process_count() <= 1:
+            steps = [own]
+        else:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                np.asarray([own], np.int64)
+            )
+            steps = [int(v) for v in gathered[:, 0]]
+        if any(s < 0 for s in steps):
+            if own >= 0:
+                logger.info(
+                    "durable gen_%s visible locally but not on every "
+                    "host (%s); starting fresh",
+                    own,
+                    steps,
+                )
+            return -1, None
+        target = min(steps)
+        result = self._load_from_durable(template, step=target)
+        if result is None:
+            if _process_count() > 1:
+                raise RuntimeError(
+                    f"agreed durable generation {target} unreadable "
+                    "locally; restart the worker to re-rendezvous"
+                )
+            return -1, None
+        return result
 
     def _reload(self, template: Any, step: int):
         result = self._load_from_storage(template, step=step)

@@ -60,6 +60,8 @@ class AsyncCheckpointSaver:
         num_hosts: int = 1,
         replicate: bool = False,
         replica_peers=None,
+        durable_dir: str = "",
+        durable_lineage: str = "",
     ):
         self.storage = PosixCheckpointStorage(storage_root)
         self.host_rank = host_rank
@@ -74,8 +76,65 @@ class AsyncCheckpointSaver:
         self._replica_peers = replica_peers
         self._replicate_q: Optional[_queue.Queue] = None
         self._replicate_thread: Optional[threading.Thread] = None
+        self._durable_writer = None
+        self._durable_every = 1
+        self._reconfigure_durable(durable_dir, durable_lineage)
         if replicate and num_hosts > 1:
             self._start_replication()
+
+    def _reconfigure_durable(self, durable_dir: str, durable_lineage: str) -> None:
+        """(Re)build the durable writer to match the current config and
+        shard topology. A stale writer — different root/lineage, or one
+        holding the previous world's shm/lock after a re-mesh — is
+        stopped and replaced."""
+        w = self._durable_writer
+        if w is not None and (
+            not durable_dir
+            or w.layout.root != durable_dir
+            or (durable_lineage and w.layout.lineage != durable_lineage)
+            or w.host_rank != self.host_rank
+            or w.num_hosts != self.num_hosts
+            or w.shm is not self.shm
+        ):
+            w.stop()
+            self._durable_writer = None
+        if durable_dir and self._durable_writer is None:
+            self._setup_durable(durable_dir, durable_lineage)
+
+    def _setup_durable(self, durable_dir: str, durable_lineage: str) -> None:
+        """Durable tier hook (checkpoint/durable/): a background writer
+        drains each flash-committed image to durable storage off the
+        persist path. The commit barrier rides the master's journaled
+        kv store when a master is reachable, else the done-file
+        fallback."""
+        from ..common.config import get_context
+        from .durable.writer import DurableWriter
+        from .replica import default_master_client
+
+        ctx = get_context()
+        lineage = (
+            durable_lineage
+            or ctx.durable_lineage
+            or os.environ.get("DLROVER_JOB_NAME", "")
+            or "default"
+        )
+        client = self.master_client or default_master_client()
+        try:
+            self._durable_writer = DurableWriter(
+                durable_dir,
+                lineage,
+                self.host_rank,
+                self.num_hosts,
+                self.shm,
+                shard_lock=self._shard_lock,
+                master_client=client,
+                keep=ctx.durable_keep,
+                commit_timeout_s=ctx.durable_commit_timeout_s,
+            )
+            self._durable_every = max(1, ctx.durable_every)
+        except Exception:  # noqa: BLE001 — durable tier is optional; flash tier unaffected
+            logger.exception("durable writer failed to start")
+            self._durable_writer = None
 
     def _start_replication(self) -> None:
         """Serve this host's replica store and register its address
@@ -184,6 +243,8 @@ class AsyncCheckpointSaver:
                             num_hosts=msg.get("num_hosts", 1),
                             replicate=msg.get("replicate", False),
                             replica_peers=msg.get("replica_peers"),
+                            durable_dir=msg.get("durable_dir", ""),
+                            durable_lineage=msg.get("durable_lineage", ""),
                         )
                         # Lock server must exist before the trainer
                         # acquires it; get_or_create made it. Ack by
@@ -209,6 +270,8 @@ class AsyncCheckpointSaver:
         num_hosts: int = 1,
         replicate: bool = False,
         replica_peers=None,
+        durable_dir: str = "",
+        durable_lineage: str = "",
     ) -> "AsyncCheckpointSaver":
         with cls._cls_lock:
             if cls._instance is None:
@@ -218,6 +281,8 @@ class AsyncCheckpointSaver:
                     num_hosts,
                     replicate=replicate,
                     replica_peers=replica_peers,
+                    durable_dir=durable_dir,
+                    durable_lineage=durable_lineage,
                 )
             else:
                 inst = cls._instance
@@ -257,6 +322,7 @@ class AsyncCheckpointSaver:
                     # stop serving and unregister the stale endpoint
                     inst.replica_manager.stop()
                     inst.replica_manager = None
+                inst._reconfigure_durable(durable_dir, durable_lineage)
             return cls._instance
 
     @classmethod
@@ -418,6 +484,15 @@ class AsyncCheckpointSaver:
                     # bounded retention (reference keeps a rolling set;
                     # unbounded step dirs eventually fill the volume)
                     self.storage.keep_latest(keep)
+                # Durable tier hand-off: submit is a latest-wins slot
+                # write + notify — the drain (copy, checksum, barrier,
+                # commit) all happens on the writer's own thread, so
+                # the persist loop's cost per step does not grow.
+                if (
+                    self._durable_writer is not None
+                    and meta.step % self._durable_every == 0
+                ):
+                    self._durable_writer.submit(meta.step)
         except Exception as e:  # noqa: BLE001 — reported via marker
             logger.exception("persist failed for step %s", step)
             try:
@@ -533,3 +608,5 @@ class AsyncCheckpointSaver:
         self._running = False
         if self.replica_manager is not None:
             self.replica_manager.stop()
+        if self._durable_writer is not None:
+            self._durable_writer.stop()

@@ -150,11 +150,31 @@ class PosixCheckpointStorage:
         )
 
     def latest_step(self) -> Optional[int]:
+        """Newest restorable step. The tracker is a hint, not the
+        truth: a crash inside the commit window (marker written, then
+        died before — or mid — tracker update) or a swept step can
+        leave it pointing at a step with no ``commit_success``. Such a
+        torn tracker is skipped in favor of the newest step that
+        actually committed."""
+        tracked: Optional[int] = None
         try:
             with open(self.tracker_path()) as f:
-                return int(f.read().strip())
+                tracked = int(f.read().strip())
         except (FileNotFoundError, ValueError):
-            return None
+            tracked = None
+        if tracked is not None and self.committed(tracked):
+            return tracked
+        committed = self.list_steps()
+        if committed:
+            if tracked is not None:
+                logger.warning(
+                    "checkpoint tracker points at uncommitted step %s; "
+                    "falling back to committed step %s",
+                    tracked,
+                    committed[-1],
+                )
+            return committed[-1]
+        return None
 
     def list_steps(self) -> List[int]:
         steps = []

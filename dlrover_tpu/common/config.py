@@ -79,6 +79,20 @@ class Context:
     # shard images — their deadline is separate from the control-plane
     # rpc_deadline_s (DLROVER_CKPT_REPLICA_TIMEOUT_S override).
     ckpt_replica_timeout_s: float = 120.0
+    # Durable checkpoint tier (checkpoint/durable/, docs/recovery.md):
+    # empty root disables it. A background writer drains each
+    # flash-committed image to <durable_dir>/<durable_lineage>/gen_<N>
+    # behind a two-phase checksum-verified commit; restore reshards on
+    # read, and other jobs can warm-start from the lineage.
+    durable_dir: str = ""
+    # Lineage (warm-pool key) this job writes under; empty → job name.
+    durable_lineage: str = ""
+    # Committed generations kept per lineage (pins/leases always kept).
+    durable_keep: int = 3
+    # Drain every Nth flash-committed step to the durable tier.
+    durable_every: int = 1
+    # Rank 0's wait for every host's shard-done signal before commit.
+    durable_commit_timeout_s: float = 120.0
 
     # Persistent XLA compilation cache shared by every process of the
     # job (common/compile_cache.py); empty disables it. Recompiles

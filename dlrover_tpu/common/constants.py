@@ -349,6 +349,11 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_SAVE_AT_BREAKPOINT", "bool", doc="checkpoint at breakpoint on failure", context_field="save_at_breakpoint"),
     EnvKnob("DLROVER_CKPT_REPLICA_COUNT", "int", doc="peer-memory replicas per shard", context_field="ckpt_replica_count"),
     EnvKnob("DLROVER_CKPT_KEEP_LATEST", "int", doc="committed steps kept on storage (0 = all)", context_field="ckpt_keep_latest"),
+    EnvKnob("DLROVER_DURABLE_DIR", doc="durable checkpoint tier root (empty = tier off)", context_field="durable_dir"),
+    EnvKnob("DLROVER_DURABLE_LINEAGE", doc="durable lineage (warm-pool key) this job writes under; empty = job name", context_field="durable_lineage"),
+    EnvKnob("DLROVER_DURABLE_KEEP", "int", doc="committed durable generations kept per lineage (pins/leases always kept)", context_field="durable_keep"),
+    EnvKnob("DLROVER_DURABLE_EVERY", "int", doc="drain every Nth flash-committed step to the durable tier", context_field="durable_every"),
+    EnvKnob("DLROVER_DURABLE_COMMIT_TIMEOUT_S", "float", doc="durable commit: rank 0's wait for all shard-done signals", context_field="durable_commit_timeout_s"),
     EnvKnob("DLROVER_PRECHECK_ENABLED", "bool", doc="pre-check gate on/off", context_field="precheck_enabled"),
     EnvKnob("DLROVER_PRECHECK_TIMEOUT_S", "float", doc="pre-check deadline", context_field="precheck_timeout_s"),
     EnvKnob("DLROVER_NETWORK_CHECK_ENABLED", "bool", doc="network check rounds on/off", context_field="network_check_enabled"),

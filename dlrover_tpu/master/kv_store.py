@@ -10,6 +10,12 @@ record and the full store rides the snapshot — the coordinator-address
 keys and barrier counters survive a master restart, so re-attaching
 agents read the same world they were trained against. The ``import_*``
 entry points apply replayed mutations without re-journaling them.
+
+The durable checkpoint tier's commit barrier
+(``checkpoint/durable/commit.MasterKVBarrier``) rides the journaled
+``add`` counters — key ``ckpt/durable/<lineage>/<step>/done`` — so a
+master restart mid-commit replays the shard-done count instead of
+wedging rank 0's phase-2 wait.
 """
 
 import base64

@@ -128,6 +128,108 @@ def apply_rules(rules: Optional[LogicalRules] = None):
     return nn_partitioning.axis_rules(rules or DEFAULT_RULES)
 
 
+# ---------------------------------------------------------------------------
+# reshard rule drivers (the dynamic consumers of RESHARD_RULES)
+# ---------------------------------------------------------------------------
+#
+# The durable tier's reshard-on-read restore (checkpoint/durable/) reads
+# a manifest saved under one mesh and materializes state under the
+# current one; these helpers are the policy dispatch it drives. They
+# live here so the policy table and its interpreters stay in one file —
+# the table itself remains pure literals for the lint pass's AST read.
+
+
+def category_of_path(path: str) -> str:
+    """TrainState category of a "/"-joined pytree leaf path. Unknown
+    roots restore under the opaque ``extra`` (host_local) rule."""
+    head = path.split("/", 1)[0]
+    return head if head in RESHARD_RULES else "extra"
+
+
+def reshard_rule_for(category: str) -> Tuple[str, Tuple[str, ...]]:
+    """(policy, allowed mesh axes) for a category; unknown → extra."""
+    return RESHARD_RULES.get(category, RESHARD_RULES["extra"])
+
+
+def spec_mesh_axes(spec) -> Tuple[str, ...]:
+    """Mesh axis names a PartitionSpec (or its jsonable form) references."""
+    axes: List[str] = []
+    for entry in tuple(spec or ()):
+        parts = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for ax in parts:
+            if isinstance(ax, str):
+                axes.append(ax)
+    return tuple(axes)
+
+
+def validate_saved_spec(category: str, spec) -> None:
+    """Reject a saved spec referencing axes its category's rule does not
+    cover — a manifest written by a build with out-of-table shardings
+    must fail loudly at restore, not silently mis-place state."""
+    policy, allowed = reshard_rule_for(category)
+    stray = [ax for ax in spec_mesh_axes(spec) if ax not in allowed]
+    if stray:
+        raise ValueError(
+            f"saved spec {tuple(spec or ())} for category {category!r} "
+            f"references mesh axes {stray} outside its {policy!r} rule "
+            f"coverage {allowed}"
+        )
+
+
+def respec_spec(saved_spec, mesh: Mesh, global_shape) -> PartitionSpec:
+    """Re-derive a leaf's PartitionSpec on the *target* mesh.
+
+    Per dim, keep each saved mesh axis only if the target mesh has it
+    AND the accumulated partitioning still divides the dim — the same
+    cleaning the train step applies when specs meet a smaller world.
+    Dropped axes mean that dim replicates over them, which is always
+    correct (ELASTIC_AXES re-extents are exactly this case).
+    """
+    shape = tuple(global_shape or ())
+    entries: List[Any] = []
+    for d, entry in enumerate(tuple(saved_spec or ())):
+        parts = entry if isinstance(entry, (tuple, list)) else (entry,)
+        dim = shape[d] if d < len(shape) else 0
+        kept: List[str] = []
+        divisor = 1
+        for ax in parts:
+            if not isinstance(ax, str) or ax not in mesh.axis_names:
+                continue
+            size = int(mesh.shape[ax])
+            if dim > 0 and dim % (divisor * size) == 0:
+                kept.append(ax)
+                divisor *= size
+        if not kept:
+            entries.append(None)
+        elif len(kept) == 1:
+            entries.append(kept[0])
+        else:
+            entries.append(tuple(kept))
+    return PartitionSpec(*entries)
+
+
+def respec_sharding(
+    category: str, saved_spec, mesh: Mesh, global_shape
+) -> Optional[NamedSharding]:
+    """Policy dispatch: target-mesh NamedSharding for one restored leaf,
+    or None for ``host_local`` payloads (never cross a reshard — the
+    caller keeps them on the host, per current rank).
+
+    ``mirror_params`` resolves like ``respec`` here: when the caller has
+    a template state its leaf shardings win anyway (the template already
+    shape-matched slots to params); templateless warm-pool restores fall
+    back to the slot's own saved spec, which the save-side mirroring
+    made identical to its param's.
+    """
+    policy, _ = reshard_rule_for(category)
+    if policy == "host_local":
+        return None
+    validate_saved_spec(category, saved_spec)
+    if policy == "replicate":
+        return NamedSharding(mesh, PartitionSpec())
+    return NamedSharding(mesh, respec_spec(saved_spec, mesh, global_shape))
+
+
 def sharded_generate_jit(
     fn, mesh: Mesh, param_trees, n_data_args: int, rules=None
 ):

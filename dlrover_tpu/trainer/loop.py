@@ -152,7 +152,14 @@ class ElasticTrainLoop:
         return self._stop_requested.is_set()
 
     def restore(self, state: Any) -> Tuple[int, Any]:
-        """(start_step, state) — consistent across hosts."""
+        """(start_step, state) — consistent across hosts.
+
+        ``load_consistent`` walks the full fallback chain: own shm →
+        peer replica → per-job storage → the durable tier
+        (``DLROVER_DURABLE_DIR``, reshard-on-read — survives losing
+        every host of the pool). Each rung agrees cross-host on the
+        source before any collective placement runs.
+        """
         t0 = time.monotonic()
         with self._evt.duration("train_restore") as span:
             loaded, restored = self.engine.load_consistent(state)

@@ -158,7 +158,9 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
         "dense_tokens_per_s flash_vs_dense headline_config ckpt_bytes "
         "flash_ckpt_save_block_s ckpt_save_block_s ckpt_async_stage_block_s "
         "ckpt_save_vs_target restore_s h2d_floor_s restore_overhead_x "
-        "goodput_ckpt_every_10_steps flash_seq4096_ms flash_seq4096_tflops "
+        "goodput_ckpt_every_10_steps durable_save_block_s "
+        "durable_restore_s durable_block_vs_flash_x "
+        "flash_seq4096_ms flash_seq4096_tflops "
         "flash_seq4096_dispatch_floor_ms generate_tokens_per_s decode_batch "
         "decode_prompt_len decode_new_tokens decode_ms_per_step "
         "decode_tokens_per_s prefill_ms decode_int8_ms_per_step "
@@ -378,6 +380,10 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["master_mttr_s"] == extra["master_mttr_s"]
     assert slim["master_kill_goodput"] == extra["master_kill_goodput"]
     assert "master_kill" not in slim
+    # the durable-tier SLO pair rides the line; the supporting ratio
+    # (durable_block_vs_flash_x) is sidecar-recoverable
+    assert slim["durable_save_block_s"] == extra["durable_save_block_s"]
+    assert slim["durable_restore_s"] == extra["durable_restore_s"]
     # the fleet SLO trio rides the line (fleet_2v1_x and the per-rep
     # rate are sidecar-recoverable, like the A/B per-leg scalars)
     for key in (

@@ -56,7 +56,8 @@ HEADLINE_SECTION_ERRORS = frozenset({
     "tpu_error", "fatal_error", "dense_error", "ckpt_error",
     "flash_seq4096_error", "decode_error", "spec_error",
     "serving_error", "serving_per_row_error", "llama_family_error",
-    "longseq_train_error", "attr_error", "fleet_error", "pool_error",
+    "longseq_train_error", "attr_error", "fleet_error",
+    "fleet_paged_error", "pool_error",
 })
 
 # Error key -> the DLROVER_BENCH_SECTIONS name that re-runs ONLY that
@@ -75,6 +76,7 @@ SECTION_OF_ERROR = {
     "serving_per_row_error": "serving",
     "attr_error": "attr",
     "fleet_error": "fleet",
+    "fleet_paged_error": "fleet",
     "pool_error": "pool",
     "llama_family_error": "llama",
     "longseq_train_error": "longseq",
@@ -267,6 +269,14 @@ _PRIORITY_KEYS = (
     # rationale as the recovery_ab per-leg scalars above
     "fleet_requests_per_s", "fleet_kill_availability",
     "fleet_rollout_max_unready",
+    # paged-KV serving trio (docs/serving_fleet.md): Zipf-trace
+    # gateway throughput, client p95, and the prefix-cache hit rate
+    # behind them. Supporting scalars (the dense-baseline leg,
+    # fleet_paged_vs_dense_x, affinity/block occupancy) are
+    # sidecar-recoverable — the verdict ratio re-derives from
+    # fleet_paged_tokens_per_s / fleet_dense_tokens_per_s.
+    "fleet_paged_tokens_per_s", "fleet_paged_p95_s",
+    "prefix_hit_rate",
     # chip-pool arbitration SLO trio (docs/pool.md): preempt latency,
     # availability through the preemption, training goodput over the
     # disruption window (supporting scalars ride the sidecar)
@@ -287,22 +297,17 @@ _PRIORITY_KEYS = (
     # storm dict with stall forensics goes to the sidecar)
     "storm_goodput", "storm_mttr_s", "storm_slice_mttr_s",
     "storm_slice_goodput",
-    # MTTR phase breakdown + the warm-vs-cold A/B verdict
-    # (docs/recovery.md). Verdict = delta + warm compile only: the
-    # line has ~130 spare bytes and the per-leg scalars
-    # (recovery_{cold,warm}_mttr_s, recovery_cold_compile_s) are
-    # recoverable from the sidecar's full recovery_ab dict.
-    # Byte offsets for the master-kill pair below: storm_restore_s and
-    # storm_first_step_s moved sidecar-only (both recoverable from the
-    # full goodput_storm dict the sidecar carries; the phase VERDICT
-    # signal rides on compile_s — the warm-restart claim — and rdzv_s).
-    "storm_rdzv_s", "storm_compile_s",
-    # incident-trace detection SLOs (docs/observability.md): MTTD from
-    # the merged cross-process trace plus the detect phase share. The
-    # other trace phase scalars (trace_mttr_s, rendezvous_s, reshard_s,
-    # recompile_s) are sidecar-recoverable from the full goodput_storm
-    # dict — only the detection headline rides the line.
-    "storm_mttd_s", "storm_detect_s",
+    # Byte offsets for the paged-KV trio above: the MTTR phase
+    # breakdown (storm_rdzv_s / storm_compile_s), the detect phase
+    # share (storm_detect_s), and the warm-vs-cold A/B verdict pair
+    # (recovery_mttr_delta_s / recovery_warm_compile_s) moved
+    # sidecar-only — the first three re-derive from the sidecar's full
+    # goodput_storm dict (the same recoverability class as the
+    # storm_restore_s / storm_first_step_s demotions before them), the
+    # A/B pair from its recovery_ab dict. The recovery headline
+    # (storm_mttr_s + storm_goodput, per fault class) and the
+    # detection headline (storm_mttd_s) still ride the line.
+    "storm_mttd_s",
     # master crash tolerance (docs/recovery.md master failover): the
     # coordination-outage MTTR and the productive fraction of the kill
     # window; the full drill dict (epoch, replay_s, restart audit) is
@@ -317,7 +322,6 @@ _PRIORITY_KEYS = (
     # (durable_block_vs_flash_x) stays sidecar-recoverable too: it
     # re-derives from durable_save_block_s / ckpt_async_stage_block_s.
     "durable_save_block_s", "durable_restore_s",
-    "recovery_mttr_delta_s", "recovery_warm_compile_s",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
 
@@ -1686,6 +1690,167 @@ def _bench_fleet(extra, cfg, params, on_tpu):
         sup2.stop()
 
 
+def _bench_paged(extra, cfg, params, on_tpu):
+    """Paged-KV serving rung (docs/serving_fleet.md): a multi-tenant
+    Zipf-prefix trace through a PAGED 2-replica fleet (block-pool KV,
+    copy-on-write prefix sharing, prefix-affinity routing) against the
+    dense per_row baseline at equal cache HBM (the default paged pool
+    is exactly the dense footprint plus one reserved trash block). The
+    dense leg carries each tenant's system prefix INLINE in every
+    prompt — what serving without a prefix cache pays — while the
+    paged leg registers the prefixes once and lets COW sharing +
+    prefix hits skip the repeated prefill. Emits
+    ``fleet_paged_tokens_per_s`` (generated tokens/s through the
+    gateway), ``fleet_paged_p95_s`` (client-observed request p95), and
+    ``prefix_hit_rate`` (engine prefix hits / requests served)."""
+    import threading
+    import urllib.request  # noqa: F401 — parity with _bench_fleet imports
+
+    import numpy as np
+
+    from dlrover_tpu.fleet import (
+        FleetConfig,
+        Gateway,
+        InProcessReplica,
+        ReplicaSupervisor,
+    )
+    from dlrover_tpu.models.generation import SamplingConfig
+    from dlrover_tpu.models.gpt import GPT
+
+    model = GPT(cfg)
+    if on_tpu:
+        B, Pw, N, n_req, n_tenant, bs = 8, 64, 32, 48, 6, 16
+    else:
+        B, Pw, N, n_req, n_tenant, bs = 2, 32, 8, 12, 3, 8
+    sampling = SamplingConfig(max_new_tokens=N, temperature=0.0)
+    r = np.random.default_rng(13)
+    # tenant system prefixes: half the prompt window, so the dense
+    # leg's inline copies dominate its prefill the way real system
+    # prompts do
+    plen = Pw // 2
+    prefixes = [
+        [int(x) for x in r.integers(1, cfg.vocab_size, plen)]
+        for _ in range(n_tenant)
+    ]
+    # Zipf tenant draw (clipped to the tenant count): a couple of hot
+    # tenants dominate, the tail stays cold — the distribution that
+    # makes prefix warmth worth routing on
+    tenants = np.minimum(r.zipf(1.5, n_req), n_tenant) - 1
+    suffixes = [
+        [int(x) for x in r.integers(1, cfg.vocab_size, r.integers(2, 8))]
+        for _ in range(n_req)
+    ]
+
+    def make_fleet(layout):
+        def engine_factory():
+            from dlrover_tpu.models.serving import (
+                ContinuousBatchingEngine,
+            )
+
+            return ContinuousBatchingEngine(
+                model, params, sampling, batch_size=B, prompt_width=Pw,
+                decode_chunk=4, cache_layout=layout,
+                kv_block_size=bs,
+            )
+
+        fc = FleetConfig(
+            replicas=2, min_replicas=2, max_replicas=2,
+            health_interval_s=0.2, health_fails=100,
+            health_timeout_s=30.0, relaunch_budget=3,
+            start_timeout_s=600.0, queue_limit=256,
+        )
+        sup = ReplicaSupervisor(
+            lambda rid, port: InProcessReplica(
+                rid, port, engine_factory=engine_factory,
+            ),
+            fc,
+        ).start()
+        gw = Gateway(sup, fc)
+        if not sup.wait_ready(2, timeout=600.0):
+            sup.stop()
+            raise RuntimeError("paged fleet never reached 2 READY")
+        return sup, gw
+
+    def pump(gw, bodies):
+        """Threaded trace replay; returns (tokens, latencies, wall_s).
+        ``tokens`` counts GENERATED tokens only (the completion body's
+        token list), the throughput both layouts are judged on."""
+        out = {"tokens": 0, "failed": 0}
+        lats = []
+        mu = threading.Lock()
+
+        def hit(body):
+            t0 = time.perf_counter()
+            try:
+                res = gw.complete(dict(body))
+                dt = time.perf_counter() - t0
+                with mu:
+                    out["tokens"] += len(res["tokens"])
+                    lats.append(dt)
+            except Exception:  # noqa: BLE001 — counted
+                with mu:
+                    out["failed"] += 1
+
+        t0 = time.perf_counter()
+        threads = []
+        for body in bodies:
+            t = threading.Thread(target=hit, args=(body,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        if out["failed"]:
+            raise RuntimeError(f"{out['failed']} trace requests failed")
+        return out["tokens"], lats, time.perf_counter() - t0
+
+    # -- dense baseline: inline prefixes, per_row layout ----------------
+    sup_d, gw_d = make_fleet("per_row")
+    try:
+        dense_trace = [
+            {"prompt": (prefixes[t] + suffixes[i])[-Pw:]}
+            for i, t in enumerate(tenants)
+        ]
+        pump(gw_d, dense_trace)  # warm every compile bucket
+        toks, lats, wall = pump(gw_d, dense_trace)
+        dense_rate = toks / wall
+        dense_p95 = float(np.percentile(lats, 95))
+    finally:
+        sup_d.stop()
+
+    # -- paged leg: registered prefixes + affinity routing --------------
+    sup_p, gw_p = make_fleet("paged")
+    try:
+        pids = [gw_p.register_prefix(p) for p in prefixes]
+        paged_trace = [
+            {"prompt": suffixes[i], "prefix_id": pids[t]}
+            for i, t in enumerate(tenants)
+        ]
+        pump(gw_p, paged_trace)  # warm compiles + prefix states
+        time.sleep(0.5)  # a health poll publishes resident_prefixes
+        toks, lats, wall = pump(gw_p, paged_trace)
+        paged_rate = toks / wall
+        paged_p95 = float(np.percentile(lats, 95))
+        time.sleep(0.5)  # let the poll catch the engines' counters
+        st = gw_p.status()
+        hits = int(st["kv"]["prefix_hits"] or 0)
+        extra["fleet_paged_tokens_per_s"] = round(paged_rate, 1)
+        extra["fleet_paged_p95_s"] = round(paged_p95, 4)
+        extra["fleet_dense_tokens_per_s"] = round(dense_rate, 1)
+        extra["fleet_dense_p95_s"] = round(dense_p95, 4)
+        extra["fleet_paged_vs_dense_x"] = round(
+            paged_rate / max(dense_rate, 1e-9), 3
+        )
+        # hits accumulate over warm+timed pumps; served counts both
+        extra["prefix_hit_rate"] = round(
+            hits / max(st["gateway"]["served"], 1), 3
+        )
+        extra["fleet_affinity_hits"] = st["gateway"]["affinity_hits"]
+        extra["fleet_blocks_free"] = st["kv"]["blocks_free"]
+        extra["fleet_blocks_total"] = st["kv"]["blocks_total"]
+    finally:
+        sup_p.stop()
+
+
 def _bench_pool(extra):
     """Chip-pool arbitration rung (dlrover_tpu/pool/): the full
     traffic-spike drill — serving SLO breach → flash-checkpointed
@@ -2241,6 +2406,10 @@ def worker():
                 _bench_fleet(extra, cfg, params, on_tpu)
             except Exception as e:  # noqa: BLE001
                 extra["fleet_error"] = repr(e)[:200]
+            try:
+                _bench_paged(extra, cfg, params, on_tpu)
+            except Exception as e:  # noqa: BLE001
+                extra["fleet_paged_error"] = repr(e)[:200]
 
         if want("pool"):
             try:

@@ -75,6 +75,8 @@ INJECTION_POINTS: Dict[str, str] = {
     "ckpt.durable_commit": "durable two-phase commit: barrier met, about to write manifest+marker",
     "serving.swap": "serving engine async weight-swap device transfer",
     "serving.admit": "serving engine slot-admission entry",
+    "kv.alloc": "paged engine planning a request's KV block table",
+    "prefill.handoff": "gateway shipping a prefilled row to a decode replica",
     "fleet.route": "gateway replica-selection for one fleet request",
     "fleet.replica_health": "supervisor health poll of one serving replica",
     "fleet.replica_kill": "supervisor about to hard-kill a serving replica",
@@ -96,6 +98,7 @@ DROP_POINTS = frozenset(
         "rpc.client.report",
         "master.servicer.get",
         "master.servicer.report",
+        "prefill.handoff",
     )
 )
 

@@ -582,6 +582,169 @@ def replica_loss(workdir: Optional[str] = None) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# kv_alloc_pressure: the paged engine's block planner fails (injected)
+# and then the pool itself runs dry under a burst — both must degrade
+# into the bounded queue path (the head request waits for frees) and
+# every request must still complete with the pool fully recovered.
+# ---------------------------------------------------------------------------
+
+
+def kv_alloc_pressure(workdir: Optional[str] = None) -> Dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.generation import SamplingConfig
+    from ..models.gpt import GPT, GPTConfig
+    from ..models.serving import ContinuousBatchingEngine
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, max_seq_len=128, num_layers=2, num_heads=2,
+            head_dim=8, embed_dim=16, use_remat=False,
+        )
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    sampling = SamplingConfig(max_new_tokens=8, temperature=0.0)
+    # 7 blocks = 6 allocatable: the worst request needs 5, so two
+    # admitted rows can NEVER coexist — every burst request after the
+    # first exercises the genuine out-of-blocks queue path on top of
+    # the injected planner failures
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;kv.alloc:error:planner@times=3")
+    )
+    try:
+        eng = ContinuousBatchingEngine(
+            model, params, sampling, batch_size=3, prompt_width=32,
+            decode_chunk=4, cache_layout="paged", kv_block_size=8,
+            kv_pool_blocks=7,
+        )
+        uids = [
+            eng.submit([((7 * i) % 50) + 1, (i % 50) + 1])
+            for i in range(10)
+        ]
+        rng = jax.random.PRNGKey(0)
+        deadline = time.monotonic() + 300.0
+        while eng.pending and time.monotonic() < deadline:
+            rng, sub = jax.random.split(rng)
+            eng.step(sub)
+        wedged = bool(eng.pending)
+        completions = {c.uid: c for c in eng.drain_completions()}
+        done = sum(
+            1 for u in uids
+            if u in completions and completions[u].tokens
+        )
+        stats = eng.stats()
+        fired = _fired(("kv.alloc",))
+        return {
+            "scenario": "kv_alloc_pressure",
+            "fired": fired,
+            "recovered": not wedged
+            and done == len(uids)
+            and stats["alloc_failures"] >= 3
+            and stats["blocks_free"] == stats["blocks_total"]
+            and fired >= 3,
+            "completed": done,
+            "alloc_failures": stats["alloc_failures"],
+            "blocks_free": stats["blocks_free"],
+            "blocks_total": stats["blocks_total"],
+        }
+    finally:
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# prefill_handoff_drop: the gateway's prefill->decode handoff payload
+# is dropped in flight (injected) — the request must fall back to the
+# direct path (decode replica prefills the prompt itself) and later
+# requests must disaggregate normally; no client ever sees an error.
+# ---------------------------------------------------------------------------
+
+
+def prefill_handoff_drop(workdir: Optional[str] = None) -> Dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ..fleet import (
+        FleetConfig,
+        Gateway,
+        InProcessReplica,
+        ReplicaSupervisor,
+    )
+    from ..models.generation import SamplingConfig
+    from ..models.gpt import GPT, GPTConfig
+    from ..models.serving import ContinuousBatchingEngine
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, max_seq_len=128, num_layers=2, num_heads=2,
+            head_dim=8, embed_dim=16, use_remat=False,
+        )
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    sampling = SamplingConfig(max_new_tokens=6, temperature=0.0)
+
+    def engine_factory():
+        return ContinuousBatchingEngine(
+            model, params, sampling, batch_size=2, prompt_width=16,
+            decode_chunk=4, cache_layout="paged", kv_block_size=8,
+        )
+
+    cfg = FleetConfig(
+        replicas=2, min_replicas=2, max_replicas=2,
+        health_interval_s=0.1, health_fails=20, health_timeout_s=15.0,
+        relaunch_budget=2, start_timeout_s=60.0,
+        prefill_replicas=1, disagg_min_prompt=2,
+    )
+
+    def factory(rid, port):
+        return InProcessReplica(
+            rid, port, engine_factory=engine_factory,
+            role="prefill" if rid < cfg.prefill_replicas else "decode",
+        )
+
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;prefill.handoff:drop@at=1")
+    )
+    supervisor = ReplicaSupervisor(factory, cfg).start()
+    gateway = Gateway(supervisor, cfg)
+    try:
+        if not supervisor.wait_ready(2, timeout=60.0):
+            return {
+                "scenario": "prefill_handoff_drop",
+                "fired": 0,
+                "recovered": False,
+                "error": "fleet never reached 2 READY replicas",
+            }
+        outs = []
+        for i in range(4):
+            outs.append(
+                gateway.complete({"prompt": [5, 9, (i % 50) + 1]})
+            )
+        fired = _fired(("prefill.handoff",))
+        st = gateway.status()
+        return {
+            "scenario": "prefill_handoff_drop",
+            "fired": fired,
+            # first request fell back (drop), the rest disaggregated;
+            # every completion decoded on the decode replica
+            "recovered": all(o["tokens"] for o in outs)
+            and all(o["replica"] == 1 for o in outs)
+            and st["gateway"]["handoff_fallbacks"] >= 1
+            and st["gateway"]["handoffs"] >= 3
+            and fired >= 1,
+            "handoffs": st["gateway"]["handoffs"],
+            "handoff_fallbacks": st["gateway"]["handoff_fallbacks"],
+        }
+    finally:
+        supervisor.stop()
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
 # traffic_spike_preempt: the chip-pool arbitration drill under
 # injected arbiter faults — a serving spike must preempt training
 # (flash-checkpointed shrink), grow serving on the freed unit, and
@@ -734,6 +897,8 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "saver_wedge": saver_wedge,
     "poisoned_swap": poisoned_swap,
     "replica_loss": replica_loss,
+    "kv_alloc_pressure": kv_alloc_pressure,
+    "prefill_handoff_drop": prefill_handoff_drop,
     "traffic_spike_preempt": traffic_spike_preempt,
     "host_kill": host_kill,
     "slice_kill": slice_kill,

@@ -381,6 +381,11 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_FLEET_AUTOSCALE_INTERVAL_S", "float", doc="serving fleet: autoscaler evaluation interval (0 disables)"),
     EnvKnob("DLROVER_FLEET_QUEUE_HIGH", "float", doc="serving fleet: mean queued-per-replica threshold to grow"),
     EnvKnob("DLROVER_FLEET_P95_TARGET_S", "float", doc="serving fleet: p95 completion-latency target to grow (0 disables)"),
+    EnvKnob("DLROVER_FLEET_PREFIX_CAPACITY", "int", doc="serving fleet: gateway prefix-registry LRU bound (refcount-aware eviction)"),
+    EnvKnob("DLROVER_FLEET_PREFILL_REPLICAS", "int", doc="serving fleet: replicas dedicated to the prefill role (0 = no disaggregation)"),
+    EnvKnob("DLROVER_DISAGG_MIN_PROMPT", "int", doc="disaggregation: minimum prompt tokens before the gateway hands prefill off"),
+    EnvKnob("DLROVER_KV_BLOCK_SIZE", "int", doc="paged KV cache: tokens per block (tpurun-serve --cache-layout paged)"),
+    EnvKnob("DLROVER_KV_POOL_BLOCKS", "int", doc="paged KV cache: pool size in blocks incl. the trash block (0 = dense-equivalent)"),
     # -- chip-pool arbiter (dlrover_tpu/pool/, docs/pool.md) ---------------
     EnvKnob("DLROVER_POOL_TOTAL_UNITS", "int", doc="chip pool: device-capacity units in the shared inventory"),
     EnvKnob("DLROVER_POOL_TRAIN_FLOOR", "int", doc="chip pool: units training is never revoked below"),

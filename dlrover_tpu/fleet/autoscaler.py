@@ -44,11 +44,32 @@ def fleet_signals(supervisor) -> Dict:
         for s in stats
         if s.get("latency_p95_s") is not None
     ]
+    paged = [s for s in stats if s.get("blocks_total") is not None]
     return {
         "ready": len(ready),
+        # disaggregation split: growth always adds decode capacity
+        # (roles are rid-derived, lowest rids are prefill), so the
+        # policy reads these to see what a scale step actually buys
+        "ready_prefill": sum(
+            1 for h in ready if getattr(h, "role", "decode") == "prefill"
+        ),
+        "ready_decode": sum(
+            1 for h in ready if getattr(h, "role", "decode") == "decode"
+        ),
         "queue_mean": (sum(queued) / len(queued) if queued else 0.0),
         "busy_total": sum(busy),
         "p95_worst_s": max(p95s) if p95s else None,
+        # paged-KV headroom (None on dense fleets): sustained
+        # exhaustion with an idle queue is a capacity signal the
+        # queue-depth pressure metric alone cannot see
+        "blocks_free": (
+            sum(int(s["blocks_free"] or 0) for s in paged)
+            if paged else None
+        ),
+        "blocks_total": (
+            sum(int(s["blocks_total"]) for s in paged)
+            if paged else None
+        ),
     }
 
 

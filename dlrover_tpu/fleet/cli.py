@@ -77,7 +77,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         serve_args.append("--cpu")
 
     def factory(rid: int, port: int) -> SubprocessReplica:
-        return SubprocessReplica(rid, port, serve_args=serve_args)
+        # rid-derived role, mirroring ReplicaSupervisor.role_of: the
+        # lowest slots run prefill in a disaggregated fleet
+        role = (
+            "prefill" if rid < cfg.prefill_replicas else "decode"
+        )
+        return SubprocessReplica(
+            rid, port, serve_args=serve_args, role=role
+        )
 
     # Replicas run in their own sessions (a replica SIGKILL must never
     # signal the fleet), so the DEFAULT SIGTERM action — immediate

@@ -32,6 +32,9 @@ _FLEET_KNOBS = {
     "autoscale_interval_s": "DLROVER_FLEET_AUTOSCALE_INTERVAL_S",
     "queue_high": "DLROVER_FLEET_QUEUE_HIGH",
     "p95_target_s": "DLROVER_FLEET_P95_TARGET_S",
+    "prefix_capacity": "DLROVER_FLEET_PREFIX_CAPACITY",
+    "prefill_replicas": "DLROVER_FLEET_PREFILL_REPLICAS",
+    "disagg_min_prompt": "DLROVER_DISAGG_MIN_PROMPT",
 }
 
 
@@ -64,6 +67,11 @@ class FleetConfig:
     queue_high: float = 4.0  # mean queued/replica to grow
     p95_target_s: float = 0.0  # p95 latency target to grow (0 = off)
 
+    # prefix registry + prefill/decode disaggregation
+    prefix_capacity: int = 256  # gateway prefix-LRU bound
+    prefill_replicas: int = 0  # lowest-rid slots run the prefill role
+    disagg_min_prompt: int = 0  # prompt tokens before handing off
+
     def __post_init__(self):
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {self.replicas}")
@@ -76,6 +84,21 @@ class FleetConfig:
             )
         if self.health_fails < 1:
             raise ValueError("health_fails must be >= 1")
+        if self.prefix_capacity < 1:
+            raise ValueError("prefix_capacity must be >= 1")
+        if self.prefill_replicas < 0:
+            raise ValueError("prefill_replicas must be >= 0")
+        # decode capacity must survive the autoscaler floor: prefill
+        # replicas hold the lowest rids and never shrink away, so the
+        # floor minus them is the guaranteed decode count
+        if self.prefill_replicas and (
+            self.prefill_replicas >= self.min_replicas
+        ):
+            raise ValueError(
+                f"prefill_replicas {self.prefill_replicas} must stay "
+                f"below min_replicas {self.min_replicas} (at least one "
+                f"decode replica must survive scale-down)"
+            )
 
     @classmethod
     def from_env(cls, **overrides) -> "FleetConfig":

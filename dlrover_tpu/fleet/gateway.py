@@ -46,8 +46,9 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import OrderedDict
 from http.server import ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..attribution.phases import PhaseAccumulator
 from ..chaos import faults
@@ -89,12 +90,20 @@ class Gateway:
         self.rejected = 0  # 429s
         self.redispatches = 0
         self.routed: Dict[int, int] = {}  # rid -> total routed
-        # fleet prefixes: fleet_pid -> token list, and the per-replica
-        # registration map (rid, generation, weight_version, fleet_pid)
-        # -> replica-local pid
-        self._prefixes: Dict[int, List[int]] = {}
+        # fleet prefixes: fleet_pid -> token list in LRU order (use
+        # touches; register_prefix evicts past cfg.prefix_capacity),
+        # and the per-replica registration map (rid, generation,
+        # weight_version, fleet_pid) -> replica-local pid
+        self._prefixes: "OrderedDict[int, List[int]]" = OrderedDict()
         self._next_prefix_id = 0
         self._replica_pids: Dict[tuple, int] = {}
+        # fleet_pid -> in-flight requests referencing it; a referenced
+        # prefix is never LRU-evicted mid-request
+        self._prefix_refs: Dict[int, int] = {}
+        self.prefix_evictions = 0
+        self.affinity_hits = 0  # routed to a prefix-warm replica
+        self.handoffs = 0  # prefill->decode disaggregated completions
+        self.handoff_fallbacks = 0  # handoff failed; direct path served
         self.phases = PhaseAccumulator()
         self._rollout_mu = threading.Lock()
         self.last_rollout: Optional[Dict] = None
@@ -103,6 +112,7 @@ class Gateway:
         supervisor.on_ready = self.replay_prefixes
         self._httpd = None
         self._http_thread = None
+        self._register_metrics()
 
     # -- admission + routing --------------------------------------------
 
@@ -121,22 +131,49 @@ class Gateway:
             if rid is not None and rid in self._inflight:
                 self._inflight[rid] -= 1
 
-    def _pick(self, exclude=()) -> ReplicaHandle:
+    def _pick(
+        self, exclude=(), prefix_id: Optional[int] = None,
+        role: Optional[str] = None,
+    ) -> ReplicaHandle:
         """Least-loaded READY replica (the chaos ``fleet.route`` point
         fires here: an injected error models a routing-layer fault and
-        surfaces as 503, not a wedge)."""
+        surfaces as 503, not a wedge).
+
+        ``prefix_id`` turns on prefix-affinity: replicas whose last
+        health poll reported the request's prefix RESIDENT (registered
+        at the replica's current generation/weight version AND present
+        in its engine's ``resident_prefixes``) sort ahead of cold ones,
+        so a shared prefix keeps hitting the replica already holding
+        its KV blocks warm instead of re-prefilling fleet-wide.
+        Affinity is a preference, not a pin — a loaded warm replica
+        still loses to the least-loaded tiebreak among warm ones, and
+        with no warm candidate the pick degrades to plain least-loaded.
+        ``role`` restricts candidates to one disaggregation role."""
         faults.inject("fleet.route", exclude=list(exclude))
         candidates = [
-            h for h in self.sup.ready_replicas() if h.rid not in exclude
+            h for h in self.sup.ready_replicas(role=role)
+            if h.rid not in exclude
         ]
         if not candidates:
             raise NoReadyReplica(
-                f"no READY replica (excluded: {sorted(exclude)})"
+                f"no READY replica (role={role}, "
+                f"excluded: {sorted(exclude)})"
             )
         with self._mu:
+            def warm(h: ReplicaHandle) -> bool:
+                if prefix_id is None:
+                    return False
+                rpid = self._replica_pids.get(
+                    (h.rid, h.generation, h.weight_version, prefix_id)
+                )
+                return rpid is not None and rpid in (
+                    h.stats.get("resident_prefixes") or ()
+                )
+
             def load(h: ReplicaHandle) -> tuple:
                 stats = h.stats
                 return (
+                    0 if warm(h) else 1,
                     (stats.get("busy_slots") or 0)
                     + (stats.get("queue_depth") or 0)
                     + self._inflight.get(h.rid, 0),
@@ -147,6 +184,8 @@ class Gateway:
                 )
 
             best = min(candidates, key=load)
+            if warm(best):
+                self.affinity_hits += 1
             self._inflight[best.rid] = (
                 self._inflight.get(best.rid, 0) + 1
             )
@@ -171,6 +210,17 @@ class Gateway:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())
 
+    def _delete_replica(self, h: ReplicaHandle, path: str, payload: Dict,
+                        timeout: float):
+        req = urllib.request.Request(
+            h.url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="DELETE",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
     # -- prefix fan-out -------------------------------------------------
 
     def register_prefix(self, tokens: List[int]) -> int:
@@ -183,6 +233,8 @@ class Gateway:
             pid = self._next_prefix_id
             self._next_prefix_id += 1
             self._prefixes[pid] = list(tokens)
+            evicted = self._evict_prefixes_locked()
+        self._forget_on_replicas(evicted)
         ok = 0
         last_err: Optional[Exception] = None
         for h in self.sup.ready_replicas():
@@ -219,6 +271,8 @@ class Gateway:
         with self._mu:
             rpid = self._replica_pids.get(key)
             tokens = self._prefixes.get(fleet_pid)
+            if tokens is not None:  # LRU touch: use protects from GC
+                self._prefixes.move_to_end(fleet_pid)
         if rpid is not None:
             return rpid
         if tokens is None:
@@ -249,6 +303,147 @@ class Gateway:
                 )
         return n
 
+    # -- prefix GC ------------------------------------------------------
+
+    def _evict_prefixes_locked(self) -> List[Tuple[int, List[tuple]]]:
+        """LRU-evict fleet prefixes past ``cfg.prefix_capacity`` —
+        caller holds ``self._mu``. A prefix referenced by an in-flight
+        request is skipped this round (its eviction would 409 on every
+        replica still decoding it); in-flight references are bounded
+        by ``queue_limit``, so the registry stays bounded by
+        ``prefix_capacity + queue_limit`` even under pure-prefix load.
+        Returns ``(fleet_pid, replica_registrations)`` pairs for the
+        out-of-lock replica-side forget fan-out."""
+        evicted: List[Tuple[int, List[tuple]]] = []
+        if len(self._prefixes) <= self.cfg.prefix_capacity:
+            return evicted
+        for pid in list(self._prefixes):  # LRU-first iteration order
+            if len(self._prefixes) <= self.cfg.prefix_capacity:
+                break
+            if self._prefix_refs.get(pid):
+                continue
+            del self._prefixes[pid]
+            regs = [k for k in self._replica_pids if k[3] == pid]
+            evicted.append(
+                (pid, [(k, self._replica_pids.pop(k)) for k in regs])
+            )
+            self.prefix_evictions += 1
+        return evicted
+
+    def _forget_on_replicas(
+        self, evicted: List[Tuple[int, List[tuple]]]
+    ) -> None:
+        """Best-effort replica-side unregistration of evicted/removed
+        prefixes — frees the engines' prefix encodings (and, on paged
+        replicas, their shared KV blocks). Failures are fine: a
+        replica that missed the delete just holds a dead replica-local
+        pid until its engine's own idle-prefix eviction or the next
+        weight swap clears it."""
+        ready = {h.rid: h for h in self.sup.ready_replicas()}
+        for _fleet_pid, regs in evicted:
+            for (rid, gen, wv, _pid), rpid in regs:
+                h = ready.get(rid)
+                if h is None or h.generation != gen or (
+                    h.weight_version != wv
+                ):
+                    continue  # that registration's engine state is gone
+                try:
+                    self._delete_replica(
+                        h, "/v1/prefixes", {"prefix_id": rpid},
+                        timeout=self.cfg.request_timeout_s,
+                    )
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    logger.debug(
+                        "fleet prefix forget on replica %s failed: %r",
+                        rid, e,
+                    )
+
+    def unregister_prefix(self, fleet_pid: int) -> None:
+        """Drop a fleet prefix (``DELETE /v1/prefixes``). Raises
+        KeyError for an unknown id and ValueError while in-flight
+        requests still reference it (the client retries after they
+        drain). Replica-side forget is best-effort fan-out."""
+        with self._mu:
+            if fleet_pid not in self._prefixes:
+                raise KeyError(f"unknown fleet prefix_id {fleet_pid}")
+            if self._prefix_refs.get(fleet_pid):
+                raise ValueError(
+                    f"fleet prefix_id {fleet_pid} is referenced by "
+                    f"{self._prefix_refs[fleet_pid]} in-flight request(s)"
+                )
+            del self._prefixes[fleet_pid]
+            regs = [k for k in self._replica_pids if k[3] == fleet_pid]
+            pairs = [(k, self._replica_pids.pop(k)) for k in regs]
+        self._forget_on_replicas([(fleet_pid, pairs)])
+
+    # -- prefill/decode disaggregation ----------------------------------
+
+    def _decode_role(self) -> Optional[str]:
+        """The role filter for completion routing: ``"decode"`` in a
+        disaggregated fleet (prefill replicas are reserved for
+        ``/v1/prefill`` work), None otherwise."""
+        return "decode" if self.cfg.prefill_replicas > 0 else None
+
+    def _maybe_disaggregate(self, body: Dict) -> Dict:
+        """Prefill/decode handoff: in a disaggregated fleet, a long
+        enough plain-prompt completion is prefilled on a PREFILL
+        replica (``/v1/prefill`` fills one row and exports its KV
+        state), then the request body is rewritten to the
+        ``prefilled`` form a decode replica finishes without touching
+        its own prefill program. Prefix-id requests skip handoff —
+        their prefill is already amortized by the decode replica's
+        prefix cache. Any handoff failure (no prefill replica, replica
+        error, or the chaos ``prefill.handoff`` point dropping the
+        payload) falls back to the direct path: the decode replica
+        prefills the prompt itself — slower, never an error."""
+        prompt = body.get("prompt")
+        if (
+            self.cfg.prefill_replicas <= 0
+            or "prefilled" in body
+            or body.get("prefix_id") is not None
+            or not isinstance(prompt, list)
+            or len(prompt) < max(1, self.cfg.disagg_min_prompt)
+        ):
+            return body
+        ph = None
+        try:
+            mode = faults.inject(
+                "prefill.handoff", prompt_len=len(prompt)
+            )
+            if mode == "drop":
+                raise ConnectionError("prefill handoff dropped (chaos)")
+            ph = self._pick(role="prefill")
+            _, out = self._post_replica(
+                ph, "/v1/prefill", {"tokens": prompt},
+                timeout=self.cfg.request_timeout_s,
+            )
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                raise  # the prompt itself is bad: verdict stands
+            with self._mu:
+                self.handoff_fallbacks += 1
+            logger.warning(
+                "fleet prefill handoff failed (HTTP %s); direct path",
+                e.code,
+            )
+            return body
+        except Exception as e:  # noqa: BLE001 — chaos drop, dead replica
+            with self._mu:
+                self.handoff_fallbacks += 1
+            logger.warning(
+                "fleet prefill handoff failed (%r); direct path", e
+            )
+            return body
+        finally:
+            if ph is not None:
+                self._unpin(ph.rid)
+        with self._mu:
+            self.handoffs += 1
+        handed = dict(body)
+        handed.pop("prompt", None)
+        handed["prefilled"] = out["prefilled"]
+        return handed
+
     # -- completions ----------------------------------------------------
 
     def complete(self, body: Dict) -> Dict:
@@ -258,11 +453,16 @@ class Gateway:
         4xx, forwarded)."""
         self._admit()
         rid = None
+        pid_ref = self._ref_prefix(body.get("prefix_id"))
         try:
+            body = self._maybe_disaggregate(body)
             tried: set = set()
             t0 = time.perf_counter()
             while True:
-                h = self._pick(exclude=tried)
+                h = self._pick(
+                    exclude=tried, prefix_id=pid_ref,
+                    role=self._decode_role(),
+                )
                 rid = h.rid
                 t1 = time.perf_counter()
                 self.phases.add("route", t1 - t0)
@@ -323,7 +523,30 @@ class Gateway:
                         "redispatch", time.perf_counter() - t0
                     )
         finally:
+            self._unref_prefix(pid_ref)
             self._release(rid)
+
+    def _ref_prefix(self, pid) -> Optional[int]:
+        """Pin a fleet prefix for a request's lifetime (LRU eviction
+        skips referenced pids). Unknown/malformed ids pass through —
+        the routing path raises UnknownPrefix with its usual 400."""
+        if pid is None or isinstance(pid, bool) or not isinstance(
+            pid, int
+        ):
+            return None
+        with self._mu:
+            self._prefix_refs[pid] = self._prefix_refs.get(pid, 0) + 1
+        return pid
+
+    def _unref_prefix(self, pid: Optional[int]) -> None:
+        if pid is None:
+            return
+        with self._mu:
+            n = self._prefix_refs.get(pid, 0) - 1
+            if n > 0:
+                self._prefix_refs[pid] = n
+            else:
+                self._prefix_refs.pop(pid, None)
 
     def _translate(self, h: ReplicaHandle, body: Dict) -> Dict:
         """Client payload -> replica payload (fleet prefix id -> the
@@ -336,8 +559,34 @@ class Gateway:
 
     # -- status ----------------------------------------------------------
 
+    def _kv_aggregate(self) -> Dict[str, Optional[int]]:
+        """Fleet-wide paged-KV occupancy summed over the READY
+        replicas' last health polls. ``blocks_total`` None means no
+        replica runs the paged layout (dense fleets report the
+        prefix-hit counter alone)."""
+        totals = {"blocks_total": 0, "blocks_free": 0,
+                  "prefix_hits": 0, "alloc_failures": 0}
+        paged = 0
+        for h in self.sup.ready_replicas():
+            stats = h.stats
+            totals["prefix_hits"] += int(stats.get("prefix_hits") or 0)
+            totals["alloc_failures"] += int(
+                stats.get("alloc_failures") or 0
+            )
+            if stats.get("blocks_total") is not None:
+                paged += 1
+                totals["blocks_total"] += int(stats["blocks_total"])
+                totals["blocks_free"] += int(
+                    stats.get("blocks_free") or 0
+                )
+        if paged == 0:
+            totals["blocks_total"] = None
+            totals["blocks_free"] = None
+        return totals
+
     def status(self) -> Dict:
         sup = self.sup.status()
+        kv = self._kv_aggregate()
         with self._mu:
             gw = {
                 "inflight": self._total_inflight,
@@ -347,13 +596,66 @@ class Gateway:
                 "routed": dict(self.routed),
                 "queue_limit": self.cfg.queue_limit,
                 "prefixes": len(self._prefixes),
+                "prefix_capacity": self.cfg.prefix_capacity,
+                "prefix_evictions": self.prefix_evictions,
+                "affinity_hits": self.affinity_hits,
+                "handoffs": self.handoffs,
+                "handoff_fallbacks": self.handoff_fallbacks,
             }
         return {
             **sup,
             "gateway": gw,
+            "kv": kv,
             "phase_split": self.phases.split().summary(),
             "rollout": self.last_rollout,
         }
+
+    def _register_metrics(self) -> None:
+        """Bind gateway+fleet KV series into the unified metrics
+        registry (render-time callbacks, the PR 12 idiom): paged block
+        occupancy, prefix-hit/affinity counters, and per-role READY
+        counts land on the same ``/metrics`` page as everything
+        else."""
+        from ..observability.metrics import get_registry
+
+        registry = get_registry()
+        registry.gauge_fn(
+            "dlrover_fleet_inflight",
+            lambda: float(self._total_inflight),
+        )
+        registry.gauge_fn(
+            "dlrover_fleet_prefixes",
+            lambda: float(len(self._prefixes)),
+        )
+        registry.gauge_fn(
+            "dlrover_fleet_prefix_evictions",
+            lambda: float(self.prefix_evictions),
+        )
+        registry.gauge_fn(
+            "dlrover_fleet_affinity_hits",
+            lambda: float(self.affinity_hits),
+        )
+        registry.gauge_fn(
+            "dlrover_fleet_handoffs", lambda: float(self.handoffs)
+        )
+        registry.gauge_fn(
+            "dlrover_fleet_handoff_fallbacks",
+            lambda: float(self.handoff_fallbacks),
+        )
+
+        def _fleet_gauges() -> Dict[str, float]:
+            flat: Dict[str, float] = {}
+            kv = self._kv_aggregate()
+            for key, val in kv.items():
+                if val is not None:
+                    flat[f"dlrover_fleet_kv_{key}"] = float(val)
+            for role in ("prefill", "decode"):
+                flat[f'dlrover_fleet_ready{{role="{role}"}}'] = float(
+                    len(self.sup.ready_replicas(role=role))
+                )
+            return flat
+
+        registry.collector(_fleet_gauges)
 
     # -- HTTP front end ---------------------------------------------------
 
@@ -425,6 +727,33 @@ def _make_handler(gw: Gateway):
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
 
+        def do_DELETE(self):
+            if self.path != "/v1/prefixes":
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                body = self._body()
+            except ValueError as e:
+                self._send(400, {"error": f"bad json: {e}"})
+                return
+            pid = body.get("prefix_id")
+            if not isinstance(pid, int) or isinstance(pid, bool):
+                self._send(400, {"error": "prefix_id must be an int"})
+                return
+            try:
+                gw.unregister_prefix(pid)
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+                return
+            except ValueError as e:
+                # referenced by in-flight requests: retryable conflict
+                self._send(409, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": repr(e)[:200]})
+                return
+            self._send(200, {"removed": pid})
+
         # -- route handlers ------------------------------------------
 
         def _complete(self, body):
@@ -471,9 +800,12 @@ def _make_handler(gw: Gateway):
                 )
                 return
             rid = None
+            pid_ref = gw._ref_prefix(body.get("prefix_id"))
             try:
                 try:
-                    h = gw._pick()
+                    h = gw._pick(
+                        prefix_id=pid_ref, role=gw._decode_role()
+                    )
                     rid = h.rid
                     payload = gw._translate(h, body)
                 except NoReadyReplica as e:
@@ -534,6 +866,7 @@ def _make_handler(gw: Gateway):
                         # the engine request
                         pass
             finally:
+                gw._unref_prefix(pid_ref)
                 gw._release(rid)
 
         def _prefixes(self, body):

@@ -35,11 +35,13 @@ __all__ = ["SubprocessReplica", "InProcessReplica", "serve_command"]
 
 
 def serve_command(
-    port: int, replica_id: int, serve_args: Optional[List[str]] = None
+    port: int, replica_id: int, serve_args: Optional[List[str]] = None,
+    role: Optional[str] = None,
 ) -> List[str]:
     """The ``tpurun-serve`` argv for one replica. ``serve_args`` carries
     the fleet-wide model/engine flags (``--cpu``, ``--ckpt-dir``,
-    ``--config``, ...); port and replica id are per-replica."""
+    ``--config``, ...); port, replica id, and disaggregation role are
+    per-replica."""
     return [
         sys.executable,
         "-m",
@@ -48,6 +50,7 @@ def serve_command(
         str(port),
         "--replica-id",
         str(replica_id),
+        *(["--role", role] if role else []),
         *(serve_args or []),
     ]
 
@@ -61,10 +64,11 @@ class SubprocessReplica:
         port: int,
         serve_args: Optional[List[str]] = None,
         env: Optional[dict] = None,
+        role: Optional[str] = None,
     ):
         self.replica_id = replica_id
         self.port = port
-        self._argv = serve_command(port, replica_id, serve_args)
+        self._argv = serve_command(port, replica_id, serve_args, role=role)
         self._env = env
         self._proc: Optional[subprocess.Popen] = None
 
@@ -131,6 +135,7 @@ class InProcessReplica:
         port: int = 0,
         engine_factory: Optional[Callable] = None,
         reload_fn: Optional[Callable] = None,
+        role: str = "decode",
     ):
         if engine_factory is None:
             raise ValueError("InProcessReplica needs an engine_factory")
@@ -138,6 +143,7 @@ class InProcessReplica:
         self.port = port  # rebound to the real port after start()
         self._engine_factory = engine_factory
         self._reload_fn = reload_fn
+        self.role = role
         self._daemon = None
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
@@ -157,6 +163,7 @@ class InProcessReplica:
             port=0,
             reload_fn=self._reload_fn,
             replica_id=self.replica_id,
+            role=self.role,
         )
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

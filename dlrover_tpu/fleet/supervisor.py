@@ -64,9 +64,10 @@ def free_port() -> int:
 class ReplicaHandle:
     """Supervisor-side bookkeeping for one replica slot."""
 
-    def __init__(self, rid: int, proc):
+    def __init__(self, rid: int, proc, role: str = "decode"):
         self.rid = rid
         self.proc = proc
+        self.role = role  # prefill/decode disaggregation role
         self.state = ReplicaState.STARTING
         self.state_since = time.monotonic()
         self.generation = 0  # bumps every (re)launch
@@ -90,6 +91,7 @@ class ReplicaHandle:
         return {
             "rid": self.rid,
             "state": self.state,
+            "role": self.role,
             "port": self.proc.port,
             "pid": self.proc.pid,
             "generation": self.generation,
@@ -153,12 +155,21 @@ class ReplicaSupervisor:
             except Exception as e:  # noqa: BLE001 — best-effort teardown
                 logger.warning("fleet replica %s teardown: %r", h.rid, e)
 
+    def role_of(self, rid: int) -> str:
+        """Disaggregation role of a slot: the LOWEST rids run prefill
+        (``cfg.prefill_replicas`` of them). Rid-derived so a relaunch
+        keeps the role and autoscaler growth (fresh, higher rids)
+        always adds decode capacity."""
+        return (
+            "prefill" if rid < self.cfg.prefill_replicas else "decode"
+        )
+
     def _spawn_slot(self) -> ReplicaHandle:
         with self._mu:
             rid = self._next_rid
             self._next_rid += 1
         proc = self._factory(rid, free_port())
-        handle = ReplicaHandle(rid, proc)
+        handle = ReplicaHandle(rid, proc, role=self.role_of(rid))
         try:
             proc.start()
         except Exception as e:  # noqa: BLE001 — a bad spawn is a death
@@ -175,9 +186,13 @@ class ReplicaSupervisor:
         with self._mu:
             return list(self._handles.values())
 
-    def ready_replicas(self) -> List[ReplicaHandle]:
+    def ready_replicas(
+        self, role: Optional[str] = None
+    ) -> List[ReplicaHandle]:
         return [
-            h for h in self.replicas() if h.state == ReplicaState.READY
+            h for h in self.replicas()
+            if h.state == ReplicaState.READY
+            and (role is None or h.role == role)
         ]
 
     def get(self, rid: int) -> Optional[ReplicaHandle]:
@@ -186,10 +201,17 @@ class ReplicaSupervisor:
 
     def status(self) -> Dict:
         reps = self.replicas()
+        ready = [h for h in reps if h.state == ReplicaState.READY]
         return {
             "replicas": [h.snapshot() for h in reps],
-            "ready": sum(
-                1 for h in reps if h.state == ReplicaState.READY
+            "ready": len(ready),
+            # per-role counts: the disaggregation topology's health at
+            # a glance (and the autoscaler/brain admission signal)
+            "ready_prefill": sum(
+                1 for h in ready if h.role == "prefill"
+            ),
+            "ready_decode": sum(
+                1 for h in ready if h.role == "decode"
             ),
             "target": len(reps),
         }

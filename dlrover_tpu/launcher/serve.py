@@ -48,6 +48,7 @@ from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import ThreadingHTTPServer
 from typing import Optional
 
+from ..common.constants import ENV_KNOBS
 from ..common.log import logger
 
 __all__ = ["ServingDaemon", "main"]
@@ -186,6 +187,29 @@ class ServingDaemon:
         lazily, invalidated by weight swaps)."""
         return self._submit_item("prefix", list(tokens), timeout)
 
+    def unregister_prefix(self, prefix_id: int,
+                          timeout: float = 60.0) -> bool:
+        """Drop a registered prefix (fleet prefix GC). Raises KeyError
+        for an unknown id, ValueError while queued requests still
+        reference it."""
+        return self._submit_item("unprefix", int(prefix_id), timeout)
+
+    def export_prefill(self, tokens, timeout: float = 300.0):
+        """Run the prompt's prefill on this engine and return the
+        hand-off payload (prefill-role half of disaggregation)."""
+        return self._submit_item("prefill_export", list(tokens), timeout)
+
+    def complete_prefilled(
+        self, payload, timeout: float = 300.0, max_new_tokens=None,
+        allowed_tokens=None,
+    ):
+        """Decode-role half of disaggregation: admit a row prefilled
+        elsewhere and block for its Completion."""
+        return self._submit_item(
+            "req_prefilled", (payload, max_new_tokens, allowed_tokens),
+            timeout, cancel_on_timeout=True,
+        )
+
     def swap_params(self, params, timeout: float = 300.0) -> float:
         """Hand new params to the driver; returns the measured swap
         latency once the driver adopts them between chunks."""
@@ -254,8 +278,21 @@ class ServingDaemon:
                                 self._stream_done.pop(uid, None)
                     if uid is not None:
                         self.eng.cancel(uid)
+                elif kind == "req_prefilled":
+                    pre_payload, cap, allowed = payload
+                    uid = self.eng.submit_prefilled(
+                        pre_payload, max_new_tokens=cap,
+                        allowed_tokens=allowed,
+                    )
+                    with self._mu:
+                        self._waiters[uid] = fut
                 elif kind == "prefix":
                     fut.set_result(self.eng.register_prefix(payload))
+                elif kind == "unprefix":
+                    self.eng.unregister_prefix(payload)
+                    fut.set_result(True)
+                elif kind == "prefill_export":
+                    fut.set_result(self.eng.export_prefill(payload))
                 elif kind == "params":
                     fut.set_result(self.eng.set_params(payload))
                 elif kind == "params_async":
@@ -392,7 +429,8 @@ def _restore_params(model, mesh, ckpt_dir: str):
 # ---------------------------------------------------------------------------
 
 
-def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
+def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None,
+                  role="decode"):
     from ..common.http import JsonRequestHandler
 
     class Handler(JsonRequestHandler):
@@ -409,6 +447,10 @@ def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
                         # fleet) — the supervisor asserts identity on
                         # relaunch and operators read it in curl output
                         "replica_id": replica_id,
+                        # prefill/decode disaggregation role (purely
+                        # observability: the gateway derives routing
+                        # roles from its own config)
+                        "role": role,
                         "served": daemon.served,
                         "pending": daemon.eng.pending,
                         "slots": daemon.eng.B,
@@ -493,6 +535,77 @@ def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
                 except OSError:
                     pass
 
+        def _complete_prefilled(self, body):
+            """Decode-role admission of a row prefilled on another
+            replica ({"prefilled": <hand-off payload>}). Shape
+            mismatches (a payload from a different model config) are
+            the CLIENT's 400, never a cache corruption."""
+            payload = body.get("prefilled")
+            if not isinstance(payload, dict):
+                self._send(
+                    400, {"error": "prefilled must be a hand-off payload"}
+                )
+                return
+            max_tokens = body.get("max_tokens")
+            if max_tokens is not None and (
+                isinstance(max_tokens, bool)
+                or not isinstance(max_tokens, int)
+            ):
+                self._send(400, {"error": "max_tokens must be int"})
+                return
+            try:
+                c = daemon.complete_prefilled(
+                    payload,
+                    timeout=float(body.get("timeout", 300.0)),
+                    max_new_tokens=max_tokens,
+                    allowed_tokens=body.get("allowed_tokens"),
+                )
+            except (ValueError, KeyError) as e:  # bad payload: client
+                self._send(400, {"error": repr(e)[:200]})
+                return
+            except Exception as e:  # noqa: BLE001 — server-side
+                self._send(500, {"error": repr(e)[:200]})
+                return
+            self._send(
+                200,
+                {
+                    "uid": c.uid,
+                    "tokens": c.tokens,
+                    "logprobs": c.logprobs,
+                    "queue_s": round(c.queue_s, 4),
+                    "ttft_s": round(c.ttft_s, 4),
+                    "total_s": round(c.total_s, 4),
+                },
+            )
+
+        def do_DELETE(self):
+            try:
+                body = self._body()
+            except ValueError as e:
+                self._send(400, {"error": f"bad json: {e}"})
+                return
+            if self.path == "/v1/prefixes":
+                pid = body.get("prefix_id")
+                if isinstance(pid, bool) or not isinstance(pid, int):
+                    self._send(400, {"error": "prefix_id must be int"})
+                    return
+                try:
+                    daemon.unregister_prefix(pid)
+                except KeyError:
+                    self._send(
+                        404, {"error": f"unknown prefix_id {pid}"}
+                    )
+                    return
+                except ValueError as e:  # still referenced by queue
+                    self._send(409, {"error": repr(e)[:200]})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)[:200]})
+                    return
+                self._send(200, {"removed": pid})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
         def do_POST(self):
             try:
                 body = self._body()
@@ -500,6 +613,9 @@ def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
                 self._send(400, {"error": f"bad json: {e}"})
                 return
             if self.path == "/v1/completions":
+                if "prefilled" in body:
+                    self._complete_prefilled(body)
+                    return
                 prompt = body.get("prompt")
                 if not isinstance(prompt, list) or not all(
                     isinstance(t, int) for t in prompt
@@ -569,6 +685,27 @@ def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
                         "total_s": round(c.total_s, 4),
                     },
                 )
+            elif self.path == "/v1/prefill":
+                # prefill-role half of disaggregation: run the
+                # prompt's prefill here, return the hand-off payload
+                # the decode replica admits via {"prefilled": ...}
+                tokens = body.get("tokens")
+                if not isinstance(tokens, list) or not all(
+                    isinstance(t, int) for t in tokens
+                ):
+                    self._send(
+                        400, {"error": "tokens must be a list of token ids"}
+                    )
+                    return
+                try:
+                    payload = daemon.export_prefill(tokens)
+                except ValueError as e:
+                    self._send(400, {"error": repr(e)[:200]})
+                    return
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": repr(e)[:200]})
+                    return
+                self._send(200, {"prefilled": payload})
             elif self.path == "/v1/prefixes":
                 tokens = body.get("tokens")
                 if not isinstance(tokens, list) or not all(
@@ -619,11 +756,11 @@ def _make_handler(daemon: ServingDaemon, reload_fn, replica_id=None):
 
 
 def serve(daemon: ServingDaemon, port: int, reload_fn=None,
-          replica_id=None):
+          replica_id=None, role="decode"):
     """Bind and return the HTTP server (caller runs serve_forever)."""
     httpd = ThreadingHTTPServer(
         ("0.0.0.0", port),
-        _make_handler(daemon, reload_fn, replica_id=replica_id),
+        _make_handler(daemon, reload_fn, replica_id=replica_id, role=role),
     )
     return httpd
 
@@ -689,11 +826,30 @@ def main(argv=None) -> int:
         "docs/generation.md)",
     )
     ap.add_argument(
-        "--cache-layout", choices=["frontier", "per_row"],
+        "--cache-layout", choices=["frontier", "per_row", "paged"],
         default="per_row",
         help="per_row: each request advances its own cache frontier — "
         "no compaction re-prefills (default). frontier: shared write "
-        "slot + compaction (the pre-r5 layout).",
+        "slot + compaction (the pre-r5 layout). paged: block-pool KV "
+        "with copy-on-write prefix sharing (docs/generation.md).",
+    )
+    ap.add_argument(
+        "--kv-block-size", type=int,
+        default=ENV_KNOBS["DLROVER_KV_BLOCK_SIZE"].get() or 16,
+        help="paged layout: tokens per KV block (must divide the "
+        "total sequence length)",
+    )
+    ap.add_argument(
+        "--kv-pool-blocks", type=int,
+        default=ENV_KNOBS["DLROVER_KV_POOL_BLOCKS"].get() or 0,
+        help="paged layout: total pool blocks incl. the reserved "
+        "trash block; 0 sizes the pool to the dense footprint",
+    )
+    ap.add_argument(
+        "--role", choices=["prefill", "decode"], default="decode",
+        help="disaggregation role tag reported on /healthz (prefill "
+        "replicas answer /v1/prefill; decode replicas finish "
+        "prefilled requests)",
     )
     ap.add_argument(
         "--cpu", action="store_true",
@@ -770,9 +926,12 @@ def main(argv=None) -> int:
             cache_layout=ns.cache_layout,
             overlap=not ns.sync_round,
             auto_chunk=ns.auto_chunk,
+            kv_block_size=ns.kv_block_size,
+            kv_pool_blocks=ns.kv_pool_blocks,
         )
     daemon = ServingDaemon(engine).start()
-    httpd = serve(daemon, ns.port, reload_fn, replica_id=ns.replica_id)
+    httpd = serve(daemon, ns.port, reload_fn, replica_id=ns.replica_id,
+                  role=ns.role)
     logger.info(
         "tpurun-serve on :%s — %s slots × %s new tokens, prompt width %s",
         httpd.server_address[1], ns.batch_size, ns.max_new_tokens,

@@ -35,6 +35,15 @@ TPU shape — every device program is static-shape and compiled once:
     ever: the paged-KV property, recovered in a static ``[B, L]``
     cache by per-request slot reuse. Liveness is per-request:
     ``prompt_width + max_new_tokens <= max_seq_len``.
+  - ``"paged"``: the full vLLM-style serving memory (models/
+    kv_blocks.py). The cache is a pool of fixed-size token blocks;
+    each slot carries a block TABLE, the decode chunk gathers the
+    dense view by table, runs the SAME per-row step body (bit-exact
+    by construction), and scatters back. Admission is bounded by free
+    BLOCKS (a short request reserves its bucket + cap, not a whole
+    [L] row), a registered prefix's fully-covered blocks are
+    refcounted and shared copy-on-write across every row using it,
+    and an out-of-blocks burst queues (bounded) instead of OOMing.
 
 - **Weight hot-swap between chunks**: ``set_params`` replaces the
   parameter argument of the jitted programs (same shapes — no
@@ -65,7 +74,7 @@ TPU shape — every device program is static-shape and compiled once:
 
 import contextlib
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -75,6 +84,7 @@ import numpy as np
 
 from ..attribution.phases import PhaseAccumulator
 from ..chaos import faults
+from . import kv_blocks
 from .generation import (
     SamplingConfig,
     decode_apply,
@@ -230,6 +240,8 @@ class ContinuousBatchingEngine:
         cache_layout: str = "frontier",
         overlap: bool = True,
         auto_chunk: bool = False,
+        kv_block_size: int = 16,
+        kv_pool_blocks: int = 0,
     ):
         """With ``mesh`` (+ optional logical-axis ``rules``) every
         device program runs SPMD over it: pass params already placed in
@@ -254,6 +266,20 @@ class ContinuousBatchingEngine:
           lifetime is bounded by its own prompt+budget, not by the
           stream's). Liveness is simply prompt_width + max_new_tokens
           <= max_seq_len. Preferred for long mixed streams.
+        - ``"paged"``: per_row's write discipline over a BLOCK POOL
+          (models/kv_blocks.py): ``kv_block_size`` tokens per block,
+          ``kv_pool_blocks`` blocks total (0 = the dense equivalent,
+          ``batch_size * L/bs + 1``; size it smaller to serve the same
+          batch in less HBM). Each slot holds a block table; the chunk
+          program gathers the dense view, runs the per_row step body
+          unchanged, and scatters back — greedy streams are bit-exact
+          with both dense layouts. Admission allocates ``ceil((bucket
+          + cap)/bs)`` blocks (bounded by free blocks, NOT free
+          slots); a registered prefix's fully-covered blocks are
+          shared refcounted across rows (copy-on-write: decode writes
+          start past the prefix, the partial tail block is the
+          per-row copy), and idle prefix blocks evict LRU under pool
+          pressure.
 
         ``overlap`` selects the double-buffered scheduler round (the
         default): chunk N+1 is dispatched before chunk N's results are
@@ -265,16 +291,18 @@ class ContinuousBatchingEngine:
         """
         cfg = model.config
         L = cfg.max_seq_len
-        if cache_layout not in ("frontier", "per_row"):
+        if cache_layout not in ("frontier", "per_row", "paged"):
             raise ValueError(
-                f"cache_layout {cache_layout!r}: frontier | per_row"
+                f"cache_layout {cache_layout!r}: frontier | per_row | "
+                f"paged"
             )
         self.layout = cache_layout
-        if cache_layout == "per_row":
+        if cache_layout in ("per_row", "paged"):
             # per-row liveness: each request lives in its own slots
             if prompt_width + sampling.max_new_tokens > L:
                 raise ValueError(
-                    f"per_row liveness: prompt_width + max_new_tokens = "
+                    f"{cache_layout} liveness: prompt_width + "
+                    f"max_new_tokens = "
                     f"{prompt_width + sampling.max_new_tokens} > "
                     f"max_seq_len {L}"
                 )
@@ -352,6 +380,35 @@ class ContinuousBatchingEngine:
         # stats the fleet gateway routes on and the autoscaler scales on
         self._lat_window: deque = deque(maxlen=256)
         self.completed_total = 0
+        # paged-layout accounting (zeroed-but-present in every layout
+        # so stats()/healthz keys stay uniform across a mixed fleet)
+        self.kv_block_size = int(kv_block_size)
+        self.prefix_hits = 0
+        self.alloc_failures = 0
+        self.prefix_evictions = 0
+        if cache_layout == "paged":
+            bs = self.kv_block_size
+            if bs < 1 or L % bs != 0:
+                raise ValueError(
+                    f"kv_block_size {bs} must divide max_seq_len {L}"
+                )
+            self._nb = L // bs  # block-table width (blocks per row)
+            n = int(kv_pool_blocks) or batch_size * self._nb + 1
+            worst = kv_blocks.blocks_for(
+                self.Pw + sampling.max_new_tokens, bs
+            )
+            if worst > n - 1:
+                raise ValueError(
+                    f"kv_pool_blocks {n}: a worst-case request needs "
+                    f"{worst} blocks but only {n - 1} are allocatable "
+                    f"(block 0 is the trash block)"
+                )
+            self._pool = kv_blocks.BlockPool(n, bs)
+            self._row_blocks: Dict[int, List[int]] = {}
+            # pid -> shared block ids, LRU-ordered for idle eviction
+            self._prefix_blocks: "OrderedDict[int, List[int]]" = (
+                OrderedDict()
+            )
         self._build_programs()
         self._reset_device_state()
         self._tuner = _ChunkAutoTuner(self) if auto_chunk else None
@@ -425,21 +482,29 @@ class ContinuousBatchingEngine:
                 row_f.at[slot].set(next_slot),
             )
 
-        def make_decode_chunk(per_row: bool, d: int):
+        def make_decode_chunk(layout: str, d: int):
             """Build the d-step decode program for one layout; returns
             stacked (toks, emits, logps) [d, B] and the advanced state.
-            ONE step body serves both layouts (the sampling contract,
+            ONE step body serves every layout (the sampling contract,
             kv_valid handling, and logits dtype must never diverge
             between them — token-exactness in each layout is proven
-            against the same one-shot engine): ``per_row`` only selects
-            the write-slot source. Frontier layout: all rows write at
-            the stream-wide ``frontier + t`` (the per-row frontier in
-            the state rides along untouched). Per-row layout: each row
-            writes at its own frontier (``cache_slots`` scatter);
-            done/empty rows keep stepping on pad (static shapes) with
-            their write slot parked clamped at L-1 — their kv bit and
-            cache row are fully replaced at the next admission, so the
-            parked writes are invisible.
+            against the same one-shot engine): ``layout`` only selects
+            the write-slot source and, for ``paged``, wraps the body in
+            a block-table gather/scatter. Frontier layout: all rows
+            write at the stream-wide ``frontier + t`` (the per-row
+            frontier in the state rides along untouched). Per-row
+            layout: each row writes at its own frontier
+            (``cache_slots`` scatter); done/empty rows keep stepping on
+            pad (static shapes) with their write slot parked clamped at
+            L-1 — their kv bit and cache row are fully replaced at the
+            next admission, so the parked writes are invisible. Paged
+            layout: the state's cache element is ``(pool, tables)``;
+            the chunk gathers the dense [B, L] view by block table,
+            runs the per_row body on it unchanged (bit-exactness is
+            structural, not re-proven), and scatters the advanced view
+            back — one dispatch per chunk, same as the dense layouts.
+            A retired slot's table is parked on the trash block, so
+            its clamped writes can never touch a re-allocated block.
 
             Per-row stop enforcement is ON THE DEVICE: each row carries
             a remaining-emission budget (its request cap), decremented
@@ -449,7 +514,15 @@ class ContinuousBatchingEngine:
             chunk N safe — a capped row cannot emit past its cap or
             consume liveness headroom during the lag window."""
 
+            per_row = layout != "frontier"
+
             def chunk(params, state, frontier, rng):
+                if layout == "paged":
+                    (pool, tables) = state[0]
+                    state = (
+                        kv_blocks.gather_cache(pool, tables), *state[1:]
+                    )
+
                 def step(carry, t):
                     (cache, kv_valid, last_logits, cur_pos, allow,
                      budget, done, row_f, rng) = carry
@@ -500,7 +573,18 @@ class ContinuousBatchingEngine:
                 carry, out = jax.lax.scan(
                     step, (*state, rng), jnp.arange(d)
                 )
-                return carry[:-1], out
+                new_state = carry[:-1]
+                if layout == "paged":
+                    new_state = (
+                        (
+                            kv_blocks.scatter_cache(
+                                pool, tables, new_state[0]
+                            ),
+                            tables,
+                        ),
+                        *new_state[1:],
+                    )
+                return new_state, out
 
             return chunk
 
@@ -518,10 +602,47 @@ class ContinuousBatchingEngine:
                 state = admit(state, *row, slot, nxt, cap)
             return state
 
+        def paged_admit(state, row_cache, row_logits, row_pos, row_kv,
+                        row_allow, slot, next_slot, cap, table_row):
+            """Paged-layout insert: scatter the prefilled [1, L] row
+            into ITS freshly planned blocks (``table_row``, trash-
+            padded past its coverage) and point the slot's table at
+            them. Shared prefix blocks in the table receive the row's
+            prefix values — bitwise identical to every other sharer's
+            (all derive from the one stored prefix state), so the
+            overwrite is a semantic no-op and COW needs no masking."""
+            (pg, kv_valid, last_logits, cur_pos, allow, budget, done,
+             row_f) = state
+            pool, tables = pg
+            pool = kv_blocks.scatter_row(pool, table_row, row_cache)
+            tables = tables.at[slot].set(table_row)
+            return (
+                (pool, tables),
+                kv_valid.at[slot].set(row_kv),
+                last_logits.at[slot].set(row_logits),
+                cur_pos.at[slot].set(row_pos),
+                allow.at[slot].set(row_allow),
+                budget.at[slot].set(cap),
+                done.at[slot].set(False),
+                row_f.at[slot].set(next_slot),
+            )
+
+        def paged_admit_many(state, rows, slots, next_slots, caps,
+                             table_rows):
+            for row, slot, nxt, cap, tr in zip(
+                rows, slots, next_slots, caps, table_rows
+            ):
+                state = paged_admit(state, *row, slot, nxt, cap, tr)
+            return state
+
         self._prefill_fn = jax.jit(prefill_row)
         self._continue_fn = jax.jit(continue_prefill_row, static_argnums=6)
-        self._admit_fn = jax.jit(admit)
-        self._admit_many_fn = jax.jit(admit_many)
+        if self.layout == "paged":
+            self._admit_fn = jax.jit(paged_admit)
+            self._admit_many_fn = jax.jit(paged_admit_many)
+        else:
+            self._admit_fn = jax.jit(admit)
+            self._admit_many_fn = jax.jit(admit_many)
         # chunk programs are cached per (layout, d): the auto-tuner
         # changes d between dispatches and each length is one compile
         self._chunk_src = make_decode_chunk
@@ -573,7 +694,7 @@ class ContinuousBatchingEngine:
         return self._compact_fns[width]
 
     def _chunk_for(self, d: int) -> Callable:
-        key = (self.layout == "per_row", d)
+        key = (self.layout, d)
         if key not in self._chunk_fns:
             self._chunk_fns[key] = jax.jit(self._chunk_src(*key))
         return self._chunk_fns[key]
@@ -591,10 +712,35 @@ class ContinuousBatchingEngine:
     def _reset_device_state(self):
         V = self.model.config.vocab_size
         self._frontier = self.Pw  # decode writes start past prompt KV
-        self._state = (
-            self._set_cache_frontier(
+        if self.layout == "paged":
+            # fresh pool: each dense cache leaf (B, L, ...) becomes
+            # (num_blocks, block_size, ...); 0-d write-index scalars
+            # stay pinned like the dense layouts'. Host allocator and
+            # block tables restart with it.
+            bs = self.kv_block_size
+            template = init_cache(self.model, 1)
+            pool = jax.tree_util.tree_map(
+                lambda leaf: (
+                    jnp.asarray(self._frontier, leaf.dtype)
+                    if leaf.ndim == 0
+                    else jnp.zeros(
+                        (self._pool.num_blocks, bs) + leaf.shape[2:],
+                        leaf.dtype,
+                    )
+                ),
+                template,
+            )
+            tables = jnp.zeros((self.B, self._nb), jnp.int32)
+            cache = (pool, tables)
+            self._pool = kv_blocks.BlockPool(self._pool.num_blocks, bs)
+            self._row_blocks.clear()
+            self._prefix_blocks.clear()
+        else:
+            cache = self._set_cache_frontier(
                 init_cache(self.model, self.B), self._frontier
-            ),
+            )
+        self._state = (
+            cache,
             jnp.zeros((self.B, self.L), bool),
             jnp.full((self.B, V), -1e9, jnp.float32),
             jnp.zeros((self.B,), jnp.int32),
@@ -640,6 +786,82 @@ class ContinuousBatchingEngine:
                 row = self._prefill_fn(self.params, toks, mask)
             self._prefix_states[pid] = (*row, width)
         return self._prefix_states[pid]
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        """Drop a registered prefix (the gateway's prefix-GC path).
+        Refcount-aware: the registry's hold on the prefix's shared
+        blocks is released, but blocks still referenced by live rows
+        stay allocated until those rows retire. Refuses while QUEUED
+        requests still reference the id (their admission would KeyError
+        mid-flight); live decoding rows are fine — their KV was built
+        at admission and never looks the prefix up again."""
+        if prefix_id not in self._prefixes:
+            raise KeyError(f"unknown prefix_id {prefix_id}")
+        if any(item[4] == prefix_id for item in self._queue):
+            raise ValueError(
+                f"prefix_id {prefix_id} still referenced by queued "
+                f"requests"
+            )
+        del self._prefixes[prefix_id]
+        self._prefix_states.pop(prefix_id, None)
+        if self.layout == "paged":
+            ids = self._prefix_blocks.pop(prefix_id, None)
+            if ids:
+                self._pool.free(ids)
+
+    # -- prefill/decode disaggregation ---------------------------------
+
+    def export_prefill(self, tokens: List[int]) -> Dict:
+        """PREFILL-role half of disaggregation: run the prompt's
+        prefill here and return the row as a JSON-safe hand-off
+        payload (see :func:`kv_blocks.pack_row_state`). The decode
+        replica admits it via :meth:`submit_prefilled` and pays only
+        the insert — long prompts stop stalling its decode rounds."""
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.Pw:
+            raise ValueError(
+                f"prompt length {len(tokens)} > prompt_width {self.Pw}"
+            )
+        width = self._bucket_width(len(tokens))
+        toks, mask = self._pad_rows([tokens], width)
+        with self._ctx():
+            row = self._prefill_fn(self.params, toks, mask)
+        row = jax.device_get(row)
+        return kv_blocks.pack_row_state(*row, width, tokens)
+
+    def submit_prefilled(
+        self,
+        payload: Dict,
+        max_new_tokens: Optional[int] = None,
+        allowed_tokens: Optional[List[int]] = None,
+    ) -> int:
+        """DECODE-role half of disaggregation: enqueue a request whose
+        prefill already ran on a prefill replica. The payload is shape-
+        validated against THIS engine's cache template (mismatched
+        model config → ValueError, never a corrupt row) and staged in
+        ``self._prefilled`` — admission pays only the insert program.
+        A weight swap between staging and admission clears the staged
+        row and the request gracefully RE-prefills from its prompt
+        tokens at the new weights (the payload carries them)."""
+        (row_cache, row_logits, row_pos, row_kv, width, prompt) = (
+            kv_blocks.unpack_row_state(
+                payload, init_cache(self.model, 1)
+            )
+        )
+        if width > self.Pw or width != self._bucket_width(len(prompt)):
+            raise ValueError(
+                f"handoff width {width} inconsistent with prompt "
+                f"length {len(prompt)} under prompt_width {self.Pw}"
+            )
+        uid = self.submit(
+            prompt, max_new_tokens=max_new_tokens,
+            allowed_tokens=allowed_tokens,
+        )
+        self._prefilled[uid] = (
+            row_cache, row_logits, row_pos, row_kv, width
+        )
+        return uid
 
     def submit(
         self,
@@ -775,6 +997,17 @@ class ContinuousBatchingEngine:
         # weights — rebuild lazily / re-prefill at admission
         self._prefix_states.clear()
         self._prefilled.clear()
+        if self.layout == "paged":
+            # drop the registry's hold on every prefix's shared blocks:
+            # the COW invariant (all sharers of a block agree on its
+            # content) would break if post-swap admissions rewrote
+            # blocks that pre-swap live rows still gather. Fresh blocks
+            # are allocated on next use; live rows keep theirs until
+            # retirement (refcounts make the order safe).
+            for ids in self._prefix_blocks.values():
+                if ids:
+                    self._pool.free(ids)
+            self._prefix_blocks.clear()
         self.swap_latency_s = time.perf_counter() - self._pending_t0
         return True
 
@@ -854,7 +1087,11 @@ class ContinuousBatchingEngine:
             if prefix_id is not None:
                 # prefix caching: derive the row from the stored prefix
                 # state (computed once per weight version) + a
-                # suffix-only forward
+                # suffix-only forward. A warm state is a prefix HIT —
+                # the prefix's own prefill is skipped entirely (the
+                # affinity signal the fleet gateway routes on).
+                if prefix_id in self._prefix_states:
+                    self.prefix_hits += 1
                 (p_cache, p_logits, p_pos, p_kv, p_width) = (
                     self._prefix_state(prefix_id)
                 )
@@ -888,20 +1125,137 @@ class ContinuousBatchingEngine:
         self, slot: int, uid: int, prompt: List[int], submit_t: float,
         cap: int, prefix_id: Optional[int] = None,
         allowed_tokens: Optional[List[int]] = None,
+        table_ids: Optional[List[int]] = None,
     ):
         row, width, full_prompt = self._build_row(
             uid, prompt, prefix_id, allowed_tokens
         )
         with self._ctx():
-            self._state = self._admit_fn(
-                self._state, *row, self._i32(slot), self._i32(width),
-                self._i32(cap),
-            )
+            if self.layout == "paged":
+                tr = jnp.asarray(
+                    kv_blocks.build_table_row(table_ids, self._nb)
+                )
+                self._state = self._admit_fn(
+                    self._state, *row, self._i32(slot),
+                    self._i32(width), self._i32(cap), tr,
+                )
+                self._row_blocks[slot] = list(table_ids)
+            else:
+                self._state = self._admit_fn(
+                    self._state, *row, self._i32(slot),
+                    self._i32(width), self._i32(cap),
+                )
         # full prefix+suffix history: compaction (frontier layout)
         # rebuilds rows from these tokens
         self._slots[slot] = _Slot(
             uid=uid, prompt=full_prompt, submit_t=submit_t, cap=cap,
             admit_t=time.perf_counter(),
+        )
+
+    # -- paged block planning (host side of admission) ------------------
+
+    def _planned_width(
+        self, uid: int, prompt: List[int], prefix_id: Optional[int]
+    ) -> int:
+        """The bucket width _build_row WILL use, computed without
+        device work — block planning must reserve exactly what the
+        insert covers."""
+        pre = self._prefilled.get(uid)
+        if pre is not None:
+            return pre[4]
+        if prefix_id is not None:
+            return self._bucket_width(
+                len(self._prefixes[prefix_id])
+            ) + self._bucket_width(len(prompt))
+        return self._bucket_width(len(prompt))
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Pool alloc with idle-prefix eviction as the backpressure
+        valve: registered prefixes whose shared blocks no live row
+        holds are evicted LRU-first until the allocation fits (their
+        device state survives — the next use just re-allocates)."""
+        ids = self._pool.alloc(n)
+        while ids is None and self._evict_idle_prefix():
+            ids = self._pool.alloc(n)
+        return ids
+
+    def _evict_idle_prefix(self) -> bool:
+        for pid, ids in self._prefix_blocks.items():  # LRU order
+            if all(self._pool.refcount(b) == 1 for b in ids):
+                del self._prefix_blocks[pid]
+                if ids:
+                    self._pool.free(ids)
+                self.prefix_evictions += 1
+                return True
+        return False
+
+    def _prefix_shared_ids(self, pid: int) -> Optional[List[int]]:
+        """The prefix's shareable blocks — the ones FULLY covered by
+        its bucket width (the partial tail block is per-row private:
+        the copy in copy-on-write). Allocated on first paged use and
+        held by the registry at refcount 1 so they stay warm between
+        rows; idle sets are LRU-evictable under pool pressure."""
+        ids = self._prefix_blocks.get(pid)
+        if ids is None:
+            n = self._bucket_width(
+                len(self._prefixes[pid])
+            ) // self.kv_block_size
+            ids = self._alloc_blocks(n) if n else []
+            if ids is None:
+                return None
+            self._prefix_blocks[pid] = ids
+        self._prefix_blocks.move_to_end(pid)
+        return ids
+
+    def _plan_blocks(self, uid, prompt, cap, prefix_id):
+        """Plan one admission's block table: shared prefix blocks plus
+        fresh private blocks covering positions [0, width + cap).
+        Returns the table's block ids, or None when the pool cannot
+        cover the request — the caller leaves it QUEUED and retries
+        as retiring rows free blocks (admission bounded by blocks,
+        never an OOM and never a wedge). The ``kv.alloc`` chaos point
+        fires here: an injected error is exactly a failed allocation
+        and takes the same bounded path."""
+        ncov = kv_blocks.blocks_for(
+            self._planned_width(uid, prompt, prefix_id) + cap,
+            self.kv_block_size,
+        )
+        try:
+            faults.inject(
+                "kv.alloc", need=ncov, free=self._pool.blocks_free
+            )
+        except faults.FaultInjectedError:
+            self.alloc_failures += 1
+            return None
+        shared: List[int] = []
+        if prefix_id is not None:
+            shared = self._prefix_shared_ids(prefix_id)
+            if shared is None:
+                self.alloc_failures += 1
+                return None
+            shared = shared[:ncov]
+        priv = self._alloc_blocks(ncov - len(shared))
+        if priv is None:
+            self.alloc_failures += 1
+            return None
+        self._pool.share(shared)
+        return shared + priv
+
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Free a retired row's blocks (shared prefix blocks decref
+        back to the registry's hold) and park the slot's table on the
+        trash block, so the done row's clamped writes can never touch
+        a re-allocated block. Idempotent — the retirement paths
+        overlap (finalize + device retire + cancel)."""
+        ids = self._row_blocks.pop(slot, None)
+        if ids is None:
+            return
+        self._pool.free(ids)
+        (pool, tables), *rest = self._state
+        if not hasattr(self, "_trash_row_arr"):
+            self._trash_row_arr = jnp.zeros((self._nb,), jnp.int32)
+        self._state = (
+            (pool, tables.at[slot].set(self._trash_row_arr)), *rest
         )
 
     def _finalize_slot(self, slot: int):
@@ -924,6 +1278,8 @@ class ContinuousBatchingEngine:
             self._lat_window.append((now, total_s, len(st.emitted)))
             self.completed_total += 1
         self._slots[slot] = _Slot()
+        if self.layout == "paged":
+            self._release_slot_blocks(slot)
 
     def _retire(self, slot: int):
         self._finalize_slot(slot)
@@ -977,6 +1333,7 @@ class ContinuousBatchingEngine:
         # driver loop rather than silently corrupting slot state.
         faults.inject("serving.admit", queue_depth=len(self._queue))
         frontier_layout = self.layout == "frontier"
+        paged = self.layout == "paged"
         burst = self.overlap and self._burst_admit
         prefill_s = 0.0
         batch = []
@@ -991,35 +1348,76 @@ class ContinuousBatchingEngine:
                 self._frontier + self._queue[0][3] > self.L
             ):
                 break  # no room for this request until compaction
+            table_ids = None
+            if paged:
+                # paged admission is bounded by free BLOCKS: plan the
+                # head request's block table before popping it, so a
+                # request the pool can't cover right now stays QUEUED
+                # (retiring rows return blocks) — never half-admitted,
+                # never an OOM, never a wedge (submit() proved it fits
+                # an empty pool).
+                head = self._queue[0]
+                table_ids = self._plan_blocks(
+                    head[0], head[1], head[3], head[4]
+                )
+                if table_ids is None:
+                    break  # out of blocks — retry next round
             (uid, prompt, submit_t, cap, prefix_id, allowed) = (
                 self._queue.pop(0)
             )
             ta = time.perf_counter()
             if not burst:
-                self._admit_one(
-                    slot, uid, prompt, submit_t, cap, prefix_id,
-                    allowed,
-                )
+                # table_ids kwarg only when paged: subclasses override
+                # _admit_one without it (they force dense layouts)
+                if paged:
+                    self._admit_one(
+                        slot, uid, prompt, submit_t, cap, prefix_id,
+                        allowed, table_ids=table_ids,
+                    )
+                else:
+                    self._admit_one(
+                        slot, uid, prompt, submit_t, cap, prefix_id,
+                        allowed,
+                    )
             else:
                 row, width, full_prompt = self._build_row(
                     uid, prompt, prefix_id, allowed
                 )
                 batch.append(
-                    (slot, row, width, cap, uid, full_prompt, submit_t)
+                    (slot, row, width, cap, uid, full_prompt, submit_t,
+                     table_ids)
                 )
             prefill_s += time.perf_counter() - ta
         if batch:
             ta = time.perf_counter()
             with self._ctx():
-                self._state = self._admit_many_fn(
-                    self._state,
-                    tuple(b[1] for b in batch),
-                    tuple(self._i32(b[0]) for b in batch),
-                    tuple(self._i32(b[2]) for b in batch),
-                    tuple(self._i32(b[3]) for b in batch),
-                )
+                if paged:
+                    self._state = self._admit_many_fn(
+                        self._state,
+                        tuple(b[1] for b in batch),
+                        tuple(self._i32(b[0]) for b in batch),
+                        tuple(self._i32(b[2]) for b in batch),
+                        tuple(self._i32(b[3]) for b in batch),
+                        tuple(
+                            jnp.asarray(kv_blocks.build_table_row(
+                                b[7], self._nb
+                            ))
+                            for b in batch
+                        ),
+                    )
+                else:
+                    self._state = self._admit_many_fn(
+                        self._state,
+                        tuple(b[1] for b in batch),
+                        tuple(self._i32(b[0]) for b in batch),
+                        tuple(self._i32(b[2]) for b in batch),
+                        tuple(self._i32(b[3]) for b in batch),
+                    )
             now = time.perf_counter()
-            for slot, _row, _w, cap, uid, full_prompt, submit_t in batch:
+            for (slot, _row, _w, cap, uid, full_prompt, submit_t,
+                 table_ids) in batch:
+                if paged:
+                    self._row_blocks[slot] = list(table_ids)
                 self._slots[slot] = _Slot(
                     uid=uid, prompt=full_prompt, submit_t=submit_t,
                     cap=cap, admit_t=now,
@@ -1384,6 +1782,24 @@ class ContinuousBatchingEngine:
             "queue_depth": len(self._queue),
             "registered_prefixes": len(self._prefixes),
             "prefix_states_cached": len(self._prefix_states),
+            # paged-pool occupancy + prefix locality: the gateway's
+            # affinity-routing and the autoscaler's admission signal
+            # (None fields when the layout is slot-dense)
+            "prefix_hits": self.prefix_hits,
+            "resident_prefixes": sorted(self._prefix_states)[:64],
+            "kv_block_size": (
+                self.kv_block_size if self.layout == "paged" else None
+            ),
+            "blocks_total": (
+                self._pool.blocks_total if self.layout == "paged"
+                else None
+            ),
+            "blocks_free": (
+                self._pool.blocks_free if self.layout == "paged"
+                else None
+            ),
+            "alloc_failures": self.alloc_failures,
+            "prefix_evictions": self.prefix_evictions,
             "kv_cache_int8": bool(
                 getattr(self.model.config, "kv_cache_int8", False)
             ),
@@ -1438,6 +1854,10 @@ class ContinuousBatchingEngine:
         self._state = (
             *state[:done_idx], done, *state[done_idx + 1:]
         )
+        if self.layout == "paged":
+            # cancel path reaches here without _finalize_slot; the
+            # release is idempotent so the retire paths can overlap
+            self._release_slot_blocks(slot)
 
     def drain_completions(self) -> List[Completion]:
         """Hand over (and clear) finished requests, uid-ordered."""

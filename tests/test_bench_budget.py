@@ -281,6 +281,18 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["fleet_rollout_aborted"] = False
     extra["fleet_rollout_load_failed"] = 0
     extra["fleet_ready"] = 2
+    # paged-KV serving section (docs/serving_fleet.md paged memory):
+    # the throughput/p95/hit-rate trio must survive in-line; the dense
+    # leg and occupancy scalars may shrink to the sidecar
+    extra["fleet_paged_tokens_per_s"] = 1613.5
+    extra["fleet_paged_p95_s"] = 0.0559
+    extra["prefix_hit_rate"] = 0.792
+    extra["fleet_dense_tokens_per_s"] = 390.0
+    extra["fleet_dense_p95_s"] = 0.2392
+    extra["fleet_paged_vs_dense_x"] = 4.138
+    extra["fleet_affinity_hits"] = 9
+    extra["fleet_blocks_total"] = 30
+    extra["fleet_blocks_free"] = 30
     # chip-pool section (docs/pool.md): the SLO trio must survive
     # in-line; the supporting scalars may shrink to the sidecar
     extra["pool_preempt_to_ready_s"] = 0.54
@@ -361,20 +373,19 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["storm_slice_mttr_s"] == extra["storm_slice_mttr_s"]
     assert slim["storm_slice_goodput"] == extra["storm_slice_goodput"]
     assert slim["storm_goodput"] == extra["storm_goodput"]
-    # the MTTR phase verdict keys and the warm-vs-cold A/B verdict ride
-    # the line; per-leg details, the two full storm dicts, and the
-    # demoted breakdown scalars (storm_restore_s / storm_first_step_s —
-    # recoverable from the sidecar's goodput_storm dict) are sidecar-only
+    # the MTTR phase breakdown, the detect phase share, and the
+    # warm-vs-cold A/B verdict pair moved sidecar-only to seat the
+    # paged-KV trio (the first three re-derive from the sidecar's
+    # goodput_storm dict — same class as storm_restore_s /
+    # storm_first_step_s before them — the A/B pair from recovery_ab)
     for key in (
-        "storm_rdzv_s", "storm_compile_s", "recovery_mttr_delta_s",
-        "recovery_warm_compile_s",
+        "storm_rdzv_s", "storm_compile_s", "storm_detect_s",
+        "recovery_mttr_delta_s", "recovery_warm_compile_s",
     ):
-        assert slim[key] == extra[key], key
+        assert key not in slim, key
     assert "recovery_ab" not in slim
-    # the trace-derived detection SLOs ride the line (the remaining
-    # trace phase scalars are sidecar-recoverable from goodput_storm)
+    # the detection headline still rides the line
     assert slim["storm_mttd_s"] == extra["storm_mttd_s"]
-    assert slim["storm_detect_s"] == extra["storm_detect_s"]
     # the master-kill SLO pair rides the line; the full drill dict is
     # sidecar-only
     assert slim["master_mttr_s"] == extra["master_mttr_s"]
@@ -389,6 +400,13 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     for key in (
         "fleet_requests_per_s", "fleet_kill_availability",
         "fleet_rollout_max_unready",
+    ):
+        assert slim[key] == extra[key], key
+    # the paged-KV trio rides the line (the dense leg, the speedup
+    # ratio, and block occupancy are sidecar-recoverable)
+    for key in (
+        "fleet_paged_tokens_per_s", "fleet_paged_p95_s",
+        "prefix_hit_rate",
     ):
         assert slim[key] == extra[key], key
     # the chip-pool SLO trio rides the line (supporting pool scalars

@@ -45,6 +45,8 @@ class StubReplica:
         self.script = script or {}
         self.served = 0
         self.reloads = 0
+        self.prefills = 0
+        self.prefix_deletes = 0
         self._uid = 0
         self._prefixes = {}
         self._next_pid = 0
@@ -98,6 +100,19 @@ class StubReplica:
                         "swap_failures": stub._swap_failures,
                         "swap_pending": False,
                         "last_swap_error": None,
+                        # scripted warmth override, else every
+                        # registered pid is resident (engine stats
+                        # surface, gateway affinity input)
+                        "resident_prefixes": stub.script.get(
+                            "resident_prefixes",
+                            sorted(stub._prefixes),
+                        ),
+                        "blocks_total": stub.script.get("blocks_total"),
+                        "blocks_free": stub.script.get("blocks_free"),
+                        "prefix_hits": stub.script.get(
+                            "prefix_hits", 0
+                        ),
+                        "alloc_failures": 0,
                     })
                 else:
                     self._send(404, {"error": "nope"})
@@ -151,6 +166,15 @@ class StubReplica:
                         stub._next_pid += 1
                         stub._prefixes[pid] = body["tokens"]
                     self._send(200, {"prefix_id": pid})
+                elif self.path == "/v1/prefill":
+                    if stub.script.get("fail_prefill"):
+                        self._send(500, {"error": "scripted"})
+                        return
+                    with stub._mu:
+                        stub.prefills += 1
+                    self._send(200, {"prefilled": {
+                        "stub": True, "tokens": body["tokens"],
+                    }})
                 elif self.path == "/v1/weights/reload":
                     stub.reloads += 1
                     if stub.script.get("fail_reload"):
@@ -161,6 +185,25 @@ class StubReplica:
                         "step": stub.script.get("reload_step", 1),
                         "swap_latency_s": 0.01,
                     })
+                else:
+                    self._send(404, {"error": "nope"})
+
+            def do_DELETE(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/v1/prefixes":
+                    pid = body.get("prefix_id")
+                    with stub._mu:
+                        known = pid in stub._prefixes
+                        if known:
+                            del stub._prefixes[pid]
+                            stub.prefix_deletes += 1
+                    if not known:
+                        self._send(
+                            404, {"error": f"unknown prefix_id {pid}"}
+                        )
+                        return
+                    self._send(200, {"removed": pid})
                 else:
                     self._send(404, {"error": "nope"})
 
@@ -595,6 +638,194 @@ class TestGatewayPrefixes:
             assert gw.redispatches == 0
             # the gateway still serves normally afterwards
             assert gw.complete({"prompt": [1]})["tokens"]
+        finally:
+            sup.stop()
+
+
+def _poll(gw, n=1):
+    """Wait out >= n health-poll intervals so scripted /healthz stats
+    land in the supervisor handles the gateway routes off."""
+    time.sleep(max(0.2, n * gw.cfg.health_interval_s * 3))
+
+
+class TestPrefixAffinity:
+    def test_prefix_requests_prefer_warm_replica(self):
+        """Replica 0 scripts an empty resident set (cold cache), so
+        every prefix-id completion should land on warm replica 1 and
+        bump the affinity counter; plain completions still spread."""
+        sup, gw, made = _stub_fleet(
+            2, scripts={0: {"resident_prefixes": []}}
+        )
+        try:
+            pid = gw.register_prefix([4, 5, 6])
+            _poll(gw)  # warmth is read off the last health poll
+            for _ in range(4):
+                out = gw.complete({"prompt": [7], "prefix_id": pid})
+                assert out["tokens"] == [1, 1, 1], out
+            assert gw.affinity_hits >= 4
+            assert gw.status()["gateway"]["affinity_hits"] >= 4
+            # affinity is a preference, not a pin: plain traffic still
+            # reaches the cold replica
+            for _ in range(4):
+                gw.complete({"prompt": [7]})
+            assert made[0].served > 0
+        finally:
+            sup.stop()
+
+    def test_kv_aggregate_sums_paged_replicas(self):
+        """/fleet/status "kv" sums block occupancy over the replicas
+        that report a paged pool and stays None-total when none do."""
+        sup, gw, _ = _stub_fleet(2)
+        try:
+            _poll(gw)
+            kv = gw.status()["kv"]
+            assert kv["blocks_total"] is None
+            assert kv["blocks_free"] is None
+        finally:
+            sup.stop()
+        sup, gw, _ = _stub_fleet(2, scripts={
+            0: {"blocks_total": 64, "blocks_free": 10,
+                "prefix_hits": 3},
+            1: {"prefix_hits": 2},  # dense replica: no pool
+        })
+        try:
+            _poll(gw)
+            kv = gw.status()["kv"]
+            assert kv["blocks_total"] == 64
+            assert kv["blocks_free"] == 10
+            assert kv["prefix_hits"] == 5
+        finally:
+            sup.stop()
+
+
+class TestPrefixGC:
+    def test_registry_bounded_no_leak(self):
+        """Leak regression: registering far past prefix_capacity keeps
+        the fleet registry, the replica-pid map, AND the replica-side
+        prefix stores bounded — evicted ids are forgotten everywhere."""
+        sup, gw, made = _stub_fleet(2, prefix_capacity=4)
+        try:
+            pids = [gw.register_prefix([i]) for i in range(50)]
+            assert len(gw._prefixes) <= 4
+            assert gw.prefix_evictions == 46
+            # replica-pid translations for evicted ids are gone too
+            assert all(
+                k[3] in gw._prefixes for k in gw._replica_pids
+            ), "evicted prefix left a dangling replica-pid entry"
+            # replica-side forget fan-out freed the stub stores
+            for rep in made.values():
+                assert len(rep._prefixes) <= 4
+                assert rep.prefix_deletes >= 46
+            # survivors are the MRU tail and still usable
+            out = gw.complete({"prompt": [7], "prefix_id": pids[-1]})
+            assert out["tokens"]
+            with pytest.raises(Exception):
+                gw.complete({"prompt": [7], "prefix_id": pids[0]})
+        finally:
+            sup.stop()
+
+    def test_unregister_blocked_while_referenced_then_ok(self):
+        """DELETE of a prefix a request is still decoding against is a
+        retryable conflict; it succeeds once the request drains."""
+        sup, gw, made = _stub_fleet(2, script={"delay_s": 0.4})
+        try:
+            pid = gw.register_prefix([1, 2, 3])
+            t = threading.Thread(
+                target=gw.complete,
+                args=({"prompt": [7], "prefix_id": pid},),
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not gw._prefix_refs:
+                time.sleep(0.01)
+            assert gw._prefix_refs, "request never pinned its prefix"
+            with pytest.raises(ValueError, match="in-flight"):
+                gw.unregister_prefix(pid)
+            t.join(timeout=30)
+            gw.unregister_prefix(pid)
+            assert not gw._prefixes
+            for rep in made.values():
+                assert not rep._prefixes
+            with pytest.raises(KeyError):
+                gw.unregister_prefix(999)
+        finally:
+            sup.stop()
+
+    def test_delete_prefix_over_http(self):
+        sup, gw, _ = _stub_fleet(2)
+        port = gw.start_http(0)
+        base = f"http://127.0.0.1:{port}"
+
+        def delete(pid):
+            req = urllib.request.Request(
+                base + "/v1/prefixes",
+                data=json.dumps({"prefix_id": pid}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="DELETE",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+
+        try:
+            pid = gw.register_prefix([1, 2, 3])
+            code, out = delete(pid)
+            assert code == 200 and out["removed"] == pid
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                delete(pid)
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                delete("not-an-int")
+            assert ei.value.code == 400
+        finally:
+            gw.stop_http()
+            sup.stop()
+
+
+class TestDisaggregatedStubFleet:
+    """Fast synthetic twin of the engine-backed disaggregation drill
+    (chaos scenario ``prefill_handoff_drop`` exercises the real
+    engines): handoff routing, short-prompt bypass, and the
+    failure->direct-path fallback over scripted stubs."""
+
+    def _fleet(self, **kw):
+        return _stub_fleet(
+            2, min_replicas=2, prefill_replicas=1,
+            disagg_min_prompt=2, **kw
+        )
+
+    def test_long_prompt_hands_off_then_decodes(self):
+        sup, gw, made = self._fleet()
+        try:
+            out = gw.complete({"prompt": [1, 2, 3]})
+            # rid 0 is the prefill replica; completions must land on
+            # the decode replica (tokens encode who served)
+            assert out["tokens"] == [1, 1, 1], out
+            assert made[0].prefills == 1
+            assert made[0].served == 0
+            assert gw.handoffs == 1 and gw.handoff_fallbacks == 0
+            st = sup.status()
+            assert st["ready_prefill"] == 1
+            assert st["ready_decode"] == 1
+        finally:
+            sup.stop()
+
+    def test_short_prompt_skips_handoff(self):
+        sup, gw, made = self._fleet()
+        try:
+            out = gw.complete({"prompt": [7]})
+            assert out["tokens"] == [1, 1, 1], out
+            assert made[0].prefills == 0 and gw.handoffs == 0
+        finally:
+            sup.stop()
+
+    def test_prefill_failure_falls_back_to_direct_path(self):
+        sup, gw, made = self._fleet(
+            scripts={0: {"fail_prefill": True}}
+        )
+        try:
+            out = gw.complete({"prompt": [1, 2, 3]})
+            assert out["tokens"] == [1, 1, 1], out
+            assert gw.handoffs == 0 and gw.handoff_fallbacks == 1
         finally:
             sup.stop()
 
@@ -1084,4 +1315,7 @@ class TestFleetConfig:
 
         for field, knob in _FLEET_KNOBS.items():
             assert knob in ENV_KNOBS, knob
-            assert knob.startswith("DLROVER_FLEET_")
+            # disaggregation knobs share the serve-side DISAGG family
+            assert knob.startswith(
+                ("DLROVER_FLEET_", "DLROVER_DISAGG_")
+            ), knob

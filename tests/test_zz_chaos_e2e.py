@@ -267,3 +267,27 @@ def test_host_kill_scenario(tmp_path):
     result = host_kill(str(tmp_path))
     assert result["fired"] >= 1, result
     assert result["recovered"], result
+
+
+def test_kv_alloc_pressure_scenario(tmp_path):
+    """Paged-KV allocator under injected block-pool exhaustion: bursts
+    queue at admission, nothing OOMs or wedges, and every request
+    completes with the pool fully recovered."""
+    from dlrover_tpu.chaos.scenarios import kv_alloc_pressure
+
+    result = kv_alloc_pressure(str(tmp_path))
+    assert result["fired"] >= 3, result
+    assert result["recovered"], result
+
+
+@pytest.mark.slow
+def test_prefill_handoff_drop_scenario(tmp_path):
+    """Full disaggregated-fleet drill (real engines; the fast
+    synthetic twin lives in test_fleet.py): a dropped prefill handoff
+    falls back to the decode replica's direct path, never a client
+    error."""
+    from dlrover_tpu.chaos.scenarios import prefill_handoff_drop
+
+    result = prefill_handoff_drop(str(tmp_path))
+    assert result["fired"] >= 1, result
+    assert result["recovered"], result

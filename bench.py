@@ -249,7 +249,7 @@ _PRIORITY_KEYS = (
     # capture as complete
     *sorted(HEADLINE_SECTION_ERRORS - {"fatal_error", "tpu_error"}),
     "headline_config", "model", "mfu", "flash_step_s",
-    "flash_vs_dense", "serving_host_frac",
+    "serving_host_frac",
     "serving_overlap_vs_sync", "serving_overlap_exact",
     "interposer_overhead_pct",
     "attr_report",
@@ -290,9 +290,10 @@ _PRIORITY_KEYS = (
     # serving_per_row_tokens_per_s and ckpt_async_stage_block_s moved
     # sidecar-only (both ride the SILICON headline dict the
     # last_silicon pointer names, same recoverability class as
-    # restore_overhead_x above; decode_tokens_per_s stays — the
-    # serving-verdict comment above already pins it in-line)
-    "decode_tokens_per_s",
+    # restore_overhead_x above). Byte offsets for the elastic trio
+    # below: decode_tokens_per_s moved sidecar-only too (it also rides
+    # the SILICON headline dict), and flash_vs_dense re-derives from
+    # the in-line flash_step_s and the sidecar's dense_step_s.
     # recovery-SLO matrix (per-fault-class, pointer-style — the full
     # storm dict with stall forensics goes to the sidecar)
     "storm_goodput", "storm_mttr_s", "storm_slice_mttr_s",
@@ -322,6 +323,11 @@ _PRIORITY_KEYS = (
     # (durable_block_vs_flash_x) stays sidecar-recoverable too: it
     # re-derives from durable_save_block_s / ckpt_async_stage_block_s.
     "durable_save_block_s", "durable_restore_s",
+    # elastic hybrid-parallelism trio (docs/elastic_parallelism.md):
+    # the dp→pp trade window, its reshard leg, and the cost-model
+    # verdict the trade is chosen by. Supporting detail (the
+    # transition label and the rung's accum) is sidecar-recoverable.
+    "dp_pp_trade_mttr_s", "reshard_s", "hybrid_vs_accum_goodput_x",
     "probe_sidecar", "extra_sidecar", "line_truncated",
 )
 
@@ -1887,6 +1893,112 @@ def _bench_pool(extra):
     extra["pool_window_s"] = result["window_s"]
 
 
+def _bench_elastic(extra):
+    """Elastic hybrid-parallelism rung (docs/elastic_parallelism.md):
+    the DP→PP trade drill on the live device set. Stage a flash image
+    under the full-world mesh, replan half the world under an HBM cap
+    sized so the accum-only rung is memory-bound (the regime the rung
+    ladder exists for), and execute the trade through RESHARD_RULES
+    (``CheckpointEngine.load_resharded``). Emits the SLO trio:
+    ``dp_pp_trade_mttr_s`` (plan + reshard, the whole rung-transition
+    window), ``reshard_s`` (the load_resharded leg alone — the same
+    quantity ``tpurun-trace`` attributes per transition), and
+    ``hybrid_vs_accum_goodput_x`` (the cost-model verdict the trade is
+    chosen by — > 1.0 or the planner would have stacked accum)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+    from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh
+    from dlrover_tpu.parallel.replan import CostModel, ElasticReplanner, Rung
+
+    n = jax.device_count()
+    full = 1 << (max(1, n).bit_length() - 1)  # largest power of 2 <= n
+    if full < 4:
+        raise RuntimeError(f"elastic rung needs >=4 devices, have {n}")
+    mesh_from = build_mesh(MeshConfig(dp=full), devices=jax.devices()[:full])
+    dim0 = full * 32
+    dp_sh = NamedSharding(mesh_from, PartitionSpec("dp"))
+    state = {
+        "params": {
+            "w": jax.device_put(
+                np.arange(dim0 * 64, dtype=np.float32).reshape(dim0, 64),
+                dp_sh,
+            )
+        },
+        "opt_state": {
+            "mu": {
+                "w": jax.device_put(np.zeros((dim0, 64), np.float32), dp_sh)
+            }
+        },
+        "step": jax.device_put(
+            np.int64(1), NamedSharding(mesh_from, PartitionSpec())
+        ),
+    }
+    # Accum-only vs trade rung at half the world; the HBM cap sits
+    # halfway between their per-device footprints so exactly one side
+    # of the trade is memory-feasible (params+moments split over pp,
+    # moments further over dp per arXiv:2004.13336).
+    shrunk = full // 2
+    trade = Rung(dp=max(1, shrunk // 2), pp=2, accum=0)
+    base = CostModel(
+        param_bytes=1 << 20,
+        opt_bytes=2 << 20,
+        step_time_s=1.0,
+        reference=Rung(dp=full),
+        opt_dp_shard=True,
+    )
+    accum_only = Rung(dp=shrunk, accum=2)
+    cap = (
+        base.mem_bytes_per_device(trade)
+        + base.mem_bytes_per_device(accum_only)
+    ) // 2
+    planner = ElasticReplanner(
+        dataclasses.replace(base, hbm_bytes_per_device=cap),
+        full_dp=full,
+        current=Rung(dp=full),
+        max_pp=2,
+    )
+    engine = CheckpointEngine(
+        tempfile.mkdtemp(prefix="bench_elastic_"), host_rank=0, num_hosts=1
+    )
+    try:
+        if not engine.save_to_memory(1, state):
+            raise RuntimeError("flash stage refused the elastic image")
+        t0 = time.perf_counter()
+        plan = planner.plan(shrunk)
+        mesh_to = build_mesh(
+            plan.rung.mesh_config(),
+            devices=jax.devices()[: plan.rung.devices],
+        )
+        t1 = time.perf_counter()
+        step, placed, _ = engine.load_resharded(mesh_to)
+        if step != 1 or not placed:
+            raise RuntimeError("reshard lost the staged image")
+        jax.block_until_ready(placed)
+        t2 = time.perf_counter()
+        if not plan.is_trade:
+            raise RuntimeError(
+                f"planner kept {plan.rung.label()}: no trade to measure"
+            )
+        extra["dp_pp_trade_mttr_s"] = round(t2 - t0, 6)
+        extra["reshard_s"] = round(t2 - t1, 6)
+        extra["hybrid_vs_accum_goodput_x"] = round(
+            plan.hybrid_vs_accum_goodput_x, 4
+        )
+        extra["elastic_transition"] = (
+            f"{plan.current.label()} -> {plan.rung.label()}"
+        )
+        extra["elastic_rung_accum"] = plan.rung.accum
+    finally:
+        engine.close()
+        AsyncCheckpointSaver.shutdown()
+
+
 def _bench_attribution(extra, cfg, params, on_tpu, interposed,
                        serving_split=None):
     """Performance-attribution rung (r6): the serving host/device
@@ -2416,6 +2528,12 @@ def worker():
                 _bench_pool(extra)
             except Exception as e:  # noqa: BLE001
                 extra["pool_error"] = repr(e)[:200]
+
+        if want("elastic"):
+            try:
+                _bench_elastic(extra)
+            except Exception as e:  # noqa: BLE001
+                extra["elastic_error"] = repr(e)[:200]
 
         params = None  # the model families below build their own
         _section_gc(extra, "post_serving")

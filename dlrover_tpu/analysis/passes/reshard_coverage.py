@@ -49,7 +49,7 @@ _MESH_REL = os.path.join("dlrover_tpu", "parallel", "mesh.py")
 
 _SAVE_CALLS = {"save_to_memory", "save_to_storage"}
 # policies whose axes set must cover everything a reshard can move
-_SHARDED_POLICIES = {"respec", "mirror_params"}
+_SHARDED_POLICIES = {"respec", "mirror_params", "mirror_dp"}
 
 
 def _literals_from(path: str, names: Tuple[str, ...]) -> Dict[str, object]:

@@ -73,6 +73,7 @@ INJECTION_POINTS: Dict[str, str] = {
     "ckpt.replica.fetch": "replica fetch of this host's shard from a peer",
     "ckpt.durable_write": "durable writer draining a committed image to the durable tier",
     "ckpt.durable_commit": "durable two-phase commit: barrier met, about to write manifest+marker",
+    "remesh.replan": "elastic replanner scoring the rung ladder for a changed world",
     "serving.swap": "serving engine async weight-swap device transfer",
     "serving.admit": "serving engine slot-admission entry",
     "kv.alloc": "paged engine planning a request's KV block table",

@@ -889,6 +889,149 @@ def master_kill(workdir: Optional[str] = None) -> Dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# dp_pp_trade_storm: a shrink storm hits mid-flight WHILE the replanner
+# itself is faulted — the first replan of the new world dies injected
+# (the loop's catch-and-retry semantics), the retry must pick a DP→PP
+# trade over the accum-only rung (memory-bound under the HBM cap), and
+# the staged flash image must cross the mesh change bit-exact through
+# RESHARD_RULES (CheckpointEngine.load_resharded). The recovery SLO is
+# the tentpole claim of docs/elastic_parallelism.md: goodput of the
+# traded rung beats accum-only (> 1.0x) AND live state survives the
+# dp→dp·pp transition exactly.
+# ---------------------------------------------------------------------------
+
+
+def dp_pp_trade_storm(workdir: Optional[str] = None) -> Dict:
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..checkpoint.engine import CheckpointEngine
+    from ..checkpoint.saver import AsyncCheckpointSaver
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from ..parallel.replan import CostModel, ElasticReplanner, Rung
+
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_dpppstorm_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    n = jax.device_count()
+    if n < 8:
+        return {
+            "scenario": "dp_pp_trade_storm",
+            "fired": 0,
+            "recovered": False,
+            "error": f"needs 8 devices for the dp8 full world, have {n}",
+        }
+    # Full world: dp8 over 8 devices. Live state staged to shm with the
+    # shardings the OLD programs gave it: params/opt over dp, one
+    # pp-flavored leaf, one replicated scalar, one host-local extra.
+    mesh_from = build_mesh(MeshConfig(dp=8), devices=jax.devices()[:8])
+    host = {
+        "params/w": np.arange(16 * 4, dtype=np.float32).reshape(16, 4),
+        "params/stage_w": np.arange(8 * 3, dtype=np.float32).reshape(8, 3),
+        "opt_state/mu/w": np.full((16, 4), 0.25, np.float32),
+        "step": np.int64(7),
+        "extra/cursor": np.int64(41),
+    }
+    state = {
+        "params": {
+            "w": jax.device_put(
+                host["params/w"],
+                NamedSharding(mesh_from, PartitionSpec("dp")),
+            ),
+            "stage_w": jax.device_put(
+                host["params/stage_w"],
+                NamedSharding(mesh_from, PartitionSpec("pp")),
+            ),
+        },
+        "opt_state": {
+            "mu": {
+                "w": jax.device_put(
+                    host["opt_state/mu/w"],
+                    NamedSharding(mesh_from, PartitionSpec(("dp",))),
+                )
+            }
+        },
+        "step": jax.device_put(
+            host["step"], NamedSharding(mesh_from, PartitionSpec())
+        ),
+        "extra": {"cursor": host["extra/cursor"]},  # host_local: no device
+    }
+    faults.activate(
+        faults.FaultPlan.parse("seed=7;remesh.replan:error:replan-blip@at=1")
+    )
+    engine = CheckpointEngine(ckpt_dir, host_rank=0, num_hosts=1)
+    try:
+        assert engine.save_to_memory(7, state), "flash stage refused"
+        # The storm: 8 → 4 devices. Cost model tuned so the accum-only
+        # rung (dp4, params replicated over the mesh) busts the HBM cap
+        # while dp2·pp2 (params+moments split over pp, moments further
+        # over dp per arXiv:2004.13336) fits — the exact regime where
+        # the trade beats stacking accum.
+        planner = ElasticReplanner(
+            CostModel(
+                param_bytes=1 << 20,
+                opt_bytes=2 << 20,
+                hbm_bytes_per_device=1_200_000,
+                step_time_s=1.0,
+                reference=Rung(dp=8),
+                opt_dp_shard=True,
+            ),
+            full_dp=8,
+            current=Rung(dp=8),
+            max_pp=2,
+        )
+        t0 = time.monotonic()
+        plan = None
+        retries = 0
+        for _ in range(3):  # the loop's catch-and-retry, condensed
+            try:
+                plan = planner.plan(4)
+                break
+            except faults.FaultInjectedError as e:
+                retries += 1
+                logger.info("replan storm blip (retrying): %s", e)
+        assert plan is not None, "replan never converged"
+        # Execute the trade: reshard the staged image onto the chosen
+        # rung's mesh through RESHARD_RULES — no template, the old
+        # world's programs are gone.
+        mesh_to = build_mesh(
+            plan.rung.mesh_config(), devices=jax.devices()[: plan.rung.devices]
+        )
+        step, placed, _extra = engine.load_resharded(mesh_to)
+        mttr_s = time.monotonic() - t0
+        planner.adopt(plan.rung)
+        parity = (
+            step == 7
+            and placed is not None
+            and all(
+                np.array_equal(np.asarray(placed[path]), host[path])
+                for path in host
+            )
+        )
+        fired = _fired(("remesh.replan",))
+        return {
+            "scenario": "dp_pp_trade_storm",
+            "fired": fired,
+            "recovered": parity
+            and plan.is_trade
+            and plan.rung == Rung(dp=2, pp=2, accum=4)
+            and plan.hybrid_vs_accum_goodput_x > 1.0
+            and retries >= 1
+            and fired >= 1,
+            "transition": f"{plan.current.label()} → {plan.rung.label()}",
+            "hybrid_vs_accum_goodput_x": round(
+                plan.hybrid_vs_accum_goodput_x, 4
+            ),
+            "mttr_s": round(mttr_s, 4),
+            "retries": retries,
+        }
+    finally:
+        engine.close()
+        AsyncCheckpointSaver.shutdown()
+        faults.deactivate()
+
+
 SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "flaky_rpc": flaky_rpc,
     "rdzv_retry": rdzv_retry,
@@ -903,6 +1046,7 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "host_kill": host_kill,
     "slice_kill": slice_kill,
     "master_kill": master_kill,
+    "dp_pp_trade_storm": dp_pp_trade_storm,
 }
 
 

@@ -165,32 +165,16 @@ def place_with_rules(
 ) -> Dict[str, Any]:
     """Templateless device placement (the warm-pool path): derive each
     leaf's target sharding from its category rule + the manifest's
-    saved spec, then place everything in one batched device_put."""
-    import jax
-
-    from ...parallel.sharding import category_of_path, respec_sharding
+    saved spec. Thin wrapper over the shared reshard engine in
+    :func:`dlrover_tpu.parallel.sharding.place_arrays_with_rules` —
+    the same code path the elastic replanner drives for in-memory
+    flash-image transitions."""
+    from ...parallel.sharding import place_arrays_with_rules
 
     saved_specs: Dict[str, Any] = {}
     for specs in manifest.category_specs.values():
         saved_specs.update(specs)
-    paths, host_arrs, shardings = [], [], []
-    placed: Dict[str, Any] = {}
-    for path, arr in arrays.items():
-        sharding = respec_sharding(
-            category_of_path(path),
-            saved_specs.get(path, []),
-            mesh,
-            arr.shape,
-        )
-        if sharding is None:  # host_local — stays on the host
-            placed[path] = arr
-            continue
-        paths.append(path)
-        host_arrs.append(arr)
-        shardings.append(sharding)
-    if paths:
-        placed.update(zip(paths, jax.device_put(host_arrs, shardings)))
-    return placed
+    return place_arrays_with_rules(saved_specs, arrays, mesh)
 
 
 def warm_start(

@@ -709,6 +709,51 @@ class CheckpointEngine:
                 return result
         return -1, None
 
+    def load_resharded(
+        self, mesh, step: Optional[int] = None
+    ) -> Tuple[int, Optional[Dict[str, Any]], Dict[str, Any]]:
+        """Templateless restore of the staged flash image under ``mesh``
+        — the in-memory rung transition of the elastic replanner
+        (docs/elastic_parallelism.md).
+
+        Unlike :meth:`load`, there is no template state to borrow
+        shardings from: the OLD world's programs are gone (the new rung
+        has different mesh extents), so each leaf's target sharding is
+        derived from its RESHARD_RULES category + the spec stamped into
+        the shm image at save time — the same
+        ``place_arrays_with_rules`` engine the durable tier's
+        reshard-on-read restore drives. Returns ``(step, {leaf path:
+        placed array}, extra)`` or ``(-1, None, {})`` when shm holds no
+        image (or ``step`` was given and the image is a different
+        step — the caller wants THIS step's state, not whatever is
+        lying around).
+        """
+        from ..parallel.sharding import place_arrays_with_rules
+
+        faults.inject("ckpt.engine.load", host_rank=self.host_rank)
+        self._drain_stage_for_read()
+        with self._events.ckpt_load():
+            got = self._read_staged_host()
+            if got is None:
+                return -1, None, {}
+            meta, arrays = got
+            if step is not None and meta.step != step:
+                logger.warning(
+                    "staged image holds step %s, wanted %s; not resharding",
+                    meta.step,
+                    step,
+                )
+                return -1, None, {}
+            saved_specs = {rec.path: rec.spec for rec in meta.records}
+            placed = place_arrays_with_rules(saved_specs, arrays, mesh)
+        logger.info(
+            "resharded step %s from host memory onto mesh %s (%s leaves)",
+            meta.step,
+            dict(getattr(mesh, "shape", {})),
+            len(placed),
+        )
+        return meta.step, placed, dict(meta.extra)
+
     def _refill_from_peer(self) -> bool:
         """Pull this host's replicated shard from its backup peer into
         local shm (control-plane transfer only — NO device collectives,

@@ -127,6 +127,23 @@ class Context:
     host_memory_mb: float = 0.0
     initial_batch_size: int = 0
 
+    # Elastic hybrid parallelism (parallel/replan.py,
+    # docs/elastic_parallelism.md): on a world change the replanner
+    # picks a DP×TP×PP rung instead of only stacking grad-accum.
+    # Off by default — accum-only elasticity is the conservative
+    # pre-rung behavior.
+    elastic_replan: bool = False
+    # ICI-bound caps on the extents the rung ladder may trade into.
+    elastic_max_tp: int = 1
+    elastic_max_pp: int = 1
+    # Per-device HBM budget the cost model checks rung feasibility
+    # against (0 = unconstrained; infeasible rungs pay a spill penalty).
+    elastic_hbm_gb: float = 0.0
+    # Cross-replica weight-update sharding (arXiv:2004.13336): Adam
+    # moments shard dim 0 over ``dp``, gathered at the update — the
+    # shrink floor stops being optimizer-memory-bound.
+    elastic_opt_dp_shard: bool = False
+
     # Misc
     log_level: str = "INFO"
     extra: Dict[str, Any] = field(default_factory=dict)

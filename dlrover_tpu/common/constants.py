@@ -365,6 +365,12 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_BRAIN_REPORT_INTERVAL_S", "float", doc="brain stats report interval", context_field="brain_report_interval_s"),
     EnvKnob("DLROVER_HOST_MEMORY_MB", "float", doc="host RAM capacity hint for hyperparam strategies", context_field="host_memory_mb"),
     EnvKnob("DLROVER_INITIAL_BATCH_SIZE", "int", doc="starting per-host dataloader batch size", context_field="initial_batch_size"),
+    # -- elastic hybrid parallelism (docs/elastic_parallelism.md) ----------
+    EnvKnob("DLROVER_ELASTIC_REPLAN", "bool", doc="elastic: replan DP×TP×PP rungs on world change (off = accum-only)", context_field="elastic_replan"),
+    EnvKnob("DLROVER_ELASTIC_MAX_TP", "int", doc="elastic: max tensor-parallel extent the rung ladder may trade into", context_field="elastic_max_tp"),
+    EnvKnob("DLROVER_ELASTIC_MAX_PP", "int", doc="elastic: max pipeline depth the rung ladder may trade into", context_field="elastic_max_pp"),
+    EnvKnob("DLROVER_ELASTIC_HBM_GB", "float", doc="elastic: per-device HBM budget for rung feasibility (0 = unconstrained)", context_field="elastic_hbm_gb"),
+    EnvKnob("DLROVER_ELASTIC_OPT_DP_SHARD", "bool", doc="elastic: shard optimizer moments over dp, gathered at the update", context_field="elastic_opt_dp_shard"),
     # -- serving fleet (dlrover_tpu/fleet/, docs/serving_fleet.md) ---------
     EnvKnob("DLROVER_FLEET_REPLICAS", "int", doc="serving fleet: initial replica count"),
     EnvKnob("DLROVER_FLEET_MIN_REPLICAS", "int", doc="serving fleet: autoscaler lower bound"),

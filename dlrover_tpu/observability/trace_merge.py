@@ -45,7 +45,7 @@ DETECT_NAMES = {
     "membership_changed",
 }
 RDZV_NAMES = {"rendezvous", "rendezvous_complete"}
-RESHARD_NAMES = {"ckpt_load", "train_restore"}
+RESHARD_NAMES = {"ckpt_load", "train_restore", "live_reshard"}
 RESUME_NAMES = {"train_resume"}
 
 _PHASE_KEYS = ("detect_s", "rendezvous_s", "reshard_s", "recompile_s")
@@ -181,6 +181,46 @@ def phase_breakdown(
     return out
 
 
+def reshard_transitions(trace_events: List[Dict]) -> List[Dict]:
+    """Per-transition reshard attribution: pair begin/end events of
+    :data:`RESHARD_NAMES` spans by span_id and label each with the
+    from→to rung the emitter stamped into the begin content (the
+    elastic replanner's ``live_reshard`` spans carry
+    ``from_rung``/``to_rung``, e.g. ``dp4 → dp2·pp2``). Spans without
+    rung labels (a plain restore) are reported unlabeled, so the
+    breakdown still accounts for every reshard second."""
+    begins: Dict[str, Dict] = {}
+    out: List[Dict] = []
+    for e in trace_events:
+        if e.get("name") not in RESHARD_NAMES:
+            continue
+        sid = e.get("span_id", "")
+        if not sid:
+            continue
+        if e.get("type") == "begin":
+            begins[sid] = e
+        elif e.get("type") == "end" and sid in begins:
+            b = begins.pop(sid)
+            content = b.get("content", {}) or {}
+            end_content = e.get("content", {}) or {}
+            item = {
+                "name": e.get("name", ""),
+                "reshard_s": round(e["aligned_ts"] - b["aligned_ts"], 6),
+            }
+            for key in ("from_rung", "to_rung"):
+                val = content.get(key) or end_content.get(key)
+                if val:
+                    item[key] = val
+            if "from_rung" in item and "to_rung" in item:
+                item["transition"] = (
+                    f"{item['from_rung']} → {item['to_rung']}"
+                )
+            if "applied" in end_content:
+                item["applied"] = bool(end_content["applied"])
+            out.append(item)
+    return out
+
+
 def incidents(events: List[Dict]) -> List[Dict]:
     """Group aligned events by trace_id and break each into phases."""
     by_trace: Dict[str, List[Dict]] = {}
@@ -199,6 +239,9 @@ def incidents(events: List[Dict]) -> List[Dict]:
             "targets": sorted({e.get("target", "") for e in tev}),
         }
         info.update(phase_breakdown(tev, events))
+        transitions = reshard_transitions(tev)
+        if transitions:
+            info["reshard_transitions"] = transitions
         out.append(info)
     return out
 

@@ -168,6 +168,30 @@ def refold_stages(stage_params: Any, new_num_stages: int) -> Any:
     return jax.tree.map(refold, stage_params)
 
 
+def stage_param_avals(layer_params: Any, num_stages: int) -> Any:
+    """ShapeDtypeStructs for ONE stage's params at ``num_stages`` depth.
+
+    ``layer_params`` leaves are layer-stacked ``[total_layers, ...]``
+    (concrete arrays or avals); a stage at depth ``num_stages`` scans
+    ``total_layers / num_stages`` of them. This is what lets the
+    compile-ahead service lower per-STAGE programs for every pipeline
+    depth on the rung ladder without materializing any weights — a
+    pp-depth change then recompiles one stage program, not the world.
+    """
+
+    def aval(leaf):
+        total = leaf.shape[0]
+        if total % num_stages:
+            raise ValueError(
+                f"{total} layers not divisible into {num_stages} stages"
+            )
+        return jax.ShapeDtypeStruct(
+            (total // num_stages,) + tuple(leaf.shape[1:]), leaf.dtype
+        )
+
+    return jax.tree.map(aval, layer_params)
+
+
 def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
     """[B, ...] → [M, B/M, ...]."""
     B = x.shape[0]

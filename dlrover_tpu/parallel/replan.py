@@ -1,0 +1,392 @@
+"""Elastic hybrid-parallel replanning: the DP×TP×PP rung ladder.
+
+Elasticity used to be data-parallel-only: a world shrink kept the mesh
+shape and stacked gradient accumulation, so MFU degraded linearly down
+the ladder and optimizer-state memory dictated the shrink floor. This
+module picks the best *rung* — a (dp, tp, pp, accum) tuple — for a new
+device count from a cost model fed by the measured step time and
+per-rung memory estimates (ElasWave-style elastic-native hybrid
+replanning, arXiv:2510.00606): a shrink can trade DP for PP depth
+instead of stacking accum, and with optimizer state sharded over ``dp``
+(arXiv:2004.13336, ``state_shardings(shard_opt_over_dp=True)``) the
+memory floor moves with the rung instead of pinning it.
+
+The planner only *chooses*; execution is split across the existing
+rails:
+
+- the flash-checkpoint shm image is driven through ``RESHARD_RULES``
+  by :meth:`CheckpointEngine.load_resharded` (the same
+  ``respec_sharding`` engine the durable tier restores through);
+- :mod:`trainer.precompile` compiles the anticipated rungs ahead of
+  the fault, per-stage programs independently of the world;
+- :class:`trainer.loop.ElasticTrainLoop` applies the trade at a step
+  boundary inside a ``live_reshard`` span labeled ``from→to``.
+
+Cost model sketch (deliberately analytic — it must rank rungs, not
+predict wall clocks):
+
+- compute time scales with ``1/devices`` off the measured reference
+  step time;
+- pipelining multiplies by the GPipe bubble ``(M + pp - 1) / M`` for
+  ``M`` microbatches per accumulation slice;
+- accumulation multiplies by ``accum`` (the global batch is fixed);
+- a rung whose per-device bytes exceed the HBM budget is not discarded
+  — real runtimes spill/remat — but pays ``spill_penalty_x``, which is
+  what makes a dp→pp trade beat the accum-only rung when the latter is
+  memory-bound.
+"""
+
+import math
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..chaos import faults
+from ..common.log import logger
+from .mesh import MeshConfig
+
+
+@dataclass(frozen=True, order=True)
+class Rung:
+    """One point on the 2D world ladder: mesh extents + the schedule
+    knob (``accum``) that keeps the global batch fixed on it."""
+
+    dp: int
+    tp: int = 1
+    pp: int = 1
+    accum: int = 1
+
+    @property
+    def devices(self) -> int:
+        return self.dp * self.tp * self.pp
+
+    def label(self) -> str:
+        """Trace/bench label, mesh axes only (``dp4``, ``dp2·pp2``):
+        accum is a schedule knob, not a mesh axis, so it stays out of
+        the transition labels ``tpurun-trace`` attributes reshard_s
+        by."""
+        parts = [f"dp{self.dp}"]
+        if self.tp > 1:
+            parts.append(f"tp{self.tp}")
+        if self.pp > 1:
+            parts.append(f"pp{self.pp}")
+        return "·".join(parts)
+
+    def mesh_config(self) -> MeshConfig:
+        return MeshConfig(dp=self.dp, tp=self.tp, pp=self.pp)
+
+    def program_key(self) -> Tuple[int, int, int, int]:
+        """Two rungs with the same key compile the same program."""
+        return (self.dp, self.tp, self.pp, self.accum)
+
+
+def enumerate_rungs(
+    n_devices: int,
+    full_dp: int,
+    max_tp: int = 1,
+    max_pp: int = 1,
+    num_layers: int = 0,
+) -> List[Rung]:
+    """Every feasible (dp, tp, pp) factoring of ``n_devices``.
+
+    ``full_dp`` is the data extent at the full world — each rung's
+    ``accum = ceil(full_dp / dp)`` keeps the global batch fixed (the
+    same round-up rule as ``gradient_accumulation_steps``). ``tp``/
+    ``pp`` range over divisors up to their ICI-bound caps; when
+    ``num_layers`` is given, pp is additionally required to divide it
+    (``refold_stages`` needs whole layers per stage).
+    """
+    if n_devices <= 0:
+        return []
+    rungs: List[Rung] = []
+    for pp in range(1, min(max(1, max_pp), n_devices) + 1):
+        if n_devices % pp:
+            continue
+        if num_layers > 0 and num_layers % pp:
+            continue
+        rest = n_devices // pp
+        for tp in range(1, min(max(1, max_tp), rest) + 1):
+            if rest % tp:
+                continue
+            dp = rest // tp
+            accum = -(-full_dp // dp) if full_dp > dp else 1
+            rungs.append(Rung(dp=dp, tp=tp, pp=pp, accum=accum))
+    return rungs
+
+
+@dataclass(frozen=True)
+class CostModel:
+    """Analytic per-rung cost: memory feasibility + estimated step time.
+
+    ``step_time_s`` is the MEASURED step time at ``reference`` (fed by
+    the loop's step timer via :meth:`ElasticReplanner.observe_step_time`
+    — the model extrapolates from reality, it does not simulate).
+    Byte fields are totals for the whole model; ``act_bytes`` is the
+    activation footprint of one data replica at accum 1.
+    """
+
+    param_bytes: int
+    opt_bytes: int
+    act_bytes: int = 0
+    hbm_bytes_per_device: int = 0  # 0 = unconstrained
+    step_time_s: float = 1.0
+    reference: Rung = field(default_factory=lambda: Rung(dp=1))
+    microbatches: int = 8  # pipeline microbatches per accum slice
+    opt_dp_shard: bool = False  # optimizer moments sharded over dp
+    spill_penalty_x: float = 4.0  # slowdown for memory-infeasible rungs
+
+    def mem_bytes_per_device(self, rung: Rung) -> int:
+        """Model-state + activation bytes one device holds on ``rung``.
+
+        Params split over tp×pp; optimizer slots additionally split
+        over dp when cross-replica update sharding is on — that division
+        is exactly why the shrink floor stops being optimizer-bound.
+        Activations split over pp stages and shrink with accum (each
+        slice is 1/accum of the replica batch).
+        """
+        model_split = max(1, rung.tp * rung.pp)
+        opt_split = model_split * (rung.dp if self.opt_dp_shard else 1)
+        act_split = max(1, rung.pp * rung.accum)
+        return (
+            self.param_bytes // model_split
+            + self.opt_bytes // max(1, opt_split)
+            + self.act_bytes // act_split
+        )
+
+    def feasible(self, rung: Rung) -> bool:
+        if self.hbm_bytes_per_device <= 0:
+            return True
+        return self.mem_bytes_per_device(rung) <= self.hbm_bytes_per_device
+
+    def est_step_s(self, rung: Rung) -> float:
+        """Estimated optimizer-step wall time on ``rung``."""
+        ref = self.reference
+        base = self.step_time_s * (ref.devices / max(1, rung.devices))
+        # undo the reference rung's own bubble/accum so they are not
+        # double-counted when extrapolating to another rung
+        m = max(1, self.microbatches)
+        ref_sched = ref.accum * (m + ref.pp - 1) / m
+        sched = rung.accum * (m + rung.pp - 1) / m
+        est = base * sched / max(1e-9, ref_sched)
+        if not self.feasible(rung):
+            est *= self.spill_penalty_x
+        return est
+
+
+@dataclass(frozen=True)
+class RungPlan:
+    """One replanning verdict: the chosen rung, the accum-only rung it
+    is judged against, and the scored candidate list (for the bench and
+    the trace)."""
+
+    rung: Rung
+    current: Rung
+    n_devices: int
+    est_step_s: float
+    accum_rung: Rung
+    accum_est_step_s: float
+    candidates: Tuple[Tuple[Rung, float], ...] = ()
+
+    @property
+    def is_trade(self) -> bool:
+        """True when the chosen rung's mesh extents differ from the
+        accum-only baseline's — i.e. the planner traded an axis, it did
+        not just re-derive accum the way the 1D ladder would."""
+        return (self.rung.dp, self.rung.tp, self.rung.pp) != (
+            self.accum_rung.dp,
+            self.accum_rung.tp,
+            self.accum_rung.pp,
+        )
+
+    @property
+    def hybrid_vs_accum_goodput_x(self) -> float:
+        """Goodput of the chosen rung over the accum-only baseline at
+        the same device count (>1.0 = the trade wins)."""
+        return self.accum_est_step_s / max(1e-9, self.est_step_s)
+
+
+class ElasticReplanner:
+    """Holds the current rung and replans it on world change.
+
+    ``plan(n_devices)`` enumerates the ladder for the new device count
+    and returns the cheapest rung under the cost model, tie-broken
+    toward the fewest changed mesh axes (a smaller reshard).
+    ``observe_step_time`` feeds measured step times back into the model
+    (EMA) so later plans extrapolate from live data.
+    """
+
+    def __init__(
+        self,
+        cost_model: CostModel,
+        full_dp: int,
+        current: Rung,
+        max_tp: int = 1,
+        max_pp: int = 1,
+        num_layers: int = 0,
+    ):
+        self.cost_model = cost_model
+        self.full_dp = max(1, full_dp)
+        self.current = current
+        self.max_tp = max(1, max_tp)
+        self.max_pp = max(1, max_pp)
+        self.num_layers = num_layers
+
+    def observe_step_time(self, step_s: float, alpha: float = 0.3) -> None:
+        """EMA the measured step time into the model, re-anchored at
+        the current rung (the rung the measurement was taken on)."""
+        if step_s <= 0:
+            return
+        prev = self.cost_model.step_time_s
+        ref = self.cost_model.reference
+        blended = step_s if ref != self.current else (
+            (1 - alpha) * prev + alpha * step_s
+        )
+        self.cost_model = replace(
+            self.cost_model, step_time_s=blended, reference=self.current
+        )
+
+    # -- planning ----------------------------------------------------------
+
+    def _accum_only_rung(self, n_devices: int) -> Rung:
+        """The baseline the ladder is judged against: keep the current
+        tp/pp extents (falling back to 1×1 when they no longer divide)
+        and absorb the rest into dp + accum."""
+        tp, pp = self.current.tp, self.current.pp
+        if n_devices % max(1, tp * pp):
+            tp = pp = 1
+        dp = max(1, n_devices // (tp * pp))
+        accum = -(-self.full_dp // dp) if self.full_dp > dp else 1
+        return Rung(dp=dp, tp=tp, pp=pp, accum=accum)
+
+    def _score(self, rung: Rung) -> float:
+        return self.cost_model.est_step_s(rung)
+
+    def _changed_axes(self, rung: Rung) -> int:
+        cur = self.current
+        return sum(
+            1
+            for a, b in ((rung.dp, cur.dp), (rung.tp, cur.tp), (rung.pp, cur.pp))
+            if a != b
+        )
+
+    def _best(self, n_devices: int) -> Optional[RungPlan]:
+        rungs = enumerate_rungs(
+            n_devices,
+            self.full_dp,
+            max_tp=self.max_tp,
+            max_pp=self.max_pp,
+            num_layers=self.num_layers,
+        )
+        if not rungs:
+            return None
+        scored = sorted(
+            ((r, self._score(r)) for r in rungs),
+            key=lambda rs: (rs[1], self._changed_axes(rs[0]), rs[0]),
+        )
+        best, best_s = scored[0]
+        accum_rung = self._accum_only_rung(n_devices)
+        return RungPlan(
+            rung=best,
+            current=self.current,
+            n_devices=n_devices,
+            est_step_s=best_s,
+            accum_rung=accum_rung,
+            accum_est_step_s=self._score(accum_rung),
+            candidates=tuple(scored),
+        )
+
+    def plan(self, n_devices: int) -> RungPlan:
+        """Pick the best rung for ``n_devices``. Raises ValueError when
+        no rung fits (zero devices)."""
+        faults.inject(
+            "remesh.replan",
+            n_devices=n_devices,
+            current=self.current.label(),
+        )
+        plan = self._best(n_devices)
+        if plan is None:
+            raise ValueError(f"no rung fits {n_devices} devices")
+        logger.info(
+            "replan %s devices: %s → %s (accum %s, est %.4fs; "
+            "accum-only %s est %.4fs, hybrid_x %.3f)",
+            n_devices,
+            plan.current.label(),
+            plan.rung.label(),
+            plan.rung.accum,
+            plan.est_step_s,
+            plan.accum_rung.label(),
+            plan.accum_est_step_s,
+            plan.hybrid_vs_accum_goodput_x,
+        )
+        return plan
+
+    def adopt(self, rung: Rung) -> None:
+        self.current = rung
+
+    def anticipate(
+        self,
+        current_devices: int,
+        max_devices: Optional[int] = None,
+        unit_devices: int = 1,
+    ) -> List[Rung]:
+        """The rungs a re-mesh is likely to land on, most likely first —
+        the 2D generalization of ``precompile.anticipated_worlds``'s
+        accum ladder: ``current ± unit`` plus the shrink ladder, each
+        world contributing its PLANNED rung, deduped by program
+        signature (distinct (dp, tp, pp, accum) = distinct program).
+        """
+        if current_devices <= 0:
+            return []
+        max_devices = (
+            max_devices if max_devices and max_devices > 0 else current_devices
+        )
+        unit = max(1, unit_devices)
+        worlds: List[int] = []
+        for w in (current_devices - unit, current_devices + unit):
+            if unit <= w <= max_devices and w != current_devices:
+                worlds.append(w)
+        w = current_devices - unit
+        while w >= unit:
+            if w not in worlds:
+                worlds.append(w)
+            w -= unit
+        seen = {self.current.program_key()}
+        rungs: List[Rung] = []
+        for w in sorted(worlds, key=lambda w: (abs(w - current_devices), -w)):
+            plan = self._best(w)
+            if plan is None:
+                continue
+            key = plan.rung.program_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            rungs.append(plan.rung)
+        return rungs
+
+
+def default_replanner(
+    cost_model: CostModel,
+    full_dp: int,
+    current: Rung,
+    num_layers: int = 0,
+) -> Optional[ElasticReplanner]:
+    """Context-configured replanner (``DLROVER_ELASTIC_*`` knobs), or
+    None when live replanning is off (the default — accum-only
+    elasticity, the pre-rung behavior)."""
+    from ..common.config import get_context
+
+    ctx = get_context()
+    if not ctx.elastic_replan:
+        return None
+    if ctx.elastic_hbm_gb > 0 and cost_model.hbm_bytes_per_device <= 0:
+        cost_model = replace(
+            cost_model,
+            hbm_bytes_per_device=int(ctx.elastic_hbm_gb * (1 << 30)),
+        )
+    return ElasticReplanner(
+        cost_model,
+        full_dp=full_dp,
+        current=current,
+        max_tp=ctx.elastic_max_tp,
+        max_pp=ctx.elastic_max_pp,
+        num_layers=num_layers,
+    )

@@ -53,8 +53,10 @@ DEFAULT_RULES: LogicalRules = [
 # import.
 
 # The world ladder re-extents these mesh axes on a rung change; every
-# "respec"/"mirror_params" rule below must cover them.
-ELASTIC_AXES = ("dp", "fsdp")
+# sharded-policy rule below must cover them. Since the DP↔PP/TP
+# replanner (parallel/replan.py) landed, a rung change can move tp/pp
+# extents too, not just the data axes.
+ELASTIC_AXES = ("dp", "fsdp", "tp", "pp")
 
 RESHARD_POLICIES = (
     # replicate:     scalar/small leaves — restore replicated on any rung
@@ -62,11 +64,16 @@ RESHARD_POLICIES = (
     #                reshard the assembled global array via device_put
     # mirror_params: optimizer slots adopt the matching param leaf's rule
     #                (shape-matched; scalar counts replicate)
+    # mirror_dp:     mirror_params PLUS cross-replica weight-update
+    #                sharding (arXiv:2004.13336): moments additionally
+    #                shard dim 0 over ``dp``, gathered at the update by
+    #                GSPMD-inserted collectives
     # host_local:    per-host payloads (rng, data cursors, metadata) —
     #                never cross a reshard boundary
     "replicate",
     "respec",
     "mirror_params",
+    "mirror_dp",
     "host_local",
 )
 
@@ -74,7 +81,7 @@ RESHARD_RULES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # category: (policy, mesh axes the category's shardings may reference)
     "step": ("replicate", ()),
     "params": ("respec", ("dp", "fsdp", "ep", "tp", "sp", "pp")),
-    "opt_state": ("mirror_params", ("dp", "fsdp", "ep", "tp", "sp", "pp")),
+    "opt_state": ("mirror_dp", ("dp", "fsdp", "ep", "tp", "sp", "pp")),
     # the engine's ``extra=`` side-channel (dataloader cursors, torch
     # host trees): opaque host bytes, restored verbatim per host
     "extra": ("host_local", ()),
@@ -215,11 +222,14 @@ def respec_sharding(
     or None for ``host_local`` payloads (never cross a reshard — the
     caller keeps them on the host, per current rank).
 
-    ``mirror_params`` resolves like ``respec`` here: when the caller has
-    a template state its leaf shardings win anyway (the template already
-    shape-matched slots to params); templateless warm-pool restores fall
-    back to the slot's own saved spec, which the save-side mirroring
-    made identical to its param's.
+    ``mirror_params``/``mirror_dp`` resolve like ``respec`` here: when
+    the caller has a template state its leaf shardings win anyway (the
+    template already shape-matched slots to params); templateless
+    restores fall back to the slot's own saved spec, which the
+    save-side mirroring made identical to its param's (plus the ``dp``
+    dim-0 factor for ``mirror_dp`` — ``respec_spec`` keeps or drops it
+    by the target mesh's own extents, which is exactly the gather/
+    reshard the rung transition needs).
     """
     policy, _ = reshard_rule_for(category)
     if policy == "host_local":
@@ -228,6 +238,41 @@ def respec_sharding(
     if policy == "replicate":
         return NamedSharding(mesh, PartitionSpec())
     return NamedSharding(mesh, respec_spec(saved_spec, mesh, global_shape))
+
+
+def place_arrays_with_rules(
+    saved_specs: Dict[str, Any],
+    arrays: Dict[str, Any],
+    mesh: Mesh,
+) -> Dict[str, Any]:
+    """The shared reshard engine: place host arrays saved under one mesh
+    onto ``mesh`` by category rule + saved spec.
+
+    Used by both reshard-on-read paths — the durable tier's
+    templateless restore (``checkpoint/durable/restore.py``) and the
+    in-memory flash-image transition the elastic replanner drives
+    (``CheckpointEngine.load_resharded``). ``host_local`` leaves stay
+    host-side; everything else goes down in ONE batched ``device_put``
+    (per-leaf puts serialize transfers and wreck restore MTTR).
+    """
+    paths, host_arrs, shardings = [], [], []
+    placed: Dict[str, Any] = {}
+    for path, arr in arrays.items():
+        sharding = respec_sharding(
+            category_of_path(path),
+            saved_specs.get(path, []),
+            mesh,
+            getattr(arr, "shape", ()),
+        )
+        if sharding is None:  # host_local — stays on the host
+            placed[path] = arr
+            continue
+        paths.append(path)
+        host_arrs.append(arr)
+        shardings.append(sharding)
+    if paths:
+        placed.update(zip(paths, jax.device_put(host_arrs, shardings)))
+    return placed
 
 
 def sharded_generate_jit(

@@ -55,6 +55,23 @@ class ElasticContext:
             auto_tunning=env.get(NodeEnv.AUTO_TUNNING, "") == "1",
         )
 
+    def world_device_count(self) -> int:
+        """Global device count of the CURRENT world — the input the
+        elastic replanner's rung ladder is enumerated for. Prefers the
+        live backend's view; falls back to num_processes × local device
+        count when jax is not up yet (or its world is stale mid-remesh).
+        """
+        try:
+            import jax
+
+            n = jax.device_count()
+            if n > 0:
+                return n
+        except Exception as e:  # noqa: BLE001 — backend not initialized
+            logger.debug("jax device count unavailable (%s); using env", e)
+        local = int(os.environ.get("DLROVER_LOCAL_DEVICES", "0") or 0)
+        return max(1, self.num_processes * max(1, local))
+
     def initialize_jax(self) -> None:
         """Bring up the multi-host JAX runtime for this world.
 

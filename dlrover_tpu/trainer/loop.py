@@ -75,6 +75,8 @@ class ElasticTrainLoop:
         prefetch_input: Optional[bool] = None,
         input_stage_fn: Optional[Callable[[Tuple], Tuple]] = None,
         compile_ahead=None,
+        replanner=None,
+        on_replan: Optional[Callable] = None,
     ):
         self.engine = engine
         self.step_fn = step_fn
@@ -120,6 +122,20 @@ class ElasticTrainLoop:
         # compile for the CPU.
         self._compile_ahead = compile_ahead
         self._compile_svc = None
+        # Elastic hybrid replanning (parallel/replan.py,
+        # docs/elastic_parallelism.md): after an adopted soft re-mesh
+        # the replanner picks the best DP×TP×PP rung for the new device
+        # count and ``on_replan(plan, state)`` executes the trade —
+        # rebuild mesh + step_fn for the rung, drive the staged flash
+        # image through RESHARD_RULES (engine.load_resharded), and
+        # return ``(step_fn, state)``. None keeps the pre-rung
+        # accum-only behavior.
+        self._replanner = replanner
+        self._on_replan = on_replan
+        # measured step-time feed for the replanner's cost model,
+        # sampled at log cadence (no extra host syncs on the hot path)
+        self._last_log_t: Optional[float] = None
+        self._last_log_step = 0
         # MTTR phase attribution (attribution/recovery.py): wall time of
         # the phases this process owns, spooled to DLROVER_RECOVERY_DIR.
         self.last_restore_s = 0.0
@@ -297,6 +313,15 @@ class ElasticTrainLoop:
             )
             self._write_recovery_record()
 
+    def _anticipation_current(self) -> int:
+        """The "current world" the compile-ahead ladder pivots on:
+        process count on the 1D accum ladder, DEVICE count when the
+        replanner's 2D rung ladder drives anticipation (rungs factor
+        devices, not hosts)."""
+        if self._replanner is not None and self.ctx is not None:
+            return self.ctx.world_device_count()
+        return self.ctx.num_processes if self.ctx is not None else 1
+
     def _start_compile_ahead(self) -> None:
         ca = self._compile_ahead
         if ca is None:
@@ -326,15 +351,86 @@ class ElasticTrainLoop:
                     current,
                     int(os.environ.get(NodeEnv.MAX_NODES, "0") or 0),
                 )
+                if self._replanner is not None:
+                    # 2D ladder: scale the host-denominated knobs to
+                    # devices (the planner's unit).
+                    per_host = max(
+                        1, self._anticipation_current() // max(1, current)
+                    )
+                    current *= per_host
+                    node_unit *= per_host
+                    max_workers *= per_host
                 svc = CompileAheadService(
                     ca,
                     current_world=current,
                     max_workers=max_workers,
                     node_unit=node_unit,
+                    planner=self._replanner,
                 )
             self._compile_svc = svc.start()
         except Exception as e:  # noqa: BLE001 — an optimization only
             logger.warning("compile-ahead unavailable: %s", e)
+
+    def _apply_replan(self, state):
+        """Execute a DP↔PP/TP trade at the adopted-remesh boundary.
+
+        The replanner scores the rung ladder for the new device count;
+        when the winner changes mesh extents, ``on_replan(plan, state)``
+        performs the live transition — rebuild mesh/step program for
+        the rung (compile-ahead made this a cache read) and drive the
+        staged flash image through RESHARD_RULES via
+        ``engine.load_resharded`` — returning ``(step_fn, state)``.
+        Every failure path keeps the current program: accum-only
+        continuation is always correct, just slower.
+        """
+        try:
+            n = self._anticipation_current()
+            plan = self._replanner.plan(n)
+        except Exception as e:  # noqa: BLE001 — incl. injected faults
+            logger.warning("replan failed (%s); keeping current program", e)
+            return state
+        if plan.rung == self._replanner.current:
+            return state
+        if self._on_replan is None:
+            logger.info(
+                "replan chose %s but no on_replan executor; keeping "
+                "current program",
+                plan.rung.label(),
+            )
+            return state
+        with self._evt.duration(
+            "live_reshard",
+            from_rung=plan.current.label(),
+            to_rung=plan.rung.label(),
+            accum=plan.rung.accum,
+        ) as span:
+            try:
+                result = self._on_replan(plan, state)
+            except Exception as e:  # noqa: BLE001 — keep training
+                logger.warning(
+                    "live reshard %s → %s failed (%s); keeping current "
+                    "program",
+                    plan.current.label(),
+                    plan.rung.label(),
+                    e,
+                )
+                span.fail(repr(e))
+                return state
+            applied = result is not None
+            if applied:
+                new_step_fn, state = result
+                if new_step_fn is not None:
+                    self.step_fn = new_step_fn
+                self._replanner.adopt(plan.rung)
+            span.end(
+                {
+                    "applied": applied,
+                    "hybrid_vs_accum_goodput_x": round(
+                        plan.hybrid_vs_accum_goodput_x, 4
+                    ),
+                }
+            )
+        return state
 
     def _write_recovery_record(self) -> None:
         """Spool this boot's phase breakdown for the storm/bench
@@ -417,12 +513,16 @@ class ElasticTrainLoop:
                             "remesh handoff: could not stage step %s",
                             step - 1,
                         )
-                if self._remesh.apply() and self._compile_svc is not None:
-                    # The likely-next worlds shifted with the adopted
-                    # one: re-anticipate so the NEXT remesh is warm too.
-                    self._compile_svc.anticipate(
-                        self.ctx.num_processes if self.ctx else 1
-                    )
+                if self._remesh.apply():
+                    if self._replanner is not None:
+                        state = self._apply_replan(state)
+                    if self._compile_svc is not None:
+                        # The likely-next worlds shifted with the
+                        # adopted one: re-anticipate so the NEXT remesh
+                        # is warm too.
+                        self._compile_svc.anticipate(
+                            self._anticipation_current()
+                        )
             try:
                 batch = next(it)
             except StopIteration:
@@ -468,6 +568,21 @@ class ElasticTrainLoop:
                 # registry gauges at log cadence only — the hot path
                 # stays free of lock traffic between log points
                 get_registry().gauge("dlrover_trainer_last_step").set(step)
+                if self._replanner is not None:
+                    # the float(loss) above already synced, so the wall
+                    # clock here brackets fully-executed steps — feed
+                    # the measured per-step time into the cost model
+                    now = time.monotonic()
+                    if (
+                        self._last_log_t is not None
+                        and step > self._last_log_step
+                    ):
+                        self._replanner.observe_step_time(
+                            (now - self._last_log_t)
+                            / (step - self._last_log_step)
+                        )
+                    self._last_log_t = now
+                    self._last_log_step = step
             step += 1
         if step > start and not self._recovery_written:
             # one-step runs never saw a steady step: record without the

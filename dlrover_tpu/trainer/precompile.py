@@ -38,16 +38,32 @@ from .loop import gradient_accumulation_steps
 
 
 def anticipated_worlds(
-    current: int, max_workers: Optional[int] = None, node_unit: int = 1
-) -> List[int]:
-    """World sizes a re-mesh is likely to produce, most likely first.
+    current: int,
+    max_workers: Optional[int] = None,
+    node_unit: int = 1,
+    planner=None,
+) -> List[Any]:
+    """Worlds a re-mesh is likely to produce, most likely first.
 
     - ``current ± node_unit`` (a slice replaced/lost/added);
     - the shrink ladder: one world per distinct gradient-accumulation
       factor below ``current`` (distinct factor = distinct program).
+
+    With a ``planner`` (:class:`~dlrover_tpu.parallel.replan.
+    ElasticReplanner`), the ladder is 2D: ``current``/``max_workers``/
+    ``node_unit`` are DEVICE counts and the returned entries are the
+    :class:`~dlrover_tpu.parallel.replan.Rung` each anticipated world
+    would actually be replanned onto, deduped by program signature —
+    the accum-only int ladder under-reports distinct programs once a
+    shrink can trade DP for PP/TP, so compile-ahead stats would lie
+    about cache warmth for 2D worlds.
     """
     if current <= 0:
         return []
+    if planner is not None:
+        return planner.anticipate(
+            current, max_devices=max_workers, unit_devices=node_unit
+        )
     max_workers = max_workers if max_workers and max_workers > 0 else current
     unit = max(1, node_unit)
     worlds = set()
@@ -79,15 +95,17 @@ class CompileAheadService:
 
     def __init__(
         self,
-        build_fn: Callable[[int], Any],
+        build_fn: Callable[[Any], Any],
         current_world: int = 1,
         max_workers: Optional[int] = None,
         node_unit: int = 1,
-        worlds: Optional[List[int]] = None,
+        worlds: Optional[List[Any]] = None,
+        planner=None,
     ):
         self._build_fn = build_fn
         self._max_workers = max_workers
         self._node_unit = max(1, node_unit)
+        self._planner = planner
         self._pending: deque = deque()
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -95,13 +113,15 @@ class CompileAheadService:
         self._idle.set()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
-        self.compiled: Dict[int, float] = {}  # world -> compile seconds
-        self.errors: Dict[int, str] = {}
+        # world -> compile seconds; keys are int worlds on the 1D accum
+        # ladder, Rungs when a planner drives the 2D ladder
+        self.compiled: Dict[Any, float] = {}
+        self.errors: Dict[Any, str] = {}
         self.anticipate(current_world, worlds=worlds)
 
     def anticipate(
-        self, current_world: int, worlds: Optional[List[int]] = None
-    ) -> List[int]:
+        self, current_world: int, worlds: Optional[List[Any]] = None
+    ) -> List[Any]:
         """(Re-)derive the anticipation set around ``current_world`` —
         called at construction and again after an adopted re-mesh, when
         the likely next worlds shift with the new current."""
@@ -109,7 +129,10 @@ class CompileAheadService:
             list(worlds)
             if worlds is not None
             else anticipated_worlds(
-                current_world, self._max_workers, self._node_unit
+                current_world,
+                self._max_workers,
+                self._node_unit,
+                planner=self._planner,
             )
         )
         with self._lock:
@@ -216,12 +239,20 @@ def make_train_step_build_fn(
     """
     import jax
 
-    from ..parallel.train_step import build_train_step
+    from ..parallel.train_step import build_train_step, state_shardings
 
     def _scaled(x, scale: int):
         return jax.ShapeDtypeStruct(
             (x.shape[0] * scale,) + tuple(x.shape[1:]), x.dtype
         )
+
+    # A rung that changes mesh extents needs a fresh sharding tree, and
+    # deriving one re-runs model.init under eval_shape — which needs a
+    # CONCRETE example (it is closed over as a constant). Capture a
+    # one-row slice before the aval conversion below.
+    init_example = (
+        example_inputs[:1] if hasattr(example_inputs, "shape") else None
+    )
 
     # Lowering only needs avals: capture the state's shapes/dtypes, not
     # the concrete arrays — build_fn lives as long as the service, and a
@@ -236,14 +267,48 @@ def make_train_step_build_fn(
         state,
     )
 
-    def build(world: int):
-        accum = gradient_accumulation_steps(max_workers, world)
+    def _resolve(world):
+        """(mesh, sharding_tree, accum) for an int world or a Rung."""
+        if isinstance(world, int):
+            return mesh, sharding_tree, gradient_accumulation_steps(
+                max_workers, world
+            )
+        # 2D ladder entry (parallel/replan.py Rung): same extents as the
+        # live mesh → only the accum (scan length) differs, reuse
+        # everything; different extents → lower against a sub-mesh of
+        # the locally visible devices. A rung needing devices this
+        # process cannot see raises, and the service records the error —
+        # that world falls back to the cold compile, honestly.
+        from ..parallel.mesh import MeshConfig, build_mesh
+
+        same = (
+            world.devices == mesh.size
+            and world.tp == int(mesh.shape.get("tp", 1))
+            and world.pp == int(mesh.shape.get("pp", 1))
+        )
+        if same:
+            return mesh, sharding_tree, world.accum
+        devs = jax.devices()
+        if world.devices > len(devs):
+            raise RuntimeError(
+                f"rung {world.label()} needs {world.devices} devices; "
+                f"{len(devs)} visible"
+            )
+        m2 = build_mesh(
+            MeshConfig(dp=world.dp, tp=world.tp, pp=world.pp),
+            devices=devs[: world.devices],
+        )
+        _, tree2 = state_shardings(model, init_example, m2, tx)
+        return m2, tree2, world.accum
+
+    def build(world):
+        m, tree, accum = _resolve(world)
         step = build_train_step(
             model,
             tx,
             loss_fn,
-            mesh,
-            sharding_tree,
+            m,
+            tree,
             grad_accum_steps=accum,
             **build_kwargs,
         )
@@ -251,5 +316,38 @@ def make_train_step_build_fn(
             state, _scaled(example_inputs, accum), _scaled(example_targets, accum)
         )
         return lowered.compile()
+
+    return build
+
+
+def make_stage_build_fn(
+    stage_fn: Callable[[Any, Any], Any],
+    layer_params: Any,
+    example_microbatch: Any,
+) -> Callable[[Any], Any]:
+    """``build_fn`` compiling PER-STAGE pipeline programs for the rung
+    ladder: one stage of depth ``pp`` is the same program on every
+    stage rank (SPMD — ``pipeline_apply`` scans identical stages), so a
+    pp-depth change costs ONE stage compile, not a world recompile, and
+    stages of different rungs compile independently of dp/accum.
+
+    ``world`` may be a Rung (its ``pp`` is used) or a bare int pipeline
+    depth. ``layer_params`` is the layer-stacked ``[total_layers, ...]``
+    tree (concrete or avals); ``example_microbatch`` fixes the
+    activation aval. A depth that does not divide the layer count
+    raises, recorded per-world by the service.
+    """
+    import jax
+
+    from ..parallel.pipeline import stage_param_avals
+
+    mb_aval = jax.ShapeDtypeStruct(
+        tuple(example_microbatch.shape), example_microbatch.dtype
+    )
+
+    def build(world):
+        pp = world if isinstance(world, int) else world.pp
+        avals = stage_param_avals(layer_params, max(1, pp))
+        return jax.jit(stage_fn).lower(avals, mb_aval).compile()
 
     return build

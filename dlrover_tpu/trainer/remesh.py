@@ -67,6 +67,10 @@ class SoftRemesh:
         self._installed = False
         self._prev_handler = None
         self.applied = 0  # worlds adopted without a restart
+        # The last adopted world contract — the replan step
+        # (loop._apply_replan) reads the device count of the world it
+        # is planning for from here when the contract carries one.
+        self.last_world: Optional[Dict[str, Any]] = None
 
     @property
     def available(self) -> bool:
@@ -153,6 +157,7 @@ class SoftRemesh:
             )
             os.environ["DLROVER_COORDINATOR_ADDRESS"] = self._ctx.coordinator
             self.applied += 1
+            self.last_world = dict(world)
             logger.info(
                 "soft remesh: adopted round %s world (coordinator %s) "
                 "without restarting",

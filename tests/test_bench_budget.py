@@ -304,6 +304,14 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["pool_escalations"] = 0
     extra["pool_recovered_vs_baseline"] = 0.98
     extra["pool_window_s"] = 10.4
+    # elastic hybrid-parallelism section (docs/elastic_parallelism.md):
+    # the DP↔PP trade trio must survive in-line; the transition label
+    # and the rung's accum may shrink to the sidecar
+    extra["dp_pp_trade_mttr_s"] = 0.327
+    extra["reshard_s"] = 0.311
+    extra["hybrid_vs_accum_goodput_x"] = 1.7778
+    extra["elastic_transition"] = "dp8 -> dp2·pp2"
+    extra["elastic_rung_accum"] = 4
     bench._merge_committed_artifacts(extra)
     extra["probe_history"] = [
         {
@@ -414,6 +422,12 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     for key in (
         "pool_preempt_to_ready_s", "pool_spike_availability",
         "pool_train_goodput",
+    ):
+        assert slim[key] == extra[key], key
+    # the elastic DP↔PP trade trio rides the line (the transition label
+    # and the rung accum are sidecar-recoverable)
+    for key in (
+        "dp_pp_trade_mttr_s", "reshard_s", "hybrid_vs_accum_goodput_x",
     ):
         assert slim[key] == extra[key], key
     assert slim["attr_report"] == extra["attr_report"]

@@ -1191,6 +1191,27 @@ class TestReshardCoverageMachinery:
             v.code == "rung-gap:params:tp" for v in r.violations
         ), [v.render() for v in r.violations]
 
+    def test_rung_gap_on_pp_axis_flagged(self, tmp_path):
+        """The 2D rung ladder's axes (docs/elastic_parallelism.md):
+        ELASTIC_AXES carries pp, so a respec rule that only answers for
+        (dp, tp) cannot survive a dp→pp trade — the planner would pick
+        a rung the reshard table never covers."""
+        root = self._tree(
+            tmp_path,
+            'DEFAULT_RULES = [("batch", ("dp",))]\n'
+            'ELASTIC_AXES = ("dp", "tp", "pp")\n'
+            'RESHARD_POLICIES = ("replicate", "respec")\n'
+            'RESHARD_RULES = {"step": ("replicate", ()),'
+            ' "params": ("respec", ("dp", "tp"))}\n',
+            train_state_fields=("step", "params"),
+        )
+        r = self._lint(root)
+        codes = {v.code for v in r.violations}
+        assert "rung-gap:params:pp" in codes, [
+            v.render() for v in r.violations
+        ]
+        assert "rung-gap:params:tp" not in codes  # tp IS covered
+
     def test_missing_table_flagged(self, tmp_path):
         root = self._tree(tmp_path, "DEFAULT_RULES = []\n")
         r = self._lint(root)

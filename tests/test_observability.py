@@ -545,6 +545,69 @@ class TestTraceMerge:
         (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
         assert inc["mttd_s"] == 0.0  # the old kill is someone else's
 
+    def test_live_reshard_transition_attribution(self, tmp_path):
+        # the elastic replanner's live_reshard span carries the from→to
+        # rung; tpurun-trace labels the reshard leg with it
+        # (docs/elastic_parallelism.md)
+        tid = "abad1dea00000000"
+        begin = _evt(
+            "r1", 11.0, 1, "trainer", "live_reshard", etype="begin",
+            trace_id=tid, from_rung="dp4", to_rung="dp2·pp2",
+        )
+        end = _evt(
+            "r2", 13.5, 1, "trainer", "live_reshard", etype="end",
+            trace_id=tid, applied=True,
+        )
+        end["span_id"] = begin["span_id"]  # one span, two events
+        _write_jsonl(
+            tmp_path / "events_1_1.jsonl",
+            [
+                _evt("a", 10.0, 1, "agent", "incident_detected",
+                     trace_id=tid),
+                begin,
+                end,
+                _evt("b", 14.0, 1, "trainer", "train_resume",
+                     trace_id=tid),
+            ],
+        )
+        (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
+        (tr,) = inc["reshard_transitions"]
+        assert tr["name"] == "live_reshard"
+        assert tr["from_rung"] == "dp4" and tr["to_rung"] == "dp2·pp2"
+        assert tr["transition"] == "dp4 → dp2·pp2"
+        assert abs(tr["reshard_s"] - 2.5) < 1e-6
+        assert tr["applied"] is True
+
+    def test_plain_restore_span_reported_unlabeled(self, tmp_path):
+        # a restore with no rung labels still accounts its seconds —
+        # just without a transition label
+        tid = "face0ff000000000"
+        begin = _evt(
+            "r1", 11.0, 1, "trainer", "ckpt_load", etype="begin",
+            trace_id=tid,
+        )
+        end = _evt(
+            "r2", 12.0, 1, "trainer", "ckpt_load", etype="end",
+            trace_id=tid,
+        )
+        end["span_id"] = begin["span_id"]
+        _write_jsonl(
+            tmp_path / "events_1_1.jsonl",
+            [
+                _evt("a", 10.0, 1, "agent", "incident_detected",
+                     trace_id=tid),
+                begin,
+                end,
+                _evt("b", 13.0, 1, "trainer", "train_resume",
+                     trace_id=tid),
+            ],
+        )
+        (inc,) = trace_merge.summarize(str(tmp_path))["incidents"]
+        (tr,) = inc["reshard_transitions"]
+        assert tr["name"] == "ckpt_load"
+        assert abs(tr["reshard_s"] - 1.0) < 1e-6
+        assert "transition" not in tr and "from_rung" not in tr
+
     def test_cli_writes_chrome_trace(self, tmp_path, capsys):
         self._skewed_dir(tmp_path)
         assert trace_merge.main([str(tmp_path)]) == 0

@@ -306,6 +306,99 @@ class TestCompileAheadService:
         assert len(built) == len(set(built))
 
 
+class TestPlannerRungLadder:
+    """``anticipated_worlds``/``CompileAheadService`` driven by the 2D
+    replanner (docs/elastic_parallelism.md): entries are the Rungs each
+    anticipated world would actually be replanned onto, not bare ints —
+    the accum-only int ladder under-reports distinct programs once a
+    shrink can trade DP for PP."""
+
+    @staticmethod
+    def _planner():
+        from dlrover_tpu.parallel.replan import (
+            CostModel,
+            ElasticReplanner,
+            Rung,
+        )
+
+        return ElasticReplanner(
+            CostModel(
+                param_bytes=1 << 20,
+                opt_bytes=2 << 20,
+                hbm_bytes_per_device=1_200_000,
+                reference=Rung(dp=8),
+                opt_dp_shard=True,
+            ),
+            full_dp=8,
+            current=Rung(dp=8),
+            max_pp=2,
+        )
+
+    def test_planner_ladder_is_the_planned_rungs(self):
+        from dlrover_tpu.parallel.replan import Rung
+
+        rungs = anticipated_worlds(
+            8, max_workers=8, node_unit=4, planner=self._planner()
+        )
+        # one likely world (8 - 4 devices): under the HBM cap its PLAN
+        # is the dp→pp trade, so the anticipation set carries the 2D
+        # rung — the int ladder would have said "world 4" and the
+        # compile-ahead cache would be warm for the wrong program
+        assert rungs == [Rung(dp=2, pp=2, accum=4)]
+
+    def test_int_ladder_unchanged_without_planner(self):
+        assert anticipated_worlds(
+            4, max_workers=8, node_unit=1, planner=None
+        ) == anticipated_worlds(4, max_workers=8, node_unit=1)
+        assert anticipated_worlds(0, planner=None) == []
+
+    def test_service_compiles_rung_keys(self):
+        from dlrover_tpu.parallel.replan import Rung
+
+        built = []
+        svc = CompileAheadService(
+            lambda r: built.append(r),
+            current_world=8,
+            max_workers=8,
+            node_unit=4,
+            planner=self._planner(),
+        )
+        svc.start()
+        assert svc.wait(timeout=10)
+        svc.stop()
+        assert built == [Rung(dp=2, pp=2, accum=4)]
+        stats = svc.stats()
+        assert set(stats["compiled"]) == {Rung(dp=2, pp=2, accum=4)}
+        assert stats["errors"] == {}
+
+    def test_stage_build_fn_compiles_per_stage_programs(self):
+        from dlrover_tpu.parallel.replan import Rung
+        from dlrover_tpu.trainer.precompile import make_stage_build_fn
+
+        layers = {
+            "w": jax.ShapeDtypeStruct((4, 8, 8), jnp.float32),
+            "b": jax.ShapeDtypeStruct((4, 8), jnp.float32),
+        }
+
+        def stage_fn(params, x):
+            def body(h, layer):
+                return jnp.tanh(h @ layer["w"] + layer["b"]), None
+
+            out, _ = jax.lax.scan(body, x, params)
+            return out
+
+        build = make_stage_build_fn(
+            stage_fn, layers, np.zeros((2, 8), np.float32)
+        )
+        # a Rung's pp picks the stage depth; a bare int works too
+        compiled = build(Rung(dp=2, pp=2, accum=4))
+        assert compiled is not None
+        assert build(1) is not None
+        # depth that does not divide the layer count is a recorded error
+        with pytest.raises(ValueError):
+            build(3)
+
+
 class TestCompileCacheKnob:
     def test_enable_disable_and_idempotence(self, tmp_path, monkeypatch):
         import dlrover_tpu.common.compile_cache as cc

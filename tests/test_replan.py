@@ -1,0 +1,203 @@
+"""Elastic hybrid-parallelism replanner (``parallel/replan.py``): the
+DP×TP×PP rung ladder, the analytic cost model behind the DP↔PP trade,
+and the planner the loop + compile-ahead service drive on world change
+(docs/elastic_parallelism.md)."""
+
+import dataclasses
+
+import pytest
+
+from dlrover_tpu.chaos import faults
+from dlrover_tpu.parallel.replan import (
+    CostModel,
+    ElasticReplanner,
+    Rung,
+    default_replanner,
+    enumerate_rungs,
+)
+
+MiB = 1 << 20
+
+
+def _capped_planner(**overrides):
+    """Planner in the regime the ladder exists for: full world dp8, the
+    HBM cap sized so the accum-only shrink rung is memory-bound while
+    the dp→pp trade (params + dp-sharded moments split over pp) fits."""
+    kwargs = dict(
+        param_bytes=1 * MiB,
+        opt_bytes=2 * MiB,
+        hbm_bytes_per_device=1_200_000,
+        step_time_s=1.0,
+        reference=Rung(dp=8),
+        opt_dp_shard=True,
+    )
+    kwargs.update(overrides)
+    return ElasticReplanner(
+        CostModel(**kwargs), full_dp=8, current=Rung(dp=8), max_pp=2
+    )
+
+
+class TestRungLadder:
+    def test_accum_only_ladder_is_the_tp1_pp1_column(self):
+        # Default caps (max_tp=max_pp=1) reproduce the 1D ladder: one
+        # rung per world, accum by the same round-up rule as
+        # gradient_accumulation_steps.
+        assert enumerate_rungs(4, full_dp=8) == [Rung(dp=4, accum=2)]
+        assert enumerate_rungs(3, full_dp=8) == [Rung(dp=3, accum=3)]
+        assert enumerate_rungs(8, full_dp=8) == [Rung(dp=8, accum=1)]
+
+    def test_2d_enumeration_covers_the_factorings(self):
+        rungs = enumerate_rungs(4, full_dp=8, max_tp=2, max_pp=2)
+        assert Rung(dp=4, accum=2) in rungs
+        assert Rung(dp=2, tp=2, accum=4) in rungs
+        assert Rung(dp=2, pp=2, accum=4) in rungs
+        assert Rung(dp=1, tp=2, pp=2, accum=8) in rungs
+        assert all(r.devices == 4 for r in rungs)
+
+    def test_pp_must_divide_the_layer_count(self):
+        rungs = enumerate_rungs(8, full_dp=8, max_pp=8, num_layers=6)
+        assert {r.pp for r in rungs} == {1, 2}  # 4 and 8 do not divide 6
+
+    def test_labels_are_mesh_axes_only(self):
+        # accum stays out: tpurun-trace attributes reshard_s by these
+        assert Rung(dp=4, accum=2).label() == "dp4"
+        assert Rung(dp=2, pp=2, accum=4).label() == "dp2·pp2"
+        assert Rung(dp=1, tp=2, pp=2).label() == "dp1·tp2·pp2"
+
+    def test_mesh_config_and_program_key(self):
+        r = Rung(dp=2, pp=2, accum=4)
+        mc = r.mesh_config()
+        assert (mc.dp, mc.tp, mc.pp) == (2, 1, 2)
+        assert r.program_key() == (2, 1, 2, 4)
+
+
+class TestCostModel:
+    def test_opt_dp_shard_moves_the_memory_floor(self):
+        base = CostModel(param_bytes=1 * MiB, opt_bytes=2 * MiB)
+        rung = Rung(dp=4, accum=2)
+        unsharded = base.mem_bytes_per_device(rung)
+        sharded = dataclasses.replace(
+            base, opt_dp_shard=True
+        ).mem_bytes_per_device(rung)
+        assert unsharded == 3 * MiB
+        assert sharded == 1 * MiB + (2 * MiB) // 4  # moments /dp
+
+    def test_pipeline_pays_the_gpipe_bubble(self):
+        cm = CostModel(
+            param_bytes=MiB, opt_bytes=MiB, microbatches=8,
+            reference=Rung(dp=8),
+        )
+        # same device count: pp2 pays (M + pp - 1)/M over dp's accum
+        flat = cm.est_step_s(Rung(dp=4, accum=2))
+        piped = cm.est_step_s(Rung(dp=2, pp=2, accum=4))
+        assert piped == pytest.approx(flat * (4 / 2) * (9 / 8) / 1)
+
+    def test_infeasible_rung_pays_spill_not_exclusion(self):
+        cm = CostModel(
+            param_bytes=4 * MiB,
+            opt_bytes=0,
+            hbm_bytes_per_device=1,
+            spill_penalty_x=4.0,
+            reference=Rung(dp=8),
+        )
+        rung = Rung(dp=8)
+        assert not cm.feasible(rung)
+        free = dataclasses.replace(cm, hbm_bytes_per_device=0)
+        assert cm.est_step_s(rung) == pytest.approx(
+            4.0 * free.est_step_s(rung)
+        )
+
+
+class TestPlanner:
+    def test_shrink_trades_dp_for_pp_under_the_memory_cap(self):
+        plan = _capped_planner().plan(4)
+        assert plan.rung == Rung(dp=2, pp=2, accum=4)
+        assert plan.is_trade
+        assert plan.accum_rung == Rung(dp=4, accum=2)
+        assert plan.hybrid_vs_accum_goodput_x > 1.0
+
+    def test_unconstrained_shrink_keeps_the_accum_rung(self):
+        plan = _capped_planner(hbm_bytes_per_device=0).plan(4)
+        assert plan.rung == Rung(dp=4, accum=2)
+        assert not plan.is_trade
+        assert plan.hybrid_vs_accum_goodput_x == pytest.approx(1.0)
+
+    def test_plan_fires_the_injection_point_then_retries_clean(self):
+        planner = _capped_planner()
+        faults.activate(
+            faults.FaultPlan.parse(
+                "seed=7;remesh.replan:error:replan-blip@at=1"
+            )
+        )
+        try:
+            with pytest.raises(faults.FaultInjectedError):
+                planner.plan(4)
+            plan = planner.plan(4)  # the loop's catch-and-retry
+            assert plan.rung == Rung(dp=2, pp=2, accum=4)
+            assert [
+                r["point"] for r in faults.records()
+            ] == ["remesh.replan"]
+        finally:
+            faults.deactivate()
+
+    def test_zero_devices_raises(self):
+        with pytest.raises(ValueError):
+            _capped_planner().plan(0)
+
+    def test_observe_step_time_reanchors_at_the_current_rung(self):
+        planner = _capped_planner()
+        planner.adopt(Rung(dp=2, pp=2, accum=4))
+        planner.observe_step_time(9.0)  # first sample on a NEW rung
+        assert planner.cost_model.reference == Rung(dp=2, pp=2, accum=4)
+        assert planner.cost_model.step_time_s == pytest.approx(9.0)
+        planner.observe_step_time(11.0)  # same rung: EMA, not replace
+        assert 9.0 < planner.cost_model.step_time_s < 11.0
+        planner.observe_step_time(-1.0)  # garbage sample ignored
+        assert 9.0 < planner.cost_model.step_time_s < 11.0
+
+    def test_anticipate_plans_each_world_and_dedupes_programs(self):
+        planner = _capped_planner()
+        rungs = planner.anticipate(8, max_devices=8, unit_devices=4)
+        # one likely world (8 - 4 = 4); its PLAN is the pp trade, and
+        # the shrink-ladder revisit of the same world dedupes away
+        assert rungs == [Rung(dp=2, pp=2, accum=4)]
+        keys = [r.program_key() for r in rungs]
+        assert len(keys) == len(set(keys))
+        assert planner.current.program_key() not in keys
+
+    def test_anticipate_unit_ladder(self):
+        planner = ElasticReplanner(
+            CostModel(param_bytes=MiB, opt_bytes=MiB, reference=Rung(dp=8)),
+            full_dp=8,
+            current=Rung(dp=8),
+        )
+        rungs = planner.anticipate(8, max_devices=16, unit_devices=2)
+        # nearest worlds first (grow 10 before shrink 6 on the tie),
+        # then the shrink ladder (4, 2)
+        assert rungs[0] == Rung(dp=10, accum=1)
+        assert rungs[1] == Rung(dp=6, accum=2)
+        assert Rung(dp=4, accum=2) in rungs
+        assert Rung(dp=2, accum=4) in rungs
+
+
+class TestDefaultReplanner:
+    def test_gated_off_by_default(self):
+        cm = CostModel(param_bytes=MiB, opt_bytes=MiB)
+        assert default_replanner(cm, full_dp=8, current=Rung(dp=8)) is None
+
+    def test_context_knobs_configure_the_planner(self, monkeypatch):
+        from dlrover_tpu.common.config import get_context
+
+        ctx = get_context()
+        monkeypatch.setattr(ctx, "elastic_replan", True)
+        monkeypatch.setattr(ctx, "elastic_max_pp", 2)
+        monkeypatch.setattr(ctx, "elastic_hbm_gb", 1_200_000 / (1 << 30))
+        cm = CostModel(
+            param_bytes=MiB, opt_bytes=2 * MiB,
+            reference=Rung(dp=8), opt_dp_shard=True,
+        )
+        planner = default_replanner(cm, full_dp=8, current=Rung(dp=8))
+        assert planner is not None
+        assert planner.max_pp == 2
+        assert planner.cost_model.hbm_bytes_per_device == 1_200_000
+        assert planner.plan(4).rung == Rung(dp=2, pp=2, accum=4)

@@ -291,3 +291,34 @@ def test_prefill_handoff_drop_scenario(tmp_path):
     result = prefill_handoff_drop(str(tmp_path))
     assert result["fired"] >= 1, result
     assert result["recovered"], result
+
+
+def test_dp_pp_trade_storm_scenario(tmp_path):
+    """Fast synthetic twin of the DP↔PP trade drill
+    (docs/elastic_parallelism.md): an injected replan blip mid-shrink,
+    then the retry picks the dp2·pp2 rung over accum-only and the
+    staged flash image reshards onto the new mesh bit-exact."""
+    from dlrover_tpu.chaos.scenarios import dp_pp_trade_storm
+
+    result = dp_pp_trade_storm(str(tmp_path))
+    assert result["fired"] >= 1, result
+    assert result["recovered"], result
+    assert result["transition"] == "dp8 → dp2·pp2", result
+    assert result["hybrid_vs_accum_goodput_x"] > 1.0, result
+    assert result["retries"] >= 1, result
+
+
+@pytest.mark.slow
+def test_dp_pp_trade_storm_via_cli(tmp_path, capsys):
+    """The same drill the operator runs: ``tpurun-chaos run
+    dp_pp_trade_storm`` exits 0 only when the trade recovered."""
+    import json as _json
+
+    from dlrover_tpu.chaos.cli import main
+
+    assert main(
+        ["run", "dp_pp_trade_storm", "--workdir", str(tmp_path)]
+    ) == 0
+    result = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["recovered"] and result["fired"] >= 1, result
+    assert result["transition"] == "dp8 → dp2·pp2", result

@@ -421,6 +421,99 @@ class BrainDataStore:
             ).fetchone()
         return float(row[0] or 0.0)
 
+    # -- prometheus ingestion ----------------------------------------------
+
+    # scraped-gauge base name -> JobMetricSample field. Covers both the
+    # master-registry names (metrics_snapshot) and the agent-scrape
+    # names so either side of the plane round-trips.
+    GAUGE_FIELD_MAP = {
+        "dlrover_job_steps_per_second": "steps_per_second",
+        "dlrover_steps_per_second": "steps_per_second",
+        "dlrover_job_tokens_per_second": "tokens_per_second",
+        "dlrover_tokens_per_second": "tokens_per_second",
+        "dlrover_job_peak_memory_mb": "peak_memory_mb",
+        "dlrover_peak_memory_mb": "peak_memory_mb",
+        "dlrover_cpu_percent": "cpu_percent",
+        "dlrover_agent_world_size": "world_size",
+        "dlrover_world_size": "world_size",
+    }
+
+    # how labeled series of one family combine into one sample value:
+    # throughput sums across workers, memory takes the worst host,
+    # utilization averages, world size is a max (every series reports
+    # the same world; max tolerates a straggler's stale 0)
+    _FIELD_AGG = {
+        "steps_per_second": "sum",
+        "tokens_per_second": "sum",
+        "peak_memory_mb": "max",
+        "cpu_percent": "mean",
+        "world_size": "max",
+    }
+
+    def ingest_gauges(
+        self,
+        job_uuid: str,
+        gauges: Dict[str, float],
+        world_size: int = 0,
+        timestamp: float = 0.0,
+        field_map: Optional[Dict[str, str]] = None,
+    ) -> Optional[JobMetricSample]:
+        """Round-trip scraped metrics into one :class:`JobMetricSample`.
+
+        Accepts the flattened key format ``parse_prometheus``
+        (``agent/metric_collector.py``) emits: every sample keeps its
+        FULL exposition key (``name{labels}``) and each labeled family
+        additionally carries a bare-name alias holding its last
+        sample. Keys are grouped by base name (the part before
+        ``{``); when a family has labeled series, its bare alias is
+        IGNORED — counting both would double the last worker's
+        contribution. Per-field aggregation follows ``_FIELD_AGG``.
+
+        Returns the stored sample, or None when no key mapped to a
+        sample field (nothing is written).
+        """
+        fmap = field_map or self.GAUGE_FIELD_MAP
+        series: Dict[str, List[float]] = {}
+        has_labels: Dict[str, bool] = {}
+        for key, value in gauges.items():
+            base, brace, _ = key.partition("{")
+            if base not in fmap:
+                continue
+            labeled = brace == "{"
+            if labeled and not has_labels.get(base):
+                # first labeled series wins the family: drop any bare
+                # alias collected before it
+                series[base] = []
+                has_labels[base] = True
+            elif not labeled and has_labels.get(base):
+                continue  # bare alias of a labeled family
+            series.setdefault(base, []).append(float(value))
+        fields: Dict[str, float] = {}
+        for base, values in series.items():
+            if not values:
+                continue
+            name = fmap[base]
+            agg = self._FIELD_AGG.get(name, "max")
+            if agg == "sum":
+                fields[name] = sum(values)
+            elif agg == "mean":
+                fields[name] = sum(values) / len(values)
+            else:
+                fields[name] = max(values)
+        if not fields:
+            return None
+        sample = JobMetricSample(
+            job_uuid=job_uuid,
+            timestamp=timestamp or time.time(),
+            world_size=world_size or int(fields.get("world_size", 0)),
+            steps_per_second=fields.get("steps_per_second", 0.0),
+            tokens_per_second=fields.get("tokens_per_second", 0.0),
+            peak_memory_mb=fields.get("peak_memory_mb", 0.0),
+            cpu_percent=fields.get("cpu_percent", 0.0),
+        )
+        self.add_metric(sample)
+        return sample
+
     # -- events ------------------------------------------------------------
 
     def add_event(

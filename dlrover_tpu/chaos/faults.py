@@ -84,6 +84,8 @@ INJECTION_POINTS: Dict[str, str] = {
     "pool.revoke": "arbiter issuing a capacity revocation to a tenant",
     "pool.grant": "arbiter applying freed capacity to a tenant",
     "pool.tenant_report": "arbiter collecting one tenant's live signals",
+    "cluster.schedule": "cluster scheduler evaluating one N-tenant round",
+    "cluster.brain_target": "brain loop emitting a per-tenant target world",
 }
 
 _MODES = ("delay", "error", "wedge", "drop")

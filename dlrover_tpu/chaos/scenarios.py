@@ -1032,6 +1032,141 @@ def dp_pp_trade_storm(workdir: Optional[str] = None) -> Dict:
         faults.deactivate()
 
 
+# ---------------------------------------------------------------------------
+# priority_inversion_storm: the N-tenant cluster scheduler under
+# injected control-plane faults — a high-priority serving breach must
+# cascade into the LOWEST-priority trainer (never the protected one),
+# a dark scheduler round must skip cleanly (no wedge, no unowned
+# moves), and a chaos-killed brain-target emission must be survived by
+# the caller and land on retry. This is the fast scripted-tenant twin
+# of the full ``tpurun-cluster drill`` (cluster/drill.py — real
+# fleets, real train loops), which the slow e2e test runs.
+# ---------------------------------------------------------------------------
+
+
+def priority_inversion_storm(workdir: Optional[str] = None) -> Dict:
+    from ..cluster import (
+        ClusterConfig,
+        ClusterScheduler,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    class _Scripted:
+        """Instant-drain tenant: the cascade mechanics without fleets."""
+
+        def __init__(self, name, units, signals=None):
+            self.name = name
+            self.initial_units = units
+            self.signals = dict(signals or {})
+            self.revoked = []
+            self.granted = []
+
+        def report(self):
+            return dict(self.signals)
+
+        def grant(self, units):
+            self.granted.append(units)
+
+        def revoke(self, units, deadline_s, on_released):
+            self.revoked.append(units)
+            on_released(units)
+
+        def escalate(self, units):
+            return units
+
+    breach = {"ready": 1, "queue_mean": 9.0, "busy_total": 2,
+              "p95_worst_s": None}
+    calm = {"ready": 1, "queue_mean": 0.0, "busy_total": 0,
+            "p95_worst_s": None}
+    fleet_hi = _Scripted("fleet_hi", 1, calm)
+    train_hi = _Scripted("train_hi", 3)
+    fleet_lo = _Scripted("fleet_lo", 1, calm)
+    train_lo = _Scripted("train_lo", 3)
+    reg = TenantRegistry()
+    reg.register(
+        TenantSpec("fleet_hi", "serve", priority=0, floor=1, ceiling=4),
+        fleet_hi,
+    )
+    reg.register(
+        TenantSpec("train_hi", "train", priority=10, floor=1, ceiling=6),
+        train_hi,
+    )
+    reg.register(
+        TenantSpec("fleet_lo", "serve", priority=20, floor=1, ceiling=2),
+        fleet_lo,
+    )
+    reg.register(
+        TenantSpec("train_lo", "train", priority=30, floor=1, ceiling=6),
+        train_lo,
+    )
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_cluster_")
+    cfg = ClusterConfig(
+        total_units=8,
+        queue_high=2.0,
+        handback_evals=50,  # the storm judges the cascade, not handback
+        journal_path=os.path.join(workdir, "cluster_journal.jsonl"),
+    )
+    faults.activate(
+        faults.FaultPlan.parse(
+            "seed=7;cluster.schedule:error:dark@at=1;"
+            "cluster.brain_target:error:dropped@at=1"
+        )
+    )
+    try:
+        sched = ClusterScheduler(reg, cfg)
+        # round 1: the scheduler's control plane is dark while the
+        # high-priority fleet breaches — the round must skip without
+        # moving capacity it did not decide on
+        fleet_hi.signals = dict(breach)
+        v_dark = sched.step()
+        dark_ok = (
+            v_dark["action"] is None
+            and "schedule error" in v_dark["reason"]
+            and sched.allocations()["fleet_hi"] == 1
+        )
+        # round 2: the cascade — lowest-priority trainer pays first
+        sched.step()
+        fleet_hi.signals = dict(calm)
+        # the brain's first target emission dies injected; the caller
+        # owns the retry (BrainFeedback journals and re-emits)
+        brain_survived = False
+        try:
+            sched.set_target("train_hi", 4)
+        except faults.FaultInjectedError:
+            brain_survived = True
+        sched.set_target("train_hi", 4)
+        for _ in range(2):
+            if sched.allocations()["train_hi"] >= 4:
+                break
+            sched.step()
+        alloc = sched.allocations()
+        cascade = [
+            e["tenant"] for e in sched.journal() if e["event"] == "revoke"
+        ]
+        fired = _fired(("cluster.schedule", "cluster.brain_target"))
+        return {
+            "scenario": "priority_inversion_storm",
+            "fired": fired,
+            "recovered": dark_ok
+            and brain_survived
+            and bool(cascade)
+            and cascade[0] == "train_lo"
+            and all(t == "train_lo" for t in cascade)
+            and alloc
+            == {"fleet_hi": 2, "train_hi": 4, "fleet_lo": 1, "train_lo": 1}
+            and sched.escalations == 0
+            and sched.adoptions >= 1
+            and fired >= 2,
+            "cascade": cascade,
+            "allocations": alloc,
+            "adopt_s": sched.last_adopt_s,
+            "journal_tail": sched.journal(6),
+        }
+    finally:
+        faults.deactivate()
+
+
 SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "flaky_rpc": flaky_rpc,
     "rdzv_retry": rdzv_retry,
@@ -1047,6 +1182,7 @@ SCENARIOS: Dict[str, Callable[[Optional[str]], Dict]] = {
     "slice_kill": slice_kill,
     "master_kill": master_kill,
     "dp_pp_trade_storm": dp_pp_trade_storm,
+    "priority_inversion_storm": priority_inversion_storm,
 }
 
 

@@ -1,0 +1,38 @@
+"""Multi-tenant cluster scheduler: N prioritized tenants on one pool.
+
+Generalizes the two-tenant ``pool/`` arbiter (PR 8) to N tenants with
+priority classes, per-tenant floors/ceilings, gang-scheduled leases on
+the node_unit grid, preemption cascades (a high-priority breach
+revokes from the lowest-priority tenant above floor first), and a
+closed brain loop that turns the PR 12 metrics plane into per-tenant
+target worlds (``brain_loop.BrainFeedback`` — the live caller of
+``brain/algorithms.py::ClusterResourceArbiter.allocate``).
+
+Layout (docs/cluster.md):
+
+- :mod:`~dlrover_tpu.cluster.config` — ``ClusterConfig`` and the
+  ``DLROVER_CLUSTER_*`` knob surface
+- :mod:`~dlrover_tpu.cluster.registry` — ``TenantSpec`` priority
+  classes and the ``TenantRegistry`` over pool tenant adapters
+- :mod:`~dlrover_tpu.cluster.scheduler` — pure ``schedule()`` policy
+  + the ``ClusterScheduler`` ledger/lease executor
+- :mod:`~dlrover_tpu.cluster.brain_loop` — ``BrainFeedback`` metrics
+  ingestion and target emission
+- :mod:`~dlrover_tpu.cluster.drill` / :mod:`~dlrover_tpu.cluster.cli`
+  — the 4-tenant priority-inversion drill and ``tpurun-cluster``
+"""
+
+from .brain_loop import BrainFeedback
+from .config import ClusterConfig
+from .registry import TenantRegistry, TenantSpec, parse_priority_classes
+from .scheduler import ClusterScheduler, schedule
+
+__all__ = [
+    "BrainFeedback",
+    "ClusterConfig",
+    "ClusterScheduler",
+    "TenantRegistry",
+    "TenantSpec",
+    "parse_priority_classes",
+    "schedule",
+]

@@ -1,0 +1,269 @@
+"""BrainFeedback: close the loop from live metrics to scheduler demand.
+
+The paper's Brain layer sits between the metrics plane and the
+scheduler: observations flow *in* (master ``metrics_snapshot()``,
+per-fleet ``fleet_signals()``, per-trainer controller reports → the
+``brain/datastore.py`` job-profile store) and **per-tenant target
+worlds** flow *out* (``ClusterResourceArbiter.allocate`` splits the
+training share of the pool by marginal throughput gain;
+``JobRunningResourceAlgorithm.optimize`` refines each job against its
+scaling knee). The emitted targets land in
+``ClusterScheduler.set_target`` — the scheduler treats them as demand,
+replacing static knob targets (docs/cluster.md).
+
+This module is the live caller ``brain/algorithms.py`` was missing:
+before it, ``ClusterResourceArbiter.allocate()`` was a stub nothing
+exercised.
+"""
+
+import json
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from ..brain.algorithms import (
+    ClusterResourceArbiter,
+    JobRunningResourceAlgorithm,
+)
+from ..brain.datastore import BrainDataStore, JobMetricSample, JobRecord
+from ..common.log import logger
+from .registry import SERVE
+
+__all__ = ["BrainFeedback"]
+
+
+class BrainFeedback:
+    """Metrics in, targets out, on a fixed cadence (or manually via
+    ``poll_once()`` / ``evaluate_once()`` for tests and drills)."""
+
+    def __init__(
+        self,
+        scheduler,
+        store: Optional[BrainDataStore] = None,
+        master: Any = None,
+        master_job: str = "",
+        min_samples: int = 0,
+        eval_interval_s: float = 0.0,
+    ):
+        self.scheduler = scheduler
+        self.store = store or BrainDataStore(":memory:")
+        self.master = master
+        # tenant name whose job profile the master's snapshot feeds
+        # (the master aggregates exactly one training job)
+        self.master_job = master_job
+        cfg = scheduler.cfg
+        self.min_samples = min_samples or cfg.brain_min_samples
+        self.eval_interval_s = eval_interval_s or cfg.brain_eval_s
+        self._trainers: Dict[str, Any] = {}
+        self._fleets: Dict[str, Callable[[], Dict]] = {}
+        self.polls = 0
+        self.evaluations = 0
+        self.emissions = 0
+        self.target_errors = 0
+        self.last_targets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- source registration ---------------------------------------------
+
+    def add_training_job(
+        self,
+        tenant: str,
+        controller: Any,
+        model_signature: str = "elastic-train",
+    ) -> None:
+        """Track one training tenant: its controller's ``report()``
+        feeds the job's scaling curve sample by sample."""
+        spec = self.scheduler.registry.spec(tenant)
+        self._trainers[tenant] = controller
+        self.store.upsert_job(
+            JobRecord(
+                job_uuid=tenant,
+                job_name=tenant,
+                model_signature=model_signature,
+                worker_num=self.scheduler.allocations().get(tenant, 0),
+                node_unit=spec.node_unit,
+            )
+        )
+
+    def add_fleet(
+        self, tenant: str, signals_fn: Callable[[], Dict]
+    ) -> None:
+        """Track one serving tenant's ``fleet_signals()`` source; the
+        signal history lands in the datastore's event stream."""
+        self._fleets[tenant] = signals_fn
+
+    # -- ingestion (metrics plane -> datastore) ---------------------------
+
+    def poll_once(self) -> int:
+        """One ingestion round; returns the number of samples stored."""
+        self.polls += 1
+        stored = 0
+        held = self.scheduler.allocations()
+        for tenant, controller in self._trainers.items():
+            try:
+                rep = controller.report() or {}
+            except Exception as e:  # noqa: BLE001 — one dark trainer
+                logger.warning(
+                    "brain: %s report failed: %r", tenant, e
+                )
+                continue
+            world = int(rep.get("world") or held.get(tenant, 0))
+            sps = float(rep.get("steps_per_s") or 0.0)
+            if world <= 0 or sps <= 0:
+                continue  # no signal yet; don't poison the curve
+            self.store.add_metric(
+                JobMetricSample(
+                    job_uuid=tenant,
+                    world_size=world,
+                    steps_per_second=sps,
+                )
+            )
+            stored += 1
+        if self.master is not None and self.master_job:
+            try:
+                gauges = self.master.metrics_snapshot()
+            except Exception as e:  # noqa: BLE001 — master dark
+                logger.warning("brain: master snapshot failed: %r", e)
+                gauges = {}
+            if gauges:
+                sample = self.store.ingest_gauges(
+                    self.master_job,
+                    gauges,
+                    world_size=held.get(self.master_job, 0),
+                )
+                if sample is not None:
+                    stored += 1
+        for tenant, signals_fn in self._fleets.items():
+            try:
+                sig = signals_fn() or {}
+            except Exception as e:  # noqa: BLE001 — one dark fleet
+                logger.warning(
+                    "brain: %s signals failed: %r", tenant, e
+                )
+                continue
+            self.store.add_event(
+                tenant, "fleet_signals", detail=json.dumps(sig)
+            )
+        return stored
+
+    # -- evaluation (datastore -> per-tenant targets) ---------------------
+
+    def _train_budget(self) -> int:
+        """Units the training tenants may split: the pool minus what
+        serving currently holds (serving keeps what the SLO policy
+        gave it; brain arbitrates the rest)."""
+        held = self.scheduler.allocations()
+        serve_held = sum(
+            held.get(s.name, 0)
+            for s in self.scheduler.registry.specs()
+            if s.kind == SERVE
+        )
+        return self.scheduler.cfg.total_units - serve_held
+
+    def _sampled_jobs(self) -> Dict[str, int]:
+        """Training tenants with enough metric history to trust,
+        mapped to their current holdings."""
+        held = self.scheduler.allocations()
+        out = {}
+        for tenant in self._trainers:
+            if (
+                len(
+                    self.store.job_metrics(
+                        tenant, limit=self.min_samples
+                    )
+                )
+                >= self.min_samples
+            ):
+                out[tenant] = held.get(tenant, 0)
+        return out
+
+    def evaluate_once(self) -> Dict[str, int]:
+        """One optimization round: split the training budget across
+        sampled jobs (``ClusterResourceArbiter.allocate`` — marginal
+        gain per host), refine each share against the job's own
+        scaling knee, and emit the targets as scheduler demand."""
+        self.evaluations += 1
+        jobs = self._sampled_jobs()
+        if not jobs:
+            return {}
+        registry = self.scheduler.registry
+        budget = self._train_budget()
+        grid = min(
+            registry.spec(t).node_unit for t in jobs
+        )
+        arbiter = ClusterResourceArbiter(self.store)
+        allocation = arbiter.allocate(
+            sorted(jobs), total_hosts=budget, node_unit=grid
+        )
+        running = JobRunningResourceAlgorithm(self.store)
+        targets: Dict[str, int] = {}
+        for tenant, current in jobs.items():
+            share = allocation.get(tenant, 0)
+            cap = share or registry.ceiling(
+                tenant, self.scheduler.cfg.total_units
+            )
+            plan = running.optimize(
+                tenant,
+                current_workers=current,
+                node_unit=registry.spec(tenant).node_unit,
+                max_workers=cap,
+            )
+            # the knee refines the arbiter's split downward; with no
+            # usable knee the split itself is the target
+            target = plan.worker_num if plan.worker_num > 0 else share
+            if target <= 0:
+                continue
+            targets[tenant] = target
+        for tenant, target in targets.items():
+            try:
+                self.scheduler.set_target(tenant, target, source="brain")
+                self.emissions += 1
+            except Exception as e:  # noqa: BLE001 — chaos-injected or
+                # racing tenant teardown: journal and keep the loop
+                self.target_errors += 1
+                logger.warning(
+                    "brain: target emission for %s failed: %r",
+                    tenant,
+                    e,
+                )
+                self.store.add_event(
+                    tenant, "brain_target_error", detail=repr(e)[:200]
+                )
+        self.last_targets = targets
+        return targets
+
+    # -- periodic driver -------------------------------------------------
+
+    def start(self) -> "BrainFeedback":
+        """Poll + evaluate at ``brain_eval_s`` (0 = manual only)."""
+        if self.eval_interval_s <= 0:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="brain-feedback", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+                self.evaluate_once()
+            except Exception as e:  # noqa: BLE001 — loop survives
+                logger.exception("brain feedback error: %s", e)
+            self._stop.wait(self.eval_interval_s)
+
+    def status(self) -> Dict:
+        return {
+            "polls": self.polls,
+            "evaluations": self.evaluations,
+            "emissions": self.emissions,
+            "target_errors": self.target_errors,
+            "last_targets": dict(self.last_targets),
+            "min_samples": self.min_samples,
+        }

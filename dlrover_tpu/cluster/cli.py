@@ -1,0 +1,217 @@
+"""``tpurun-cluster`` — run the multi-tenant cluster scheduler.
+
+Two subcommands:
+
+- ``tpurun-cluster drill`` runs the scripted 4-tenant priority-
+  inversion drill (cluster/drill.py — the same code path behind the
+  docs/cluster.md numbers and the bench ``cluster`` section) and
+  prints the measured verdict JSON; exit 0 only when the drill passed.
+- ``tpurun-cluster serve`` runs a scheduler over tenants declared in
+  ``DLROVER_CLUSTER_TENANTS`` (serve tenants get a subprocess fleet
+  each; train tenants attach later through the registry), with the
+  scheduler's status endpoint on ``--port`` (``/cluster/status``,
+  ``/cluster/journal``, ``/healthz`` read state; POST
+  ``/cluster/step`` forces one evaluation and POST
+  ``/cluster/target`` feeds an explicit per-tenant target world —
+  same JSON conventions as ``/pool/status``).
+"""
+
+import argparse
+import json
+import signal
+import threading
+from http.server import ThreadingHTTPServer
+from typing import List, Optional
+
+from ..common.log import logger
+from .config import ClusterConfig
+from .registry import SERVE, TenantRegistry
+from .scheduler import ClusterScheduler
+
+__all__ = ["main", "serve_status"]
+
+
+def _make_handler(scheduler: ClusterScheduler):
+    from ..common.http import JsonRequestHandler
+
+    class Handler(JsonRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("cluster: " + fmt, *args)
+
+        def do_GET(self):
+            if self.path in ("/cluster/status", "/healthz"):
+                self._send(200, scheduler.status())
+            elif self.path == "/cluster/journal":
+                self._send(200, {"journal": scheduler.journal()})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path == "/cluster/step":
+                # manual evaluation (eval_interval_s=0 deployments)
+                self._send(200, scheduler.step())
+            elif self.path == "/cluster/target":
+                try:
+                    body = self._body()
+                    scheduler.set_target(
+                        body["tenant"],
+                        int(body["units"]),
+                        source=body.get("source", "operator"),
+                    )
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send(400, {"error": repr(e)[:200]})
+                    return
+                self._send(200, {"targets": scheduler.targets()})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+
+    return Handler
+
+
+def serve_status(
+    scheduler: ClusterScheduler, port: int = 0
+) -> ThreadingHTTPServer:
+    """Bind the scheduler's status endpoint (caller runs serve_forever
+    or wraps it in a daemon thread)."""
+    return ThreadingHTTPServer(
+        ("0.0.0.0", port), _make_handler(scheduler)
+    )
+
+
+def _cmd_drill(ns) -> int:
+    from .drill import run_priority_inversion_drill
+
+    result = run_priority_inversion_drill(
+        workdir=ns.workdir, timeout_s=ns.timeout
+    )
+    print(json.dumps(result, indent=1))
+    return 0 if result.get("ok") else 1
+
+
+def _cmd_serve(ns, overrides) -> int:
+    from ..fleet.config import FleetConfig
+    from ..fleet.replica import SubprocessReplica
+    from ..fleet.supervisor import ReplicaSupervisor
+    from ..pool.tenants import ServingTenant
+
+    cfg = ClusterConfig.from_env(**overrides)
+    registry = TenantRegistry.from_config(cfg)
+    if not len(registry):
+        logger.error(
+            "tpurun-cluster serve: no tenants declared — set "
+            "DLROVER_CLUSTER_TENANTS (name:kind:priority[:floor"
+            "[:ceiling[:node_unit]]];...)"
+        )
+        return 2
+
+    serve_args = list(ns.serve_args)
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    if ns.cpu and "--cpu" not in serve_args:
+        serve_args.append("--cpu")
+
+    supervisors = []
+    for spec in registry.specs():
+        if spec.kind != SERVE:
+            # train tenants attach through the embedding job's
+            # controller (MasterTrainingController beside its master);
+            # the CLI can only materialize fleets
+            continue
+        base = FleetConfig.from_env()
+        ceiling = registry.ceiling(spec.name, cfg.total_units)
+        fleet_cfg = FleetConfig.from_env(
+            replicas=max(1, spec.floor),
+            max_replicas=max(base.max_replicas, ceiling),
+        )
+
+        def factory(rid: int, port: int) -> SubprocessReplica:
+            return SubprocessReplica(rid, port, serve_args=serve_args)
+
+        sup = ReplicaSupervisor(factory, fleet_cfg)
+        supervisors.append(sup)
+        registry.attach(spec.name, ServingTenant(sup, name=spec.name))
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    for sup in supervisors:
+        sup.start()
+    scheduler = ClusterScheduler(registry, cfg).start()
+    httpd = serve_status(scheduler, ns.port)
+    logger.info(
+        "tpurun-cluster: %s units across %s tenants, status on :%s",
+        cfg.total_units,
+        len(registry),
+        httpd.server_address[1],
+    )
+    status_thread = threading.Thread(
+        target=httpd.serve_forever, name="cluster-status", daemon=True
+    )
+    status_thread.start()
+    try:
+        threading.Event().wait()  # scheduler + fleets run on threads
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        scheduler.stop()
+        for sup in supervisors:
+            sup.stop()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ..analysis.witness import maybe_install
+
+    maybe_install()  # DLROVER_LOCK_WITNESS=1 -> sanitize lock order
+    ap = argparse.ArgumentParser(
+        prog="tpurun-cluster",
+        description="multi-tenant cluster scheduler: N prioritized "
+        "tenants (training jobs + serving fleets) on one chip pool, "
+        "brain-driven targets closed-loop",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser(
+        "drill", help="run the 4-tenant priority-inversion drill"
+    )
+    d.add_argument("--workdir", default=None)
+    d.add_argument("--timeout", type=float, default=240.0)
+
+    s = sub.add_parser(
+        "serve", help="tenant fleets + scheduler + status endpoint"
+    )
+    s.add_argument("--port", type=int, default=8600,
+                   help="scheduler status endpoint port")
+    s.add_argument("--units", type=int, default=None,
+                   help="pool inventory (DLROVER_CLUSTER_TOTAL_UNITS)")
+    s.add_argument("--tenants", default=None,
+                   help="tenant declarations (DLROVER_CLUSTER_TENANTS)")
+    s.add_argument("--eval-interval", type=float, default=None,
+                   help="scheduler period "
+                   "(DLROVER_CLUSTER_EVAL_INTERVAL_S)")
+    s.add_argument("--cpu", action="store_true",
+                   help="forward --cpu to every replica (local smoke)")
+    s.add_argument(
+        "serve_args", nargs=argparse.REMAINDER,
+        help="args after -- are forwarded to every tpurun-serve replica",
+    )
+
+    ns = ap.parse_args(argv)
+    if ns.cmd == "drill":
+        return _cmd_drill(ns)
+    overrides = {}
+    if ns.units is not None:
+        overrides["total_units"] = ns.units
+    if ns.tenants is not None:
+        overrides["tenants"] = ns.tenants
+    if ns.eval_interval is not None:
+        overrides["eval_interval_s"] = ns.eval_interval
+    return _cmd_serve(ns, overrides)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

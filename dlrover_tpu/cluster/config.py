@@ -1,0 +1,101 @@
+"""Cluster configuration: the ``DLROVER_CLUSTER_*`` operator surface.
+
+One typed dataclass consumed by the scheduler, the tenant registry,
+the brain loop, the ``tpurun-cluster`` CLI, and the drill. Every field
+is overridable through a registered env knob (``common/constants.py
+ENV_KNOBS`` — the ``tpurun-lint`` env-knobs pass enforces registered ⇔
+documented ⇔ referenced), mirroring the pool's ``DLROVER_POOL_*``
+contract (docs/cluster.md knob table).
+"""
+
+from dataclasses import dataclass, fields
+
+from ..common.constants import ENV_KNOBS
+
+# field name -> env knob. Declared next to the dataclass so a new
+# field and its knob land in the same diff (the lint staleness check
+# fails on either half missing).
+_CLUSTER_KNOBS = {
+    "total_units": "DLROVER_CLUSTER_TOTAL_UNITS",
+    "tenants": "DLROVER_CLUSTER_TENANTS",
+    "priority_classes": "DLROVER_CLUSTER_PRIORITY_CLASSES",
+    "eval_interval_s": "DLROVER_CLUSTER_EVAL_INTERVAL_S",
+    "revoke_deadline_s": "DLROVER_CLUSTER_REVOKE_DEADLINE_S",
+    "handback_evals": "DLROVER_CLUSTER_HANDBACK_EVALS",
+    "spike_units": "DLROVER_CLUSTER_SPIKE_UNITS",
+    "queue_high": "DLROVER_CLUSTER_QUEUE_HIGH",
+    "p95_target_s": "DLROVER_CLUSTER_P95_TARGET_S",
+    "brain_eval_s": "DLROVER_CLUSTER_BRAIN_EVAL_S",
+    "brain_min_samples": "DLROVER_CLUSTER_BRAIN_MIN_SAMPLES",
+    "journal_path": "DLROVER_CLUSTER_JOURNAL",
+    "status_timeout_s": "DLROVER_CLUSTER_STATUS_TIMEOUT_S",
+}
+
+
+@dataclass
+class ClusterConfig:
+    """Knobs for one N-tenant cluster scheduler (docs/cluster.md)."""
+
+    # inventory: device-capacity units (1 unit = 1 serving replica =
+    # 1 training worker-host at node_unit granularity)
+    total_units: int = 8
+
+    # declarative tenant roster for the CLI/serve shape, parsed by
+    # ``registry.TenantRegistry.parse`` — semicolon-separated
+    # ``name:kind:priority[:floor[:ceiling[:node_unit]]]`` entries,
+    # e.g. ``api:serve:critical:1;batch:train:preemptible:1:0:2``.
+    # Priority accepts a class name from ``priority_classes`` or a
+    # bare integer rank. Empty = tenants registered programmatically.
+    tenants: str = ""
+
+    # priority-class table: ``name=rank`` pairs, lower rank = more
+    # important (revoked last, granted first)
+    priority_classes: str = "critical=0,high=10,standard=20,preemptible=30"
+
+    # policy loop
+    eval_interval_s: float = 0.0  # 0 = manual step() only
+    revoke_deadline_s: float = 30.0  # cooperative drain budget
+    handback_evals: int = 3  # calm evals before surge units return
+    spike_units: int = 1  # units moved per breach decision
+
+    # serving SLO defaults (a TenantSpec may override per tenant)
+    queue_high: float = 4.0  # mean queued/replica that breaches
+    p95_target_s: float = 0.0  # p95 latency target (0 = off)
+
+    # brain loop cadence (0 = manual evaluate_once() only) and the
+    # metric-sample floor below which brain opinions are not adopted
+    brain_eval_s: float = 0.0
+    brain_min_samples: int = 2
+
+    # decision journal (JSONL; empty = in-memory only)
+    journal_path: str = ""
+
+    # HTTP status endpoint client deadline (CLI, drill watchers)
+    status_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        if self.total_units < 2:
+            raise ValueError(
+                f"total_units must be >= 2 (one per tenant floor), got "
+                f"{self.total_units}"
+            )
+        if self.revoke_deadline_s <= 0:
+            raise ValueError("revoke_deadline_s must be > 0")
+        if self.handback_evals < 1:
+            raise ValueError("handback_evals must be >= 1")
+        if self.spike_units < 1:
+            raise ValueError("spike_units must be >= 1")
+        if self.brain_min_samples < 1:
+            raise ValueError("brain_min_samples must be >= 1")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ClusterConfig":
+        """Defaults ← ``DLROVER_CLUSTER_*`` env ← explicit overrides."""
+        kwargs = {}
+        for f in fields(cls):
+            knob = ENV_KNOBS[_CLUSTER_KNOBS[f.name]]
+            val = knob.get()
+            if val is not None:
+                kwargs[f.name] = val
+        kwargs.update(overrides)
+        return cls(**kwargs)

@@ -1,0 +1,229 @@
+"""Tenant registry: priority classes, bounds, and the gang grid.
+
+A **tenant** is one workload holding a revocable share of the pool —
+a serving fleet (``pool/tenants.py::ServingTenant``) or a training job
+(``TrainingTenant`` over a ``LoopTrainingController``). The registry
+binds each tenant's *adapter* (the report/grant/revoke/escalate
+protocol the PR 8 arbiter defined) to a :class:`TenantSpec`:
+
+- **priority**: an integer rank, lower = more important. Ranks come
+  from the operator's priority-class table
+  (``DLROVER_CLUSTER_PRIORITY_CLASSES``, e.g. ``critical=0``,
+  ``preemptible=30``) or are given directly. The scheduler grants
+  deficits in ascending rank order and revokes from the **highest**
+  rank (lowest priority) above floor first.
+- **floor / ceiling**: capacity a tenant is never revoked below /
+  granted above (ceiling 0 = the whole pool). Floors are reserved —
+  their sum must fit the pool.
+- **node_unit**: the gang grid. Every grant/revoke sized against this
+  tenant is snapped to a multiple of ``node_unit`` (a training job can
+  only land on grid worlds; serving replicas use ``node_unit=1``).
+- per-tenant SLO overrides (``queue_high`` / ``p95_target_s``) for
+  serving tenants whose breach thresholds differ from the cluster
+  default.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Union
+
+__all__ = [
+    "SERVE",
+    "TRAIN",
+    "TenantRegistry",
+    "TenantSpec",
+    "parse_priority_classes",
+]
+
+TRAIN = "train"
+SERVE = "serve"
+
+
+def parse_priority_classes(text: str) -> Dict[str, int]:
+    """``"critical=0,high=10"`` → ``{"critical": 0, "high": 10}``."""
+    classes: Dict[str, int] = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"priority class {part!r} is not name=rank"
+            )
+        name, _, rank = part.partition("=")
+        classes[name.strip()] = int(rank)
+    return classes
+
+
+def resolve_priority(
+    value: Union[int, str], classes: Dict[str, int]
+) -> int:
+    """A priority is a class name from the table or a bare rank."""
+    if isinstance(value, int):
+        return value
+    text = str(value).strip()
+    if text in classes:
+        return classes[text]
+    try:
+        return int(text)
+    except ValueError:
+        raise ValueError(
+            f"unknown priority class {text!r} "
+            f"(known: {sorted(classes)})"
+        ) from None
+
+
+@dataclass
+class TenantSpec:
+    """Scheduling contract for one tenant (docs/cluster.md)."""
+
+    name: str
+    kind: str  # TRAIN | SERVE
+    priority: int = 20  # rank; lower = more important
+    floor: int = 0  # never revoked below
+    ceiling: int = 0  # never granted above (0 = whole pool)
+    node_unit: int = 1  # gang grid for grants/revokes
+    # SLO overrides for serving tenants (None = cluster default)
+    queue_high: Optional[float] = None
+    p95_target_s: Optional[float] = None
+    # whether idle free units may be parked here absent an explicit
+    # target (the pool's "reclaim" branch); None = kind == TRAIN
+    expandable: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.kind not in (TRAIN, SERVE):
+            raise ValueError(
+                f"tenant {self.name!r}: kind must be "
+                f"{TRAIN!r}|{SERVE!r}, got {self.kind!r}"
+            )
+        if self.node_unit < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: node_unit must be >= 1"
+            )
+        if self.floor < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: floor must be >= 0"
+            )
+        if self.floor % self.node_unit:
+            raise ValueError(
+                f"tenant {self.name!r}: floor {self.floor} off the "
+                f"node_unit={self.node_unit} grid"
+            )
+        if self.ceiling and self.ceiling % self.node_unit:
+            raise ValueError(
+                f"tenant {self.name!r}: ceiling {self.ceiling} off "
+                f"the node_unit={self.node_unit} grid"
+            )
+        if self.ceiling and self.floor > self.ceiling:
+            raise ValueError(
+                f"tenant {self.name!r}: floor above ceiling"
+            )
+        if self.expandable is None:
+            self.expandable = self.kind == TRAIN
+
+    @classmethod
+    def parse(
+        cls, entry: str, classes: Dict[str, int]
+    ) -> "TenantSpec":
+        """One ``DLROVER_CLUSTER_TENANTS`` entry:
+        ``name:kind:priority[:floor[:ceiling[:node_unit]]]``."""
+        parts = [p.strip() for p in entry.split(":")]
+        if len(parts) < 3:
+            raise ValueError(
+                f"tenant spec {entry!r}: need at least "
+                "name:kind:priority"
+            )
+        kw: Dict[str, Any] = {
+            "name": parts[0],
+            "kind": parts[1],
+            "priority": resolve_priority(parts[2], classes),
+        }
+        for field_name, idx in (
+            ("floor", 3),
+            ("ceiling", 4),
+            ("node_unit", 5),
+        ):
+            if len(parts) > idx and parts[idx]:
+                kw[field_name] = int(parts[idx])
+        return cls(**kw)
+
+
+class TenantRegistry:
+    """Name → (spec, adapter) with roster-level validation.
+
+    The adapter is anything speaking the pool tenant protocol:
+    ``initial_units`` (attr), ``report()``, ``grant(units)``,
+    ``revoke(units, deadline_s, on_released)``, ``escalate(units)``.
+    ``ServingTenant`` / ``TrainingTenant`` qualify unchanged — the
+    registry is how the PR 8 two-tenant pool generalizes without a
+    new tenant-side contract.
+    """
+
+    def __init__(self, priority_classes: Optional[Dict[str, int]] = None):
+        self.priority_classes = dict(priority_classes or {})
+        self._specs: Dict[str, TenantSpec] = {}
+        self._adapters: Dict[str, Any] = {}
+        self._order: List[str] = []  # registration order, for ties
+
+    @classmethod
+    def from_config(cls, cfg) -> "TenantRegistry":
+        """Registry pre-seeded with specs parsed from
+        ``cfg.tenants`` (adapters attached later via ``attach``)."""
+        reg = cls(parse_priority_classes(cfg.priority_classes))
+        for entry in (cfg.tenants or "").split(";"):
+            entry = entry.strip()
+            if entry:
+                spec = TenantSpec.parse(entry, reg.priority_classes)
+                reg.register(spec, adapter=None)
+        return reg
+
+    def register(self, spec: TenantSpec, adapter: Any) -> TenantSpec:
+        if spec.name in self._specs:
+            raise ValueError(
+                f"tenant {spec.name!r} already registered"
+            )
+        self._specs[spec.name] = spec
+        self._adapters[spec.name] = adapter
+        self._order.append(spec.name)
+        return spec
+
+    def attach(self, name: str, adapter: Any) -> None:
+        if name not in self._specs:
+            raise KeyError(f"unknown tenant {name!r}")
+        self._adapters[name] = adapter
+
+    def names(self) -> List[str]:
+        return list(self._order)
+
+    def specs(self) -> List[TenantSpec]:
+        return [self._specs[n] for n in self._order]
+
+    def spec(self, name: str) -> TenantSpec:
+        return self._specs[name]
+
+    def adapter(self, name: str) -> Any:
+        return self._adapters.get(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def validate(self, total_units: int) -> None:
+        """Roster-level invariants against one pool inventory."""
+        floors = sum(s.floor for s in self._specs.values())
+        if floors > total_units:
+            raise ValueError(
+                f"tenant floors exceed the pool: {floors} > "
+                f"{total_units}"
+            )
+        for s in self._specs.values():
+            if s.ceiling > total_units:
+                raise ValueError(
+                    f"tenant {s.name!r}: ceiling {s.ceiling} above "
+                    f"the pool ({total_units})"
+                )
+
+    def ceiling(self, name: str, total_units: int) -> int:
+        c = self._specs[name].ceiling
+        return c if c > 0 else total_units

@@ -1,0 +1,1063 @@
+"""ClusterScheduler: N prioritized tenants on one chip pool.
+
+Generalization of the PR 8 two-tenant ``pool/arbiter.py`` to a
+registry of N tenants with priority classes. The split is the same —
+a **pure policy function** (:func:`schedule`, the N-tenant analogue of
+``pool/arbiter.py::decide``, unit-testable on plain dicts) and a
+**ledger executor** (:class:`ClusterScheduler`) that owns the unit
+inventory, issues revocable leases, and keeps every transition
+journaled — but the policy is now a *preemption cascade*:
+
+- **Demand resolution**: each tenant's effective target comes from its
+  live signals (serving breach/calm, the pool's SLO rules per tenant)
+  and from **brain-emitted targets** (``set_target``, fed by
+  ``brain_loop.BrainFeedback``) — not from static knobs. Targets are
+  clamped to [floor, ceiling] and snapped to the tenant's gang grid.
+- **Cascade order**: the highest-priority tenant in deficit claims
+  first; capacity comes from the free pool, then from **voluntary
+  surplus** (tenants whose own target is below their holding — calm
+  handback), then by **involuntary preemption strictly ordered from
+  the lowest-priority tenant above floor upward**. A tenant never
+  involuntarily preempts an equal- or higher-priority tenant.
+- **One move in flight per tenant**: a tenant with a pending lease
+  (outbound revoke or inbound grant) is excluded from this round —
+  the cascade advances lease by lease, every step attributable.
+- Deadline escalation, ledger honesty (only actually-freed units move
+  the ledger; failed grants roll back), and the journal discipline
+  are reused from PR 8 via :class:`common.journal.DecisionJournal`.
+
+Locking discipline (inherited from the pool): ``_mu`` guards the
+ledger/journal only; every tenant call and fault-injection hook runs
+outside it. ``_step_mu`` serializes whole evaluations.
+
+Observability: with ``trace_incidents=True`` the scheduler opens one
+incident trace per cascade (``cluster_breach`` → ``cluster_decision``
+→ per-victim ``cluster_revoke`` spans → ``cluster_grant``), which
+``tpurun-trace`` tiles into per-phase costs (docs/observability.md).
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..attribution.phases import PhaseAccumulator
+from ..chaos import faults
+from ..common.events import EventEmitter
+from ..common.journal import DecisionJournal
+from ..common.log import logger
+from ..observability import trace
+from .config import ClusterConfig
+from .registry import SERVE, TenantRegistry
+
+__all__ = ["ClusterScheduler", "ClusterLease", "schedule"]
+
+
+class LeaseState:
+    REVOKING = "revoking"
+    RELEASED = "released"
+    ESCALATED = "escalated"
+
+
+@dataclass
+class ClusterLease:
+    """One in-flight revocation (the pool's Lease, plus the span that
+    makes the drain visible inside the cascade trace)."""
+
+    lease_id: int
+    tenant: str
+    units: int
+    deadline_t: float
+    grant_to: str = ""
+    reason: str = ""
+    state: str = LeaseState.REVOKING
+    created_t: float = field(default_factory=time.monotonic)
+    released_units: int = 0
+    span: Any = None  # cluster_revoke DurationSpan (or None)
+
+    def snapshot(self) -> Dict:
+        return {
+            "lease_id": self.lease_id,
+            "tenant": self.tenant,
+            "units": self.units,
+            "state": self.state,
+            "grant_to": self.grant_to,
+            "reason": self.reason,
+            "age_s": round(time.monotonic() - self.created_t, 3),
+            "deadline_in_s": round(
+                self.deadline_t - time.monotonic(), 3
+            ),
+        }
+
+
+def _snap_down(units: int, grid: int) -> int:
+    return (units // grid) * grid
+
+
+def _snap_up(units: int, grid: int) -> int:
+    return -(-units // grid) * grid
+
+
+def _serve_demand(t: Dict, cfg: ClusterConfig):
+    """(target, calm_streak, reason) for one serving tenant view."""
+    held = t["held"]
+    sig = t.get("signals")
+    queue_high = t.get("queue_high")
+    if queue_high is None:
+        queue_high = cfg.queue_high
+    p95_target = t.get("p95_target_s")
+    if p95_target is None:
+        p95_target = cfg.p95_target_s
+    if sig is None or sig.get("ready", 0) == 0:
+        # nothing healthy to measure: never arbitrate blind (the
+        # fleet autoscaler's rule, applied cluster-wide)
+        return held, 0, "no serving signal"
+    queue_mean = sig.get("queue_mean") or 0.0
+    p95 = sig.get("p95_worst_s")
+    over_queue = queue_mean >= queue_high
+    over_latency = (
+        p95_target > 0 and p95 is not None and p95 > p95_target
+    )
+    brain = t.get("target")
+    if over_queue or over_latency:
+        want = held + cfg.spike_units
+        if brain is not None:
+            # a live breach outranks a stale brain opinion, but a
+            # brain target ABOVE the spike step is adopted whole
+            want = max(want, brain)
+        reason = (
+            f"queue_mean={queue_mean:.2f}"
+            if over_queue
+            else f"p95={p95:.3f}s>{p95_target:.3f}s"
+        )
+        return want, 0, reason
+    calm_now = (
+        queue_mean == 0
+        and sig.get("busy_total", 0) == 0
+        and (p95_target <= 0 or p95 is None or p95 < p95_target / 2)
+    )
+    if brain is not None:
+        # brain opinion with no breach: adopt it as the demand; the
+        # calm streak keeps its own clock for the hysteresis fallback
+        streak = t.get("calm_streak", 0) + 1 if calm_now else 0
+        return brain, streak, "brain target"
+    if not calm_now:
+        return held, 0, "active, within SLO"
+    streak = t.get("calm_streak", 0) + 1
+    surge = held - max(t["floor"], t.get("baseline", 0))
+    if streak >= cfg.handback_evals and surge > 0:
+        want = held - min(cfg.spike_units, surge)
+        return want, streak, f"calm for {streak} evals"
+    return held, streak, f"calm ({streak} evals)"
+
+
+def _train_demand(t: Dict, cfg: ClusterConfig):
+    brain = t.get("target")
+    if brain is not None:
+        return brain, 0, "brain target"
+    return t["held"], 0, "hold"
+
+
+def schedule(
+    tenants: List[Dict], free: int, cfg: ClusterConfig
+) -> Dict[str, Any]:
+    """Pure policy: one evaluation's move (or none).
+
+    Each tenant view is a plain dict::
+
+        {"name", "kind": "train"|"serve", "priority": int,
+         "floor", "ceiling", "node_unit", "held",
+         "target": Optional[int],      # brain/explicit demand
+         "signals": Optional[dict],    # serve: fleet_signals shape
+         "calm_streak": int, "baseline": int,
+         "busy": bool,                 # lease in flight
+         "expandable": bool,
+         "attached": bool,             # adapter present (default True)
+         "queue_high"/"p95_target_s": Optional per-tenant SLO}
+
+    Returns ``{"action": "grant"|"release"|None, "tenant", "units",
+    "from_free", "victims": [{"tenant", "units"}...], "reason",
+    "calm": {name: streak}, "demand": {name: effective_target}}`` —
+    one decision covering the whole cascade: grant ``units`` to
+    ``tenant``, drawing ``from_free`` from the pool and the rest by
+    revoking each listed victim; a ``release`` drains ``units`` from
+    ``tenant`` back to the free ledger (no grant leg). Kept free of
+    ledger and tenant state so every branch is unit-testable on plain
+    dicts.
+    """
+    out: Dict[str, Any] = {
+        "action": None,
+        "tenant": "",
+        "units": 0,
+        "from_free": 0,
+        "victims": [],
+        "reason": "",
+        "calm": {},
+        "demand": {},
+    }
+    views: Dict[str, Dict] = {}
+    demand: Dict[str, int] = {}
+    why: Dict[str, str] = {}
+    for t in tenants:
+        name = t["name"]
+        views[name] = t
+        if t["kind"] == SERVE:
+            want, streak, reason = _serve_demand(t, cfg)
+        else:
+            want, streak, reason = _train_demand(t, cfg)
+        # clamp to bounds, snap to the tenant's own gang grid
+        want = max(t["floor"], min(want, t["ceiling"]))
+        want = _snap_down(want, t["node_unit"])
+        want = max(t["floor"], want)
+        demand[name] = want
+        why[name] = reason
+        out["calm"][name] = streak
+    out["demand"] = dict(demand)
+
+    def _order(items):
+        # ascending rank = most important first; registration order
+        # (list position) breaks ties deterministically
+        index = {t["name"]: i for i, t in enumerate(tenants)}
+        return sorted(
+            items, key=lambda t: (t["priority"], index[t["name"]])
+        )
+
+    claimants = _order(
+        t
+        for t in tenants
+        if not t.get("busy") and demand[t["name"]] > t["held"]
+    )
+    stuck_reason = ""
+    for c in claimants:
+        move = _gather(c, tenants, demand, free, cfg, out)
+        if move is not None:
+            out.update(move)
+            out["reason"] = f"{c['name']}: {why[c['name']]}"
+            return out
+        if not stuck_reason:
+            stuck_reason = (
+                f"{c['name']}: breach but no capacity movable"
+            )
+
+    # idle placement (the pool's "reclaim" branch): unowned free units
+    # and voluntary surplus flow to the best expandable tenant so
+    # capacity never strands in the free ledger. Tenants with an
+    # explicit target are SKIPPED: their demand is brain-owned, and
+    # greedily lifting one above its target would immediately make it
+    # a voluntary victim — two targeted tenants then trade the same
+    # unit every round (grant↔handback livelock) until a new target
+    # breaks the tie. Unattached tenants (declared but no adapter yet)
+    # are skipped too: the grant could only ever be journaled as
+    # grant_skipped, repeating forever and starving the release branch.
+    for c in _order(
+        t
+        for t in tenants
+        if not t.get("busy")
+        and t.get("expandable")
+        and t.get("attached", True)
+        and t.get("target") is None
+        and t["held"] < t["ceiling"]
+        and demand[t["name"]] <= t["held"]  # not already a claimant
+    ):
+        grid = c["node_unit"]
+        headroom = _snap_down(c["ceiling"] - c["held"], grid)
+        take = min(free, headroom)
+        take = _snap_down(take, grid)
+        if take > 0:
+            out.update(
+                action="grant",
+                tenant=c["name"],
+                units=take,
+                from_free=take,
+                victims=[],
+                reason=f"{c['name']}: reclaim {free} free unit(s)",
+            )
+            return out
+        # no free units (or below grid): voluntary surplus handback
+        vol = _voluntary_victims(
+            c, tenants, demand, headroom, out
+        )
+        if vol:
+            total = sum(v["units"] for v in vol)
+            out.update(
+                action="grant",
+                tenant=c["name"],
+                units=total,
+                from_free=0,
+                victims=vol,
+                reason=f"{c['name']}: handback",
+            )
+            for v in vol:
+                out["calm"][v["tenant"]] = 0
+            return out
+
+    # surplus with no recipient: when every expandable tenant is
+    # brain-capped (or at ceiling), a serve tenant's calm handback and
+    # a trainer's shrink target still have to land somewhere — the
+    # lease drains cooperatively as usual, the freed units just have
+    # no grant leg and return to the FREE ledger. Without this branch
+    # the surge stays with its tenant forever once the brain owns
+    # every trainer's size.
+    for d in sorted(
+        (
+            t
+            for t in tenants
+            if not t.get("busy")
+            and t["held"] > max(t["floor"], demand[t["name"]])
+        ),
+        key=lambda t: -t["priority"],
+    ):
+        give = d["held"] - max(d["floor"], demand[d["name"]])
+        give = _snap_down(give, d["node_unit"])
+        if give <= 0:
+            continue
+        out.update(
+            action="release",
+            tenant=d["name"],
+            units=give,
+            from_free=0,
+            victims=[],
+            reason=f"{d['name']}: release {give} surplus unit(s)",
+        )
+        out["calm"][d["name"]] = 0
+        return out
+
+    out["reason"] = stuck_reason or "all tenants at target"
+    return out
+
+
+def _voluntary_victims(
+    claimant: Dict,
+    tenants: List[Dict],
+    demand: Dict[str, int],
+    cap: int,
+    out: Dict,
+) -> List[Dict]:
+    """Victims offering surplus (demand < held) for an idle-placement
+    grant — lowest priority first, never below max(floor, demand)."""
+    victims: List[Dict] = []
+    remaining = cap
+    for v in sorted(
+        (
+            t
+            for t in tenants
+            if t is not claimant
+            and not t.get("busy")
+            and t["held"] > max(t["floor"], demand[t["name"]])
+        ),
+        key=lambda t: -t["priority"],
+    ):
+        if remaining <= 0:
+            break
+        give = v["held"] - max(v["floor"], demand[v["name"]])
+        take = min(remaining, give)
+        take = _snap_down(take, v["node_unit"])
+        if take <= 0:
+            continue
+        victims.append({"tenant": v["name"], "units": take})
+        remaining -= take
+    return victims
+
+
+def _gather(
+    claimant: Dict,
+    tenants: List[Dict],
+    demand: Dict[str, int],
+    free: int,
+    cfg: ClusterConfig,
+    out: Dict,
+) -> Optional[Dict]:
+    """Source one claimant's move: free pool → voluntary surplus →
+    involuntary preemption (strictly lower priority, lowest first).
+    Returns the move dict or None when nothing can be assembled."""
+    grid = claimant["node_unit"]
+    deficit = demand[claimant["name"]] - claimant["held"]
+    headroom = claimant["ceiling"] - claimant["held"]
+    # per-move cap: one attributable spike step, but never below the
+    # claimant's gang grid (a grid tenant cannot take less than one
+    # node_unit slice)
+    move = min(deficit, headroom, max(cfg.spike_units, grid))
+    move = _snap_down(move, grid)
+    if move <= 0:
+        return None
+    from_free = min(free, move)
+    remaining = move - from_free
+    victims: List[Dict] = []
+    if remaining > 0:
+        cands = []
+        for i, v in enumerate(tenants):
+            if v is claimant or v.get("busy"):
+                continue
+            voluntary = max(
+                0, v["held"] - max(v["floor"], demand[v["name"]])
+            )
+            if v["priority"] > claimant["priority"]:
+                give = v["held"] - v["floor"]
+            else:
+                # equal/higher priority: only what it volunteers
+                give = voluntary
+            if give <= 0:
+                continue
+            # lowest-priority first; among equals, voluntary surplus
+            # before involuntary revocation
+            cands.append((-v["priority"], 0 if voluntary else 1, i, v, give))
+        cands.sort()
+        for _, _, _, v, give in cands:
+            if remaining <= 0:
+                break
+            take = min(remaining, give)
+            # snap UP to the victim's gang grid (its shrink ladder can
+            # only land on grid worlds; the excess returns to the free
+            # pool), then clamp back inside what it can give
+            take = _snap_up(take, v["node_unit"])
+            if take > give:
+                take = _snap_down(give, v["node_unit"])
+            if take <= 0:
+                continue
+            victims.append({"tenant": v["name"], "units": take})
+            remaining -= take
+    gathered = from_free + sum(v["units"] for v in victims)
+    if gathered <= 0:
+        return None
+    if remaining > 0 and gathered < grid:
+        # a gang claimant cannot use a partial slice
+        return None
+    for v in victims:
+        out["calm"][v["tenant"]] = 0
+    return {
+        "action": "grant",
+        "tenant": claimant["name"],
+        "units": gathered,
+        "from_free": from_free,
+        "victims": victims,
+    }
+
+
+class ClusterScheduler:
+    """Owns the N-tenant unit ledger; issues and reclaims leases.
+
+    Tenants come from a :class:`TenantRegistry`; adapters speak the
+    pool tenant protocol. Initial holdings are each adapter's
+    ``initial_units`` (or the spec floor), and must fit the pool.
+    """
+
+    def __init__(
+        self,
+        registry: TenantRegistry,
+        config: Optional[ClusterConfig] = None,
+        trace_incidents: bool = False,
+        exporter=None,
+    ):
+        self.cfg = config or ClusterConfig.from_env()
+        self.registry = registry
+        registry.validate(self.cfg.total_units)
+        self.trace_incidents = trace_incidents
+        self._mu = threading.Lock()
+        self._alloc: Dict[str, int] = {}
+        for spec in registry.specs():
+            adapter = registry.adapter(spec.name)
+            held = int(getattr(adapter, "initial_units", 0) or 0)
+            self._alloc[spec.name] = held or spec.floor
+        total_held = sum(self._alloc.values())
+        if total_held > self.cfg.total_units:
+            raise ValueError(
+                "tenants hold more units than the pool: "
+                f"{total_held} > {self.cfg.total_units}"
+            )
+        self._free = self.cfg.total_units - total_held
+        self._baseline: Dict[str, int] = {
+            s.name: self._alloc[s.name]
+            for s in registry.specs()
+            if s.kind == SERVE
+        }
+        self._calm: Dict[str, int] = {n: 0 for n in registry.names()}
+        self._targets: Dict[str, Dict] = {}
+        self._pending: List[ClusterLease] = []
+        self._next_lease_id = 0
+        self._journal = DecisionJournal(self.cfg.journal_path)
+        self.last_signals: Dict[str, Optional[Dict]] = {}
+        self.last_verdict: Dict[str, Any] = {}
+        self.last_adopt_s: Optional[float] = None
+        self.evaluations = 0
+        self.revokes = 0
+        self.grants = 0
+        self.escalations = 0
+        self.adoptions = 0
+        self.phases = PhaseAccumulator()
+        # an explicit exporter pins the event sink per scheduler (the
+        # drill aims it at its own dir so tpurun-trace can merge the
+        # cascade without depending on the process-global default)
+        self._emitter = EventEmitter("cluster", exporter=exporter)
+        # serializes whole evaluations (periodic loop vs POST
+        # /cluster/step), the pool's _step_mu discipline
+        self._step_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ledger views ----------------------------------------------------
+
+    def allocations(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._alloc)
+
+    def free_units(self) -> int:
+        with self._mu:
+            return self._free
+
+    def pending_leases(self) -> List[ClusterLease]:
+        with self._mu:
+            return list(self._pending)
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until no revocation is in flight (drill/test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                if not self._pending:
+                    return True
+            if self._stop.wait(0.05):
+                with self._mu:
+                    return not self._pending
+        return False
+
+    # -- journal ---------------------------------------------------------
+
+    def _record(self, event: str, **detail) -> Dict:
+        """Journal one ledger event (caller may hold ``_mu``)."""
+        return self._journal.record(
+            event, self._alloc, self._free, **detail
+        )
+
+    def journal(self, tail: int = 0) -> List[Dict]:
+        with self._mu:
+            return self._journal.tail(tail)
+
+    # -- brain targets ---------------------------------------------------
+
+    def set_target(
+        self, name: str, units: int, source: str = "brain"
+    ) -> None:
+        """Adopt a per-tenant target world as demand. Raises on an
+        unknown tenant, and surfaces a chaos-injected failure to the
+        caller (the brain loop journals and survives it)."""
+        if name not in self.registry:
+            raise KeyError(f"unknown tenant {name!r}")
+        faults.inject("cluster.brain_target", tenant=name, units=units)
+        with self._mu:
+            prev = self._targets.get(name)
+            if prev is not None and prev["units"] == units:
+                return  # unchanged opinion keeps its adoption clock
+            self._targets[name] = {
+                "units": int(units),
+                "source": source,
+                "set_t": time.monotonic(),
+                "adopted": False,
+            }
+            self._record(
+                "target", tenant=name, units=int(units), source=source
+            )
+            if self._alloc.get(name, 0) >= int(units):
+                # a target at or below current holdings is satisfied
+                # the moment it lands (a SHRINK opinion is demand the
+                # scheduler meets by not defending the surplus) —
+                # adoption latency zero, and the grant path never runs
+                self._targets[name]["adopted"] = True
+                self.adoptions += 1
+                self.last_adopt_s = 0.0
+                self._record(
+                    "target_adopted", tenant=name, adopt_s=0.0
+                )
+
+    def clear_target(self, name: str) -> None:
+        with self._mu:
+            self._targets.pop(name, None)
+
+    def targets(self) -> Dict[str, Dict]:
+        with self._mu:
+            return {
+                n: {"units": t["units"], "source": t["source"]}
+                for n, t in self._targets.items()
+            }
+
+    # -- signal collection -----------------------------------------------
+
+    def _collect(self, name: str) -> Optional[Dict]:
+        adapter = self.registry.adapter(name)
+        if adapter is None:
+            return None
+        try:
+            return adapter.report()
+        except Exception as e:  # noqa: BLE001 — one dark report
+            logger.warning("cluster: %s report failed: %r", name, e)
+            with self._mu:
+                self._record(
+                    "report_error", tenant=name, error=repr(e)[:200]
+                )
+            return None
+
+    # -- policy loop -----------------------------------------------------
+
+    def step(self) -> Dict:
+        """One evaluate→decide→execute round; returns the verdict."""
+        with self._step_mu:
+            return self._step_locked()
+
+    def _step_locked(self) -> Dict:
+        self.evaluations += 1
+        signals = {
+            name: self._collect(name) for name in self.registry.names()
+        }
+        self.last_signals = signals
+        self._check_deadlines()
+        try:
+            # chaos hook: an errored evaluation models a scheduler
+            # whose control plane is dark — it must skip the round,
+            # never wedge or move capacity it did not decide on
+            faults.inject("cluster.schedule")
+        except Exception as e:  # noqa: BLE001 — injected
+            with self._mu:
+                self._record("schedule_error", error=repr(e)[:200])
+            return {
+                "action": None,
+                "reason": f"schedule error: {e!r}",
+            }
+        with self._mu:
+            if len(self._pending) >= len(self.registry):
+                return {
+                    "action": None,
+                    "reason": "all tenants busy",
+                    "pending": [l.snapshot() for l in self._pending],
+                }
+            busy = {l.tenant for l in self._pending}
+            busy |= {l.grant_to for l in self._pending if l.grant_to}
+            views = [
+                self._tenant_view(spec, signals.get(spec.name), busy)
+                for spec in self.registry.specs()
+            ]
+            free = self._free
+        verdict = schedule(views, free, self.cfg)
+        self.last_verdict = verdict
+        with self._mu:
+            self._calm.update(verdict.get("calm", {}))
+        if verdict["action"] == "grant":
+            self._execute(verdict)
+        elif verdict["action"] == "release":
+            self._execute_release(verdict)
+        return verdict
+
+    def _tenant_view(
+        self, spec, sig: Optional[Dict], busy
+    ) -> Dict[str, Any]:
+        """Build one policy-input dict (caller holds ``_mu``)."""
+        target = self._targets.get(spec.name)
+        return {
+            "name": spec.name,
+            "kind": spec.kind,
+            "priority": spec.priority,
+            "floor": spec.floor,
+            "ceiling": self.registry.ceiling(
+                spec.name, self.cfg.total_units
+            ),
+            "node_unit": spec.node_unit,
+            "held": self._alloc[spec.name],
+            "target": target["units"] if target else None,
+            "signals": sig,
+            "calm_streak": self._calm.get(spec.name, 0),
+            "baseline": self._baseline.get(spec.name, 0),
+            "busy": spec.name in busy,
+            "expandable": spec.expandable,
+            "attached": self.registry.adapter(spec.name) is not None,
+            "queue_high": spec.queue_high,
+            "p95_target_s": spec.p95_target_s,
+        }
+
+    def _execute(self, verdict: Dict) -> None:
+        claimant = verdict["tenant"]
+        victims = verdict.get("victims", [])
+        if self.trace_incidents and victims and trace.current() is None:
+            trace.start_incident()
+        if victims:
+            self._emitter.instant(
+                "cluster_breach",
+                tenant=claimant,
+                units=verdict["units"],
+                reason=verdict["reason"],
+            )
+        self._emitter.instant(
+            "cluster_decision",
+            tenant=claimant,
+            units=verdict["units"],
+            from_free=verdict["from_free"],
+            victims=victims,
+            reason=verdict["reason"],
+        )
+        with self._mu:
+            self._record(
+                "decision",
+                tenant=claimant,
+                units=verdict["units"],
+                from_free=verdict["from_free"],
+                victims=victims,
+                reason=verdict["reason"],
+            )
+        if verdict["from_free"]:
+            self._grant(
+                claimant,
+                verdict["from_free"],
+                reason=verdict["reason"],
+            )
+        for v in victims:
+            self._revoke(
+                v["tenant"],
+                v["units"],
+                grant_to=claimant,
+                reason=verdict["reason"],
+            )
+
+    def _execute_release(self, verdict: Dict) -> None:
+        """A no-recipient shrink: revoke with no grant leg — the
+        drained units land in the free ledger (``_on_released`` /
+        ``_escalate`` skip the grant when ``grant_to`` is empty)."""
+        donor = verdict["tenant"]
+        self._emitter.instant(
+            "cluster_decision",
+            tenant=donor,
+            units=verdict["units"],
+            from_free=0,
+            victims=[{"tenant": donor, "units": verdict["units"]}],
+            reason=verdict["reason"],
+        )
+        with self._mu:
+            self._record(
+                "decision",
+                tenant=donor,
+                units=verdict["units"],
+                from_free=0,
+                victims=[{"tenant": donor, "units": verdict["units"]}],
+                reason=verdict["reason"],
+            )
+        self._revoke(
+            donor, verdict["units"], grant_to="", reason=verdict["reason"]
+        )
+
+    def _check_deadlines(self) -> None:
+        with self._mu:
+            overdue = [
+                l
+                for l in self._pending
+                if time.monotonic() > l.deadline_t
+            ]
+        for lease in overdue:
+            self._escalate(lease)
+
+    # -- moves (the pool's lease machine, keyed by tenant name) ----------
+
+    def _revoke(
+        self, frm: str, units: int, grant_to: str, reason: str
+    ) -> None:
+        adapter = self.registry.adapter(frm)
+        if adapter is None:
+            with self._mu:
+                self._alloc[frm] -= units
+                self._free += units
+                self._record(
+                    "release", tenant=frm, units=units, reason="no adapter"
+                )
+            if grant_to:
+                self._grant(grant_to, units, reason=reason)
+            return
+        t0 = time.perf_counter()
+        with self._mu:
+            held = self._alloc[frm]
+            lease = ClusterLease(
+                lease_id=self._next_lease_id,
+                tenant=frm,
+                units=units,
+                deadline_t=time.monotonic()
+                + self.cfg.revoke_deadline_s,
+                grant_to=grant_to,
+                reason=reason,
+            )
+            self._next_lease_id += 1
+            self._pending.append(lease)
+            self.revokes += 1
+            self._record(
+                "revoke",
+                lease_id=lease.lease_id,
+                tenant=frm,
+                units=units,
+                grant_to=grant_to,
+                reason=reason,
+                deadline_s=self.cfg.revoke_deadline_s,
+            )
+        # the drain leg of the cascade trace: from/to "rungs" are the
+        # victim's world before/after, so tpurun-trace labels each
+        # victim's cost (reshard_transitions)
+        lease.span = self._emitter.duration(
+            "cluster_revoke",
+            tenant=frm,
+            units=units,
+            lease_id=lease.lease_id,
+            from_rung=f"{frm}@{held}",
+            to_rung=f"{frm}@{held - units}",
+        ).begin()
+        try:
+            adapter.revoke(
+                units,
+                self.cfg.revoke_deadline_s,
+                lambda released=units, _l=lease: self._on_released(
+                    _l, released
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — dispatch failed: the
+            # deadline still stands; escalation reclaims at expiry
+            logger.warning(
+                "cluster: revoke dispatch to %s failed: %r", frm, e
+            )
+            with self._mu:
+                self._record(
+                    "revoke_error",
+                    lease_id=lease.lease_id,
+                    tenant=frm,
+                    error=repr(e)[:200],
+                )
+        self.phases.add("revoke", time.perf_counter() - t0)
+
+    def _on_released(self, lease: ClusterLease, released: int) -> None:
+        """Tenant-side confirmation (tenant drain thread). ``released``
+        may EXCEED the leased units — a gang shrink can only land on
+        grid worlds — and the ledger moves by what was actually freed
+        (the grant stays clamped; excess sits in the free pool)."""
+        with self._mu:
+            if lease.state != LeaseState.REVOKING:
+                self._record(
+                    "late_release",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    units=released,
+                )
+                return
+            lease.state = LeaseState.RELEASED
+            lease.released_units = released
+            self._pending.remove(lease)
+            self._alloc[lease.tenant] -= released
+            self._free += released
+            drain_s = time.monotonic() - lease.created_t
+            self._record(
+                "release",
+                lease_id=lease.lease_id,
+                tenant=lease.tenant,
+                units=released,
+                drain_s=round(drain_s, 3),
+            )
+        if lease.span is not None:
+            lease.span.end({"released": released})
+        self.phases.add("drain", drain_s)
+        if lease.grant_to and released > 0:
+            self._grant(
+                lease.grant_to,
+                min(released, lease.units),
+                reason=lease.reason,
+            )
+
+    def _escalate(self, lease: ClusterLease) -> None:
+        """Cooperative drain missed its deadline: force the reclaim."""
+        adapter = self.registry.adapter(lease.tenant)
+        with self._mu:
+            if lease.state != LeaseState.REVOKING:
+                return
+            lease.state = LeaseState.ESCALATED
+            self.escalations += 1
+            self._record(
+                "escalate",
+                lease_id=lease.lease_id,
+                tenant=lease.tenant,
+                units=lease.units,
+                overdue_s=round(
+                    time.monotonic() - lease.deadline_t, 3
+                ),
+            )
+        freed = 0
+        try:
+            freed = int(adapter.escalate(lease.units))
+        except Exception as e:  # noqa: BLE001 — even the hard path
+            # failed: journal it; the units stay with the tenant (the
+            # ledger never claims capacity nobody actually freed)
+            logger.error(
+                "cluster: escalation on %s failed: %r",
+                lease.tenant,
+                e,
+            )
+            with self._mu:
+                self._record(
+                    "escalate_error",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    error=repr(e)[:200],
+                )
+        with self._mu:
+            if lease in self._pending:
+                self._pending.remove(lease)
+            lease.released_units = freed
+            self._alloc[lease.tenant] -= freed
+            self._free += freed
+            drain_s = time.monotonic() - lease.created_t
+            if freed:
+                self._record(
+                    "escalate_freed",
+                    lease_id=lease.lease_id,
+                    tenant=lease.tenant,
+                    units=freed,
+                    drain_s=round(drain_s, 3),
+                )
+        if lease.span is not None:
+            lease.span.end({"released": freed, "escalated": True})
+        self.phases.add("drain", drain_s)
+        if lease.grant_to and freed > 0:
+            self._grant(
+                lease.grant_to,
+                min(freed, lease.units),
+                reason=lease.reason,
+            )
+
+    def _grant(self, to: str, units: int, reason: str) -> None:
+        adapter = self.registry.adapter(to)
+        ceiling = self.registry.ceiling(to, self.cfg.total_units)
+        with self._mu:
+            # clamp to the FREE ledger too, not just the ceiling: a
+            # drain-thread release and a concurrent step() can both
+            # try to place the same freed units — whichever grant runs
+            # second must find them spent, never drive _free negative
+            grantable = min(
+                units, ceiling - self._alloc.get(to, 0), self._free
+            )
+            if adapter is None or grantable <= 0:
+                self._record(
+                    "grant_skipped",
+                    tenant=to,
+                    units=units,
+                    reason=reason,
+                )
+                return
+            units = grantable
+            self._alloc[to] += units
+            self._free -= units
+            self.grants += 1
+            self._record("grant", tenant=to, units=units, reason=reason)
+            adopt_s = self._note_adoption_locked(to)
+        span = self._emitter.duration(
+            "cluster_grant", tenant=to, units=units, reason=reason
+        ).begin()
+        t0 = time.perf_counter()
+        try:
+            adapter.grant(units)
+        except Exception as e:  # noqa: BLE001 — the tenant could not
+            # apply the capacity: roll the ledger back to free so a
+            # later eval can retry the move
+            logger.warning("cluster: grant to %s failed: %r", to, e)
+            span.fail(repr(e)[:200])
+            with self._mu:
+                self._alloc[to] -= units
+                self._free += units
+                self._record(
+                    "grant_error",
+                    tenant=to,
+                    units=units,
+                    error=repr(e)[:200],
+                )
+            return
+        span.end({"adopt_s": adopt_s} if adopt_s is not None else None)
+        self.phases.add("grant", time.perf_counter() - t0)
+
+    def _note_adoption_locked(self, to: str) -> Optional[float]:
+        """Brain-target adoption latency: first grant that lifts the
+        tenant to (or past) its target closes the adoption clock.
+        Caller holds ``_mu``."""
+        target = self._targets.get(to)
+        if (
+            target is None
+            or target["adopted"]
+            or self._alloc[to] < target["units"]
+        ):
+            return None
+        target["adopted"] = True
+        adopt_s = time.monotonic() - target["set_t"]
+        self.adoptions += 1
+        self.last_adopt_s = adopt_s
+        self._record(
+            "target_adopted",
+            tenant=to,
+            units=target["units"],
+            source=target["source"],
+            adopt_s=round(adopt_s, 3),
+        )
+        return round(adopt_s, 6)
+
+    # -- status ----------------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._mu:
+            out = {
+                "total_units": self.cfg.total_units,
+                "allocations": dict(self._alloc),
+                "free": self._free,
+                "pending": [l.snapshot() for l in self._pending],
+                "calm": dict(self._calm),
+                "targets": {
+                    n: {
+                        "units": t["units"],
+                        "source": t["source"],
+                        "adopted": t["adopted"],
+                    }
+                    for n, t in self._targets.items()
+                },
+                "counters": {
+                    "evaluations": self.evaluations,
+                    "revokes": self.revokes,
+                    "grants": self.grants,
+                    "escalations": self.escalations,
+                    "adoptions": self.adoptions,
+                },
+                "journal_tail": self._journal.tail(20),
+            }
+        out["signals"] = self.last_signals
+        out["phase_split"] = self.phases.split().summary()
+        out["tenants"] = {
+            s.name: {
+                "kind": s.kind,
+                "priority": s.priority,
+                "floor": s.floor,
+                "ceiling": self.registry.ceiling(
+                    s.name, self.cfg.total_units
+                ),
+                "node_unit": s.node_unit,
+            }
+            for s in self.registry.specs()
+        }
+        return out
+
+    # -- periodic driver -------------------------------------------------
+
+    def start(self) -> "ClusterScheduler":
+        """Periodic evaluation at ``eval_interval_s`` (0 = manual
+        ``step()`` only — start() is then a no-op)."""
+        if self.cfg.eval_interval_s <= 0:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="cluster-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — scheduler survives
+                logger.exception("cluster scheduler error: %s", e)
+            self._stop.wait(self.cfg.eval_interval_s)

@@ -406,4 +406,18 @@ ENV_KNOBS: Dict[str, EnvKnob] = _knobs(
     EnvKnob("DLROVER_POOL_P95_TARGET_S", "float", doc="chip pool: serving p95 latency target that preempts training (0 disables)"),
     EnvKnob("DLROVER_POOL_JOURNAL", doc="chip pool: decision-journal JSONL path (empty = in-memory only)"),
     EnvKnob("DLROVER_POOL_STATUS_TIMEOUT_S", "float", doc="chip pool: /pool/status HTTP client deadline"),
+    # -- multi-tenant cluster scheduler (dlrover_tpu/cluster/, docs/cluster.md)
+    EnvKnob("DLROVER_CLUSTER_TOTAL_UNITS", "int", doc="cluster scheduler: device-capacity units in the shared pool"),
+    EnvKnob("DLROVER_CLUSTER_TENANTS", doc="cluster scheduler: tenant declarations, 'name:kind:priority[:floor[:ceiling[:node_unit]]]' joined by ';'"),
+    EnvKnob("DLROVER_CLUSTER_PRIORITY_CLASSES", doc="cluster scheduler: named priority ranks, 'critical=0,high=10,...' (lower = more important)"),
+    EnvKnob("DLROVER_CLUSTER_EVAL_INTERVAL_S", "float", doc="cluster scheduler: evaluation interval (0 = manual stepping)"),
+    EnvKnob("DLROVER_CLUSTER_REVOKE_DEADLINE_S", "float", doc="cluster scheduler: cooperative drain budget before escalation"),
+    EnvKnob("DLROVER_CLUSTER_HANDBACK_EVALS", "int", doc="cluster scheduler: consecutive calm evaluations before a serve tenant returns surge units"),
+    EnvKnob("DLROVER_CLUSTER_SPIKE_UNITS", "int", doc="cluster scheduler: units moved per preemption-cascade decision"),
+    EnvKnob("DLROVER_CLUSTER_QUEUE_HIGH", "float", doc="cluster scheduler: default mean queued-per-replica threshold that starts a cascade"),
+    EnvKnob("DLROVER_CLUSTER_P95_TARGET_S", "float", doc="cluster scheduler: default serving p95 latency target that starts a cascade (0 disables)"),
+    EnvKnob("DLROVER_CLUSTER_BRAIN_EVAL_S", "float", doc="cluster scheduler: brain feedback poll/evaluate interval (0 = manual)"),
+    EnvKnob("DLROVER_CLUSTER_BRAIN_MIN_SAMPLES", "int", doc="cluster scheduler: metric samples a job needs before brain targets it"),
+    EnvKnob("DLROVER_CLUSTER_JOURNAL", doc="cluster scheduler: decision-journal JSONL path (empty = in-memory only)"),
+    EnvKnob("DLROVER_CLUSTER_STATUS_TIMEOUT_S", "float", doc="cluster scheduler: /cluster/status HTTP client deadline"),
 )

@@ -188,12 +188,17 @@ class DurationSpan:
         self._begin_time: Optional[float] = None
         self._ended = False
         self._trace_token = None
+        self._span_ctx = None
 
     def begin(self) -> "DurationSpan":
         self._begin_time = time.time()
         # Child span for the duration: begin/end share a span_id and
         # events emitted inside nest under it in the merged trace.
         self._trace_token = trace.push_child()
+        # Remember the child context so end() can re-enter it even on
+        # a different thread (revoke issued on the scheduler's eval
+        # thread, release confirmed on the tenant's drain thread).
+        self._span_ctx = trace.current() if self._trace_token else None
         self._emitter.emit(self.name, EventType.BEGIN, self.content)
         return self
 
@@ -206,9 +211,14 @@ class DurationSpan:
             content.update(extra)
         if self._begin_time is not None:
             content["duration_s"] = round(time.time() - self._begin_time, 6)
+        reenter = None
+        if self._span_ctx is not None and trace.current() is not self._span_ctx:
+            reenter = trace.enter(self._span_ctx)
         self._emitter.emit(self.name, EventType.END, content)
+        trace.release(reenter)
         trace.release(self._trace_token)
         self._trace_token = None
+        self._span_ctx = None
 
     def fail(self, error: str) -> None:
         self.end({"error": error, "success": False})

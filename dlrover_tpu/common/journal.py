@@ -1,0 +1,159 @@
+"""Decision journal: in-memory ring + optional JSONL file.
+
+Extracted from ``pool/arbiter.py`` (PR 8) so the N-tenant cluster
+scheduler (``cluster/scheduler.py``) reuses the exact same discipline
+instead of re-implementing it:
+
+- every ledger transition is journaled with a monotonically increasing
+  ``seq`` and a full ``alloc``/``free`` snapshot, so any single entry
+  is sufficient to reconstruct the ledger at that point;
+- the file append is a single ``O_APPEND`` ``os.write`` (atomic under
+  ``PIPE_BUF``, the fault-log discipline) — concurrent writers can
+  never interleave partial lines;
+- the in-memory ring is bounded (``JOURNAL_KEEP``); the JSONL file
+  keeps everything and is the replay source after a crash.
+
+``replay()`` folds a journal back into ledger state and surfaces
+**open leases** — revokes that never reached a terminal event — which
+is how a scheduler restarted mid-cascade learns which moves died with
+it (tests/test_cluster.py crash-replay table).
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Union
+
+__all__ = ["DecisionJournal", "JOURNAL_KEEP", "load_journal", "replay"]
+
+# ring bound: decisions are low-rate (one per eval at most); 1000
+# entries cover hours of arbitration — the JSONL file keeps all
+JOURNAL_KEEP = 1000
+
+# journal events that close a revoke lease. ``escalate`` is terminal
+# even when it frees nothing: the ledger moved once (by ``freed``,
+# possibly 0) and a later cooperative release is journaled as
+# ``late_release`` and ignored. ``revoke_error`` is NOT terminal —
+# the deadline still stands and escalation will close the lease.
+_LEASE_TERMINAL = ("release", "escalate")
+
+
+class DecisionJournal:
+    """Bounded ring of ledger events with an optional JSONL sink.
+
+    Not internally locked: callers hold their own ledger mutex across
+    ``record`` (the pool/cluster ``_mu`` discipline) so ``seq`` order
+    matches ledger order.
+    """
+
+    def __init__(self, path: str = "", keep: int = JOURNAL_KEEP):
+        self.path = path
+        self.keep = keep
+        self._seq = 0
+        self._entries: List[Dict] = []
+
+    def record(
+        self, event: str, alloc: Dict[str, int], free: int, **detail: Any
+    ) -> Dict:
+        """Journal one ledger event. The file append is a single
+        O_APPEND write, never a blocking wait."""
+        entry = {
+            "ts": round(time.time(), 3),
+            "seq": self._seq,
+            "event": event,
+            "alloc": dict(alloc),
+            "free": free,
+            **detail,
+        }
+        self._seq += 1
+        self._entries.append(entry)
+        if len(self._entries) > self.keep:
+            del self._entries[: -self.keep]
+        if self.path:
+            try:
+                line = (json.dumps(entry) + "\n").encode()
+                fd = os.open(
+                    self.path,
+                    os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                    0o644,
+                )
+                try:
+                    os.write(fd, line)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass  # the in-memory ring still exists
+        return entry
+
+    def tail(self, n: int = 0) -> List[Dict]:
+        return list(self._entries[-n:] if n else self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+def load_journal(path: str) -> List[Dict]:
+    """Read a journal JSONL back; tolerates a torn final line (the
+    crash may have died mid-append on a filesystem without the
+    PIPE_BUF guarantee)."""
+    entries: List[Dict] = []
+    try:
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail
+    except OSError:
+        return []
+    return entries
+
+
+def replay(source: Union[str, List[Dict]]) -> Dict:
+    """Fold a journal into the ledger state it describes.
+
+    Every entry snapshots ``alloc``/``free`` at record time, so the
+    final ledger is simply the last entry's snapshot; the work here is
+    the **open-lease audit**: a ``revoke`` with no terminal event means
+    the process died while a drain was in flight — the capacity is
+    still attributed to the victim tenant (the ledger never moved) and
+    the restarted scheduler must re-issue the move, not assume it
+    completed.
+    """
+    entries = load_journal(source) if isinstance(source, str) else source
+    out: Dict[str, Any] = {
+        "alloc": {},
+        "free": 0,
+        "last_seq": -1,
+        "events": len(entries),
+        "open_leases": [],
+    }
+    if not entries:
+        return out
+    last = entries[-1]
+    out["alloc"] = dict(last.get("alloc", {}))
+    out["free"] = last.get("free", 0)
+    out["last_seq"] = last.get("seq", -1)
+    opened: Dict[int, Dict] = {}
+    for e in entries:
+        lease_id = e.get("lease_id")
+        if lease_id is None:
+            continue
+        if e.get("event") == "revoke":
+            opened[lease_id] = e
+        elif e.get("event") in _LEASE_TERMINAL:
+            opened.pop(lease_id, None)
+    out["open_leases"] = [
+        {
+            "lease_id": lid,
+            "tenant": e.get("tenant", ""),
+            "units": e.get("units", 0),
+            "grant_to": e.get("grant_to", ""),
+            "reason": e.get("reason", ""),
+        }
+        for lid, e in sorted(opened.items())
+    ]
+    return out

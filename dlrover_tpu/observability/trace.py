@@ -142,6 +142,19 @@ def release(token: Optional["contextvars.Token"]) -> None:
         _scoped.set(None)
 
 
+def enter(ctx: SpanContext) -> "contextvars.Token":
+    """Scoped re-entry of an EXISTING span context (same span_id).
+
+    ``adopt`` mints a fresh child span — right for a servicer handling
+    someone else's request, wrong for the second half of a span pair:
+    a ``DurationSpan`` whose begin and end run on different threads
+    (the cluster scheduler issues a revoke on its eval thread; the
+    tenant's drain thread confirms the release) must stamp the SAME
+    span_id on both events or the merger cannot pair them
+    (``trace_merge.reshard_transitions``)."""
+    return _scoped.set(ctx)
+
+
 def push_child() -> Optional["contextvars.Token"]:
     """Enter a child span of the current context (DurationSpan begin);
     returns None when no trace is active."""

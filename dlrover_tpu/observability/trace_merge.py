@@ -35,18 +35,30 @@ from typing import Dict, List, Optional, Tuple
 
 # Milestone vocabulary: event names produced by the runtime (agents,
 # master, trainers, chaos harness). Order of the tiling is fixed; each
-# set marks the END of the phase named in _PHASE_KEYS.
-FAULT_NAMES = {"chaos_kill", "fatal_signal", "crash", "process_fail", "node_fail"}
+# set marks the END of the phase named in _PHASE_KEYS. The cluster
+# scheduler's preemption cascade maps onto the same chain: breach
+# (fault) → decision (detect) → victim drains (reshard; labeled
+# per-victim spans) → claimant grant (resume), so one cascade reads as
+# one incident with per-phase costs.
+FAULT_NAMES = {
+    "chaos_kill",
+    "fatal_signal",
+    "crash",
+    "process_fail",
+    "node_fail",
+    "cluster_breach",
+}
 DETECT_NAMES = {
     "incident_detected",
     "node_relaunch",
     "process_restart",
     "worker_failure",
     "membership_changed",
+    "cluster_decision",
 }
 RDZV_NAMES = {"rendezvous", "rendezvous_complete"}
-RESHARD_NAMES = {"ckpt_load", "train_restore", "live_reshard"}
-RESUME_NAMES = {"train_resume"}
+RESHARD_NAMES = {"ckpt_load", "train_restore", "live_reshard", "cluster_revoke"}
+RESUME_NAMES = {"train_resume", "cluster_grant"}
 
 _PHASE_KEYS = ("detect_s", "rendezvous_s", "reshard_s", "recompile_s")
 

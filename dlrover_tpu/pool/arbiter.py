@@ -38,8 +38,6 @@ hook runs outside it (snapshot-under-lock / act-outside — the
 PodScaler incident class).
 """
 
-import json
-import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -47,17 +45,14 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..attribution.phases import PhaseAccumulator
 from ..chaos import faults
+from ..common.journal import JOURNAL_KEEP, DecisionJournal
 from ..common.log import logger
 from .config import PoolConfig
 
-__all__ = ["ChipPoolArbiter", "Lease", "LeaseState", "decide"]
+__all__ = ["ChipPoolArbiter", "Lease", "LeaseState", "decide", "JOURNAL_KEEP"]
 
 TRAINING = "training"
 SERVING = "serving"
-
-# journal ring bound: decisions are low-rate (one per eval at most);
-# 1000 entries cover hours of arbitration — the JSONL file keeps all
-JOURNAL_KEEP = 1000
 
 
 class LeaseState:
@@ -251,8 +246,7 @@ class ChipPoolArbiter:
         self._pending: List[Lease] = []
         self._next_lease_id = 0
         self._calm_streak = 0
-        self._seq = 0
-        self._journal: List[Dict] = []
+        self._journal = DecisionJournal(self.cfg.journal_path)
         self.last_signals: Dict[str, Optional[Dict]] = {}
         self.evaluations = 0
         self.revokes = 0
@@ -295,39 +289,17 @@ class ChipPoolArbiter:
     # -- journal ---------------------------------------------------------
 
     def _record(self, event: str, **detail) -> Dict:
-        """Journal one ledger event. Caller may hold ``_mu`` — the file
-        append is a single O_APPEND write (atomic under PIPE_BUF, the
-        fault-log discipline), never a blocking wait."""
-        entry = {
-            "ts": round(time.time(), 3),
-            "seq": self._seq,
-            "event": event,
-            "alloc": dict(self._alloc),
-            "free": self._free,
-            **detail,
-        }
-        self._seq += 1
-        self._journal.append(entry)
-        if len(self._journal) > JOURNAL_KEEP:
-            del self._journal[: -JOURNAL_KEEP]
-        path = self.cfg.journal_path
-        if path:
-            try:
-                line = (json.dumps(entry) + "\n").encode()
-                fd = os.open(
-                    path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
-                )
-                try:
-                    os.write(fd, line)
-                finally:
-                    os.close(fd)
-            except OSError:
-                pass  # the in-memory journal still exists
-        return entry
+        """Journal one ledger event. Caller may hold ``_mu`` — the
+        shared :class:`DecisionJournal` append is a single O_APPEND
+        write (atomic under PIPE_BUF, the fault-log discipline), never
+        a blocking wait."""
+        return self._journal.record(
+            event, self._alloc, self._free, **detail
+        )
 
     def journal(self, tail: int = 0) -> List[Dict]:
         with self._mu:
-            return list(self._journal[-tail:] if tail else self._journal)
+            return self._journal.tail(tail)
 
     # -- signal collection -----------------------------------------------
 
@@ -651,7 +623,7 @@ class ChipPoolArbiter:
                     "grants": self.grants,
                     "escalations": self.escalations,
                 },
-                "journal_tail": list(self._journal[-20:]),
+                "journal_tail": self._journal.tail(20),
             }
         out["signals"] = self.last_signals
         out["phase_split"] = self.phases.split().summary()

@@ -53,9 +53,10 @@ __all__ = [
 class ServingTenant:
     """Units = serving replicas, applied through the fleet supervisor."""
 
-    name = "serving"
-
-    def __init__(self, supervisor):
+    def __init__(self, supervisor, name: str = "serving"):
+        # the registry key under a multi-tenant scheduler (cluster/):
+        # several fleets share one pool, so the adapter carries which
+        self.name = name
         self.sup = supervisor
         self.initial_units = len(supervisor.replicas())
         # the in-flight revoke's victim rids: escalation must finish
@@ -144,10 +145,9 @@ class ServingTenant:
 class TrainingTenant:
     """Units = training worker-hosts at ``node_unit`` granularity."""
 
-    name = "training"
-
     def __init__(self, controller, node_unit: int = 1,
-                 floor_units: int = 0):
+                 floor_units: int = 0, name: str = "training"):
+        self.name = name
         self.controller = controller
         self.node_unit = max(1, node_unit)
         # the pool's train_floor, enforced on the GRID: decide()

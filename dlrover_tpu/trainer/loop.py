@@ -53,7 +53,8 @@ class ElasticTrainLoop:
     ``step_fn(state, *batch) -> (state, loss)``; ``data_iter`` yields
     batch tuples. The loop:
     - restores via ``load_consistent`` (cross-host step agreement),
-    - stages every step to shm, persists every ``storage_every`` steps,
+    - stages every step to shm, persists every ``storage_every`` steps
+      (0 disables disk persistence — shm staging only),
     - reports steps to the master (PerfMonitor / goodput / hang check),
     - stops at ``max_steps`` and waits for pending persists.
     """
@@ -83,7 +84,12 @@ class ElasticTrainLoop:
         self.ctx = ctx
         self.max_steps = max_steps
         self.memory_every = max(1, memory_every)
-        self.storage_every = max(1, storage_every)
+        # 0 disables storage persistence entirely (shm staging only):
+        # in-process multi-tenant rigs share one agent saver, and a
+        # second engine's queued disk save can starve behind the
+        # first's event loop — a loop that never persists must not
+        # block its exit-path wait_saving on it either
+        self.storage_every = max(0, storage_every)
         self.log_every = max(1, log_every)
         self.on_step = on_step
         self.start_step = 0
@@ -545,7 +551,7 @@ class ElasticTrainLoop:
             # once and the engine degrades itself back to blocking
             # saves. Handoff saves below (pre-remesh, final) stay
             # blocking — they must be durable before proceeding.
-            if step % self.storage_every == 0:
+            if self.storage_every and step % self.storage_every == 0:
                 last_save_ok = self.engine.save_to_storage(
                     step, state, block=False
                 )

@@ -304,6 +304,17 @@ def _worst_case_extra(bench, tmp_path, monkeypatch):
     extra["pool_escalations"] = 0
     extra["pool_recovered_vs_baseline"] = 0.98
     extra["pool_window_s"] = 10.4
+    # multi-tenant cluster section (docs/cluster.md): the SLO trio must
+    # survive in-line; the supporting scalars may shrink to the sidecar
+    extra["cluster_inversion_avail"] = 1.0
+    extra["cluster_preempt_cascade_s"] = 0.41
+    extra["cluster_brain_adopt_s"] = 0.22
+    extra["cluster_first_victim"] = "train_lo"
+    extra["cluster_adoptions"] = 2
+    extra["cluster_revokes"] = 2
+    extra["cluster_escalations"] = 0
+    extra["cluster_handback"] = True
+    extra["cluster_one_trace"] = True
     # elastic hybrid-parallelism section (docs/elastic_parallelism.md):
     # the DP↔PP trade trio must survive in-line; the transition label
     # and the rung's accum may shrink to the sidecar
@@ -375,20 +386,25 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     assert slim["interposer_overhead_pct"] == (
         extra["interposer_overhead_pct"]
     )
-    # the recovery-SLO matrix rides the line as pointer-style scalars
-    # (the full storm dict with its stall list stays sidecar-only)
+    # the host-fault recovery headline rides the line as pointer-style
+    # scalars (the full storm dict with its stall list stays
+    # sidecar-only)
     assert slim["storm_mttr_s"] == extra["storm_mttr_s"]
-    assert slim["storm_slice_mttr_s"] == extra["storm_slice_mttr_s"]
-    assert slim["storm_slice_goodput"] == extra["storm_slice_goodput"]
     assert slim["storm_goodput"] == extra["storm_goodput"]
     # the MTTR phase breakdown, the detect phase share, and the
     # warm-vs-cold A/B verdict pair moved sidecar-only to seat the
     # paged-KV trio (the first three re-derive from the sidecar's
     # goodput_storm dict — same class as storm_restore_s /
-    # storm_first_step_s before them — the A/B pair from recovery_ab)
+    # storm_first_step_s before them — the A/B pair from recovery_ab);
+    # the slice row of the matrix (storm_slice_mttr_s /
+    # storm_slice_goodput) and the flash_step_s / headline_config pair
+    # moved sidecar-only to seat the cluster trio (slice row from
+    # goodput_storm, the pair from the SILICON headline dict)
     for key in (
         "storm_rdzv_s", "storm_compile_s", "storm_detect_s",
         "recovery_mttr_delta_s", "recovery_warm_compile_s",
+        "storm_slice_mttr_s", "storm_slice_goodput",
+        "flash_step_s", "headline_config",
     ):
         assert key not in slim, key
     assert "recovery_ab" not in slim
@@ -422,6 +438,13 @@ def test_line_budget_worst_case(tmp_path, monkeypatch):
     for key in (
         "pool_preempt_to_ready_s", "pool_spike_availability",
         "pool_train_goodput",
+    ):
+        assert slim[key] == extra[key], key
+    # the multi-tenant cluster SLO trio rides the line (first victim,
+    # counters, and the one-trace flag are sidecar-recoverable)
+    for key in (
+        "cluster_inversion_avail", "cluster_preempt_cascade_s",
+        "cluster_brain_adopt_s",
     ):
         assert slim[key] == extra[key], key
     # the elastic DP↔PP trade trio rides the line (the transition label

@@ -1,0 +1,1247 @@
+"""Multi-tenant cluster scheduler (dlrover_tpu/cluster/, PR 20).
+
+Tier-1 fast synthetics: the pure ``schedule()`` policy on plain dicts
+(priority ordering, preemption cascades, floors/ceilings, gang grids,
+busy exclusion, idle placement), the ``ClusterScheduler`` lease machine
+over scripted tenants, brain-target adoption (``BrainFeedback`` over a
+seeded datastore — targets come from measured scaling curves, not
+static knobs), journal replay of a mid-cascade crash, the chaos
+injection points (``cluster.schedule`` / ``cluster.brain_target``) and
+the ``priority_inversion_storm`` scenario twin. The real-engine
+4-tenant drill is slow-marked at the bottom.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.cluster.config import ClusterConfig
+from dlrover_tpu.cluster.registry import (
+    SERVE,
+    TRAIN,
+    TenantRegistry,
+    TenantSpec,
+    parse_priority_classes,
+)
+from dlrover_tpu.cluster.scheduler import ClusterScheduler, schedule
+
+
+# ---------------------------------------------------------------------------
+# policy-table helpers: plain tenant-view dicts, no scheduler state
+# ---------------------------------------------------------------------------
+
+
+def view(
+    name,
+    kind=TRAIN,
+    priority=20,
+    floor=0,
+    ceiling=8,
+    node_unit=1,
+    held=0,
+    target=None,
+    signals=None,
+    calm_streak=0,
+    baseline=0,
+    busy=False,
+    expandable=None,
+    **extra,
+):
+    v = {
+        "name": name,
+        "kind": kind,
+        "priority": priority,
+        "floor": floor,
+        "ceiling": ceiling,
+        "node_unit": node_unit,
+        "held": held,
+        "target": target,
+        "signals": signals,
+        "calm_streak": calm_streak,
+        "baseline": baseline,
+        "busy": busy,
+        "expandable": kind == TRAIN if expandable is None else expandable,
+    }
+    v.update(extra)
+    return v
+
+
+def breach_sig(queue_mean=8.0, ready=1, busy_total=1, p95=None):
+    return {
+        "ready": ready,
+        "queue_mean": queue_mean,
+        "busy_total": busy_total,
+        "p95_worst_s": p95,
+    }
+
+
+def calm_sig(ready=1):
+    return {
+        "ready": ready,
+        "queue_mean": 0.0,
+        "busy_total": 0,
+        "p95_worst_s": 0.0,
+    }
+
+
+CFG = ClusterConfig(total_units=8, queue_high=2.0)
+
+
+class TestSchedulePolicy:
+    def test_no_demand_no_move(self):
+        out = schedule(
+            [view("a", held=2), view("b", held=2)], free=0, cfg=CFG
+        )
+        assert out["action"] is None
+        assert out["reason"] == "all tenants at target"
+
+    def test_breach_claims_free_pool_first(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("bulk", priority=30, floor=1, held=3),
+            ],
+            free=2,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant"
+        assert out["tenant"] == "svc"
+        assert out["from_free"] == 1  # one spike step, not the pool
+        assert out["victims"] == []
+
+    def test_involuntary_victim_is_lowest_priority_above_floor(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("mid", priority=20, floor=1, held=3),
+                view("low", priority=30, floor=1, held=3),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant" and out["tenant"] == "svc"
+        assert out["victims"] == [{"tenant": "low", "units": 1}]
+
+    def test_victim_at_floor_is_skipped(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("mid", priority=20, floor=1, held=3),
+                view("low", priority=30, floor=1, held=1),  # at floor
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        # low is untouchable; the cascade moves up the priority order
+        assert out["victims"] == [{"tenant": "mid", "units": 1}]
+
+    def test_never_involuntarily_preempts_equal_or_higher(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=10,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("peer", priority=10, floor=1, held=4),
+                view("boss", priority=0, floor=1, held=3),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        # no strictly-lower-priority capacity above floor, no
+        # volunteers: the breach is stuck, not stolen
+        assert out["action"] is None
+        assert "no capacity movable" in out["reason"]
+
+    def test_equal_priority_voluntary_surplus_moves(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=10,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                # a peer whose own brain target is below its holding
+                # volunteers the surplus even at equal priority
+                view("peer", priority=10, floor=1, held=4, target=3),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant" and out["tenant"] == "svc"
+        assert out["victims"] == [{"tenant": "peer", "units": 1}]
+
+    def test_voluntary_before_involuntary_among_equals(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("a", priority=30, floor=1, held=3),  # involuntary
+                view("b", priority=30, floor=1, held=3, target=2),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        # same rank: the volunteer pays before the conscript
+        assert out["victims"] == [{"tenant": "b", "units": 1}]
+
+    def test_priority_orders_competing_claimants(self):
+        out = schedule(
+            [
+                view("hi", priority=0, floor=0, held=0, target=2),
+                view("lo", priority=30, floor=0, held=0, target=2),
+            ],
+            free=2,
+            cfg=CFG,
+        )
+        assert out["tenant"] == "hi"
+
+    def test_registration_order_breaks_priority_ties(self):
+        out = schedule(
+            [
+                view("first", priority=10, held=0, target=1),
+                view("second", priority=10, held=0, target=1),
+            ],
+            free=1,
+            cfg=CFG,
+        )
+        assert out["tenant"] == "first"
+
+    def test_ceiling_clamps_demand(self):
+        out = schedule(
+            [view("t", held=4, ceiling=4, target=6)], free=4, cfg=CFG
+        )
+        assert out["action"] is None  # already at ceiling
+
+    def test_floor_clamps_shrink_target(self):
+        # a brain target below floor is lifted to the floor: no
+        # voluntary surplus below the reserved capacity
+        out = schedule(
+            [
+                view("hungry", priority=0, held=0, target=4),
+                view("t", priority=30, floor=2, held=2, target=0),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["action"] is None
+
+    def test_gang_claimant_snaps_demand_down_to_grid(self):
+        out = schedule(
+            [view("gang", node_unit=2, held=2, target=5)],
+            free=4,
+            cfg=CFG,
+        )
+        # demand 5 → 4 on the grid; one move = one node_unit slice
+        assert out["action"] == "grant"
+        assert out["units"] == 2 and out["from_free"] == 2
+
+    def test_gang_victim_revocation_snaps_up_to_grid(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("gang", priority=30, floor=0, node_unit=2, held=4),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        # svc needs 1 but the gang tenant can only shrink by whole
+        # slices: the revoke is 2, the excess lands in the free pool
+        assert out["victims"] == [{"tenant": "gang", "units": 2}]
+
+    def test_gang_claimant_refuses_partial_slice(self):
+        out = schedule(
+            [
+                view("gang", node_unit=4, held=0, floor=0, target=4),
+                view(
+                    "donor",
+                    priority=30,
+                    floor=0,
+                    held=1,
+                    expandable=False,
+                ),
+            ],
+            free=1,
+            cfg=CFG,
+        )
+        # only 2 units reachable < one node_unit=4 slice: no move
+        assert out["action"] is None
+
+    def test_busy_claimant_excluded(self):
+        out = schedule(
+            [view("t", held=0, target=2, busy=True)], free=2, cfg=CFG
+        )
+        assert out["action"] is None
+
+    def test_busy_victim_excluded(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(),
+                ),
+                view("low", priority=30, floor=1, held=3, busy=True),
+                view("mid", priority=20, floor=1, held=3),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        # the busy tenant's lease is in flight: one move per tenant
+        assert out["victims"] == [{"tenant": "mid", "units": 1}]
+
+    def test_serve_breach_needs_a_ready_replica(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(ready=0),
+                ),
+            ],
+            free=2,
+            cfg=CFG,
+        )
+        assert out["action"] is None  # never arbitrate blind
+
+    def test_serve_p95_breach(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=1,
+                    signals=breach_sig(queue_mean=0.0, p95=1.0),
+                    p95_target_s=0.5,
+                ),
+            ],
+            free=2,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant" and out["tenant"] == "svc"
+        assert "p95" in out["reason"]
+
+    def test_serve_calm_streak_hands_surge_back(self):
+        views = [
+            view(
+                "svc",
+                kind=SERVE,
+                priority=0,
+                floor=1,
+                held=3,
+                baseline=1,
+                signals=calm_sig(),
+                calm_streak=CFG.handback_evals - 1,
+            ),
+            view("train", priority=30, floor=1, held=5),
+        ]
+        out = schedule(views, free=0, cfg=CFG)
+        # svc's demand drops below held → voluntary surplus flows to
+        # the expandable trainer through idle placement
+        assert out["action"] == "grant" and out["tenant"] == "train"
+        assert out["victims"] == [{"tenant": "svc", "units": 1}]
+        assert out["calm"]["svc"] == 0  # streak consumed by the move
+
+    def test_calm_streak_below_hysteresis_holds(self):
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=3,
+                    baseline=1,
+                    signals=calm_sig(),
+                    calm_streak=0,
+                ),
+                view("train", priority=30, floor=1, held=5),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["action"] is None
+        assert out["calm"]["svc"] == 1  # the streak advances
+
+    def test_idle_free_units_reclaimed_by_expandable(self):
+        out = schedule(
+            [
+                view("svc", kind=SERVE, priority=0, floor=1, held=1),
+                view("train", priority=30, floor=1, held=3, ceiling=6),
+            ],
+            free=2,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant" and out["tenant"] == "train"
+        assert out["from_free"] == 2 and out["victims"] == []
+        assert "reclaim" in out["reason"]
+
+    def test_targeted_tenants_never_reclaim_past_target(self):
+        # two brain-targeted trainers sitting AT target with a free
+        # unit: idle placement must leave the unit in the free ledger.
+        # Lifting either above its target would make it a voluntary
+        # victim next round and the pair would trade the unit forever
+        # (grant↔handback livelock).
+        out = schedule(
+            [
+                view("a", held=4, target=4),
+                view("b", priority=30, held=1, target=1),
+            ],
+            free=1,
+            cfg=CFG,
+        )
+        assert out["action"] is None
+
+    def test_idle_placement_skips_unattached_tenants(self):
+        # a declared-but-unattached trainer can only ever produce
+        # grant_skipped — idle placement must not pick it (it would
+        # retry forever and starve the release branch); with no other
+        # recipient the calm surge releases to the free ledger instead
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=2,
+                    baseline=1,
+                    signals=calm_sig(),
+                    calm_streak=CFG.handback_evals - 1,
+                ),
+                view("t", priority=30, held=0, attached=False),
+            ],
+            free=6,
+            cfg=CFG,
+        )
+        assert out["action"] == "release"
+        assert out["tenant"] == "svc" and out["units"] == 1
+
+    def test_surplus_with_no_recipient_releases_to_free(self):
+        # calm serve surge while every trainer is brain-capped: no
+        # idle-placement recipient exists, so the surge must release
+        # back to the free ledger instead of sticking to the fleet
+        out = schedule(
+            [
+                view(
+                    "svc",
+                    kind=SERVE,
+                    priority=0,
+                    floor=1,
+                    held=2,
+                    baseline=1,
+                    signals=calm_sig(),
+                    calm_streak=CFG.handback_evals - 1,
+                ),
+                view("t", held=4, target=4),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["action"] == "release"
+        assert out["tenant"] == "svc" and out["units"] == 1
+        assert out["calm"]["svc"] == 0
+
+    def test_brain_target_replaces_static_hold(self):
+        # without a target a trainer holds; with one, it claims
+        # (expandable off isolates demand from idle reclaim)
+        assert (
+            schedule(
+                [view("t", held=2, expandable=False)], free=4, cfg=CFG
+            )["action"]
+            is None
+        )
+        out = schedule(
+            [view("t", held=2, target=4, expandable=False)],
+            free=4,
+            cfg=CFG,
+        )
+        assert out["action"] == "grant" and out["tenant"] == "t"
+
+    def test_demand_map_reports_effective_targets(self):
+        out = schedule(
+            [
+                view("t", floor=1, ceiling=4, held=2, target=9),
+                view("u", floor=2, held=2, target=0),
+            ],
+            free=0,
+            cfg=CFG,
+        )
+        assert out["demand"] == {"t": 4, "u": 2}  # clamped both ways
+
+
+# ---------------------------------------------------------------------------
+# scheduler lease machine over scripted tenants
+# ---------------------------------------------------------------------------
+
+
+class Scripted:
+    """Pool tenant protocol with scriptable drain behaviour."""
+
+    def __init__(
+        self,
+        initial_units=0,
+        signals=None,
+        drain="instant",
+        grant_error=False,
+        escalate_frees=True,
+    ):
+        self.initial_units = initial_units
+        self.signals = signals
+        self.drain = drain  # "instant" | "never"
+        self.grant_error = grant_error
+        self.escalate_frees = escalate_frees
+        self.granted = []
+        self.revoked = []
+        self.escalated = []
+        self.pending_release = []
+
+    def report(self):
+        return self.signals
+
+    def grant(self, units):
+        if self.grant_error:
+            raise RuntimeError("no capacity applied")
+        self.granted.append(units)
+
+    def revoke(self, units, deadline_s, on_released):
+        self.revoked.append(units)
+        if self.drain == "instant":
+            on_released(units)
+        else:
+            self.pending_release.append((units, on_released))
+
+    def release_all(self):
+        for units, cb in self.pending_release:
+            cb(units)
+        self.pending_release = []
+
+    def escalate(self, units):
+        self.escalated.append(units)
+        return units if self.escalate_frees else 0
+
+
+def two_tenant(svc_sig, cfg=None, drain="instant", **svc_kw):
+    reg = TenantRegistry()
+    svc = Scripted(initial_units=1, signals=svc_sig, **svc_kw)
+    bulk = Scripted(initial_units=3, drain=drain)
+    reg.register(
+        TenantSpec("svc", SERVE, priority=0, floor=1, ceiling=4), svc
+    )
+    reg.register(
+        TenantSpec("bulk", TRAIN, priority=30, floor=1), bulk
+    )
+    sched = ClusterScheduler(
+        reg, cfg or ClusterConfig(total_units=4, queue_high=2.0)
+    )
+    return sched, svc, bulk
+
+
+class TestClusterScheduler:
+    def test_breach_revokes_then_grants(self):
+        sched, svc, bulk = two_tenant(breach_sig())
+        verdict = sched.step()
+        assert verdict["action"] == "grant"
+        assert sched.allocations() == {"svc": 2, "bulk": 2}
+        assert bulk.revoked == [1] and svc.granted == [1]
+        assert sched.revokes == 1 and sched.grants == 1
+        events = [e["event"] for e in sched.journal()]
+        assert events == ["decision", "revoke", "release", "grant"]
+
+    def test_one_move_in_flight_per_tenant(self):
+        sched, svc, bulk = two_tenant(breach_sig(), drain="never")
+        sched.step()
+        # ledger honesty: nothing moved until the drain confirms
+        assert sched.allocations() == {"svc": 1, "bulk": 3}
+        # lease open: both the victim and the claimant are busy, the
+        # breach cannot issue a second overlapping move
+        verdict = sched.step()
+        assert verdict["action"] is None
+        assert bulk.revoked == [1]
+        bulk.release_all()
+        assert sched.allocations() == {"svc": 2, "bulk": 2}
+        assert sched.wait_idle(timeout=1.0)
+
+    def test_deadline_escalation_reclaims(self):
+        cfg = ClusterConfig(
+            total_units=4, queue_high=2.0, revoke_deadline_s=0.05
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg, drain="never")
+        sched.step()
+        svc.signals["queue_mean"] = 0.0  # breach quiets; lease hangs
+        time.sleep(0.08)
+        sched.step()  # deadline check escalates the overdue lease
+        assert bulk.escalated == [1]
+        assert sched.escalations == 1
+        assert sched.allocations() == {"svc": 2, "bulk": 2}
+        events = [e["event"] for e in sched.journal()]
+        assert "escalate" in events and "escalate_freed" in events
+
+    def test_late_release_after_escalation_is_ignored(self):
+        cfg = ClusterConfig(
+            total_units=4, queue_high=2.0, revoke_deadline_s=0.05
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg, drain="never")
+        sched.step()
+        svc.signals["queue_mean"] = 0.0
+        time.sleep(0.08)
+        sched.step()
+        alloc = sched.allocations()
+        bulk.release_all()  # the cooperative drain finally answers
+        assert sched.allocations() == alloc  # ledger moved exactly once
+        assert any(
+            e["event"] == "late_release" for e in sched.journal()
+        )
+
+    def test_failed_grant_rolls_ledger_back(self):
+        sched, svc, bulk = two_tenant(breach_sig(), grant_error=True)
+        sched.step()
+        # the unit was freed but could not be applied: it sits in the
+        # free pool for a later round, never vanishes
+        assert sched.allocations() == {"svc": 1, "bulk": 2}
+        assert sched.free_units() == 1
+        assert any(
+            e["event"] == "grant_error" for e in sched.journal()
+        )
+
+    def test_shrink_target_adopts_immediately(self):
+        sched, svc, bulk = two_tenant(None)
+        sched.set_target("bulk", 2, source="brain")
+        st = sched.status()["targets"]["bulk"]
+        assert st["adopted"] and st["source"] == "brain"
+        assert sched.adoptions == 1 and sched.last_adopt_s == 0.0
+
+    def test_grow_target_adopts_at_the_lifting_grant(self):
+        # a calm serving tenant with a brain GROW target: the target
+        # itself is the demand; bulk's shrink target volunteers the
+        # capacity, and adoption closes at the lifting grant
+        sched, svc, bulk = two_tenant(calm_sig())
+        sched.set_target("svc", 2, source="brain")
+        assert not sched.status()["targets"]["svc"]["adopted"]
+        sched.set_target("bulk", 2, source="brain")
+        sched.step()
+        assert sched.allocations() == {"svc": 2, "bulk": 2}
+        assert sched.status()["targets"]["svc"]["adopted"]
+        assert sched.last_adopt_s is not None
+        assert sched.last_adopt_s > 0.0
+        assert any(
+            e["event"] == "target_adopted" and e["tenant"] == "svc"
+            for e in sched.journal()
+        )
+
+    def test_unknown_tenant_target_raises(self):
+        sched, _, _ = two_tenant(None)
+        with pytest.raises(KeyError):
+            sched.set_target("ghost", 2)
+
+    def test_roster_overcommit_rejected(self):
+        reg = TenantRegistry()
+        reg.register(
+            TenantSpec("a", TRAIN, floor=1), Scripted(initial_units=3)
+        )
+        reg.register(
+            TenantSpec("b", TRAIN, floor=1), Scripted(initial_units=3)
+        )
+        with pytest.raises(ValueError):
+            ClusterScheduler(reg, ClusterConfig(total_units=4))
+
+    def test_status_shape(self):
+        sched, _, _ = two_tenant(calm_sig())
+        sched.step()
+        st = sched.status()
+        assert st["total_units"] == 4
+        assert st["allocations"] == {"svc": 1, "bulk": 3}
+        assert st["counters"]["evaluations"] == 1
+        assert st["tenants"]["svc"]["priority"] == 0
+        assert st["tenants"]["bulk"]["ceiling"] == 4  # 0 = whole pool
+
+
+# ---------------------------------------------------------------------------
+# journal replay: a scheduler crash mid-cascade
+# ---------------------------------------------------------------------------
+
+
+class TestJournalReplay:
+    def test_mid_cascade_crash_surfaces_open_lease(self, tmp_path):
+        from dlrover_tpu.common.journal import replay
+
+        path = str(tmp_path / "journal.jsonl")
+        cfg = ClusterConfig(
+            total_units=4, queue_high=2.0, journal_path=path
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg, drain="never")
+        sched.step()
+        # "crash": the process dies with the drain in flight. The
+        # journal file is all that survives.
+        state = replay(path)
+        # the ledger never moved — capacity is still the victim's
+        assert state["alloc"] == {"svc": 1, "bulk": 3}
+        assert state["free"] == 0
+        assert state["open_leases"] == [
+            {
+                "lease_id": 0,
+                "tenant": "bulk",
+                "units": 1,
+                "grant_to": "svc",
+                "reason": state["open_leases"][0]["reason"],
+            }
+        ]
+
+    def test_completed_cascade_replays_closed(self, tmp_path):
+        from dlrover_tpu.common.journal import replay
+
+        path = str(tmp_path / "journal.jsonl")
+        cfg = ClusterConfig(
+            total_units=4, queue_high=2.0, journal_path=path
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg)
+        sched.step()
+        state = replay(path)
+        assert state["alloc"] == {"svc": 2, "bulk": 2}
+        assert state["open_leases"] == []
+        assert state["last_seq"] == len(sched.journal()) - 1
+
+    def test_escalated_lease_is_terminal(self, tmp_path):
+        from dlrover_tpu.common.journal import replay
+
+        path = str(tmp_path / "journal.jsonl")
+        cfg = ClusterConfig(
+            total_units=4,
+            queue_high=2.0,
+            revoke_deadline_s=0.05,
+            journal_path=path,
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg, drain="never")
+        sched.step()
+        svc.signals["queue_mean"] = 0.0  # breach quiets; lease hangs
+        time.sleep(0.08)
+        sched.step()
+        state = replay(path)
+        assert state["open_leases"] == []
+        assert state["alloc"] == {"svc": 2, "bulk": 2}
+
+    def test_replay_tolerates_torn_tail(self, tmp_path):
+        from dlrover_tpu.common.journal import replay
+
+        path = str(tmp_path / "journal.jsonl")
+        cfg = ClusterConfig(
+            total_units=4, queue_high=2.0, journal_path=path
+        )
+        sched, svc, bulk = two_tenant(breach_sig(), cfg=cfg)
+        sched.step()
+        with open(path, "a") as f:
+            f.write('{"event": "gra')  # died mid-append
+        state = replay(path)
+        assert state["alloc"] == {"svc": 2, "bulk": 2}
+
+
+# ---------------------------------------------------------------------------
+# registry / config parsing
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryConfig:
+    def test_priority_classes_parse(self):
+        classes = parse_priority_classes("critical=0, high=10,low=30")
+        assert classes == {"critical": 0, "high": 10, "low": 30}
+        with pytest.raises(ValueError):
+            parse_priority_classes("not-a-pair")
+
+    def test_tenant_spec_parse_with_class_names(self):
+        classes = {"critical": 0, "preemptible": 30}
+        spec = TenantSpec.parse("api:serve:critical:1:4", classes)
+        assert spec.kind == SERVE and spec.priority == 0
+        assert spec.floor == 1 and spec.ceiling == 4
+        spec = TenantSpec.parse("batch:train:25:2::2", classes)
+        assert spec.priority == 25 and spec.node_unit == 2
+        with pytest.raises(ValueError):
+            TenantSpec.parse("x:serve:no-such-class", classes)
+
+    def test_spec_grid_invariants(self):
+        with pytest.raises(ValueError):
+            TenantSpec("t", TRAIN, floor=3, node_unit=2)
+        with pytest.raises(ValueError):
+            TenantSpec("t", TRAIN, ceiling=3, node_unit=2)
+        with pytest.raises(ValueError):
+            TenantSpec("t", TRAIN, floor=4, ceiling=2)
+        with pytest.raises(ValueError):
+            TenantSpec("t", "batch")
+
+    def test_registry_from_config_roster(self):
+        cfg = ClusterConfig(
+            total_units=8,
+            tenants="api:serve:critical:1:4;batch:train:preemptible:1",
+        )
+        reg = TenantRegistry.from_config(cfg)
+        assert reg.names() == ["api", "batch"]
+        assert reg.spec("api").priority == 0
+        assert reg.spec("batch").priority == 30
+        assert reg.ceiling("batch", cfg.total_units) == 8
+        reg.validate(cfg.total_units)
+        with pytest.raises(ValueError):
+            reg.validate(1)  # floors exceed a 1-unit pool
+
+    def test_duplicate_registration_rejected(self):
+        reg = TenantRegistry()
+        reg.register(TenantSpec("t", TRAIN), None)
+        with pytest.raises(ValueError):
+            reg.register(TenantSpec("t", SERVE), None)
+
+    def test_config_from_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("DLROVER_CLUSTER_TOTAL_UNITS", "16")
+        monkeypatch.setenv("DLROVER_CLUSTER_QUEUE_HIGH", "3.5")
+        monkeypatch.setenv(
+            "DLROVER_CLUSTER_TENANTS", "api:serve:0:1"
+        )
+        cfg = ClusterConfig.from_env(handback_evals=5)
+        assert cfg.total_units == 16
+        assert cfg.queue_high == 3.5
+        assert cfg.tenants == "api:serve:0:1"
+        assert cfg.handback_evals == 5  # explicit override wins
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            ClusterConfig(total_units=1)
+        with pytest.raises(ValueError):
+            ClusterConfig(spike_units=0)
+        with pytest.raises(ValueError):
+            ClusterConfig(revoke_deadline_s=0)
+
+
+# ---------------------------------------------------------------------------
+# brain loop: targets from measured curves, not static knobs
+# ---------------------------------------------------------------------------
+
+
+class DummyController:
+    def __init__(self, world, sps):
+        self._world = world
+        self._sps = sps
+
+    def report(self):
+        return {"world": self._world, "steps_per_s": self._sps}
+
+
+def brain_cluster():
+    from dlrover_tpu.brain.datastore import BrainDataStore
+    from dlrover_tpu.cluster.brain_loop import BrainFeedback
+
+    reg = TenantRegistry()
+    fast = Scripted(initial_units=2)
+    slow = Scripted(initial_units=2)
+    reg.register(
+        TenantSpec("fast", TRAIN, priority=10, floor=1, ceiling=6), fast
+    )
+    reg.register(
+        TenantSpec("slow", TRAIN, priority=30, floor=1, ceiling=6), slow
+    )
+    sched = ClusterScheduler(reg, ClusterConfig(total_units=6))
+    store = BrainDataStore(":memory:")
+    brain = BrainFeedback(sched, store=store, min_samples=2)
+    brain.add_training_job(
+        "fast", DummyController(2, 4.0), model_signature="linear"
+    )
+    brain.add_training_job(
+        "slow", DummyController(2, 2.05), model_signature="saturated"
+    )
+    return sched, brain, store, fast, slow
+
+
+def seed_curves(store):
+    """fast scales linearly to 6 units; slow saturates at 2."""
+    from dlrover_tpu.brain.datastore import JobMetricSample
+
+    for w in range(1, 7):
+        store.add_metric(
+            JobMetricSample(
+                job_uuid="fast", world_size=w, steps_per_second=2.0 * w
+            )
+        )
+    for w, sps in ((1, 2.0), (2, 2.05), (3, 2.08), (4, 2.1)):
+        store.add_metric(
+            JobMetricSample(
+                job_uuid="slow", world_size=w, steps_per_second=sps
+            )
+        )
+
+
+class TestBrainFeedback:
+    def test_without_samples_no_targets(self):
+        sched, brain, store, _, _ = brain_cluster()
+        assert brain.evaluate_once() == {}
+        assert sched.targets() == {}
+
+    def test_poll_feeds_the_scaling_curve(self):
+        sched, brain, store, _, _ = brain_cluster()
+        assert brain.poll_once() == 2
+        rows = store.job_metrics("fast", limit=10)
+        assert len(rows) == 1
+        assert rows[0].steps_per_second == 4.0
+        assert rows[0].world_size == 2
+
+    def test_targets_follow_marginal_gain_not_knobs(self):
+        sched, brain, store, fast, slow = brain_cluster()
+        seed_curves(store)
+        targets = brain.evaluate_once()
+        # the linear scaler gets the spare capacity, the saturated job
+        # is cut to its knee — nothing in any static knob says this
+        assert targets["fast"] > 2
+        assert targets["slow"] <= 2
+        assert brain.emissions == len(targets)
+        src = sched.status()["targets"]
+        assert all(t["source"] == "brain" for t in src.values())
+
+    def test_scheduler_converges_to_brain_targets(self):
+        sched, brain, store, fast, slow = brain_cluster()
+        seed_curves(store)
+        targets = brain.evaluate_once()
+        for _ in range(8):
+            sched.step()
+            if not sched.pending_leases():
+                alloc = sched.allocations()
+                if alloc.get("fast") == targets["fast"]:
+                    break
+        alloc = sched.allocations()
+        assert alloc["fast"] == targets["fast"]
+        assert alloc["slow"] >= 1  # never below floor
+        assert alloc["fast"] + alloc["slow"] <= 6
+        assert sched.adoptions >= 1
+
+    def test_live_caller_of_cluster_resource_arbiter(self):
+        # the acceptance criterion: evaluate_once drives
+        # ClusterResourceArbiter.allocate with real sampled jobs
+        from dlrover_tpu.brain import algorithms
+
+        sched, brain, store, _, _ = brain_cluster()
+        seed_curves(store)
+        calls = {}
+        orig = algorithms.ClusterResourceArbiter.allocate
+
+        def spy(self, job_uuids, total_hosts, node_unit=1):
+            out = orig(self, job_uuids, total_hosts, node_unit)
+            calls["jobs"] = list(job_uuids)
+            calls["hosts"] = total_hosts
+            calls["result"] = dict(out)
+            return out
+
+        algorithms.ClusterResourceArbiter.allocate = spy
+        try:
+            brain.evaluate_once()
+        finally:
+            algorithms.ClusterResourceArbiter.allocate = orig
+        assert calls["jobs"] == ["fast", "slow"]
+        assert calls["hosts"] == 6  # no serving tenants: whole pool
+        assert sum(calls["result"].values()) <= 6
+
+    def test_serving_holdings_shrink_the_train_budget(self):
+        from dlrover_tpu.brain.datastore import BrainDataStore
+        from dlrover_tpu.cluster.brain_loop import BrainFeedback
+
+        reg = TenantRegistry()
+        reg.register(
+            TenantSpec("svc", SERVE, priority=0, floor=2),
+            Scripted(initial_units=2, signals=calm_sig()),
+        )
+        reg.register(
+            TenantSpec("train", TRAIN, priority=30, floor=1),
+            Scripted(initial_units=2),
+        )
+        sched = ClusterScheduler(reg, ClusterConfig(total_units=6))
+        brain = BrainFeedback(
+            sched, store=BrainDataStore(":memory:"), min_samples=1
+        )
+        brain.add_training_job("train", DummyController(2, 1.0))
+        assert brain._train_budget() == 4  # 6 minus svc's 2
+
+    def test_emission_error_survives_and_journals(self):
+        from dlrover_tpu.chaos import faults
+
+        sched, brain, store, _, _ = brain_cluster()
+        seed_curves(store)
+        faults.activate(
+            faults.FaultPlan.parse(
+                "cluster.brain_target:error:dropped@once"
+            )
+        )
+        try:
+            targets = brain.evaluate_once()
+        finally:
+            faults.deactivate()
+        assert targets  # the evaluation itself survived
+        assert brain.target_errors >= 1
+        errs = [
+            e
+            for t in targets
+            for e in store.job_events(t, "brain_target_error")
+        ]
+        assert errs
+
+
+# ---------------------------------------------------------------------------
+# chaos: injection points + the scenario twin
+# ---------------------------------------------------------------------------
+
+
+class TestClusterChaos:
+    def test_injection_points_registered(self):
+        from dlrover_tpu.chaos import faults
+
+        assert "cluster.schedule" in faults.INJECTION_POINTS
+        assert "cluster.brain_target" in faults.INJECTION_POINTS
+
+    def test_dark_schedule_round_skips_without_moving(self):
+        from dlrover_tpu.chaos import faults
+
+        sched, svc, bulk = two_tenant(breach_sig())
+        faults.activate(
+            faults.FaultPlan.parse("cluster.schedule:error:dark@once")
+        )
+        try:
+            verdict = sched.step()
+        finally:
+            faults.deactivate()
+        assert verdict["action"] is None
+        assert "schedule error" in verdict["reason"]
+        assert sched.allocations() == {"svc": 1, "bulk": 3}
+        assert any(
+            e["event"] == "schedule_error" for e in sched.journal()
+        )
+        # the next round decides normally
+        assert sched.step()["action"] == "grant"
+
+    def test_priority_inversion_storm_scenario(self, tmp_path):
+        """The tier-1 synthetic twin of the 4-tenant drill: scripted
+        tenants, a dark scheduler round, a dropped brain emission, and
+        the full cascade — fast enough for every run."""
+        from dlrover_tpu.chaos.scenarios import SCENARIOS
+
+        out = SCENARIOS["priority_inversion_storm"](
+            workdir=str(tmp_path)
+        )
+        assert out["recovered"], out
+        assert out["fired"] >= 2
+        assert out["cascade"] and set(out["cascade"]) == {"train_lo"}
+        assert out["allocations"]["train_hi"] == 4
+
+
+# ---------------------------------------------------------------------------
+# brain datastore: flattened-Prometheus ingestion (the PR 20 fix)
+# ---------------------------------------------------------------------------
+
+
+class TestIngestLabeledGauges:
+    def make_store(self):
+        from dlrover_tpu.brain.datastore import BrainDataStore
+
+        return BrainDataStore(":memory:")
+
+    def test_labeled_series_aggregate_alias_ignored(self):
+        store = self.make_store()
+        sample = store.ingest_gauges(
+            "job-1",
+            {
+                'dlrover_steps_per_second{pod="w0"}': 2.0,
+                'dlrover_steps_per_second{pod="w1"}': 3.0,
+                # the flattener's bare-name alias repeats the LAST
+                # labeled sample — counting it would double w1
+                "dlrover_steps_per_second": 3.0,
+                'dlrover_peak_memory_mb{pod="w0"}': 100.0,
+                'dlrover_peak_memory_mb{pod="w1"}': 200.0,
+                'dlrover_cpu_percent{pod="w0"}': 10.0,
+                'dlrover_cpu_percent{pod="w1"}': 30.0,
+                'dlrover_world_size{pod="w0"}': 2.0,
+                'dlrover_world_size{pod="w1"}': 2.0,
+            },
+        )
+        assert sample is not None
+        assert sample.steps_per_second == 5.0  # sum, not 8.0
+        assert sample.peak_memory_mb == 200.0  # max
+        assert sample.cpu_percent == 20.0  # mean
+        assert sample.world_size == 2  # max
+
+    def test_alias_before_labeled_series_still_ignored(self):
+        store = self.make_store()
+        sample = store.ingest_gauges(
+            "job-1",
+            {
+                # dict order must not matter: alias first
+                "dlrover_tokens_per_second": 30.0,
+                'dlrover_tokens_per_second{pod="w0"}': 10.0,
+                'dlrover_tokens_per_second{pod="w1"}': 30.0,
+            },
+        )
+        assert sample.tokens_per_second == 40.0
+
+    def test_bare_only_family_still_ingests(self):
+        store = self.make_store()
+        sample = store.ingest_gauges(
+            "job-1", {"dlrover_job_steps_per_second": 7.0}
+        )
+        assert sample.steps_per_second == 7.0
+
+    def test_unmapped_keys_store_nothing(self):
+        store = self.make_store()
+        assert (
+            store.ingest_gauges(
+                "job-1", {'unrelated_gauge{x="1"}': 1.0}
+            )
+            is None
+        )
+        assert store.job_metrics("job-1", limit=5) == []
+
+    def test_explicit_world_size_wins(self):
+        store = self.make_store()
+        sample = store.ingest_gauges(
+            "job-1",
+            {'dlrover_steps_per_second{pod="w0"}': 1.0},
+            world_size=4,
+        )
+        assert sample.world_size == 4
+
+
+# ---------------------------------------------------------------------------
+# endpoint handler (tpurun-cluster serve surface)
+# ---------------------------------------------------------------------------
+
+
+class TestClusterEndpoint:
+    def make_server(self):
+        import threading
+        import urllib.request
+
+        from dlrover_tpu.cluster.cli import serve_status
+
+        sched, svc, bulk = two_tenant(breach_sig())
+        httpd = serve_status(sched, port=0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        port = httpd.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        def post(path, body=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body or {}).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return json.loads(r.read())
+
+        return sched, httpd, get, post
+
+    def test_status_journal_step_target(self):
+        sched, httpd, get, post = self.make_server()
+        try:
+            st = get("/cluster/status")
+            assert st["allocations"] == {"svc": 1, "bulk": 3}
+            assert get("/healthz")["total_units"] == 4
+            verdict = post("/cluster/step")
+            assert verdict["action"] == "grant"
+            journal = get("/cluster/journal")["journal"]
+            assert [e["event"] for e in journal][:2] == [
+                "decision",
+                "revoke",
+            ]
+            out = post(
+                "/cluster/target",
+                {"tenant": "bulk", "units": 2, "source": "operator"},
+            )
+            assert out["targets"]["bulk"]["units"] == 2
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_bad_target_is_400(self):
+        import urllib.error
+        import urllib.request
+
+        sched, httpd, get, post = self.make_server()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("/cluster/target", {"tenant": "ghost", "units": 1})
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing (slow tier): 4 tenants, live engines, one trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_priority_inversion_drill(tmp_path, tmp_ipc_dir, monkeypatch):
+    from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+    from dlrover_tpu.cluster.drill import run_priority_inversion_drill
+
+    monkeypatch.setenv("DLROVER_JOB_NAME", f"clusterdrill_{os.getpid()}")
+    AsyncCheckpointSaver.reset()
+    try:
+        out = run_priority_inversion_drill(
+            workdir=str(tmp_path / "drill"), timeout_s=240.0
+        )
+    finally:
+        AsyncCheckpointSaver.reset()
+    assert out["ok"], out
+    assert out["first_victim"] == "train_lo"
+    assert out["availability"] == 1.0
+    assert out["escalations"] == 0
+    assert out["adoptions"] >= 1 and out["brain_adopt_s"] is not None
+    assert out["cascade_one_trace"], out.get("trace")
